@@ -1,0 +1,44 @@
+"""spark_rapids_tpu — a TPU-native columnar SQL/ETL engine.
+
+A ground-up re-design of the RAPIDS Accelerator for Apache Spark
+(reference: /root/reference, open-infrastructure-labs/spark-rapids) for TPU
+hardware.  Where the reference lowers Spark physical plans to libcudf kernels
+called one JNI hop at a time, this framework compiles an entire query stage
+(scan -> filter -> project -> partial aggregate) into a single XLA computation
+over fixed-capacity columnar buffers, and expresses shuffle as a pod-wide
+``shard_map`` all-to-all collective over ICI instead of UCX point-to-point
+transfers.
+
+Layer map (mirrors SURVEY.md section 1 of the reference analysis):
+
+========  ==============================================  =======================
+Layer     This package                                    Reference counterpart
+========  ==============================================  =======================
+L0        XLA / Pallas kernels (``ops/``)                 libcudf + JNI
+L1        ``memory/`` spill catalog, stores, semaphore    RMM + RapidsBufferStore
+L2        ``config/`` typed conf registry                 RapidsConf.scala
+L3        ``plan/`` meta/tagging planner + overrides      GpuOverrides/RapidsMeta
+L4        ``exec/`` columnar physical operators           GpuExec subclasses
+L5        ``parallel/`` mesh shuffle & broadcast          shuffle-plugin (UCX)
+L6        ``io/`` parquet/orc/csv scan & write            GpuParquetScan etc.
+L7        ``udf/`` Python-bytecode -> expression compiler udf-compiler (Scala)
+L9        ``tools/`` qualification & profiling CLIs       tools/
+========  ==============================================  =======================
+"""
+
+from spark_rapids_tpu.version import __version__
+
+from spark_rapids_tpu.columnar.dtypes import (
+    DataType, BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64,
+    STRING, DATE32, TIMESTAMP_US, DecimalType,
+)
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config.rapids_conf import RapidsConf
+
+__all__ = [
+    "__version__",
+    "DataType", "BOOL", "INT8", "INT16", "INT32", "INT64", "FLOAT32",
+    "FLOAT64", "STRING", "DATE32", "TIMESTAMP_US", "DecimalType",
+    "Column", "ColumnarBatch", "RapidsConf",
+]

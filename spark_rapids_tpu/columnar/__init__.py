@@ -1,0 +1,5 @@
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+__all__ = ["DataType", "Column", "ColumnarBatch"]

@@ -1,0 +1,140 @@
+"""ColumnarBatch: a set of equal-length device columns.
+
+Counterpart of Spark's ``ColumnarBatch`` of GpuColumnVectors flowing between
+GpuExecs (SURVEY.md section 1 "data-plane containment").  All columns share one
+logical ``nrows`` and one row capacity; batches flow device-resident between
+TPU operators, and crossing back to the host happens only at explicit
+collect/transition points (exec/collect.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+Schema = Sequence[Tuple[str, DataType]]
+
+
+class ColumnarBatch:
+    __slots__ = ("columns", "nrows")
+
+    def __init__(self, columns: Dict[str, Column], nrows: Optional[int] = None):
+        self.columns: Dict[str, Column] = dict(columns)
+        if nrows is None:
+            if not columns:
+                raise ValueError("empty batch needs explicit nrows")
+            nrows = next(iter(columns.values())).nrows
+        self.nrows = int(nrows)
+        for name, col in self.columns.items():
+            if col.nrows != self.nrows:
+                raise ValueError(
+                    f"column {name} nrows {col.nrows} != batch {self.nrows}")
+
+    # ------------------------------------------------------------------ basics --
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns)
+
+    @property
+    def schema(self) -> List[Tuple[str, DataType]]:
+        return [(n, c.dtype) for n, c in self.columns.items()]
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return bucket_capacity(self.nrows)
+        return next(iter(self.columns.values())).capacity
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def device_size_bytes(self) -> int:
+        return sum(c.device_size_bytes() for c in self.columns.values())
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.dtype}" for n, c in self.columns.items())
+        return f"ColumnarBatch[{self.nrows} rows]({cols})"
+
+    # ------------------------------------------------------------ host interop --
+    @classmethod
+    def from_pydict(cls, data: Dict[str, Sequence],
+                    capacity: Optional[int] = None) -> "ColumnarBatch":
+        nrows = len(next(iter(data.values()))) if data else 0
+        cap = capacity or bucket_capacity(nrows)
+        cols = {}
+        for name, values in data.items():
+            if isinstance(values, Column):
+                cols[name] = values
+                continue
+            arr = np.asarray(values) if not isinstance(values, (list, tuple)) \
+                else values
+            if isinstance(arr, (list, tuple)):
+                if any(isinstance(v, str) or v is None for v in arr) and \
+                        any(isinstance(v, str) for v in arr):
+                    cols[name] = Column.from_strings(arr, capacity=cap)
+                    continue
+                validity = np.array([v is not None for v in arr])
+                filled = [0 if v is None else v for v in arr]
+                cols[name] = Column.from_numpy(
+                    np.asarray(filled), capacity=cap,
+                    validity=None if validity.all() else validity)
+            else:
+                cols[name] = Column.from_numpy(arr, capacity=cap)
+        return cls(cols, nrows)
+
+    @classmethod
+    def from_arrow(cls, table, capacity: Optional[int] = None) -> "ColumnarBatch":
+        nrows = table.num_rows
+        cap = capacity or bucket_capacity(nrows)
+        cols = {name: Column.from_arrow(table.column(name), capacity=cap)
+                for name in table.column_names}
+        return cls(cols, nrows)
+
+    @classmethod
+    def from_pandas(cls, df, capacity: Optional[int] = None) -> "ColumnarBatch":
+        import pyarrow as pa
+        return cls.from_arrow(pa.Table.from_pandas(df, preserve_index=False),
+                              capacity=capacity)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.table({n: c.to_arrow() for n, c in self.columns.items()})
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def to_pydict(self):
+        return {n: c.to_pylist() for n, c in self.columns.items()}
+
+    # --------------------------------------------------------------- reshaping --
+    def select(self, names: Iterable[str]) -> "ColumnarBatch":
+        return ColumnarBatch({n: self.columns[n] for n in names}, self.nrows)
+
+    def rename(self, mapping: Dict[str, str]) -> "ColumnarBatch":
+        return ColumnarBatch({mapping.get(n, n): c
+                              for n, c in self.columns.items()}, self.nrows)
+
+    def with_column(self, name: str, col: Column) -> "ColumnarBatch":
+        cols = dict(self.columns)
+        cols[name] = col
+        return ColumnarBatch(cols, self.nrows)
+
+
+def empty_batch(schema: Schema, capacity: int = 0) -> ColumnarBatch:
+    cap = bucket_capacity(max(capacity, 1))
+    cols = {}
+    for name, dt in schema:
+        if dt.is_string:
+            cols[name] = Column.from_strings([], capacity=cap)
+        else:
+            cols[name] = Column.from_numpy(
+                np.zeros(0, dtype=dt.storage), dtype=dt, capacity=cap)
+    return ColumnarBatch(cols, 0)

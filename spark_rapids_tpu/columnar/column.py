@@ -1,0 +1,243 @@
+"""Device-resident column: the TPU counterpart of GpuColumnVector.
+
+Reference: ``sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:46``
+wraps a device cudf ColumnVector with dynamic length.  XLA wants static shapes,
+so a TPU Column is a *fixed-capacity* device array plus a host-side logical row
+count:
+
+* capacity is bucketed to powers of two (min 1024) so the universe of traced
+  shapes — and therefore XLA recompiles — stays bounded;
+* rows in ``[nrows, capacity)`` are padding with unspecified contents; any
+  row-sensitive kernel (aggregate, sort, compaction, collect) masks them with
+  ``iota < nrows``;
+* null tracking is a separate bool validity array (True = valid), ``None``
+  meaning "no nulls" — the dense equivalent of cudf's validity bitmask.
+
+Strings are a pair of fixed-capacity arrays (int32 offsets[capacity+1] +
+uint8 chars[char_capacity]) mirroring Arrow/cudf layout but padded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+MIN_CAPACITY = 1024
+
+
+def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+    """Round up to the shape bucket: next power of two, floor ``minimum``."""
+    n = max(int(n), 1)
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class Column:
+    """One device column with logical length ``nrows`` and static capacity."""
+
+    __slots__ = ("dtype", "data", "validity", "offsets", "nrows")
+
+    def __init__(self, dtype: DataType, data, nrows: int,
+                 validity=None, offsets=None):
+        self.dtype = dtype
+        self.data = data          # fixed-width values, or uint8 chars for string
+        self.validity = validity  # bool[capacity] or None (all valid)
+        self.offsets = offsets    # int32[capacity+1] for strings else None
+        self.nrows = int(nrows)
+        if dtype.is_string and offsets is None:
+            raise ValueError("string column requires offsets")
+
+    # ------------------------------------------------------------------ shape --
+    @property
+    def capacity(self) -> int:
+        if self.dtype.is_string:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def char_capacity(self) -> int:
+        assert self.dtype.is_string
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def null_count(self) -> int:
+        if self.validity is None:
+            return 0
+        v = np.asarray(self.validity[: self.nrows])
+        return int((~v).sum())
+
+    def device_size_bytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        if self.validity is not None:
+            n += self.validity.size
+        if self.offsets is not None:
+            n += self.offsets.size * 4
+        return int(n)
+
+    # ----------------------------------------------------------- construction --
+    @classmethod
+    def from_numpy(cls, values: np.ndarray, dtype: Optional[DataType] = None,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        """Build a device column from host values (non-string)."""
+        values = np.asarray(values)
+        if values.dtype.kind in ("U", "S", "O"):
+            return cls.from_strings(values.tolist(), validity=validity,
+                                    capacity=capacity)
+        if values.dtype.kind == "M":
+            values = values.astype("datetime64[us]").astype(np.int64)
+            dtype = dtype or dts.TIMESTAMP_US
+        dtype = dtype or dts.from_numpy_dtype(values.dtype)
+        nrows = len(values)
+        cap = capacity or bucket_capacity(nrows)
+        buf = np.zeros(cap, dtype=dtype.storage)
+        buf[:nrows] = values.astype(dtype.storage, copy=False)
+        dev_validity = None
+        if validity is not None:
+            v = np.zeros(cap, dtype=np.bool_)
+            v[:nrows] = validity
+            if not v[:nrows].all():
+                dev_validity = jnp.asarray(v)
+        return cls(dtype, jnp.asarray(buf), nrows, validity=dev_validity)
+
+    @classmethod
+    def from_strings(cls, values: Sequence[Optional[str]],
+                     validity: Optional[np.ndarray] = None,
+                     capacity: Optional[int] = None,
+                     char_capacity: Optional[int] = None) -> "Column":
+        nrows = len(values)
+        valid = np.ones(nrows, dtype=np.bool_)
+        if validity is not None:
+            valid &= np.asarray(validity, dtype=np.bool_)
+        encoded = []
+        for i, s in enumerate(values):
+            if s is None:
+                valid[i] = False
+                encoded.append(b"")
+            else:
+                encoded.append(str(s).encode("utf-8"))
+        offsets = np.zeros(nrows + 1, dtype=np.int32)
+        np.cumsum([len(b) for b in encoded], out=offsets[1:] if nrows else None)
+        total = int(offsets[-1]) if nrows else 0
+        chars = np.frombuffer(b"".join(encoded), dtype=np.uint8) if total else \
+            np.zeros(0, dtype=np.uint8)
+        cap = capacity or bucket_capacity(nrows)
+        ccap = char_capacity or bucket_capacity(max(total, 1))
+        off_buf = np.zeros(cap + 1, dtype=np.int32)
+        off_buf[: nrows + 1] = offsets
+        off_buf[nrows + 1:] = offsets[-1] if nrows else 0
+        char_buf = np.zeros(ccap, dtype=np.uint8)
+        char_buf[:total] = chars
+        dev_validity = None
+        if not valid.all():
+            v = np.zeros(cap, dtype=np.bool_)
+            v[:nrows] = valid
+            dev_validity = jnp.asarray(v)
+        return cls(dts.STRING, jnp.asarray(char_buf), nrows,
+                   validity=dev_validity, offsets=jnp.asarray(off_buf))
+
+    @classmethod
+    def from_arrow(cls, arr, capacity: Optional[int] = None) -> "Column":
+        import pyarrow as pa
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        dtype = dts.from_arrow_type(arr.type)
+        if dtype.is_string:
+            return cls.from_strings(arr.to_pylist(), capacity=capacity)
+        validity = None
+        if arr.null_count:
+            validity = ~np.asarray(arr.is_null())
+        if dtype.is_decimal:
+            ints = [None if v is None else int(v.scaleb(dtype.scale))
+                    for v in arr.to_pylist()]
+            values = np.array([0 if v is None else v for v in ints],
+                              dtype=np.int64)
+        elif dtype.is_timestamp:
+            values = np.asarray(arr.cast(pa.timestamp("us"))).astype(
+                "datetime64[us]").astype(np.int64)
+        elif dtype.is_date:
+            values = np.asarray(arr.cast(pa.int32()))
+        else:
+            np_arr = arr.to_numpy(zero_copy_only=False)
+            if arr.null_count:
+                # to_numpy promotes ints-with-nulls to float NaN; zero the
+                # null slots before casting back to the storage dtype.
+                np_arr = np.where(validity, np_arr, 0)
+            values = np_arr.astype(dtype.storage, copy=False)
+        return cls.from_numpy(values, dtype=dtype, validity=validity,
+                              capacity=capacity)
+
+    # ------------------------------------------------------------- host export --
+    def to_numpy(self) -> np.ndarray:
+        """Valid-length values as numpy; nulls hold unspecified data."""
+        if self.dtype.is_string:
+            raise TypeError("use to_pylist for string columns")
+        return np.asarray(self.data[: self.nrows])
+
+    def validity_numpy(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(self.nrows, dtype=np.bool_)
+        return np.asarray(self.validity[: self.nrows])
+
+    def to_pylist(self):
+        valid = self.validity_numpy()
+        if self.dtype.is_string:
+            offs = np.asarray(self.offsets[: self.nrows + 1])
+            chars = np.asarray(self.data)
+            blob = chars.tobytes()
+            return [blob[offs[i]:offs[i + 1]].decode("utf-8")
+                    if valid[i] else None for i in range(self.nrows)]
+        vals = self.to_numpy()
+        out = []
+        for i in range(self.nrows):
+            if not valid[i]:
+                out.append(None)
+            elif self.dtype.is_decimal:
+                import decimal
+                out.append(decimal.Decimal(int(vals[i])).scaleb(-self.dtype.scale))
+            elif self.dtype.is_boolean:
+                out.append(bool(vals[i]))
+            elif self.dtype.is_floating:
+                out.append(float(vals[i]))
+            else:
+                out.append(int(vals[i]))
+        return out
+
+    def to_arrow(self):
+        import pyarrow as pa
+        at = dts.to_arrow_type(self.dtype)
+        if self.dtype.is_string:
+            return pa.array(self.to_pylist(), type=at)
+        vals = self.to_numpy()
+        valid = self.validity_numpy()
+        if self.dtype.is_timestamp:
+            vals = vals.astype("datetime64[us]")
+        elif self.dtype.is_date:
+            vals = vals.astype("datetime64[D]")
+        elif self.dtype.is_decimal:
+            return pa.array(self.to_pylist(), type=at)
+        mask = None if valid.all() else ~valid
+        return pa.array(vals, type=at, mask=mask)
+
+    # ------------------------------------------------------------------- misc --
+    def with_nrows(self, nrows: int) -> "Column":
+        return Column(self.dtype, self.data, nrows, validity=self.validity,
+                      offsets=self.offsets)
+
+    def __repr__(self) -> str:
+        return (f"Column({self.dtype}, nrows={self.nrows}, "
+                f"capacity={self.capacity}, nulls={self.has_nulls})")

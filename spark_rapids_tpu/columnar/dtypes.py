@@ -1,0 +1,205 @@
+"""Logical data types for TPU columnar batches.
+
+Counterpart of the Spark<->cudf DType mapping in the reference
+(``GpuColumnVector.java:46`` `getNonNestedRapidsType`), re-designed for XLA:
+every logical type maps onto a *storage* dtype that XLA handles natively on
+TPU.  Notable departures from the cudf mapping:
+
+* STRING is not a single device buffer-pair type; the Column stores UTF-8
+  bytes + int32 offsets as two fixed-capacity arrays (see ``strings.py``).
+* DECIMAL follows the reference's DECIMAL_64 restriction (precision <= 18,
+  ``TypeSig.DECIMAL_64`` in TypeChecks.scala): unscaled int64 storage.
+* TIMESTAMP is int64 microseconds, UTC only — the reference refuses
+  non-UTC sessions (SURVEY.md Appendix B), we inherit that contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """A logical column type.
+
+    ``name``     logical name, e.g. ``int`` / ``string`` / ``decimal(10,2)``
+    ``storage``  numpy dtype used for the device representation (strings use
+                 uint8 chars + int32 offsets and set storage to object-free
+                 ``np.uint8`` for the char buffer).
+    """
+
+    name: str
+    storage: Any  # np.dtype-like
+    # decimal only
+    precision: Optional[int] = None
+    scale: Optional[int] = None
+
+    # ---- classification helpers -------------------------------------------------
+    @property
+    def is_string(self) -> bool:
+        return self.name == "string"
+
+    @property
+    def is_boolean(self) -> bool:
+        return self.name == "boolean"
+
+    @property
+    def is_integral(self) -> bool:
+        return self.name in ("tinyint", "smallint", "int", "bigint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("float", "double")
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integral or self.is_floating or self.is_decimal
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.name.startswith("decimal")
+
+    @property
+    def is_date(self) -> bool:
+        return self.name == "date"
+
+    @property
+    def is_timestamp(self) -> bool:
+        return self.name == "timestamp"
+
+    @property
+    def is_datetime(self) -> bool:
+        return self.is_date or self.is_timestamp
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __str__(self) -> str:
+        return self.name
+
+
+BOOL = DataType("boolean", np.dtype(np.bool_))
+INT8 = DataType("tinyint", np.dtype(np.int8))
+INT16 = DataType("smallint", np.dtype(np.int16))
+INT32 = DataType("int", np.dtype(np.int32))
+INT64 = DataType("bigint", np.dtype(np.int64))
+FLOAT32 = DataType("float", np.dtype(np.float32))
+FLOAT64 = DataType("double", np.dtype(np.float64))
+# chars buffer storage; offsets are always int32 (2^31 byte cap per batch —
+# the same per-column row/byte limit the reference designs around, see
+# SURVEY.md Appendix B "2 GiB hard cap").
+STRING = DataType("string", np.dtype(np.uint8))
+DATE32 = DataType("date", np.dtype(np.int32))  # days since unix epoch
+TIMESTAMP_US = DataType("timestamp", np.dtype(np.int64))  # micros since epoch, UTC
+
+
+def DecimalType(precision: int, scale: int) -> DataType:
+    """DECIMAL_64 only, like the reference snapshot (precision <= 18)."""
+    if precision > 18:
+        raise ValueError(
+            f"decimal precision {precision} > 18 unsupported (DECIMAL_64 only, "
+            "matching reference TypeSig.DECIMAL_64)")
+    if scale < 0 or scale > precision:
+        raise ValueError(f"bad decimal scale {scale} for precision {precision}")
+    return DataType(f"decimal({precision},{scale})", np.dtype(np.int64),
+                    precision=precision, scale=scale)
+
+
+_BY_NAME = {t.name: t for t in
+            (BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, STRING,
+             DATE32, TIMESTAMP_US)}
+
+
+def dtype_from_name(name: str) -> DataType:
+    name = name.strip().lower()
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    if name.startswith("decimal"):
+        inner = name[name.index("(") + 1:name.index(")")]
+        p, s = (int(x) for x in inner.split(","))
+        return DecimalType(p, s)
+    aliases = {"long": INT64, "integer": INT32, "short": INT16, "byte": INT8,
+               "bool": BOOL, "str": STRING, "float64": FLOAT64,
+               "float32": FLOAT32}
+    if name in aliases:
+        return aliases[name]
+    raise ValueError(f"unknown data type name: {name}")
+
+
+def from_numpy_dtype(dt) -> DataType:
+    dt = np.dtype(dt)
+    mapping = {
+        np.dtype(np.bool_): BOOL,
+        np.dtype(np.int8): INT8,
+        np.dtype(np.int16): INT16,
+        np.dtype(np.int32): INT32,
+        np.dtype(np.int64): INT64,
+        np.dtype(np.float32): FLOAT32,
+        np.dtype(np.float64): FLOAT64,
+    }
+    if dt in mapping:
+        return mapping[dt]
+    if dt.kind == "M":  # datetime64
+        return TIMESTAMP_US
+    if dt.kind in ("U", "S", "O"):
+        return STRING
+    raise ValueError(f"unsupported numpy dtype {dt}")
+
+
+def from_arrow_type(at) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BOOL
+    if pa.types.is_int8(at):
+        return INT8
+    if pa.types.is_int16(at):
+        return INT16
+    if pa.types.is_int32(at):
+        return INT32
+    if pa.types.is_int64(at):
+        return INT64
+    if pa.types.is_float32(at):
+        return FLOAT32
+    if pa.types.is_float64(at):
+        return FLOAT64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_date32(at):
+        return DATE32
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP_US
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_dictionary(at):
+        return from_arrow_type(at.value_type)
+    raise ValueError(f"unsupported arrow type {at}")
+
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+    if dt is BOOL or dt.name == "boolean":
+        return pa.bool_()
+    if dt.name == "tinyint":
+        return pa.int8()
+    if dt.name == "smallint":
+        return pa.int16()
+    if dt.name == "int":
+        return pa.int32()
+    if dt.name == "bigint":
+        return pa.int64()
+    if dt.name == "float":
+        return pa.float32()
+    if dt.name == "double":
+        return pa.float64()
+    if dt.is_string:
+        return pa.string()
+    if dt.is_date:
+        return pa.date32()
+    if dt.is_timestamp:
+        return pa.timestamp("us", tz="UTC")
+    if dt.is_decimal:
+        return pa.decimal128(dt.precision, dt.scale)
+    raise ValueError(f"no arrow type for {dt}")

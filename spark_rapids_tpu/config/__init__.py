@@ -1,0 +1,3 @@
+from spark_rapids_tpu.config.rapids_conf import RapidsConf, ConfEntry
+
+__all__ = ["RapidsConf", "ConfEntry"]

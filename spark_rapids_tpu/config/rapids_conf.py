@@ -1,0 +1,258 @@
+"""Typed, self-documenting configuration registry.
+
+Counterpart of ``sql-plugin/.../RapidsConf.scala`` (1,745 LoC, 119 entries):
+typed entries with defaults, docs and validators, a global registry, and a
+``generate_docs()`` that renders the configs reference markdown the same way
+``RapidsConf.main`` writes ``docs/configs.md``.
+
+Key names keep the reference's ``spark.rapids.*`` prefix so that users of the
+reference find the same knobs; GPU-specific words become TPU ones
+(``concurrentGpuTasks`` -> ``concurrentTpuTasks``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfEntry:
+    """One typed config entry (RapidsConf.scala:116 `ConfEntry`)."""
+
+    def __init__(self, key: str, default: Any, doc: str, conv: Callable,
+                 validator: Optional[Callable[[Any], Optional[str]]] = None,
+                 internal: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conv = conv
+        self.validator = validator
+        self.internal = internal
+
+    def get(self, settings: Dict[str, str]) -> Any:
+        raw = settings.get(self.key)
+        if raw is None:
+            raw = os.environ.get(self.key.upper().replace(".", "_"))
+        if raw is None:
+            return self.default
+        value = self.conv(raw) if isinstance(raw, str) else raw
+        if self.validator is not None:
+            err = self.validator(value)
+            if err:
+                raise ValueError(f"{self.key}={value!r}: {err}")
+        return value
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _to_int(s: str) -> int:
+    return int(s)
+
+
+def _to_float(s: str) -> float:
+    return float(s)
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    assert entry.key not in _REGISTRY, f"duplicate conf {entry.key}"
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf(key, default, doc, conv=str, validator=None, internal=False):
+    return _register(ConfEntry(key, default, doc, conv, validator, internal))
+
+
+def _positive(v):
+    return None if v > 0 else "must be positive"
+
+
+def _fraction(v):
+    return None if 0.0 <= v <= 1.0 else "must be in [0, 1]"
+
+
+# --------------------------------------------------------------------- entries --
+SQL_ENABLED = conf(
+    "spark.rapids.sql.enabled", True,
+    "Enable or disable TPU acceleration of SQL operators entirely. "
+    "(reference RapidsConf.scala:514)", _to_bool)
+
+EXPLAIN = conf(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a query did or did not run on TPU: NONE, "
+    "NOT_ON_TPU, ALL. (reference `sql.explain` RapidsConf.scala:1142)", str,
+    lambda v: None if v in ("NONE", "NOT_ON_TPU", "ALL") else
+    "must be NONE, NOT_ON_TPU or ALL")
+
+BATCH_SIZE_BYTES = conf(
+    "spark.rapids.sql.batchSizeBytes", 1 << 31,
+    "Target size in bytes for columnar batches; hard-capped at 2 GiB "
+    "mirroring the reference's per-column row-count limit "
+    "(RapidsConf.scala:436-444).", _to_int,
+    lambda v: None if 0 < v <= (1 << 31) else "must be in (0, 2GiB]")
+
+BATCH_ROW_CAPACITY = conf(
+    "spark.rapids.sql.tpu.maxBatchRows", 1 << 22,
+    "Maximum rows per device batch (shape-bucket ceiling). TPU-specific: "
+    "bounds the set of XLA-compiled shapes.", _to_int, _positive)
+
+CONCURRENT_TPU_TASKS = conf(
+    "spark.rapids.sql.concurrentTpuTasks", 1,
+    "Number of tasks that may issue work to the TPU concurrently "
+    "(reference `concurrentGpuTasks` RapidsConf.scala:423).", _to_int,
+    _positive)
+
+HAS_NANS = conf(
+    "spark.rapids.sql.hasNans", True,
+    "Assume floating point values may be NaN; some float aggregations "
+    "refuse to run when set (reference RapidsConf.scala:549).", _to_bool)
+
+DECIMAL_ENABLED = conf(
+    "spark.rapids.sql.decimalType.enabled", False,
+    "Enable decimal (DECIMAL_64) processing "
+    "(reference RapidsConf.scala:564).", _to_bool)
+
+IMPROVED_FLOAT_OPS = conf(
+    "spark.rapids.sql.improvedFloatOps.enabled", False,
+    "Allow float ops whose results may differ from CPU beyond 1-ulp.",
+    _to_bool)
+
+UDF_COMPILER_ENABLED = conf(
+    "spark.rapids.sql.udfCompiler.enabled", False,
+    "Compile Python UDF bytecode into TPU expression trees "
+    "(reference udf-compiler, RapidsConf.scala:519).", _to_bool)
+
+MEM_POOL_FRACTION = conf(
+    "spark.rapids.memory.tpu.allocFraction", 0.9,
+    "Fraction of HBM this engine may retain in its batch pool before "
+    "spilling (reference `memory.gpu.allocFraction`).", _to_float, _fraction)
+
+HOST_SPILL_STORAGE_SIZE = conf(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory used as the first spill tier before disk "
+    "(reference RapidsConf.scala:357).", _to_int, _positive)
+
+SPILL_ENABLED = conf(
+    "spark.rapids.memory.tpu.spillEnabled", True,
+    "Enable HBM->host->disk spilling of spillable batches.", _to_bool)
+
+DEVICE_MEMORY_LIMIT = conf(
+    "spark.rapids.memory.tpu.deviceLimitBytes", 0,
+    "Device-pool budget in bytes for spillable batches; 0 = derive from HBM "
+    "size * allocFraction.", _to_int)
+
+SHUFFLE_PARTITIONS = conf(
+    "spark.rapids.sql.shuffle.partitions", 8,
+    "Default number of shuffle partitions (spark.sql.shuffle.partitions "
+    "analog).", _to_int, _positive)
+
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "Codec for host-path shuffle payloads: none, lz4, zstd "
+    "(reference TableCompressionCodec.scala:107).", str,
+    lambda v: None if v in ("none", "lz4", "zstd") else "unknown codec")
+
+SHUFFLE_TRANSPORT_ENABLED = conf(
+    "spark.rapids.shuffle.transport.enabled", True,
+    "Use the ICI all-to-all collective exchange when executing on a device "
+    "mesh (the UCX-transport analog, reference RapidsConf.scala:986); "
+    "otherwise serialize through the host shuffle store.", _to_bool)
+
+MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
+    "Thread-pool size for the multithreaded file reader "
+    "(reference RapidsConf.scala:734).", _to_int, _positive)
+
+MAX_NUM_FILES_PARALLEL = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.maxNumFilesParallel", 4,
+    "Max files buffered in flight per task by the multithreaded reader "
+    "(reference RapidsConf.scala:740).", _to_int, _positive)
+
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.sql.format.parquet.reader.type", "AUTO",
+    "Parquet reader strategy: PERFILE, COALESCING, MULTITHREADED, AUTO "
+    "(reference RapidsConf.scala:693-722).", str,
+    lambda v: None if v in ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
+    else "must be PERFILE, COALESCING, MULTITHREADED or AUTO")
+
+CBO_ENABLED = conf(
+    "spark.rapids.sql.optimizer.enabled", False,
+    "Cost-based fall-back of subplans to CPU when TPU not worth it "
+    "(reference RapidsConf.scala:1177).", _to_bool)
+
+TEST_ENABLED = conf(
+    "spark.rapids.sql.test.enabled", False,
+    "Strict test mode: fail if an op silently falls back to CPU "
+    "(reference RapidsConf.scala:928).", _to_bool, internal=True)
+
+TEST_ALLOWED_NON_TPU = conf(
+    "spark.rapids.sql.test.allowedNonTpu", "",
+    "Comma-separated op names tolerated on CPU in strict test mode "
+    "(reference `test.allowedNonGpu`).", str, internal=True)
+
+METRICS_LEVEL = conf(
+    "spark.rapids.sql.metrics.level", "MODERATE",
+    "Operator metric verbosity: ESSENTIAL, MODERATE, DEBUG "
+    "(reference GpuExec.scala MetricsLevel).", str,
+    lambda v: None if v in ("ESSENTIAL", "MODERATE", "DEBUG") else
+    "must be ESSENTIAL, MODERATE or DEBUG")
+
+
+class RapidsConf:
+    """Immutable snapshot view over a settings dict (RapidsConf.scala:1281)."""
+
+    def __init__(self, settings: Optional[Dict[str, str]] = None):
+        self.settings = dict(settings or {})
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self.settings)
+
+    def __getitem__(self, key: str) -> Any:
+        return _REGISTRY[key].get(self.settings)
+
+    def set(self, key: str, value) -> "RapidsConf":
+        s = dict(self.settings)
+        s[key] = value
+        return RapidsConf(s)
+
+    # convenience accessors used on hot paths
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def max_batch_rows(self) -> int:
+        return self.get(BATCH_ROW_CAPACITY)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @staticmethod
+    def registry() -> Dict[str, ConfEntry]:
+        return dict(_REGISTRY)
+
+    @staticmethod
+    def generate_docs() -> str:
+        """Render docs/configs.md (reference RapidsConf.main)."""
+        lines = ["# spark-rapids-tpu Configuration", "",
+                 "Name | Description | Default", "---|---|---"]
+        for key in sorted(_REGISTRY):
+            e = _REGISTRY[key]
+            if e.internal:
+                continue
+            lines.append(f"{e.key} | {e.doc} | {e.default}")
+        return "\n".join(lines) + "\n"

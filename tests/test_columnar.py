@@ -1,0 +1,130 @@
+"""Columnar core round-trip tests (Column/ColumnarBatch host<->device)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, empty_batch
+from spark_rapids_tpu.columnar import dtypes as dts
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 1024
+    assert bucket_capacity(1) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    assert bucket_capacity(1 << 20) == 1 << 20
+
+
+def test_int_column_roundtrip():
+    vals = np.arange(10, dtype=np.int64)
+    col = Column.from_numpy(vals)
+    assert col.dtype is dts.INT64
+    assert col.nrows == 10 and col.capacity == 1024
+    assert not col.has_nulls
+    np.testing.assert_array_equal(col.to_numpy(), vals)
+    assert col.to_pylist() == list(range(10))
+
+
+def test_nullable_column():
+    vals = np.array([1.5, 2.5, 3.5])
+    validity = np.array([True, False, True])
+    col = Column.from_numpy(vals, validity=validity)
+    assert col.has_nulls and col.null_count() == 1
+    assert col.to_pylist() == [1.5, None, 3.5]
+
+
+def test_string_column_roundtrip():
+    strings = ["hello", "", None, "wörld", "tpu"]
+    col = Column.from_strings(strings)
+    assert col.dtype.is_string
+    assert col.nrows == 5
+    assert col.to_pylist() == strings
+    arrow = col.to_arrow()
+    assert arrow.to_pylist() == strings
+
+
+def test_arrow_roundtrip_types():
+    table = pa.table({
+        "i32": pa.array([1, 2, None], type=pa.int32()),
+        "f64": pa.array([1.0, None, 3.0], type=pa.float64()),
+        "b": pa.array([True, False, None]),
+        "s": pa.array(["a", None, "ccc"]),
+        "ts": pa.array([1, 2, 3], type=pa.timestamp("us", tz="UTC")),
+        "d": pa.array([10, 20, None], type=pa.date32()),
+    })
+    batch = ColumnarBatch.from_arrow(table)
+    assert batch.nrows == 3
+    out = batch.to_arrow()
+    assert out.column("i32").to_pylist() == [1, 2, None]
+    assert out.column("f64").to_pylist() == [1.0, None, 3.0]
+    assert out.column("b").to_pylist() == [True, False, None]
+    assert out.column("s").to_pylist() == ["a", None, "ccc"]
+    assert out.column("d").to_pylist() == table.column("d").to_pylist()
+
+
+def test_pandas_roundtrip():
+    import pandas as pd
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"],
+                       "z": [0.1, 0.2, 0.3]})
+    batch = ColumnarBatch.from_pandas(df)
+    out = batch.to_pandas()
+    pd.testing.assert_frame_equal(out, df, check_dtype=False)
+
+
+def test_from_pydict_with_nones():
+    batch = ColumnarBatch.from_pydict({
+        "a": [1, None, 3],
+        "s": ["x", None, "z"],
+    })
+    assert batch.column("a").to_pylist() == [1, None, 3]
+    assert batch.column("s").to_pylist() == ["x", None, "z"]
+
+
+def test_batch_select_rename_with_column():
+    batch = ColumnarBatch.from_pydict({"a": [1, 2], "b": [3, 4]})
+    sel = batch.select(["b"])
+    assert sel.names == ["b"]
+    ren = batch.rename({"a": "aa"})
+    assert set(ren.names) == {"aa", "b"}
+    wc = batch.with_column("c", Column.from_numpy(np.array([9, 9])))
+    assert wc.column("c").to_pylist() == [9, 9]
+
+
+def test_empty_batch():
+    b = empty_batch([("x", dts.INT64), ("s", dts.STRING)])
+    assert b.nrows == 0
+    assert b.to_arrow().num_rows == 0
+
+
+def test_decimal_type():
+    d = dts.DecimalType(10, 2)
+    assert d.precision == 10 and d.scale == 2
+    with pytest.raises(ValueError):
+        dts.DecimalType(19, 0)
+    arr = pa.array([None, 1, 2], type=pa.decimal128(10, 2))
+    col = Column.from_arrow(arr)
+    out = col.to_pylist()
+    assert out[0] is None and float(out[1]) == 1.0
+
+
+def test_mismatched_nrows_raises():
+    a = Column.from_numpy(np.arange(3))
+    b = Column.from_numpy(np.arange(4))
+    with pytest.raises(ValueError):
+        ColumnarBatch({"a": a, "b": b})
+
+
+def test_conf_registry():
+    from spark_rapids_tpu.config.rapids_conf import (
+        RapidsConf, SQL_ENABLED, BATCH_SIZE_BYTES, EXPLAIN)
+    conf = RapidsConf()
+    assert conf.sql_enabled is True
+    assert conf.batch_size_bytes == 1 << 31
+    conf2 = conf.set("spark.rapids.sql.enabled", "false")
+    assert conf2.get(SQL_ENABLED) is False
+    with pytest.raises(ValueError):
+        conf.set("spark.rapids.sql.explain", "BOGUS").get(EXPLAIN)
+    docs = RapidsConf.generate_docs()
+    assert "spark.rapids.sql.batchSizeBytes" in docs
