@@ -26,6 +26,12 @@ L9        ``tools/`` qualification & profiling CLIs       tools/
 ========  ==============================================  =======================
 """
 
+import jax as _jax
+
+# Spark SQL semantics require 64-bit longs and doubles end to end; JAX
+# defaults to 32-bit. Enabled at engine import, before any tracing.
+_jax.config.update("jax_enable_x64", True)
+
 from spark_rapids_tpu.version import __version__
 
 from spark_rapids_tpu.columnar.dtypes import (

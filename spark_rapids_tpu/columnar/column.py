@@ -167,10 +167,10 @@ class Column:
             values = np.array([0 if v is None else v for v in ints],
                               dtype=np.int64)
         elif dtype.is_timestamp:
-            values = np.asarray(arr.cast(pa.timestamp("us"))).astype(
-                "datetime64[us]").astype(np.int64)
+            ints = arr.cast(pa.timestamp("us")).cast(pa.int64())
+            values = np.asarray(ints.fill_null(0))
         elif dtype.is_date:
-            values = np.asarray(arr.cast(pa.int32()))
+            values = np.asarray(arr.cast(pa.int32()).fill_null(0))
         else:
             np_arr = arr.to_numpy(zero_copy_only=False)
             if arr.null_count:

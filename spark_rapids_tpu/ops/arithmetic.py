@@ -1,0 +1,411 @@
+"""Arithmetic, math and bitwise expressions.
+
+Coverage target: the reference's ``arithmetic.scala`` (691 LoC),
+``mathExpressions.scala`` (472) and ``bitwise.scala`` (149) rule sets
+(SURVEY.md Appendix A.1).  ANSI mode is off as in the reference defaults:
+integer overflow wraps, division by zero yields null.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.expressions import (
+    BinaryExpression, ColVal, EmitContext, Expression, UnaryExpression,
+    cast_value, combine_validity, promote_types,
+)
+
+
+class Add(BinaryExpression):
+    def eval_values(self, l, r):
+        return l + r, None
+
+
+class Subtract(BinaryExpression):
+    def eval_values(self, l, r):
+        return l - r, None
+
+
+class Multiply(BinaryExpression):
+    def eval_values(self, l, r):
+        return l * r, None
+
+
+class Divide(BinaryExpression):
+    """Spark `/`: always double (fractional) division; x/0 -> null."""
+
+    def operand_type(self) -> DataType:
+        return dts.FLOAT64
+
+    def eval_values(self, l, r):
+        zero = r == 0
+        safe = jnp.where(zero, 1.0, r)
+        return l / safe, jnp.logical_not(zero)
+
+
+class IntegralDivide(BinaryExpression):
+    """Spark `div`: long division; x div 0 -> null."""
+
+    def operand_type(self) -> DataType:
+        return dts.INT64
+
+    @property
+    def dtype(self):
+        return dts.INT64
+
+    def eval_values(self, l, r):
+        zero = r == 0
+        safe = jnp.where(zero, 1, r)
+        # Spark truncates toward zero; jnp // floors. Adjust.
+        q = l // safe
+        rem = l - q * safe
+        q = jnp.where((rem != 0) & ((l < 0) != (safe < 0)), q + 1, q)
+        return q, jnp.logical_not(zero)
+
+
+class Remainder(BinaryExpression):
+    """Spark `%`: sign follows dividend; x % 0 -> null."""
+
+    def eval_values(self, l, r):
+        zero = r == 0
+        safe = jnp.where(zero, 1, r)
+        if jnp.issubdtype(l.dtype, jnp.integer):
+            # truncated-division remainder (Java % semantics): sign of dividend
+            m = jnp.mod(l, safe)  # floored
+            rem = jnp.where((m != 0) & ((l < 0) != (safe < 0)), m - safe, m)
+        else:
+            rem = jnp.fmod(l, safe)
+        return rem, jnp.logical_not(zero)
+
+
+class Pmod(BinaryExpression):
+    """Positive modulus; x pmod 0 -> null."""
+
+    def eval_values(self, l, r):
+        zero = r == 0
+        safe = jnp.where(zero, 1, r)
+        m = jnp.mod(l, safe)  # floored mod: sign follows divisor
+        m = jnp.where(m < 0, m + jnp.abs(safe), m)
+        return m, jnp.logical_not(zero)
+
+
+class UnaryMinus(UnaryExpression):
+    def eval_values(self, v, cv):
+        return -v
+
+
+class UnaryPositive(UnaryExpression):
+    def eval_values(self, v, cv):
+        return v
+
+
+class Abs(UnaryExpression):
+    def eval_values(self, v, cv):
+        return jnp.abs(v)
+
+
+# ------------------------------------------------------------------ math fns --
+
+class _MathUnary(UnaryExpression):
+    """Double-typed unary math fn (reference CudfUnaryMathExpression)."""
+
+    fn = None
+
+    @property
+    def dtype(self):
+        return dts.FLOAT64
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = cast_value(self.child.emit(ctx), dts.FLOAT64)
+        return ColVal(self.dtype, type(self).fn(c.values), c.validity)
+
+
+class Sqrt(_MathUnary):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Cbrt(_MathUnary):
+    fn = staticmethod(jnp.cbrt)
+
+
+class Exp(_MathUnary):
+    fn = staticmethod(jnp.exp)
+
+
+class Expm1(_MathUnary):
+    fn = staticmethod(jnp.expm1)
+
+
+class Log(_MathUnary):
+    fn = staticmethod(jnp.log)
+
+
+class Log2(_MathUnary):
+    fn = staticmethod(jnp.log2)
+
+
+class Log10(_MathUnary):
+    fn = staticmethod(jnp.log10)
+
+
+class Log1p(_MathUnary):
+    fn = staticmethod(jnp.log1p)
+
+
+class Sin(_MathUnary):
+    fn = staticmethod(jnp.sin)
+
+
+class Cos(_MathUnary):
+    fn = staticmethod(jnp.cos)
+
+
+class Tan(_MathUnary):
+    fn = staticmethod(jnp.tan)
+
+
+class Cot(_MathUnary):
+    fn = staticmethod(lambda v: 1.0 / jnp.tan(v))
+
+
+class Asin(_MathUnary):
+    fn = staticmethod(jnp.arcsin)
+
+
+class Acos(_MathUnary):
+    fn = staticmethod(jnp.arccos)
+
+
+class Atan(_MathUnary):
+    fn = staticmethod(jnp.arctan)
+
+
+class Sinh(_MathUnary):
+    fn = staticmethod(jnp.sinh)
+
+
+class Cosh(_MathUnary):
+    fn = staticmethod(jnp.cosh)
+
+
+class Tanh(_MathUnary):
+    fn = staticmethod(jnp.tanh)
+
+
+class Asinh(_MathUnary):
+    fn = staticmethod(jnp.arcsinh)
+
+
+class Acosh(_MathUnary):
+    fn = staticmethod(jnp.arccosh)
+
+
+class Atanh(_MathUnary):
+    fn = staticmethod(jnp.arctanh)
+
+
+class ToDegrees(_MathUnary):
+    fn = staticmethod(jnp.degrees)
+
+
+class ToRadians(_MathUnary):
+    fn = staticmethod(jnp.radians)
+
+
+class Rint(_MathUnary):
+    fn = staticmethod(jnp.rint)
+
+
+class Signum(_MathUnary):
+    fn = staticmethod(jnp.sign)
+
+
+class Floor(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT64 if self.child.dtype.is_floating else self.child.dtype
+
+    def eval_values(self, v, cv):
+        if self.child.dtype.is_floating:
+            return jnp.floor(v).astype(jnp.int64)
+        return v
+
+
+class Ceil(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT64 if self.child.dtype.is_floating else self.child.dtype
+
+    def eval_values(self, v, cv):
+        if self.child.dtype.is_floating:
+            return jnp.ceil(v).astype(jnp.int64)
+        return v
+
+
+class Pow(BinaryExpression):
+    def operand_type(self):
+        return dts.FLOAT64
+
+    def eval_values(self, l, r):
+        return jnp.power(l, r), None
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x)."""
+
+    def operand_type(self):
+        return dts.FLOAT64
+
+    def eval_values(self, l, r):
+        return jnp.log(r) / jnp.log(l), None
+
+
+class Atan2(BinaryExpression):
+    def operand_type(self):
+        return dts.FLOAT64
+
+    def eval_values(self, l, r):
+        return jnp.arctan2(l, r), None
+
+
+class _RoundBase(Expression):
+    def __init__(self, child: Expression, scale: int = 0):
+        self.children = (child,)
+        self.scale = int(scale)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return type(self)(children[0], self.scale)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def cache_key(self):
+        return (type(self).__name__, self.scale, self.child.cache_key())
+
+
+class Round(_RoundBase):
+    """HALF_UP rounding (Spark Round)."""
+
+    def emit(self, ctx):
+        c = self.child.emit(ctx)
+        v = c.values
+        if self.child.dtype.is_floating:
+            f = 10.0 ** self.scale
+            out = jnp.trunc(jnp.abs(v) * f + 0.5) / f * jnp.sign(v)
+        elif self.scale >= 0:
+            out = v
+        else:
+            f = 10 ** (-self.scale)
+            half = f // 2
+            out = (jnp.abs(v) + half) // f * f * jnp.sign(v)
+        return ColVal(self.dtype, out.astype(self.dtype.storage), c.validity)
+
+
+class BRound(_RoundBase):
+    """HALF_EVEN (banker's) rounding (Spark BRound)."""
+
+    def emit(self, ctx):
+        c = self.child.emit(ctx)
+        v = c.values
+        if self.child.dtype.is_floating:
+            f = 10.0 ** self.scale
+            out = jnp.round(v * f) / f  # jnp.round is half-even
+        elif self.scale >= 0:
+            out = v
+        else:
+            f = 10 ** (-self.scale)
+            q, rem = v // f, v % f
+            half = f / 2.0
+            round_up = (rem > half) | ((rem == half) & (q % 2 != 0))
+            out = (q + round_up.astype(v.dtype)) * f
+        return ColVal(self.dtype, out.astype(self.dtype.storage), c.validity)
+
+
+# ------------------------------------------------------------------- bitwise --
+
+class BitwiseAnd(BinaryExpression):
+    def eval_values(self, l, r):
+        return l & r, None
+
+
+class BitwiseOr(BinaryExpression):
+    def eval_values(self, l, r):
+        return l | r, None
+
+
+class BitwiseXor(BinaryExpression):
+    def eval_values(self, l, r):
+        return l ^ r, None
+
+
+class BitwiseNot(UnaryExpression):
+    def eval_values(self, v, cv):
+        return ~v
+
+
+class _ShiftBase(BinaryExpression):
+    """Java shift semantics: shift amount masked by value bit-width."""
+
+    def operand_type(self):
+        return self.left.dtype
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        bits = l.values.dtype.itemsize * 8
+        amount = r.values.astype(jnp.int32) & (bits - 1)
+        values = self.shift(l.values, amount)
+        return ColVal(self.dtype, values,
+                      combine_validity(l.validity, r.validity))
+
+
+class ShiftLeft(_ShiftBase):
+    def shift(self, v, amount):
+        return v << amount.astype(v.dtype)
+
+
+class ShiftRight(_ShiftBase):
+    def shift(self, v, amount):
+        return v >> amount.astype(v.dtype)
+
+
+class ShiftRightUnsigned(_ShiftBase):
+    def shift(self, v, amount):
+        unsigned = v.view(jnp.uint32 if v.dtype.itemsize == 4 else jnp.uint64)
+        return (unsigned >> amount.astype(unsigned.dtype)).view(v.dtype)
+
+
+# -------------------------------------------------------------------- random --
+
+class Rand(Expression):
+    """rand([seed]) — uniform [0,1) double, seeded per batch + row position.
+
+    TPU-first: threefry via jax.random keyed on (seed, batch ordinal).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    @property
+    def dtype(self):
+        return dts.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        import jax
+        key = jax.random.PRNGKey(self.seed)
+        vals = jax.random.uniform(key, (ctx.capacity,), dtype=jnp.float64)
+        return ColVal(self.dtype, vals)
+
+    def cache_key(self):
+        return ("Rand", self.seed)

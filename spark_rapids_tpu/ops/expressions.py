@@ -1,0 +1,395 @@
+"""Expression trees and the traced column-value representation.
+
+Counterpart of ``GpuExpressions.scala:113-425`` (`GpuExpression` hierarchy and
+``columnarEval``), re-designed for XLA tracing: instead of each expression
+issuing a cudf kernel per batch, ``Expression.emit(ctx)`` runs *inside a jax
+trace* and returns a :class:`ColVal`; an operator's whole expression forest
+therefore lowers into one fused XLA computation per stage
+(see ``ops/compiler.py``).
+
+Null semantics follow Spark SQL: null-propagating binary ops, Kleene logic for
+AND/OR, null on division by zero, etc.  Validity is a dense bool array (or
+``None`` = all valid) carried alongside the value array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+
+@dataclasses.dataclass
+class ColVal:
+    """A column value inside a trace: values + optional validity (+ offsets).
+
+    ``values`` is a (capacity,) array, or a 0-d array for scalar literals —
+    broadcasting against row arrays is left to jnp.  For strings ``values``
+    holds uint8 chars and ``offsets`` the int32 row offsets.
+    """
+    dtype: DataType
+    values: Any
+    validity: Optional[Any] = None   # bool array, None = all valid
+    offsets: Optional[Any] = None    # strings only
+
+    @property
+    def is_scalar(self) -> bool:
+        return getattr(self.values, "ndim", 0) == 0 and self.offsets is None
+
+
+def combine_validity(*vs: Optional[Any]) -> Optional[Any]:
+    """AND together validity masks, treating None as all-valid."""
+    present = [v for v in vs if v is not None]
+    if not present:
+        return None
+    out = present[0]
+    for v in present[1:]:
+        out = jnp.logical_and(out, v)
+    return out
+
+
+class EmitContext:
+    """Per-trace state handed to ``Expression.emit``.
+
+    ``inputs``: ColVal per input ordinal (the operator's child output).
+    ``nrows``: traced int32 scalar — logical row count of the batch.
+    ``capacity``: static int — the shape bucket.
+    """
+
+    def __init__(self, inputs: Sequence[ColVal], nrows, capacity: int):
+        self.inputs = list(inputs)
+        self.nrows = nrows
+        self.capacity = capacity
+
+    def row_mask(self):
+        """bool[capacity], True for rows < nrows (padding mask)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows
+
+
+class Expression:
+    """Base class. Subclasses define ``children`` and are immutable after bind."""
+
+    children: Tuple["Expression", ...] = ()
+
+    # ---- resolution ----------------------------------------------------------
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    @property
+    def resolved(self) -> bool:
+        return all(c.resolved for c in self.children)
+
+    def bind(self, schema: Sequence[Tuple[str, DataType]]) -> "Expression":
+        """Resolve column names to ordinals recursively."""
+        new_children = [c.bind(schema) for c in self.children]
+        return self.with_children(new_children)
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        if not self.children:
+            return self
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement with_children")
+
+    # ---- evaluation ----------------------------------------------------------
+    def emit(self, ctx: EmitContext) -> ColVal:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- misc ----------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        """Output name when this expression is projected without an alias."""
+        return str(self)
+
+    def cache_key(self) -> Tuple:
+        """Structural identity used by the stage-compiler cache."""
+        return (type(self).__name__,
+                tuple(c.cache_key() for c in self.children))
+
+    def references(self) -> List[str]:
+        out: List[str] = []
+        for c in self.children:
+            out.extend(c.references())
+        return out
+
+    def __str__(self) -> str:
+        args = ", ".join(str(c) for c in self.children)
+        return f"{type(self).__name__}({args})"
+
+
+# ------------------------------------------------------------------- leaves --
+
+class UnresolvedColumn(Expression):
+    def __init__(self, col_name: str):
+        self.col_name = col_name
+
+    @property
+    def dtype(self) -> DataType:
+        raise RuntimeError(f"unresolved column {self.col_name}")
+
+    @property
+    def nullable(self) -> bool:
+        raise RuntimeError(f"unresolved column {self.col_name}")
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+    def bind(self, schema) -> "Expression":
+        for i, (name, dt) in enumerate(schema):
+            if name == self.col_name:
+                return BoundReference(i, dt, name=name)
+        raise KeyError(
+            f"column {self.col_name!r} not in schema "
+            f"{[n for n, _ in schema]}")
+
+    @property
+    def name(self) -> str:
+        return self.col_name
+
+    def references(self):
+        return [self.col_name]
+
+    def cache_key(self):
+        return ("UnresolvedColumn", self.col_name)
+
+    def __str__(self):
+        return f"'{self.col_name}"
+
+
+class BoundReference(Expression):
+    """Input column by ordinal (GpuBoundAttribute.scala:125 analog)."""
+
+    def __init__(self, ordinal: int, dtype: DataType, name: str = "",
+                 nullable: bool = True):
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._name = name
+        self._nullable = nullable
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def name(self) -> str:
+        return self._name or f"c{self.ordinal}"
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        return ctx.inputs[self.ordinal]
+
+    def references(self):
+        return [self._name] if self._name else []
+
+    def cache_key(self):
+        return ("BoundReference", self.ordinal, self._dtype.name)
+
+    def __str__(self):
+        return f"input[{self.ordinal}, {self._dtype}]"
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[DataType] = None):
+        self.value = value
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self._dtype = dtype
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        if self.value is None:
+            zeros = jnp.zeros((), dtype=self._dtype.storage)
+            return ColVal(self._dtype, zeros,
+                          validity=jnp.zeros((), dtype=jnp.bool_))
+        if self._dtype.is_string:
+            data = np.frombuffer(str(self.value).encode("utf-8"),
+                                 dtype=np.uint8)
+            offs = jnp.asarray(
+                np.array([0, len(data)], dtype=np.int32))
+            return ColVal(self._dtype, jnp.asarray(data), offsets=offs)
+        v = self.value
+        if self._dtype.is_timestamp and not isinstance(v, (int, np.integer)):
+            v = np.datetime64(v, "us").astype(np.int64)
+        if self._dtype.is_date and not isinstance(v, (int, np.integer)):
+            v = np.datetime64(v, "D").astype(np.int32)
+        return ColVal(self._dtype, jnp.asarray(v, dtype=self._dtype.storage))
+
+    @property
+    def name(self) -> str:
+        return str(self.value)
+
+    def cache_key(self):
+        return ("Literal", self._dtype.name, self.value)
+
+    def __str__(self):
+        return f"lit({self.value!r})"
+
+
+def _infer_literal_type(value) -> DataType:
+    if value is None:
+        raise ValueError("null literal needs an explicit dtype")
+    if isinstance(value, bool):
+        return dts.BOOL
+    if isinstance(value, (int, np.integer)):
+        return dts.INT64 if not isinstance(value, np.int32) else dts.INT32
+    if isinstance(value, (float, np.floating)):
+        return dts.FLOAT64
+    if isinstance(value, str):
+        return dts.STRING
+    if isinstance(value, np.datetime64):
+        return dts.TIMESTAMP_US
+    import datetime
+    if isinstance(value, datetime.datetime):
+        return dts.TIMESTAMP_US
+    if isinstance(value, datetime.date):
+        return dts.DATE32
+    raise ValueError(f"cannot infer literal type for {value!r}")
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias: str):
+        self.children = (child,)
+        self.alias = alias
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def with_children(self, children):
+        return Alias(children[0], self.alias)
+
+    def bind(self, schema):
+        return Alias(self.child.bind(schema), self.alias)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        return self.child.emit(ctx)
+
+    @property
+    def name(self) -> str:
+        return self.alias
+
+    def cache_key(self):
+        return ("Alias", self.alias, self.child.cache_key())
+
+    def __str__(self):
+        return f"{self.child} AS {self.alias}"
+
+
+# ----------------------------------------------------------- op scaffolding --
+
+class UnaryExpression(Expression):
+    """Null-propagating unary op (CudfUnaryExpression analog)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        values = self.eval_values(c.values, c)
+        return ColVal(self.dtype, values, c.validity)
+
+    def eval_values(self, v, cv: ColVal):
+        raise NotImplementedError
+
+
+class BinaryExpression(Expression):
+    """Null-propagating binary op with implicit numeric promotion."""
+
+    # subclasses may force the promoted operand type / result type
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def operand_type(self) -> DataType:
+        return promote_types(self.left.dtype, self.right.dtype)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.operand_type()
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        t = self.operand_type()
+        l = cast_value(self.left.emit(ctx), t)
+        r = cast_value(self.right.emit(ctx), t)
+        values, extra_validity = self.eval_values(l.values, r.values)
+        validity = combine_validity(l.validity, r.validity, extra_validity)
+        return ColVal(self.dtype, values, validity)
+
+    def eval_values(self, l, r):
+        """Return (values, extra_invalidity-mask-or-None)."""
+        raise NotImplementedError
+
+
+# --------------------------------------------------------- shared emit helpers
+
+def promote_types(a: DataType, b: DataType) -> DataType:
+    """Numeric widening used when binding binary arithmetic/comparison."""
+    if a.name == b.name:
+        return a
+    order = ["tinyint", "smallint", "int", "bigint", "float", "double"]
+    if a.name in order and b.name in order:
+        return dts.dtype_from_name(order[max(order.index(a.name),
+                                             order.index(b.name))])
+    if a.is_decimal and b.is_integral:
+        return a
+    if b.is_decimal and a.is_integral:
+        return b
+    if a.is_datetime and b.is_datetime:
+        return dts.TIMESTAMP_US
+    raise TypeError(f"cannot promote {a} and {b}")
+
+
+def cast_value(v: ColVal, target: DataType) -> ColVal:
+    """Numeric-only in-trace cast used for implicit promotions."""
+    if v.dtype.name == target.name:
+        return v
+    return ColVal(target, v.values.astype(target.storage), v.validity)

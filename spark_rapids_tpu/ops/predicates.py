@@ -1,0 +1,485 @@
+"""Comparison, logic, null and conditional expressions.
+
+Coverage target: the reference's ``predicates.scala`` (651 LoC),
+``nullExpressions.scala`` (281) and ``conditionalExpressions.scala`` (151)
+(SURVEY.md Appendix A.1).  Spark semantics replicated here:
+
+* AND/OR use Kleene three-valued logic (null AND false = false);
+* floating comparisons treat NaN = NaN as true and NaN as the largest value
+  (matching Spark's ordering, `docs/compatibility.md:76-81` in the reference);
+* -0.0 compares equal to 0.0 (IEEE, jnp default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.expressions import (
+    BinaryExpression, ColVal, EmitContext, Expression, UnaryExpression,
+    cast_value, combine_validity, promote_types,
+)
+
+
+def _is_float(v) -> bool:
+    return jnp.issubdtype(v.dtype, jnp.floating)
+
+
+class _Comparison(BinaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        return dts.BOOL
+
+
+class EqualTo(_Comparison):
+    def eval_values(self, l, r):
+        eq = l == r
+        if _is_float(l):
+            eq = eq | (jnp.isnan(l) & jnp.isnan(r))
+        return eq, None
+
+
+class LessThan(_Comparison):
+    def eval_values(self, l, r):
+        lt = l < r
+        if _is_float(l):  # NaN is largest: NaN < x is false, x < NaN true unless x NaN
+            lt = jnp.where(jnp.isnan(l), False,
+                           jnp.where(jnp.isnan(r), True, lt))
+        return lt, None
+
+
+class LessThanOrEqual(_Comparison):
+    def eval_values(self, l, r):
+        le = l <= r
+        if _is_float(l):
+            le = jnp.where(jnp.isnan(l), jnp.isnan(r),
+                           jnp.where(jnp.isnan(r), True, le))
+        return le, None
+
+
+class GreaterThan(_Comparison):
+    def eval_values(self, l, r):
+        gt = l > r
+        if _is_float(l):
+            gt = jnp.where(jnp.isnan(l), ~jnp.isnan(r),
+                           jnp.where(jnp.isnan(r), False, gt))
+        return gt, None
+
+
+class GreaterThanOrEqual(_Comparison):
+    def eval_values(self, l, r):
+        ge = l >= r
+        if _is_float(l):
+            ge = jnp.where(jnp.isnan(l), True,
+                           jnp.where(jnp.isnan(r), False, ge))
+        return ge, None
+
+
+class EqualNullSafe(_Comparison):
+    """<=> : null-safe equality, never returns null."""
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        t = promote_types(self.left.dtype, self.right.dtype)
+        l = cast_value(self.left.emit(ctx), t)
+        r = cast_value(self.right.emit(ctx), t)
+        eq = l.values == r.values
+        if _is_float(l.values):
+            eq = eq | (jnp.isnan(l.values) & jnp.isnan(r.values))
+        lv = l.validity if l.validity is not None else jnp.bool_(True)
+        rv = r.validity if r.validity is not None else jnp.bool_(True)
+        both_valid = jnp.logical_and(lv, rv)
+        both_null = jnp.logical_and(jnp.logical_not(lv), jnp.logical_not(rv))
+        return ColVal(dts.BOOL, jnp.where(both_valid, eq, both_null))
+
+
+class And(BinaryExpression):
+    """Kleene AND: false dominates null."""
+
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        values = jnp.logical_and(l.values, r.values)
+        if l.validity is None and r.validity is None:
+            return ColVal(dts.BOOL, values)
+        lv = l.validity if l.validity is not None else jnp.bool_(True)
+        rv = r.validity if r.validity is not None else jnp.bool_(True)
+        # result valid if both valid, or either side is a valid False
+        validity = (lv & rv) | (lv & jnp.logical_not(l.values)) | \
+            (rv & jnp.logical_not(r.values))
+        return ColVal(dts.BOOL, values, validity)
+
+
+class Or(BinaryExpression):
+    """Kleene OR: true dominates null."""
+
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        values = jnp.logical_or(l.values, r.values)
+        if l.validity is None and r.validity is None:
+            return ColVal(dts.BOOL, values)
+        lv = l.validity if l.validity is not None else jnp.bool_(True)
+        rv = r.validity if r.validity is not None else jnp.bool_(True)
+        validity = (lv & rv) | (lv & l.values) | (rv & r.values)
+        return ColVal(dts.BOOL, values, validity)
+
+
+class Not(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    def eval_values(self, v, cv):
+        return jnp.logical_not(v)
+
+
+class IsNull(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        if c.validity is None:
+            shape = () if c.is_scalar else (ctx.capacity,)
+            return ColVal(dts.BOOL, jnp.zeros(shape, dtype=jnp.bool_))
+        return ColVal(dts.BOOL, jnp.logical_not(c.validity))
+
+
+class IsNotNull(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        if c.validity is None:
+            shape = () if c.is_scalar else (ctx.capacity,)
+            return ColVal(dts.BOOL, jnp.ones(shape, dtype=jnp.bool_))
+        return ColVal(dts.BOOL, c.validity)
+
+
+class IsNaN(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        nan = jnp.isnan(c.values) if _is_float(c.values) else \
+            jnp.zeros_like(c.values, dtype=jnp.bool_)
+        if c.validity is not None:  # null is not NaN
+            nan = jnp.logical_and(nan, c.validity)
+        return ColVal(dts.BOOL, nan)
+
+
+class NaNvl(BinaryExpression):
+    """nanvl(a, b): b where a is NaN else a."""
+
+    def eval_values(self, l, r):
+        return jnp.where(jnp.isnan(l), r, l), None
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    @property
+    def dtype(self) -> DataType:
+        t = self.children[0].dtype
+        for c in self.children[1:]:
+            t = promote_types(t, c.dtype)
+        return t
+
+    @property
+    def nullable(self):
+        return all(c.nullable for c in self.children)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        t = self.dtype
+        out = cast_value(self.children[-1].emit(ctx), t)
+        for child in reversed(self.children[:-1]):
+            c = cast_value(child.emit(ctx), t)
+            if c.validity is None:
+                out = c
+            else:
+                values = jnp.where(c.validity, c.values, out.values)
+                if out.validity is None:
+                    validity = jnp.logical_or(
+                        c.validity, jnp.ones((), dtype=jnp.bool_))
+                    validity = None
+                else:
+                    validity = jnp.logical_or(c.validity, out.validity)
+                out = ColVal(t, values, validity)
+        return out
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, if_true: Expression,
+                 if_false: Expression):
+        self.children = (pred, if_true, if_false)
+
+    def with_children(self, children):
+        return If(*children)
+
+    @property
+    def dtype(self) -> DataType:
+        return promote_types(self.children[1].dtype, self.children[2].dtype)
+
+    @property
+    def nullable(self):
+        return (self.children[0].nullable or self.children[1].nullable
+                or self.children[2].nullable)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        t = self.dtype
+        p = self.children[0].emit(ctx)
+        a = cast_value(self.children[1].emit(ctx), t)
+        b = cast_value(self.children[2].emit(ctx), t)
+        # null predicate selects the else branch (Spark semantics)
+        cond = p.values
+        if p.validity is not None:
+            cond = jnp.logical_and(cond, p.validity)
+        values = jnp.where(cond, a.values, b.values)
+        if a.validity is None and b.validity is None:
+            return ColVal(t, values)
+        av = a.validity if a.validity is not None else jnp.bool_(True)
+        bv = b.validity if b.validity is not None else jnp.bool_(True)
+        return ColVal(t, values, jnp.where(cond, av, bv))
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... [ELSE e] END, lowered to a chain of Ifs."""
+
+    def __init__(self, branches: Sequence[tuple],
+                 else_value: Optional[Expression] = None):
+        self.branches = [(p, v) for p, v in branches]
+        self.else_value = else_value
+        flat = [e for pv in self.branches for e in pv]
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = tuple(flat)
+
+    def with_children(self, children):
+        n = len(self.branches)
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        els = children[2 * n] if self.else_value is not None else None
+        return CaseWhen(branches, els)
+
+    def bind(self, schema):
+        return self.with_children([c.bind(schema) for c in self.children])
+
+    def _as_if_chain(self) -> Expression:
+        from spark_rapids_tpu.ops.expressions import Literal
+        els = self.else_value
+        if els is None:
+            els = Literal(None, self.branches[0][1].dtype)
+        out = els
+        for pred, val in reversed(self.branches):
+            out = If(pred, val, out)
+        return out
+
+    @property
+    def dtype(self) -> DataType:
+        return self._as_if_chain().dtype
+
+    @property
+    def nullable(self):
+        return self.else_value is None or self._as_if_chain().nullable
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        return self._as_if_chain().emit(ctx)
+
+    def cache_key(self):
+        return ("CaseWhen", tuple(c.cache_key() for c in self.children),
+                self.else_value is not None)
+
+
+class In(Expression):
+    """value IN (literals...)."""
+
+    def __init__(self, value: Expression, options: Sequence[Expression]):
+        self.children = (value,) + tuple(options)
+
+    def with_children(self, children):
+        return In(children[0], children[1:])
+
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        v = self.children[0].emit(ctx)
+        hit = jnp.zeros((), dtype=jnp.bool_)
+        has_null_option = jnp.zeros((), dtype=jnp.bool_)
+        for opt in self.children[1:]:
+            o = opt.emit(ctx)
+            eq = v.values == o.values.astype(v.values.dtype)
+            if o.validity is not None:
+                eq = jnp.logical_and(eq, o.validity)
+                has_null_option = jnp.logical_or(
+                    has_null_option, jnp.logical_not(o.validity))
+            hit = jnp.logical_or(hit, eq)
+        # match -> true; no match with a null anywhere -> null; else false
+        base = v.validity if v.validity is not None else jnp.bool_(True)
+        validity = jnp.logical_and(
+            base, jnp.logical_or(hit, jnp.logical_not(has_null_option)))
+        hit = jnp.broadcast_to(hit, (ctx.capacity,)) if hit.ndim == 0 else hit
+        return ColVal(dts.BOOL, hit, validity)
+
+
+class Greatest(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Greatest(*children)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        for c in self.children[1:]:
+            t = promote_types(t, c.dtype)
+        return t
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        # greatest skips nulls; null only if all null
+        t = self.dtype
+        out = None
+        for child in self.children:
+            c = cast_value(child.emit(ctx), t)
+            if out is None:
+                out = c
+                continue
+            if c.validity is None and out.validity is None:
+                out = ColVal(t, jnp.maximum(out.values, c.values))
+            else:
+                ov = out.validity if out.validity is not None else jnp.bool_(True)
+                cv = c.validity if c.validity is not None else jnp.bool_(True)
+                bigger = jnp.where(
+                    ov & cv, jnp.maximum(out.values, c.values),
+                    jnp.where(ov, out.values, c.values))
+                out = ColVal(t, bigger, jnp.logical_or(ov, cv))
+        return out
+
+
+class Least(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Least(*children)
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        for c in self.children[1:]:
+            t = promote_types(t, c.dtype)
+        return t
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        t = self.dtype
+        out = None
+        for child in self.children:
+            c = cast_value(child.emit(ctx), t)
+            if out is None:
+                out = c
+                continue
+            if c.validity is None and out.validity is None:
+                out = ColVal(t, jnp.minimum(out.values, c.values))
+            else:
+                ov = out.validity if out.validity is not None else jnp.bool_(True)
+                cv = c.validity if c.validity is not None else jnp.bool_(True)
+                smaller = jnp.where(
+                    ov & cv, jnp.minimum(out.values, c.values),
+                    jnp.where(ov, out.values, c.values))
+                out = ColVal(t, smaller, jnp.logical_or(ov, cv))
+        return out
+
+
+class AtLeastNNonNulls(Expression):
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        count = jnp.zeros((), dtype=jnp.int32)
+        total = None
+        for child in self.children:
+            c = child.emit(ctx)
+            valid = c.validity if c.validity is not None else jnp.bool_(True)
+            if _is_float(c.values):
+                valid = jnp.logical_and(valid, jnp.logical_not(
+                    jnp.isnan(c.values)))
+            inc = valid.astype(jnp.int32)
+            total = inc if total is None else total + inc
+        return ColVal(dts.BOOL, total >= self.n)
+
+    def cache_key(self):
+        return ("AtLeastNNonNulls", self.n,
+                tuple(c.cache_key() for c in self.children))
+
+
+class KnownNotNull(UnaryExpression):
+    @property
+    def nullable(self):
+        return False
+
+    def emit(self, ctx):
+        c = self.child.emit(ctx)
+        return ColVal(c.dtype, c.values, None, c.offsets)
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    def emit(self, ctx):
+        return self.child.emit(ctx)
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN payloads and -0.0 -> 0.0 before grouping/joining
+    (reference NormalizeFloatingNumbers.scala:38)."""
+
+    def eval_values(self, v, cv):
+        v = jnp.where(jnp.isnan(v), jnp.nan, v)
+        return jnp.where(v == 0.0, 0.0, v)

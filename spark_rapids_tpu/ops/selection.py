@@ -1,0 +1,64 @@
+"""Row selection kernels: mask compaction and permutation gather.
+
+The TPU replacement for cudf's ``Table.filter`` / gather-map machinery
+(reference ``basicPhysicalOperators.scala:297`` GpuFilterExec and
+``JoinGatherer.scala``).  cudf allocates an exact-size output; XLA wants
+static shapes, so these kernels keep the input capacity and return a traced
+``new_nrows`` — the caller re-buckets later if occupancy gets low.
+
+String gather is fully vectorized: new offsets by cumsum of gathered lengths,
+then a searchsorted over char positions maps every output byte to its source
+byte (O(C log N) for C chars — bandwidth-bound, which is what TPUs like).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.expressions import ColVal
+
+
+def gather(cols: Sequence[ColVal], indices, out_count) -> List[ColVal]:
+    """Gather rows of every column at ``indices`` (int array, len=capacity).
+
+    Rows at positions >= out_count are padding. ``indices`` entries for
+    padding rows may be arbitrary but must be in-range.
+    """
+    capacity = indices.shape[0]
+    out_mask = jnp.arange(capacity, dtype=jnp.int32) < out_count
+    outs: List[ColVal] = []
+    for c in cols:
+        validity = None if c.validity is None else c.validity[indices]
+        if c.offsets is None:
+            outs.append(ColVal(c.dtype, c.values[indices], validity))
+            continue
+        # string column: rebuild offsets + chars
+        lengths = c.offsets[indices + 1] - c.offsets[indices]
+        lengths = jnp.where(out_mask, lengths, 0)
+        new_offsets = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(lengths,
+                                                       dtype=jnp.int32)])
+        char_cap = c.values.shape[0]
+        pos = jnp.arange(char_cap, dtype=jnp.int32)
+        # row containing each output byte (last offset <= pos)
+        row = jnp.searchsorted(new_offsets, pos, side="right") - 1
+        row = jnp.clip(row, 0, capacity - 1)
+        src = c.offsets[indices[row]] + (pos - new_offsets[row])
+        src = jnp.clip(src, 0, char_cap - 1)
+        total = new_offsets[capacity]
+        chars = jnp.where(pos < total, c.values[src], 0).astype(jnp.uint8)
+        outs.append(ColVal(c.dtype, chars, validity, new_offsets))
+    return outs
+
+
+def compact(cols: Sequence[ColVal], keep) -> Tuple[List[ColVal], jnp.ndarray]:
+    """Move rows where ``keep`` is True to the front, preserving order.
+
+    Returns (columns, new_nrows). ``keep`` must already exclude padding rows.
+    """
+    # stable sort: kept rows (0) before dropped (1), original order preserved
+    perm = jnp.argsort(jnp.logical_not(keep), stable=True).astype(jnp.int32)
+    new_nrows = keep.sum().astype(jnp.int32)
+    return gather(cols, perm, new_nrows), new_nrows

@@ -1,0 +1,15 @@
+"""String expressions over the chars+offsets layout.
+
+Coverage target: reference ``stringFunctions.scala`` (1,053 LoC).  Filled in
+incrementally; cast_string is the GpuCast string-path hook.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.expressions import ColVal, EmitContext
+
+
+def cast_string(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:
+    raise NotImplementedError(
+        f"cast {c.dtype} -> {target} not yet supported on TPU")

@@ -1,0 +1,219 @@
+"""Expression engine tests — oracle: hand-computed / pandas values.
+
+Mirrors the reference's operator-level unit suites (ArithmeticOperationsSuite,
+CastOpSuite, ...) in miniature: evaluate expressions through the stage
+compiler and compare to Spark-semantics expectations.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.ops import arithmetic as A
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.compiler import FilterStageFn, StageFn
+from spark_rapids_tpu.ops.expressions import (
+    Alias, Literal, UnresolvedColumn as col)
+
+
+def run_exprs(batch: ColumnarBatch, *exprs):
+    schema = batch.schema
+    bound = [e.bind(schema) for e in exprs]
+    fn = StageFn(bound, [dt for _, dt in schema])
+    cols = fn(batch)
+    return [c.to_pylist() for c in cols]
+
+
+def test_add_mul_sub():
+    b = ColumnarBatch.from_pydict({"x": [1, 2, 3], "y": [10, 20, 30]})
+    (add,), = (run_exprs(b, A.Add(col("x"), col("y"))),)
+    assert add == [11, 22, 33]
+    out = run_exprs(b, A.Multiply(col("x"), col("y")),
+                    A.Subtract(col("y"), col("x")))
+    assert out[0] == [10, 40, 90]
+    assert out[1] == [9, 18, 27]
+
+
+def test_null_propagation():
+    b = ColumnarBatch.from_pydict({"x": [1, None, 3], "y": [None, 5, 6]})
+    out, = run_exprs(b, A.Add(col("x"), col("y")))
+    assert out == [None, None, 9]
+
+
+def test_divide_semantics():
+    b = ColumnarBatch.from_pydict({"x": [10, 7, 5], "y": [2, 0, -2]})
+    div, = run_exprs(b, A.Divide(col("x"), col("y")))
+    assert div[0] == 5.0 and div[1] is None and div[2] == -2.5
+    idiv, = run_exprs(b, A.IntegralDivide(col("x"), col("y")))
+    assert idiv == [5, None, -2]  # truncation toward zero
+    rem, = run_exprs(b, A.Remainder(col("x"), col("y")))
+    assert rem == [0, None, 1]  # sign follows dividend
+    pmod, = run_exprs(b, A.Pmod(col("x"), col("y")))
+    assert pmod[0] == 0 and pmod[1] is None and pmod[2] == 1
+
+
+def test_remainder_negative_dividend():
+    b = ColumnarBatch.from_pydict({"x": [-7], "y": [3]})
+    rem, = run_exprs(b, A.Remainder(col("x"), col("y")))
+    assert rem == [-1]  # Java: -7 % 3 == -1
+    pmod, = run_exprs(b, A.Pmod(col("x"), col("y")))
+    assert pmod == [2]
+
+
+def test_comparisons_and_nan():
+    b = ColumnarBatch.from_pydict({
+        "x": np.array([1.0, np.nan, 3.0]),
+        "y": np.array([np.nan, np.nan, 2.0])})
+    eq, = run_exprs(b, P.EqualTo(col("x"), col("y")))
+    assert eq == [False, True, False]  # NaN == NaN is true in Spark
+    lt, = run_exprs(b, P.LessThan(col("x"), col("y")))
+    assert lt == [True, False, False]  # NaN is largest
+    gt, = run_exprs(b, P.GreaterThan(col("x"), col("y")))
+    assert gt == [False, False, True]
+
+
+def test_kleene_logic():
+    b = ColumnarBatch.from_pydict({
+        "p": [True, False, None, True, None],
+        "q": [None, None, False, False, None]})
+    andv, = run_exprs(b, P.And(col("p"), col("q")))
+    assert andv == [None, False, False, False, None]
+    orv, = run_exprs(b, P.Or(col("p"), col("q")))
+    assert orv == [True, None, None, True, None]
+
+
+def test_conditionals():
+    b = ColumnarBatch.from_pydict({"x": [1, 5, None]})
+    out, = run_exprs(b, P.If(P.GreaterThan(col("x"), Literal(2)),
+                             Literal(100), Literal(-100)))
+    assert out == [-100, 100, -100]  # null predicate -> else branch
+    cw, = run_exprs(b, P.CaseWhen(
+        [(P.EqualTo(col("x"), Literal(1)), Literal(10)),
+         (P.EqualTo(col("x"), Literal(5)), Literal(50))]))
+    assert cw == [10, 50, None]
+
+
+def test_null_ops():
+    b = ColumnarBatch.from_pydict({"x": [1, None, 3], "y": [9, 8, None]})
+    out = run_exprs(b, P.IsNull(col("x")), P.IsNotNull(col("x")),
+                    P.Coalesce(col("x"), col("y")))
+    assert out[0] == [False, True, False]
+    assert out[1] == [True, False, True]
+    assert out[2] == [1, 8, 3]
+
+
+def test_in_expr():
+    b = ColumnarBatch.from_pydict({"x": [1, 2, 3, None]})
+    out, = run_exprs(b, P.In(col("x"), [Literal(1), Literal(3)]))
+    assert out == [True, False, True, None]
+    out2, = run_exprs(b, P.In(col("x"),
+                              [Literal(1), Literal(None, dts.INT64)]))
+    assert out2 == [True, None, None, None]
+
+
+def test_greatest_least():
+    b = ColumnarBatch.from_pydict({"x": [1, None, 3], "y": [2, 5, None]})
+    g, = run_exprs(b, P.Greatest(col("x"), col("y")))
+    assert g == [2, 5, 3]  # skips nulls
+    l, = run_exprs(b, P.Least(col("x"), col("y")))
+    assert l == [1, 5, 3]
+
+
+def test_math_fns():
+    b = ColumnarBatch.from_pydict({"x": [1.0, 4.0, 9.0]})
+    out = run_exprs(b, A.Sqrt(col("x")), A.Log(col("x")), A.Abs(
+        A.UnaryMinus(col("x"))))
+    np.testing.assert_allclose(out[0], [1, 2, 3])
+    np.testing.assert_allclose(out[1], np.log([1, 4, 9]))
+    np.testing.assert_allclose(out[2], [1, 4, 9])
+
+
+def test_floor_ceil_round():
+    b = ColumnarBatch.from_pydict({"x": [1.5, -1.5, 2.5]})
+    fl, ce = run_exprs(b, A.Floor(col("x")), A.Ceil(col("x")))
+    assert fl == [1, -2, 2] and ce == [2, -1, 3]
+    rd, = run_exprs(b, A.Round(col("x")))
+    assert rd == [2.0, -2.0, 3.0]  # HALF_UP
+    brd, = run_exprs(b, A.BRound(col("x")))
+    assert brd == [2.0, -2.0, 2.0]  # HALF_EVEN
+
+
+def test_bitwise_and_shifts():
+    b = ColumnarBatch.from_pydict({"x": [0b1100, -8], "n": [2, 1]})
+    out = run_exprs(b, A.BitwiseAnd(col("x"), Literal(0b1010)),
+                    A.ShiftLeft(col("x"), col("n")),
+                    A.ShiftRight(col("x"), col("n")))
+    assert out[0] == [0b1000, 8]
+    assert out[1] == [48, -16]
+    assert out[2] == [3, -4]
+
+
+def test_cast_matrix():
+    b = ColumnarBatch.from_pydict({"f": [1.9, -2.9, float("nan")]})
+    out, = run_exprs(b, Cast(col("f"), dts.INT32))
+    assert out == [1, -2, 0]  # truncation; NaN -> 0
+    b2 = ColumnarBatch.from_pydict({"i": [0, 1, 5]})
+    bl, = run_exprs(b2, Cast(col("i"), dts.BOOL))
+    assert bl == [False, True, True]
+    ts, = run_exprs(b2, Cast(col("i"), dts.TIMESTAMP_US))
+    assert ts == [0, 1_000_000, 5_000_000]  # seconds -> micros
+
+
+def test_cast_saturation():
+    b = ColumnarBatch.from_pydict({"f": [1e12, -1e12]})
+    out, = run_exprs(b, Cast(col("f"), dts.INT32))
+    assert out == [(1 << 31) - 1, -(1 << 31)]
+
+
+def test_equal_null_safe():
+    b = ColumnarBatch.from_pydict({"x": [1, None, None], "y": [1, 2, None]})
+    out, = run_exprs(b, P.EqualNullSafe(col("x"), col("y")))
+    assert out == [True, False, True]
+
+
+def test_filter_stage_compacts():
+    b = ColumnarBatch.from_pydict({
+        "x": [1, 2, 3, 4, 5],
+        "s": ["a", "bb", "ccc", "dddd", "eeeee"]})
+    schema = b.schema
+    pred = P.GreaterThan(col("x"), Literal(2)).bind(schema)
+    projs = [col("x").bind(schema), col("s").bind(schema)]
+    fn = FilterStageFn(pred, projs, [dt for _, dt in schema])
+    cols, n = fn(b)
+    assert n == 3
+    assert cols[0].to_pylist() == [3, 4, 5]
+    assert cols[1].to_pylist() == ["ccc", "dddd", "eeeee"]
+
+
+def test_filter_with_null_predicate():
+    b = ColumnarBatch.from_pydict({"x": [1, None, 3]})
+    schema = b.schema
+    pred = P.GreaterThan(col("x"), Literal(0)).bind(schema)
+    fn = FilterStageFn(pred, [col("x").bind(schema)],
+                       [dt for _, dt in schema])
+    cols, n = fn(b)
+    assert n == 2 and cols[0].to_pylist() == [1, 3]
+
+
+def test_string_gather_roundtrip():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import selection
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.ops.expressions import ColVal
+    c = Column.from_strings(["aa", "b", "cccc", "", "dd"])
+    cv = ColVal(c.dtype, c.data, c.validity, c.offsets)
+    idx = jnp.zeros(c.capacity, dtype=jnp.int32).at[:3].set(
+        jnp.array([4, 2, 0], dtype=jnp.int32))
+    out = selection.gather([cv], idx, jnp.int32(3))[0]
+    res = Column(c.dtype, out.values, 3, validity=out.validity,
+                 offsets=out.offsets)
+    assert res.to_pylist() == ["dd", "cccc", "aa"]
+
+
+def test_alias_and_literal_project():
+    b = ColumnarBatch.from_pydict({"x": [1, 2]})
+    out = run_exprs(b, Alias(A.Add(col("x"), Literal(1)), "x1"), Literal(7))
+    assert out[0] == [2, 3]
+    assert out[1] == [7, 7]
