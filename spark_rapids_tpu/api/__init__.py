@@ -1,0 +1,4 @@
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions
+
+__all__ = ["TpuSession", "functions"]

@@ -1,0 +1,177 @@
+"""DataFrame API over logical plans."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from spark_rapids_tpu.api.functions import Col, SortKey, _expr, _lit_expr
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, Expression, UnresolvedColumn)
+from spark_rapids_tpu.plan import logical as L
+
+
+class DataFrame:
+    def __init__(self, session, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # ------------------------------------------------------------- transforms --
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return [n for n, _ in self.plan.schema]
+
+    def select(self, *cols: Union[Col, str]) -> "DataFrame":
+        exprs = [_expr(c) for c in cols]
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def filter(self, condition: Col) -> "DataFrame":
+        return DataFrame(self.session, L.Filter(_expr(condition), self.plan))
+
+    where = filter
+
+    def withColumn(self, name: str, c: Col) -> "DataFrame":
+        exprs: List[Expression] = []
+        replaced = False
+        for n, _ in self.plan.schema:
+            if n == name:
+                exprs.append(Alias(_expr(c), name))
+                replaced = True
+            else:
+                exprs.append(UnresolvedColumn(n))
+        if not replaced:
+            exprs.append(Alias(_expr(c), name))
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    with_column = withColumn
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [Alias(UnresolvedColumn(n), new) if n == old
+                 else UnresolvedColumn(n) for n, _ in self.plan.schema]
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def drop(self, *names: str) -> "DataFrame":
+        exprs = [UnresolvedColumn(n) for n, _ in self.plan.schema
+                 if n not in names]
+        return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def groupBy(self, *cols: Union[Col, str]) -> "GroupedData":
+        return GroupedData(self, [_expr(c) for c in cols])
+
+    group_by = groupBy
+
+    def agg(self, *aggs: Col) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
+             how: str = "inner") -> "DataFrame":
+        keys = [on] if isinstance(on, str) else list(on)
+        lk = [UnresolvedColumn(k) for k in keys]
+        rk = [UnresolvedColumn(k) for k in keys]
+        return DataFrame(self.session, L.Join(
+            self.plan, other.plan, lk, rk, how))
+
+    def orderBy(self, *keys: Union[Col, str, SortKey]) -> "DataFrame":
+        orders = []
+        for k in keys:
+            if isinstance(k, SortKey):
+                orders.append((k.expr, k.descending, k.nulls_first))
+            else:
+                orders.append((_expr(k), False, True))
+        return DataFrame(self.session, L.Sort(orders, self.plan))
+
+    sort = orderBy
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, L.Limit(n, self.plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, L.Union([self.plan, other.plan]))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(self.session, L.Aggregate(
+            [UnresolvedColumn(n) for n, _ in self.plan.schema], [],
+            self.plan))
+
+    # --------------------------------------------------------------- actions --
+    def _execute_batches(self) -> List[ColumnarBatch]:
+        exec_plan = self.session.plan(self.plan)
+        self._last_exec = exec_plan
+        return list(exec_plan.execute())
+
+    def to_arrow(self):
+        import pyarrow as pa
+        batches = self._execute_batches()
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            return empty_batch(self.plan.schema).to_arrow()
+        return pa.concat_tables(b.to_arrow() for b in batches)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    toPandas = to_pandas
+
+    def collect(self) -> List[tuple]:
+        table = self.to_arrow()
+        cols = [table.column(i).to_pylist()
+                for i in range(table.num_columns)]
+        return list(zip(*cols)) if cols else []
+
+    def count(self) -> int:
+        from spark_rapids_tpu.api import functions as F
+        rows = self.agg(F.count().alias("n")).collect()
+        return int(rows[0][0])
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).to_pandas().to_string(index=False))
+
+    def explain(self, mode: str = "formatted") -> None:
+        exec_plan = self.session.plan(self.plan)
+        print("== Logical Plan ==")
+        print(str(self.plan))
+        print("== Physical Plan ==")
+        print(exec_plan.tree_string())
+        print("== TPU Overrides ==")
+        print(self.session.overrides.last_explain)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, group_exprs: List[Expression]):
+        self.df = df
+        self.group_exprs = group_exprs
+
+    def agg(self, *aggs: Col) -> DataFrame:
+        agg_exprs = [_expr(a) for a in aggs]
+        return DataFrame(self.df.session, L.Aggregate(
+            self.group_exprs, agg_exprs, self.df.plan))
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.count().alias("count"))
+
+    def _simple(self, fname, *cols) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        fn = getattr(F, fname)
+        names = cols or [n for n, dt in self.df.plan.schema
+                         if dt.is_numeric and
+                         n not in {e.name for e in self.group_exprs}]
+        return self.agg(*[fn(c).alias(f"{fname}({c})") for c in names])
+
+    def sum(self, *cols):  # noqa: A003
+        return self._simple("sum", *cols)
+
+    def avg(self, *cols):
+        return self._simple("avg", *cols)
+
+    def min(self, *cols):  # noqa: A003
+        return self._simple("min", *cols)
+
+    def max(self, *cols):  # noqa: A003
+        return self._simple("max", *cols)

@@ -1,0 +1,254 @@
+"""PySpark-flavored column DSL.
+
+The user API the reference accelerates is Spark's DataFrame/Column DSL; this
+module provides the same surface (col/lit/when/agg functions with the
+familiar names) building this framework's expression trees.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType, dtype_from_name
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops import arithmetic as arith
+from spark_rapids_tpu.ops import predicates as preds
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.expressions import (
+    Alias, Expression, Literal, UnresolvedColumn)
+from spark_rapids_tpu.plan.logical import AggregateExpression
+
+ColumnLike = Union["Col", str, int, float, bool]
+
+
+def _expr(c: ColumnLike) -> Expression:
+    if isinstance(c, Col):
+        return c.expr
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return UnresolvedColumn(c)
+    return Literal(c)
+
+
+def _lit_expr(c) -> Expression:
+    """Like _expr but bare strings become string literals, not columns."""
+    if isinstance(c, Col):
+        return c.expr
+    if isinstance(c, Expression):
+        return c
+    return Literal(c)
+
+
+class Col:
+    """Wrapper adding pythonic operators over Expression trees."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, o):
+        return Col(arith.Add(self.expr, _lit_expr(o)))
+
+    def __radd__(self, o):
+        return Col(arith.Add(_lit_expr(o), self.expr))
+
+    def __sub__(self, o):
+        return Col(arith.Subtract(self.expr, _lit_expr(o)))
+
+    def __rsub__(self, o):
+        return Col(arith.Subtract(_lit_expr(o), self.expr))
+
+    def __mul__(self, o):
+        return Col(arith.Multiply(self.expr, _lit_expr(o)))
+
+    def __rmul__(self, o):
+        return Col(arith.Multiply(_lit_expr(o), self.expr))
+
+    def __truediv__(self, o):
+        return Col(arith.Divide(self.expr, _lit_expr(o)))
+
+    def __rtruediv__(self, o):
+        return Col(arith.Divide(_lit_expr(o), self.expr))
+
+    def __mod__(self, o):
+        return Col(arith.Remainder(self.expr, _lit_expr(o)))
+
+    def __neg__(self):
+        return Col(arith.UnaryMinus(self.expr))
+
+    # comparison
+    def __eq__(self, o):  # type: ignore[override]
+        return Col(preds.EqualTo(self.expr, _lit_expr(o)))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Col(preds.Not(preds.EqualTo(self.expr, _lit_expr(o))))
+
+    def __lt__(self, o):
+        return Col(preds.LessThan(self.expr, _lit_expr(o)))
+
+    def __le__(self, o):
+        return Col(preds.LessThanOrEqual(self.expr, _lit_expr(o)))
+
+    def __gt__(self, o):
+        return Col(preds.GreaterThan(self.expr, _lit_expr(o)))
+
+    def __ge__(self, o):
+        return Col(preds.GreaterThanOrEqual(self.expr, _lit_expr(o)))
+
+    # logic
+    def __and__(self, o):
+        return Col(preds.And(self.expr, _lit_expr(o)))
+
+    def __or__(self, o):
+        return Col(preds.Or(self.expr, _lit_expr(o)))
+
+    def __invert__(self):
+        return Col(preds.Not(self.expr))
+
+    # misc
+    def alias(self, name: str) -> "Col":
+        return Col(Alias(self.expr, name))
+
+    def cast(self, dtype: Union[str, DataType]) -> "Col":
+        if isinstance(dtype, str):
+            dtype = dtype_from_name(dtype)
+        return Col(Cast(self.expr, dtype))
+
+    def isNull(self) -> "Col":
+        return Col(preds.IsNull(self.expr))
+
+    def isNotNull(self) -> "Col":
+        return Col(preds.IsNotNull(self.expr))
+
+    def isin(self, *values) -> "Col":
+        return Col(preds.In(self.expr, [Literal(v) for v in values]))
+
+    def between(self, lo, hi) -> "Col":
+        return Col(preds.And(
+            preds.GreaterThanOrEqual(self.expr, _lit_expr(lo)),
+            preds.LessThanOrEqual(self.expr, _lit_expr(hi))))
+
+    def asc(self):
+        return SortKey(self.expr, descending=False, nulls_first=True)
+
+    def desc(self):
+        return SortKey(self.expr, descending=True, nulls_first=False)
+
+    def __repr__(self):
+        return f"Col({self.expr})"
+
+
+class SortKey:
+    def __init__(self, expr: Expression, descending: bool,
+                 nulls_first: bool):
+        self.expr = expr
+        self.descending = descending
+        self.nulls_first = nulls_first
+
+    def nullsFirst(self):
+        return SortKey(self.expr, self.descending, True)
+
+    def nullsLast(self):
+        return SortKey(self.expr, self.descending, False)
+
+
+def col(name: str) -> Col:
+    return Col(UnresolvedColumn(name))
+
+
+def lit(value, dtype: Optional[DataType] = None) -> Col:
+    return Col(Literal(value, dtype))
+
+
+def when(condition: Col, value) -> "CaseBuilder":
+    return CaseBuilder([(condition.expr, _lit_expr(value))])
+
+
+class CaseBuilder(Col):
+    def __init__(self, branches):
+        self.branches = branches
+        super().__init__(preds.CaseWhen(branches))
+
+    def when(self, condition: Col, value) -> "CaseBuilder":
+        return CaseBuilder(self.branches + [(condition.expr,
+                                             _lit_expr(value))])
+
+    def otherwise(self, value) -> Col:
+        return Col(preds.CaseWhen(self.branches, _lit_expr(value)))
+
+
+def coalesce(*cols) -> Col:
+    return Col(preds.Coalesce(*[_expr(c) for c in cols]))
+
+
+def isnan(c) -> Col:
+    return Col(preds.IsNaN(_expr(c)))
+
+
+def greatest(*cols) -> Col:
+    return Col(preds.Greatest(*[_expr(c) for c in cols]))
+
+
+def least(*cols) -> Col:
+    return Col(preds.Least(*[_expr(c) for c in cols]))
+
+
+def abs(c) -> Col:  # noqa: A001 - mirrors pyspark.sql.functions.abs
+    return Col(arith.Abs(_expr(c)))
+
+
+def sqrt(c) -> Col:
+    return Col(arith.Sqrt(_expr(c)))
+
+
+def round(c, scale: int = 0) -> Col:  # noqa: A001
+    return Col(arith.Round(_expr(c), scale))
+
+
+def pow(base, exp) -> Col:  # noqa: A001
+    return Col(arith.Pow(_expr(base), _lit_expr(exp)))
+
+
+def rand(seed: int = 0) -> Col:
+    return Col(arith.Rand(seed))
+
+
+# ----------------------------------------------------------------- aggregates
+
+def _agg(func_cls, c, **kw) -> Col:
+    return Col(AggregateExpression(func_cls(_expr(c), **kw)))
+
+
+def sum(c) -> Col:  # noqa: A001
+    return _agg(agg.Sum, c)
+
+
+def count(c="*") -> Col:
+    if c == "*" or (isinstance(c, Col) and isinstance(c.expr, Literal)):
+        return Col(AggregateExpression(agg.Count(None)))
+    return _agg(agg.Count, c)
+
+
+def avg(c) -> Col:
+    return _agg(agg.Average, c)
+
+
+mean = avg
+
+
+def min(c) -> Col:  # noqa: A001
+    return _agg(agg.Min, c)
+
+
+def max(c) -> Col:  # noqa: A001
+    return _agg(agg.Max, c)
+
+
+def first(c, ignore_nulls: bool = False) -> Col:
+    return Col(AggregateExpression(agg.First(_expr(c), ignore_nulls)))
+
+
+def last(c, ignore_nulls: bool = False) -> Col:
+    return Col(AggregateExpression(agg.Last(_expr(c), ignore_nulls)))

@@ -1,0 +1,121 @@
+"""TpuSession: entry point (the SparkSession + SQLPlugin bootstrap analog).
+
+Where the reference's SQLPlugin hooks into an existing SparkSession
+(Plugin.scala:57-70 injecting ColumnarOverrideRules), this standalone engine
+owns the session: it holds the RapidsConf, the planner (TpuOverrides), and
+the device runtime handles (memory manager + semaphore come with the memory
+task).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+from spark_rapids_tpu.api.dataframe import DataFrame
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config.rapids_conf import RapidsConf
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+
+
+class DataFrameReader:
+    def __init__(self, session: "TpuSession"):
+        self.session = session
+        self._options: Dict[str, str] = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def _make(self, paths, file_format) -> DataFrame:
+        from spark_rapids_tpu.io.readers import infer_file_schema
+        if isinstance(paths, str):
+            paths = [paths]
+        schema = infer_file_schema(paths, file_format)
+        rel = L.FileRelation(paths, file_format, schema, self._options)
+        return DataFrame(self.session, rel)
+
+    def parquet(self, *paths: str) -> DataFrame:
+        return self._make(list(paths), "parquet")
+
+    def orc(self, *paths: str) -> DataFrame:
+        return self._make(list(paths), "orc")
+
+    def csv(self, *paths: str) -> DataFrame:
+        return self._make(list(paths), "csv")
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None):
+        if isinstance(conf, dict):
+            conf = RapidsConf(conf)
+        self.conf = conf or RapidsConf()
+        self.overrides = TpuOverrides(self.conf)
+        TpuSession._active = self
+
+    # --------------------------------------------------------------- builders --
+    @classmethod
+    def builder(cls) -> "SessionBuilder":
+        return SessionBuilder()
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        if cls._active is None:
+            cls._active = TpuSession()
+        return cls._active
+
+    def set_conf(self, key: str, value) -> None:
+        self.conf = self.conf.set(key, value)
+        self.overrides = TpuOverrides(self.conf)
+
+    # ------------------------------------------------------------ data inputs --
+    def create_dataframe(self, data, schema: Optional[Sequence[str]] = None
+                         ) -> DataFrame:
+        import pandas as pd
+        import pyarrow as pa
+        if isinstance(data, pd.DataFrame):
+            batch = ColumnarBatch.from_pandas(data)
+        elif isinstance(data, pa.Table):
+            batch = ColumnarBatch.from_arrow(data)
+        elif isinstance(data, dict):
+            batch = ColumnarBatch.from_pydict(data)
+        elif isinstance(data, ColumnarBatch):
+            batch = data
+        elif isinstance(data, list) and schema is not None:
+            batch = ColumnarBatch.from_pydict(
+                {name: [row[i] for row in data]
+                 for i, name in enumerate(schema)})
+        else:
+            raise TypeError(f"cannot create DataFrame from {type(data)}")
+        rel = L.InMemoryRelation([batch], batch.schema)
+        return DataFrame(self, rel)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.Range(start, end, step))
+
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    # --------------------------------------------------------------- planning --
+    def plan(self, logical: L.LogicalPlan):
+        return self.overrides.apply(logical)
+
+
+class SessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, str] = {}
+
+    def config(self, key: str, value) -> "SessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def getOrCreate(self) -> TpuSession:
+        return TpuSession(RapidsConf(self._conf))

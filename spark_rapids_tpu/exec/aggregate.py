@@ -1,0 +1,273 @@
+"""Hash-aggregate physical operator (sort-based under the hood).
+
+Pipeline mirrors the reference's GpuHashAggregateIterator (aggregate.scala:
+184-209): per input batch run the *update* aggregation (fused with key/child
+expression evaluation in one XLA computation), cache the partial result
+batches, then concatenate on device and run the *merge* aggregation +
+finalization.  The reference's sort-based fallback is unnecessary: the primary
+algorithm here already IS sort+segment-reduce, which degrades gracefully with
+cardinality instead of blowing up a hash table.
+
+String group keys are dictionary-encoded on the host per operator instance
+(codes are stable across batches) — the acknowledged round-1 compromise for
+strings under XLA static shapes (SURVEY.md section 7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, empty_batch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.exec.base import (
+    AGG_TIME, CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, Schema, TpuExec)
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.compiler import (
+    StageFn, batch_to_flat, capacity_of, colvals_to_columns, flat_to_colvals)
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.plan.logical import AggregateExpression
+
+
+class _StringKeyEncoder:
+    """Host dictionary encoder with codes stable across batches."""
+
+    def __init__(self):
+        self.codes: Dict[Optional[str], int] = {}
+        self.values: List[Optional[str]] = []
+
+    def encode(self, col: Column) -> Column:
+        out = np.empty(col.nrows, dtype=np.int32)
+        for i, s in enumerate(col.to_pylist()):
+            code = self.codes.get(s)
+            if code is None:
+                code = len(self.values)
+                self.codes[s] = code
+                self.values.append(s)
+            out[i] = code
+        return Column.from_numpy(out, dtype=dts.INT32, capacity=col.capacity)
+
+    def decode(self, col: Column) -> Column:
+        codes = col.to_numpy()
+        return Column.from_strings([self.values[c] for c in codes],
+                                   capacity=col.capacity)
+
+
+def _merge_kind(update_kind: str) -> str:
+    return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+            "first": "first", "last": "last"}[update_kind]
+
+
+@functools.lru_cache(maxsize=None)
+def _grouped_kernel(kinds: Tuple[str, ...], nkeys: int):
+    """Group-by over pre-evaluated fixed-width (values, validity) columns."""
+
+    @jax.jit
+    def run(keys_flat, bufs_flat, nrows):
+        capacity = keys_flat[0][0].shape[0]
+        keys = [ColVal(None, v, val) for v, val in keys_flat]
+        buf_inputs = [(k, ColVal(None, v, val))
+                      for k, (v, val) in zip(kinds, bufs_flat)]
+        out_keys, out_bufs, n = agg.groupby_aggregate(
+            keys, buf_inputs, nrows, capacity)
+        return ([(k.values, k.validity) for k in out_keys],
+                [(b.values, b.validity) for b in out_bufs], n)
+
+    return run
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Tuple[str, AggregateExpression]],
+                 child: TpuExec):
+        super().__init__(child)
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self.funcs = [ae.func for _, ae in agg_exprs]
+        self._register_metric(NUM_INPUT_ROWS)
+        self._register_metric(NUM_INPUT_BATCHES)
+        self._register_metric(AGG_TIME)
+        self._register_metric(CONCAT_TIME)
+
+        self._in_dtypes = [dt for _, dt in child.schema]
+        self._string_key_idx = [i for i, e in enumerate(self.group_exprs)
+                                if e.dtype.is_string]
+        self._encoders = {i: _StringKeyEncoder()
+                          for i in self._string_key_idx}
+
+        # buffer layout: per func, a slice of the flat buffer-column list
+        self._buf_specs: List[agg.BufferSpec] = []
+        self._buf_slices: List[slice] = []
+        for f in self.funcs:
+            specs = f.buffers()
+            self._buf_slices.append(
+                slice(len(self._buf_specs), len(self._buf_specs) + len(specs)))
+            self._buf_specs.extend(specs)
+        self._update_kinds = tuple(s.kind for s in self._buf_specs)
+        self._merge_kinds = tuple(_merge_kind(k) for k in self._update_kinds)
+
+        if self._string_key_idx:
+            # stage A evaluates keys + agg children; the group kernel runs in
+            # stage B after host dictionary encoding of string keys
+            pre_exprs = list(self.group_exprs) + \
+                [f.child for f in self.funcs if f.child is not None]
+            self._pre_fn = StageFn(pre_exprs, self._in_dtypes)
+        else:
+            self._pre_fn = None
+            self._update_fn = jax.jit(self._update_fused)
+        self._merge_fn = jax.jit(self._merge)
+
+    # ------------------------------------------------------------------ plan --
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        out = [(e.name, e.dtype) for e in self.group_exprs]
+        out += [(name, ae.dtype) for name, ae in self.agg_exprs]
+        return out
+
+    def describe(self):
+        return (f"TpuHashAggregateExec[keys="
+                f"{[e.name for e in self.group_exprs]}, aggs="
+                f"{[n for n, _ in self.agg_exprs]}]")
+
+    @property
+    def _partial_schema(self) -> Schema:
+        keys = []
+        for i, e in enumerate(self.group_exprs):
+            dt = dts.INT32 if i in self._string_key_idx else e.dtype
+            keys.append((f"_k{i}", dt))
+        bufs = [(f"_b{j}", spec.dtype)
+                for j, spec in enumerate(self._buf_specs)]
+        return keys + bufs
+
+    # ---------------------------------------------------------- update stage --
+    def _eval_update_inputs(self, ctx: EmitContext) -> List[Tuple[str, ColVal]]:
+        pairs: List[Tuple[str, ColVal]] = []
+        for f in self.funcs:
+            c = f.child.emit(ctx) if f.child is not None else None
+            if c is not None and getattr(c.values, "ndim", 0) == 0 and \
+                    c.offsets is None:
+                c = ColVal(c.dtype,
+                           jnp.broadcast_to(c.values, (ctx.capacity,)),
+                           c.validity)
+            for spec, cv in zip(f.buffers(), f.update_inputs(c, ctx.capacity)):
+                pairs.append((spec.kind, cv))
+        return pairs
+
+    def _update_fused(self, flat_cols, nrows):
+        """No string keys: key eval + buffer eval + group-by, one computation."""
+        capacity = capacity_of(flat_cols)
+        inputs = flat_to_colvals(flat_cols, self._in_dtypes)
+        ctx = EmitContext(inputs, nrows, capacity)
+        keys = [e.emit(ctx) for e in self.group_exprs]
+        buf_inputs = self._eval_update_inputs(ctx)
+        if not keys:
+            outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
+            return ([], [(o.values, o.validity, o.offsets) for o in outs],
+                    jnp.int32(1))
+        out_keys, out_bufs, n = agg.groupby_aggregate(
+            keys, buf_inputs, nrows, capacity)
+        return ([(k.values, k.validity, k.offsets) for k in out_keys],
+                [(b.values, b.validity, b.offsets) for b in out_bufs], n)
+
+    def _partial_batches(self) -> Iterator[ColumnarBatch]:
+        names = [n for n, _ in self._partial_schema]
+        dtypes = [dt for _, dt in self._partial_schema]
+        for batch in self.child.execute():
+            self.metrics[NUM_INPUT_ROWS] += batch.nrows
+            self.metrics[NUM_INPUT_BATCHES] += 1
+            if batch.nrows == 0:
+                continue
+            with self.timer(AGG_TIME):
+                if self._string_key_idx:
+                    yield self._partial_with_string_keys(batch, names, dtypes)
+                else:
+                    key_flat, buf_flat, n = self._update_fn(
+                        batch_to_flat(batch), jnp.int32(batch.nrows))
+                    n = int(n)
+                    outs = [ColVal(dt, v, val, offs)
+                            for dt, (v, val, offs) in
+                            zip(dtypes, list(key_flat) + list(buf_flat))]
+                    cols = colvals_to_columns(outs, n, batch.capacity)
+                    yield ColumnarBatch(dict(zip(names, cols)), n)
+
+    def _partial_with_string_keys(self, batch, names, dtypes):
+        nkeys = len(self.group_exprs)
+        pre_cols = self._pre_fn(batch)
+        key_cols, child_cols = pre_cols[:nkeys], pre_cols[nkeys:]
+        enc_keys = [self._encoders[i].encode(c) if i in self._string_key_idx
+                    else c for i, c in enumerate(key_cols)]
+        child_iter = iter(child_cols)
+        buf_inputs: List[Tuple[str, ColVal]] = []
+        for f in self.funcs:
+            cc = next(child_iter) if f.child is not None else None
+            cv = None if cc is None else \
+                ColVal(cc.dtype, cc.data, cc.validity, cc.offsets)
+            for spec, bi in zip(f.buffers(),
+                                f.update_inputs(cv, batch.capacity)):
+                buf_inputs.append((spec.kind, bi))
+        kernel = _grouped_kernel(self._update_kinds, nkeys)
+        key_flat, buf_flat, n = kernel(
+            [(c.data, c.validity) for c in enc_keys],
+            [(c.values, c.validity) for _, c in buf_inputs],
+            jnp.int32(batch.nrows))
+        n = int(n)
+        outs = [ColVal(dt, v, val) for dt, (v, val) in
+                zip(dtypes, list(key_flat) + list(buf_flat))]
+        cols = colvals_to_columns(outs, n, batch.capacity)
+        return ColumnarBatch(dict(zip(names, cols)), n)
+
+    # ------------------------------------------------------------ merge stage --
+    def _merge(self, flat_cols, nrows):
+        dtypes = [dt for _, dt in self._partial_schema]
+        nkeys = len(self.group_exprs)
+        capacity = capacity_of(flat_cols)
+        cols = flat_to_colvals(flat_cols, dtypes)
+        keys, bufs = cols[:nkeys], cols[nkeys:]
+        merge_inputs = [(k, c) for k, c in zip(self._merge_kinds, bufs)]
+        if keys:
+            out_keys, out_bufs, n = agg.groupby_aggregate(
+                keys, merge_inputs, nrows, capacity)
+        else:
+            out_keys = []
+            out_bufs = agg.reduce_aggregate(merge_inputs, nrows, capacity)
+            n = jnp.int32(1)
+        results = [f.finalize(out_bufs[sl])
+                   for f, sl in zip(self.funcs, self._buf_slices)]
+        return ([(k.values, k.validity, k.offsets) for k in out_keys],
+                [(r.values, r.validity, r.offsets) for r in results], n)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        partials = list(self._partial_batches())
+        nkeys = len(self.group_exprs)
+        if not partials:
+            if nkeys:
+                return
+            partials = [empty_batch(self._partial_schema)]
+        with self.timer(CONCAT_TIME):
+            merged_in = concat_batches(partials)
+        with self.timer(AGG_TIME):
+            key_flat, res_flat, n = self._merge_fn(
+                batch_to_flat(merged_in), jnp.int32(merged_in.nrows))
+            n = int(n)
+        out_names = [name for name, _ in self.schema]
+        outs: List[ColVal] = []
+        for i, (e, (v, val, offs)) in enumerate(zip(self.group_exprs,
+                                                    key_flat)):
+            dt = dts.INT32 if i in self._string_key_idx else e.dtype
+            outs.append(ColVal(dt, v, val, offs))
+        for (name, ae), (v, val, offs) in zip(self.agg_exprs, res_flat):
+            outs.append(ColVal(ae.dtype, v, val, offs))
+        cols = colvals_to_columns(outs, n, merged_in.capacity)
+        for i in self._string_key_idx:
+            cols[i] = self._encoders[i].decode(cols[i])
+        yield ColumnarBatch(dict(zip(out_names, cols)), n)

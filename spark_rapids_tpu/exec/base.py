@@ -1,0 +1,128 @@
+"""TpuExec: base class for columnar physical operators + metrics.
+
+Counterpart of ``GpuExec.scala`` (metric registry with ESSENTIAL/MODERATE/
+DEBUG levels, standard names like opTime/numOutputRows/numOutputBatches).
+Operators produce an iterator of device-resident ColumnarBatches; crossing to
+the host happens only in collect/transition nodes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+Schema = List[Tuple[str, DataType]]
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+# standard metric names (GpuExec.scala:43-160)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+OP_TIME = "opTime"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+CONCAT_TIME = "concatTime"
+JOIN_TIME = "joinTime"
+SPILL_AMOUNT = "spillData"
+
+
+class TpuMetric:
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: int = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v) -> None:
+        self.value += v
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+
+class MetricTimer:
+    def __init__(self, metric: TpuMetric):
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter_ns() - self._t0)
+        return False
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, *children: "TpuExec"):
+        self.children: Tuple[TpuExec, ...] = tuple(children)
+        self.metrics: Dict[str, TpuMetric] = {}
+        self._register_metric(NUM_OUTPUT_ROWS, ESSENTIAL)
+        self._register_metric(NUM_OUTPUT_BATCHES, MODERATE)
+        self._register_metric(OP_TIME, MODERATE)
+
+    def _register_metric(self, name: str, level: int = MODERATE) -> TpuMetric:
+        m = self.metrics.setdefault(name, TpuMetric(name, level))
+        return m
+
+    def metric(self, name: str) -> TpuMetric:
+        return self.metrics[name]
+
+    def timer(self, name: str) -> MetricTimer:
+        return MetricTimer(self.metrics[name])
+
+    # ---- interface -----------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        """Produce device batches, updating numOutputRows/Batches."""
+        with self.timer(OP_TIME):
+            it = self.do_execute()
+        for batch in it:
+            self.metrics[NUM_OUTPUT_ROWS] += batch.nrows
+            self.metrics[NUM_OUTPUT_BATCHES] += 1
+            yield batch
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # ---- plan display --------------------------------------------------------
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.node_name()
+
+    def tree_string(self) -> str:
+        lines: List[str] = []
+
+        def rec(node, depth):
+            lines.append("  " * depth + node.describe())
+            for c in node.children:
+                rec(c, depth + 1)
+        rec(self, 0)
+        return "\n".join(lines)
+
+    def collect_metrics(self) -> Dict[str, Dict[str, int]]:
+        out = {}
+
+        def rec(node, path):
+            key = f"{path}{node.node_name()}"
+            out[key] = {m.name: m.value for m in node.metrics.values()}
+            for i, c in enumerate(node.children):
+                rec(c, f"{key}.{i}.")
+        rec(self, "")
+        return out

@@ -1,0 +1,181 @@
+"""Basic physical operators: scan, project, filter, range, union, limit.
+
+Counterpart of ``basicPhysicalOperators.scala`` (GpuProjectExec:111,
+GpuFilterExec:297, GpuRangeExec:358, GpuUnionExec:493) — with the stage-fusion
+twist: project and filter own compiled StageFns, so their whole expression
+forest is one XLA computation per capacity bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.exec.base import (
+    NUM_INPUT_BATCHES, NUM_INPUT_ROWS, Schema, TpuExec)
+from spark_rapids_tpu.ops.compiler import FilterStageFn, StageFn
+from spark_rapids_tpu.ops.expressions import BoundReference, Expression
+
+
+class TpuScanExec(TpuExec):
+    """In-memory relation scan: re-chunks host/device batches to target rows."""
+
+    def __init__(self, batches: Sequence[ColumnarBatch], schema: Schema,
+                 max_rows: Optional[int] = None):
+        super().__init__()
+        self.batches = list(batches)
+        self._schema = list(schema)
+        self.max_rows = max_rows
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for b in self.batches:
+            if self.max_rows is None or b.nrows <= self.max_rows:
+                yield b
+            else:
+                table = b.to_arrow()
+                for off in range(0, b.nrows, self.max_rows):
+                    yield ColumnarBatch.from_arrow(
+                        table.slice(off, self.max_rows))
+
+    def describe(self):
+        return f"TpuScanExec[{sum(b.nrows for b in self.batches)} rows]"
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._fn = StageFn(self.exprs, [dt for _, dt in child.schema])
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return [(e.name, e.dtype) for e in self.exprs]
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        names = [e.name for e in self.exprs]
+        for batch in self.child.execute():
+            cols = self._fn(batch)
+            yield ColumnarBatch(dict(zip(names, cols)), batch.nrows)
+
+    def describe(self):
+        return f"TpuProjectExec[{', '.join(e.name for e in self.exprs)}]"
+
+
+class TpuFilterExec(TpuExec):
+    """Fused predicate + compaction (+ pass-through projection)."""
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__(child)
+        self.condition = condition
+        in_schema = child.schema
+        passthrough = [BoundReference(i, dt, name=n)
+                       for i, (n, dt) in enumerate(in_schema)]
+        self._fn = FilterStageFn(condition, passthrough,
+                                 [dt for _, dt in in_schema])
+        self._register_metric(NUM_INPUT_ROWS)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        names = [n for n, _ in self.schema]
+        for batch in self.child.execute():
+            self.metrics[NUM_INPUT_ROWS] += batch.nrows
+            cols, n = self._fn(batch)
+            if n == 0:
+                continue
+            yield ColumnarBatch(dict(zip(names, cols)), n)
+
+    def describe(self):
+        return f"TpuFilterExec[{self.condition}]"
+
+
+class TpuRangeExec(TpuExec):
+    """range(start, end, step) -> bigint id column (GpuRangeExec:358)."""
+
+    def __init__(self, start: int, end: int, step: int,
+                 max_rows: int = 1 << 20):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.max_rows = max_rows
+        self._schema = [("id", dts.INT64)]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        emitted = 0
+        while emitted < total:
+            n = min(self.max_rows, total - emitted)
+            cap = bucket_capacity(n)
+            base = self.start + emitted * self.step
+            vals = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            yield ColumnarBatch({"id": Column(dts.INT64, vals, n)}, n)
+            emitted += n
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, *children: TpuExec):
+        super().__init__(*children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        names = [n for n, _ in self.schema]
+        for child in self.children:
+            for batch in child.execute():
+                cols = dict(zip(names, batch.columns.values()))
+                yield ColumnarBatch(cols, batch.nrows)
+
+
+class TpuLocalLimitExec(TpuExec):
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        remaining = self.n
+        for batch in self.child.execute():
+            if remaining <= 0:
+                return
+            if batch.nrows <= remaining:
+                remaining -= batch.nrows
+                yield batch
+            else:
+                cols = {n: c.with_nrows(remaining)
+                        for n, c in batch.columns.items()}
+                yield ColumnarBatch(cols, remaining)
+                return
+
+    def describe(self):
+        return f"TpuLocalLimitExec[{self.n}]"

@@ -1,0 +1,69 @@
+"""CPU fallback operator.
+
+The analog of the reference leaving unconverted Spark ops on the CPU: a
+logical node with no (or disallowed) TPU conversion executes on the host via
+pandas over the collected child output.  Columnar data crosses the device
+boundary exactly once each way (the GpuColumnarToRow/RowToColumnar
+transition-pair analog, GpuTransitionOverrides.scala:44).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import pandas as pd
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.plan import logical as L
+
+
+class CpuFallbackExec(TpuExec):
+    def __init__(self, node: L.LogicalPlan, children: List[TpuExec]):
+        super().__init__(*children)
+        self.node = node
+
+    @property
+    def schema(self) -> Schema:
+        return self.node.schema
+
+    def describe(self):
+        return f"CpuFallbackExec[{self.node.describe()}]"
+
+    def _child_pandas(self, i: int) -> pd.DataFrame:
+        import pyarrow as pa
+        batches = [b.to_arrow() for b in self.children[i].execute()]
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            return empty_batch(self.children[i].schema).to_pandas()
+        return pa.concat_tables(batches).to_pandas()
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        node = self.node
+        if isinstance(node, L.Sort):
+            df = self._child_pandas(0)
+            by = [e.name for e, _, _ in node.orders]
+            ascending = [not d for _, d, _ in node.orders]
+            na_position = "first" if node.orders[0][2] else "last"
+            out = df.sort_values(by=by, ascending=ascending,
+                                 na_position=na_position, kind="stable")
+        elif isinstance(node, L.Join):
+            left = self._child_pandas(0)
+            right = self._child_pandas(1)
+            lk = [e.name for e in node.left_keys]
+            rk = [e.name for e in node.right_keys]
+            how = {"inner": "inner", "left": "left", "right": "right",
+                   "full": "outer", "cross": "cross"}.get(node.join_type)
+            if how is None:
+                raise NotImplementedError(
+                    f"CPU fallback join type {node.join_type}")
+            out = left.merge(right, left_on=lk, right_on=rk, how=how)
+        elif isinstance(node, L.Limit):
+            out = self._child_pandas(0).head(node.n)
+        elif isinstance(node, L.Union):
+            out = pd.concat([self._child_pandas(i)
+                             for i in range(len(self.children))])
+        else:
+            raise NotImplementedError(
+                f"no CPU fallback for {type(node).__name__}")
+        yield ColumnarBatch.from_pandas(out.reset_index(drop=True))

@@ -1,0 +1,59 @@
+"""File scan execs (parquet / csv / orc).
+
+Round-1 shape of the reference's L6 I/O layer (GpuParquetScan.scala,
+GpuOrcScan.scala, GpuBatchScanExec.scala): host-side parse via pyarrow —
+the parquet-mr/footers analog — then device upload of columnar batches.
+Column pruning happens at the pyarrow level; the multi-file COALESCING /
+MULTITHREADED strategies and predicate pushdown land with the full io task.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.plan.logical import FileRelation
+
+
+def infer_file_schema(paths: List[str], file_format: str) -> Schema:
+    import pyarrow.dataset as ds
+    dataset = ds.dataset(paths, format=file_format)
+    return [(f.name, dts.from_arrow_type(f.type)) for f in dataset.schema]
+
+
+class TpuFileScanExec(TpuExec):
+    def __init__(self, paths: List[str], file_format: str, schema: Schema,
+                 batch_rows: int = 1 << 20,
+                 columns: Optional[List[str]] = None):
+        super().__init__()
+        self.paths = paths
+        self.file_format = file_format
+        self._schema = [s for s in schema
+                        if columns is None or s[0] in columns]
+        self.batch_rows = batch_rows
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return (f"TpuFileScanExec[{self.file_format}, {len(self.paths)} "
+                f"files]")
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        import pyarrow.dataset as ds
+        dataset = ds.dataset(self.paths, format=self.file_format)
+        names = [n for n, _ in self._schema]
+        for record_batch in dataset.to_batches(columns=names,
+                                               batch_size=self.batch_rows):
+            if record_batch.num_rows == 0:
+                continue
+            import pyarrow as pa
+            yield ColumnarBatch.from_arrow(
+                pa.Table.from_batches([record_batch]))
+
+
+def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
+    return TpuFileScanExec(node.paths, node.file_format, node.schema)

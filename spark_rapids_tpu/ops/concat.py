@@ -1,0 +1,109 @@
+"""Device-side batch concatenation.
+
+The TPU analog of ``GpuCoalesceBatches``' cudf ``Table.concatenate``
+(GpuCoalesceBatches.scala:195): small batches are appended into a larger
+fixed-capacity buffer entirely on device — no host round trip between a
+partial aggregation and its merge pass.
+
+``append_cols`` is shape-polymorphic only over (out_capacity, in_capacity)
+pairs, both power-of-two buckets, so the jit cache stays small.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.ops.expressions import ColVal
+
+
+@jax.jit
+def _append_fixed(out_vals, out_valid, out_n, in_vals, in_valid, in_n):
+    out_cap = out_vals.shape[0]
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    src = jnp.clip(pos - out_n, 0, in_vals.shape[0] - 1)
+    write = (pos >= out_n) & (pos < out_n + in_n)
+    vals = jnp.where(write, in_vals[src], out_vals)
+    valid = jnp.where(write, in_valid[src], out_valid)
+    return vals, valid
+
+
+@jax.jit
+def _append_string(out_chars, out_offs, out_valid, out_n,
+                   in_chars, in_offs, in_valid, in_n):
+    out_cap = out_offs.shape[0] - 1
+    pos = jnp.arange(out_cap + 1, dtype=jnp.int32)
+    base = out_offs[out_n]
+    src = jnp.clip(pos - out_n, 0, in_offs.shape[0] - 1)
+    new_offs = jnp.where((pos >= out_n) & (pos <= out_n + in_n),
+                         base + in_offs[src], out_offs)
+    # rows past the appended region keep the final offset (monotone padding)
+    end = base + in_offs[in_n]
+    new_offs = jnp.where(pos > out_n + in_n, end, new_offs)
+
+    cpos = jnp.arange(out_chars.shape[0], dtype=jnp.int32)
+    csrc = jnp.clip(cpos - base, 0, in_chars.shape[0] - 1)
+    cwrite = (cpos >= base) & (cpos < end)
+    chars = jnp.where(cwrite, in_chars[csrc], out_chars)
+
+    rpos = jnp.arange(out_cap, dtype=jnp.int32)
+    rsrc = jnp.clip(rpos - out_n, 0, in_valid.shape[0] - 1)
+    rwrite = (rpos >= out_n) & (rpos < out_n + in_n)
+    valid = jnp.where(rwrite, in_valid[rsrc], out_valid)
+    return chars, new_offs, valid
+
+
+def _ensure_validity(col: Column):
+    if col.validity is not None:
+        return col.validity
+    return jnp.ones(col.capacity, dtype=jnp.bool_)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate same-schema batches into one device batch."""
+    batches = [b for b in batches if b.nrows > 0] or list(batches[:1])
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(b.nrows for b in batches)
+    cap = bucket_capacity(total)
+    names = batches[0].names
+    out_cols = {}
+    for name in names:
+        first = batches[0].column(name)
+        dt = first.dtype
+        any_nulls = any(b.column(name).validity is not None for b in batches)
+        if dt.is_string:
+            total_chars = sum(
+                int(b.column(name).offsets[b.nrows]) for b in batches)
+            ccap = bucket_capacity(max(total_chars, 1))
+            chars = jnp.zeros(ccap, dtype=jnp.uint8)
+            offs = jnp.zeros(cap + 1, dtype=jnp.int32)
+            valid = jnp.zeros(cap, dtype=jnp.bool_)
+            n = 0
+            for b in batches:
+                c = b.column(name)
+                chars, offs, valid = _append_string(
+                    chars, offs, valid, jnp.int32(n),
+                    c.data, c.offsets, _ensure_validity(c),
+                    jnp.int32(c.nrows))
+                n += c.nrows
+            out_cols[name] = Column(dt, chars, total,
+                                    validity=valid if any_nulls else None,
+                                    offsets=offs)
+        else:
+            vals = jnp.zeros(cap, dtype=dt.storage)
+            valid = jnp.zeros(cap, dtype=jnp.bool_)
+            n = 0
+            for b in batches:
+                c = b.column(name)
+                vals, valid = _append_fixed(
+                    vals, valid, jnp.int32(n), c.data, _ensure_validity(c),
+                    jnp.int32(c.nrows))
+                n += c.nrows
+            out_cols[name] = Column(dt, vals, total,
+                                    validity=valid if any_nulls else None)
+    return ColumnarBatch(out_cols, total)

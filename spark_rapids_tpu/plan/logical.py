@@ -1,0 +1,272 @@
+"""Logical plan nodes.
+
+The reference plugs into Spark's Catalyst and never owns a logical plan; this
+framework is standalone, so it carries a small Catalyst-shaped logical algebra
+that the DataFrame API builds and ``plan/overrides.py`` lowers to TpuExec
+physical operators (the GpuOverrides analog, GpuOverrides.scala:3258).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.aggregates import AggregateFunction
+from spark_rapids_tpu.ops.expressions import (
+    Alias, ColVal, EmitContext, Expression,
+)
+
+Schema = List[Tuple[str, DataType]]
+
+
+class AggregateExpression(Expression):
+    """Expression wrapper around an AggregateFunction (mirrors Catalyst's)."""
+
+    def __init__(self, func: AggregateFunction):
+        self.func = func
+        self.children = (func.child,) if func.child is not None else ()
+
+    def with_children(self, children):
+        import copy
+        f = copy.copy(self.func)
+        f.child = children[0] if children else None
+        return AggregateExpression(f)
+
+    def bind(self, schema):
+        return self.with_children([c.bind(schema) for c in self.children])
+
+    @property
+    def dtype(self) -> DataType:
+        return self.func.result_dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.func.result_nullable
+
+    @property
+    def name(self) -> str:
+        arg = self.func.child.name if self.func.child is not None else "*"
+        return f"{self.func.name}({arg})"
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        raise RuntimeError(
+            "AggregateExpression must be planned by TpuHashAggregateExec, "
+            "not emitted directly")
+
+    def cache_key(self):
+        return ("AggregateExpression", self.func.cache_key())
+
+    def __str__(self):
+        return self.name
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def __str__(self) -> str:
+        lines: List[str] = []
+
+        def rec(node, depth):
+            lines.append("  " * depth + node.describe())
+            for c in node.children:
+                rec(c, depth + 1)
+        rec(self, 0)
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.node_name()
+
+
+class InMemoryRelation(LogicalPlan):
+    def __init__(self, batches: Sequence[ColumnarBatch], schema: Schema):
+        self.batches = list(batches)
+        self._schema = list(schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        rows = sum(b.nrows for b in self.batches)
+        return f"InMemoryRelation[{rows} rows]"
+
+
+class FileRelation(LogicalPlan):
+    def __init__(self, paths: Sequence[str], file_format: str, schema: Schema,
+                 options: Optional[dict] = None):
+        self.paths = list(paths)
+        self.file_format = file_format
+        self._schema = list(schema)
+        self.options = dict(options or {})
+        self.pushed_filters: List[Expression] = []
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return f"FileRelation[{self.file_format}, {len(self.paths)} files]"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: LogicalPlan):
+        self.exprs = [e.bind(child.schema) for e in exprs]
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return [(e.name, e.dtype) for e in self.exprs]
+
+    def describe(self):
+        return f"Project[{', '.join(e.name for e in self.exprs)}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition.bind(child.schema)
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"Filter[{self.condition}]"
+
+
+class Aggregate(LogicalPlan):
+    """group_exprs may be empty (grand-total reduction)."""
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Expression], child: LogicalPlan):
+        self.group_exprs = [e.bind(child.schema) for e in group_exprs]
+        self.agg_exprs = [e.bind(child.schema) for e in agg_exprs]
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        out = [(e.name, e.dtype) for e in self.group_exprs]
+        out += [(e.name, e.dtype) for e in self.agg_exprs]
+        return out
+
+    def describe(self):
+        return (f"Aggregate[keys={[e.name for e in self.group_exprs]}, "
+                f"aggs={[e.name for e in self.agg_exprs]}]")
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 condition: Optional[Expression] = None):
+        self.left_keys = [e.bind(left.schema) for e in left_keys]
+        self.right_keys = [e.bind(right.schema) for e in right_keys]
+        self.join_type = join_type
+        self.condition = condition
+        self.children = (left, right)
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def schema(self) -> Schema:
+        left = self.left.schema
+        right = self.right.schema
+        if self.join_type in ("semi", "anti"):
+            return list(left)
+        return list(left) + list(right)
+
+    def describe(self):
+        keys = list(zip([e.name for e in self.left_keys],
+                        [e.name for e in self.right_keys]))
+        return f"Join[{self.join_type}, on={keys}]"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: Sequence[Tuple[Expression, bool, bool]],
+                 child: LogicalPlan):
+        """orders: (expr, descending, nulls_first)"""
+        self.orders = [(e.bind(child.schema), d, nf) for e, d, nf in orders]
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        parts = [f"{e.name} {'DESC' if d else 'ASC'}"
+                 for e, d, _ in self.orders]
+        return f"Sort[{', '.join(parts)}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = int(n)
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = tuple(children)
+        first = self.children[0].schema
+        for c in self.children[1:]:
+            if [dt.name for _, dt in c.schema] != [dt.name for _, dt in first]:
+                raise ValueError("union children schemas differ")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+class Range(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1):
+        from spark_rapids_tpu.columnar import dtypes as dts
+        self.start, self.end, self.step = start, end, step
+        self._schema = [("id", dts.INT64)]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return f"Range[{self.start}, {self.end}, {self.step}]"

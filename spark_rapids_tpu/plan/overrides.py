@@ -1,0 +1,287 @@
+"""TpuOverrides: plan-replacement rules + meta/tagging framework.
+
+Counterpart of ``GpuOverrides.scala`` (rule registry, ``GpuOverrides.apply``)
+and ``RapidsMeta.scala`` (the wrap/tag/convert lifecycle): every logical node
+and expression is wrapped in a Meta carrying "will not work on TPU because…"
+reasons; supported subtrees convert to TpuExec operators, unsupported ones
+fall back to CPU (pandas) execs — the analog of leaving Spark ops on CPU —
+and ``explain()`` renders the reasons like `spark.rapids.sql.explain=ALL`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from spark_rapids_tpu.config.rapids_conf import RapidsConf
+from spark_rapids_tpu.ops import arithmetic as arith
+from spark_rapids_tpu.ops import predicates as preds
+from spark_rapids_tpu.ops.cast import Cast
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, Expression, Literal, UnresolvedColumn)
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan import typechecks as ts
+from spark_rapids_tpu.plan.logical import AggregateExpression
+
+
+# ------------------------------------------------------- expression registry --
+
+class ExprRule:
+    def __init__(self, cls: Type[Expression], sig: ts.TypeSig,
+                 note: str = ""):
+        self.cls = cls
+        self.sig = sig
+        self.note = note
+
+
+_EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
+
+
+def expr_rule(cls, sig=ts.COMMON, note=""):
+    _EXPR_RULES[cls] = ExprRule(cls, sig, note)
+
+
+# leaves / structural
+for c in (Alias, BoundReference, Literal, UnresolvedColumn, Cast,
+          AggregateExpression):
+    expr_rule(c)
+
+# arithmetic + math (numeric only)
+for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
+          arith.IntegralDivide, arith.Remainder, arith.Pmod,
+          arith.UnaryMinus, arith.UnaryPositive, arith.Abs, arith.Sqrt,
+          arith.Cbrt, arith.Exp, arith.Expm1, arith.Log, arith.Log2,
+          arith.Log10, arith.Log1p, arith.Sin, arith.Cos, arith.Tan,
+          arith.Cot, arith.Asin, arith.Acos, arith.Atan, arith.Sinh,
+          arith.Cosh, arith.Tanh, arith.Asinh, arith.Acosh, arith.Atanh,
+          arith.ToDegrees, arith.ToRadians, arith.Rint, arith.Signum,
+          arith.Floor, arith.Ceil, arith.Pow, arith.Logarithm, arith.Atan2,
+          arith.Round, arith.BRound, arith.BitwiseAnd, arith.BitwiseOr,
+          arith.BitwiseXor, arith.BitwiseNot, arith.ShiftLeft,
+          arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand):
+    expr_rule(c, ts.NUMERIC)
+
+# predicates / conditionals (any common type flows through)
+for c in (preds.EqualTo, preds.EqualNullSafe, preds.LessThan,
+          preds.LessThanOrEqual, preds.GreaterThan, preds.GreaterThanOrEqual,
+          preds.And, preds.Or, preds.Not, preds.IsNull, preds.IsNotNull,
+          preds.IsNaN, preds.NaNvl, preds.Coalesce, preds.If, preds.CaseWhen,
+          preds.In, preds.Greatest, preds.Least, preds.AtLeastNNonNulls,
+          preds.KnownNotNull, preds.KnownFloatingPointNormalized,
+          preds.NormalizeNaNAndZero):
+    expr_rule(c)
+
+
+# --------------------------------------------------------------- meta classes --
+
+class BaseMeta:
+    def __init__(self, wrapped, conf: RapidsConf):
+        self.wrapped = wrapped
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.child_metas: List[BaseMeta] = []
+
+    def will_not_work(self, reason: str) -> None:
+        self.reasons.append(reason)
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons and all(
+            c.can_replace for c in self.child_metas)
+
+    def tag(self) -> None:
+        raise NotImplementedError
+
+    def explain_lines(self, depth: int = 0, all_nodes: bool = True
+                      ) -> List[str]:
+        status = "will run on TPU" if not self.reasons else \
+            "will NOT run on TPU because " + "; ".join(self.reasons)
+        name = type(self.wrapped).__name__
+        lines = []
+        if all_nodes or self.reasons:
+            lines.append("  " * depth + f"{'*' if not self.reasons else '!'}"
+                         f" {name} {status}")
+        for c in self.child_metas:
+            lines.extend(c.explain_lines(depth + 1, all_nodes))
+        return lines
+
+
+class ExprMeta(BaseMeta):
+    def __init__(self, expr: Expression, conf: RapidsConf):
+        super().__init__(expr, conf)
+        self.child_metas = [ExprMeta(c, conf) for c in expr.children]
+        if isinstance(expr, AggregateExpression) and \
+                expr.func.child is not None:
+            self.child_metas = [ExprMeta(expr.func.child, conf)]
+
+    def tag(self) -> None:
+        expr = self.wrapped
+        rule = _EXPR_RULES.get(type(expr))
+        if rule is None:
+            self.will_not_work(
+                f"expression {type(expr).__name__} has no TPU implementation")
+        else:
+            try:
+                dt = expr.dtype
+                if dt.is_decimal and not self.conf[
+                        "spark.rapids.sql.decimalType.enabled"]:
+                    self.will_not_work(
+                        "decimal is disabled by "
+                        "spark.rapids.sql.decimalType.enabled")
+                reason = rule.sig.reason_if_unsupported(
+                    dt, f"expression {type(expr).__name__}")
+                if reason and not isinstance(expr, (BoundReference, Alias,
+                                                    Literal)):
+                    self.will_not_work(reason)
+            except (RuntimeError, TypeError) as e:
+                self.will_not_work(str(e))
+        for c in self.child_metas:
+            c.tag()
+
+
+class PlanMeta(BaseMeta):
+    """Wraps a logical node; conversion handled by the planner below."""
+
+    def __init__(self, plan: L.LogicalPlan, conf: RapidsConf):
+        super().__init__(plan, conf)
+        self.child_metas = [PlanMeta(c, conf) for c in plan.children]
+        self.expr_metas: List[ExprMeta] = [
+            ExprMeta(e, conf) for e in _node_expressions(plan)]
+
+    def tag(self) -> None:
+        node = self.wrapped
+        if type(node) not in _PLAN_CONVERTERS:
+            self.will_not_work(
+                f"{type(node).__name__} has no TPU implementation")
+        for em in self.expr_metas:
+            em.tag()
+            if not em.can_replace:
+                self.will_not_work(
+                    f"expression {type(em.wrapped).__name__} cannot run on "
+                    f"TPU")
+        for c in self.child_metas:
+            c.tag()
+
+    def explain_lines(self, depth: int = 0, all_nodes: bool = True):
+        lines = super().explain_lines(depth, all_nodes)
+        for em in self.expr_metas:
+            if em.reasons:
+                lines.extend(em.explain_lines(depth + 1, False))
+        return lines
+
+
+def _node_expressions(plan: L.LogicalPlan) -> List[Expression]:
+    if isinstance(plan, L.Project):
+        return list(plan.exprs)
+    if isinstance(plan, L.Filter):
+        return [plan.condition]
+    if isinstance(plan, L.Aggregate):
+        return list(plan.group_exprs) + list(plan.agg_exprs)
+    if isinstance(plan, L.Join):
+        return list(plan.left_keys) + list(plan.right_keys)
+    if isinstance(plan, L.Sort):
+        return [e for e, _, _ in plan.orders]
+    return []
+
+
+# ------------------------------------------------------------------ planner --
+
+_PLAN_CONVERTERS: Dict[type, object] = {}
+
+
+def _converter(cls):
+    def deco(fn):
+        _PLAN_CONVERTERS[cls] = fn
+        return fn
+    return deco
+
+
+@_converter(L.InMemoryRelation)
+def _conv_inmemory(node: L.InMemoryRelation, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuScanExec
+    return TpuScanExec(node.batches, node.schema)
+
+
+@_converter(L.FileRelation)
+def _conv_file(node: L.FileRelation, children, conf):
+    from spark_rapids_tpu.io.readers import make_file_scan_exec
+    return make_file_scan_exec(node, conf)
+
+
+@_converter(L.Project)
+def _conv_project(node: L.Project, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    return TpuProjectExec(node.exprs, children[0])
+
+
+@_converter(L.Filter)
+def _conv_filter(node: L.Filter, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuFilterExec
+    return TpuFilterExec(node.condition, children[0])
+
+
+@_converter(L.Aggregate)
+def _conv_aggregate(node: L.Aggregate, children, conf):
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    agg_pairs = []
+    for e in node.agg_exprs:
+        name = e.name
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if not isinstance(inner, AggregateExpression):
+            raise ValueError(f"aggregate output {name} is not an aggregate "
+                             "expression")
+        agg_pairs.append((name, inner))
+    return TpuHashAggregateExec(node.group_exprs, agg_pairs, children[0])
+
+
+@_converter(L.Limit)
+def _conv_limit(node: L.Limit, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuLocalLimitExec
+    return TpuLocalLimitExec(node.n, children[0])
+
+
+@_converter(L.Union)
+def _conv_union(node: L.Union, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuUnionExec
+    return TpuUnionExec(*children)
+
+
+@_converter(L.Range)
+def _conv_range(node: L.Range, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuRangeExec
+    return TpuRangeExec(node.start, node.end, node.step)
+
+
+class TpuOverrides:
+    """The planner: logical plan -> TpuExec tree with CPU fallback."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf()
+        self.last_explain: str = ""
+
+    def apply(self, plan: L.LogicalPlan):
+        meta = PlanMeta(plan, self.conf)
+        meta.tag()
+        self.last_explain = "\n".join(meta.explain_lines())
+        if self.conf.explain == "ALL":
+            print(self.last_explain)
+        elif self.conf.explain == "NOT_ON_TPU":
+            lines = [ln for ln in meta.explain_lines(all_nodes=False)]
+            if lines:
+                print("\n".join(lines))
+        return self._convert(meta)
+
+    def _convert(self, meta: PlanMeta):
+        node = meta.wrapped
+        children = [self._convert(c) for c in meta.child_metas]
+        own_ok = not meta.reasons
+        if own_ok and type(node) in _PLAN_CONVERTERS:
+            return _PLAN_CONVERTERS[type(node)](node, children, self.conf)
+        if self.conf["spark.rapids.sql.test.enabled"]:
+            allowed = self.conf[
+                "spark.rapids.sql.test.allowedNonTpu"].split(",")
+            if type(node).__name__ not in [a.strip() for a in allowed]:
+                raise RuntimeError(
+                    f"{type(node).__name__} fell back to CPU in strict test "
+                    f"mode: {'; '.join(meta.reasons)}")
+        from spark_rapids_tpu.exec.fallback import CpuFallbackExec
+        return CpuFallbackExec(node, children)

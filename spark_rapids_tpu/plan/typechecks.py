@@ -1,0 +1,53 @@
+"""Type support signatures.
+
+Lightweight analog of the reference's ``TypeChecks.scala`` TypeSig algebra
+(2,060 LoC): each replacement rule declares which input/output types it
+supports on TPU; the planner tags nodes that fall outside as
+"will not work on TPU" with a reason, and generates the supported-ops doc.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+
+class TypeSig:
+    """A set of supported logical type names (+ decimal flag)."""
+
+    def __init__(self, names: Iterable[str], decimal: bool = False):
+        self.names: Set[str] = set(names)
+        self.decimal = decimal
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.names | other.names,
+                       self.decimal or other.decimal)
+
+    def supports(self, dt: DataType) -> bool:
+        if dt.is_decimal:
+            return self.decimal
+        return dt.name in self.names
+
+    def reason_if_unsupported(self, dt: DataType,
+                              what: str) -> Optional[str]:
+        if self.supports(dt):
+            return None
+        return f"{what} has unsupported type {dt}"
+
+    def __repr__(self):
+        names = sorted(self.names) + (["decimal"] if self.decimal else [])
+        return "TypeSig(" + ", ".join(names) + ")"
+
+
+BOOLEAN = TypeSig(["boolean"])
+INTEGRAL = TypeSig(["tinyint", "smallint", "int", "bigint"])
+FP = TypeSig(["float", "double"])
+DECIMAL_64 = TypeSig([], decimal=True)
+NUMERIC = INTEGRAL + FP + DECIMAL_64
+STRING = TypeSig(["string"])
+DATETIME = TypeSig(["date", "timestamp"])
+# the common cudf-equivalent set (TypeChecks.scala:557 commonCudfTypes)
+COMMON = BOOLEAN + NUMERIC + STRING + DATETIME
+ORDERABLE = COMMON
+ALL = COMMON

@@ -13,12 +13,19 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags += " --xla_force_host_platform_device_count=8"
+# XLA:CPU defaults to fast-math, which breaks correctly-rounded f64 division
+# (7.0/3 comes out 2 digits short); the CPU oracle tests need exact IEEE.
+if "xla_cpu_enable_fast_math" not in flags:
+    flags += " --xla_cpu_enable_fast_math=false"
+os.environ["XLA_FLAGS"] = flags.strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# The image's sitecustomize pins jax_platforms to "axon,cpu" (the real TPU
+# tunnel); tests must run on the virtual 8-device CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
