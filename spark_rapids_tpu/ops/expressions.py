@@ -42,6 +42,15 @@ class ColVal:
         return getattr(self.values, "ndim", 0) == 0 and self.offsets is None
 
 
+import jax.tree_util as _tree_util
+
+_tree_util.register_pytree_node(
+    ColVal,
+    lambda c: ((c.values, c.validity, c.offsets), c.dtype),
+    lambda dtype, children: ColVal(dtype, children[0], children[1],
+                                   children[2]))
+
+
 def combine_validity(*vs: Optional[Any]) -> Optional[Any]:
     """AND together validity masks, treating None as all-valid."""
     present = [v for v in vs if v is not None]

@@ -1,0 +1,166 @@
+"""Distributed query steps: SPMD pipelines over the device mesh.
+
+The reference executes a distributed aggregation as: partial agg per task ->
+hash-partitioned UCX shuffle -> final agg per reducer (SURVEY.md sections 3.3
+and 3.4).  Here the entire sequence — filter, partial aggregate, shuffle
+by key, final aggregate — is ONE ``shard_map``-ped XLA program: the shuffle
+is a compiled all-to-all riding ICI, overlapping with compute under XLA's
+scheduler, with zero host round trips between stages.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops import selection
+from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.parallel.partitioning import hash_partition_ids
+from spark_rapids_tpu.parallel.shuffle import exchange
+
+
+class DistributedAggregate:
+    """filter? -> partial group-by -> all-to-all by key hash -> final agg.
+
+    Inputs are leading-axis sharded arrays: each of the mesh's N shards holds
+    a [capacity] slice of every column plus its own row count.  Outputs stay
+    sharded — each shard owns the key range that hashed to it (the reducer
+    layout); a collect all-gathers afterwards if needed.
+    """
+
+    def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
+                 group_exprs: Sequence[Expression],
+                 funcs: Sequence[agg.AggregateFunction],
+                 filter_cond: Optional[Expression] = None):
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.nshards = mesh.devices.size
+        self.in_dtypes = list(in_dtypes)
+        self.group_exprs = list(group_exprs)
+        self.funcs = list(funcs)
+        self.filter_cond = filter_cond
+
+        self._buf_specs = []
+        self._buf_slices = []
+        for f in self.funcs:
+            specs = f.buffers()
+            self._buf_slices.append(
+                slice(len(self._buf_specs), len(self._buf_specs) + len(specs)))
+            self._buf_specs.extend(specs)
+
+        shard = NamedSharding(mesh, P(self.axis))
+        self._jitted = jax.jit(
+            jax.shard_map(self._step, mesh=mesh,
+                          in_specs=(P(self.axis), P(self.axis)),
+                          out_specs=P(self.axis), check_vma=False))
+
+    # ---- SPMD body (runs per shard) -----------------------------------------
+    def _step(self, flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        capacity = None
+        for v, _, _ in flat_cols:
+            capacity = v.shape[0]
+            break
+        inputs = [ColVal(dt, v, val, offs)
+                  for (v, val, offs), dt in zip(flat_cols, self.in_dtypes)]
+        ctx = EmitContext(inputs, nrows, capacity)
+
+        # 1. fused filter
+        if self.filter_cond is not None:
+            pred = self.filter_cond.emit(ctx)
+            keep = pred.values
+            if pred.validity is not None:
+                keep = jnp.logical_and(keep, pred.validity)
+            keep = jnp.logical_and(keep, ctx.row_mask())
+            compacted, nrows = selection.compact(inputs, keep)
+            ctx = EmitContext(compacted, nrows, capacity)
+
+        # 2. local partial aggregate
+        keys = [e.emit(ctx) for e in self.group_exprs]
+        buf_inputs = []
+        for f in self.funcs:
+            c = f.child.emit(ctx) if f.child is not None else None
+            if c is not None and getattr(c.values, "ndim", 0) == 0:
+                c = ColVal(c.dtype,
+                           jnp.broadcast_to(c.values, (capacity,)), c.validity)
+            for spec, cv in zip(f.buffers(), f.update_inputs(c, capacity)):
+                buf_inputs.append((spec.kind, cv))
+
+        if not keys:
+            # grand total: local reduce then a psum-style merge via exchange
+            outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
+            merged = self._merge_grand_totals(outs)
+            one = jnp.ones((1,), dtype=jnp.int32)
+            return tuple((o.values, _v(o), one) for o in merged)
+
+        pkeys, pbufs, n_groups = agg.groupby_aggregate(
+            keys, buf_inputs, nrows, capacity)
+
+        # 3. shuffle partial groups by key hash (the ICI all-to-all)
+        pids = hash_partition_ids(pkeys, self.nshards)
+        all_cols = list(pkeys) + list(pbufs)
+        recv, recv_n = exchange(all_cols, pids, n_groups, self.axis,
+                                self.nshards)
+        rkeys = recv[:len(pkeys)]
+        rbufs = recv[len(pkeys):]
+
+        # 4. final merge + finalize on the receiving shard
+        merge_inputs = [(_merge_kind(s.kind), c)
+                        for s, c in zip(self._buf_specs, rbufs)]
+        fkeys, fbufs, fn_groups = agg.groupby_aggregate(
+            rkeys, merge_inputs, recv_n, rkeys[0].values.shape[0])
+        results = [f.finalize(fbufs[sl])
+                   for f, sl in zip(self.funcs, self._buf_slices)]
+        outs = list(fkeys) + list(results)
+        n_out = jnp.reshape(fn_groups, (1,))
+        return tuple((o.values, _v(o), n_out) for o in outs)
+
+    def _merge_grand_totals(self, outs: List[ColVal]) -> List[ColVal]:
+        """psum/pmin/pmax the single-row locals across the mesh."""
+        merged = []
+        for spec_idx, (spec, o) in enumerate(zip(self._buf_specs, outs)):
+            kind = _merge_kind(spec.kind)
+            v = o.values
+            valid = o.validity if o.validity is not None else \
+                jnp.ones_like(v, dtype=jnp.bool_)
+            if kind == "sum":
+                mv = jax.lax.psum(jnp.where(valid, v, 0), self.axis)
+            elif kind == "min":
+                mv = jax.lax.pmin(
+                    jnp.where(valid, v, agg._sentinel("min", v.dtype)),
+                    self.axis)
+            elif kind == "max":
+                mv = jax.lax.pmax(
+                    jnp.where(valid, v, agg._sentinel("max", v.dtype)),
+                    self.axis)
+            else:
+                mv = v  # first/last over shards: keep local
+            any_valid = jax.lax.pmax(valid.astype(jnp.int8), self.axis) > 0
+            merged.append(ColVal(o.dtype, mv, any_valid))
+        # finalize per function
+        results = [f.finalize(merged[sl])
+                   for f, sl in zip(self.funcs, self._buf_slices)]
+        return results
+
+    # ---- host API ------------------------------------------------------------
+    def __call__(self, flat_cols, nrows_per_shard):
+        """flat_cols: [(values, validity, offsets)] with leading dim
+        nshards*capacity; nrows_per_shard: int32[nshards]."""
+        return self._jitted(flat_cols, nrows_per_shard)
+
+
+def _merge_kind(update_kind: str) -> str:
+    return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+            "first": "first", "last": "last"}[update_kind]
+
+
+def _v(o: ColVal):
+    if o.validity is None:
+        return jnp.ones_like(o.values, dtype=jnp.bool_)
+    return o.validity

@@ -1,0 +1,41 @@
+"""Device mesh management.
+
+The reference's distributed substrate is one GPU per Spark executor connected
+by UCX (shuffle-plugin/, SURVEY.md section 2.5).  The TPU substrate is a
+``jax.sharding.Mesh`` over the pod slice: shuffle partitions map onto mesh
+shards and exchange rides ICI collectives instead of UCX point-to-point.
+
+One mesh axis ("data") is enough for the SQL workload: all reference
+parallelism is data parallelism over partitions (SURVEY.md section 2.5
+"Parallelism strategies").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def shard_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,93 @@
+"""Partitioning kernels: assign every row a destination shard.
+
+Counterpart of ``GpuPartitioning.scala`` + Gpu{Hash,Range,RoundRobin,Single}
+Partitioning (SURVEY.md section 2.4): where cudf computes partition indices
+then ``Table.contiguousSplit``, the TPU path computes destination ids and
+*sorts rows by destination* so each shard's outgoing rows are contiguous —
+the layout the padded all-to-all collective wants.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.expressions import ColVal
+
+
+def _mix64(h):
+    """splitmix64 finalizer — good avalanche, vectorizes trivially."""
+    h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return h ^ (h >> 31)
+
+
+def hash_columns(cols: Sequence[ColVal], seed: int = 42) -> jnp.ndarray:
+    """uint64 hash per row over the key columns (murmur-mix based).
+
+    Floats are canonicalized (-0.0 -> 0.0, NaN payloads collapsed) so rows
+    that compare equal hash equal, matching the reference's requirement on
+    GpuHashPartitioning (murmur3 over canonical bytes).
+    """
+    acc = None
+    for c in cols:
+        v = c.values
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = jnp.where(v == 0.0, 0.0, v)
+            v = jnp.where(jnp.isnan(v), jnp.nan, v)
+            bits = v.astype(jnp.float64).view(jnp.uint64)
+        elif v.dtype == jnp.bool_:
+            bits = v.astype(jnp.uint64)
+        else:
+            bits = v.astype(jnp.int64).view(jnp.uint64)
+        if c.validity is not None:
+            bits = jnp.where(c.validity, bits, jnp.uint64(0x9E3779B97F4A7C15))
+        h = _mix64(bits + jnp.uint64(seed))
+        acc = h if acc is None else _mix64(acc * jnp.uint64(31) + h)
+    return acc
+
+
+def hash_partition_ids(key_cols: Sequence[ColVal], num_parts: int
+                       ) -> jnp.ndarray:
+    h = hash_columns(key_cols)
+    return (h % jnp.uint64(num_parts)).astype(jnp.int32)
+
+
+def round_robin_partition_ids(capacity: int, num_parts: int,
+                              start: int = 0) -> jnp.ndarray:
+    return ((jnp.arange(capacity, dtype=jnp.int32) + start) % num_parts)
+
+
+def single_partition_ids(capacity: int) -> jnp.ndarray:
+    return jnp.zeros(capacity, dtype=jnp.int32)
+
+
+def range_partition_ids(key: ColVal, bounds: jnp.ndarray) -> jnp.ndarray:
+    """Destination by sampled range bounds (ascending), like
+    GpuRangePartitioning with host-sampled bounds."""
+    return jnp.searchsorted(bounds, key.values, side="right").astype(jnp.int32)
+
+
+def layout_by_partition(cols: Sequence[ColVal], pids: jnp.ndarray,
+                        nrows, num_parts: int
+                        ) -> Tuple[List[ColVal], jnp.ndarray, jnp.ndarray]:
+    """Sort rows by destination; return (sorted cols, counts, starts).
+
+    counts[d] = rows destined to shard d; starts = exclusive prefix sum.
+    Padding rows sort last and are counted in no partition.
+    """
+    from spark_rapids_tpu.ops import selection
+
+    capacity = pids.shape[0]
+    row_mask = jnp.arange(capacity, dtype=jnp.int32) < nrows
+    sort_key = jnp.where(row_mask, pids, num_parts)
+    perm = jnp.argsort(sort_key, stable=True).astype(jnp.int32)
+    sorted_cols = selection.gather(cols, perm, nrows)
+    counts = jax.ops.segment_sum(
+        jnp.where(row_mask, 1, 0), sort_key, num_segments=num_parts + 1
+    )[:num_parts].astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(counts)[:-1]])
+    return sorted_cols, counts, starts

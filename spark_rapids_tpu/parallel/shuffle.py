@@ -1,0 +1,119 @@
+"""Shuffle as an SPMD collective: padded ragged all-to-all over the mesh.
+
+This replaces the reference's entire UCX transport stack (shuffle-plugin/,
+RapidsShuffleClient/Server, bounce buffers, heartbeats — SURVEY.md section
+2.5): instead of point-to-point pull with metadata requests, every shard
+partitions its rows by destination, lays them out contiguously, and one
+``lax.all_to_all`` moves all slices across ICI simultaneously.  Peer
+discovery, connection management, and retry logic disappear — the collective
+is compiled into the XLA program.
+
+Raggedness: all_to_all needs equal-sized slices, so each (src, dst) slice is
+padded to ``slot`` rows, with true counts exchanged alongside (an int vector
+all_to_all).  Receivers compact the slices back to a dense batch.  ``slot``
+defaults to the full per-shard capacity (always correct); callers with
+skew-free data can pass a smaller slot to cut the padding bandwidth.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.expressions import ColVal
+from spark_rapids_tpu.parallel.partitioning import layout_by_partition
+
+
+def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
+             axis_name: str, num_parts: int,
+             slot: Optional[int] = None) -> Tuple[List[ColVal], jnp.ndarray]:
+    """All-to-all exchange inside shard_map.
+
+    Every shard sends row r to shard ``pids[r]``.  Returns (received cols,
+    received nrows); received capacity is ``num_parts * slot``.
+    Only fixed-width columns (strings must be dictionary-encoded upstream).
+    """
+    capacity = pids.shape[0]
+    slot = slot or capacity
+    sorted_cols, counts, starts = layout_by_partition(
+        cols, pids, nrows, num_parts)
+
+    # counts for my slices on every peer: all_to_all of the counts vector
+    recv_counts = jax.lax.all_to_all(
+        counts.reshape(num_parts, 1), axis_name, split_axis=0,
+        concat_axis=0).reshape(num_parts)
+
+    # gather each destination's rows into its padded slot: send[d, j]
+    d = jnp.arange(num_parts, dtype=jnp.int32)[:, None]
+    j = jnp.arange(slot, dtype=jnp.int32)[None, :]
+    src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
+    slot_valid = j < counts[:, None]
+
+    out_cols: List[ColVal] = []
+    total = recv_counts.sum()
+    # positions of received valid rows after compaction
+    recv_starts = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(recv_counts)[:-1]])
+    for c in sorted_cols:
+        send = c.values[src]                      # [num_parts, slot]
+        recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
+                                  concat_axis=0)
+        flat, validity = _compact_received(
+            recv, None if c.validity is None else c.validity, src, slot_valid,
+            recv_counts, recv_starts, axis_name, num_parts, slot)
+        out_cols.append(ColVal(c.dtype, flat, validity))
+    return out_cols, total
+
+
+def _compact_received(recv, send_validity, src, slot_valid, recv_counts,
+                      recv_starts, axis_name, num_parts, slot):
+    """Flatten [num_parts, slot] received rows into a dense prefix."""
+    validity_flat = None
+    if send_validity is not None:
+        vsend = send_validity[src]
+        vrecv = jax.lax.all_to_all(vsend, axis_name, split_axis=0,
+                                   concat_axis=0)
+    cap = num_parts * slot
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    # source slice for each dense output position
+    part = jnp.searchsorted(recv_starts, pos, side="right") - 1
+    part = jnp.clip(part, 0, num_parts - 1)
+    offset = pos - recv_starts[part]
+    in_range = pos < recv_counts.sum()
+    flat = recv[part, jnp.clip(offset, 0, slot - 1)]
+    if send_validity is not None:
+        validity_flat = jnp.where(
+            in_range, vrecv[part, jnp.clip(offset, 0, slot - 1)], False)
+    return flat, validity_flat
+
+
+def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
+                    num_parts: int) -> Tuple[List[ColVal], jnp.ndarray]:
+    """Broadcast-style collective: every shard receives every shard's rows.
+
+    The TPU analog of GpuBroadcastExchangeExec (one-to-all replication,
+    SURVEY.md section 2.4 "Exchanges") — except all-gather is symmetric, so
+    "broadcast" of a small table costs one collective, no driver round trip.
+    """
+    capacity = cols[0].values.shape[0] if cols else 0
+    counts = jax.lax.all_gather(nrows, axis_name)  # [num_parts]
+    out_cols: List[ColVal] = []
+    starts = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    total = counts.sum()
+    cap = num_parts * capacity
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    part = jnp.searchsorted(starts, pos, side="right") - 1
+    part = jnp.clip(part, 0, num_parts - 1)
+    offset = jnp.clip(pos - starts[part], 0, capacity - 1)
+    for c in cols:
+        g = jax.lax.all_gather(c.values, axis_name)  # [num_parts, capacity]
+        flat = g[part, offset]
+        validity = None
+        if c.validity is not None:
+            gv = jax.lax.all_gather(c.validity, axis_name)
+            validity = jnp.where(pos < total, gv[part, offset], False)
+        out_cols.append(ColVal(c.dtype, flat, validity))
+    return out_cols, total

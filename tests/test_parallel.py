@@ -1,0 +1,150 @@
+"""Distributed path tests on the virtual 8-device CPU mesh.
+
+The local-cluster analog of the reference's shuffle tests (SURVEY.md section
+4 tier 2) — but where those mock the UCX transport, the collective exchange
+here actually runs across 8 XLA host devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expressions import BoundReference, ColVal
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.arithmetic import Multiply
+from spark_rapids_tpu.ops.expressions import Literal
+from spark_rapids_tpu.parallel.distributed import DistributedAggregate
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.parallel.partitioning import (
+    hash_partition_ids, layout_by_partition)
+
+
+NSHARDS = 8
+CAP = 256
+
+
+def _make_sharded(values, dtype=np.int64):
+    """values: [NSHARDS, CAP] -> flat [NSHARDS*CAP] device array."""
+    return jnp.asarray(np.asarray(values, dtype=dtype).reshape(-1))
+
+
+def test_hash_partition_ids_deterministic():
+    c = ColVal(dts.INT64, jnp.arange(CAP, dtype=jnp.int64))
+    p1 = hash_partition_ids([c], 8)
+    p2 = hash_partition_ids([c], 8)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    assert np.asarray(p1).min() >= 0 and np.asarray(p1).max() < 8
+    # equal values -> equal partition regardless of position
+    c2 = ColVal(dts.INT64, jnp.full(CAP, 7, dtype=jnp.int64))
+    assert len(set(np.asarray(hash_partition_ids([c2], 8)))) == 1
+
+
+def test_layout_by_partition():
+    vals = jnp.asarray(np.arange(CAP, dtype=np.int64))
+    pids = jnp.asarray((np.arange(CAP) % 4).astype(np.int32))
+    cols, counts, starts = jax.jit(
+        lambda v, p: layout_by_partition(
+            [ColVal(dts.INT64, v)], p, jnp.int32(100), 4))(vals, pids)
+    counts = np.asarray(counts)
+    assert counts.sum() == 100
+    out = np.asarray(cols[0].values)
+    starts = np.asarray(starts)
+    for d in range(4):
+        seg = out[starts[d]: starts[d] + counts[d]]
+        assert all(v % 4 == d for v in seg)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(NSHARDS)
+
+
+def test_distributed_groupby_sum(mesh, rng):
+    keys = rng.integers(0, 20, (NSHARDS, CAP)).astype(np.int64)
+    vals = rng.normal(size=(NSHARDS, CAP))
+    nrows = rng.integers(50, CAP, NSHARDS).astype(np.int32)
+
+    dist = DistributedAggregate(
+        mesh,
+        in_dtypes=[dts.INT64, dts.FLOAT64],
+        group_exprs=[BoundReference(0, dts.INT64, name="k",
+                                    nullable=False)],
+        funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v")),
+               agg.Count(BoundReference(1, dts.FLOAT64, name="v"))])
+
+    flat_cols = [( _make_sharded(keys), None, None),
+                 (_make_sharded(vals, np.float64), None, None)]
+    outs = dist(flat_cols, jnp.asarray(nrows))
+    # outputs: key, sum, count — each (values[global], validity, ngroups[gl])
+    (kv, kval, kn), (sv, sval, sn), (cv, cval, cn) = outs
+
+    # collect per-shard results
+    got = {}
+    recv_cap = np.asarray(kv).shape[0] // NSHARDS
+    ngroups = np.asarray(kn).reshape(NSHARDS, -1)[:, 0]
+    kvs = np.asarray(kv).reshape(NSHARDS, recv_cap)
+    svs = np.asarray(sv).reshape(NSHARDS, recv_cap)
+    cvs = np.asarray(cv).reshape(NSHARDS, recv_cap)
+    for s in range(NSHARDS):
+        for g in range(ngroups[s]):
+            k = kvs[s, g]
+            assert k not in got, "key appears on two shards"
+            got[k] = (svs[s, g], cvs[s, g])
+
+    # pandas oracle over the same logical rows
+    dfs = []
+    for s in range(NSHARDS):
+        dfs.append(pd.DataFrame({"k": keys[s, :nrows[s]],
+                                 "v": vals[s, :nrows[s]]}))
+    want = pd.concat(dfs).groupby("k").agg(s=("v", "sum"), c=("v", "count"))
+    assert set(got) == set(want.index)
+    for k, row in want.iterrows():
+        np.testing.assert_allclose(got[k][0], row["s"], rtol=1e-9)
+        assert got[k][1] == row["c"]
+
+
+def test_distributed_grand_total(mesh, rng):
+    vals = rng.normal(size=(NSHARDS, CAP))
+    nrows = np.full(NSHARDS, 100, dtype=np.int32)
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.FLOAT64], group_exprs=[],
+        funcs=[agg.Sum(BoundReference(0, dts.FLOAT64, name="v")),
+               agg.Min(BoundReference(0, dts.FLOAT64, name="v")),
+               agg.Max(BoundReference(0, dts.FLOAT64, name="v"))])
+    flat_cols = [(_make_sharded(vals, np.float64), None, None)]
+    outs = dist(flat_cols, jnp.asarray(nrows))
+    valid_rows = np.concatenate([vals[s, :100] for s in range(NSHARDS)])
+    s0 = np.asarray(outs[0][0]).reshape(NSHARDS, -1)[:, 0]
+    np.testing.assert_allclose(s0, valid_rows.sum(), rtol=1e-9)
+    mn = np.asarray(outs[1][0]).reshape(NSHARDS, -1)[:, 0]
+    mx = np.asarray(outs[2][0]).reshape(NSHARDS, -1)[:, 0]
+    np.testing.assert_allclose(mn, valid_rows.min())
+    np.testing.assert_allclose(mx, valid_rows.max())
+
+
+def test_distributed_filtered_aggregate(mesh, rng):
+    """The q6 shape distributed: filter -> partial -> exchange -> final."""
+    price = rng.uniform(100, 1000, (NSHARDS, CAP))
+    disc = rng.uniform(0, 0.1, (NSHARDS, CAP)).round(2)
+    nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    cond = P.And(
+        P.GreaterThanOrEqual(BoundReference(1, dts.FLOAT64, name="d"),
+                             Literal(0.05)),
+        P.LessThanOrEqual(BoundReference(1, dts.FLOAT64, name="d"),
+                          Literal(0.07)))
+    rev = Multiply(BoundReference(0, dts.FLOAT64, name="p"),
+                   BoundReference(1, dts.FLOAT64, name="d"))
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.FLOAT64, dts.FLOAT64], group_exprs=[],
+        funcs=[agg.Sum(rev)], filter_cond=cond)
+    flat_cols = [(_make_sharded(price, np.float64), None, None),
+                 (_make_sharded(disc, np.float64), None, None)]
+    outs = dist(flat_cols, jnp.asarray(nrows))
+    got = np.asarray(outs[0][0]).reshape(NSHARDS, -1)[0, 0]
+    mask = (disc >= 0.05) & (disc <= 0.07)
+    want = (price * disc)[mask].sum()
+    np.testing.assert_allclose(got, want, rtol=1e-9)
