@@ -1,0 +1,92 @@
+"""Benchmark: TPC-H q6 (scan -> filter -> project -> sum), SF1-scale.
+
+BASELINE.md config 1 — the reference's minimum end-to-end slice.  Runs the
+real engine (planner -> fused filter/project stage -> reduction) on the
+default JAX device (TPU when present) against a pandas CPU baseline on the
+same data, and prints ONE JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+N_ROWS = 6_000_000  # SF1 lineitem ~6M rows
+ITERS = 5
+
+
+def gen_lineitem(n):
+    rng = np.random.default_rng(42)
+    return {
+        "l_extendedprice": rng.uniform(1000.0, 100000.0, n),
+        "l_discount": rng.uniform(0.0, 0.11, n).round(2),
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+    }
+
+
+def run_tpu(data):
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+
+    session = TpuSession()
+    df = session.create_dataframe(data)
+
+    def query():
+        q = df.filter(
+            (F.col("l_shipdate") >= 9131) & (F.col("l_shipdate") < 9496) &
+            (F.col("l_discount") >= 0.05) & (F.col("l_discount") <= 0.07) &
+            (F.col("l_quantity") < 24.0)
+        ).select((F.col("l_extendedprice") * F.col("l_discount"))
+                 .alias("rev")).agg(F.sum("rev").alias("revenue"))
+        return q.collect()[0][0]
+
+    result = query()  # warmup: compile
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        result = query()
+        times.append(time.perf_counter() - t0)
+    return result, min(times)
+
+
+def run_pandas(data):
+    import pandas as pd
+    df = pd.DataFrame(data)
+
+    def query():
+        m = df[(df.l_shipdate >= 9131) & (df.l_shipdate < 9496) &
+               (df.l_discount >= 0.05) & (df.l_discount <= 0.07) &
+               (df.l_quantity < 24.0)]
+        return (m.l_extendedprice * m.l_discount).sum()
+
+    result = query()
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        result = query()
+        times.append(time.perf_counter() - t0)
+    return result, min(times)
+
+
+def main():
+    data = gen_lineitem(N_ROWS)
+    tpu_result, tpu_t = run_tpu(data)
+    cpu_result, cpu_t = run_pandas(data)
+    rel_err = abs(tpu_result - cpu_result) / max(abs(cpu_result), 1e-9)
+    assert rel_err < 1e-6, f"wrong answer: {tpu_result} vs {cpu_result}"
+    rows_per_sec = N_ROWS / tpu_t
+    print(json.dumps({
+        "metric": "tpch_q6_sf1_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+    }))
+    print(f"tpu={tpu_t * 1e3:.1f}ms pandas={cpu_t * 1e3:.1f}ms "
+          f"result={tpu_result:.2f} rel_err={rel_err:.2e}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

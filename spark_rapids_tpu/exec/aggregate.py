@@ -85,10 +85,15 @@ def _grouped_kernel(kinds: Tuple[str, ...], nkeys: int):
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Tuple[str, AggregateExpression]],
-                 child: TpuExec):
+                 child: TpuExec,
+                 pre_filter: Optional[Expression] = None):
+        """``pre_filter``: a fused upstream Filter condition (whole-stage
+        fusion: predicate becomes a row mask inside the aggregation kernel —
+        no compaction pass at all)."""
         super().__init__(child)
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
+        self.pre_filter = pre_filter
         self.funcs = [ae.func for _, ae in agg_exprs]
         self._register_metric(NUM_INPUT_ROWS)
         self._register_metric(NUM_INPUT_BATCHES)
@@ -164,18 +169,31 @@ class TpuHashAggregateExec(TpuExec):
         return pairs
 
     def _update_fused(self, flat_cols, nrows):
-        """No string keys: key eval + buffer eval + group-by, one computation."""
+        """No string keys: key eval + buffer eval + group-by, one computation.
+
+        A fused pre_filter predicate contributes a row mask — the whole
+        filter+project+partial-agg stage is a single XLA program."""
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
+        row_mask = None
+        if self.pre_filter is not None:
+            pred = self.pre_filter.emit(ctx)
+            keep = pred.values
+            if getattr(keep, "ndim", 0) == 0:
+                keep = jnp.broadcast_to(keep, (capacity,))
+            if pred.validity is not None:
+                keep = jnp.logical_and(keep, pred.validity)
+            row_mask = jnp.logical_and(keep, ctx.row_mask())
         keys = [e.emit(ctx) for e in self.group_exprs]
         buf_inputs = self._eval_update_inputs(ctx)
         if not keys:
-            outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
+            outs = agg.reduce_aggregate(buf_inputs, nrows, capacity,
+                                        row_mask=row_mask)
             return ([], [(o.values, o.validity, o.offsets) for o in outs],
                     jnp.int32(1))
         out_keys, out_bufs, n = agg.groupby_aggregate(
-            keys, buf_inputs, nrows, capacity)
+            keys, buf_inputs, nrows, capacity, row_mask=row_mask)
         return ([(k.values, k.validity, k.offsets) for k in out_keys],
                 [(b.values, b.validity, b.offsets) for b in out_bufs], n)
 

@@ -31,18 +31,25 @@ from spark_rapids_tpu.ops.expressions import ColVal, Expression, combine_validit
 
 # ------------------------------------------------------------- sort utilities
 
-def _sortable_keys(keys: Sequence[ColVal], nrows, capacity: int,
+def _row_mask(nrows, capacity: int, row_mask=None):
+    """bool[capacity] of live rows: row_mask overrides the nrows prefix."""
+    if row_mask is not None:
+        return row_mask
+    return jnp.arange(capacity, dtype=jnp.int32) < nrows
+
+
+def _sortable_keys(keys: Sequence[ColVal], valid_rows, capacity: int,
                    descending: Optional[Sequence[bool]] = None,
                    nulls_first: Optional[Sequence[bool]] = None):
     """Build jnp.lexsort key list (least-significant first) from key columns.
 
-    Pad rows always sort last.  Floats are normalized so NaN sorts largest and
-    -0.0 == 0.0 (Spark ordering).  Returns (lex_keys, pad_flag).
+    Dead rows (padding or filtered) always sort last.  Floats are normalized
+    so NaN sorts largest and -0.0 == 0.0 (Spark ordering).
     """
     n = len(keys)
     descending = descending or [False] * n
     nulls_first = nulls_first or [not d for d in descending]
-    pad = jnp.arange(capacity, dtype=jnp.int32) >= nrows
+    pad = jnp.logical_not(valid_rows)
     lex: List = []
     # jnp.lexsort sorts by last key first; we append least-significant first
     for c, desc, nf in zip(reversed(list(keys)), reversed(list(descending)),
@@ -63,14 +70,14 @@ def _sortable_keys(keys: Sequence[ColVal], nrows, capacity: int,
         if c.validity is not None:
             null_key = jnp.logical_not(c.validity).astype(jnp.int8)
             lex.append(-null_key if nf else null_key)
-    lex.append(pad.astype(jnp.int8))  # most significant: padding last
-    return lex, pad
+    lex.append(pad.astype(jnp.int8))  # most significant: dead rows last
+    return lex
 
 
-def sort_permutation(keys: Sequence[ColVal], nrows, capacity: int,
+def sort_permutation(keys: Sequence[ColVal], valid_rows, capacity: int,
                      descending: Optional[Sequence[bool]] = None,
                      nulls_first: Optional[Sequence[bool]] = None):
-    lex, _ = _sortable_keys(keys, nrows, capacity, descending, nulls_first)
+    lex = _sortable_keys(keys, valid_rows, capacity, descending, nulls_first)
     return jnp.lexsort(lex).astype(jnp.int32)
 
 
@@ -323,19 +330,24 @@ def _segment_reduce(kind: str, c: ColVal, seg_ids, num_segments: int,
 
 def groupby_aggregate(keys: Sequence[ColVal],
                       buffer_inputs: Sequence[Tuple[str, ColVal]],
-                      nrows, capacity: int):
+                      nrows, capacity: int, row_mask=None):
     """Group by ``keys``, reduce each (kind, column) buffer input.
 
-    All arguments are traced values; runs inside jit.  Returns
-    (out_keys: List[ColVal], out_buffers: List[ColVal], num_groups).
-    Output rows beyond num_groups are padding.
+    All arguments are traced values; runs inside jit.  ``row_mask`` (if
+    given) marks live rows — a fused upstream filter — overriding the
+    ``nrows`` prefix.  Returns (out_keys, out_buffers, num_groups); output
+    rows beyond num_groups are padding.
     """
     from spark_rapids_tpu.ops import selection
 
-    perm = sort_permutation(keys, nrows, capacity)
-    valid_sorted_mask = jnp.arange(capacity, dtype=jnp.int32) < nrows
-    sorted_keys = selection.gather(keys, perm, nrows)
-    sorted_bufs = selection.gather([c for _, c in buffer_inputs], perm, nrows)
+    live = _row_mask(nrows, capacity, row_mask)
+    n_live = live.sum().astype(jnp.int32)
+    perm = sort_permutation(keys, live, capacity)
+    # after the sort all live rows form a prefix of length n_live
+    valid_sorted_mask = jnp.arange(capacity, dtype=jnp.int32) < n_live
+    sorted_keys = selection.gather(keys, perm, n_live)
+    sorted_bufs = selection.gather([c for _, c in buffer_inputs], perm,
+                                   n_live)
 
     same_as_prev = _keys_equal_prev(sorted_keys, capacity)
     boundary = jnp.logical_and(jnp.logical_not(same_as_prev),
@@ -360,9 +372,9 @@ def groupby_aggregate(keys: Sequence[ColVal],
 
 
 def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
-                     nrows, capacity: int) -> List[ColVal]:
+                     nrows, capacity: int, row_mask=None) -> List[ColVal]:
     """Grand-total (no keys) reduction: one output row per buffer."""
-    valid_rows = jnp.arange(capacity, dtype=jnp.int32) < nrows
+    valid_rows = _row_mask(nrows, capacity, row_mask)
     seg = jnp.where(valid_rows, 0, 1)
     outs: List[ColVal] = []
     for kind, c in buffer_inputs:

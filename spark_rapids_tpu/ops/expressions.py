@@ -380,6 +380,19 @@ class BinaryExpression(Expression):
 
 # --------------------------------------------------------- shared emit helpers
 
+def substitute_bound(expr: Expression,
+                     replacements: Sequence[Expression]) -> Expression:
+    """Replace each BoundReference(i) with replacements[i] (expression
+    composition — used by whole-stage fusion to push aggregate/filter
+    expressions through an intermediate Project)."""
+    if isinstance(expr, BoundReference):
+        return replacements[expr.ordinal]
+    if not expr.children:
+        return expr
+    return expr.with_children(
+        [substitute_bound(c, replacements) for c in expr.children])
+
+
 def promote_types(a: DataType, b: DataType) -> DataType:
     """Numeric widening used when binding binary arithmetic/comparison."""
     if a.name == b.name:

@@ -57,8 +57,15 @@ def compact(cols: Sequence[ColVal], keep) -> Tuple[List[ColVal], jnp.ndarray]:
     """Move rows where ``keep`` is True to the front, preserving order.
 
     Returns (columns, new_nrows). ``keep`` must already exclude padding rows.
+    Linear cost: a prefix-sum gives each kept row its target slot and one
+    scatter builds the permutation — no sort (cudf's apply_boolean_mask does
+    a similar stream compaction; an argsort here would be O(n log^2 n) on
+    TPU's bitonic sorter).
     """
-    # stable sort: kept rows (0) before dropped (1), original order preserved
-    perm = jnp.argsort(jnp.logical_not(keep), stable=True).astype(jnp.int32)
+    capacity = keep.shape[0]
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
     new_nrows = keep.sum().astype(jnp.int32)
+    tgt = jnp.where(keep, pos, capacity)  # dropped rows scatter out of range
+    perm = jnp.zeros(capacity, dtype=jnp.int32).at[tgt].set(
+        jnp.arange(capacity, dtype=jnp.int32), mode="drop")
     return gather(cols, perm, new_nrows), new_nrows

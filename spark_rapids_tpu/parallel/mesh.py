@@ -25,6 +25,15 @@ DATA_AXIS = "data"
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = DATA_AXIS) -> Mesh:
     devices = jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        # single-TPU dev boxes: fall back to the virtual CPU mesh (the
+        # xla_force_host_platform_device_count path used by dry runs/tests)
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n_devices:
+                devices = cpu
+        except RuntimeError:
+            pass
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(

@@ -272,6 +272,10 @@ class TpuOverrides:
 
     def _convert(self, meta: PlanMeta):
         node = meta.wrapped
+        if isinstance(node, L.Aggregate) and not meta.reasons:
+            fused = self._try_fuse_aggregate(meta)
+            if fused is not None:
+                return fused
         children = [self._convert(c) for c in meta.child_metas]
         own_ok = not meta.reasons
         if own_ok and type(node) in _PLAN_CONVERTERS:
@@ -285,3 +289,51 @@ class TpuOverrides:
                     f"mode: {'; '.join(meta.reasons)}")
         from spark_rapids_tpu.exec.fallback import CpuFallbackExec
         return CpuFallbackExec(node, children)
+
+    def _try_fuse_aggregate(self, meta: PlanMeta):
+        """Whole-stage fusion: collapse Project/Filter chains under an
+        Aggregate into the aggregation kernel (predicate becomes a row mask,
+        projections compose into key/agg expressions).  The reference gets
+        partial fusion from cudf kernel launches per op; XLA gives us the
+        fully fused stage if we hand it one computation.
+        """
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.ops.expressions import substitute_bound
+        from spark_rapids_tpu.ops.predicates import And
+
+        node: L.Aggregate = meta.wrapped
+        group = list(node.group_exprs)
+        aggs = list(node.agg_exprs)
+        cond = None
+        child_meta = meta.child_metas[0]
+        hops = 0
+        while isinstance(child_meta.wrapped, (L.Project, L.Filter)):
+            if child_meta.reasons or any(
+                    not em.can_replace for em in child_meta.expr_metas):
+                break
+            inner = child_meta.wrapped
+            if isinstance(inner, L.Project):
+                repl = inner.exprs
+                group = [substitute_bound(e, repl) for e in group]
+                aggs = [substitute_bound(e, repl) for e in aggs]
+                if cond is not None:
+                    cond = substitute_bound(cond, repl)
+            else:
+                c = inner.condition
+                cond = c if cond is None else And(c, cond)
+            child_meta = child_meta.child_metas[0]
+            hops += 1
+        if hops == 0 or cond is None:
+            # fusing projections alone adds nothing (already one stage)
+            if hops == 0:
+                return None
+        if any(e.dtype.is_string for e in group):
+            return None  # string keys take the host dict-encode path
+        agg_pairs = []
+        for e in aggs:
+            inner_e = e.children[0] if isinstance(e, Alias) else e
+            if not isinstance(inner_e, AggregateExpression):
+                return None
+            agg_pairs.append((e.name, inner_e))
+        base = self._convert(child_meta)
+        return TpuHashAggregateExec(group, agg_pairs, base, pre_filter=cond)

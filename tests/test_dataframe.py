@@ -199,6 +199,20 @@ def test_tpch_q6_shape(session):
     np.testing.assert_allclose(got, want, rtol=1e-12)
 
 
+def test_aggregate_fusion(session):
+    """Filter+Project under Aggregate collapse into one fused exec."""
+    pdf = pd.DataFrame({"k": [1, 2, 1, 2, 3], "v": [1., 2., 3., 4., 100.]})
+    df = session.create_dataframe(pdf)
+    q = df.filter(F.col("v") < 50).select("k", (F.col("v") * 2).alias("v2")) \
+        .groupBy("k").agg(F.sum("v2").alias("s"))
+    plan = session.plan(q.plan)
+    tree = plan.tree_string()
+    assert "TpuFilterExec" not in tree and "TpuProjectExec" not in tree
+    out = q.to_pandas().sort_values("k")
+    assert out["s"].tolist() == [8.0, 12.0]
+    assert out["k"].tolist() == [1, 2]  # k=3 filtered out entirely
+
+
 def test_parquet_scan_roundtrip(session, tmp_path):
     import pyarrow.parquet as pq
     import pyarrow as pa
