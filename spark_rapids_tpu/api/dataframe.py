@@ -70,10 +70,18 @@ class DataFrame:
     def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
              how: str = "inner") -> "DataFrame":
         keys = [on] if isinstance(on, str) else list(on)
+        how = {"left_outer": "left", "right_outer": "right",
+               "outer": "full", "full_outer": "full", "leftsemi": "semi",
+               "left_semi": "semi", "leftanti": "anti",
+               "left_anti": "anti"}.get(how, how)
         lk = [UnresolvedColumn(k) for k in keys]
         rk = [UnresolvedColumn(k) for k in keys]
         return DataFrame(self.session, L.Join(
-            self.plan, other.plan, lk, rk, how))
+            self.plan, other.plan, lk, rk, how, using=keys))
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, L.Join(
+            self.plan, other.plan, [], [], "cross"))
 
     def orderBy(self, *keys: Union[Col, str, SortKey]) -> "DataFrame":
         orders = []

@@ -66,4 +66,8 @@ class CpuFallbackExec(TpuExec):
         else:
             raise NotImplementedError(
                 f"no CPU fallback for {type(node).__name__}")
-        yield ColumnarBatch.from_pandas(out.reset_index(drop=True))
+        out = out.reset_index(drop=True)
+        want = [n for n, _ in node.schema]
+        if list(out.columns) != want:
+            out = out[want]
+        yield ColumnarBatch.from_pandas(out)

@@ -1,0 +1,308 @@
+"""Join physical operators.
+
+Counterpart of the reference's join family (GpuShuffledHashJoinBase,
+GpuBroadcastHashJoinExec, GpuHashJoin trait with null-key filtering +
+JoinGatherer chunked materialization — SURVEY.md section 2.4 "Joins").
+One exec covers the single-process path: build side collected and
+concatenated on device, probe side streamed, with the combined-sort kernel
+from ops/joins.py.  Join types: inner, left, right, full, semi (left semi),
+anti (left anti), cross.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.exec.base import JOIN_TIME, Schema, TpuExec
+from spark_rapids_tpu.ops import joins as J
+from spark_rapids_tpu.ops import selection
+from spark_rapids_tpu.ops.compiler import StageFn
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.expressions import ColVal, Expression
+
+
+def _to_colvals(batch: ColumnarBatch) -> List[ColVal]:
+    return [ColVal(c.dtype, c.data, c.validity, c.offsets)
+            for c in batch.columns.values()]
+
+
+def _to_columns(cols: Sequence[ColVal], nrows: int) -> List[Column]:
+    return [Column(c.dtype, c.values, nrows, validity=c.validity,
+                   offsets=c.offsets) for c in cols]
+
+
+class _JoinKeyEncoder:
+    """Shared host dictionary for string join keys (codes match across
+    sides, so code equality == string equality)."""
+
+    def __init__(self):
+        self.codes: Dict[Optional[str], int] = {}
+
+    def encode(self, col: Column) -> Column:
+        out = np.empty(col.nrows, dtype=np.int64)
+        for i, s in enumerate(col.to_pylist()):
+            if s is None:
+                out[i] = -1
+            else:
+                out[i] = self.codes.setdefault(s, len(self.codes))
+        validity = None
+        if col.validity is not None:
+            validity = np.asarray(col.validity[:col.nrows])
+        return Column.from_numpy(out, dtype=dts.INT64, validity=validity,
+                                 capacity=col.capacity)
+
+
+class TpuHashJoinExec(TpuExec):
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 left: TpuExec, right: TpuExec,
+                 using: Optional[List[str]] = None,
+                 max_output_rows: int = 1 << 22):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.using = using
+        self.max_output_rows = max_output_rows
+        self._register_metric(JOIN_TIME)
+        self._lkey_fn = StageFn(self.left_keys,
+                                [dt for _, dt in left.schema])
+        self._rkey_fn = StageFn(self.right_keys,
+                                [dt for _, dt in right.schema])
+        self._encoders = [
+            _JoinKeyEncoder() if e.dtype.is_string else None
+            for e in self.left_keys]
+
+    # ------------------------------------------------------------------ plan --
+    @property
+    def left(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def right(self) -> TpuExec:
+        return self.children[1]
+
+    @property
+    def schema(self) -> Schema:
+        lschema, rschema = self.left.schema, self.right.schema
+        if self.join_type in ("semi", "anti"):
+            return list(lschema)
+        if self.using:
+            keyset = set(self.using)
+            out = [(n, dt) for n, dt in lschema if n in keyset]
+            out += [(n, dt) for n, dt in lschema if n not in keyset]
+            out += [(n, dt) for n, dt in rschema if n not in keyset]
+            return out
+        return list(lschema) + list(rschema)
+
+    def describe(self):
+        return (f"TpuHashJoinExec[{self.join_type}, "
+                f"{[e.name for e in self.left_keys]}]")
+
+    # ------------------------------------------------------------------ exec --
+    def _encoded_keys(self, batch: ColumnarBatch, fn: StageFn) -> List[ColVal]:
+        cols = fn(batch)
+        out = []
+        for enc, c in zip(self._encoders, cols):
+            if enc is not None:
+                c = enc.encode(c)
+            out.append(ColVal(c.dtype, c.data, c.validity, c.offsets))
+        return out
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self.join_type == "cross":
+            yield from self._execute_cross()
+            return
+        # build = right side normally (the reference also builds the right,
+        # GpuSortMergeJoinMeta -> shuffled hash join); a RIGHT outer join
+        # swaps roles so the preserved side streams as the probe.
+        self._swap = self.join_type == "right"
+        probe_exec, build_exec = (self.right, self.left) if self._swap \
+            else (self.left, self.right)
+        probe_fn, build_fn = (self._rkey_fn, self._lkey_fn) if self._swap \
+            else (self._lkey_fn, self._rkey_fn)
+        build_batches = list(build_exec.execute())
+        if not build_batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            build = empty_batch(build_exec.schema, capacity=1)
+        else:
+            build = concat_batches(build_batches)
+        build_keys = self._encoded_keys(build, build_fn)
+        build_payload = _to_colvals(build)
+        b_matched_acc = None
+
+        outer = self.join_type in ("left", "right", "full")
+        for batch in probe_exec.execute():
+            with self.timer(JOIN_TIME):
+                probe_keys = self._encoded_keys(batch, probe_fn)
+                m = J.join_match(build_keys, probe_keys,
+                                 jnp.int32(build.nrows),
+                                 jnp.int32(batch.nrows))
+                if self.join_type == "full":
+                    bm = m["build_matched"]
+                    b_matched_acc = bm if b_matched_acc is None else \
+                        jnp.logical_or(b_matched_acc, bm)
+                if self.join_type in ("semi", "anti"):
+                    yield from self._emit_semi_anti(batch, m)
+                    continue
+                count, starts, ends, total = J.join_out_starts(
+                    m["probe_count"], jnp.int32(batch.nrows), outer)
+                total = int(total)
+                if total == 0:
+                    continue
+                for off in range(0, total, self.max_output_rows):
+                    n_out = min(self.max_output_rows, total - off)
+                    yield self._emit_chunk(batch, build, build_payload, m,
+                                           count, starts, ends, off, n_out)
+        if self.join_type == "full":
+            yield from self._emit_unmatched_build(build, build_payload,
+                                                  b_matched_acc)
+
+    def _emit_chunk(self, probe_batch, build, build_payload, m, count,
+                    starts, ends, offset, n_out) -> ColumnarBatch:
+        out_cap = bucket_capacity(n_out)
+        # note: starts/ends use the outer-adjusted counts (row emission),
+        # while `matched` must test the RAW match count so outer rows get
+        # a null build side
+        p, brow, matched, _ = J.join_gather_indices(
+            starts - offset if offset else starts,
+            ends - offset if offset else ends,
+            m["probe_count"], m["probe_bstart"], m["sorted_to_build"],
+            jnp.int64(n_out), out_cap)
+        probe_cols = selection.gather(
+            _to_colvals(probe_batch), p, jnp.int32(n_out),
+            char_capacity=self._char_cap(probe_batch, p, n_out))
+        build_cols = J.gather_build_side(
+            build_payload, brow, matched, jnp.int32(n_out),
+            char_capacity=self._char_cap_cols(build_payload, brow, n_out))
+        return self._assemble(probe_cols, build_cols, n_out,
+                              probe_valid=None)
+
+    @staticmethod
+    def _char_cap(batch: ColumnarBatch, indices, n_out) -> int:
+        """Static char capacity covering a row-duplicating string gather."""
+        needed = 0
+        for c in batch.columns.values():
+            if c.offsets is not None:
+                needed = max(needed, int(selection.gathered_char_count(
+                    c.offsets, indices, jnp.int32(n_out))))
+        return bucket_capacity(needed) if needed else 0
+
+    @staticmethod
+    def _char_cap_cols(cols: Sequence[ColVal], indices, n_out) -> int:
+        needed = 0
+        for c in cols:
+            if c.offsets is not None:
+                needed = max(needed, int(selection.gathered_char_count(
+                    c.offsets, indices, jnp.int32(n_out))))
+        return bucket_capacity(needed) if needed else 0
+
+    def _emit_semi_anti(self, batch, m) -> Iterator[ColumnarBatch]:
+        count = m["probe_count"]
+        in_range = jnp.arange(count.shape[0],
+                              dtype=jnp.int32) < batch.nrows
+        if self.join_type == "semi":
+            keep = jnp.logical_and(count > 0, in_range)
+        else:
+            keep = jnp.logical_and(count == 0, in_range)
+        cols, n = selection.compact(_to_colvals(batch), keep)
+        n = int(n)
+        if n == 0:
+            return
+        names = [nm for nm, _ in self.schema]
+        yield ColumnarBatch(dict(zip(names, _to_columns(cols, n))), n)
+
+    def _emit_unmatched_build(self, build, build_payload, matched_acc
+                              ) -> Iterator[ColumnarBatch]:
+        in_range = jnp.arange(
+            matched_acc.shape[0], dtype=jnp.int32) < build.nrows
+        keep = jnp.logical_and(jnp.logical_not(matched_acc), in_range)
+        cols, n = selection.compact(build_payload, keep)
+        n = int(n)
+        if n == 0:
+            return
+        # left side all-null
+        lschema = self.left.schema
+        null_left = []
+        cap = cols[0].values.shape[0] if cols else bucket_capacity(n)
+        for _, dt in lschema:
+            if dt.is_string:
+                c = Column.from_strings([None] * n, capacity=cap)
+                null_left.append(ColVal(dt, c.data, c.validity, c.offsets))
+            else:
+                null_left.append(ColVal(
+                    dt, jnp.zeros(cap, dtype=dt.storage),
+                    jnp.zeros(cap, dtype=jnp.bool_)))
+        yield self._assemble(null_left, cols, n, probe_valid=False)
+
+    def _assemble(self, probe_cols: List[ColVal], build_cols: List[ColVal],
+                  n_out: int, probe_valid) -> ColumnarBatch:
+        """Stitch left+right columns into the output schema (handling
+        USING-style key deduplication and full-outer key coalescing)."""
+        lschema, rschema = self.left.schema, self.right.schema
+        if getattr(self, "_swap", False):
+            lmap = {nm: c for (nm, _), c in zip(lschema, build_cols)}
+            rmap = {nm: c for (nm, _), c in zip(rschema, probe_cols)}
+        else:
+            lmap = {nm: c for (nm, _), c in zip(lschema, probe_cols)}
+            rmap = {nm: c for (nm, _), c in zip(rschema, build_cols)}
+        out_cols: Dict[str, Column] = {}
+        for nm, dt in self.schema:
+            if self.using and nm in self.using:
+                # preserved (probe) side supplies the key
+                c = rmap[nm] if getattr(self, "_swap", False) else lmap[nm]
+                if self.join_type == "full":
+                    rc = rmap.get(nm)
+                    if rc is not None:
+                        lv = c.validity if c.validity is not None else \
+                            jnp.ones_like(c.values, dtype=jnp.bool_) \
+                            if not dt.is_string else None
+                        if dt.is_string:
+                            # coalesce handled by unmatched-build batches
+                            # carrying the key in the right map
+                            c = rc if probe_valid is False else c
+                        else:
+                            c = ColVal(
+                                dt,
+                                jnp.where(lv, c.values, rc.values),
+                                None if c.validity is None or
+                                rc.validity is None else
+                                jnp.logical_or(c.validity, rc.validity))
+                elif probe_valid is False:
+                    c = rmap.get(nm, c)
+            elif nm in lmap:
+                c = lmap[nm]
+            else:
+                c = rmap[nm]
+            out_cols[nm] = Column(c.dtype, c.values, n_out,
+                                  validity=c.validity, offsets=c.offsets)
+        return ColumnarBatch(out_cols, n_out)
+
+    def _execute_cross(self) -> Iterator[ColumnarBatch]:
+        right_batches = list(self.right.execute())
+        if not right_batches:
+            return
+        build = concat_batches(right_batches)
+        bn = build.nrows
+        build_payload = _to_colvals(build)
+        for batch in self.left.execute():
+            total = batch.nrows * bn
+            for off in range(0, total, self.max_output_rows):
+                n_out = min(self.max_output_rows, total - off)
+                out_cap = bucket_capacity(n_out)
+                j = jnp.arange(out_cap, dtype=jnp.int64) + off
+                p = (j // bn).astype(jnp.int32)
+                b = (j % bn).astype(jnp.int32)
+                probe_cols = selection.gather(
+                    _to_colvals(batch), jnp.clip(p, 0, batch.capacity - 1),
+                    jnp.int32(n_out))
+                build_cols = selection.gather(
+                    build_payload, jnp.clip(b, 0, build.capacity - 1),
+                    jnp.int32(n_out))
+                yield self._assemble(probe_cols, build_cols, n_out, None)

@@ -1,0 +1,130 @@
+"""Sort and TopN physical operators.
+
+Counterpart of ``GpuSortExec.scala`` (per-batch / single-batch / out-of-core
+modes) and ``GpuTopN`` (limit.scala:148).  The single-process path sorts the
+concatenated input with the same lexsort kernel the group-by uses (Spark
+ordering: NaN largest, -0.0 == 0.0, null placement per sort key).  The
+out-of-core merge path arrives with the spill framework.
+
+TopN streams: each batch is sorted and truncated to n, the survivors are
+concatenated and re-sorted — a tournament reduction that never materializes
+more than batch+n rows (the GpuTopN iterator does the same with cudf sorts).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.exec.base import SORT_TIME, Schema, TpuExec
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops import selection
+from spark_rapids_tpu.ops.compiler import StageFn
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.expressions import ColVal, Expression
+
+# orders: (expr, descending, nulls_first)
+Order = Tuple[Expression, bool, bool]
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, orders: Sequence[Order], child: TpuExec):
+        super().__init__(child)
+        self.orders = list(orders)
+        self._key_fn = StageFn([e for e, _, _ in orders],
+                               [dt for _, dt in child.schema])
+        self._register_metric(SORT_TIME)
+        self._sort = jax.jit(self._sort_batch)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        parts = [f"{e.name} {'DESC' if d else 'ASC'}"
+                 for e, d, _ in self.orders]
+        return f"TpuSortExec[{', '.join(parts)}]"
+
+    def _sort_batch(self, key_cols: List[ColVal], payload: List[ColVal],
+                    nrows):
+        capacity = payload[0].values.shape[0]
+        live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+        perm = agg.sort_permutation(
+            key_cols, live, capacity,
+            descending=[d for _, d, _ in self.orders],
+            nulls_first=[nf for _, _, nf in self.orders])
+        return selection.gather(payload, perm, nrows)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        with self.timer(SORT_TIME):
+            merged = concat_batches(batches)
+            key_cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                        for c in self._key_fn(merged)]
+            payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                       for c in merged.columns.values()]
+            outs = self._sort(key_cols, payload, jnp.int32(merged.nrows))
+        names = [n for n, _ in self.schema]
+        cols = {nm: Column(o.dtype, o.values, merged.nrows,
+                           validity=o.validity, offsets=o.offsets)
+                for nm, o in zip(names, outs)}
+        yield ColumnarBatch(cols, merged.nrows)
+
+
+class TpuTopNExec(TpuExec):
+    """TakeOrderedAndProject (GpuOverrides.scala:3002 TakeOrderedAndProject
+    -> GpuTopN)."""
+
+    def __init__(self, n: int, orders: Sequence[Order], child: TpuExec):
+        super().__init__(child)
+        self.n = n
+        self.orders = list(orders)
+        self._inner = TpuSortExec(orders, child)
+        self._register_metric(SORT_TIME)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"TpuTopNExec[{self.n}]"
+
+    def _sorted_head(self, batch: ColumnarBatch) -> ColumnarBatch:
+        key_cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                    for c in self._inner._key_fn(batch)]
+        payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                   for c in batch.columns.values()]
+        outs = self._inner._sort(key_cols, payload, jnp.int32(batch.nrows))
+        take = min(self.n, batch.nrows)
+        names = [nm for nm, _ in self.schema]
+        cols = {nm: Column(o.dtype, o.values, take, validity=o.validity,
+                           offsets=o.offsets)
+                for nm, o in zip(names, outs)}
+        return ColumnarBatch(cols, take)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        pending: List[ColumnarBatch] = []
+        with self.timer(SORT_TIME):
+            for batch in self.child.execute():
+                if batch.nrows == 0:
+                    continue
+                pending.append(self._sorted_head(batch))
+                if len(pending) > 8:
+                    pending = [self._sorted_head(concat_batches(pending))]
+            if not pending:
+                return
+            yield self._sorted_head(concat_batches(pending))

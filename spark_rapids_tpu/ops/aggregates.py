@@ -54,24 +54,30 @@ def _sortable_keys(keys: Sequence[ColVal], valid_rows, capacity: int,
     # jnp.lexsort sorts by last key first; we append least-significant first
     for c, desc, nf in zip(reversed(list(keys)), reversed(list(descending)),
                            reversed(list(nulls_first))):
-        v = c.values
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            # total order: -inf < ... < inf < NaN; -0.0 == 0.0
-            v = jnp.where(v == 0.0, 0.0, v)
-            bits = v.astype(jnp.float64).view(jnp.int64)
-            v = jnp.where(bits < 0, jnp.int64(-1) ^ bits, bits)
-            v = jnp.where(jnp.isnan(c.values), jnp.iinfo(jnp.int64).max, v)
-        elif v.dtype == jnp.bool_:
-            v = v.astype(jnp.int8)
+        u = _order_preserving_u64(c.values)
         if desc:
-            v = -v.astype(jnp.int64) if jnp.issubdtype(v.dtype, jnp.integer) \
-                else -v
-        lex.append(v)
+            u = ~u
+        lex.append(u)
         if c.validity is not None:
             null_key = jnp.logical_not(c.validity).astype(jnp.int8)
             lex.append(-null_key if nf else null_key)
     lex.append(pad.astype(jnp.int8))  # most significant: dead rows last
     return lex
+
+
+def _order_preserving_u64(v):
+    """Map any numeric column to uint64 whose unsigned order matches the
+    Spark total order: ints biased by 2^63; floats via the IEEE bit trick
+    (sign-flipped), with -0.0 == 0.0 and NaN largest."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        f = jnp.where(v == 0.0, 0.0, v).astype(jnp.float64)
+        u = f.view(jnp.uint64)
+        sign = u >> jnp.uint64(63)
+        u = jnp.where(sign == 1, ~u, u | jnp.uint64(1 << 63))
+        return jnp.where(jnp.isnan(v), jnp.uint64(0xFFFFFFFFFFFFFFFF), u)
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.uint64)
+    return v.astype(jnp.int64).view(jnp.uint64) ^ jnp.uint64(1 << 63)
 
 
 def sort_permutation(keys: Sequence[ColVal], valid_rows, capacity: int,

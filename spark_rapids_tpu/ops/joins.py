@@ -1,0 +1,187 @@
+"""Equi-join kernels: combined-sort run matching + two-phase materialization.
+
+The reference drives cudf hash joins and materializes unbounded outputs
+through chunked gather maps (``GpuHashJoin.scala:96``, ``JoinGatherer.scala:
+36-60``).  Hash tables scatter serially; the TPU formulation is sort-merge:
+
+* phase A (``join_match``): concatenate build+probe key columns, lexsort by
+  (keys, side) so each equal-key run holds its build rows first; segment
+  arithmetic yields, for every probe row, its match count and the sorted
+  position of its first build match.  Null keys never match (Spark equi-join
+  semantics) but outer/anti rows survive via count adjustment.
+* phase B (``join_gather``): with the total match count known on the host,
+  a bucketed output capacity is chosen and every output row is mapped back
+  to (probe row, k-th build match) with two searchsorted/gather passes —
+  the same static-shape expansion trick as the string gather.
+
+Semi/anti joins skip phase B entirely (a compaction of the probe side).
+Full outer adds one extra batch of never-matched build rows.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.expressions import ColVal
+from spark_rapids_tpu.ops import selection
+
+
+def _concat_col(b: ColVal, p: ColVal) -> ColVal:
+    values = jnp.concatenate([b.values, p.values])
+    validity = None
+    if b.validity is not None or p.validity is not None:
+        bv = b.validity if b.validity is not None else \
+            jnp.ones(b.values.shape[0], dtype=jnp.bool_)
+        pv = p.validity if p.validity is not None else \
+            jnp.ones(p.values.shape[0], dtype=jnp.bool_)
+        validity = jnp.concatenate([bv, pv])
+    return ColVal(b.dtype, values, validity)
+
+
+def _norm_key(v):
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jnp.where(v == 0.0, 0.0, v)
+        bits = v.astype(jnp.float64).view(jnp.int64)
+        v = jnp.where(bits < 0, jnp.int64(-1) ^ bits, bits)
+    elif v.dtype == jnp.bool_:
+        v = v.astype(jnp.int8)
+    return v
+
+
+@jax.jit
+def join_match(build_keys: Sequence[ColVal], probe_keys: Sequence[ColVal],
+               build_n, probe_n):
+    """Phase A. Returns a dict of device arrays (see keys below)."""
+    b_cap = build_keys[0].values.shape[0]
+    p_cap = probe_keys[0].values.shape[0]
+    cap = b_cap + p_cap
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    is_build = pos < b_cap
+    side = jnp.where(is_build, 0, 1).astype(jnp.int8)
+
+    # live = in-range AND all keys non-null (null never matches)
+    live_b = pos < build_n
+    live_p = (pos >= b_cap) & (pos < b_cap + probe_n)
+    live = live_b | live_p
+    norm_keys = []
+    for bk, pk in zip(build_keys, probe_keys):
+        c = _concat_col(bk, pk)
+        if c.validity is not None:
+            live = live & c.validity
+        norm_keys.append(_norm_key(c.values))
+
+    # sort: dead rows last, then by keys, then build before probe
+    lex = [side]
+    for k in reversed(norm_keys):
+        lex.append(k)
+    lex.append(jnp.logical_not(live).astype(jnp.int8))
+    perm = jnp.lexsort(lex).astype(jnp.int32)
+    n_live = live.sum().astype(jnp.int32)
+
+    s_keys = [k[perm] for k in norm_keys]
+    s_side = side[perm]
+    s_live = jnp.arange(cap, dtype=jnp.int32) < n_live
+
+    same = jnp.ones(cap, dtype=jnp.bool_)
+    for k in s_keys:
+        same = same & (k == jnp.roll(k, 1))
+    boundary = jnp.logical_and(jnp.logical_not(same.at[0].set(True)) |
+                               (jnp.arange(cap) == 0), s_live)
+    run_id = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    run_id = jnp.where(s_live, run_id, cap)  # trash segment
+
+    sb = jnp.logical_and(s_side == 0, s_live)
+    sp = jnp.logical_and(s_side == 1, s_live)
+    build_per_run = jax.ops.segment_sum(sb.astype(jnp.int32), run_id,
+                                        num_segments=cap + 1)[:cap]
+    probe_per_run = jax.ops.segment_sum(sp.astype(jnp.int32), run_id,
+                                        num_segments=cap + 1)[:cap]
+    spos = jnp.arange(cap, dtype=jnp.int32)
+    first_build = jax.ops.segment_min(
+        jnp.where(sb, spos, cap), run_id, num_segments=cap + 1)[:cap]
+
+    # scatter per-sorted-probe-row info back to original probe row ids
+    orig = perm - b_cap  # original probe row (valid where s_side==1)
+    probe_tgt = jnp.where(sp, orig, p_cap)
+    probe_count = jnp.zeros(p_cap, dtype=jnp.int32).at[probe_tgt].set(
+        jnp.where(sp, build_per_run[jnp.clip(run_id, 0, cap - 1)], 0),
+        mode="drop")
+    probe_bstart = jnp.zeros(p_cap, dtype=jnp.int32).at[probe_tgt].set(
+        jnp.where(sp, first_build[jnp.clip(run_id, 0, cap - 1)], 0),
+        mode="drop")
+
+    # sorted position -> original build row
+    sorted_to_build = jnp.where(s_side == 0, perm, 0).astype(jnp.int32)
+
+    # build rows that matched no probe row (for full outer)
+    build_matched = jnp.zeros(b_cap, dtype=jnp.bool_)
+    build_tgt = jnp.where(sb, perm, b_cap)
+    build_matched = build_matched.at[build_tgt].set(
+        jnp.where(sb, probe_per_run[jnp.clip(run_id, 0, cap - 1)] > 0,
+                  False), mode="drop")
+    return {
+        "probe_count": probe_count,
+        "probe_bstart": probe_bstart,
+        "sorted_to_build": sorted_to_build,
+        "build_matched": build_matched,
+    }
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("outer",))
+def join_out_starts(probe_count, probe_n, outer: bool):
+    """Adjusted counts (left outer keeps unmatched with one null row),
+    exclusive starts, inclusive ends, and total."""
+    p_cap = probe_count.shape[0]
+    in_range = jnp.arange(p_cap, dtype=jnp.int32) < probe_n
+    count = probe_count
+    if outer:
+        count = jnp.where(in_range & (count == 0), 1, count)
+    count = jnp.where(in_range, count, 0)
+    ends = jnp.cumsum(count, dtype=jnp.int64)
+    starts = (ends - count).astype(jnp.int64)
+    return count, starts, ends, ends[p_cap - 1]
+
+
+@lru_cache(maxsize=None)
+def _gather_indices_kernel(out_cap: int):
+    @jax.jit
+    def run(starts, ends, probe_count, probe_bstart, sorted_to_build, total):
+        j = jnp.arange(out_cap, dtype=jnp.int64)
+        p = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+        p = jnp.clip(p, 0, probe_count.shape[0] - 1)
+        k = (j - starts[p]).astype(jnp.int32)
+        matched = k < probe_count[p]
+        bpos = probe_bstart[p] + k
+        brow = sorted_to_build[jnp.clip(bpos, 0,
+                                        sorted_to_build.shape[0] - 1)]
+        in_range = j < total
+        return p, jnp.clip(brow, 0, None), matched & in_range, in_range
+    return run
+
+
+def join_gather_indices(starts, ends, probe_count, probe_bstart,
+                        sorted_to_build, total, out_cap: int):
+    """Phase B mapping: output row j -> (probe row, build row, matched?)."""
+    return _gather_indices_kernel(out_cap)(
+        starts, ends, probe_count, probe_bstart, sorted_to_build, total)
+
+
+def gather_build_side(cols: Sequence[ColVal], brow, matched,
+                      out_count, char_capacity: int = 0) -> List[ColVal]:
+    """Gather build columns at brow; unmatched rows become null."""
+    outs = selection.gather(cols, brow, out_count,
+                            char_capacity=char_capacity)
+    res = []
+    for o in outs:
+        validity = o.validity
+        validity = matched if validity is None else \
+            jnp.logical_and(validity, matched)
+        res.append(ColVal(o.dtype, o.values, validity, o.offsets))
+    return res

@@ -15,16 +15,20 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu.ops.expressions import ColVal
 
 
-def gather(cols: Sequence[ColVal], indices, out_count) -> List[ColVal]:
+def gather(cols: Sequence[ColVal], indices, out_count,
+           char_capacity: int = 0) -> List[ColVal]:
     """Gather rows of every column at ``indices`` (int array, len=capacity).
 
     Rows at positions >= out_count are padding. ``indices`` entries for
-    padding rows may be arbitrary but must be in-range.
+    padding rows may be arbitrary but must be in-range.  ``char_capacity``
+    (static) sizes string outputs when the gather can *expand* total chars
+    (join duplication); 0 keeps each input's char capacity.
     """
     capacity = indices.shape[0]
     out_mask = jnp.arange(capacity, dtype=jnp.int32) < out_count
@@ -40,17 +44,27 @@ def gather(cols: Sequence[ColVal], indices, out_count) -> List[ColVal]:
         new_offsets = jnp.concatenate(
             [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(lengths,
                                                        dtype=jnp.int32)])
-        char_cap = c.values.shape[0]
-        pos = jnp.arange(char_cap, dtype=jnp.int32)
+        in_char_cap = c.values.shape[0]
+        out_char_cap = char_capacity or in_char_cap
+        pos = jnp.arange(out_char_cap, dtype=jnp.int32)
         # row containing each output byte (last offset <= pos)
         row = jnp.searchsorted(new_offsets, pos, side="right") - 1
         row = jnp.clip(row, 0, capacity - 1)
         src = c.offsets[indices[row]] + (pos - new_offsets[row])
-        src = jnp.clip(src, 0, char_cap - 1)
+        src = jnp.clip(src, 0, in_char_cap - 1)
         total = new_offsets[capacity]
         chars = jnp.where(pos < total, c.values[src], 0).astype(jnp.uint8)
         outs.append(ColVal(c.dtype, chars, validity, new_offsets))
     return outs
+
+
+@jax.jit
+def gathered_char_count(offsets, indices, out_count):
+    """Total chars a gather of ``indices`` would produce (for sizing)."""
+    capacity = indices.shape[0]
+    mask = jnp.arange(capacity, dtype=jnp.int32) < out_count
+    lengths = offsets[indices + 1] - offsets[indices]
+    return jnp.where(mask, lengths, 0).sum()
 
 
 def compact(cols: Sequence[ColVal], keep) -> Tuple[List[ColVal], jnp.ndarray]:

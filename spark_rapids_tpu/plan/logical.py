@@ -178,11 +178,13 @@ class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], join_type: str,
-                 condition: Optional[Expression] = None):
+                 condition: Optional[Expression] = None,
+                 using: Optional[Sequence[str]] = None):
         self.left_keys = [e.bind(left.schema) for e in left_keys]
         self.right_keys = [e.bind(right.schema) for e in right_keys]
         self.join_type = join_type
         self.condition = condition
+        self.using = list(using) if using else None
         self.children = (left, right)
 
     @property
@@ -199,6 +201,12 @@ class Join(LogicalPlan):
         right = self.right.schema
         if self.join_type in ("semi", "anti"):
             return list(left)
+        if self.using:
+            keyset = set(self.using)
+            out = [(n, dt) for n, dt in left if n in keyset]
+            out += [(n, dt) for n, dt in left if n not in keyset]
+            out += [(n, dt) for n, dt in right if n not in keyset]
+            return out
         return list(left) + list(right)
 
     def describe(self):

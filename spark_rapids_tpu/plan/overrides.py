@@ -152,6 +152,17 @@ class PlanMeta(BaseMeta):
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")
+        if isinstance(node, L.Sort) and any(
+                e.dtype.is_string for e, _, _ in node.orders):
+            self.will_not_work("string sort keys not yet supported on TPU")
+        if isinstance(node, L.Join):
+            if node.condition is not None:
+                self.will_not_work(
+                    "non-equi join conditions not yet supported on TPU")
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                if lk.dtype.name != rk.dtype.name:
+                    self.will_not_work(
+                        f"join key type mismatch {lk.dtype} vs {rk.dtype}")
         for em in self.expr_metas:
             em.tag()
             if not em.can_replace:
@@ -251,6 +262,19 @@ def _conv_range(node: L.Range, children, conf):
     return TpuRangeExec(node.start, node.end, node.step)
 
 
+@_converter(L.Sort)
+def _conv_sort(node: L.Sort, children, conf):
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+    return TpuSortExec(node.orders, children[0])
+
+
+@_converter(L.Join)
+def _conv_join(node: L.Join, children, conf):
+    from spark_rapids_tpu.exec.join import TpuHashJoinExec
+    return TpuHashJoinExec(node.left_keys, node.right_keys, node.join_type,
+                           children[0], children[1], using=node.using)
+
+
 class TpuOverrides:
     """The planner: logical plan -> TpuExec tree with CPU fallback."""
 
@@ -276,6 +300,14 @@ class TpuOverrides:
             fused = self._try_fuse_aggregate(meta)
             if fused is not None:
                 return fused
+        # Limit(Sort) -> TopN (TakeOrderedAndProject analog)
+        if isinstance(node, L.Limit) and meta.child_metas and \
+                isinstance(meta.child_metas[0].wrapped, L.Sort) and \
+                meta.child_metas[0].can_replace:
+            from spark_rapids_tpu.exec.sort import TpuTopNExec
+            sort_meta = meta.child_metas[0]
+            base = self._convert(sort_meta.child_metas[0])
+            return TpuTopNExec(node.n, sort_meta.wrapped.orders, base)
         children = [self._convert(c) for c in meta.child_metas]
         own_ok = not meta.reasons
         if own_ok and type(node) in _PLAN_CONVERTERS:

@@ -168,7 +168,8 @@ def test_explain_smoke(session, capsys):
 
 def test_strict_mode_raises():
     s = TpuSession({"spark.rapids.sql.test.enabled": True})
-    df = s.create_dataframe({"a": [2, 1]}).orderBy("a")
+    # string sort keys still fall back to CPU
+    df = s.create_dataframe({"a": ["b", "a"]}).orderBy("a")
     with pytest.raises(RuntimeError, match="fell back to CPU"):
         df.collect()
 
