@@ -11,6 +11,12 @@ from spark_rapids_tpu.ops.expressions import (
 from spark_rapids_tpu.plan import logical as L
 
 
+def _is_window(e: Expression) -> bool:
+    from spark_rapids_tpu.exec.window import WindowExpression
+    inner = e.children[0] if isinstance(e, Alias) else e
+    return isinstance(inner, WindowExpression)
+
+
 class DataFrame:
     def __init__(self, session, plan: L.LogicalPlan):
         self.session = session
@@ -27,6 +33,26 @@ class DataFrame:
 
     def select(self, *cols: Union[Col, str]) -> "DataFrame":
         exprs = [_expr(c) for c in cols]
+        win = [(i, e) for i, e in enumerate(exprs) if _is_window(e)]
+        if win:
+            # route window expressions through a Window node, then project
+            child_names = [n for n, _ in self.plan.schema]
+            wexprs = []
+            names = []
+            for i, e in win:
+                name = e.name if not isinstance(e, Alias) else e.alias
+                inner = e.children[0] if isinstance(e, Alias) else e
+                wexprs.append((name, inner))
+                names.append((i, name))
+            wplan = L.Window(wexprs, self.plan)
+            final: List[Expression] = []
+            by_idx = dict(names)
+            for i, e in enumerate(exprs):
+                if i in by_idx:
+                    final.append(UnresolvedColumn(by_idx[i]))
+                else:
+                    final.append(e)
+            return DataFrame(self.session, L.Project(final, wplan))
         return DataFrame(self.session, L.Project(exprs, self.plan))
 
     def filter(self, condition: Col) -> "DataFrame":
@@ -45,7 +71,7 @@ class DataFrame:
                 exprs.append(UnresolvedColumn(n))
         if not replaced:
             exprs.append(Alias(_expr(c), name))
-        return DataFrame(self.session, L.Project(exprs, self.plan))
+        return self.select(*exprs)
 
     with_column = withColumn
 

@@ -130,6 +130,19 @@ class Col:
             preds.GreaterThanOrEqual(self.expr, _lit_expr(lo)),
             preds.LessThanOrEqual(self.expr, _lit_expr(hi))))
 
+    def over(self, window: "Window") -> "Col":
+        """agg_fn(...).over(window) — pyspark surface for window aggs."""
+        from spark_rapids_tpu.exec.window import WindowExpression
+        from spark_rapids_tpu.plan.logical import AggregateExpression
+        e = self.expr
+        if not isinstance(e, AggregateExpression):
+            raise TypeError(".over() applies to aggregate functions")
+        kind = {"sum": "sum", "count": "count", "min": "min", "max": "max",
+                "avg": "avg"}.get(e.func.name)
+        if kind is None:
+            raise TypeError(f"{e.func.name} is not a window aggregate")
+        return Col(WindowExpression(kind, window._spec(), child=e.func.child))
+
     def asc(self):
         return SortKey(self.expr, descending=False, nulls_first=True)
 
@@ -252,3 +265,109 @@ def first(c, ignore_nulls: bool = False) -> Col:
 
 def last(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.Last(_expr(c), ignore_nulls)))
+
+
+# ------------------------------------------------------------------- windows
+
+class Window:
+    """Window spec builder (pyspark.sql.Window surface)."""
+
+    unboundedPreceding = None
+    unboundedFollowing = None
+    currentRow = 0
+
+    def __init__(self, partition=(), orders=(), frame=None):
+        self._partition = list(partition)
+        self._orders = list(orders)
+        self._frame = frame
+
+    @classmethod
+    def partitionBy(cls, *cols) -> "Window":
+        return cls(partition=[_expr(c) for c in cols])
+
+    def orderBy(self, *keys) -> "Window":
+        orders = []
+        for k in keys:
+            if isinstance(k, SortKey):
+                orders.append((k.expr, k.descending, k.nulls_first))
+            else:
+                orders.append((_expr(k), False, True))
+        return Window(self._partition, orders, self._frame)
+
+    def rowsBetween(self, start, end) -> "Window":
+        from spark_rapids_tpu.exec.window import Frame
+        return Window(self._partition, self._orders,
+                      Frame("rows", start, end))
+
+    def rangeBetween(self, start, end) -> "Window":
+        from spark_rapids_tpu.exec.window import Frame
+        return Window(self._partition, self._orders,
+                      Frame("range", start, end))
+
+    def _spec(self):
+        from spark_rapids_tpu.exec.window import WindowSpec
+        return WindowSpec(self._partition, self._orders, self._frame)
+
+
+class _WindowFunc(Col):
+    """A window function waiting for .over(window)."""
+
+    def __init__(self, kind: str, child=None, offset: int = 1, default=None):
+        self._kind = kind
+        self._child = child
+        self._offset = offset
+        self._default = default
+        # not usable as a plain Col until .over()
+
+    def over(self, window: Window) -> Col:
+        from spark_rapids_tpu.exec.window import WindowExpression
+        return Col(WindowExpression(
+            self._kind, window._spec(),
+            child=None if self._child is None else _expr(self._child),
+            offset=self._offset,
+            default=None if self._default is None
+            else _lit_expr(self._default)))
+
+
+def row_number() -> _WindowFunc:
+    return _WindowFunc("row_number")
+
+
+def rank() -> _WindowFunc:
+    return _WindowFunc("rank")
+
+
+def dense_rank() -> _WindowFunc:
+    return _WindowFunc("dense_rank")
+
+
+def percent_rank() -> _WindowFunc:
+    return _WindowFunc("percent_rank")
+
+
+def lead(c, offset: int = 1, default=None) -> _WindowFunc:
+    return _WindowFunc("lead", c, offset, default)
+
+
+def lag(c, offset: int = 1, default=None) -> _WindowFunc:
+    return _WindowFunc("lag", c, offset, default)
+
+
+def window_sum(c) -> _WindowFunc:
+    return _WindowFunc("sum", c)
+
+
+def window_count(c="*") -> _WindowFunc:
+    return _WindowFunc("count", None if c == "*" else c)
+
+
+def window_min(c) -> _WindowFunc:
+    return _WindowFunc("min", c)
+
+
+def window_max(c) -> _WindowFunc:
+    return _WindowFunc("max", c)
+
+
+def window_avg(c) -> _WindowFunc:
+    return _WindowFunc("avg", c)

@@ -1,0 +1,335 @@
+"""Window physical operator + window expression classes.
+
+Counterpart of GpuWindowExec / GpuWindowExpression (SURVEY.md section 2.4
+"Window": frame types, lead/lag/rank/row_number/count/sum/min/max, and the
+running-window optimization).  Here *every* supported frame is computed from
+one sort + segment arithmetic (ops/window.py), so the reference's special
+"running window" fast path is simply the general path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import SORT_TIME, Schema, TpuExec
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops import selection
+from spark_rapids_tpu.ops import window as W
+from spark_rapids_tpu.ops.compiler import StageFn
+from spark_rapids_tpu.ops.concat import concat_batches
+from spark_rapids_tpu.ops.expressions import ColVal, Expression
+
+
+@dataclasses.dataclass
+class Frame:
+    kind: str = "range"          # 'rows' | 'range'
+    lo: Optional[int] = None     # None = unbounded preceding
+    hi: Optional[int] = 0        # 0 = current row; None = unbounded following
+
+
+class WindowSpec:
+    def __init__(self, partition_exprs: Sequence[Expression] = (),
+                 orders: Sequence[Tuple[Expression, bool, bool]] = (),
+                 frame: Optional[Frame] = None):
+        self.partition_exprs = list(partition_exprs)
+        self.orders = list(orders)
+        if frame is None:
+            # Spark default: range running frame if ordered, else whole
+            # partition
+            frame = Frame("range", None, 0) if self.orders else \
+                Frame("rows", None, None)
+        self.frame = frame
+
+    def bind(self, schema) -> "WindowSpec":
+        return WindowSpec([e.bind(schema) for e in self.partition_exprs],
+                          [(e.bind(schema), d, nf)
+                           for e, d, nf in self.orders], self.frame)
+
+    def cache_key(self):
+        return (tuple(e.cache_key() for e in self.partition_exprs),
+                tuple((e.cache_key(), d, nf) for e, d, nf in self.orders),
+                (self.frame.kind, self.frame.lo, self.frame.hi))
+
+
+class WindowExpression(Expression):
+    """func OVER spec."""
+
+    def __init__(self, kind: str, spec: WindowSpec,
+                 child: Optional[Expression] = None, offset: int = 1,
+                 default: Optional[Expression] = None):
+        self.kind = kind  # row_number|rank|dense_rank|percent_rank|
+        #                   lead|lag|sum|count|min|max|avg
+        self.spec = spec
+        self.child_expr = child
+        self.offset = offset
+        self.default = default
+        kids = [e for e, _, _ in spec.orders] + list(spec.partition_exprs)
+        if child is not None:
+            kids.append(child)
+        if default is not None:
+            kids.append(default)
+        self.children = tuple(kids)
+
+    def bind(self, schema):
+        return WindowExpression(
+            self.kind, self.spec.bind(schema),
+            self.child_expr.bind(schema) if self.child_expr is not None
+            else None,
+            self.offset,
+            self.default.bind(schema) if self.default is not None else None)
+
+    @property
+    def dtype(self) -> DataType:
+        if self.kind in ("row_number", "rank", "dense_rank"):
+            return dts.INT32
+        if self.kind == "percent_rank":
+            return dts.FLOAT64
+        if self.kind == "count":
+            return dts.INT64
+        if self.kind == "avg":
+            return dts.FLOAT64
+        if self.kind == "sum":
+            t = self.child_expr.dtype
+            return dts.FLOAT64 if t.is_floating else (
+                t if t.is_decimal else dts.INT64)
+        return self.child_expr.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.kind not in ("row_number", "rank", "dense_rank",
+                                 "percent_rank", "count")
+
+    @property
+    def name(self) -> str:
+        return f"{self.kind}()"
+
+    def emit(self, ctx):
+        raise RuntimeError("WindowExpression must be planned by "
+                           "TpuWindowExec")
+
+    def cache_key(self):
+        return ("WindowExpression", self.kind, self.offset,
+                self.spec.cache_key(),
+                self.child_expr.cache_key() if self.child_expr else None)
+
+    def supported_reason(self) -> Optional[str]:
+        f = self.spec.frame
+        if self.kind in ("row_number", "rank", "dense_rank", "percent_rank",
+                         "lead", "lag"):
+            if not self.spec.orders and self.kind != "row_number":
+                return f"{self.kind} requires an ORDER BY"
+            return None
+        if self.kind in ("sum", "count", "avg"):
+            if f.kind == "range" and not (f.lo is None and f.hi in (0, None)):
+                return "range frames with value offsets not supported"
+            return None
+        if self.kind in ("min", "max"):
+            whole = f.lo is None and f.hi is None
+            running = f.lo is None and f.hi == 0
+            if not (whole or running):
+                return f"{self.kind} supports only running or " \
+                    "whole-partition frames"
+            return None
+        return f"unknown window function {self.kind}"
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, window_exprs: Sequence[Tuple[str, WindowExpression]],
+                 child: TpuExec):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+        self._register_metric(SORT_TIME)
+        spec = self.window_exprs[0][1].spec
+        for _, we in self.window_exprs[1:]:
+            if we.spec.cache_key() != spec.cache_key():
+                raise ValueError("one TpuWindowExec handles one window spec")
+        self.spec = spec
+        in_dtypes = [dt for _, dt in child.schema]
+        # stage A: partition keys, order keys, agg children, defaults
+        self._pre_exprs: List[Expression] = list(spec.partition_exprs) + \
+            [e for e, _, _ in spec.orders]
+        n_keys = len(self._pre_exprs)
+        self._extra_ofs: Dict[int, int] = {}
+        for i, (_, we) in enumerate(self.window_exprs):
+            if we.child_expr is not None:
+                self._extra_ofs[i] = len(self._pre_exprs) - n_keys
+                self._pre_exprs.append(we.child_expr)
+        self._pre_fn = StageFn(self._pre_exprs, in_dtypes)
+        self._string_part_idx = [
+            i for i, e in enumerate(spec.partition_exprs)
+            if e.dtype.is_string]
+        from spark_rapids_tpu.exec.aggregate import _StringKeyEncoder
+        self._encoders = {i: _StringKeyEncoder()
+                          for i in self._string_part_idx}
+        self._kernel = jax.jit(self._run)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return list(self.child.schema) + \
+            [(name, we.dtype) for name, we in self.window_exprs]
+
+    def describe(self):
+        return (f"TpuWindowExec[{[n for n, _ in self.window_exprs]} over "
+                f"part={[e.name for e in self.spec.partition_exprs]}]")
+
+    # ---- kernel --------------------------------------------------------------
+    def _run(self, part_keys: List[ColVal], order_keys: List[ColVal],
+             extras: List[ColVal], payload: List[ColVal], nrows):
+        capacity = payload[0].values.shape[0] if payload else \
+            (part_keys + order_keys)[0].values.shape[0]
+        live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+        keys = list(part_keys) + list(order_keys)
+        if keys:
+            perm = agg.sort_permutation(
+                keys, live, capacity,
+                descending=[False] * len(part_keys) +
+                [d for _, d, _ in self.spec.orders],
+                nulls_first=[True] * len(part_keys) +
+                [nf for _, _, nf in self.spec.orders])
+        else:
+            perm = jnp.arange(capacity, dtype=jnp.int32)
+        s_part = selection.gather(part_keys, perm, nrows)
+        s_order = selection.gather(order_keys, perm, nrows)
+        s_extras = selection.gather(extras, perm, nrows)
+        s_payload = selection.gather(payload, perm, nrows)
+        s_live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+
+        seg_boundary = _boundaries(s_part, s_live, capacity)
+        run_boundary = _boundaries(s_order, s_live, capacity) \
+            if s_order else jnp.zeros(capacity, dtype=jnp.bool_)
+        sp = W.SortedPartitions(seg_boundary, run_boundary, s_live, capacity)
+
+        outs: List[ColVal] = []
+        for i, (_, we) in enumerate(self.window_exprs):
+            c = s_extras[self._extra_ofs[i]] if i in self._extra_ofs else None
+            outs.append(self._eval_window(we, sp, c, seg_boundary, capacity))
+        return s_payload, outs
+
+    def _eval_window(self, we: WindowExpression, sp: W.SortedPartitions,
+                     c: Optional[ColVal], seg_boundary, capacity: int
+                     ) -> ColVal:
+        f = we.spec.frame
+        kind = we.kind
+        if kind == "row_number":
+            return W.row_number(sp)
+        if kind == "rank":
+            return W.rank(sp)
+        if kind == "dense_rank":
+            return W.dense_rank(sp)
+        if kind == "percent_rank":
+            return W.percent_rank(sp)
+        if kind in ("lead", "lag"):
+            off = we.offset if kind == "lead" else -we.offset
+            # defaults are literals; emit standalone
+            dflt = None
+            if we.default is not None:
+                from spark_rapids_tpu.ops.expressions import EmitContext
+                dflt = we.default.emit(EmitContext([], jnp.int32(0),
+                                                   capacity))
+            return W.lead_lag(sp, c, off, dflt)
+
+        rows = f.kind == "rows"
+        result_dt = we.dtype
+        if kind in ("sum", "count", "avg"):
+            cin = c if kind != "count" else (c or ColVal(
+                dts.INT64, jnp.ones(capacity, dtype=jnp.int64)))
+            vals = cin.values.astype(result_dt.storage) \
+                if kind == "sum" else cin.values
+            if kind == "avg":
+                vals = vals.astype(jnp.float64)
+            cv = ColVal(cin.dtype, vals, cin.validity)
+            if not rows and f.hi == 0:
+                # range running: include full tie run
+                s, n = W.frame_sum(sp, cv, None, None, rows=False)
+                s2, n2 = W.frame_sum(sp, cv, None, 0, rows=False)
+                s, n = s2, n2
+            else:
+                s, n = W.frame_sum(sp, cv, f.lo, f.hi, rows=True)
+            if kind == "count":
+                return ColVal(dts.INT64, n)
+            if kind == "avg":
+                return ColVal(dts.FLOAT64,
+                              s / jnp.maximum(n, 1).astype(jnp.float64),
+                              n > 0)
+            return ColVal(result_dt, s, n > 0)
+        if kind in ("min", "max"):
+            whole = f.lo is None and f.hi is None
+            if whole:
+                v, n = W.partition_reduce(sp, c, kind, capacity)
+            else:
+                v, n = W.running_minmax(sp, c, kind, seg_boundary)
+                if f.kind == "range":
+                    v = v[sp.run_end]
+                    n = n[sp.run_end]
+            return ColVal(result_dt, v, n > 0)
+        raise ValueError(kind)
+
+    # ---- drive ---------------------------------------------------------------
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        merged = concat_batches(batches)
+        with self.timer(SORT_TIME):
+            pre_cols = self._pre_fn(merged)
+            np_ = len(self.spec.partition_exprs)
+            no = len(self.spec.orders)
+            part_cols = pre_cols[:np_]
+            part_cols = [self._encoders[i].encode(c)
+                         if i in self._string_part_idx else c
+                         for i, c in enumerate(part_cols)]
+            part_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                         for c in part_cols]
+            order_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                          for c in pre_cols[np_:np_ + no]]
+            extras = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                      for c in pre_cols[np_ + no:]]
+            payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                       for c in merged.columns.values()]
+            s_payload, outs = self._kernel(part_keys, order_keys, extras,
+                                           payload, jnp.int32(merged.nrows))
+        n = merged.nrows
+        names = [nm for nm, _ in self.schema]
+        cols: Dict[str, Column] = {}
+        for nm, o in zip(names, list(s_payload) + list(outs)):
+            values = o.values
+            if getattr(values, "ndim", 0) == 0:
+                values = jnp.broadcast_to(values, (merged.capacity,))
+            cols[nm] = Column(o.dtype, values, n, validity=o.validity,
+                              offsets=o.offsets)
+        yield ColumnarBatch(cols, n)
+
+
+def _boundaries(cols: List[ColVal], live, capacity: int):
+    """True where any key differs from the previous row (or first live)."""
+    if not cols:
+        return (jnp.arange(capacity, dtype=jnp.int32) == 0) & live
+    same = jnp.ones(capacity, dtype=jnp.bool_)
+    for c in cols:
+        v = c.values
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = jnp.where(v == 0.0, 0.0, v)
+            eq = (v == jnp.roll(v, 1)) | (jnp.isnan(v) &
+                                          jnp.isnan(jnp.roll(v, 1)))
+        else:
+            eq = v == jnp.roll(v, 1)
+        if c.validity is not None:
+            pv = jnp.roll(c.validity, 1)
+            eq = jnp.where(c.validity & pv, eq,
+                           jnp.logical_not(c.validity | pv))
+        same = jnp.logical_and(same, eq)
+    boundary = jnp.logical_not(same)
+    boundary = boundary.at[0].set(True)
+    return jnp.logical_and(boundary, live)

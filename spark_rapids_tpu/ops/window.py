@@ -1,0 +1,219 @@
+"""Window function kernels over sorted segments.
+
+Counterpart of ``GpuWindowExec.scala`` + ``GpuWindowExpression.scala`` (2,797
+LoC driving cudf rolling/scan ops).  TPU formulation: one sort by (partition
+keys, order keys), then every window function is segment arithmetic on the
+sorted arrays —
+
+* ranking (row_number / rank / dense_rank) from positions and order-key run
+  boundaries;
+* running and sliding ROWS-frame sums/counts/averages from masked prefix
+  sums differenced at clamped frame edges;
+* running min/max from a segmented associative scan;
+* whole-partition aggregates from segment reductions gathered back;
+* lead/lag from shifted gathers with segment-bound nulling.
+
+Like Spark's WindowExec, output rows are emitted in (partition, order)
+sorted order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.aggregates import _sentinel
+from spark_rapids_tpu.ops.expressions import ColVal
+
+
+class SortedPartitions:
+    """Per-trace context: sorted segment structure shared by all window fns.
+
+    ``seg_id``    int32[cap]  partition id per sorted row (trash for dead)
+    ``seg_start`` int32[cap]  sorted position of this row's partition start
+    ``seg_end``   int32[cap]  inclusive end position of this row's partition
+    ``pos``       int32[cap]
+    ``live``      bool[cap]
+    ``run_start`` int32[cap]  start position of this row's order-key run
+    ``run_end``   int32[cap]  inclusive end of the order-key run
+    ``run_id_in_seg`` int32[cap] dense run index within the partition
+    """
+
+    def __init__(self, seg_boundary, run_boundary, live, capacity: int):
+        pos = jnp.arange(capacity, dtype=jnp.int32)
+        self.pos = pos
+        self.live = live
+        seg_id = jnp.cumsum(seg_boundary.astype(jnp.int32)) - 1
+        self.seg_id = jnp.where(live, seg_id, capacity)
+        self.seg_start = jnp.where(seg_boundary, pos, 0)
+        self.seg_start = jax.lax.associative_scan(jnp.maximum,
+                                                  self.seg_start)
+        # inclusive segment end: scan max from the right; a segment ends
+        # before the next boundary OR at the last live row
+        nxt_boundary = jnp.concatenate(
+            [seg_boundary[1:], jnp.ones(1, dtype=jnp.bool_)])
+        last_live = jnp.logical_and(live, jnp.logical_not(jnp.concatenate(
+            [live[1:], jnp.zeros(1, dtype=jnp.bool_)])))
+        big = jnp.int32(2**31 - 1)
+        end_marker = jnp.where(jnp.logical_or(nxt_boundary, last_live),
+                               pos, big)
+        # nearest end at-or-after each row: reverse min-scan
+        self.seg_end = jax.lax.associative_scan(
+            jnp.minimum, end_marker, reverse=True)
+        # order-key runs (ties)
+        rb = jnp.logical_or(run_boundary, seg_boundary)
+        self.run_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(rb, pos, 0))
+        run_next = jnp.logical_or(
+            jnp.concatenate([rb[1:], jnp.ones(1, dtype=jnp.bool_)]),
+            last_live)
+        self.run_end = jax.lax.associative_scan(
+            jnp.minimum, jnp.where(run_next, pos, big), reverse=True)
+        run_counter = jnp.cumsum(rb.astype(jnp.int32)) - 1
+        run_at_seg_start = run_counter[self.seg_start]
+        self.run_id_in_seg = run_counter - run_at_seg_start
+
+
+def row_number(sp: SortedPartitions) -> ColVal:
+    from spark_rapids_tpu.columnar import dtypes as dts
+    return ColVal(dts.INT32, sp.pos - sp.seg_start + 1)
+
+
+def rank(sp: SortedPartitions) -> ColVal:
+    from spark_rapids_tpu.columnar import dtypes as dts
+    return ColVal(dts.INT32, sp.run_start - sp.seg_start + 1)
+
+
+def dense_rank(sp: SortedPartitions) -> ColVal:
+    from spark_rapids_tpu.columnar import dtypes as dts
+    return ColVal(dts.INT32, sp.run_id_in_seg + 1)
+
+
+def percent_rank(sp: SortedPartitions) -> ColVal:
+    from spark_rapids_tpu.columnar import dtypes as dts
+    n = (sp.seg_end - sp.seg_start).astype(jnp.float64)
+    r = (sp.run_start - sp.seg_start).astype(jnp.float64)
+    return ColVal(dts.FLOAT64, jnp.where(n > 0, r / jnp.maximum(n, 1), 0.0))
+
+
+def lead_lag(sp: SortedPartitions, c: ColVal, offset: int,
+             default: Optional[ColVal] = None) -> ColVal:
+    """lead(+offset) / lag(-offset) within the partition."""
+    capacity = sp.pos.shape[0]
+    tgt = sp.pos + offset
+    in_seg = jnp.logical_and(tgt >= sp.seg_start, tgt <= sp.seg_end)
+    safe = jnp.clip(tgt, 0, capacity - 1)
+    values = c.values[safe]
+    validity = c.validity[safe] if c.validity is not None else None
+    if default is not None:
+        dvals = jnp.broadcast_to(default.values.astype(values.dtype),
+                                 values.shape)
+        values = jnp.where(in_seg, values, dvals)
+        if default.validity is not None or validity is not None:
+            dv = jnp.broadcast_to(
+                default.validity if default.validity is not None else True,
+                (capacity,))
+            sv = validity if validity is not None else \
+                jnp.ones(capacity, dtype=jnp.bool_)
+            validity = jnp.where(in_seg, sv, dv)
+    else:
+        base = validity if validity is not None else \
+            jnp.ones(capacity, dtype=jnp.bool_)
+        validity = jnp.logical_and(base, in_seg)
+    return ColVal(c.dtype, values, validity)
+
+
+# ------------------------------------------------------------- frame helpers
+
+UNBOUNDED = None
+
+
+def _frame_edges(sp: SortedPartitions, lo, hi, rows: bool):
+    """Inclusive [lo_idx, hi_idx] per sorted row for a ROWS frame, or the
+    running-with-ties RANGE frame when rows=False (lo=None, hi=0)."""
+    if rows:
+        lo_idx = sp.seg_start if lo is UNBOUNDED else \
+            jnp.maximum(sp.seg_start, sp.pos + lo)
+        hi_idx = sp.seg_end if hi is UNBOUNDED else \
+            jnp.minimum(sp.seg_end, sp.pos + hi)
+    else:
+        # RANGE unbounded preceding -> current row (ties included)
+        lo_idx = sp.seg_start if lo is UNBOUNDED else sp.run_start
+        hi_idx = sp.seg_end if hi is UNBOUNDED else sp.run_end
+    return lo_idx, hi_idx
+
+
+def frame_sum(sp: SortedPartitions, c: ColVal, lo, hi, rows: bool,
+              count: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum, nonnull_count) of c over each row's frame via prefix sums."""
+    capacity = sp.pos.shape[0]
+    valid = sp.live if c.validity is None else \
+        jnp.logical_and(sp.live, c.validity)
+    vals = jnp.where(valid, c.values, jnp.zeros((), dtype=c.values.dtype))
+    prefix = jnp.cumsum(vals)
+    cprefix = jnp.cumsum(valid.astype(jnp.int64))
+    lo_idx, hi_idx = _frame_edges(sp, lo, hi, rows)
+    empty = lo_idx > hi_idx
+    lo_safe = jnp.clip(lo_idx, 0, capacity - 1)
+    hi_safe = jnp.clip(hi_idx, 0, capacity - 1)
+    def window(p):
+        below = jnp.where(lo_safe > 0, p[jnp.maximum(lo_safe - 1, 0)], 0)
+        return jnp.where(empty, 0, p[hi_safe] - below)
+    return window(prefix), window(cprefix)
+
+
+def _segmented_scan(op, vals, boundary, reverse=False):
+    """Segmented associative scan: restart ``op`` at boundaries."""
+    flags = boundary
+    if reverse:
+        vals = vals[::-1]
+        flags = jnp.concatenate(
+            [boundary[1:], jnp.ones(1, dtype=jnp.bool_)])[::-1]
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return jnp.logical_or(af, bf), jnp.where(bf, bv, op(av, bv))
+
+    _, out = jax.lax.associative_scan(combine, (flags, vals))
+    return out[::-1] if reverse else out
+
+
+def running_minmax(sp: SortedPartitions, c: ColVal, kind: str,
+                   seg_boundary) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(value, nonnull_count) for min/max over unbounded-preceding frames."""
+    valid = sp.live if c.validity is None else \
+        jnp.logical_and(sp.live, c.validity)
+    sent = _sentinel(kind, c.values.dtype)
+    vals = jnp.where(valid, c.values, sent)
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    out = _segmented_scan(op, vals, seg_boundary)
+    counts = _segmented_scan(jnp.add, valid.astype(jnp.int64), seg_boundary)
+    # extend over ties (range frame): take the run-end value
+    return out, counts
+
+
+def partition_reduce(sp: SortedPartitions, c: ColVal, kind: str,
+                     capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(value, count) of whole-partition aggregate, broadcast to rows."""
+    valid = sp.live if c.validity is None else \
+        jnp.logical_and(sp.live, c.validity)
+    seg = sp.seg_id
+    counts = jax.ops.segment_sum(valid.astype(jnp.int64), seg,
+                                 num_segments=capacity + 1)[:capacity]
+    if kind == "sum":
+        vals = jnp.where(valid, c.values, jnp.zeros((), c.values.dtype))
+        red = jax.ops.segment_sum(vals, seg,
+                                  num_segments=capacity + 1)[:capacity]
+    elif kind == "min":
+        vals = jnp.where(valid, c.values, _sentinel("min", c.values.dtype))
+        red = jax.ops.segment_min(vals, seg,
+                                  num_segments=capacity + 1)[:capacity]
+    else:
+        vals = jnp.where(valid, c.values, _sentinel("max", c.values.dtype))
+        red = jax.ops.segment_max(vals, seg,
+                                  num_segments=capacity + 1)[:capacity]
+    safe_seg = jnp.clip(seg, 0, capacity - 1)
+    return red[safe_seg], counts[safe_seg]

@@ -266,6 +266,29 @@ class Union(LogicalPlan):
         return self.children[0].schema
 
 
+class Window(LogicalPlan):
+    """Append window-function columns (WindowExec analog)."""
+
+    def __init__(self, window_exprs: Sequence[Tuple[str, Expression]],
+                 child: LogicalPlan):
+        # (output name, WindowExpression) pairs, bound to child schema
+        self.window_exprs = [(n, e.bind(child.schema))
+                             for n, e in window_exprs]
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return list(self.child.schema) + \
+            [(n, e.dtype) for n, e in self.window_exprs]
+
+    def describe(self):
+        return f"Window[{[n for n, _ in self.window_exprs]}]"
+
+
 class Range(LogicalPlan):
     def __init__(self, start: int, end: int, step: int = 1):
         from spark_rapids_tpu.columnar import dtypes as dts

@@ -45,6 +45,10 @@ for c in (Alias, BoundReference, Literal, UnresolvedColumn, Cast,
           AggregateExpression):
     expr_rule(c)
 
+from spark_rapids_tpu.exec.window import WindowExpression  # noqa: E402
+
+expr_rule(WindowExpression)
+
 # arithmetic + math (numeric only)
 for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.IntegralDivide, arith.Remainder, arith.Pmod,
@@ -115,6 +119,15 @@ class ExprMeta(BaseMeta):
 
     def tag(self) -> None:
         expr = self.wrapped
+        if isinstance(expr, WindowExpression):
+            reason = expr.supported_reason()
+            if reason:
+                self.will_not_work(reason)
+            if any(e.dtype.is_string for e, _, _ in expr.spec.orders):
+                self.will_not_work("string window order keys not supported")
+            for c in self.child_metas:
+                c.tag()
+            return
         rule = _EXPR_RULES.get(type(expr))
         if rule is None:
             self.will_not_work(
@@ -191,6 +204,8 @@ def _node_expressions(plan: L.LogicalPlan) -> List[Expression]:
         return list(plan.left_keys) + list(plan.right_keys)
     if isinstance(plan, L.Sort):
         return [e for e, _, _ in plan.orders]
+    if isinstance(plan, L.Window):
+        return [e for _, e in plan.window_exprs]
     return []
 
 
@@ -273,6 +288,12 @@ def _conv_join(node: L.Join, children, conf):
     from spark_rapids_tpu.exec.join import TpuHashJoinExec
     return TpuHashJoinExec(node.left_keys, node.right_keys, node.join_type,
                            children[0], children[1], using=node.using)
+
+
+@_converter(L.Window)
+def _conv_window(node: L.Window, children, conf):
+    from spark_rapids_tpu.exec.window import TpuWindowExec
+    return TpuWindowExec(node.window_exprs, children[0])
 
 
 class TpuOverrides:

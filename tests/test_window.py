@@ -1,0 +1,147 @@
+"""Window function tests — oracle: pandas groupby transforms.
+
+Miniature of the reference's window_function_test.py (858 LoC).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import Window
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture
+def pdf(rng):
+    return pd.DataFrame({
+        "grp": rng.integers(0, 8, 200),
+        "ord": rng.permutation(200),
+        "v": rng.normal(size=200).round(3),
+    })
+
+
+def _sorted_out(df, *extra):
+    return df.to_pandas().sort_values(
+        ["grp", "ord", *extra]).reset_index(drop=True)
+
+
+def test_row_number_rank(session, pdf):
+    w = Window.partitionBy("grp").orderBy("ord")
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord",
+        F.row_number().over(w).alias("rn"),
+        F.rank().over(w).alias("rk"),
+        F.dense_rank().over(w).alias("dr"))
+    got = _sorted_out(out)
+    want = pdf.sort_values(["grp", "ord"]).reset_index(drop=True)
+    want["rn"] = want.groupby("grp").cumcount() + 1
+    # ord is a permutation (unique) so rank == dense_rank == row_number
+    np.testing.assert_array_equal(got["rn"], want["rn"])
+    np.testing.assert_array_equal(got["rk"], want["rn"])
+    np.testing.assert_array_equal(got["dr"], want["rn"])
+
+
+def test_rank_with_ties(session):
+    pdf = pd.DataFrame({"grp": [1] * 6, "ord": [10, 10, 20, 20, 20, 30]})
+    w = Window.partitionBy("grp").orderBy("ord")
+    out = session.create_dataframe(pdf).select(
+        "ord", F.rank().over(w).alias("rk"),
+        F.dense_rank().over(w).alias("dr"),
+        F.percent_rank().over(w).alias("pr")).to_pandas()
+    out = out.sort_values("ord").reset_index(drop=True)
+    assert out["rk"].tolist() == [1, 1, 3, 3, 3, 6]
+    assert out["dr"].tolist() == [1, 1, 2, 2, 2, 3]
+    np.testing.assert_allclose(out["pr"], [0, 0, 0.4, 0.4, 0.4, 1.0])
+
+
+def test_running_sum(session, pdf):
+    w = Window.partitionBy("grp").orderBy("ord")
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord", "v", F.sum("v").over(w).alias("rs"))
+    got = _sorted_out(out)
+    want = pdf.sort_values(["grp", "ord"]).reset_index(drop=True)
+    want["rs"] = want.groupby("grp")["v"].cumsum()
+    np.testing.assert_allclose(got["rs"], want["rs"], rtol=1e-9)
+
+
+def test_whole_partition_agg(session, pdf):
+    w = Window.partitionBy("grp")
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord", F.sum("v").over(w).alias("s"),
+        F.max("v").over(w).alias("mx"),
+        F.count().over(w).alias("c"))
+    got = _sorted_out(out)
+    want = pdf.sort_values(["grp", "ord"]).reset_index(drop=True)
+    want["s"] = want.groupby("grp")["v"].transform("sum")
+    want["mx"] = want.groupby("grp")["v"].transform("max")
+    want["c"] = want.groupby("grp")["v"].transform("count")
+    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
+    np.testing.assert_allclose(got["mx"], want["mx"])
+    np.testing.assert_array_equal(got["c"], want["c"])
+
+
+def test_sliding_rows_frame(session, pdf):
+    w = Window.partitionBy("grp").orderBy("ord").rowsBetween(-2, 0)
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord", F.avg("v").over(w).alias("ma"))
+    got = _sorted_out(out)
+    want = pdf.sort_values(["grp", "ord"]).reset_index(drop=True)
+    want["ma"] = want.groupby("grp")["v"].transform(
+        lambda s: s.rolling(3, min_periods=1).mean())
+    np.testing.assert_allclose(got["ma"], want["ma"], rtol=1e-9)
+
+
+def test_lead_lag(session):
+    pdf = pd.DataFrame({"grp": [1, 1, 1, 2, 2], "ord": [1, 2, 3, 1, 2],
+                        "v": [10, 20, 30, 40, 50]})
+    w = Window.partitionBy("grp").orderBy("ord")
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord",
+        F.lead("v").over(w).alias("ld"),
+        F.lag("v").over(w).alias("lg"),
+        F.lag("v", 1, -1).over(w).alias("lgd")).to_pandas()
+    out = out.sort_values(["grp", "ord"]).reset_index(drop=True)
+    assert out["ld"].tolist()[0:3] == [20, 30, None] or \
+        (out["ld"][0] == 20 and out["ld"][1] == 30 and pd.isna(out["ld"][2]))
+    assert pd.isna(out["lg"][0]) and out["lg"][1] == 10
+    assert out["lgd"].tolist() == [-1, 10, 20, -1, 40]
+
+
+def test_running_min_running_count(session, pdf):
+    w = Window.partitionBy("grp").orderBy("ord")
+    out = session.create_dataframe(pdf).select(
+        "grp", "ord", F.min("v").over(w).alias("rm"),
+        F.count("v").over(w).alias("rc"))
+    got = _sorted_out(out)
+    want = pdf.sort_values(["grp", "ord"]).reset_index(drop=True)
+    want["rm"] = want.groupby("grp")["v"].cummin()
+    want["rc"] = want.groupby("grp").cumcount() + 1
+    np.testing.assert_allclose(got["rm"], want["rm"])
+    np.testing.assert_array_equal(got["rc"], want["rc"])
+
+
+def test_window_string_partition(session):
+    pdf = pd.DataFrame({"g": ["a", "b", "a", "b", "a"],
+                        "o": [1, 1, 2, 2, 3], "v": [1, 2, 3, 4, 5]})
+    w = Window.partitionBy("g").orderBy("o")
+    out = session.create_dataframe(pdf).select(
+        "g", "o", F.sum("v").over(w).alias("rs")).to_pandas()
+    out = out.sort_values(["g", "o"]).reset_index(drop=True)
+    assert out["rs"].tolist() == [1, 4, 9, 2, 6]
+
+
+def test_range_running_with_ties(session):
+    pdf = pd.DataFrame({"g": [1] * 5, "o": [1, 1, 2, 2, 3],
+                        "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    w = Window.partitionBy("g").orderBy("o")  # default: range running
+    out = session.create_dataframe(pdf).select(
+        "o", F.sum("v").over(w).alias("rs")).to_pandas()
+    out = out.sort_values(["o", "rs"]).reset_index(drop=True)
+    # ties share the frame: rows with o=1 both see 1+2; o=2 see 1+2+3+4
+    assert out["rs"].tolist() == [3.0, 3.0, 10.0, 10.0, 15.0]
