@@ -130,6 +130,27 @@ class Col:
             preds.GreaterThanOrEqual(self.expr, _lit_expr(lo)),
             preds.LessThanOrEqual(self.expr, _lit_expr(hi))))
 
+    # string predicates (literal patterns)
+    def startswith(self, prefix: str) -> "Col":
+        from spark_rapids_tpu.ops import stringops as S
+        return Col(S.StartsWith(self.expr, prefix))
+
+    def endswith(self, suffix: str) -> "Col":
+        from spark_rapids_tpu.ops import stringops as S
+        return Col(S.EndsWith(self.expr, suffix))
+
+    def contains(self, needle: str) -> "Col":
+        from spark_rapids_tpu.ops import stringops as S
+        return Col(S.Contains(self.expr, needle))
+
+    def like(self, pattern: str) -> "Col":
+        from spark_rapids_tpu.ops import stringops as S
+        return Col(S.Like(self.expr, pattern))
+
+    def substr(self, pos: int, length: int = 2**31 - 1) -> "Col":
+        from spark_rapids_tpu.ops import stringops as S
+        return Col(S.Substring(self.expr, pos, length))
+
     def over(self, window: "Window") -> "Col":
         """agg_fn(...).over(window) — pyspark surface for window aggs."""
         from spark_rapids_tpu.exec.window import WindowExpression
@@ -265,6 +286,177 @@ def first(c, ignore_nulls: bool = False) -> Col:
 
 def last(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.Last(_expr(c), ignore_nulls)))
+
+
+# ------------------------------------------------------------------ strings
+
+def length(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.Length(_expr(c)))
+
+
+def upper(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.Upper(_expr(c)))
+
+
+def lower(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.Lower(_expr(c)))
+
+
+def initcap(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.InitCap(_expr(c)))
+
+
+def substring(c, pos: int, length_: int = 2**31 - 1) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.Substring(_expr(c), pos, length_))
+
+
+def concat(*cols) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.ConcatStrings(*[_expr(c) for c in cols]))
+
+
+def concat_ws(sep: str, *cols) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    parts = []
+    for i, c in enumerate(cols):
+        if i:
+            parts.append(Literal(sep))
+        parts.append(_expr(c))
+    return Col(S.ConcatStrings(*parts))
+
+
+def trim(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringTrim(_expr(c)))
+
+
+def ltrim(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringTrimLeft(_expr(c)))
+
+
+def rtrim(c) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringTrimRight(_expr(c)))
+
+
+def lpad(c, width: int, pad: str = " ") -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringLPad(_expr(c), width, pad))
+
+
+def rpad(c, width: int, pad: str = " ") -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringRPad(_expr(c), width, pad))
+
+
+def locate(substr: str, c, start: int = 1) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringLocate(substr, _expr(c), start))
+
+
+def substring_index(c, delim: str, count: int) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.SubstringIndex(_expr(c), delim, count))
+
+
+def repeat(c, n: int) -> Col:
+    from spark_rapids_tpu.ops import stringops as S
+    return Col(S.StringRepeat(_expr(c), n))
+
+
+# ---------------------------------------------------------------- date/time
+
+def _dt(cls, c) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(getattr(D, cls)(_expr(c)))
+
+
+def year(c) -> Col:
+    return _dt("Year", c)
+
+
+def month(c) -> Col:
+    return _dt("Month", c)
+
+
+def dayofmonth(c) -> Col:
+    return _dt("DayOfMonth", c)
+
+
+def dayofweek(c) -> Col:
+    return _dt("DayOfWeek", c)
+
+
+def weekday(c) -> Col:
+    return _dt("WeekDay", c)
+
+
+def dayofyear(c) -> Col:
+    return _dt("DayOfYear", c)
+
+
+def quarter(c) -> Col:
+    return _dt("Quarter", c)
+
+
+def hour(c) -> Col:
+    return _dt("Hour", c)
+
+
+def minute(c) -> Col:
+    return _dt("Minute", c)
+
+
+def second(c) -> Col:
+    return _dt("Second", c)
+
+
+def last_day(c) -> Col:
+    return _dt("LastDay", c)
+
+
+def date_add(c, days) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.DateAdd(_expr(c), _lit_expr(days)))
+
+
+def date_sub(c, days) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.DateSub(_expr(c), _lit_expr(days)))
+
+
+def datediff(end, start) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.DateDiff(_expr(end), _expr(start)))
+
+
+def add_months(c, months) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.AddMonths(_expr(c), _lit_expr(months)))
+
+
+def months_between(a, b) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.MonthsBetween(_expr(a), _expr(b)))
+
+
+def trunc(c, fmt: str) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.TruncDate(_expr(c), fmt))
+
+
+def unix_timestamp(c) -> Col:
+    return _dt("UnixTimestamp", c)
+
+
+def from_unixtime(c) -> Col:
+    return _dt("FromUnixTime", c)
 
 
 # ------------------------------------------------------------------- windows

@@ -93,9 +93,27 @@ class Column:
                    capacity: Optional[int] = None) -> "Column":
         """Build a device column from host values (non-string)."""
         values = np.asarray(values)
+        if values.dtype.kind == "O":
+            import datetime as _dt
+            sample = next((v for v in values if v is not None), None)
+            if isinstance(sample, _dt.datetime):
+                validity = np.array([v is not None for v in values]) \
+                    if validity is None else validity
+                filled = [sample if v is None else v for v in values]
+                values = np.array(filled, dtype="datetime64[us]")
+                dtype = dtype or dts.TIMESTAMP_US
+            elif isinstance(sample, _dt.date):
+                validity = np.array([v is not None for v in values]) \
+                    if validity is None else validity
+                filled = [sample if v is None else v for v in values]
+                values = np.array(filled, dtype="datetime64[D]").astype(
+                    np.int32)
+                dtype = dtype or dts.DATE32
         if values.dtype.kind in ("U", "S", "O"):
             return cls.from_strings(values.tolist(), validity=validity,
                                     capacity=capacity)
+        if validity is not None and np.asarray(validity).all():
+            validity = None
         if values.dtype.kind == "M":
             values = values.astype("datetime64[us]").astype(np.int64)
             dtype = dtype or dts.TIMESTAMP_US

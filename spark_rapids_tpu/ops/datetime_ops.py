@@ -1,0 +1,302 @@
+"""Date/time expressions — pure integer math on date32 days / int64 micros.
+
+Coverage target: the reference's ``datetimeExpressions.scala`` (1,040 LoC,
+SURVEY.md Appendix A.1 "Date/time").  UTC only, like the reference
+(Appendix B "Timestamps: UTC only").  Calendar conversion uses the civil-
+from-days algorithm (Euclidean affine transforms), which is branch-free and
+vectorizes cleanly on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.ops.cast import US_PER_DAY, US_PER_SEC
+from spark_rapids_tpu.ops.expressions import (
+    BinaryExpression, ColVal, EmitContext, Expression, UnaryExpression,
+    cast_value, combine_validity,
+)
+
+
+def _civil_from_days(z):
+    """days since 1970-01-01 -> (year, month, day), proleptic Gregorian."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                                  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                   # [1, 12]
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = 365 * yoe + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _to_days(c: ColVal):
+    if c.dtype.is_timestamp:
+        return c.values // US_PER_DAY
+    return c.values.astype(jnp.int64)
+
+
+class _DatePart(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def eval_values(self, v, cv):
+        y, m, d = _civil_from_days(_to_days(cv))
+        return self.part(y, m, d, _to_days(cv)).astype(jnp.int32)
+
+
+class Year(_DatePart):
+    def part(self, y, m, d, days):
+        return y
+
+
+class Month(_DatePart):
+    def part(self, y, m, d, days):
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def part(self, y, m, d, days):
+        return d
+
+
+class Quarter(_DatePart):
+    def part(self, y, m, d, days):
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DatePart):
+    """1 = Sunday ... 7 = Saturday (Spark)."""
+
+    def part(self, y, m, d, days):
+        return (days + 4) % 7 + 1
+
+
+class WeekDay(_DatePart):
+    """0 = Monday ... 6 = Sunday (Spark weekday)."""
+
+    def part(self, y, m, d, days):
+        return (days + 3) % 7
+
+
+class DayOfYear(_DatePart):
+    def part(self, y, m, d, days):
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return (days - jan1 + 1).astype(jnp.int32)
+
+
+class LastDay(UnaryExpression):
+    """Last day of the month, as a date."""
+
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def eval_values(self, v, cv):
+        y, m, d = _civil_from_days(_to_days(cv))
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        first_next = _days_from_civil(ny, nm, jnp.ones_like(d))
+        return (first_next - 1).astype(jnp.int32)
+
+
+class Hour(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def eval_values(self, v, cv):
+        return (jnp.mod(v, US_PER_DAY) // 3_600_000_000).astype(jnp.int32)
+
+
+class Minute(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def eval_values(self, v, cv):
+        return (jnp.mod(v, 3_600_000_000) // 60_000_000).astype(jnp.int32)
+
+
+class Second(UnaryExpression):
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def eval_values(self, v, cv):
+        return (jnp.mod(v, 60_000_000) // US_PER_SEC).astype(jnp.int32)
+
+
+class DateAdd(BinaryExpression):
+    """date_add(date, n_days)."""
+
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        out = l.values.astype(jnp.int32) + r.values.astype(jnp.int32)
+        return ColVal(dts.DATE32, out,
+                      combine_validity(l.validity, r.validity))
+
+
+class DateSub(BinaryExpression):
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        out = l.values.astype(jnp.int32) - r.values.astype(jnp.int32)
+        return ColVal(dts.DATE32, out,
+                      combine_validity(l.validity, r.validity))
+
+
+class DateDiff(BinaryExpression):
+    """datediff(end, start) in days."""
+
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        out = (_to_days(l) - _to_days(r)).astype(jnp.int32)
+        return ColVal(dts.INT32, out,
+                      combine_validity(l.validity, r.validity))
+
+
+class AddMonths(BinaryExpression):
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        y, m, d = _civil_from_days(_to_days(l))
+        months = y * 12 + (m - 1) + r.values.astype(jnp.int64)
+        ny, nm = months // 12, months % 12 + 1
+        # clamp day to target month length
+        nny = jnp.where(nm == 12, ny + 1, ny)
+        nnm = jnp.where(nm == 12, 1, nm + 1)
+        month_len = (_days_from_civil(nny, nnm, jnp.ones_like(d)) -
+                     _days_from_civil(ny, nm, jnp.ones_like(d)))
+        nd = jnp.minimum(d, month_len)
+        out = _days_from_civil(ny, nm, nd).astype(jnp.int32)
+        return ColVal(dts.DATE32, out,
+                      combine_validity(l.validity, r.validity))
+
+
+class MonthsBetween(BinaryExpression):
+    @property
+    def dtype(self):
+        return dts.FLOAT64
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        a = self.left.emit(ctx)
+        b = self.right.emit(ctx)
+        ya, ma, da = _civil_from_days(_to_days(a))
+        yb, mb, db = _civil_from_days(_to_days(b))
+        whole = (ya * 12 + ma) - (yb * 12 + mb)
+        frac = (da - db).astype(jnp.float64) / 31.0
+        out = whole.astype(jnp.float64) + frac
+        return ColVal(dts.FLOAT64, out,
+                      combine_validity(a.validity, b.validity))
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in year/month/week/quarter."""
+
+    def __init__(self, child: Expression, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt.lower()
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return TruncDate(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        days = _to_days(c)
+        y, m, d = _civil_from_days(days)
+        one = jnp.ones_like(m)
+        if self.fmt in ("year", "yyyy", "yy"):
+            out = _days_from_civil(y, one, one)
+        elif self.fmt in ("month", "mon", "mm"):
+            out = _days_from_civil(y, m, one)
+        elif self.fmt == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            out = _days_from_civil(y, qm, one)
+        elif self.fmt == "week":
+            out = days - (days + 3) % 7  # Monday
+        else:
+            raise ValueError(f"unsupported trunc format {self.fmt}")
+        return ColVal(dts.DATE32, out.astype(jnp.int32), c.validity)
+
+    def cache_key(self):
+        return ("TruncDate", self.fmt, self.child.cache_key())
+
+
+class UnixTimestamp(UnaryExpression):
+    """to_unix_timestamp(ts_or_date) -> seconds."""
+
+    @property
+    def dtype(self):
+        return dts.INT64
+
+    def eval_values(self, v, cv):
+        if cv.dtype.is_date:
+            return v.astype(jnp.int64) * 86_400
+        return v // US_PER_SEC
+
+
+class FromUnixTime(UnaryExpression):
+    """seconds -> timestamp (formatting to string is a separate cast)."""
+
+    @property
+    def dtype(self):
+        return dts.TIMESTAMP_US
+
+    def eval_values(self, v, cv):
+        return v.astype(jnp.int64) * US_PER_SEC
+
+
+class TimeAdd(BinaryExpression):
+    """timestamp + interval microseconds (literal)."""
+
+    @property
+    def dtype(self):
+        return dts.TIMESTAMP_US
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        l = self.left.emit(ctx)
+        r = self.right.emit(ctx)
+        return ColVal(dts.TIMESTAMP_US, l.values + r.values.astype(jnp.int64),
+                      combine_validity(l.validity, r.validity))

@@ -49,6 +49,25 @@ from spark_rapids_tpu.exec.window import WindowExpression  # noqa: E402
 
 expr_rule(WindowExpression)
 
+# strings (stringFunctions.scala analog)
+from spark_rapids_tpu.ops import stringops as S  # noqa: E402
+
+for c in (S.Length, S.OctetLength, S.Upper, S.Lower, S.InitCap,
+          S.StartsWith, S.EndsWith, S.Contains, S.Like, S.EqualsLiteral,
+          S.StringLocate, S.Substring, S.StringTrim, S.StringTrimLeft,
+          S.StringTrimRight, S.ConcatStrings, S.StringRepeat, S.StringLPad,
+          S.StringRPad, S.SubstringIndex):
+    expr_rule(c, ts.COMMON)
+
+# date/time (datetimeExpressions.scala analog)
+from spark_rapids_tpu.ops import datetime_ops as D  # noqa: E402
+
+for c in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek, D.WeekDay,
+          D.DayOfYear, D.LastDay, D.Hour, D.Minute, D.Second, D.DateAdd,
+          D.DateSub, D.DateDiff, D.AddMonths, D.MonthsBetween, D.TruncDate,
+          D.UnixTimestamp, D.FromUnixTime, D.TimeAdd):
+    expr_rule(c, ts.COMMON)
+
 # arithmetic + math (numeric only)
 for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.IntegralDivide, arith.Remainder, arith.Pmod,
@@ -119,6 +138,9 @@ class ExprMeta(BaseMeta):
 
     def tag(self) -> None:
         expr = self.wrapped
+        if isinstance(expr, S.Like) and not expr.supported:
+            self.will_not_work(
+                f"LIKE pattern {expr.pattern!r} too general for TPU")
         if isinstance(expr, WindowExpression):
             reason = expr.supported_reason()
             if reason:

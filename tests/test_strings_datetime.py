@@ -1,0 +1,234 @@
+"""String + datetime expression tests (CastOpSuite/StringOperatorsSuite
+miniature)."""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dts
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+STRINGS = ["hello world", "", "Spark", "tpu TPU tpu", "  padded  ",
+           None, "日本語テキスト", "a,b,c,d", "xyz"]
+
+
+@pytest.fixture(scope="module")
+def sdf(session):
+    return session.create_dataframe({"s": STRINGS})
+
+
+def test_length(session, sdf):
+    out = sdf.select(F.length("s").alias("n")).to_pandas()["n"]
+    want = [len(s) if s is not None else None for s in STRINGS]
+    assert [None if pd.isna(v) else v for v in out] == want
+
+
+def test_upper_lower(session, sdf):
+    out = sdf.select(F.upper("s").alias("u"),
+                     F.lower("s").alias("l")).to_pandas()
+    for got, s in zip(out["u"], STRINGS):
+        if s is None:
+            assert pd.isna(got)
+        else:
+            # ASCII-only case mapping
+            want = "".join(ch.upper() if ch.isascii() else ch for ch in s)
+            assert got == want
+    assert out["l"][2] == "spark"
+
+
+def test_startswith_endswith_contains(session, sdf):
+    out = sdf.select(
+        F.col("s").startswith("hel").alias("sw"),
+        F.col("s").endswith("rld").alias("ew"),
+        F.col("s").contains("ark").alias("ct")).to_pandas()
+    assert bool(out["sw"][0]) and not bool(out["sw"][2])
+    assert bool(out["ew"][0])
+    assert bool(out["ct"][2]) and not bool(out["ct"][0])
+    assert pd.isna(out["sw"][5])
+
+
+def test_like(session, sdf):
+    out = sdf.select(
+        F.col("s").like("%world").alias("a"),
+        F.col("s").like("Spark").alias("b"),
+        F.col("s").like("%TPU%").alias("c"),
+        F.col("s").like("h%d").alias("d")).to_pandas()
+    assert bool(out["a"][0]) and not bool(out["a"][2])
+    assert bool(out["b"][2])
+    assert bool(out["c"][3]) and not bool(out["c"][0])
+    assert bool(out["d"][0])  # h...d
+
+
+def test_substring(session, sdf):
+    out = sdf.select(F.substring("s", 1, 5).alias("a"),
+                     F.substring("s", 7).alias("b"),
+                     F.substring("s", -3).alias("c")).to_pandas()
+    assert out["a"][0] == "hello"
+    assert out["b"][0] == "world"
+    assert out["c"][0] == "rld"
+    assert out["a"][1] == ""
+    # UTF-8: char-based slicing
+    assert out["a"][6] == "日本語テキ"
+
+
+def test_trim_pad(session, sdf):
+    out = sdf.select(F.trim("s").alias("t"), F.ltrim("s").alias("lt"),
+                     F.rtrim("s").alias("rt"),
+                     F.lpad("s", 6, "*").alias("lp"),
+                     F.rpad("s", 6, "*").alias("rp")).to_pandas()
+    assert out["t"][4] == "padded"
+    assert out["lt"][4] == "padded  "
+    assert out["rt"][4] == "  padded"
+    assert out["lp"][2] == "*Spark"
+    assert out["rp"][2] == "Spark*"
+    assert out["lp"][0] == "hello "  # truncated to width
+
+
+def test_concat(session, sdf):
+    out = sdf.select(F.concat("s", F.lit("!")).alias("c")).to_pandas()["c"]
+    assert out[0] == "hello world!"
+    assert out[1] == "!"
+    assert pd.isna(out[5])
+    out2 = sdf.select(F.concat_ws("-", "s", "s").alias("c")).to_pandas()["c"]
+    assert out2[2] == "Spark-Spark"
+
+
+def test_substring_index_locate_repeat(session, sdf):
+    out = sdf.select(
+        F.substring_index("s", ",", 2).alias("si"),
+        F.substring_index("s", ",", -1).alias("sn"),
+        F.locate("b", F.col("s")).alias("lc"),
+        F.repeat("s", 2).alias("rp")).to_pandas()
+    assert out["si"][7] == "a,b"
+    assert out["sn"][7] == "d"
+    assert out["lc"][7] == 3
+    assert out["lc"][0] == 0
+    assert out["rp"][2] == "SparkSpark"
+
+
+def test_initcap(session):
+    df = TpuSession().create_dataframe({"s": ["hello world", "SPARK ok"]})
+    out = df.select(F.initcap("s").alias("i")).to_pandas()["i"]
+    assert out[0] == "Hello World"
+    assert out[1] == "Spark Ok"
+
+
+def test_cast_string_to_numbers(session):
+    df = session.create_dataframe(
+        {"s": ["123", "-45", "3.5", "abc", "", "+7", "12.0.3", None]})
+    ints = df.select(F.col("s").cast("bigint").alias("i")).to_pandas()["i"]
+    assert [None if pd.isna(v) else int(v) for v in ints] == \
+        [123, -45, None, None, None, 7, None, None]
+    floats = df.select(F.col("s").cast("double").alias("f")).to_pandas()["f"]
+    assert floats[0] == 123.0 and floats[2] == 3.5
+    assert pd.isna(floats[3]) and pd.isna(floats[6])
+
+
+def test_cast_string_to_date(session):
+    df = session.create_dataframe({"s": ["2024-02-29", "1970-01-01",
+                                         "bogus", None]})
+    out = df.select(F.col("s").cast("date").alias("d")).to_pandas()["d"]
+    assert out[0] == datetime.date(2024, 2, 29)
+    assert out[1] == datetime.date(1970, 1, 1)
+    assert pd.isna(out[2]) and pd.isna(out[3])
+
+
+def test_cast_int_bool_date_to_string(session):
+    df = session.create_dataframe({"i": [0, -123, 98765, None]})
+    out = df.select(F.col("i").cast("string").alias("s")).to_pandas()["s"]
+    assert out.tolist()[:3] == ["0", "-123", "98765"]
+    assert pd.isna(out[3])
+    bf = session.create_dataframe({"b": [True, False]})
+    bs = bf.select(F.col("b").cast("string").alias("s")).to_pandas()["s"]
+    assert bs.tolist() == ["true", "false"]
+    dd = session.create_dataframe(
+        {"d": pd.to_datetime(["2023-07-04", "1999-12-31"]).date})
+    ds = dd.select(F.col("d").cast("string").alias("s")).to_pandas()["s"]
+    assert ds.tolist() == ["2023-07-04", "1999-12-31"]
+
+
+DATES = pd.to_datetime(["2024-02-29", "1970-01-01", "2000-12-31",
+                        "1969-07-20", "2023-06-15"])
+
+
+def test_date_parts(session):
+    df = session.create_dataframe({"d": DATES.date})
+    out = df.select(
+        F.year("d").alias("y"), F.month("d").alias("m"),
+        F.dayofmonth("d").alias("dom"), F.quarter("d").alias("q"),
+        F.dayofweek("d").alias("dow"), F.dayofyear("d").alias("doy"),
+        F.weekday("d").alias("wd")).to_pandas()
+    assert out["y"].tolist() == [d.year for d in DATES]
+    assert out["m"].tolist() == [d.month for d in DATES]
+    assert out["dom"].tolist() == [d.day for d in DATES]
+    assert out["q"].tolist() == [(d.month - 1) // 3 + 1 for d in DATES]
+    assert out["dow"].tolist() == [d.isoweekday() % 7 + 1 for d in DATES]
+    assert out["doy"].tolist() == [d.dayofyear for d in DATES]
+    assert out["wd"].tolist() == [d.weekday() for d in DATES]
+
+
+def test_date_arithmetic(session):
+    df = session.create_dataframe({"d": DATES.date})
+    out = df.select(
+        F.date_add("d", 10).alias("p10"),
+        F.date_sub("d", 1).alias("m1"),
+        F.last_day("d").alias("ld"),
+        F.add_months("d", 1).alias("am"),
+        F.trunc("d", "month").alias("tm")).to_pandas()
+    assert out["p10"][0] == datetime.date(2024, 3, 10)
+    assert out["m1"][0] == datetime.date(2024, 2, 28)
+    assert out["ld"][4] == datetime.date(2023, 6, 30)
+    assert out["am"][0] == datetime.date(2024, 3, 29)
+    assert out["am"][2] == datetime.date(2001, 1, 31)
+    assert out["tm"][0] == datetime.date(2024, 2, 1)
+
+
+def test_datediff_months_between(session):
+    df = session.create_dataframe({
+        "a": pd.to_datetime(["2024-03-01", "2020-01-15"]).date,
+        "b": pd.to_datetime(["2024-02-28", "2019-12-15"]).date})
+    out = df.select(F.datediff("a", "b").alias("dd"),
+                    F.months_between("a", "b").alias("mb")).to_pandas()
+    assert out["dd"].tolist() == [2, 31]
+    np.testing.assert_allclose(out["mb"],
+                               [(1 + 3 / 31.0) - 1 + 0.0967741935483871 * 0,
+                                1.0], atol=0.2)
+
+
+def test_timestamp_parts(session):
+    ts = pd.to_datetime(["2023-06-15 13:45:30", "1970-01-01 00:00:59"])
+    df = session.create_dataframe({"t": ts})
+    out = df.select(F.hour("t").alias("h"), F.minute("t").alias("m"),
+                    F.second("t").alias("s"),
+                    F.year("t").alias("y")).to_pandas()
+    assert out["h"].tolist() == [13, 0]
+    assert out["m"].tolist() == [45, 0]
+    assert out["s"].tolist() == [30, 59]
+    assert out["y"].tolist() == [2023, 1970]
+
+
+def test_unix_timestamp_roundtrip(session):
+    ts = pd.to_datetime(["2023-06-15 13:45:30"])
+    df = session.create_dataframe({"t": ts})
+    out = df.select(F.unix_timestamp("t").alias("u")).to_pandas()["u"]
+    assert out[0] == int(ts[0].timestamp())
+
+
+def test_string_groupby_like_filter(session):
+    """TPC-H-ish: string predicate + group by string key."""
+    df = session.create_dataframe({
+        "p_type": ["ECONOMY BRASS", "LARGE BRASS", "SMALL COPPER",
+                   "MEDIUM BRASS", "PROMO TIN"],
+        "v": [1, 2, 3, 4, 5]})
+    out = df.filter(F.col("p_type").like("%BRASS")) \
+        .agg(F.sum("v").alias("s")).collect()
+    assert out[0][0] == 7
