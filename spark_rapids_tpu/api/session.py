@@ -53,7 +53,30 @@ class TpuSession:
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
         self.overrides = TpuOverrides(self.conf)
+        self._init_memory()
         TpuSession._active = self
+
+    def _init_memory(self) -> None:
+        """GpuDeviceManager.initializeGpuAndMemory analog: size the spill
+        catalog from HBM and install the admission semaphore."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.memory.spill import (
+            SpillableBatchCatalog, TpuSemaphore, set_default_catalog)
+        device_budget = self.conf.get(rc.DEVICE_MEMORY_LIMIT)
+        if not device_budget:
+            import jax
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                hbm = stats.get("bytes_limit", 16 << 30)
+            except Exception:
+                hbm = 16 << 30
+            device_budget = int(hbm * self.conf.get(rc.MEM_POOL_FRACTION))
+        self.memory_catalog = SpillableBatchCatalog(
+            device_budget=device_budget,
+            host_budget=self.conf.get(rc.HOST_SPILL_STORAGE_SIZE))
+        set_default_catalog(self.memory_catalog)
+        self.semaphore = TpuSemaphore(
+            self.conf.get(rc.CONCURRENT_TPU_TASKS))
 
     # --------------------------------------------------------------- builders --
     @classmethod

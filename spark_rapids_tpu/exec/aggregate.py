@@ -265,14 +265,22 @@ class TpuHashAggregateExec(TpuExec):
                 [(r.values, r.validity, r.offsets) for r in results], n)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
-        partials = list(self._partial_batches())
+        from spark_rapids_tpu.memory.spill import default_catalog
+        catalog = default_catalog()
+        # cache partials as spillable batches (the reference caches
+        # SpillableColumnarBatch between update and merge, aggregate.scala)
+        handles = [catalog.register(b) for b in self._partial_batches()]
         nkeys = len(self.group_exprs)
-        if not partials:
+        if not handles:
             if nkeys:
                 return
             partials = [empty_batch(self._partial_schema)]
+        else:
+            partials = [h.materialize() for h in handles]
         with self.timer(CONCAT_TIME):
             merged_in = concat_batches(partials)
+        for h in handles:
+            h.close()
         with self.timer(AGG_TIME):
             key_flat, res_flat, n = self._merge_fn(
                 batch_to_flat(merged_in), jnp.int32(merged_in.nrows))

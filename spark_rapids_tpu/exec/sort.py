@@ -64,11 +64,16 @@ class TpuSortExec(TpuExec):
         return selection.gather(payload, perm, nrows)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
-        batches = list(self.child.execute())
-        if not batches:
+        from spark_rapids_tpu.memory.spill import default_catalog
+        catalog = default_catalog()
+        handles = [catalog.register(b) for b in self.child.execute()]
+        if not handles:
             return
         with self.timer(SORT_TIME):
+            batches = [h.materialize() for h in handles]
             merged = concat_batches(batches)
+            for h in handles:
+                h.close()
             key_cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
                         for c in self._key_fn(merged)]
             payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)

@@ -1,0 +1,66 @@
+"""Batch coalescing: CoalesceGoal + the concatenating iterator.
+
+Counterpart of GpuCoalesceBatches.scala (CoalesceGoal:142 TargetSize /
+RequireSingleBatch, AbstractGpuCoalesceIterator:195): accumulate small
+batches until a size goal is met, concatenating on device.  Pending batches
+are registered spillable so a long accumulation can't pin HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.spill import (
+    AGGREGATE_INTERMEDIATE_PRIORITY, SpillableBatchCatalog, default_catalog)
+from spark_rapids_tpu.ops.concat import concat_batches
+
+
+class CoalesceGoal:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TargetSize(CoalesceGoal):
+    bytes: int = 1 << 31
+
+
+class RequireSingleBatch(CoalesceGoal):
+    pass
+
+
+def coalesce_iterator(batches: Iterator[ColumnarBatch], goal: CoalesceGoal,
+                      catalog: Optional[SpillableBatchCatalog] = None
+                      ) -> Iterator[ColumnarBatch]:
+    catalog = catalog or default_catalog()
+    pending = []
+    pending_bytes = 0
+    target = goal.bytes if isinstance(goal, TargetSize) else None
+
+    def flush():
+        nonlocal pending, pending_bytes
+        if not pending:
+            return None
+        got = [h.materialize() for h in pending]
+        for h in pending:
+            h.close()
+        pending = []
+        pending_bytes = 0
+        return concat_batches(got) if len(got) > 1 else got[0]
+
+    for batch in batches:
+        if batch.nrows == 0:
+            continue
+        size = batch.device_size_bytes()
+        if target is not None and pending and \
+                pending_bytes + size > target:
+            out = flush()
+            if out is not None:
+                yield out
+        pending.append(catalog.register(
+            batch, AGGREGATE_INTERMEDIATE_PRIORITY))
+        pending_bytes += size
+    out = flush()
+    if out is not None:
+        yield out

@@ -1,0 +1,290 @@
+"""Tiered spill framework: HBM -> host RAM -> disk.
+
+Counterpart of the reference's RapidsBufferCatalog / RapidsBufferStore chain
+(RapidsBufferCatalog.scala:40, RapidsBufferStore.scala:41, Device/Host/Disk
+stores) and SpillableColumnarBatch (SpillableColumnarBatch.scala:29), with
+one structural difference dictated by the platform: XLA owns HBM and there
+is no RMM-style allocation-failure callback, so spilling is *watermark-
+driven* — the catalog tracks bytes held by spillable batches and proactively
+moves the lowest-priority ones to host (numpy) and then disk (npz files)
+when the budget is exceeded.  The analog of the reference's
+``DeviceMemoryEventHandler.onAllocFailure`` retry loop is
+``ensure_budget()``, which callers invoke before large allocations.
+
+Spill priorities mirror SpillPriorities.scala: shuffle outputs coldest,
+actively-iterated batches hottest.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+
+# storage tiers (RapidsBuffer.scala:53 StorageTier)
+DEVICE = "DEVICE"
+HOST = "HOST"
+DISK = "DISK"
+
+# spill priorities (SpillPriorities.scala:26-61)
+OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = -1000
+AGGREGATE_INTERMEDIATE_PRIORITY = 0
+ACTIVE_ON_DECK_PRIORITY = 1000
+
+
+class SpillableHandle:
+    """One registered batch, resident at exactly one tier."""
+
+    _ids = itertools.count()
+
+    def __init__(self, catalog: "SpillableBatchCatalog",
+                 batch: ColumnarBatch, priority: int):
+        self.id = next(SpillableHandle._ids)
+        self.catalog = catalog
+        self.priority = priority
+        self.tier = DEVICE
+        self.size_bytes = batch.device_size_bytes()
+        self.last_access = 0
+        self._device: Optional[ColumnarBatch] = batch
+        self._host: Optional[dict] = None
+        self._disk_path: Optional[str] = None
+        self._schema = batch.schema
+        self._nrows = batch.nrows
+        self.closed = False
+
+    # -------------------------------------------------------------- movement --
+    def _to_host_payload(self) -> dict:
+        b = self._device
+        payload = {"__nrows": self._nrows}
+        for name, col in b.columns.items():
+            payload[f"{name}.data"] = np.asarray(col.data)
+            if col.validity is not None:
+                payload[f"{name}.validity"] = np.asarray(col.validity)
+            if col.offsets is not None:
+                payload[f"{name}.offsets"] = np.asarray(col.offsets)
+        return payload
+
+    def _rebuild(self, get) -> ColumnarBatch:
+        import jax.numpy as jnp
+        cols = {}
+        for name, dt in self._schema:
+            data = jnp.asarray(get(f"{name}.data"))
+            validity = get(f"{name}.validity")
+            offsets = get(f"{name}.offsets")
+            cols[name] = Column(
+                dt, data, self._nrows,
+                validity=None if validity is None else jnp.asarray(validity),
+                offsets=None if offsets is None else jnp.asarray(offsets))
+        return ColumnarBatch(cols, self._nrows)
+
+    def spill_to_host(self) -> int:
+        assert self.tier == DEVICE
+        self._host = self._to_host_payload()
+        self._device = None
+        self.tier = HOST
+        return self.size_bytes
+
+    def spill_to_disk(self) -> int:
+        assert self.tier == HOST
+        path = os.path.join(self.catalog.spill_dir, f"buf-{self.id}.npz")
+        arrays = {k: v for k, v in self._host.items() if k != "__nrows"}
+        np.savez(path, **arrays)
+        self._disk_path = path
+        self._host = None
+        self.tier = DISK
+        return self.size_bytes
+
+    def materialize(self) -> ColumnarBatch:
+        """Get the batch back on device (unspilling if needed)."""
+        if self.closed:
+            raise ValueError("spillable batch already closed")
+        self.last_access = self.catalog.next_access_stamp()
+        if self.tier == DEVICE:
+            return self._device
+        if self.tier == HOST:
+            payload = self._host
+            batch = self._rebuild(lambda k: payload.get(k))
+        else:
+            with np.load(self._disk_path) as z:
+                batch = self._rebuild(
+                    lambda k, z=z: z[k] if k in z.files else None)
+        self.catalog.unspill(self, batch)
+        return batch
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._device = None
+        self._host = None
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self.catalog.remove(self)
+
+
+class SpillableBatchCatalog:
+    """Singleton-ish registry with watermark-driven tier demotion.
+
+    ``device_budget``: bytes of HBM this engine lets spillable batches pin
+    before demoting the coldest to host; ``host_budget``: same for host RAM
+    before demoting to disk (reference `memory.host.spillStorageSize`).
+    """
+
+    def __init__(self, device_budget: int = 1 << 34,
+                 host_budget: int = 1 << 30,
+                 spill_dir: Optional[str] = None):
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpu-spill-")
+        self._lock = threading.Lock()
+        self._handles: Dict[int, SpillableHandle] = {}
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.spilled_to_host_total = 0
+        self.spilled_to_disk_total = 0
+        self._access_counter = itertools.count(1)
+
+    def next_access_stamp(self) -> int:
+        return next(self._access_counter)
+
+    # ------------------------------------------------------------- interface --
+    def register(self, batch: ColumnarBatch,
+                 priority: int = AGGREGATE_INTERMEDIATE_PRIORITY
+                 ) -> SpillableHandle:
+        h = SpillableHandle(self, batch, priority)
+        with self._lock:
+            self._handles[h.id] = h
+            self.device_bytes += h.size_bytes
+        self.ensure_budget()
+        return h
+
+    def unspill(self, h: SpillableHandle, batch: ColumnarBatch) -> None:
+        """Promote back to DEVICE after materialize (shouldUnspill=true
+        behavior, RapidsBufferCatalog.scala)."""
+        with self._lock:
+            if h.tier == HOST:
+                self.host_bytes -= h.size_bytes
+            elif h.tier == DISK:
+                self.disk_bytes -= h.size_bytes
+                if h._disk_path and os.path.exists(h._disk_path):
+                    os.unlink(h._disk_path)
+                    h._disk_path = None
+            h.tier = DEVICE
+            h._device = batch
+            h._host = None
+            self.device_bytes += h.size_bytes
+        self.ensure_budget()
+
+    def remove(self, h: SpillableHandle) -> None:
+        with self._lock:
+            if h.id not in self._handles:
+                return
+            del self._handles[h.id]
+            if h.tier == DEVICE:
+                self.device_bytes -= h.size_bytes
+            elif h.tier == HOST:
+                self.host_bytes -= h.size_bytes
+            else:
+                self.disk_bytes -= h.size_bytes
+
+    def ensure_budget(self, extra_needed: int = 0) -> None:
+        """Demote coldest handles until budgets hold (the synchronousSpill
+        loop, RapidsBufferStore.scala:146)."""
+        with self._lock:
+            self._spill_tier(DEVICE, self.device_budget - extra_needed)
+            self._spill_tier(HOST, self.host_budget)
+
+    def _spill_tier(self, tier: str, budget: int) -> None:
+        used = self.device_bytes if tier == DEVICE else self.host_bytes
+        if used <= budget:
+            return
+        # coldest first: lowest priority, then least-recently accessed
+        candidates = sorted(
+            (h for h in self._handles.values() if h.tier == tier),
+            key=lambda h: (h.priority, h.last_access, h.id))
+        for h in candidates:
+            if used <= budget:
+                break
+            if tier == DEVICE:
+                freed = h.spill_to_host()
+                self.device_bytes -= freed
+                self.host_bytes += freed
+                self.spilled_to_host_total += freed
+                used -= freed
+            else:
+                freed = h.spill_to_disk()
+                self.host_bytes -= freed
+                self.disk_bytes += freed
+                self.spilled_to_disk_total += freed
+                used -= freed
+        if tier == DEVICE and self.host_bytes > self.host_budget:
+            self._spill_tier(HOST, self.host_budget)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "device_bytes": self.device_bytes,
+            "host_bytes": self.host_bytes,
+            "disk_bytes": self.disk_bytes,
+            "spilled_to_host_total": self.spilled_to_host_total,
+            "spilled_to_disk_total": self.spilled_to_disk_total,
+            "num_handles": len(self._handles),
+        }
+
+
+_default_catalog: Optional[SpillableBatchCatalog] = None
+
+
+def default_catalog() -> SpillableBatchCatalog:
+    global _default_catalog
+    if _default_catalog is None:
+        _default_catalog = SpillableBatchCatalog()
+    return _default_catalog
+
+
+def set_default_catalog(cat: Optional[SpillableBatchCatalog]) -> None:
+    global _default_catalog
+    _default_catalog = cat
+
+
+class TpuSemaphore:
+    """Admission control: bounds tasks concurrently issuing TPU work
+    (GpuSemaphore.scala:28, `spark.rapids.sql.concurrentGpuTasks`)."""
+
+    def __init__(self, permits: int = 1):
+        self._sem = threading.BoundedSemaphore(permits)
+        self._held = threading.local()
+        self.wait_time_ns = 0
+
+    def acquire_if_necessary(self) -> None:
+        if getattr(self._held, "count", 0) == 0:
+            import time
+            t0 = time.perf_counter_ns()
+            self._sem.acquire()
+            self.wait_time_ns += time.perf_counter_ns() - t0
+        self._held.count = getattr(self._held, "count", 0) + 1
+
+    def release_if_held(self) -> None:
+        count = getattr(self._held, "count", 0)
+        if count > 0:
+            self._held.count = count - 1
+            if self._held.count == 0:
+                self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_held()
+        return False
