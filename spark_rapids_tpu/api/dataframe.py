@@ -158,6 +158,11 @@ class DataFrame:
                 for i in range(table.num_columns)]
         return list(zip(*cols)) if cols else []
 
+    @property
+    def write(self):
+        from spark_rapids_tpu.io.writers import DataFrameWriter
+        return DataFrameWriter(self)
+
     def count(self) -> int:
         from spark_rapids_tpu.api import functions as F
         rows = self.agg(F.count().alias("n")).collect()

@@ -1,10 +1,16 @@
-"""File scan execs (parquet / csv / orc).
+"""File scan execs (parquet / orc / csv) with pushdown.
 
-Round-1 shape of the reference's L6 I/O layer (GpuParquetScan.scala,
-GpuOrcScan.scala, GpuBatchScanExec.scala): host-side parse via pyarrow —
-the parquet-mr/footers analog — then device upload of columnar batches.
-Column pruning happens at the pyarrow level; the multi-file COALESCING /
-MULTITHREADED strategies and predicate pushdown land with the full io task.
+Counterpart of the reference's L6 I/O layer (GpuParquetScan.scala 1,900 LoC,
+GpuOrcScan.scala, GpuBatchScanExec.scala, GpuFileSourceScanExec.scala): the
+host side parses footers, prunes row groups by predicate, discovers hive
+partition values, and assembles host buffers (here: pyarrow, the parquet-mr
+analog); the device side receives columnar uploads.  The three multi-file
+strategies live in ``multifile.py``.
+
+Predicate pushdown: supported filter subtrees are translated to pyarrow
+dataset expressions (``to_arrow_filter``) — this subsumes the reference's
+row-group statistics filtering AND applies exact filtering host-side; the
+engine's own TpuFilterExec still runs above for semantics parity.
 """
 
 from __future__ import annotations
@@ -13,47 +19,188 @@ from typing import Iterator, List, Optional
 
 from spark_rapids_tpu.columnar import dtypes as dts
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.exec.base import NUM_INPUT_BATCHES, Schema, TpuExec
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops import stringops as S
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, Expression, Literal, UnresolvedColumn)
 from spark_rapids_tpu.plan.logical import FileRelation
 
 
-def infer_file_schema(paths: List[str], file_format: str) -> Schema:
+def _dataset(paths, file_format):
     import pyarrow.dataset as ds
-    dataset = ds.dataset(paths, format=file_format)
+    fmt = file_format
+    if file_format == "csv":
+        fmt = ds.CsvFileFormat()
+    # a single path may be a directory (hive-partitioned dataset root);
+    # pyarrow only accepts directories as a bare string
+    src = paths[0] if len(paths) == 1 else paths
+    return ds.dataset(src, format=fmt, partitioning="hive")
+
+
+def infer_file_schema(paths: List[str], file_format: str) -> Schema:
+    dataset = _dataset(paths, file_format)
     return [(f.name, dts.from_arrow_type(f.type)) for f in dataset.schema]
+
+
+def to_arrow_filter(expr: Expression):
+    """Translate a supported predicate subtree to a pyarrow expression;
+    returns None when any part is untranslatable (the caller keeps the full
+    engine-side filter either way)."""
+    import pyarrow.dataset as ds
+    import pyarrow.compute as pc
+
+    def field(e):
+        if isinstance(e, BoundReference):
+            return ds.field(e.name)
+        if isinstance(e, UnresolvedColumn):
+            return ds.field(e.col_name)
+        return None
+
+    def lit(e):
+        if isinstance(e, Literal) and not (
+                e.dtype.is_string and e.value is None):
+            return e.value
+        return None
+
+    def rec(e):
+        if isinstance(e, P.And):
+            l, r = rec(e.left), rec(e.right)
+            return l & r if l is not None and r is not None else None
+        if isinstance(e, P.Or):
+            l, r = rec(e.left), rec(e.right)
+            return (l | r) if l is not None and r is not None else None
+        ops = {P.EqualTo: "__eq__", P.LessThan: "__lt__",
+               P.LessThanOrEqual: "__le__", P.GreaterThan: "__gt__",
+               P.GreaterThanOrEqual: "__ge__"}
+        for cls, method in ops.items():
+            if isinstance(e, cls):
+                f, v = field(e.left), lit(e.right)
+                if f is not None and v is not None:
+                    return getattr(f, method)(v)
+                f, v = field(e.right), lit(e.left)
+                if f is not None and v is not None:
+                    flipped = {"__lt__": "__gt__", "__le__": "__ge__",
+                               "__gt__": "__lt__", "__ge__": "__le__",
+                               "__eq__": "__eq__"}[method]
+                    return getattr(f, flipped)(v)
+                return None
+        if isinstance(e, P.IsNull):
+            f = field(e.child)
+            return f.is_null() if f is not None else None
+        if isinstance(e, P.IsNotNull):
+            f = field(e.child)
+            return f.is_valid() if f is not None else None
+        if isinstance(e, P.In):
+            f = field(e.children[0])
+            vals = [lit(o) for o in e.children[1:]]
+            if f is not None and all(v is not None for v in vals):
+                return f.isin(vals)
+            return None
+        return None
+
+    return rec(expr)
 
 
 class TpuFileScanExec(TpuExec):
     def __init__(self, paths: List[str], file_format: str, schema: Schema,
                  batch_rows: int = 1 << 20,
-                 columns: Optional[List[str]] = None):
+                 columns: Optional[List[str]] = None,
+                 arrow_filter=None, reader_type: str = "AUTO",
+                 num_threads: int = 8, max_files_parallel: int = 4):
         super().__init__()
         self.paths = paths
         self.file_format = file_format
-        self._schema = [s for s in schema
-                        if columns is None or s[0] in columns]
+        self._schema = list(schema)
+        # columns actually read; the rest are emitted as null placeholders
+        # (pruning preserves the schema so bound ordinals stay valid)
+        self.columns = [n for n, _ in schema
+                        if columns is None or n in columns]
         self.batch_rows = batch_rows
+        self.arrow_filter = arrow_filter
+        self.reader_type = reader_type
+        self.num_threads = num_threads
+        self.max_files_parallel = max_files_parallel
+        self._register_metric(NUM_INPUT_BATCHES)
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
     def describe(self):
+        extra = ", pushdown" if self.arrow_filter is not None else ""
         return (f"TpuFileScanExec[{self.file_format}, {len(self.paths)} "
-                f"files]")
+                f"files, {self.reader_type}{extra}]")
+
+    def _finish_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Re-add pruned columns as all-null placeholders so the output
+        matches the relation schema position-for-position."""
+        if len(batch.names) == len(self._schema):
+            return batch.select([n for n, _ in self._schema]) \
+                if batch.names != [n for n, _ in self._schema] else batch
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar.column import Column
+        cols = {}
+        cap = batch.capacity
+        for name, dt in self._schema:
+            if name in batch.columns:
+                cols[name] = batch.columns[name]
+            elif dt.is_string:
+                c = Column.from_strings([None] * batch.nrows, capacity=cap)
+                cols[name] = c
+            else:
+                cols[name] = Column(
+                    dt, jnp.zeros(cap, dtype=dt.storage), batch.nrows,
+                    validity=jnp.zeros(cap, dtype=jnp.bool_))
+        return ColumnarBatch(cols, batch.nrows)
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
-        import pyarrow.dataset as ds
-        dataset = ds.dataset(self.paths, format=self.file_format)
-        names = [n for n, _ in self._schema]
-        for record_batch in dataset.to_batches(columns=names,
-                                               batch_size=self.batch_rows):
+        if self.file_format == "csv" or len(self.paths) == 1:
+            yield from self._simple_scan()
+            return
+        from spark_rapids_tpu.io.multifile import iter_file_tables
+        for table in iter_file_tables(
+                self.paths, self.file_format, self.columns,
+                self.arrow_filter, self.reader_type, self.batch_rows,
+                self.num_threads, self.max_files_parallel):
+            self.metrics[NUM_INPUT_BATCHES] += 1
+            for off in range(0, table.num_rows, self.batch_rows):
+                chunk = table.slice(off, self.batch_rows)
+                if chunk.num_rows:
+                    yield self._finish_batch(ColumnarBatch.from_arrow(chunk))
+
+    def _simple_scan(self) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        dataset = _dataset(self.paths, self.file_format)
+        kwargs = {"columns": self.columns, "batch_size": self.batch_rows}
+        if self.arrow_filter is not None:
+            kwargs["filter"] = self.arrow_filter
+        for record_batch in dataset.to_batches(**kwargs):
             if record_batch.num_rows == 0:
                 continue
-            import pyarrow as pa
-            yield ColumnarBatch.from_arrow(
-                pa.Table.from_batches([record_batch]))
+            self.metrics[NUM_INPUT_BATCHES] += 1
+            yield self._finish_batch(ColumnarBatch.from_arrow(
+                pa.Table.from_batches([record_batch])))
 
 
 def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
-    return TpuFileScanExec(node.paths, node.file_format, node.schema)
+    arrow_filter = None
+    for f in node.pushed_filters:
+        af = to_arrow_filter(f)
+        if af is not None:
+            arrow_filter = af if arrow_filter is None else \
+                (arrow_filter & af)
+    fmt_key = node.file_format if node.file_format != "csv" else "parquet"
+    return TpuFileScanExec(
+        node.paths, node.file_format, node.schema,
+        columns=sorted(node.required_columns)
+        if getattr(node, "required_columns", None) else None,
+        arrow_filter=arrow_filter,
+        reader_type=conf[
+            "spark.rapids.sql.format.parquet.reader.type"],
+        num_threads=conf[
+            "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads"],
+        max_files_parallel=conf[
+            "spark.rapids.sql.format.parquet.multiThreadedRead."
+            "maxNumFilesParallel"])

@@ -1,0 +1,153 @@
+"""File writers: parquet / orc / csv with dynamic partitioning.
+
+Counterpart of ``GpuParquetFileFormat`` / ``GpuOrcFileFormat`` /
+``ColumnarOutputWriter`` / ``GpuFileFormatWriter`` (SURVEY.md section 2.4
+"Writers"): batches leave the device once, are encoded host-side via
+pyarrow, with hive-style dynamic partitioning (the reference sorts by
+partition columns then splits; pyarrow's dataset writer does the same
+bucketing) and write-stats tracking (BasicColumnarWriteStatsTracker analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+@dataclasses.dataclass
+class WriteStats:
+    """numFiles/numBytes/numRows (BasicColumnarWriteStatsTracker.scala)."""
+    num_files: int = 0
+    num_bytes: int = 0
+    num_rows: int = 0
+    num_partitions: int = 0
+
+
+def write_batches(batches: Iterator[ColumnarBatch], path: str,
+                  file_format: str, mode: str = "error",
+                  partition_by: Optional[List[str]] = None,
+                  max_rows_per_file: int = 1 << 22) -> WriteStats:
+    import pyarrow as pa
+    import pyarrow.dataset as ds
+
+    exists = os.path.isdir(path) and bool(os.listdir(path)) or \
+        os.path.isfile(path)
+    if exists:
+        if mode == "error":
+            raise FileExistsError(f"path {path} already exists")
+        if mode == "ignore":
+            return WriteStats()
+        if mode == "overwrite":
+            import shutil
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.unlink(path)
+        # mode == "append": fall through, write additional files
+
+    tables = [b.to_arrow() for b in batches]
+    if not tables:
+        os.makedirs(path, exist_ok=True)
+        return WriteStats()
+    table = pa.concat_tables(tables)
+    stats = WriteStats(num_rows=table.num_rows)
+
+    if file_format == "orc":
+        # pyarrow's dataset writer has no ORC support; write files directly
+        # (dynamic partitioning by hive-style directory split)
+        _write_orc(table, path, partition_by, stats)
+        return stats
+
+    fmt = {"parquet": "parquet", "csv": "csv"}[file_format]
+    partitioning = None
+    if partition_by:
+        partitioning = ds.partitioning(
+            pa.schema([table.schema.field(c) for c in partition_by]),
+            flavor="hive")
+    import uuid
+    ext = {"parquet": "parquet", "orc": "orc", "csv": "csv"}[file_format]
+    ds.write_dataset(
+        table, path, format=fmt, partitioning=partitioning,
+        max_rows_per_file=max_rows_per_file,
+        max_rows_per_group=min(1 << 20, max_rows_per_file),
+        basename_template=f"part-{uuid.uuid4().hex[:8]}-{{i}}.{ext}",
+        existing_data_behavior="overwrite_or_ignore")
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            stats.num_files += 1
+            stats.num_bytes += os.path.getsize(os.path.join(root, f))
+    if partition_by:
+        parts = set()
+        for root, dirs, _files in os.walk(path):
+            for d in dirs:
+                if "=" in d:
+                    parts.add(os.path.join(root, d))
+        stats.num_partitions = len(parts)
+    return stats
+
+
+def _write_orc(table, path: str, partition_by, stats: WriteStats) -> None:
+    import uuid
+    import pyarrow.orc as orc
+
+    os.makedirs(path, exist_ok=True)
+    tag = uuid.uuid4().hex[:8]
+    if not partition_by:
+        f = os.path.join(path, f"part-{tag}-0.orc")
+        orc.write_table(table, f)
+        stats.num_files = 1
+        stats.num_bytes = os.path.getsize(f)
+        return
+    # hive-style split: distinct partition tuples -> subdirectories
+    import pyarrow.compute as pc
+    keys = table.select(partition_by).to_pylist()
+    seen = {}
+    for i, k in enumerate(keys):
+        seen.setdefault(tuple(k.values()), []).append(i)
+    drop = [c for c in table.column_names if c not in partition_by]
+    for values, rows in seen.items():
+        sub = os.path.join(path, *[
+            f"{c}={v}" for c, v in zip(partition_by, values)])
+        os.makedirs(sub, exist_ok=True)
+        f = os.path.join(sub, f"part-{tag}-0.orc")
+        orc.write_table(table.take(rows).select(drop), f)
+        stats.num_files += 1
+        stats.num_bytes += os.path.getsize(f)
+    stats.num_partitions = len(seen)
+
+
+class DataFrameWriter:
+    """df.write.mode(...).partitionBy(...).parquet(path) surface."""
+
+    def __init__(self, df):
+        self.df = df
+        self._mode = "error"
+        self._partition_by: Optional[List[str]] = None
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        assert m in ("error", "errorifexists", "overwrite", "append",
+                     "ignore")
+        self._mode = "error" if m == "errorifexists" else m
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def _write(self, path: str, file_format: str) -> WriteStats:
+        exec_plan = self.df.session.plan(self.df.plan)
+        return write_batches(exec_plan.execute(), path, file_format,
+                             mode=self._mode,
+                             partition_by=self._partition_by)
+
+    def parquet(self, path: str) -> WriteStats:
+        return self._write(path, "parquet")
+
+    def orc(self, path: str) -> WriteStats:
+        return self._write(path, "orc")
+
+    def csv(self, path: str) -> WriteStats:
+        return self._write(path, "csv")

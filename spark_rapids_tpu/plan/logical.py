@@ -106,7 +106,10 @@ class FileRelation(LogicalPlan):
         self.file_format = file_format
         self._schema = list(schema)
         self.options = dict(options or {})
+        # set by the planner's pushdown pass (GpuParquetScan predicate
+        # pushdown + column pruning analog)
         self.pushed_filters: List[Expression] = []
+        self.required_columns = None  # None = all
 
     @property
     def schema(self) -> Schema:

@@ -318,6 +318,44 @@ def _conv_window(node: L.Window, children, conf):
     return TpuWindowExec(node.window_exprs, children[0])
 
 
+def _pushdown_pass(plan: L.LogicalPlan) -> None:
+    """Column pruning + predicate pushdown into FileRelations.
+
+    Pruned columns are only those dropped by a Project/Aggregate above, so
+    BoundReference ordinals stay valid (the scan emits null placeholders
+    for unread columns, which by construction nothing references).
+    Filters push down until a Project renames the namespace.
+    """
+
+    def visit(node, required, filters):
+        if isinstance(node, L.FileRelation):
+            if required is not None:
+                node.required_columns = set(required)
+            node.pushed_filters = list(filters)
+            return
+        if isinstance(node, L.Filter):
+            req = None if required is None else \
+                set(required) | set(node.condition.references())
+            visit(node.child, req, filters + [node.condition])
+            return
+        if isinstance(node, L.Project):
+            refs = set()
+            for e in node.exprs:
+                refs.update(e.references())
+            visit(node.child, refs, [])
+            return
+        if isinstance(node, L.Aggregate):
+            refs = set()
+            for e in list(node.group_exprs) + list(node.agg_exprs):
+                refs.update(e.references())
+            visit(node.child, refs, [])
+            return
+        for c in node.children:
+            visit(c, None, [])
+
+    visit(plan, None, [])
+
+
 class TpuOverrides:
     """The planner: logical plan -> TpuExec tree with CPU fallback."""
 
@@ -326,6 +364,7 @@ class TpuOverrides:
         self.last_explain: str = ""
 
     def apply(self, plan: L.LogicalPlan):
+        _pushdown_pass(plan)
         meta = PlanMeta(plan, self.conf)
         meta.tag()
         self.last_explain = "\n".join(meta.explain_lines())
