@@ -11,6 +11,18 @@ from spark_rapids_tpu.ops.expressions import (
 from spark_rapids_tpu.plan import logical as L
 
 
+def _parse_schema(schema):
+    """'a int, b string' or [(name, DataType)] -> Schema."""
+    from spark_rapids_tpu.columnar.dtypes import dtype_from_name
+    if isinstance(schema, str):
+        out = []
+        for part in schema.split(","):
+            name, tname = part.strip().split()
+            out.append((name, dtype_from_name(tname)))
+        return out
+    return list(schema)
+
+
 def _is_window(e: Expression) -> bool:
     from spark_rapids_tpu.exec.window import WindowExpression
     inner = e.children[0] if isinstance(e, Alias) else e
@@ -158,6 +170,10 @@ class DataFrame:
                 for i in range(table.num_columns)]
         return list(zip(*cols)) if cols else []
 
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        return DataFrame(self.session, L.MapInPandas(
+            fn, _parse_schema(schema), self.plan))
+
     @property
     def write(self):
         from spark_rapids_tpu.io.writers import DataFrameWriter
@@ -194,6 +210,11 @@ class GroupedData:
     def count(self) -> DataFrame:
         from spark_rapids_tpu.api import functions as F
         return self.agg(F.count().alias("count"))
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        names = [e.name for e in self.group_exprs]
+        return DataFrame(self.df.session, L.MapInPandas(
+            fn, _parse_schema(schema), self.df.plan, group_names=names))
 
     def _simple(self, fname, *cols) -> DataFrame:
         from spark_rapids_tpu.api import functions as F

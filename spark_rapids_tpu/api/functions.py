@@ -288,6 +288,41 @@ def last(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.Last(_expr(c), ignore_nulls)))
 
 
+# --------------------------------------------------------------------- udfs
+
+def udf(f=None, returnType: str = "string"):
+    """Register a Python UDF.
+
+    The udf-compiler analog: the function's bytecode is compiled to a TPU
+    expression tree when possible; otherwise it runs as a host black box.
+    """
+    from spark_rapids_tpu.columnar.dtypes import dtype_from_name
+
+    def wrap(fn):
+        rt = dtype_from_name(returnType) if isinstance(returnType, str) \
+            else returnType
+
+        def call(*cols) -> Col:
+            from spark_rapids_tpu.udf.compiler import compile_udf
+            from spark_rapids_tpu.udf.python_exec import PythonUDF
+            args = [_expr(c) for c in cols]
+            compiled = compile_udf(fn, args)
+            if compiled is not None:
+                return Col(compiled)
+            return Col(PythonUDF(fn, rt, args))
+
+        call.__name__ = getattr(fn, "__name__", "udf")
+        call.fn = fn
+        return call
+
+    if f is not None:
+        return wrap(f)
+    return wrap
+
+
+pandas_udf = udf
+
+
 # ------------------------------------------------------------------ strings
 
 def length(c) -> Col:

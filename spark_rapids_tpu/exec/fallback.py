@@ -18,6 +18,49 @@ from spark_rapids_tpu.exec.base import Schema, TpuExec
 from spark_rapids_tpu.plan import logical as L
 
 
+def _eval_pandas(expr, df: pd.DataFrame):
+    """Host evaluation of an expression over a pandas frame — the CPU-Spark
+    analog used when a Project/Filter falls back (e.g. uncompilable UDFs)."""
+    from spark_rapids_tpu.ops import arithmetic as A
+    from spark_rapids_tpu.ops import predicates as P
+    from spark_rapids_tpu.ops.expressions import (
+        Alias, BoundReference, Literal, UnresolvedColumn)
+    from spark_rapids_tpu.udf.python_exec import PythonUDF
+
+    e = expr
+    if isinstance(e, Alias):
+        return _eval_pandas(e.child, df)
+    if isinstance(e, BoundReference):
+        return df.iloc[:, e.ordinal]
+    if isinstance(e, UnresolvedColumn):
+        return df[e.col_name]
+    if isinstance(e, Literal):
+        return pd.Series([e.value] * len(df))
+    if isinstance(e, PythonUDF):
+        args = [_eval_pandas(c, df) for c in e.children]
+        out = [None if any(pd.isna(v) for v in row) else e.fn(*row)
+               for row in zip(*[a.tolist() for a in args])] if args else []
+        return pd.Series(out, dtype=object)
+    binops = {A.Add: "__add__", A.Subtract: "__sub__",
+              A.Multiply: "__mul__", A.Divide: "__truediv__",
+              P.LessThan: "__lt__", P.LessThanOrEqual: "__le__",
+              P.GreaterThan: "__gt__", P.GreaterThanOrEqual: "__ge__",
+              P.EqualTo: "__eq__"}
+    for cls, method in binops.items():
+        if type(e) is cls:
+            l = _eval_pandas(e.children[0], df)
+            r = _eval_pandas(e.children[1], df)
+            return getattr(l, method)(r)
+    if isinstance(e, P.And):
+        return _eval_pandas(e.left, df) & _eval_pandas(e.right, df)
+    if isinstance(e, P.Or):
+        return _eval_pandas(e.left, df) | _eval_pandas(e.right, df)
+    if isinstance(e, P.Not):
+        return ~_eval_pandas(e.child, df)
+    raise NotImplementedError(
+        f"CPU fallback cannot evaluate {type(e).__name__}")
+
+
 class CpuFallbackExec(TpuExec):
     def __init__(self, node: L.LogicalPlan, children: List[TpuExec]):
         super().__init__(*children)
@@ -58,6 +101,14 @@ class CpuFallbackExec(TpuExec):
                 raise NotImplementedError(
                     f"CPU fallback join type {node.join_type}")
             out = left.merge(right, left_on=lk, right_on=rk, how=how)
+        elif isinstance(node, L.Project):
+            df = self._child_pandas(0)
+            out = pd.DataFrame({e.name: _eval_pandas(e, df)
+                                for e in node.exprs})
+        elif isinstance(node, L.Filter):
+            df = self._child_pandas(0)
+            mask = _eval_pandas(node.condition, df).fillna(False)
+            out = df[mask.astype(bool)]
         elif isinstance(node, L.Limit):
             out = self._child_pandas(0).head(node.n)
         elif isinstance(node, L.Union):

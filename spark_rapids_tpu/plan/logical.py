@@ -269,6 +269,29 @@ class Union(LogicalPlan):
         return self.children[0].schema
 
 
+class MapInPandas(LogicalPlan):
+    """df.mapInPandas / groupBy().applyInPandas host-function nodes."""
+
+    def __init__(self, fn, out_schema: Schema, child: LogicalPlan,
+                 group_names: Optional[Sequence[str]] = None):
+        self.fn = fn
+        self._schema = list(out_schema)
+        self.group_names = list(group_names) if group_names else None
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        kind = "FlatMapGroupsInPandas" if self.group_names else "MapInPandas"
+        return f"{kind}[{getattr(self.fn, '__name__', 'fn')}]"
+
+
 class Window(LogicalPlan):
     """Append window-function columns (WindowExec analog)."""
 

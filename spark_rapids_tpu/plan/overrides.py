@@ -318,6 +318,16 @@ def _conv_window(node: L.Window, children, conf):
     return TpuWindowExec(node.window_exprs, children[0])
 
 
+@_converter(L.MapInPandas)
+def _conv_map_in_pandas(node: L.MapInPandas, children, conf):
+    from spark_rapids_tpu.udf.python_exec import (
+        TpuFlatMapGroupsInPandasExec, TpuMapInPandasExec)
+    if node.group_names:
+        return TpuFlatMapGroupsInPandasExec(node.fn, node.schema,
+                                            node.group_names, children[0])
+    return TpuMapInPandasExec(node.fn, node.schema, children[0])
+
+
 def _pushdown_pass(plan: L.LogicalPlan) -> None:
     """Column pruning + predicate pushdown into FileRelations.
 

@@ -1,0 +1,310 @@
+"""UDF compiler: CPython bytecode -> expression trees.
+
+Counterpart of the reference's ``udf-compiler`` (SURVEY.md section 2.7:
+LambdaReflection -> CFG -> abstract interpretation of JVM opcodes ->
+Catalyst; ``Instruction.scala:198-928``), retargeted at CPython bytecode:
+``dis`` supplies instructions, a symbolic evaluator executes them over a
+stack/locals of *expression trees*, and conditional jumps fork the
+evaluation — the two arms rejoin as an ``If`` expression (equivalent to the
+reference's CFG condition folding for loop-free lambdas).  On any
+unsupported opcode or call, compilation returns None and the UDF runs as a
+host black box (the reference falls back to the original UDF the same way,
+``Plugin.scala:39-89``).
+
+Supported surface mirrors the reference's opcode tables: arithmetic,
+comparison and boolean logic, conditional expressions, math builtins
+(abs/min/max and ``math.*``), and common ``str`` methods (upper/lower/
+strip/startswith/endswith/...).
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from spark_rapids_tpu.ops import arithmetic as A
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops import stringops as S
+from spark_rapids_tpu.ops.expressions import Expression, Literal
+
+
+class CompileError(Exception):
+    pass
+
+
+_MAX_INSTRUCTIONS = 4000  # path-explosion guard
+
+# dis BINARY_OP argrepr -> builder
+_BINARY_OPS: Dict[str, Callable] = {
+    "+": A.Add, "-": A.Subtract, "*": A.Multiply, "/": A.Divide,
+    "//": A.IntegralDivide, "%": A.Remainder, "**": A.Pow,
+    "&": A.BitwiseAnd, "|": A.BitwiseOr, "^": A.BitwiseXor,
+    "<<": A.ShiftLeft, ">>": A.ShiftRight,
+}
+
+_COMPARE_OPS: Dict[str, Callable] = {
+    "<": P.LessThan, "<=": P.LessThanOrEqual, ">": P.GreaterThan,
+    ">=": P.GreaterThanOrEqual, "==": P.EqualTo,
+}
+
+_MATH_FNS: Dict[str, Callable] = {
+    "sqrt": A.Sqrt, "exp": A.Exp, "log": A.Log, "log2": A.Log2,
+    "log10": A.Log10, "log1p": A.Log1p, "sin": A.Sin, "cos": A.Cos,
+    "tan": A.Tan, "asin": A.Asin, "acos": A.Acos, "atan": A.Atan,
+    "sinh": A.Sinh, "cosh": A.Cosh, "tanh": A.Tanh, "floor": A.Floor,
+    "ceil": A.Ceil, "degrees": A.ToDegrees, "radians": A.ToRadians,
+    "fabs": A.Abs,
+}
+
+
+def _expr_or_lit(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+class _Evaluator:
+    def __init__(self, fn: Callable, args: Sequence[Expression]):
+        self.code = fn.__code__
+        self.fn = fn
+        if self.code.co_argcount != len(args):
+            raise CompileError("arity mismatch")
+        self.instructions = list(dis.get_instructions(fn))
+        self.by_offset = {ins.offset: idx
+                          for idx, ins in enumerate(self.instructions)}
+        self.globals = fn.__globals__
+        self.closure = {}
+        if fn.__closure__:
+            for name, cell in zip(self.code.co_freevars, fn.__closure__):
+                self.closure[name] = cell.cell_contents
+        self.init_locals: Dict[str, Any] = {
+            name: arg for name, arg in zip(self.code.co_varnames, args)}
+        self.budget = _MAX_INSTRUCTIONS
+
+    def run(self) -> Expression:
+        out = self._exec(0, [], dict(self.init_locals))
+        return _expr_or_lit(out)
+
+    # ---- the symbolic interpreter -------------------------------------------
+    def _exec(self, idx: int, stack: List, local_vars: Dict[str, Any]):
+        stack = list(stack)
+        local_vars = dict(local_vars)
+        while True:
+            self.budget -= 1
+            if self.budget <= 0:
+                raise CompileError("instruction budget exceeded (loop?)")
+            if idx >= len(self.instructions):
+                raise CompileError("fell off end of bytecode")
+            ins = self.instructions[idx]
+            op = ins.opname
+
+            if op in ("RESUME", "NOP", "PRECALL", "CACHE", "PUSH_NULL",
+                      "COPY_FREE_VARS", "MAKE_CELL", "NOT_TAKEN"):
+                pass
+            elif op == "LOAD_FAST" or op == "LOAD_FAST_CHECK" or \
+                    op == "LOAD_FAST_BORROW":
+                if ins.argval not in local_vars:
+                    raise CompileError(f"unbound local {ins.argval}")
+                stack.append(local_vars[ins.argval])
+            elif op == "LOAD_FAST_LOAD_FAST" or \
+                    op == "LOAD_FAST_BORROW_LOAD_FAST_BORROW":
+                a, b = ins.argval
+                stack.append(local_vars[a])
+                stack.append(local_vars[b])
+            elif op == "STORE_FAST":
+                local_vars[ins.argval] = stack.pop()
+            elif op == "STORE_FAST_STORE_FAST":
+                a, b = ins.argval
+                local_vars[a] = stack.pop()
+                local_vars[b] = stack.pop()
+            elif op == "LOAD_CONST" or op == "LOAD_SMALL_INT":
+                stack.append(ins.argval)
+            elif op == "LOAD_GLOBAL":
+                name = ins.argval
+                if name in self.globals:
+                    stack.append(self.globals[name])
+                elif name in __builtins__ if isinstance(__builtins__, dict) \
+                        else hasattr(__builtins__, name):
+                    b = __builtins__[name] if isinstance(__builtins__, dict) \
+                        else getattr(__builtins__, name)
+                    stack.append(b)
+                else:
+                    raise CompileError(f"unknown global {name}")
+            elif op == "LOAD_DEREF":
+                if ins.argval not in self.closure:
+                    raise CompileError(f"unknown closure var {ins.argval}")
+                stack.append(self.closure[ins.argval])
+            elif op == "LOAD_ATTR" or op == "LOAD_METHOD":
+                obj = stack.pop()
+                stack.append(_Attr(obj, ins.argval))
+            elif op == "BINARY_OP":
+                r, l = stack.pop(), stack.pop()
+                sym = ins.argrepr.rstrip("=")
+                if sym not in _BINARY_OPS:
+                    raise CompileError(f"binary op {ins.argrepr}")
+                if isinstance(l, Expression) or isinstance(r, Expression):
+                    if sym == "+" and _is_stringy(l, r):
+                        stack.append(S.ConcatStrings(_expr_or_lit(l),
+                                                     _expr_or_lit(r)))
+                    else:
+                        stack.append(_BINARY_OPS[sym](_expr_or_lit(l),
+                                                      _expr_or_lit(r)))
+                else:
+                    stack.append(_const_binop(sym, l, r))
+            elif op == "UNARY_NEGATIVE":
+                v = stack.pop()
+                stack.append(A.UnaryMinus(_expr_or_lit(v))
+                             if isinstance(v, Expression) else -v)
+            elif op == "UNARY_NOT":
+                v = stack.pop()
+                stack.append(P.Not(_expr_or_lit(v))
+                             if isinstance(v, Expression) else (not v))
+            elif op == "TO_BOOL":
+                pass  # operand already usable as a predicate
+            elif op == "COMPARE_OP":
+                r, l = stack.pop(), stack.pop()
+                sym = ins.argrepr.strip().split()[0]
+                if sym == "!=":
+                    e = P.Not(P.EqualTo(_expr_or_lit(l), _expr_or_lit(r)))
+                elif sym in _COMPARE_OPS:
+                    e = _COMPARE_OPS[sym](_expr_or_lit(l), _expr_or_lit(r))
+                else:
+                    raise CompileError(f"compare {ins.argrepr}")
+                stack.append(e)
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                cond = stack.pop()
+                target = self.by_offset[ins.argval]
+                if not isinstance(cond, Expression):
+                    taken = (not cond) if op == "POP_JUMP_IF_FALSE" else \
+                        bool(cond)
+                    idx = target if taken else idx + 1
+                    continue
+                if op == "POP_JUMP_IF_TRUE":
+                    cond = P.Not(cond)
+                t_val = self._exec(idx + 1, stack, local_vars)
+                f_val = self._exec(target, stack, local_vars)
+                return P.If(cond, _expr_or_lit(t_val), _expr_or_lit(f_val))
+            elif op in ("JUMP_FORWARD", "JUMP_ABSOLUTE"):
+                idx = self.by_offset[ins.argval]
+                continue
+            elif op == "JUMP_BACKWARD":
+                raise CompileError("loops are not compilable")
+            elif op == "POP_TOP":
+                stack.pop()
+            elif op == "COPY":
+                stack.append(stack[-ins.arg])
+            elif op == "SWAP":
+                stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+            elif op == "RETURN_VALUE":
+                return stack.pop()
+            elif op == "RETURN_CONST":
+                return ins.argval
+            elif op == "CALL" or op == "CALL_FUNCTION" or \
+                    op == "CALL_METHOD":
+                argc = ins.arg
+                args = [stack.pop() for _ in range(argc)][::-1]
+                callee = stack.pop()
+                # 3.11/3.12 push NULL under callee for non-method calls
+                if stack and stack[-1] is None:
+                    stack.pop()
+                stack.append(self._call(callee, args))
+            elif op == "KW_NAMES":
+                raise CompileError("keyword arguments not supported")
+            else:
+                raise CompileError(f"unsupported opcode {op}")
+            idx += 1
+
+    # ---- known calls ---------------------------------------------------------
+    def _call(self, callee, args):
+        if isinstance(callee, _Attr):
+            return self._method_call(callee.obj, callee.name, args)
+        if callee is abs:
+            return A.Abs(_expr_or_lit(args[0])) \
+                if isinstance(args[0], Expression) else abs(args[0])
+        if callee is min and len(args) == 2:
+            return P.Least(*[_expr_or_lit(a) for a in args])
+        if callee is max and len(args) == 2:
+            return P.Greatest(*[_expr_or_lit(a) for a in args])
+        if callee is len:
+            return S.Length(_expr_or_lit(args[0]))
+        if callee is round:
+            scale = args[1] if len(args) > 1 else 0
+            return A.Round(_expr_or_lit(args[0]), scale)
+        if callee is float:
+            from spark_rapids_tpu.columnar import dtypes as dts
+            from spark_rapids_tpu.ops.cast import Cast
+            return Cast(_expr_or_lit(args[0]), dts.FLOAT64)
+        if callee is int:
+            from spark_rapids_tpu.columnar import dtypes as dts
+            from spark_rapids_tpu.ops.cast import Cast
+            return Cast(_expr_or_lit(args[0]), dts.INT64)
+        raise CompileError(f"call to {callee!r} not compilable")
+
+    def _method_call(self, obj, name, args):
+        if obj is math or (hasattr(obj, "__name__") and
+                           getattr(obj, "__name__", "") == "math"):
+            if name == "pow":
+                return A.Pow(_expr_or_lit(args[0]), _expr_or_lit(args[1]))
+            if name in _MATH_FNS:
+                return _MATH_FNS[name](_expr_or_lit(args[0]))
+            raise CompileError(f"math.{name} not compilable")
+        e = _expr_or_lit(obj) if isinstance(obj, (Expression, str)) else None
+        if e is None:
+            raise CompileError(f"method {name} on {obj!r}")
+        str_methods = {
+            "upper": lambda: S.Upper(e),
+            "lower": lambda: S.Lower(e),
+            "strip": lambda: S.StringTrim(e),
+            "lstrip": lambda: S.StringTrimLeft(e),
+            "rstrip": lambda: S.StringTrimRight(e),
+            "title": lambda: S.InitCap(e),
+        }
+        if name in str_methods and not args:
+            return str_methods[name]()
+        if name == "startswith" and isinstance(args[0], str):
+            return S.StartsWith(e, args[0])
+        if name == "endswith" and isinstance(args[0], str):
+            return S.EndsWith(e, args[0])
+        if name == "__contains__" and isinstance(args[0], str):
+            return S.Contains(e, args[0])
+        raise CompileError(f"str.{name} not compilable")
+
+
+class _Attr:
+    def __init__(self, obj, name):
+        self.obj = obj
+        self.name = name
+
+
+def _is_stringy(l, r) -> bool:
+    for v in (l, r):
+        if isinstance(v, str):
+            return True
+        if isinstance(v, Expression):
+            try:
+                if v.dtype.is_string:
+                    return True
+            except Exception:
+                pass
+    return False
+
+
+def _const_binop(sym, l, r):
+    import operator
+    return {"+": operator.add, "-": operator.sub, "*": operator.mul,
+            "/": operator.truediv, "//": operator.floordiv,
+            "%": operator.mod, "**": operator.pow, "&": operator.and_,
+            "|": operator.or_, "^": operator.xor,
+            "<<": operator.lshift, ">>": operator.rshift}[sym](l, r)
+
+
+def compile_udf(fn: Callable,
+                args: Sequence[Expression]) -> Optional[Expression]:
+    """Compile fn(args) to an expression tree, or None if not compilable."""
+    try:
+        return _Evaluator(fn, list(args)).run()
+    except CompileError:
+        return None
+    except Exception:
+        return None

@@ -1,0 +1,104 @@
+"""Python UDF integration: black-box UDF expression + pandas-UDF execs.
+
+Counterpart of SURVEY.md section 2.7: `GpuUserDefinedFunction`/`RapidsUDF`
+(compiled-else-blackbox dispatch), and the pandas exec family
+(GpuArrowEvalPythonExec / GpuMapInPandasExec / GpuFlatMapGroupsInPandasExec).
+The reference ships batches to external Python workers over Arrow IPC with
+the semaphore released; this engine IS Python, so a "worker" is a host
+function call on the arrow-converted batch — the device is released in the
+same way (no TPU work while the UDF runs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import pandas as pd
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+class PythonUDF(Expression):
+    """Uncompilable UDF: runs on the host (the reference's CPU fallback)."""
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 args: Sequence[Expression], name: str = ""):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(args)
+        self._name = name or getattr(fn, "__name__", "udf")
+
+    def with_children(self, children):
+        return PythonUDF(self.fn, self.return_type, children, self._name)
+
+    def bind(self, schema):
+        return self.with_children([c.bind(schema) for c in self.children])
+
+    @property
+    def dtype(self) -> DataType:
+        return self.return_type
+
+    @property
+    def name(self) -> str:
+        return f"{self._name}(...)"
+
+    def emit(self, ctx):
+        raise RuntimeError("PythonUDF executes on the host, not the TPU")
+
+    def cache_key(self):
+        return ("PythonUDF", id(self.fn),
+                tuple(c.cache_key() for c in self.children))
+
+
+class TpuMapInPandasExec(TpuExec):
+    """df.mapInPandas (GpuMapInPandasExec analog)."""
+
+    def __init__(self, fn: Callable, out_schema: Schema, child: TpuExec):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = list(out_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute():
+            for out in self.fn(iter([batch.to_pandas()])):
+                if len(out):
+                    yield ColumnarBatch.from_pandas(
+                        out[[n for n, _ in self._schema]])
+
+
+class TpuFlatMapGroupsInPandasExec(TpuExec):
+    """groupBy().applyInPandas (GpuFlatMapGroupsInPandasExec analog):
+    groups are split with the engine's own machinery, the user fn runs per
+    group on the host."""
+
+    def __init__(self, fn: Callable, out_schema: Schema,
+                 group_names: List[str], child: TpuExec):
+        super().__init__(child)
+        self.fn = fn
+        self.group_names = group_names
+        self._schema = list(out_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        import pyarrow as pa
+        tables = [b.to_arrow() for b in self.children[0].execute()]
+        if not tables:
+            return
+        df = pa.concat_tables(tables).to_pandas()
+        for _, group in df.groupby(self.group_names, dropna=False,
+                                   sort=False):
+            out = self.fn(group)
+            if len(out):
+                yield ColumnarBatch.from_pandas(
+                    out[[n for n, _ in self._schema]].reset_index(drop=True))
