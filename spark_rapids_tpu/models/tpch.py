@@ -1,0 +1,261 @@
+"""TPC-H: synthetic data generator + query pipelines.
+
+The engine's "model zoo": BASELINE.md configs 1-2 call for TPC-H q6 and the
+22-query suite.  ``gen_tables(sf)`` produces schema-faithful synthetic data
+(uniform approximations of the spec's distributions — enough for perf work
+and CPU-oracle correctness testing; it is not a dbgen replacement), and
+``QUERIES`` maps query names to DataFrame-API pipelines.
+
+Dates are date32 columns; money columns are float64 (the reference snapshot
+has decimals disabled by default too, RapidsConf.scala:564).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, Dict
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.dataframe import DataFrame
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _d(s: str):
+    return np.datetime64(s, "D").astype("datetime64[D]")
+
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+RETURNFLAGS = ["R", "A", "N"]
+LINESTATUS = ["O", "F"]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+           "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+           "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+           "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+           "UNITED KINGDOM", "UNITED STATES"]
+TYPES = [f"{a} {b} {c}" for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE",
+                                  "ECONOMY", "PROMO")
+         for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
+         for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+
+
+def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
+    rng = np.random.default_rng(seed)
+    n_orders = max(int(1_500_000 * sf), 100)
+    n_line = max(int(6_000_000 * sf), 400)
+    n_cust = max(int(150_000 * sf), 50)
+    n_part = max(int(200_000 * sf), 40)
+    n_supp = max(int(10_000 * sf), 10)
+
+    base = _d("1992-01-01")
+    order_dates = base + rng.integers(0, 2405, n_orders)
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_orders),
+        "o_orderstatus": rng.choice(["O", "F", "P"], n_orders),
+        "o_totalprice": rng.uniform(800, 500000, n_orders).round(2),
+        "o_orderdate": order_dates.astype("datetime64[D]"),
+        "o_orderpriority": rng.choice(PRIORITIES, n_orders),
+        "o_shippriority": np.zeros(n_orders, dtype=np.int32),
+    })
+
+    okeys = rng.integers(1, n_orders + 1, n_line)
+    ship_delay = rng.integers(1, 122, n_line)
+    odate_for_line = np.asarray(order_dates)[okeys - 1]
+    shipdate = odate_for_line + ship_delay
+    lineitem = pd.DataFrame({
+        "l_orderkey": okeys.astype(np.int64),
+        "l_partkey": rng.integers(1, n_part + 1, n_line),
+        "l_suppkey": rng.integers(1, n_supp + 1, n_line),
+        "l_linenumber": rng.integers(1, 8, n_line).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, n_line).astype(np.float64),
+        "l_extendedprice": rng.uniform(900, 105000, n_line).round(2),
+        "l_discount": (rng.integers(0, 11, n_line) / 100.0),
+        "l_tax": (rng.integers(0, 9, n_line) / 100.0),
+        "l_returnflag": rng.choice(RETURNFLAGS, n_line),
+        "l_linestatus": rng.choice(LINESTATUS, n_line),
+        "l_shipdate": shipdate.astype("datetime64[D]"),
+        "l_commitdate": (odate_for_line +
+                         rng.integers(30, 92, n_line)).astype(
+                             "datetime64[D]"),
+        "l_receiptdate": (shipdate +
+                          rng.integers(1, 31, n_line)).astype(
+                              "datetime64[D]"),
+        "l_shipinstruct": rng.choice(
+            ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+             "TAKE BACK RETURN"], n_line),
+        "l_shipmode": rng.choice(SHIPMODES, n_line),
+    })
+
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+        "c_acctbal": rng.uniform(-999, 9999, n_cust).round(2),
+        "c_mktsegment": rng.choice(SEGMENTS, n_cust),
+    })
+
+    part = pd.DataFrame({
+        "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+        "p_name": [f"part {i}" for i in range(1, n_part + 1)],
+        "p_brand": [f"Brand#{rng.integers(1, 6)}{rng.integers(1, 6)}"
+                    for _ in range(n_part)],
+        "p_type": rng.choice(TYPES, n_part),
+        "p_size": rng.integers(1, 51, n_part).astype(np.int32),
+        "p_container": rng.choice(
+            ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE",
+             "LG BOX", "JUMBO PKG", "WRAP PACK"], n_part),
+        "p_retailprice": rng.uniform(900, 2000, n_part).round(2),
+    })
+
+    supplier = pd.DataFrame({
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
+        "s_acctbal": rng.uniform(-999, 9999, n_supp).round(2),
+    })
+
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": np.arange(25, dtype=np.int64) % 5,
+    })
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS,
+    })
+    return {"lineitem": lineitem, "orders": orders, "customer": customer,
+            "part": part, "supplier": supplier, "nation": nation,
+            "region": region}
+
+
+def load(session: TpuSession, tables: Dict[str, pd.DataFrame]
+         ) -> Dict[str, DataFrame]:
+    return {name: session.create_dataframe(df)
+            for name, df in tables.items()}
+
+
+# ------------------------------------------------------------------- queries
+
+def q1(t: Dict[str, DataFrame]) -> DataFrame:
+    """Pricing summary report."""
+    l = t["lineitem"]
+    disc_price = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    charge = disc_price * (1 + F.col("l_tax"))
+    return (l.filter(F.col("l_shipdate") <=
+                     F.lit(datetime.date(1998, 9, 2)))
+            .groupBy("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count().alias("count_order"))
+            .orderBy("l_returnflag", "l_linestatus"))
+
+
+def q3(t: Dict[str, DataFrame]) -> DataFrame:
+    """Shipping priority."""
+    cutoff = datetime.date(1995, 3, 15)
+    c = t["customer"].filter(F.col("c_mktsegment") == F.lit("BUILDING"))
+    o = t["orders"].filter(F.col("o_orderdate") < F.lit(cutoff))
+    l = t["lineitem"].filter(F.col("l_shipdate") > F.lit(cutoff))
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    joined = c.select("c_custkey") \
+        .withColumnRenamed("c_custkey", "o_custkey") \
+        .join(o, on="o_custkey", how="inner")
+    joined = joined.withColumnRenamed("o_orderkey", "l_orderkey") \
+        .join(l, on="l_orderkey", how="inner")
+    return (joined.groupBy("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(F.sum(rev).alias("revenue"))
+            .orderBy(F.col("revenue").desc(), "o_orderdate")
+            .limit(10))
+
+
+def q5(t: Dict[str, DataFrame]) -> DataFrame:
+    """Local supplier volume: ASIA, 1994."""
+    o = t["orders"].filter(
+        (F.col("o_orderdate") >= F.lit(datetime.date(1994, 1, 1))) &
+        (F.col("o_orderdate") < F.lit(datetime.date(1995, 1, 1))))
+    r = t["region"].filter(F.col("r_name") == F.lit("ASIA"))
+    n = t["nation"].withColumnRenamed("n_regionkey", "r_regionkey") \
+        .join(r, on="r_regionkey", how="inner")
+    s = t["supplier"].withColumnRenamed("s_nationkey", "n_nationkey") \
+        .join(n.select("n_nationkey", "n_name"), on="n_nationkey")
+    c = t["customer"].withColumnRenamed("c_nationkey", "n_nationkey")
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    l = t["lineitem"].withColumnRenamed("l_suppkey", "s_suppkey")
+    oc = o.withColumnRenamed("o_custkey", "c_custkey") \
+        .join(c.select("c_custkey", "n_nationkey"), on="c_custkey")
+    lo = l.withColumnRenamed("l_orderkey", "o_orderkey") \
+        .join(oc.select("o_orderkey", "n_nationkey"), on="o_orderkey")
+    # supplier nation must equal customer nation
+    ls = lo.join(s.select("s_suppkey", "n_nationkey", "n_name")
+                 .withColumnRenamed("n_nationkey", "s_nation")
+                 .withColumnRenamed("n_name", "n_name"),
+                 on="s_suppkey")
+    same = ls.filter(F.col("n_nationkey") == F.col("s_nation"))
+    return (same.groupBy("n_name").agg(F.sum(rev).alias("revenue"))
+            .orderBy(F.col("revenue").desc()))
+
+
+def q6(t: Dict[str, DataFrame]) -> DataFrame:
+    """Forecasting revenue change (the benchmark slice)."""
+    l = t["lineitem"]
+    return (l.filter(
+        (F.col("l_shipdate") >= F.lit(datetime.date(1994, 1, 1))) &
+        (F.col("l_shipdate") < F.lit(datetime.date(1995, 1, 1))) &
+        (F.col("l_discount") >= 0.05) & (F.col("l_discount") <= 0.07) &
+        (F.col("l_quantity") < 24.0))
+        .select((F.col("l_extendedprice") * F.col("l_discount"))
+                .alias("rev"))
+        .agg(F.sum("rev").alias("revenue")))
+
+
+def q12(t: Dict[str, DataFrame]) -> DataFrame:
+    """Shipping modes and order priority."""
+    l = t["lineitem"].filter(
+        (F.col("l_shipmode").isin("MAIL", "SHIP")) &
+        (F.col("l_commitdate") < F.col("l_receiptdate")) &
+        (F.col("l_shipdate") < F.col("l_commitdate")) &
+        (F.col("l_receiptdate") >= F.lit(datetime.date(1994, 1, 1))) &
+        (F.col("l_receiptdate") < F.lit(datetime.date(1995, 1, 1))))
+    o = t["orders"]
+    j = l.withColumnRenamed("l_orderkey", "o_orderkey") \
+        .join(o.select("o_orderkey", "o_orderpriority"), on="o_orderkey")
+    high = F.when((F.col("o_orderpriority") == F.lit("1-URGENT")) |
+                  (F.col("o_orderpriority") == F.lit("2-HIGH")), 1) \
+        .otherwise(0)
+    low = F.when((F.col("o_orderpriority") != F.lit("1-URGENT")) &
+                 (F.col("o_orderpriority") != F.lit("2-HIGH")), 1) \
+        .otherwise(0)
+    return (j.groupBy("l_shipmode")
+            .agg(F.sum(high).alias("high_line_count"),
+                 F.sum(low).alias("low_line_count"))
+            .orderBy("l_shipmode"))
+
+
+def q14(t: Dict[str, DataFrame]) -> DataFrame:
+    """Promotion effect."""
+    l = t["lineitem"].filter(
+        (F.col("l_shipdate") >= F.lit(datetime.date(1995, 9, 1))) &
+        (F.col("l_shipdate") < F.lit(datetime.date(1995, 10, 1))))
+    p = t["part"]
+    j = l.withColumnRenamed("l_partkey", "p_partkey") \
+        .join(p.select("p_partkey", "p_type"), on="p_partkey")
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    promo = F.when(F.col("p_type").like("PROMO%"), rev).otherwise(0.0)
+    return j.agg((F.sum(promo) * 100.0).alias("promo_sum"),
+                 F.sum(rev).alias("total_sum"))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1": q1, "q3": q3, "q5": q5, "q6": q6, "q12": q12, "q14": q14,
+}

@@ -411,7 +411,10 @@ def promote_types(a: DataType, b: DataType) -> DataType:
 
 
 def cast_value(v: ColVal, target: DataType) -> ColVal:
-    """Numeric-only in-trace cast used for implicit promotions."""
+    """In-trace cast used for implicit promotions (full semantics — a
+    date->timestamp promotion must convert days to micros, not reinterpret
+    storage)."""
     if v.dtype.name == target.name:
         return v
-    return ColVal(target, v.values.astype(target.storage), v.validity)
+    from spark_rapids_tpu.ops.cast import cast_colval
+    return cast_colval(v, target, None)

@@ -35,6 +35,16 @@ class _Comparison(BinaryExpression):
 
 
 class EqualTo(_Comparison):
+    def emit(self, ctx: EmitContext) -> ColVal:
+        if self.left.dtype.is_string and self.right.dtype.is_string:
+            from spark_rapids_tpu.ops import stringops
+            l = self.left.emit(ctx)
+            r = self.right.emit(ctx)
+            eq = stringops.string_equal(l, r, ctx)
+            return ColVal(dts.BOOL, eq,
+                          combine_validity(l.validity, r.validity))
+        return super().emit(ctx)
+
     def eval_values(self, l, r):
         eq = l == r
         if _is_float(l):
@@ -344,7 +354,11 @@ class In(Expression):
         has_null_option = jnp.zeros((), dtype=jnp.bool_)
         for opt in self.children[1:]:
             o = opt.emit(ctx)
-            eq = v.values == o.values.astype(v.values.dtype)
+            if self.children[0].dtype.is_string:
+                from spark_rapids_tpu.ops import stringops
+                eq = stringops.string_equal(v, o, ctx)
+            else:
+                eq = v.values == o.values.astype(v.values.dtype)
             if o.validity is not None:
                 eq = jnp.logical_and(eq, o.validity)
                 has_null_option = jnp.logical_or(

@@ -700,6 +700,42 @@ def _next_pow2(n: int) -> int:
     return cap
 
 
+def string_equal(l: ColVal, r: ColVal, ctx: EmitContext):
+    """Per-row equality of two string ColVals (either may be a scalar
+    literal: offsets of length 2).  Returns a bool values array."""
+    l_scalar = l.offsets.shape[0] == 2 and ctx.capacity != 1
+    r_scalar = r.offsets.shape[0] == 2 and ctx.capacity != 1
+    if l_scalar and not r_scalar:
+        return string_equal(r, l, ctx)
+    if r_scalar:
+        lens_l = row_lengths(l)
+        rlen = r.offsets[1]
+        ok = lens_l == rlen
+        ccap = l.values.shape[0]
+        rcap = int(r.values.shape[0])
+        # compare byte-by-byte over the literal's (small) length
+        for i in range(rcap):
+            idx = jnp.clip(l.offsets[:-1] + i, 0, ccap - 1)
+            ok = jnp.logical_and(
+                ok, jnp.logical_or(i >= rlen, l.values[idx] == r.values[i]))
+        return ok
+    # column vs column
+    lens_l = row_lengths(l)
+    lens_r = row_lengths(r)
+    same_len = lens_l == lens_r
+    ccap = l.values.shape[0]
+    pos = jnp.arange(ccap, dtype=jnp.int32)
+    row = byte_to_row(l, ctx.capacity)
+    k = pos - l.offsets[row]
+    r_idx = jnp.clip(r.offsets[row] + k, 0, r.values.shape[0] - 1)
+    byte_ok = l.values == r.values[r_idx]
+    total = l.offsets[ctx.capacity]
+    byte_bad = jnp.logical_and(jnp.logical_not(byte_ok), pos < total)
+    any_bad = jax.ops.segment_max(byte_bad.astype(jnp.int32), row,
+                                  num_segments=ctx.capacity) > 0
+    return jnp.logical_and(same_len, jnp.logical_not(any_bad))
+
+
 # -------------------------------------------------------------------- casts
 
 def cast_string(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:

@@ -138,6 +138,14 @@ class ExprMeta(BaseMeta):
 
     def tag(self) -> None:
         expr = self.wrapped
+        if isinstance(expr, (preds.LessThan, preds.LessThanOrEqual,
+                             preds.GreaterThan, preds.GreaterThanOrEqual)):
+            try:
+                if any(c.dtype.is_string for c in expr.children):
+                    self.will_not_work(
+                        "string ordering comparisons not yet supported")
+            except (RuntimeError, TypeError):
+                pass
         if isinstance(expr, S.Like) and not expr.supported:
             self.will_not_work(
                 f"LIKE pattern {expr.pattern!r} too general for TPU")
@@ -267,18 +275,63 @@ def _conv_filter(node: L.Filter, children, conf):
     return TpuFilterExec(node.condition, children[0])
 
 
-@_converter(L.Aggregate)
-def _conv_aggregate(node: L.Aggregate, children, conf):
+def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
+                    pre_filter=None):
+    """Build the aggregate exec, plus a result projection when outputs
+    combine aggregates in larger expressions (sum(x)*100, sum(a)/sum(b)...
+    — Catalyst's resultExpressions split)."""
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
-    agg_pairs = []
-    for e in node.agg_exprs:
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+
+    nkeys = len(group_exprs)
+    agg_list: List[AggregateExpression] = []
+
+    def extract(e):
+        if isinstance(e, AggregateExpression):
+            idx = len(agg_list)
+            agg_list.append(e)
+            return BoundReference(nkeys + idx, e.dtype, name=f"_a{idx}",
+                                  nullable=e.nullable)
+        if not e.children:
+            return e
+        return e.with_children([extract(c) for c in e.children])
+
+    out_named = []
+    trivial = True
+    for e in agg_out_exprs:
         name = e.name
         inner = e.children[0] if isinstance(e, Alias) else e
-        if not isinstance(inner, AggregateExpression):
-            raise ValueError(f"aggregate output {name} is not an aggregate "
-                             "expression")
-        agg_pairs.append((name, inner))
-    return TpuHashAggregateExec(node.group_exprs, agg_pairs, children[0])
+        rewritten = extract(inner)
+        if not (isinstance(rewritten, BoundReference) and
+                rewritten.ordinal == nkeys + len(agg_list) - 1 and
+                isinstance(inner, AggregateExpression)):
+            trivial = False
+        out_named.append((name, rewritten))
+
+    agg_exec = TpuHashAggregateExec(
+        group_exprs, [(f"_a{i}", a) for i, a in enumerate(agg_list)],
+        child_exec, pre_filter=pre_filter)
+    if trivial:
+        # rename agg outputs to the requested names via schema positions
+        exprs = [BoundReference(i, dt, name=n) for i, (n, dt) in
+                 enumerate(agg_exec.schema)]
+        final = []
+        for i, (n, dt) in enumerate(agg_exec.schema):
+            want = agg_out_exprs[i - nkeys].name if i >= nkeys else n
+            final.append(Alias(BoundReference(i, dt, name=n), want)
+                         if want != n else exprs[i])
+        if all(not isinstance(e, Alias) for e in final):
+            return agg_exec
+        return TpuProjectExec(final, agg_exec)
+    proj = [BoundReference(i, dt, name=n)
+            for i, (n, dt) in enumerate(agg_exec.schema[:nkeys])]
+    proj += [Alias(rewritten, name) for name, rewritten in out_named]
+    return TpuProjectExec(proj, agg_exec)
+
+
+@_converter(L.Aggregate)
+def _conv_aggregate(node: L.Aggregate, children, conf):
+    return _plan_aggregate(node.group_exprs, node.agg_exprs, children[0])
 
 
 @_converter(L.Limit)
@@ -453,11 +506,5 @@ class TpuOverrides:
                 return None
         if any(e.dtype.is_string for e in group):
             return None  # string keys take the host dict-encode path
-        agg_pairs = []
-        for e in aggs:
-            inner_e = e.children[0] if isinstance(e, Alias) else e
-            if not isinstance(inner_e, AggregateExpression):
-                return None
-            agg_pairs.append((e.name, inner_e))
         base = self._convert(child_meta)
-        return TpuHashAggregateExec(group, agg_pairs, base, pre_filter=cond)
+        return _plan_aggregate(group, aggs, base, pre_filter=cond)

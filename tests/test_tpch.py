@@ -1,0 +1,129 @@
+"""TPC-H query suite vs pandas oracle (the Mortgage/qa_nightly analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.models import tpch
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.gen_tables(sf=0.002)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def t(session, data):
+    return tpch.load(session, data)
+
+
+def test_q1(data, t):
+    got = tpch.q1(t).to_pandas()
+    l = data["lineitem"]
+    m = l[l.l_shipdate <= pd.Timestamp("1998-09-02")]
+    disc = m.l_extendedprice * (1 - m.l_discount)
+    charge = disc * (1 + m.l_tax)
+    want = m.assign(disc_price=disc, charge=charge).groupby(
+        ["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"),
+    ).sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert got["l_returnflag"].tolist() == want["l_returnflag"].tolist()
+    for c in ("sum_qty", "sum_disc_price", "avg_disc"):
+        np.testing.assert_allclose(got[c], want[c], rtol=1e-9)
+    assert got["count_order"].tolist() == want["count_order"].tolist()
+
+
+def test_q3(data, t):
+    got = tpch.q3(t).to_pandas()
+    c = data["customer"]
+    o = data["orders"]
+    l = data["lineitem"]
+    cutoff = pd.Timestamp("1995-03-15")
+    cc = c[c.c_mktsegment == "BUILDING"]
+    oo = o[o.o_orderdate < cutoff]
+    ll = l[l.l_shipdate > cutoff]
+    j = cc.merge(oo, left_on="c_custkey", right_on="o_custkey") \
+        .merge(ll, left_on="o_orderkey", right_on="l_orderkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                     as_index=False)["revenue"].sum() \
+        .sort_values(["revenue", "o_orderdate"],
+                     ascending=[False, True]).head(10)
+    np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-9)
+    assert got["l_orderkey"].tolist() == want["l_orderkey"].tolist()
+
+
+def test_q5(data, t):
+    got = tpch.q5(t).to_pandas()
+    n, r = data["nation"], data["region"]
+    s, c = data["supplier"], data["customer"]
+    o, l = data["orders"], data["lineitem"]
+    nr = n.merge(r[r.r_name == "ASIA"], left_on="n_regionkey",
+                 right_on="r_regionkey")
+    j = l.merge(o[(o.o_orderdate >= pd.Timestamp("1994-01-01")) &
+                  (o.o_orderdate < pd.Timestamp("1995-01-01"))],
+                left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(c, left_on="o_custkey", right_on="c_custkey") \
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey") \
+        .merge(nr, left_on="s_nationkey", right_on="n_nationkey")
+    j = j[j.c_nationkey == j.s_nationkey]
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = j.groupby("n_name", as_index=False)["revenue"].sum() \
+        .sort_values("revenue", ascending=False)
+    assert got["n_name"].tolist() == want["n_name"].tolist()
+    np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=1e-9)
+
+
+def test_q6(data, t):
+    got = tpch.q6(t).collect()[0][0]
+    l = data["lineitem"]
+    m = l[(l.l_shipdate >= pd.Timestamp("1994-01-01")) &
+          (l.l_shipdate < pd.Timestamp("1995-01-01")) &
+          (l.l_discount >= 0.05) & (l.l_discount <= 0.07) &
+          (l.l_quantity < 24)]
+    want = (m.l_extendedprice * m.l_discount).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_q12(data, t):
+    got = tpch.q12(t).to_pandas()
+    l, o = data["lineitem"], data["orders"]
+    m = l[(l.l_shipmode.isin(["MAIL", "SHIP"])) &
+          (l.l_commitdate < l.l_receiptdate) &
+          (l.l_shipdate < l.l_commitdate) &
+          (l.l_receiptdate >= pd.Timestamp("1994-01-01")) &
+          (l.l_receiptdate < pd.Timestamp("1995-01-01"))]
+    j = m.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    j["high"] = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    j["low"] = 1 - j["high"]
+    want = j.groupby("l_shipmode", as_index=False).agg(
+        high_line_count=("high", "sum"), low_line_count=("low", "sum")) \
+        .sort_values("l_shipmode")
+    assert got["l_shipmode"].tolist() == want["l_shipmode"].tolist()
+    assert got["high_line_count"].tolist() == \
+        want["high_line_count"].tolist()
+
+
+def test_q14(data, t):
+    got = tpch.q14(t).collect()[0]
+    l, p = data["lineitem"], data["part"]
+    m = l[(l.l_shipdate >= pd.Timestamp("1995-09-01")) &
+          (l.l_shipdate < pd.Timestamp("1995-10-01"))]
+    j = m.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
+    np.testing.assert_allclose(got[0], promo.sum() * 100, rtol=1e-9)
+    np.testing.assert_allclose(got[1], rev.sum(), rtol=1e-9)
