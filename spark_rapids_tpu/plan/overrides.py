@@ -302,27 +302,19 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
         name = e.name
         inner = e.children[0] if isinstance(e, Alias) else e
         rewritten = extract(inner)
-        if not (isinstance(rewritten, BoundReference) and
-                rewritten.ordinal == nkeys + len(agg_list) - 1 and
-                isinstance(inner, AggregateExpression)):
+        if not isinstance(inner, AggregateExpression):
             trivial = False
         out_named.append((name, rewritten))
 
+    if trivial:
+        # every output is a bare aggregate: name the agg columns directly
+        return TpuHashAggregateExec(
+            group_exprs,
+            [(name, a) for (name, _), a in zip(out_named, agg_list)],
+            child_exec, pre_filter=pre_filter)
     agg_exec = TpuHashAggregateExec(
         group_exprs, [(f"_a{i}", a) for i, a in enumerate(agg_list)],
         child_exec, pre_filter=pre_filter)
-    if trivial:
-        # rename agg outputs to the requested names via schema positions
-        exprs = [BoundReference(i, dt, name=n) for i, (n, dt) in
-                 enumerate(agg_exec.schema)]
-        final = []
-        for i, (n, dt) in enumerate(agg_exec.schema):
-            want = agg_out_exprs[i - nkeys].name if i >= nkeys else n
-            final.append(Alias(BoundReference(i, dt, name=n), want)
-                         if want != n else exprs[i])
-        if all(not isinstance(e, Alias) for e in final):
-            return agg_exec
-        return TpuProjectExec(final, agg_exec)
     proj = [BoundReference(i, dt, name=n)
             for i, (n, dt) in enumerate(agg_exec.schema[:nkeys])]
     proj += [Alias(rewritten, name) for name, rewritten in out_named]
