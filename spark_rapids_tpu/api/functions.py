@@ -260,7 +260,9 @@ def sum(c) -> Col:  # noqa: A001
 
 
 def count(c="*") -> Col:
-    if c == "*" or (isinstance(c, Col) and isinstance(c.expr, Literal)):
+    # NB: don't write `c == "*"` — Col.__eq__ builds an expression
+    if (isinstance(c, str) and c == "*") or \
+            (isinstance(c, Col) and isinstance(c.expr, Literal)):
         return Col(AggregateExpression(agg.Count(None)))
     return _agg(agg.Count, c)
 
@@ -585,7 +587,8 @@ def window_sum(c) -> _WindowFunc:
 
 
 def window_count(c="*") -> _WindowFunc:
-    return _WindowFunc("count", None if c == "*" else c)
+    return _WindowFunc(
+        "count", None if isinstance(c, str) and c == "*" else c)
 
 
 def window_min(c) -> _WindowFunc:

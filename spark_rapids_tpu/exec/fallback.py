@@ -57,6 +57,13 @@ def _eval_pandas(expr, df: pd.DataFrame):
         return _eval_pandas(e.left, df) | _eval_pandas(e.right, df)
     if isinstance(e, P.Not):
         return ~_eval_pandas(e.child, df)
+    from spark_rapids_tpu.ops import stringops as S
+    if isinstance(e, S.Like):
+        import re
+        rx = "(?s)" + "".join(".*" if ch == "%" else "." if ch == "_"
+                              else re.escape(ch) for ch in e.pattern)
+        child = _eval_pandas(e.child, df)
+        return child.str.match(rx + r"\Z", na=False)
     raise NotImplementedError(
         f"CPU fallback cannot evaluate {type(e).__name__}")
 

@@ -55,7 +55,11 @@ class TpuSortExec(TpuExec):
 
     def _sort_batch(self, key_cols: List[ColVal], payload: List[ColVal],
                     nrows):
-        capacity = payload[0].values.shape[0]
+        # row capacity: a string column's .values is its byte buffer, so
+        # derive from offsets (len+1) when present
+        first = payload[0]
+        capacity = (first.offsets.shape[0] - 1 if first.offsets is not None
+                    else first.values.shape[0])
         live = jnp.arange(capacity, dtype=jnp.int32) < nrows
         perm = agg.sort_permutation(
             key_cols, live, capacity,

@@ -42,6 +42,26 @@ TYPES = [f"{a} {b} {c}" for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE",
                                   "ECONOMY", "PROMO")
          for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
          for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+          "black", "blanched", "blue", "blush", "brown", "burlywood",
+          "burnished", "chartreuse", "chiffon", "chocolate", "coral",
+          "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+          "dim", "dodger", "drab", "firebrick", "floral", "forest",
+          "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey",
+          "honeydew", "hot", "hotpink", "indian", "ivory", "khaki",
+          "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+          "magenta", "maroon", "medium", "metallic", "midnight", "mint",
+          "misty", "moccasin", "navajo", "navy", "olive", "orange",
+          "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+          "powder", "puff", "purple", "red", "rose", "rosy", "royal",
+          "saddle", "salmon", "sandy", "seashell", "sienna", "sky",
+          "slate", "smoke", "snow", "spring", "steel", "tan", "thistle",
+          "tomato", "turquoise", "violet", "wheat", "white", "yellow"]
+COMMENT_WORDS = ["carefully", "quickly", "furiously", "slyly", "blithely",
+                 "pending", "final", "express", "regular", "ironic",
+                 "deposits", "packages", "accounts", "theodolites",
+                 "instructions", "foxes", "pinto", "beans", "requests",
+                 "special", "even", "bold", "unusual", "silent"]
 
 
 def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
@@ -52,16 +72,31 @@ def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
     n_part = max(int(200_000 * sf), 40)
     n_supp = max(int(10_000 * sf), 10)
 
+    def comments(n, special_frac=0.05):
+        w = rng.choice(COMMENT_WORDS, (n, 4))
+        out = np.array([" ".join(r) for r in w], dtype=object)
+        k = max(int(n * special_frac), 1)
+        idx = rng.choice(n, k, replace=False)
+        out[idx] = np.array(
+            [f"{a} special {b} requests {c}"
+             for a, b, c in rng.choice(COMMENT_WORDS, (k, 3))],
+            dtype=object)
+        return out
+
     base = _d("1992-01-01")
     order_dates = base + rng.integers(0, 2405, n_orders)
+    # spec: customers with custkey % 3 == 0 place no orders (drives q13/q22)
+    with_orders = np.arange(1, n_cust + 1, dtype=np.int64)
+    with_orders = with_orders[with_orders % 3 != 0]
     orders = pd.DataFrame({
         "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
-        "o_custkey": rng.integers(1, n_cust + 1, n_orders),
+        "o_custkey": rng.choice(with_orders, n_orders),
         "o_orderstatus": rng.choice(["O", "F", "P"], n_orders),
         "o_totalprice": rng.uniform(800, 500000, n_orders).round(2),
         "o_orderdate": order_dates.astype("datetime64[D]"),
         "o_orderpriority": rng.choice(PRIORITIES, n_orders),
         "o_shippriority": np.zeros(n_orders, dtype=np.int32),
+        "o_comment": comments(n_orders),
     })
 
     okeys = rng.integers(1, n_orders + 1, n_line)
@@ -92,17 +127,25 @@ def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
         "l_shipmode": rng.choice(SHIPMODES, n_line),
     })
 
+    cnation = rng.integers(0, 25, n_cust).astype(np.int64)
     customer = pd.DataFrame({
         "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
         "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
-        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+        "c_nationkey": cnation,
+        "c_phone": [f"{nk + 10}-{rng.integers(100, 999)}-"
+                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+                    for nk in cnation],
         "c_acctbal": rng.uniform(-999, 9999, n_cust).round(2),
         "c_mktsegment": rng.choice(SEGMENTS, n_cust),
+        "c_comment": comments(n_cust),
     })
 
+    name_words = rng.choice(COLORS, (n_part, 5))
     part = pd.DataFrame({
         "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
-        "p_name": [f"part {i}" for i in range(1, n_part + 1)],
+        "p_name": [" ".join(r) for r in name_words],
+        "p_mfgr": [f"Manufacturer#{rng.integers(1, 6)}"
+                   for _ in range(n_part)],
         "p_brand": [f"Brand#{rng.integers(1, 6)}{rng.integers(1, 6)}"
                     for _ in range(n_part)],
         "p_type": rng.choice(TYPES, n_part),
@@ -113,11 +156,36 @@ def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
         "p_retailprice": rng.uniform(900, 2000, n_part).round(2),
     })
 
+    scomment = comments(n_supp)
+    k = max(n_supp // 20, 1)
+    idx = rng.choice(n_supp, k, replace=False)
+    scomment[idx] = np.array(
+        [f"{a} Customer {b} Complaints {c}"
+         for a, b, c in rng.choice(COMMENT_WORDS, (k, 3))], dtype=object)
     supplier = pd.DataFrame({
         "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
         "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+        "s_address": [f"addr {i}" for i in range(1, n_supp + 1)],
         "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
+        "s_phone": [f"{rng.integers(10, 35)}-{rng.integers(100, 999)}-"
+                    f"{rng.integers(100, 999)}-{rng.integers(1000, 9999)}"
+                    for _ in range(n_supp)],
         "s_acctbal": rng.uniform(-999, 9999, n_supp).round(2),
+        "s_comment": scomment,
+    })
+
+    # partsupp: each part has 4 suppliers; spec formula
+    # s = (p + i*(S/4 + (p-1)/S)) % S + 1 guarantees distinct suppliers
+    ps_part = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
+    i = np.tile(np.arange(4, dtype=np.int64), n_part)
+    ps_supp = ((ps_part + i * (n_supp // 4 + (ps_part - 1) // n_supp))
+               % n_supp) + 1
+    partsupp = pd.DataFrame({
+        "ps_partkey": ps_part,
+        "ps_suppkey": ps_supp,
+        "ps_availqty": rng.integers(1, 10000, len(ps_part)).astype(
+            np.int32),
+        "ps_supplycost": rng.uniform(1, 1000, len(ps_part)).round(2),
     })
 
     nation = pd.DataFrame({
@@ -130,8 +198,8 @@ def gen_tables(sf: float = 0.01, seed: int = 7) -> Dict[str, pd.DataFrame]:
         "r_name": REGIONS,
     })
     return {"lineitem": lineitem, "orders": orders, "customer": customer,
-            "part": part, "supplier": supplier, "nation": nation,
-            "region": region}
+            "part": part, "supplier": supplier, "partsupp": partsupp,
+            "nation": nation, "region": region}
 
 
 def load(session: TpuSession, tables: Dict[str, pd.DataFrame]
@@ -141,6 +209,18 @@ def load(session: TpuSession, tables: Dict[str, pd.DataFrame]
 
 
 # ------------------------------------------------------------------- queries
+
+def _join(left: DataFrame, right: DataFrame, lk, rk=None,
+          how: str = "inner") -> DataFrame:
+    """Join helper: renames right-side keys to the left-side names so the
+    using-columns join applies, mirroring the rename-then-join idiom."""
+    lk = [lk] if isinstance(lk, str) else list(lk)
+    rk = lk if rk is None else ([rk] if isinstance(rk, str) else list(rk))
+    for a, b in zip(lk, rk):
+        if a != b:
+            right = right.withColumnRenamed(b, a)
+    return left.join(right, on=lk, how=how)
+
 
 def q1(t: Dict[str, DataFrame]) -> DataFrame:
     """Pricing summary report."""
@@ -256,6 +336,354 @@ def q14(t: Dict[str, DataFrame]) -> DataFrame:
                  F.sum(rev).alias("total_sum"))
 
 
+def q2(t: Dict[str, DataFrame]) -> DataFrame:
+    """Minimum cost supplier: size-15 %BRASS parts, EUROPE."""
+    p = t["part"].filter((F.col("p_size") == 15) &
+                         F.col("p_type").like("%BRASS"))
+    r = t["region"].filter(F.col("r_name") == F.lit("EUROPE"))
+    n = _join(t["nation"], r.select("r_regionkey"),
+              "n_regionkey", "r_regionkey")
+    s = _join(t["supplier"], n.select("n_nationkey", "n_name"),
+              "s_nationkey", "n_nationkey")
+    ps = _join(t["partsupp"], p.select("p_partkey", "p_mfgr"),
+               "ps_partkey", "p_partkey")
+    ps = _join(ps, s.select("s_suppkey", "s_acctbal", "s_name", "s_address",
+                            "s_phone", "n_name"),
+               "ps_suppkey", "s_suppkey")
+    minc = ps.groupBy("ps_partkey").agg(
+        F.min("ps_supplycost").alias("min_cost"))
+    best = _join(ps, minc, "ps_partkey").filter(
+        F.col("ps_supplycost") == F.col("min_cost"))
+    return (best.select("s_acctbal", "s_name", "n_name", "ps_partkey",
+                        "p_mfgr", "s_address", "s_phone")
+            .orderBy(F.col("s_acctbal").desc(), "n_name", "s_name",
+                     "ps_partkey")
+            .limit(100))
+
+
+def q4(t: Dict[str, DataFrame]) -> DataFrame:
+    """Order priority checking (EXISTS -> semi join)."""
+    o = t["orders"].filter(
+        (F.col("o_orderdate") >= F.lit(datetime.date(1993, 7, 1))) &
+        (F.col("o_orderdate") < F.lit(datetime.date(1993, 10, 1))))
+    late = t["lineitem"].filter(
+        F.col("l_commitdate") < F.col("l_receiptdate")) \
+        .select("l_orderkey")
+    j = _join(o, late, "o_orderkey", "l_orderkey", how="semi")
+    return (j.groupBy("o_orderpriority")
+            .agg(F.count().alias("order_count"))
+            .orderBy("o_orderpriority"))
+
+
+def q7(t: Dict[str, DataFrame]) -> DataFrame:
+    """Volume shipping FRANCE <-> GERMANY."""
+    n = t["nation"].select("n_nationkey", "n_name")
+    s = _join(t["supplier"].select("s_suppkey", "s_nationkey"),
+              n.withColumnRenamed("n_name", "supp_nation"),
+              "s_nationkey", "n_nationkey")
+    c = _join(t["customer"].select("c_custkey", "c_nationkey"),
+              n.withColumnRenamed("n_name", "cust_nation"),
+              "c_nationkey", "n_nationkey")
+    o = _join(t["orders"].select("o_orderkey", "o_custkey"),
+              c.select("c_custkey", "cust_nation"), "o_custkey", "c_custkey")
+    l = t["lineitem"].filter(
+        (F.col("l_shipdate") >= F.lit(datetime.date(1995, 1, 1))) &
+        (F.col("l_shipdate") <= F.lit(datetime.date(1996, 12, 31))))
+    j = _join(l, o.select("o_orderkey", "cust_nation"),
+              "l_orderkey", "o_orderkey")
+    j = _join(j, s.select("s_suppkey", "supp_nation"),
+              "l_suppkey", "s_suppkey")
+    j = j.filter(
+        ((F.col("supp_nation") == F.lit("FRANCE")) &
+         (F.col("cust_nation") == F.lit("GERMANY"))) |
+        ((F.col("supp_nation") == F.lit("GERMANY")) &
+         (F.col("cust_nation") == F.lit("FRANCE"))))
+    j = j.withColumn("l_year", F.year(F.col("l_shipdate"))) \
+        .withColumn("volume",
+                    F.col("l_extendedprice") * (1 - F.col("l_discount")))
+    return (j.groupBy("supp_nation", "cust_nation", "l_year")
+            .agg(F.sum("volume").alias("revenue"))
+            .orderBy("supp_nation", "cust_nation", "l_year"))
+
+
+def q8(t: Dict[str, DataFrame]) -> DataFrame:
+    """National market share: BRAZIL in AMERICA, ECONOMY ANODIZED STEEL."""
+    p = t["part"].filter(
+        F.col("p_type") == F.lit("ECONOMY ANODIZED STEEL")) \
+        .select("p_partkey")
+    n2 = t["nation"].select("n_nationkey", "n_name") \
+        .withColumnRenamed("n_name", "nation")
+    s = _join(t["supplier"].select("s_suppkey", "s_nationkey"), n2,
+              "s_nationkey", "n_nationkey")
+    r = t["region"].filter(F.col("r_name") == F.lit("AMERICA"))
+    n1 = _join(t["nation"].select("n_nationkey", "n_regionkey"),
+               r.select("r_regionkey"), "n_regionkey", "r_regionkey",
+               how="semi")
+    c = _join(t["customer"].select("c_custkey", "c_nationkey"),
+              n1.select("n_nationkey"), "c_nationkey", "n_nationkey",
+              how="semi")
+    o = t["orders"].filter(
+        (F.col("o_orderdate") >= F.lit(datetime.date(1995, 1, 1))) &
+        (F.col("o_orderdate") <= F.lit(datetime.date(1996, 12, 31)))) \
+        .select("o_orderkey", "o_custkey", "o_orderdate")
+    o = _join(o, c.select("c_custkey"), "o_custkey", "c_custkey",
+              how="semi")
+    l = _join(t["lineitem"], p, "l_partkey", "p_partkey", how="semi")
+    j = _join(l, o.select("o_orderkey", "o_orderdate"),
+              "l_orderkey", "o_orderkey")
+    j = _join(j, s.select("s_suppkey", "nation"), "l_suppkey", "s_suppkey")
+    j = j.withColumn("o_year", F.year(F.col("o_orderdate"))) \
+        .withColumn("volume",
+                    F.col("l_extendedprice") * (1 - F.col("l_discount")))
+    brazil = F.when(F.col("nation") == F.lit("BRAZIL"),
+                    F.col("volume")).otherwise(0.0)
+    agg = j.groupBy("o_year").agg(F.sum(brazil).alias("brazil_vol"),
+                                  F.sum("volume").alias("total_vol"))
+    return (agg.withColumn("mkt_share",
+                           F.col("brazil_vol") / F.col("total_vol"))
+            .select("o_year", "mkt_share").orderBy("o_year"))
+
+
+def q9(t: Dict[str, DataFrame]) -> DataFrame:
+    """Product type profit measure: parts named %green%."""
+    p = t["part"].filter(F.col("p_name").contains("green")) \
+        .select("p_partkey")
+    l = _join(t["lineitem"], p, "l_partkey", "p_partkey", how="semi")
+    n = t["nation"].select("n_nationkey", "n_name") \
+        .withColumnRenamed("n_name", "nation")
+    s = _join(t["supplier"].select("s_suppkey", "s_nationkey"), n,
+              "s_nationkey", "n_nationkey")
+    j = _join(l, s.select("s_suppkey", "nation"), "l_suppkey", "s_suppkey")
+    j = _join(j, t["partsupp"].select("ps_partkey", "ps_suppkey",
+                                      "ps_supplycost"),
+              ["l_partkey", "l_suppkey"], ["ps_partkey", "ps_suppkey"])
+    j = _join(j, t["orders"].select("o_orderkey", "o_orderdate"),
+              "l_orderkey", "o_orderkey")
+    j = j.withColumn("o_year", F.year(F.col("o_orderdate"))) \
+        .withColumn(
+            "amount",
+            F.col("l_extendedprice") * (1 - F.col("l_discount")) -
+            F.col("ps_supplycost") * F.col("l_quantity"))
+    return (j.groupBy("nation", "o_year")
+            .agg(F.sum("amount").alias("sum_profit"))
+            .orderBy("nation", F.col("o_year").desc()))
+
+
+def q10(t: Dict[str, DataFrame]) -> DataFrame:
+    """Returned item reporting: top 20 customers by lost revenue."""
+    o = t["orders"].filter(
+        (F.col("o_orderdate") >= F.lit(datetime.date(1993, 10, 1))) &
+        (F.col("o_orderdate") < F.lit(datetime.date(1994, 1, 1)))) \
+        .select("o_orderkey", "o_custkey")
+    l = t["lineitem"].filter(F.col("l_returnflag") == F.lit("R"))
+    j = _join(l, o, "l_orderkey", "o_orderkey")
+    j = _join(j, t["customer"].select("c_custkey", "c_name", "c_acctbal",
+                                      "c_phone", "c_nationkey",
+                                      "c_comment"),
+              "o_custkey", "c_custkey")
+    j = _join(j, t["nation"].select("n_nationkey", "n_name"),
+              "c_nationkey", "n_nationkey")
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    return (j.groupBy("o_custkey", "c_name", "c_acctbal", "c_phone",
+                      "n_name", "c_comment")
+            .agg(F.sum(rev).alias("revenue"))
+            .orderBy(F.col("revenue").desc())
+            .limit(20))
+
+
+def q11(t: Dict[str, DataFrame], fraction: float = 0.0001) -> DataFrame:
+    """Important stock identification (HAVING with scalar subquery)."""
+    g = t["nation"].filter(F.col("n_name") == F.lit("GERMANY")) \
+        .select("n_nationkey")
+    s = _join(t["supplier"].select("s_suppkey", "s_nationkey"), g,
+              "s_nationkey", "n_nationkey", how="semi")
+    ps = _join(t["partsupp"], s.select("s_suppkey"),
+               "ps_suppkey", "s_suppkey", how="semi")
+    value = F.col("ps_supplycost") * F.col("ps_availqty").cast("double")
+    per_part = ps.groupBy("ps_partkey").agg(F.sum(value).alias("value"))
+    total = per_part.agg(F.sum("value").alias("total")).collect()[0][0]
+    return (per_part.filter(F.col("value") > float(total) * fraction)
+            .orderBy(F.col("value").desc()))
+
+
+def q13(t: Dict[str, DataFrame]) -> DataFrame:
+    """Customer distribution (left outer join + count of non-null)."""
+    o = t["orders"].filter(
+        ~F.col("o_comment").like("%special%requests%")) \
+        .select("o_orderkey", "o_custkey")
+    j = _join(t["customer"].select("c_custkey"), o,
+              "c_custkey", "o_custkey", how="left")
+    per_cust = j.groupBy("c_custkey").agg(
+        F.count(F.col("o_orderkey")).alias("c_count"))
+    return (per_cust.groupBy("c_count").agg(F.count().alias("custdist"))
+            .orderBy(F.col("custdist").desc(), F.col("c_count").desc()))
+
+
+def q15(t: Dict[str, DataFrame]) -> DataFrame:
+    """Top supplier (view + max scalar subquery)."""
+    l = t["lineitem"].filter(
+        (F.col("l_shipdate") >= F.lit(datetime.date(1996, 1, 1))) &
+        (F.col("l_shipdate") < F.lit(datetime.date(1996, 4, 1))))
+    rev = l.groupBy("l_suppkey").agg(
+        F.sum(F.col("l_extendedprice") * (1 - F.col("l_discount")))
+        .alias("total_revenue"))
+    m = rev.agg(F.max("total_revenue").alias("m")).collect()[0][0]
+    j = _join(t["supplier"].select("s_suppkey", "s_name", "s_address",
+                                   "s_phone"),
+              rev, "s_suppkey", "l_suppkey")
+    return (j.filter(F.col("total_revenue") >= float(m))
+            .select("s_suppkey", "s_name", "s_address", "s_phone",
+                    "total_revenue")
+            .orderBy("s_suppkey"))
+
+
+def q16(t: Dict[str, DataFrame]) -> DataFrame:
+    """Parts/supplier relationship (NOT IN -> anti join, count distinct)."""
+    p = t["part"].filter(
+        (F.col("p_brand") != F.lit("Brand#45")) &
+        ~F.col("p_type").like("MEDIUM POLISHED%") &
+        F.col("p_size").isin(49, 14, 23, 45, 19, 3, 36, 9))
+    bad = t["supplier"].filter(
+        F.col("s_comment").like("%Customer%Complaints%")) \
+        .select("s_suppkey")
+    ps = _join(t["partsupp"].select("ps_partkey", "ps_suppkey"), bad,
+               "ps_suppkey", "s_suppkey", how="anti")
+    j = _join(ps, p.select("p_partkey", "p_brand", "p_type", "p_size"),
+              "ps_partkey", "p_partkey")
+    d = j.select("p_brand", "p_type", "p_size", "ps_suppkey").distinct()
+    return (d.groupBy("p_brand", "p_type", "p_size")
+            .agg(F.count().alias("supplier_cnt"))
+            .orderBy(F.col("supplier_cnt").desc(), "p_brand", "p_type",
+                     "p_size"))
+
+
+def q17(t: Dict[str, DataFrame]) -> DataFrame:
+    """Small-quantity-order revenue (correlated avg subquery -> join)."""
+    p = t["part"].filter((F.col("p_brand") == F.lit("Brand#23")) &
+                         (F.col("p_container") == F.lit("MED BOX"))) \
+        .select("p_partkey")
+    l = _join(t["lineitem"].select("l_partkey", "l_quantity",
+                                   "l_extendedprice"),
+              p, "l_partkey", "p_partkey", how="semi")
+    avgq = l.groupBy("l_partkey").agg(
+        (F.avg("l_quantity") * 0.2).alias("qty_limit"))
+    j = _join(l, avgq, "l_partkey")
+    return (j.filter(F.col("l_quantity") < F.col("qty_limit"))
+            .agg((F.sum("l_extendedprice") / 7.0).alias("avg_yearly")))
+
+
+def q18(t: Dict[str, DataFrame], threshold: float = 300.0) -> DataFrame:
+    """Large volume customer (IN subquery with HAVING)."""
+    big = t["lineitem"].groupBy("l_orderkey").agg(
+        F.sum("l_quantity").alias("sum_qty"))
+    big = big.filter(F.col("sum_qty") > threshold)
+    o = _join(t["orders"].select("o_orderkey", "o_custkey", "o_orderdate",
+                                 "o_totalprice"),
+              big, "o_orderkey", "l_orderkey")
+    j = _join(o, t["customer"].select("c_custkey", "c_name"),
+              "o_custkey", "c_custkey")
+    return (j.select("c_name", "o_custkey", "o_orderkey", "o_orderdate",
+                     "o_totalprice", "sum_qty")
+            .orderBy(F.col("o_totalprice").desc(), "o_orderdate")
+            .limit(100))
+
+
+def q19(t: Dict[str, DataFrame]) -> DataFrame:
+    """Discounted revenue (disjunction of conjunctive predicate groups)."""
+    j = _join(t["lineitem"].select("l_partkey", "l_quantity",
+                                   "l_extendedprice", "l_discount",
+                                   "l_shipmode", "l_shipinstruct"),
+              t["part"].select("p_partkey", "p_brand", "p_container",
+                               "p_size"),
+              "l_partkey", "p_partkey")
+    qty, size = F.col("l_quantity"), F.col("p_size")
+    g1 = (F.col("p_brand").like("Brand#1%") &
+          F.col("p_container").isin("SM CASE", "SM BOX") &
+          (qty >= 1) & (qty <= 11) & (size >= 1) & (size <= 15))
+    g2 = (F.col("p_brand").like("Brand#2%") &
+          F.col("p_container").isin("MED BAG", "MED BOX") &
+          (qty >= 10) & (qty <= 20) & (size >= 1) & (size <= 25))
+    g3 = (F.col("p_brand").like("Brand#3%") &
+          F.col("p_container").isin("LG CASE", "LG BOX") &
+          (qty >= 20) & (qty <= 30) & (size >= 1) & (size <= 35))
+    common = (F.col("l_shipmode").isin("AIR", "REG AIR") &
+              (F.col("l_shipinstruct") == F.lit("DELIVER IN PERSON")))
+    rev = F.col("l_extendedprice") * (1 - F.col("l_discount"))
+    return (j.filter(common & (g1 | g2 | g3))
+            .agg(F.sum(rev).alias("revenue")))
+
+
+def q20(t: Dict[str, DataFrame]) -> DataFrame:
+    """Potential part promotion (nested IN subqueries -> semi joins)."""
+    p = t["part"].filter(F.col("p_name").like("forest%")) \
+        .select("p_partkey")
+    qty = t["lineitem"].filter(
+        (F.col("l_shipdate") >= F.lit(datetime.date(1994, 1, 1))) &
+        (F.col("l_shipdate") < F.lit(datetime.date(1995, 1, 1)))) \
+        .groupBy("l_partkey", "l_suppkey") \
+        .agg((F.sum("l_quantity") * 0.5).alias("half_qty"))
+    ps = _join(t["partsupp"].select("ps_partkey", "ps_suppkey",
+                                    "ps_availqty"),
+               p, "ps_partkey", "p_partkey", how="semi")
+    ps = _join(ps, qty, ["ps_partkey", "ps_suppkey"],
+               ["l_partkey", "l_suppkey"])
+    good = ps.filter(F.col("ps_availqty").cast("double") >
+                     F.col("half_qty")) \
+        .select("ps_suppkey").distinct()
+    s = _join(t["supplier"], good, "s_suppkey", "ps_suppkey", how="semi")
+    n = t["nation"].filter(F.col("n_name") == F.lit("CANADA")) \
+        .select("n_nationkey")
+    s = _join(s, n, "s_nationkey", "n_nationkey", how="semi")
+    return s.select("s_name", "s_address").orderBy("s_name")
+
+
+def q21(t: Dict[str, DataFrame]) -> DataFrame:
+    """Suppliers who kept orders waiting (EXISTS + NOT EXISTS)."""
+    pairs = t["lineitem"].select("l_orderkey", "l_suppkey").distinct()
+    cnt_all = pairs.groupBy("l_orderkey").agg(F.count().alias("n_supp"))
+    late = t["lineitem"].filter(
+        F.col("l_receiptdate") > F.col("l_commitdate")) \
+        .select("l_orderkey", "l_suppkey")
+    cnt_late = late.distinct().groupBy("l_orderkey").agg(
+        F.count().alias("n_late"))
+    o = t["orders"].filter(F.col("o_orderstatus") == F.lit("F")) \
+        .select("o_orderkey")
+    l1 = late
+    j = _join(l1, o, "l_orderkey", "o_orderkey", how="semi")
+    j = _join(j, cnt_all, "l_orderkey")
+    j = _join(j, cnt_late, "l_orderkey")
+    j = j.filter((F.col("n_supp") > 1) & (F.col("n_late") == 1))
+    n = t["nation"].filter(F.col("n_name") == F.lit("SAUDI ARABIA")) \
+        .select("n_nationkey")
+    s = _join(t["supplier"].select("s_suppkey", "s_name", "s_nationkey"),
+              n, "s_nationkey", "n_nationkey", how="semi")
+    j = _join(j, s.select("s_suppkey", "s_name"),
+              "l_suppkey", "s_suppkey")
+    return (j.groupBy("s_name").agg(F.count().alias("numwait"))
+            .orderBy(F.col("numwait").desc(), "s_name")
+            .limit(100))
+
+
+def q22(t: Dict[str, DataFrame]) -> DataFrame:
+    """Global sales opportunity (substring country codes, NOT EXISTS)."""
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = t["customer"].withColumn(
+        "cntrycode", F.substring(F.col("c_phone"), 1, 2)) \
+        .filter(F.col("cntrycode").isin(*codes))
+    avg_bal = cust.filter(F.col("c_acctbal") > 0.0) \
+        .agg(F.avg("c_acctbal").alias("a")).collect()[0][0]
+    good = cust.filter(F.col("c_acctbal") > float(avg_bal))
+    noord = _join(good, t["orders"].select("o_custkey"),
+                  "c_custkey", "o_custkey", how="anti")
+    return (noord.groupBy("cntrycode")
+            .agg(F.count().alias("numcust"),
+                 F.sum("c_acctbal").alias("totacctbal"))
+            .orderBy("cntrycode"))
+
+
 QUERIES: Dict[str, Callable] = {
-    "q1": q1, "q3": q3, "q5": q5, "q6": q6, "q12": q12, "q14": q14,
+    "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
+    "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
+    "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18,
+    "q19": q19, "q20": q20, "q21": q21, "q22": q22,
 }

@@ -54,10 +54,7 @@ def _sortable_keys(keys: Sequence[ColVal], valid_rows, capacity: int,
     # jnp.lexsort sorts by last key first; we append least-significant first
     for c, desc, nf in zip(reversed(list(keys)), reversed(list(descending)),
                            reversed(list(nulls_first))):
-        u = _order_preserving_u64(c.values)
-        if desc:
-            u = ~u
-        lex.append(u)
+        lex.extend(_order_keys(c.values, desc))
         if c.validity is not None:
             null_key = jnp.logical_not(c.validity).astype(jnp.int8)
             lex.append(-null_key if nf else null_key)
@@ -65,19 +62,25 @@ def _sortable_keys(keys: Sequence[ColVal], valid_rows, capacity: int,
     return lex
 
 
-def _order_preserving_u64(v):
-    """Map any numeric column to uint64 whose unsigned order matches the
-    Spark total order: ints biased by 2^63; floats via the IEEE bit trick
-    (sign-flipped), with -0.0 == 0.0 and NaN largest."""
+def _order_keys(v, desc: bool) -> List:
+    """Lexsort key pieces (least-significant first) realizing the Spark
+    total order for one column.  No 64-bit bitcasts: TPU's X64 rewriter
+    cannot lower f64<->u64 bitcast-convert, so floats sort as a normalized
+    float key plus a more-significant NaN flag (NaN largest, -0.0 == 0.0),
+    ints directly (descending via bitwise-not, monotone-decreasing for
+    two's-complement)."""
     if jnp.issubdtype(v.dtype, jnp.floating):
-        f = jnp.where(v == 0.0, 0.0, v).astype(jnp.float64)
-        u = f.view(jnp.uint64)
-        sign = u >> jnp.uint64(63)
-        u = jnp.where(sign == 1, ~u, u | jnp.uint64(1 << 63))
-        return jnp.where(jnp.isnan(v), jnp.uint64(0xFFFFFFFFFFFFFFFF), u)
+        nan = jnp.isnan(v)
+        f = jnp.where(v == 0.0, 0.0, v)
+        f = jnp.where(nan, 0.0, f)
+        flag = nan.astype(jnp.int8)
+        if desc:
+            return [-f, -flag]
+        return [f, flag]
     if v.dtype == jnp.bool_:
-        return v.astype(jnp.uint64)
-    return v.astype(jnp.int64).view(jnp.uint64) ^ jnp.uint64(1 << 63)
+        v = v.astype(jnp.int8)
+        return [~v] if desc else [v]
+    return [~v] if desc else [v]
 
 
 def sort_permutation(keys: Sequence[ColVal], valid_rows, capacity: int,

@@ -183,9 +183,12 @@ class Contains(_PatternPredicate):
 
 
 class Like(_PatternPredicate):
-    """SQL LIKE, supporting the %/_ forms that decompose into prefix/suffix/
-    infix tests (the overwhelmingly common cases; general patterns fall back
-    via the planner)."""
+    """SQL LIKE with arbitrary ``%`` wildcards (``_`` falls back via the
+    planner).  Single-wildcard forms decompose into prefix/suffix/infix
+    tests; multi-wildcard patterns like ``%special%requests%`` run a fully
+    data-parallel ordered-infix match: per segment, find the earliest match
+    position at-or-after the previous segment's end with a masked
+    ``segment_min`` (the device analog of cudf's ``strings::like``)."""
 
     def __init__(self, child: Expression, pattern: str):
         super().__init__(child, pattern)
@@ -196,10 +199,9 @@ class Like(_PatternPredicate):
         if "_" in p:
             return None
         parts = p.split("%")
-        # '%abc%def%' -> infix sequence; support 0-2 % with simple anchors
         if "%" not in p:
             return ("exact", p)
-        if p == "%":
+        if set(p) == {"%"}:
             return ("any",)
         inner = [s for s in parts if s]
         if p.startswith("%") and p.endswith("%") and len(inner) == 1:
@@ -211,7 +213,9 @@ class Like(_PatternPredicate):
         if not p.startswith("%") and not p.endswith("%") and \
                 len(inner) == 2 and len(parts) == 2:
             return ("prefix_suffix", inner[0], inner[1])
-        return None
+        # general: ordered segments, optionally anchored at either end
+        return ("general", not p.startswith("%"), not p.endswith("%"),
+                tuple(inner))
 
     @property
     def supported(self) -> bool:
@@ -235,14 +239,52 @@ class Like(_PatternPredicate):
             return StartsWith(self.child, plan[1]).emit(ctx)
         if kind == "suffix":
             return EndsWith(self.child, plan[1]).emit(ctx)
-        # prefix_suffix: both, non-overlapping
+        if kind == "prefix_suffix":
+            # both, non-overlapping
+            c = self.child.emit(ctx)
+            pre = StartsWith(self.child, plan[1]).emit(ctx)
+            suf = EndsWith(self.child, plan[2]).emit(ctx)
+            long_enough = row_lengths(c) >= (len(_literal_bytes(plan[1])) +
+                                             len(_literal_bytes(plan[2])))
+            return ColVal(dts.BOOL,
+                          pre.values & suf.values & long_enough, c.validity)
+        # general: ordered infix chain with optional anchors
+        _, anchor_start, anchor_end, segments = plan
         c = self.child.emit(ctx)
-        pre = StartsWith(self.child, plan[1]).emit(ctx)
-        suf = EndsWith(self.child, plan[2]).emit(ctx)
-        long_enough = row_lengths(c) >= (len(_literal_bytes(plan[1])) +
-                                         len(_literal_bytes(plan[2])))
-        return ColVal(dts.BOOL,
-                      pre.values & suf.values & long_enough, c.validity)
+        ccap = c.values.shape[0]
+        starts = c.offsets[:-1]
+        ends = c.offsets[1:]
+        INF = jnp.int32(2**30)
+        ok = jnp.ones(ctx.capacity, dtype=jnp.bool_)
+        # cur[row] = earliest byte position the next segment may start at
+        cur = starts.astype(jnp.int32)
+        segs = list(segments)
+        if anchor_start and segs:
+            pre = StartsWith(self.child, segs[0]).emit(ctx)
+            ok = jnp.logical_and(ok, pre.values)
+            cur = cur + len(_literal_bytes(segs[0]))
+            segs = segs[1:]
+        last = None
+        if anchor_end and segs:
+            last = segs[-1]
+            segs = segs[:-1]
+        for seg in segs:
+            pat = _literal_bytes(seg)
+            m, row = _match_starts(c, pat, ctx.capacity)
+            pos = jnp.arange(ccap, dtype=jnp.int32)
+            eligible = jnp.logical_and(m, pos >= cur[row])
+            first = jax.ops.segment_min(
+                jnp.where(eligible, pos, INF), row,
+                num_segments=ctx.capacity)
+            ok = jnp.logical_and(ok, first < INF)
+            cur = jnp.where(first < INF, first + len(pat), cur)
+        if last is not None:
+            pat = _literal_bytes(last)
+            suf = EndsWith(self.child, last).emit(ctx)
+            ok = jnp.logical_and(ok, suf.values)
+            ok = jnp.logical_and(ok,
+                                 ends.astype(jnp.int32) - len(pat) >= cur)
+        return ColVal(dts.BOOL, ok, c.validity)
 
 
 class EqualsLiteral(_PatternPredicate):

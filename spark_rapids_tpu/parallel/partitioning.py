@@ -17,42 +17,59 @@ import jax.numpy as jnp
 from spark_rapids_tpu.ops.expressions import ColVal
 
 
-def _mix64(h):
-    """splitmix64 finalizer — good avalanche, vectorizes trivially."""
-    h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
-    h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
-    return h ^ (h >> 31)
+def _mix32(h):
+    """murmur3 fmix32 — good avalanche, all 32-bit ops (TPU's X64 rewriter
+    cannot lower f64<->u64 bitcast-convert, and 64-bit lane math is
+    emulated; 32-bit mixing is native on the VPU)."""
+    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _column_words(c: ColVal):
+    """Per-row (lo, hi) uint32 words encoding a column's value such that
+    rows comparing equal yield equal words.  Floats canonicalize
+    (-0.0 -> 0.0, NaN collapsed) then split as f32-bitcast of the value
+    plus f32-bitcast of the scaled residual — no 64-bit bitcasts."""
+    v = c.values
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jnp.where(v == 0.0, 0.0, v).astype(jnp.float64)
+        v = jnp.where(jnp.isnan(v), jnp.float64(0.0), v)  # collapse NaN
+        top = v.astype(jnp.float32)
+        resid = (v - top.astype(jnp.float64)).astype(jnp.float32)
+        resid = resid * jnp.float32(2.0) ** 29
+        lo = jax.lax.bitcast_convert_type(top, jnp.uint32)
+        hi = jax.lax.bitcast_convert_type(resid, jnp.uint32)
+        return lo, hi
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.uint32), jnp.zeros_like(v, dtype=jnp.uint32)
+    w = v.astype(jnp.int64)
+    lo = jnp.bitwise_and(w, jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = jnp.right_shift(w, 32).astype(jnp.uint32)
+    return lo, hi
 
 
 def hash_columns(cols: Sequence[ColVal], seed: int = 42) -> jnp.ndarray:
-    """uint64 hash per row over the key columns (murmur-mix based).
+    """uint32 hash per row over the key columns (murmur3-mix based).
 
     Floats are canonicalized (-0.0 -> 0.0, NaN payloads collapsed) so rows
     that compare equal hash equal, matching the reference's requirement on
-    GpuHashPartitioning (murmur3 over canonical bytes).
-    """
+    GpuHashPartitioning (murmur3 over canonical bytes)."""
     acc = None
     for c in cols:
-        v = c.values
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            v = jnp.where(v == 0.0, 0.0, v)
-            v = jnp.where(jnp.isnan(v), jnp.nan, v)
-            bits = v.astype(jnp.float64).view(jnp.uint64)
-        elif v.dtype == jnp.bool_:
-            bits = v.astype(jnp.uint64)
-        else:
-            bits = v.astype(jnp.int64).view(jnp.uint64)
+        lo, hi = _column_words(c)
+        h = _mix32(lo ^ jnp.uint32(seed))
+        h = _mix32(h * jnp.uint32(31) + _mix32(hi ^ jnp.uint32(seed)))
         if c.validity is not None:
-            bits = jnp.where(c.validity, bits, jnp.uint64(0x9E3779B97F4A7C15))
-        h = _mix64(bits + jnp.uint64(seed))
-        acc = h if acc is None else _mix64(acc * jnp.uint64(31) + h)
+            h = jnp.where(c.validity, h, jnp.uint32(0x9E3779B9))
+        acc = h if acc is None else _mix32(acc * jnp.uint32(31) + h)
     return acc
 
 
 def hash_partition_ids(key_cols: Sequence[ColVal], num_parts: int
                        ) -> jnp.ndarray:
     h = hash_columns(key_cols)
-    return (h % jnp.uint64(num_parts)).astype(jnp.int32)
+    return (h % jnp.uint32(num_parts)).astype(jnp.int32)
 
 
 def round_robin_partition_ids(capacity: int, num_parts: int,

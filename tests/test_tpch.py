@@ -127,3 +127,357 @@ def test_q14(data, t):
     promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
     np.testing.assert_allclose(got[0], promo.sum() * 100, rtol=1e-9)
     np.testing.assert_allclose(got[1], rev.sum(), rtol=1e-9)
+
+
+def _cmp(got: pd.DataFrame, want: pd.DataFrame, rtol=1e-9):
+    """Order-insensitive frame comparison: sort both by all columns."""
+    assert list(got.columns) == list(want.columns), \
+        (list(got.columns), list(want.columns))
+    assert len(got) == len(want), (len(got), len(want))
+    got = got.copy()
+    for c in got.columns:  # engine timestamps are tz-aware UTC
+        if isinstance(got[c].dtype, pd.DatetimeTZDtype):
+            got[c] = got[c].dt.tz_localize(None)
+    keys = list(got.columns)
+    g = got.sort_values(keys).reset_index(drop=True)
+    w = want.sort_values(keys).reset_index(drop=True)
+    for c in keys:
+        if np.issubdtype(np.asarray(w[c]).dtype, np.floating):
+            np.testing.assert_allclose(g[c], w[c], rtol=rtol)
+        else:
+            assert g[c].tolist() == w[c].tolist(), c
+
+
+def test_q2(data, t):
+    got = tpch.q2(t).to_pandas()
+    p, s, ps = data["part"], data["supplier"], data["partsupp"]
+    n, r = data["nation"], data["region"]
+    pp = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    nr = n.merge(r[r.r_name == "EUROPE"], left_on="n_regionkey",
+                 right_on="r_regionkey")
+    ss = s.merge(nr[["n_nationkey", "n_name"]], left_on="s_nationkey",
+                 right_on="n_nationkey")
+    j = ps.merge(pp[["p_partkey", "p_mfgr"]], left_on="ps_partkey",
+                 right_on="p_partkey") \
+        .merge(ss, left_on="ps_suppkey", right_on="s_suppkey")
+    j["min_cost"] = j.groupby("ps_partkey")["ps_supplycost"] \
+        .transform("min")
+    best = j[j.ps_supplycost == j.min_cost]
+    want = best[["s_acctbal", "s_name", "n_name", "ps_partkey", "p_mfgr",
+                 "s_address", "s_phone"]] \
+        .sort_values(["s_acctbal", "n_name", "s_name", "ps_partkey"],
+                     ascending=[False, True, True, True]).head(100) \
+        .reset_index(drop=True)
+    _cmp(got, want)
+
+
+def test_q4(data, t):
+    got = tpch.q4(t).to_pandas()
+    o, l = data["orders"], data["lineitem"]
+    oo = o[(o.o_orderdate >= pd.Timestamp("1993-07-01")) &
+           (o.o_orderdate < pd.Timestamp("1993-10-01"))]
+    late = set(l[l.l_commitdate < l.l_receiptdate].l_orderkey)
+    sel = oo[oo.o_orderkey.isin(late)]
+    want = sel.groupby("o_orderpriority", as_index=False) \
+        .agg(order_count=("o_orderkey", "count")) \
+        .sort_values("o_orderpriority").reset_index(drop=True)
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q7(data, t):
+    got = tpch.q7(t).to_pandas()
+    l, o, c, s, n = (data["lineitem"], data["orders"], data["customer"],
+                     data["supplier"], data["nation"])
+    ll = l[(l.l_shipdate >= pd.Timestamp("1995-01-01")) &
+           (l.l_shipdate <= pd.Timestamp("1996-12-31"))]
+    j = ll.merge(o[["o_orderkey", "o_custkey"]], left_on="l_orderkey",
+                 right_on="o_orderkey") \
+        .merge(c[["c_custkey", "c_nationkey"]], left_on="o_custkey",
+               right_on="c_custkey") \
+        .merge(n.rename(columns={"n_name": "cust_nation"})
+               [["n_nationkey", "cust_nation"]],
+               left_on="c_nationkey", right_on="n_nationkey") \
+        .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+               right_on="s_suppkey") \
+        .merge(n.rename(columns={"n_name": "supp_nation"})
+               [["n_nationkey", "supp_nation"]],
+               left_on="s_nationkey", right_on="n_nationkey")
+    j = j[((j.supp_nation == "FRANCE") & (j.cust_nation == "GERMANY")) |
+          ((j.supp_nation == "GERMANY") & (j.cust_nation == "FRANCE"))]
+    j["l_year"] = j.l_shipdate.dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    want = j.groupby(["supp_nation", "cust_nation", "l_year"],
+                     as_index=False).agg(revenue=("volume", "sum"))
+    assert len(want) > 0
+    _cmp(got, want.astype({"l_year": got["l_year"].dtype}))
+
+
+def test_q8(data, t):
+    got = tpch.q8(t).to_pandas()
+    l, o, c, s, n, r, p = (data["lineitem"], data["orders"],
+                           data["customer"], data["supplier"],
+                           data["nation"], data["region"], data["part"])
+    pp = p[p.p_type == "ECONOMY ANODIZED STEEL"]
+    america = n.merge(r[r.r_name == "AMERICA"], left_on="n_regionkey",
+                      right_on="r_regionkey").n_nationkey
+    oo = o[(o.o_orderdate >= pd.Timestamp("1995-01-01")) &
+           (o.o_orderdate <= pd.Timestamp("1996-12-31"))]
+    oo = oo[oo.o_custkey.isin(
+        set(c[c.c_nationkey.isin(set(america))].c_custkey))]
+    j = l[l.l_partkey.isin(set(pp.p_partkey))] \
+        .merge(oo[["o_orderkey", "o_orderdate"]], left_on="l_orderkey",
+               right_on="o_orderkey") \
+        .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+               right_on="s_suppkey") \
+        .merge(n.rename(columns={"n_name": "nation"})
+               [["n_nationkey", "nation"]],
+               left_on="s_nationkey", right_on="n_nationkey")
+    j["o_year"] = j.o_orderdate.dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["brazil"] = j.volume.where(j.nation == "BRAZIL", 0.0)
+    g = j.groupby("o_year", as_index=False).agg(
+        brazil_vol=("brazil", "sum"), total_vol=("volume", "sum"))
+    g["mkt_share"] = g.brazil_vol / g.total_vol
+    want = g[["o_year", "mkt_share"]]
+    assert len(want) > 0
+    _cmp(got, want.astype({"o_year": got["o_year"].dtype}))
+
+
+def test_q9(data, t):
+    got = tpch.q9(t).to_pandas()
+    l, o, s, n, p, ps = (data["lineitem"], data["orders"],
+                         data["supplier"], data["nation"], data["part"],
+                         data["partsupp"])
+    pp = p[p.p_name.str.contains("green")]
+    j = l[l.l_partkey.isin(set(pp.p_partkey))] \
+        .merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey",
+               right_on="s_suppkey") \
+        .merge(n.rename(columns={"n_name": "nation"})
+               [["n_nationkey", "nation"]],
+               left_on="s_nationkey", right_on="n_nationkey") \
+        .merge(ps[["ps_partkey", "ps_suppkey", "ps_supplycost"]],
+               left_on=["l_partkey", "l_suppkey"],
+               right_on=["ps_partkey", "ps_suppkey"]) \
+        .merge(o[["o_orderkey", "o_orderdate"]], left_on="l_orderkey",
+               right_on="o_orderkey")
+    j["o_year"] = j.o_orderdate.dt.year
+    j["amount"] = (j.l_extendedprice * (1 - j.l_discount) -
+                   j.ps_supplycost * j.l_quantity)
+    want = j.groupby(["nation", "o_year"], as_index=False) \
+        .agg(sum_profit=("amount", "sum"))
+    assert len(want) > 0
+    _cmp(got, want.astype({"o_year": got["o_year"].dtype}))
+
+
+def test_q10(data, t):
+    got = tpch.q10(t).to_pandas()
+    l, o, c, n = (data["lineitem"], data["orders"], data["customer"],
+                  data["nation"])
+    oo = o[(o.o_orderdate >= pd.Timestamp("1993-10-01")) &
+           (o.o_orderdate < pd.Timestamp("1994-01-01"))]
+    j = l[l.l_returnflag == "R"] \
+        .merge(oo[["o_orderkey", "o_custkey"]], left_on="l_orderkey",
+               right_on="o_orderkey") \
+        .merge(c[["c_custkey", "c_name", "c_acctbal", "c_phone",
+                  "c_nationkey", "c_comment"]],
+               left_on="o_custkey", right_on="c_custkey") \
+        .merge(n[["n_nationkey", "n_name"]], left_on="c_nationkey",
+               right_on="n_nationkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    want = j.groupby(["o_custkey", "c_name", "c_acctbal", "c_phone",
+                      "n_name", "c_comment"], as_index=False) \
+        .agg(revenue=("revenue", "sum")) \
+        .sort_values("revenue", ascending=False).head(20) \
+        .reset_index(drop=True)
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q11(data, t):
+    fraction = 0.02
+    got = tpch.q11(t, fraction=fraction).to_pandas()
+    ps, s, n = data["partsupp"], data["supplier"], data["nation"]
+    germany = set(n[n.n_name == "GERMANY"].n_nationkey)
+    ss = set(s[s.s_nationkey.isin(germany)].s_suppkey)
+    m = ps[ps.ps_suppkey.isin(ss)].copy()
+    m["value"] = m.ps_supplycost * m.ps_availqty
+    per = m.groupby("ps_partkey", as_index=False).agg(
+        value=("value", "sum"))
+    want = per[per.value > per.value.sum() * fraction] \
+        .sort_values("value", ascending=False).reset_index(drop=True)
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q13(data, t):
+    got = tpch.q13(t).to_pandas()
+    o, c = data["orders"], data["customer"]
+    oo = o[~o.o_comment.str.match(r".*special.*requests.*")]
+    j = c[["c_custkey"]].merge(oo[["o_orderkey", "o_custkey"]],
+                               left_on="c_custkey", right_on="o_custkey",
+                               how="left")
+    per = j.groupby("c_custkey", as_index=False).agg(
+        c_count=("o_orderkey", "count"))
+    want = per.groupby("c_count", as_index=False).size() \
+        .rename(columns={"size": "custdist"})
+    want = want[["c_count", "custdist"]].astype(
+        {"c_count": got["c_count"].dtype,
+         "custdist": got["custdist"].dtype})
+    assert len(want) > 1
+    _cmp(got, want)
+
+
+def test_q15(data, t):
+    got = tpch.q15(t).to_pandas()
+    l, s = data["lineitem"], data["supplier"]
+    ll = l[(l.l_shipdate >= pd.Timestamp("1996-01-01")) &
+           (l.l_shipdate < pd.Timestamp("1996-04-01"))].copy()
+    ll["rev"] = ll.l_extendedprice * (1 - ll.l_discount)
+    per = ll.groupby("l_suppkey", as_index=False).agg(
+        total_revenue=("rev", "sum"))
+    m = per.total_revenue.max()
+    j = s.merge(per[per.total_revenue >= m], left_on="s_suppkey",
+                right_on="l_suppkey")
+    want = j[["s_suppkey", "s_name", "s_address", "s_phone",
+              "total_revenue"]].sort_values("s_suppkey") \
+        .reset_index(drop=True)
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q16(data, t):
+    got = tpch.q16(t).to_pandas()
+    p, ps, s = data["part"], data["partsupp"], data["supplier"]
+    pp = p[(p.p_brand != "Brand#45") &
+           ~p.p_type.str.startswith("MEDIUM POLISHED") &
+           p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    bad = set(s[s.s_comment.str.match(
+        r".*Customer.*Complaints.*")].s_suppkey)
+    m = ps[~ps.ps_suppkey.isin(bad)] \
+        .merge(pp[["p_partkey", "p_brand", "p_type", "p_size"]],
+               left_on="ps_partkey", right_on="p_partkey")
+    d = m[["p_brand", "p_type", "p_size", "ps_suppkey"]].drop_duplicates()
+    want = d.groupby(["p_brand", "p_type", "p_size"], as_index=False) \
+        .size().rename(columns={"size": "supplier_cnt"})
+    want = want.astype({"supplier_cnt": got["supplier_cnt"].dtype,
+                        "p_size": got["p_size"].dtype})
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q17(data, t):
+    got = tpch.q17(t).collect()[0][0]
+    l, p = data["lineitem"], data["part"]
+    pp = set(p[(p.p_brand == "Brand#23") &
+               (p.p_container == "MED BOX")].p_partkey)
+    m = l[l.l_partkey.isin(pp)].copy()
+    m["lim"] = m.groupby("l_partkey")["l_quantity"].transform("mean") * 0.2
+    want = m[m.l_quantity < m.lim].l_extendedprice.sum() / 7.0
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_q18(data, t):
+    threshold = 120.0
+    got = tpch.q18(t, threshold=threshold).to_pandas()
+    l, o, c = data["lineitem"], data["orders"], data["customer"]
+    per = l.groupby("l_orderkey", as_index=False).agg(
+        sum_qty=("l_quantity", "sum"))
+    big = per[per.sum_qty > threshold]
+    j = o.merge(big, left_on="o_orderkey", right_on="l_orderkey") \
+        .merge(c[["c_custkey", "c_name"]], left_on="o_custkey",
+               right_on="c_custkey")
+    want = j[["c_name", "o_custkey", "o_orderkey", "o_orderdate",
+              "o_totalprice", "sum_qty"]] \
+        .sort_values(["o_totalprice", "o_orderdate"],
+                     ascending=[False, True]).head(100) \
+        .reset_index(drop=True)
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q19(data, t):
+    got = tpch.q19(t).collect()[0][0]
+    l, p = data["lineitem"], data["part"]
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    g1 = (j.p_brand.str.startswith("Brand#1") &
+          j.p_container.isin(["SM CASE", "SM BOX"]) &
+          (j.l_quantity >= 1) & (j.l_quantity <= 11) &
+          (j.p_size >= 1) & (j.p_size <= 15))
+    g2 = (j.p_brand.str.startswith("Brand#2") &
+          j.p_container.isin(["MED BAG", "MED BOX"]) &
+          (j.l_quantity >= 10) & (j.l_quantity <= 20) &
+          (j.p_size >= 1) & (j.p_size <= 25))
+    g3 = (j.p_brand.str.startswith("Brand#3") &
+          j.p_container.isin(["LG CASE", "LG BOX"]) &
+          (j.l_quantity >= 20) & (j.l_quantity <= 30) &
+          (j.p_size >= 1) & (j.p_size <= 35))
+    common = (j.l_shipmode.isin(["AIR", "REG AIR"]) &
+              (j.l_shipinstruct == "DELIVER IN PERSON"))
+    m = j[common & (g1 | g2 | g3)]
+    assert len(m) > 0
+    want = (m.l_extendedprice * (1 - m.l_discount)).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_q20(data, t):
+    got = tpch.q20(t).to_pandas()
+    l, p, ps, s, n = (data["lineitem"], data["part"], data["partsupp"],
+                      data["supplier"], data["nation"])
+    pp = set(p[p.p_name.str.startswith("forest")].p_partkey)
+    ll = l[(l.l_shipdate >= pd.Timestamp("1994-01-01")) &
+           (l.l_shipdate < pd.Timestamp("1995-01-01"))]
+    qty = ll.groupby(["l_partkey", "l_suppkey"], as_index=False).agg(
+        q=("l_quantity", "sum"))
+    m = ps[ps.ps_partkey.isin(pp)] \
+        .merge(qty, left_on=["ps_partkey", "ps_suppkey"],
+               right_on=["l_partkey", "l_suppkey"])
+    good = set(m[m.ps_availqty > 0.5 * m.q].ps_suppkey)
+    canada = set(n[n.n_name == "CANADA"].n_nationkey)
+    sel = s[s.s_suppkey.isin(good) & s.s_nationkey.isin(canada)]
+    want = sel[["s_name", "s_address"]].sort_values("s_name") \
+        .reset_index(drop=True)
+    _cmp(got, want)
+
+
+def test_q21(data, t):
+    got = tpch.q21(t).to_pandas()
+    l, o, s, n = (data["lineitem"], data["orders"], data["supplier"],
+                  data["nation"])
+    pairs = l[["l_orderkey", "l_suppkey"]].drop_duplicates()
+    n_supp = pairs.groupby("l_orderkey").size()
+    late = l[l.l_receiptdate > l.l_commitdate]
+    late_pairs = late[["l_orderkey", "l_suppkey"]].drop_duplicates()
+    n_late = late_pairs.groupby("l_orderkey").size()
+    fkeys = set(o[o.o_orderstatus == "F"].o_orderkey)
+    l1 = late[late.l_orderkey.isin(fkeys)].copy()
+    l1["n_supp"] = l1.l_orderkey.map(n_supp)
+    l1["n_late"] = l1.l_orderkey.map(n_late)
+    l1 = l1[(l1.n_supp > 1) & (l1.n_late == 1)]
+    saudi = set(n[n.n_name == "SAUDI ARABIA"].n_nationkey)
+    ss = s[s.s_nationkey.isin(saudi)][["s_suppkey", "s_name"]]
+    j = l1.merge(ss, left_on="l_suppkey", right_on="s_suppkey")
+    want = j.groupby("s_name", as_index=False).size() \
+        .rename(columns={"size": "numwait"}) \
+        .sort_values(["numwait", "s_name"], ascending=[False, True]) \
+        .head(100).reset_index(drop=True)
+    want = want.astype({"numwait": got["numwait"].dtype}) \
+        if len(want) else want
+    assert len(want) > 0
+    _cmp(got, want)
+
+
+def test_q22(data, t):
+    got = tpch.q22(t).to_pandas()
+    c, o = data["customer"], data["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cust = c[c.c_phone.str[:2].isin(codes)].copy()
+    avg_bal = cust[cust.c_acctbal > 0.0].c_acctbal.mean()
+    good = cust[cust.c_acctbal > avg_bal]
+    noord = good[~good.c_custkey.isin(set(o.o_custkey))].copy()
+    noord["cntrycode"] = noord.c_phone.str[:2]
+    want = noord.groupby("cntrycode", as_index=False).agg(
+        numcust=("c_custkey", "count"), totacctbal=("c_acctbal", "sum"))
+    want = want.astype({"numcust": got["numcust"].dtype})
+    assert len(want) > 0
+    _cmp(got, want)
