@@ -105,7 +105,30 @@ class ColumnarBatch:
                               capacity=capacity)
 
     def to_arrow(self):
+        import jax
         import pyarrow as pa
+        # One device_get for every buffer in the batch: each separate
+        # np.asarray(device_array) pays a full device->host round trip
+        # (dominant with a remote-tunnel device), so gather all columns'
+        # values/validity/offsets in a single transfer first.
+        device_bufs = []
+        seen = set()
+        for c in self.columns.values():
+            for buf in (c.data, c.validity, c.offsets):
+                if buf is not None and not isinstance(buf, np.ndarray) \
+                        and id(buf) not in seen:
+                    seen.add(id(buf))
+                    device_bufs.append(buf)
+        if device_bufs:
+            fetched = jax.device_get(device_bufs)
+            cache = {id(d): h for d, h in zip(device_bufs, fetched)}
+            cols = {}
+            for n, c in self.columns.items():
+                cols[n] = Column(
+                    c.dtype, cache.get(id(c.data), c.data), c.nrows,
+                    validity=cache.get(id(c.validity), c.validity),
+                    offsets=cache.get(id(c.offsets), c.offsets))
+            return pa.table({n: c.to_arrow() for n, c in cols.items()})
         return pa.table({n: c.to_arrow() for n, c in self.columns.items()})
 
     def to_pandas(self):

@@ -117,6 +117,10 @@ class TpuHashAggregateExec(TpuExec):
         self._update_kinds = tuple(s.kind for s in self._buf_specs)
         self._merge_kinds = tuple(_merge_kind(k) for k in self._update_kinds)
 
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        base_sig = (tuple(dt.name for dt in self._in_dtypes),
+                    tuple(e.cache_key() for e in self.group_exprs),
+                    tuple(f.cache_key() for f in self.funcs))
         if self._string_key_idx:
             # stage A evaluates keys + agg children; the group kernel runs in
             # stage B after host dictionary encoding of string keys
@@ -125,8 +129,15 @@ class TpuHashAggregateExec(TpuExec):
             self._pre_fn = StageFn(pre_exprs, self._in_dtypes)
         else:
             self._pre_fn = None
-            self._update_fn = jax.jit(self._update_fused)
-        self._merge_fn = jax.jit(self._merge)
+            update_sig = ("agg_update",) + base_sig + (
+                self.pre_filter.cache_key()
+                if self.pre_filter is not None else None,)
+            self._update_fn = cached_jit(update_sig,
+                                         lambda: self._update_fused)
+        # merge never evaluates pre_filter: exclude it so queries differing
+        # only in filter constants share the merge executable
+        self._merge_fn = cached_jit(("agg_merge",) + base_sig,
+                                    lambda: self._merge)
 
     # ------------------------------------------------------------------ plan --
     @property
@@ -211,7 +222,10 @@ class TpuHashAggregateExec(TpuExec):
                 else:
                     key_flat, buf_flat, n = self._update_fn(
                         batch_to_flat(batch), jnp.int32(batch.nrows))
-                    n = int(n)
+                    # keyless reductions have statically one output row;
+                    # skip the device->host sync (it costs a full tunnel
+                    # round-trip per batch)
+                    n = 1 if not self.group_exprs else int(n)
                     outs = [ColVal(dt, v, val, offs)
                             for dt, (v, val, offs) in
                             zip(dtypes, list(key_flat) + list(buf_flat))]
@@ -284,7 +298,7 @@ class TpuHashAggregateExec(TpuExec):
         with self.timer(AGG_TIME):
             key_flat, res_flat, n = self._merge_fn(
                 batch_to_flat(merged_in), jnp.int32(merged_in.nrows))
-            n = int(n)
+            n = 1 if not self.group_exprs else int(n)
         out_names = [name for name, _ in self.schema]
         outs: List[ColVal] = []
         for i, (e, (v, val, offs)) in enumerate(zip(self.group_exprs,

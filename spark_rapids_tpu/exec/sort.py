@@ -38,7 +38,11 @@ class TpuSortExec(TpuExec):
         self._key_fn = StageFn([e for e, _, _ in orders],
                                [dt for _, dt in child.schema])
         self._register_metric(SORT_TIME)
-        self._sort = jax.jit(self._sort_batch)
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        sig = ("sort", tuple((e.cache_key(), d, nf)
+                             for e, d, nf in self.orders),
+               tuple(dt.name for _, dt in child.schema))
+        self._sort = cached_jit(sig, lambda: self._sort_batch)
 
     @property
     def child(self) -> TpuExec:

@@ -169,7 +169,11 @@ class TpuWindowExec(TpuExec):
         from spark_rapids_tpu.exec.aggregate import _StringKeyEncoder
         self._encoders = {i: _StringKeyEncoder()
                           for i in self._string_part_idx}
-        self._kernel = jax.jit(self._run)
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        sig = ("window",
+               tuple(we.cache_key() for _, we in self.window_exprs),
+               tuple(dt.name for dt in in_dtypes))
+        self._kernel = cached_jit(sig, lambda: self._run)
 
     @property
     def child(self) -> TpuExec:

@@ -382,11 +382,36 @@ def groupby_aggregate(keys: Sequence[ColVal],
 
 def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
                      nrows, capacity: int, row_mask=None) -> List[ColVal]:
-    """Grand-total (no keys) reduction: one output row per buffer."""
+    """Grand-total (no keys) reduction: one output row per buffer.
+
+    Dense masked reductions, NOT segment ops: XLA lowers segment_* to
+    scatter, which serializes on TPU; a masked jnp.sum/min/max is a native
+    tree reduction on the VPU (orders of magnitude faster at multi-million
+    row capacities)."""
     valid_rows = _row_mask(nrows, capacity, row_mask)
-    seg = jnp.where(valid_rows, 0, 1)
     outs: List[ColVal] = []
     for kind, c in buffer_inputs:
-        vals, counts = _segment_reduce(kind, c, seg, 2, valid_rows)
-        outs.append(ColVal(c.dtype, vals[:1], (counts > 0)[:1]))
+        contrib_valid = valid_rows if c.validity is None else \
+            jnp.logical_and(valid_rows, c.validity)
+        count = contrib_valid.astype(jnp.int64).sum()
+        if kind == "sum":
+            out = jnp.where(contrib_valid, c.values,
+                            jnp.zeros((), dtype=c.values.dtype)).sum()
+        elif kind == "min":
+            out = jnp.where(contrib_valid, c.values,
+                            _sentinel("min", c.values.dtype)).min()
+        elif kind == "max":
+            out = jnp.where(contrib_valid, c.values,
+                            _sentinel("max", c.values.dtype)).max()
+        elif kind in ("first", "last"):
+            n = c.values.shape[0]
+            idx = jnp.arange(n, dtype=jnp.int64)
+            if kind == "first":
+                best = jnp.where(contrib_valid, idx, n).min()
+            else:
+                best = jnp.where(contrib_valid, idx, -1).max()
+            out = c.values[jnp.clip(best, 0, n - 1).astype(jnp.int32)]
+        else:
+            raise ValueError(f"unknown reduce kind {kind}")
+        outs.append(ColVal(c.dtype, out[None], (count > 0)[None]))
     return outs

@@ -72,9 +72,12 @@ class StageFn:
 
     def __init__(self, exprs: Sequence[Expression],
                  input_dtypes: Sequence[DataType]):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.exprs = list(exprs)
         self.input_dtypes = list(input_dtypes)
-        self._jitted = jax.jit(self._run)
+        sig = ("stage", tuple(e.cache_key() for e in self.exprs),
+               tuple(dt.name for dt in self.input_dtypes))
+        self._jitted = cached_jit(sig, lambda: self._run)
 
     def _run(self, flat_cols, nrows):
         capacity = capacity_of(flat_cols) if flat_cols else 0
@@ -101,10 +104,14 @@ class FilterStageFn:
 
     def __init__(self, predicate: Expression, project: Sequence[Expression],
                  input_dtypes: Sequence[DataType]):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.predicate = predicate
         self.project = list(project)
         self.input_dtypes = list(input_dtypes)
-        self._jitted = jax.jit(self._run)
+        sig = ("filter_stage", self.predicate.cache_key(),
+               tuple(e.cache_key() for e in self.project),
+               tuple(dt.name for dt in self.input_dtypes))
+        self._jitted = cached_jit(sig, lambda: self._run)
 
     def _run(self, flat_cols, nrows):
         from spark_rapids_tpu.ops import selection

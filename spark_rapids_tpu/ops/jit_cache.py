@@ -1,0 +1,53 @@
+"""Process-wide cache of jitted executables keyed by structural signature.
+
+Physical plans are rebuilt per query, so per-instance ``jax.jit(bound
+method)`` would recompile the same XLA program on every run — the dominant
+cost for repeated queries (an aggregate stage costs seconds to compile,
+microseconds to run).  The reference relies on cudf's precompiled kernels;
+the TPU analog is this cache: executables are shared across plan instances
+whose expression forests are structurally identical (``Expression.
+cache_key`` includes literal values, so constants bake correctly).
+
+The cached callable still goes through jax.jit's own shape-bucket cache, so
+one signature may hold several XLA executables (one per input capacity).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Hashable
+
+import jax
+
+# LRU-bounded: cached entries close over their originating plan instance
+# (and thus its child tree), so an unbounded map would pin every distinct
+# query shape ever run.  256 signatures comfortably covers a working set
+# of queries while keeping retention bounded.
+_MAX_ENTRIES = 256
+_CACHE: "OrderedDict[Hashable, Callable]" = OrderedDict()
+
+
+def cached_jit(signature: Hashable, make: Callable[[], Callable],
+               **jit_kwargs: Any) -> Callable:
+    """Return a jitted callable for ``signature``; build via ``make()`` on
+    miss.  ``make`` returns the plain (untraced) function to jit — it is
+    only invoked when the signature is new, so closures over a freshly
+    constructed plan instance are safe as long as everything the function's
+    trace depends on is captured in the signature."""
+    fn = _CACHE.get(signature)
+    if fn is None:
+        fn = jax.jit(make(), **jit_kwargs)
+        _CACHE[signature] = fn
+        while len(_CACHE) > _MAX_ENTRIES:
+            _CACHE.popitem(last=False)
+    else:
+        _CACHE.move_to_end(signature)
+    return fn
+
+
+def cache_info() -> Dict[str, int]:
+    return {"entries": len(_CACHE)}
+
+
+def clear() -> None:
+    _CACHE.clear()

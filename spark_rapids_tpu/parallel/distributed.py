@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import aggregates as agg
@@ -54,11 +54,20 @@ class DistributedAggregate:
                 slice(len(self._buf_specs), len(self._buf_specs) + len(specs)))
             self._buf_specs.extend(specs)
 
-        shard = NamedSharding(mesh, P(self.axis))
-        self._jitted = jax.jit(
-            jax.shard_map(self._step, mesh=mesh,
-                          in_specs=(P(self.axis), P(self.axis)),
-                          out_specs=P(self.axis), check_vma=False))
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        sig = ("dist_agg", tuple(self.mesh.axis_names),
+               tuple(self.mesh.devices.shape),
+               tuple(str(d) for d in self.mesh.devices.flat),
+               tuple(dt.name for dt in self.in_dtypes),
+               tuple(e.cache_key() for e in self.group_exprs),
+               tuple(f.cache_key() for f in self.funcs),
+               self.filter_cond.cache_key()
+               if self.filter_cond is not None else None)
+        self._jitted = cached_jit(
+            sig, lambda: jax.shard_map(
+                self._step, mesh=mesh,
+                in_specs=(P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
 
     # ---- SPMD body (runs per shard) -----------------------------------------
     def _step(self, flat_cols, nrows_arr):
