@@ -37,6 +37,28 @@ def _read_file_to_table(path: str, file_format: str,
     return dataset.to_table(columns=columns, filter=filter_expr)
 
 
+def _decode_bytes(blob: bytes, file_format: str,
+                  columns: Optional[List[str]], filter_expr) -> pa.Table:
+    """Decode one file's raw bytes (from the native prefetcher) to Arrow."""
+    reader = pa.BufferReader(blob)
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+        # filters= keeps row-group statistics pruning even from a buffer
+        t = pq.read_table(reader, columns=columns, filters=filter_expr)
+        filter_expr = None
+    elif file_format == "orc":
+        import pyarrow.orc as orc
+        t = orc.ORCFile(reader).read(columns=columns)
+    else:
+        import pyarrow.csv as pacsv
+        t = pacsv.read_csv(reader)
+        if columns is not None:
+            t = t.select(columns)
+    if filter_expr is not None:
+        t = t.filter(filter_expr)
+    return t
+
+
 def iter_file_tables(paths: Sequence[str], file_format: str,
                      columns: Optional[List[str]], filter_expr,
                      reader_type: str, batch_rows: int,
@@ -57,6 +79,29 @@ def iter_file_tables(paths: Sequence[str], file_format: str,
         return
 
     if reader_type == "MULTITHREADED":
+        from spark_rapids_tpu import native
+        if native.available() and file_format in ("parquet", "orc", "csv"):
+            # native thread pool reads raw bytes (GIL-free IO) while this
+            # thread decodes prior files — the background-read + decode
+            # overlap of MultiFileCloudParquetPartitionReader.  A sliding
+            # window of max_files_parallel bounds resident raw bytes (and
+            # teardown work on early generator close, e.g. LIMIT queries).
+            pf = native.FilePrefetcher(num_threads)
+            try:
+                all_paths = list(paths)
+                window = max(max_files_parallel, 1)
+                submitted = min(window, len(all_paths))
+                pf.submit(all_paths[:submitted])
+                for i in range(len(all_paths)):
+                    blob = pf.get(i)
+                    if submitted < len(all_paths):
+                        pf.submit([all_paths[submitted]])
+                        submitted += 1
+                    yield _decode_bytes(blob, file_format, columns,
+                                        filter_expr)
+            finally:
+                pf.close()
+            return
         with concurrent.futures.ThreadPoolExecutor(num_threads) as pool:
             pending = []
             it = iter(paths)

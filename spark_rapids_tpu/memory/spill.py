@@ -96,9 +96,16 @@ class SpillableHandle:
 
     def spill_to_disk(self) -> int:
         assert self.tier == HOST
-        path = os.path.join(self.catalog.spill_dir, f"buf-{self.id}.npz")
-        arrays = {k: v for k, v in self._host.items() if k != "__nrows"}
-        np.savez(path, **arrays)
+        from spark_rapids_tpu import native
+        path = os.path.join(self.catalog.spill_dir, f"buf-{self.id}.tcf")
+        cols = []
+        for name, dt in self._schema:
+            cols.append((native.dtype_code(dt),
+                         self._host.get(f"{name}.data"),
+                         self._host.get(f"{name}.validity"),
+                         self._host.get(f"{name}.offsets")))
+        blob = native.serialize_batch(self._nrows, cols)
+        native.write_spill_file(path, blob)
         self._disk_path = path
         self._host = None
         self.tier = DISK
@@ -115,9 +122,19 @@ class SpillableHandle:
             payload = self._host
             batch = self._rebuild(lambda k: payload.get(k))
         else:
-            with np.load(self._disk_path) as z:
-                batch = self._rebuild(
-                    lambda k, z=z: z[k] if k in z.files else None)
+            from spark_rapids_tpu import native
+            blob = native.read_spill_file(self._disk_path)
+            _, cols = native.deserialize_batch(blob)
+            payload = {}
+            for (name, dt), (_, d, v, o) in zip(self._schema, cols):
+                if d is not None:
+                    payload[f"{name}.data"] = d if dt.is_string else \
+                        d.view(dt.storage)
+                if v is not None:
+                    payload[f"{name}.validity"] = v.view(np.bool_)
+                if o is not None:
+                    payload[f"{name}.offsets"] = o.view(np.int32)
+            batch = self._rebuild(lambda k: payload.get(k))
         self.catalog.unspill(self, batch)
         return batch
 
@@ -146,6 +163,11 @@ class SpillableBatchCatalog:
         self.device_budget = device_budget
         self.host_budget = host_budget
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpu-spill-")
+        # warm the native library now: its first load may shell out to g++
+        # (up to ~2min); doing it lazily inside spill_to_disk would stall
+        # every thread behind the catalog lock
+        from spark_rapids_tpu import native
+        native.available()
         self._lock = threading.Lock()
         self._handles: Dict[int, SpillableHandle] = {}
         self.device_bytes = 0
