@@ -389,6 +389,27 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
     tree reduction on the VPU (orders of magnitude faster at multi-million
     row capacities)."""
     valid_rows = _row_mask(nrows, capacity, row_mask)
+    # all-float all-sum shape (count buffers are int sums handled below):
+    # fuse every column into one HBM pass on TPU via the pallas kernel
+    from spark_rapids_tpu.ops import pallas_kernels as pk
+    import os
+    float_sums = [(k, c) for k, c in buffer_inputs
+                  if k == "sum" and jnp.issubdtype(c.values.dtype,
+                                                  jnp.floating)]
+    # opt-in until f64-in-pallas is validated on the target chip
+    # (interpret-mode tests pass; hardware lowering of f64 is the risk)
+    if pk.use_pallas() and \
+            os.environ.get("SPARK_RAPIDS_TPU_PALLAS_REDUCE") and \
+            len(float_sums) == len(buffer_inputs) and buffer_inputs:
+        vals = [c.values for _, c in buffer_inputs]
+        valids = [jnp.ones(capacity, dtype=jnp.bool_)
+                  if c.validity is None else c.validity
+                  for _, c in buffer_inputs]
+        sums, cnts = pk.masked_multi_reduce(vals, valids, valid_rows,
+                                            interpret=False)
+        return [ColVal(c.dtype, sums[i:i + 1].astype(c.values.dtype),
+                       (cnts[i:i + 1] > 0))
+                for i, (_, c) in enumerate(buffer_inputs)]
     outs: List[ColVal] = []
     for kind, c in buffer_inputs:
         contrib_valid = valid_rows if c.validity is None else \

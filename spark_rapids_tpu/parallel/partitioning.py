@@ -97,14 +97,18 @@ def layout_by_partition(cols: Sequence[ColVal], pids: jnp.ndarray,
     """
     from spark_rapids_tpu.ops import selection
 
+    from spark_rapids_tpu.ops import pallas_kernels as pk
+
     capacity = pids.shape[0]
     row_mask = jnp.arange(capacity, dtype=jnp.int32) < nrows
     sort_key = jnp.where(row_mask, pids, num_parts)
     perm = jnp.argsort(sort_key, stable=True).astype(jnp.int32)
     sorted_cols = selection.gather(cols, perm, nrows)
-    counts = jax.ops.segment_sum(
-        jnp.where(row_mask, 1, 0), sort_key, num_segments=num_parts + 1
-    )[:num_parts].astype(jnp.int32)
+    # per-destination counts: pallas one-hot accumulation on TPU (XLA's
+    # segment_sum lowers to a serialized scatter there), one-hot matmul
+    # fallback elsewhere
+    counts = pk.histogram(pids.astype(jnp.int32), row_mask,
+                          num_parts).astype(jnp.int32)
     starts = jnp.concatenate(
         [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(counts)[:-1]])
     return sorted_cols, counts, starts
