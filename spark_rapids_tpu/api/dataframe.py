@@ -147,9 +147,37 @@ class DataFrame:
 
     # --------------------------------------------------------------- actions --
     def _execute_batches(self) -> List[ColumnarBatch]:
+        import time as _time
         exec_plan = self.session.plan(self.plan)
         self._last_exec = exec_plan
-        return list(exec_plan.execute())
+        events = getattr(self.session, "events", None)
+        if events is None or not events.enabled:
+            return list(exec_plan.execute())
+        qid = next(self.session._query_ids)
+        events.emit("QueryStart", queryId=qid,
+                    logicalPlan=self.plan.tree_string(),
+                    physicalPlan=exec_plan.tree_string(),
+                    explain=self.session.overrides.last_explain)
+        cat = getattr(self.session, "memory_catalog", None)
+        host0 = cat.spilled_to_host_total if cat else 0
+        disk0 = cat.spilled_to_disk_total if cat else 0
+        t0 = _time.perf_counter()
+        status = "success"
+        try:
+            return list(exec_plan.execute())
+        except Exception as e:
+            status = f"failed: {type(e).__name__}: {e}"
+            raise
+        finally:
+            # per-query deltas of the session-cumulative spill counters
+            spill = {} if cat is None else {
+                "spilledToHostBytes": cat.spilled_to_host_total - host0,
+                "spilledToDiskBytes": cat.spilled_to_disk_total - disk0,
+            }
+            events.emit(
+                "QueryEnd", queryId=qid, status=status,
+                durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
+                metrics=exec_plan.collect_metrics(), spill=spill)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -54,7 +54,25 @@ class TpuSession:
         self.conf = conf or RapidsConf()
         self.overrides = TpuOverrides(self.conf)
         self._init_memory()
+        self._init_observability()
         TpuSession._active = self
+
+    def _init_observability(self) -> None:
+        import itertools
+        import uuid
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.utils.events import EventLogger
+        self._query_ids = itertools.count(1)
+        self.session_id = uuid.uuid4().hex[:12]
+        self.events = EventLogger(
+            self.conf.get(rc.EVENT_LOG_DIR) or None, self.session_id,
+            conf_snapshot=dict(self.conf.settings))
+
+    def stop(self) -> None:
+        """Close the session's observability resources (SessionEnd)."""
+        self.events.close()
+        if TpuSession._active is self:
+            TpuSession._active = None
 
     def _init_memory(self) -> None:
         """GpuDeviceManager.initializeGpuAndMemory analog: size the spill
@@ -129,7 +147,15 @@ class TpuSession:
 
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan):
-        return self.overrides.apply(logical)
+        from spark_rapids_tpu.config import rapids_conf as rc
+        exec_plan = self.overrides.apply(logical)
+        if self.conf.get(rc.PROFILE_TRACE):
+            def mark(node):
+                node.trace_ops = True
+                for c in node.children:
+                    mark(c)
+            mark(exec_plan)
+        return exec_plan
 
 
 class SessionBuilder:

@@ -89,6 +89,19 @@ EXPLAIN = conf(
     lambda v: None if v in ("NONE", "NOT_ON_TPU", "ALL") else
     "must be NONE, NOT_ON_TPU or ALL")
 
+EVENT_LOG_DIR = conf(
+    "spark.rapids.tpu.eventLog.dir", "",
+    "Directory for the session's JSON-lines query event log (plans, per-op "
+    "metrics, spill stats). Empty disables logging. Consumed by the "
+    "qualification/profiling tools (reference analog: Spark event logs + "
+    "GpuMetric -> SQLMetrics).", str)
+
+PROFILE_TRACE = conf(
+    "spark.rapids.tpu.profile.trace", False,
+    "Wrap each operator's execution in a jax.profiler TraceAnnotation so "
+    "per-op ranges appear in XPlane/perfetto captures (the NVTX-range "
+    "analog, NvtxWithMetrics.scala).", _to_bool)
+
 BATCH_SIZE_BYTES = conf(
     "spark.rapids.sql.batchSizeBytes", 1 << 31,
     "Target size in bytes for columnar batches; hard-capped at 2 GiB "

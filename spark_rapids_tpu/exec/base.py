@@ -65,6 +65,11 @@ class MetricTimer:
 class TpuExec:
     """Base physical operator."""
 
+    # per-plan: set by the planner from spark.rapids.tpu.profile.trace;
+    # when True each iteration step wraps in a jax.profiler
+    # TraceAnnotation (NVTX-range analog)
+    trace_ops = False
+
     def __init__(self, *children: "TpuExec"):
         self.children: Tuple[TpuExec, ...] = tuple(children)
         self.metrics: Dict[str, TpuMetric] = {}
@@ -88,10 +93,29 @@ class TpuExec:
         raise NotImplementedError
 
     def execute(self) -> Iterator[ColumnarBatch]:
-        """Produce device batches, updating numOutputRows/Batches."""
-        with self.timer(OP_TIME):
-            it = self.do_execute()
-        for batch in it:
+        """Produce device batches, updating numOutputRows/Batches.
+
+        opTime covers the operator's own iteration steps (the pull of each
+        batch), not just generator construction — generators return
+        instantly, the work happens in ``next()``."""
+        trace = None
+        if self.trace_ops:
+            from jax.profiler import TraceAnnotation
+            trace = TraceAnnotation
+        it = self.do_execute()
+        timer = self.metrics[OP_TIME]
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                if trace is not None:
+                    with trace(self.node_name()):
+                        batch = next(it)
+                else:
+                    batch = next(it)
+            except StopIteration:
+                timer.add(time.perf_counter_ns() - t0)
+                return
+            timer.add(time.perf_counter_ns() - t0)
             self.metrics[NUM_OUTPUT_ROWS] += batch.nrows
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield batch
@@ -107,21 +131,24 @@ class TpuExec:
         return self.node_name()
 
     def tree_string(self) -> str:
-        lines: List[str] = []
-
-        def rec(node, depth):
-            lines.append("  " * depth + node.describe())
-            for c in node.children:
-                rec(c, depth + 1)
-        rec(self, 0)
-        return "\n".join(lines)
+        from spark_rapids_tpu.utils.trees import render_tree
+        return render_tree(self)
 
     def collect_metrics(self) -> Dict[str, Dict[str, int]]:
+        """Per-node metric dicts keyed by tree path.  ``opTime`` is
+        inclusive of the child subtree (iterator pulls); the derived
+        ``opTimeSelf`` subtracts direct children so consumers can
+        aggregate without double counting."""
         out = {}
 
         def rec(node, path):
             key = f"{path}{node.node_name()}"
-            out[key] = {m.name: m.value for m in node.metrics.values()}
+            m = {metric.name: metric.value
+                 for metric in node.metrics.values()}
+            child_time = sum(c.metrics[OP_TIME].value
+                             for c in node.children)
+            m["opTimeSelf"] = max(m.get(OP_TIME, 0) - child_time, 0)
+            out[key] = m
             for i, c in enumerate(node.children):
                 rec(c, f"{key}.{i}.")
         rec(self, "")

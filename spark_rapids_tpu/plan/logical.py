@@ -84,6 +84,10 @@ class LogicalPlan:
     def describe(self) -> str:
         return self.node_name()
 
+    def tree_string(self) -> str:
+        from spark_rapids_tpu.utils.trees import render_tree
+        return render_tree(self)
+
 
 class InMemoryRelation(LogicalPlan):
     def __init__(self, batches: Sequence[ColumnarBatch], schema: Schema):

@@ -1,0 +1,102 @@
+"""Shared event-log parsing for the qualification and profiling tools.
+
+Reads the JSON-lines files the engine writes (utils/events.py), grouping
+records into per-session ``AppInfo`` objects with per-query details —
+the role ``ApplicationInfo``/``EventsProcessor`` play in the reference's
+tools module (tools/src/main/.../profiling/ApplicationInfo.scala).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class QueryInfo:
+    query_id: int
+    logical_plan: str = ""
+    physical_plan: str = ""
+    explain: str = ""
+    status: str = ""
+    duration_ms: float = 0.0
+    metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    spill: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def succeeded(self) -> bool:
+        return self.status == "success"
+
+    def op_names(self) -> List[str]:
+        return [line.strip() for line in self.physical_plan.splitlines()]
+
+    def fallback_ops(self) -> List[str]:
+        return [op for op in self.op_names()
+                if op.startswith("CpuFallbackExec")]
+
+    def op_time_ns(self) -> Dict[str, int]:
+        """Per-exec-node opTime; keys are metric-tree paths."""
+        return {k: v.get("opTime", 0) for k, v in self.metrics.items()}
+
+
+@dataclass
+class AppInfo:
+    session_id: str
+    path: str
+    conf: Dict[str, str] = field(default_factory=dict)
+    queries: List[QueryInfo] = field(default_factory=list)
+
+    @property
+    def total_duration_ms(self) -> float:
+        return sum(q.duration_ms for q in self.queries)
+
+
+def parse_event_log(path: str) -> AppInfo:
+    app = AppInfo(session_id=os.path.basename(path), path=path)
+    open_queries: Dict[int, QueryInfo] = {}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write at the tail of a live log
+            ev = rec.get("event")
+            if ev == "SessionStart":
+                app.conf = rec.get("conf", {})
+                app.session_id = rec.get("sessionId", app.session_id)
+            elif ev == "QueryStart":
+                q = QueryInfo(rec["queryId"],
+                              logical_plan=rec.get("logicalPlan", ""),
+                              physical_plan=rec.get("physicalPlan", ""),
+                              explain=rec.get("explain", ""))
+                open_queries[q.query_id] = q
+            elif ev == "QueryEnd":
+                q = open_queries.pop(rec["queryId"],
+                                     QueryInfo(rec["queryId"]))
+                q.status = rec.get("status", "")
+                q.duration_ms = rec.get("durationMs", 0.0)
+                q.metrics = rec.get("metrics", {})
+                q.spill = rec.get("spill", {})
+                app.queries.append(q)
+    # queries that started but never ended (crash) count as failed
+    for q in open_queries.values():
+        q.status = "incomplete"
+        app.queries.append(q)
+    return app
+
+
+def load_logs(log_dir_or_file: str) -> List[AppInfo]:
+    if os.path.isdir(log_dir_or_file):
+        paths = sorted(glob.glob(os.path.join(log_dir_or_file,
+                                              "tpu-events-*.jsonl")))
+    elif os.path.isfile(log_dir_or_file):
+        paths = [log_dir_or_file]
+    else:
+        return []
+    return [parse_event_log(p) for p in paths]

@@ -1,0 +1,152 @@
+"""Profiling tool: aggregate per-op metrics, plan graphs, health checks.
+
+CLI over engine event logs — the role of the reference's profiling tool
+(tools/src/main/.../profiling/ProfileMain.scala: CollectInformation,
+Analysis, HealthCheck, GenerateDot): per-operator time/row aggregation
+across queries, the slowest queries, spill totals, query-duration skew,
+a DOT graph of any query's physical plan, and a health check listing
+failures.
+
+Usage:  python -m spark_rapids_tpu.tools.profiling LOGDIR
+            [--dot QUERYID] [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from spark_rapids_tpu.tools.eventlog import AppInfo, QueryInfo, load_logs
+
+
+def aggregate_ops(apps: List[AppInfo]) -> List[Tuple[str, float, int, int]]:
+    """[(op_name, total opTime ms, total rows, occurrences)] sorted by
+    time desc."""
+    time_ns: Dict[str, int] = defaultdict(int)
+    rows: Dict[str, int] = defaultdict(int)
+    count: Dict[str, int] = defaultdict(int)
+    for app in apps:
+        for q in app.queries:
+            for path, m in q.metrics.items():
+                name = path.rsplit(".", 1)[-1]
+                time_ns[name] += m.get("opTimeSelf", m.get("opTime", 0))
+                rows[name] += m.get("numOutputRows", 0)
+                count[name] += 1
+    out = [(n, time_ns[n] / 1e6, rows[n], count[n]) for n in time_ns]
+    out.sort(key=lambda t: -t[1])
+    return out
+
+
+def slowest_queries(apps: List[AppInfo], top: int
+                    ) -> List[Tuple[str, QueryInfo]]:
+    pairs = [(a.session_id, q) for a in apps for q in a.queries]
+    pairs.sort(key=lambda p: -p[1].duration_ms)
+    return pairs[:top]
+
+
+def skew_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    durs = [q.duration_ms for a in apps for q in a.queries if q.succeeded]
+    if not durs:
+        return {}
+    mean = statistics.fmean(durs)
+    return {
+        "queries": len(durs),
+        "mean_ms": mean,
+        "p50_ms": statistics.median(durs),
+        "max_ms": max(durs),
+        "skew_ratio": (max(durs) / mean) if mean else 0.0,
+    }
+
+
+def health_check(apps: List[AppInfo]) -> List[str]:
+    problems = []
+    for a in apps:
+        for q in a.queries:
+            if not q.succeeded:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: {q.status}")
+            spilled = sum(q.spill.values()) if q.spill else 0
+            if spilled:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: spilled "
+                    f"{spilled} bytes")
+    return problems
+
+
+def plan_dot(q: QueryInfo) -> str:
+    """Physical plan as a DOT digraph (GenerateDot.scala analog)."""
+    lines = q.physical_plan.splitlines()
+    out = ["digraph plan {", "  rankdir=BT;",
+           '  node [shape=box, fontname="monospace"];']
+    # indentation encodes the tree
+    stack: List[Tuple[int, int]] = []  # (depth, node_id)
+    for i, raw in enumerate(lines):
+        depth = (len(raw) - len(raw.lstrip())) // 2
+        label = raw.strip().replace('"', r'\"')
+        out.append(f'  n{i} [label="{label}"];')
+        while stack and stack[-1][0] >= depth:
+            stack.pop()
+        if stack:
+            out.append(f"  n{i} -> n{stack[-1][1]};")
+        stack.append((depth, i))
+    out.append("}")
+    return "\n".join(out)
+
+
+def format_report(apps: List[AppInfo], top: int) -> str:
+    out = ["=" * 72, "TPU Profiling Report", "=" * 72]
+    out.append(f"\nSessions: {len(apps)}, queries: "
+               f"{sum(len(a.queries) for a in apps)}")
+    out.append("\n-- Operator aggregate (by total opTime) --")
+    out.append(f"{'operator':40s} {'time_ms':>10s} {'rows':>12s} "
+               f"{'uses':>6s}")
+    for name, ms, rows, n in aggregate_ops(apps)[:top]:
+        out.append(f"{name:40s} {ms:10.2f} {rows:12d} {n:6d}")
+    out.append("\n-- Slowest queries --")
+    for sid, q in slowest_queries(apps, top):
+        out.append(f"  {sid} q{q.query_id}: {q.duration_ms:.1f} ms "
+                   f"[{q.status}]")
+    sk = skew_stats(apps)
+    if sk:
+        out.append("\n-- Duration distribution --")
+        out.append(f"  n={sk['queries']} mean={sk['mean_ms']:.1f}ms "
+                   f"p50={sk['p50_ms']:.1f}ms max={sk['max_ms']:.1f}ms "
+                   f"skew={sk['skew_ratio']:.2f}x")
+    problems = health_check(apps)
+    out.append("\n-- Health check --")
+    if problems:
+        out.extend(f"  ! {p}" for p in problems)
+    else:
+        out.append("  no failures, no spill")
+    return "\n".join(out)
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="spark_rapids_tpu.tools.profiling", description=__doc__)
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--dot", type=int, default=None, metavar="QUERYID",
+                    help="print a DOT graph of this query's physical plan")
+    args = ap.parse_args(argv)
+    apps = load_logs(args.logdir)
+    if not apps:
+        print("no event logs found", file=sys.stderr)
+        return 1
+    if args.dot is not None:
+        for a in apps:
+            for q in a.queries:
+                if q.query_id == args.dot:
+                    print(plan_dot(q))
+                    return 0
+        print(f"query {args.dot} not found", file=sys.stderr)
+        return 1
+    print(format_report(apps, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

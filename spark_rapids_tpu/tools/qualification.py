@@ -1,0 +1,133 @@
+"""Qualification tool: score workloads for TPU acceleration fitness.
+
+CLI over engine event logs (no device needed) — the role of the
+reference's qualification tool (tools/src/main/.../qualification/
+QualificationMain.scala, QualAppInfo.scala): for each session it computes
+how much of the work ran on TPU operators vs CPU fallbacks, surfaces the
+reasons ops stayed on the CPU, and emits a ranked recommendation report
+(text and CSV).
+
+Usage:  python -m spark_rapids_tpu.tools.qualification LOGDIR [-o OUT.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import re
+import sys
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List
+
+from spark_rapids_tpu.tools.eventlog import AppInfo, load_logs
+
+
+@dataclass
+class QualSummary:
+    session_id: str
+    num_queries: int
+    failed_queries: int
+    total_duration_ms: float
+    tpu_op_time_share: float   # opTime on Tpu* execs / all opTime
+    fallback_op_count: int
+    not_on_tpu_reasons: Counter
+    score: float               # 0..100 recommendation
+    recommendation: str
+
+
+_REASON_RE = re.compile(r"because (.+)$")
+
+
+def qualify_app(app: AppInfo) -> QualSummary:
+    tpu_ns = 0
+    cpu_ns = 0
+    fallbacks = 0
+    reasons: Counter = Counter()
+    failed = 0
+    for q in app.queries:
+        if not q.succeeded:
+            failed += 1
+        for path, m in q.metrics.items():
+            name = path.rsplit(".", 1)[-1]
+            # self time (exclusive of children) so nested ops don't
+            # double count; older logs without it fall back to opTime
+            t = m.get("opTimeSelf", m.get("opTime", 0))
+            if name.startswith("CpuFallback"):
+                cpu_ns += t
+            else:
+                tpu_ns += t
+        fallbacks += len(q.fallback_ops())
+        for line in q.explain.splitlines():
+            mm = _REASON_RE.search(line)
+            if mm:
+                reasons[mm.group(1).strip()] += 1
+    total = tpu_ns + cpu_ns
+    share = (tpu_ns / total) if total else 1.0
+    # score: TPU-time share, penalized by failures (the reference weighs
+    # SQL-task-time share and unsupported-op penalties similarly)
+    score = 100.0 * share
+    if app.queries:
+        score *= 1.0 - 0.5 * (failed / len(app.queries))
+    if score >= 80:
+        rec = "Strongly Recommended"
+    elif score >= 50:
+        rec = "Recommended"
+    elif score >= 20:
+        rec = "Not Recommended"
+    else:
+        rec = "Not Applicable"
+    return QualSummary(app.session_id, len(app.queries), failed,
+                       app.total_duration_ms, share, fallbacks, reasons,
+                       score, rec)
+
+
+def format_report(summaries: List[QualSummary]) -> str:
+    out = ["=" * 72,
+           "TPU Qualification Report",
+           "=" * 72]
+    for s in sorted(summaries, key=lambda x: -x.score):
+        out.append(f"\nSession: {s.session_id}")
+        out.append(f"  queries: {s.num_queries}  failed: {s.failed_queries}"
+                   f"  wall: {s.total_duration_ms:.0f} ms")
+        out.append(f"  TPU op-time share: {s.tpu_op_time_share * 100:.1f}%"
+                   f"  CPU-fallback ops: {s.fallback_op_count}")
+        out.append(f"  score: {s.score:.1f}  -> {s.recommendation}")
+        for reason, n in s.not_on_tpu_reasons.most_common(5):
+            out.append(f"    not-on-TPU ({n}x): {reason}")
+    return "\n".join(out)
+
+
+def write_csv(summaries: List[QualSummary], path: str) -> None:
+    with open(path, "w", newline="", encoding="utf-8") as fh:
+        w = csv.writer(fh)
+        w.writerow(["session_id", "num_queries", "failed_queries",
+                    "total_duration_ms", "tpu_op_time_share",
+                    "fallback_op_count", "score", "recommendation"])
+        for s in summaries:
+            w.writerow([s.session_id, s.num_queries, s.failed_queries,
+                        f"{s.total_duration_ms:.3f}",
+                        f"{s.tpu_op_time_share:.4f}", s.fallback_op_count,
+                        f"{s.score:.2f}", s.recommendation])
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="spark_rapids_tpu.tools.qualification", description=__doc__)
+    ap.add_argument("logdir", help="event-log directory or file")
+    ap.add_argument("-o", "--output-csv", default=None)
+    args = ap.parse_args(argv)
+    apps = load_logs(args.logdir)
+    if not apps:
+        print("no event logs found", file=sys.stderr)
+        return 1
+    summaries = [qualify_app(a) for a in apps]
+    print(format_report(summaries))
+    if args.output_csv:
+        write_csv(summaries, args.output_csv)
+        print(f"\nwrote {args.output_csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

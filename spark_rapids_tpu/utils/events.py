@@ -1,0 +1,66 @@
+"""Query event log: JSON-lines records of plans, metrics, and spill stats.
+
+The observability backbone (reference analogs: GpuMetric -> Spark
+SQLMetrics surfaced in the UI/event log, and the NVTX range taxonomy,
+NvtxWithMetrics.scala).  One file per session in
+``spark.rapids.tpu.eventLog.dir``; each line is one event:
+
+  {"event": "SessionStart", "ts": ..., "conf": {...}}
+  {"event": "QueryStart",  "queryId": n, "logicalPlan": "...",
+   "physicalPlan": "...", "explain": "..."}
+  {"event": "QueryEnd",    "queryId": n, "durationMs": ..., "status": ...,
+   "metrics": {"TpuHashAggregateExec": {"opTime": ...}, ...},
+   "spill": {"hostBytes": ..., "diskBytes": ...}}
+
+The qualification and profiling tools (tools/) consume these files the way
+the reference's tools consume Spark event logs (SURVEY.md section 2.8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class EventLogger:
+    """Append-only JSON-lines writer; no-op when dir is empty."""
+
+    def __init__(self, log_dir: Optional[str], session_id: str,
+                 conf_snapshot: Optional[Dict[str, Any]] = None):
+        self._lock = threading.Lock()
+        self._fh = None
+        self.path: Optional[str] = None
+        if log_dir:
+            import atexit
+            os.makedirs(log_dir, exist_ok=True)
+            self.path = os.path.join(log_dir,
+                                     f"tpu-events-{session_id}.jsonl")
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self.emit("SessionStart", conf=conf_snapshot or {},
+                      sessionId=session_id)
+            # sessions without an explicit stop() still close their log
+            # (and emit SessionEnd) at interpreter shutdown
+            atexit.register(self.close)
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def emit(self, event: str, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        rec = {"event": event, "ts": time.time()}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self.emit("SessionEnd")
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,110 @@
+"""Event log + qualification/profiling tools (the reference's tools/
+module, SURVEY.md section 2.8) — end to end: run queries with logging on,
+then analyze the produced logs."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.tools import profiling, qualification
+from spark_rapids_tpu.tools.eventlog import load_logs
+
+
+@pytest.fixture()
+def logged_session(tmp_path):
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    df = s.create_dataframe(pd.DataFrame({
+        "k": (np.arange(1000) % 7).astype(np.int64),
+        "v": np.arange(1000, dtype=np.float64)}))
+    df.groupBy("k").agg(F.sum("v").alias("s")).collect()
+    df.filter(F.col("v") < 100).agg(F.count().alias("n")).collect()
+    return s, tmp_path
+
+
+def test_event_log_records_queries(logged_session):
+    s, d = logged_session
+    apps = load_logs(str(d))
+    assert len(apps) == 1
+    app = apps[0]
+    assert len(app.queries) == 2
+    q = app.queries[0]
+    assert q.succeeded
+    assert "TpuHashAggregateExec" in q.physical_plan
+    assert "Aggregate" in q.logical_plan
+    assert any(m.get("opTime", 0) > 0 for m in q.metrics.values())
+    assert q.duration_ms > 0
+
+
+def test_event_log_conf_snapshot(logged_session):
+    s, d = logged_session
+    app = load_logs(str(d))[0]
+    assert app.conf.get("spark.rapids.tpu.eventLog.dir") == str(d)
+
+
+def test_qualification_scores(logged_session):
+    s, d = logged_session
+    summary = qualification.qualify_app(load_logs(str(d))[0])
+    assert summary.num_queries == 2
+    assert summary.failed_queries == 0
+    assert summary.tpu_op_time_share > 0.9
+    assert summary.recommendation in ("Strongly Recommended", "Recommended")
+    report = qualification.format_report([summary])
+    assert "Qualification" in report and "score" in report
+
+
+def test_qualification_csv(logged_session, tmp_path):
+    s, d = logged_session
+    out = tmp_path / "qual.csv"
+    rc = qualification.main([str(d), "-o", str(out)])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert lines[0].startswith("session_id")
+    assert len(lines) == 2
+
+
+def test_profiling_report(logged_session, capsys):
+    s, d = logged_session
+    rc = profiling.main([str(d)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Operator aggregate" in text
+    assert "TpuHashAggregateExec" in text
+    assert "Health check" in text
+
+
+def test_profiling_dot(logged_session, capsys):
+    s, d = logged_session
+    rc = profiling.main([str(d), "--dot", "1"])
+    assert rc == 0
+    dot = capsys.readouterr().out
+    assert dot.startswith("digraph plan")
+    assert "->" in dot
+
+
+def test_failed_query_recorded(tmp_path):
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    df = s.create_dataframe(pd.DataFrame({"v": [1.0, 2.0]}))
+
+    @F.udf(returnType="double")
+    def boom(x):
+        raise RuntimeError("kaboom")
+
+    with pytest.raises(Exception):
+        df.select(boom(F.col("v")).alias("b")).collect()
+    app = load_logs(str(tmp_path))[0]
+    assert any(not q.succeeded for q in app.queries)
+    problems = profiling.health_check([app])
+    assert problems
+
+
+def test_tolerates_torn_tail(tmp_path):
+    p = tmp_path / "tpu-events-x.jsonl"
+    p.write_text(json.dumps({"event": "SessionStart", "ts": 0,
+                             "sessionId": "x", "conf": {}}) +
+                 "\n{\"event\": \"QueryStart\", \"que")
+    app = load_logs(str(p))[0]
+    assert app.session_id == "x"
