@@ -145,6 +145,24 @@ class DataFrame:
             [UnresolvedColumn(n) for n, _ in self.plan.schema], [],
             self.plan))
 
+    # --------------------------------------------------------------- caching --
+    def cache(self) -> "DataFrame":
+        """Mark this plan for caching: the first action materializes it as
+        compressed host columnar frames (ParquetCachedBatchSerializer
+        analog); later queries containing this plan read the cache."""
+        self.session.cache_manager.register(self.plan)
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        self.session.cache_manager.unregister(self.plan)
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        return self.session.cache_manager.lookup(self.plan) is not None
+
     # --------------------------------------------------------------- actions --
     def _execute_batches(self) -> List[ColumnarBatch]:
         import time as _time

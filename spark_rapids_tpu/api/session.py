@@ -52,7 +52,9 @@ class TpuSession:
         if isinstance(conf, dict):
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
-        self.overrides = TpuOverrides(self.conf)
+        from spark_rapids_tpu.exec.cache import CacheManager
+        self.cache_manager = CacheManager()
+        self.overrides = TpuOverrides(self.conf, self.cache_manager)
         self._init_memory()
         self._init_observability()
         TpuSession._active = self
@@ -108,8 +110,15 @@ class TpuSession:
         return cls._active
 
     def set_conf(self, key: str, value) -> None:
+        from spark_rapids_tpu.config import rapids_conf as rc
+        old_log_dir = self.conf.get(rc.EVENT_LOG_DIR)
         self.conf = self.conf.set(key, value)
-        self.overrides = TpuOverrides(self.conf)
+        self.overrides = TpuOverrides(self.conf, self.cache_manager)
+        if self.conf.get(rc.EVENT_LOG_DIR) != old_log_dir:
+            # rebuild the logger so a post-construction eventLog.dir
+            # change takes effect instead of being silently ignored
+            self.events.close()
+            self._init_observability()
 
     # ------------------------------------------------------------ data inputs --
     def create_dataframe(self, data, schema: Optional[Sequence[str]] = None

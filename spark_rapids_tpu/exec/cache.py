@@ -1,0 +1,149 @@
+"""df.cache(): compressed host-resident columnar caching.
+
+The reference caches DataFrames as in-memory *Parquet-encoded* batches
+(ParquetCachedBatchSerializer, shims/spark311/.../
+ParquetCachedBatchSerializer.scala) — compact host bytes, decoded on the
+device when re-read.  The TPU analog uses the native columnar frame codec
+(zero-RLE compressed, native/host_runtime.cpp) as the storage format:
+first execution streams batches through a materializing exec that frames
+them to host RAM; later executions deserialize and re-upload.
+
+Cache identity is plan-object identity: any query whose logical tree
+contains a cached plan node reuses the materialized bytes (the planner
+substitutes at conversion time).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+
+
+def batch_to_frame(batch: ColumnarBatch) -> bytes:
+    """Serialize one device batch to a compressed host frame."""
+    import jax
+    from spark_rapids_tpu import native
+    cols = []
+    device_bufs = []
+    for c in batch.columns.values():
+        for buf in (c.data, c.validity, c.offsets):
+            if buf is not None and not isinstance(buf, np.ndarray):
+                device_bufs.append(buf)
+    fetched = jax.device_get(device_bufs) if device_bufs else []
+    host = {id(d): h for d, h in zip(device_bufs, fetched)}
+
+    def h(buf):
+        if buf is None:
+            return None
+        return host.get(id(buf), buf)
+
+    for (name, dt), c in zip(batch.schema, batch.columns.values()):
+        cols.append((native.dtype_code(dt), h(c.data), h(c.validity),
+                     h(c.offsets)))
+    return native.serialize_batch(batch.nrows, cols)
+
+
+def frame_to_batch(blob: bytes, schema: Schema) -> ColumnarBatch:
+    import jax.numpy as jnp
+    from spark_rapids_tpu import native
+    nrows, cols = native.deserialize_batch(blob)
+    out = {}
+    for (name, dt), (_, d, v, o) in zip(schema, cols):
+        data = None if d is None else jnp.asarray(
+            d if dt.is_string else d.view(dt.storage))
+        validity = None if v is None else jnp.asarray(v.view(np.bool_))
+        offsets = None if o is None else jnp.asarray(o.view(np.int32))
+        out[name] = Column(dt, data, nrows, validity=validity,
+                           offsets=offsets)
+    return ColumnarBatch(out, nrows)
+
+
+class CacheEntry:
+    def __init__(self, plan):
+        self.plan = plan
+        self.schema: Schema = list(plan.schema)
+        self.frames: Optional[List[bytes]] = None
+
+    @property
+    def materialized(self) -> bool:
+        return self.frames is not None
+
+    @property
+    def cached_bytes(self) -> int:
+        return sum(len(f) for f in self.frames) if self.frames else 0
+
+
+class CacheManager:
+    """Session-level registry of cached logical plans (CacheManager /
+    InMemoryRelation role)."""
+
+    def __init__(self):
+        self._entries: Dict[int, CacheEntry] = {}
+
+    def register(self, plan) -> CacheEntry:
+        e = self._entries.get(id(plan))
+        if e is None:
+            e = CacheEntry(plan)
+            self._entries[id(plan)] = e
+        return e
+
+    def unregister(self, plan) -> None:
+        self._entries.pop(id(plan), None)
+
+    def lookup(self, plan) -> Optional[CacheEntry]:
+        return self._entries.get(id(plan))
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+class TpuMaterializeCacheExec(TpuExec):
+    """First pass over a cached plan: stream child batches through,
+    framing each to host; the cache only becomes visible when the pass
+    completes (a LIMIT that stops early must not publish a partial
+    cache)."""
+
+    def __init__(self, entry: CacheEntry, child: TpuExec):
+        super().__init__(child)
+        self.entry = entry
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return "TpuMaterializeCacheExec"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        frames: List[bytes] = []
+        for batch in self.children[0].execute():
+            frames.append(batch_to_frame(batch))
+            yield batch
+        self.entry.frames = frames
+
+
+class TpuCachedScanExec(TpuExec):
+    """Later passes: deserialize host frames and re-upload (InMemory
+    TableScanExec analog)."""
+
+    def __init__(self, entry: CacheEntry):
+        super().__init__()
+        self.entry = entry
+
+    @property
+    def schema(self) -> Schema:
+        return self.entry.schema
+
+    def describe(self):
+        n = len(self.entry.frames or [])
+        return f"TpuCachedScanExec[{n} batches, " \
+               f"{self.entry.cached_bytes} bytes]"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for blob in self.entry.frames or []:
+            yield frame_to_batch(blob, self.entry.schema)

@@ -173,3 +173,112 @@ def _v(o: ColVal):
     if o.validity is None:
         return jnp.ones_like(o.values, dtype=jnp.bool_)
     return o.validity
+
+
+class DistributedHashJoin:
+    """Equi-join over the mesh, two strategies (reference analogs:
+    GpuBroadcastHashJoinExec and GpuShuffledHashJoinExec, SURVEY.md
+    section 2.4 "Joins"):
+
+    - ``broadcast``: the (small) build side is all-gathered to every shard
+      over ICI — one collective replaces the reference's driver-hosted
+      broadcast round trip — and each shard joins its probe slice locally.
+    - ``shuffle``: both sides are hash-partitioned by join key with the
+      padded ragged all-to-all, co-locating equal keys on one shard, then
+      joined locally.
+
+    Probe (left) columns stream sharded on the leading axis; the join runs
+    inside ONE shard_map'd XLA program.  Output stays sharded with a
+    per-shard row count; ``out_factor`` sizes the static output capacity
+    (per-shard output rows <= probe_capacity * out_factor — exceeding it
+    drops rows, so callers size it like the reference sizes its join
+    output batches via JoinGatherer).  Fixed-width keys/payloads only
+    (strings are dictionary-encoded upstream, as for the aggregate).
+    """
+
+    def __init__(self, mesh: Mesh,
+                 probe_dtypes: Sequence[DataType],
+                 build_dtypes: Sequence[DataType],
+                 probe_key_idx: Sequence[int],
+                 build_key_idx: Sequence[int],
+                 join_type: str = "inner",
+                 strategy: str = "broadcast",
+                 out_factor: int = 1):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        if join_type not in ("inner", "left"):
+            raise ValueError("distributed join supports inner/left")
+        if strategy not in ("broadcast", "shuffle"):
+            raise ValueError(f"unknown strategy {strategy}")
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.nshards = mesh.devices.size
+        self.probe_dtypes = list(probe_dtypes)
+        self.build_dtypes = list(build_dtypes)
+        self.probe_key_idx = list(probe_key_idx)
+        self.build_key_idx = list(build_key_idx)
+        self.join_type = join_type
+        self.strategy = strategy
+        self.out_factor = out_factor
+        sig = ("dist_join", tuple(mesh.axis_names),
+               tuple(mesh.devices.shape),
+               tuple(str(d) for d in mesh.devices.flat),
+               tuple(dt.name for dt in self.probe_dtypes),
+               tuple(dt.name for dt in self.build_dtypes),
+               tuple(self.probe_key_idx), tuple(self.build_key_idx),
+               join_type, strategy, out_factor)
+        self._jitted = cached_jit(
+            sig, lambda: jax.shard_map(
+                self._step, mesh=mesh,
+                in_specs=(P(self.axis), P(self.axis),
+                          P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+
+    def _step(self, probe_flat, probe_nrows_arr, build_flat,
+              build_nrows_arr):
+        from spark_rapids_tpu.ops import joins as J
+        from spark_rapids_tpu.parallel.shuffle import all_gather_cols
+
+        pn = probe_nrows_arr[0]
+        bn = build_nrows_arr[0]
+        probe = [ColVal(dt, v, val)
+                 for (v, val), dt in zip(probe_flat, self.probe_dtypes)]
+        build = [ColVal(dt, v, val)
+                 for (v, val), dt in zip(build_flat, self.build_dtypes)]
+
+        if self.strategy == "broadcast":
+            build, bn = all_gather_cols(build, bn, self.axis, self.nshards)
+        else:
+            pkeys = [probe[i] for i in self.probe_key_idx]
+            bkeys = [build[i] for i in self.build_key_idx]
+            ppids = hash_partition_ids(pkeys, self.nshards)
+            bpids = hash_partition_ids(bkeys, self.nshards)
+            probe, pn = exchange(probe, ppids, pn, self.axis, self.nshards)
+            build, bn = exchange(build, bpids, bn, self.axis, self.nshards)
+
+        pkeys = [probe[i] for i in self.probe_key_idx]
+        bkeys = [build[i] for i in self.build_key_idx]
+        m = J.join_match(bkeys, pkeys, jnp.int32(bn), jnp.int32(pn))
+        outer = self.join_type == "left"
+        count, starts, ends, total = J.join_out_starts(
+            m["probe_count"], jnp.int32(pn), outer)
+        out_cap = probe[0].values.shape[0] * self.out_factor
+        p, brow, matched, _ = J.join_gather_indices(
+            starts, ends, m["probe_count"], m["probe_bstart"],
+            m["sorted_to_build"], total, out_cap)
+        n_out = jnp.minimum(total, out_cap).astype(jnp.int32)
+        probe_out = selection.gather(probe, p, n_out)
+        build_out = J.gather_build_side(build, brow, matched, n_out)
+        flat = [(c.values,
+                 c.validity if c.validity is not None
+                 else jnp.ones(out_cap, dtype=jnp.bool_))
+                for c in probe_out + build_out]
+        return flat, n_out[None]
+
+    def __call__(self, probe_flat, probe_nrows_per_shard, build_flat,
+                 build_nrows_per_shard):
+        """probe_flat/build_flat: [(values, validity)] with leading-axis
+        sharded arrays; nrows arrays have one entry per shard.  Returns
+        (flat output cols [probe cols then build cols], nrows per
+        shard)."""
+        return self._jitted(probe_flat, probe_nrows_per_shard,
+                            build_flat, build_nrows_per_shard)

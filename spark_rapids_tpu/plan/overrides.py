@@ -373,16 +373,29 @@ def _conv_map_in_pandas(node: L.MapInPandas, children, conf):
     return TpuMapInPandasExec(node.fn, node.schema, children[0])
 
 
-def _pushdown_pass(plan: L.LogicalPlan) -> None:
+def _pushdown_pass(plan: L.LogicalPlan, cache_manager=None) -> None:
     """Column pruning + predicate pushdown into FileRelations.
 
     Pruned columns are only those dropped by a Project/Aggregate above, so
     BoundReference ordinals stay valid (the scan emits null placeholders
     for unread columns, which by construction nothing references).
     Filters push down until a Project renames the namespace.
+
+    Cached plan nodes are pushdown BARRIERS: a query-specific filter or
+    column pruning pushed below a cache boundary would materialize a
+    filtered/pruned subset as the cache, silently poisoning every later
+    reader.  At a cached node the pushdown restarts fresh (and, because
+    assignments overwrite, clears any pushdown a previous query left on
+    the shared FileRelation nodes).
     """
+    barrier_entered: set = set()
 
     def visit(node, required, filters):
+        if cache_manager is not None and id(node) not in barrier_entered \
+                and cache_manager.lookup(node) is not None:
+            barrier_entered.add(id(node))
+            visit(node, None, [])
+            return
         if isinstance(node, L.FileRelation):
             if required is not None:
                 node.required_columns = set(required)
@@ -414,12 +427,14 @@ def _pushdown_pass(plan: L.LogicalPlan) -> None:
 class TpuOverrides:
     """The planner: logical plan -> TpuExec tree with CPU fallback."""
 
-    def __init__(self, conf: Optional[RapidsConf] = None):
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 cache_manager=None):
         self.conf = conf or RapidsConf()
         self.last_explain: str = ""
+        self.cache_manager = cache_manager
 
     def apply(self, plan: L.LogicalPlan):
-        _pushdown_pass(plan)
+        _pushdown_pass(plan, self.cache_manager)
         meta = PlanMeta(plan, self.conf)
         meta.tag()
         self.last_explain = "\n".join(meta.explain_lines())
@@ -433,14 +448,31 @@ class TpuOverrides:
 
     def _convert(self, meta: PlanMeta):
         node = meta.wrapped
+        if self.cache_manager is not None:
+            entry = self.cache_manager.lookup(node)
+            if entry is not None:
+                from spark_rapids_tpu.exec.cache import (
+                    TpuCachedScanExec, TpuMaterializeCacheExec)
+                if entry.materialized:
+                    return TpuCachedScanExec(entry)
+                return TpuMaterializeCacheExec(
+                    entry, self._convert_uncached(meta))
+        return self._convert_uncached(meta)
+
+    def _convert_uncached(self, meta: PlanMeta):
+        node = meta.wrapped
         if isinstance(node, L.Aggregate) and not meta.reasons:
             fused = self._try_fuse_aggregate(meta)
             if fused is not None:
                 return fused
-        # Limit(Sort) -> TopN (TakeOrderedAndProject analog)
+        # Limit(Sort) -> TopN (TakeOrderedAndProject analog); not across a
+        # cached Sort, whose materialized result must be read/populated
         if isinstance(node, L.Limit) and meta.child_metas and \
                 isinstance(meta.child_metas[0].wrapped, L.Sort) and \
-                meta.child_metas[0].can_replace:
+                meta.child_metas[0].can_replace and \
+                (self.cache_manager is None or
+                 self.cache_manager.lookup(meta.child_metas[0].wrapped)
+                 is None):
             from spark_rapids_tpu.exec.sort import TpuTopNExec
             sort_meta = meta.child_metas[0]
             base = self._convert(sort_meta.child_metas[0])
@@ -479,6 +511,11 @@ class TpuOverrides:
         while isinstance(child_meta.wrapped, (L.Project, L.Filter)):
             if child_meta.reasons or any(
                     not em.can_replace for em in child_meta.expr_metas):
+                break
+            # don't fuse across a cached node: its materialized batches
+            # must be consumed (and populated) at that boundary
+            if self.cache_manager is not None and \
+                    self.cache_manager.lookup(child_meta.wrapped) is not None:
                 break
             inner = child_meta.wrapped
             if isinstance(inner, L.Project):

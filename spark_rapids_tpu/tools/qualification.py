@@ -63,7 +63,9 @@ def qualify_app(app: AppInfo) -> QualSummary:
             if mm:
                 reasons[mm.group(1).strip()] += 1
     total = tpu_ns + cpu_ns
-    share = (tpu_ns / total) if total else 1.0
+    # no op metrics at all (e.g. every query failed before running an
+    # operator) means nothing ran on TPU — score it 0, not 100
+    share = (tpu_ns / total) if total else 0.0
     # score: TPU-time share, penalized by failures (the reference weighs
     # SQL-task-time share and unsupported-op penalties similarly)
     score = 100.0 * share

@@ -61,6 +61,11 @@ class EventLogger:
 
     def close(self) -> None:
         if self._fh is not None:
+            import atexit
             self.emit("SessionEnd")
             self._fh.close()
             self._fh = None
+            try:  # release the atexit pin so the logger can be GC'd
+                atexit.unregister(self.close)
+            except Exception:
+                pass

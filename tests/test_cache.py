@@ -1,0 +1,112 @@
+"""df.cache(): compressed host caching (ParquetCachedBatchSerializer
+analog, SURVEY.md section 2.4 "Caching")."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture()
+def session():
+    return TpuSession()
+
+
+def _df(session, n=2000):
+    rng = np.random.default_rng(9)
+    return session.create_dataframe(pd.DataFrame({
+        "k": (np.arange(n) % 11).astype(np.int64),
+        "v": rng.uniform(size=n),
+        "s": [f"name-{i % 5}" for i in range(n)]}))
+
+
+def test_cache_materializes_on_first_action(session):
+    df = _df(session).filter(F.col("v") < 0.5)
+    df.cache()
+    entry = session.cache_manager.lookup(df.plan)
+    assert entry is not None and not entry.materialized
+    r1 = df.to_pandas()
+    assert entry.materialized
+    assert entry.cached_bytes > 0
+    # second read comes from the cache and matches
+    r2 = df.to_pandas()
+    pd.testing.assert_frame_equal(
+        r1.reset_index(drop=True), r2.reset_index(drop=True))
+    assert df.is_cached
+
+
+def test_downstream_query_uses_cache(session):
+    df = _df(session)
+    df.cache()
+    df.count()  # materialize
+    out = df.groupBy("k").agg(F.sum("v").alias("sv"))
+    plan = session.plan(out.plan)
+    assert "TpuCachedScanExec" in plan.tree_string()
+    got = out.to_pandas().sort_values("k").reset_index(drop=True)
+    # oracle from an uncached session
+    s2 = TpuSession()
+    want = _df(s2).groupBy("k").agg(F.sum("v").alias("sv")) \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_allclose(got.sv.values, want.sv.values, rtol=1e-12)
+
+
+def test_cache_preserves_strings_and_nulls(session):
+    base = session.create_dataframe(pd.DataFrame({
+        "k": [1, 2, 3, 4], "s": ["a", None, "ccc", "dd"]}))
+    df = base.cache()
+    first = df.to_pandas()
+    second = df.to_pandas()
+    vals = second["s"].tolist()
+    assert vals[0] == "a" and pd.isna(vals[1]) and vals[2:] == ["ccc", "dd"]
+    pd.testing.assert_frame_equal(first, second)
+
+
+def test_unpersist(session):
+    df = _df(session).cache()
+    df.count()
+    df.unpersist()
+    assert not df.is_cached
+    plan = session.plan(df.plan)
+    assert "TpuCachedScanExec" not in plan.tree_string()
+
+
+def test_limit_does_not_publish_partial_cache(session):
+    df = _df(session).cache()
+    df.limit(5).collect()
+    entry = session.cache_manager.lookup(df.plan)
+    # the limited run may stop the iterator early; a partial cache must
+    # not be published as complete
+    if entry.materialized:
+        assert len(df.to_pandas()) == 2000
+
+
+def test_cache_not_poisoned_by_pushdown(session, tmp_path):
+    """A filtered/pruned first query must not materialize a subset as the
+    cache (pushdown stops at the cache boundary)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({"a": list(range(100)),
+                             "b": [float(i) for i in range(100)]}),
+                   str(tmp_path / "t.parquet"))
+    df = session.read.parquet(str(tmp_path / "t.parquet"))
+    df.cache()
+    # first action pushes a filter + prunes to column a
+    n = df.filter(F.col("a") > 90).select("a").count()
+    assert n == 9
+    # full read afterwards must see every row and BOTH columns
+    full = df.to_pandas()
+    assert len(full) == 100
+    assert full["b"].tolist() == [float(i) for i in range(100)]
+
+
+def test_cached_sort_limit_reads_cache(session):
+    df = _df(session).orderBy(F.col("v"))
+    df.cache()
+    df.collect()  # materialize full sorted result
+    limited = df.limit(3)
+    plan = session.plan(limited.plan)
+    assert "TpuCachedScanExec" in plan.tree_string()
+    got = [r[1] for r in limited.collect()]
+    assert got == sorted(got)

@@ -148,3 +148,73 @@ def test_distributed_filtered_aggregate(mesh, rng):
     mask = (disc >= 0.05) & (disc <= 0.07)
     want = (price * disc)[mask].sum()
     np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+@pytest.mark.parametrize("strategy", ["broadcast", "shuffle"])
+@pytest.mark.parametrize("join_type", ["inner", "left"])
+def test_distributed_hash_join(mesh, rng, strategy, join_type):
+    from spark_rapids_tpu.parallel.distributed import DistributedHashJoin
+    # probe: fact rows with fk in [0, 40); build: dim table with unique keys
+    fk = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
+    amount = rng.normal(size=(NSHARDS, CAP))
+    p_nrows = rng.integers(50, CAP, NSHARDS).astype(np.int32)
+    # 30 of the 40 fk values exist in the dim table (some probe misses)
+    dim_keys_all = rng.permutation(40)[:30].astype(np.int64)
+    dk = np.zeros((NSHARDS, CAP), dtype=np.int64)
+    dv = np.zeros((NSHARDS, CAP), dtype=np.float64)
+    b_nrows = np.zeros(NSHARDS, dtype=np.int32)
+    for i, k in enumerate(dim_keys_all):
+        s = i % NSHARDS
+        dk[s, b_nrows[s]] = k
+        dv[s, b_nrows[s]] = float(k) * 10
+        b_nrows[s] += 1
+
+    join = DistributedHashJoin(
+        mesh,
+        probe_dtypes=[dts.INT64, dts.FLOAT64],
+        build_dtypes=[dts.INT64, dts.FLOAT64],
+        probe_key_idx=[0], build_key_idx=[0],
+        join_type=join_type, strategy=strategy)
+
+    probe_flat = [(_make_sharded(fk), jnp.ones(NSHARDS * CAP, bool)),
+                  (_make_sharded(amount, np.float64),
+                   jnp.ones(NSHARDS * CAP, bool))]
+    build_flat = [(_make_sharded(dk), jnp.ones(NSHARDS * CAP, bool)),
+                  (_make_sharded(dv, np.float64),
+                   jnp.ones(NSHARDS * CAP, bool))]
+    flat, n_out = join(probe_flat, jnp.asarray(p_nrows),
+                       build_flat, jnp.asarray(b_nrows))
+
+    # collect shard-local outputs
+    per_shard = np.asarray(n_out)
+    out_cap = np.asarray(flat[0][0]).shape[0] // NSHARDS
+    rows = []
+    for s in range(NSHARDS):
+        n = per_shard[s]
+        fkv = np.asarray(flat[0][0]).reshape(NSHARDS, -1)[s, :n]
+        amt = np.asarray(flat[1][0]).reshape(NSHARDS, -1)[s, :n]
+        bkv = np.asarray(flat[2][0]).reshape(NSHARDS, -1)[s, :n]
+        bval = np.asarray(flat[2][1]).reshape(NSHARDS, -1)[s, :n]
+        dvv = np.asarray(flat[3][0]).reshape(NSHARDS, -1)[s, :n]
+        for i in range(n):
+            rows.append((fkv[i], amt[i],
+                         dvv[i] if bval[i] else None))
+    got = pd.DataFrame(rows, columns=["fk", "amount", "dimval"])
+
+    dfs = [pd.DataFrame({"fk": fk[s, :p_nrows[s]],
+                         "amount": amount[s, :p_nrows[s]]})
+           for s in range(NSHARDS)]
+    probe_df = pd.concat(dfs)
+    dim_df = pd.DataFrame({"fk": dim_keys_all,
+                           "dimval": dim_keys_all * 10.0})
+    how = "inner" if join_type == "inner" else "left"
+    want = probe_df.merge(dim_df, on="fk", how=how)
+    assert len(got) == len(want)
+    gs = got.sort_values(["fk", "amount"]).reset_index(drop=True)
+    ws = want.sort_values(["fk", "amount"]).reset_index(drop=True)
+    np.testing.assert_array_equal(gs.fk.values, ws.fk.values)
+    np.testing.assert_allclose(gs.amount.values, ws.amount.values)
+    gd = gs.dimval.astype(float).values
+    wd = ws.dimval.astype(float).values
+    np.testing.assert_allclose(np.nan_to_num(gd, nan=-1),
+                               np.nan_to_num(wd, nan=-1))
