@@ -1,0 +1,77 @@
+"""Self-documenting doc generation: configs.md + supported_ops.md.
+
+The reference generates its docs from code (RapidsConf.main ->
+docs/configs.md; TypeChecks doc-gen mains -> docs/supported_ops.md); this
+module does the same from the conf registry, the expression rule registry,
+and the plan converter table, so the docs can never drift from the code.
+
+Usage:  python -m spark_rapids_tpu.tools.docgen [DOCS_DIR]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+
+def generate_configs_md() -> str:
+    from spark_rapids_tpu.config.rapids_conf import RapidsConf
+    return RapidsConf.generate_docs()
+
+
+def generate_supported_ops_md() -> str:
+    from spark_rapids_tpu.plan import overrides as ov
+    from spark_rapids_tpu.plan import logical as L
+
+    lines: List[str] = [
+        "# Supported operators and expressions", "",
+        "Generated from the planner registries "
+        "(`python -m spark_rapids_tpu.tools.docgen`). An expression or "
+        "operator outside this list (or used with an unsupported type) "
+        "is tagged \"will not work on TPU\" and runs on the CPU "
+        "fallback path.", "",
+        "## Physical operators", "",
+        "Logical node | TPU conversion", "---|---"]
+    for cls in sorted(ov._PLAN_CONVERTERS, key=lambda c: c.__name__):
+        fn = ov._PLAN_CONVERTERS[cls]
+        doc = (fn.__doc__ or "").strip().splitlines()
+        note = doc[0] if doc else ""
+        lines.append(f"{cls.__name__} | supported{': ' + note if note else ''}")
+    unconverted = [c.__name__ for c in vars(L).values()
+                   if isinstance(c, type) and
+                   issubclass(c, L.LogicalPlan) and c is not L.LogicalPlan
+                   and c not in ov._PLAN_CONVERTERS]
+    if unconverted:
+        lines += ["", "CPU-only logical nodes: " +
+                  ", ".join(sorted(unconverted))]
+
+    lines += ["", "## Expressions", "",
+              "Expression | Supported types | Notes", "---|---|---"]
+    for cls in sorted(ov._EXPR_RULES, key=lambda c: c.__name__):
+        rule = ov._EXPR_RULES[cls]
+        names = sorted(rule.sig.names) + \
+            (["decimal64"] if rule.sig.decimal else [])
+        lines.append(f"{cls.__name__} | {', '.join(names)} | "
+                     f"{rule.note}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: List[str] = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    docs_dir = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "docs")
+    os.makedirs(docs_dir, exist_ok=True)
+    cfg = os.path.join(docs_dir, "configs.md")
+    ops = os.path.join(docs_dir, "supported_ops.md")
+    with open(cfg, "w", encoding="utf-8") as f:
+        f.write(generate_configs_md())
+    with open(ops, "w", encoding="utf-8") as f:
+        f.write(generate_supported_ops_md())
+    print(f"wrote {cfg}\nwrote {ops}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
