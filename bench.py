@@ -1,27 +1,72 @@
-"""Benchmark: TPC-H q6 (scan -> filter -> project -> sum), SF10-scale.
+"""Benchmark: TPC-H q6 (scan -> filter -> project -> sum), device-resident.
 
-BASELINE.md config 1 — the reference's minimum end-to-end slice, scaled to
-SF10 so per-query work dominates the fixed device round-trip (the remote
-TPU tunnel has a ~63ms dispatch+sync floor; at SF1 every engine, no matter
-how fast, is bounded by it).  Runs the real engine (planner -> fused
-filter/project stage -> reduction) on the default JAX device (TPU when
-present) against a pandas CPU baseline on the same data, and prints ONE
-JSON line.
+BASELINE.md config 1 — the reference's minimum end-to-end slice.  The
+round-1 bench generated 60M rows host-side and pushed ~1.9 GB through the
+remote TPU tunnel, which blew the driver's wall-clock budget before the one
+JSON line was printed.  This version is structured so a result is ALWAYS
+captured:
+
+* **Data lives on device.**  The lineitem columns are generated inside a
+  jitted ``jax.random`` program, so nothing but the 8-byte result crosses
+  the tunnel per query.  Engine batches are built directly from the device
+  arrays (``Column`` wraps any jax array).
+* **Phased, cheapest first.**  (1) exact correctness vs pandas at 64K rows,
+  (2) pandas CPU baseline timed at a host-sized sample and scaled linearly
+  (q6 is O(n)), (3) engine perf at growing sizes (4M -> 67M rows), keeping
+  the largest size that fits the budget.
+* **Watchdog.**  A SIGALRM/SIGTERM handler and ``atexit`` hook print the
+  best JSON line seen so far, so even a hard budget kill yields a number.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": rows/s, "unit": "rows/s", "vs_baseline": x}``.
 """
 
+import atexit
 import json
+import os
+import signal
 import sys
 import time
 
 import numpy as np
 
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "480"))
+_T0 = time.monotonic()
 
-N_ROWS = 60_000_000  # SF10 lineitem ~60M rows
-ITERS = 5
+
+def remaining() -> float:
+    return WALL_BUDGET - (time.monotonic() - _T0)
 
 
-def gen_lineitem(n):
-    rng = np.random.default_rng(42)
+_best = {"metric": "tpch_q6_rows_per_sec", "value": 0, "unit": "rows/s",
+         "vs_baseline": 0.0}
+_emitted = False
+
+
+def _emit():
+    global _emitted
+    if not _emitted:
+        _emitted = True
+        print(json.dumps(_best))
+        sys.stdout.flush()
+
+
+def _on_signal(signum, frame):
+    print(f"bench: signal {signum} with {remaining():.0f}s left; emitting",
+          file=sys.stderr)
+    _emit()
+    os._exit(0)
+
+
+atexit.register(_emit)
+signal.signal(signal.SIGTERM, _on_signal)
+signal.signal(signal.SIGALRM, _on_signal)
+signal.alarm(int(WALL_BUDGET) + 5)
+
+
+# ------------------------------------------------------------------ data gen --
+def gen_host(n: int, seed: int = 42):
+    rng = np.random.default_rng(seed)
     return {
         "l_extendedprice": rng.uniform(1000.0, 100000.0, n),
         "l_discount": rng.uniform(0.0, 0.11, n).round(2),
@@ -30,12 +75,38 @@ def gen_lineitem(n):
     }
 
 
-def run_tpu(data):
-    from spark_rapids_tpu.api import functions as F
-    from spark_rapids_tpu.api.session import TpuSession
+def gen_device_batch(n: int, seed: int = 42):
+    """Generate the lineitem columns on device; only PRNG keys cross host."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
 
-    session = TpuSession()
-    df = session.create_dataframe(data)
+    @jax.jit
+    def gen(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        price = jax.random.uniform(k1, (n,), dtype=jnp.float64,
+                                   minval=1000.0, maxval=100000.0)
+        disc = jnp.round(
+            jax.random.uniform(k2, (n,), dtype=jnp.float64, maxval=0.11), 2)
+        qty = jax.random.randint(k3, (n,), 1, 51).astype(jnp.float64)
+        ship = jax.random.randint(k4, (n,), 8766, 10957).astype(jnp.int32)
+        return price, disc, qty, ship
+
+    price, disc, qty, ship = gen(jax.random.PRNGKey(seed))
+    price.block_until_ready()
+    return ColumnarBatch({
+        "l_extendedprice": Column(dts.FLOAT64, price, n),
+        "l_discount": Column(dts.FLOAT64, disc, n),
+        "l_quantity": Column(dts.FLOAT64, qty, n),
+        "l_shipdate": Column(dts.INT32, ship, n),
+    })
+
+
+# -------------------------------------------------------------------- engine --
+def make_query(session, df):
+    from spark_rapids_tpu.api import functions as F
 
     def query():
         q = df.filter(
@@ -46,16 +117,24 @@ def run_tpu(data):
                  .alias("rev")).agg(F.sum("rev").alias("revenue"))
         return q.collect()[0][0]
 
-    result = query()  # warmup: compile
+    return query
+
+
+def time_query(query, budget: float, max_iters: int = 5):
+    """Warmup once (compile), then run timed iterations inside ``budget``."""
+    result = query()
     times = []
-    for _ in range(ITERS):
+    t_stop = time.monotonic() + budget
+    for _ in range(max_iters):
         t0 = time.perf_counter()
         result = query()
         times.append(time.perf_counter() - t0)
+        if time.monotonic() > t_stop:
+            break
     return result, min(times)
 
 
-def run_pandas(data):
+def run_pandas(data, max_iters: int = 3):
     import pandas as pd
     df = pd.DataFrame(data)
 
@@ -67,7 +146,7 @@ def run_pandas(data):
 
     result = query()
     times = []
-    for _ in range(ITERS):
+    for _ in range(max_iters):
         t0 = time.perf_counter()
         result = query()
         times.append(time.perf_counter() - t0)
@@ -75,21 +154,65 @@ def run_pandas(data):
 
 
 def main():
-    data = gen_lineitem(N_ROWS)
-    tpu_result, tpu_t = run_tpu(data)
-    cpu_result, cpu_t = run_pandas(data)
-    rel_err = abs(tpu_result - cpu_result) / max(abs(cpu_result), 1e-9)
-    assert rel_err < 1e-6, f"wrong answer: {tpu_result} vs {cpu_result}"
-    rows_per_sec = N_ROWS / tpu_t
-    print(json.dumps({
-        "metric": "tpch_q6_sf10_rows_per_sec",
-        "value": round(rows_per_sec),
-        "unit": "rows/s",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
-    }))
-    print(f"tpu={tpu_t * 1e3:.1f}ms pandas={cpu_t * 1e3:.1f}ms "
-          f"result={tpu_result:.2f} rel_err={rel_err:.2e}", file=sys.stderr)
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession()
+    import jax
+    dev = jax.devices()[0]
+    print(f"bench: device={dev.platform}:{dev.device_kind} "
+          f"budget={WALL_BUDGET:.0f}s", file=sys.stderr)
+
+    # Phase 1: exact correctness at 64K rows (2 MB through the tunnel).
+    n_small = 1 << 16
+    small = gen_host(n_small)
+    engine_res, _ = time_query(
+        make_query(session, session.create_dataframe(small)), budget=5.0,
+        max_iters=1)
+    pd_res, _ = run_pandas(small, max_iters=1)
+    rel_err = abs(engine_res - pd_res) / max(abs(pd_res), 1e-9)
+    assert rel_err < 1e-9, f"wrong answer: {engine_res} vs {pd_res}"
+    print(f"bench: correctness ok at {n_small} rows rel_err={rel_err:.2e} "
+          f"({remaining():.0f}s left)", file=sys.stderr)
+
+    # Phase 2: pandas baseline, sampled then scaled (q6 is O(n)).
+    pd_n = 1 << 23
+    _, pd_t = run_pandas(gen_host(pd_n))
+    pd_rows_per_sec = pd_n / pd_t
+    print(f"bench: pandas {pd_n} rows in {pd_t * 1e3:.1f}ms "
+          f"({pd_rows_per_sec / 1e6:.1f}M rows/s, {remaining():.0f}s left)",
+          file=sys.stderr)
+
+    # Phase 3: engine perf at growing device-resident sizes.
+    for shift in (22, 24, 26):
+        n = 1 << shift
+        # Reserve time: generation + compile (first size) + iterations.
+        if remaining() < 90:
+            print(f"bench: skipping n=2^{shift}, {remaining():.0f}s left",
+                  file=sys.stderr)
+            break
+        try:
+            batch = gen_device_batch(n)
+            df = session.create_dataframe(batch)
+            result, t = time_query(make_query(session, df),
+                                   budget=min(20.0, remaining() / 3))
+            assert np.isfinite(result) and result > 0, result
+            rows_per_sec = n / t
+            _best.update(
+                value=round(rows_per_sec),
+                vs_baseline=round(rows_per_sec / pd_rows_per_sec, 3))
+            print(f"bench: n=2^{shift} t={t * 1e3:.1f}ms "
+                  f"{rows_per_sec / 1e6:.1f}M rows/s "
+                  f"vs_pandas={_best['vs_baseline']}x "
+                  f"({remaining():.0f}s left)", file=sys.stderr)
+        except Exception as e:  # keep the best completed size
+            print(f"bench: n=2^{shift} failed: {e!r}", file=sys.stderr)
+            break
+
+    _emit()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        print(f"bench: fatal {e!r}", file=sys.stderr)
+        _emit()
