@@ -165,6 +165,22 @@ static uint64_t get_varint(const uint8_t *&p) {
     return v;
 }
 
+// bounded variant: never reads at/past `end`; returns false on truncation
+static bool get_varint_bounded(const uint8_t *&p, const uint8_t *end,
+                               uint64_t *out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && (*p & 0x80)) {
+        v |= static_cast<uint64_t>(*p++ & 0x7F) << shift;
+        shift += 7;
+        if (shift > 63) return false;
+    }
+    if (p >= end) return false;
+    v |= static_cast<uint64_t>(*p++) << shift;
+    *out = v;
+    return true;
+}
+
 // zero-run-length encode; returns false (caller stores raw) when no gain
 static bool zrle_encode(const uint8_t *src, size_t n,
                         std::vector<uint8_t> &out) {
@@ -191,22 +207,29 @@ static bool zrle_encode(const uint8_t *src, size_t n,
     return out.size() < n;
 }
 
-static void zrle_decode(const uint8_t *src, size_t encoded_len, uint8_t *dst,
-                        size_t n) {
+// returns 0 on success, <0 on corrupt/truncated input; every run length is
+// bounded against both the remaining source and the destination capacity so
+// a bad spill/cache file yields an error code, not a heap overflow
+static int zrle_decode(const uint8_t *src, size_t encoded_len, uint8_t *dst,
+                       size_t n) {
     const uint8_t *p = src;
     const uint8_t *end = src + encoded_len;
     size_t o = 0;
     while (p < end && o < n) {
         uint8_t tag = *p++;
-        uint64_t len = get_varint(p);
+        uint64_t len;
+        if (!get_varint_bounded(p, end, &len)) return -1;
+        if (len > n - o) return -2;
         if (tag == 0x00) {
             std::memset(dst + o, 0, len);
         } else {
+            if (len > static_cast<uint64_t>(end - p)) return -3;
             std::memcpy(dst + o, p, len);
             p += len;
         }
         o += len;
     }
+    return 0;
 }
 
 struct FrameBuf {
@@ -272,6 +295,7 @@ int frame_header(const uint8_t *src, uint64_t src_len, uint64_t *nrows,
     uint32_t nc;
     std::memcpy(&nc, src + 4, 4);
     if (nc > max_cols) return -3;
+    if (src_len < 16 + 26ull * nc) return -4;  // truncated header
     std::memcpy(nrows, src + 8, 8);
     *ncols = nc;
     const uint8_t *p = src + 16;
@@ -294,16 +318,18 @@ int frame_deserialize(const uint8_t *src, uint64_t src_len,
         for (int k = 0; k < 3; k++) {
             uint64_t n = lens[c * 3 + k];
             if (!dst_bufs[c * 3 + k] || n == 0) continue;
-            if (p + 9 > end) return -1;
+            if (end - p < 9) return -1;
             uint8_t codec = *p++;
             uint64_t enc_len;
             std::memcpy(&enc_len, p, 8);
             p += 8;
-            if (p + enc_len > end) return -2;
+            if (enc_len > static_cast<uint64_t>(end - p)) return -2;
             if (codec == 0) {
+                if (enc_len > n) return -3;  // dest sized from header lens
                 std::memcpy(dst_bufs[c * 3 + k], p, enc_len);
             } else {
-                zrle_decode(p, enc_len, dst_bufs[c * 3 + k], n);
+                if (zrle_decode(p, enc_len, dst_bufs[c * 3 + k], n) != 0)
+                    return -4;
             }
             p += enc_len;
         }
@@ -400,7 +426,11 @@ struct Prefetcher {
     std::mutex mu;
     std::condition_variable cv_work, cv_done;
     std::deque<size_t> queue;
-    std::vector<PrefetchTask> tasks;
+    // deque, not vector: prefetcher_submit appends while workers hold
+    // references to in-flight tasks; vector reallocation would invalidate
+    // them (use-after-free under io/multifile.py's sliding-window submits).
+    // deque guarantees element addresses are stable under push_back.
+    std::deque<PrefetchTask> tasks;
     std::vector<std::thread> threads;
     bool stop = false;
 
@@ -411,15 +441,16 @@ struct Prefetcher {
 
     void worker() {
         for (;;) {
-            size_t idx;
+            PrefetchTask *tp;
             {
                 std::unique_lock<std::mutex> lock(mu);
                 cv_work.wait(lock, [this] { return stop || !queue.empty(); });
                 if (stop && queue.empty()) return;
-                idx = queue.front();
+                size_t idx = queue.front();
                 queue.pop_front();
+                tp = &tasks[idx];  // element address stable outside the lock
             }
-            PrefetchTask &t = tasks[idx];
+            PrefetchTask &t = *tp;
             int64_t sz = pager_file_size(t.path.c_str());
             if (sz < 0) {
                 t.status = -1;
@@ -456,7 +487,6 @@ int prefetcher_submit(void *pf, const char **paths, int npaths) {
     {
         std::lock_guard<std::mutex> lock(p->mu);
         size_t base = p->tasks.size();
-        p->tasks.reserve(base + npaths);
         for (int i = 0; i < npaths; i++) {
             p->tasks.emplace_back();
             p->tasks.back().path = paths[i];

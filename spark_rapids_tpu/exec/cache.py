@@ -54,8 +54,11 @@ def frame_to_batch(blob: bytes, schema: Schema) -> ColumnarBatch:
     nrows, cols = native.deserialize_batch(blob)
     out = {}
     for (name, dt), (_, d, v, o) in zip(schema, cols):
-        data = None if d is None else jnp.asarray(
-            d if dt.is_string else d.view(dt.storage))
+        if d is None:
+            # zero-length buffers come back from the codec as absent; an
+            # empty chars/data buffer must rebuild as empty, not None
+            d = np.zeros(0, dtype=np.uint8 if dt.is_string else dt.storage)
+        data = jnp.asarray(d if dt.is_string else d.view(dt.storage))
         validity = None if v is None else jnp.asarray(v.view(np.bool_))
         offsets = None if o is None else jnp.asarray(o.view(np.int32))
         out[name] = Column(dt, data, nrows, validity=validity,

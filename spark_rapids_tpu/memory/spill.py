@@ -78,7 +78,15 @@ class SpillableHandle:
         import jax.numpy as jnp
         cols = {}
         for name, dt in self._schema:
-            data = jnp.asarray(get(f"{name}.data"))
+            data = get(f"{name}.data")
+            if data is None:
+                # the frame codec stores zero-length buffers as absent
+                # (lens=0); a legitimately empty buffer (e.g. the chars of
+                # an all-empty string column) must round-trip as empty, not
+                # as None -> jnp.asarray(None) crash
+                data = np.zeros(
+                    0, dtype=np.uint8 if dt.is_string else dt.storage)
+            data = jnp.asarray(data)
             validity = get(f"{name}.validity")
             offsets = get(f"{name}.offsets")
             cols[name] = Column(

@@ -151,6 +151,10 @@ class HostArena:
         if not ptr:
             raise MemoryError(f"arena_alloc({nbytes}) failed")
         buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        # The view chain arr -> buf -> arena keeps the slabs alive even if
+        # the caller drops the HostArena while views are outstanding
+        # (ctypes instances accept attribute assignment).
+        buf._arena_keepalive = self
         arr = np.frombuffer(buf, dtype=np.uint8)
         self._live[arr.__array_interface__["data"][0]] = (ptr, nbytes)
         return arr
@@ -174,14 +178,23 @@ class HostArena:
                 "watermark": w.value}
 
     def close(self) -> None:
-        if self._handle is not None:
-            self._lib.arena_destroy(self._handle)
-            self._handle = None
+        if self._handle is None:
+            return
+        if self._live:
+            # freeing the slabs would leave the outstanding numpy views
+            # dangling (silent memory corruption on later access)
+            raise RuntimeError(
+                f"HostArena.close with {len(self._live)} live allocations")
+        self._lib.arena_destroy(self._handle)
+        self._handle = None
 
     def __del__(self):
         try:
             self.close()
         except Exception:
+            # live views hold a keepalive reference to this arena, so
+            # reaching __del__ with _live non-empty cannot happen; any
+            # other failure here just leaks the arena
             pass
 
 

@@ -272,13 +272,19 @@ class DistributedHashJoin:
                  c.validity if c.validity is not None
                  else jnp.ones(out_cap, dtype=jnp.bool_))
                 for c in probe_out + build_out]
-        return flat, n_out[None]
+        # also expose the UNclamped total: when total > n_out the output was
+        # truncated to out_cap and the caller must retry with a larger
+        # out_factor (the reference instead splits join output batches,
+        # JoinGatherer.scala:36-60 — silent truncation = wrong results)
+        return flat, n_out[None], total.astype(jnp.int32)[None]
 
     def __call__(self, probe_flat, probe_nrows_per_shard, build_flat,
                  build_nrows_per_shard):
         """probe_flat/build_flat: [(values, validity)] with leading-axis
         sharded arrays; nrows arrays have one entry per shard.  Returns
-        (flat output cols [probe cols then build cols], nrows per
-        shard)."""
+        (flat output cols [probe cols then build cols], nrows per shard,
+        unclamped match total per shard).  Any shard where total > nrows
+        was truncated at out_factor * capacity rows: the caller must
+        retry with a larger out_factor."""
         return self._jitted(probe_flat, probe_nrows_per_shard,
                             build_flat, build_nrows_per_shard)

@@ -28,6 +28,7 @@ def test_arena_alloc_recycle():
         b2 = a.alloc(1024)  # recycled from free list
         assert a.stats()["reserved"] == s1["reserved"]
         b2[:] = 0
+        a.free(b2)
     finally:
         a.close()
 
@@ -38,6 +39,7 @@ def test_arena_grows_beyond_slab():
         big = a.alloc(3 << 20)  # larger than slab
         big[:17] = 5
         assert a.stats()["reserved"] >= 3 << 20
+        a.free(big)
     finally:
         a.close()
 
@@ -151,3 +153,72 @@ def test_spill_disk_uses_native_frames(tmp_path):
                                   np.arange(64, dtype=np.float64))
     assert back.columns["s"].to_pylist()[:4] == ["alpha", None, "b", "gamma"]
     h.close()
+
+
+def test_frame_rejects_corrupt_and_truncated():
+    """Corrupt/truncated frames must yield error codes, never OOB writes."""
+    cols = [(5, np.arange(4096, dtype=np.int64), None, None)]
+    blob = native.serialize_batch(4096, cols, compress=True)
+    # truncate mid-payload at several points
+    for cut in (4, 10, 17, len(blob) // 2, len(blob) - 3):
+        with pytest.raises(ValueError):
+            native.deserialize_batch(blob[:cut])
+    # corrupt the encoded length field of the first buffer (claims more
+    # bytes than the frame holds)
+    bad = bytearray(blob)
+    hdr = 16 + 26  # magic/ncols/nrows + one column descriptor
+    bad[hdr + 1:hdr + 9] = (1 << 40).to_bytes(8, "little")
+    with pytest.raises(ValueError):
+        native.deserialize_batch(bytes(bad))
+
+
+def test_frame_empty_buffer_column_rebuilds():
+    """A 0-length chars buffer (all-empty strings) must round-trip through
+    the disk-spill rebuild path as an empty array, not None (regression:
+    jnp.asarray(None) crash in SpillableHandle._rebuild)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.cache import frame_to_batch, batch_to_frame
+    col = Column(dts.STRING, jnp.zeros(0, dtype=jnp.uint8), 3,
+                 offsets=jnp.zeros(4, dtype=jnp.int32))
+    batch = ColumnarBatch({"s": col}, 3)
+    out = frame_to_batch(batch_to_frame(batch), batch.schema)
+    assert out.nrows == 3
+    assert out.column("s").data.shape == (0,)
+    assert out.column("s").offsets.tolist() == [0, 0, 0, 0]
+
+
+def test_prefetcher_incremental_sliding_window(tmp_path):
+    """Sliding-window submits while workers are mid-read (regression: task
+    vector reallocation invalidated worker references; 400-file incremental
+    submit pattern from io/multifile.py deadlocked)."""
+    paths = []
+    for i in range(400):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(bytes([i % 256]) * (100 + i))
+        paths.append(str(p))
+    pf = native.FilePrefetcher(nthreads=4)
+    try:
+        window = 8
+        submitted = 0
+        for i in range(len(paths)):
+            while submitted < min(i + window, len(paths)):
+                pf.submit([paths[submitted]])
+                submitted += 1
+            data = pf.get(i)
+            assert data is not None and len(data) == 100 + i
+            assert data[0] == i % 256
+    finally:
+        pf.close()
+
+
+def test_arena_close_refuses_with_live_views():
+    """close() with outstanding allocations would dangle the numpy views."""
+    a = native.HostArena(1 << 20)
+    buf = a.alloc(256)
+    with pytest.raises(RuntimeError):
+        a.close()
+    a.free(buf)
+    a.close()

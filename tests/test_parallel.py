@@ -182,8 +182,10 @@ def test_distributed_hash_join(mesh, rng, strategy, join_type):
     build_flat = [(_make_sharded(dk), jnp.ones(NSHARDS * CAP, bool)),
                   (_make_sharded(dv, np.float64),
                    jnp.ones(NSHARDS * CAP, bool))]
-    flat, n_out = join(probe_flat, jnp.asarray(p_nrows),
-                       build_flat, jnp.asarray(b_nrows))
+    flat, n_out, total = join(probe_flat, jnp.asarray(p_nrows),
+                              build_flat, jnp.asarray(b_nrows))
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(n_out),
+                                  err_msg="join output truncated")
 
     # collect shard-local outputs
     per_shard = np.asarray(n_out)
