@@ -43,14 +43,12 @@ class _JoinKeyEncoder:
 
     def __init__(self):
         self.codes: Dict[Optional[str], int] = {}
+        self._values: List[Optional[str]] = []
 
     def encode(self, col: Column) -> Column:
-        out = np.empty(col.nrows, dtype=np.int64)
-        for i, s in enumerate(col.to_pylist()):
-            if s is None:
-                out[i] = -1
-            else:
-                out[i] = self.codes.setdefault(s, len(self.codes))
+        from spark_rapids_tpu.ops.dictionary import dict_encode_stable
+        out = dict_encode_stable(col, self.codes, self._values,
+                                 null_code=-1)
         validity = None
         if col.validity is not None:
             validity = np.asarray(col.validity[:col.nrows])

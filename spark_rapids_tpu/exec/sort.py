@@ -57,6 +57,24 @@ class TpuSortExec(TpuExec):
                  for e, d, _ in self.orders]
         return f"TpuSortExec[{', '.join(parts)}]"
 
+    def _eval_keys(self, batch: ColumnarBatch) -> List[ColVal]:
+        """Evaluate sort keys; string keys become order-preserving int32
+        ranks (host-vectorized, per materialized batch — exact for the
+        single-batch sort because ranks are dense over its value set).
+        The device lexsort kernel then treats them as plain numerics."""
+        from spark_rapids_tpu.ops.dictionary import rank_encode
+        keys = []
+        for c in self._key_fn(batch):
+            if c.dtype.is_string:
+                ranks = rank_encode(c)
+                enc = Column.from_numpy(ranks, validity=None,
+                                        capacity=c.capacity)
+                keys.append(ColVal(enc.dtype, enc.data,
+                                   c.validity, None))
+            else:
+                keys.append(ColVal(c.dtype, c.data, c.validity, c.offsets))
+        return keys
+
     def _sort_batch(self, key_cols: List[ColVal], payload: List[ColVal],
                     nrows):
         # row capacity: a string column's .values is its byte buffer, so
@@ -82,8 +100,7 @@ class TpuSortExec(TpuExec):
             merged = concat_batches(batches)
             for h in handles:
                 h.close()
-            key_cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                        for c in self._key_fn(merged)]
+            key_cols = self._eval_keys(merged)
             payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
                        for c in merged.columns.values()]
             outs = self._sort(key_cols, payload, jnp.int32(merged.nrows))
@@ -117,8 +134,7 @@ class TpuTopNExec(TpuExec):
         return f"TpuTopNExec[{self.n}]"
 
     def _sorted_head(self, batch: ColumnarBatch) -> ColumnarBatch:
-        key_cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                    for c in self._inner._key_fn(batch)]
+        key_cols = self._inner._eval_keys(batch)
         payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
                    for c in batch.columns.values()]
         outs = self._inner._sort(key_cols, payload, jnp.int32(batch.nrows))

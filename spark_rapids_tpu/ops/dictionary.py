@@ -1,0 +1,204 @@
+"""Vectorized host-side string key encoding.
+
+Round 1 dictionary-encoded string group-by/join keys with a per-row Python
+loop (``for i, s in enumerate(col.to_pylist())``) — at TPC-DS scale that
+loop IS the runtime.  This module replaces it with numpy-vectorized
+encoders built on one primitive: a zero-padded ``(nrows, width+4)`` byte
+matrix of every row's UTF-8 bytes plus a big-endian length tail.
+
+* Row-wise lexicographic comparison of matrix rows == Spark string
+  ordering (UTF-8 byte-wise lex order equals code-point order; zero
+  padding sorts prefixes first; the length tail only breaks ties between
+  strings that differ in trailing NUL bytes, in the correct direction).
+* ``np.unique(matrix, axis=0)`` therefore yields sorted-by-string uniques
+  and an inverse that is an *order-preserving* dense rank — the device
+  sort kernel consumes the ranks as plain int32 keys.
+* Stable-across-batches dictionary codes (group-by / join keys) loop only
+  over the *distinct* values of each batch, not its rows.
+
+The reference keeps string keys device-side in cudf hash tables
+(stringFunctions.scala, SortUtils); under XLA static shapes the dictionary
+hop stays on host, but vectorized it is a bandwidth copy, not a Python
+interpreter loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.column import Column
+
+
+def row_byte_matrix(col: Column) -> Tuple[np.ndarray, np.ndarray]:
+    """``(nrows, width+4)`` uint8 matrix of each row's bytes (zero-padded)
+    with a big-endian length tail, plus the row validity mask.
+
+    Null rows encode as all-zero (callers mask them via validity).
+    """
+    n = col.nrows
+    offs = np.asarray(col.offsets[: n + 1]).astype(np.int64)
+    chars = np.asarray(col.data)
+    valid = col.validity_numpy()
+    lens = (offs[1:] - offs[:-1]) if n else np.zeros(0, dtype=np.int64)
+    if not valid.all():
+        lens = np.where(valid, lens, 0)
+    width = int(lens.max()) if n and lens.size else 0
+    mat = np.zeros((n, width + 4), dtype=np.uint8)
+    if width and len(chars):
+        idx = offs[:-1, None] + np.arange(width, dtype=np.int64)[None, :]
+        mask = (np.arange(width, dtype=np.int64)[None, :] < lens[:, None])
+        np.copyto(mat[:, :width],
+                  np.where(mask, chars[np.minimum(idx, len(chars) - 1)], 0))
+    mat[:, width + 0] = (lens >> 24) & 0xFF
+    mat[:, width + 1] = (lens >> 16) & 0xFF
+    mat[:, width + 2] = (lens >> 8) & 0xFF
+    mat[:, width + 3] = lens & 0xFF
+    return mat, valid
+
+
+def _hash_rows(mat: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a over the byte columns (one pass per matrix
+    column, not per row)."""
+    h = np.full(mat.shape[0], 0xCBF29CE484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    for j in range(mat.shape[1]):
+        h = (h ^ mat[:, j].astype(np.uint64)) * prime
+    return h
+
+
+def _unique_rows(mat: np.ndarray):
+    """(uniq_rows, inverse): uniques in string-lexicographic order,
+    inverse[i] = order-preserving dense rank of row i.
+
+    Hash-based: ``np.unique(mat, axis=0)`` sorts n void rows with per-row
+    memcmp (measured ~12x slower than the round-1 Python loop at 1M rows);
+    instead dedupe on a 64-bit row hash, verify exactness by comparing
+    every row against its representative (any collision — astronomically
+    rare — falls back to the exact sort), then lexsort only the distinct
+    representatives."""
+    n = mat.shape[0]
+    h = _hash_rows(mat)
+    _, first_idx, inv = np.unique(h, return_index=True,
+                                  return_inverse=True)
+    reps = mat[first_idx]
+    if not np.array_equal(mat, reps[inv]):
+        uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+        return uniq, inverse.reshape(-1)
+    order = np.lexsort(reps.T[::-1])  # primary key = first byte column
+    rank_of = np.empty(len(order), dtype=np.int64)
+    rank_of[order] = np.arange(len(order))
+    return reps[order], rank_of[inv]
+
+
+def _unique_bytes(uniq_row: np.ndarray) -> bytes:
+    length = int.from_bytes(uniq_row[-4:].tobytes(), "big")
+    return uniq_row[:length].tobytes()
+
+
+def _arrow_dictionary(col: Column):
+    """pyarrow hash-based dictionary encode over the column's buffers,
+    zero-copy (~10x the numpy matrix fallback).  Returns
+    ``(inverse, dictionary: pa.Array)`` or None when pyarrow is absent."""
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return None
+    n = col.nrows
+    valid = col.validity_numpy()
+    offs = np.ascontiguousarray(
+        np.asarray(col.offsets[: n + 1], dtype=np.int32))
+    chars = np.ascontiguousarray(np.asarray(col.data))
+    validity_buf = None
+    if not valid.all():
+        validity_buf = pa.py_buffer(np.packbits(valid, bitorder="little"))
+    arr = pa.Array.from_buffers(
+        pa.utf8(), n,
+        [validity_buf, pa.py_buffer(offs), pa.py_buffer(chars)])
+    d = arr.dictionary_encode()
+    inverse = np.asarray(d.indices.fill_null(0)).astype(np.int64)
+    return inverse, d.dictionary
+
+
+def _encode_distinct(col: Column):
+    """(inverse, distinct, valid): per-row index into the batch-local
+    distinct-value list (arbitrary index for null rows), the distinct
+    values as Python strings, and the validity mask."""
+    valid = col.validity_numpy()
+    enc = _arrow_dictionary(col)
+    if enc is not None:
+        inverse, dictionary = enc
+        distinct = dictionary.to_pylist()
+        if not distinct and col.nrows:  # all rows null: keep luts non-empty
+            return np.zeros(col.nrows, dtype=np.int64), [""], valid
+        return inverse, distinct, valid
+    mat, _ = row_byte_matrix(col)
+    uniq, inverse = _unique_rows(mat)
+    distinct = [_unique_bytes(u).decode("utf-8") for u in uniq]
+    return inverse, distinct, valid
+
+
+def rank_encode(col: Column) -> np.ndarray:
+    """Order-preserving int32 dense ranks of the column's values (within
+    this column's value set only — not stable across batches).  Null rows
+    get rank 0; callers order them via the validity mask.
+
+    Only the *distinct* values are ordered — via arrow's C++ sort (utf8
+    sorts byte-wise lexicographic == Spark string order), so even
+    near-unique sort keys never hit Python-per-value work.  The numpy
+    fallback's ``_unique_rows`` inverse is already an order-preserving
+    rank."""
+    n = col.nrows
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    enc = _arrow_dictionary(col)
+    if enc is not None:
+        import pyarrow.compute as pc
+        inverse, dictionary = enc
+        k = len(dictionary)
+        if k == 0:
+            return np.zeros(n, dtype=np.int32)
+        order = np.asarray(pc.sort_indices(dictionary))
+        rank = np.empty(k, dtype=np.int32)
+        rank[order] = np.arange(k, dtype=np.int32)
+        return rank[inverse]
+    mat, _ = row_byte_matrix(col)
+    _, inverse = _unique_rows(mat)
+    return inverse.astype(np.int32)
+
+
+def dict_encode_stable(col: Column, codes: Dict[Optional[str], int],
+                       values: List[Optional[str]],
+                       null_code: Optional[int] = None) -> np.ndarray:
+    """Dictionary-encode with codes stable across batches: the first
+    appearance of a value (across all calls sharing ``codes``/``values``)
+    fixes its code.  Python work is O(distinct per batch), not O(rows).
+
+    ``null_code``: fixed code for null rows; None means nulls intern like
+    values (keyed on the None entry), matching the group-by encoder.
+    """
+    n = col.nrows
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    inverse, distinct, valid = _encode_distinct(col)
+    lut = np.empty(len(distinct), dtype=np.int64)
+    for j, s in enumerate(distinct):
+        code = codes.get(s)
+        if code is None:
+            code = len(values)
+            codes[s] = code
+            values.append(s)
+        lut[j] = code
+    out = lut[inverse]
+    if not valid.all():
+        if null_code is not None:
+            out = np.where(valid, out, null_code)
+        else:
+            code = codes.get(None)
+            if code is None:
+                code = len(values)
+                codes[None] = code
+                values.append(None)
+            out = np.where(valid, out, code)
+    return out

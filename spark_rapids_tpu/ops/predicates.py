@@ -29,21 +29,28 @@ def _is_float(v) -> bool:
 
 
 class _Comparison(BinaryExpression):
+    # name of the stringops comparator for string operands (ordering
+    # comparisons are exact byte-wise lex — stringops._string_lex_compare)
+    _string_op: Optional[str] = None
+
     @property
     def dtype(self) -> DataType:
         return dts.BOOL
 
-
-class EqualTo(_Comparison):
     def emit(self, ctx: EmitContext) -> ColVal:
-        if self.left.dtype.is_string and self.right.dtype.is_string:
+        if self.left.dtype.is_string and self.right.dtype.is_string and \
+                self._string_op is not None:
             from spark_rapids_tpu.ops import stringops
             l = self.left.emit(ctx)
             r = self.right.emit(ctx)
-            eq = stringops.string_equal(l, r, ctx)
-            return ColVal(dts.BOOL, eq,
+            vals = getattr(stringops, self._string_op)(l, r, ctx)
+            return ColVal(dts.BOOL, vals,
                           combine_validity(l.validity, r.validity))
         return super().emit(ctx)
+
+
+class EqualTo(_Comparison):
+    _string_op = "string_equal"
 
     def eval_values(self, l, r):
         eq = l == r
@@ -53,6 +60,8 @@ class EqualTo(_Comparison):
 
 
 class LessThan(_Comparison):
+    _string_op = "string_lt"
+
     def eval_values(self, l, r):
         lt = l < r
         if _is_float(l):  # NaN is largest: NaN < x is false, x < NaN true unless x NaN
@@ -62,6 +71,8 @@ class LessThan(_Comparison):
 
 
 class LessThanOrEqual(_Comparison):
+    _string_op = "string_le"
+
     def eval_values(self, l, r):
         le = l <= r
         if _is_float(l):
@@ -71,6 +82,8 @@ class LessThanOrEqual(_Comparison):
 
 
 class GreaterThan(_Comparison):
+    _string_op = "string_gt"
+
     def eval_values(self, l, r):
         gt = l > r
         if _is_float(l):
@@ -80,6 +93,8 @@ class GreaterThan(_Comparison):
 
 
 class GreaterThanOrEqual(_Comparison):
+    _string_op = "string_ge"
+
     def eval_values(self, l, r):
         ge = l >= r
         if _is_float(l):

@@ -778,6 +778,68 @@ def string_equal(l: ColVal, r: ColVal, ctx: EmitContext):
     return jnp.logical_and(same_len, jnp.logical_not(any_bad))
 
 
+def _string_lex_compare(l: ColVal, r: ColVal, ctx: EmitContext):
+    """(has_diff, l_byte_lt, len_lt, len_le): first-differing-byte verdict
+    for per-row lexicographic comparison of two string ColVals.
+
+    Single pass over l's char buffer (the byte->row map + segment_min find
+    the first position where the rows differ); ties fall to length
+    comparison.  UTF-8 byte-wise lex order == code-point order, so this is
+    exact Spark string ordering.
+    """
+    l = _as_string_col(l, ctx)
+    r = _as_string_col(r, ctx)
+    # An empty-string literal (or all-empty column) has a zero-length char
+    # buffer; every gather below would clip to bound -1 and crash.  Pad to
+    # one byte — offsets are all zero so the byte is never semantically
+    # read (the `within`/has_diff masks exclude it).
+    if l.values.shape[0] == 0:
+        l = ColVal(l.dtype, jnp.zeros(1, dtype=jnp.uint8), l.validity,
+                   l.offsets)
+    if r.values.shape[0] == 0:
+        r = ColVal(r.dtype, jnp.zeros(1, dtype=jnp.uint8), r.validity,
+                   r.offsets)
+    cap = ctx.capacity
+    len_l = row_lengths(l)
+    len_r = row_lengths(r)
+    minlen = jnp.minimum(len_l, len_r)
+    ccap = l.values.shape[0]
+    pos = jnp.arange(ccap, dtype=jnp.int32)
+    row = byte_to_row(l, cap)
+    k = pos - l.offsets[row]
+    r_idx = jnp.clip(r.offsets[row] + k, 0, r.values.shape[0] - 1)
+    within = jnp.logical_and(k < minlen[row], pos < l.offsets[cap])
+    differ = jnp.logical_and(within, l.values != r.values[r_idx])
+    big = jnp.int32(1 << 30)
+    first_k = jax.ops.segment_min(jnp.where(differ, k, big), row,
+                                  num_segments=cap)
+    has_diff = first_k < big
+    safe_k = jnp.where(has_diff, first_k, 0)
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    lb = l.values[jnp.clip(l.offsets[rows] + safe_k, 0, ccap - 1)]
+    rb = r.values[jnp.clip(r.offsets[rows] + safe_k, 0,
+                           r.values.shape[0] - 1)]
+    return has_diff, lb < rb, len_l < len_r, len_l <= len_r
+
+
+def string_lt(l: ColVal, r: ColVal, ctx: EmitContext):
+    has_diff, byte_lt, len_lt, _ = _string_lex_compare(l, r, ctx)
+    return jnp.where(has_diff, byte_lt, len_lt)
+
+
+def string_le(l: ColVal, r: ColVal, ctx: EmitContext):
+    has_diff, byte_lt, _, len_le = _string_lex_compare(l, r, ctx)
+    return jnp.where(has_diff, byte_lt, len_le)
+
+
+def string_gt(l: ColVal, r: ColVal, ctx: EmitContext):
+    return jnp.logical_not(string_le(l, r, ctx))
+
+
+def string_ge(l: ColVal, r: ColVal, ctx: EmitContext):
+    return jnp.logical_not(string_lt(l, r, ctx))
+
+
 # -------------------------------------------------------------------- casts
 
 def cast_string(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:

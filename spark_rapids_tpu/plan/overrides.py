@@ -138,14 +138,6 @@ class ExprMeta(BaseMeta):
 
     def tag(self) -> None:
         expr = self.wrapped
-        if isinstance(expr, (preds.LessThan, preds.LessThanOrEqual,
-                             preds.GreaterThan, preds.GreaterThanOrEqual)):
-            try:
-                if any(c.dtype.is_string for c in expr.children):
-                    self.will_not_work(
-                        "string ordering comparisons not yet supported")
-            except (RuntimeError, TypeError):
-                pass
         if isinstance(expr, S.Like) and not expr.supported:
             self.will_not_work(
                 f"LIKE pattern {expr.pattern!r} too general for TPU")
@@ -195,9 +187,6 @@ class PlanMeta(BaseMeta):
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")
-        if isinstance(node, L.Sort) and any(
-                e.dtype.is_string for e, _, _ in node.orders):
-            self.will_not_work("string sort keys not yet supported on TPU")
         if isinstance(node, L.Join):
             if node.condition is not None:
                 self.will_not_work(

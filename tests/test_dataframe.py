@@ -168,8 +168,9 @@ def test_explain_smoke(session, capsys):
 
 def test_strict_mode_raises():
     s = TpuSession({"spark.rapids.sql.test.enabled": True})
-    # string sort keys still fall back to CPU
-    df = s.create_dataframe({"a": ["b", "a"]}).orderBy("a")
+    # a LIKE pattern with the _ wildcard still falls back
+    df = s.create_dataframe({"a": ["axb", "ab"]}).filter(
+        F.col("a").like("a_b"))
     with pytest.raises(RuntimeError, match="fell back to CPU"):
         df.collect()
 

@@ -195,9 +195,12 @@ def test_topn(session):
     assert got == want
 
 
-def test_sort_strings_falls_back(session):
+def test_sort_strings_runs_native(session):
+    """String sort keys run on device since round 2 (rank-encoded keys);
+    previously this fell back to CPU."""
     pdf = pd.DataFrame({"s": ["b", "a", "c"]})
     q = session.create_dataframe(pdf).orderBy("s")
     tree = session.plan(q.plan).tree_string()
-    assert "CpuFallbackExec" in tree
+    assert "CpuFallbackExec" not in tree
+    assert "TpuSortExec" in tree
     assert q.to_pandas()["s"].tolist() == ["a", "b", "c"]
