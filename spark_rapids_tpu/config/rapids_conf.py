@@ -114,6 +114,26 @@ BATCH_ROW_CAPACITY = conf(
     "Maximum rows per device batch (shape-bucket ceiling). TPU-specific: "
     "bounds the set of XLA-compiled shapes.", _to_int, _positive)
 
+SORT_OOC_THRESHOLD = conf(
+    "spark.rapids.sql.sort.outOfCoreThresholdBytes", 256 << 20,
+    "Total input bytes above which multi-batch sorts use the windowed "
+    "out-of-core merge (sorted spillable runs, bounded merge windows) "
+    "instead of one concatenated device sort (reference "
+    "GpuSortExec.scala:225 GpuOutOfCoreSortIterator).", _to_int, _positive)
+
+SORT_OOC_WINDOW_ROWS = conf(
+    "spark.rapids.sql.sort.outOfCoreWindowRows", 1 << 16,
+    "Rows pulled from each sorted run per merge step of the out-of-core "
+    "sort; bounds the merge working set to ~2*runs*window rows.",
+    _to_int, _positive)
+
+AGG_MERGE_CHUNK_ROWS = conf(
+    "spark.rapids.sql.agg.mergeChunkRows", 1 << 22,
+    "Partial-aggregate batches are merged in chunks of at most this many "
+    "rows (tree reduction) instead of one concatenation of every partial, "
+    "so the merge working set stays bounded (reference sort-based "
+    "fallback, aggregate.scala:184-197).", _to_int, _positive)
+
 CONCURRENT_TPU_TASKS = conf(
     "spark.rapids.sql.concurrentTpuTasks", 1,
     "Number of tasks that may issue work to the TPU concurrently "

@@ -85,11 +85,13 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Tuple[str, AggregateExpression]],
                  child: TpuExec,
-                 pre_filter: Optional[Expression] = None):
+                 pre_filter: Optional[Expression] = None,
+                 merge_chunk_rows: int = 1 << 22):
         """``pre_filter``: a fused upstream Filter condition (whole-stage
         fusion: predicate becomes a row mask inside the aggregation kernel —
         no compaction pass at all)."""
         super().__init__(child)
+        self.merge_chunk_rows = merge_chunk_rows
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
         self.pre_filter = pre_filter
@@ -137,6 +139,8 @@ class TpuHashAggregateExec(TpuExec):
         # only in filter constants share the merge executable
         self._merge_fn = cached_jit(("agg_merge",) + base_sig,
                                     lambda: self._merge)
+        self._merge_partial_fn = cached_jit(
+            ("agg_merge_partial",) + base_sig, lambda: self._merge_partial)
 
     # ------------------------------------------------------------------ plan --
     @property
@@ -258,7 +262,8 @@ class TpuHashAggregateExec(TpuExec):
         return ColumnarBatch(dict(zip(names, cols)), n)
 
     # ------------------------------------------------------------ merge stage --
-    def _merge(self, flat_cols, nrows):
+    def _merge_body(self, flat_cols, nrows):
+        """Shared merge group-by/reduce over partial-schema columns."""
         dtypes = [dt for _, dt in self._partial_schema]
         nkeys = len(self.group_exprs)
         capacity = capacity_of(flat_cols)
@@ -266,16 +271,71 @@ class TpuHashAggregateExec(TpuExec):
         keys, bufs = cols[:nkeys], cols[nkeys:]
         merge_inputs = [(k, c) for k, c in zip(self._merge_kinds, bufs)]
         if keys:
-            out_keys, out_bufs, n = agg.groupby_aggregate(
-                keys, merge_inputs, nrows, capacity)
-        else:
-            out_keys = []
-            out_bufs = agg.reduce_aggregate(merge_inputs, nrows, capacity)
-            n = jnp.int32(1)
+            return agg.groupby_aggregate(keys, merge_inputs, nrows,
+                                         capacity)
+        out_bufs = agg.reduce_aggregate(merge_inputs, nrows, capacity)
+        return [], out_bufs, jnp.int32(1)
+
+    def _merge(self, flat_cols, nrows):
+        out_keys, out_bufs, n = self._merge_body(flat_cols, nrows)
         results = [f.finalize(out_bufs[sl])
                    for f, sl in zip(self.funcs, self._buf_slices)]
         return ([(k.values, k.validity, k.offsets) for k in out_keys],
                 [(r.values, r.validity, r.offsets) for r in results], n)
+
+    def _merge_partial(self, flat_cols, nrows):
+        """Merge partial batches into one partial batch (no finalize) —
+        the tree-reduction step bounding the final concat (the reference's
+        sort-based fallback serves the same purpose, aggregate.scala:
+        184-197: never require every partial in memory at once)."""
+        out_keys, out_bufs, n = self._merge_body(flat_cols, nrows)
+        return ([(k.values, k.validity, k.offsets) for k in out_keys],
+                [(b.values, b.validity, b.offsets) for b in out_bufs], n)
+
+    def _tree_merge(self, handles, catalog):
+        """Reduce partial handles until their total rows fit one merge
+        chunk; each step merges >=2 partials into one (still-partial)
+        spillable batch, so the device never holds every partial."""
+        names = [n for n, _ in self._partial_schema]
+        dtypes = [dt for _, dt in self._partial_schema]
+        chunk = self.merge_chunk_rows
+        while len(handles) > 1 and \
+                sum(h.nrows for h in handles) > chunk:
+            group = []
+            rows = 0
+            while handles and (len(group) < 2 or
+                               rows + handles[0].nrows <= chunk):
+                h = handles.pop(0)
+                group.append(h)
+                rows += h.nrows
+                if rows >= chunk and len(group) >= 2:
+                    break
+            with self.timer(CONCAT_TIME):
+                merged_in = concat_batches([h.materialize()
+                                            for h in group])
+            for h in group:
+                h.close()
+            with self.timer(AGG_TIME):
+                key_flat, buf_flat, n = self._merge_partial_fn(
+                    batch_to_flat(merged_in), jnp.int32(merged_in.nrows))
+                n = 1 if not self.group_exprs else int(n)
+            outs = [ColVal(dt, v, val, offs)
+                    for dt, (v, val, offs) in
+                    zip(dtypes, list(key_flat) + list(buf_flat))]
+            # compact to the live row count before registering: n is
+            # already concrete here, and keeping the concat capacity
+            # would make padding, not rows, dominate the spill bytes
+            from spark_rapids_tpu.columnar.column import bucket_capacity
+            out_cap = min(bucket_capacity(n), merged_in.capacity)
+            if out_cap < merged_in.capacity:
+                outs = [ColVal(c.dtype, c.values[:out_cap],
+                               None if c.validity is None
+                               else c.validity[:out_cap], c.offsets)
+                        for c in outs]
+            cols = colvals_to_columns(outs, n, out_cap)
+            handles.append(
+                catalog.register(ColumnarBatch(dict(zip(names, cols)), n)))
+        return handles
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.spill import default_catalog
@@ -289,6 +349,7 @@ class TpuHashAggregateExec(TpuExec):
                 return
             partials = [empty_batch(self._partial_schema)]
         else:
+            handles = self._tree_merge(handles, catalog)
             partials = [h.materialize() for h in handles]
         with self.timer(CONCAT_TIME):
             merged_in = concat_batches(partials)

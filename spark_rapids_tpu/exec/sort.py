@@ -17,9 +17,10 @@ from typing import Iterator, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
 from spark_rapids_tpu.exec.base import SORT_TIME, Schema, TpuExec
 from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops import selection
@@ -32,8 +33,12 @@ Order = Tuple[Expression, bool, bool]
 
 
 class TpuSortExec(TpuExec):
-    def __init__(self, orders: Sequence[Order], child: TpuExec):
+    def __init__(self, orders: Sequence[Order], child: TpuExec,
+                 ooc_threshold_bytes: int = 256 << 20,
+                 ooc_window_rows: int = 1 << 16):
         super().__init__(child)
+        self.ooc_threshold_bytes = ooc_threshold_bytes
+        self.ooc_window_rows = ooc_window_rows
         self.orders = list(orders)
         self._key_fn = StageFn([e for e, _, _ in orders],
                                [dt for _, dt in child.schema])
@@ -89,26 +94,157 @@ class TpuSortExec(TpuExec):
             nulls_first=[nf for _, _, nf in self.orders])
         return selection.gather(payload, perm, nrows)
 
+    def _sorted_batch(self, batch: ColumnarBatch,
+                      extra_payload: Sequence[ColVal] = ()
+                      ) -> List[ColVal]:
+        """Device-sort one batch; extra payload columns ride the same
+        permutation (used for the merge-phase source tags)."""
+        key_cols = self._eval_keys(batch)
+        payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                   for c in batch.columns.values()] + list(extra_payload)
+        return self._sort(key_cols, payload, jnp.int32(batch.nrows))
+
+    def _emit(self, outs: Sequence[ColVal], nrows: int) -> ColumnarBatch:
+        names = [n for n, _ in self.schema]
+        cols = {nm: Column(o.dtype, o.values, nrows,
+                           validity=o.validity, offsets=o.offsets)
+                for nm, o in zip(names, outs)}
+        return ColumnarBatch(cols, nrows)
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.spill import default_catalog
         catalog = default_catalog()
         handles = [catalog.register(b) for b in self.child.execute()]
         if not handles:
             return
+        total_bytes = sum(h.size_bytes for h in handles)
+        if len(handles) > 1 and total_bytes > self.ooc_threshold_bytes:
+            yield from self._out_of_core(handles, catalog)
+            return
         with self.timer(SORT_TIME):
             batches = [h.materialize() for h in handles]
             merged = concat_batches(batches)
             for h in handles:
                 h.close()
-            key_cols = self._eval_keys(merged)
-            payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                       for c in merged.columns.values()]
-            outs = self._sort(key_cols, payload, jnp.int32(merged.nrows))
-        names = [n for n, _ in self.schema]
-        cols = {nm: Column(o.dtype, o.values, merged.nrows,
-                           validity=o.validity, offsets=o.offsets)
-                for nm, o in zip(names, outs)}
-        yield ColumnarBatch(cols, merged.nrows)
+            outs = self._sorted_batch(merged)
+        yield self._emit(outs, merged.nrows)
+
+    # ------------------------------------------------------- out-of-core --
+    def _slice_rows(self, batch: ColumnarBatch, start: int, count: int,
+                    out_capacity: int) -> ColumnarBatch:
+        """Rows [start, start+count) into a fresh batch of out_capacity;
+        string char buffers are resized to the slice's own char count (a
+        window of a big string run must not inherit the run's full char
+        capacity, or the merge working-set bound fails for strings)."""
+        idx = jnp.clip(jnp.arange(out_capacity, dtype=jnp.int32) + start,
+                       0, max(batch.capacity - 1, 0))
+        cols = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                for c in batch.columns.values()]
+        char_cap = 0
+        for c in cols:
+            if c.offsets is not None:
+                cc = int(selection.gathered_char_count(
+                    c.offsets, idx, jnp.int32(count)))
+                char_cap = max(char_cap, cc)
+        outs = selection.gather(
+            cols, idx, jnp.int32(count),
+            char_capacity=bucket_capacity(char_cap) if char_cap else 0)
+        names = [n for n, _ in batch.schema]
+        return ColumnarBatch(
+            {nm: Column(o.dtype, o.values, count, validity=o.validity,
+                        offsets=o.offsets)
+             for nm, o in zip(names, outs)}, count)
+
+    def _out_of_core(self, handles, catalog) -> Iterator[ColumnarBatch]:
+        """Windowed merge of sorted spillable runs
+        (GpuOutOfCoreSortIterator, GpuSortExec.scala:225 — redesigned for
+        the device: the merge step is itself a bounded device sort).
+
+        Each input batch is device-sorted and split into window-sized
+        spillable chunks, so a merge step unspills exactly one chunk per
+        refilled run — never a whole run.  Per step, the carry plus the
+        refill windows are sorted together; every row up to the earliest
+        live-run boundary is globally final and is emitted.  The boundary
+        needs NO key comparisons: each live run's last resident row
+        carries an int32 source tag through the sort (persisting in the
+        carry across steps), and the earliest tagged position bounds the
+        emit.  Only runs whose tagged row was emitted are refilled, so
+        the carry holds at most one window per live run and the working
+        set stays <= ~2 * runs * window rows even for disjoint-range
+        runs (e.g. pre-sorted input split into batches)."""
+        window = self.ooc_window_rows
+        with self.timer(SORT_TIME):
+            runs = []  # {"chunks": [spillable handles], "next": int}
+            for h in handles:
+                b = h.materialize()
+                h.close()
+                outs = self._sorted_batch(b)
+                sb = self._emit(outs, b.nrows)
+                chunks = []
+                for start in range(0, sb.nrows, window):
+                    take = min(window, sb.nrows - start)
+                    chunks.append(catalog.register(self._slice_rows(
+                        sb, start, take, bucket_capacity(take))))
+                if chunks:
+                    runs.append({"chunks": chunks, "next": 0})
+        carry: ColumnarBatch = None
+        carry_tags = np.zeros(0, dtype=np.int32)
+        need = set(range(len(runs)))
+        while True:
+            with self.timer(SORT_TIME):
+                windows = []
+                tags = [carry_tags] if carry is not None else []
+                for rid in sorted(need):
+                    run = runs[rid]
+                    if run["next"] >= len(run["chunks"]):
+                        continue
+                    ch = run["chunks"][run["next"]]
+                    run["next"] += 1
+                    win = ch.materialize()
+                    ch.close()
+                    exhausted = run["next"] >= len(run["chunks"])
+                    tag = np.full(win.nrows, -1, dtype=np.int32)
+                    if not exhausted:
+                        tag[win.nrows - 1] = rid
+                    windows.append(win)
+                    tags.append(tag)
+                need = set()
+                parts = ([carry] if carry is not None else []) + windows
+                if not parts:
+                    return
+                merged = concat_batches(parts)
+                tag_np = np.concatenate(tags) if tags else \
+                    np.zeros(0, dtype=np.int32)
+                padded = np.full(merged.capacity, -1, dtype=np.int32)
+                padded[: len(tag_np)] = tag_np
+                tag_col = ColVal(None, jnp.asarray(padded), None)
+                outs = self._sorted_batch(merged, extra_payload=[tag_col])
+                sorted_tags = np.asarray(outs[-1].values[:merged.nrows])
+                outs = outs[:-1]
+                batch = self._emit(outs, merged.nrows)
+                tagged = np.nonzero(sorted_tags >= 0)[0]
+                if not len(tagged):
+                    # no live boundaries left: everything is final
+                    if batch.nrows:
+                        yield batch
+                    return
+                safe = int(tagged[0]) + 1
+                # refill exactly the runs whose boundary row was emitted
+                for pos in tagged:
+                    if pos < safe:
+                        need.add(int(sorted_tags[pos]))
+                out = self._slice_rows(batch, 0, safe,
+                                       bucket_capacity(safe))
+                rest = merged.nrows - safe
+                if rest:
+                    carry = self._slice_rows(batch, safe, rest,
+                                             bucket_capacity(rest))
+                    carry_tags = sorted_tags[safe:].astype(np.int32)
+                else:
+                    carry = None
+                    carry_tags = np.zeros(0, dtype=np.int32)
+            if out.nrows:
+                yield out
 
 
 class TpuTopNExec(TpuExec):

@@ -62,6 +62,10 @@ class SpillableHandle:
         self._nrows = batch.nrows
         self.closed = False
 
+    @property
+    def nrows(self) -> int:
+        return self._nrows
+
     # -------------------------------------------------------------- movement --
     def _to_host_payload(self) -> dict:
         b = self._device

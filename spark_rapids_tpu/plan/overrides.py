@@ -265,7 +265,7 @@ def _conv_filter(node: L.Filter, children, conf):
 
 
 def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
-                    pre_filter=None):
+                    pre_filter=None, merge_chunk_rows=1 << 22):
     """Build the aggregate exec, plus a result projection when outputs
     combine aggregates in larger expressions (sum(x)*100, sum(a)/sum(b)...
     — Catalyst's resultExpressions split)."""
@@ -300,10 +300,12 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
         return TpuHashAggregateExec(
             group_exprs,
             [(name, a) for (name, _), a in zip(out_named, agg_list)],
-            child_exec, pre_filter=pre_filter)
+            child_exec, pre_filter=pre_filter,
+            merge_chunk_rows=merge_chunk_rows)
     agg_exec = TpuHashAggregateExec(
         group_exprs, [(f"_a{i}", a) for i, a in enumerate(agg_list)],
-        child_exec, pre_filter=pre_filter)
+        child_exec, pre_filter=pre_filter,
+        merge_chunk_rows=merge_chunk_rows)
     proj = [BoundReference(i, dt, name=n)
             for i, (n, dt) in enumerate(agg_exec.schema[:nkeys])]
     proj += [Alias(rewritten, name) for name, rewritten in out_named]
@@ -312,7 +314,9 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
 
 @_converter(L.Aggregate)
 def _conv_aggregate(node: L.Aggregate, children, conf):
-    return _plan_aggregate(node.group_exprs, node.agg_exprs, children[0])
+    from spark_rapids_tpu.config import rapids_conf as rc
+    return _plan_aggregate(node.group_exprs, node.agg_exprs, children[0],
+                           merge_chunk_rows=conf.get(rc.AGG_MERGE_CHUNK_ROWS))
 
 
 @_converter(L.Limit)
@@ -335,8 +339,12 @@ def _conv_range(node: L.Range, children, conf):
 
 @_converter(L.Sort)
 def _conv_sort(node: L.Sort, children, conf):
+    from spark_rapids_tpu.config import rapids_conf as rc
     from spark_rapids_tpu.exec.sort import TpuSortExec
-    return TpuSortExec(node.orders, children[0])
+    return TpuSortExec(
+        node.orders, children[0],
+        ooc_threshold_bytes=conf.get(rc.SORT_OOC_THRESHOLD),
+        ooc_window_rows=conf.get(rc.SORT_OOC_WINDOW_ROWS))
 
 
 @_converter(L.Join)
@@ -524,5 +532,8 @@ class TpuOverrides:
                 return None
         if any(e.dtype.is_string for e in group):
             return None  # string keys take the host dict-encode path
+        from spark_rapids_tpu.config import rapids_conf as rc
         base = self._convert(child_meta)
-        return _plan_aggregate(group, aggs, base, pre_filter=cond)
+        return _plan_aggregate(
+            group, aggs, base, pre_filter=cond,
+            merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS))
