@@ -45,6 +45,9 @@ class DataFrame:
 
     def select(self, *cols: Union[Col, str]) -> "DataFrame":
         exprs = [_expr(c) for c in cols]
+        gen = self._route_generate(exprs)
+        if gen is not None:
+            return gen
         win = [(i, e) for i, e in enumerate(exprs) if _is_window(e)]
         if win:
             # route window expressions through a Window node, then project
@@ -66,6 +69,28 @@ class DataFrame:
                     final.append(e)
             return DataFrame(self.session, L.Project(final, wplan))
         return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def _route_generate(self, exprs) -> Optional["DataFrame"]:
+        """Route F.explode/F.posexplode in a select into an L.Generate
+        node (Spark plans Generate the same way)."""
+        from spark_rapids_tpu.api.functions import _ExplodeMarker
+        from spark_rapids_tpu.ops.expressions import Alias
+
+        def marker_of(e):
+            inner = e.children[0] if isinstance(e, Alias) else e
+            return inner if isinstance(inner, _ExplodeMarker) else None
+
+        marked = [(i, e, marker_of(e)) for i, e in enumerate(exprs)]
+        gens = [(i, e, m) for i, e, m in marked if m is not None]
+        if not gens:
+            return None
+        if len(gens) > 1:
+            raise ValueError("only one explode per select is supported")
+        i, e, m = gens[0]
+        required = [x for j, x in enumerate(exprs) if j != i]
+        col_name = e.alias if isinstance(e, Alias) else "col"
+        return DataFrame(self.session, L.Generate(
+            m.child, required, m.position, self.plan, col_name=col_name))
 
     def filter(self, condition: Col) -> "DataFrame":
         return DataFrame(self.session, L.Filter(_expr(condition), self.plan))

@@ -601,3 +601,67 @@ def window_max(c) -> _WindowFunc:
 
 def window_avg(c) -> _WindowFunc:
     return _WindowFunc("avg", c)
+
+
+# --------------------------------------------------------------- collections --
+
+class _ExplodeMarker(Expression):
+    """select-time marker routed into an L.Generate node by DataFrame.select
+    (Spark's Generate planning of explode/posexplode)."""
+
+    def __init__(self, child: Expression, position: bool):
+        self.children = (child,)
+        self.position = position
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self):
+        return self.child.dtype.element
+
+    def with_children(self, children):
+        return _ExplodeMarker(children[0], self.position)
+
+    @property
+    def name(self) -> str:
+        return "col"
+
+
+def array(*cols) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import CreateArray
+    return Col(CreateArray(*[_expr(c) for c in cols]))
+
+
+def size(c) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import Size
+    return Col(Size(_expr(c)))
+
+
+def array_contains(c, value) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import ArrayContains
+    return Col(ArrayContains(_expr(c), _lit_expr(value)))
+
+
+def get_array_item(c, index) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import GetArrayItem
+    return Col(GetArrayItem(_expr(c), _lit_expr(index)))
+
+
+def element_at(c, index) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import ElementAt
+    return Col(ElementAt(_expr(c), _lit_expr(index)))
+
+
+def sort_array(c, asc: bool = True) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import SortArray
+    return Col(SortArray(_expr(c), asc))
+
+
+def explode(c) -> Col:
+    return Col(_ExplodeMarker(_expr(c), position=False))
+
+
+def posexplode(c) -> Col:
+    return Col(_ExplodeMarker(_expr(c), position=True))

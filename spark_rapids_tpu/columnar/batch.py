@@ -81,6 +81,13 @@ class ColumnarBatch:
                         any(isinstance(v, str) for v in arr):
                     cols[name] = Column.from_strings(arr, capacity=cap)
                     continue
+                if any(isinstance(v, (list, tuple, np.ndarray))
+                       for v in arr):
+                    flat = [e for v in arr if v is not None for e in v]
+                    edt = dts.from_numpy_dtype(np.asarray(
+                        flat if flat else [0]).dtype)
+                    cols[name] = Column.from_arrays(arr, edt, capacity=cap)
+                    continue
                 validity = np.array([v is not None for v in arr])
                 filled = [0 if v is None else v for v in arr]
                 cols[name] = Column.from_numpy(
@@ -155,7 +162,9 @@ def empty_batch(schema: Schema, capacity: int = 0) -> ColumnarBatch:
     cap = bucket_capacity(max(capacity, 1))
     cols = {}
     for name, dt in schema:
-        if dt.is_string:
+        if dt.is_array:
+            cols[name] = Column.from_arrays([], dt.element, capacity=cap)
+        elif dt.is_string:
             cols[name] = Column.from_strings([], capacity=cap)
         else:
             cols[name] = Column.from_numpy(

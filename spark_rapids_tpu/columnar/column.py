@@ -53,19 +53,21 @@ class Column:
         self.validity = validity  # bool[capacity] or None (all valid)
         self.offsets = offsets    # int32[capacity+1] for strings else None
         self.nrows = int(nrows)
-        if dtype.is_string and offsets is None:
-            raise ValueError("string column requires offsets")
+        if dtype.has_offsets and offsets is None:
+            raise ValueError(f"{dtype} column requires offsets")
 
     # ------------------------------------------------------------------ shape --
     @property
     def capacity(self) -> int:
-        if self.dtype.is_string:
+        if self.dtype.has_offsets:
             return int(self.offsets.shape[0]) - 1
         return int(self.data.shape[0])
 
     @property
     def char_capacity(self) -> int:
-        assert self.dtype.is_string
+        """Element-buffer capacity (chars for strings, elements for
+        arrays)."""
+        assert self.dtype.has_offsets
         return int(self.data.shape[0])
 
     @property
@@ -167,6 +169,50 @@ class Column:
                    validity=dev_validity, offsets=jnp.asarray(off_buf))
 
     @classmethod
+    def from_arrays(cls, values, element: DataType,
+                    validity: Optional[np.ndarray] = None,
+                    capacity: Optional[int] = None,
+                    elem_capacity: Optional[int] = None) -> "Column":
+        """Array column from a list of (list | None): flat element buffer +
+        int32 offsets, the string chars layout generalized to any
+        fixed-width element type.  Null ELEMENTS inside arrays are not
+        supported (the planner tags them off)."""
+        nrows = len(values)
+        valid = np.ones(nrows, dtype=np.bool_)
+        if validity is not None:
+            valid &= np.asarray(validity, dtype=np.bool_)
+        rows = []
+        for i, v in enumerate(values):
+            if v is None:
+                valid[i] = False
+                rows.append([])
+            elif any(e is None for e in v):
+                raise ValueError("null array elements not supported")
+            else:
+                rows.append(list(v))
+        lens = np.array([len(r) for r in rows], dtype=np.int32)
+        offsets = np.zeros(nrows + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:] if nrows else None)
+        total = int(offsets[-1]) if nrows else 0
+        flat = np.array([e for r in rows for e in r],
+                        dtype=element.storage) if total else             np.zeros(0, dtype=element.storage)
+        cap = capacity or bucket_capacity(nrows)
+        ecap = elem_capacity or bucket_capacity(max(total, 1))
+        off_buf = np.zeros(cap + 1, dtype=np.int32)
+        off_buf[: nrows + 1] = offsets
+        off_buf[nrows + 1:] = offsets[-1] if nrows else 0
+        elem_buf = np.zeros(ecap, dtype=element.storage)
+        elem_buf[:total] = flat
+        dev_validity = None
+        if not valid.all():
+            v = np.zeros(cap, dtype=np.bool_)
+            v[:nrows] = valid
+            dev_validity = jnp.asarray(v)
+        from spark_rapids_tpu.columnar.dtypes import ArrayType
+        return cls(ArrayType(element), jnp.asarray(elem_buf), nrows,
+                   validity=dev_validity, offsets=jnp.asarray(off_buf))
+
+    @classmethod
     def from_arrow(cls, arr, capacity: Optional[int] = None) -> "Column":
         import pyarrow as pa
         if isinstance(arr, pa.ChunkedArray):
@@ -176,6 +222,9 @@ class Column:
         dtype = dts.from_arrow_type(arr.type)
         if dtype.is_string:
             return cls.from_strings(arr.to_pylist(), capacity=capacity)
+        if dtype.is_array:
+            return cls.from_arrays(arr.to_pylist(), dtype.element,
+                                   capacity=capacity)
         validity = None
         if arr.null_count:
             validity = ~np.asarray(arr.is_null())
@@ -213,6 +262,18 @@ class Column:
 
     def to_pylist(self):
         valid = self.validity_numpy()
+        if self.dtype.is_array:
+            offs = np.asarray(self.offsets[: self.nrows + 1])
+            elems = np.asarray(self.data)
+            edt = self.dtype.element
+            def conv(x):
+                if edt.is_boolean:
+                    return bool(x)
+                if edt.is_floating:
+                    return float(x)
+                return int(x)
+            return [[conv(v) for v in elems[offs[i]:offs[i + 1]]]
+                    if valid[i] else None for i in range(self.nrows)]
         if self.dtype.is_string:
             offs = np.asarray(self.offsets[: self.nrows + 1])
             chars = np.asarray(self.data)
@@ -238,7 +299,7 @@ class Column:
     def to_arrow(self):
         import pyarrow as pa
         at = dts.to_arrow_type(self.dtype)
-        if self.dtype.is_string:
+        if self.dtype.is_string or self.dtype.is_array:
             return pa.array(self.to_pylist(), type=at)
         vals = self.to_numpy()
         valid = self.validity_numpy()

@@ -36,11 +36,25 @@ class DataType:
     # decimal only
     precision: Optional[int] = None
     scale: Optional[int] = None
+    # array only: the element type (storage then holds the ELEMENT storage
+    # dtype — an array column is flat element values + int32 row offsets,
+    # the same layout strings use for their chars)
+    element: Optional["DataType"] = None
 
     # ---- classification helpers -------------------------------------------------
     @property
     def is_string(self) -> bool:
         return self.name == "string"
+
+    @property
+    def is_array(self) -> bool:
+        return self.element is not None
+
+    @property
+    def has_offsets(self) -> bool:
+        """True when the device layout is (flat values, int32 offsets):
+        strings (chars) and arrays (elements)."""
+        return self.is_string or self.is_array
 
     @property
     def is_boolean(self) -> bool:
@@ -96,6 +110,19 @@ DATE32 = DataType("date", np.dtype(np.int32))  # days since unix epoch
 TIMESTAMP_US = DataType("timestamp", np.dtype(np.int64))  # micros since epoch, UTC
 
 
+def ArrayType(element: DataType) -> DataType:
+    """ARRAY<element>: flat element buffer + int32 offsets (the reference
+    keeps nested types in cudf list columns, GpuColumnVector.java; here the
+    layout mirrors the string chars+offsets pair so all offset-aware
+    kernels — gather, concat, serialize — apply unchanged)."""
+    if element.has_offsets:
+        raise ValueError(
+            f"nested element type {element} not supported (single-level "
+            "arrays of fixed-width elements only)")
+    return DataType(f"array<{element.name}>", element.storage,
+                    element=element)
+
+
 def DecimalType(precision: int, scale: int) -> DataType:
     """DECIMAL_64 only, like the reference snapshot (precision <= 18)."""
     if precision > 18:
@@ -117,6 +144,8 @@ def dtype_from_name(name: str) -> DataType:
     name = name.strip().lower()
     if name in _BY_NAME:
         return _BY_NAME[name]
+    if name.startswith("array<") and name.endswith(">"):
+        return ArrayType(dtype_from_name(name[6:-1]))
     if name.startswith("decimal"):
         inner = name[name.index("(") + 1:name.index(")")]
         p, s = (int(x) for x in inner.split(","))
@@ -151,6 +180,8 @@ def from_numpy_dtype(dt) -> DataType:
 
 def from_arrow_type(at) -> DataType:
     import pyarrow as pa
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type))
     if pa.types.is_boolean(at):
         return BOOL
     if pa.types.is_int8(at):
@@ -196,6 +227,8 @@ def to_arrow_type(dt: DataType):
         return pa.float64()
     if dt.is_string:
         return pa.string()
+    if dt.is_array:
+        return pa.list_(to_arrow_type(dt.element))
     if dt.is_date:
         return pa.date32()
     if dt.is_timestamp:

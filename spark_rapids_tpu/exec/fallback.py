@@ -64,6 +64,36 @@ def _eval_pandas(expr, df: pd.DataFrame):
                               else re.escape(ch) for ch in e.pattern)
         child = _eval_pandas(e.child, df)
         return child.str.match(rx + r"\Z", na=False)
+    from spark_rapids_tpu.ops import collections_ops as C
+    if isinstance(e, C.CreateArray):
+        parts = [_eval_pandas(c, df) for c in e.children]
+        return pd.Series([list(row) for row in zip(*parts)])
+    if isinstance(e, C.Size):
+        child = _eval_pandas(e.child, df)
+        return child.map(lambda v: -1 if v is None else len(v))
+    if isinstance(e, C.SortArray):
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if v is None else
+                         sorted(v, reverse=not e.ascending))
+    if isinstance(e, C.ElementAt):
+        arr = _eval_pandas(e.children[0], df)
+        idx = _eval_pandas(e.children[1], df)
+        def at(v, i):
+            if v is None:
+                return None
+            j = i - 1 if i > 0 else len(v) + i
+            return v[j] if 0 <= j < len(v) else None
+        return pd.Series([at(v, i) for v, i in zip(arr, idx)])
+    if isinstance(e, C.GetArrayItem):
+        arr = _eval_pandas(e.children[0], df)
+        idx = _eval_pandas(e.children[1], df)
+        return pd.Series([None if v is None or not 0 <= i < len(v)
+                          else v[i] for v, i in zip(arr, idx)])
+    if isinstance(e, C.ArrayContains):
+        arr = _eval_pandas(e.children[0], df)
+        val = _eval_pandas(e.children[1], df)
+        return pd.Series([None if v is None else (x in v)
+                          for v, x in zip(arr, val)])
     raise NotImplementedError(
         f"CPU fallback cannot evaluate {type(e).__name__}")
 
@@ -121,6 +151,22 @@ class CpuFallbackExec(TpuExec):
         elif isinstance(node, L.Union):
             out = pd.concat([self._child_pandas(i)
                              for i in range(len(self.children))])
+        elif isinstance(node, L.Generate):
+            df = self._child_pandas(0)
+            arrs = _eval_pandas(node.generator, df)
+            rows = []
+            req = {e.name: _eval_pandas(e, df) for e in node.required}
+            for i, a in enumerate(arrs):
+                if a is None or (not isinstance(a, (list, tuple))
+                                 and pd.isna(a)):
+                    continue
+                for p, el in enumerate(a):
+                    row = {n: s.iloc[i] for n, s in req.items()}
+                    if node.position:
+                        row[node.pos_name] = p
+                    row[node.col_name] = el
+                    rows.append(row)
+            out = pd.DataFrame(rows, columns=[n for n, _ in node.schema])
         else:
             raise NotImplementedError(
                 f"no CPU fallback for {type(node).__name__}")

@@ -1,0 +1,236 @@
+"""Array (collection) expressions.
+
+Counterpart of the reference's ``collectionOperations.scala`` (272 LoC) +
+``complexTypeCreator.scala`` / ``complexTypeExtractors.scala`` rules
+(CreateArray / Size / SortArray / ArrayContains / GetArrayItem / ElementAt,
+``GpuOverrides.scala:777-2826``).  An array ColVal is flat element values +
+int32 row offsets — the string chars layout generalized — so these kernels
+are the string byte-map tricks applied to typed elements:
+
+* per-element row ids come from ``searchsorted`` over the offsets;
+* per-row reductions over elements are ``segment_*`` ops;
+* SortArray is one ``lexsort`` keyed (row, element) — every row's segment
+  sorts in a single fused device pass, no per-row loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import ArrayType, DataType
+from spark_rapids_tpu.ops.expressions import (
+    ColVal, EmitContext, Expression, UnaryExpression, combine_validity,
+    promote_types)
+
+
+def element_rows(c: ColVal, capacity: int):
+    """row index of every element position in the flat buffer."""
+    pos = jnp.arange(c.values.shape[0], dtype=jnp.int32)
+    row = jnp.searchsorted(c.offsets, pos, side="right") - 1
+    return jnp.clip(row, 0, capacity - 1)
+
+
+def row_lengths(c: ColVal):
+    return c.offsets[1:] - c.offsets[:-1]
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...): row i -> [e1[i], e2[i], ...]."""
+
+    def __init__(self, *children: Expression):
+        if not children:
+            raise ValueError("array() needs at least one element")
+        self.children = tuple(children)
+
+    @property
+    def _element_dtype(self) -> DataType:
+        dt = self.children[0].dtype
+        for c in self.children[1:]:
+            dt = promote_types(dt, c.dtype)
+        return dt
+
+    @property
+    def dtype(self) -> DataType:
+        return ArrayType(self._element_dtype)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        k = len(self.children)
+        elem = self._element_dtype
+        vals = []
+        validity = None
+        for c in self.children:
+            cv = c.emit(ctx)
+            v = cv.values.astype(elem.storage)
+            if getattr(v, "ndim", 0) == 0:
+                v = jnp.broadcast_to(v, (ctx.capacity,))
+            vals.append(v)
+            validity = combine_validity(validity, cv.validity)
+        if validity is not None:
+            raise NotImplementedError(
+                "null array elements not supported (the planner tags "
+                "CreateArray over nullable children as not-on-TPU)")
+        flat = jnp.stack(vals, axis=1).reshape(-1)
+        offsets = jnp.arange(ctx.capacity + 1, dtype=jnp.int32) * k
+        return ColVal(self.dtype, flat, None, offsets)
+
+
+class Size(UnaryExpression):
+    """size(array): element count; -1 for null input (Spark's default
+    ``spark.sql.legacy.sizeOfNull=true``)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return dts.INT32
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        lens = row_lengths(c).astype(jnp.int32)
+        if c.validity is not None:
+            lens = jnp.where(c.validity, lens, jnp.int32(-1))
+        return ColVal(dts.INT32, lens, None)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value-literal)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    @property
+    def dtype(self) -> DataType:
+        return dts.BOOL
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.children[0].emit(ctx)
+        v = self.children[1].emit(ctx)
+        cap = ctx.capacity
+        row = element_rows(c, cap)
+        target = v.values if v.is_scalar else v.values[row]
+        live = jnp.arange(c.values.shape[0],
+                          dtype=jnp.int32) < c.offsets[cap]
+        hit = jnp.logical_and(live, c.values == target)
+        found = jax.ops.segment_max(hit.astype(jnp.int32), row,
+                                    num_segments=cap) > 0
+        return ColVal(dts.BOOL, found, c.validity)
+
+
+class GetArrayItem(Expression):
+    """arr[i] (0-based ordinal, Spark GetArrayItem); null when out of
+    range."""
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.children[0].dtype.element
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], children[1])
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.children[0].emit(ctx)
+        i = self.children[1].emit(ctx)
+        idx = i.values.astype(jnp.int32)
+        if getattr(idx, "ndim", 0) == 0:
+            idx = jnp.broadcast_to(idx, (ctx.capacity,))
+        lens = row_lengths(c)
+        in_range = jnp.logical_and(idx >= 0, idx < lens)
+        ecap = c.values.shape[0]
+        pos = jnp.clip(c.offsets[:-1] + idx, 0, max(ecap - 1, 0))
+        vals = c.values[pos]
+        validity = combine_validity(c.validity, in_range)
+        validity = combine_validity(validity, i.validity)
+        return ColVal(self.dtype, vals, validity)
+
+
+class ElementAt(GetArrayItem):
+    """element_at(arr, i): 1-based; negative indexes from the end."""
+
+    def with_children(self, children):
+        return ElementAt(children[0], children[1])
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.children[0].emit(ctx)
+        i = self.children[1].emit(ctx)
+        idx = i.values.astype(jnp.int32)
+        if getattr(idx, "ndim", 0) == 0:
+            idx = jnp.broadcast_to(idx, (ctx.capacity,))
+        lens = row_lengths(c).astype(jnp.int32)
+        zero_based = jnp.where(idx > 0, idx - 1, lens + idx)
+        in_range = jnp.logical_and(zero_based >= 0, zero_based < lens)
+        ecap = c.values.shape[0]
+        pos = jnp.clip(c.offsets[:-1] + zero_based, 0, max(ecap - 1, 0))
+        vals = c.values[pos]
+        validity = combine_validity(c.validity, in_range)
+        validity = combine_validity(validity, i.validity)
+        return ColVal(self.dtype, vals, validity)
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): every row's elements sorted in one fused
+    lexsort over (row, element) — the data-parallel form of cudf's
+    segmented sort."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.children = (child,)
+        self.ascending = ascending
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def with_children(self, children):
+        return SortArray(children[0], self.ascending)
+
+    def cache_key(self):
+        return ("SortArray", self.ascending, self.child.cache_key())
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        row = element_rows(c, cap)
+        v = c.values
+        # dead elements (buffer padding beyond the last row's end) must
+        # sort AFTER every real segment, not into row cap-1
+        live = jnp.arange(v.shape[0], dtype=jnp.int32) < c.offsets[cap]
+        row_key = jnp.where(live, row, jnp.int32(cap))
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            key = jnp.where(v == 0.0, 0.0, v)  # -0.0 == 0.0
+            nan_flag = jnp.isnan(v).astype(jnp.int8)  # NaN sorts largest
+            key = jnp.where(jnp.isnan(v), 0.0, key)
+            elem_keys = [-key, -nan_flag] if not self.ascending else \
+                [key, nan_flag]
+        elif v.dtype == jnp.bool_:
+            k = v.astype(jnp.int8)
+            elem_keys = [-k] if not self.ascending else [k]
+        else:
+            elem_keys = [~v] if not self.ascending else [v]
+        perm = jnp.lexsort(elem_keys + [row_key])
+        return ColVal(c.dtype, v[perm], c.validity, c.offsets)

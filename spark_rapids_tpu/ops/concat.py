@@ -76,11 +76,11 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         first = batches[0].column(name)
         dt = first.dtype
         any_nulls = any(b.column(name).validity is not None for b in batches)
-        if dt.is_string:
+        if dt.has_offsets:
             total_chars = sum(
                 int(b.column(name).offsets[b.nrows]) for b in batches)
             ccap = bucket_capacity(max(total_chars, 1))
-            chars = jnp.zeros(ccap, dtype=jnp.uint8)
+            chars = jnp.zeros(ccap, dtype=dt.storage)
             offs = jnp.zeros(cap + 1, dtype=jnp.int32)
             valid = jnp.zeros(cap, dtype=jnp.bool_)
             n = 0

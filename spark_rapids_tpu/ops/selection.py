@@ -27,8 +27,9 @@ def gather(cols: Sequence[ColVal], indices, out_count,
 
     Rows at positions >= out_count are padding. ``indices`` entries for
     padding rows may be arbitrary but must be in-range.  ``char_capacity``
-    (static) sizes string outputs when the gather can *expand* total chars
-    (join duplication); 0 keeps each input's char capacity.
+    (static) sizes offset-bearing outputs (string chars / array elements)
+    when the gather can *expand* totals (join/explode duplication); 0
+    keeps each input's capacity.
     """
     capacity = indices.shape[0]
     out_mask = jnp.arange(capacity, dtype=jnp.int32) < out_count
@@ -53,7 +54,11 @@ def gather(cols: Sequence[ColVal], indices, out_count,
         src = c.offsets[indices[row]] + (pos - new_offsets[row])
         src = jnp.clip(src, 0, in_char_cap - 1)
         total = new_offsets[capacity]
-        chars = jnp.where(pos < total, c.values[src], 0).astype(jnp.uint8)
+        # keep the element buffer's own dtype: uint8 chars for strings,
+        # the element storage dtype for arrays (a hardcoded uint8 cast
+        # silently truncated array elements, e.g. 300 -> 44)
+        chars = jnp.where(pos < total, c.values[src],
+                          jnp.zeros((), dtype=c.values.dtype))
         outs.append(ColVal(c.dtype, chars, validity, new_offsets))
     return outs
 

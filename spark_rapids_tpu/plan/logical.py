@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.dtypes import INT32, DataType
 from spark_rapids_tpu.ops.aggregates import AggregateFunction
 from spark_rapids_tpu.ops.expressions import (
     Alias, ColVal, EmitContext, Expression,
@@ -294,6 +294,45 @@ class MapInPandas(LogicalPlan):
     def describe(self):
         kind = "FlatMapGroupsInPandas" if self.group_names else "MapInPandas"
         return f"{kind}[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class Generate(LogicalPlan):
+    """explode/posexplode of one array-typed generator over the child
+    (GpuGenerateExec.scala analog).  ``required`` are pass-through child
+    expressions repeated per output element."""
+
+    def __init__(self, generator: Expression, required, position: bool,
+                 child: LogicalPlan, col_name: str = "col",
+                 pos_name: str = "pos"):
+        self.generator = generator.bind(child.schema)
+        self.required = [e.bind(child.schema) for e in required]
+        self.position = position
+        self.col_name = col_name
+        self.pos_name = pos_name
+        taken = {e.name for e in self.required}
+        clash = {col_name} | ({pos_name} if position else set())
+        if taken & clash:
+            raise ValueError(
+                f"explode output name(s) {sorted(taken & clash)} collide "
+                "with pass-through columns; alias the explode (e.g. "
+                ".alias('elem'))")
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        out = [(e.name, e.dtype) for e in self.required]
+        if self.position:
+            out.append((self.pos_name, INT32))
+        out.append((self.col_name, self.generator.dtype.element))
+        return out
+
+    def describe(self):
+        kind = "posexplode" if self.position else "explode"
+        return f"Generate[{kind}({self.generator.name})]"
 
 
 class Window(LogicalPlan):

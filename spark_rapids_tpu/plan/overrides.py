@@ -83,6 +83,16 @@ for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand):
     expr_rule(c, ts.NUMERIC)
 
+# collections (collectionOperations.scala + complexType rules analog)
+from spark_rapids_tpu.ops import collections_ops as C  # noqa: E402
+
+expr_rule(C.CreateArray, ts.ARRAY)
+expr_rule(C.SortArray, ts.ARRAY)
+expr_rule(C.Size, ts.COMMON)
+expr_rule(C.ArrayContains, ts.COMMON)
+expr_rule(C.GetArrayItem, ts.COMMON)
+expr_rule(C.ElementAt, ts.COMMON)
+
 # predicates / conditionals (any common type flows through)
 for c in (preds.EqualTo, preds.EqualNullSafe, preds.LessThan,
           preds.LessThanOrEqual, preds.GreaterThan, preds.GreaterThanOrEqual,
@@ -138,6 +148,12 @@ class ExprMeta(BaseMeta):
 
     def tag(self) -> None:
         expr = self.wrapped
+        if isinstance(expr, C.CreateArray) and any(
+                c.nullable for c in expr.children):
+            self.will_not_work(
+                "array() over nullable children not supported on TPU "
+                "(null array elements have no device representation); "
+                "falls back to CPU")
         if isinstance(expr, S.Like) and not expr.supported:
             self.will_not_work(
                 f"LIKE pattern {expr.pattern!r} too general for TPU")
@@ -167,7 +183,7 @@ class ExprMeta(BaseMeta):
                 if reason and not isinstance(expr, (BoundReference, Alias,
                                                     Literal)):
                     self.will_not_work(reason)
-            except (RuntimeError, TypeError) as e:
+            except (RuntimeError, TypeError, ValueError) as e:
                 self.will_not_work(str(e))
         for c in self.child_metas:
             c.tag()
@@ -187,6 +203,17 @@ class PlanMeta(BaseMeta):
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")
+        if isinstance(node, L.Sort) and any(
+                e.dtype.is_array for e, _, _ in node.orders):
+            self.will_not_work("array sort keys not supported on TPU")
+        if isinstance(node, L.Aggregate) and any(
+                e.dtype.is_array for e in node.group_exprs):
+            self.will_not_work("array group-by keys not supported on TPU")
+        if isinstance(node, L.Generate) and not \
+                node.generator.dtype.is_array:
+            self.will_not_work(
+                f"explode needs an array column, got "
+                f"{node.generator.dtype}")
         if isinstance(node, L.Join):
             if node.condition is not None:
                 self.will_not_work(
@@ -195,6 +222,9 @@ class PlanMeta(BaseMeta):
                 if lk.dtype.name != rk.dtype.name:
                     self.will_not_work(
                         f"join key type mismatch {lk.dtype} vs {rk.dtype}")
+                if lk.dtype.is_array:
+                    self.will_not_work(
+                        "array join keys not supported on TPU")
         for em in self.expr_metas:
             em.tag()
             if not em.can_replace:
@@ -215,6 +245,8 @@ class PlanMeta(BaseMeta):
 def _node_expressions(plan: L.LogicalPlan) -> List[Expression]:
     if isinstance(plan, L.Project):
         return list(plan.exprs)
+    if isinstance(plan, L.Generate):
+        return [plan.generator] + list(plan.required)
     if isinstance(plan, L.Filter):
         return [plan.condition]
     if isinstance(plan, L.Aggregate):
@@ -352,6 +384,14 @@ def _conv_join(node: L.Join, children, conf):
     from spark_rapids_tpu.exec.join import TpuHashJoinExec
     return TpuHashJoinExec(node.left_keys, node.right_keys, node.join_type,
                            children[0], children[1], using=node.using)
+
+
+@_converter(L.Generate)
+def _conv_generate(node: L.Generate, children, conf):
+    from spark_rapids_tpu.exec.generate import TpuGenerateExec
+    return TpuGenerateExec(node.generator, node.required, node.position,
+                           children[0], col_name=node.col_name,
+                           pos_name=node.pos_name)
 
 
 @_converter(L.Window)

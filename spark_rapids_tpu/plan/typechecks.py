@@ -14,19 +14,25 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 
 
 class TypeSig:
-    """A set of supported logical type names (+ decimal flag)."""
+    """A set of supported logical type names (+ decimal/array flags)."""
 
-    def __init__(self, names: Iterable[str], decimal: bool = False):
+    def __init__(self, names: Iterable[str], decimal: bool = False,
+                 arrays: bool = False):
         self.names: Set[str] = set(names)
         self.decimal = decimal
+        self.arrays = arrays
 
     def __add__(self, other: "TypeSig") -> "TypeSig":
         return TypeSig(self.names | other.names,
-                       self.decimal or other.decimal)
+                       self.decimal or other.decimal,
+                       self.arrays or other.arrays)
 
     def supports(self, dt: DataType) -> bool:
         if dt.is_decimal:
             return self.decimal
+        if dt.is_array:
+            return self.arrays and dt.element is not None and \
+                not dt.element.has_offsets
         return dt.name in self.names
 
     def reason_if_unsupported(self, dt: DataType,
@@ -50,4 +56,7 @@ DATETIME = TypeSig(["date", "timestamp"])
 # the common cudf-equivalent set (TypeChecks.scala:557 commonCudfTypes)
 COMMON = BOOLEAN + NUMERIC + STRING + DATETIME
 ORDERABLE = COMMON
-ALL = COMMON
+# single-level arrays of fixed-width elements (TypeSig.ARRAY analog,
+# TypeChecks.scala nested support)
+ARRAY = TypeSig([], arrays=True)
+ALL = COMMON + ARRAY

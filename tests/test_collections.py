@@ -1,0 +1,189 @@
+"""Array type + collection expressions + explode/posexplode
+(GpuGenerateExec.scala + collectionOperations.scala analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar import dtypes as dts
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+ARRS = [[10, 20], [30], None, [], [40, 50, 60], [7]]
+IDS = [1, 2, 3, 4, 5, 6]
+NAMES = ["alpha", "b", "c", "dd", "eee", None]
+
+
+@pytest.fixture(scope="module")
+def df(session):
+    return session.create_dataframe(
+        {"id": IDS, "name": NAMES, "arr": ARRS})
+
+
+def test_array_column_roundtrip(session, df):
+    out = df.select("arr").to_pandas()["arr"]
+    got = [None if v is None else list(v) for v in out]
+    assert got == ARRS
+
+
+def test_explode_drops_null_and_empty(session, df):
+    out = df.select("id", F.explode("arr")).to_pandas()
+    want = [(i, e) for i, a in zip(IDS, ARRS) if a for e in a]
+    assert list(zip(out["id"], out["col"])) == want
+
+
+def test_explode_with_string_passthrough(session, df):
+    out = df.select("name", F.explode("arr")).to_pandas()
+    want = [(n, e) for n, a in zip(NAMES, ARRS) if a for e in a]
+    got = [(None if pd.isna(n) else n, c)
+           for n, c in zip(out["name"], out["col"])]
+    assert got == want
+
+
+def test_posexplode(session, df):
+    out = df.select("id", F.posexplode("arr")).to_pandas()
+    want = [(i, p, e) for i, a in zip(IDS, ARRS) if a
+            for p, e in enumerate(a)]
+    assert list(zip(out["id"], out["pos"], out["col"])) == want
+
+
+def test_explode_alias(session, df):
+    out = df.select(F.explode("arr").alias("elem")).to_pandas()
+    assert list(out.columns) == ["elem"]
+    assert out["elem"].tolist() == [e for a in ARRS if a for e in a]
+
+
+def test_size(session, df):
+    out = df.select(F.size("arr").alias("n")).to_pandas()["n"]
+    want = [-1 if a is None else len(a) for a in ARRS]
+    assert out.tolist() == want
+
+
+def test_sort_array(session, df):
+    data = {"a": [[3, 1, 2], [5.0], [], [9, -1, 0, 4]]}
+    d = session.create_dataframe({"a": [[3, 1, 2], [5, 1], [], [9, -1, 0]]})
+    asc = d.select(F.sort_array(F.col("a")).alias("s")).to_pandas()["s"]
+    assert [list(v) for v in asc] == [[1, 2, 3], [1, 5], [], [-1, 0, 9]]
+    desc = d.select(F.sort_array(F.col("a"), False).alias("s")) \
+        .to_pandas()["s"]
+    assert [list(v) for v in desc] == [[3, 2, 1], [5, 1], [], [9, 0, -1]]
+
+
+def test_sort_array_floats_nan(session):
+    d = session.create_dataframe(
+        {"a": [[np.nan, 1.0, -0.0], [2.5, np.nan]]})
+    out = d.select(F.sort_array(F.col("a")).alias("s")).to_pandas()["s"]
+    first = list(out[0])
+    assert first[0] == -0.0 and first[1] == 1.0 and np.isnan(first[2])
+    second = list(out[1])
+    assert second[0] == 2.5 and np.isnan(second[1])
+
+
+def test_get_array_item_element_at(session, df):
+    out = df.select(
+        F.get_array_item("arr", 1).alias("i1"),
+        F.element_at("arr", 1).alias("e1"),
+        F.element_at("arr", -1).alias("last")).to_pandas()
+    for row, a in zip(out.itertuples(index=False), ARRS):
+        if a is None or len(a) < 2:
+            assert pd.isna(row.i1)
+        else:
+            assert row.i1 == a[1]
+        if not a:
+            assert pd.isna(row.e1) and pd.isna(row.last)
+        else:
+            assert row.e1 == a[0] and row.last == a[-1]
+
+
+def test_array_contains(session, df):
+    out = df.select(F.array_contains("arr", 30).alias("c")).to_pandas()["c"]
+    for got, a in zip(out, ARRS):
+        if a is None:
+            assert pd.isna(got)
+        else:
+            assert bool(got) == (30 in a)
+
+
+def test_create_array_from_columns_falls_back_correctly(session):
+    """array() over nullable columns is tagged off (null elements have no
+    device representation) but the CPU fallback matches Spark."""
+    d = session.create_dataframe({"x": [1, 2, 3], "y": [10, 20, 30]})
+    plan = session.plan(
+        d.select(F.array(F.col("x"), F.col("y")).alias("p")).plan)
+    assert "CpuFallbackExec" in plan.tree_string()
+    out = d.select(F.array(F.col("x"), F.col("y")).alias("p")).to_pandas()
+    assert [list(v) for v in out["p"]] == [[1, 10], [2, 20], [3, 30]]
+
+
+def test_create_array_literals_on_device(session):
+    d = session.create_dataframe({"x": [1, 2]})
+    q = d.select(F.array(7, 8, 9).alias("p"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()
+    assert [list(v) for v in out["p"]] == [[7, 8, 9], [7, 8, 9]]
+
+
+def test_create_array_mixed_types_promotes(session):
+    d = session.create_dataframe({"i": [1, 2], "f": [1.5, 2.5]})
+    out = d.select(F.array(F.col("i"), F.col("f")).alias("p")).to_pandas()
+    assert [list(v) for v in out["p"]] == [[1.0, 1.5], [2.0, 2.5]]
+
+
+def test_explode_name_collision_raises(session):
+    d = session.create_dataframe({"col": [1, 2], "a": [[1], [2]]})
+    with pytest.raises(ValueError, match="collide"):
+        d.select("col", F.explode("a"))
+
+
+def test_array_values_survive_filter_gather(session):
+    """Regression: gather() hardcoded a uint8 cast for offset-bearing
+    columns, truncating array elements (300 -> 44)."""
+    d = session.create_dataframe({"a": [[300, 1], [5]], "x": [1, 2]})
+    out = d.filter(F.col("x") > 0).select("a").to_pandas()["a"]
+    assert [list(v) for v in out] == [[300, 1], [5]]
+
+
+def test_arrays_through_filter_and_union(session, df):
+    out = df.filter(F.col("id") > 2).select("id", "arr").to_pandas()
+    want = [(i, a) for i, a in zip(IDS, ARRS) if i > 2]
+    got = [(i, None if v is None else list(v))
+           for i, v in zip(out["id"], out["arr"])]
+    assert got == want
+    u = df.select("arr").union(df.select("arr")).to_pandas()["arr"]
+    got_u = [None if v is None else list(v) for v in u]
+    assert got_u == ARRS + ARRS
+
+
+def test_arrays_spill_roundtrip(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.spill import SpillableBatchCatalog
+    cat = SpillableBatchCatalog(device_budget=1, host_budget=1,
+                                spill_dir=str(tmp_path))
+    col = Column.from_arrays(ARRS, dts.INT64)
+    batch = ColumnarBatch({"a": col}, len(ARRS))
+    h = cat.register(batch)
+    assert h.tier == "DISK"
+    back = h.materialize()
+    assert back.column("a").to_pylist() == ARRS
+    h.close()
+
+
+def test_explode_of_split_like_pipeline(session):
+    """explode composes with projections downstream."""
+    d = session.create_dataframe({"g": [1, 1, 2], "a": [[1, 2], [3], [4]]})
+    out = d.select("g", F.explode("a")).groupBy("g").agg(
+        F.sum("col").alias("s")).to_pandas().sort_values("g")
+    assert out["s"].tolist() == [6, 4]
+
+
+def test_array_sort_key_falls_back(session):
+    d = session.create_dataframe({"a": [[1], [2]]})
+    tree = session.plan(d.orderBy("a").plan).tree_string()
+    assert "CpuFallbackExec" in tree
