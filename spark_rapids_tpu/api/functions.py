@@ -143,6 +143,15 @@ class Col:
         from spark_rapids_tpu.ops import stringops as S
         return Col(S.Contains(self.expr, needle))
 
+    def rlike(self, pattern: str) -> "Col":
+        from spark_rapids_tpu.ops.regexops import RLike
+        return Col(RLike(self.expr, pattern))
+
+    def getItem(self, key) -> "Col":
+        from spark_rapids_tpu.ops.collections_ops import GetArrayItem
+        from spark_rapids_tpu.ops.expressions import Literal
+        return Col(GetArrayItem(self.expr, Literal(int(key))))
+
     def like(self, pattern: str) -> "Col":
         from spark_rapids_tpu.ops import stringops as S
         return Col(S.Like(self.expr, pattern))
@@ -665,3 +674,60 @@ def explode(c) -> Col:
 
 def posexplode(c) -> Col:
     return Col(_ExplodeMarker(_expr(c), position=True))
+
+
+# -------------------------------------------------------------------- regex --
+
+def rlike(c, pattern: str) -> Col:
+    from spark_rapids_tpu.ops.regexops import RLike
+    return Col(RLike(_expr(c), pattern))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Col:
+    from spark_rapids_tpu.ops.regexops import RegExpReplace
+    return Col(RegExpReplace(_expr(c), pattern, replacement))
+
+
+def replace(c, search: str, replacement: str) -> Col:
+    from spark_rapids_tpu.ops.regexops import StringReplace
+    return Col(StringReplace(_expr(c), search, replacement))
+
+
+def concat_ws(sep: str, *cols) -> Col:
+    from spark_rapids_tpu.ops.regexops import ConcatWs
+    return Col(ConcatWs(sep, *[_expr(c) for c in cols]))
+
+
+def translate(c, from_str: str, to_str: str) -> Col:
+    from spark_rapids_tpu.ops.regexops import Translate
+    return Col(Translate(_expr(c), from_str, to_str))
+
+
+class _SplitCol(Col):
+    """Result of F.split: only ``getItem(n)`` is usable (arrays hold
+    fixed-width elements, so a standalone array<string> has no device
+    representation — the split+getItem pair fuses into SplitPart)."""
+
+    def __init__(self, child_expr, pattern: str):
+        self._child = child_expr
+        self._pattern = pattern
+        # no super().__init__: using the column without getItem must fail
+        # loudly rather than produce a bogus expression
+
+    @property
+    def expr(self):
+        raise TypeError(
+            "split(...) produces array<string>, which has no TPU "
+            "representation; use split(...).getItem(n)")
+
+    @expr.setter
+    def expr(self, v):  # pragma: no cover - Col.__init__ compat
+        pass
+
+    def getItem(self, n: int) -> Col:
+        from spark_rapids_tpu.ops.regexops import SplitPart
+        return Col(SplitPart(self._child, self._pattern, int(n)))
+
+
+def split(c, pattern: str) -> _SplitCol:
+    return _SplitCol(_expr(c), pattern)

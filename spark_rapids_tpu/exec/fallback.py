@@ -11,11 +11,17 @@ from __future__ import annotations
 
 from typing import Iterator, List
 
+import numpy as np
 import pandas as pd
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exec.base import Schema, TpuExec
 from spark_rapids_tpu.plan import logical as L
+
+
+def _isnull(v) -> bool:
+    """Null test for scalar values out of pandas (None or NaN float)."""
+    return v is None or (isinstance(v, float) and pd.isna(v))
 
 
 def _eval_pandas(expr, df: pd.DataFrame):
@@ -64,22 +70,90 @@ def _eval_pandas(expr, df: pd.DataFrame):
                               else re.escape(ch) for ch in e.pattern)
         child = _eval_pandas(e.child, df)
         return child.str.match(rx + r"\Z", na=False)
+    from spark_rapids_tpu.ops import regexops as RX
+    if isinstance(e, RX.RLike):
+        import re
+        child = _eval_pandas(e.child, df)
+        rx = re.compile(e.pattern)
+        return child.map(lambda v: None if _isnull(v)
+                         else bool(rx.search(v)))
+    if isinstance(e, RX.RegExpReplace):
+        import re
+        child = _eval_pandas(e.child, df)
+        rx = re.compile(e.pattern)
+        # translate a Java replacement to Python re.sub syntax: $n (multi
+        # digit) -> \g<n>, \x -> literal x, lone backslashes escaped
+        out = []
+        i = 0
+        r = e.replacement
+        while i < len(r):
+            ch = r[i]
+            if ch == "\\" and i + 1 < len(r):
+                out.append(re.escape(r[i + 1]) if r[i + 1] != "\\"
+                           else "\\\\")
+                i += 2
+            elif ch == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+                j = i + 1
+                while j < len(r) and r[j].isdigit():
+                    j += 1
+                out.append(f"\\g<{r[i + 1:j]}>")
+                i = j
+            elif ch == "\\":
+                out.append("\\\\")
+                i += 1
+            else:
+                out.append(ch)
+                i += 1
+        repl = "".join(out)
+        return child.map(lambda v: None if _isnull(v)
+                         else rx.sub(repl, v))
+    if isinstance(e, RX.StringReplace):
+        child = _eval_pandas(e.child, df)
+        if not e.search:  # Spark: empty search leaves input unchanged
+            return child
+        return child.map(lambda v: None if _isnull(v) else
+                         v.replace(e.search, e.replacement))
+    if isinstance(e, RX.Translate):
+        child = _eval_pandas(e.child, df)
+        # Spark: FIRST occurrence in from_str wins (str.maketrans would
+        # apply last-wins and deletion-overrides)
+        tbl = {}
+        for i, ch in enumerate(e.from_str):
+            o = ord(ch)
+            if o not in tbl:
+                tbl[o] = e.to_str[i] if i < len(e.to_str) else None
+        return child.map(lambda v: None if _isnull(v)
+                         else v.translate(tbl))
+    if isinstance(e, RX.SplitPart):
+        import re
+        child = _eval_pandas(e.child, df)
+        def part(v):
+            if _isnull(v):
+                return None
+            parts = re.split(e.delim, v)
+            return parts[e.index] if 0 <= e.index < len(parts) else None
+        return child.map(part)
+    if isinstance(e, RX.ConcatWs):
+        parts = [_eval_pandas(c, df) for c in e.children]
+        return pd.Series([
+            e.sep.join(str(v) for v in row if not _isnull(v))
+            for row in zip(*parts)])
     from spark_rapids_tpu.ops import collections_ops as C
     if isinstance(e, C.CreateArray):
         parts = [_eval_pandas(c, df) for c in e.children]
         return pd.Series([list(row) for row in zip(*parts)])
     if isinstance(e, C.Size):
         child = _eval_pandas(e.child, df)
-        return child.map(lambda v: -1 if v is None else len(v))
+        return child.map(lambda v: -1 if _isnull(v) else len(v))
     if isinstance(e, C.SortArray):
         child = _eval_pandas(e.children[0], df)
-        return child.map(lambda v: None if v is None else
+        return child.map(lambda v: None if _isnull(v) else
                          sorted(v, reverse=not e.ascending))
     if isinstance(e, C.ElementAt):
         arr = _eval_pandas(e.children[0], df)
         idx = _eval_pandas(e.children[1], df)
         def at(v, i):
-            if v is None:
+            if _isnull(v):
                 return None
             j = i - 1 if i > 0 else len(v) + i
             return v[j] if 0 <= j < len(v) else None
@@ -87,12 +161,12 @@ def _eval_pandas(expr, df: pd.DataFrame):
     if isinstance(e, C.GetArrayItem):
         arr = _eval_pandas(e.children[0], df)
         idx = _eval_pandas(e.children[1], df)
-        return pd.Series([None if v is None or not 0 <= i < len(v)
+        return pd.Series([None if _isnull(v) or not 0 <= i < len(v)
                           else v[i] for v, i in zip(arr, idx)])
     if isinstance(e, C.ArrayContains):
         arr = _eval_pandas(e.children[0], df)
         val = _eval_pandas(e.children[1], df)
-        return pd.Series([None if v is None else (x in v)
+        return pd.Series([None if _isnull(v) else (x in v)
                           for v, x in zip(arr, val)])
     raise NotImplementedError(
         f"CPU fallback cannot evaluate {type(e).__name__}")
@@ -174,4 +248,27 @@ class CpuFallbackExec(TpuExec):
         want = [n for n, _ in node.schema]
         if list(out.columns) != want:
             out = out[want]
-        yield ColumnarBatch.from_pandas(out)
+        # build against the node's declared schema: pandas loses types on
+        # all-null / object columns (arrow would type them `null`)
+        from spark_rapids_tpu.columnar.column import Column
+        cols = {}
+        for name, dt in node.schema:
+            s = out[name]
+            if dt.is_string:
+                vals = [None if v is None or
+                        (not isinstance(v, str) and pd.isna(v))
+                        else str(v) for v in s]
+                cols[name] = Column.from_strings(vals)
+            elif dt.is_array:
+                vals = [None if v is None or
+                        (not isinstance(v, (list, tuple, np.ndarray))
+                         and pd.isna(v)) else list(v) for v in s]
+                cols[name] = Column.from_arrays(vals, dt.element)
+            else:
+                valid = s.notna().to_numpy()
+                filled = s.fillna(0).to_numpy()
+                cols[name] = Column.from_numpy(
+                    np.asarray(filled).astype(dt.storage, copy=False),
+                    dtype=dt,
+                    validity=None if valid.all() else valid)
+        yield ColumnarBatch(cols, len(out))

@@ -83,6 +83,15 @@ for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand):
     expr_rule(c, ts.NUMERIC)
 
+# regex family + remaining string surface (stringFunctions.scala +
+# shim RegExpReplace rules; unsupported patterns tag off like the
+# reference's incompat flag)
+from spark_rapids_tpu.ops import regexops as RX  # noqa: E402
+
+for c in (RX.RLike, RX.RegExpReplace, RX.StringReplace, RX.ConcatWs,
+          RX.Translate, RX.SplitPart):
+    expr_rule(c, ts.COMMON)
+
 # collections (collectionOperations.scala + complexType rules analog)
 from spark_rapids_tpu.ops import collections_ops as C  # noqa: E402
 
@@ -157,6 +166,13 @@ class ExprMeta(BaseMeta):
         if isinstance(expr, S.Like) and not expr.supported:
             self.will_not_work(
                 f"LIKE pattern {expr.pattern!r} too general for TPU")
+        if isinstance(expr, (RX.RLike, RX.RegExpReplace, RX.StringReplace,
+                             RX.Translate, RX.SplitPart)) and \
+                not expr.supported:
+            self.will_not_work(
+                f"{type(expr).__name__} arguments outside the TPU regex "
+                "subset (falls back to CPU, like the reference's regex "
+                "incompat flag)")
         if isinstance(expr, WindowExpression):
             reason = expr.supported_reason()
             if reason:

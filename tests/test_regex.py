@@ -1,0 +1,202 @@
+"""Regex family: RLike / RegExpReplace / StringReplace / ConcatWs /
+Translate / split().getItem() — device subset vs Python-re oracle, with
+out-of-subset patterns falling back to CPU (reference: shim RegExpReplace
+rules + stringFunctions.scala)."""
+
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+STRINGS = ["hello world", "", "Spark-3.2", "a1b2c3", "  pad  ", None,
+           "2021-09-15", "xyz", "aaa", "foo_bar_baz", "the cat sat",
+           "UPPER lower", "192.168.0.1", "no digits here!"]
+
+
+@pytest.fixture(scope="module")
+def sdf(session):
+    return session.create_dataframe({"s": STRINGS})
+
+
+def _oracle(pat):
+    rx = re.compile(pat)
+    return [None if s is None else bool(rx.search(s)) for s in STRINGS]
+
+
+DEVICE_PATTERNS = [
+    "cat",                      # literal
+    "^hello",                   # anchored start
+    "xyz$",                     # anchored end
+    "^aaa$",                    # fully anchored
+    r"\d",                      # digit class
+    r"\d{4}-\d{2}-\d{2}",       # date shape with repetition
+    "a.b",                      # dot atom
+    "[0-9][a-z]",               # ranges
+    "[^a-z ]",                  # negation
+    r"foo.*baz",                # gap
+    r"^\d+.+\d$"[1:-1] if False else r"cat.+sat",  # .+ gap
+    r"\w\s\w",                  # escapes
+    r"192\.168",                # escaped dot
+]
+
+
+@pytest.mark.parametrize("pat", DEVICE_PATTERNS)
+def test_rlike_device_subset(session, sdf, pat):
+    plan = session.plan(sdf.select(
+        F.rlike("s", pat).alias("m")).plan)
+    assert "CpuFallbackExec" not in plan.tree_string(), pat
+    out = sdf.select(F.rlike("s", pat).alias("m")).to_pandas()["m"]
+    want = _oracle(pat)
+    for i, w in enumerate(want):
+        if w is None:
+            assert pd.isna(out[i]), (pat, i)
+        else:
+            assert bool(out[i]) == w, (pat, STRINGS[i])
+
+
+@pytest.mark.parametrize("pat", [r"a|b", r"(ab)+", r"\d+", r"colou?r",
+                                 r"\bword\b"])
+def test_rlike_fallback_patterns(session, sdf, pat):
+    plan = session.plan(sdf.select(F.rlike("s", pat).alias("m")).plan)
+    assert "CpuFallbackExec" in plan.tree_string(), pat
+    out = sdf.select(F.rlike("s", pat).alias("m")).to_pandas()["m"]
+    want = _oracle(pat)
+    for i, w in enumerate(want):
+        if w is None:
+            assert pd.isna(out[i])
+        else:
+            assert bool(out[i]) == w, (pat, STRINGS[i])
+
+
+def test_regexp_replace_device(session, sdf):
+    q = sdf.select(F.regexp_replace("s", r"\d", "#").alias("r"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()["r"]
+    for i, s in enumerate(STRINGS):
+        if s is None:
+            assert pd.isna(out[i])
+        else:
+            assert out[i] == re.sub(r"\d", "#", s), s
+
+
+def test_regexp_replace_multibyte_replacement(session, sdf):
+    q = sdf.select(F.regexp_replace("s", "a", "<<>>").alias("r"))
+    out = q.to_pandas()["r"]
+    for i, s in enumerate(STRINGS):
+        if s is not None:
+            assert out[i] == s.replace("a", "<<>>"), s
+
+
+def test_regexp_replace_shrinking(session, sdf):
+    q = sdf.select(F.regexp_replace("s", "[aeiou]", "").alias("r"))
+    out = q.to_pandas()["r"]
+    for i, s in enumerate(STRINGS):
+        if s is not None:
+            assert out[i] == re.sub("[aeiou]", "", s), s
+
+
+def test_regexp_replace_self_overlapping_falls_back(session, sdf):
+    # "aa" can overlap itself: greedy left-to-right needs the fallback
+    q = sdf.select(F.regexp_replace("s", "aa", "X").alias("r"))
+    assert "CpuFallbackExec" in session.plan(q.plan).tree_string()
+    out = q.to_pandas()["r"]
+    idx = STRINGS.index("aaa")
+    assert out[idx] == "Xa"  # greedy: aa|a, not a|aa
+
+
+def test_string_replace(session, sdf):
+    q = sdf.select(F.replace("s", "o", "0").alias("r"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()["r"]
+    for i, s in enumerate(STRINGS):
+        if s is not None:
+            assert out[i] == s.replace("o", "0"), s
+
+
+def test_concat_ws(session):
+    df = TpuSession().create_dataframe({
+        "a": ["x", None, "p", None], "b": ["y", "q", None, None]})
+    out = df.select(F.concat_ws("-", "a", "b").alias("c")).to_pandas()["c"]
+    assert out.tolist() == ["x-y", "q", "p", ""]
+
+
+def test_concat_ws_three_cols_empty_sep(session):
+    df = session.create_dataframe({"a": ["1", "2"], "b": ["3", "4"],
+                                   "c": ["5", "6"]})
+    out = df.select(F.concat_ws("::", "a", "b", "c").alias("x"),
+                    F.concat_ws("", "a", "b").alias("y")).to_pandas()
+    assert out["x"].tolist() == ["1::3::5", "2::4::6"]
+    assert out["y"].tolist() == ["13", "24"]
+
+
+def test_translate(session, sdf):
+    q = sdf.select(F.translate("s", "aeo-", "430").alias("t"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()["t"]
+    tbl = str.maketrans("aeo", "430", "-")
+    for i, s in enumerate(STRINGS):
+        if s is not None:
+            assert out[i] == s.translate(tbl), s
+
+
+def test_split_get_item(session):
+    vals = ["a,b,c", "one", "", "x,,z", None, "1,2"]
+    df = session.create_dataframe({"s": vals})
+    q = df.select(F.split("s", ",").getItem(0).alias("p0"),
+                  F.split("s", ",").getItem(1).alias("p1"),
+                  F.split("s", ",").getItem(2).alias("p2"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()
+    for i, s in enumerate(vals):
+        if s is None:
+            assert pd.isna(out["p0"][i])
+            continue
+        parts = s.split(",")
+        for j, col in enumerate(["p0", "p1", "p2"]):
+            if j < len(parts):
+                assert out[col][i] == parts[j], (s, j)
+            else:
+                assert pd.isna(out[col][i]), (s, j)
+
+
+def test_split_without_getitem_raises(session, sdf):
+    with pytest.raises(TypeError, match="getItem"):
+        sdf.select(F.split("s", ","))
+
+
+def test_rlike_col_method(session, sdf):
+    out = sdf.filter(F.col("s").rlike(r"^\d")).to_pandas()["s"]
+    want = [s for s in STRINGS if s is not None and re.search(r"^\d", s)]
+    assert sorted(out) == sorted(want)
+
+
+def test_fallback_semantics_match_spark(session):
+    """The CPU-fallback-only cases must keep Spark semantics (regression:
+    empty-search replace, duplicate translate chars, negative split index,
+    $n group refs)."""
+    df = session.create_dataframe({"s": ["abc", "a1b2"]})
+    # empty search: input unchanged
+    out = df.select(F.replace("s", "", "x").alias("r")).to_pandas()["r"]
+    assert out.tolist() == ["abc", "a1b2"]
+    # duplicate from chars: first occurrence wins
+    out = df.select(F.translate("s", "aba", "12").alias("t")) \
+        .to_pandas()["t"]
+    assert out.tolist() == ["12c", "1122"]
+    # negative getItem: null, not python negative indexing
+    out = df.select(F.split("s", "1").getItem(-1).alias("p")) \
+        .to_pandas()["p"]
+    assert out.isna().all()
+    # $n group references through the fallback
+    out = df.select(
+        F.regexp_replace("s", r"(a)(\d)", "$2$1").alias("g")).to_pandas()
+    assert out["g"].tolist() == ["abc", "1ab2"]
