@@ -2,10 +2,15 @@
 
 The reference executes a distributed aggregation as: partial agg per task ->
 hash-partitioned UCX shuffle -> final agg per reducer (SURVEY.md sections 3.3
-and 3.4).  Here the entire sequence — filter, partial aggregate, shuffle
-by key, final aggregate — is ONE ``shard_map``-ped XLA program: the shuffle
-is a compiled all-to-all riding ICI, overlapping with compute under XLA's
-scheduler, with zero host round trips between stages.
+and 3.4).  Here the sequence — filter, partial aggregate, shuffle by
+key, final aggregate — runs as compiled ``shard_map`` programs with the
+shuffle as an all-to-all riding ICI.  Keyed aggregates and shuffle joins
+are ADAPTIVE, in two compiled phases: phase 1 materializes per-destination
+histograms (the stage statistics, like the reference's AQE reading map
+output sizes), the host sizes the all-to-all slots from the true max
+slice, and phase 2 exchanges with those static slots.  The phase boundary
+is a blocking host sync, so ``__call__`` is NOT traceable under an outer
+jit.
 """
 
 from __future__ import annotations
@@ -55,22 +60,31 @@ class DistributedAggregate:
             self._buf_specs.extend(specs)
 
         from spark_rapids_tpu.ops.jit_cache import cached_jit
-        sig = ("dist_agg", tuple(self.mesh.axis_names),
-               tuple(self.mesh.devices.shape),
-               tuple(str(d) for d in self.mesh.devices.flat),
-               tuple(dt.name for dt in self.in_dtypes),
-               tuple(e.cache_key() for e in self.group_exprs),
-               tuple(f.cache_key() for f in self.funcs),
-               self.filter_cond.cache_key()
-               if self.filter_cond is not None else None)
-        self._jitted = cached_jit(
-            sig, lambda: jax.shard_map(
-                self._step, mesh=mesh,
+        self._cached_jit = cached_jit
+        self._sig = ("dist_agg", tuple(self.mesh.axis_names),
+                     tuple(self.mesh.devices.shape),
+                     tuple(str(d) for d in self.mesh.devices.flat),
+                     tuple(dt.name for dt in self.in_dtypes),
+                     tuple(e.cache_key() for e in self.group_exprs),
+                     tuple(f.cache_key() for f in self.funcs),
+                     self.filter_cond.cache_key()
+                     if self.filter_cond is not None else None)
+        # keyless grand totals never exchange rows: single fused program
+        self._jitted_keyless = cached_jit(
+            self._sig + ("keyless",), lambda: jax.shard_map(
+                self._step_keyless, mesh=mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
+        self._jitted_local = cached_jit(
+            self._sig + ("local",), lambda: jax.shard_map(
+                self._step_local, mesh=mesh,
+                in_specs=(P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+        self.last_stats: Optional[dict] = None
 
-    # ---- SPMD body (runs per shard) -----------------------------------------
-    def _step(self, flat_cols, nrows_arr):
+    # ---- SPMD bodies (run per shard) ----------------------------------------
+    def _local_partials(self, flat_cols, nrows_arr):
+        """filter + local partial aggregate (shared by both bodies)."""
         nrows = nrows_arr[0]
         capacity = None
         for v, _, _ in flat_cols:
@@ -80,7 +94,6 @@ class DistributedAggregate:
                   for (v, val, offs), dt in zip(flat_cols, self.in_dtypes)]
         ctx = EmitContext(inputs, nrows, capacity)
 
-        # 1. fused filter
         if self.filter_cond is not None:
             pred = self.filter_cond.emit(ctx)
             keep = pred.values
@@ -90,7 +103,6 @@ class DistributedAggregate:
             compacted, nrows = selection.compact(inputs, keep)
             ctx = EmitContext(compacted, nrows, capacity)
 
-        # 2. local partial aggregate
         keys = [e.emit(ctx) for e in self.group_exprs]
         buf_inputs = []
         for f in self.funcs:
@@ -100,26 +112,51 @@ class DistributedAggregate:
                            jnp.broadcast_to(c.values, (capacity,)), c.validity)
             for spec, cv in zip(f.buffers(), f.update_inputs(c, capacity)):
                 buf_inputs.append((spec.kind, cv))
+        return keys, buf_inputs, ctx, nrows, capacity
 
-        if not keys:
-            # grand total: local reduce then a psum-style merge via exchange
-            outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
-            merged = self._merge_grand_totals(outs)
-            one = jnp.ones((1,), dtype=jnp.int32)
-            return tuple((o.values, _v(o), one) for o in merged)
+    def _step_keyless(self, flat_cols, nrows_arr):
+        _, buf_inputs, _, nrows, capacity = self._local_partials(
+            flat_cols, nrows_arr)
+        # grand total: local reduce then a psum-style merge
+        outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
+        merged = self._merge_grand_totals(outs)
+        one = jnp.ones((1,), dtype=jnp.int32)
+        return tuple((o.values, _v(o), one) for o in merged)
 
+    def _step_local(self, flat_cols, nrows_arr):
+        """Phase 1: partial aggregate + per-destination histogram.  The
+        histogram is this stage's materialized statistics — the analog of
+        the reference's AQE reading map-output sizes before re-planning
+        the exchange (GpuCustomShuffleReaderExec intent)."""
+        from spark_rapids_tpu.ops.pallas_kernels import histogram
+        keys, buf_inputs, _, nrows, capacity = self._local_partials(
+            flat_cols, nrows_arr)
         pkeys, pbufs, n_groups = agg.groupby_aggregate(
             keys, buf_inputs, nrows, capacity)
-
-        # 3. shuffle partial groups by key hash (the ICI all-to-all)
         pids = hash_partition_ids(pkeys, self.nshards)
-        all_cols = list(pkeys) + list(pbufs)
-        recv, recv_n = exchange(all_cols, pids, n_groups, self.axis,
-                                self.nshards)
-        rkeys = recv[:len(pkeys)]
-        rbufs = recv[len(pkeys):]
+        live = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+        hist = histogram(pids, live, self.nshards)
+        outs = list(pkeys) + list(pbufs)
+        # validity stays None for non-nullable columns so phase 2's
+        # exchange skips the per-column validity all_to_all entirely
+        return (tuple((o.values, o.validity) for o in outs),
+                jnp.reshape(n_groups, (1,)), hist)
 
-        # 4. final merge + finalize on the receiving shard
+    def _step_final(self, slot, partial_flat, n_groups_arr):
+        """Phase 2: exchange partials with the stats-sized slot, then the
+        final merge + finalize on the receiving shard."""
+        n_groups = n_groups_arr[0]
+        nkeys = len(self.group_exprs)
+        dtypes = [e.dtype for e in self.group_exprs] + \
+            [s.dtype for s in self._buf_specs]
+        cols = [ColVal(dt, v, val)
+                for dt, (v, val) in zip(dtypes, partial_flat)]
+        pkeys, pbufs = cols[:nkeys], cols[nkeys:]
+        pids = hash_partition_ids(pkeys, self.nshards)
+        recv, recv_n = exchange(list(pkeys) + list(pbufs), pids, n_groups,
+                                self.axis, self.nshards, slot=slot)
+        rkeys = recv[:nkeys]
+        rbufs = recv[nkeys:]
         merge_inputs = [(_merge_kind(s.kind), c)
                         for s, c in zip(self._buf_specs, rbufs)]
         fkeys, fbufs, fn_groups = agg.groupby_aggregate(
@@ -158,10 +195,39 @@ class DistributedAggregate:
         return results
 
     # ---- host API ------------------------------------------------------------
+    def _final_jitted(self, slot: int):
+        return self._cached_jit(
+            self._sig + ("final", slot), lambda: jax.shard_map(
+                partial(self._step_final, slot), mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+
     def __call__(self, flat_cols, nrows_per_shard):
         """flat_cols: [(values, validity, offsets)] with leading dim
-        nshards*capacity; nrows_per_shard: int32[nshards]."""
-        return self._jitted(flat_cols, nrows_per_shard)
+        nshards*capacity; nrows_per_shard: int32[nshards].
+
+        Adaptive in two compiled phases: the local phase materializes the
+        per-destination histogram, the host sizes the all-to-all slot
+        from the TRUE max slice count (power-of-two bucketed, so at most
+        2x the ideal bytes ride ICI instead of the old full-capacity
+        padding = nshards x ideal), and the exchange phase runs with that
+        static slot."""
+        import numpy as np
+        if not self.group_exprs:
+            self.last_stats = {"keyless": True}
+            return self._jitted_keyless(flat_cols, nrows_per_shard)
+        partial_flat, n_groups, hist = self._jitted_local(
+            flat_cols, nrows_per_shard)
+        from spark_rapids_tpu.parallel.shuffle import pick_slot
+        counts = np.asarray(hist).reshape(self.nshards, self.nshards)
+        capacity = int(partial_flat[0][0].shape[0]) // self.nshards
+        slot = pick_slot(int(counts.max()), capacity)
+        self.last_stats = {
+            "partition_counts": counts,  # [src_shard, dst_shard]
+            "slot": slot,
+            "capacity": capacity,
+        }
+        return self._final_jitted(slot)(partial_flat, n_groups)
 
 
 def _merge_kind(update_kind: str) -> str:
@@ -187,8 +253,10 @@ class DistributedHashJoin:
       padded ragged all-to-all, co-locating equal keys on one shard, then
       joined locally.
 
-    Probe (left) columns stream sharded on the leading axis; the join runs
-    inside ONE shard_map'd XLA program.  Output stays sharded with a
+    Probe (left) columns stream sharded on the leading axis; the join
+    runs as compiled shard_map programs (plus a histogram stats pass and
+    host sync when shuffling — see the module docstring).  Output stays
+    sharded with a
     per-shard row count; ``out_factor`` sizes the static output capacity
     (per-shard output rows <= probe_capacity * out_factor — exceeding it
     drops rows, so callers size it like the reference sizes its join
@@ -202,12 +270,13 @@ class DistributedHashJoin:
                  probe_key_idx: Sequence[int],
                  build_key_idx: Sequence[int],
                  join_type: str = "inner",
-                 strategy: str = "broadcast",
-                 out_factor: int = 1):
+                 strategy: str = "auto",
+                 out_factor: int = 1,
+                 broadcast_threshold_rows: int = 1 << 16):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         if join_type not in ("inner", "left"):
             raise ValueError("distributed join supports inner/left")
-        if strategy not in ("broadcast", "shuffle"):
+        if strategy not in ("auto", "broadcast", "shuffle"):
             raise ValueError(f"unknown strategy {strategy}")
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -219,22 +288,56 @@ class DistributedHashJoin:
         self.join_type = join_type
         self.strategy = strategy
         self.out_factor = out_factor
-        sig = ("dist_join", tuple(mesh.axis_names),
-               tuple(mesh.devices.shape),
-               tuple(str(d) for d in mesh.devices.flat),
-               tuple(dt.name for dt in self.probe_dtypes),
-               tuple(dt.name for dt in self.build_dtypes),
-               tuple(self.probe_key_idx), tuple(self.build_key_idx),
-               join_type, strategy, out_factor)
-        self._jitted = cached_jit(
-            sig, lambda: jax.shard_map(
-                self._step, mesh=mesh,
+        self.broadcast_threshold_rows = broadcast_threshold_rows
+        self._cached_jit = cached_jit
+        self._sig = ("dist_join", tuple(mesh.axis_names),
+                     tuple(mesh.devices.shape),
+                     tuple(str(d) for d in mesh.devices.flat),
+                     tuple(dt.name for dt in self.probe_dtypes),
+                     tuple(dt.name for dt in self.build_dtypes),
+                     tuple(self.probe_key_idx), tuple(self.build_key_idx),
+                     join_type, out_factor)
+        self.last_stats: Optional[dict] = None
+
+    def _jitted(self, strategy: str, slots):
+        """Compiled program per (strategy, exchange slots)."""
+        return self._cached_jit(
+            self._sig + (strategy, slots), lambda: jax.shard_map(
+                partial(self._step, strategy, slots), mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis),
                           P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
-    def _step(self, probe_flat, probe_nrows_arr, build_flat,
-              build_nrows_arr):
+    def _stats_jitted(self):
+        """Per-destination histograms of both sides (the AQE stats pass
+        that sizes the shuffle slots)."""
+        def stats(probe_flat, probe_nrows_arr, build_flat,
+                  build_nrows_arr):
+            from spark_rapids_tpu.ops.pallas_kernels import histogram
+            probe = [ColVal(dt, v, val)
+                     for (v, val), dt in zip(probe_flat, self.probe_dtypes)]
+            build = [ColVal(dt, v, val)
+                     for (v, val), dt in zip(build_flat, self.build_dtypes)]
+            cap_p = probe[0].values.shape[0]
+            cap_b = build[0].values.shape[0]
+            ppids = hash_partition_ids(
+                [probe[i] for i in self.probe_key_idx], self.nshards)
+            bpids = hash_partition_ids(
+                [build[i] for i in self.build_key_idx], self.nshards)
+            plive = jnp.arange(cap_p, dtype=jnp.int32) < probe_nrows_arr[0]
+            blive = jnp.arange(cap_b, dtype=jnp.int32) < build_nrows_arr[0]
+            return (histogram(ppids, plive, self.nshards),
+                    histogram(bpids, blive, self.nshards))
+
+        return self._cached_jit(
+            self._sig + ("stats",), lambda: jax.shard_map(
+                stats, mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis),
+                          P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+
+    def _step(self, strategy, slots, probe_flat, probe_nrows_arr,
+              build_flat, build_nrows_arr):
         from spark_rapids_tpu.ops import joins as J
         from spark_rapids_tpu.parallel.shuffle import all_gather_cols
 
@@ -244,16 +347,22 @@ class DistributedHashJoin:
                  for (v, val), dt in zip(probe_flat, self.probe_dtypes)]
         build = [ColVal(dt, v, val)
                  for (v, val), dt in zip(build_flat, self.build_dtypes)]
+        # output capacity contract: per-shard output rows <=
+        # probe_capacity * out_factor, where probe_capacity is the
+        # PRE-exchange capacity (the adaptive slot must not shrink it)
+        in_probe_cap = probe[0].values.shape[0]
 
-        if self.strategy == "broadcast":
+        if strategy == "broadcast":
             build, bn = all_gather_cols(build, bn, self.axis, self.nshards)
         else:
             pkeys = [probe[i] for i in self.probe_key_idx]
             bkeys = [build[i] for i in self.build_key_idx]
             ppids = hash_partition_ids(pkeys, self.nshards)
             bpids = hash_partition_ids(bkeys, self.nshards)
-            probe, pn = exchange(probe, ppids, pn, self.axis, self.nshards)
-            build, bn = exchange(build, bpids, bn, self.axis, self.nshards)
+            probe, pn = exchange(probe, ppids, pn, self.axis, self.nshards,
+                                 slot=slots[0])
+            build, bn = exchange(build, bpids, bn, self.axis, self.nshards,
+                                 slot=slots[1])
 
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
@@ -261,7 +370,8 @@ class DistributedHashJoin:
         outer = self.join_type == "left"
         count, starts, ends, total = J.join_out_starts(
             m["probe_count"], jnp.int32(pn), outer)
-        out_cap = probe[0].values.shape[0] * self.out_factor
+        out_cap = max(in_probe_cap,
+                      probe[0].values.shape[0]) * self.out_factor
         p, brow, matched, _ = J.join_gather_indices(
             starts, ends, m["probe_count"], m["probe_bstart"],
             m["sorted_to_build"], total, out_cap)
@@ -285,6 +395,37 @@ class DistributedHashJoin:
         (flat output cols [probe cols then build cols], nrows per shard,
         unclamped match total per shard).  Any shard where total > nrows
         was truncated at out_factor * capacity rows: the caller must
-        retry with a larger out_factor."""
-        return self._jitted(probe_flat, probe_nrows_per_shard,
-                            build_flat, build_nrows_per_shard)
+        retry with a larger out_factor.
+
+        ``strategy='auto'`` picks broadcast vs shuffled-hash from the
+        build-side row stats (the reference's planner picks
+        GpuBroadcastHashJoinExec vs GpuShuffledHashJoinExec by build
+        size); the shuffle path additionally sizes its all-to-all slots
+        from per-destination histograms instead of full-capacity padding.
+        """
+        import numpy as np
+        strategy = self.strategy
+        total_build = int(np.asarray(build_nrows_per_shard).sum())
+        if strategy == "auto":
+            strategy = "broadcast" \
+                if total_build <= self.broadcast_threshold_rows else \
+                "shuffle"
+        slots = (None, None)
+        stats = {"strategy": strategy, "build_rows": total_build}
+        if strategy == "shuffle":
+            phist, bhist = self._stats_jitted()(
+                probe_flat, probe_nrows_per_shard,
+                build_flat, build_nrows_per_shard)
+            pcounts = np.asarray(phist).reshape(self.nshards, self.nshards)
+            bcounts = np.asarray(bhist).reshape(self.nshards, self.nshards)
+            from spark_rapids_tpu.parallel.shuffle import pick_slot
+            cap_p = int(probe_flat[0][0].shape[0]) // self.nshards
+            cap_b = int(build_flat[0][0].shape[0]) // self.nshards
+            slots = (pick_slot(int(pcounts.max()), cap_p),
+                     pick_slot(int(bcounts.max()), cap_b))
+            stats.update(probe_counts=pcounts, build_counts=bcounts,
+                         slots=slots)
+        self.last_stats = stats
+        return self._jitted(strategy, slots)(
+            probe_flat, probe_nrows_per_shard,
+            build_flat, build_nrows_per_shard)

@@ -26,6 +26,16 @@ from spark_rapids_tpu.ops.expressions import ColVal
 from spark_rapids_tpu.parallel.partitioning import layout_by_partition
 
 
+def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:
+    """Slot size for ``exchange`` from a materialized per-destination
+    histogram: the true max slice count bucketed up to a power of two
+    (<= 2x the ideal bytes on ICI), capped at the full capacity."""
+    s = floor
+    while s < max_slice:
+        s <<= 1
+    return min(s, capacity)
+
+
 def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
              axis_name: str, num_parts: int,
              slot: Optional[int] = None) -> Tuple[List[ColVal], jnp.ndarray]:

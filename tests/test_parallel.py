@@ -220,3 +220,101 @@ def test_distributed_hash_join(mesh, rng, strategy, join_type):
     wd = ws.dimval.astype(float).values
     np.testing.assert_allclose(np.nan_to_num(gd, nan=-1),
                                np.nan_to_num(wd, nan=-1))
+
+
+def test_adaptive_exchange_slot_bounded(mesh, rng):
+    """AQE step: the all-to-all slot is sized from the materialized
+    per-destination histogram — at most 2x the true max slice (power-of-2
+    bucket), never the old full-capacity padding (which moved nshards x
+    the needed bytes over ICI)."""
+    keys = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
+    vals = rng.normal(size=(NSHARDS, CAP))
+    nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.INT64, dts.FLOAT64],
+        group_exprs=[BoundReference(0, dts.INT64, name="k",
+                                    nullable=False)],
+        funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v"))])
+    flat_cols = [(_make_sharded(keys), None, None),
+                 (_make_sharded(vals, np.float64), None, None)]
+    outs = dist(flat_cols, jnp.asarray(nrows))
+    np.asarray(outs[0][0])  # force
+    stats = dist.last_stats
+    assert stats is not None
+    true_max = int(stats["partition_counts"].max())
+    assert stats["slot"] <= max(2 * true_max, 8)
+    # 40 distinct keys over 8 shards: ~5-key slices, nowhere near CAP
+    assert stats["slot"] < CAP
+
+
+def test_adaptive_exchange_skewed_correct(mesh, rng):
+    """90% of rows in one hot key: slot sizing must adapt, results must
+    stay exact."""
+    keys = np.where(rng.random((NSHARDS, CAP)) < 0.9, 7,
+                    rng.integers(0, 1000, (NSHARDS, CAP))).astype(np.int64)
+    vals = rng.normal(size=(NSHARDS, CAP))
+    nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.INT64, dts.FLOAT64],
+        group_exprs=[BoundReference(0, dts.INT64, name="k",
+                                    nullable=False)],
+        funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v"))])
+    flat_cols = [(_make_sharded(keys), None, None),
+                 (_make_sharded(vals, np.float64), None, None)]
+    outs = dist(flat_cols, jnp.asarray(nrows))
+    (kv, _, kn), (sv, _, _) = outs
+    recv_cap = np.asarray(kv).shape[0] // NSHARDS
+    ngroups = np.asarray(kn).reshape(NSHARDS, -1)[:, 0]
+    got = {}
+    kvs = np.asarray(kv).reshape(NSHARDS, recv_cap)
+    svs = np.asarray(sv).reshape(NSHARDS, recv_cap)
+    for s in range(NSHARDS):
+        for i in range(ngroups[s]):
+            got[int(kvs[s, i])] = svs[s, i]
+    want = pd.DataFrame({"k": keys.reshape(-1),
+                         "v": vals.reshape(-1)}).groupby("k")["v"].sum()
+    assert set(got) == set(want.index)
+    for k, v in want.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-9)
+
+
+def test_join_auto_strategy_from_stats(mesh, rng):
+    """strategy='auto' picks broadcast for a small build side and
+    shuffled-hash above the threshold, from the build row stats."""
+    from spark_rapids_tpu.parallel.distributed import DistributedHashJoin
+    ones = jnp.ones(NSHARDS * CAP, dtype=jnp.bool_)
+    fk = rng.integers(0, 16, (NSHARDS, CAP)).astype(np.int64)
+    probe_flat = [(_make_sharded(fk), ones),
+                  (_make_sharded(rng.normal(size=(NSHARDS, CAP)),
+                                 np.float64), ones)]
+    bkeys = np.tile(np.arange(16, dtype=np.int64),
+                    NSHARDS * CAP // 16).reshape(NSHARDS, CAP)
+    build_flat = [(_make_sharded(bkeys), ones),
+                  (_make_sharded(bkeys * 2.0, np.float64), ones)]
+    p_nrows = jnp.asarray(np.full(NSHARDS, CAP, dtype=np.int32))
+
+    def run(threshold, b_nrows):
+        join = DistributedHashJoin(
+            mesh, probe_dtypes=[dts.INT64, dts.FLOAT64],
+            build_dtypes=[dts.INT64, dts.FLOAT64],
+            probe_key_idx=[0], build_key_idx=[0],
+            join_type="inner", strategy="auto",
+            broadcast_threshold_rows=threshold)
+        flat, n_out, total = join(probe_flat, p_nrows, build_flat,
+                                  jnp.asarray(b_nrows))
+        np.asarray(n_out)
+        return join.last_stats, int(np.asarray(n_out).sum())
+
+    small_build = np.zeros(NSHARDS, dtype=np.int32)
+    small_build[0] = 16
+    stats_b, rows_b = run(threshold=1000, b_nrows=small_build)
+    assert stats_b["strategy"] == "broadcast"
+
+    big_build = np.full(NSHARDS, CAP, dtype=np.int32)
+    stats_s, rows_s = run(threshold=64, b_nrows=big_build)
+    assert stats_s["strategy"] == "shuffle"
+    assert "slots" in stats_s
+    # slot sized from histograms: bounded by 2x the true max slice
+    assert stats_s["slots"][0] <= max(
+        2 * int(stats_s["probe_counts"].max()), 8)
+    assert rows_b > 0 and rows_s > 0
