@@ -334,6 +334,31 @@ def udf(f=None, returnType: str = "string"):
 pandas_udf = udf
 
 
+def tpu_udf(f=None, returnType: str = "double"):
+    """Register a user-supplied JAX function as a columnar expression —
+    the RapidsUDF analog (a UDF providing its own columnar evaluation,
+    RapidsUDF.java:40).  ``fn`` receives the raw per-column jnp value
+    arrays and returns one array; it traces INTO the enclosing stage's
+    XLA program, so it fuses with the surrounding query for free."""
+    from spark_rapids_tpu.columnar.dtypes import dtype_from_name
+
+    def wrap(fn):
+        rt = dtype_from_name(returnType) if isinstance(returnType, str) \
+            else returnType
+
+        def call(*cols) -> Col:
+            from spark_rapids_tpu.udf.python_exec import JaxUDF
+            return Col(JaxUDF(fn, rt, [_expr(c) for c in cols]))
+
+        call.__name__ = getattr(fn, "__name__", "tpu_udf")
+        call.fn = fn
+        return call
+
+    if f is not None:
+        return wrap(f)
+    return wrap
+
+
 # ------------------------------------------------------------------ strings
 
 def length(c) -> Col:

@@ -102,6 +102,11 @@ expr_rule(C.ArrayContains, ts.COMMON)
 expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
 
+# UDFs: a user jax function fuses into the stage (RapidsUDF analog)
+from spark_rapids_tpu.udf.python_exec import JaxUDF  # noqa: E402
+
+expr_rule(JaxUDF, ts.ALL)
+
 # predicates / conditionals (any common type flows through)
 for c in (preds.EqualTo, preds.EqualNullSafe, preds.LessThan,
           preds.LessThanOrEqual, preds.GreaterThan, preds.GreaterThanOrEqual,
@@ -534,6 +539,12 @@ class TpuOverrides:
         own_ok = not meta.reasons
         if own_ok and type(node) in _PLAN_CONVERTERS:
             return _PLAN_CONVERTERS[type(node)](node, children, self.conf)
+        if isinstance(node, L.Project) and self._udf_only_failure(meta):
+            # scalar Python UDF projection: device-evaluate everything
+            # except the UDF calls themselves (GpuArrowEvalPythonExec)
+            from spark_rapids_tpu.udf.python_exec import (
+                TpuArrowEvalPythonExec)
+            return TpuArrowEvalPythonExec(node.exprs, children[0])
         if self.conf["spark.rapids.sql.test.enabled"]:
             allowed = self.conf[
                 "spark.rapids.sql.test.allowedNonTpu"].split(",")
@@ -543,6 +554,38 @@ class TpuOverrides:
                     f"mode: {'; '.join(meta.reasons)}")
         from spark_rapids_tpu.exec.fallback import CpuFallbackExec
         return CpuFallbackExec(node, children)
+
+    def _udf_only_failure(self, meta: PlanMeta) -> bool:
+        """True when the node's only obstacles are black-box PythonUDF
+        calls (everything around them is TPU-supported): re-tag each
+        expression with UDF subtrees replaced by typed placeholders."""
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        from spark_rapids_tpu.udf.python_exec import (
+            _find_python_udfs, _replace_udfs)
+        # (child failures need no handling here: each child converts with
+        # its own fallback independently)
+        node = meta.wrapped
+        found = False
+        for e in node.exprs:
+            udfs = _find_python_udfs(e)
+            if any(_find_python_udfs(a) for u in udfs
+                   for a in u.children):
+                return False  # nested black-box UDFs: whole-plan fallback
+            if not udfs:
+                em = ExprMeta(e, self.conf)
+                em.tag()
+                if not em.can_replace:
+                    return False
+                continue
+            found = True
+            mapping = {id(u): BoundReference(0, u.return_type,
+                                             name="_udf")
+                       for u in udfs}
+            em = ExprMeta(_replace_udfs(e, mapping), self.conf)
+            em.tag()
+            if not em.can_replace:
+                return False
+        return found
 
     def _try_fuse_aggregate(self, meta: PlanMeta):
         """Whole-stage fusion: collapse Project/Filter chains under an

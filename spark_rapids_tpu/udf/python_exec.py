@@ -102,3 +102,196 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
             if len(out):
                 yield ColumnarBatch.from_pandas(
                     out[[n for n, _ in self._schema]].reset_index(drop=True))
+
+
+class JaxUDF(Expression):
+    """User-supplied JAX function as a columnar expression — the
+    RapidsUDF analog (sql-plugin RapidsUDF.java:40: a UDF that provides
+    its own columnar evaluation).  On TPU this is the cheapest possible
+    UDF: the function traces straight into the enclosing stage's XLA
+    program and fuses with everything around it.
+
+    ``fn(*value_arrays) -> value_array`` over the raw (capacity,) jnp
+    arrays; null handling is the engine's (output row null iff any input
+    row null), so ``fn`` sees padded/null slots and must simply be
+    elementwise-safe over them.
+    """
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 args: Sequence[Expression], name: str = ""):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(args)
+        self._name = name or getattr(fn, "__name__", "jax_udf")
+
+    def with_children(self, children):
+        return JaxUDF(self.fn, self.return_type, children, self._name)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.return_type
+
+    @property
+    def name(self) -> str:
+        return f"{self._name}(...)"
+
+    def emit(self, ctx):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.expressions import (
+            ColVal, combine_validity)
+        args = []
+        validity = None
+        for c in self.children:
+            cv = c.emit(ctx)
+            v = cv.values
+            if getattr(v, "ndim", 0) == 0:
+                v = jnp.broadcast_to(v, (ctx.capacity,))
+            args.append(v)
+            validity = combine_validity(validity, cv.validity)
+        out = self.fn(*args)
+        if getattr(out, "shape", None) != (ctx.capacity,):
+            raise ValueError(
+                f"tpu_udf {self._name} must return a ({ctx.capacity},) "
+                f"array, got {getattr(out, 'shape', type(out))}")
+        return ColVal(self.return_type, out, validity)
+
+    def cache_key(self):
+        return ("JaxUDF", id(self.fn),
+                tuple(c.cache_key() for c in self.children))
+
+
+def _find_python_udfs(expr: Expression) -> List[PythonUDF]:
+    out = []
+    if isinstance(expr, PythonUDF):
+        out.append(expr)
+    for c in expr.children:
+        out.extend(_find_python_udfs(c))
+    return out
+
+
+def _replace_udfs(expr: Expression, mapping) -> Expression:
+    if isinstance(expr, PythonUDF):
+        return mapping[id(expr)]
+    if not expr.children:
+        return expr
+    return expr.with_children(
+        [_replace_udfs(c, mapping) for c in expr.children])
+
+
+class TpuArrowEvalPythonExec(TpuExec):
+    """Scalar Python UDF projection (GpuArrowEvalPythonExec analog,
+    python/GpuArrowEvalPythonExec.scala).  Per batch: UDF *arguments*
+    evaluate on device in one stage, only those columns cross to the
+    host (arrow), the admission semaphore is RELEASED while the Python
+    functions run (:285-289 in the reference — no device work happens),
+    results come back as columns, and the remaining projection — with
+    each UDF call replaced by a reference to its result column — runs on
+    device.  Streaming: never materializes more than one batch."""
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+        from spark_rapids_tpu.ops.compiler import StageFn
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._udfs: List[PythonUDF] = []
+        seen = set()
+        for e in self.exprs:
+            for u in _find_python_udfs(e):
+                if id(u) not in seen:
+                    seen.add(id(u))
+                    self._udfs.append(u)
+        if not self._udfs:
+            raise ValueError("no PythonUDF in projection")
+        for u in self._udfs:
+            for a in u.children:
+                if _find_python_udfs(a):
+                    # nested black-box UDFs take the whole-plan CPU path
+                    # (the planner's _udf_only_failure rejects them too)
+                    raise ValueError("nested PythonUDFs unsupported")
+        in_dtypes = [dt for _, dt in child.schema]
+        # result-column names must not collide with child columns
+        prefix = "_udf"
+        child_names = [n for n, _ in child.schema]
+        while any(n.startswith(prefix) for n in child_names):
+            prefix += "_"
+        self._result_prefix = prefix
+        # stage A: only the UDF argument expressions (child columns are
+        # reused from the input batch, not re-materialized)
+        self._args_per_udf = [list(u.children) for u in self._udfs]
+        arg_exprs = [a for args in self._args_per_udf for a in args]
+        self._stage_a = StageFn(arg_exprs, in_dtypes)
+        # stage B: the projection over child columns + UDF result columns
+        n = len(child.schema)
+        mapping = {id(u): BoundReference(n + j, u.return_type,
+                                         name=f"{prefix}{j}")
+                   for j, u in enumerate(self._udfs)}
+        self._rewritten = [_replace_udfs(e, mapping) for e in self.exprs]
+        self._stage_b_dtypes = in_dtypes + [u.return_type
+                                            for u in self._udfs]
+        self._stage_b = StageFn(self._rewritten, self._stage_b_dtypes)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return [(e.name, e.dtype) for e in self.exprs]
+
+    def describe(self):
+        names = [u._name for u in self._udfs]
+        return f"TpuArrowEvalPythonExec[{', '.join(names)}]"
+
+    def _semaphore(self):
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        return s.semaphore if s is not None else None
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.columnar.column import Column
+        for batch in self.child.execute():
+            if batch.nrows == 0:
+                continue
+            arg_cols = self._stage_a(batch)
+            # device->host transfer of the argument columns happens while
+            # still ADMITTED; only the pure-Python function calls run with
+            # the semaphore released (no device work in that window)
+            arg_lists_all = [c.to_pylist() for c in arg_cols]
+            sem = self._semaphore()
+            if sem is not None:
+                sem.release_if_held()
+            outs_per_udf = []
+            k = 0
+            for u, args in zip(self._udfs, self._args_per_udf):
+                arg_lists = arg_lists_all[k:k + len(args)]
+                k += len(args)
+                out = [None if any(v is None for v in row) else
+                       u.fn(*row) for row in zip(*arg_lists)] \
+                    if arg_lists else [u.fn() for _ in range(batch.nrows)]
+                outs_per_udf.append(out)
+            if sem is not None:
+                sem.acquire_if_necessary()
+            results: List[Column] = []
+            for u, out in zip(self._udfs, outs_per_udf):
+                if u.return_type.is_string:
+                    results.append(Column.from_strings(
+                        [None if v is None else str(v) for v in out],
+                        capacity=batch.capacity))
+                else:
+                    import numpy as np
+                    validity = np.array([v is not None for v in out])
+                    filled = np.array(
+                        [0 if v is None else v for v in out],
+                        dtype=u.return_type.storage)
+                    results.append(Column.from_numpy(
+                        filled, dtype=u.return_type,
+                        validity=None if validity.all() else validity,
+                        capacity=batch.capacity))
+            extended = batch
+            for j, rc in enumerate(results):
+                extended = extended.with_column(
+                    f"{self._result_prefix}{j}", rc)
+            outs = self._stage_b(extended)
+            names = [e.name for e in self.exprs]
+            yield ColumnarBatch(
+                {nm: c for nm, c in zip(names, outs)}, batch.nrows)

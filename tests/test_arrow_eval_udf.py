@@ -1,0 +1,156 @@
+"""Scalar Python UDF exec + user-jax-function UDF (GpuArrowEvalPythonExec
++ RapidsUDF analogs) and the fallback chain compiled -> jax-UDF -> host."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_arrow_eval_python_exec_routes(session):
+    """A black-box (uncompilable) UDF projection uses the ArrowEval exec,
+    not whole-plan CPU fallback."""
+    import math
+
+    @F.udf(returnType="double")
+    def weird(x):
+        # os/math tricks the bytecode compiler can't express
+        return math.fsum([x, 1.0, x * 0.5])
+
+    df = session.create_dataframe({"x": [1.0, 2.0, None, 4.0],
+                                   "y": [10, 20, 30, 40]})
+    q = df.select("y", weird(F.col("x")).alias("w"),
+                  (F.col("y") * 2).alias("y2"))
+    tree = session.plan(q.plan).tree_string()
+    assert "TpuArrowEvalPythonExec" in tree
+    assert "CpuFallbackExec" not in tree
+    out = q.to_pandas()
+    for i, x in enumerate([1.0, 2.0, None, 4.0]):
+        if x is None:
+            assert pd.isna(out["w"][i])
+        else:
+            np.testing.assert_allclose(out["w"][i], x + 1.0 + x * 0.5)
+    assert out["y2"].tolist() == [20, 40, 60, 80]
+
+
+def test_arrow_eval_string_udf(session):
+    @F.udf(returnType="string")
+    def shout(s):
+        return s.upper() + "!!"   # .upper() method: host black box
+
+    df = session.create_dataframe({"s": ["a", None, "bc"]})
+    out = df.select(shout(F.col("s")).alias("r")).to_pandas()["r"]
+    assert out[0] == "A!!" and pd.isna(out[1]) and out[2] == "BC!!"
+
+
+def test_arrow_eval_streams_batches(session):
+    """Union produces multiple batches; ArrowEval must stream them."""
+    @F.udf(returnType="bigint")
+    def mystery(x):
+        return int(str(int(x))[::-1])  # string reversal: uncompilable
+
+    d1 = session.create_dataframe({"x": [12, 34]})
+    d2 = session.create_dataframe({"x": [56, 78]})
+    out = d1.union(d2).select(mystery(F.col("x")).alias("r")).to_pandas()
+    assert out["r"].tolist() == [21, 43, 65, 87]
+
+
+def test_tpu_udf_fuses_on_device(session):
+    """A user jax function runs as a columnar expression with NO
+    ArrowEval/CPU hop (the RapidsUDF flagship path)."""
+    import jax.numpy as jnp
+
+    @F.tpu_udf(returnType="double")
+    def gelu_ish(x):
+        return x * 0.5 * (1.0 + jnp.tanh(0.797885 * (x + 0.044715 * x**3)))
+
+    df = session.create_dataframe({"x": [0.0, 1.0, -2.0, 3.5]})
+    q = df.select(gelu_ish(F.col("x")).alias("g"),
+                  (F.col("x") + 1).alias("x1"))
+    tree = session.plan(q.plan).tree_string()
+    assert "TpuArrowEvalPythonExec" not in tree
+    assert "CpuFallbackExec" not in tree
+    out = q.to_pandas()
+    x = np.array([0.0, 1.0, -2.0, 3.5])
+    want = x * 0.5 * (1.0 + np.tanh(0.797885 * (x + 0.044715 * x**3)))
+    np.testing.assert_allclose(out["g"], want, rtol=1e-12)
+
+
+def test_tpu_udf_multi_arg_with_nulls(session):
+    @F.tpu_udf(returnType="double")
+    def hypot(a, b):
+        import jax.numpy as jnp
+        return jnp.sqrt(a * a + b * b)
+
+    df = session.create_dataframe({"a": [3.0, None, 5.0],
+                                   "b": [4.0, 1.0, 12.0]})
+    out = df.select(hypot(F.col("a"), F.col("b")).alias("h")).to_pandas()
+    assert out["h"][0] == 5.0 and pd.isna(out["h"][1]) and \
+        out["h"][2] == 13.0
+
+
+def test_udf_fallback_chain(session):
+    """compiled -> jax -> host: the compiler handles arithmetic UDFs
+    (no ArrowEval), the host path takes the rest."""
+    @F.udf(returnType="double")
+    def simple(x):
+        return x * 2.0 + 1.0   # bytecode-compilable
+
+    df = session.create_dataframe({"x": [1.0, 2.0]})
+    q = df.select(simple(F.col("x")).alias("r"))
+    tree = session.plan(q.plan).tree_string()
+    assert "TpuArrowEvalPythonExec" not in tree  # compiled to expressions
+    assert q.to_pandas()["r"].tolist() == [3.0, 5.0]
+
+
+def test_udf_inside_larger_expression(session):
+    """UDF result feeding further device arithmetic."""
+    @F.udf(returnType="bigint")
+    def digits(x):
+        return len(str(int(x)))  # uncompilable
+
+    df = session.create_dataframe({"x": [5, 55, 555]})
+    q = df.select((digits(F.col("x")) * 100).alias("d"))
+    tree = session.plan(q.plan).tree_string()
+    assert "TpuArrowEvalPythonExec" in tree
+    assert q.to_pandas()["d"].tolist() == [100, 200, 300]
+
+
+def test_nested_udfs_fall_back_whole_plan(session):
+    """Nested black-box UDFs can't split device/host: whole-plan CPU
+    fallback (regression: stage A tried to device-compile the inner)."""
+    @F.udf(returnType="bigint")
+    def inner(x):
+        return int(str(int(x))[::-1])
+
+    @F.udf(returnType="bigint")
+    def outer(x):
+        return int(str(int(x)) * 2)
+
+    df = session.create_dataframe({"x": [12, 34]})
+    q = df.select(outer(inner(F.col("x"))).alias("r"))
+    tree = session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert q.to_pandas()["r"].tolist() == [2121, 4343]
+
+
+def test_udf_result_name_collision(session):
+    """A child column literally named _udf0 must not clash with the
+    internal result columns."""
+    @F.udf(returnType="bigint")
+    def digits(x):
+        return len(str(int(x)))
+
+    df = session.create_dataframe({"_udf0": [5, 55, 555]})
+    q = df.select((digits(F.col("_udf0")) * 100).alias("d"),
+                  F.col("_udf0"))
+    out = q.to_pandas()
+    assert out["d"].tolist() == [100, 200, 300]
+    assert out["_udf0"].tolist() == [5, 55, 555]

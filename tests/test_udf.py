@@ -100,7 +100,10 @@ def test_uncompilable_udf_falls_back(session):
     df = session.create_dataframe({"v": [1, 2, 3]})
     q = df.select(translate(F.col("v")).alias("t"))
     tree = session.plan(q.plan).tree_string()
-    assert "CpuFallbackExec" in tree
+    # uncompilable UDFs now use the ArrowEval exec (host UDF, device
+    # everything-else) instead of whole-plan CPU fallback
+    assert "TpuArrowEvalPythonExec" in tree
+    assert "CpuFallbackExec" not in tree
     assert q.to_pandas()["t"].tolist() == ["one", "two", "?"]
 
 
