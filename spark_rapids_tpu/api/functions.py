@@ -111,10 +111,13 @@ class Col:
     def alias(self, name: str) -> "Col":
         return Col(Alias(self.expr, name))
 
-    def cast(self, dtype: Union[str, DataType]) -> "Col":
+    def cast(self, dtype: Union[str, DataType],
+             ansi: bool = False) -> "Col":
+        """``ansi=True`` = Spark's AnsiCast: conversion failures raise
+        instead of producing null/wrapping."""
         if isinstance(dtype, str):
             dtype = dtype_from_name(dtype)
-        return Col(Cast(self.expr, dtype))
+        return Col(Cast(self.expr, dtype, ansi=ansi))
 
     def isNull(self) -> "Col":
         return Col(preds.IsNull(self.expr))

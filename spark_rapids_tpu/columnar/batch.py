@@ -90,6 +90,11 @@ class ColumnarBatch:
                     continue
                 validity = np.array([v is not None for v in arr])
                 filled = [0 if v is None else v for v in arr]
+                present = [v for v in arr if v is not None]
+                if present and all(isinstance(v, bool) for v in present):
+                    # bools + None otherwise infer as int64
+                    filled = np.array([bool(v) for v in filled],
+                                      dtype=np.bool_)
                 cols[name] = Column.from_numpy(
                     np.asarray(filled), capacity=cap,
                     validity=None if validity.all() else validity)

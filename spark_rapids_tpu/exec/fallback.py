@@ -19,6 +19,15 @@ from spark_rapids_tpu.exec.base import Schema, TpuExec
 from spark_rapids_tpu.plan import logical as L
 
 
+def _ansi_fail(cast_expr, value):
+    """ANSI casts raise on conversion failure even on the CPU path."""
+    if getattr(cast_expr, "ansi", False):
+        raise ArithmeticError(
+            f"invalid input {value!r} for ANSI cast to "
+            f"{cast_expr.target}")
+    return None
+
+
 def _isnull(v) -> bool:
     """Null test for scalar values out of pandas (None or NaN float)."""
     return v is None or (isinstance(v, float) and pd.isna(v))
@@ -63,6 +72,43 @@ def _eval_pandas(expr, df: pd.DataFrame):
         return _eval_pandas(e.left, df) | _eval_pandas(e.right, df)
     if isinstance(e, P.Not):
         return ~_eval_pandas(e.child, df)
+    from spark_rapids_tpu.ops.cast import Cast as _Cast
+    if isinstance(e, _Cast):
+        child = _eval_pandas(e.child, df)
+        t = e.target
+        def conv(v):
+            if _isnull(v):
+                return None
+            try:
+                if t.is_string:
+                    if isinstance(v, bool):
+                        return "true" if v else "false"
+                    if isinstance(v, float):
+                        import math
+                        if math.isnan(v):
+                            return "NaN"
+                        if math.isinf(v):
+                            return "Infinity" if v > 0 else "-Infinity"
+                        if v == int(v) and abs(v) < 1e16:
+                            return f"{v:.1f}"
+                    return str(v)
+                if t.is_boolean:
+                    if isinstance(v, str):
+                        lv = v.strip().lower()
+                        if lv in ("true", "t", "yes", "y", "1"):
+                            return True
+                        if lv in ("false", "f", "no", "n", "0"):
+                            return False
+                        return _ansi_fail(e, v)
+                    return bool(v)
+                if t.is_integral:
+                    return int(float(v)) if isinstance(v, str) else int(v)
+                if t.is_floating:
+                    return float(v)
+            except (ValueError, TypeError, OverflowError):
+                return _ansi_fail(e, v)
+            return v
+        return child.map(conv)
     from spark_rapids_tpu.ops import stringops as S
     if isinstance(e, S.Like):
         import re

@@ -28,6 +28,26 @@ from spark_rapids_tpu.ops.expressions import (
 US_PER_DAY = 86_400_000_000
 US_PER_SEC = 1_000_000
 
+
+def cast_supported(src: DataType, dst: DataType):
+    """None when the cast runs on device; else the reason string (the
+    planner tags it and the query falls back to CPU) — the TypeSig role
+    GpuCast.scala's matrix plays in the reference."""
+    if src.name == dst.name:
+        return None
+    if src.is_array or dst.is_array:
+        return f"cast {src} -> {dst}: array casts not supported"
+    if src.is_string:
+        if dst.is_decimal:
+            return "cast string -> decimal not supported on TPU"
+        return None  # numeric/bool/date/timestamp parse on device
+    if dst.is_string:
+        if src.is_floating or src.is_decimal:
+            return (f"cast {src} -> string needs shortest-round-trip "
+                    "float formatting (host fallback)")
+        return None  # int/bool/date/timestamp format on device
+    return None
+
 _INT_RANGE = {
     "tinyint": (-(1 << 7), (1 << 7) - 1),
     "smallint": (-(1 << 15), (1 << 15) - 1),
@@ -107,26 +127,65 @@ def _rescale_decimal(v, from_scale: int, to_scale: int):
 
 
 class Cast(Expression):
-    def __init__(self, child: Expression, target: DataType):
+    """Non-ANSI cast: invalid parses/overflow produce null/truncation
+    (Spark default).  ``ansi=True`` is the AnsiCast analog: any row that
+    fails to convert registers a runtime check that raises host-side
+    after the stage executes (GpuCast.scala ansi mode throws)."""
+
+    def __init__(self, child: Expression, target: DataType,
+                 ansi: bool = False):
         self.children = (child,)
         self.target = target
+        self.ansi = ansi
 
     @property
     def child(self):
         return self.children[0]
 
     def with_children(self, children):
-        return Cast(children[0], self.target)
+        return Cast(children[0], self.target, self.ansi)
 
     @property
     def dtype(self) -> DataType:
         return self.target
 
     def emit(self, ctx: EmitContext) -> ColVal:
-        return cast_colval(self.child.emit(ctx), self.target, ctx)
+        c = self.child.emit(ctx)
+        out = cast_colval(c, self.target, ctx)
+        if self.ansi:
+            self._ansi_checks(c, out, ctx)
+        return out
+
+    def _ansi_checks(self, c: ColVal, out: ColVal, ctx: EmitContext):
+        live = ctx.row_mask()
+        src, dst = c.dtype, self.target
+        bad = None
+        if src.is_string and out.validity is not None:
+            # rows that were valid input but failed to parse
+            bad = jnp.logical_not(out.validity)
+        elif src.is_floating and dst.is_integral:
+            # Spark ANSI bounds the TRUNCATED value (cast(127.6 as
+            # tinyint) is 127, not an overflow)
+            lo, hi = _INT_RANGE[dst.name]
+            v = c.values
+            t = jnp.trunc(v)
+            bad = jnp.isnan(v) | (t < float(lo)) | (t > float(hi))
+        elif src.is_integral and dst.is_integral:
+            lo, hi = _INT_RANGE[dst.name]
+            bad = (c.values < lo) | (c.values > hi)
+        if bad is None:
+            return
+        msg = (f"invalid input for cast to {dst}" if src.is_string
+               else f"overflow casting {src} to {dst}")
+        bad = jnp.logical_and(bad, live)
+        if c.validity is not None:  # null inputs never error
+            bad = jnp.logical_and(bad, c.validity)
+        ctx.add_check(msg, jnp.any(bad))
 
     def cache_key(self):
-        return ("Cast", self.target.name, self.child.cache_key())
+        return ("Cast", self.target.name, self.ansi,
+                self.child.cache_key())
 
     def __str__(self):
-        return f"cast({self.child} as {self.target})"
+        kind = "ansi_cast" if self.ansi else "cast"
+        return f"{kind}({self.child} as {self.target})"

@@ -63,6 +63,19 @@ def colvals_to_columns(outs: Sequence[ColVal], nrows: int,
     return cols
 
 
+# ANSI check messages per stage signature: the jit cache shares traced
+# functions across StageFn instances with the same signature, so messages
+# recorded at trace time must be shared the same way
+_CHECK_MSGS = {}
+
+
+def raise_failed_checks(messages, flags) -> None:
+    """Host-side surfacing of in-trace ANSI checks (Spark ANSI throws)."""
+    if flags and any(bool(f) for f in flags):
+        failed = [m for m, f in zip(messages, flags) if bool(f)]
+        raise ArithmeticError("; ".join(failed) or "ANSI check failed")
+
+
 class StageFn:
     """A compiled per-batch function for a fixed expression forest.
 
@@ -75,21 +88,26 @@ class StageFn:
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.exprs = list(exprs)
         self.input_dtypes = list(input_dtypes)
-        sig = ("stage", tuple(e.cache_key() for e in self.exprs),
-               tuple(dt.name for dt in self.input_dtypes))
-        self._jitted = cached_jit(sig, lambda: self._run)
+        self._sig = ("stage", tuple(e.cache_key() for e in self.exprs),
+                     tuple(dt.name for dt in self.input_dtypes))
+        self._jitted = cached_jit(self._sig, lambda: self._run)
 
     def _run(self, flat_cols, nrows):
         capacity = capacity_of(flat_cols) if flat_cols else 0
         inputs = flat_to_colvals(flat_cols, self.input_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
         outs = [e.emit(ctx) for e in self.exprs]
-        return [(o.values, o.validity, o.offsets) for o in outs]
+        # messages are static per expression tree: record them at trace
+        # time so a failure needs no re-execution
+        _CHECK_MSGS[self._sig] = [m for m, _ in ctx.checks]
+        return ([(o.values, o.validity, o.offsets) for o in outs],
+                tuple(flag for _, flag in ctx.checks))
 
     def __call__(self, batch: ColumnarBatch) -> List[Column]:
         flat = batch_to_flat(batch)
         nrows = jnp.int32(batch.nrows)
-        out_flat = self._jitted(flat, nrows)
+        out_flat, check_flags = self._jitted(flat, nrows)
+        raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
         outs = [ColVal(e.dtype, v, validity, offsets)
                 for e, (v, validity, offsets) in zip(self.exprs, out_flat)]
         return colvals_to_columns(outs, batch.nrows, batch.capacity)
@@ -108,10 +126,10 @@ class FilterStageFn:
         self.predicate = predicate
         self.project = list(project)
         self.input_dtypes = list(input_dtypes)
-        sig = ("filter_stage", self.predicate.cache_key(),
-               tuple(e.cache_key() for e in self.project),
-               tuple(dt.name for dt in self.input_dtypes))
-        self._jitted = cached_jit(sig, lambda: self._run)
+        self._sig = ("filter_stage", self.predicate.cache_key(),
+                     tuple(e.cache_key() for e in self.project),
+                     tuple(dt.name for dt in self.input_dtypes))
+        self._jitted = cached_jit(self._sig, lambda: self._run)
 
     def _run(self, flat_cols, nrows):
         from spark_rapids_tpu.ops import selection
@@ -133,12 +151,15 @@ class FilterStageFn:
                        o.validity, o.offsets)
                 for o in outs]
         compacted, new_nrows = selection.compact(outs, keep)
+        _CHECK_MSGS[self._sig] = [m for m, _ in ctx.checks]
         return ([(o.values, o.validity, o.offsets) for o in compacted],
-                new_nrows)
+                new_nrows, tuple(flag for _, flag in ctx.checks))
 
     def __call__(self, batch: ColumnarBatch) -> Tuple[List[Column], int]:
         flat = batch_to_flat(batch)
-        out_flat, new_nrows = self._jitted(flat, jnp.int32(batch.nrows))
+        out_flat, new_nrows, check_flags = self._jitted(
+            flat, jnp.int32(batch.nrows))
+        raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
         n = int(new_nrows)
         outs = [ColVal(e.dtype, v, validity, offsets)
                 for e, (v, validity, offsets) in zip(self.project, out_flat)]

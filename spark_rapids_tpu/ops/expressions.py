@@ -74,6 +74,13 @@ class EmitContext:
         self.inputs = list(inputs)
         self.nrows = nrows
         self.capacity = capacity
+        # (message, traced bool scalar) pairs appended by ANSI-mode
+        # expressions; stage runners surface them and raise host-side
+        # (Spark ANSI throws, GpuCast ansi mode)
+        self.checks = []
+
+    def add_check(self, message: str, failed) -> None:
+        self.checks.append((message, failed))
 
     def row_mask(self):
         """bool[capacity], True for rows < nrows (padding mask)."""

@@ -847,10 +847,16 @@ def cast_string(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:
         return _parse_number(c, target, ctx)
     if c.dtype.is_string and target.is_date:
         return _parse_date(c, ctx)
+    if c.dtype.is_string and target.is_timestamp:
+        return _parse_timestamp(c, ctx)
+    if c.dtype.is_string and target.is_boolean:
+        return _parse_bool(c, ctx)
     if (c.dtype.is_integral or c.dtype.is_boolean) and target.is_string:
         return _format_int(c, ctx)
     if c.dtype.is_date and target.is_string:
         return _format_date(c, ctx)
+    if c.dtype.is_timestamp and target.is_string:
+        return _format_timestamp(c, ctx)
     raise NotImplementedError(
         f"cast {c.dtype} -> {target} not yet supported on TPU")
 
@@ -889,6 +895,7 @@ def _parse_number(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:
     scale = jnp.zeros(win.shape[0], dtype=jnp.float64)
     seen_dot = jnp.zeros(win.shape[0], dtype=jnp.bool_)
     fdigits = jnp.zeros(win.shape[0], dtype=jnp.float64)
+    has_digit = jnp.zeros(win.shape[0], dtype=jnp.bool_)
     ok = lens > 0
     for k in range(_MAX_NUM_BYTES):
         ch = win[:, k]
@@ -897,13 +904,14 @@ def _parse_number(c: ColVal, target: DataType, ctx: EmitContext) -> ColVal:
         isd = digit[:, k]
         this_dot = dot[:, k] & ~seen_dot
         val = jnp.where(active & isd & ~seen_dot, val * 10 + d, val)
+        has_digit = has_digit | (active & isd)
         fdigits = jnp.where(active & isd & seen_dot,
                             fdigits * 10 + d.astype(jnp.float64), fdigits)
         scale = jnp.where(active & isd & seen_dot, scale + 1, scale)
         seen_dot = seen_dot | (active & dot[:, k])
         bad = active & ~isd & ~this_dot
         ok = ok & ~bad
-    ok = ok & (lens <= _MAX_NUM_BYTES) & (lens > start)
+    ok = ok & (lens <= _MAX_NUM_BYTES) & (lens > start) & has_digit
     fval = val.astype(jnp.float64) + fdigits / jnp.power(10.0, scale)
     fval = jnp.where(neg, -fval, fval)
     ival = jnp.where(neg, -val, val)
@@ -928,12 +936,200 @@ def _parse_date(c: ColVal, ctx: EmitContext) -> ColVal:
     ok = (lens == 10) & (win[:, 4] == ord("-")) & (win[:, 7] == ord("-"))
     for i in (0, 1, 2, 3, 5, 6, 8, 9):
         ok = ok & (win[:, i] >= ord("0")) & (win[:, i] <= ord("9"))
-    y = num((0, 1, 2, 3))
-    m = jnp.clip(num((5, 6)), 1, 12)
-    d = jnp.clip(num((8, 9)), 1, 31)
-    days = _days_from_civil(y.astype(jnp.int64), m.astype(jnp.int64),
-                            d.astype(jnp.int64)).astype(jnp.int32)
+    y = num((0, 1, 2, 3)).astype(jnp.int64)
+    m_raw = num((5, 6)).astype(jnp.int64)
+    d_raw = num((8, 9)).astype(jnp.int64)
+    m = jnp.clip(m_raw, 1, 12)
+    month_days = _days_from_civil(
+        jnp.where(m == 12, y + 1, y), jnp.where(m == 12, 1, m + 1),
+        jnp.ones_like(m)) - _days_from_civil(y, m, jnp.ones_like(m))
+    ok = ok & (m_raw >= 1) & (m_raw <= 12) & (d_raw >= 1) & \
+        (d_raw <= month_days)
+    days = _days_from_civil(y, m, jnp.clip(d_raw, 1, 31)).astype(jnp.int32)
     return ColVal(dts.DATE32, days, combine_validity(c.validity, ok))
+
+
+def _parse_timestamp(c: ColVal, ctx: EmitContext) -> ColVal:
+    """'yyyy-MM-dd[ HH:mm:ss[.SSSSSS]]' -> micros since epoch UTC (the
+    default-format quadrant of GpuCast.scala's string->timestamp rules;
+    zone suffixes are not accepted — the engine is UTC-only)."""
+    from spark_rapids_tpu.ops.datetime_ops import _days_from_civil
+    width = 26
+    win, lens = _row_window(c, width, ctx)
+    digits = (win - ord("0")).astype(jnp.int64)
+    isd = (win >= ord("0")) & (win <= ord("9"))
+
+    def num(sl):
+        out = jnp.zeros(win.shape[0], dtype=jnp.int64)
+        for i in sl:
+            out = out * 10 + digits[:, i]
+        return out
+
+    date_ok = (lens >= 10) & (win[:, 4] == ord("-")) & \
+        (win[:, 7] == ord("-"))
+    for i in (0, 1, 2, 3, 5, 6, 8, 9):
+        date_ok = date_ok & isd[:, i]
+    y, m, d = num((0, 1, 2, 3)), num((5, 6)), num((8, 9))
+    mc = jnp.clip(m, 1, 12)
+    # real month length: civil-day difference to the next month
+    month_days = _days_from_civil(
+        jnp.where(mc == 12, y + 1, y), jnp.where(mc == 12, 1, mc + 1),
+        jnp.ones_like(mc)) - _days_from_civil(y, mc, jnp.ones_like(mc))
+    date_ok = date_ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= month_days)
+    days = _days_from_civil(y, mc, jnp.clip(d, 1, 31))
+
+    has_time = lens >= 19
+    time_ok = (win[:, 10] == ord(" ")) | (win[:, 10] == ord("T"))
+    time_ok = time_ok & (win[:, 13] == ord(":")) & (win[:, 16] == ord(":"))
+    for i in (11, 12, 14, 15, 17, 18):
+        time_ok = time_ok & isd[:, i]
+    hh, mi, ss = num((11, 12)), num((14, 15)), num((17, 18))
+    secs = jnp.clip(hh, 0, 23) * 3600 + jnp.clip(mi, 0, 59) * 60 + \
+        jnp.clip(ss, 0, 59)
+    time_ok = time_ok & (hh <= 23) & (mi <= 59) & (ss <= 59)
+
+    # optional .fraction (1-6 digits)
+    has_frac = lens >= 21
+    frac_ok = win[:, 19] == ord(".")
+    frac = jnp.zeros(win.shape[0], dtype=jnp.int64)
+    fdig = jnp.zeros(win.shape[0], dtype=jnp.int64)
+    for i in range(20, 26):
+        in_frac = (i < lens) & isd[:, i]
+        frac = jnp.where(in_frac, frac * 10 + digits[:, i], frac)
+        fdig = fdig + in_frac.astype(jnp.int64)
+        frac_ok = frac_ok & ((i >= lens) | isd[:, i])
+    # frac has fdig digits; scale to micros: frac * 10^(6-fdig)
+    micros_frac = frac * (10 ** 6) // jnp.asarray(
+        [1, 10, 100, 1000, 10 ** 4, 10 ** 5, 10 ** 6],
+        dtype=jnp.int64)[jnp.clip(fdig, 0, 6)]
+
+    ok = date_ok & (
+        (lens == 10) |
+        ((lens == 19) & time_ok) |
+        ((lens >= 21) & (lens <= 26) & time_ok & frac_ok))
+    micros = days * 86_400_000_000 + \
+        jnp.where(has_time, secs * 1_000_000, 0) + \
+        jnp.where(has_frac, micros_frac, 0)
+    return ColVal(dts.TIMESTAMP_US, micros,
+                  combine_validity(c.validity, ok))
+
+
+_BOOL_TRUE = ("true", "t", "yes", "y", "1")
+_BOOL_FALSE = ("false", "f", "no", "n", "0")
+
+
+def _parse_bool(c: ColVal, ctx: EmitContext) -> ColVal:
+    """Spark string->boolean: true/t/yes/y/1 and false/f/no/n/0
+    (case-insensitive, whitespace-trimmed like UTF8String.trim);
+    anything else is null."""
+    width = 16
+    win, lens = _row_window(c, width, ctx)
+    ws = win <= 0x20
+    in_row = jnp.arange(width, dtype=jnp.int32)[None, :] < lens[:, None]
+    # leading whitespace count + trimmed length
+    lead = jnp.zeros(win.shape[0], dtype=jnp.int32)
+    still = jnp.ones(win.shape[0], dtype=jnp.bool_)
+    for i in range(width):
+        hit = still & ws[:, i] & in_row[:, i]
+        lead = lead + hit.astype(jnp.int32)
+        still = hit
+    trail = jnp.zeros(win.shape[0], dtype=jnp.int32)
+    for i in range(width):
+        j = jnp.clip(lens - 1 - i, 0, width - 1)
+        hit = (trail == i) & (win[jnp.arange(win.shape[0]), j] <= 0x20) & \
+            (lens - i > lead)
+        trail = trail + hit.astype(jnp.int32)
+    tlen = jnp.maximum(lens - lead - trail, 0)
+    rows = jnp.arange(win.shape[0])
+    lower = jnp.where((win >= ord("A")) & (win <= ord("Z")), win + 32, win)
+
+    def matches(word: str):
+        ok = (tlen == len(word)) & (lens <= width)
+        for i, ch in enumerate(word):
+            ok = ok & (lower[rows, jnp.clip(lead + i, 0, width - 1)] ==
+                       ord(ch))
+        return ok
+
+    is_true = jnp.zeros(win.shape[0], dtype=jnp.bool_)
+    for w in _BOOL_TRUE:
+        is_true = is_true | matches(w)
+    is_false = jnp.zeros(win.shape[0], dtype=jnp.bool_)
+    for w in _BOOL_FALSE:
+        is_false = is_false | matches(w)
+    ok = is_true | is_false
+    return ColVal(dts.BOOL, is_true, combine_validity(c.validity, ok))
+
+
+def _format_timestamp(c: ColVal, ctx: EmitContext) -> ColVal:
+    """micros -> 'yyyy-MM-dd HH:mm:ss[.ffffff]' with trailing fraction
+    zeros trimmed (Spark's cast timestamp->string)."""
+    from spark_rapids_tpu.ops.datetime_ops import _civil_from_days
+    v = c.values.astype(jnp.int64)
+    days = jnp.floor_divide(v, 86_400_000_000)
+    in_day = v - days * 86_400_000_000
+    secs = in_day // 1_000_000
+    micros = in_day - secs * 1_000_000
+    y, m, d = _civil_from_days(days)
+    hh = secs // 3600
+    mi = (secs // 60) % 60
+    ss = secs % 60
+
+    # fraction length: 0 (none) or 1-6 digits with trailing zeros cut
+    fdig = jnp.zeros(v.shape[0], dtype=jnp.int32)
+    for k in range(6, 0, -1):
+        # number of digits needed so micros % 10^(6-k) == 0
+        fdig = jnp.where((micros % (10 ** (6 - k + 1))) != 0,
+                         jnp.maximum(fdig, k), fdig)
+    lens = jnp.where(micros > 0, 20 + fdig, 19).astype(jnp.int32)
+
+    def digit_at(p, r, k):
+        # returns the BYTE for output position k of row r
+        yy = y[r]
+        out = jnp.zeros_like(p)
+
+        def dig(val, power):
+            return (val // power) % 10 + ord("0")
+
+        out = jnp.where(k == 0, dig(yy, 1000), out)
+        out = jnp.where(k == 1, dig(yy, 100), out)
+        out = jnp.where(k == 2, dig(yy, 10), out)
+        out = jnp.where(k == 3, dig(yy, 1), out)
+        out = jnp.where(k == 4, ord("-"), out)
+        out = jnp.where(k == 5, dig(m[r], 10), out)
+        out = jnp.where(k == 6, dig(m[r], 1), out)
+        out = jnp.where(k == 7, ord("-"), out)
+        out = jnp.where(k == 8, dig(d[r], 10), out)
+        out = jnp.where(k == 9, dig(d[r], 1), out)
+        out = jnp.where(k == 10, ord(" "), out)
+        out = jnp.where(k == 11, dig(hh[r], 10), out)
+        out = jnp.where(k == 12, dig(hh[r], 1), out)
+        out = jnp.where(k == 13, ord(":"), out)
+        out = jnp.where(k == 14, dig(mi[r], 10), out)
+        out = jnp.where(k == 15, dig(mi[r], 1), out)
+        out = jnp.where(k == 16, ord(":"), out)
+        out = jnp.where(k == 17, dig(ss[r], 10), out)
+        out = jnp.where(k == 18, dig(ss[r], 1), out)
+        out = jnp.where(k == 19, ord("."), out)
+        frac_pos = k - 20  # 0-based fraction digit index
+        fr = micros[r]
+        for i in range(6):
+            out = jnp.where(frac_pos == i,
+                            dig(fr, 10 ** (5 - i)), out)
+        return out
+
+    # build via a byte pool trick: we need computed bytes, not copied
+    # bytes, so build offsets/chars directly
+    offsets = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                               jnp.cumsum(lens, dtype=jnp.int32)])
+    out_cap = _next_pow2(26 * ctx.capacity)
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets, pos, side="right") - 1,
+                   0, ctx.capacity - 1)
+    k = pos - offsets[row]
+    total = offsets[ctx.capacity]
+    chars = jnp.where(pos < total, digit_at(pos, row, k),
+                      0).astype(jnp.uint8)
+    return ColVal(dts.STRING, chars, c.validity, offsets)
 
 
 def _format_int(c: ColVal, ctx: EmitContext) -> ColVal:

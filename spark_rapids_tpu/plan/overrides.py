@@ -161,7 +161,15 @@ class ExprMeta(BaseMeta):
             self.child_metas = [ExprMeta(expr.func.child, conf)]
 
     def tag(self) -> None:
+        from spark_rapids_tpu.ops.cast import cast_supported
         expr = self.wrapped
+        if isinstance(expr, Cast):
+            try:
+                reason = cast_supported(expr.child.dtype, expr.target)
+                if reason:
+                    self.will_not_work(reason)
+            except (RuntimeError, TypeError, ValueError):
+                pass
         if isinstance(expr, C.CreateArray) and any(
                 c.nullable for c in expr.children):
             self.will_not_work(

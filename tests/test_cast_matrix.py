@@ -1,0 +1,142 @@
+"""Cast matrix additions: string<->timestamp/boolean, ANSI mode, and
+plan-time tagging of unsupported casts (GpuCast.scala analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_string_to_timestamp(session):
+    vals = ["2021-09-15 10:30:05", "2021-09-15", "2021-09-15T23:59:59",
+            "bad", None, "2021-09-15 10:30:05.25",
+            "1969-12-31 23:59:59", "2021-09-15 25:00:00"]
+    df = session.create_dataframe({"s": vals})
+    out = df.select(F.col("s").cast("timestamp").alias("t")).to_pandas()
+    want = [pd.Timestamp("2021-09-15 10:30:05", tz="UTC"),
+            pd.Timestamp("2021-09-15", tz="UTC"),
+            pd.Timestamp("2021-09-15 23:59:59", tz="UTC"),
+            None, None,
+            pd.Timestamp("2021-09-15 10:30:05.250000", tz="UTC"),
+            pd.Timestamp("1969-12-31 23:59:59", tz="UTC"),
+            None]
+    for g, w in zip(out["t"], want):
+        if w is None:
+            assert pd.isna(g)
+        else:
+            assert g == w, (g, w)
+
+
+def test_timestamp_to_string(session):
+    ts = pd.to_datetime(["2021-09-15 10:30:05",
+                         "2021-01-02 00:00:00.123456",
+                         "1969-12-31 23:59:59",
+                         "2021-01-02 00:00:00.100000"], format="mixed")
+    df = session.create_dataframe({"t": ts})
+    out = df.select(F.col("t").cast("string").alias("s")).to_pandas()["s"]
+    assert out.tolist() == ["2021-09-15 10:30:05",
+                            "2021-01-02 00:00:00.123456",
+                            "1969-12-31 23:59:59",
+                            "2021-01-02 00:00:00.1"]
+
+
+def test_string_to_boolean(session):
+    vals = ["true", "FALSE", "T", "n", "YES", "0", "1", "x", "", None]
+    df = session.create_dataframe({"s": vals})
+    out = df.select(F.col("s").cast("boolean").alias("b")).to_pandas()["b"]
+    want = [True, False, True, False, True, False, True, None, None, None]
+    for g, w in zip(out, want):
+        if w is None:
+            assert pd.isna(g)
+        else:
+            assert bool(g) == w
+
+
+def test_ansi_cast_raises_and_plain_nulls(session):
+    df = session.create_dataframe({"s": ["12", "oops", None]})
+    out = df.select(F.col("s").cast("int").alias("i")).to_pandas()["i"]
+    assert out[0] == 12 and pd.isna(out[1]) and pd.isna(out[2])
+    with pytest.raises(ArithmeticError, match="invalid input"):
+        df.select(F.col("s").cast("int", ansi=True).alias("i")).collect()
+    # null inputs never raise in ansi mode
+    ok = session.create_dataframe({"s": ["3", None]})
+    got = ok.select(F.col("s").cast("int", ansi=True).alias("i")).collect()
+    assert got[0][0] == 3
+
+
+def test_ansi_float_to_int_overflow(session):
+    df = session.create_dataframe({"x": [1.5, 3e10]})
+    out = df.select(F.col("x").cast("int").alias("i")).to_pandas()["i"]
+    assert out[0] == 1 and out[1] == (1 << 31) - 1  # saturates non-ansi
+    with pytest.raises(ArithmeticError, match="overflow"):
+        df.select(F.col("x").cast("int", ansi=True).alias("i")).collect()
+
+
+def test_unsupported_cast_tags_off_and_falls_back(session):
+    df = session.create_dataframe({"x": [1.5, 2.0]})
+    q = df.select(F.col("x").cast("string").alias("s"))
+    tree = session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree  # float->string: host formatting
+    assert q.to_pandas()["s"].tolist() == ["1.5", "2.0"]
+
+
+def test_invalid_dates_reject_not_clip(session):
+    """Out-of-range month/day must be null (regression: the parser used
+    to clip 2021-13-45 into a valid date)."""
+    vals = ["2021-13-01", "2021-02-30", "2021-00-10", "2021-04-31",
+            "2020-02-29", "2021-02-28", "2021-12-31"]
+    df = session.create_dataframe({"s": vals})
+    out = df.select(F.col("s").cast("date").alias("d"),
+                    F.col("s").cast("timestamp").alias("t")).to_pandas()
+    for i in range(4):
+        assert pd.isna(out["d"][i]), vals[i]
+        assert pd.isna(out["t"][i]), vals[i]
+    for i in range(4, 7):
+        assert not pd.isna(out["d"][i]), vals[i]
+        assert not pd.isna(out["t"][i]), vals[i]
+
+
+def test_bool_parse_trims_whitespace(session):
+    vals = [" true", "false  ", "  Y ", " x "]
+    df = session.create_dataframe({"s": vals})
+    out = df.select(F.col("s").cast("boolean").alias("b")).to_pandas()["b"]
+    assert bool(out[0]) is True and bool(out[1]) is False
+    assert bool(out[2]) is True and pd.isna(out[3])
+
+
+def test_ansi_cast_in_filter_raises(session):
+    """ANSI checks surface through the fused filter stage too."""
+    df = session.create_dataframe({"s": ["5", "bad"]})
+    q = df.filter(F.col("s").cast("int", ansi=True) > 1)
+    with pytest.raises(ArithmeticError, match="invalid input"):
+        q.collect()
+
+
+def test_ansi_fractional_in_range_ok(session):
+    """cast(127.6 as tinyint, ansi) truncates to 127 — not an overflow."""
+    df = session.create_dataframe({"x": [127.6, -128.9]})
+    out = df.select(F.col("x").cast("tinyint", ansi=True).alias("i")) \
+        .to_pandas()["i"]
+    assert out.tolist() == [127, -128]
+    with pytest.raises(ArithmeticError, match="overflow"):
+        session.create_dataframe({"x": [128.1]}).select(
+            F.col("x").cast("tinyint", ansi=True).alias("i")).collect()
+
+
+def test_fallback_cast_handles_inf(session):
+    """Infinities must not crash the CPU fallback (regression:
+    OverflowError from int(inf)).  NaN doubles become null on the
+    fallback path — pandas cannot distinguish NaN-the-value from null,
+    a documented fallback limitation."""
+    df = session.create_dataframe({"x": [float("inf"), float("-inf"),
+                                         float("nan"), 2.5]})
+    out = df.select(F.col("x").cast("string").alias("s")).to_pandas()["s"]
+    assert out[0] == "Infinity" and out[1] == "-Infinity"
+    assert out[3] == "2.5"
