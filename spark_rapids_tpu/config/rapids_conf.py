@@ -150,6 +150,15 @@ DECIMAL_ENABLED = conf(
     "Enable decimal (DECIMAL_64) processing "
     "(reference RapidsConf.scala:564).", _to_bool)
 
+INCOMPAT_ENABLED = conf(
+    "spark.rapids.sql.incompatibleOps.enabled", True,
+    "Run operators whose semantics differ from CPU Spark in documented "
+    "corner cases (ASCII-only case mapping, byte-semantics regex). The "
+    "reference defaults this OFF (RapidsMeta.scala:271); this engine "
+    "defaults ON because each incompat is individually documented and "
+    "per-op keys (spark.rapids.sql.expression.<Name>) can disable any "
+    "single one.", _to_bool)
+
 IMPROVED_FLOAT_OPS = conf(
     "spark.rapids.sql.improvedFloatOps.enabled", False,
     "Allow float ops whose results may differ from CPU beyond 1-ulp.",
@@ -236,11 +245,48 @@ METRICS_LEVEL = conf(
     "must be ESSENTIAL, MODERATE or DEBUG")
 
 
+# dynamic per-op enable keys (confKey wiring, GpuOverrides.scala:204-296):
+# spark.rapids.sql.expression.<Name> / spark.rapids.sql.exec.<Name>
+_DYNAMIC_PREFIXES = ("spark.rapids.sql.expression.",
+                     "spark.rapids.sql.exec.")
+
+
+def _known_key(key: str) -> bool:
+    if key in _REGISTRY:
+        return True
+    for p in _DYNAMIC_PREFIXES:
+        if key.startswith(p):
+            suffix = key[len(p):]
+            try:  # lazy: the planner imports this module
+                from spark_rapids_tpu.plan.overrides import valid_op_names
+                return suffix in valid_op_names()
+            except ImportError:
+                return True
+    return False
+
+
 class RapidsConf:
-    """Immutable snapshot view over a settings dict (RapidsConf.scala:1281)."""
+    """Immutable snapshot view over a settings dict (RapidsConf.scala:1281).
+
+    Unknown ``spark.rapids.*`` keys are rejected at construction — a typo
+    in a tuning knob must fail loudly, not silently no-op.  Non-rapids
+    keys (e.g. ``spark.sql.*`` passthroughs) are kept untouched."""
 
     def __init__(self, settings: Optional[Dict[str, str]] = None):
         self.settings = dict(settings or {})
+        for k in self.settings:
+            if k.startswith("spark.rapids.") and not _known_key(k):
+                raise ValueError(
+                    f"unknown configuration key {k!r}; see "
+                    "RapidsConf.registry() for available keys")
+
+    def op_enabled(self, kind: str, name: str) -> bool:
+        """Per-op enable key: spark.rapids.sql.<kind>.<Name>, default
+        True (the reference derives one such key per replacement rule)."""
+        raw = self.settings.get(f"spark.rapids.sql.{kind}.{name}")
+        if raw is None:
+            return True
+        return raw if isinstance(raw, bool) else _to_bool(str(raw))
 
     def get(self, entry: ConfEntry) -> Any:
         return entry.get(self.settings)

@@ -116,6 +116,41 @@ def _eval_pandas(expr, df: pd.DataFrame):
                               else re.escape(ch) for ch in e.pattern)
         child = _eval_pandas(e.child, df)
         return child.str.match(rx + r"\Z", na=False)
+    if isinstance(e, S.Upper):
+        # full-Unicode semantics on the CPU path: the device op is
+        # ASCII-only (its incompat flag), and the fallback exists
+        # precisely to provide CPU Spark behavior
+        child = _eval_pandas(e.child, df)
+        return child.map(lambda v: None if _isnull(v) else v.upper())
+    if isinstance(e, S.Lower):
+        child = _eval_pandas(e.child, df)
+        return child.map(lambda v: None if _isnull(v) else v.lower())
+    if isinstance(e, S.InitCap):
+        child = _eval_pandas(e.child, df)
+        def initcap(v):
+            out = []
+            prev_space = True
+            for ch in v:
+                out.append(ch.upper() if prev_space else ch.lower())
+                prev_space = ch == " "
+            return "".join(out)
+        return child.map(lambda v: None if _isnull(v) else initcap(v))
+    if isinstance(e, (S.StringTrim, S.StringTrimLeft, S.StringTrimRight)):
+        child = _eval_pandas(e.child, df)
+        fn = {"StringTrim": lambda v: v.strip(" "),
+              "StringTrimLeft": lambda v: v.lstrip(" "),
+              "StringTrimRight": lambda v: v.rstrip(" ")}[
+                  type(e).__name__]
+        return child.map(lambda v: None if _isnull(v) else fn(v))
+    if isinstance(e, S.Length):
+        child = _eval_pandas(e.child, df)
+        return child.map(lambda v: None if _isnull(v) else len(v))
+    if isinstance(e, (S.StartsWith, S.EndsWith, S.Contains)):
+        child = _eval_pandas(e.child, df)
+        fn = {"StartsWith": str.startswith, "EndsWith": str.endswith,
+              "Contains": str.__contains__}[type(e).__name__]
+        return child.map(lambda v: None if _isnull(v)
+                         else fn(v, e.pattern))
     from spark_rapids_tpu.ops import regexops as RX
     if isinstance(e, RX.RLike):
         import re

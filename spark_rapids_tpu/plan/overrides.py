@@ -27,17 +27,21 @@ from spark_rapids_tpu.plan.logical import AggregateExpression
 
 class ExprRule:
     def __init__(self, cls: Type[Expression], sig: ts.TypeSig,
-                 note: str = ""):
+                 note: str = "", incompat: str = ""):
         self.cls = cls
         self.sig = sig
         self.note = note
+        # non-empty = documented semantics difference vs CPU Spark; runs
+        # only when spark.rapids.sql.incompatibleOps.enabled
+        # (RapidsMeta.scala:271 incompat tier)
+        self.incompat = incompat
 
 
 _EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
 
 
-def expr_rule(cls, sig=ts.COMMON, note=""):
-    _EXPR_RULES[cls] = ExprRule(cls, sig, note)
+def expr_rule(cls, sig=ts.COMMON, note="", incompat=""):
+    _EXPR_RULES[cls] = ExprRule(cls, sig, note, incompat)
 
 
 # leaves / structural
@@ -52,12 +56,14 @@ expr_rule(WindowExpression)
 # strings (stringFunctions.scala analog)
 from spark_rapids_tpu.ops import stringops as S  # noqa: E402
 
-for c in (S.Length, S.OctetLength, S.Upper, S.Lower, S.InitCap,
-          S.StartsWith, S.EndsWith, S.Contains, S.Like, S.EqualsLiteral,
-          S.StringLocate, S.Substring, S.StringTrim, S.StringTrimLeft,
-          S.StringTrimRight, S.ConcatStrings, S.StringRepeat, S.StringLPad,
-          S.StringRPad, S.SubstringIndex):
+for c in (S.Length, S.OctetLength, S.StartsWith, S.EndsWith, S.Contains,
+          S.Like, S.EqualsLiteral, S.StringLocate, S.Substring,
+          S.StringTrim, S.StringTrimLeft, S.StringTrimRight,
+          S.ConcatStrings, S.StringRepeat, S.StringLPad, S.StringRPad,
+          S.SubstringIndex):
     expr_rule(c, ts.COMMON)
+for c in (S.Upper, S.Lower, S.InitCap):
+    expr_rule(c, ts.COMMON, incompat="ASCII-only case mapping")
 
 # date/time (datetimeExpressions.scala analog)
 from spark_rapids_tpu.ops import datetime_ops as D  # noqa: E402
@@ -88,9 +94,11 @@ for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
 # reference's incompat flag)
 from spark_rapids_tpu.ops import regexops as RX  # noqa: E402
 
-for c in (RX.RLike, RX.RegExpReplace, RX.StringReplace, RX.ConcatWs,
-          RX.Translate, RX.SplitPart):
+for c in (RX.StringReplace, RX.ConcatWs, RX.Translate):
     expr_rule(c, ts.COMMON)
+for c in (RX.RLike, RX.RegExpReplace, RX.SplitPart):
+    expr_rule(c, ts.COMMON,
+              incompat="byte-semantics regex ('.' matches one byte)")
 
 # collections (collectionOperations.scala + complexType rules analog)
 from spark_rapids_tpu.ops import collections_ops as C  # noqa: E402
@@ -163,6 +171,19 @@ class ExprMeta(BaseMeta):
     def tag(self) -> None:
         from spark_rapids_tpu.ops.cast import cast_supported
         expr = self.wrapped
+        name = type(expr).__name__
+        if not self.conf.op_enabled("expression", name):
+            self.will_not_work(
+                f"expression {name} disabled by "
+                f"spark.rapids.sql.expression.{name}")
+        rule = _EXPR_RULES.get(type(expr))
+        if rule is not None and rule.incompat:
+            from spark_rapids_tpu.config.rapids_conf import INCOMPAT_ENABLED
+            if not self.conf.get(INCOMPAT_ENABLED):
+                self.will_not_work(
+                    f"{name} is incompatible with CPU Spark "
+                    f"({rule.incompat}) and "
+                    "spark.rapids.sql.incompatibleOps.enabled is false")
         if isinstance(expr, Cast):
             try:
                 reason = cast_supported(expr.child.dtype, expr.target)
@@ -195,10 +216,9 @@ class ExprMeta(BaseMeta):
             for c in self.child_metas:
                 c.tag()
             return
-        rule = _EXPR_RULES.get(type(expr))
         if rule is None:
             self.will_not_work(
-                f"expression {type(expr).__name__} has no TPU implementation")
+                f"expression {name} has no TPU implementation")
         else:
             try:
                 dt = expr.dtype
@@ -229,6 +249,10 @@ class PlanMeta(BaseMeta):
 
     def tag(self) -> None:
         node = self.wrapped
+        if not self.conf.op_enabled("exec", type(node).__name__):
+            self.will_not_work(
+                f"{type(node).__name__} disabled by "
+                f"spark.rapids.sql.exec.{type(node).__name__}")
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")
@@ -257,9 +281,11 @@ class PlanMeta(BaseMeta):
         for em in self.expr_metas:
             em.tag()
             if not em.can_replace:
+                deep = _deep_reasons(em)
+                detail = "; ".join(deep) if deep else "unsupported"
                 self.will_not_work(
                     f"expression {type(em.wrapped).__name__} cannot run on "
-                    f"TPU")
+                    f"TPU: {detail}")
         for c in self.child_metas:
             c.tag()
 
@@ -269,6 +295,15 @@ class PlanMeta(BaseMeta):
             if em.reasons:
                 lines.extend(em.explain_lines(depth + 1, False))
         return lines
+
+
+def _deep_reasons(meta: BaseMeta) -> List[str]:
+    """All will-not-work reasons in an expression meta tree (the inner
+    reason, e.g. a per-op disable, is what the user needs to see)."""
+    out = list(meta.reasons)
+    for c in meta.child_metas:
+        out.extend(_deep_reasons(c))
+    return out
 
 
 def _node_expressions(plan: L.LogicalPlan) -> List[Expression]:
@@ -644,3 +679,12 @@ class TpuOverrides:
         return _plan_aggregate(
             group, aggs, base, pre_filter=cond,
             merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS))
+
+
+def valid_op_names():
+    """Known per-op conf suffixes: expression class names + plan node
+    names (consumed by RapidsConf's unknown-key validation)."""
+    exprs = {c.__name__ for c in _EXPR_RULES}
+    execs = {c.__name__ for c in _PLAN_CONVERTERS}
+    # logical node names double as exec keys (Sort, Join, ...)
+    return exprs | execs | {"WindowExpression"}

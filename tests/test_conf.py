@@ -1,0 +1,79 @@
+"""Config completeness: unknown-key rejection, per-op enable keys,
+incompat tier (RapidsConf.scala + RapidsMeta.scala:271 analogs)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.config.rapids_conf import RapidsConf
+
+
+def test_unknown_rapids_key_rejected():
+    with pytest.raises(ValueError, match="unknown configuration key"):
+        RapidsConf({"spark.rapids.sql.batchSizeByts": "1024"})  # typo
+    # non-rapids keys pass through untouched
+    RapidsConf({"spark.sql.shuffle.partitions": "8"})
+
+
+def test_per_expression_disable():
+    s = TpuSession({"spark.rapids.sql.expression.Upper": "false"})
+    df = s.create_dataframe({"x": ["ab"]})
+    q = df.select(F.upper("x").alias("u"))
+    tree = s.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert "disabled by spark.rapids.sql.expression.Upper" in \
+        s.overrides.last_explain
+    # still enabled by default
+    s2 = TpuSession()
+    assert "CpuFallbackExec" not in s2.plan(q.plan).tree_string()
+
+
+def test_per_exec_disable():
+    s = TpuSession({"spark.rapids.sql.exec.Sort": "false"})
+    df = s.create_dataframe({"x": [3, 1, 2]})
+    tree = s.plan(df.orderBy("x").plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert df.orderBy("x").to_pandas()["x"].tolist() == [1, 2, 3]
+
+
+def test_incompat_tier():
+    s = TpuSession({"spark.rapids.sql.incompatibleOps.enabled": "false"})
+    df = s.create_dataframe({"x": ["ab1"]})
+    # regex ops are incompat-flagged (byte-semantics)
+    q = df.select(F.rlike("x", r"\d").alias("m"))
+    tree = s.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert "incompatible" in s.overrides.last_explain
+    assert bool(q.to_pandas()["m"][0])  # fallback still correct
+    # default: runs on device
+    s2 = TpuSession()
+    assert "CpuFallbackExec" not in s2.plan(q.plan).tree_string()
+
+
+def test_conf_docs_generate():
+    from spark_rapids_tpu.config.rapids_conf import RapidsConf
+    reg = RapidsConf.registry()
+    assert len(reg) >= 25
+    assert "spark.rapids.sql.incompatibleOps.enabled" in reg
+
+
+def test_per_op_key_typo_rejected():
+    with pytest.raises(ValueError, match="unknown configuration key"):
+        RapidsConf({"spark.rapids.sql.expression.Uppr": "false"})
+
+
+def test_window_expression_disable_honored():
+    s = TpuSession(
+        {"spark.rapids.sql.expression.WindowExpression": "false"})
+    df = s.create_dataframe({"g": [1, 1, 2], "x": [3.0, 1.0, 2.0]})
+    q = df.select("g", F.row_number().over(
+        F.Window.partitionBy("g").orderBy("x")).alias("rn"))
+    tree = s.plan(q.plan).tree_string()
+    assert "TpuWindowExec" not in tree
+
+
+def test_incompat_fallback_uses_unicode_semantics():
+    s = TpuSession({"spark.rapids.sql.incompatibleOps.enabled": "false"})
+    df = s.create_dataframe({"x": ["straße", "café"]})
+    out = df.select(F.upper("x").alias("u")).to_pandas()["u"]
+    assert out.tolist() == ["STRASSE", "CAFÉ"]
