@@ -11,6 +11,73 @@ from spark_rapids_tpu.ops.expressions import (
 from spark_rapids_tpu.plan import logical as L
 
 
+class PivotedGroupedData:
+    """Pivot rewrite: each aggregate over x becomes, per pivot value v,
+    the same aggregate over IF(p == v, x, NULL) — the standard pivot
+    lowering (nulls are ignored by every aggregate), so no new kernel is
+    needed and the result matches GpuPivotFirst."""
+
+    def __init__(self, df: DataFrame, group_exprs, pivot_expr, values):
+        self.df = df
+        self.group_exprs = group_exprs
+        self.pivot_expr = pivot_expr
+        self.values = values
+
+    def agg(self, *aggs: "Col") -> DataFrame:
+        import copy
+        from spark_rapids_tpu.ops import predicates as preds
+        from spark_rapids_tpu.ops.expressions import Alias, Literal
+        from spark_rapids_tpu.plan.logical import AggregateExpression
+        agg_exprs = [_expr(a) for a in aggs]
+        out: List[Expression] = []
+        for v in self.values:
+            for e in agg_exprs:
+                alias = e.alias if isinstance(e, Alias) else None
+                inner = e.children[0] if isinstance(e, Alias) else e
+                if not isinstance(inner, AggregateExpression):
+                    raise ValueError("pivot aggregates must be aggregate "
+                                     "expressions")
+                func = copy.copy(inner.func)
+                # CASE WHEN p == v THEN x END (implicit null else): every
+                # aggregate ignores nulls, realizing the pivot.  count()
+                # has no child: count rows where p == v via CASE -> 1.
+                cond = preds.EqualTo(self.pivot_expr, Literal(v))
+                if func.child is not None:
+                    child_name = func.child.name
+                    func.child = preds.CaseWhen([(cond, func.child)])
+                else:
+                    child_name = "*"
+                    func.child = preds.CaseWhen([(cond, Literal(1))])
+                if len(agg_exprs) == 1:
+                    name = str(v)
+                else:
+                    name = f"{v}_{alias}" if alias else \
+                        f"{v}_{func.name}({child_name})"
+                out.append(Alias(AggregateExpression(func), name))
+        return DataFrame(self.df.session, L.Aggregate(
+            self.group_exprs, out, self.df.plan))
+
+    def sum(self, c) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.sum(c))
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.count(self.pivot_expr))
+
+    def min(self, c) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.min(c))
+
+    def max(self, c) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.max(c))
+
+    def avg(self, c) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+        return self.agg(F.avg(c))
+
+
 def _parse_schema(schema):
     """'a int, b string' or [(name, DataType)] -> Schema."""
     from spark_rapids_tpu.columnar.dtypes import dtype_from_name
@@ -48,6 +115,9 @@ class DataFrame:
         gen = self._route_generate(exprs)
         if gen is not None:
             return gen
+        routed = self._route_batch_ids(exprs)
+        if routed is not None:
+            return routed
         win = [(i, e) for i, e in enumerate(exprs) if _is_window(e)]
         if win:
             # route window expressions through a Window node, then project
@@ -92,6 +162,36 @@ class DataFrame:
         return DataFrame(self.session, L.Generate(
             m.child, required, m.position, self.plan, col_name=col_name))
 
+    def _route_batch_ids(self, exprs) -> Optional["DataFrame"]:
+        """monotonically_increasing_id()/spark_partition_id() need batch
+        state: insert a BatchId node and rewrite markers to its columns."""
+        from spark_rapids_tpu.ops.misc_exprs import _BatchIdMarker
+
+        def rewrite(e):
+            if isinstance(e, _BatchIdMarker):
+                return UnresolvedColumn(
+                    "__mid" if e.kind == "mid" else "__pid")
+            if not e.children:
+                return e
+            return e.with_children([rewrite(c) for c in e.children])
+
+        def has_marker(e):
+            if isinstance(e, _BatchIdMarker):
+                return True
+            return any(has_marker(c) for c in e.children)
+
+        if not any(has_marker(e) for e in exprs):
+            return None
+        base = L.BatchId(self.plan)
+        out = []
+        for e in exprs:
+            r = rewrite(e)
+            if isinstance(r, UnresolvedColumn) and r.col_name in (
+                    "__mid", "__pid"):
+                r = Alias(r, e.name)
+            out.append(r)
+        return DataFrame(self.session, L.Project(out, base))
+
     def filter(self, condition: Col) -> "DataFrame":
         return DataFrame(self.session, L.Filter(_expr(condition), self.plan))
 
@@ -130,17 +230,67 @@ class DataFrame:
     def agg(self, *aggs: Col) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
-    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
-             how: str = "inner") -> "DataFrame":
-        keys = [on] if isinstance(on, str) else list(on)
+    def join(self, other: "DataFrame", on, how: str = "inner"
+             ) -> "DataFrame":
         how = {"left_outer": "left", "right_outer": "right",
                "outer": "full", "full_outer": "full", "leftsemi": "semi",
                "left_semi": "semi", "leftanti": "anti",
                "left_anti": "anti"}.get(how, how)
-        lk = [UnresolvedColumn(k) for k in keys]
-        rk = [UnresolvedColumn(k) for k in keys]
+        if isinstance(on, (str,)) or (isinstance(on, (list, tuple)) and
+                                      all(isinstance(k, str) for k in on)):
+            keys = [on] if isinstance(on, str) else list(on)
+            lk = [UnresolvedColumn(k) for k in keys]
+            rk = [UnresolvedColumn(k) for k in keys]
+            return DataFrame(self.session, L.Join(
+                self.plan, other.plan, lk, rk, how, using=keys))
+        # expression join condition: split equi conjuncts (left-col ==
+        # right-col) into hash-join keys, the rest into a residual
+        # condition (GpuHashJoin equi extraction; pure-residual inner
+        # joins become nested-loop = cross + filter)
+        cond = _expr(on)
+        lnames = {n for n, _ in self.plan.schema}
+        rnames = {n for n, _ in other.plan.schema}
+        dup = lnames & rnames
+        if dup:
+            raise ValueError(
+                f"expression joins need distinct column names on the two "
+                f"sides; duplicated: {sorted(dup)}")
+        from spark_rapids_tpu.ops import predicates as preds
+
+        def conjuncts(e):
+            if isinstance(e, preds.And):
+                return conjuncts(e.children[0]) + conjuncts(e.children[1])
+            return [e]
+
+        def side_of(e):
+            refs = set(e.references())
+            if refs and refs <= lnames:
+                return "l"
+            if refs and refs <= rnames:
+                return "r"
+            return None
+
+        lk, rk, residual = [], [], []
+        for c in conjuncts(cond):
+            if isinstance(c, preds.EqualTo):
+                a, b = c.children
+                sa, sb = side_of(a), side_of(b)
+                if sa == "l" and sb == "r":
+                    lk.append(a)
+                    rk.append(b)
+                    continue
+                if sa == "r" and sb == "l":
+                    lk.append(b)
+                    rk.append(a)
+                    continue
+            residual.append(c)
+        condition = None
+        if residual:
+            condition = residual[0]
+            for c in residual[1:]:
+                condition = preds.And(condition, c)
         return DataFrame(self.session, L.Join(
-            self.plan, other.plan, lk, rk, how, using=keys))
+            self.plan, other.plan, lk, rk, how, condition=condition))
 
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session, L.Join(
@@ -281,6 +431,14 @@ class GroupedData:
     def count(self) -> DataFrame:
         from spark_rapids_tpu.api import functions as F
         return self.agg(F.count().alias("count"))
+
+    def pivot(self, col, values) -> "PivotedGroupedData":
+        """df.groupBy(k).pivot(p, [v1, v2]).sum(x): one output column per
+        pivot value (GpuPivotFirst, AggregateFunctions.scala:530).
+        Values must be listed explicitly (Spark's implicit distinct-scan
+        variant needs an extra query)."""
+        return PivotedGroupedData(self.df, self.group_exprs, _expr(col),
+                                  list(values))
 
     def applyInPandas(self, fn, schema) -> DataFrame:
         names = [e.name for e in self.group_exprs]

@@ -298,6 +298,14 @@ def first(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.First(_expr(c), ignore_nulls)))
 
 
+def collect_list(c) -> Col:
+    return Col(AggregateExpression(agg.CollectList(_expr(c))))
+
+
+def collect_set(c) -> Col:
+    return Col(AggregateExpression(agg.CollectSet(_expr(c))))
+
+
 def last(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.Last(_expr(c), ignore_nulls)))
 
@@ -759,3 +767,25 @@ class _SplitCol(Col):
 
 def split(c, pattern: str) -> _SplitCol:
     return _SplitCol(_expr(c), pattern)
+
+
+# ---------------------------------------------------------------- misc ids --
+
+def hash(*cols) -> Col:  # noqa: A001 - Spark calls it hash()
+    from spark_rapids_tpu.ops.misc_exprs import Murmur3Hash
+    return Col(Murmur3Hash(*[_expr(c) for c in cols]))
+
+
+def md5(c) -> Col:
+    from spark_rapids_tpu.ops.misc_exprs import Md5
+    return Col(Md5(_expr(c)))
+
+
+def monotonically_increasing_id() -> Col:
+    from spark_rapids_tpu.ops.misc_exprs import _BatchIdMarker
+    return Col(_BatchIdMarker("mid"))
+
+
+def spark_partition_id() -> Col:
+    from spark_rapids_tpu.ops.misc_exprs import _BatchIdMarker
+    return Col(_BatchIdMarker("pid"))

@@ -102,11 +102,25 @@ class TpuHashAggregateExec(TpuExec):
         self._register_metric(CONCAT_TIME)
 
         self._in_dtypes = [dt for _, dt in child.schema]
+        self._single_pass = any(getattr(f, "single_pass", False)
+                                for f in self.funcs)
         self._string_key_idx = [i for i, e in enumerate(self.group_exprs)
                                 if e.dtype.is_string]
         self._encoders = {i: _StringKeyEncoder()
                           for i in self._string_key_idx}
 
+        if self._single_pass:
+            # collect aggregates: one grouped pass over the concatenated
+            # input (no partial/merge pipeline); jitted kernel below
+            from spark_rapids_tpu.ops.jit_cache import cached_jit
+            sig = ("agg_single_pass",
+                   tuple(dt.name for dt in self._in_dtypes),
+                   tuple(e.cache_key() for e in self.group_exprs),
+                   tuple(f.cache_key() for f in self.funcs),
+                   self.pre_filter.cache_key()
+                   if self.pre_filter is not None else None)
+            self._single_fn = cached_jit(sig, lambda: self._single_kernel)
+            return
         # buffer layout: per func, a slice of the flat buffer-column list
         self._buf_specs: List[agg.BufferSpec] = []
         self._buf_slices: List[slice] = []
@@ -337,7 +351,109 @@ class TpuHashAggregateExec(TpuExec):
                 catalog.register(ColumnarBatch(dict(zip(names, cols)), n)))
         return handles
 
+    def _single_kernel(self, flat_cols, nrows):
+        """Grouped pass mixing collect arrays with regular reductions."""
+        capacity = capacity_of(flat_cols)
+        inputs = flat_to_colvals(flat_cols, self._in_dtypes)
+        ctx = EmitContext(inputs, nrows, capacity)
+        row_mask = None
+        if self.pre_filter is not None:
+            pred = self.pre_filter.emit(ctx)
+            keep = pred.values
+            if pred.validity is not None:
+                keep = jnp.logical_and(keep, pred.validity)
+            row_mask = jnp.logical_and(keep, ctx.row_mask())
+        keys = [e.emit(ctx) for e in self.group_exprs]
+        keyless = not keys
+        if keyless:
+            # constant key -> exactly one group over the live rows; the
+            # key column is dropped from the output below
+            keys = [ColVal(dts.INT64,
+                           jnp.zeros(capacity, dtype=jnp.int64))]
+        collect_inputs = []
+        buffer_inputs = []
+        layout = []  # ("collect", idx) | ("buf", slice) per func
+        for f in self.funcs:
+            c = f.child.emit(ctx) if f.child is not None else None
+            if c is not None and getattr(c.values, "ndim", 0) == 0 and                     c.offsets is None:
+                c = ColVal(c.dtype,
+                           jnp.broadcast_to(c.values, (capacity,)),
+                           c.validity)
+            if getattr(f, "single_pass", False):
+                layout.append(("collect", len(collect_inputs)))
+                collect_inputs.append((c, f.dedup))
+            else:
+                start = len(buffer_inputs)
+                for spec, cv in zip(f.buffers(),
+                                    f.update_inputs(c, capacity)):
+                    buffer_inputs.append((spec.kind, cv))
+                layout.append(("buf", slice(start, len(buffer_inputs))))
+        out_keys, out_bufs, collects, n = agg.groupby_collect(
+            keys, collect_inputs, nrows, capacity,
+            buffer_inputs=buffer_inputs, row_mask=row_mask)
+        if keyless:
+            out_keys = []
+        results = []
+        for f, (kind, ref) in zip(self.funcs, layout):
+            if kind == "collect":
+                results.append(collects[ref])
+            else:
+                results.append(f.finalize(out_bufs[ref]))
+        outs = list(out_keys) + results
+        return ([(o.values, o.validity, o.offsets) for o in outs], n)
+
+    def _single_pass_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.spill import default_catalog
+        catalog = default_catalog()
+        handles = []
+        for b in self.child.execute():
+            self.metrics[NUM_INPUT_ROWS] += b.nrows
+            self.metrics[NUM_INPUT_BATCHES] += 1
+            handles.append(catalog.register(b))
+        if not handles:
+            if self.group_exprs:
+                return
+            # Spark keyless aggregation of empty input is ONE row:
+            # empty arrays for collects, identity for the rest
+            yield self._keyless_empty_result()
+            return
+        batches = [h.materialize() for h in handles]
+        with self.timer(CONCAT_TIME):
+            merged = concat_batches(batches)
+        for h in handles:
+            h.close()
+        with self.timer(AGG_TIME):
+            out_flat, n = self._single_fn(batch_to_flat(merged),
+                                          jnp.int32(merged.nrows))
+            n = int(n)
+        if n == 0 and not self.group_exprs:
+            yield self._keyless_empty_result()
+            return
+        names = [nm for nm, _ in self.schema]
+        dtypes = [dt for _, dt in self.schema]
+        outs = [ColVal(dt, v, val, offs)
+                for dt, (v, val, offs) in zip(dtypes, out_flat)]
+        cols = colvals_to_columns(outs, n, merged.capacity)
+        yield ColumnarBatch(dict(zip(names, cols)), n)
+
+    def _keyless_empty_result(self) -> ColumnarBatch:
+        cols = {}
+        for (name, dt), f in zip(self.schema, self.funcs):
+            if getattr(f, "single_pass", False):
+                cols[name] = Column.from_arrays([[]], dt.element)
+            elif f.name == "count":
+                cols[name] = Column.from_numpy(
+                    np.zeros(1, dtype=np.int64), dtype=dts.INT64)
+            else:
+                cols[name] = Column.from_numpy(
+                    np.zeros(1, dtype=dt.storage), dtype=dt,
+                    validity=np.array([False]))
+        return ColumnarBatch(cols, 1)
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self._single_pass:
+            yield from self._single_pass_execute()
+            return
         from spark_rapids_tpu.memory.spill import default_catalog
         catalog = default_catalog()
         # cache partials as spillable batches (the reference caches

@@ -219,6 +219,12 @@ def _eval_pandas(expr, df: pd.DataFrame):
         return pd.Series([
             e.sep.join(str(v) for v in row if not _isnull(v))
             for row in zip(*parts)])
+    from spark_rapids_tpu.ops.misc_exprs import Md5 as _Md5
+    if isinstance(e, _Md5):
+        import hashlib
+        child = _eval_pandas(e.child, df)
+        return child.map(lambda v: None if _isnull(v) else
+                         hashlib.md5(str(v).encode()).hexdigest())
     from spark_rapids_tpu.ops import collections_ops as C
     if isinstance(e, C.CreateArray):
         parts = [_eval_pandas(c, df) for c in e.children]
@@ -292,7 +298,34 @@ class CpuFallbackExec(TpuExec):
             if how is None:
                 raise NotImplementedError(
                     f"CPU fallback join type {node.join_type}")
-            out = left.merge(right, left_on=lk, right_on=rk, how=how)
+            if node.condition is not None and how in ("left", "right",
+                                                      "outer"):
+                if how in ("right", "outer"):
+                    raise NotImplementedError(
+                        "CPU fallback right/full join with residual "
+                        "condition not supported")
+                # residual applies to the MATCH: matched-but-failing rows
+                # revert to null-extended output, they are not dropped
+                lid = "__fallback_lid"
+                left2 = left.copy()
+                left2[lid] = np.arange(len(left2))
+                inner = left2.merge(right, left_on=lk, right_on=rk,
+                                    how="inner")
+                mask = _eval_pandas(node.condition, inner.drop(
+                    columns=[lid])).fillna(False).astype(bool)
+                inner = inner[mask.values]
+                missing = left2[~left2[lid].isin(inner[lid])]
+                pad = missing.reindex(
+                    columns=list(left2.columns) +
+                    [c for c in right.columns if c not in left2.columns])
+                inner = pd.concat([inner, pad], ignore_index=True)
+                out = inner.drop(columns=[lid])
+            else:
+                out = left.merge(right, left_on=lk, right_on=rk, how=how)
+                if node.condition is not None:
+                    mask = _eval_pandas(node.condition,
+                                        out).fillna(False).astype(bool)
+                    out = out[mask.values]
         elif isinstance(node, L.Project):
             df = self._child_pandas(0)
             out = pd.DataFrame({e.name: _eval_pandas(e, df)

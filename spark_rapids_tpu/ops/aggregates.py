@@ -436,3 +436,129 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
             raise ValueError(f"unknown reduce kind {kind}")
         outs.append(ColVal(c.dtype, out[None], (count > 0)[None]))
     return outs
+
+
+# ----------------------------------------------------- collect aggregates
+
+class CollectList(AggregateFunction):
+    """collect_list(x): per-group array of non-null values
+    (CudfCollectList, AggregateFunctions.scala:256).  Evaluated in a
+    single grouped pass — after the group sort the group's values are
+    already contiguous, so the array column is a compaction, not a
+    per-group loop.  ``single_pass``: the exec concatenates its input
+    instead of the partial/merge pipeline."""
+
+    name = "collect_list"
+    single_pass = True
+    dedup = False
+
+    @property
+    def result_dtype(self):
+        from spark_rapids_tpu.columnar.dtypes import ArrayType
+        return ArrayType(self.child.dtype)
+
+    @property
+    def result_nullable(self):
+        return False
+
+    def buffers(self):
+        raise NotImplementedError("collect runs in the single-pass path")
+
+
+class CollectSet(CollectList):
+    """collect_set(x): distinct non-null values per group, ascending
+    (CudfCollectSet, AggregateFunctions.scala:278 — Spark leaves set
+    order unspecified)."""
+
+    name = "collect_set"
+    dedup = True
+
+
+def groupby_collect(keys: Sequence[ColVal], collect_inputs, nrows,
+                    capacity: int,
+                    buffer_inputs: Sequence[Tuple[str, ColVal]] = (),
+                    row_mask=None):
+    """Group by ``keys``; for each (child, dedup) in collect_inputs build
+    a per-group array column, and reduce ``buffer_inputs`` as usual.
+
+    Returns (out_keys, out_buffers, collect_arrays, num_groups) where
+    each collect array is a ColVal with offsets (ARRAY layout).
+    """
+    from spark_rapids_tpu.ops import selection
+
+    live = _row_mask(nrows, capacity, row_mask)
+    n_live = live.sum().astype(jnp.int32)
+    perm = sort_permutation(keys, live, capacity)
+    valid_sorted_mask = jnp.arange(capacity, dtype=jnp.int32) < n_live
+    sorted_keys = selection.gather(keys, perm, n_live)
+    same_as_prev = _keys_equal_prev(sorted_keys, capacity)
+    boundary = jnp.logical_and(jnp.logical_not(same_as_prev),
+                               valid_sorted_mask)
+    num_groups = boundary.sum().astype(jnp.int32)
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(valid_sorted_mask, seg_ids, capacity)
+
+    out_bufs: List[ColVal] = []
+    if buffer_inputs:
+        sorted_bufs = selection.gather([c for _, c in buffer_inputs], perm,
+                                       n_live)
+        for (kind, _), sc in zip(buffer_inputs, sorted_bufs):
+            vals, counts = _segment_reduce(kind, sc, seg_ids, capacity,
+                                           valid_sorted_mask)
+            out_bufs.append(ColVal(sc.dtype, vals, counts > 0))
+
+    collect_outs: List[ColVal] = []
+    for child, dedup in collect_inputs:
+        if dedup:
+            # per-group value order + dedup need values as a secondary
+            # sort key: same group order (keys are the primary keys)
+            perm2 = jnp.lexsort(
+                _order_keys(child.values, False) +
+                _sortable_keys(keys, live, capacity))
+            sc = selection.gather([child] + list(keys), perm2, n_live)
+            schild, skeys2 = sc[0], sc[1:]
+            same2 = _keys_equal_prev(skeys2, capacity)
+            seg2 = jnp.cumsum(jnp.logical_and(
+                jnp.logical_not(same2), valid_sorted_mask)
+                .astype(jnp.int32)) - 1
+            seg2 = jnp.where(valid_sorted_mask, seg2, capacity)
+            v = schild.values
+            same_val = v == jnp.roll(v, 1)
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                same_val = same_val | (jnp.isnan(v) &
+                                       jnp.isnan(jnp.roll(v, 1)))
+            if schild.validity is not None:
+                # a null row's LANE value may equal a valid value; runs
+                # must only merge valid-with-valid
+                vv = schild.validity
+                same_val = jnp.logical_and(
+                    same_val, jnp.logical_and(vv, jnp.roll(vv, 1)))
+            first_of_run = jnp.logical_not(
+                jnp.logical_and(same2, same_val))
+            keep = jnp.logical_and(valid_sorted_mask, first_of_run)
+            if schild.validity is not None:
+                keep = jnp.logical_and(keep, schild.validity)
+            seg_for = seg2
+        else:
+            sc = selection.gather([child], perm, n_live)
+            schild = sc[0]
+            keep = valid_sorted_mask
+            if schild.validity is not None:
+                keep = jnp.logical_and(keep, schild.validity)
+            seg_for = seg_ids
+        lengths = jax.ops.segment_sum(keep.astype(jnp.int32), seg_for,
+                                      num_segments=capacity)
+        offsets = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                                   jnp.cumsum(lengths, dtype=jnp.int32)])
+        compacted, _ = selection.compact(
+            [ColVal(child.dtype, schild.values, None)], keep)
+        from spark_rapids_tpu.columnar.dtypes import ArrayType
+        collect_outs.append(ColVal(ArrayType(child.dtype),
+                                   compacted[0].values, None, offsets))
+
+    first_idx = jax.ops.segment_min(
+        jnp.arange(capacity, dtype=jnp.int64), seg_ids,
+        num_segments=capacity)
+    first_idx = jnp.clip(first_idx, 0, capacity - 1).astype(jnp.int32)
+    out_keys = selection.gather(sorted_keys, first_idx, num_groups)
+    return out_keys, out_bufs, collect_outs, num_groups

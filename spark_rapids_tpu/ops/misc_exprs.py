@@ -1,0 +1,141 @@
+"""Misc expressions: hashes and id generators.
+
+Reference analogs: ``HashFunctions.scala:56`` (GpuMurmur3Hash),
+``GpuMonotonicallyIncreasingID``/``GpuSparkPartitionID`` (75/52 LoC) and
+the Md5 rule (Appendix A.1 "Misc").  Murmur3Hash runs fully on device via
+the partitioner's canonical hash; Md5 is host-only (bit-rotation digests
+don't vectorize usefully onto the VPU) and lives in the CPU fallback.
+
+MonotonicallyIncreasingID and SparkPartitionID need per-batch state
+(Spark: partition id in the high bits, row offset in the low 33), which
+stateless expressions cannot carry; the planner routes them through
+``TpuBatchIdExec`` which appends the id columns per batch, exactly the
+pattern Generate and Window use.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.ops.expressions import (
+    ColVal, EmitContext, Expression)
+
+
+class Murmur3Hash(Expression):
+    """hash(cols...): int32, matching the engine's partitioning hash so
+    hash(col) is consistent with shuffle placement."""
+
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def cache_key(self):
+        return ("Murmur3Hash", self.seed,
+                tuple(c.cache_key() for c in self.children))
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        from spark_rapids_tpu.parallel.partitioning import hash_columns
+        cols = []
+        for c in self.children:
+            cv = c.emit(ctx)
+            v = cv.values
+            if getattr(v, "ndim", 0) == 0:
+                v = jnp.broadcast_to(v, (ctx.capacity,))
+                cv = ColVal(cv.dtype, v, cv.validity, cv.offsets)
+            cols.append(cv)
+        h = hash_columns(cols, seed=self.seed)
+        return ColVal(dts.INT32, h.astype(jnp.int32), None)
+
+
+class Md5(Expression):
+    """md5(string): host-only (no device rule is registered, so any plan
+    containing it falls back and ``_eval_pandas`` computes it)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return Md5(children[0])
+
+    @property
+    def dtype(self):
+        return dts.STRING
+
+
+class _BatchIdMarker(Expression):
+    """select-time marker routed into TpuBatchIdExec by DataFrame.select
+    (monotonically_increasing_id / spark_partition_id)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind  # "mid" | "pid"
+        self.children = ()
+
+    @property
+    def dtype(self):
+        return dts.INT64 if self.kind == "mid" else dts.INT32
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    @property
+    def name(self) -> str:
+        return ("monotonically_increasing_id()" if self.kind == "mid"
+                else "spark_partition_id()")
+
+
+class TpuBatchIdExec(TpuExec):
+    """Appends per-batch id columns: each input batch is a 'partition' —
+    mid = (batch_ordinal << 33) | row_offset (Spark's bit split), pid =
+    batch_ordinal."""
+
+    MID_COL = "__mid"
+    PID_COL = "__pid"
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return list(self.child.schema) + [
+            (self.MID_COL, dts.INT64), (self.PID_COL, dts.INT32)]
+
+    def describe(self):
+        return "TpuBatchIdExec"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for ordinal, batch in enumerate(self.child.execute()):
+            cap = batch.capacity
+            base = jnp.int64(ordinal << 33)
+            mid = jnp.arange(cap, dtype=jnp.int64) + base
+            pid = jnp.full(cap, ordinal, dtype=jnp.int32)
+            out = batch.with_column(
+                self.MID_COL, Column(dts.INT64, mid, batch.nrows))
+            out = out.with_column(
+                self.PID_COL, Column(dts.INT32, pid, batch.nrows))
+            yield out

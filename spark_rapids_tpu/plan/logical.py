@@ -190,9 +190,15 @@ class Join(LogicalPlan):
         self.left_keys = [e.bind(left.schema) for e in left_keys]
         self.right_keys = [e.bind(right.schema) for e in right_keys]
         self.join_type = join_type
-        self.condition = condition
         self.using = list(using) if using else None
         self.children = (left, right)
+        # residual (non-equi) condition binds against left+right columns
+        # (NOT Join.schema: semi/anti schemas drop the right side but a
+        # residual may legitimately reference it — the planner then tags
+        # the join off gracefully instead of a bind KeyError)
+        self.condition = condition.bind(
+            list(left.schema) + list(right.schema)) \
+            if condition is not None else None
 
     @property
     def left(self):
@@ -220,6 +226,27 @@ class Join(LogicalPlan):
         keys = list(zip([e.name for e in self.left_keys],
                         [e.name for e in self.right_keys]))
         return f"Join[{self.join_type}, on={keys}]"
+
+
+class BatchId(LogicalPlan):
+    """Appends the per-batch id columns consumed by
+    monotonically_increasing_id()/spark_partition_id()."""
+
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.columnar.dtypes import INT64
+        return list(self.child.schema) + [("__mid", INT64),
+                                          ("__pid", INT32)]
+
+    def describe(self):
+        return "BatchId"
 
 
 class Sort(LogicalPlan):

@@ -45,9 +45,10 @@ def expr_rule(cls, sig=ts.COMMON, note="", incompat=""):
 
 
 # leaves / structural
-for c in (Alias, BoundReference, Literal, UnresolvedColumn, Cast,
-          AggregateExpression):
+for c in (Alias, BoundReference, Literal, UnresolvedColumn, Cast):
     expr_rule(c)
+# aggregates may produce arrays (collect_list/collect_set)
+expr_rule(AggregateExpression, ts.ALL)
 
 from spark_rapids_tpu.exec.window import WindowExpression  # noqa: E402
 
@@ -109,6 +110,12 @@ expr_rule(C.Size, ts.COMMON)
 expr_rule(C.ArrayContains, ts.COMMON)
 expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
+
+# misc (HashFunctions.scala, GpuMonotonicallyIncreasingID analogs)
+from spark_rapids_tpu.ops import misc_exprs as ME  # noqa: E402
+
+expr_rule(ME.Murmur3Hash, ts.COMMON)
+# Md5 has NO rule: it is host-only and always falls back
 
 # UDFs: a user jax function fuses into the stage (RapidsUDF analog)
 from spark_rapids_tpu.udf.python_exec import JaxUDF  # noqa: E402
@@ -268,9 +275,11 @@ class PlanMeta(BaseMeta):
                 f"explode needs an array column, got "
                 f"{node.generator.dtype}")
         if isinstance(node, L.Join):
-            if node.condition is not None:
+            if node.condition is not None and node.join_type != "inner":
                 self.will_not_work(
-                    "non-equi join conditions not yet supported on TPU")
+                    "non-equi join conditions only supported for inner "
+                    "joins on TPU (outer residual semantics need the "
+                    "nested-loop join)")
             for lk, rk in zip(node.left_keys, node.right_keys):
                 if lk.dtype.name != rk.dtype.name:
                     self.will_not_work(
@@ -445,9 +454,25 @@ def _conv_sort(node: L.Sort, children, conf):
 
 @_converter(L.Join)
 def _conv_join(node: L.Join, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuFilterExec
     from spark_rapids_tpu.exec.join import TpuHashJoinExec
-    return TpuHashJoinExec(node.left_keys, node.right_keys, node.join_type,
+    join_type = node.join_type
+    if node.condition is not None and not node.left_keys:
+        # pure non-equi inner join: cross product + filter (the
+        # GpuBroadcastNestedLoopJoinExec shape)
+        join_type = "cross"
+    join = TpuHashJoinExec(node.left_keys, node.right_keys, join_type,
                            children[0], children[1], using=node.using)
+    if node.condition is not None:
+        # residual condition evaluated over the joined output
+        return TpuFilterExec(node.condition, join)
+    return join
+
+
+@_converter(L.BatchId)
+def _conv_batch_id(node: L.BatchId, children, conf):
+    from spark_rapids_tpu.ops.misc_exprs import TpuBatchIdExec
+    return TpuBatchIdExec(children[0])
 
 
 @_converter(L.Generate)

@@ -1,0 +1,157 @@
+"""collect_list / collect_set aggregates + pivot
+(AggregateFunctions.scala:256,278,530 analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_collect_list_basic(session):
+    df = session.create_dataframe(
+        {"k": [1, 2, 1, 1, 2], "v": [5, 3, 5, 1, None]})
+    out = df.groupBy("k").agg(F.collect_list("v").alias("l")) \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    assert sorted(out["l"][0]) == [1, 5, 5]   # nulls dropped
+    assert list(out["l"][1]) == [3]
+
+
+def test_collect_set_dedups_sorted(session):
+    df = session.create_dataframe(
+        {"k": [1, 1, 1, 1, 2], "v": [5, 5, 1, 5, 7]})
+    out = df.groupBy("k").agg(F.collect_set("v").alias("s")) \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    assert list(out["s"][0]) == [1, 5]
+    assert list(out["s"][1]) == [7]
+
+
+def test_collect_mixed_with_regular_aggs(session):
+    rng = np.random.default_rng(5)
+    k = rng.integers(0, 10, 300)
+    v = rng.integers(0, 50, 300).astype(float)
+    df = session.create_dataframe({"k": k, "v": v})
+    out = df.groupBy("k").agg(
+        F.collect_list("v").alias("l"), F.sum("v").alias("s"),
+        F.count("v").alias("c")).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    want = pd.DataFrame({"k": k, "v": v}).groupby("k").agg(
+        l=("v", list), s=("v", "sum"), c=("v", "count")).reset_index()
+    for i in range(len(out)):
+        assert sorted(out["l"][i]) == sorted(want["l"][i])
+        np.testing.assert_allclose(out["s"][i], want["s"][i])
+        assert out["c"][i] == want["c"][i]
+
+
+def test_collect_grand_total(session):
+    df = session.create_dataframe({"v": [3, 1, None, 2]})
+    out = df.agg(F.collect_list("v").alias("l")).to_pandas()
+    assert sorted(out["l"][0]) == [1, 2, 3]
+
+
+def test_collect_multiple_batches(session):
+    d1 = session.create_dataframe({"k": [1, 2], "v": [10, 20]})
+    d2 = session.create_dataframe({"k": [1, 2], "v": [30, 40]})
+    out = d1.union(d2).groupBy("k").agg(
+        F.collect_list("v").alias("l")).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    assert sorted(out["l"][0]) == [10, 30]
+    assert sorted(out["l"][1]) == [20, 40]
+
+
+def test_collect_then_explode_roundtrip(session):
+    df = session.create_dataframe({"k": [1, 1, 2], "v": [4, 5, 6]})
+    collected = df.groupBy("k").agg(F.collect_list("v").alias("arr"))
+    back = collected.select("k", F.explode("arr")).to_pandas()
+    got = sorted(zip(back["k"], back["col"]))
+    assert got == [(1, 4), (1, 5), (2, 6)]
+
+
+def test_pivot_sum(session):
+    df = session.create_dataframe(
+        {"k": [1, 1, 2, 2, 1], "p": ["a", "b", "a", "a", "a"],
+         "v": [10, 20, 30, 40, 50]})
+    out = df.groupBy("k").pivot("p", ["a", "b"]).sum("v") \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    assert out["a"].tolist() == [60, 70]
+    assert out["b"][0] == 20 and pd.isna(out["b"][1])
+
+
+def test_pivot_multi_agg(session):
+    df = session.create_dataframe(
+        {"k": [1, 1, 2], "p": ["x", "y", "x"], "v": [1.0, 2.0, 3.0]})
+    out = df.groupBy("k").pivot("p", ["x", "y"]).agg(
+        F.sum("v").alias("s"), F.count("v").alias("c")) \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    assert out["x_s"].tolist() == [1.0, 3.0]
+    assert out["x_c"].tolist() == [1, 1]
+    assert out["y_c"].tolist() == [1, 0]
+
+
+def test_pivot_matches_pandas(session):
+    rng = np.random.default_rng(9)
+    k = rng.integers(0, 5, 200)
+    p = rng.choice(["r", "g", "b"], 200)
+    v = rng.normal(size=200)
+    df = session.create_dataframe({"k": k, "p": p, "v": v})
+    out = df.groupBy("k").pivot("p", ["r", "g", "b"]).sum("v") \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    want = pd.DataFrame({"k": k, "p": p, "v": v}).pivot_table(
+        index="k", columns="p", values="v", aggfunc="sum").reset_index()
+    for c in ("r", "g", "b"):
+        np.testing.assert_allclose(out[c].astype(float),
+                                   want[c].astype(float), rtol=1e-12)
+
+
+def test_pivot_multi_same_func(session):
+    """Two sums of different columns must not collide (regression: both
+    named '<v>_sum', silently dropping one)."""
+    df = session.create_dataframe(
+        {"k": [1, 1], "p": ["a", "b"], "x": [1.0, 2.0], "y": [10.0, 20.0]})
+    out = df.groupBy("k").pivot("p", ["a", "b"]).agg(
+        F.sum("x"), F.sum("y")).to_pandas()
+    assert len([c for c in out.columns if c != "k"]) == 4
+    assert out["a_sum(x)"][0] == 1.0 and out["a_sum(y)"][0] == 10.0
+    assert out["b_sum(x)"][0] == 2.0 and out["b_sum(y)"][0] == 20.0
+
+
+def test_pivot_count_star(session):
+    """count() (childless) must count only rows of each pivot value."""
+    df = session.create_dataframe(
+        {"k": [1, 1, 1, 2], "p": ["a", "a", "b", "a"]})
+    out = df.groupBy("k").pivot("p", ["a", "b"]).agg(F.count()) \
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    assert out["a"].tolist() == [2, 1]
+    assert out["b"].tolist() == [1, 0]
+
+
+def test_collect_set_null_lane_regression(session):
+    """A null row's buffer lane (fill 0) must not swallow a real 0."""
+    df = session.create_dataframe({"k": [1, 1], "v": [None, 0]})
+    out = df.groupBy("k").agg(F.collect_set("v").alias("s")).to_pandas()
+    assert list(out["s"][0]) == [0]
+
+
+def test_keyless_collect_empty_input(session):
+    df = session.create_dataframe({"v": [1.0, 2.0]})
+    out = df.filter(F.col("v") > 100).agg(
+        F.collect_list("v").alias("l"), F.sum("v").alias("s"),
+        F.count("v").alias("c")).to_pandas()
+    assert len(out) == 1
+    assert list(out["l"][0]) == []
+    assert pd.isna(out["s"][0]) and out["c"][0] == 0
+
+
+def test_semi_join_with_residual_tags_off(session):
+    l = session.create_dataframe({"a": [1], "x": [1.0]})
+    r = session.create_dataframe({"b": [1], "y": [2.0]})
+    q = l.join(r, (F.col("a") == F.col("b")) & (F.col("x") > F.col("y")),
+               how="semi")
+    tree = session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree  # graceful, no bind KeyError

@@ -1,0 +1,66 @@
+"""Misc expressions: hash, md5, monotonically_increasing_id,
+spark_partition_id (HashFunctions.scala + GpuMonotonicallyIncreasingID
+analogs)."""
+
+import hashlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_monotonic_id_unique_and_partitioned(session):
+    parts = [session.create_dataframe({"x": list(range(i * 10, i * 10 + 7))})
+             for i in range(3)]
+    df = parts[0]
+    for p in parts[1:]:
+        df = df.union(p)
+    out = df.select("x", F.monotonically_increasing_id().alias("id"),
+                    F.spark_partition_id().alias("p")).to_pandas()
+    assert out["id"].is_unique
+    # Spark bit split: partition in the high bits
+    assert (out["id"].astype("int64").to_numpy() >> 33).tolist() == \
+        out["p"].tolist()
+    assert sorted(out["p"].unique()) == [0, 1, 2]
+
+
+def test_hash_deterministic_consistent(session):
+    df = session.create_dataframe({"x": [1, 2, 1], "s": ["a", "b", "a"]})
+    out = df.select(F.hash(F.col("x"), F.col("s")).alias("h")).to_pandas()
+    assert out["h"][0] == out["h"][2]
+    assert out["h"][0] != out["h"][1]
+    out2 = df.select(F.hash(F.col("x"), F.col("s")).alias("h")).to_pandas()
+    assert out["h"].tolist() == out2["h"].tolist()
+
+
+def test_hash_runs_on_device(session):
+    df = session.create_dataframe({"x": [1.0, -0.0, 0.0]})
+    q = df.select(F.hash(F.col("x")).alias("h"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+    out = q.to_pandas()
+    assert out["h"][1] == out["h"][2]  # -0.0 hashes like 0.0
+
+
+def test_md5_host_fallback(session):
+    df = session.create_dataframe({"s": ["hello", "", None]})
+    q = df.select(F.md5("s").alias("m"))
+    assert "CpuFallbackExec" in session.plan(q.plan).tree_string()
+    out = q.to_pandas()["m"]
+    assert out[0] == hashlib.md5(b"hello").hexdigest()
+    assert out[1] == hashlib.md5(b"").hexdigest()
+    assert pd.isna(out[2])
+
+
+def test_monotonic_id_in_expression(session):
+    df = session.create_dataframe({"x": [10, 20]})
+    out = df.select((F.monotonically_increasing_id() + 100).alias("i")) \
+        .to_pandas()
+    assert out["i"].tolist() == [100, 101]

@@ -1,0 +1,100 @@
+"""Expression join conditions: equi-conjunct extraction + residual filter,
+and pure non-equi inner joins as cross+filter (GpuHashJoin condition
+handling + GpuBroadcastNestedLoopJoinExec analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def _pdf_l(rng, n=200):
+    return pd.DataFrame({"lk": rng.integers(0, 20, n),
+                         "lv": rng.normal(size=n) * 10})
+
+
+def _pdf_r(rng, n=60):
+    return pd.DataFrame({"rk": rng.integers(0, 20, n),
+                         "rv": rng.normal(size=n) * 10})
+
+
+def test_equi_plus_residual(session):
+    rng = np.random.default_rng(0)
+    lp, rp = _pdf_l(rng), _pdf_r(rng)
+    l = session.create_dataframe(lp)
+    r = session.create_dataframe(rp)
+    q = l.join(r, (F.col("lk") == F.col("rk")) &
+               (F.col("lv") > F.col("rv")))
+    tree = session.plan(q.plan).tree_string()
+    assert "TpuHashJoinExec" in tree and "CpuFallbackExec" not in tree
+    got = q.to_pandas().sort_values(["lk", "lv", "rv"]).reset_index(
+        drop=True)
+    want = lp.merge(rp, left_on="lk", right_on="rk")
+    want = want[want.lv > want.rv].sort_values(
+        ["lk", "lv", "rv"]).reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_allclose(got["lv"], want["lv"], rtol=1e-12)
+    np.testing.assert_allclose(got["rv"], want["rv"], rtol=1e-12)
+
+
+def test_pure_nonequi_inner(session):
+    rng = np.random.default_rng(1)
+    lp, rp = _pdf_l(rng, 50), _pdf_r(rng, 20)
+    l = session.create_dataframe(lp)
+    r = session.create_dataframe(rp)
+    q = l.join(r, F.col("lv") < F.col("rv"))
+    got = q.to_pandas()
+    want = lp.merge(rp, how="cross")
+    want = want[want.lv < want.rv]
+    assert len(got) == len(want)
+    np.testing.assert_allclose(sorted(got["lv"] + got["rv"]),
+                               sorted(want["lv"] + want["rv"]), rtol=1e-12)
+
+
+def test_equi_only_expression_condition(session):
+    """A pure equi expression condition behaves like on=names."""
+    rng = np.random.default_rng(2)
+    lp, rp = _pdf_l(rng, 80), _pdf_r(rng, 40)
+    l = session.create_dataframe(lp)
+    r = session.create_dataframe(rp)
+    got = l.join(r, F.col("lk") == F.col("rk")).to_pandas()
+    want = lp.merge(rp, left_on="lk", right_on="rk")
+    assert len(got) == len(want)
+
+
+def test_residual_outer_join_falls_back(session):
+    l = session.create_dataframe({"lk": [1], "lv": [1.0]})
+    r = session.create_dataframe({"rk": [1], "rv": [2.0]})
+    q = l.join(r, (F.col("lk") == F.col("rk")) &
+               (F.col("lv") > F.col("rv")), how="left")
+    tree = session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree  # documented limitation
+
+
+def test_duplicate_names_rejected(session):
+    l = session.create_dataframe({"k": [1], "v": [1.0]})
+    r = session.create_dataframe({"k": [1], "w": [2.0]})
+    with pytest.raises(ValueError, match="distinct column names"):
+        l.join(r, F.col("v") > F.col("w"))
+
+
+def test_residual_left_join_fallback_semantics(session):
+    """Left join with residual: matched-but-failing rows null-extend."""
+    l = session.create_dataframe({"lk": [1, 2, 3], "lv": [1.0, 9.0, 5.0]})
+    r = session.create_dataframe({"rk": [1, 2], "rv": [2.0, 3.0]})
+    q = l.join(r, (F.col("lk") == F.col("rk")) &
+               (F.col("lv") > F.col("rv")), how="left")
+    got = q.to_pandas().sort_values("lk").reset_index(drop=True)
+    # lk=1: matched rk=1 but 1.0 > 2.0 false -> null-extended
+    # lk=2: 9.0 > 3.0 -> matched; lk=3: no key match -> null-extended
+    assert len(got) == 3
+    assert pd.isna(got["rv"][0])
+    assert got["rv"][1] == 3.0
+    assert pd.isna(got["rv"][2])
