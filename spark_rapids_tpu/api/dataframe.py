@@ -78,6 +78,23 @@ class PivotedGroupedData:
         return self.agg(F.avg(c))
 
 
+class CoGroupedData:
+    """groupBy(a).cogroup(other.groupBy(b)).applyInPandas(fn, schema):
+    fn(left_group_df, right_group_df) per key in the union of keys
+    (GpuFlatMapCoGroupsInPandasExec analog)."""
+
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self.left = left
+        self.right = right
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        lnames = [e.name for e in self.left.group_exprs]
+        rnames = [e.name for e in self.right.group_exprs]
+        return DataFrame(self.left.df.session, L.CoGroupMapInPandas(
+            fn, _parse_schema(schema), lnames, rnames,
+            self.left.df.plan, self.right.df.plan))
+
+
 def _parse_schema(schema):
     """'a int, b string' or [(name, DataType)] -> Schema."""
     from spark_rapids_tpu.columnar.dtypes import dtype_from_name
@@ -243,6 +260,14 @@ class DataFrame:
             rk = [UnresolvedColumn(k) for k in keys]
             return DataFrame(self.session, L.Join(
                 self.plan, other.plan, lk, rk, how, using=keys))
+        if isinstance(on, (list, tuple)):
+            # PySpark form: a list of Column conditions, AND-ed together
+            from spark_rapids_tpu.ops import predicates as preds
+            exprs = [_expr(c) for c in on]
+            combined = exprs[0]
+            for c in exprs[1:]:
+                combined = preds.And(combined, c)
+            on = Col(combined)
         # expression join condition: split equi conjuncts (left-col ==
         # right-col) into hash-join keys, the rest into a residual
         # condition (GpuHashJoin equi extraction; pure-residual inner
@@ -424,6 +449,17 @@ class GroupedData:
         self.group_exprs = group_exprs
 
     def agg(self, *aggs: Col) -> DataFrame:
+        from spark_rapids_tpu.api.functions import _PandasAggCall
+        pandas_aggs = [a for a in aggs if isinstance(a, _PandasAggCall)]
+        if pandas_aggs:
+            if len(pandas_aggs) != len(aggs):
+                raise ValueError("cannot mix grouped-agg pandas UDFs "
+                                 "with built-in aggregates")
+            names = [e.name for e in self.group_exprs]
+            specs = [(a.out_name, a.fn, a.arg_name, a.return_type)
+                     for a in pandas_aggs]
+            return DataFrame(self.df.session, L.AggInPandas(
+                names, specs, self.df.plan))
         agg_exprs = [_expr(a) for a in aggs]
         return DataFrame(self.df.session, L.Aggregate(
             self.group_exprs, agg_exprs, self.df.plan))
@@ -444,6 +480,9 @@ class GroupedData:
         names = [e.name for e in self.group_exprs]
         return DataFrame(self.df.session, L.MapInPandas(
             fn, _parse_schema(schema), self.df.plan, group_names=names))
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
 
     def _simple(self, fname, *cols) -> DataFrame:
         from spark_rapids_tpu.api import functions as F

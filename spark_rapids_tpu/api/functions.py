@@ -789,3 +789,54 @@ def monotonically_increasing_id() -> Col:
 def spark_partition_id() -> Col:
     from spark_rapids_tpu.ops.misc_exprs import _BatchIdMarker
     return Col(_BatchIdMarker("pid"))
+
+
+class _PandasAggCall(Col):
+    """Marker produced by a grouped-agg pandas UDF call; GroupedData.agg
+    routes it into an AggInPandas node (never evaluated as an
+    expression)."""
+
+    def __init__(self, fn, return_type, arg_name: str):
+        self.fn = fn
+        self.return_type = return_type
+        self.arg_name = arg_name
+        self.out_name = f"{getattr(fn, '__name__', 'udf')}({arg_name})"
+
+    @property
+    def expr(self):
+        raise TypeError("grouped-agg pandas UDFs are only valid inside "
+                        "groupBy().agg()")
+
+    @expr.setter
+    def expr(self, v):  # pragma: no cover
+        pass
+
+    def alias(self, name: str) -> "_PandasAggCall":
+        out = _PandasAggCall(self.fn, self.return_type, self.arg_name)
+        out.out_name = name
+        return out
+
+
+def pandas_agg_udf(f=None, returnType: str = "double"):
+    """Grouped-aggregate pandas UDF (Spark's pandas_udf with GROUPED_AGG):
+    ``fn(pd.Series) -> scalar``, one call per group
+    (GpuAggregateInPandasExec analog)."""
+    from spark_rapids_tpu.columnar.dtypes import dtype_from_name
+
+    def wrap(fn):
+        rt = dtype_from_name(returnType) if isinstance(returnType, str) \
+            else returnType
+
+        def call(col_name) -> _PandasAggCall:
+            if not isinstance(col_name, str):
+                raise TypeError("grouped-agg pandas UDFs take a column "
+                                "NAME argument")
+            return _PandasAggCall(fn, rt, col_name)
+
+        call.__name__ = getattr(fn, "__name__", "udf")
+        call.fn = fn
+        return call
+
+    if f is not None:
+        return wrap(f)
+    return wrap

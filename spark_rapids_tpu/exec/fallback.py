@@ -309,8 +309,11 @@ class CpuFallbackExec(TpuExec):
                 lid = "__fallback_lid"
                 left2 = left.copy()
                 left2[lid] = np.arange(len(left2))
-                inner = left2.merge(right, left_on=lk, right_on=rk,
-                                    how="inner")
+                if lk:
+                    inner = left2.merge(right, left_on=lk, right_on=rk,
+                                        how="inner")
+                else:  # pure non-equi: nested loop = cross
+                    inner = left2.merge(right, how="cross")
                 mask = _eval_pandas(node.condition, inner.drop(
                     columns=[lid])).fillna(False).astype(bool)
                 inner = inner[mask.values]
@@ -339,6 +342,68 @@ class CpuFallbackExec(TpuExec):
         elif isinstance(node, L.Union):
             out = pd.concat([self._child_pandas(i)
                              for i in range(len(self.children))])
+        elif isinstance(node, L.Aggregate):
+            df = self._child_pandas(0)
+            from spark_rapids_tpu.plan.logical import AggregateExpression
+            from spark_rapids_tpu.ops.expressions import Alias as _Alias
+            gcols = {}
+            for e in node.group_exprs:
+                gcols[e.name] = _eval_pandas(e, df)
+            aggs = []
+            for e in node.agg_exprs:
+                name = e.name
+                inner = e.children[0] if isinstance(e, _Alias) else e
+                if not isinstance(inner, AggregateExpression):
+                    raise NotImplementedError(
+                        "CPU fallback aggregate output must be a bare "
+                        "aggregate")
+                aggs.append((name, inner.func))
+
+            def apply_aggs(sub: pd.DataFrame) -> dict:
+                row = {}
+                for name, func in aggs:
+                    s = _eval_pandas(func.child, sub).dropna() \
+                        if func.child is not None else None
+                    k = func.name
+                    if k == "count":
+                        row[name] = len(s) if s is not None else len(sub)
+                    elif k == "sum":
+                        row[name] = s.sum() if len(s) else None
+                    elif k == "min":
+                        row[name] = s.min() if len(s) else None
+                    elif k == "max":
+                        row[name] = s.max() if len(s) else None
+                    elif k in ("avg", "average", "mean"):
+                        row[name] = s.mean() if len(s) else None
+                    elif k == "first":
+                        row[name] = s.iloc[0] if len(s) else None
+                    elif k == "last":
+                        row[name] = s.iloc[-1] if len(s) else None
+                    elif k == "collect_list":
+                        row[name] = list(s)
+                    elif k == "collect_set":
+                        row[name] = sorted(set(s))
+                    else:
+                        raise NotImplementedError(
+                            f"CPU fallback aggregate {k}")
+                return row
+
+            if gcols:
+                gdf = pd.DataFrame(gcols)
+                gdf["__data_idx"] = np.arange(len(df))
+                rows = []
+                for key, grp in gdf.groupby(list(gcols), dropna=False,
+                                            sort=False):
+                    key = key if isinstance(key, tuple) else (key,)
+                    sub = df.iloc[grp["__data_idx"].to_numpy()]
+                    row = dict(zip(gcols, key))
+                    row.update(apply_aggs(sub))
+                    rows.append(row)
+                out = pd.DataFrame(rows,
+                                   columns=[n for n, _ in node.schema])
+            else:
+                out = pd.DataFrame([apply_aggs(df)],
+                                   columns=[n for n, _ in node.schema])
         elif isinstance(node, L.Generate):
             df = self._child_pandas(0)
             arrs = _eval_pandas(node.generator, df)

@@ -511,9 +511,13 @@ def groupby_collect(keys: Sequence[ColVal], collect_inputs, nrows,
     for child, dedup in collect_inputs:
         if dedup:
             # per-group value order + dedup need values as a secondary
-            # sort key: same group order (keys are the primary keys)
+            # sort key: same group order (keys primary), nulls pushed to
+            # the group end so they can never split a run of equal values
+            null_flag = jnp.zeros(capacity, dtype=jnp.int8) \
+                if child.validity is None else \
+                jnp.logical_not(child.validity).astype(jnp.int8)
             perm2 = jnp.lexsort(
-                _order_keys(child.values, False) +
+                _order_keys(child.values, False) + [null_flag] +
                 _sortable_keys(keys, live, capacity))
             sc = selection.gather([child] + list(keys), perm2, n_live)
             schild, skeys2 = sc[0], sc[1:]

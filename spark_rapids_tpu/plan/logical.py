@@ -228,6 +228,49 @@ class Join(LogicalPlan):
         return f"Join[{self.join_type}, on={keys}]"
 
 
+class AggInPandas(LogicalPlan):
+    """groupBy().agg(grouped-agg pandas UDFs)."""
+
+    def __init__(self, group_names: Sequence[str], aggs: Sequence[tuple],
+                 child: LogicalPlan):
+        self.group_names = list(group_names)
+        self.aggs = list(aggs)  # (name, fn, arg_name, dtype)
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        child_schema = dict(self.child.schema)
+        out = [(n, child_schema[n]) for n in self.group_names]
+        out += [(name, dt) for name, _, _, dt in self.aggs]
+        return out
+
+    def describe(self):
+        return f"AggInPandas[{[n for n, *_ in self.aggs]}]"
+
+
+class CoGroupMapInPandas(LogicalPlan):
+    """cogroup().applyInPandas."""
+
+    def __init__(self, fn, out_schema: Schema, left_names, right_names,
+                 left: LogicalPlan, right: LogicalPlan):
+        self.fn = fn
+        self._schema = list(out_schema)
+        self.left_names = list(left_names)
+        self.right_names = list(right_names)
+        self.children = (left, right)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return "CoGroupMapInPandas"
+
+
 class BatchId(LogicalPlan):
     """Appends the per-batch id columns consumed by
     monotonically_increasing_id()/spark_partition_id()."""

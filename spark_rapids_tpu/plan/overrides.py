@@ -191,6 +191,16 @@ class ExprMeta(BaseMeta):
                     f"{name} is incompatible with CPU Spark "
                     f"({rule.incompat}) and "
                     "spark.rapids.sql.incompatibleOps.enabled is false")
+        if isinstance(expr, AggregateExpression):
+            try:
+                if expr.dtype.is_array and not getattr(
+                        expr.func, "single_pass", False):
+                    self.will_not_work(
+                        f"aggregate {expr.func.name} over array values "
+                        "not supported (only collect_list/collect_set "
+                        "produce arrays)")
+            except (RuntimeError, TypeError, ValueError) as e:
+                self.will_not_work(str(e))
         if isinstance(expr, Cast):
             try:
                 reason = cast_supported(expr.child.dtype, expr.target)
@@ -467,6 +477,22 @@ def _conv_join(node: L.Join, children, conf):
         # residual condition evaluated over the joined output
         return TpuFilterExec(node.condition, join)
     return join
+
+
+@_converter(L.AggInPandas)
+def _conv_agg_in_pandas(node: L.AggInPandas, children, conf):
+    from spark_rapids_tpu.udf.python_exec import TpuAggregateInPandasExec
+    return TpuAggregateInPandasExec(node.group_names, node.aggs,
+                                    children[0])
+
+
+@_converter(L.CoGroupMapInPandas)
+def _conv_cogroup(node: L.CoGroupMapInPandas, children, conf):
+    from spark_rapids_tpu.udf.python_exec import (
+        TpuFlatMapCoGroupsInPandasExec)
+    return TpuFlatMapCoGroupsInPandasExec(
+        node.fn, node.schema, node.left_names, node.right_names,
+        children[0], children[1])
 
 
 @_converter(L.BatchId)

@@ -295,3 +295,158 @@ class TpuArrowEvalPythonExec(TpuExec):
             names = [e.name for e in self.exprs]
             yield ColumnarBatch(
                 {nm: c for nm, c in zip(names, outs)}, batch.nrows)
+
+
+def _norm_key(key) -> tuple:
+    """Normalize a pandas group key: NaN members collapse to None so
+    null keys from two sides cogroup together (NaN != NaN)."""
+    key = key if isinstance(key, tuple) else (key,)
+    return tuple(None if (isinstance(x, float) and pd.isna(x)) else x
+                 for x in key)
+
+
+def _child_pandas(exec_node: TpuExec) -> pd.DataFrame:
+    """Concatenate every child batch into one pandas frame (empty frame
+    with the right columns when the child yields nothing)."""
+    import pyarrow as pa
+    tables = [b.to_arrow() for b in exec_node.execute()]
+    if not tables:
+        from spark_rapids_tpu.columnar.batch import empty_batch
+        return empty_batch(exec_node.schema).to_pandas()
+    return pa.concat_tables(tables).to_pandas()
+
+
+def _batch_from_pandas_schema(df: pd.DataFrame, schema: Schema
+                              ) -> ColumnarBatch:
+    """Build a batch with columns COERCED to the declared schema (pandas
+    loses dtypes on empty/object/nullable columns)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.column import Column
+    cols = {}
+    for name, dt in schema:
+        s = df[name]
+        if dt.is_string:
+            cols[name] = Column.from_strings(
+                [None if v is None or
+                 (not isinstance(v, str) and pd.isna(v)) else str(v)
+                 for v in s])
+        elif dt.is_array:
+            cols[name] = Column.from_arrays(
+                [None if v is None or
+                 (not isinstance(v, (list, tuple, np.ndarray))
+                  and pd.isna(v)) else list(v) for v in s], dt.element)
+        else:
+            valid = s.notna().to_numpy()
+            filled = s.fillna(0).to_numpy()
+            cols[name] = Column.from_numpy(
+                np.asarray(filled).astype(dt.storage, copy=False),
+                dtype=dt, validity=None if valid.all() else valid)
+    return ColumnarBatch(cols, len(df))
+
+
+class TpuAggregateInPandasExec(TpuExec):
+    """groupBy().agg(grouped-agg pandas UDF) — GpuAggregateInPandasExec
+    analog (python/GpuAggregateInPandasExec.scala, 270 LoC): groups are
+    split host-side, each UDF receives its group's argument Series and
+    returns one scalar per group."""
+
+    def __init__(self, group_names: Sequence[str],
+                 aggs: Sequence[tuple], child: TpuExec):
+        """aggs: (out_name, fn, arg_name, return_dtype)."""
+        super().__init__(child)
+        self.group_names = list(group_names)
+        self.aggs = list(aggs)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        child_schema = dict(self.child.schema)
+        out = [(n, child_schema[n]) for n in self.group_names]
+        out += [(name, dt) for name, _, _, dt in self.aggs]
+        return out
+
+    def describe(self):
+        return (f"TpuAggregateInPandasExec[{[n for n, *_ in self.aggs]}]")
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        df = _child_pandas(self.child)
+        if df.empty and self.group_names:
+            return
+        # keyless over empty input: Spark still applies the UDF once
+        # (to empty Series) and returns one row
+        sem = None
+        from spark_rapids_tpu.api.session import TpuSession
+        if TpuSession._active is not None:
+            sem = TpuSession._active.semaphore
+        if sem is not None:
+            sem.release_if_held()
+        rows = []
+        if self.group_names:
+            grouped = df.groupby(self.group_names, dropna=False,
+                                 sort=False)
+            for key, group in grouped:
+                row = dict(zip(self.group_names, _norm_key(key)))
+                for name, fn, arg, _ in self.aggs:
+                    row[name] = fn(group[arg])
+                rows.append(row)
+        else:
+            row = {}
+            for name, fn, arg, _ in self.aggs:
+                row[name] = fn(df[arg])
+            rows.append(row)
+        if sem is not None:
+            sem.acquire_if_necessary()
+        out = pd.DataFrame(rows, columns=[n for n, _ in self.schema])
+        yield _batch_from_pandas_schema(out, self.schema)
+
+
+class TpuFlatMapCoGroupsInPandasExec(TpuExec):
+    """cogroup().applyInPandas — GpuFlatMapCoGroupsInPandasExec analog
+    (142 LoC; disabled by default in the reference,
+    GpuOverrides.scala:3205): both sides grouped host-side, the user fn
+    gets (left_group, right_group) per key in the union of keys."""
+
+    def __init__(self, fn: Callable, out_schema: Schema,
+                 left_names: Sequence[str], right_names: Sequence[str],
+                 left: TpuExec, right: TpuExec):
+        super().__init__(left, right)
+        self.fn = fn
+        self._schema = list(out_schema)
+        self.left_names = list(left_names)
+        self.right_names = list(right_names)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self):
+        return "TpuFlatMapCoGroupsInPandasExec"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        left = _child_pandas(self.children[0])
+        right = _child_pandas(self.children[1])
+        lgroups = {_norm_key(k): g
+                   for k, g in left.groupby(self.left_names, dropna=False,
+                                            sort=False)}
+        rgroups = {_norm_key(k): g
+                   for k, g in right.groupby(self.right_names,
+                                             dropna=False, sort=False)}
+        keys = list(lgroups)
+        keys += [k for k in rgroups if k not in lgroups]
+        outs = []
+        for k in keys:
+            lg = lgroups.get(k, left.iloc[0:0])
+            rg = rgroups.get(k, right.iloc[0:0])
+            res = self.fn(lg.reset_index(drop=True),
+                          rg.reset_index(drop=True))
+            if len(res):
+                outs.append(res[[n for n, _ in self._schema]])
+        if not outs:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            yield empty_batch(self._schema)
+            return
+        yield _batch_from_pandas_schema(
+            pd.concat(outs, ignore_index=True), self._schema)

@@ -130,3 +130,132 @@ def test_apply_in_pandas(session):
     out = df.groupBy("k").applyInPandas(center, "k bigint, v double") \
         .to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
     assert out["v"].tolist() == [-1.0, 1.0, -5.0, 5.0]
+
+
+def test_pandas_agg_udf(session):
+    """GpuAggregateInPandasExec analog: fn(Series) -> scalar per group."""
+    import numpy as np
+
+    @F.pandas_agg_udf(returnType="double")
+    def p90(series):
+        return float(series.quantile(0.9))
+
+    rng = np.random.default_rng(4)
+    k = rng.integers(0, 5, 200)
+    v = rng.normal(size=200)
+    df = session.create_dataframe({"k": k, "v": v})
+    out = df.groupBy("k").agg(p90("v").alias("q")).to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    import pandas as pd
+    want = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"] \
+        .quantile(0.9).reset_index()
+    np.testing.assert_allclose(out["q"], want["v"], rtol=1e-12)
+
+
+def test_pandas_agg_udf_grand_total(session):
+    @F.pandas_agg_udf(returnType="double")
+    def spread(series):
+        return float(series.max() - series.min())
+
+    df = session.create_dataframe({"v": [1.0, 9.0, 4.0]})
+    out = df.agg(spread("v").alias("s")).to_pandas()
+    assert out["s"][0] == 8.0
+
+
+def test_pandas_agg_udf_mixing_rejected(session):
+    @F.pandas_agg_udf(returnType="double")
+    def m(series):
+        return float(series.mean())
+
+    df = session.create_dataframe({"k": [1], "v": [1.0]})
+    with pytest.raises(ValueError, match="cannot mix"):
+        df.groupBy("k").agg(m("v"), F.sum("v"))
+
+
+def test_cogroup_apply_in_pandas(session):
+    import pandas as pd
+    l = session.create_dataframe({"k": [1, 1, 2], "x": [1.0, 2.0, 3.0]})
+    r = session.create_dataframe({"k2": [1, 3], "y": [10.0, 30.0]})
+
+    def merge_fn(lg, rg):
+        key = lg.k.iloc[0] if len(lg) else rg.k2.iloc[0]
+        return pd.DataFrame({"k": [key],
+                             "nl": [len(lg)], "nr": [len(rg)]})
+
+    out = l.groupBy("k").cogroup(r.groupBy("k2")).applyInPandas(
+        merge_fn, "k bigint, nl bigint, nr bigint").to_pandas() \
+        .sort_values("k").reset_index(drop=True)
+    assert out["k"].tolist() == [1, 2, 3]
+    assert out["nl"].tolist() == [2, 1, 0]
+    assert out["nr"].tolist() == [1, 0, 1]
+
+
+def test_collect_set_null_lane_between_equals(session):
+    """Regression: a null row sorting between equal valid values must
+    not split the dedup run."""
+    import pandas as pd
+    df = session.create_dataframe({"k": [1, 1, 1], "v": [0, None, 0]})
+    out = df.groupBy("k").agg(F.collect_set("v").alias("s")).to_pandas()
+    assert list(out["s"][0]) == [0]
+
+
+def test_cogroup_null_keys_pair(session):
+    import pandas as pd
+    l = session.create_dataframe({"k": [1, None], "x": [1.0, 2.0]})
+    r = session.create_dataframe({"k2": [None], "y": [9.0]})
+
+    def fn(lg, rg):
+        return pd.DataFrame({"nl": [len(lg)], "nr": [len(rg)]})
+
+    out = l.groupBy("k").cogroup(r.groupBy("k2")).applyInPandas(
+        fn, "nl bigint, nr bigint").to_pandas()
+    assert len(out) == 2  # key 1 and the shared null key
+    assert sorted(zip(out["nl"], out["nr"])) == [(1, 0), (1, 1)]
+
+
+def test_pandas_agg_keyless_empty_input(session):
+    @F.pandas_agg_udf(returnType="double")
+    def total(series):
+        return float(series.sum())
+
+    df = session.create_dataframe({"v": [1.0, 2.0]})
+    out = df.filter(F.col("v") > 100).agg(total("v").alias("t")) \
+        .to_pandas()
+    assert len(out) == 1 and out["t"][0] == 0.0
+
+
+def test_nonequi_left_join_no_keys_fallback(session):
+    import pandas as pd
+    l = session.create_dataframe({"a": [1.0, 5.0]})
+    r = session.create_dataframe({"b": [3.0]})
+    out = l.join(r, F.col("a") < F.col("b"), how="left").to_pandas() \
+        .sort_values("a").reset_index(drop=True)
+    assert out["a"].tolist() == [1.0, 5.0]
+    assert out["b"][0] == 3.0 and pd.isna(out["b"][1])
+
+
+def test_join_list_of_conditions(session):
+    l = session.create_dataframe({"a": [1, 2], "x": [1.0, 9.0]})
+    r = session.create_dataframe({"b": [1, 2], "y": [5.0, 5.0]})
+    out = l.join(r, [F.col("a") == F.col("b"),
+                     F.col("x") > F.col("y")]).to_pandas()
+    assert out["a"].tolist() == [2]
+
+
+def test_first_of_array_tags_off(session):
+    df = session.create_dataframe({"k": [1], "a": [[1, 2]]})
+    q = df.groupBy("k").agg(F.first("a").alias("f"))
+    tree = session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+
+
+def test_aggregate_cpu_fallback_executes(session):
+    """Aggregates that tag off (e.g. first over arrays) must still run
+    via the CPU fallback, not crash."""
+    import pandas as pd
+    df = session.create_dataframe({"k": [1, 1, 2], "a": [[1], [2], [3]]})
+    q = df.groupBy("k").agg(F.first("a").alias("f"),
+                            F.count("a").alias("c"))
+    out = q.to_pandas().sort_values("k").reset_index(drop=True)
+    assert list(out["f"][0]) == [1] and list(out["f"][1]) == [3]
+    assert out["c"].tolist() == [2, 1]
