@@ -150,6 +150,12 @@ DECIMAL_ENABLED = conf(
     "Enable decimal (DECIMAL_64) processing "
     "(reference RapidsConf.scala:564).", _to_bool)
 
+OPTIMIZER_TRANSITION_COST = conf(
+    "spark.rapids.sql.optimizer.transitionRowCost", 2.0,
+    "Per-row cost weight of a host<->device transition used by the "
+    "cost-based optimizer (relative to ~1.0 per row of CPU operator "
+    "work).", _to_float)
+
 INCOMPAT_ENABLED = conf(
     "spark.rapids.sql.incompatibleOps.enabled", True,
     "Run operators whose semantics differ from CPU Spark in documented "
@@ -224,8 +230,11 @@ PARQUET_READER_TYPE = conf(
 
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
-    "Cost-based fall-back of subplans to CPU when TPU not worth it "
-    "(reference RapidsConf.scala:1177).", _to_bool)
+    "Enable the cost-based optimizer: device regions whose estimated "
+    "speedup cannot pay for the host<->device transition costs are "
+    "reverted to CPU (reference CostBasedOptimizer.scala:35, default "
+    "off).", _to_bool)
+
 
 TEST_ENABLED = conf(
     "spark.rapids.sql.test.enabled", False,

@@ -1,0 +1,149 @@
+"""Cost-based optimizer: force subtrees back to CPU when the device is
+not worth the transitions.
+
+Counterpart of ``CostBasedOptimizer.scala:35-63`` (optional, default off
+via ``spark.rapids.sql.optimizer.enabled`` — RapidsConf.scala:1177): the
+reference walks the tagged meta tree with CPU/GPU cost models plus
+row/columnar transition costs and reverts subtrees whose acceleration
+cannot pay for the boundary crossings.
+
+The TPU formulation works on DEVICE REGIONS: maximal connected subtrees
+of can-replace nodes.  Each region's cost is
+
+    tpu = sum(rows_i * w_tpu(op_i)) + (rows_in + rows_out) * w_transition
+    cpu = sum(rows_i * w_cpu(op_i))
+
+with rows estimated bottom-up (known for in-memory relations, heuristic
+selectivities elsewhere — the reference hardcodes comparable defaults).
+When ``tpu > cpu`` every node in the region is tagged
+"not worth the transition cost (CBO)" and the planner's normal fallback
+machinery does the rest.  A region whose BOUNDARIES are the plan's own
+source/sink (scan feeds it, collect drains it) pays only the sink
+transition — device-resident sources are free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from spark_rapids_tpu.plan import logical as L
+
+# per-row work coefficients (arbitrary units; only ratios matter)
+_CPU_W = {
+    "Project": 1.0, "Filter": 1.0, "Aggregate": 4.0, "Join": 6.0,
+    "Sort": 5.0, "Window": 8.0, "Generate": 2.0, "Limit": 0.1,
+    "Union": 0.1, "default": 1.0,
+}
+# the TPU runs the columnar kernels far faster but pays a fixed per-batch
+# dispatch; the ratio vs _CPU_W encodes the measured ~5-8x engine speedup
+_TPU_W = {k: v / 6.0 for k, v in _CPU_W.items()}
+
+
+def _estimate_rows(node, child_rows: List[float]) -> float:
+    if isinstance(node, L.InMemoryRelation):
+        return float(sum(b.nrows for b in node.batches))
+    if isinstance(node, L.FileRelation):
+        return 1_000_000.0 * max(len(node.paths), 1)
+    if isinstance(node, L.Range):
+        step = node.step or 1
+        return float(max((node.end - node.start) // step, 0))
+    inp = child_rows[0] if child_rows else 0.0
+    if isinstance(node, L.Filter):
+        return inp * 0.5
+    if isinstance(node, L.Aggregate):
+        return max(inp * 0.1, 1.0)
+    if isinstance(node, L.Join):
+        right = child_rows[1] if len(child_rows) > 1 else 0.0
+        return max(inp, right)
+    if isinstance(node, L.Generate):
+        return inp * 4.0
+    if isinstance(node, L.Limit):
+        return min(inp, float(node.n))
+    if isinstance(node, L.Union):
+        return float(sum(child_rows))
+    return inp
+
+
+class CostBasedOptimizer:
+    """optimize(meta) mutates the tagged meta tree in place."""
+
+    def __init__(self, conf):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.transition_w = conf.get(rc.OPTIMIZER_TRANSITION_COST)
+        self.explain: List[str] = []
+
+    def optimize(self, meta) -> None:
+        self._rows: Dict[int, float] = {}
+        self._fill_rows(meta)
+        self._visit_regions(meta, parent_on_tpu=False)
+
+    def _fill_rows(self, meta) -> float:
+        child_rows = [self._fill_rows(c) for c in meta.child_metas]
+        rows = _estimate_rows(meta.wrapped, child_rows)
+        self._rows[id(meta)] = rows
+        return rows
+
+    def _op_name(self, meta) -> str:
+        return type(meta.wrapped).__name__
+
+    @staticmethod
+    def _own_ok(meta) -> bool:
+        """This NODE converts to a device operator (regions are built
+        from per-node viability, NOT the subtree-recursive can_replace:
+        a device region legitimately sits above a CPU-fallback child and
+        must still be cost-evaluated)."""
+        return not meta.reasons
+
+    def _region_cost(self, meta) -> Tuple[float, float, float, List]:
+        """(tpu_work, cpu_work, rows_in_from_cpu, nodes) over the
+        device region rooted at meta."""
+        rows = self._rows[id(meta)]
+        w = self._op_name(meta)
+        tpu = rows * _TPU_W.get(w, _TPU_W["default"])
+        cpu = rows * _CPU_W.get(w, _CPU_W["default"])
+        rows_in = 0.0
+        nodes = [meta]
+        for c in meta.child_metas:
+            if isinstance(c.wrapped, (L.InMemoryRelation,
+                                      L.FileRelation, L.Range)):
+                # leaf relations stay as-is: they source data from the
+                # host either way (no transition, never reverted)
+                continue
+            if self._own_ok(c):
+                t, p, ri, ns = self._region_cost(c)
+                tpu += t
+                cpu += p
+                rows_in += ri
+                nodes.extend(ns)
+            else:
+                # a CPU child feeds this region: entry transition
+                rows_in += self._rows[id(c)]
+        return tpu, cpu, rows_in, nodes
+
+    def _visit_regions(self, meta, parent_on_tpu: bool) -> None:
+        if isinstance(meta.wrapped, (L.InMemoryRelation, L.FileRelation,
+                                     L.Range)):
+            return
+        if self._own_ok(meta) and not parent_on_tpu:
+            tpu, cpu, rows_in, nodes = self._region_cost(meta)
+            rows_out = self._rows[id(meta)]
+            # the region's output always crosses to the host (collect or
+            # a CPU parent)
+            transitions = (rows_in + rows_out) * self.transition_w
+            if tpu + transitions > cpu:
+                for n in nodes:
+                    n.will_not_work(
+                        "not worth the transition cost "
+                        f"(CBO: tpu={tpu + transitions:.0f} > "
+                        f"cpu={cpu:.0f})")
+                self.explain.append(
+                    f"CBO reverted {self._op_name(meta)} region "
+                    f"({len(nodes)} ops) to CPU")
+                for c in meta.child_metas:
+                    self._visit_regions(c, False)
+                return
+            for c in meta.child_metas:
+                self._visit_regions(c, True)
+            return
+        for c in meta.child_metas:
+            self._visit_regions(c, self._own_ok(meta) and parent_on_tpu)

@@ -583,12 +583,20 @@ class TpuOverrides:
                  cache_manager=None):
         self.conf = conf or RapidsConf()
         self.last_explain: str = ""
+        self.last_cbo: List[str] = []
         self.cache_manager = cache_manager
 
     def apply(self, plan: L.LogicalPlan):
         _pushdown_pass(plan, self.cache_manager)
         meta = PlanMeta(plan, self.conf)
         meta.tag()
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.last_cbo = []
+        if self.conf.get(rc.CBO_ENABLED):
+            from spark_rapids_tpu.plan.cbo import CostBasedOptimizer
+            cbo = CostBasedOptimizer(self.conf)
+            cbo.optimize(meta)
+            self.last_cbo = cbo.explain
         self.last_explain = "\n".join(meta.explain_lines())
         if self.conf.explain == "ALL":
             print(self.last_explain)

@@ -1,0 +1,65 @@
+"""Cost-based optimizer (CostBasedOptimizer.scala analog, default off)."""
+
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def test_cbo_off_by_default():
+    s = TpuSession()
+    df = s.create_dataframe({"x": [1, 2, 3]})
+    q = df.filter(F.col("x") > 1)
+    assert "CpuFallbackExec" not in s.plan(q.plan).tree_string()
+
+
+def test_cbo_reverts_tiny_plans():
+    """With a huge transition weight, small plans are not worth the
+    device round trip and revert to CPU."""
+    s = TpuSession({"spark.rapids.sql.optimizer.enabled": "true",
+                    "spark.rapids.sql.optimizer.transitionRowCost": "1e9"})
+    df = s.create_dataframe({"x": [1, 2, 3]})
+    q = df.filter(F.col("x") > 1).select((F.col("x") * 2).alias("y"))
+    tree = s.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert "not worth the transition cost" in s.overrides.last_explain
+    # results stay correct on the CPU path
+    assert q.to_pandas()["y"].tolist() == [4, 6]
+
+
+def test_cbo_keeps_cheap_transitions():
+    """With zero transition cost, plans stay on device."""
+    s = TpuSession({"spark.rapids.sql.optimizer.enabled": "true",
+                    "spark.rapids.sql.optimizer.transitionRowCost": "0"})
+    df = s.create_dataframe({"x": list(range(100))})
+    q = df.filter(F.col("x") > 50)
+    assert "CpuFallbackExec" not in s.plan(q.plan).tree_string()
+
+
+def test_cbo_explain_records_decisions():
+    s = TpuSession({"spark.rapids.sql.optimizer.enabled": "true",
+                    "spark.rapids.sql.optimizer.transitionRowCost": "1e9"})
+    df = s.create_dataframe({"x": [1]})
+    s.plan(df.select((F.col("x") + 1).alias("y")).plan)
+    assert s.overrides.last_cbo
+    assert "reverted" in s.overrides.last_cbo[0]
+
+
+def test_cbo_evaluates_regions_above_fallback_nodes():
+    """Regression: a device region sitting ABOVE a CPU-fallback child
+    must still be cost-evaluated (subtree-recursive can_replace skipped
+    it entirely)."""
+    s = TpuSession({"spark.rapids.sql.optimizer.enabled": "true",
+                    "spark.rapids.sql.optimizer.transitionRowCost": "1e9",
+                    "spark.rapids.sql.exec.Filter": "false"})
+    df = s.create_dataframe({"x": [1, 2, 3]})
+    q = df.filter(F.col("x") > 0).select((F.col("x") * 2).alias("y"))
+    tree = s.plan(q.plan).tree_string()
+    assert "TpuProjectExec" not in tree  # reverted, not sandwiched
+    assert s.overrides.last_cbo
+    assert q.to_pandas()["y"].tolist() == [2, 4, 6]
+
+
+def test_last_cbo_initialized():
+    s = TpuSession()
+    assert s.overrides.last_cbo == []
