@@ -379,6 +379,10 @@ class DataFrame:
         cat = getattr(self.session, "memory_catalog", None)
         host0 = cat.spilled_to_host_total if cat else 0
         disk0 = cat.spilled_to_disk_total if cat else 0
+        from spark_rapids_tpu.memory.retry import retry_metrics
+        # thread-local view: concurrent queries on other threads must not
+        # contaminate this query's attribution
+        retry0 = retry_metrics.snapshot_local()
         t0 = _time.perf_counter()
         status = "success"
         try:
@@ -392,10 +396,12 @@ class DataFrame:
                 "spilledToHostBytes": cat.spilled_to_host_total - host0,
                 "spilledToDiskBytes": cat.spilled_to_disk_total - disk0,
             }
+            retry1 = retry_metrics.snapshot_local()
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
                 durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
-                metrics=exec_plan.collect_metrics(), spill=spill)
+                metrics=exec_plan.collect_metrics(), spill=spill,
+                retry={k: retry1[k] - retry0[k] for k in retry1})
 
     def to_arrow(self):
         import pyarrow as pa

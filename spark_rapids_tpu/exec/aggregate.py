@@ -226,28 +226,35 @@ class TpuHashAggregateExec(TpuExec):
                 [(b.values, b.validity, b.offsets) for b in out_bufs], n)
 
     def _partial_batches(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.retry import with_retry
         names = [n for n, _ in self._partial_schema]
         dtypes = [dt for _, dt in self._partial_schema]
-        for batch in self.child.execute():
-            self.metrics[NUM_INPUT_ROWS] += batch.nrows
-            self.metrics[NUM_INPUT_BATCHES] += 1
-            if batch.nrows == 0:
-                continue
+
+        def tallied():
+            for batch in self.child.execute():
+                self.metrics[NUM_INPUT_ROWS] += batch.nrows
+                self.metrics[NUM_INPUT_BATCHES] += 1
+                if batch.nrows:
+                    yield batch
+
+        def compute(batch):
             with self.timer(AGG_TIME):
                 if self._string_key_idx:
-                    yield self._partial_with_string_keys(batch, names, dtypes)
-                else:
-                    key_flat, buf_flat, n = self._update_fn(
-                        batch_to_flat(batch), jnp.int32(batch.nrows))
-                    # keyless reductions have statically one output row;
-                    # skip the device->host sync (it costs a full tunnel
-                    # round-trip per batch)
-                    n = 1 if not self.group_exprs else int(n)
-                    outs = [ColVal(dt, v, val, offs)
-                            for dt, (v, val, offs) in
-                            zip(dtypes, list(key_flat) + list(buf_flat))]
-                    cols = colvals_to_columns(outs, n, batch.capacity)
-                    yield ColumnarBatch(dict(zip(names, cols)), n)
+                    return self._partial_with_string_keys(
+                        batch, names, dtypes)
+                key_flat, buf_flat, n = self._update_fn(
+                    batch_to_flat(batch), jnp.int32(batch.nrows))
+                # keyless reductions have statically one output row;
+                # skip the device->host sync (it costs a full tunnel
+                # round-trip per batch)
+                n = 1 if not self.group_exprs else int(n)
+                outs = [ColVal(dt, v, val, offs)
+                        for dt, (v, val, offs) in
+                        zip(dtypes, list(key_flat) + list(buf_flat))]
+                cols = colvals_to_columns(outs, n, batch.capacity)
+                return ColumnarBatch(dict(zip(names, cols)), n)
+
+        yield from with_retry(tallied(), compute)
 
     def _partial_with_string_keys(self, batch, names, dtypes):
         nkeys = len(self.group_exprs)

@@ -65,10 +65,14 @@ class TpuProjectExec(TpuExec):
         return [(e.name, e.dtype) for e in self.exprs]
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.retry import with_retry
         names = [e.name for e in self.exprs]
-        for batch in self.child.execute():
+
+        def compute(batch):
             cols = self._fn(batch)
-            yield ColumnarBatch(dict(zip(names, cols)), batch.nrows)
+            return ColumnarBatch(dict(zip(names, cols)), batch.nrows)
+
+        yield from with_retry(self.child.execute(), compute)
 
     def describe(self):
         return f"TpuProjectExec[{', '.join(e.name for e in self.exprs)}]"
@@ -96,13 +100,22 @@ class TpuFilterExec(TpuExec):
         return self.child.schema
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.retry import with_retry
         names = [n for n, _ in self.schema]
-        for batch in self.child.execute():
-            self.metrics[NUM_INPUT_ROWS] += batch.nrows
+
+        def tallied():
+            for batch in self.child.execute():
+                self.metrics[NUM_INPUT_ROWS] += batch.nrows
+                yield batch
+
+        def compute(batch):
             cols, n = self._fn(batch)
-            if n == 0:
-                continue
-            yield ColumnarBatch(dict(zip(names, cols)), n)
+            return None if n == 0 else \
+                ColumnarBatch(dict(zip(names, cols)), n)
+
+        for out in with_retry(tallied(), compute):
+            if out is not None:
+                yield out
 
     def describe(self):
         return f"TpuFilterExec[{self.condition}]"

@@ -125,18 +125,30 @@ class TpuHashJoinExec(TpuExec):
             else (self.left, self.right)
         probe_fn, build_fn = (self._rkey_fn, self._lkey_fn) if self._swap \
             else (self._lkey_fn, self._rkey_fn)
+        from spark_rapids_tpu.memory.retry import (
+            with_retry, with_retry_no_split)
         build_batches = list(build_exec.execute())
         if not build_batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
             build = empty_batch(build_exec.schema, capacity=1)
         else:
-            build = concat_batches(build_batches)
-        build_keys = self._encoded_keys(build, build_fn)
+            # the join's single largest device allocation — guard it
+            build = with_retry_no_split(
+                lambda: concat_batches(build_batches))
+            del build_batches
+        build_keys = with_retry_no_split(
+            lambda: self._encoded_keys(build, build_fn))
         build_payload = _to_colvals(build)
         b_matched_acc = None
 
         outer = self.join_type in ("left", "right", "full")
-        for batch in probe_exec.execute():
+
+        # the match phase per probe batch; OOM recovery may split the
+        # probe side — safe for every join type (build-matched flags
+        # accumulate across splits the same way they do across batches,
+        # and logical_or is idempotent under re-attempts)
+        def match_one(batch):
+            nonlocal b_matched_acc
             with self.timer(JOIN_TIME):
                 probe_keys = self._encoded_keys(batch, probe_fn)
                 m = J.join_match(build_keys, probe_keys,
@@ -146,21 +158,36 @@ class TpuHashJoinExec(TpuExec):
                     bm = m["build_matched"]
                     b_matched_acc = bm if b_matched_acc is None else \
                         jnp.logical_or(b_matched_acc, bm)
+            return batch, m
+
+        for batch, m in with_retry(probe_exec.execute(), match_one):
+            with self.timer(JOIN_TIME):
                 if self.join_type in ("semi", "anti"):
-                    yield from self._emit_semi_anti(batch, m)
+                    # output <= one probe batch: spill-retry suffices
+                    yield from with_retry_no_split(
+                        lambda: list(self._emit_semi_anti(batch, m)))
                     continue
-                count, starts, ends, total = J.join_out_starts(
-                    m["probe_count"], jnp.int32(batch.nrows), outer)
+                count, starts, ends, total = with_retry_no_split(
+                    lambda: J.join_out_starts(
+                        m["probe_count"], jnp.int32(batch.nrows), outer))
                 total = int(total)
-                if total == 0:
-                    continue
+                # chunks stream one at a time (peak HBM stays bounded by
+                # max_output_rows); each emit gets spill-retry only — its
+                # size is already the configured bound, not splittable
                 for off in range(0, total, self.max_output_rows):
                     n_out = min(self.max_output_rows, total - off)
-                    yield self._emit_chunk(batch, build, build_payload, m,
-                                           count, starts, ends, off, n_out)
+                    yield with_retry_no_split(
+                        lambda off=off, n_out=n_out: self._emit_chunk(
+                            batch, build, build_payload, m,
+                            count, starts, ends, off, n_out))
         if self.join_type == "full":
-            yield from self._emit_unmatched_build(build, build_payload,
-                                                  b_matched_acc)
+            if b_matched_acc is None:
+                # probe side produced zero batches: every build row is
+                # unmatched
+                b_matched_acc = jnp.zeros(build.capacity, dtype=bool)
+            yield from with_retry_no_split(
+                lambda: list(self._emit_unmatched_build(
+                    build, build_payload, b_matched_acc)))
 
     def _emit_chunk(self, probe_batch, build, build_payload, m, count,
                     starts, ends, offset, n_out) -> ColumnarBatch:

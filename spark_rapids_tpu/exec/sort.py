@@ -121,12 +121,19 @@ class TpuSortExec(TpuExec):
         if len(handles) > 1 and total_bytes > self.ooc_threshold_bytes:
             yield from self._out_of_core(handles, catalog)
             return
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
         with self.timer(SORT_TIME):
-            batches = [h.materialize() for h in handles]
-            merged = concat_batches(batches)
+            # materialize + concat is this operator's peak allocation;
+            # it needs the spill-retry guard as much as the sort itself
+            def gather_input():
+                batches = [h.materialize() for h in handles]
+                return concat_batches(batches)
+
+            merged = with_retry_no_split(gather_input, catalog=catalog)
             for h in handles:
                 h.close()
-            outs = self._sorted_batch(merged)
+            outs = with_retry_no_split(
+                lambda: self._sorted_batch(merged), catalog=catalog)
         yield self._emit(outs, merged.nrows)
 
     # ------------------------------------------------------- out-of-core --
@@ -172,21 +179,48 @@ class TpuSortExec(TpuExec):
         the carry holds at most one window per live run and the working
         set stays <= ~2 * runs * window rows even for disjoint-range
         runs (e.g. pre-sorted input split into batches)."""
+        from spark_rapids_tpu.memory.retry import (
+            with_retry, with_retry_no_split)
         window = self.ooc_window_rows
         with self.timer(SORT_TIME):
             runs = []  # {"chunks": [spillable handles], "next": int}
-            for h in handles:
-                b = h.materialize()
-                h.close()
+
+            # materialize is itself a device allocation: guard it with
+            # spill-retry (a generator would die on the first raise, so
+            # the pull happens in the loop body, not upstream of
+            # with_retry)
+            def materialized():
+                for h in handles:
+                    b = with_retry_no_split(h.materialize, catalog=catalog)
+                    h.close()
+                    yield b
+
+            # OOM during run building splits the input batch; each half
+            # simply becomes its own sorted run — the merge phase is
+            # indifferent to run count
+            def build_run(b):
                 outs = self._sorted_batch(b)
                 sb = self._emit(outs, b.nrows)
                 chunks = []
-                for start in range(0, sb.nrows, window):
-                    take = min(window, sb.nrows - start)
-                    chunks.append(catalog.register(self._slice_rows(
-                        sb, start, take, bucket_capacity(take))))
+                try:
+                    for start in range(0, sb.nrows, window):
+                        take = min(window, sb.nrows - start)
+                        chunks.append(catalog.register(self._slice_rows(
+                            sb, start, take, bucket_capacity(take))))
+                except BaseException:
+                    # a retry re-runs the whole function; orphaned
+                    # handles from the failed attempt must not stay
+                    # pinned in the catalog
+                    for ch in chunks:
+                        ch.close()
+                    raise
                 if chunks:
                     runs.append({"chunks": chunks, "next": 0})
+                return True
+
+            for _ in with_retry(materialized(), build_run,
+                                catalog=catalog):
+                pass
         carry: ColumnarBatch = None
         carry_tags = np.zeros(0, dtype=np.int32)
         need = set(range(len(runs)))
@@ -200,7 +234,8 @@ class TpuSortExec(TpuExec):
                         continue
                     ch = run["chunks"][run["next"]]
                     run["next"] += 1
-                    win = ch.materialize()
+                    win = with_retry_no_split(ch.materialize,
+                                              catalog=catalog)
                     ch.close()
                     exhausted = run["next"] >= len(run["chunks"])
                     tag = np.full(win.nrows, -1, dtype=np.int32)

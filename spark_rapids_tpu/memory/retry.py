@@ -1,0 +1,261 @@
+"""Split-and-retry OOM framework.
+
+TPU analog of the reference's device-OOM recovery discipline
+(``DeviceMemoryEventHandler.scala:43`` ``onAllocFailure`` — spill the device
+store and reattempt the allocation — plus the split-and-retry iterator
+pattern its operators layer on top: when an attempt still OOMs after
+spilling, halve the work and process the halves independently).
+
+XLA owns HBM and there is no allocation callback to hook, so recovery is
+exception-driven instead: a device computation that exhausts HBM surfaces as
+``XlaRuntimeError: RESOURCE_EXHAUSTED``.  The framework catches exactly
+that, demotes every registered spillable batch off the device, and retries;
+a second failure at the same size splits the input batch in half
+(recursively, down to a floor) so each attempt needs less scratch HBM.
+
+Failure *detection* is also centralised here: ``is_oom`` classifies
+exceptions, and every recovery step is recorded on the ``RetryMetrics``
+singleton so the profiling tool can report retry/split counts per query.
+
+Test hook: ``inject_oom(n)`` forces the next ``n`` guarded attempts to
+raise a synthetic OOM, mirroring how the reference's tests force RMM retry
+paths without real exhaustion.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+# markers XLA / jax use for device-memory exhaustion
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM ", "Attempting to reserve")
+
+
+class InjectedOomError(MemoryError):
+    """Synthetic OOM raised by the test-injection hook."""
+
+
+class SplitAndRetryOOM(MemoryError):
+    """Raised when an attempt still OOMs at the minimum split size —
+    the work cannot be made to fit no matter how small the batch."""
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True for *device* memory exhaustion only.  A plain host
+    ``MemoryError`` is deliberately NOT recoverable: the recovery path
+    (spill to host, Arrow split round-trip) allocates host memory and
+    would amplify the very pressure that raised it."""
+    if isinstance(exc, InjectedOomError):
+        return True
+    if isinstance(exc, MemoryError):
+        return False
+    text = str(exc)
+    return any(m in text for m in _OOM_MARKERS)
+
+
+# ---------------------------------------------------------------- injection --
+class _Injector(threading.local):
+    def __init__(self):
+        self.remaining = 0
+        self.skip = 0
+
+
+_injector = _Injector()
+
+
+def inject_oom(num_ooms: int = 1, skip: int = 0) -> None:
+    """Force the next ``num_ooms`` guarded attempts (after skipping
+    ``skip``) on this thread to raise ``InjectedOomError``."""
+    _injector.remaining = num_ooms
+    _injector.skip = skip
+
+
+def clear_injected_oom() -> None:
+    _injector.remaining = 0
+    _injector.skip = 0
+
+
+def _checkpoint() -> None:
+    if _injector.remaining > 0:
+        if _injector.skip > 0:
+            _injector.skip -= 1
+            return
+        _injector.remaining -= 1
+        raise InjectedOomError("injected OOM (test hook)")
+
+
+# ------------------------------------------------------------------ metrics --
+class RetryMetrics:
+    """Recovery counters, surfaced by tools/profiling.py.
+
+    Process-wide totals plus a thread-local mirror: a query executes its
+    operator pipeline on the calling thread, so per-query deltas read the
+    thread-local view — concurrent queries on other threads (or other
+    sessions) don't contaminate each other's QueryEnd attribution."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.retry_count = 0
+        self.split_count = 0
+        self.spilled_on_retry = 0
+        self._local = threading.local()
+
+    def _bump(self, retries=0, splits=0, spilled=0) -> None:
+        with self.lock:
+            self.retry_count += retries
+            self.split_count += splits
+            self.spilled_on_retry += spilled
+        loc = self._local
+        loc.retry_count = getattr(loc, "retry_count", 0) + retries
+        loc.split_count = getattr(loc, "split_count", 0) + splits
+        loc.spilled_on_retry = getattr(loc, "spilled_on_retry", 0) + spilled
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {"retryCount": self.retry_count,
+                    "splitAndRetryCount": self.split_count,
+                    "spilledOnRetryBytes": self.spilled_on_retry}
+
+    def snapshot_local(self) -> dict:
+        """This thread's counters — the per-query attribution view."""
+        loc = self._local
+        return {"retryCount": getattr(loc, "retry_count", 0),
+                "splitAndRetryCount": getattr(loc, "split_count", 0),
+                "spilledOnRetryBytes": getattr(loc, "spilled_on_retry", 0)}
+
+    def reset(self) -> None:
+        with self.lock:
+            self.retry_count = 0
+            self.split_count = 0
+            self.spilled_on_retry = 0
+        self._local = threading.local()
+
+
+retry_metrics = RetryMetrics()
+
+
+# ----------------------------------------------------------------- recovery --
+# serializes the budget save/zero/restore dance: without it two threads
+# recovering concurrently can capture the other's zeroed budget as
+# "saved" and leave the shared catalog pinned at budget 0 forever
+_recovery_lock = threading.Lock()
+
+
+def _spill_device_store(catalog=None) -> int:
+    """Demote every registered spillable batch off the device (the
+    synchronousSpill(targetSize=0) step of onAllocFailure)."""
+    if catalog is None:
+        from spark_rapids_tpu.memory.spill import default_catalog
+        catalog = default_catalog()
+    with _recovery_lock:
+        before = catalog.spilled_to_host_total
+        saved = catalog.device_budget
+        try:
+            catalog.device_budget = 0
+            catalog.ensure_budget()
+        finally:
+            catalog.device_budget = saved
+        return catalog.spilled_to_host_total - before
+
+
+def _handle_oom(catalog=None) -> None:
+    """Must run AFTER the except block that caught the OOM has exited:
+    while the handler is live, the exception's traceback pins the failed
+    attempt's frame (and its device-array locals), so a gc pass inside
+    the handler could not reclaim the very buffers we need back."""
+    # drop dead device buffers eagerly so XLA can actually reuse the HBM
+    import gc
+    gc.collect()
+    freed = _spill_device_store(catalog)
+    retry_metrics._bump(retries=1, spilled=freed)
+
+
+# ----------------------------------------------------------------- wrappers --
+def with_retry_no_split(fn: Callable[[], R], *, catalog=None,
+                        max_retries: int = 2) -> R:
+    """Run ``fn``; on device OOM spill the device store and rerun, up to
+    ``max_retries`` recoveries.  For attempts whose input cannot be
+    subdivided (e.g. emitting one already-sized output batch)."""
+    attempt = 0
+    while True:
+        try:
+            _checkpoint()
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not is_oom(e) or attempt >= max_retries:
+                raise
+            attempt += 1
+        # recovery runs here, after the except block has exited and the
+        # exception (whose traceback pins the failed attempt's frame and
+        # device locals) is cleared — see _handle_oom
+        _handle_oom(catalog)
+
+
+def split_batch_in_half(batch) -> List:
+    """Default splitter: one ColumnarBatch -> two of half the rows.
+
+    Goes through Arrow (host) deliberately — this is the rare recovery
+    path, and a host round-trip both frees the device copy and
+    re-materialises compact halves (the contiguous-split analog)."""
+    n = batch.nrows
+    if n <= 1:
+        raise SplitAndRetryOOM(
+            f"cannot split batch of {n} row(s) any further")
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    table = batch.to_arrow()
+    mid = n // 2
+    return [ColumnarBatch.from_arrow(table.slice(0, mid)),
+            ColumnarBatch.from_arrow(table.slice(mid, n - mid))]
+
+
+def with_retry(inputs: Iterable[T], fn: Callable[[T], R], *,
+               split: Callable[[T], List[T]] = split_batch_in_half,
+               catalog=None) -> Iterator[R]:
+    """Map ``fn`` over ``inputs`` with OOM recovery.
+
+    Per input: first OOM spills the device store and retries at full
+    size; an OOM on the retry splits the input and pushes the halves
+    back on the work queue (each half gets the same spill-then-split
+    treatment, recursively).  Yields one result per final attempt, so
+    callers must tolerate ``fn``'s unit of work shrinking.  ``inputs``
+    is consumed lazily — one upstream batch is live at a time."""
+    upstream = iter(inputs)
+    queue: deque = deque()
+    while True:
+        if queue:
+            item = queue.popleft()
+        else:
+            try:
+                item = next(upstream)
+            except StopIteration:
+                return
+        spilled_once = False
+        while True:
+            must_split = False
+            try:
+                _checkpoint()
+                yield fn(item)
+                break
+            except Exception as e:  # noqa: BLE001 - classified below
+                # SplitAndRetryOOM (raised by split at the 1-row floor)
+                # re-raises here: is_oom is False for host MemoryErrors
+                if not is_oom(e):
+                    raise
+                must_split = spilled_once
+            # recovery runs after the except block so the cleared
+            # exception no longer pins the failed attempt's device
+            # locals — see _handle_oom
+            if not must_split:
+                spilled_once = True
+                _handle_oom(catalog)
+                continue
+            halves = split(item)
+            retry_metrics._bump(splits=1)
+            for h in reversed(halves):
+                queue.appendleft(h)
+            break

@@ -25,6 +25,7 @@ class QueryInfo:
     duration_ms: float = 0.0
     metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
     spill: Dict[str, int] = field(default_factory=dict)
+    retry: Dict[str, int] = field(default_factory=dict)
 
     @property
     def succeeded(self) -> bool:
@@ -83,6 +84,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.duration_ms = rec.get("durationMs", 0.0)
                 q.metrics = rec.get("metrics", {})
                 q.spill = rec.get("spill", {})
+                q.retry = rec.get("retry", {})
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed
     for q in open_queries.values():

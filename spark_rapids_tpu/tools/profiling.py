@@ -73,6 +73,12 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                 problems.append(
                     f"{a.session_id} query {q.query_id}: spilled "
                     f"{spilled} bytes")
+            retries = q.retry.get("retryCount", 0) if q.retry else 0
+            splits = q.retry.get("splitAndRetryCount", 0) if q.retry else 0
+            if retries or splits:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: device OOM "
+                    f"recovered — {retries} retries, {splits} splits")
     return problems
 
 

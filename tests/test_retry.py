@@ -1,0 +1,218 @@
+"""Split-and-retry OOM framework tests.
+
+Oracle pattern: inject synthetic OOMs (the RmmSpark force-retry analog) and
+assert the recovered result equals the uninjected run — mirroring how the
+reference tests its device-OOM retry discipline without real exhaustion.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory.spill import (
+    SpillableBatchCatalog, default_catalog, set_default_catalog)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    R.clear_injected_oom()
+    R.retry_metrics.reset()
+    yield
+    R.clear_injected_oom()
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict({
+        "a": rng.integers(0, 1000, n),
+        "b": rng.normal(size=n),
+    })
+
+
+# ------------------------------------------------------------ classification --
+def test_is_oom_markers():
+    # a plain host MemoryError is NOT recoverable (recovery allocates host
+    # memory and would amplify it); only device exhaustion qualifies
+    assert not R.is_oom(MemoryError("x"))
+    assert R.is_oom(R.InjectedOomError("x"))
+    assert R.is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"))
+    assert not R.is_oom(ValueError("bad shape"))
+
+
+# ------------------------------------------------------- with_retry_no_split --
+def test_no_split_retries_and_spills():
+    cat = SpillableBatchCatalog(device_budget=1 << 30)
+    h = cat.register(_batch())
+    assert h.tier == "DEVICE"
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return 42
+
+    R.inject_oom(1)
+    assert R.with_retry_no_split(fn, catalog=cat) == 42
+    assert len(calls) == 1  # first attempt died at the checkpoint
+    assert h.tier != "DEVICE"  # device store was spilled on recovery
+    assert R.retry_metrics.snapshot()["retryCount"] == 1
+
+
+def test_no_split_gives_up_after_max_retries():
+    cat = SpillableBatchCatalog()
+    R.inject_oom(5)
+    with pytest.raises(R.InjectedOomError):
+        R.with_retry_no_split(lambda: 1, catalog=cat, max_retries=2)
+
+
+def test_non_oom_errors_pass_through():
+    cat = SpillableBatchCatalog()
+    with pytest.raises(ValueError):
+        R.with_retry_no_split(
+            lambda: (_ for _ in ()).throw(ValueError("no")), catalog=cat)
+
+
+# ---------------------------------------------------------------- with_retry --
+def test_retry_splits_after_second_oom():
+    cat = SpillableBatchCatalog()
+    b = _batch(100)
+    # 2 OOMs: full-size attempt + post-spill attempt -> split in half
+    R.inject_oom(2)
+    outs = list(R.with_retry([b], lambda x: x.nrows, catalog=cat))
+    assert sum(outs) == 100
+    assert len(outs) >= 2
+    snap = R.retry_metrics.snapshot()
+    assert snap["splitAndRetryCount"] >= 1
+
+
+def test_retry_split_preserves_rows():
+    cat = SpillableBatchCatalog()
+    b = _batch(101, seed=3)
+    want = b.to_pandas()
+    R.inject_oom(2)
+    parts = list(R.with_retry([b], lambda x: x.to_pandas(), catalog=cat))
+    got = pd.concat(parts, ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_retry_unsplittable_raises():
+    cat = SpillableBatchCatalog()
+    b = _batch(1)
+    R.inject_oom(20)
+    with pytest.raises(R.SplitAndRetryOOM):
+        list(R.with_retry([b], lambda x: x.nrows, catalog=cat))
+
+
+def test_retry_is_lazy_over_upstream():
+    pulled = []
+
+    def upstream():
+        for i in range(5):
+            pulled.append(i)
+            yield _batch(10, seed=i)
+
+    it = R.with_retry(upstream(), lambda b: b.nrows)
+    next(it)
+    assert pulled == [0]  # nothing pre-materialized
+
+
+# ------------------------------------------------------------- through execs --
+def _run_with_oom(session, df, num_ooms, skip=0):
+    R.clear_injected_oom()
+    want = df.to_pandas()
+    R.inject_oom(num_ooms, skip=skip)
+    got = df.to_pandas()
+    R.clear_injected_oom()
+    return want, got
+
+
+def test_project_filter_recover(session):
+    rng = np.random.default_rng(7)
+    pdf = pd.DataFrame({"x": rng.integers(0, 100, 500),
+                        "y": rng.normal(size=500)})
+    df = (session.create_dataframe(pdf)
+          .filter(F.col("x") > 20)
+          .select((F.col("x") * 2 + 1).alias("x2"), F.col("y")))
+    want, got = _run_with_oom(session, df, num_ooms=2)
+    pd.testing.assert_frame_equal(
+        got.sort_values("x2").reset_index(drop=True),
+        want.sort_values("x2").reset_index(drop=True))
+
+
+def test_aggregate_recover(session):
+    rng = np.random.default_rng(8)
+    pdf = pd.DataFrame({"k": rng.integers(0, 9, 400),
+                        "v": rng.normal(size=400)})
+    df = (session.create_dataframe(pdf)
+          .group_by("k").agg(F.sum(F.col("v")).alias("s"),
+                             F.count(F.col("v")).alias("c")))
+    want, got = _run_with_oom(session, df, num_ooms=2)
+    g = got.sort_values("k").reset_index(drop=True)
+    w = want.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w)
+
+
+def test_join_recover(session):
+    rng = np.random.default_rng(9)
+    left = pd.DataFrame({"k": rng.integers(0, 30, 200),
+                         "lv": rng.normal(size=200).round(3)})
+    right = pd.DataFrame({"k": rng.integers(0, 30, 150),
+                          "rv": rng.integers(0, 99, 150)})
+    df = (session.create_dataframe(left)
+          .join(session.create_dataframe(right), on="k", how="inner"))
+    want, got = _run_with_oom(session, df, num_ooms=2, skip=1)
+    key = sorted(got.columns)
+    g = got[key].sort_values(key).reset_index(drop=True)
+    w = want[key].sort_values(key).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w, check_dtype=False)
+
+
+def test_retry_counts_in_event_log(tmp_path):
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    pdf = pd.DataFrame({"x": np.arange(50), "y": np.arange(50) * 0.5})
+    df = s.create_dataframe(pdf).select((F.col("x") + 1).alias("x1"))
+    R.inject_oom(2)
+    df.to_pandas()
+    R.clear_injected_oom()
+    apps = load_logs(str(tmp_path))
+    assert apps
+    retried = [q for a in apps for q in a.queries
+               if q.retry.get("retryCount", 0) or
+               q.retry.get("splitAndRetryCount", 0)]
+    assert retried, "QueryEnd should carry the per-query retry deltas"
+
+
+def test_full_join_empty_probe(session):
+    # probe side filtered to zero batches: every build row must come
+    # back null-extended (regression: b_matched_acc stayed None)
+    l = session.create_dataframe(
+        pd.DataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    ).filter(F.col("k") > 99)
+    r = session.create_dataframe(pd.DataFrame({"k": [1, 2], "w": [10, 20]}))
+    out = l.join(r, on="k", how="full").to_pandas()
+    assert len(out) == 2
+    assert out["v"].isna().all()
+    assert sorted(out["w"].tolist()) == [10, 20]
+
+
+def test_sort_recover(session):
+    rng = np.random.default_rng(10)
+    pdf = pd.DataFrame({"k": rng.integers(0, 1000, 300),
+                        "v": rng.normal(size=300)})
+    df = session.create_dataframe(pdf).orderBy("k")
+    want, got = _run_with_oom(session, df, num_ooms=1)
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True).sort_values(["k", "v"])
+           .reset_index(drop=True),
+        want.reset_index(drop=True).sort_values(["k", "v"])
+            .reset_index(drop=True))
