@@ -128,7 +128,10 @@ class DataFrame:
         return [n for n, _ in self.plan.schema]
 
     def select(self, *cols: Union[Col, str]) -> "DataFrame":
+        from spark_rapids_tpu.ops.nested_ops import \
+            expand_nested_projections
         exprs = [_expr(c) for c in cols]
+        exprs = expand_nested_projections(exprs, self.plan.schema)
         gen = self._route_generate(exprs)
         if gen is not None:
             return gen
@@ -405,11 +408,15 @@ class DataFrame:
 
     def to_arrow(self):
         import pyarrow as pa
+        from spark_rapids_tpu.columnar import nested
         batches = self._execute_batches()
         if not batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
-            return empty_batch(self.plan.schema).to_arrow()
-        return pa.concat_tables(b.to_arrow() for b in batches)
+            table = empty_batch(self.plan.schema).to_arrow()
+        else:
+            table = pa.concat_tables(b.to_arrow() for b in batches)
+        # shredded struct/map columns reassemble at the output boundary
+        return nested.assemble_table(table)
 
     def to_pandas(self):
         return self.to_arrow().to_pandas()

@@ -151,9 +151,17 @@ class Col:
         return Col(RLike(self.expr, pattern))
 
     def getItem(self, key) -> "Col":
+        if isinstance(key, str):
+            return self.getField(key)
         from spark_rapids_tpu.ops.collections_ops import GetArrayItem
         from spark_rapids_tpu.ops.expressions import Literal
         return Col(GetArrayItem(self.expr, Literal(int(key))))
+
+    def getField(self, field: str) -> "Col":
+        from spark_rapids_tpu.ops.nested_ops import GetStructField
+        return Col(GetStructField(self.expr, field))
+
+    __getitem__ = getItem
 
     def like(self, pattern: str) -> "Col":
         from spark_rapids_tpu.ops import stringops as S
@@ -697,6 +705,42 @@ def get_array_item(c, index) -> Col:
 def element_at(c, index) -> Col:
     from spark_rapids_tpu.ops.collections_ops import ElementAt
     return Col(ElementAt(_expr(c), _lit_expr(index)))
+
+
+def struct(*cols) -> Col:
+    """struct(c1, c2, ...) — field names come from each column's
+    name/alias (complexTypeCreator.scala CreateNamedStruct)."""
+    from spark_rapids_tpu.ops.nested_ops import CreateNamedStruct
+    pairs = []
+    for c in cols:
+        e = _expr(c)
+        from spark_rapids_tpu.ops.expressions import Alias
+        if isinstance(e, Alias):
+            pairs.append((e.alias, e.children[0]))
+        else:
+            pairs.append((e.name, e))
+    return Col(CreateNamedStruct(pairs))
+
+
+def create_map(*entries) -> Col:
+    """create_map(k1, v1, k2, v2, ...)."""
+    from spark_rapids_tpu.ops.nested_ops import CreateMap
+    return Col(CreateMap(*[_lit_expr(e) for e in entries]))
+
+
+def map_keys(c) -> Col:
+    from spark_rapids_tpu.ops.nested_ops import MapKeys
+    return Col(MapKeys(_expr(c)))
+
+
+def map_values(c) -> Col:
+    from spark_rapids_tpu.ops.nested_ops import MapValues
+    return Col(MapValues(_expr(c)))
+
+
+def get_map_value(c, key) -> Col:
+    from spark_rapids_tpu.ops.nested_ops import GetMapValue
+    return Col(GetMapValue(_expr(c), _lit_expr(key)))
 
 
 def sort_array(c, asc: bool = True) -> Col:

@@ -104,6 +104,9 @@ class ColumnarBatch:
 
     @classmethod
     def from_arrow(cls, table, capacity: Optional[int] = None) -> "ColumnarBatch":
+        from spark_rapids_tpu.columnar import nested
+        if nested.has_nested(table):
+            table = nested.shred_table(table)
         nrows = table.num_rows
         cap = capacity or bucket_capacity(nrows)
         cols = {name: Column.from_arrow(table.column(name), capacity=cap)

@@ -40,6 +40,14 @@ class DataType:
     # dtype — an array column is flat element values + int32 row offsets,
     # the same layout strings use for their chars)
     element: Optional["DataType"] = None
+    # struct only: ((field_name, DataType), ...); map uses exactly two
+    # pseudo-fields ("key", K) and ("value", V).  Nested types never
+    # materialize as device containers — they are SHREDDED into flat
+    # physical columns (struct field "a" of column "s" lives as column
+    # "s.a"; a map "m" as two aligned array columns "m.__key" /
+    # "m.__value") and reassembled only at the Arrow output boundary.
+    # The dot and the __key/__value suffixes are reserved naming.
+    fields: Optional[tuple] = None
 
     # ---- classification helpers -------------------------------------------------
     @property
@@ -49,6 +57,28 @@ class DataType:
     @property
     def is_array(self) -> bool:
         return self.element is not None
+
+    @property
+    def is_struct(self) -> bool:
+        return self.fields is not None and not self.name.startswith("map<")
+
+    @property
+    def is_map(self) -> bool:
+        return self.fields is not None and self.name.startswith("map<")
+
+    @property
+    def is_nested(self) -> bool:
+        return self.fields is not None
+
+    @property
+    def key_type(self) -> "DataType":
+        assert self.is_map
+        return self.fields[0][1]
+
+    @property
+    def value_type(self) -> "DataType":
+        assert self.is_map
+        return self.fields[1][1]
 
     @property
     def has_offsets(self) -> bool:
@@ -123,6 +153,31 @@ def ArrayType(element: DataType) -> DataType:
                     element=element)
 
 
+def StructType(fields) -> DataType:
+    """STRUCT<f1: t1, ...> — a logical grouping over shredded flat columns
+    (see the ``fields`` attribute note above; GpuColumnVector keeps these
+    as cudf struct children, here each field is an ordinary flat column,
+    which is the layout XLA wants anyway)."""
+    fields = tuple((str(n), t) for n, t in fields)
+    if not fields:
+        raise ValueError("struct needs at least one field")
+    inner = ",".join(f"{n}:{t.name}" for n, t in fields)
+    return DataType(f"struct<{inner}>", np.dtype(np.uint8), fields=fields)
+
+
+def MapType(key: DataType, value: DataType) -> DataType:
+    """MAP<K, V> — shredded to two aligned array columns (same per-row
+    offsets): ``<name>.__key`` of ARRAY<K> and ``<name>.__value`` of
+    ARRAY<V>."""
+    if key.has_offsets or value.has_offsets or key.is_nested \
+            or value.is_nested:
+        raise ValueError(
+            f"map<{key},{value}> unsupported: key/value must be "
+            "fixed-width scalar types")
+    return DataType(f"map<{key.name},{value.name}>", np.dtype(np.uint8),
+                    fields=(("key", key), ("value", value)))
+
+
 def DecimalType(precision: int, scale: int) -> DataType:
     """DECIMAL_64 only, like the reference snapshot (precision <= 18)."""
     if precision > 18:
@@ -180,6 +235,11 @@ def from_numpy_dtype(dt) -> DataType:
 
 def from_arrow_type(at) -> DataType:
     import pyarrow as pa
+    if pa.types.is_struct(at):
+        return StructType((f.name, from_arrow_type(f.type)) for f in at)
+    if pa.types.is_map(at):
+        return MapType(from_arrow_type(at.key_type),
+                       from_arrow_type(at.item_type))
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ArrayType(from_arrow_type(at.value_type))
     if pa.types.is_boolean(at):
@@ -235,4 +295,10 @@ def to_arrow_type(dt: DataType):
         return pa.timestamp("us", tz="UTC")
     if dt.is_decimal:
         return pa.decimal128(dt.precision, dt.scale)
+    if dt.is_map:
+        return pa.map_(to_arrow_type(dt.key_type),
+                       to_arrow_type(dt.value_type))
+    if dt.is_struct:
+        return pa.struct([pa.field(n, to_arrow_type(t))
+                          for n, t in dt.fields])
     raise ValueError(f"no arrow type for {dt}")

@@ -27,15 +27,22 @@ from spark_rapids_tpu.ops.expressions import ColVal, Expression
 class TpuGenerateExec(TpuExec):
     def __init__(self, generator: Expression, required: Sequence[Expression],
                  position: bool, child: TpuExec,
-                 col_name: str = "col", pos_name: str = "pos"):
+                 col_name: str = "col", pos_name: str = "pos",
+                 generator2: Expression = None):
         super().__init__(child)
         self.generator = generator
+        # map explode: generator2 is the value array (same offsets as
+        # the key array in `generator`), emitted as a second column
+        self.generator2 = generator2
         self.required = list(required)
         self.position = position
         self.col_name = col_name
         self.pos_name = pos_name
         in_dtypes = [dt for _, dt in child.schema]
-        self._eval_fn = StageFn([generator] + self.required, in_dtypes)
+        gens = [generator] + ([generator2] if generator2 is not None
+                              else [])
+        self._n_gens = len(gens)
+        self._eval_fn = StageFn(gens + self.required, in_dtypes)
 
     @property
     def child(self) -> TpuExec:
@@ -46,7 +53,11 @@ class TpuGenerateExec(TpuExec):
         out = [(e.name, e.dtype) for e in self.required]
         if self.position:
             out.append((self.pos_name, dts.INT32))
-        out.append((self.col_name, self.generator.dtype.element))
+        if self.generator2 is not None:
+            out.append(("key", self.generator.dtype.element))
+            out.append(("value", self.generator2.dtype.element))
+        else:
+            out.append((self.col_name, self.generator.dtype.element))
         return out
 
     def describe(self):
@@ -59,7 +70,8 @@ class TpuGenerateExec(TpuExec):
             if batch.nrows == 0:
                 continue
             cols = self._eval_fn(batch)
-            arr, req = cols[0], cols[1:]
+            arr, req = cols[0], cols[self._n_gens:]
+            arr2 = cols[1] if self._n_gens == 2 else None
             cap = batch.capacity
             acv = ColVal(arr.dtype, arr.data, arr.validity, arr.offsets)
             total = int(arr.offsets[batch.nrows])
@@ -84,6 +96,12 @@ class TpuGenerateExec(TpuExec):
             if self.position:
                 pos = jnp.arange(ecap, dtype=jnp.int32) - arr.offsets[row]
                 out[self.pos_name] = Column(dts.INT32, pos, total)
-            out[self.col_name] = Column(self.generator.dtype.element,
-                                        arr.data, total)
+            if arr2 is not None:
+                out["key"] = Column(self.generator.dtype.element,
+                                    arr.data, total)
+                out["value"] = Column(self.generator2.dtype.element,
+                                      arr2.data, total)
+            else:
+                out[self.col_name] = Column(self.generator.dtype.element,
+                                            arr.data, total)
             yield ColumnarBatch(out, total)

@@ -150,6 +150,18 @@ class GetArrayItem(Expression):
     def with_children(self, children):
         return GetArrayItem(children[0], children[1])
 
+    def bind(self, schema):
+        # applied to a shredded MAP column, m[k] is a key lookup (Spark
+        # GetMapValue), not a positional index into the key array
+        from spark_rapids_tpu.columnar.nested import is_shredded_map
+        from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+        from spark_rapids_tpu.ops.nested_ops import GetMapValue
+        base = self.children[0]
+        if isinstance(base, UnresolvedColumn) and \
+                is_shredded_map(base.col_name, [n for n, _ in schema]):
+            return GetMapValue(base, self.children[1]).bind(schema)
+        return super().bind(schema)
+
     def emit(self, ctx: EmitContext) -> ColVal:
         c = self.children[0].emit(ctx)
         i = self.children[1].emit(ctx)
@@ -167,7 +179,10 @@ class GetArrayItem(Expression):
 
 
 class ElementAt(GetArrayItem):
-    """element_at(arr, i): 1-based; negative indexes from the end."""
+    """element_at(arr, i): 1-based; negative indexes from the end.
+    Applied to a shredded MAP column it dispatches to GetMapValue via
+    the inherited bind (Spark's ElementAt handles both container
+    kinds)."""
 
     def with_children(self, children):
         return ElementAt(children[0], children[1])

@@ -164,9 +164,25 @@ class UnresolvedColumn(Expression):
         for i, (name, dt) in enumerate(schema):
             if name == self.col_name:
                 return BoundReference(i, dt, name=name)
+        # a bare reference to a shredded MAP column denotes its key
+        # array (size(m), explode cardinality, ...); whole-map/struct
+        # projection expands earlier, in select()
+        from spark_rapids_tpu.columnar.nested import (
+            MAP_KEY_SUFFIX, is_shredded_map)
+        flat = [n for n, _ in schema]
+        if is_shredded_map(self.col_name, flat):
+            alt = self.col_name + MAP_KEY_SUFFIX
+            for i, (name, dt) in enumerate(schema):
+                if name == alt:
+                    return BoundReference(i, dt, name=name)
+        members = [n for n in flat if n.startswith(self.col_name + ".")]
+        if members:
+            raise KeyError(
+                f"column {self.col_name!r} is a shredded struct "
+                f"({members}); access fields via getField or select it "
+                "whole")
         raise KeyError(
-            f"column {self.col_name!r} not in schema "
-            f"{[n for n, _ in schema]}")
+            f"column {self.col_name!r} not in schema {flat}")
 
     @property
     def name(self) -> str:

@@ -1,0 +1,348 @@
+"""Struct / map expressions over shredded nested columns.
+
+Counterpart of the reference's ``complexTypeCreator.scala`` /
+``complexTypeExtractors.scala`` rules (CreateNamedStruct, GetStructField,
+CreateMap, GetMapValue, MapKeys, MapValues — ``GpuOverrides.scala``
+registrations around lines 777-2826).
+
+The execution model differs by design (see ``columnar/nested.py``): nested
+columns are shredded to flat physical columns, so most of these expressions
+COMPILE AWAY at bind time instead of running device kernels:
+
+* ``GetStructField(col("s"), "a")``       binds to flat column ``s.a``
+* ``MapKeys(col("m"))``                   binds to array column ``m.__key``
+* ``CreateNamedStruct`` / ``CreateMap``   expand at select() time into one
+  projection per shredded child
+* ``GetMapValue`` is the one real kernel: a segmented first-match over the
+  key elements followed by a value gather — single fused XLA program, no
+  per-row loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType, MapType, StructType
+from spark_rapids_tpu.columnar.nested import MAP_KEY_SUFFIX, MAP_VALUE_SUFFIX
+from spark_rapids_tpu.ops.expressions import (
+    Alias, ColVal, EmitContext, Expression, UnresolvedColumn)
+
+
+def _base_name(e: Expression, what: str) -> str:
+    if isinstance(e, UnresolvedColumn):
+        return e.col_name
+    raise ValueError(
+        f"{what} requires a direct column reference, got {e}")
+
+
+class GetStructField(Expression):
+    """s.a / s["a"]: resolves to the shredded flat column ``s.a`` (chains
+    compose: s.a.b).  Applied to a CreateNamedStruct it short-circuits to
+    the field's defining expression."""
+
+    def __init__(self, child: Expression, field: str):
+        self.children = (child,)
+        self.field = field
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.field)
+
+    @property
+    def dtype(self) -> DataType:
+        raise RuntimeError("GetStructField resolves at bind time")
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def bind(self, schema) -> Expression:
+        base = self.children[0]
+        if isinstance(base, CreateNamedStruct):
+            return Alias(base.field_expr(self.field),
+                         self.field).bind(schema)
+        parts = [self.field]
+        while isinstance(base, GetStructField):
+            parts.append(base.field)
+            base = base.children[0]
+        root = _base_name(base, "struct field access")
+        path = ".".join([root] + parts[::-1])
+        names = [n for n, _ in schema]
+        if path not in names:
+            hits = [n for n in names if n.startswith(path + ".")]
+            if hits:
+                raise KeyError(
+                    f"{path!r} is a nested struct; select it whole or "
+                    f"access a leaf field ({hits})")
+            raise KeyError(
+                f"struct field {path!r} not found; flat columns: {names}")
+        return Alias(UnresolvedColumn(path).bind(schema), self.field)
+
+    def references(self):
+        return self.children[0].references()
+
+    @property
+    def name(self) -> str:
+        return self.field
+
+    def __str__(self):
+        return f"{self.children[0]}.{self.field}"
+
+
+class CreateNamedStruct(Expression):
+    """struct(a, b, ...) — expands at select() time into one shredded
+    projection per field (``<out>.<field>``); never emits device code."""
+
+    def __init__(self, pairs: Sequence[Tuple[str, Expression]]):
+        if not pairs:
+            raise ValueError("struct() needs at least one field")
+        self.pairs = [(str(n), e) for n, e in pairs]
+        self.children = tuple(e for _, e in self.pairs)
+
+    def with_children(self, children):
+        return CreateNamedStruct(
+            list(zip([n for n, _ in self.pairs], children)))
+
+    def field_expr(self, field: str) -> Expression:
+        for n, e in self.pairs:
+            if n == field:
+                return e
+        raise KeyError(f"struct has no field {field!r}; "
+                       f"fields: {[n for n, _ in self.pairs]}")
+
+    @property
+    def dtype(self) -> DataType:
+        return StructType((n, e.dtype) for n, e in self.pairs)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def expand(self, out_name: str) -> List[Expression]:
+        return [Alias(e, f"{out_name}.{n}") for n, e in self.pairs]
+
+    @property
+    def name(self) -> str:
+        return "struct(" + ", ".join(n for n, _ in self.pairs) + ")"
+
+    def emit(self, ctx):
+        raise NotImplementedError(
+            "CreateNamedStruct must be expanded by select(); it cannot "
+            "appear nested inside another expression")
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — expands at select() time into the two
+    aligned array projections ``<out>.__key`` / ``<out>.__value``."""
+
+    def __init__(self, *entries: Expression):
+        if not entries or len(entries) % 2:
+            raise ValueError("map() needs alternating key, value pairs")
+        self.children = tuple(entries)
+
+    def with_children(self, children):
+        return CreateMap(*children)
+
+    @property
+    def keys(self):
+        return self.children[0::2]
+
+    @property
+    def values(self):
+        return self.children[1::2]
+
+    @property
+    def dtype(self) -> DataType:
+        return MapType(self.keys[0].dtype, self.values[0].dtype)
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def expand(self, out_name: str) -> List[Expression]:
+        from spark_rapids_tpu.ops.collections_ops import CreateArray
+        # enforce MapType's fixed-width restriction up front where the
+        # entry dtypes are already known (literals, resolved refs) —
+        # otherwise a string-keyed map would shred into byte garbage and
+        # only fail (confusingly) at CreateArray.dtype time
+        for e in self.children:
+            try:
+                dt = e.dtype
+            except Exception:
+                continue
+            if dt.has_offsets or dt.is_nested:
+                raise ValueError(
+                    f"map() entry {e} has type {dt}: map keys/values "
+                    "must be fixed-width scalar types")
+        return [
+            Alias(CreateArray(*self.keys), out_name + MAP_KEY_SUFFIX),
+            Alias(CreateArray(*self.values), out_name + MAP_VALUE_SUFFIX),
+        ]
+
+    @property
+    def name(self) -> str:
+        return "map"
+
+    def emit(self, ctx):
+        raise NotImplementedError(
+            "CreateMap must be expanded by select(); it cannot appear "
+            "nested inside another expression")
+
+
+class _MapPart(Expression):
+    """Shared base for MapKeys/MapValues: binds to the shredded array."""
+
+    suffix = ""
+    fn_name = ""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def dtype(self) -> DataType:
+        raise RuntimeError(f"{type(self).__name__} resolves at bind time")
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def bind(self, schema) -> Expression:
+        base = _base_name(self.children[0], self.fn_name)
+        return Alias(UnresolvedColumn(base + self.suffix).bind(schema),
+                     f"{self.fn_name}({base})")
+
+    @property
+    def name(self) -> str:
+        c = self.children[0]
+        n = c.col_name if isinstance(c, UnresolvedColumn) else str(c)
+        return f"{self.fn_name}({n})"
+
+
+class MapKeys(_MapPart):
+    suffix = MAP_KEY_SUFFIX
+    fn_name = "map_keys"
+
+
+class MapValues(_MapPart):
+    suffix = MAP_VALUE_SUFFIX
+    fn_name = "map_values"
+
+
+class GetMapValue(Expression):
+    """m[key] / element_at(m, key): per-row first-match lookup.
+
+    Pre-bind children are (map_ref, key_expr); bind rewires to the two
+    shredded array columns.  The kernel: every key element compares
+    against its row's probe key in one vector op, the earliest matching
+    element position per row comes from a segmented min, and the value
+    gathers at that position — null when the row has no match (Spark
+    ``element_at``/``GetMapValue`` null semantics)."""
+
+    def __init__(self, *children: Expression):
+        # (map_ref, key) pre-bind; (keys_arr, values_arr, key) post-bind
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return GetMapValue(*children)
+
+    @property
+    def _bound(self) -> bool:
+        return len(self.children) == 3
+
+    @property
+    def dtype(self) -> DataType:
+        if not self._bound:
+            raise RuntimeError("GetMapValue resolves dtypes at bind time")
+        return self.children[1].dtype.element
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def bind(self, schema) -> Expression:
+        if self._bound:
+            return self
+        base = _base_name(self.children[0], "map lookup")
+        keys = UnresolvedColumn(base + MAP_KEY_SUFFIX).bind(schema)
+        values = UnresolvedColumn(base + MAP_VALUE_SUFFIX).bind(schema)
+        key = self.children[1].bind(schema)
+        return Alias(GetMapValue(keys, values, key),
+                     f"{base}[{key}]")
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        from spark_rapids_tpu.ops.collections_ops import element_rows
+        keys_e, values_e, key_e = self.children
+        kc = keys_e.emit(ctx)
+        vc = values_e.emit(ctx)
+        probe = key_e.emit(ctx)
+        ecap = kc.values.shape[0]
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        row = element_rows(kc, ctx.capacity)
+        total = jnp.take(kc.offsets, jnp.int32(ctx.nrows))
+        pv = probe.values
+        if getattr(pv, "ndim", 0) == 0:
+            per_elem = pv
+        else:
+            per_elem = pv[row]
+        match = jnp.logical_and(pos < total,
+                                kc.values == per_elem.astype(
+                                    kc.values.dtype))
+        big = jnp.int32(ecap)
+        first = jax.ops.segment_min(
+            jnp.where(match, pos, big), row,
+            num_segments=ctx.capacity)
+        found = first < big
+        idx = jnp.clip(first, 0, max(ecap - 1, 0))
+        vals = vc.values[idx]
+        valid = found
+        if vc.validity is not None:
+            valid = jnp.logical_and(valid, vc.validity[idx])
+        if probe.validity is not None:
+            valid = jnp.logical_and(valid, probe.validity)
+        return ColVal(self.dtype, vals, valid)
+
+    @property
+    def name(self) -> str:
+        return "element_at"
+
+
+def expand_nested_projections(exprs: List[Expression],
+                              child_schema) -> List[Expression]:
+    """select()-time rewrite: CreateNamedStruct/CreateMap outputs expand
+    into their shredded projections, and a whole-column reference to a
+    shredded nested column expands to all its flat members (so
+    ``select("s", "v")`` keeps the struct)."""
+    names = [n for n, _ in child_schema]
+    out: List[Expression] = []
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, Alias) else e
+        out_name = e.alias if isinstance(e, Alias) else None
+        if isinstance(inner, (CreateNamedStruct, CreateMap)):
+            if out_name is None:
+                raise ValueError(
+                    f"{inner.name}: struct()/map() outputs must be "
+                    "aliased (.alias('name'))")
+            out.extend(inner.expand(out_name))
+            continue
+        if isinstance(inner, UnresolvedColumn) and \
+                inner.col_name not in names:
+            members = [n for n in names
+                       if n.startswith(inner.col_name + ".")]
+            if members:
+                if out_name is not None and out_name != inner.col_name:
+                    members_out = [
+                        Alias(UnresolvedColumn(n),
+                              out_name + n[len(inner.col_name):])
+                        for n in members]
+                else:
+                    members_out = [UnresolvedColumn(n) for n in members]
+                out.extend(members_out)
+                continue
+        out.append(e)
+    return out

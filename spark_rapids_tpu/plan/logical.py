@@ -374,13 +374,33 @@ class Generate(LogicalPlan):
     def __init__(self, generator: Expression, required, position: bool,
                  child: LogicalPlan, col_name: str = "col",
                  pos_name: str = "pos"):
-        self.generator = generator.bind(child.schema)
+        from spark_rapids_tpu.columnar.nested import (
+            MAP_KEY_SUFFIX, MAP_VALUE_SUFFIX, is_shredded_map)
+        from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+        names = [n for n, _ in child.schema]
+        # explode(map) emits key+value columns (Spark's map explode):
+        # the shredded arrays share offsets, so both ride one row
+        # expansion
+        self.map_mode = (
+            isinstance(generator, UnresolvedColumn)
+            and is_shredded_map(generator.col_name, names))
+        if self.map_mode:
+            base = generator.col_name
+            self.generator = UnresolvedColumn(
+                base + MAP_KEY_SUFFIX).bind(child.schema)
+            self.generator2 = UnresolvedColumn(
+                base + MAP_VALUE_SUFFIX).bind(child.schema)
+        else:
+            self.generator = generator.bind(child.schema)
+            self.generator2 = None
         self.required = [e.bind(child.schema) for e in required]
         self.position = position
         self.col_name = col_name
         self.pos_name = pos_name
         taken = {e.name for e in self.required}
-        clash = {col_name} | ({pos_name} if position else set())
+        clash = {"key", "value"} if self.map_mode else {col_name}
+        if position:
+            clash |= {pos_name}
         if taken & clash:
             raise ValueError(
                 f"explode output name(s) {sorted(taken & clash)} collide "
@@ -397,7 +417,11 @@ class Generate(LogicalPlan):
         out = [(e.name, e.dtype) for e in self.required]
         if self.position:
             out.append((self.pos_name, INT32))
-        out.append((self.col_name, self.generator.dtype.element))
+        if self.map_mode:
+            out.append(("key", self.generator.dtype.element))
+            out.append(("value", self.generator2.dtype.element))
+        else:
+            out.append((self.col_name, self.generator.dtype.element))
         return out
 
     def describe(self):

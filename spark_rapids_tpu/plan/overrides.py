@@ -111,6 +111,17 @@ expr_rule(C.ArrayContains, ts.COMMON)
 expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
 
+# nested struct/map (complexTypeCreator/Extractors analog; most of these
+# compile away at bind time — see ops/nested_ops.py)
+from spark_rapids_tpu.ops import nested_ops as NO  # noqa: E402
+
+expr_rule(NO.GetStructField, ts.COMMON)
+expr_rule(NO.CreateNamedStruct, ts.COMMON)
+expr_rule(NO.CreateMap, ts.COMMON)
+expr_rule(NO.MapKeys, ts.COMMON)
+expr_rule(NO.MapValues, ts.COMMON)
+expr_rule(NO.GetMapValue, ts.COMMON)
+
 # misc (HashFunctions.scala, GpuMonotonicallyIncreasingID analogs)
 from spark_rapids_tpu.ops import misc_exprs as ME  # noqa: E402
 
@@ -506,7 +517,8 @@ def _conv_generate(node: L.Generate, children, conf):
     from spark_rapids_tpu.exec.generate import TpuGenerateExec
     return TpuGenerateExec(node.generator, node.required, node.position,
                            children[0], col_name=node.col_name,
-                           pos_name=node.pos_name)
+                           pos_name=node.pos_name,
+                           generator2=node.generator2)
 
 
 @_converter(L.Window)

@@ -1,0 +1,240 @@
+"""Struct / map nested-type tests — oracle: pyarrow/pandas.
+
+Miniature of the reference's struct/map coverage (complexTypeExtractors,
+complexTypeCreator, map_test.py / struct_test.py in integration_tests).
+Nested columns are shredded to flat physical columns (columnar/nested.py)
+and reassembled at the Arrow boundary; these tests pin both the round trip
+and the expression semantics.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import nested as N
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def _struct_table():
+    return pa.table({
+        "s": pa.array([{"a": 1, "b": "x"}, {"a": 2, "b": "y"},
+                       {"a": 3, "b": None}, None]),
+        "v": [10.0, 20.0, 30.0, 40.0],
+    })
+
+
+def _map_table():
+    return pa.table({
+        "m": pa.array([[(1, 10), (2, 20)], [], [(3, 30)], [(2, 99)]],
+                      type=pa.map_(pa.int64(), pa.int64())),
+        "v": [1, 2, 3, 4],
+    })
+
+
+# ------------------------------------------------------------- shred layer --
+def test_shred_assemble_struct_roundtrip():
+    t = _struct_table()
+    flat = N.shred_table(t)
+    assert flat.column_names == ["s.a", "s.b", "v"]
+    back = N.assemble_table(flat)
+    assert back.column_names == ["s", "v"]
+    # null struct rows come back as all-null-fields rows (struct-level
+    # validity folds into the children at shred time)
+    got = back.column("s").to_pylist()
+    assert got[0] == {"a": 1, "b": "x"}
+    assert got[3] == {"a": None, "b": None}
+
+
+def test_shred_assemble_map_roundtrip():
+    t = _map_table()
+    flat = N.shred_table(t)
+    assert flat.column_names == ["m.__key", "m.__value", "v"]
+    back = N.assemble_table(flat)
+    assert back.column("m").to_pylist() == t.column("m").to_pylist()
+
+
+def test_nested_struct_two_levels():
+    t = pa.table({"o": pa.array(
+        [{"p": {"q": 1}, "r": 5}, {"p": {"q": 2}, "r": 6}])})
+    flat = N.shred_table(t)
+    assert set(flat.column_names) == {"o.p.q", "o.r"}
+    back = N.assemble_table(flat)
+    assert back.column("o").to_pylist() == t.column("o").to_pylist()
+
+
+def test_orphan_map_key_stays_plain():
+    # map_keys() output projected alone must not reassemble into a map
+    t = pa.table({"m.__key": pa.array([[1], [2]],
+                                      type=pa.list_(pa.int64()))})
+    back = N.assemble_table(t)
+    assert back.column_names == ["m.__key"]
+
+
+def test_string_keyed_map_rejected(session):
+    t = pa.table({"m": pa.array([[("k", 1)]],
+                                type=pa.map_(pa.string(), pa.int64()))})
+    with pytest.raises(ValueError, match="fixed-width"):
+        session.create_dataframe(t)
+
+
+# ---------------------------------------------------------------- struct ops --
+def test_get_struct_field(session):
+    df = session.create_dataframe(_struct_table())
+    out = df.select(F.col("s").getField("a").alias("a"), "v").to_pandas()
+    assert out["a"].tolist()[:3] == [1, 2, 3]
+    assert pd.isna(out["a"].iloc[3])
+
+
+def test_get_field_via_getitem(session):
+    df = session.create_dataframe(_struct_table())
+    out = df.select(F.col("s")["b"].alias("b")).to_pandas()
+    assert out["b"].tolist()[:2] == ["x", "y"]
+
+
+def test_filter_on_struct_field(session):
+    df = session.create_dataframe(_struct_table())
+    out = df.filter(F.col("s").getField("a") >= 2).select("v").to_pandas()
+    assert out["v"].tolist() == [20.0, 30.0]
+
+
+def test_whole_struct_passthrough(session):
+    df = session.create_dataframe(_struct_table())
+    out = df.select("s", "v").to_arrow()
+    assert pa.types.is_struct(out.column("s").type)
+    assert out.column("s").to_pylist()[1] == {"a": 2, "b": "y"}
+
+
+def test_create_named_struct(session):
+    pdf = pd.DataFrame({"x": [1, 2], "y": [3.0, 4.0]})
+    df = session.create_dataframe(pdf)
+    out = df.select(F.struct(F.col("x"), F.col("y")).alias("st")
+                    ).to_arrow()
+    assert out.column("st").to_pylist() == [
+        {"x": 1, "y": 3.0}, {"x": 2, "y": 4.0}]
+
+
+def test_get_field_of_created_struct_short_circuits(session):
+    pdf = pd.DataFrame({"x": [5, 6]})
+    df = session.create_dataframe(pdf)
+    st = F.struct((F.col("x") * 2).alias("d"))
+    out = df.select(st.getField("d").alias("d2")).to_pandas()
+    assert out["d2"].tolist() == [10, 12]
+
+
+def test_struct_survives_sort_and_filter(session):
+    df = session.create_dataframe(_struct_table())
+    out = (df.filter(F.col("v") > 10)
+             .orderBy(F.col("v").desc())
+             .select("s", "v")).to_arrow()
+    assert out.column("v").to_pylist() == [40.0, 30.0, 20.0]
+    assert out.column("s").to_pylist()[2] == {"a": 2, "b": "y"}
+
+
+def test_bare_struct_reference_error_is_helpful(session):
+    df = session.create_dataframe(_struct_table())
+    with pytest.raises(Exception, match="shredded struct"):
+        df.filter(F.col("s") > 1).to_pandas()
+
+
+# ------------------------------------------------------------------ map ops --
+def test_map_keys_values_size(session):
+    df = session.create_dataframe(_map_table())
+    out = df.select(F.map_keys(F.col("m")).alias("k"),
+                    F.map_values(F.col("m")).alias("w"),
+                    F.size(F.col("m")).alias("n")).to_pandas()
+    assert out["k"].tolist()[0].tolist() == [1, 2]
+    assert out["w"].tolist()[3].tolist() == [99]
+    assert out["n"].tolist() == [2, 0, 1, 1]
+
+
+def test_element_at_map(session):
+    df = session.create_dataframe(_map_table())
+    out = df.select(F.element_at(F.col("m"), 2).alias("got")).to_pandas()
+    got = out["got"].tolist()
+    assert got[0] == 20 and got[3] == 99
+    assert pd.isna(got[1]) and pd.isna(got[2])
+
+
+def test_get_map_value_per_row_key(session):
+    df = session.create_dataframe(_map_table())
+    out = df.select(
+        F.get_map_value(F.col("m"), F.col("v")).alias("got")).to_pandas()
+    # row 0 probes key 1 -> 10; row 2 probes key 3 -> 30; others miss
+    got = out["got"].tolist()
+    assert got[0] == 10 and got[2] == 30
+    assert pd.isna(got[1]) and pd.isna(got[3])
+
+
+def test_create_map(session):
+    pdf = pd.DataFrame({"k": [1, 2], "v": [10, 20]})
+    df = session.create_dataframe(pdf)
+    out = df.select(F.create_map(F.col("k"), F.col("v")).alias("m")
+                    ).to_arrow()
+    assert out.column("m").to_pylist() == [[(1, 10)], [(2, 20)]]
+
+
+def test_explode_map(session):
+    df = session.create_dataframe(_map_table())
+    out = df.select(F.explode(F.col("m")), "v").to_pandas()
+    assert out["key"].tolist() == [1, 2, 3, 2]
+    assert out["value"].tolist() == [10, 20, 30, 99]
+    assert out["v"].tolist() == [1, 1, 3, 4]
+
+
+def test_map_roundtrip_through_engine(session):
+    df = session.create_dataframe(_map_table())
+    out = df.filter(F.col("v") <= 3).to_arrow()
+    assert out.column("m").to_pylist() == \
+        _map_table().column("m").to_pylist()[:3]
+
+
+def test_getitem_on_map_is_key_lookup(session):
+    # m[2] on a map must look up key 2 (Spark GetMapValue), not index
+    # position 2 of the key array
+    df = session.create_dataframe(_map_table())
+    out = df.select(F.col("m")[2].alias("got")).to_pandas()
+    got = out["got"].tolist()
+    assert got[0] == 20 and got[3] == 99
+    assert pd.isna(got[1]) and pd.isna(got[2])
+
+
+def test_map_inside_struct_roundtrip():
+    t = pa.table({"s": pa.array(
+        [{"m": [(1, 10)], "a": 5}, {"m": [(2, 20), (3, 30)], "a": 6}],
+        type=pa.struct([("m", pa.map_(pa.int64(), pa.int64())),
+                        ("a", pa.int64())]))})
+    flat = N.shred_table(t)
+    assert set(flat.column_names) == {"s.m.__key", "s.m.__value", "s.a"}
+    back = N.assemble_table(flat)
+    assert back.column_names == ["s"]
+    assert back.column("s").to_pylist() == t.column("s").to_pylist()
+
+
+def test_create_map_rejects_string_keys(session):
+    pdf = pd.DataFrame({"x": [1, 2]})
+    df = session.create_dataframe(pdf)
+    with pytest.raises(ValueError, match="fixed-width"):
+        df.select(F.create_map(F.lit("a"), F.col("x")).alias("m"))
+
+
+# --------------------------------------------------------------- plan layer --
+def test_nested_rules_registered():
+    from spark_rapids_tpu.ops import nested_ops as NO
+    from spark_rapids_tpu.plan.overrides import _EXPR_RULES
+    for cls in (NO.GetStructField, NO.CreateNamedStruct, NO.CreateMap,
+                NO.MapKeys, NO.MapValues, NO.GetMapValue):
+        assert cls in _EXPR_RULES, cls.__name__
+
+
+def test_struct_field_native_plan(session):
+    df = session.create_dataframe(_struct_table())
+    q = df.select(F.col("s").getField("a").alias("a"))
+    tree = q.session.plan(q.plan).tree_string()
+    assert "CpuFallbackExec" not in tree, tree
