@@ -130,6 +130,9 @@ class DataFrame:
     def select(self, *cols: Union[Col, str]) -> "DataFrame":
         from spark_rapids_tpu.ops.nested_ops import \
             expand_nested_projections
+        routed_pw = self._route_pandas_windows(cols)
+        if routed_pw is not None:
+            return routed_pw
         exprs = [_expr(c) for c in cols]
         exprs = expand_nested_projections(exprs, self.plan.schema)
         gen = self._route_generate(exprs)
@@ -159,6 +162,34 @@ class DataFrame:
                     final.append(e)
             return DataFrame(self.session, L.Project(final, wplan))
         return DataFrame(self.session, L.Project(exprs, self.plan))
+
+    def _route_pandas_windows(self, cols) -> Optional["DataFrame"]:
+        """Route pandas-UDF-over-window markers into a WindowInPandas
+        node, then select the requested columns on top.  Result columns
+        get collision-proof internal names so replacing an existing
+        column (withColumn semantics) never duplicates a schema entry;
+        the final projection re-enters select() so nested expansion /
+        explode routing still apply to the other columns."""
+        from spark_rapids_tpu.api.functions import _PandasWindowCall
+        if not any(isinstance(c, _PandasWindowCall) for c in cols):
+            return None
+        child_names = [n for n, _ in self.plan.schema]
+        prefix = "_pw"
+        while any(n.startswith(prefix) for n in child_names):
+            prefix += "_"
+        calls, final = [], []
+        for c in cols:
+            if isinstance(c, _PandasWindowCall):
+                internal = f"{prefix}{len(calls)}"
+                calls.append((internal, c.call.fn, c.call.arg_name,
+                              c.call.return_type, c.spec_data()))
+                final.append(Alias(UnresolvedColumn(internal),
+                                   c.out_name))
+            else:
+                final.append(c)
+        base = DataFrame(self.session,
+                         L.WindowInPandas(calls, self.plan))
+        return base.select(*final)
 
     def _route_generate(self, exprs) -> Optional["DataFrame"]:
         """Route F.explode/F.posexplode in a select into an L.Generate
@@ -218,16 +249,21 @@ class DataFrame:
     where = filter
 
     def withColumn(self, name: str, c: Col) -> "DataFrame":
-        exprs: List[Expression] = []
+        from spark_rapids_tpu.api.functions import _PandasWindowCall
+        if isinstance(c, _PandasWindowCall):
+            wrapped = c.alias(name)
+        else:
+            wrapped = Alias(_expr(c), name)
+        exprs: List = []
         replaced = False
         for n, _ in self.plan.schema:
             if n == name:
-                exprs.append(Alias(_expr(c), name))
+                exprs.append(wrapped)
                 replaced = True
             else:
                 exprs.append(UnresolvedColumn(n))
         if not replaced:
-            exprs.append(Alias(_expr(c), name))
+            exprs.append(wrapped)
         return self.select(*exprs)
 
     with_column = withColumn

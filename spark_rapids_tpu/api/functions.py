@@ -860,6 +860,69 @@ class _PandasAggCall(Col):
         out.out_name = name
         return out
 
+    def over(self, window: "Window") -> "_PandasWindowCall":
+        """Window form: the UDF evaluates once per frame and the scalar
+        broadcasts to the frame's anchor row (GpuWindowInPandasExec
+        analog)."""
+        return _PandasWindowCall(self, window)
+
+
+class _PandasWindowCall(Col):
+    """Marker for pandas-UDF-over-window; DataFrame.select routes it
+    into a WindowInPandas node."""
+
+    def __init__(self, call: _PandasAggCall, window: "Window"):
+        self.call = call
+        self.window = window
+        self.out_name = call.out_name
+
+    @property
+    def expr(self):
+        raise TypeError("windowed pandas UDFs are only valid in select()")
+
+    @expr.setter
+    def expr(self, v):  # pragma: no cover
+        pass
+
+    def alias(self, name: str) -> "_PandasWindowCall":
+        out = _PandasWindowCall(self.call, self.window)
+        out.out_name = name
+        return out
+
+    def spec_data(self):
+        """(partition_names, [(order_name, desc, nulls_first)], frame) —
+        host execution needs plain column names."""
+        from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+
+        def name_of(e, what):
+            if isinstance(e, UnresolvedColumn):
+                return e.col_name
+            raise ValueError(
+                f"windowed pandas UDFs: {what} must be plain columns, "
+                f"got {e}")
+
+        w = self.window
+        parts = [name_of(e, "partitionBy") for e in w._partition]
+        orders = [(name_of(e, "orderBy"), d, nf)
+                  for e, d, nf in w._orders]
+        frame = w._frame
+        if frame is None:
+            from spark_rapids_tpu.exec.window import Frame
+            frame = Frame("range", None, 0) if orders else \
+                Frame("rows", None, None)
+        elif frame.kind == "range":
+            # explicit range frames: only running bounds are supported
+            # (Spark's WindowInPandas restriction), and like Spark a
+            # range frame requires an ordering
+            if not (frame.lo is None and frame.hi in (0, None)):
+                raise ValueError(
+                    "windowed pandas UDFs support rows-based frames and "
+                    "the running range frame only")
+            if not orders:
+                raise ValueError(
+                    "a range window frame requires orderBy")
+        return parts, orders, frame
+
 
 def pandas_agg_udf(f=None, returnType: str = "double"):
     """Grouped-aggregate pandas UDF (Spark's pandas_udf with GROUPED_AGG):

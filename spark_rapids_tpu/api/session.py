@@ -125,11 +125,15 @@ class TpuSession:
                          ) -> DataFrame:
         import pandas as pd
         import pyarrow as pa
+        from spark_rapids_tpu.columnar.nested import check_reserved_names
         if isinstance(data, pd.DataFrame):
+            check_reserved_names(data.columns)
             batch = ColumnarBatch.from_pandas(data)
         elif isinstance(data, pa.Table):
+            check_reserved_names(data.column_names)
             batch = ColumnarBatch.from_arrow(data)
         elif isinstance(data, dict):
+            check_reserved_names(data.keys())
             batch = ColumnarBatch.from_pydict(data)
         elif isinstance(data, ColumnarBatch):
             batch = data

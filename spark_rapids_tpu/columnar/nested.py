@@ -27,6 +27,17 @@ MAP_KEY_SUFFIX = ".__key"
 MAP_VALUE_SUFFIX = ".__value"
 
 
+def check_reserved_names(names) -> None:
+    """Ingest-boundary guard: user column names must not collide with
+    the shredding convention, or assemble_table would silently reshape
+    them into structs/maps on output."""
+    bad = [n for n in names if "." in n]
+    if bad:
+        raise ValueError(
+            f"column name(s) {bad} contain '.', which is reserved for "
+            "nested-type shredding; rename the column(s)")
+
+
 def is_shredded_map(name: str, schema_names) -> bool:
     """True when a bare column reference names a shredded MAP column:
     absent itself, both halves present.  The single definition every
@@ -119,8 +130,12 @@ def _group_prefixes(names: List[str]):
                 continue
         if "." in n:
             base = n.split(".", 1)[0]
+            if base in names:
+                raise ValueError(
+                    f"ambiguous output: both column {base!r} and struct "
+                    f"member {n!r} present — alias one of them")
             members = [m for m in names if m not in consumed and
-                       (m == base or m.startswith(base + "."))]
+                       m.startswith(base + ".")]
             slots.append((base, "struct", members))
             consumed.update(members)
             continue

@@ -290,9 +290,13 @@ class GetMapValue(Expression):
             per_elem = pv
         else:
             per_elem = pv[row]
-        match = jnp.logical_and(pos < total,
-                                kc.values == per_elem.astype(
-                                    kc.values.dtype))
+        # compare under the promoted common dtype (Spark casts both
+        # sides): a fractional float probe must MISS an integer key,
+        # not truncate onto it
+        ct = jnp.result_type(kc.values.dtype, pv.dtype)
+        match = jnp.logical_and(
+            pos < total,
+            kc.values.astype(ct) == per_elem.astype(ct))
         big = jnp.int32(ecap)
         first = jax.ops.segment_min(
             jnp.where(match, pos, big), row,

@@ -252,6 +252,42 @@ class AggInPandas(LogicalPlan):
         return f"AggInPandas[{[n for n, *_ in self.aggs]}]"
 
 
+class WindowInPandas(LogicalPlan):
+    """Pandas UDFs evaluated over window frames
+    (GpuWindowInPandasExec analog, python/GpuWindowInPandasExec.scala).
+    Output = child columns + one column per windowed UDF."""
+
+    def __init__(self, calls: Sequence[tuple], child: LogicalPlan):
+        # calls: (out_name, fn, arg_name, dtype,
+        #         (partition_names, orders, frame))
+        self.calls = list(calls)
+        self.children = (child,)
+        child_names = {n for n, _ in child.schema}
+        for out_name, _, arg, _, (parts, orders, _) in self.calls:
+            if out_name in child_names:
+                raise ValueError(
+                    f"windowed pandas UDF output {out_name!r} collides "
+                    "with a child column (the select() router assigns "
+                    "internal names — construct through it)")
+            for c in [arg] + list(parts) + [n for n, _, _ in orders]:
+                if c not in child_names:
+                    raise KeyError(
+                        f"windowed pandas UDF references unknown "
+                        f"column {c!r}")
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return list(self.child.schema) + \
+            [(name, dt) for name, _, _, dt, _ in self.calls]
+
+    def describe(self):
+        return f"WindowInPandas[{[n for n, *_ in self.calls]}]"
+
+
 class CoGroupMapInPandas(LogicalPlan):
     """cogroup().applyInPandas."""
 

@@ -497,6 +497,12 @@ def _conv_agg_in_pandas(node: L.AggInPandas, children, conf):
                                     children[0])
 
 
+@_converter(L.WindowInPandas)
+def _conv_window_in_pandas(node: L.WindowInPandas, children, conf):
+    from spark_rapids_tpu.udf.python_exec import TpuWindowInPandasExec
+    return TpuWindowInPandasExec(node.calls, children[0])
+
+
 @_converter(L.CoGroupMapInPandas)
 def _conv_cogroup(node: L.CoGroupMapInPandas, children, conf):
     from spark_rapids_tpu.udf.python_exec import (

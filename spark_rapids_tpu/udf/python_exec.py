@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
+import numpy as np
 import pandas as pd
 
 from spark_rapids_tpu.columnar import dtypes as dts
@@ -401,6 +402,103 @@ class TpuAggregateInPandasExec(TpuExec):
             sem.acquire_if_necessary()
         out = pd.DataFrame(rows, columns=[n for n, _ in self.schema])
         yield _batch_from_pandas_schema(out, self.schema)
+
+
+class TpuWindowInPandasExec(TpuExec):
+    """Pandas UDFs over window frames — GpuWindowInPandasExec analog
+    (python/GpuWindowInPandasExec.scala, 430 LoC).  Per partition group
+    the UDF sees its frame's argument Series and returns a scalar for
+    the anchor row:
+
+    * whole-partition frame: ONE call per group, broadcast (the
+      reference's unbounded-window batching optimization);
+    * running range frame: one call per peer group (ties share a frame
+      end), broadcast across the tie run;
+    * bounded rows frame: one call per row over the sliced Series.
+
+    Original row order is restored on output (Spark windows are a
+    projection, not a sort)."""
+
+    def __init__(self, calls: Sequence[tuple], child: TpuExec):
+        super().__init__(child)
+        self.calls = list(calls)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return list(self.child.schema) + \
+            [(name, dt) for name, _, _, dt, _ in self.calls]
+
+    def describe(self):
+        return f"TpuWindowInPandasExec[{[n for n, *_ in self.calls]}]"
+
+    @staticmethod
+    def _eval_one_group(g: pd.DataFrame, fn, arg: str, orders, frame
+                        ) -> pd.Series:
+        if orders:
+            g = g.sort_values(
+                [n for n, _, _ in orders],
+                ascending=[not d for _, d, _ in orders],
+                na_position="first" if orders[0][2] else "last",
+                kind="stable")
+        s = g[arg].reset_index(drop=True)
+        n = len(s)
+        out = np.empty(n, dtype=object)
+        whole = frame.lo is None and frame.hi is None
+        if whole:
+            out[:] = fn(s)
+        elif frame.kind == "range":
+            # running range frame: peers (tied order keys) share the
+            # frame end — evaluate once per tie run
+            keys = g[[n for n, _, _ in orders]].reset_index(drop=True)
+            # NaN != NaN would split tied null keys into separate peer
+            # runs; Spark treats nulls as peers of each other
+            changed = keys.ne(keys.shift()) & \
+                ~(keys.isna() & keys.shift().isna())
+            run_id = changed.any(axis=1).cumsum()
+            start = 0
+            for _, idx in keys.groupby(run_id, sort=False).groups.items():
+                e = idx[-1] + 1
+                out[start:e] = fn(s.iloc[:e])
+                start = e
+        else:
+            lo, hi = frame.lo, frame.hi
+            for i in range(n):
+                a = 0 if lo is None else max(0, i + lo)
+                b = n if hi is None else min(n, i + hi + 1)
+                out[i] = fn(s.iloc[a:b])
+        res = pd.Series(out, index=g.index)
+        return res
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.api.session import TpuSession
+        df = _child_pandas(self.child)
+        if df.empty:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            yield empty_batch(self.schema)
+            return
+        sem = None
+        if TpuSession._active is not None:
+            sem = TpuSession._active.semaphore
+        if sem is not None:
+            sem.release_if_held()
+        for out_name, fn, arg, dt, (parts, orders, frame) in self.calls:
+            if parts:
+                pieces = [
+                    self._eval_one_group(g, fn, arg, orders, frame)
+                    for _, g in df.groupby(parts, dropna=False,
+                                           sort=False)]
+                df[out_name] = pd.concat(pieces).reindex(df.index)
+            else:
+                df[out_name] = self._eval_one_group(
+                    df, fn, arg, orders, frame).reindex(df.index)
+        if sem is not None:
+            sem.acquire_if_necessary()
+        yield _batch_from_pandas_schema(df[[n for n, _ in self.schema]],
+                                        self.schema)
 
 
 class TpuFlatMapCoGroupsInPandasExec(TpuExec):

@@ -217,6 +217,26 @@ def test_map_inside_struct_roundtrip():
     assert back.column("s").to_pylist() == t.column("s").to_pylist()
 
 
+def test_dotted_user_column_rejected(session):
+    with pytest.raises(ValueError, match="reserved"):
+        session.create_dataframe(pd.DataFrame({"a.b": [1, 2]}))
+
+
+def test_ambiguous_assembly_raises():
+    t = pa.table({"s": [1, 2], "s.a": [3, 4]})
+    with pytest.raises(ValueError, match="ambiguous"):
+        N.assemble_table(t)
+
+
+def test_map_float_probe_misses_int_key(session):
+    # a fractional probe must MISS integer keys (common-type compare),
+    # not truncate onto them
+    df = session.create_dataframe(_map_table())
+    out = df.select(
+        F.get_map_value(F.col("m"), F.lit(2.5)).alias("got")).to_pandas()
+    assert out["got"].isna().all()
+
+
 def test_create_map_rejects_string_keys(session):
     pdf = pd.DataFrame({"x": [1, 2]})
     df = session.create_dataframe(pdf)

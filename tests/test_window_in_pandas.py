@@ -1,0 +1,149 @@
+"""Windowed pandas UDF tests (GpuWindowInPandasExec analog) — oracle:
+pandas groupby/rolling/expanding."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import Window
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def _frame(n=60, seed=4):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 5, n),
+        "o": rng.integers(0, 20, n),
+        "v": rng.normal(size=n).round(4),
+    })
+
+
+@F.pandas_agg_udf(returnType="double")
+def smean(s: pd.Series) -> float:
+    return float(s.mean()) if len(s) else float("nan")
+
+
+def test_whole_partition_window(session):
+    pdf = _frame()
+    w = Window.partitionBy("k")
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    want = pdf.assign(m=pdf.groupby("k")["v"].transform("mean"))
+    pd.testing.assert_series_equal(
+        out.sort_values(["k", "o", "v"]).reset_index(drop=True)["m"],
+        want.sort_values(["k", "o", "v"]).reset_index(drop=True)["m"],
+        rtol=1e-12)
+
+
+def test_running_window_with_ties(session):
+    pdf = pd.DataFrame({"k": [1, 1, 1, 1, 2, 2],
+                        "o": [10, 20, 20, 30, 5, 5],
+                        "v": [1.0, 2.0, 3.0, 4.0, 10.0, 20.0]})
+    w = Window.partitionBy("k").orderBy("o")
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    out = out.sort_values(["k", "o", "v"]).reset_index(drop=True)
+    # ties (o=20) share a frame end: mean(1,2,3) for both tied rows
+    assert out["m"].tolist() == pytest.approx(
+        [1.0, 2.0, 2.0, 2.5, 15.0, 15.0])
+
+
+def test_sliding_rows_frame(session):
+    pdf = _frame(40, seed=9)
+    w = Window.partitionBy("k").orderBy("o", "v").rowsBetween(-2, 0)
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    want = pdf.sort_values(["o", "v"], kind="stable")
+    want["m"] = want.groupby("k")["v"].transform(
+        lambda s: s.rolling(3, min_periods=1).mean())
+    key = ["k", "o", "v"]
+    got = out.sort_values(key).reset_index(drop=True)
+    exp = want.sort_values(key).reset_index(drop=True)
+    pd.testing.assert_series_equal(got["m"], exp["m"], rtol=1e-12)
+
+
+def test_unpartitioned_window(session):
+    pdf = _frame(20, seed=2)
+    w = Window.partitionBy()
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    assert out["m"].tolist() == pytest.approx(
+        [pdf["v"].mean()] * len(pdf))
+
+
+def test_select_routing_and_plan(session):
+    pdf = _frame(15)
+    w = Window.partitionBy("k")
+    df = session.create_dataframe(pdf).select(
+        "k", smean("v").over(w).alias("m"))
+    tree = df.session.plan(df.plan).tree_string()
+    assert "TpuWindowInPandasExec" in tree, tree
+    out = df.to_pandas()
+    assert set(out.columns) == {"k", "m"}
+
+
+def test_with_column_replace_existing(session):
+    # replacing an existing column via withColumn must not duplicate a
+    # schema entry (internal result names in the WindowInPandas node)
+    pdf = pd.DataFrame({"k": [1, 1, 2], "v": [1.0, 3.0, 5.0]})
+    w = Window.partitionBy("k")
+    out = (session.create_dataframe(pdf)
+           .withColumn("v", smean("v").over(w))).to_pandas()
+    assert list(out.columns) == ["k", "v"]
+    assert out["v"].tolist() == pytest.approx([2.0, 2.0, 5.0])
+
+
+def test_null_order_keys_are_peers(session):
+    # tied NULL order keys form one peer run (Spark range-frame
+    # semantics), not one run per NaN
+    pdf = pd.DataFrame({"k": [1] * 4,
+                        "o": [1.0, None, None, 2.0],
+                        "v": [1.0, 2.0, 3.0, 4.0]})
+    w = Window.partitionBy("k").orderBy("o")
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    by_v = dict(zip(out["v"], out["m"]))
+    # nulls first: both null rows share frame {2,3}
+    assert by_v[2.0] == pytest.approx(2.5)
+    assert by_v[3.0] == pytest.approx(2.5)
+
+
+def test_range_frame_requires_order(session):
+    pdf = _frame(10)
+    # explicit bounded range frame: rejected outright
+    with pytest.raises(ValueError, match="range"):
+        (session.create_dataframe(pdf)
+         .withColumn("m", smean("v").over(
+             Window.partitionBy("k").rangeBetween(-5, 5))))
+    # explicit running range frame without orderBy: needs an ordering
+    with pytest.raises(ValueError, match="orderBy"):
+        (session.create_dataframe(pdf)
+         .withColumn("m", smean("v").over(
+             Window.partitionBy("k").rangeBetween(None, 0))))
+
+
+def test_window_udf_combines_with_struct_select(session):
+    pdf = _frame(12)
+    w = Window.partitionBy("k")
+    out = (session.create_dataframe(pdf).select(
+        F.struct(F.col("k"), F.col("o")).alias("s"),
+        smean("v").over(w).alias("m"))).to_arrow()
+    assert out.column_names == ["s", "m"]
+    assert out.column("s").to_pylist()[0]["k"] == pdf["k"].iloc[0]
+
+
+def test_row_order_preserved(session):
+    pdf = pd.DataFrame({"k": [2, 1, 2, 1], "o": [4, 3, 2, 1],
+                        "v": [1.0, 2.0, 3.0, 4.0]})
+    w = Window.partitionBy("k")
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    # output rows keep input order (window is a projection, not a sort)
+    assert out["o"].tolist() == [4, 3, 2, 1]
+    assert out["m"].tolist() == pytest.approx([2.0, 3.0, 2.0, 3.0])
