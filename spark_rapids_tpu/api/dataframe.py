@@ -107,6 +107,44 @@ def _parse_schema(schema):
     return list(schema)
 
 
+def _file_meta_needs(exprs, schema) -> set:
+    """Which file-metadata column groups these expressions reference
+    that the schema doesn't expose yet."""
+    present = {n for n, _ in schema}
+    needs = set()
+    for e in exprs:
+        for r in e.references():
+            if r == L.FileRelation.INPUT_FILE_COL and r not in present:
+                needs.add("input_file")
+            elif (r == "_metadata" or r.startswith("_metadata.")) and \
+                    "_metadata.file_path" not in present:
+                needs.add("metadata")
+    return needs
+
+
+def _attach_file_meta(plan: L.LogicalPlan, needs: set):
+    """Rebuild the plan with file-metadata columns enabled on its
+    FileRelation leaves.  Metadata columns append to the END of the scan
+    schema, so bound ordinals in intermediate Filter/Limit/Sort nodes
+    stay valid; anything else between the reference and the scan is
+    unsupported (as in Spark, metadata columns resolve against the
+    scan)."""
+    import copy
+    if isinstance(plan, L.FileRelation):
+        new = copy.copy(plan)
+        new.pushed_filters = list(plan.pushed_filters)
+        new.file_meta = set(plan.file_meta) | needs
+        return new
+    if isinstance(plan, (L.Filter, L.Limit, L.Sort)):
+        child = _attach_file_meta(plan.children[0], needs)
+        if child is None:
+            return None
+        new = copy.copy(plan)
+        new.children = (child,)
+        return new
+    return None
+
+
 def _is_window(e: Expression) -> bool:
     from spark_rapids_tpu.exec.window import WindowExpression
     inner = e.children[0] if isinstance(e, Alias) else e
@@ -134,6 +172,15 @@ class DataFrame:
         if routed_pw is not None:
             return routed_pw
         exprs = [_expr(c) for c in cols]
+        needs = _file_meta_needs(exprs, self.plan.schema)
+        if needs:
+            attached = _attach_file_meta(self.plan, needs)
+            if attached is None:
+                raise ValueError(
+                    "input_file_name()/_metadata are only available "
+                    "above a file scan (optionally through "
+                    "filter/limit/sort)")
+            return DataFrame(self.session, attached).select(*cols)
         exprs = expand_nested_projections(exprs, self.plan.schema)
         gen = self._route_generate(exprs)
         if gen is not None:
@@ -244,7 +291,17 @@ class DataFrame:
         return DataFrame(self.session, L.Project(out, base))
 
     def filter(self, condition: Col) -> "DataFrame":
-        return DataFrame(self.session, L.Filter(_expr(condition), self.plan))
+        cond = _expr(condition)
+        needs = _file_meta_needs([cond], self.plan.schema)
+        if needs:
+            attached = _attach_file_meta(self.plan, needs)
+            if attached is None:
+                raise ValueError(
+                    "input_file_name()/_metadata are only available "
+                    "above a file scan (optionally through "
+                    "filter/limit/sort)")
+            return DataFrame(self.session, attached).filter(condition)
+        return DataFrame(self.session, L.Filter(cond, self.plan))
 
     where = filter
 

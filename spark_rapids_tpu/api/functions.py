@@ -190,6 +190,18 @@ class Col:
     def desc(self):
         return SortKey(self.expr, descending=True, nulls_first=False)
 
+    def asc_nulls_first(self):
+        return SortKey(self.expr, descending=False, nulls_first=True)
+
+    def asc_nulls_last(self):
+        return SortKey(self.expr, descending=False, nulls_first=False)
+
+    def desc_nulls_first(self):
+        return SortKey(self.expr, descending=True, nulls_first=True)
+
+    def desc_nulls_last(self):
+        return SortKey(self.expr, descending=True, nulls_first=False)
+
     def __repr__(self):
         return f"Col({self.expr})"
 
@@ -835,6 +847,13 @@ def spark_partition_id() -> Col:
     return Col(_BatchIdMarker("pid"))
 
 
+def input_file_name() -> Col:
+    """Source file path of each row (resolves against the file scan;
+    the DataFrame layer enables the scan's metadata column on use)."""
+    from spark_rapids_tpu.plan.logical import FileRelation
+    return Col(UnresolvedColumn(FileRelation.INPUT_FILE_COL))
+
+
 class _PandasAggCall(Col):
     """Marker produced by a grouped-agg pandas UDF call; GroupedData.agg
     routes it into an AggInPandas node (never evaluated as an
@@ -905,6 +924,12 @@ class _PandasWindowCall(Col):
         parts = [name_of(e, "partitionBy") for e in w._partition]
         orders = [(name_of(e, "orderBy"), d, nf)
                   for e, d, nf in w._orders]
+        if len({nf for _, _, nf in orders}) > 1:
+            # pandas sort_values has one global na_position; refusing
+            # beats silently mis-framing
+            raise ValueError(
+                "windowed pandas UDFs require a consistent nulls-first/"
+                "nulls-last across orderBy keys")
         frame = w._frame
         if frame is None:
             from spark_rapids_tpu.exec.window import Frame

@@ -28,11 +28,16 @@ class DataFrameReader:
         return self
 
     def _make(self, paths, file_format) -> DataFrame:
+        from spark_rapids_tpu.io.bucketing import read_spec
         from spark_rapids_tpu.io.readers import infer_file_schema
         if isinstance(paths, str):
             paths = [paths]
         schema = infer_file_schema(paths, file_format)
-        rel = L.FileRelation(paths, file_format, schema, self._options)
+        # a _bucket_spec.json sidecar marks a bucketed table (enables
+        # equality-filter bucket pruning, io/bucketing.py)
+        bucket_spec = read_spec(paths[0]) if len(paths) == 1 else None
+        rel = L.FileRelation(paths, file_format, schema, self._options,
+                             bucket_spec=bucket_spec)
         return DataFrame(self.session, rel)
 
     def parquet(self, *paths: str) -> DataFrame:

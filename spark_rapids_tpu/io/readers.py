@@ -103,20 +103,30 @@ def to_arrow_filter(expr: Expression):
     return rec(expr)
 
 
+META_COLUMN_NAMES = frozenset({
+    "__input_file_name", "_metadata.file_path", "_metadata.file_name",
+    "_metadata.file_size", "_metadata.file_modification_time"})
+
+
 class TpuFileScanExec(TpuExec):
     def __init__(self, paths: List[str], file_format: str, schema: Schema,
                  batch_rows: int = 1 << 20,
                  columns: Optional[List[str]] = None,
                  arrow_filter=None, reader_type: str = "AUTO",
-                 num_threads: int = 8, max_files_parallel: int = 4):
+                 num_threads: int = 8, max_files_parallel: int = 4,
+                 file_meta=()):
         super().__init__()
         self.paths = paths
         self.file_format = file_format
         self._schema = list(schema)
+        # per-file metadata columns requested (input_file_name /
+        # _metadata struct); these never read from the files themselves
+        self.file_meta = set(file_meta)
         # columns actually read; the rest are emitted as null placeholders
         # (pruning preserves the schema so bound ordinals stay valid)
         self.columns = [n for n, _ in schema
-                        if columns is None or n in columns]
+                        if (columns is None or n in columns)
+                        and n not in META_COLUMN_NAMES]
         self.batch_rows = batch_rows
         self.arrow_filter = arrow_filter
         self.reader_type = reader_type
@@ -155,7 +165,54 @@ class TpuFileScanExec(TpuExec):
                     validity=jnp.zeros(cap, dtype=jnp.bool_))
         return ColumnarBatch(cols, batch.nrows)
 
+    def _attach_meta(self, batch: ColumnarBatch, path: str
+                     ) -> ColumnarBatch:
+        import os
+        from spark_rapids_tpu.columnar.column import Column
+        cols = dict(batch.columns)
+        n, cap = batch.nrows, batch.capacity
+        if "input_file" in self.file_meta:
+            cols["__input_file_name"] = Column.from_strings(
+                [path] * n, capacity=cap)
+        if "metadata" in self.file_meta:
+            import jax.numpy as jnp
+            st = os.stat(path)
+            cols["_metadata.file_path"] = Column.from_strings(
+                [os.path.abspath(path)] * n, capacity=cap)
+            cols["_metadata.file_name"] = Column.from_strings(
+                [os.path.basename(path)] * n, capacity=cap)
+            cols["_metadata.file_size"] = Column(
+                dts.INT64, jnp.full(cap, st.st_size, dtype=jnp.int64), n)
+            cols["_metadata.file_modification_time"] = Column(
+                dts.TIMESTAMP_US,
+                jnp.full(cap, int(st.st_mtime * 1e6), dtype=jnp.int64), n)
+        return ColumnarBatch(cols, n)
+
+    def _per_file_scan(self) -> Iterator[ColumnarBatch]:
+        """Metadata columns need per-file batch attribution: each
+        dataset fragment reads and chunks independently (fragment reads
+        keep hive partition columns), its constant meta columns ride
+        every chunk."""
+        dataset = _dataset(self.paths, self.file_format)
+        for frag in dataset.get_fragments(filter=self.arrow_filter):
+            table = frag.to_table(schema=dataset.schema,
+                                  columns=self.columns,
+                                  filter=self.arrow_filter)
+            for off in range(0, table.num_rows, self.batch_rows):
+                chunk = table.slice(off, self.batch_rows)
+                if not chunk.num_rows:
+                    continue
+                self.metrics[NUM_INPUT_BATCHES] += 1
+                yield self._finish_batch(self._attach_meta(
+                    ColumnarBatch.from_arrow(chunk), frag.path))
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        if not self.paths:
+            # bucket pruning eliminated every file
+            return
+        if self.file_meta:
+            yield from self._per_file_scan()
+            return
         if self.file_format == "csv" or len(self.paths) == 1:
             yield from self._simple_scan()
             return
@@ -184,6 +241,35 @@ class TpuFileScanExec(TpuExec):
                 pa.Table.from_batches([record_batch])))
 
 
+def _bucket_pruned_paths(node: FileRelation) -> List[str]:
+    """Bucket pruning: an equality filter on the bucket column narrows
+    the scan to that bucket's file (GpuFileSourceScanExec bucket-pruning
+    analog, spec from the _bucket_spec.json sidecar)."""
+    from spark_rapids_tpu.io import bucketing as B
+    spec = node.bucket_spec
+    if not spec:
+        return node.paths
+    col = spec["column"]
+
+    def name_of(e):
+        if isinstance(e, BoundReference):
+            return e.name
+        if isinstance(e, UnresolvedColumn):
+            return e.col_name
+        return None
+
+    for f in node.pushed_filters:
+        if not isinstance(f, P.EqualTo):
+            continue
+        for a, b in ((f.left, f.right), (f.right, f.left)):
+            if name_of(a) == col and isinstance(b, Literal) \
+                    and b.value is not None:
+                pruned, _ = B.prune_paths(node.paths, spec,
+                                          node.file_format, b.value)
+                return pruned
+    return node.paths
+
+
 def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
     arrow_filter = None
     for f in node.pushed_filters:
@@ -193,10 +279,11 @@ def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
                 (arrow_filter & af)
     fmt_key = node.file_format if node.file_format != "csv" else "parquet"
     return TpuFileScanExec(
-        node.paths, node.file_format, node.schema,
+        _bucket_pruned_paths(node), node.file_format, node.schema,
         columns=sorted(node.required_columns)
         if getattr(node, "required_columns", None) else None,
         arrow_filter=arrow_filter,
+        file_meta=node.file_meta,
         reader_type=conf[
             "spark.rapids.sql.format.parquet.reader.type"],
         num_threads=conf[

@@ -29,6 +29,7 @@ class WriteStats:
 def write_batches(batches: Iterator[ColumnarBatch], path: str,
                   file_format: str, mode: str = "error",
                   partition_by: Optional[List[str]] = None,
+                  bucket_by: Optional[tuple] = None,
                   max_rows_per_file: int = 1 << 22) -> WriteStats:
     import pyarrow as pa
     import pyarrow.dataset as ds
@@ -54,6 +55,16 @@ def write_batches(batches: Iterator[ColumnarBatch], path: str,
         return WriteStats()
     table = pa.concat_tables(tables)
     stats = WriteStats(num_rows=table.num_rows)
+
+    if bucket_by is not None:
+        if partition_by:
+            raise ValueError("bucketBy cannot combine with partitionBy")
+        if mode == "append" and exists:
+            # bucket files have deterministic names; appending would
+            # silently replace them
+            raise ValueError(
+                "append mode is unsupported for bucketed tables")
+        return _write_bucketed(table, path, file_format, bucket_by, stats)
 
     if file_format == "orc":
         # pyarrow's dataset writer has no ORC support; write files directly
@@ -86,6 +97,41 @@ def write_batches(batches: Iterator[ColumnarBatch], path: str,
                 if "=" in d:
                     parts.add(os.path.join(root, d))
         stats.num_partitions = len(parts)
+    return stats
+
+
+def _write_bucketed(table, path: str, file_format: str, bucket_by,
+                    stats: WriteStats) -> WriteStats:
+    """Hash-route rows to part-bucket-N files + the _bucket_spec.json
+    sidecar (Spark bucketBy; see io/bucketing.py for read-side
+    pruning)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io import bucketing as B
+    num_buckets, column = bucket_by
+    if column not in table.column_names:
+        raise KeyError(f"bucketBy column {column!r} not in output")
+    os.makedirs(path, exist_ok=True)
+    vals = table.column(column).to_pandas().to_numpy()
+    ids = B.bucket_ids(vals, num_buckets)
+    for b in range(num_buckets):
+        rows = np.nonzero(ids == b)[0]
+        if not len(rows):
+            continue
+        f = B.bucket_file(path, b, file_format)
+        sub = table.take(rows)
+        if file_format == "parquet":
+            pq.write_table(sub, f)
+        elif file_format == "orc":
+            import pyarrow.orc as orc
+            orc.write_table(sub, f)
+        else:
+            raise ValueError(
+                f"bucketed write unsupported for {file_format}")
+        stats.num_files += 1
+        stats.num_bytes += os.path.getsize(f)
+    B.write_spec(path, column, num_buckets)
+    stats.num_partitions = num_buckets
     return stats
 
 
@@ -126,6 +172,7 @@ class DataFrameWriter:
         self.df = df
         self._mode = "error"
         self._partition_by: Optional[List[str]] = None
+        self._bucket_by: Optional[tuple] = None
 
     def mode(self, m: str) -> "DataFrameWriter":
         assert m in ("error", "errorifexists", "overwrite", "append",
@@ -137,11 +184,16 @@ class DataFrameWriter:
         self._partition_by = list(cols)
         return self
 
+    def bucketBy(self, num_buckets: int, col: str) -> "DataFrameWriter":
+        self._bucket_by = (int(num_buckets), col)
+        return self
+
     def _write(self, path: str, file_format: str) -> WriteStats:
         exec_plan = self.df.session.plan(self.df.plan)
         return write_batches(exec_plan.execute(), path, file_format,
                              mode=self._mode,
-                             partition_by=self._partition_by)
+                             partition_by=self._partition_by,
+                             bucket_by=self._bucket_by)
 
     def parquet(self, path: str) -> WriteStats:
         return self._write(path, "parquet")

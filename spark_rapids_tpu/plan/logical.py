@@ -104,8 +104,13 @@ class InMemoryRelation(LogicalPlan):
 
 
 class FileRelation(LogicalPlan):
+    # the per-file metadata columns the scan can expose on request
+    # (GpuFileSourceScanExec metadata-column analog): input_file_name()
+    # and the _metadata struct (shredded — see columnar/nested.py)
+    INPUT_FILE_COL = "__input_file_name"
+
     def __init__(self, paths: Sequence[str], file_format: str, schema: Schema,
-                 options: Optional[dict] = None):
+                 options: Optional[dict] = None, bucket_spec=None):
         self.paths = list(paths)
         self.file_format = file_format
         self._schema = list(schema)
@@ -114,13 +119,30 @@ class FileRelation(LogicalPlan):
         # pushdown + column pruning analog)
         self.pushed_filters: List[Expression] = []
         self.required_columns = None  # None = all
+        # subset of {"input_file", "metadata"}; set by the DataFrame
+        # layer when a query references the metadata columns
+        self.file_meta = set()
+        # {"column", "num_buckets"} from the _bucket_spec.json sidecar
+        self.bucket_spec = bucket_spec
 
     @property
     def schema(self) -> Schema:
-        return self._schema
+        from spark_rapids_tpu.columnar.dtypes import (
+            INT64, STRING, TIMESTAMP_US)
+        out = list(self._schema)
+        if "input_file" in self.file_meta:
+            out.append((self.INPUT_FILE_COL, STRING))
+        if "metadata" in self.file_meta:
+            out += [("_metadata.file_path", STRING),
+                    ("_metadata.file_name", STRING),
+                    ("_metadata.file_size", INT64),
+                    ("_metadata.file_modification_time", TIMESTAMP_US)]
+        return out
 
     def describe(self):
-        return f"FileRelation[{self.file_format}, {len(self.paths)} files]"
+        extra = ", bucketed" if self.bucket_spec else ""
+        return (f"FileRelation[{self.file_format}, {len(self.paths)} "
+                f"files{extra}]")
 
 
 class Project(LogicalPlan):

@@ -468,7 +468,10 @@ class TpuWindowInPandasExec(TpuExec):
             lo, hi = frame.lo, frame.hi
             for i in range(n):
                 a = 0 if lo is None else max(0, i + lo)
-                b = n if hi is None else min(n, i + hi + 1)
+                # clamp below at 0: a negative upper bound near the
+                # partition start means an EMPTY frame, not a wrapped
+                # negative iloc slice
+                b = n if hi is None else min(n, max(0, i + hi + 1))
                 out[i] = fn(s.iloc[a:b])
         res = pd.Series(out, index=g.index)
         return res

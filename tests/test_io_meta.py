@@ -1,0 +1,179 @@
+"""File metadata columns + bucketed tables (GpuFileSourceScanExec
+metadata-column and bucket-pruning analogs)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.io import bucketing as B
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture()
+def two_files(tmp_path):
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(pa.table({"a": [i * 10 + 1, i * 10 + 2],
+                                 "b": [1.0, 2.0]}), p)
+        paths.append(p)
+    return paths
+
+
+# ------------------------------------------------------- input_file_name --
+def test_input_file_name(session, two_files):
+    df = session.read.parquet(*two_files)
+    out = df.select("a", F.input_file_name().alias("f")).to_pandas()
+    by_a = dict(zip(out["a"], out["f"]))
+    assert by_a[1].endswith("f0.parquet")
+    assert by_a[11].endswith("f1.parquet")
+
+
+def test_input_file_name_above_filter(session, two_files):
+    df = session.read.parquet(*two_files).filter(F.col("a") > 5)
+    out = df.select(F.input_file_name().alias("f")).to_pandas()
+    assert all(f.endswith("f1.parquet") for f in out["f"])
+
+
+def test_filter_on_input_file_name(session, two_files):
+    df = session.read.parquet(*two_files)
+    out = df.filter(F.input_file_name().contains("f0")).to_pandas()
+    assert sorted(out["a"].tolist()) == [1, 2]
+
+
+def test_input_file_name_without_scan_errors(session):
+    df = session.create_dataframe(pd.DataFrame({"a": [1]}))
+    with pytest.raises(ValueError, match="file scan"):
+        df.select(F.input_file_name())
+
+
+# ------------------------------------------------------------- _metadata --
+def test_metadata_struct(session, two_files):
+    df = session.read.parquet(*two_files)
+    out = df.select("a", "_metadata").to_arrow()
+    assert pa.types.is_struct(out.column("_metadata").type)
+    row = out.column("_metadata").to_pylist()[0]
+    assert row["file_name"] in ("f0.parquet", "f1.parquet")
+    assert row["file_size"] > 0
+    assert row["file_path"].endswith(row["file_name"])
+
+
+def test_metadata_field_access(session, two_files):
+    df = session.read.parquet(*two_files)
+    out = df.select(
+        F.col("_metadata").getField("file_name").alias("fn"),
+        "a").to_pandas()
+    assert set(out["fn"]) == {"f0.parquet", "f1.parquet"}
+
+
+# ------------------------------------------------------------- bucketing --
+def test_bucket_ids_stable():
+    v = np.array([1, 2, 3, 1, 2, 3], dtype=np.int64)
+    ids = B.bucket_ids(v, 4)
+    assert (ids[:3] == ids[3:]).all()
+    assert ((0 <= ids) & (ids < 4)).all()
+    assert B.bucket_id_of(1, 4) == ids[0]
+
+
+def test_bucketed_write_read_roundtrip(session, tmp_path):
+    pdf = pd.DataFrame({"k": np.arange(100) % 10,
+                        "v": np.arange(100.0)})
+    out_dir = str(tmp_path / "tbl")
+    stats = (session.create_dataframe(pdf).write
+             .bucketBy(4, "k").parquet(out_dir))
+    assert stats.num_files <= 4
+    assert os.path.exists(os.path.join(out_dir, B.SPEC_FILE))
+    back = session.read.parquet(out_dir).to_pandas()
+    pd.testing.assert_frame_equal(
+        back.sort_values(["k", "v"]).reset_index(drop=True),
+        pdf.sort_values(["k", "v"]).reset_index(drop=True),
+        check_dtype=False)
+
+
+def test_bucket_pruning(session, tmp_path):
+    pdf = pd.DataFrame({"k": np.arange(200) % 13,
+                        "v": np.arange(200)})
+    out_dir = str(tmp_path / "tbl")
+    (session.create_dataframe(pdf).write
+     .bucketBy(8, "k").parquet(out_dir))
+    df = session.read.parquet(out_dir).filter(F.col("k") == 5)
+    plan = df.session.plan(df.plan)
+    scans = [n for n in _walk(plan) if type(n).__name__ ==
+             "TpuFileScanExec"]
+    assert scans and len(scans[0].paths) == 1, \
+        "equality filter must prune to one bucket file"
+    out = df.to_pandas()
+    assert sorted(out["v"].tolist()) == \
+        sorted(pdf[pdf["k"] == 5]["v"].tolist())
+
+
+def test_bucket_hash_dtype_insensitive():
+    # int literal vs float column (and vice versa) must agree
+    assert B.bucket_id_of(5, 8) == B.bucket_id_of(5.0, 8)
+    ints = B.bucket_ids(np.array([1, 2, 3], dtype=np.int64), 8)
+    floats = B.bucket_ids(np.array([1.0, 2.0, 3.0]), 8)
+    assert (ints == floats).all()
+
+
+def test_bucket_pruning_float_literal(session, tmp_path):
+    pdf = pd.DataFrame({"k": np.arange(60) % 7, "v": np.arange(60)})
+    out_dir = str(tmp_path / "tbl")
+    (session.create_dataframe(pdf).write
+     .bucketBy(4, "k").parquet(out_dir))
+    out = (session.read.parquet(out_dir)
+           .filter(F.col("k") == 3.0)).to_pandas()
+    assert sorted(out["v"].tolist()) == \
+        sorted(pdf[pdf["k"] == 3]["v"].tolist())
+
+
+def test_string_bucket_ids_vectorized():
+    vals = np.array(["alpha", "beta", "alpha", None, ""], dtype=object)
+    ids = B.bucket_ids(vals, 16)
+    assert ids[0] == ids[2]
+    assert ids[3] == B.bucket_ids(np.array([None], dtype=object), 16)[0]
+
+
+def test_bucketed_append_rejected(session, tmp_path):
+    pdf = pd.DataFrame({"k": [1, 2], "v": [1, 2]})
+    out_dir = str(tmp_path / "tbl")
+    (session.create_dataframe(pdf).write.bucketBy(2, "k")
+     .parquet(out_dir))
+    with pytest.raises(ValueError, match="append"):
+        (session.create_dataframe(pdf).write.mode("append")
+         .bucketBy(2, "k").parquet(out_dir))
+
+
+def test_input_file_name_on_hive_partitioned(session, tmp_path):
+    pdf = pd.DataFrame({"p": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]})
+    out_dir = str(tmp_path / "tbl")
+    (session.create_dataframe(pdf).write.partitionBy("p")
+     .parquet(out_dir))
+    out = (session.read.parquet(out_dir)
+           .select("v", "p", F.input_file_name().alias("f"))).to_pandas()
+    assert len(out) == 4
+    for _, r in out.iterrows():
+        assert f"p={int(r['p'])}" in r["f"]
+
+
+def test_bucketed_scan_without_filter_reads_all(session, tmp_path):
+    pdf = pd.DataFrame({"k": np.arange(50) % 5, "v": np.arange(50)})
+    out_dir = str(tmp_path / "tbl")
+    (session.create_dataframe(pdf).write
+     .bucketBy(3, "k").parquet(out_dir))
+    assert len(session.read.parquet(out_dir).to_pandas()) == 50
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)

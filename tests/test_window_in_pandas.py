@@ -88,6 +88,29 @@ def test_select_routing_and_plan(session):
     assert set(out.columns) == {"k", "m"}
 
 
+def test_negative_upper_bound_frame_empty_at_start(session):
+    # rowsBetween(-3, -2) at partition start is an EMPTY frame, not a
+    # wrapped negative slice
+    pdf = pd.DataFrame({"k": [1] * 5, "o": range(5),
+                        "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    w = Window.partitionBy("k").orderBy("o").rowsBetween(-3, -2)
+    out = (session.create_dataframe(pdf)
+           .withColumn("m", smean("v").over(w))).to_pandas()
+    got = out.sort_values("o")["m"].tolist()
+    assert pd.isna(got[0]) and pd.isna(got[1])
+    assert got[2:] == pytest.approx([1.0, 1.5, 2.5])
+
+
+def test_mixed_null_order_flags_rejected(session):
+    pdf = _frame(10)
+    with pytest.raises(ValueError, match="nulls"):
+        (session.create_dataframe(pdf)
+         .withColumn("m", smean("v").over(
+             Window.partitionBy("k").orderBy(
+                 F.col("o").asc_nulls_first(),
+                 F.col("v").asc_nulls_last()))))
+
+
 def test_with_column_replace_existing(session):
     # replacing an existing column via withColumn must not duplicate a
     # schema entry (internal result names in the WindowInPandas node)
