@@ -232,6 +232,108 @@ static int zrle_decode(const uint8_t *src, size_t encoded_len, uint8_t *dst,
     return 0;
 }
 
+// ---------------------------------------------------------------------------
+// lzb: LZ4-class byte compressor (greedy hash-table match finder, 64 KiB
+// window; own framing, no interop needed).  The general-payload codec the
+// reference gets from nvcomp-LZ4 (TableCompressionCodec.scala) — zrle stays
+// the cheap win for zero-heavy validity masks, lzb catches repetitive data
+// and string payloads.
+//
+// Stream: tokens of u8 (lit_len:4 | match_len:4); lit_len==15 extends by
+// varint; literal bytes; u16 LE offset (0 = end marker, stream ends after
+// the final literal run); match_len==15 extends by varint; real match
+// length = match_len + 4.
+// ---------------------------------------------------------------------------
+static bool lzb_encode(const uint8_t *src, size_t n,
+                       std::vector<uint8_t> &out) {
+    out.clear();
+    if (n < 16) return false;
+    out.reserve(n / 2);
+    const uint32_t HBITS = 13;
+    // reused across calls: frame_serialize invokes this once per buffer
+    // per column, and a fresh 64 KiB table per call would dominate the
+    // spill/cache hot path for wide frames
+    static thread_local std::vector<int64_t> head;
+    head.assign(1u << HBITS, -1);
+    auto hash4 = [&](uint32_t v) { return (v * 2654435761u) >> (32 - HBITS); };
+    size_t i = 0, anchor = 0;
+    while (i + 4 <= n) {
+        uint32_t v;
+        std::memcpy(&v, src + i, 4);
+        uint32_t h = hash4(v);
+        int64_t cand = head[h];
+        head[h] = static_cast<int64_t>(i);
+        if (cand >= 0 && i - cand <= 0xFFFF) {
+            uint32_t cv;
+            std::memcpy(&cv, src + cand, 4);
+            if (cv == v) {
+                size_t m = 4;
+                while (i + m < n && src[cand + m] == src[i + m]) m++;
+                size_t lit = i - anchor;
+                size_t ml = m - 4;
+                out.push_back(static_cast<uint8_t>(
+                    ((lit < 15 ? lit : 15) << 4) | (ml < 15 ? ml : 15)));
+                if (lit >= 15) put_varint(out, lit - 15);
+                out.insert(out.end(), src + anchor, src + i);
+                uint16_t off = static_cast<uint16_t>(i - cand);
+                out.push_back(static_cast<uint8_t>(off & 0xFF));
+                out.push_back(static_cast<uint8_t>(off >> 8));
+                if (ml >= 15) put_varint(out, ml - 15);
+                i += m;
+                anchor = i;
+                if (out.size() >= n) return false;
+                continue;
+            }
+        }
+        i++;
+    }
+    size_t lit = n - anchor;
+    out.push_back(static_cast<uint8_t>((lit < 15 ? lit : 15) << 4));
+    if (lit >= 15) put_varint(out, lit - 15);
+    out.insert(out.end(), src + anchor, src + n);
+    out.push_back(0);
+    out.push_back(0);  // offset 0 = end marker
+    return out.size() < n;
+}
+
+// 0 on success, <0 on corrupt input; all lengths/offsets bounded against
+// source remainder, destination capacity, and decoded position
+static int lzb_decode(const uint8_t *src, size_t encoded_len, uint8_t *dst,
+                      size_t n) {
+    const uint8_t *p = src;
+    const uint8_t *end = src + encoded_len;
+    size_t o = 0;
+    while (p < end) {
+        uint8_t tok = *p++;
+        uint64_t lit = tok >> 4;
+        if (lit == 15) {
+            uint64_t ext;
+            if (!get_varint_bounded(p, end, &ext)) return -1;
+            lit += ext;
+        }
+        if (lit > n - o || lit > static_cast<uint64_t>(end - p)) return -2;
+        std::memcpy(dst + o, p, lit);
+        p += lit;
+        o += lit;
+        if (end - p < 2) return -3;
+        uint16_t off = static_cast<uint16_t>(p[0] | (p[1] << 8));
+        p += 2;
+        if (off == 0) return o == n ? 0 : -4;  // end marker
+        uint64_t ml = tok & 15;
+        if (ml == 15) {
+            uint64_t ext;
+            if (!get_varint_bounded(p, end, &ext)) return -5;
+            ml += ext;
+        }
+        ml += 4;
+        if (off > o) return -6;
+        if (ml > n - o) return -7;
+        for (uint64_t j = 0; j < ml; j++, o++)  // overlap-safe byte copy
+            dst[o] = dst[o - off];
+    }
+    return -8;  // ran out of input before the end marker
+}
+
 struct FrameBuf {
     std::vector<uint8_t> bytes;
 };
@@ -256,13 +358,22 @@ void *frame_serialize(uint64_t nrows, uint32_t ncols,
         put_u64(o, lens[c * 3 + 1]);
         put_u64(o, lens[c * 3 + 2]);
     }
-    std::vector<uint8_t> scratch;
+    // try_compress: 0 = raw, 1 = zrle, 2 = zrle AND lzb, keep the smaller
+    std::vector<uint8_t> scratch, scratch2;
     for (uint32_t c = 0; c < ncols; c++) {
         for (int k = 0; k < 3; k++) {
             const uint8_t *src = bufs[c * 3 + k];
             uint64_t n = lens[c * 3 + k];
             if (!src || n == 0) continue;
-            if (try_compress && n >= 64 && zrle_encode(src, n, scratch)) {
+            bool z = try_compress >= 1 && n >= 64 &&
+                     zrle_encode(src, n, scratch);
+            bool l = try_compress >= 2 && n >= 64 &&
+                     lzb_encode(src, n, scratch2);
+            if (l && (!z || scratch2.size() < scratch.size())) {
+                o.push_back(2);
+                put_u64(o, scratch2.size());
+                o.insert(o.end(), scratch2.begin(), scratch2.end());
+            } else if (z) {
                 o.push_back(1);
                 put_u64(o, scratch.size());
                 o.insert(o.end(), scratch.begin(), scratch.end());
@@ -327,9 +438,14 @@ int frame_deserialize(const uint8_t *src, uint64_t src_len,
             if (codec == 0) {
                 if (enc_len > n) return -3;  // dest sized from header lens
                 std::memcpy(dst_bufs[c * 3 + k], p, enc_len);
-            } else {
+            } else if (codec == 1) {
                 if (zrle_decode(p, enc_len, dst_bufs[c * 3 + k], n) != 0)
                     return -4;
+            } else if (codec == 2) {
+                if (lzb_decode(p, enc_len, dst_bufs[c * 3 + k], n) != 0)
+                    return -5;
+            } else {
+                return -6;  // unknown codec byte
             }
             p += enc_len;
         }

@@ -102,6 +102,9 @@ class TpuSession:
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))
+        from spark_rapids_tpu import native
+        native.set_frame_codec(
+            self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC))
 
     # --------------------------------------------------------------- builders --
     @classmethod

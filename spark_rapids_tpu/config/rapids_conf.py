@@ -200,10 +200,13 @@ SHUFFLE_PARTITIONS = conf(
     "analog).", _to_int, _positive)
 
 SHUFFLE_COMPRESSION_CODEC = conf(
-    "spark.rapids.shuffle.compression.codec", "none",
-    "Codec for host-path shuffle payloads: none, lz4, zstd "
-    "(reference TableCompressionCodec.scala:107).", str,
-    lambda v: None if v in ("none", "lz4", "zstd") else "unknown codec")
+    "spark.rapids.shuffle.compression.codec", "lz4",
+    "Codec for host-path frame payloads (spill, cache, host-staged "
+    "shuffle): none, zrle (zero-RLE only), lz4 (zrle + LZ4-class lzb, "
+    "smaller wins per buffer; zstd accepted as an alias) — reference "
+    "TableCompressionCodec.scala:107.", str,
+    lambda v: None if v in ("none", "zrle", "lz4", "zstd")
+    else "unknown codec")
 
 SHUFFLE_TRANSPORT_ENABLED = conf(
     "spark.rapids.shuffle.transport.enabled", True,

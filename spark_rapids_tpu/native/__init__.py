@@ -222,6 +222,26 @@ def _as_bytes(a: Optional[np.ndarray]) -> Optional[np.ndarray]:
     return np.ascontiguousarray(a).view(np.uint8).reshape(-1)
 
 
+# frame codec level for compress=True: 0 raw, 1 zrle only, 2 zrle+lzb
+# (per-buffer, smaller wins); set from spark.rapids.shuffle.compression.codec
+_frame_codec_level = 2
+
+
+def set_frame_codec(name: str) -> None:
+    """Map the conf codec name to the native frame codec level.
+    "zstd" is accepted as an alias of the strongest level for config
+    compatibility with the reference's codec names."""
+    global _frame_codec_level
+    levels = {"none": 0, "zrle": 1, "lz4": 2, "zstd": 2}
+    if name not in levels:
+        raise ValueError(f"unknown compression codec {name!r}")
+    _frame_codec_level = levels[name]
+
+
+def frame_codec_level() -> int:
+    return _frame_codec_level
+
+
 def serialize_batch(nrows: int,
                     columns: Sequence[Tuple[int, Optional[np.ndarray],
                                             Optional[np.ndarray],
@@ -250,7 +270,7 @@ def serialize_batch(nrows: int,
     codes = (ctypes.c_uint8 * ncols)(*[c[0] for c in columns])
     out_len = ctypes.c_uint64()
     frame = lib.frame_serialize(nrows, ncols, bufs, lens, codes,
-                                1 if compress else 0,
+                                _frame_codec_level if compress else 0,
                                 ctypes.byref(out_len))
     try:
         data_ptr = lib.frame_data(frame)
