@@ -229,7 +229,9 @@ static int zrle_decode(const uint8_t *src, size_t encoded_len, uint8_t *dst,
         }
         o += len;
     }
-    return 0;
+    // a truncated stream that under-fills the destination is corrupt —
+    // accepting it would hand back uninitialized tail bytes
+    return o == n ? 0 : -5;
 }
 
 // ---------------------------------------------------------------------------

@@ -96,15 +96,15 @@ class TpuSession:
             except Exception:
                 hbm = 16 << 30
             device_budget = int(hbm * self.conf.get(rc.MEM_POOL_FRACTION))
+        from spark_rapids_tpu import native
         self.memory_catalog = SpillableBatchCatalog(
             device_budget=device_budget,
-            host_budget=self.conf.get(rc.HOST_SPILL_STORAGE_SIZE))
+            host_budget=self.conf.get(rc.HOST_SPILL_STORAGE_SIZE),
+            frame_codec=native.codec_level(
+                self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC)))
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))
-        from spark_rapids_tpu import native
-        native.set_frame_codec(
-            self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC))
 
     # --------------------------------------------------------------- builders --
     @classmethod

@@ -45,7 +45,9 @@ def batch_to_frame(batch: ColumnarBatch) -> bytes:
     for (name, dt), c in zip(batch.schema, batch.columns.values()):
         cols.append((native.dtype_code(dt), h(c.data), h(c.validity),
                      h(c.offsets)))
-    return native.serialize_batch(batch.nrows, cols)
+    from spark_rapids_tpu.memory.spill import default_catalog
+    return native.serialize_batch(batch.nrows, cols,
+                                  compress=default_catalog().frame_codec)
 
 
 def frame_to_batch(blob: bytes, schema: Schema) -> ColumnarBatch:

@@ -116,7 +116,8 @@ class SpillableHandle:
                          self._host.get(f"{name}.data"),
                          self._host.get(f"{name}.validity"),
                          self._host.get(f"{name}.offsets")))
-        blob = native.serialize_batch(self._nrows, cols)
+        blob = native.serialize_batch(self._nrows, cols,
+                                      compress=self.catalog.frame_codec)
         native.write_spill_file(path, blob)
         self._disk_path = path
         self._host = None
@@ -171,9 +172,14 @@ class SpillableBatchCatalog:
 
     def __init__(self, device_budget: int = 1 << 34,
                  host_budget: int = 1 << 30,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 frame_codec: int = 2):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # per-session frame codec level for spilled/cached frames
+        # (0 raw / 1 zrle / 2 zrle+lzb); sessions set this from
+        # spark.rapids.shuffle.compression.codec
+        self.frame_codec = frame_codec
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpu-spill-")
         # warm the native library now: its first load may shell out to g++
         # (up to ~2min); doing it lazily inside spill_to_disk would stall

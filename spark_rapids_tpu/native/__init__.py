@@ -227,15 +227,22 @@ def _as_bytes(a: Optional[np.ndarray]) -> Optional[np.ndarray]:
 _frame_codec_level = 2
 
 
-def set_frame_codec(name: str) -> None:
-    """Map the conf codec name to the native frame codec level.
-    "zstd" is accepted as an alias of the strongest level for config
-    compatibility with the reference's codec names."""
-    global _frame_codec_level
+def codec_level(name: str) -> int:
+    """Conf codec name -> native frame codec level.  "zstd" is accepted
+    as an alias of the strongest level for config compatibility with
+    the reference's codec names."""
     levels = {"none": 0, "zrle": 1, "lz4": 2, "zstd": 2}
     if name not in levels:
         raise ValueError(f"unknown compression codec {name!r}")
-    _frame_codec_level = levels[name]
+    return levels[name]
+
+
+def set_frame_codec(name: str) -> None:
+    """Set the PROCESS-default level (used when compress=True).
+    Sessions scope their conf codec per-catalog instead — see
+    SpillableBatchCatalog.frame_codec."""
+    global _frame_codec_level
+    _frame_codec_level = codec_level(name)
 
 
 def frame_codec_level() -> int:
@@ -246,8 +253,10 @@ def serialize_batch(nrows: int,
                     columns: Sequence[Tuple[int, Optional[np.ndarray],
                                             Optional[np.ndarray],
                                             Optional[np.ndarray]]],
-                    compress: bool = True) -> bytes:
-    """columns: (dtype_code, data, validity, offsets) per column."""
+                    compress=True) -> bytes:
+    """columns: (dtype_code, data, validity, offsets) per column.
+    ``compress``: True = process-default level, False = raw, or an
+    explicit int level (0 raw / 1 zrle / 2 zrle+lzb)."""
     lib = _load()
     flat: List[Optional[np.ndarray]] = []
     for _, data, validity, offsets in columns:
@@ -269,9 +278,14 @@ def serialize_batch(nrows: int,
             lens[i] = a.nbytes
     codes = (ctypes.c_uint8 * ncols)(*[c[0] for c in columns])
     out_len = ctypes.c_uint64()
+    if compress is True:
+        level = _frame_codec_level
+    elif compress is False:
+        level = 0
+    else:
+        level = int(compress)
     frame = lib.frame_serialize(nrows, ncols, bufs, lens, codes,
-                                _frame_codec_level if compress else 0,
-                                ctypes.byref(out_len))
+                                level, ctypes.byref(out_len))
     try:
         data_ptr = lib.frame_data(frame)
         return ctypes.string_at(data_ptr, out_len.value)

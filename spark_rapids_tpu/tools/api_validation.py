@@ -1,0 +1,156 @@
+"""API-surface validation tool.
+
+Counterpart of the reference's ``api_validation`` module
+(``ApiValidation.scala``): there it reflects over GPU exec constructor
+signatures and diffs them against Spark's to catch silent API drift
+between releases.  This engine has no host Spark to diff against, so the
+audit runs against a RECORDED golden manifest (``api_manifest.json`` at
+the repo root): the public API surface — DataFrame/Column/functions/
+Session methods, registered expression rules, logical plan nodes,
+physical execs, and config keys — is collected by introspection and
+compared entry-by-entry.
+
+* an entry in the manifest but missing from the code = REMOVED API
+  (breaks users; the check fails)
+* an entry in the code but not the manifest = new surface (reported;
+  refresh the manifest with --update to accept it)
+
+CLI:  spark-rapids-tpu-api-validation [--manifest PATH] [--update]
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import sys
+from typing import Dict, List
+
+# ships inside the package so the installed console script finds it
+DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "api_manifest.json")
+
+
+def _public_methods(cls) -> List[str]:
+    out = []
+    for name, member in inspect.getmembers(cls):
+        if name.startswith("_"):
+            continue
+        if callable(member) or isinstance(
+                inspect.getattr_static(cls, name, None), property):
+            out.append(name)
+    return sorted(out)
+
+
+def _public_functions(module) -> List[str]:
+    return sorted(
+        name for name, member in inspect.getmembers(module)
+        if not name.startswith("_")
+        and (inspect.isfunction(member) or inspect.isclass(member))
+        and getattr(member, "__module__", "").startswith(
+            "spark_rapids_tpu"))
+
+
+def collect_surface() -> Dict[str, List[str]]:
+    """Introspect the live package for every audited surface group."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.dataframe import DataFrame, GroupedData
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.overrides import (
+        _EXPR_RULES, _PLAN_CONVERTERS)
+
+    from spark_rapids_tpu.exec import (  # noqa: F401 - registration
+        aggregate, basic, cache, fallback, generate, join, sort, window)
+    import spark_rapids_tpu.exec as exec_pkg
+    execs = set()
+    for mod_name in ("aggregate", "basic", "cache", "fallback",
+                     "generate", "join", "sort", "window"):
+        mod = getattr(exec_pkg, mod_name)
+        for name, member in inspect.getmembers(mod, inspect.isclass):
+            if name.startswith("Tpu") and name.endswith("Exec"):
+                execs.add(name)
+    from spark_rapids_tpu.udf import python_exec
+    for name, _ in inspect.getmembers(python_exec, inspect.isclass):
+        if name.startswith("Tpu") and name.endswith("Exec"):
+            execs.add(name)
+
+    return {
+        "dataframe_methods": _public_methods(DataFrame),
+        "grouped_data_methods": _public_methods(GroupedData),
+        "column_methods": _public_methods(F.Col),
+        "functions": _public_functions(F),
+        "session_methods": _public_methods(TpuSession),
+        "expression_rules": sorted(c.__name__ for c in _EXPR_RULES),
+        "logical_nodes": sorted(
+            n for n, m in inspect.getmembers(L, inspect.isclass)
+            if issubclass(m, L.LogicalPlan) and m is not L.LogicalPlan),
+        "plan_converters": sorted(c.__name__ for c in _PLAN_CONVERTERS),
+        "physical_execs": sorted(execs),
+        "config_keys": sorted(rc._REGISTRY),
+    }
+
+
+def validate(manifest_path: str = DEFAULT_MANIFEST) -> Dict[str, dict]:
+    """Diff the live surface against the manifest.  Returns per-group
+    {"removed": [...], "added": [...]}; any non-empty "removed" is a
+    failure."""
+    with open(manifest_path) as f:
+        want = json.load(f)
+    got = collect_surface()
+    report = {}
+    for group in sorted(set(want) | set(got)):
+        w = set(want.get(group, []))
+        g = set(got.get(group, []))
+        removed = sorted(w - g)
+        added = sorted(g - w)
+        if removed or added:
+            report[group] = {"removed": removed, "added": added}
+    return report
+
+
+def write_manifest(manifest_path: str = DEFAULT_MANIFEST) -> None:
+    with open(manifest_path, "w") as f:
+        json.dump(collect_surface(), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def main(argv: List[str] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Audit the public API surface against the recorded "
+                    "manifest (api_validation analog)")
+    ap.add_argument("--manifest", default=DEFAULT_MANIFEST)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the manifest from the live surface")
+    args = ap.parse_args(argv)
+    if args.update:
+        write_manifest(args.manifest)
+        print(f"manifest written: {args.manifest}")
+        return 0
+    if not os.path.exists(args.manifest):
+        print(f"no manifest at {args.manifest}; run with --update first",
+              file=sys.stderr)
+        return 2
+    report = validate(args.manifest)
+    failed = False
+    for group, diff in report.items():
+        for name in diff["removed"]:
+            failed = True
+            print(f"REMOVED  {group}: {name}")
+        for name in diff["added"]:
+            print(f"added    {group}: {name}")
+    if failed:
+        print("\nAPI validation FAILED: entries above were removed from "
+              "the public surface; restore them or update the manifest "
+              "deliberately (--update).", file=sys.stderr)
+        return 1
+    print("API surface OK"
+          + (" (new additions listed above — refresh with --update)"
+             if report else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

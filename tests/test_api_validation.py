@@ -1,0 +1,55 @@
+"""api_validation tool tests (ApiValidation.scala analog)."""
+
+import json
+
+import pytest
+
+from spark_rapids_tpu.tools import api_validation as av
+
+
+def test_live_surface_matches_manifest():
+    """The checked-in manifest must track the live surface: removals
+    fail CI here; additions require a deliberate --update."""
+    report = av.validate()
+    removed = {g: d["removed"] for g, d in report.items() if d["removed"]}
+    assert not removed, f"public API removed: {removed}"
+    added = {g: d["added"] for g, d in report.items() if d["added"]}
+    assert not added, \
+        f"new public API not recorded — run api_validation --update: {added}"
+
+
+def test_detects_removed_api(tmp_path):
+    surface = av.collect_surface()
+    surface["functions"].append("made_up_function")
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps(surface))
+    report = av.validate(str(p))
+    assert report["functions"]["removed"] == ["made_up_function"]
+
+
+def test_detects_added_api(tmp_path):
+    surface = av.collect_surface()
+    surface["expression_rules"].remove(surface["expression_rules"][0])
+    p = tmp_path / "m.json"
+    p.write_text(json.dumps(surface))
+    report = av.validate(str(p))
+    assert len(report["expression_rules"]["added"]) == 1
+
+
+def test_cli_exit_codes(tmp_path):
+    p = tmp_path / "m.json"
+    assert av.main(["--update", "--manifest", str(p)]) == 0
+    assert av.main(["--manifest", str(p)]) == 0
+    surface = json.loads(p.read_text())
+    surface["dataframe_methods"].append("gone_method")
+    p.write_text(json.dumps(surface))
+    assert av.main(["--manifest", str(p)]) == 1
+    assert av.main(["--manifest", str(tmp_path / "nope.json")]) == 2
+
+
+def test_surface_covers_key_groups():
+    s = av.collect_surface()
+    assert "select" in s["dataframe_methods"]
+    assert "GetMapValue" in s["expression_rules"]
+    assert "TpuWindowInPandasExec" in s["physical_execs"]
+    assert any(k.startswith("spark.rapids.") for k in s["config_keys"])

@@ -280,3 +280,30 @@ def test_lzb_corrupt_input_rejected():
             # if it decodes, it must not crash; content may differ
         except ValueError:
             pass
+
+
+def test_codec_scoped_per_catalog(tmp_path):
+    """Two sessions' catalogs keep independent codec levels — no
+    process-global cross-talk."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.spill import SpillableBatchCatalog
+    text = ["spark rapids tpu " * 50] * 200
+    batch = ColumnarBatch.from_pydict({"s": text})
+    sizes = {}
+    for level in (0, 2):
+        import os
+        spill_dir = str(tmp_path / str(level))
+        os.makedirs(spill_dir, exist_ok=True)
+        cat = SpillableBatchCatalog(spill_dir=spill_dir,
+                                    frame_codec=level)
+        h = cat.register(ColumnarBatch.from_pydict({"s": text}))
+        h.spill_to_host()
+        h.spill_to_disk()
+        import os
+        f = [os.path.join(cat.spill_dir, x)
+             for x in os.listdir(cat.spill_dir)][0]
+        sizes[level] = os.path.getsize(f)
+        assert np.array_equal(
+            h.materialize().columns["s"].to_pylist(),
+            batch.columns["s"].to_pylist())
+    assert sizes[2] < sizes[0] * 0.2
