@@ -438,7 +438,9 @@ int frame_deserialize(const uint8_t *src, uint64_t src_len,
             p += 8;
             if (enc_len > static_cast<uint64_t>(end - p)) return -2;
             if (codec == 0) {
-                if (enc_len > n) return -3;  // dest sized from header lens
+                // raw buffers are written at exactly the header length;
+                // a shorter payload is truncation (uninitialized tail)
+                if (enc_len != n) return -3;
                 std::memcpy(dst_bufs[c * 3 + k], p, enc_len);
             } else if (codec == 1) {
                 if (zrle_decode(p, enc_len, dst_bufs[c * 3 + k], n) != 0)

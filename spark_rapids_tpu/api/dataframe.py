@@ -511,6 +511,51 @@ class DataFrame:
         # shredded struct/map columns reassemble at the output boundary
         return nested.assemble_table(table)
 
+    def to_device_batches(self):
+        """ML interop, streaming form (ColumnarRdd analog —
+        /root/reference sql-plugin ColumnarRdd: export the device table
+        per partition to ML consumers without a host round trip).
+        Yields the engine's internal device-resident ColumnarBatches
+        one at a time (bounded memory — batches are NOT materialized up
+        front; this path skips query event logging); columns expose jax
+        arrays as ``.data``/``.validity``."""
+        exec_plan = self.session.plan(self.plan)
+        self._last_exec = exec_plan
+        yield from exec_plan.execute()
+
+    def to_jax(self):
+        """ML interop, materialized form: the full result as a dict of
+        column name -> jax device array (plus ``name__mask`` boolean
+        validity arrays for nullable columns), trimmed to the row
+        count.  Fixed-width columns only — strings/nested types have no
+        dense tensor form; project them away first."""
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.concat import concat_batches
+        names = [n for n, _ in self.plan.schema]
+        for name, dt in self.plan.schema:
+            if dt.has_offsets or dt.is_nested:
+                raise ValueError(
+                    f"to_jax(): column {name!r} has type {dt}; only "
+                    "fixed-width columns export as dense arrays")
+            if name.endswith("__mask") and \
+                    name[:-len("__mask")] in names:
+                raise ValueError(
+                    f"to_jax(): column {name!r} collides with the "
+                    "validity-mask output key for "
+                    f"{name[:-len('__mask')]!r}; alias it first")
+        batches = self._execute_batches()
+        if not batches:
+            return {name: jnp.zeros(0, dtype=dt.storage)
+                    for name, dt in self.plan.schema}
+        merged = concat_batches(batches)
+        out = {}
+        n = merged.nrows
+        for name, col in merged.columns.items():
+            out[name] = col.data[:n]
+            if col.validity is not None:
+                out[name + "__mask"] = col.validity[:n]
+        return out
+
     def to_pandas(self):
         return self.to_arrow().to_pandas()
 

@@ -156,6 +156,62 @@ class TpuSession:
 
     createDataFrame = create_dataframe
 
+    def create_dataframe_from_jax(self, arrays: dict,
+                                  masks: Optional[dict] = None
+                                  ) -> DataFrame:
+        """ML-interop ingest: build a DataFrame directly from jax device
+        arrays (zero host round trip — the inverse of
+        ``DataFrame.to_jax``).  ``masks``: optional {name: bool array}
+        validity."""
+        from spark_rapids_tpu.columnar.column import (
+            Column, bucket_capacity)
+        from spark_rapids_tpu.columnar.dtypes import from_numpy_dtype
+        from spark_rapids_tpu.columnar.nested import check_reserved_names
+        import jax.numpy as jnp
+        import numpy as np
+        check_reserved_names(arrays.keys())
+        masks = masks or {}
+        for name in arrays:
+            if name.endswith("__mask"):
+                raise ValueError(
+                    f"column name {name!r}: the '__mask' suffix is "
+                    "reserved for to_jax() validity outputs")
+        unknown = set(masks) - set(arrays)
+        if unknown:
+            raise ValueError(f"masks for unknown column(s) {unknown}")
+        cols = {}
+        nrows = None
+        for name, arr in arrays.items():
+            arr = jnp.asarray(arr)
+            if arr.ndim != 1:
+                raise ValueError(
+                    f"column {name!r}: expected 1-D array, got "
+                    f"shape {arr.shape}")
+            if nrows is None:
+                nrows = arr.shape[0]
+            elif arr.shape[0] != nrows:
+                raise ValueError(
+                    f"column {name!r}: length {arr.shape[0]} != {nrows}")
+            dt = from_numpy_dtype(np.dtype(arr.dtype))
+            cap = bucket_capacity(nrows)
+            if arr.shape[0] < cap:
+                arr = jnp.concatenate(
+                    [arr, jnp.zeros(cap - arr.shape[0], dtype=arr.dtype)])
+            validity = masks.get(name)
+            if validity is not None:
+                validity = jnp.asarray(validity).astype(bool)
+                if validity.shape[0] != nrows:
+                    raise ValueError(
+                        f"mask for {name!r}: length "
+                        f"{validity.shape[0]} != {nrows}")
+                validity = jnp.concatenate(
+                    [validity,
+                     jnp.zeros(cap - validity.shape[0], dtype=bool)])
+            cols[name] = Column(dt, arr, nrows, validity=validity)
+        batch = ColumnarBatch(cols, nrows or 0)
+        rel = L.InMemoryRelation([batch], batch.schema)
+        return DataFrame(self, rel)
+
     def range(self, start: int, end: Optional[int] = None,
               step: int = 1) -> DataFrame:
         if end is None:

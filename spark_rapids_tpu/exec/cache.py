@@ -24,7 +24,7 @@ from spark_rapids_tpu.columnar.column import Column
 from spark_rapids_tpu.exec.base import Schema, TpuExec
 
 
-def batch_to_frame(batch: ColumnarBatch) -> bytes:
+def batch_to_frame(batch: ColumnarBatch, compress=True) -> bytes:
     """Serialize one device batch to a compressed host frame."""
     import jax
     from spark_rapids_tpu import native
@@ -45,9 +45,7 @@ def batch_to_frame(batch: ColumnarBatch) -> bytes:
     for (name, dt), c in zip(batch.schema, batch.columns.values()):
         cols.append((native.dtype_code(dt), h(c.data), h(c.validity),
                      h(c.offsets)))
-    from spark_rapids_tpu.memory.spill import default_catalog
-    return native.serialize_batch(batch.nrows, cols,
-                                  compress=default_catalog().frame_codec)
+    return native.serialize_batch(batch.nrows, cols, compress=compress)
 
 
 def frame_to_batch(blob: bytes, schema: Schema) -> ColumnarBatch:
@@ -113,9 +111,13 @@ class TpuMaterializeCacheExec(TpuExec):
     completes (a LIMIT that stops early must not publish a partial
     cache)."""
 
-    def __init__(self, entry: CacheEntry, child: TpuExec):
+    def __init__(self, entry: CacheEntry, child: TpuExec,
+                 codec_level: int = 2):
         super().__init__(child)
         self.entry = entry
+        # the owning session's conf codec (per-session, not process
+        # global — a second session must not change this plan's codec)
+        self.codec_level = codec_level
 
     @property
     def schema(self) -> Schema:
@@ -127,7 +129,8 @@ class TpuMaterializeCacheExec(TpuExec):
     def do_execute(self) -> Iterator[ColumnarBatch]:
         frames: List[bytes] = []
         for batch in self.children[0].execute():
-            frames.append(batch_to_frame(batch))
+            frames.append(batch_to_frame(batch,
+                                         compress=self.codec_level))
             yield batch
         self.entry.frames = frames
 

@@ -633,8 +633,12 @@ class TpuOverrides:
                     TpuCachedScanExec, TpuMaterializeCacheExec)
                 if entry.materialized:
                     return TpuCachedScanExec(entry)
+                from spark_rapids_tpu import native
+                from spark_rapids_tpu.config import rapids_conf as rc
                 return TpuMaterializeCacheExec(
-                    entry, self._convert_uncached(meta))
+                    entry, self._convert_uncached(meta),
+                    codec_level=native.codec_level(
+                        self.conf[rc.SHUFFLE_COMPRESSION_CODEC.key]))
         return self._convert_uncached(meta)
 
     def _convert_uncached(self, meta: PlanMeta):

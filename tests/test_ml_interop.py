@@ -1,0 +1,101 @@
+"""ML interop: device-array export/ingest (ColumnarRdd analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_to_jax_roundtrip(session):
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame({"x": rng.normal(size=200),
+                        "y": rng.integers(0, 100, 200)})
+    out = session.create_dataframe(pdf).to_jax()
+    np.testing.assert_allclose(np.asarray(out["x"]), pdf["x"].to_numpy())
+    np.testing.assert_array_equal(np.asarray(out["y"]),
+                                  pdf["y"].to_numpy())
+
+
+def test_to_jax_after_query(session):
+    pdf = pd.DataFrame({"x": np.arange(100.0), "k": np.arange(100) % 4})
+    df = (session.create_dataframe(pdf)
+          .filter(F.col("k") == 1)
+          .select((F.col("x") * 2).alias("x2")))
+    out = df.to_jax()
+    want = pdf[pdf["k"] == 1]["x"].to_numpy() * 2
+    np.testing.assert_allclose(np.asarray(out["x2"]), want)
+
+
+def test_to_jax_nullable_mask(session):
+    pdf = pd.DataFrame({"x": [1.0, None, 3.0, None]})
+    out = session.create_dataframe(pdf).to_jax()
+    assert np.asarray(out["x__mask"]).tolist() == [True, False, True,
+                                                   False]
+
+
+def test_to_jax_rejects_strings(session):
+    pdf = pd.DataFrame({"s": ["a", "b"]})
+    with pytest.raises(ValueError, match="fixed-width"):
+        session.create_dataframe(pdf).to_jax()
+
+
+def test_to_jax_empty_result(session):
+    pdf = pd.DataFrame({"x": [1.0, 2.0]})
+    out = (session.create_dataframe(pdf)
+           .filter(F.col("x") > 99)).to_jax()
+    assert np.asarray(out["x"]).shape == (0,)
+
+
+def test_to_device_batches_streams(session):
+    pdf = pd.DataFrame({"x": np.arange(50.0)})
+    batches = list(session.create_dataframe(pdf).to_device_batches())
+    assert sum(b.nrows for b in batches) == 50
+    # device-resident jax arrays, not numpy
+    import jax
+    assert isinstance(batches[0].columns["x"].data, jax.Array)
+
+
+def test_from_jax_ingest_and_query(session):
+    import jax.numpy as jnp
+    df = session.create_dataframe_from_jax({
+        "a": jnp.arange(10.0),
+        "b": jnp.arange(10, dtype=jnp.int64),
+    })
+    out = df.filter(F.col("b") >= 5).to_pandas()
+    assert out["a"].tolist() == [5.0, 6.0, 7.0, 8.0, 9.0]
+
+
+def test_from_jax_with_mask(session):
+    import jax.numpy as jnp
+    df = session.create_dataframe_from_jax(
+        {"a": jnp.arange(4.0)},
+        masks={"a": jnp.asarray([True, False, True, True])})
+    out = df.to_pandas()
+    assert pd.isna(out["a"].iloc[1])
+    assert out["a"].iloc[2] == 2.0
+
+
+def test_from_jax_validates(session):
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="length"):
+        session.create_dataframe_from_jax(
+            {"a": jnp.arange(3.0), "b": jnp.arange(4.0)})
+    with pytest.raises(ValueError, match="1-D"):
+        session.create_dataframe_from_jax(
+            {"a": jnp.zeros((2, 2))})
+
+
+def test_jax_roundtrip_both_ways(session):
+    import jax.numpy as jnp
+    arrays = {"v": jnp.asarray(np.random.default_rng(1).normal(size=64))}
+    df = session.create_dataframe_from_jax(arrays)
+    out = df.select((F.col("v") + 1).alias("v1")).to_jax()
+    np.testing.assert_allclose(np.asarray(out["v1"]),
+                               np.asarray(arrays["v"]) + 1, rtol=1e-12)
