@@ -161,21 +161,29 @@ class TpuSession:
                                   ) -> DataFrame:
         """ML-interop ingest: build a DataFrame directly from jax device
         arrays (zero host round trip — the inverse of
-        ``DataFrame.to_jax``).  ``masks``: optional {name: bool array}
-        validity."""
+        ``DataFrame.to_jax``: ``name__mask`` keys route automatically
+        into validity).  ``masks``: optional {name: bool array}
+        validity, merged with any inline ``__mask`` keys."""
         from spark_rapids_tpu.columnar.column import (
             Column, bucket_capacity)
         from spark_rapids_tpu.columnar.dtypes import from_numpy_dtype
         from spark_rapids_tpu.columnar.nested import check_reserved_names
         import jax.numpy as jnp
         import numpy as np
+        masks = dict(masks or {})
+        # round-trip support: to_jax() emits validity as '<name>__mask'
+        inline = {n: a for n, a in arrays.items()
+                  if n.endswith("__mask")}
+        if inline:
+            arrays = {n: a for n, a in arrays.items() if n not in inline}
+            for n, a in inline.items():
+                base = n[:-len("__mask")]
+                if base not in arrays:
+                    raise ValueError(
+                        f"mask key {n!r} has no matching column "
+                        f"{base!r}")
+                masks.setdefault(base, a)
         check_reserved_names(arrays.keys())
-        masks = masks or {}
-        for name in arrays:
-            if name.endswith("__mask"):
-                raise ValueError(
-                    f"column name {name!r}: the '__mask' suffix is "
-                    "reserved for to_jax() validity outputs")
         unknown = set(masks) - set(arrays)
         if unknown:
             raise ValueError(f"masks for unknown column(s) {unknown}")

@@ -272,7 +272,9 @@ class DistributedHashJoin:
                  join_type: str = "inner",
                  strategy: str = "auto",
                  out_factor: int = 1,
-                 broadcast_threshold_rows: int = 1 << 16):
+                 broadcast_threshold_rows: int = 1 << 16,
+                 skew_factor: float = 4.0,
+                 skew_min_rows: int = 1 << 12):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         if join_type not in ("inner", "left"):
             raise ValueError("distributed join supports inner/left")
@@ -289,6 +291,13 @@ class DistributedHashJoin:
         self.strategy = strategy
         self.out_factor = out_factor
         self.broadcast_threshold_rows = broadcast_threshold_rows
+        # skew mitigation (OptimizeSkewedJoin / GpuCustomShuffleReader
+        # analog): a destination receiving > skew_factor * median rows
+        # (and > skew_min_rows) is "skewed" — its probe rows scatter
+        # round-robin across ALL shards and its build rows replicate to
+        # all shards, so one hot key cannot serialize on one chip
+        self.skew_factor = skew_factor
+        self.skew_min_rows = skew_min_rows
         self._cached_jit = cached_jit
         self._sig = ("dist_join", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
@@ -299,11 +308,13 @@ class DistributedHashJoin:
                      join_type, out_factor)
         self.last_stats: Optional[dict] = None
 
-    def _jitted(self, strategy: str, slots):
-        """Compiled program per (strategy, exchange slots)."""
+    def _jitted(self, strategy: str, slots, skewed=()):
+        """Compiled program per (strategy, exchange slots, skew set)."""
         return self._cached_jit(
-            self._sig + (strategy, slots), lambda: jax.shard_map(
-                partial(self._step, strategy, slots), mesh=self.mesh,
+            self._sig + (strategy, slots, tuple(skewed)),
+            lambda: jax.shard_map(
+                partial(self._step, strategy, slots, tuple(skewed)),
+                mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis),
                           P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
@@ -336,7 +347,15 @@ class DistributedHashJoin:
                           P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
-    def _step(self, strategy, slots, probe_flat, probe_nrows_arr,
+    @staticmethod
+    def _in_skewed(pids, skewed):
+        """Boolean mask: pid is in the (static, small) skewed tuple."""
+        m = jnp.zeros(pids.shape, dtype=jnp.bool_)
+        for s in skewed:
+            m = jnp.logical_or(m, pids == s)
+        return m
+
+    def _step(self, strategy, slots, skewed, probe_flat, probe_nrows_arr,
               build_flat, build_nrows_arr):
         from spark_rapids_tpu.ops import joins as J
         from spark_rapids_tpu.parallel.shuffle import all_gather_cols
@@ -359,10 +378,70 @@ class DistributedHashJoin:
             bkeys = [build[i] for i in self.build_key_idx]
             ppids = hash_partition_ids(pkeys, self.nshards)
             bpids = hash_partition_ids(bkeys, self.nshards)
-            probe, pn = exchange(probe, ppids, pn, self.axis, self.nshards,
-                                 slot=slots[0])
-            build, bn = exchange(build, bpids, bn, self.axis, self.nshards,
-                                 slot=slots[1])
+            if skewed:
+                # skew-join mitigation: probe rows bound for a skewed
+                # destination scatter round-robin over ALL shards; the
+                # matching build rows replicate everywhere.  Non-skewed
+                # keys hash to different pids, so the replicated rows
+                # can never produce cross matches or duplicates.
+                sk_p = self._in_skewed(ppids, skewed)
+                # enumerate SKEWED rows only (cumsum over the mask):
+                # raw-position % nshards would bias toward one
+                # destination for strided layouts and overflow the
+                # slot bound sized in __call__
+                order = jnp.cumsum(sk_p.astype(jnp.int32)) - 1
+                rr = (order % self.nshards).astype(ppids.dtype)
+                ppids = jnp.where(sk_p, rr, ppids)
+                live_b = jnp.arange(bpids.shape[0],
+                                    dtype=jnp.int32) < bn
+                sk_b = self._in_skewed(bpids, skewed)
+                norm_cols, n_norm = selection.compact(
+                    build, jnp.logical_and(live_b, ~sk_b))
+                sk_cols, n_sk = selection.compact(
+                    build, jnp.logical_and(live_b, sk_b))
+                probe, pn = exchange(probe, ppids, pn, self.axis,
+                                     self.nshards, slot=slots[0])
+                norm_keys = [norm_cols[i] for i in self.build_key_idx]
+                b1, bn1 = exchange(
+                    norm_cols, hash_partition_ids(norm_keys,
+                                                  self.nshards),
+                    n_norm, self.axis, self.nshards, slot=slots[1])
+                # gather only a bounded prefix: the host sized
+                # slots[2] from the true max per-shard skewed build
+                # count, so the full cap_b column never rides ICI
+                gcap = slots[2]
+                sk_sliced = [
+                    ColVal(c.dtype, c.values[:gcap],
+                           None if c.validity is None
+                           else c.validity[:gcap])
+                    for c in sk_cols]
+                b2, bn2 = all_gather_cols(sk_sliced, n_sk, self.axis,
+                                          self.nshards)
+                # merge the two dense prefixes into one
+                c1 = b1[0].values.shape[0]
+                c2 = b2[0].values.shape[0]
+                pos = jnp.arange(c1 + c2, dtype=jnp.int32)
+                idx = jnp.where(
+                    pos < bn1, jnp.clip(pos, 0, c1 - 1),
+                    c1 + jnp.clip(pos - bn1, 0, c2 - 1))
+                merged = []
+                for x, y in zip(b1, b2):
+                    vals = jnp.concatenate([x.values, y.values])
+                    validity = None
+                    if x.validity is not None or y.validity is not None:
+                        xv = x.validity if x.validity is not None else \
+                            jnp.ones(c1, dtype=jnp.bool_)
+                        yv = y.validity if y.validity is not None else \
+                            jnp.ones(c2, dtype=jnp.bool_)
+                        validity = jnp.concatenate([xv, yv])
+                    merged.append(ColVal(x.dtype, vals, validity))
+                bn = bn1 + bn2
+                build = selection.gather(merged, idx, bn.astype(jnp.int32))
+            else:
+                probe, pn = exchange(probe, ppids, pn, self.axis,
+                                     self.nshards, slot=slots[0])
+                build, bn = exchange(build, bpids, bn, self.axis,
+                                     self.nshards, slot=slots[1])
 
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
@@ -411,6 +490,7 @@ class DistributedHashJoin:
                 if total_build <= self.broadcast_threshold_rows else \
                 "shuffle"
         slots = (None, None)
+        skewed = ()
         stats = {"strategy": strategy, "build_rows": total_build}
         if strategy == "shuffle":
             phist, bhist = self._stats_jitted()(
@@ -421,11 +501,41 @@ class DistributedHashJoin:
             from spark_rapids_tpu.parallel.shuffle import pick_slot
             cap_p = int(probe_flat[0][0].shape[0]) // self.nshards
             cap_b = int(build_flat[0][0].shape[0]) // self.nshards
-            slots = (pick_slot(int(pcounts.max()), cap_p),
-                     pick_slot(int(bcounts.max()), cap_b))
+            # skew detection on the probe destination totals
+            # (OptimizeSkewedJoin: partition > factor * median)
+            dest_p = pcounts.sum(axis=0)
+            med = max(1.0, float(np.median(dest_p)))
+            skewed = tuple(
+                int(d) for d in np.nonzero(
+                    (dest_p > self.skew_factor * med)
+                    & (dest_p > self.skew_min_rows))[0])
+            if skewed:
+                sk = np.zeros(self.nshards, dtype=bool)
+                sk[list(skewed)] = True
+                # after mitigation each src spreads its skewed rows
+                # exactly evenly (cumsum round-robin), so the
+                # per-(src,dst) slice bound is the non-skewed count
+                # plus that src's share
+                share = np.ceil(
+                    pcounts[:, sk].sum(axis=1) / self.nshards) + 1
+                padj = pcounts.copy()
+                padj[:, sk] = 0
+                padj = padj + share[:, None]
+                badj = bcounts.copy()
+                badj[:, sk] = 0
+                # third slot: capacity of the skewed-build all-gather
+                # prefix (max skewed build rows on any one shard)
+                gather_cap = pick_slot(
+                    int(bcounts[:, sk].sum(axis=1).max()), cap_b)
+                slots = (pick_slot(int(padj.max()), cap_p),
+                         pick_slot(int(badj.max()), cap_b),
+                         gather_cap)
+            else:
+                slots = (pick_slot(int(pcounts.max()), cap_p),
+                         pick_slot(int(bcounts.max()), cap_b))
             stats.update(probe_counts=pcounts, build_counts=bcounts,
-                         slots=slots)
+                         slots=slots, skewed=skewed)
         self.last_stats = stats
-        return self._jitted(strategy, slots)(
+        return self._jitted(strategy, slots, skewed)(
             probe_flat, probe_nrows_per_shard,
             build_flat, build_nrows_per_shard)

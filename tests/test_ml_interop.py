@@ -99,3 +99,20 @@ def test_jax_roundtrip_both_ways(session):
     out = df.select((F.col("v") + 1).alias("v1")).to_jax()
     np.testing.assert_allclose(np.asarray(out["v1"]),
                                np.asarray(arrays["v"]) + 1, rtol=1e-12)
+
+
+def test_to_jax_from_jax_nullable_roundtrip(session):
+    # create_dataframe_from_jax(df.to_jax()) is a true inverse: the
+    # __mask keys route back into validity automatically
+    pdf = pd.DataFrame({"x": [1.0, None, 3.0], "y": [1, 2, 3]})
+    out = session.create_dataframe(pdf).to_jax()
+    back = session.create_dataframe_from_jax(out).to_pandas()
+    pd.testing.assert_frame_equal(
+        back, pdf, check_dtype=False)
+
+
+def test_from_jax_orphan_mask_rejected(session):
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="no matching column"):
+        session.create_dataframe_from_jax(
+            {"a__mask": jnp.asarray([True, False])})

@@ -318,3 +318,155 @@ def test_join_auto_strategy_from_stats(mesh, rng):
     assert stats_s["slots"][0] <= max(
         2 * int(stats_s["probe_counts"].max()), 8)
     assert rows_b > 0 and rows_s > 0
+
+
+def test_skew_join_mitigation(mesh, rng):
+    """One hot key dominating the probe side: the skewed destination's
+    probe rows scatter across all shards (round-robin) while its build
+    rows replicate — output matches the oracle and no single shard
+    serializes the hot key."""
+    from spark_rapids_tpu.parallel.distributed import DistributedHashJoin
+    hot = 7
+    # ~85% of probe rows carry the hot key
+    fk = np.where(rng.uniform(size=(NSHARDS, CAP)) < 0.85, hot,
+                  rng.integers(0, 40, (NSHARDS, CAP))).astype(np.int64)
+    amount = rng.normal(size=(NSHARDS, CAP))
+    p_nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    dim_keys = np.arange(40, dtype=np.int64)
+    dk = np.zeros((NSHARDS, CAP), dtype=np.int64)
+    dv = np.zeros((NSHARDS, CAP), dtype=np.float64)
+    b_nrows = np.zeros(NSHARDS, dtype=np.int32)
+    for i, k in enumerate(dim_keys):
+        s = i % NSHARDS
+        dk[s, b_nrows[s]] = k
+        dv[s, b_nrows[s]] = float(k) * 10
+        b_nrows[s] += 1
+
+    join = DistributedHashJoin(
+        mesh,
+        probe_dtypes=[dts.INT64, dts.FLOAT64],
+        build_dtypes=[dts.INT64, dts.FLOAT64],
+        probe_key_idx=[0], build_key_idx=[0],
+        join_type="inner", strategy="shuffle", out_factor=2,
+        skew_factor=2.0, skew_min_rows=64)
+
+    probe_flat = [(_make_sharded(fk), jnp.ones(NSHARDS * CAP, bool)),
+                  (_make_sharded(amount, np.float64),
+                   jnp.ones(NSHARDS * CAP, bool))]
+    build_flat = [(_make_sharded(dk), jnp.ones(NSHARDS * CAP, bool)),
+                  (_make_sharded(dv, np.float64),
+                   jnp.ones(NSHARDS * CAP, bool))]
+    flat, n_out, total = join(probe_flat, jnp.asarray(p_nrows),
+                              build_flat, jnp.asarray(b_nrows))
+    assert join.last_stats["skewed"], \
+        "the hot destination must be detected as skewed"
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(n_out),
+                                  err_msg="join output truncated")
+    per_shard = np.asarray(n_out)
+    # mitigation spreads the hot key: no shard holds more than ~2x the
+    # mean output
+    assert per_shard.max() <= 2.2 * per_shard.mean()
+
+    rows = []
+    for s in range(NSHARDS):
+        n = per_shard[s]
+        fkv = np.asarray(flat[0][0]).reshape(NSHARDS, -1)[s, :n]
+        amt = np.asarray(flat[1][0]).reshape(NSHARDS, -1)[s, :n]
+        dvv = np.asarray(flat[3][0]).reshape(NSHARDS, -1)[s, :n]
+        rows += list(zip(fkv, amt, dvv))
+    got = pd.DataFrame(rows, columns=["fk", "amount", "dimval"])
+    probe_df = pd.concat([
+        pd.DataFrame({"fk": fk[s, :p_nrows[s]],
+                      "amount": amount[s, :p_nrows[s]]})
+        for s in range(NSHARDS)])
+    want = probe_df.merge(
+        pd.DataFrame({"fk": dim_keys, "dimval": dim_keys * 10.0}),
+        on="fk", how="inner")
+    assert len(got) == len(want)
+    key = ["fk", "amount", "dimval"]
+    g = got.sort_values(key).reset_index(drop=True)
+    w = want.sort_values(key).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w, check_dtype=False)
+
+
+def test_skew_slots_smaller_than_unmitigated(mesh, rng):
+    """With mitigation, the probe exchange slot sizes to the spread
+    share, not the hot destination's full column."""
+    from spark_rapids_tpu.parallel.distributed import DistributedHashJoin
+    fk = np.full((NSHARDS, CAP), 3, dtype=np.int64)  # all rows hot
+    p_nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    dk = np.zeros((NSHARDS, CAP), dtype=np.int64)
+    for k in range(8):  # unique global keys, one per shard
+        dk[k % NSHARDS, 0] = k
+    b_nrows = np.full(NSHARDS, 1, dtype=np.int32)
+    join = DistributedHashJoin(
+        mesh, probe_dtypes=[dts.INT64], build_dtypes=[dts.INT64],
+        probe_key_idx=[0], build_key_idx=[0],
+        join_type="inner", strategy="shuffle", out_factor=2,
+        skew_factor=2.0, skew_min_rows=16)
+    pf = [(_make_sharded(fk), jnp.ones(NSHARDS * CAP, bool))]
+    bf = [(_make_sharded(dk), jnp.ones(NSHARDS * CAP, bool))]
+    flat, n_out, total = join(pf, jnp.asarray(p_nrows),
+                              bf, jnp.asarray(b_nrows))
+    stats = join.last_stats
+    assert stats["skewed"]
+    # unmitigated, the slot would be CAP (every row to one dest);
+    # mitigated it is ~CAP/NSHARDS rounded up to a power of two
+    assert stats["slots"][0] <= CAP // 2
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(n_out))
+
+
+def test_skew_strided_layout_no_overflow(mesh, rng):
+    """Hot rows at strided positions (pos % nshards constant): the
+    round-robin must enumerate skewed rows, not raw positions, or one
+    destination overflows its slot and corrupts output."""
+    from spark_rapids_tpu.parallel.distributed import DistributedHashJoin
+    hot = 5
+    fk = rng.integers(8, 40, (NSHARDS, CAP)).astype(np.int64)
+    fk[:, ::2] = hot  # half the rows hot, all at even positions
+    amount = rng.normal(size=(NSHARDS, CAP))
+    p_nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    dim_keys = np.arange(40, dtype=np.int64)
+    dk = np.zeros((NSHARDS, CAP), dtype=np.int64)
+    dv = np.zeros((NSHARDS, CAP), dtype=np.float64)
+    b_nrows = np.zeros(NSHARDS, dtype=np.int32)
+    for i, k in enumerate(dim_keys):
+        s = i % NSHARDS
+        dk[s, b_nrows[s]] = k
+        dv[s, b_nrows[s]] = float(k) * 10
+        b_nrows[s] += 1
+    join = DistributedHashJoin(
+        mesh, probe_dtypes=[dts.INT64, dts.FLOAT64],
+        build_dtypes=[dts.INT64, dts.FLOAT64],
+        probe_key_idx=[0], build_key_idx=[0],
+        join_type="inner", strategy="shuffle", out_factor=2,
+        skew_factor=2.0, skew_min_rows=64)
+    pf = [(_make_sharded(fk), jnp.ones(NSHARDS * CAP, bool)),
+          (_make_sharded(amount, np.float64),
+           jnp.ones(NSHARDS * CAP, bool))]
+    bf = [(_make_sharded(dk), jnp.ones(NSHARDS * CAP, bool)),
+          (_make_sharded(dv, np.float64), jnp.ones(NSHARDS * CAP, bool))]
+    flat, n_out, total = join(pf, jnp.asarray(p_nrows),
+                              bf, jnp.asarray(b_nrows))
+    assert join.last_stats["skewed"]
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(n_out))
+    per_shard = np.asarray(n_out)
+    rows = []
+    for s in range(NSHARDS):
+        n = per_shard[s]
+        fkv = np.asarray(flat[0][0]).reshape(NSHARDS, -1)[s, :n]
+        amt = np.asarray(flat[1][0]).reshape(NSHARDS, -1)[s, :n]
+        dvv = np.asarray(flat[3][0]).reshape(NSHARDS, -1)[s, :n]
+        rows += list(zip(fkv, amt, dvv))
+    got = pd.DataFrame(rows, columns=["fk", "amount", "dimval"])
+    probe_df = pd.concat([
+        pd.DataFrame({"fk": fk[s], "amount": amount[s]})
+        for s in range(NSHARDS)])
+    want = probe_df.merge(
+        pd.DataFrame({"fk": dim_keys, "dimval": dim_keys * 10.0}),
+        on="fk", how="inner")
+    assert len(got) == len(want)
+    key = ["fk", "amount", "dimval"]
+    pd.testing.assert_frame_equal(
+        got.sort_values(key).reset_index(drop=True),
+        want.sort_values(key).reset_index(drop=True), check_dtype=False)
