@@ -511,6 +511,11 @@ class DataFrame:
         # shredded struct/map columns reassemble at the output boundary
         return nested.assemble_table(table)
 
+    def createOrReplaceTempView(self, name: str) -> None:
+        self.session.register_view(name, self)
+
+    create_or_replace_temp_view = createOrReplaceTempView
+
     def to_device_batches(self):
         """ML interop, streaming form (ColumnarRdd analog —
         /root/reference sql-plugin ColumnarRdd: export the device table

@@ -230,6 +230,29 @@ class TpuSession:
     def read(self) -> DataFrameReader:
         return DataFrameReader(self)
 
+    # ------------------------------------------------------------- SQL --
+    def register_view(self, name: str, df: DataFrame) -> None:
+        """Temp-view registry backing ``session.sql`` FROM clauses
+        (df.createOrReplaceTempView forwards here)."""
+        if not hasattr(self, "_views"):
+            self._views = {}
+        self._views[name.lower()] = df
+
+    def table(self, name: str) -> DataFrame:
+        views = getattr(self, "_views", {})
+        key = name.lower()
+        if key not in views:
+            raise KeyError(
+                f"unknown table or view {name!r}; register with "
+                "df.createOrReplaceTempView(name)")
+        return views[key]
+
+    def sql(self, query: str) -> DataFrame:
+        """Run a SQL SELECT over registered temp views (the SQL string
+        entry point; parsing/lowering in spark_rapids_tpu/sql/)."""
+        from spark_rapids_tpu.sql import parse, resolve
+        return resolve(self, parse(query))
+
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan):
         from spark_rapids_tpu.config import rapids_conf as rc

@@ -289,12 +289,20 @@ class If(Expression):
     def emit(self, ctx: EmitContext) -> ColVal:
         t = self.dtype
         p = self.children[0].emit(ctx)
-        a = cast_value(self.children[1].emit(ctx), t)
-        b = cast_value(self.children[2].emit(ctx), t)
         # null predicate selects the else branch (Spark semantics)
         cond = p.values
         if p.validity is not None:
             cond = jnp.logical_and(cond, p.validity)
+        if getattr(cond, "ndim", 0) == 0:
+            cond = jnp.broadcast_to(cond, (ctx.capacity,))
+        if t.is_string:
+            from spark_rapids_tpu.ops.stringops import string_select
+            return string_select(
+                [cond, jnp.ones(ctx.capacity, dtype=jnp.bool_)],
+                [self.children[1].emit(ctx),
+                 self.children[2].emit(ctx)], ctx.capacity)
+        a = cast_value(self.children[1].emit(ctx), t)
+        b = cast_value(self.children[2].emit(ctx), t)
         values = jnp.where(cond, a.values, b.values)
         if a.validity is None and b.validity is None:
             return ColVal(t, values)
@@ -343,6 +351,26 @@ class CaseWhen(Expression):
         return self.else_value is None or self._as_if_chain().nullable
 
     def emit(self, ctx: EmitContext) -> ColVal:
+        if self.dtype.is_string:
+            # one fused N-branch select instead of a chain of Ifs (each
+            # link would materialize an intermediate string column)
+            from spark_rapids_tpu.ops.expressions import Literal
+            from spark_rapids_tpu.ops.stringops import string_select
+            masks, branches = [], []
+            for pred, val in self.branches:
+                p = pred.emit(ctx)
+                cond = p.values
+                if p.validity is not None:
+                    cond = jnp.logical_and(cond, p.validity)
+                if getattr(cond, "ndim", 0) == 0:
+                    cond = jnp.broadcast_to(cond, (ctx.capacity,))
+                masks.append(cond)
+                branches.append(val.emit(ctx))
+            els = self.else_value if self.else_value is not None else \
+                Literal(None, self.branches[0][1].dtype)
+            masks.append(jnp.ones(ctx.capacity, dtype=jnp.bool_))
+            branches.append(els.emit(ctx))
+            return string_select(masks, branches, ctx.capacity)
         return self._as_if_chain().emit(ctx)
 
     def cache_key(self):

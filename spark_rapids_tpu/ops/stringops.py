@@ -78,6 +78,75 @@ def _literal_bytes(s: str) -> np.ndarray:
     return np.frombuffer(s.encode("utf-8"), dtype=np.uint8)
 
 
+def string_select(masks, branches, capacity: int):
+    """CASE over string branches: per row, the first true mask picks its
+    branch's string; no true mask -> null.
+
+    ``branches`` are string ColVals — full columns (offsets of
+    capacity+1) or 1-row literals (offsets of length 2, broadcast to
+    every row).  One fused pass: the chosen branch's (start, len) per
+    row indexes a concatenation of all branch char buffers, and
+    ``build_strings`` lays out the output — no per-branch materializing,
+    no host loop."""
+    nb = len(branches)
+    ar = jnp.arange(capacity, dtype=jnp.int32)
+    idx = jnp.full(capacity, nb, dtype=jnp.int32)
+    for i in reversed(range(nb)):
+        idx = jnp.where(masks[i], jnp.int32(i), idx)
+    chosen = idx < nb
+    safe = jnp.clip(idx, 0, nb - 1)
+    starts, lens, valids, chunks = [], [], [], []
+    base = 0
+    out_char_cap = 0
+    # literals contribute capacity * MAX literal length once (each row
+    # picks at most one branch), not per-branch
+    lit_max = 0
+    for b in branches:
+        if b.offsets is None:
+            # null literal branch: zero-length slice, never valid
+            chunks.append(jnp.zeros(0, dtype=jnp.uint8))
+            starts.append(jnp.full(capacity, base, dtype=jnp.int32))
+            lens.append(jnp.zeros(capacity, dtype=jnp.int32))
+            valids.append(jnp.zeros(capacity, dtype=jnp.bool_))
+            continue
+        ch = b.values
+        chunks.append(ch)
+        if b.offsets.shape[0] == capacity + 1:
+            st = b.offsets[:capacity].astype(jnp.int32)
+            ln = (b.offsets[1:] - b.offsets[:-1]).astype(jnp.int32)
+            out_char_cap += int(ch.shape[0])
+        else:  # 1-row literal: same slice for every row
+            st = jnp.zeros(capacity, dtype=jnp.int32)
+            ln = jnp.broadcast_to(b.offsets[-1].astype(jnp.int32),
+                                  (capacity,))
+            lit_max = max(lit_max, int(ch.shape[0]))
+        starts.append(st + base)
+        lens.append(ln)
+        if b.validity is None:
+            valids.append(jnp.ones(capacity, dtype=jnp.bool_))
+        elif getattr(b.validity, "ndim", 0) == 0:
+            valids.append(jnp.broadcast_to(b.validity, (capacity,)))
+        elif b.validity.shape[0] == capacity:
+            valids.append(b.validity)
+        else:
+            valids.append(jnp.broadcast_to(b.validity[0], (capacity,)))
+        base += int(ch.shape[0])
+    out_char_cap += lit_max * capacity
+    all_chars = jnp.concatenate(chunks) if chunks else \
+        jnp.zeros(0, dtype=jnp.uint8)
+    smat = jnp.stack(starts)
+    lmat = jnp.stack(lens)
+    vmat = jnp.stack(valids)
+    row_start = smat[safe, ar]
+    validity = jnp.logical_and(chosen, vmat[safe, ar])
+    row_len = jnp.where(validity, lmat[safe, ar], 0)
+    from spark_rapids_tpu.columnar.column import bucket_capacity
+    chars, offsets = build_strings(
+        row_len, lambda pos, row, k: row_start[row] + k, all_chars,
+        bucket_capacity(out_char_cap, minimum=8), capacity)
+    return ColVal(dts.STRING, chars, validity=validity, offsets=offsets)
+
+
 # ------------------------------------------------------------------- scalars
 
 class Length(UnaryExpression):

@@ -1,0 +1,20 @@
+"""SQL string frontend: parse -> resolve onto the DataFrame algebra.
+
+Counterpart of the reference's Spark SQL entry point (SURVEY.md section
+2.1 "plugin entry"): the reference rides Spark's parser/analyzer and
+replaces the physical plan; this engine has no host Spark, so a compact
+recursive-descent parser (``parser.py``) produces an AST that
+``resolver.py`` lowers onto the existing DataFrame/functions API — every
+downstream stage (planner meta/tagging, fused XLA stages, spill, AQE) is
+shared with the programmatic API.
+
+Surface: SELECT [DISTINCT] ... FROM (tables, subqueries, JOINs with
+ON/USING), WHERE, GROUP BY/HAVING, ORDER BY, LIMIT, UNION ALL, CASE,
+CAST, BETWEEN/IN/LIKE/IS NULL, window functions with OVER, and the
+function library mapped 1:1 onto ``api.functions``.
+"""
+
+from spark_rapids_tpu.sql.parser import parse
+from spark_rapids_tpu.sql.resolver import resolve
+
+__all__ = ["parse", "resolve"]

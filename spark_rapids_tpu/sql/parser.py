@@ -1,0 +1,617 @@
+"""Recursive-descent SQL parser: SELECT statements -> AST dataclasses.
+
+Hand-rolled (no parser library in the image) with a conventional
+precedence ladder: OR < AND < NOT < comparison/IS/IN/BETWEEN/LIKE <
+additive < multiplicative < unary < primary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple
+
+# ---------------------------------------------------------------------- AST --
+
+
+@dataclasses.dataclass
+class Lit:
+    value: object
+    kind: str = "plain"  # plain | date | timestamp
+
+
+@dataclasses.dataclass
+class ColRef:
+    parts: Tuple[str, ...]  # ("t", "c") or ("c",)
+
+
+@dataclasses.dataclass
+class Star:
+    table: Optional[str] = None
+
+
+@dataclasses.dataclass
+class BinOp:
+    op: str
+    left: object
+    right: object
+
+
+@dataclasses.dataclass
+class UnOp:
+    op: str  # '-', 'NOT'
+    child: object
+
+
+@dataclasses.dataclass
+class IsNull:
+    child: object
+    negated: bool
+
+
+@dataclasses.dataclass
+class Between:
+    child: object
+    lo: object
+    hi: object
+    negated: bool
+
+
+@dataclasses.dataclass
+class InList:
+    child: object
+    items: List[object]
+    negated: bool
+
+
+@dataclasses.dataclass
+class LikeOp:
+    child: object
+    pattern: str
+    negated: bool
+
+
+@dataclasses.dataclass
+class FuncCall:
+    name: str
+    args: List[object]
+    distinct: bool = False
+    window: Optional["WindowDef"] = None
+
+
+@dataclasses.dataclass
+class WindowDef:
+    partition_by: List[object]
+    order_by: List["OrderItem"]
+    rows: Optional[Tuple[Optional[int], Optional[int]]] = None
+
+
+@dataclasses.dataclass
+class CaseExpr:
+    whens: List[Tuple[object, object]]
+    else_: Optional[object]
+
+
+@dataclasses.dataclass
+class CastExpr:
+    child: object
+    type_name: str
+
+
+@dataclasses.dataclass
+class Projection:
+    expr: object
+    alias: Optional[str]
+
+
+@dataclasses.dataclass
+class OrderItem:
+    expr: object
+    desc: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class TableRef:
+    name: str
+    alias: Optional[str]
+
+
+@dataclasses.dataclass
+class SubqueryRef:
+    query: "SelectStmt"
+    alias: str
+
+
+@dataclasses.dataclass
+class JoinClause:
+    how: str  # inner/left/right/full/semi/anti/cross
+    right: object  # TableRef | SubqueryRef
+    on: Optional[object] = None
+    using: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class SelectStmt:
+    projections: List[Projection]
+    from_: Optional[object]  # TableRef | SubqueryRef | None
+    joins: List[JoinClause]
+    where: Optional[object]
+    group_by: List[object]
+    having: Optional[object]
+    order_by: List[OrderItem]
+    limit: Optional[int]
+    distinct: bool
+    union_all: Optional["SelectStmt"] = None
+
+
+# -------------------------------------------------------------------- lexer --
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
+  | (?P<op><>|!=|>=|<=|=|<|>|\|\||[-+*/%(),.])
+""", re.VERBOSE)
+
+KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having",
+    "order", "limit", "as", "and", "or", "not", "in", "is", "null",
+    "like", "between", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "full", "outer", "semi", "anti",
+    "cross", "on", "using", "union", "all", "true", "false", "asc",
+    "desc", "nulls", "first", "last", "date", "timestamp", "interval",
+    "over", "partition", "rows", "unbounded", "preceding", "following",
+    "current", "row",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind, value):
+        self.kind = kind  # num | str | ident | kw | op | eof
+        self.value = value
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+def tokenize(text: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            raise ValueError(
+                f"SQL syntax error at {text[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup is None:
+            continue
+        v = m.group(m.lastgroup)
+        if m.lastgroup == "num":
+            out.append(Token("num", v))
+        elif m.lastgroup == "str":
+            out.append(Token("str", v[1:-1].replace("''", "'")))
+        elif m.lastgroup == "ident":
+            if v.startswith("`"):
+                out.append(Token("ident", v[1:-1]))
+            elif v.lower() in KEYWORDS:
+                out.append(Token("kw", v.lower()))
+            else:
+                out.append(Token("ident", v))
+        else:
+            out.append(Token("op", v))
+    out.append(Token("eof", ""))
+    return out
+
+
+# ------------------------------------------------------------------- parser --
+
+
+class Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def advance(self) -> Token:
+        t = self.cur
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws) -> bool:
+        return self.cur.kind == "kw" and self.cur.value in kws
+
+    def eat_kw(self, *kws) -> bool:
+        if self.at_kw(*kws):
+            self.advance()
+            return True
+        return False
+
+    def expect_kw(self, kw):
+        if not self.eat_kw(kw):
+            raise ValueError(f"expected {kw.upper()}, got {self.cur}")
+
+    def at_op(self, *ops) -> bool:
+        return self.cur.kind == "op" and self.cur.value in ops
+
+    def eat_op(self, *ops) -> bool:
+        if self.at_op(*ops):
+            self.advance()
+            return True
+        return False
+
+    def expect_op(self, op):
+        if not self.eat_op(op):
+            raise ValueError(f"expected {op!r}, got {self.cur}")
+
+    def ident(self) -> str:
+        if self.cur.kind == "ident":
+            return self.advance().value
+        # non-reserved keywords usable as identifiers in practice
+        if self.cur.kind == "kw" and self.cur.value in (
+                "date", "timestamp", "first", "last", "left", "right",
+                "row", "rows"):
+            return self.advance().value
+        raise ValueError(f"expected identifier, got {self.cur}")
+
+    # -- statements --------------------------------------------------------
+    def parse(self) -> SelectStmt:
+        stmt = self.select_stmt()
+        if self.cur.kind != "eof":
+            raise ValueError(f"unexpected trailing input at {self.cur}")
+        return stmt
+
+    def select_stmt(self) -> SelectStmt:
+        self.expect_kw("select")
+        distinct = self.eat_kw("distinct")
+        projections = [self.projection()]
+        while self.eat_op(","):
+            projections.append(self.projection())
+        from_ = None
+        joins: List[JoinClause] = []
+        if self.eat_kw("from"):
+            from_ = self.from_item()
+            while True:
+                j = self.join_clause()
+                if j is None:
+                    break
+                joins.append(j)
+        where = self.expr() if self.eat_kw("where") else None
+        group_by: List[object] = []
+        if self.eat_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expr())
+            while self.eat_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.eat_kw("having") else None
+        order_by: List[OrderItem] = []
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.order_item())
+            while self.eat_op(","):
+                order_by.append(self.order_item())
+        limit = None
+        if self.eat_kw("limit"):
+            limit = int(self.advance().value)
+        union_all = None
+        if self.eat_kw("union"):
+            self.expect_kw("all")
+            union_all = self.select_stmt()
+        return SelectStmt(projections, from_, joins, where, group_by,
+                          having, order_by, limit, distinct, union_all)
+
+    def projection(self) -> Projection:
+        if self.at_op("*"):
+            self.advance()
+            return Projection(Star(), None)
+        e = self.expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().value
+        return Projection(e, alias)
+
+    def from_item(self):
+        if self.eat_op("("):
+            q = self.select_stmt()
+            self.expect_op(")")
+            self.eat_kw("as")
+            return SubqueryRef(q, self.ident())
+        name = self.ident()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.ident()
+        elif self.cur.kind == "ident":
+            alias = self.advance().value
+        return TableRef(name, alias)
+
+    def join_clause(self) -> Optional[JoinClause]:
+        how = None
+        if self.eat_kw("join"):
+            how = "inner"
+        elif self.at_kw("inner", "left", "right", "full", "cross"):
+            kw = self.advance().value
+            if kw == "left" and self.at_kw("semi", "anti"):
+                kw = self.advance().value
+            elif kw in ("left", "right", "full"):
+                self.eat_kw("outer")
+            self.expect_kw("join")
+            how = {"inner": "inner", "left": "left", "right": "right",
+                   "full": "full", "semi": "semi", "anti": "anti",
+                   "cross": "cross"}[kw]
+        else:
+            return None
+        right = self.from_item()
+        on = None
+        using = None
+        if self.eat_kw("on"):
+            on = self.expr()
+        elif self.eat_kw("using"):
+            self.expect_op("(")
+            using = [self.ident()]
+            while self.eat_op(","):
+                using.append(self.ident())
+            self.expect_op(")")
+        return JoinClause(how, right, on, using)
+
+    def order_item(self) -> OrderItem:
+        e = self.expr()
+        desc = False
+        if self.eat_kw("asc"):
+            pass
+        elif self.eat_kw("desc"):
+            desc = True
+        nulls_first = None
+        if self.eat_kw("nulls"):
+            if self.eat_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return OrderItem(e, desc, nulls_first)
+
+    # -- expressions -------------------------------------------------------
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        e = self.and_expr()
+        while self.eat_kw("or"):
+            e = BinOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.eat_kw("and"):
+            e = BinOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.eat_kw("not"):
+            return UnOp("NOT", self.not_expr())
+        return self.predicate()
+
+    def predicate(self):
+        e = self.additive()
+        while True:
+            if self.cur.kind == "op" and self.cur.value in (
+                    "=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.advance().value
+                e = BinOp(op, e, self.additive())
+                continue
+            if self.at_kw("is"):
+                self.advance()
+                negated = self.eat_kw("not")
+                self.expect_kw("null")
+                e = IsNull(e, negated)
+                continue
+            negated = False
+            if self.at_kw("not") and self.toks[self.i + 1].kind == "kw" \
+                    and self.toks[self.i + 1].value in (
+                        "between", "in", "like"):
+                self.advance()
+                negated = True
+            if self.eat_kw("between"):
+                lo = self.additive()
+                self.expect_kw("and")
+                hi = self.additive()
+                e = Between(e, lo, hi, negated)
+                continue
+            if self.eat_kw("in"):
+                self.expect_op("(")
+                items = [self.expr()]
+                while self.eat_op(","):
+                    items.append(self.expr())
+                self.expect_op(")")
+                e = InList(e, items, negated)
+                continue
+            if self.eat_kw("like"):
+                pat = self.advance()
+                if pat.kind != "str":
+                    raise ValueError("LIKE needs a string literal")
+                e = LikeOp(e, pat.value, negated)
+                continue
+            if negated:
+                raise ValueError(f"unexpected NOT at {self.cur}")
+            return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.advance().value
+                e = BinOp(op, e, self.multiplicative())
+            elif self.at_op("||"):
+                self.advance()
+                e = FuncCall("concat", [e, self.multiplicative()])
+            else:
+                return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.advance().value
+            e = BinOp(op, e, self.unary())
+        return e
+
+    def unary(self):
+        if self.eat_op("-"):
+            return UnOp("-", self.unary())
+        if self.eat_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self):
+        t = self.cur
+        if t.kind == "num":
+            self.advance()
+            v = float(t.value) if "." in t.value else int(t.value)
+            return Lit(v)
+        if t.kind == "str":
+            self.advance()
+            return Lit(t.value)
+        if self.at_kw("true"):
+            self.advance()
+            return Lit(True)
+        if self.at_kw("false"):
+            self.advance()
+            return Lit(False)
+        if self.at_kw("null"):
+            self.advance()
+            return Lit(None)
+        if self.at_kw("date"):
+            # DATE 'yyyy-mm-dd'
+            if self.toks[self.i + 1].kind == "str":
+                self.advance()
+                return Lit(self.advance().value, kind="date")
+            return ColRef((self.ident(),))
+        if self.at_kw("timestamp"):
+            if self.toks[self.i + 1].kind == "str":
+                self.advance()
+                return Lit(self.advance().value, kind="timestamp")
+            return ColRef((self.ident(),))
+        if self.at_kw("interval"):
+            raise ValueError("INTERVAL literals are not supported; "
+                             "use date_add/date_sub")
+        if self.at_kw("case"):
+            return self.case_expr()
+        if self.at_kw("cast"):
+            self.advance()
+            self.expect_op("(")
+            child = self.expr()
+            self.expect_kw("as")
+            tname = self.type_name()
+            self.expect_op(")")
+            return CastExpr(child, tname)
+        if self.eat_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind in ("ident", "kw"):
+            name = self.ident()
+            if self.at_op("("):
+                return self.func_call(name)
+            parts = [name]
+            while self.at_op(".") and (
+                    self.toks[self.i + 1].kind in ("ident", "kw")
+                    or self.toks[self.i + 1].value == "*"):
+                self.advance()
+                if self.at_op("*"):
+                    self.advance()
+                    return Star(table=parts[0])
+                parts.append(self.ident())
+            return ColRef(tuple(parts))
+        raise ValueError(f"unexpected token {t}")
+
+    def type_name(self) -> str:
+        base = self.ident().lower()
+        if self.eat_op("("):
+            args = [self.advance().value]
+            while self.eat_op(","):
+                args.append(self.advance().value)
+            self.expect_op(")")
+            return f"{base}({','.join(args)})"
+        return base
+
+    def case_expr(self) -> CaseExpr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()  # CASE x WHEN v THEN ...
+        whens = []
+        while self.eat_kw("when"):
+            cond = self.expr()
+            if operand is not None:
+                cond = BinOp("=", operand, cond)
+            self.expect_kw("then")
+            whens.append((cond, self.expr()))
+        else_ = self.expr() if self.eat_kw("else") else None
+        self.expect_kw("end")
+        return CaseExpr(whens, else_)
+
+    def func_call(self, name: str) -> FuncCall:
+        self.expect_op("(")
+        distinct = False
+        args: List[object] = []
+        if self.at_op("*"):
+            self.advance()
+            args.append(Star())
+        elif not self.at_op(")"):
+            distinct = self.eat_kw("distinct")
+            args.append(self.expr())
+            while self.eat_op(","):
+                args.append(self.expr())
+        self.expect_op(")")
+        window = None
+        if self.eat_kw("over"):
+            window = self.window_def()
+        return FuncCall(name.lower(), args, distinct, window)
+
+    def window_def(self) -> WindowDef:
+        self.expect_op("(")
+        partition: List[object] = []
+        orders: List[OrderItem] = []
+        rows = None
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.eat_op(","):
+                partition.append(self.expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            orders.append(self.order_item())
+            while self.eat_op(","):
+                orders.append(self.order_item())
+        if self.eat_kw("rows"):
+            self.expect_kw("between")
+            rows = (self.frame_bound(), None)
+            self.expect_kw("and")
+            rows = (rows[0], self.frame_bound())
+        self.expect_op(")")
+        return WindowDef(partition, orders, rows)
+
+    def frame_bound(self) -> Optional[int]:
+        if self.eat_kw("unbounded"):
+            if not self.eat_kw("preceding"):
+                self.expect_kw("following")
+            return None
+        if self.eat_kw("current"):
+            self.expect_kw("row")
+            return 0
+        n = int(self.advance().value)
+        if self.eat_kw("preceding"):
+            return -n
+        self.expect_kw("following")
+        return n
+
+
+def parse(text: str) -> SelectStmt:
+    return Parser(tokenize(text)).parse()

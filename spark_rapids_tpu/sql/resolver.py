@@ -1,0 +1,591 @@
+"""Lower the SQL AST onto the DataFrame algebra.
+
+Every SQL construct becomes the equivalent programmatic call, so the
+planner's meta/tagging, fused stages, spill, and AQE all apply
+identically to SQL and DataFrame queries.
+
+Aggregation lowering: each aggregate subtree in the projection/HAVING
+gets a hidden name, the query groups by its keys with those aggregates,
+and the outer expressions re-project against the hidden columns — which
+is how ``sum(x) + 1`` or HAVING conditions compose without special
+cases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.sql import parser as A
+
+AGG_FNS = {"sum", "count", "avg", "mean", "min", "max", "first", "last",
+           "collect_list", "collect_set"}
+
+WINDOW_RANK_FNS = {"row_number", "rank", "dense_rank", "percent_rank"}
+
+
+class Scope:
+    """Name resolution for one FROM clause.
+
+    Each source maps its ORIGINAL (SQL-visible) column names to the
+    flat engine names (which differ after join-deduplication renames).
+    A bare name appearing in two sources is ambiguous even when one
+    side was renamed — matching Spark's analyzer."""
+
+    def __init__(self):
+        self.sources: List[Tuple[Optional[str], Dict[str, str]]] = []
+
+    def add(self, alias: Optional[str], columns,
+            renames: Optional[Dict[str, str]] = None):
+        renames = renames or {}
+        self.sources.append(
+            (alias, {c: renames.get(c, c) for c in columns}))
+
+    def all_columns(self) -> List[str]:
+        out = []
+        for _, m in self.sources:
+            out.extend(v for v in m.values() if v not in out)
+        return out
+
+    def mapping_of(self, alias: str) -> Optional[Dict[str, str]]:
+        for a, m in self.sources:
+            if a == alias:
+                return m
+        return None
+
+    def resolve(self, parts: Tuple[str, ...]) -> Tuple[str, Tuple[str, ...]]:
+        """(qualified) name -> (flat column name, remaining struct path)."""
+        if len(parts) >= 2 and self.mapping_of(parts[0]) is not None:
+            alias, name, rest = parts[0], parts[1], parts[2:]
+            m = self.mapping_of(alias)
+            if name not in m:
+                raise KeyError(
+                    f"column {name!r} not found in {alias!r} "
+                    f"(has {sorted(m)})")
+            return m[name], rest
+        name, rest = parts[0], parts[1:]
+        hits = [(a, m[name]) for a, m in self.sources if name in m]
+        flats = {f for _, f in hits}
+        if len(hits) > 1 and len(flats) > 1:
+            raise ValueError(
+                f"column {name!r} is ambiguous "
+                f"(in {[a for a, _ in hits]}); qualify it")
+        if hits:
+            return hits[0][1], rest
+        if self.sources:
+            all_cols = self.all_columns()
+            if name not in all_cols:
+                raise KeyError(
+                    f"column {name!r} not found; available: {all_cols}")
+        return name, rest
+
+
+class Resolver:
+    def __init__(self, session):
+        self.session = session
+        from spark_rapids_tpu.api import functions as F
+        self.F = F
+
+    # ------------------------------------------------------------ entry --
+    def run(self, stmt: A.SelectStmt):
+        df = self._select(stmt)
+        while stmt.union_all is not None:
+            stmt = stmt.union_all
+            df = df.union(self._select(stmt))
+        return df
+
+    # ----------------------------------------------------------- select --
+    def _select(self, stmt: A.SelectStmt):
+        F = self.F
+        scope = Scope()
+        if stmt.from_ is None:
+            df = self.session.range(1)
+            scope.add(None, ["id"])
+        else:
+            df = self._from_item(stmt.from_, scope)
+        for j in stmt.joins:
+            df = self._join(df, j, scope)
+        if stmt.where is not None:
+            df = df.filter(self._expr(stmt.where, scope))
+
+        aggs: Dict[str, object] = {}   # hidden name -> Col aggregate
+        agg_keys: Dict[str, str] = {}  # structural key -> hidden name
+
+        def lift_aggs(node):
+            """Replace aggregate subtrees with hidden column refs."""
+            if isinstance(node, A.FuncCall) and node.window is None \
+                    and node.name in AGG_FNS:
+                key = repr(node)
+                if key not in agg_keys:
+                    hidden = f"__a{len(aggs)}"
+                    agg_keys[key] = hidden
+                    aggs[hidden] = self._agg_call(node, scope).alias(hidden)
+                return A.ColRef((agg_keys[key],))
+            for f in getattr(node, "__dataclass_fields__", {}):
+                v = getattr(node, f)
+                if isinstance(v, list):
+                    setattr(node, f, [lift_aggs(x) if hasattr(
+                        x, "__dataclass_fields__") else x for x in v])
+                elif hasattr(v, "__dataclass_fields__"):
+                    setattr(node, f, lift_aggs(v))
+            return node
+
+        projections = self._expand_stars(stmt.projections, scope)
+        has_aggs = stmt.group_by or any(
+            self._contains_agg(p.expr) for p in projections) or (
+            stmt.having is not None and self._contains_agg(stmt.having))
+
+        if has_aggs:
+            # group keys: plain column refs group directly; computed
+            # keys materialize as hidden columns first
+            key_cols: List[str] = []
+            pre_exprs = []
+            for i, g in enumerate(stmt.group_by):
+                if isinstance(g, A.ColRef):
+                    name, rest = scope.resolve(g.parts)
+                    if rest:
+                        raise ValueError(
+                            "GROUP BY struct fields: alias the field in "
+                            "a subquery first")
+                    key_cols.append(name)
+                else:
+                    hidden = f"__g{i}"
+                    pre_exprs.append(
+                        self._expr(g, scope).alias(hidden))
+                    key_cols.append(hidden)
+            if pre_exprs:
+                df = df.select(*[F.col(c) for c in scope.all_columns()],
+                               *pre_exprs)
+                scope.add(None, [k for k in key_cols
+                                 if k.startswith("__g")])
+            proj_asts = [lift_aggs(p.expr) for p in projections]
+            having_ast = lift_aggs(stmt.having) \
+                if stmt.having is not None else None
+            if not aggs and not key_cols:
+                raise ValueError("grouped query with no aggregates")
+            df = df.group_by(*key_cols).agg(*aggs.values())
+            # post-agg scope: original aliases keep their surviving
+            # group keys so qualified refs (c.name) still resolve; the
+            # anonymous source holds only the hidden names
+            post_scope = Scope()
+            key_set = set(key_cols)
+            for alias, m in scope.sources:
+                kept = {o: f for o, f in m.items() if f in key_set}
+                if kept:
+                    post_scope.sources.append((alias, kept))
+            post_scope.add(None, [k for k in key_cols
+                                  if k.startswith("__g")]
+                           + list(aggs.keys()))
+            if having_ast is not None:
+                df = df.filter(self._expr(having_ast, post_scope))
+            out_cols = []
+            out_names = []
+            for p, ast in zip(projections, proj_asts):
+                name = p.alias or self._default_name(p.expr)
+                out_cols.append(self._expr(ast, post_scope).alias(name))
+                out_names.append(name)
+            df = df.select(*out_cols)
+        else:
+            if stmt.having is not None:
+                raise ValueError("HAVING requires GROUP BY/aggregates")
+            raw_cols = []
+            out_names = []
+            for p in projections:
+                name = p.alias or self._default_name(p.expr)
+                raw_cols.append(self._expr(p.expr, scope))
+                out_names.append(name)
+            # ORDER BY may mix output aliases with input columns the
+            # projection drops (Spark allows both): materialize the
+            # outputs alongside the inputs, sort once, then project
+            if stmt.order_by and not stmt.distinct and any(
+                    self._order_name(o, out_names) is None
+                    for o in stmt.order_by):
+                F = self.F
+                ext = df
+                for name, c in zip(out_names, raw_cols):
+                    ext = ext.withColumn(name, c)
+                keys = []
+                for o in stmt.order_by:
+                    name = self._order_name(o, out_names)
+                    if name is not None:
+                        keys.append(self._sortkey_for(F.col(name), o))
+                    else:
+                        keys.append(self._order_sortkey(o, scope))
+                df = ext.orderBy(*keys).select(
+                    *[F.col(n) for n in out_names])
+                stmt = dataclasses.replace(stmt, order_by=[])
+            else:
+                df = df.select(*[c.alias(n) for c, n in
+                                 zip(raw_cols, out_names)])
+
+        if stmt.distinct:
+            df = df.distinct()
+        if stmt.order_by:
+            df = df.orderBy(*[self._order_key(o, out_names)
+                              for o in stmt.order_by])
+        if stmt.limit is not None:
+            df = df.limit(stmt.limit)
+        return df
+
+    # ------------------------------------------------------------- from --
+    def _from_item(self, item, scope: Scope):
+        if isinstance(item, A.SubqueryRef):
+            sub = self._select(item.query)
+            scope.add(item.alias, [n for n, _ in sub.schema])
+            return sub
+        df = self.session.table(item.name)
+        cols = [n for n, _ in df.schema]
+        scope.add(item.alias or item.name, cols)
+        return df
+
+    def _join(self, left, j: A.JoinClause, scope: Scope):
+        right_scope = Scope()
+        right = self._from_item(j.right, right_scope)
+        ralias, rmap = right_scope.sources[0]
+        rcols = list(rmap)
+        if j.how == "cross":
+            scope.add(ralias, rcols)
+            out = left.join(right, on=None, how="cross")
+            return out if j.on is None else out.filter(
+                self._expr(j.on, scope))
+        if j.using is not None:
+            if j.how in ("semi", "anti"):
+                # output is left-only; right columns leave scope
+                scope.add(ralias, [])
+                return left.join(right, on=j.using, how=j.how)
+            # rename right-side non-key duplicates so qualified refs
+            # (tb.v) resolve to the RIGHT side's values, not the left's
+            lcols = set(scope.all_columns())
+            dup = [c for c in rcols
+                   if c not in j.using and c in lcols]
+            renames = {}
+            if dup:
+                prefix = ralias or "r"
+                renames = {c: f"{prefix}__{c}" for c in dup}
+                for old, new in renames.items():
+                    right = right.withColumnRenamed(old, new)
+            scope.add(ralias, [c for c in rcols if c not in j.using],
+                      renames=renames)
+            return left.join(right, on=j.using, how=j.how)
+        if j.on is None:
+            raise ValueError("JOIN requires ON or USING")
+        # deduplicate overlapping column names so the flat engine can
+        # hold both sides; qualified refs resolve through the rename map
+        lcols = set(scope.all_columns())
+        dup = [c for c in rcols if c in lcols]
+        keep_right = j.how not in ("semi", "anti")
+        renames = {}
+        if dup:
+            prefix = ralias or "r"
+            renames = {c: f"{prefix}__{c}" for c in dup}
+            for old, new in renames.items():
+                right = right.withColumnRenamed(old, new)
+        if keep_right:
+            scope.add(ralias, rcols, renames=renames)
+            return left.join(right, on=self._expr(j.on, scope),
+                             how=j.how)
+        # semi/anti resolve the ON condition over both sides before
+        # the scope narrows back to the left
+        cond_scope = Scope()
+        cond_scope.sources = list(scope.sources)
+        cond_scope.add(ralias, rcols, renames=renames)
+        return left.join(right, on=self._expr(j.on, cond_scope),
+                         how=j.how)
+
+    # ------------------------------------------------------ expressions --
+    def _expand_stars(self, projections, scope: Scope):
+        out = []
+        for p in projections:
+            if isinstance(p.expr, A.Star):
+                if p.expr.table is None:
+                    cols = scope.all_columns()
+                else:
+                    m = scope.mapping_of(p.expr.table)
+                    if m is None:
+                        raise KeyError(f"unknown table {p.expr.table!r}")
+                    cols = list(m.values())
+                out.extend(A.Projection(A.ColRef((c,)), None)
+                           for c in cols)
+            else:
+                out.append(p)
+        return out
+
+    def _contains_agg(self, node) -> bool:
+        if isinstance(node, A.FuncCall) and node.window is None and \
+                node.name in AGG_FNS:
+            return True
+        for f in getattr(node, "__dataclass_fields__", {}):
+            v = getattr(node, f)
+            if isinstance(v, list):
+                if any(self._contains_agg(x) for x in v
+                       if hasattr(x, "__dataclass_fields__")):
+                    return True
+            elif hasattr(v, "__dataclass_fields__") and \
+                    self._contains_agg(v):
+                return True
+        return False
+
+    def _default_name(self, ast) -> str:
+        if isinstance(ast, A.ColRef):
+            return ast.parts[-1]
+        if isinstance(ast, A.FuncCall):
+            return ast.name
+        return "col"
+
+    def _order_name(self, o: A.OrderItem,
+                    out_names: List[str]) -> Optional[str]:
+        """Output-column name an ORDER BY item refers to, or None when
+        it must resolve against the pre-projection input."""
+        if isinstance(o.expr, A.Lit) and isinstance(o.expr.value, int):
+            return out_names[o.expr.value - 1]  # 1-based position
+        if isinstance(o.expr, A.ColRef):
+            # a qualified ref (o.amount) matches the output column the
+            # projection produced for it (default name = last part)
+            if o.expr.parts[-1] in out_names:
+                return o.expr.parts[-1]
+        return None
+
+    def _order_key(self, o: A.OrderItem, out_names: List[str]):
+        F = self.F
+        name = self._order_name(o, out_names)
+        if name is None:
+            raise ValueError(
+                "ORDER BY supports output columns/aliases/positions "
+                "(or input columns for non-aggregate queries)")
+        c = F.col(name)
+        if o.desc:
+            return c.desc_nulls_first() if o.nulls_first else c.desc()
+        if o.nulls_first is False:
+            return c.asc_nulls_last()
+        return c.asc()
+
+    def _agg_call(self, node: A.FuncCall, scope: Scope):
+        F = self.F
+        if node.distinct:
+            raise ValueError(f"{node.name.upper()}(DISTINCT ...) is not "
+                             "supported; use a subquery with DISTINCT")
+        fn = {"sum": F.sum, "count": F.count, "avg": F.avg,
+              "mean": F.avg, "min": F.min, "max": F.max,
+              "first": F.first, "last": F.last,
+              "collect_list": F.collect_list,
+              "collect_set": F.collect_set}[node.name]
+        if node.name == "count" and (not node.args or
+                                     isinstance(node.args[0], A.Star)):
+            return F.count("*")
+        return fn(self._expr(node.args[0], scope))
+
+    def _window_call(self, node: A.FuncCall, scope: Scope):
+        F = self.F
+        w = node.window
+        win = F.Window.partitionBy(
+            *[self._expr(e, scope) for e in w.partition_by])
+        if w.order_by:
+            win = win.orderBy(*[self._order_sortkey(o, scope)
+                                for o in w.order_by])
+        if w.rows is not None:
+            win = win.rowsBetween(w.rows[0], w.rows[1])
+        if node.name in WINDOW_RANK_FNS:
+            return getattr(F, node.name)().over(win)
+        if node.name in ("lead", "lag"):
+            off = node.args[1].value if len(node.args) > 1 else 1
+            default = node.args[2].value if len(node.args) > 2 else None
+            return getattr(F, node.name)(
+                self._expr(node.args[0], scope), off, default).over(win)
+        wfn = {"sum": F.window_sum, "count": F.window_count,
+               "min": F.window_min, "max": F.window_max,
+               "avg": F.window_avg, "mean": F.window_avg}.get(node.name)
+        if wfn is None:
+            raise ValueError(
+                f"window function {node.name!r} not supported")
+        if node.name == "count" and (not node.args or
+                                     isinstance(node.args[0], A.Star)):
+            return wfn("*").over(win)
+        return wfn(self._expr(node.args[0], scope)).over(win)
+
+    def _order_sortkey(self, o: A.OrderItem, scope: Scope):
+        return self._sortkey_for(self._expr(o.expr, scope), o)
+
+    @staticmethod
+    def _sortkey_for(c, o: A.OrderItem):
+        if o.desc:
+            return c.desc_nulls_first() if o.nulls_first else c.desc()
+        if o.nulls_first is False:
+            return c.asc_nulls_last()
+        return c.asc()
+
+    def _func(self, node: A.FuncCall, scope: Scope):
+        F = self.F
+        if node.window is not None:
+            return self._window_call(node, scope)
+        if node.name in AGG_FNS:
+            return self._agg_call(node, scope)
+        args = [self._expr(a, scope) for a in node.args]
+        n = node.name
+
+        def lit_arg(i):
+            a = node.args[i]
+            if not isinstance(a, A.Lit):
+                raise ValueError(f"{n}: argument {i + 1} must be a "
+                                 "literal")
+            return a.value
+
+        simple = {
+            "abs": F.abs, "sqrt": F.sqrt, "coalesce": F.coalesce,
+            "isnan": F.isnan, "greatest": F.greatest, "least": F.least,
+            "length": F.length, "upper": F.upper, "lower": F.lower,
+            "initcap": F.initcap, "concat": F.concat, "trim": F.trim,
+            "ltrim": F.ltrim, "rtrim": F.rtrim, "year": F.year,
+            "month": F.month, "day": F.dayofmonth,
+            "dayofmonth": F.dayofmonth, "dayofweek": F.dayofweek,
+            "weekday": F.weekday, "dayofyear": F.dayofyear,
+            "quarter": F.quarter, "hour": F.hour, "minute": F.minute,
+            "second": F.second, "last_day": F.last_day,
+            "unix_timestamp": F.unix_timestamp,
+            "from_unixtime": F.from_unixtime, "size": F.size,
+            "array": F.array, "datediff": F.datediff,
+            "months_between": F.months_between, "pow": F.pow,
+            "power": F.pow, "element_at": F.element_at,
+            "map_keys": F.map_keys, "map_values": F.map_values,
+        }
+        if n in simple:
+            return simple[n](*args)
+        if n == "round":
+            return F.round(args[0], int(lit_arg(1)) if len(args) > 1
+                           else 0)
+        if n in ("substring", "substr"):
+            return F.substring(args[0], int(lit_arg(1)),
+                               int(lit_arg(2)) if len(args) > 2
+                               else 2 ** 31 - 1)
+        if n == "concat_ws":
+            return F.concat_ws(lit_arg(0), *args[1:])
+        if n in ("lpad", "rpad"):
+            fn = F.lpad if n == "lpad" else F.rpad
+            return fn(args[0], int(lit_arg(1)), lit_arg(2)
+                      if len(args) > 2 else " ")
+        if n == "locate":
+            return F.locate(lit_arg(0), args[1])
+        if n == "repeat":
+            return F.repeat(args[0], int(lit_arg(1)))
+        if n == "substring_index":
+            return F.substring_index(args[0], lit_arg(1),
+                                     int(lit_arg(2)))
+        if n == "regexp_replace":
+            return F.regexp_replace(args[0], lit_arg(1), lit_arg(2))
+        if n == "replace":
+            return F.replace(args[0], lit_arg(1), lit_arg(2))
+        if n == "translate":
+            return F.translate(args[0], lit_arg(1), lit_arg(2))
+        if n == "split":
+            return F.split(args[0], lit_arg(1))
+        if n == "date_add":
+            return F.date_add(args[0], int(lit_arg(1)))
+        if n == "date_sub":
+            return F.date_sub(args[0], int(lit_arg(1)))
+        if n == "add_months":
+            return F.add_months(args[0], int(lit_arg(1)))
+        if n == "trunc":
+            return F.trunc(args[0], lit_arg(1))
+        if n == "struct":
+            return F.struct(*args)
+        if n == "md5":
+            return F.md5(args[0])
+        if n == "hash":
+            return F.hash(*args) if hasattr(F, "hash") else \
+                F.murmur3(*args)
+        raise ValueError(f"unknown SQL function {n!r}")
+
+    def _expr(self, node, scope: Scope):
+        F = self.F
+        if isinstance(node, A.Lit):
+            if node.kind == "date":
+                return F.lit(datetime.date.fromisoformat(node.value))
+            if node.kind == "timestamp":
+                import pandas as pd
+                return F.lit(pd.Timestamp(node.value, tz="UTC")
+                             .to_pydatetime())
+            return F.lit(node.value)
+        if isinstance(node, A.ColRef):
+            name, rest = scope.resolve(node.parts)
+            c = F.col(name)
+            for field in rest:
+                c = c.getField(field)
+            return c
+        if isinstance(node, A.BinOp):
+            left = self._expr(node.left, scope)
+            right = self._expr(node.right, scope)
+            op = node.op
+            if op == "and":
+                return left & right
+            if op == "or":
+                return left | right
+            if op == "=":
+                return left == right
+            if op in ("<>", "!="):
+                return left != right
+            if op == "<":
+                return left < right
+            if op == "<=":
+                return left <= right
+            if op == ">":
+                return left > right
+            if op == ">=":
+                return left >= right
+            if op == "+":
+                return left + right
+            if op == "-":
+                return left - right
+            if op == "*":
+                return left * right
+            if op == "/":
+                return left / right
+            if op == "%":
+                return left % right
+            raise ValueError(f"unknown operator {op!r}")
+        if isinstance(node, A.UnOp):
+            c = self._expr(node.child, scope)
+            return ~c if node.op == "NOT" else -c
+        if isinstance(node, A.IsNull):
+            c = self._expr(node.child, scope)
+            return c.isNotNull() if node.negated else c.isNull()
+        if isinstance(node, A.Between):
+            c = self._expr(node.child, scope)
+            e = c.between(self._expr(node.lo, scope),
+                          self._expr(node.hi, scope))
+            return ~e if node.negated else e
+        if isinstance(node, A.InList):
+            c = self._expr(node.child, scope)
+            vals = []
+            for it in node.items:
+                if not isinstance(it, A.Lit):
+                    raise ValueError("IN list items must be literals")
+                vals.append(it.value)
+            e = c.isin(*vals)
+            return ~e if node.negated else e
+        if isinstance(node, A.LikeOp):
+            c = self._expr(node.child, scope)
+            e = c.like(node.pattern)
+            return ~e if node.negated else e
+        if isinstance(node, A.CaseExpr):
+            if not node.whens:
+                raise ValueError("CASE needs at least one WHEN")
+            b = F.when(self._expr(node.whens[0][0], scope),
+                       self._expr(node.whens[0][1], scope))
+            for cond, val in node.whens[1:]:
+                b = b.when(self._expr(cond, scope),
+                           self._expr(val, scope))
+            if node.else_ is not None:
+                return b.otherwise(self._expr(node.else_, scope))
+            return b
+        if isinstance(node, A.CastExpr):
+            return self._expr(node.child, scope).cast(node.type_name)
+        if isinstance(node, A.FuncCall):
+            return self._func(node, scope)
+        if isinstance(node, A.Star):
+            raise ValueError("* is only valid as a projection or in "
+                             "count(*)")
+        raise ValueError(f"cannot resolve {node!r}")
+
+
+def resolve(session, stmt: A.SelectStmt):
+    return Resolver(session).run(stmt)
