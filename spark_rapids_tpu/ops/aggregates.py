@@ -51,20 +51,13 @@ def _sortable_keys(keys: Sequence[ColVal], valid_rows, capacity: int,
     nulls_first = nulls_first or [not d for d in descending]
     pad = jnp.logical_not(valid_rows)
     lex: List = []
-
-    def bcast(x):
-        # scalar-broadcast values/validity (e.g. from a literal-divisor
-        # Divide) must widen: lexsort requires uniform key shapes
-        return jnp.broadcast_to(x, (capacity,)) \
-            if getattr(x, "ndim", 0) == 0 else x
-
     # jnp.lexsort sorts by last key first; we append least-significant first
     for c, desc, nf in zip(reversed(list(keys)), reversed(list(descending)),
                            reversed(list(nulls_first))):
-        lex.extend(_order_keys(bcast(c.values), desc))
+        c = widen_colval(c, capacity)
+        lex.extend(_order_keys(c.values, desc))
         if c.validity is not None:
-            null_key = jnp.logical_not(
-                bcast(c.validity)).astype(jnp.int8)
+            null_key = jnp.logical_not(c.validity).astype(jnp.int8)
             lex.append(-null_key if nf else null_key)
     lex.append(pad.astype(jnp.int8))  # most significant: dead rows last
     return lex
@@ -89,6 +82,20 @@ def _order_keys(v, desc: bool) -> List:
         v = v.astype(jnp.int8)
         return [~v] if desc else [v]
     return [~v] if desc else [v]
+
+
+def widen_colval(c: ColVal, capacity: int) -> ColVal:
+    """Scalar-broadcast values/validity (e.g. from literal-operand
+    arithmetic) widen to full columns before sort/gather — lexsort and
+    row gathers require uniform shapes."""
+    v, val = c.values, c.validity
+    if getattr(v, "ndim", 0) == 0:
+        v = jnp.broadcast_to(v, (capacity,))
+    if val is not None and getattr(val, "ndim", 0) == 0:
+        val = jnp.broadcast_to(val, (capacity,))
+    if v is c.values and val is c.validity:
+        return c
+    return ColVal(c.dtype, v, val, c.offsets)
 
 
 def sort_permutation(keys: Sequence[ColVal], valid_rows, capacity: int,
@@ -357,19 +364,9 @@ def groupby_aggregate(keys: Sequence[ColVal],
     """
     from spark_rapids_tpu.ops import selection
 
-    def widen(c: ColVal) -> ColVal:
-        """Scalar-broadcast values/validity (e.g. from literal-operand
-        arithmetic) widen to full columns before sort/gather."""
-        v, val = c.values, c.validity
-        if getattr(v, "ndim", 0) == 0:
-            v = jnp.broadcast_to(v, (capacity,))
-        if val is not None and getattr(val, "ndim", 0) == 0:
-            val = jnp.broadcast_to(val, (capacity,))
-        return ColVal(c.dtype, v, val, c.offsets) \
-            if (v is not c.values or val is not c.validity) else c
-
-    keys = [widen(c) for c in keys]
-    buffer_inputs = [(k, widen(c)) for k, c in buffer_inputs]
+    keys = [widen_colval(c, capacity) for c in keys]
+    buffer_inputs = [(k, widen_colval(c, capacity))
+                     for k, c in buffer_inputs]
     live = _row_mask(nrows, capacity, row_mask)
     n_live = live.sum().astype(jnp.int32)
     perm = sort_permutation(keys, live, capacity)

@@ -65,6 +65,18 @@ class InList:
 
 
 @dataclasses.dataclass
+class InSubquery:
+    child: object
+    query: "SelectStmt"
+    negated: bool
+
+
+@dataclasses.dataclass
+class ScalarSubquery:
+    query: "SelectStmt"
+
+
+@dataclasses.dataclass
 class LikeOp:
     child: object
     pattern: str
@@ -149,7 +161,7 @@ class SelectStmt:
 
 _TOKEN_RE = re.compile(r"""
     \s+
-  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][-+]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
   | (?P<op><>|!=|>=|<=|=|<|>|\|\||[-+*/%(),.])
@@ -427,6 +439,11 @@ class Parser:
                 continue
             if self.eat_kw("in"):
                 self.expect_op("(")
+                if self.at_kw("select"):
+                    q = self.select_stmt()
+                    self.expect_op(")")
+                    e = InSubquery(e, q, negated)
+                    continue
                 items = [self.expr()]
                 while self.eat_op(","):
                     items.append(self.expr())
@@ -473,8 +490,8 @@ class Parser:
         t = self.cur
         if t.kind == "num":
             self.advance()
-            v = float(t.value) if "." in t.value else int(t.value)
-            return Lit(v)
+            is_float = "." in t.value or "e" in t.value.lower()
+            return Lit(float(t.value) if is_float else int(t.value))
         if t.kind == "str":
             self.advance()
             return Lit(t.value)
@@ -512,6 +529,11 @@ class Parser:
             self.expect_op(")")
             return CastExpr(child, tname)
         if self.eat_op("("):
+            if self.at_kw("select"):
+                # uncorrelated scalar subquery: one row, one column
+                q = self.select_stmt()
+                self.expect_op(")")
+                return ScalarSubquery(q)
             e = self.expr()
             self.expect_op(")")
             return e

@@ -107,13 +107,26 @@ class Resolver:
         for j in stmt.joins:
             df = self._join(df, j, scope)
         if stmt.where is not None:
-            df = df.filter(self._expr(stmt.where, scope))
+            # top-level conjuncts that are IN (subquery) become
+            # semi/anti joins (Spark's RewritePredicateSubquery); the
+            # rest filter normally
+            residual = None
+            for conj in self._split_conjuncts(stmt.where):
+                if isinstance(conj, A.InSubquery):
+                    df = self._in_subquery_join(df, conj, scope)
+                    continue
+                c = self._expr(conj, scope)
+                residual = c if residual is None else (residual & c)
+            if residual is not None:
+                df = df.filter(residual)
 
         aggs: Dict[str, object] = {}   # hidden name -> Col aggregate
         agg_keys: Dict[str, str] = {}  # structural key -> hidden name
 
         def lift_aggs(node):
             """Replace aggregate subtrees with hidden column refs."""
+            if isinstance(node, A.ScalarSubquery):
+                return node  # opaque: its aggregates are its own
             if isinstance(node, A.FuncCall) and node.window is None \
                     and node.name in AGG_FNS:
                 key = repr(node)
@@ -159,8 +172,29 @@ class Resolver:
                                *pre_exprs)
                 scope.add(None, [k for k in key_cols
                                  if k.startswith("__g")])
-            proj_asts = [lift_aggs(p.expr) for p in projections]
-            having_ast = lift_aggs(stmt.having) \
+            # re-projected GROUP BY expressions (SELECT cust/2 ... GROUP
+            # BY cust/2) resolve to the materialized key column by
+            # structural match, before aggregate lifting
+            gmap = {repr(g): k for g, k in zip(stmt.group_by, key_cols)}
+
+            def replace_group_exprs(node):
+                if hasattr(node, "__dataclass_fields__"):
+                    if repr(node) in gmap:
+                        return A.ColRef((gmap[repr(node)],))
+                    for f in node.__dataclass_fields__:
+                        v = getattr(node, f)
+                        if isinstance(v, list):
+                            setattr(node, f, [
+                                replace_group_exprs(x) if hasattr(
+                                    x, "__dataclass_fields__") else x
+                                for x in v])
+                        elif hasattr(v, "__dataclass_fields__"):
+                            setattr(node, f, replace_group_exprs(v))
+                return node
+
+            proj_asts = [lift_aggs(replace_group_exprs(p.expr))
+                         for p in projections]
+            having_ast = lift_aggs(replace_group_exprs(stmt.having)) \
                 if stmt.having is not None else None
             if not aggs and not key_cols:
                 raise ValueError("grouped query with no aggregates")
@@ -202,18 +236,28 @@ class Resolver:
                     self._order_name(o, out_names) is None
                     for o in stmt.order_by):
                 F = self.F
-                ext = df
-                for name, c in zip(out_names, raw_cols):
-                    ext = ext.withColumn(name, c)
+                # outputs materialize under hidden names so input
+                # columns stay addressable for the sort (an alias may
+                # shadow the input name it sorts by)
+                prefix = "__o"
+                in_names = scope.all_columns()
+                while any(n.startswith(prefix) for n in in_names):
+                    prefix += "_"
+                hidden = [f"{prefix}{i}" for i in range(len(raw_cols))]
+                ext = df.select(
+                    *[F.col(c) for c in in_names],
+                    *[c.alias(h) for c, h in zip(raw_cols, hidden)])
                 keys = []
                 for o in stmt.order_by:
                     name = self._order_name(o, out_names)
                     if name is not None:
-                        keys.append(self._sortkey_for(F.col(name), o))
+                        keys.append(self._sortkey_for(
+                            F.col(hidden[out_names.index(name)]), o))
                     else:
                         keys.append(self._order_sortkey(o, scope))
                 df = ext.orderBy(*keys).select(
-                    *[F.col(n) for n in out_names])
+                    *[F.col(h).alias(n)
+                      for h, n in zip(hidden, out_names)])
                 stmt = dataclasses.replace(stmt, order_by=[])
             else:
                 df = df.select(*[c.alias(n) for c, n in
@@ -227,6 +271,40 @@ class Resolver:
         if stmt.limit is not None:
             df = df.limit(stmt.limit)
         return df
+
+    @staticmethod
+    def _split_conjuncts(node):
+        if isinstance(node, A.BinOp) and node.op == "and":
+            yield from Resolver._split_conjuncts(node.left)
+            yield from Resolver._split_conjuncts(node.right)
+        else:
+            yield node
+
+    def _in_subquery_join(self, df, node: A.InSubquery, scope: Scope):
+        """x IN (SELECT k FROM ...) -> semi join; NOT IN -> null-aware
+        anti (SQL three-valued semantics: a NULL anywhere in the
+        subquery makes NOT IN unknown for every row)."""
+        F = self.F
+        sub = self._select(node.query)
+        sub_cols = [n for n, _ in sub.schema]
+        if len(sub_cols) != 1:
+            raise ValueError(
+                "IN (subquery) must select exactly one column")
+        key = self._expr(node.child, scope)
+        rname = sub_cols[0]
+        if rname in {n for n, _ in df.schema}:
+            new = "__in_sub"
+            sub = sub.withColumnRenamed(rname, new)
+            rname = new
+        if node.negated:
+            # uncorrelated: probe the subquery's null/empty state once
+            if not sub.limit(1).collect():
+                return df  # empty list: NOT IN is true for every row
+            if sub.filter(F.col(rname).isNull()).limit(1).collect():
+                return df.limit(0)  # NULL present: never true
+            return df.filter(key.isNotNull()).join(
+                sub, on=key == F.col(rname), how="anti")
+        return df.join(sub, on=key == F.col(rname), how="semi")
 
     # ------------------------------------------------------------- from --
     def _from_item(self, item, scope: Scope):
@@ -312,6 +390,8 @@ class Resolver:
         return out
 
     def _contains_agg(self, node) -> bool:
+        if isinstance(node, A.ScalarSubquery):
+            return False  # opaque: its aggregates are its own
         if isinstance(node, A.FuncCall) and node.window is None and \
                 node.name in AGG_FNS:
             return True
@@ -338,12 +418,18 @@ class Resolver:
         """Output-column name an ORDER BY item refers to, or None when
         it must resolve against the pre-projection input."""
         if isinstance(o.expr, A.Lit) and isinstance(o.expr.value, int):
-            return out_names[o.expr.value - 1]  # 1-based position
-        if isinstance(o.expr, A.ColRef):
-            # a qualified ref (o.amount) matches the output column the
-            # projection produced for it (default name = last part)
-            if o.expr.parts[-1] in out_names:
-                return o.expr.parts[-1]
+            pos = o.expr.value
+            if not 1 <= pos <= len(out_names):
+                raise ValueError(
+                    f"ORDER BY position {pos} out of range "
+                    f"(1..{len(out_names)})")
+            return out_names[pos - 1]
+        if isinstance(o.expr, A.ColRef) and len(o.expr.parts) == 1:
+            # bare names resolve against the output; QUALIFIED refs
+            # (t.c) name the input relation and fall through to
+            # pre-projection resolution (Spark's behavior)
+            if o.expr.parts[0] in out_names:
+                return o.expr.parts[0]
         return None
 
     def _order_key(self, o: A.OrderItem, out_names: List[str]):
@@ -581,6 +667,21 @@ class Resolver:
             return self._expr(node.child, scope).cast(node.type_name)
         if isinstance(node, A.FuncCall):
             return self._func(node, scope)
+        if isinstance(node, A.ScalarSubquery):
+            # uncorrelated: runs once at resolve time, inlines the value
+            # (Spark executes uncorrelated scalar subqueries the same
+            # way — once, before the main query)
+            sub = self._select(node.query)
+            rows = sub.collect()
+            if len(sub.schema) != 1 or len(rows) != 1:
+                raise ValueError(
+                    "scalar subquery must return one row, one column "
+                    f"(got {len(rows)} rows x {len(sub.schema)} cols)")
+            return F.lit(rows[0][0])
+        if isinstance(node, A.InSubquery):
+            raise ValueError(
+                "IN (subquery) is only supported as a top-level WHERE "
+                "conjunct")
         if isinstance(node, A.Star):
             raise ValueError("* is only valid as a projection or in "
                              "count(*)")

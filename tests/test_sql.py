@@ -321,3 +321,61 @@ def test_group_by_mixed_computed_and_plain_key(session):
     o = session._test_orders
     want = o.groupby("cust").size()
     assert got["n"].tolist() == want.tolist()
+
+
+def test_not_in_subquery_null_aware(session):
+    a = pd.DataFrame({"k": [1.0, 2.0]})
+    b = pd.DataFrame({"v": [1.0, None]})
+    session.create_dataframe(a).createOrReplaceTempView("na_a")
+    session.create_dataframe(b).createOrReplaceTempView("na_b")
+    # a NULL in the subquery makes NOT IN unknown for every row
+    got = session.sql(
+        "SELECT k FROM na_a WHERE k NOT IN (SELECT v FROM na_b)"
+    ).to_pandas()
+    assert len(got) == 0
+    # without the NULL, ordinary anti-join semantics
+    session.create_dataframe(pd.DataFrame({"v": [1.0]})) \
+        .createOrReplaceTempView("na_c")
+    got = session.sql(
+        "SELECT k FROM na_a WHERE k NOT IN (SELECT v FROM na_c)"
+    ).to_pandas()
+    assert got["k"].tolist() == [2.0]
+    # empty subquery: NOT IN is true for every row
+    session.create_dataframe(pd.DataFrame({"v": [5.0]})) \
+        .createOrReplaceTempView("na_d")
+    got = session.sql(
+        "SELECT k FROM na_a WHERE k NOT IN "
+        "(SELECT v FROM na_d WHERE v > 99)").to_pandas()
+    assert sorted(got["k"]) == [1.0, 2.0]
+
+
+def test_scientific_notation_literal(session):
+    got = session.sql("SELECT 1e5 AS big, 2.5e-2 AS small").to_pandas()
+    assert got["big"].iloc[0] == pytest.approx(1e5)
+    assert got["small"].iloc[0] == pytest.approx(0.025)
+
+
+def test_order_by_qualified_names_input(session):
+    pdf = pd.DataFrame({"cust": [1, 2, 3, 4], "amt": [4.0, 3.0, 2.0, 1.0]})
+    session.create_dataframe(pdf).createOrReplaceTempView("oq")
+    # qualified t.cust names the INPUT column even when an output alias
+    # shadows it
+    got = session.sql(
+        "SELECT amt AS cust FROM oq ORDER BY oq.cust DESC").to_pandas()
+    assert got["cust"].tolist() == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_group_expr_reprojection(session):
+    got = session.sql(
+        "SELECT cust / 2 AS h, count(*) AS n FROM orders "
+        "GROUP BY cust / 2 ORDER BY h").to_pandas()
+    o = session._test_orders
+    want = o.groupby(o.cust / 2).size().sort_index()
+    assert got["n"].tolist() == want.tolist()
+
+
+def test_order_by_position_validation(session):
+    with pytest.raises(ValueError, match="out of range"):
+        session.sql("SELECT cust FROM orders ORDER BY 2")
+    with pytest.raises(ValueError, match="out of range"):
+        session.sql("SELECT cust FROM orders ORDER BY 0")
