@@ -462,6 +462,10 @@ class DataFrame:
     # --------------------------------------------------------------- actions --
     def _execute_batches(self) -> List[ColumnarBatch]:
         import time as _time
+        from spark_rapids_tpu.api.session import TpuSession
+        # conf resolved at call time (retry budget, semaphore) follows
+        # the session EXECUTING the query, not the last-constructed one
+        TpuSession._active = self.session
         exec_plan = self.session.plan(self.plan)
         self._last_exec = exec_plan
         events = getattr(self.session, "events", None)

@@ -231,6 +231,76 @@ PARQUET_READER_TYPE = conf(
     lambda v: None if v in ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
     else "must be PERFILE, COALESCING, MULTITHREADED or AUTO")
 
+_READER_TYPES = ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
+
+
+def _reader_type_ok(v):
+    return None if v in _READER_TYPES else \
+        "must be PERFILE, COALESCING, MULTITHREADED or AUTO"
+
+
+ORC_READER_TYPE = conf(
+    "spark.rapids.sql.format.orc.reader.type", "AUTO",
+    "ORC reader strategy (reference RapidsConf.scala per-format reader "
+    "knobs).", str, _reader_type_ok)
+
+CSV_READER_TYPE = conf(
+    "spark.rapids.sql.format.csv.reader.type", "AUTO",
+    "CSV reader strategy.", str, _reader_type_ok)
+
+ORC_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.orc.multiThreadedRead.numThreads", 8,
+    "Thread-pool size for the multithreaded ORC reader.",
+    _to_int, _positive)
+
+CSV_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.csv.multiThreadedRead.numThreads", 8,
+    "Thread-pool size for the multithreaded CSV reader.",
+    _to_int, _positive)
+
+READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per batch produced by file scans (reference "
+    "`spark.rapids.sql.reader.batchSizeRows`).", _to_int, _positive)
+
+WRITER_MAX_ROWS_PER_FILE = conf(
+    "spark.rapids.sql.writer.maxRowsPerFile", 1 << 22,
+    "Max rows per output file for dataset writes.", _to_int, _positive)
+
+JOIN_OUTPUT_BATCH_ROWS = conf(
+    "spark.rapids.sql.join.outputBatchRows", 1 << 22,
+    "Join output chunk size in rows — bounds peak HBM per emitted "
+    "batch (the JoinGatherer output-splitting analog, "
+    "GpuHashJoin output batching).", _to_int, _positive)
+
+OOM_RETRY_MAX = conf(
+    "spark.rapids.memory.oomRetry.maxRetries", 2,
+    "Spill-and-retry attempts per device OOM before splitting or "
+    "failing (memory/retry.py split-and-retry framework).",
+    _to_int, lambda v: None if v >= 0 else "must be >= 0")
+
+SKEW_JOIN_ENABLED = conf(
+    "spark.rapids.sql.join.skew.enabled", True,
+    "Enable skew-join mitigation in the distributed exchange "
+    "(OptimizeSkewedJoin analog; parallel/distributed.py).", _to_bool)
+
+SKEW_JOIN_FACTOR = conf(
+    "spark.rapids.sql.join.skew.factor", 4.0,
+    "A shuffle destination receiving more than factor x median rows "
+    "is treated as skewed.", float,
+    lambda v: None if v > 1.0 else "must be > 1.0")
+
+SKEW_JOIN_MIN_ROWS = conf(
+    "spark.rapids.sql.join.skew.minRows", 1 << 12,
+    "Minimum destination row count before skew mitigation triggers.",
+    _to_int, _positive)
+
+BROADCAST_JOIN_THRESHOLD_ROWS = conf(
+    "spark.rapids.sql.join.broadcastThresholdRows", 1 << 16,
+    "Build sides at or below this many rows broadcast instead of "
+    "shuffling (autoBroadcastJoinThreshold analog, in rows).",
+    _to_int, _positive)
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

@@ -277,17 +277,19 @@ def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
         if af is not None:
             arrow_filter = af if arrow_filter is None else \
                 (arrow_filter & af)
-    fmt_key = node.file_format if node.file_format != "csv" else "parquet"
+    fmt = node.file_format
     return TpuFileScanExec(
         _bucket_pruned_paths(node), node.file_format, node.schema,
         columns=sorted(node.required_columns)
         if getattr(node, "required_columns", None) else None,
         arrow_filter=arrow_filter,
         file_meta=node.file_meta,
+        batch_rows=conf["spark.rapids.sql.reader.batchSizeRows"],
         reader_type=conf[
-            "spark.rapids.sql.format.parquet.reader.type"],
+            f"spark.rapids.sql.format.{fmt}.reader.type"],
         num_threads=conf[
-            "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads"],
+            f"spark.rapids.sql.format.{fmt}.multiThreadedRead."
+            "numThreads"],
         max_files_parallel=conf[
             "spark.rapids.sql.format.parquet.multiThreadedRead."
             "maxNumFilesParallel"])

@@ -189,11 +189,15 @@ class DataFrameWriter:
         return self
 
     def _write(self, path: str, file_format: str) -> WriteStats:
+        from spark_rapids_tpu.config import rapids_conf as rc
         exec_plan = self.df.session.plan(self.df.plan)
-        return write_batches(exec_plan.execute(), path, file_format,
-                             mode=self._mode,
-                             partition_by=self._partition_by,
-                             bucket_by=self._bucket_by)
+        return write_batches(
+            exec_plan.execute(), path, file_format,
+            mode=self._mode,
+            partition_by=self._partition_by,
+            bucket_by=self._bucket_by,
+            max_rows_per_file=self.df.session.conf.get(
+                rc.WRITER_MAX_ROWS_PER_FILE))
 
     def parquet(self, path: str) -> WriteStats:
         return self._write(path, "parquet")

@@ -176,11 +176,36 @@ def _handle_oom(catalog=None) -> None:
 
 
 # ----------------------------------------------------------------- wrappers --
+# fallback when no session is active; with a session the budget reads
+# from ITS conf (spark.rapids.memory.oomRetry.maxRetries) at call time,
+# so one session's setting never leaks into another's
+_default_max_retries = 2
+
+
+def set_default_max_retries(n: int) -> None:
+    global _default_max_retries
+    _default_max_retries = int(n)
+
+
+def _resolve_max_retries() -> int:
+    try:
+        from spark_rapids_tpu.api.session import TpuSession
+        from spark_rapids_tpu.config import rapids_conf as rc
+        s = TpuSession._active
+        if s is not None:
+            return s.conf.get(rc.OOM_RETRY_MAX)
+    except Exception:
+        pass
+    return _default_max_retries
+
+
 def with_retry_no_split(fn: Callable[[], R], *, catalog=None,
-                        max_retries: int = 2) -> R:
+                        max_retries: Optional[int] = None) -> R:
     """Run ``fn``; on device OOM spill the device store and rerun, up to
     ``max_retries`` recoveries.  For attempts whose input cannot be
     subdivided (e.g. emitting one already-sized output batch)."""
+    if max_retries is None:
+        max_retries = _resolve_max_retries()
     attempt = 0
     while True:
         try:

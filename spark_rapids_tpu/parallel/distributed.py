@@ -272,10 +272,25 @@ class DistributedHashJoin:
                  join_type: str = "inner",
                  strategy: str = "auto",
                  out_factor: int = 1,
-                 broadcast_threshold_rows: int = 1 << 16,
-                 skew_factor: float = 4.0,
-                 skew_min_rows: int = 1 << 12):
+                 broadcast_threshold_rows: Optional[int] = None,
+                 skew_factor: Optional[float] = None,
+                 skew_min_rows: Optional[int] = None):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        def _conf_default(value, entry):
+            """Explicit arg > active session conf > entry default."""
+            if value is not None:
+                return value
+            from spark_rapids_tpu.api.session import TpuSession
+            s = TpuSession._active
+            return s.conf.get(entry) if s is not None else entry.default
+
+        broadcast_threshold_rows = _conf_default(
+            broadcast_threshold_rows, rc.BROADCAST_JOIN_THRESHOLD_ROWS)
+        skew_factor = _conf_default(skew_factor, rc.SKEW_JOIN_FACTOR)
+        skew_min_rows = _conf_default(skew_min_rows, rc.SKEW_JOIN_MIN_ROWS)
+        self.skew_enabled = _conf_default(None, rc.SKEW_JOIN_ENABLED)
         if join_type not in ("inner", "left"):
             raise ValueError("distributed join supports inner/left")
         if strategy not in ("auto", "broadcast", "shuffle"):
@@ -508,7 +523,8 @@ class DistributedHashJoin:
             skewed = tuple(
                 int(d) for d in np.nonzero(
                     (dest_p > self.skew_factor * med)
-                    & (dest_p > self.skew_min_rows))[0])
+                    & (dest_p > self.skew_min_rows))[0]) \
+                if self.skew_enabled else ()
             if skewed:
                 sk = np.zeros(self.nshards, dtype=bool)
                 sk[list(skewed)] = True

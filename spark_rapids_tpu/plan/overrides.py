@@ -482,8 +482,11 @@ def _conv_join(node: L.Join, children, conf):
         # pure non-equi inner join: cross product + filter (the
         # GpuBroadcastNestedLoopJoinExec shape)
         join_type = "cross"
+    from spark_rapids_tpu.config import rapids_conf as rc
     join = TpuHashJoinExec(node.left_keys, node.right_keys, join_type,
-                           children[0], children[1], using=node.using)
+                           children[0], children[1], using=node.using,
+                           max_output_rows=conf.get(
+                               rc.JOIN_OUTPUT_BATCH_ROWS))
     if node.condition is not None:
         # residual condition evaluated over the joined output
         return TpuFilterExec(node.condition, join)

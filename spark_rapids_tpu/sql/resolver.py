@@ -178,6 +178,8 @@ class Resolver:
             gmap = {repr(g): k for g, k in zip(stmt.group_by, key_cols)}
 
             def replace_group_exprs(node):
+                if isinstance(node, A.ScalarSubquery):
+                    return node  # opaque: its expressions are its own
                 if hasattr(node, "__dataclass_fields__"):
                     if repr(node) in gmap:
                         return A.ColRef((gmap[repr(node)],))
@@ -297,10 +299,14 @@ class Resolver:
             sub = sub.withColumnRenamed(rname, new)
             rname = new
         if node.negated:
-            # uncorrelated: probe the subquery's null/empty state once
-            if not sub.limit(1).collect():
+            # one aggregate pass answers both probes: count(*) for
+            # emptiness, count(col) for null presence
+            n_all, n_nonnull = sub.agg(
+                F.count("*").alias("n"),
+                F.count(F.col(rname)).alias("nn")).collect()[0]
+            if n_all == 0:
                 return df  # empty list: NOT IN is true for every row
-            if sub.filter(F.col(rname).isNull()).limit(1).collect():
+            if n_nonnull < n_all:
                 return df.limit(0)  # NULL present: never true
             return df.filter(key.isNotNull()).join(
                 sub, on=key == F.col(rname), how="anti")
@@ -413,10 +419,13 @@ class Resolver:
             return ast.name
         return "col"
 
-    def _order_name(self, o: A.OrderItem,
-                    out_names: List[str]) -> Optional[str]:
+    def _order_name(self, o: A.OrderItem, out_names: List[str],
+                    allow_qualified: bool = False) -> Optional[str]:
         """Output-column name an ORDER BY item refers to, or None when
-        it must resolve against the pre-projection input."""
+        it must resolve against the pre-projection input.  In grouped
+        queries (``allow_qualified``) there is no input to fall back
+        to, so a qualified ref (c.name) matches the output column its
+        last part named."""
         if isinstance(o.expr, A.Lit) and isinstance(o.expr.value, int):
             pos = o.expr.value
             if not 1 <= pos <= len(out_names):
@@ -424,17 +433,20 @@ class Resolver:
                     f"ORDER BY position {pos} out of range "
                     f"(1..{len(out_names)})")
             return out_names[pos - 1]
-        if isinstance(o.expr, A.ColRef) and len(o.expr.parts) == 1:
-            # bare names resolve against the output; QUALIFIED refs
-            # (t.c) name the input relation and fall through to
-            # pre-projection resolution (Spark's behavior)
-            if o.expr.parts[0] in out_names:
-                return o.expr.parts[0]
+        if isinstance(o.expr, A.ColRef):
+            if len(o.expr.parts) == 1:
+                # bare names resolve against the output; QUALIFIED refs
+                # (t.c) name the input relation and fall through to
+                # pre-projection resolution (Spark's behavior)
+                if o.expr.parts[0] in out_names:
+                    return o.expr.parts[0]
+            elif allow_qualified and o.expr.parts[-1] in out_names:
+                return o.expr.parts[-1]
         return None
 
     def _order_key(self, o: A.OrderItem, out_names: List[str]):
         F = self.F
-        name = self._order_name(o, out_names)
+        name = self._order_name(o, out_names, allow_qualified=True)
         if name is None:
             raise ValueError(
                 "ORDER BY supports output columns/aliases/positions "
@@ -673,10 +685,15 @@ class Resolver:
             # way — once, before the main query)
             sub = self._select(node.query)
             rows = sub.collect()
-            if len(sub.schema) != 1 or len(rows) != 1:
+            if len(sub.schema) != 1 or len(rows) > 1:
                 raise ValueError(
-                    "scalar subquery must return one row, one column "
-                    f"(got {len(rows)} rows x {len(sub.schema)} cols)")
+                    "scalar subquery must return at most one row, one "
+                    f"column (got {len(rows)} rows x "
+                    f"{len(sub.schema)} cols)")
+            if not rows:
+                # empty scalar subquery yields NULL (SQL semantics)
+                from spark_rapids_tpu.ops.expressions import Literal
+                return self.F.Col(Literal(None, sub.schema[0][1]))
             return F.lit(rows[0][0])
         if isinstance(node, A.InSubquery):
             raise ValueError(

@@ -77,3 +77,43 @@ def test_incompat_fallback_uses_unicode_semantics():
     df = s.create_dataframe({"x": ["straße", "café"]})
     out = df.select(F.upper("x").alias("u")).to_pandas()["u"]
     assert out.tolist() == ["STRASSE", "CAFÉ"]
+
+
+def test_new_knobs_wired(tmp_path):
+    """The round's new conf entries actually reach their consumers."""
+    import numpy as np
+    import pandas as pd
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.memory import retry as R
+
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(
+        __import__("pyarrow").table({"a": list(range(100))}), p)
+    s = TpuSession({
+        "spark.rapids.sql.reader.batchSizeRows": "16",
+        "spark.rapids.sql.join.outputBatchRows": "32",
+        "spark.rapids.memory.oomRetry.maxRetries": "5",
+    })
+    # retry budget resolves from the ACTIVE session's conf at call time
+    assert R._resolve_max_retries() == 5
+    scan = s.read.parquet(p)
+    plan = s.plan(scan.plan)
+    from tests.test_io_meta import _walk
+    scans = [n for n in _walk(plan)
+             if type(n).__name__ == "TpuFileScanExec"]
+    assert scans[0].batch_rows == 16
+    df = s.create_dataframe(pd.DataFrame({"k": [1, 2], "v": [1.0, 2.0]}))
+    j = df.join(s.create_dataframe(pd.DataFrame({"k": [1], "w": [9]})),
+                on="k")
+    joins = [n for n in _walk(s.plan(j.plan))
+             if type(n).__name__ == "TpuHashJoinExec"]
+    assert joins[0].max_output_rows == 32
+
+
+def test_per_format_reader_type_keys():
+    from spark_rapids_tpu.config.rapids_conf import RapidsConf
+    c = RapidsConf({"spark.rapids.sql.format.orc.reader.type": "PERFILE"})
+    assert c["spark.rapids.sql.format.orc.reader.type"] == "PERFILE"
+    assert c["spark.rapids.sql.format.csv.reader.type"] == "AUTO"

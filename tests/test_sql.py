@@ -379,3 +379,28 @@ def test_order_by_position_validation(session):
         session.sql("SELECT cust FROM orders ORDER BY 2")
     with pytest.raises(ValueError, match="out of range"):
         session.sql("SELECT cust FROM orders ORDER BY 0")
+
+
+def test_scalar_subquery_in_having_untouched_by_group_rewrite(session):
+    # group-key rewriting must not descend into scalar subqueries
+    got = session.sql(
+        "SELECT cust / 2 AS h, count(*) AS n FROM orders "
+        "GROUP BY cust / 2 "
+        "HAVING count(*) >= (SELECT min(cust / 2) FROM orders) "
+        "ORDER BY h").to_pandas()
+    assert len(got) > 0
+
+
+def test_order_by_qualified_on_grouped_query(session):
+    got = session.sql(
+        "SELECT cust, count(*) AS n FROM orders o "
+        "GROUP BY cust ORDER BY o.cust").to_pandas()
+    assert got["cust"].tolist() == sorted(got["cust"])
+
+
+def test_empty_scalar_subquery_is_null(session):
+    # SQL semantics: empty scalar subquery -> NULL -> predicate false
+    got = session.sql(
+        "SELECT cust FROM orders WHERE amount > "
+        "(SELECT amount FROM orders WHERE amount > 99999)").to_pandas()
+    assert len(got) == 0
