@@ -528,6 +528,8 @@ class DataFrame:
         one at a time (bounded memory — batches are NOT materialized up
         front; this path skips query event logging); columns expose jax
         arrays as ``.data``/``.validity``."""
+        from spark_rapids_tpu.api.session import TpuSession
+        TpuSession._active = self.session
         exec_plan = self.session.plan(self.plan)
         self._last_exec = exec_plan
         yield from exec_plan.execute()

@@ -269,6 +269,72 @@ def sqrt(c) -> Col:
     return Col(arith.Sqrt(_expr(c)))
 
 
+def _unary_fn(cls):
+    def fn(c) -> Col:
+        return Col(cls(_expr(c)))
+    fn.__name__ = cls.__name__.lower()
+    return fn
+
+
+# double-typed math unaries (reference CudfUnaryMathExpression family);
+# the expression classes predate these wrappers — this exposes them on
+# the pyspark-like surface
+exp = _unary_fn(arith.Exp)
+expm1 = _unary_fn(arith.Expm1)
+log = _unary_fn(arith.Log)
+log2 = _unary_fn(arith.Log2)
+log10 = _unary_fn(arith.Log10)
+log1p = _unary_fn(arith.Log1p)
+sin = _unary_fn(arith.Sin)
+cos = _unary_fn(arith.Cos)
+tan = _unary_fn(arith.Tan)
+cot = _unary_fn(arith.Cot)
+asin = _unary_fn(arith.Asin)
+acos = _unary_fn(arith.Acos)
+atan = _unary_fn(arith.Atan)
+sinh = _unary_fn(arith.Sinh)
+cosh = _unary_fn(arith.Cosh)
+tanh = _unary_fn(arith.Tanh)
+asinh = _unary_fn(arith.Asinh)
+acosh = _unary_fn(arith.Acosh)
+atanh = _unary_fn(arith.Atanh)
+degrees = _unary_fn(arith.ToDegrees)
+radians = _unary_fn(arith.ToRadians)
+rint = _unary_fn(arith.Rint)
+signum = _unary_fn(arith.Signum)
+cbrt = _unary_fn(arith.Cbrt)
+floor = _unary_fn(arith.Floor)
+ceil = _unary_fn(arith.Ceil)
+ceiling = ceil
+bitwise_not = _unary_fn(arith.BitwiseNot)
+bitwiseNOT = bitwise_not
+ln = log
+
+
+def atan2(y, x) -> Col:
+    return Col(arith.Atan2(_lit_expr(y), _lit_expr(x)))
+
+
+def bround(c, scale: int = 0) -> Col:
+    return Col(arith.BRound(_expr(c), scale))
+
+
+def pmod(dividend, divisor) -> Col:
+    return Col(arith.Pmod(_lit_expr(dividend), _lit_expr(divisor)))
+
+
+def shiftleft(c, n: int) -> Col:
+    return Col(arith.ShiftLeft(_expr(c), _lit_expr(n)))
+
+
+def shiftright(c, n: int) -> Col:
+    return Col(arith.ShiftRight(_expr(c), _lit_expr(n)))
+
+
+def shiftrightunsigned(c, n: int) -> Col:
+    return Col(arith.ShiftRightUnsigned(_expr(c), _lit_expr(n)))
+
+
 def round(c, scale: int = 0) -> Col:  # noqa: A001
     return Col(arith.Round(_expr(c), scale))
 

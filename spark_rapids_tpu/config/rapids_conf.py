@@ -214,6 +214,14 @@ SHUFFLE_TRANSPORT_ENABLED = conf(
     "mesh (the UCX-transport analog, reference RapidsConf.scala:986); "
     "otherwise serialize through the host shuffle store.", _to_bool)
 
+_READER_TYPES = ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
+
+
+def _reader_type_ok(v):
+    return None if v in _READER_TYPES else \
+        "must be PERFILE, COALESCING, MULTITHREADED or AUTO"
+
+
 MULTITHREADED_READ_NUM_THREADS = conf(
     "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
     "Thread-pool size for the multithreaded file reader "
@@ -227,17 +235,7 @@ MAX_NUM_FILES_PARALLEL = conf(
 PARQUET_READER_TYPE = conf(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",
     "Parquet reader strategy: PERFILE, COALESCING, MULTITHREADED, AUTO "
-    "(reference RapidsConf.scala:693-722).", str,
-    lambda v: None if v in ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
-    else "must be PERFILE, COALESCING, MULTITHREADED or AUTO")
-
-_READER_TYPES = ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
-
-
-def _reader_type_ok(v):
-    return None if v in _READER_TYPES else \
-        "must be PERFILE, COALESCING, MULTITHREADED or AUTO"
-
+    "(reference RapidsConf.scala:693-722).", str, _reader_type_ok)
 
 ORC_READER_TYPE = conf(
     "spark.rapids.sql.format.orc.reader.type", "AUTO",
@@ -257,6 +255,14 @@ CSV_READ_NUM_THREADS = conf(
     "spark.rapids.sql.format.csv.multiThreadedRead.numThreads", 8,
     "Thread-pool size for the multithreaded CSV reader.",
     _to_int, _positive)
+
+ORC_MAX_NUM_FILES_PARALLEL = conf(
+    "spark.rapids.sql.format.orc.multiThreadedRead.maxNumFilesParallel",
+    4, "Max ORC files buffered in flight per task.", _to_int, _positive)
+
+CSV_MAX_NUM_FILES_PARALLEL = conf(
+    "spark.rapids.sql.format.csv.multiThreadedRead.maxNumFilesParallel",
+    4, "Max CSV files buffered in flight per task.", _to_int, _positive)
 
 READER_BATCH_SIZE_ROWS = conf(
     "spark.rapids.sql.reader.batchSizeRows", 1 << 20,

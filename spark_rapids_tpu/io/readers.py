@@ -291,5 +291,5 @@ def make_file_scan_exec(node: FileRelation, conf) -> TpuFileScanExec:
             f"spark.rapids.sql.format.{fmt}.multiThreadedRead."
             "numThreads"],
         max_files_parallel=conf[
-            "spark.rapids.sql.format.parquet.multiThreadedRead."
+            f"spark.rapids.sql.format.{fmt}.multiThreadedRead."
             "maxNumFilesParallel"])

@@ -189,7 +189,11 @@ class DataFrameWriter:
         return self
 
     def _write(self, path: str, file_format: str) -> WriteStats:
+        from spark_rapids_tpu.api.session import TpuSession
         from spark_rapids_tpu.config import rapids_conf as rc
+        # call-time conf resolution (retry budget, join knobs) follows
+        # the session executing this write
+        TpuSession._active = self.df.session
         exec_plan = self.df.session.plan(self.df.plan)
         return write_batches(
             exec_plan.execute(), path, file_format,

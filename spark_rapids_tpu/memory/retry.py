@@ -191,11 +191,13 @@ def _resolve_max_retries() -> int:
     try:
         from spark_rapids_tpu.api.session import TpuSession
         from spark_rapids_tpu.config import rapids_conf as rc
-        s = TpuSession._active
-        if s is not None:
-            return s.conf.get(rc.OOM_RETRY_MAX)
-    except Exception:
-        pass
+    except ImportError:  # torn-down interpreter only
+        return _default_max_retries
+    s = TpuSession._active
+    if s is not None:
+        # conf errors (bad oomRetry.maxRetries value) must fail loudly,
+        # not silently fall back to the default budget
+        return s.conf.get(rc.OOM_RETRY_MAX)
     return _default_max_retries
 
 

@@ -274,7 +274,8 @@ class DistributedHashJoin:
                  out_factor: int = 1,
                  broadcast_threshold_rows: Optional[int] = None,
                  skew_factor: Optional[float] = None,
-                 skew_min_rows: Optional[int] = None):
+                 skew_min_rows: Optional[int] = None,
+                 skew_enabled: Optional[bool] = None):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.config import rapids_conf as rc
 
@@ -290,7 +291,8 @@ class DistributedHashJoin:
             broadcast_threshold_rows, rc.BROADCAST_JOIN_THRESHOLD_ROWS)
         skew_factor = _conf_default(skew_factor, rc.SKEW_JOIN_FACTOR)
         skew_min_rows = _conf_default(skew_min_rows, rc.SKEW_JOIN_MIN_ROWS)
-        self.skew_enabled = _conf_default(None, rc.SKEW_JOIN_ENABLED)
+        self.skew_enabled = _conf_default(skew_enabled,
+                                          rc.SKEW_JOIN_ENABLED)
         if join_type not in ("inner", "left"):
             raise ValueError("distributed join supports inner/left")
         if strategy not in ("auto", "broadcast", "shuffle"):

@@ -268,8 +268,9 @@ class Resolver:
         if stmt.distinct:
             df = df.distinct()
         if stmt.order_by:
-            df = df.orderBy(*[self._order_key(o, out_names)
-                              for o in stmt.order_by])
+            df = df.orderBy(*[
+                self._order_key(o, out_names, grouped=has_aggs)
+                for o in stmt.order_by])
         if stmt.limit is not None:
             df = df.limit(stmt.limit)
         return df
@@ -444,9 +445,13 @@ class Resolver:
                 return o.expr.parts[-1]
         return None
 
-    def _order_key(self, o: A.OrderItem, out_names: List[str]):
+    def _order_key(self, o: A.OrderItem, out_names: List[str],
+                   grouped: bool = False):
+        """Post-projection sort key.  Qualified refs (t.c) may match
+        output columns by last part only in GROUPED queries, where no
+        input relation survives to resolve them against."""
         F = self.F
-        name = self._order_name(o, out_names, allow_qualified=True)
+        name = self._order_name(o, out_names, allow_qualified=grouped)
         if name is None:
             raise ValueError(
                 "ORDER BY supports output columns/aliases/positions "
@@ -529,6 +534,15 @@ class Resolver:
             return a.value
 
         simple = {
+            "exp": F.exp, "expm1": F.expm1, "log": F.log, "ln": F.log,
+            "log2": F.log2, "log10": F.log10, "log1p": F.log1p,
+            "sin": F.sin, "cos": F.cos, "tan": F.tan, "cot": F.cot,
+            "asin": F.asin, "acos": F.acos, "atan": F.atan,
+            "atan2": F.atan2, "sinh": F.sinh, "cosh": F.cosh,
+            "tanh": F.tanh, "degrees": F.degrees, "radians": F.radians,
+            "rint": F.rint, "signum": F.signum, "sign": F.signum,
+            "cbrt": F.cbrt, "floor": F.floor, "ceil": F.ceil,
+            "ceiling": F.ceil, "pmod": F.pmod,
             "abs": F.abs, "sqrt": F.sqrt, "coalesce": F.coalesce,
             "isnan": F.isnan, "greatest": F.greatest, "least": F.least,
             "length": F.length, "upper": F.upper, "lower": F.lower,
@@ -551,6 +565,13 @@ class Resolver:
         if n == "round":
             return F.round(args[0], int(lit_arg(1)) if len(args) > 1
                            else 0)
+        if n == "bround":
+            return F.bround(args[0], int(lit_arg(1)) if len(args) > 1
+                            else 0)
+        if n == "shiftleft":
+            return F.shiftleft(args[0], int(lit_arg(1)))
+        if n == "shiftright":
+            return F.shiftright(args[0], int(lit_arg(1)))
         if n in ("substring", "substr"):
             return F.substring(args[0], int(lit_arg(1)),
                                int(lit_arg(2)) if len(args) > 2

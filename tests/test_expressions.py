@@ -217,3 +217,46 @@ def test_alias_and_literal_project():
     out = run_exprs(b, Alias(A.Add(col("x"), Literal(1)), "x1"), Literal(7))
     assert out[0] == [2, 3]
     assert out[1] == [7, 7]
+
+
+def test_math_function_surface():
+    """The full math-unary surface through F wrappers vs numpy."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession()
+    rng = np.random.default_rng(11)
+    x = rng.uniform(0.1, 0.9, 50)
+    df = session.create_dataframe(pd.DataFrame({"x": x}))
+    cases = {
+        "exp": np.exp, "log": np.log, "log2": np.log2,
+        "log10": np.log10, "log1p": np.log1p, "expm1": np.expm1,
+        "sin": np.sin, "cos": np.cos, "tan": np.tan,
+        "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+        "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+        "degrees": np.degrees, "radians": np.radians,
+        "cbrt": np.cbrt, "floor": np.floor, "signum": np.sign,
+    }
+    cols = [getattr(F, n)(F.col("x")).alias(n) for n in cases]
+    out = df.select(*cols).to_pandas()
+    for n, fn in cases.items():
+        np.testing.assert_allclose(out[n], fn(x), rtol=1e-12,
+                                   err_msg=n)
+
+
+def test_shift_and_bitwise_fns():
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession()
+    df = session.create_dataframe(pd.DataFrame({"a": [1, 4, 12]}))
+    out = df.select(
+        F.shiftleft(F.col("a"), 2).alias("sl"),
+        F.shiftright(F.col("a"), 1).alias("sr"),
+        F.bitwise_not(F.col("a")).alias("bn"),
+        F.pmod(F.col("a"), 5).alias("pm")).to_pandas()
+    assert out["sl"].tolist() == [4, 16, 48]
+    assert out["sr"].tolist() == [0, 2, 6]
+    assert out["bn"].tolist() == [-2, -5, -13]
+    assert out["pm"].tolist() == [1, 4, 2]
