@@ -311,8 +311,15 @@ bitwiseNOT = bitwise_not
 ln = log
 
 
+def _col_or_lit(v) -> Expression:
+    """pyspark coercion: str/Col = column reference, else literal."""
+    if isinstance(v, (str, Col)):
+        return _expr(v)
+    return _lit_expr(v)
+
+
 def atan2(y, x) -> Col:
-    return Col(arith.Atan2(_lit_expr(y), _lit_expr(x)))
+    return Col(arith.Atan2(_col_or_lit(y), _col_or_lit(x)))
 
 
 def bround(c, scale: int = 0) -> Col:
@@ -320,7 +327,7 @@ def bround(c, scale: int = 0) -> Col:
 
 
 def pmod(dividend, divisor) -> Col:
-    return Col(arith.Pmod(_lit_expr(dividend), _lit_expr(divisor)))
+    return Col(arith.Pmod(_col_or_lit(dividend), _col_or_lit(divisor)))
 
 
 def shiftleft(c, n: int) -> Col:

@@ -307,6 +307,14 @@ BROADCAST_JOIN_THRESHOLD_ROWS = conf(
     "shuffling (autoBroadcastJoinThreshold analog, in rows).",
     _to_int, _positive)
 
+PYTHON_NUM_WORKERS = conf(
+    "spark.rapids.sql.python.numWorkers", 0,
+    "Worker processes for black-box Python UDF evaluation (0 = inline "
+    "on the driver thread; the concurrentPythonWorkers analog). "
+    "Spawn-started and reused across batches; unpicklable functions "
+    "fall back to inline.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

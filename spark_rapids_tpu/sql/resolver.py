@@ -268,8 +268,11 @@ class Resolver:
         if stmt.distinct:
             df = df.distinct()
         if stmt.order_by:
+            # DISTINCT also lacks a pre-projection fallback, so
+            # qualified refs match outputs by last part there too
             df = df.orderBy(*[
-                self._order_key(o, out_names, grouped=has_aggs)
+                self._order_key(o, out_names,
+                                grouped=has_aggs or stmt.distinct)
                 for o in stmt.order_by])
         if stmt.limit is not None:
             df = df.limit(stmt.limit)
@@ -534,7 +537,8 @@ class Resolver:
             return a.value
 
         simple = {
-            "exp": F.exp, "expm1": F.expm1, "log": F.log, "ln": F.log,
+            "exp": F.exp, "expm1": F.expm1, "ln": F.log,
+            "asinh": F.asinh, "acosh": F.acosh, "atanh": F.atanh,
             "log2": F.log2, "log10": F.log10, "log1p": F.log1p,
             "sin": F.sin, "cos": F.cos, "tan": F.tan, "cot": F.cot,
             "asin": F.asin, "acos": F.acos, "atan": F.atan,
@@ -572,6 +576,15 @@ class Resolver:
             return F.shiftleft(args[0], int(lit_arg(1)))
         if n == "shiftright":
             return F.shiftright(args[0], int(lit_arg(1)))
+        if n == "shiftrightunsigned":
+            return F.shiftrightunsigned(args[0], int(lit_arg(1)))
+        if n == "log":
+            # 1-arg = natural log; 2-arg = log(base, expr) (Spark)
+            if len(args) == 1:
+                return F.log(args[0])
+            from spark_rapids_tpu.ops import arithmetic as arith
+            from spark_rapids_tpu.api.functions import Col, _expr
+            return Col(arith.Logarithm(_expr(args[0]), _expr(args[1])))
         if n in ("substring", "substr"):
             return F.substring(args[0], int(lit_arg(1)),
                                int(lit_arg(2)) if len(args) > 2

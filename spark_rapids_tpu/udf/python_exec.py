@@ -248,6 +248,13 @@ class TpuArrowEvalPythonExec(TpuExec):
         s = TpuSession._active
         return s.semaphore if s is not None else None
 
+    @staticmethod
+    def _num_workers() -> int:
+        from spark_rapids_tpu.api.session import TpuSession
+        from spark_rapids_tpu.config import rapids_conf as rc
+        s = TpuSession._active
+        return s.conf.get(rc.PYTHON_NUM_WORKERS) if s is not None else 0
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.columnar.column import Column
         for batch in self.child.execute():
@@ -263,12 +270,25 @@ class TpuArrowEvalPythonExec(TpuExec):
                 sem.release_if_held()
             outs_per_udf = []
             k = 0
+            num_workers = self._num_workers()
             for u, args in zip(self._udfs, self._args_per_udf):
                 arg_lists = arg_lists_all[k:k + len(args)]
                 k += len(args)
-                out = [None if any(v is None for v in row) else
-                       u.fn(*row) for row in zip(*arg_lists)] \
-                    if arg_lists else [u.fn() for _ in range(batch.nrows)]
+                if not arg_lists:
+                    outs_per_udf.append(
+                        [u.fn() for _ in range(batch.nrows)])
+                    continue
+                out = None
+                if num_workers > 1:
+                    from spark_rapids_tpu.udf.worker_pool import \
+                        eval_rows
+                    out = eval_rows(u.fn, list(zip(*arg_lists)),
+                                    num_workers)
+                if out is None:
+                    # inline path consumes the zip lazily — no
+                    # materialized row-tuple list
+                    out = [None if any(v is None for v in row) else
+                           u.fn(*row) for row in zip(*arg_lists)]
                 outs_per_udf.append(out)
             if sem is not None:
                 sem.acquire_if_necessary()

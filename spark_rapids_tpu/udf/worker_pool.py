@@ -1,0 +1,104 @@
+"""Persistent Python worker processes for UDF evaluation.
+
+Counterpart of the reference's Python worker scheduling (its pandas UDF
+execs reuse Spark's daemon-forked Python workers and gate them with
+``concurrentPythonWorkers`` — python/GpuArrowEvalPythonExec.scala).
+This engine is single-process, so black-box Python UDFs are GIL-bound:
+the pool spreads row chunks across ``spawn``-started worker processes
+(spawn, not fork — the parent holds initialized XLA state that must not
+be forked) and reuses them across batches to amortize startup.
+
+Off by default (``spark.rapids.sql.python.numWorkers = 0``): for cheap
+UDFs the pickle/IPC overhead exceeds the GIL win.  Functions that
+cannot pickle (lambdas, closures over open handles) fall back to inline
+evaluation transparently.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+_pool = None
+_pool_size = 0
+
+
+def _eval_chunk(fn_bytes: bytes, rows: list) -> list:
+    """Worker-side: unpickle the function once per chunk, evaluate
+    row-wise with Spark null semantics (any NULL argument -> NULL)."""
+    fn = pickle.loads(fn_bytes)
+    return [None if any(v is None for v in r) else fn(*r) for r in rows]
+
+
+def get_pool(num_workers: int):
+    """Process-wide pool, resized when the conf changes."""
+    global _pool, _pool_size
+    if num_workers <= 1:
+        return None
+    if _pool is not None and _pool_size == num_workers:
+        return _pool
+    if _pool is not None:
+        _pool.shutdown(wait=False, cancel_futures=True)
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+    _pool = ProcessPoolExecutor(
+        max_workers=num_workers,
+        mp_context=multiprocessing.get_context("spawn"))
+    _pool_size = num_workers
+    return _pool
+
+
+def shutdown_pool() -> None:
+    global _pool, _pool_size
+    if _pool is not None:
+        _pool.shutdown(wait=False, cancel_futures=True)
+        _pool = None
+        _pool_size = 0
+
+
+import weakref
+
+# functions that failed to pickle; weak so a collected function can
+# never alias a new one's address (id-reuse) and the set self-prunes
+_unpicklable_fns: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def eval_rows(fn, rows: List[tuple], num_workers: int,
+              min_rows_per_worker: int = 256) -> Optional[list]:
+    """Evaluate ``fn`` over rows on the worker pool; None when the pool
+    path does not apply (disabled, too few rows, unpicklable fn) and
+    the caller should evaluate inline."""
+    if num_workers <= 1 or len(rows) < 2 * min_rows_per_worker:
+        return None
+    try:
+        if fn in _unpicklable_fns:
+            return None
+    except TypeError:
+        pass  # unhashable callables just retry the pickle probe
+    try:
+        fn_bytes = pickle.dumps(fn)
+    except Exception:
+        try:
+            _unpicklable_fns.add(fn)
+        except TypeError:
+            pass
+        return None
+    pool = get_pool(num_workers)
+    if pool is None:
+        return None
+    chunk = max(min_rows_per_worker, -(-len(rows) // num_workers))
+    futures = [pool.submit(_eval_chunk, fn_bytes, rows[i:i + chunk])
+               for i in range(0, len(rows), chunk)]
+    from concurrent.futures.process import BrokenProcessPool
+    try:
+        out: list = []
+        for f in futures:
+            out.extend(f.result())
+        return out
+    except BrokenProcessPool:
+        # pool infrastructure failure (worker killed, spawn broken)
+        # degrades to inline evaluation rather than failing the query
+        shutdown_pool()
+        return None
+    # a user UDF exception propagates — re-running inline would
+    # duplicate any side effects the completed rows already had

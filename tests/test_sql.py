@@ -404,3 +404,18 @@ def test_empty_scalar_subquery_is_null(session):
         "SELECT cust FROM orders WHERE amount > "
         "(SELECT amount FROM orders WHERE amount > 99999)").to_pandas()
     assert len(got) == 0
+
+
+def test_two_arg_log_and_extra_math(session):
+    got = session.sql(
+        "SELECT log(2, 8.0) AS l2, asinh(0.0) AS ash, "
+        "shiftrightunsigned(8, 2) AS sru").to_pandas()
+    assert got["l2"].iloc[0] == pytest.approx(3.0)
+    assert got["ash"].iloc[0] == pytest.approx(0.0)
+    assert got["sru"].iloc[0] == 2
+
+
+def test_distinct_with_qualified_order(session):
+    got = session.sql(
+        "SELECT DISTINCT cust FROM orders o ORDER BY o.cust").to_pandas()
+    assert got["cust"].tolist() == sorted(got["cust"].tolist())

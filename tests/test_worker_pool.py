@@ -1,0 +1,74 @@
+"""Python worker pool tests (Python worker scheduling analog)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.udf import worker_pool as WP
+
+
+def picklable_double(x):
+    return x * 2.0 + 1.0
+
+
+def test_eval_rows_pool_matches_inline():
+    rows = [(float(i),) for i in range(2000)]
+    rows[7] = (None,)
+    got = WP.eval_rows(picklable_double, rows, num_workers=2,
+                      min_rows_per_worker=100)
+    assert got is not None
+    want = [None if r[0] is None else picklable_double(r[0])
+            for r in rows]
+    assert got == want
+    WP.shutdown_pool()
+
+
+def test_eval_rows_declines_small_batches():
+    rows = [(1.0,)] * 10
+    assert WP.eval_rows(picklable_double, rows, num_workers=4) is None
+
+
+def test_eval_rows_declines_unpicklable():
+    f = lambda x: x  # noqa: E731 - deliberately unpicklable-by-value
+    f.__qualname__ = "<locals>.f"
+    import pickle
+
+    class NoPickle:
+        def __reduce__(self):
+            raise pickle.PicklingError("no")
+
+    bad = NoPickle()
+
+    def closure(x):
+        return (x, bad)
+
+    rows = [(1.0,)] * 2000
+    assert WP.eval_rows(closure, rows, num_workers=2,
+                        min_rows_per_worker=10) is None
+    # cached as unpicklable: immediate decline on re-entry
+    assert WP.eval_rows(closure, rows, num_workers=2,
+                        min_rows_per_worker=10) is None
+
+
+def fsum_plus_one(x):
+    import math
+    # math.fsum defeats the bytecode compiler -> ArrowEval exec
+    return math.fsum([x, 1.0])
+
+
+def test_udf_through_worker_pool():
+    s = TpuSession({"spark.rapids.sql.python.numWorkers": "2"})
+    weird = F.udf(fsum_plus_one, returnType="double")
+    n = 2000
+    pdf = pd.DataFrame({"x": np.arange(float(n))})
+    df = s.create_dataframe(pdf).select(weird(F.col("x")).alias("y"))
+    tree = df.session.plan(df.plan).tree_string()
+    assert "TpuArrowEvalPythonExec" in tree, tree
+    out = df.to_pandas()
+    np.testing.assert_allclose(out["y"], pdf["x"] + 1.0)
+    # the module-level fn is picklable and the batch is large: the
+    # pool must actually have spun up
+    assert WP._pool is not None and WP._pool_size == 2
+    WP.shutdown_pool()
