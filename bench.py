@@ -64,6 +64,27 @@ signal.signal(signal.SIGALRM, _on_signal)
 signal.alarm(int(WALL_BUDGET) + 5)
 
 
+def _thread_watchdog():
+    """Signal handlers only run between Python bytecodes; if the main
+    thread is stuck inside a native call (e.g. device init against a
+    dead tunnel), SIGALRM never lands.  A daemon thread timer emits the
+    best-so-far line and hard-exits regardless."""
+    import threading
+
+    def fire():
+        print(f"bench: thread watchdog fired with {remaining():.0f}s "
+              "left; emitting", file=sys.stderr)
+        _emit()
+        os._exit(0)
+
+    t = threading.Timer(WALL_BUDGET + 10, fire)
+    t.daemon = True
+    t.start()
+
+
+_thread_watchdog()
+
+
 # ------------------------------------------------------------------ data gen --
 def gen_host(n: int, seed: int = 42):
     rng = np.random.default_rng(seed)

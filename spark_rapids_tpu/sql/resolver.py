@@ -269,10 +269,12 @@ class Resolver:
             df = df.distinct()
         if stmt.order_by:
             # DISTINCT also lacks a pre-projection fallback, so
-            # qualified refs match outputs by last part there too
+            # qualified refs may match outputs there too — but only
+            # when the qualifier really owns the named column
             df = df.orderBy(*[
                 self._order_key(o, out_names,
-                                grouped=has_aggs or stmt.distinct)
+                                grouped=has_aggs or stmt.distinct,
+                                scope=scope)
                 for o in stmt.order_by])
         if stmt.limit is not None:
             df = df.limit(stmt.limit)
@@ -424,12 +426,14 @@ class Resolver:
         return "col"
 
     def _order_name(self, o: A.OrderItem, out_names: List[str],
-                    allow_qualified: bool = False) -> Optional[str]:
+                    allow_qualified: bool = False,
+                    scope: Optional[Scope] = None) -> Optional[str]:
         """Output-column name an ORDER BY item refers to, or None when
-        it must resolve against the pre-projection input.  In grouped
-        queries (``allow_qualified``) there is no input to fall back
-        to, so a qualified ref (c.name) matches the output column its
-        last part named."""
+        it must resolve against the pre-projection input.  In grouped/
+        DISTINCT queries (``allow_qualified``) there is no input to
+        fall back to, so a qualified ref (c.name) matches the output
+        column its last part named — after validating the qualifier
+        actually owns that column in ``scope``."""
         if isinstance(o.expr, A.Lit) and isinstance(o.expr.value, int):
             pos = o.expr.value
             if not 1 <= pos <= len(out_names):
@@ -445,16 +449,28 @@ class Resolver:
                 if o.expr.parts[0] in out_names:
                     return o.expr.parts[0]
             elif allow_qualified and o.expr.parts[-1] in out_names:
+                if scope is not None and len(o.expr.parts) == 2:
+                    m = scope.mapping_of(o.expr.parts[0])
+                    if m is None:
+                        raise KeyError(
+                            f"unknown relation {o.expr.parts[0]!r} "
+                            "in ORDER BY")
+                    if o.expr.parts[1] not in m:
+                        raise KeyError(
+                            f"column {o.expr.parts[1]!r} not in "
+                            f"relation {o.expr.parts[0]!r}")
                 return o.expr.parts[-1]
         return None
 
     def _order_key(self, o: A.OrderItem, out_names: List[str],
-                   grouped: bool = False):
+                   grouped: bool = False,
+                   scope: Optional[Scope] = None):
         """Post-projection sort key.  Qualified refs (t.c) may match
-        output columns by last part only in GROUPED queries, where no
-        input relation survives to resolve them against."""
+        output columns by last part only in GROUPED/DISTINCT queries,
+        where no input relation survives to resolve them against."""
         F = self.F
-        name = self._order_name(o, out_names, allow_qualified=grouped)
+        name = self._order_name(o, out_names, allow_qualified=grouped,
+                                scope=scope)
         if name is None:
             raise ValueError(
                 "ORDER BY supports output columns/aliases/positions "

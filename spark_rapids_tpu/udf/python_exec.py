@@ -279,11 +279,13 @@ class TpuArrowEvalPythonExec(TpuExec):
                         [u.fn() for _ in range(batch.nrows)])
                     continue
                 out = None
-                if num_workers > 1:
-                    from spark_rapids_tpu.udf.worker_pool import \
-                        eval_rows
-                    out = eval_rows(u.fn, list(zip(*arg_lists)),
-                                    num_workers)
+                if num_workers >= 1:
+                    from spark_rapids_tpu.udf import worker_pool as WP
+                    # cheap declines first: never materialize the row
+                    # list for a pool path that won't run
+                    if WP.worth_trying(u.fn, batch.nrows, num_workers):
+                        out = WP.eval_rows(u.fn, list(zip(*arg_lists)),
+                                           num_workers)
                 if out is None:
                     # inline path consumes the zip lazily — no
                     # materialized row-tuple list

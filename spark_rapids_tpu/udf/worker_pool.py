@@ -23,17 +23,28 @@ _pool = None
 _pool_size = 0
 
 
+class WorkerUnpicklable(Exception):
+    """The worker could not reconstruct the function (e.g. pickled by
+    reference to a __main__ the spawn-started worker cannot import).
+    Raised before any row evaluates, so inline fallback cannot
+    duplicate side effects."""
+
+
 def _eval_chunk(fn_bytes: bytes, rows: list) -> list:
     """Worker-side: unpickle the function once per chunk, evaluate
     row-wise with Spark null semantics (any NULL argument -> NULL)."""
-    fn = pickle.loads(fn_bytes)
+    try:
+        fn = pickle.loads(fn_bytes)
+    except Exception as e:
+        raise WorkerUnpicklable(repr(e))
     return [None if any(v is None for v in r) else fn(*r) for r in rows]
 
 
 def get_pool(num_workers: int):
-    """Process-wide pool, resized when the conf changes."""
+    """Process-wide pool, resized when the conf changes.  1 is a valid
+    size (one reused isolated worker); 0 disables the pool."""
     global _pool, _pool_size
-    if num_workers <= 1:
+    if num_workers <= 0:
         return None
     if _pool is not None and _pool_size == num_workers:
         return _pool
@@ -63,18 +74,27 @@ import weakref
 _unpicklable_fns: "weakref.WeakSet" = weakref.WeakSet()
 
 
+def worth_trying(fn, nrows: int, num_workers: int,
+                 min_rows_per_worker: int = 256) -> bool:
+    """Cheap pre-checks so callers can avoid materializing row tuples
+    for a pool path that would immediately decline."""
+    if num_workers <= 0 or nrows < 2 * min_rows_per_worker:
+        return False
+    try:
+        if fn in _unpicklable_fns:
+            return False
+    except TypeError:
+        pass  # unhashable callables just retry the pickle probe
+    return True
+
+
 def eval_rows(fn, rows: List[tuple], num_workers: int,
               min_rows_per_worker: int = 256) -> Optional[list]:
     """Evaluate ``fn`` over rows on the worker pool; None when the pool
     path does not apply (disabled, too few rows, unpicklable fn) and
     the caller should evaluate inline."""
-    if num_workers <= 1 or len(rows) < 2 * min_rows_per_worker:
+    if not worth_trying(fn, len(rows), num_workers, min_rows_per_worker):
         return None
-    try:
-        if fn in _unpicklable_fns:
-            return None
-    except TypeError:
-        pass  # unhashable callables just retry the pickle probe
     try:
         fn_bytes = pickle.dumps(fn)
     except Exception:
@@ -95,10 +115,18 @@ def eval_rows(fn, rows: List[tuple], num_workers: int,
         for f in futures:
             out.extend(f.result())
         return out
+    except WorkerUnpicklable:
+        # pickled fine by reference but the worker cannot reconstruct
+        # it (REPL __main__ fn); no row ran, inline fallback is safe
+        try:
+            _unpicklable_fns.add(fn)
+        except TypeError:
+            pass
+        return None
     except BrokenProcessPool:
         # pool infrastructure failure (worker killed, spawn broken)
         # degrades to inline evaluation rather than failing the query
         shutdown_pool()
         return None
-    # a user UDF exception propagates — re-running inline would
-    # duplicate any side effects the completed rows already had
+    # any other (user UDF) exception propagates — re-running inline
+    # would duplicate side effects the completed rows already had

@@ -31,8 +31,6 @@ def test_eval_rows_declines_small_batches():
 
 
 def test_eval_rows_declines_unpicklable():
-    f = lambda x: x  # noqa: E731 - deliberately unpicklable-by-value
-    f.__qualname__ = "<locals>.f"
     import pickle
 
     class NoPickle:
