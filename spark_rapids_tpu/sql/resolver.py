@@ -448,18 +448,34 @@ class Resolver:
                 # pre-projection resolution (Spark's behavior)
                 if o.expr.parts[0] in out_names:
                     return o.expr.parts[0]
-            elif allow_qualified and o.expr.parts[-1] in out_names:
-                if scope is not None and len(o.expr.parts) == 2:
-                    m = scope.mapping_of(o.expr.parts[0])
+            elif allow_qualified:
+                parts = o.expr.parts
+                if len(parts) != 2:
+                    raise ValueError(
+                        f"ORDER BY {'.'.join(parts)}: multi-part "
+                        "references are not supported in grouped/"
+                        "DISTINCT queries; alias the expression")
+                if scope is not None:
+                    m = scope.mapping_of(parts[0])
                     if m is None:
                         raise KeyError(
-                            f"unknown relation {o.expr.parts[0]!r} "
-                            "in ORDER BY")
-                    if o.expr.parts[1] not in m:
+                            f"unknown relation {parts[0]!r} in "
+                            "ORDER BY")
+                    flat = m.get(parts[1])
+                    if flat is None:
                         raise KeyError(
-                            f"column {o.expr.parts[1]!r} not in "
-                            f"relation {o.expr.parts[0]!r}")
-                return o.expr.parts[-1]
+                            f"column {parts[1]!r} not in relation "
+                            f"{parts[0]!r}")
+                    # provenance check: the qualifier's FLAT column
+                    # (post join-dedup rename) must itself be the
+                    # output — b.v must not silently sort by a's v
+                    if flat in out_names:
+                        return flat
+                    raise KeyError(
+                        f"ORDER BY {parts[0]}.{parts[1]}: that "
+                        "relation's column is not among the outputs")
+                if parts[-1] in out_names:
+                    return parts[-1]
         return None
 
     def _order_key(self, o: A.OrderItem, out_names: List[str],

@@ -70,3 +70,35 @@ def test_udf_through_worker_pool():
     # pool must actually have spun up
     assert WP._pool is not None and WP._pool_size == 2
     WP.shutdown_pool()
+
+
+def test_worker_reconstruct_failure_falls_back():
+    """A fn that pickles by reference to a module the spawn worker
+    cannot import declines the pool path (WorkerUnpicklable round
+    trip) instead of failing the query."""
+    import sys
+    import types
+    mod = types.ModuleType("wp_fake_module_not_on_disk")
+    exec("def ghost(x):\n    return x + 1.0\n", mod.__dict__)
+    mod.ghost.__module__ = mod.__name__
+    sys.modules[mod.__name__] = mod
+    try:
+        rows = [(float(i),) for i in range(2000)]
+        out = WP.eval_rows(mod.ghost, rows, num_workers=2,
+                           min_rows_per_worker=100)
+        assert out is None  # declined, cached as unpicklable
+        assert WP.eval_rows(mod.ghost, rows, num_workers=2,
+                            min_rows_per_worker=100) is None
+    finally:
+        del sys.modules[mod.__name__]
+        WP.shutdown_pool()
+
+
+def test_single_worker_pool_mode():
+    rows = [(float(i),) for i in range(1000)]
+    out = WP.eval_rows(picklable_double, rows, num_workers=1,
+                       min_rows_per_worker=100)
+    assert out is not None
+    assert out[3] == picklable_double(3.0)
+    assert WP._pool_size == 1
+    WP.shutdown_pool()
