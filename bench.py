@@ -1,34 +1,34 @@
-"""Benchmark: TPC-H q6 (scan -> filter -> project -> sum), device-resident.
+"""Benchmark driver: TPC-H q6 + q1-shaped group-by, tunnel-proof.
 
-BASELINE.md config 1 — the reference's minimum end-to-end slice.  The
-round-1 bench generated 60M rows host-side and pushed ~1.9 GB through the
-remote TPU tunnel, which blew the driver's wall-clock budget before the one
-JSON line was printed.  This version is structured so a result is ALWAYS
-captured:
+BASELINE.md configs 1-2.  Rounds 1-2 never captured a number because the
+single process blocked inside TPU backend init against a dead tunnel until
+the driver's wall clock ran out.  This version is a PARENT that never
+imports jax:
 
-* **Data lives on device.**  The lineitem columns are generated inside a
-  jitted ``jax.random`` program, so nothing but the 8-byte result crosses
-  the tunnel per query.  Engine batches are built directly from the device
-  arrays (``Column`` wraps any jax array).
-* **Phased, cheapest first.**  (1) exact correctness vs pandas at 64K rows,
-  (2) pandas CPU baseline timed at a host-sized sample and scaled linearly
-  (q6 is O(n)), (3) engine perf at growing sizes (4M -> 67M rows), keeping
-  the largest size that fits the budget.
-* **Watchdog.**  A SIGALRM/SIGTERM handler and ``atexit`` hook print the
-  best JSON line seen so far, so even a hard budget kill yields a number.
+* **Probe loop.**  Device init runs in a SUBPROCESS with a short timeout
+  (60s).  A dead tunnel kills the probe, not the budget; the parent keeps
+  re-probing until ~30s of budget remains, so a tunnel that comes back
+  mid-window still yields a number.
+* **Child bench with salvage file.**  The measurement child writes its
+  best-so-far JSON line to a file after every completed phase; if the
+  child is killed by its timeout, the parent emits the salvaged line.
+* **CPU fallback with explicit provenance.**  If no TPU ever appears but
+  the CPU platform works, the bench runs there and the line carries
+  ``"device": "cpu"`` plus an error note — a diagnosed environment, not a
+  silent zero.  Only when nothing at all can run does the line degrade to
+  ``value: 0`` with ``"error": "device_unreachable"``.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": rows/s, "unit": "rows/s", "vs_baseline": x}``.
+``{"metric": "tpch_q6_rows_per_sec", "value": rows/s, "unit": "rows/s",
+"vs_baseline": x, ...extra diagnostics...}``.
 """
 
-import atexit
 import json
 import os
-import signal
+import subprocess
 import sys
+import tempfile
 import time
-
-import numpy as np
 
 WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "480"))
 _T0 = time.monotonic()
@@ -38,12 +38,65 @@ def remaining() -> float:
     return WALL_BUDGET - (time.monotonic() - _T0)
 
 
+def log(msg: str) -> None:
+    print(f"bench[{WALL_BUDGET - remaining():6.0f}s]: {msg}",
+          file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------------------- probe --
+PROBE_SRC = r"""
+import json, sys
+import jax
+devs = jax.devices()
+d = devs[0]
+print(json.dumps({"platform": d.platform,
+                  "kind": getattr(d, "device_kind", "?"),
+                  "n": len(devs)}))
+"""
+
+
+def cpu_env(base=None):
+    """Env that really forces the CPU platform.  The image's
+    sitecustomize registers the axon PJRT plugin at interpreter startup
+    (gated on PALLAS_AXON_POOL_IPS) and pins jax_platforms via
+    jax.config.update, which overrides the JAX_PLATFORMS env var — so a
+    dead tunnel hangs even nominally-CPU children unless the axon
+    registration is disabled outright."""
+    env = dict(base or os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def probe_device(timeout: float, platforms=None):
+    """Run ``jax.devices()`` in a subprocess.  Returns the parsed dict or
+    None (init hung / crashed — a dead tunnel shows up here, cheaply)."""
+    env = cpu_env() if platforms == "cpu" else dict(os.environ)
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE_SRC], env=env,
+                           stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    if p.returncode != 0:
+        log(f"probe rc={p.returncode}: {p.stderr.strip()[-200:]}")
+        return None
+    for line in p.stdout.splitlines():
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+# -------------------------------------------------------------------- main --
 _best = {"metric": "tpch_q6_rows_per_sec", "value": 0, "unit": "rows/s",
          "vs_baseline": 0.0}
 _emitted = False
 
 
 def _emit():
+    """Print the one JSON line exactly once, whatever kills us."""
     global _emitted
     if not _emitted:
         _emitted = True
@@ -51,53 +104,119 @@ def _emit():
         sys.stdout.flush()
 
 
-def _on_signal(signum, frame):
-    print(f"bench: signal {signum} with {remaining():.0f}s left; emitting",
-          file=sys.stderr)
-    _emit()
-    os._exit(0)
+def _install_safety_net():
+    import atexit
+    import signal
 
-
-atexit.register(_emit)
-signal.signal(signal.SIGTERM, _on_signal)
-signal.signal(signal.SIGALRM, _on_signal)
-signal.alarm(int(WALL_BUDGET) + 5)
-
-
-def _thread_watchdog():
-    """Signal handlers only run between Python bytecodes; if the main
-    thread is stuck inside a native call (e.g. device init against a
-    dead tunnel), SIGALRM never lands.  A daemon thread timer emits the
-    best-so-far line and hard-exits regardless."""
-    import threading
-
-    def fire():
-        print(f"bench: thread watchdog fired with {remaining():.0f}s "
-              "left; emitting", file=sys.stderr)
+    def on_signal(signum, frame):
+        log(f"signal {signum}; emitting best-so-far")
         _emit()
         os._exit(0)
 
-    t = threading.Timer(WALL_BUDGET + 10, fire)
-    t.daemon = True
-    t.start()
+    atexit.register(_emit)
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGALRM, on_signal)
+    signal.alarm(int(WALL_BUDGET) + 15)
 
 
-_thread_watchdog()
+def main() -> None:
+    best = _best
+    salvage = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".json", prefix="bench_best_", delete=False)
+    salvage.close()
+
+    def read_salvage():
+        try:
+            with open(salvage.name) as f:
+                line = f.read().strip()
+            return json.loads(line) if line else None
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    probes = 0
+    info = None
+    try:
+        # Phase 1: find a real accelerator; keep retrying (the tunnel may
+        # come back mid-window).  Stop early enough for a CPU fallback.
+        while remaining() > 150:
+            probes += 1
+            t = min(60.0, remaining() - 120)
+            log(f"probe #{probes} (timeout {t:.0f}s)")
+            info = probe_device(t)
+            if info is not None:
+                break
+            time.sleep(min(5.0, max(0.0, remaining() - 140)))
+        device = info["platform"] if info else None
+        log(f"probe result: {info}")
+
+        run_env = dict(os.environ)
+        err = None
+        if info is None or device == "cpu":
+            # no accelerator: fall back to the CPU platform with explicit
+            # provenance (proves the engine; diagnoses the environment)
+            err = None if info is not None else \
+                "tpu_unreachable_cpu_fallback"
+            if info is None:
+                cinfo = probe_device(
+                    min(60.0, max(10.0, remaining() - 60)),
+                    platforms="cpu")
+                if cinfo is None:
+                    best["error"] = "device_unreachable"
+                    best["probe_attempts"] = probes
+                    return
+                info = cinfo
+            device = "cpu"
+            run_env = cpu_env(run_env)
+
+        # Phase 2: run the measurement child; salvage on timeout.
+        t = max(20.0, remaining() - 20)
+        log(f"device={device}:{info.get('kind')}; running child "
+            f"(timeout {t:.0f}s)")
+        run_env["BENCH_BEST_FILE"] = salvage.name
+        run_env["BENCH_CHILD_BUDGET"] = str(max(10.0, t - 10))
+        try:
+            p = subprocess.run(
+                [sys.executable, __file__, "--child"], env=run_env,
+                stdout=sys.stderr, stderr=sys.stderr, timeout=t)
+            log(f"child rc={p.returncode}")
+        except subprocess.TimeoutExpired:
+            log("child timed out; salvaging best-so-far")
+        got = read_salvage()
+        if got:
+            best.update(got)
+        best.setdefault("device", device)
+        best["probe_attempts"] = probes
+        if err and "error" not in best:
+            best["error"] = err
+    except Exception as e:
+        log(f"fatal {e!r}")
+        best.setdefault("error", f"bench_crashed: {type(e).__name__}")
+    finally:
+        try:
+            os.unlink(salvage.name)
+        except OSError:
+            pass
+        _emit()
 
 
-# ------------------------------------------------------------------ data gen --
+# ------------------------------------------------------------------- child --
 def gen_host(n: int, seed: int = 42):
+    import numpy as np
     rng = np.random.default_rng(seed)
     return {
         "l_extendedprice": rng.uniform(1000.0, 100000.0, n),
         "l_discount": rng.uniform(0.0, 0.11, n).round(2),
         "l_quantity": rng.integers(1, 51, n).astype(np.float64),
         "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+        "l_tax": rng.uniform(0.0, 0.08, n).round(2),
+        "l_returnflag_code": rng.integers(0, 3, n).astype(np.int64),
+        "l_linestatus_code": rng.integers(0, 2, n).astype(np.int64),
     }
 
 
 def gen_device_batch(n: int, seed: int = 42):
-    """Generate the lineitem columns on device; only PRNG keys cross host."""
+    """Generate lineitem columns on device; only PRNG keys cross host."""
     import jax
     import jax.numpy as jnp
     from spark_rapids_tpu.columnar import dtypes as dts
@@ -106,27 +225,35 @@ def gen_device_batch(n: int, seed: int = 42):
 
     @jax.jit
     def gen(key):
-        k1, k2, k3, k4 = jax.random.split(key, 4)
-        price = jax.random.uniform(k1, (n,), dtype=jnp.float64,
+        ks = jax.random.split(key, 7)
+        price = jax.random.uniform(ks[0], (n,), dtype=jnp.float64,
                                    minval=1000.0, maxval=100000.0)
         disc = jnp.round(
-            jax.random.uniform(k2, (n,), dtype=jnp.float64, maxval=0.11), 2)
-        qty = jax.random.randint(k3, (n,), 1, 51).astype(jnp.float64)
-        ship = jax.random.randint(k4, (n,), 8766, 10957).astype(jnp.int32)
-        return price, disc, qty, ship
+            jax.random.uniform(ks[1], (n,), dtype=jnp.float64,
+                               maxval=0.11), 2)
+        qty = jax.random.randint(ks[2], (n,), 1, 51).astype(jnp.float64)
+        ship = jax.random.randint(ks[3], (n,), 8766, 10957).astype(jnp.int32)
+        tax = jnp.round(
+            jax.random.uniform(ks[4], (n,), dtype=jnp.float64,
+                               maxval=0.08), 2)
+        rf = jax.random.randint(ks[5], (n,), 0, 3).astype(jnp.int64)
+        ls = jax.random.randint(ks[6], (n,), 0, 2).astype(jnp.int64)
+        return price, disc, qty, ship, tax, rf, ls
 
-    price, disc, qty, ship = gen(jax.random.PRNGKey(seed))
+    price, disc, qty, ship, tax, rf, ls = gen(jax.random.PRNGKey(seed))
     price.block_until_ready()
     return ColumnarBatch({
         "l_extendedprice": Column(dts.FLOAT64, price, n),
         "l_discount": Column(dts.FLOAT64, disc, n),
         "l_quantity": Column(dts.FLOAT64, qty, n),
         "l_shipdate": Column(dts.INT32, ship, n),
+        "l_tax": Column(dts.FLOAT64, tax, n),
+        "l_returnflag_code": Column(dts.INT64, rf, n),
+        "l_linestatus_code": Column(dts.INT64, ls, n),
     })
 
 
-# -------------------------------------------------------------------- engine --
-def make_query(session, df):
+def make_q6(session, df):
     from spark_rapids_tpu.api import functions as F
 
     def query():
@@ -141,9 +268,28 @@ def make_query(session, df):
     return query
 
 
+def make_q1(session, df):
+    """q1-shaped group-by: BASELINE.md config 2's first step (grouped
+    sums/averages with a derived product expression, 6 groups)."""
+    from spark_rapids_tpu.api import functions as F
+
+    def query():
+        q = (df.filter(F.col("l_shipdate") <= 10471)
+             .groupBy("l_returnflag_code", "l_linestatus_code")
+             .agg(F.sum("l_quantity").alias("sum_qty"),
+                  F.sum("l_extendedprice").alias("sum_base"),
+                  F.sum((F.col("l_extendedprice") *
+                         (F.lit(1.0) - F.col("l_discount")))
+                        .alias("d")).alias("sum_disc"),
+                  F.avg("l_discount").alias("avg_disc"),
+                  F.count("l_quantity").alias("n")))
+        return q.collect()
+
+    return query
+
+
 def time_query(query, budget: float, max_iters: int = 5):
-    """Warmup once (compile), then run timed iterations inside ``budget``."""
-    result = query()
+    result = query()  # warmup / compile
     times = []
     t_stop = time.monotonic() + budget
     for _ in range(max_iters):
@@ -155,7 +301,7 @@ def time_query(query, budget: float, max_iters: int = 5):
     return result, min(times)
 
 
-def run_pandas(data, max_iters: int = 3):
+def pandas_q6(data, max_iters: int = 3):
     import pandas as pd
     df = pd.DataFrame(data)
 
@@ -165,75 +311,126 @@ def run_pandas(data, max_iters: int = 3):
                (df.l_quantity < 24.0)]
         return (m.l_extendedprice * m.l_discount).sum()
 
-    result = query()
-    times = []
-    for _ in range(max_iters):
-        t0 = time.perf_counter()
-        result = query()
-        times.append(time.perf_counter() - t0)
-    return result, min(times)
+    return time_query(query, budget=30.0, max_iters=max_iters)
 
 
-def main():
+def pandas_q1(data, max_iters: int = 3):
+    import pandas as pd
+    df = pd.DataFrame(data)
+
+    def query():
+        m = df[df.l_shipdate <= 10471].copy()
+        m["disc_price"] = m.l_extendedprice * (1.0 - m.l_discount)
+        return (m.groupby(["l_returnflag_code", "l_linestatus_code"])
+                .agg(sum_qty=("l_quantity", "sum"),
+                     sum_base=("l_extendedprice", "sum"),
+                     sum_disc=("disc_price", "sum"),
+                     avg_disc=("l_discount", "mean"),
+                     n=("l_quantity", "count")))
+
+    return time_query(query, budget=30.0, max_iters=max_iters)
+
+
+def child_main() -> None:
+    import numpy as np
+    child_budget = float(os.environ.get("BENCH_CHILD_BUDGET", "240"))
+    t0 = time.monotonic()
+
+    def left() -> float:
+        return child_budget - (time.monotonic() - t0)
+
+    best_file = os.environ.get("BENCH_BEST_FILE")
+    best = {"metric": "tpch_q6_rows_per_sec", "value": 0, "unit": "rows/s",
+            "vs_baseline": 0.0}
+
+    def save():
+        if best_file:
+            tmp = best_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(best))
+            os.replace(tmp, best_file)
+
     from spark_rapids_tpu.api.session import TpuSession
     session = TpuSession()
     import jax
     dev = jax.devices()[0]
-    print(f"bench: device={dev.platform}:{dev.device_kind} "
-          f"budget={WALL_BUDGET:.0f}s", file=sys.stderr)
+    best["device"] = dev.platform
+    save()
+    log(f"child: device={dev.platform}:{dev.device_kind} "
+        f"budget={child_budget:.0f}s")
 
-    # Phase 1: exact correctness at 64K rows (2 MB through the tunnel).
+    # correctness gate at 64K rows (cheap; ~2MB through any tunnel)
     n_small = 1 << 16
     small = gen_host(n_small)
     engine_res, _ = time_query(
-        make_query(session, session.create_dataframe(small)), budget=5.0,
+        make_q6(session, session.create_dataframe(small)), budget=5.0,
         max_iters=1)
-    pd_res, _ = run_pandas(small, max_iters=1)
-    rel_err = abs(engine_res - pd_res) / max(abs(pd_res), 1e-9)
-    assert rel_err < 1e-9, f"wrong answer: {engine_res} vs {pd_res}"
-    print(f"bench: correctness ok at {n_small} rows rel_err={rel_err:.2e} "
-          f"({remaining():.0f}s left)", file=sys.stderr)
+    pd_res, _ = pandas_q6(small, max_iters=1)
+    rel = abs(engine_res - pd_res) / max(abs(pd_res), 1e-9)
+    assert rel < 1e-9, f"q6 wrong answer: {engine_res} vs {pd_res}"
+    g_engine = make_q1(session, session.create_dataframe(small))()
+    g_pandas = pandas_q1(small, max_iters=1)[0]
+    assert len(g_engine) == len(g_pandas), "q1 group count mismatch"
+    eng = {(int(r[0]), int(r[1])): r[2:] for r in g_engine}
+    for key, row in g_pandas.iterrows():
+        got = eng[(int(key[0]), int(key[1]))]
+        for a, b in zip(got, row):
+            assert abs(a - b) / max(abs(b), 1e-9) < 1e-9, (key, got, row)
+    best["correctness"] = "ok"
+    save()
+    log(f"child: correctness ok at {n_small} rows ({left():.0f}s left)")
 
-    # Phase 2: pandas baseline, sampled then scaled (q6 is O(n)).
-    pd_n = 1 << 23
-    _, pd_t = run_pandas(gen_host(pd_n))
-    pd_rows_per_sec = pd_n / pd_t
-    print(f"bench: pandas {pd_n} rows in {pd_t * 1e3:.1f}ms "
-          f"({pd_rows_per_sec / 1e6:.1f}M rows/s, {remaining():.0f}s left)",
-          file=sys.stderr)
+    # pandas CPU baselines, sampled then scaled (both queries are O(n));
+    # shrink the sample under a tight budget so baselines can't eat it
+    pd_n = 1 << (23 if left() > 120 else 21)
+    data = gen_host(pd_n)
+    _, t_q6 = pandas_q6(data)
+    _, t_q1 = pandas_q1(data)
+    q6_base = pd_n / t_q6
+    q1_base = pd_n / t_q1
+    del data
+    log(f"child: pandas q6 {q6_base / 1e6:.1f}M rows/s, "
+        f"q1 {q1_base / 1e6:.1f}M rows/s ({left():.0f}s left)")
 
-    # Phase 3: engine perf at growing device-resident sizes.
+    # engine perf at growing device-resident sizes
     for shift in (22, 24, 26):
-        n = 1 << shift
-        # Reserve time: generation + compile (first size) + iterations.
-        if remaining() < 90:
-            print(f"bench: skipping n=2^{shift}, {remaining():.0f}s left",
-                  file=sys.stderr)
+        if left() < 20:
+            log(f"child: skipping n=2^{shift} ({left():.0f}s left)")
             break
+        n = 1 << shift
         try:
             batch = gen_device_batch(n)
             df = session.create_dataframe(batch)
-            result, t = time_query(make_query(session, df),
-                                   budget=min(20.0, remaining() / 3))
-            assert np.isfinite(result) and result > 0, result
-            rows_per_sec = n / t
-            _best.update(
-                value=round(rows_per_sec),
-                vs_baseline=round(rows_per_sec / pd_rows_per_sec, 3))
-            print(f"bench: n=2^{shift} t={t * 1e3:.1f}ms "
-                  f"{rows_per_sec / 1e6:.1f}M rows/s "
-                  f"vs_pandas={_best['vs_baseline']}x "
-                  f"({remaining():.0f}s left)", file=sys.stderr)
-        except Exception as e:  # keep the best completed size
-            print(f"bench: n=2^{shift} failed: {e!r}", file=sys.stderr)
+            r6, t6 = time_query(make_q6(session, df),
+                                budget=min(15.0, left() / 4))
+            assert np.isfinite(r6) and r6 > 0, r6
+            best.update(value=round(n / t6),
+                        vs_baseline=round(n / t6 / q6_base, 3))
+            save()
+            log(f"child: q6 n=2^{shift} t={t6 * 1e3:.1f}ms "
+                f"{n / t6 / 1e6:.1f}M rows/s "
+                f"vs_pandas={best['vs_baseline']}x")
+            if left() < 30:
+                save()
+                continue
+            r1, t1 = time_query(make_q1(session, df),
+                                budget=min(15.0, left() / 4))
+            assert len(r1) == 6, f"q1 expected 6 groups, got {len(r1)}"
+            best["groupby_rows_per_sec"] = round(n / t1)
+            best["groupby_vs_baseline"] = round(n / t1 / q1_base, 3)
+            save()
+            log(f"child: q1 n=2^{shift} t={t1 * 1e3:.1f}ms "
+                f"{n / t1 / 1e6:.1f}M rows/s "
+                f"vs_pandas={best['groupby_vs_baseline']}x")
+        except Exception as e:
+            log(f"child: n=2^{shift} failed: {e!r}")
             break
-
-    _emit()
+    save()
 
 
 if __name__ == "__main__":
-    try:
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        _install_safety_net()
         main()
-    except Exception as e:
-        print(f"bench: fatal {e!r}", file=sys.stderr)
-        _emit()
