@@ -466,6 +466,33 @@ class DataFrame:
         # conf resolved at call time (retry budget, semaphore) follows
         # the session EXECUTING the query, not the last-constructed one
         TpuSession._active = self.session
+        if getattr(self.session, "mesh", None) is not None:
+            # mesh session: offer the plan to the distributed planner
+            # first (planner-inserted exchange analog); unsupported plans
+            # fall through to the single-process engine
+            from spark_rapids_tpu.parallel.dist_planner import (
+                try_distributed)
+            events = getattr(self.session, "events", None)
+            t0 = _time.perf_counter()
+            dist = try_distributed(self.session, self.plan)
+            if dist is not None:
+                if events is not None and events.enabled:
+                    # full query envelope for distributed runs so the
+                    # event log keeps per-query attribution (the
+                    # DistExchange events carry the stage stats)
+                    qid = next(self.session._query_ids)
+                    events.emit(
+                        "QueryStart", queryId=qid,
+                        logicalPlan=self.plan.tree_string(),
+                        physicalPlan="DistributedPlan",
+                        explain=self.session.last_dist_explain)
+                    events.emit(
+                        "QueryEnd", queryId=qid, status="success",
+                        durationMs=round(
+                            (_time.perf_counter() - t0) * 1e3, 3),
+                        metrics={}, spill={}, retry={},
+                        distributed=True)
+                return dist
         exec_plan = self.session.plan(self.plan)
         self._last_exec = exec_plan
         events = getattr(self.session, "events", None)

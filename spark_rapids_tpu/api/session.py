@@ -53,13 +53,25 @@ class DataFrameReader:
 class TpuSession:
     _active: Optional["TpuSession"] = None
 
-    def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None):
+    def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None,
+                 mesh=None):
+        """``mesh``: a ``jax.sharding.Mesh`` — supported queries then run
+        distributed over it (parallel/dist_planner.py); alternatively set
+        spark.rapids.sql.distributed.numShards to build one here."""
         if isinstance(conf, dict):
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
         from spark_rapids_tpu.exec.cache import CacheManager
         self.cache_manager = CacheManager()
         self.overrides = TpuOverrides(self.conf, self.cache_manager)
+        self.last_dist_explain = ""
+        self.mesh = mesh
+        if self.mesh is None:
+            from spark_rapids_tpu.config import rapids_conf as rc
+            n = self.conf.get(rc.DISTRIBUTED_NUM_SHARDS)
+            if n:
+                from spark_rapids_tpu.parallel.mesh import make_mesh
+                self.mesh = make_mesh(n)
         self._init_memory()
         self._init_observability()
         TpuSession._active = self

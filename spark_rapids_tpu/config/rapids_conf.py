@@ -208,6 +208,24 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     lambda v: None if v in ("none", "zrle", "lz4", "zstd")
     else "unknown codec")
 
+DISTRIBUTED_ENABLED = conf(
+    "spark.rapids.sql.distributed.enabled", True,
+    "When the session holds a device mesh, offer every query plan to the "
+    "distributed planner (parallel/dist_planner.py) before the single-"
+    "process engine; unsupported plans fall back with the reason on "
+    "session.last_dist_explain (the planner-inserted exchange analog, "
+    "reference GpuShuffleExchangeExec.scala:120).", _to_bool)
+
+DISTRIBUTED_NUM_SHARDS = conf(
+    "spark.rapids.sql.distributed.numShards", 0,
+    "Build an N-device mesh at session start and run supported queries "
+    "distributed (0 = only when a Mesh is passed to TpuSession "
+    "directly). N devices must already be visible to jax — real chips, "
+    "or virtual CPU devices which require XLA_FLAGS="
+    "--xla_force_host_platform_device_count=N to be set BEFORE jax "
+    "initializes; session construction raises otherwise.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 SHUFFLE_TRANSPORT_ENABLED = conf(
     "spark.rapids.shuffle.transport.enabled", True,
     "Use the ICI all-to-all collective exchange when executing on a device "

@@ -349,15 +349,31 @@ class CpuFallbackExec(TpuExec):
             gcols = {}
             for e in node.group_exprs:
                 gcols[e.name] = _eval_pandas(e, df)
+            # non-bare outputs (sum(a)*2, sum(a)/sum(b)...): compute the
+            # bare aggregates first, then evaluate the result expression
+            # over the aggregated frame (the planner's resultExpressions
+            # split, mirrored host-side)
+            from spark_rapids_tpu.ops.expressions import UnresolvedColumn
             aggs = []
+            result_exprs = []  # per output: None (bare) or rewritten expr
+
+            def extract(e):
+                if isinstance(e, AggregateExpression):
+                    name = f"_a{len(aggs)}"
+                    aggs.append((name, e.func))
+                    return UnresolvedColumn(name)
+                if not e.children:
+                    return e
+                return e.with_children([extract(c) for c in e.children])
+
             for e in node.agg_exprs:
                 name = e.name
                 inner = e.children[0] if isinstance(e, _Alias) else e
-                if not isinstance(inner, AggregateExpression):
-                    raise NotImplementedError(
-                        "CPU fallback aggregate output must be a bare "
-                        "aggregate")
-                aggs.append((name, inner.func))
+                if isinstance(inner, AggregateExpression):
+                    aggs.append((name, inner.func))
+                    result_exprs.append(None)
+                else:
+                    result_exprs.append((name, extract(inner)))
 
             def apply_aggs(sub: pd.DataFrame) -> dict:
                 row = {}
@@ -399,11 +415,22 @@ class CpuFallbackExec(TpuExec):
                     row = dict(zip(gcols, key))
                     row.update(apply_aggs(sub))
                     rows.append(row)
-                out = pd.DataFrame(rows,
-                                   columns=[n for n, _ in node.schema])
+                agg_frame = pd.DataFrame(
+                    rows, columns=list(gcols) + [n for n, _ in aggs])
             else:
-                out = pd.DataFrame([apply_aggs(df)],
-                                   columns=[n for n, _ in node.schema])
+                agg_frame = pd.DataFrame([apply_aggs(df)])
+            # evaluate non-bare result expressions over the agg frame
+            out_cols = {}
+            agg_names = [e.name for e in node.agg_exprs]
+            for name in gcols:
+                out_cols[name] = agg_frame[name]
+            for name, spec in zip(agg_names, result_exprs):
+                if spec is None:
+                    out_cols[name] = agg_frame[name]
+                else:
+                    out_cols[name] = _eval_pandas(spec[1], agg_frame)
+            out = pd.DataFrame(out_cols,
+                               columns=[n for n, _ in node.schema])
         elif isinstance(node, L.Generate):
             df = self._child_pandas(0)
             arrs = _eval_pandas(node.generator, df)

@@ -168,6 +168,33 @@ def rank_encode(col: Column) -> np.ndarray:
     return inverse.astype(np.int32)
 
 
+def ordered_dict_encode(col: Column
+                        ) -> Tuple[np.ndarray, List[str]]:
+    """(codes int64, sorted distinct values): ORDER-PRESERVING dictionary
+    encode of the whole column — code order == Spark string order — so
+    the distributed planner can group, sort, min/max, and compare codes
+    on device and decode at collect.  Null rows get code 0; callers keep
+    the validity mask."""
+    n = col.nrows
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), []
+    enc = _arrow_dictionary(col)
+    if enc is not None:
+        import pyarrow.compute as pc
+        inverse, dictionary = enc
+        k = len(dictionary)
+        if k == 0:
+            return np.zeros(n, dtype=np.int64), []
+        order = np.asarray(pc.sort_indices(dictionary))
+        rank = np.empty(k, dtype=np.int64)
+        rank[order] = np.arange(k, dtype=np.int64)
+        return rank[inverse], dictionary.take(order).to_pylist()
+    mat, _ = row_byte_matrix(col)
+    uniq, inverse = _unique_rows(mat)
+    return (inverse.astype(np.int64),
+            [_unique_bytes(u).decode("utf-8") for u in uniq])
+
+
 def dict_encode_stable(col: Column, codes: Dict[Optional[str], int],
                        values: List[Optional[str]],
                        null_code: Optional[int] = None) -> np.ndarray:

@@ -1,0 +1,853 @@
+"""Distributed query planner: lower logical plans onto the device mesh.
+
+This is the piece that turns ``session.sql("...")`` into an SPMD program:
+when the session holds a ``jax.sharding.Mesh``, every query's logical
+plan is first offered to this planner; a fully-supported plan executes
+as compiled shard_map pipelines over the mesh (the reference's
+planner-inserted exchange — ``GpuShuffleExchangeExec.scala:120-199``,
+``RapidsShuffleInternalManagerBase.scala:114-127`` — SURVEY.md section
+2.5), anything else falls back to the single-process engine with the
+reason recorded on ``session.last_dist_explain``.
+
+Design (TPU-first, whole-stage SPMD):
+
+* A query executes as a chain of **ShardedFrame** transforms — every
+  column is one leading-axis-sharded array ``[nshards * capacity]``
+  plus a per-shard row-count vector.  Static shapes per stage; the only
+  host syncs are the adaptive phase boundaries (histogram -> slot
+  sizing) inside aggregate/join/sort.
+* **Strings dictionary-encode at the scan** with ORDER-PRESERVING codes
+  (``ops.dictionary.ordered_dict_encode``): group-by, sort, min/max and
+  literal comparisons all run on int64 codes on device; values decode at
+  collect.  Comparisons against string literals lower to code-space
+  comparisons via binary search in the sorted dictionary.
+* Aggregates/joins/sorts wrap the SPMD kernels in
+  ``parallel/distributed.py`` / ``parallel/distsort.py``.
+* The planner is an **eager executor with a dry mode**: the same
+  recursion first runs with ``dry=True`` (schemas and empty
+  dictionaries, no kernels, no data) as the support pre-flight, so an
+  unsupported query falls back before any scan runs; the second pass
+  executes for real.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import predicates as preds
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, ColVal, EmitContext, Expression, Literal)
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import AggregateExpression
+
+
+class NotDistributable(Exception):
+    """Plan (or expression) cannot lower onto the mesh; single-process
+    fallback with this reason."""
+
+
+class ShardedFrame:
+    """Columns as leading-axis sharded device arrays + per-shard counts.
+
+    ``cols``: [(values, validity)] each ``[nshards * capacity]``;
+    ``nrows``: int32 ``[nshards]``; ``enc``: ordinal -> sorted dictionary
+    values for string columns travelling as int64 codes.  In dry mode
+    (the support pre-flight) ``cols``/``nrows`` are None and ``enc``
+    maps string ordinals to empty dictionaries."""
+
+    def __init__(self, mesh, names: List[str], log_dtypes: List[DataType],
+                 cols, nrows, enc: Dict[int, List[Optional[str]]]):
+        self.mesh = mesh
+        self.names = names
+        self.log_dtypes = log_dtypes
+        self.cols = cols
+        self.nrows = nrows
+        self.enc = enc
+
+    @property
+    def dry(self) -> bool:
+        return self.cols is None
+
+    @property
+    def phys_dtypes(self) -> List[DataType]:
+        return [_phys(dt) for dt in self.log_dtypes]
+
+    @property
+    def nshards(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def capacity(self) -> int:
+        return int(self.cols[0][0].shape[0]) // self.nshards if self.cols \
+            else 0
+
+    @property
+    def schema(self) -> List[Tuple[str, DataType]]:
+        return list(zip(self.names, self.log_dtypes))
+
+    def replace(self, **kw) -> "ShardedFrame":
+        args = dict(mesh=self.mesh, names=self.names,
+                    log_dtypes=self.log_dtypes, cols=self.cols,
+                    nrows=self.nrows, enc=self.enc)
+        args.update(kw)
+        return ShardedFrame(**args)
+
+
+def _phys(dt: DataType) -> DataType:
+    return dts.INT64 if dt.is_string else dt
+
+
+# --------------------------------------------------- expression lowering --
+
+_CMP = (preds.EqualTo, preds.LessThan, preds.LessThanOrEqual,
+        preds.GreaterThan, preds.GreaterThanOrEqual)
+
+
+class ExprLowering:
+    """Rewrite a bound expression for the encoded physical frame:
+    references to string columns become int64 code references, and
+    comparisons against string literals become code-space comparisons
+    via binary search in the (sorted) dictionary.  With empty
+    dictionaries (dry mode) the rewrite still type-checks — codes just
+    come out as never-matching sentinels."""
+
+    def __init__(self, enc: Dict[int, List[Optional[str]]]):
+        self.enc = enc
+
+    def lower(self, e: Expression) -> Expression:
+        if isinstance(e, Alias):
+            return Alias(self.lower(e.children[0]), e.alias)
+        if isinstance(e, BoundReference):
+            if e.ordinal in self.enc:
+                return BoundReference(e.ordinal, dts.INT64, name=e.name,
+                                      nullable=e.nullable)
+            if e.dtype.is_string or e.dtype.has_offsets or e.dtype.is_nested:
+                raise NotDistributable(
+                    f"column {e.name!r} of type {e.dtype} has no encoded "
+                    "device representation on the mesh")
+            return e
+        if isinstance(e, _CMP) and (e.children[0].dtype.is_string or
+                                    e.children[1].dtype.is_string):
+            return self._lower_cmp(e)
+        if isinstance(e, preds.In) and e.children[0].dtype.is_string:
+            return self._lower_in(e)
+        if isinstance(e, (preds.IsNull, preds.IsNotNull)) and \
+                e.children[0].dtype.is_string:
+            return type(e)(self.lower(e.children[0]))
+        if isinstance(e, AggregateExpression):
+            return self.lower_agg(e)
+        for c in e.children:
+            if c.dtype.is_string:
+                raise NotDistributable(
+                    f"{type(e).__name__} over string operands has no "
+                    "code-space lowering (only =, <, <=, >, >=, IN, "
+                    "IS NULL against literals)")
+        if e.dtype.is_string:
+            raise NotDistributable(
+                f"{type(e).__name__} produces strings; string-producing "
+                "expressions do not run distributed")
+        if not e.children:
+            return e
+        return e.with_children([self.lower(c) for c in e.children])
+
+    def lower_agg(self, e: AggregateExpression) -> AggregateExpression:
+        import copy
+        from spark_rapids_tpu.ops import aggregates as agg
+        func = e.func
+        if func.child is None:
+            return e
+        if func.child.dtype.is_string and not isinstance(
+                func, (agg.Min, agg.Max, agg.First, agg.Last)):
+            raise NotDistributable(
+                f"aggregate {func.name} over strings not supported on "
+                "the mesh (only min/max/first/last are order/identity "
+                "preserving under dictionary codes)")
+        f2 = copy.copy(func)
+        f2.child = self.lower(func.child)
+        return AggregateExpression(f2)
+
+    def encoded_ref(self, e: Expression):
+        """The encoded BoundReference behind e (through one Alias)."""
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, BoundReference) and inner.ordinal in self.enc:
+            return inner
+        return None
+
+    def _ref_and_literal(self, e):
+        l, r = e.children
+        if isinstance(r, Literal) and not isinstance(l, Literal):
+            return l, r, False
+        if isinstance(l, Literal) and not isinstance(r, Literal):
+            return r, l, True
+        return None
+
+    def _lower_cmp(self, e):
+        pair = self._ref_and_literal(e)
+        ref = self.encoded_ref(pair[0]) if pair else None
+        if pair is None or ref is None or \
+                not isinstance(pair[1].value, str):
+            raise NotDistributable(
+                f"string comparison {e} is not (encoded column vs "
+                "literal); no code-space lowering")
+        _, lit, flipped = pair
+        values = [v for v in self.enc[ref.ordinal] if v is not None]
+        codes = BoundReference(ref.ordinal, dts.INT64, name=ref.name,
+                               nullable=ref.nullable)
+        cls = type(e)
+        if flipped:  # lit OP ref  ->  ref OP' lit
+            cls = {preds.LessThan: preds.GreaterThan,
+                   preds.LessThanOrEqual: preds.GreaterThanOrEqual,
+                   preds.GreaterThan: preds.LessThan,
+                   preds.GreaterThanOrEqual: preds.LessThanOrEqual,
+                   preds.EqualTo: preds.EqualTo}[cls]
+        s = lit.value
+        if cls is preds.EqualTo:
+            i = bisect.bisect_left(values, s)
+            code = i if i < len(values) and values[i] == s else -1
+            return preds.EqualTo(codes, Literal(np.int64(code), dts.INT64))
+        lo = bisect.bisect_left(values, s)
+        hi = bisect.bisect_right(values, s)
+        if cls is preds.LessThan:        # code < first index >= s
+            return preds.LessThan(codes, Literal(np.int64(lo), dts.INT64))
+        if cls is preds.LessThanOrEqual:  # code < first index > s
+            return preds.LessThan(codes, Literal(np.int64(hi), dts.INT64))
+        if cls is preds.GreaterThan:
+            return preds.GreaterThanOrEqual(
+                codes, Literal(np.int64(hi), dts.INT64))
+        return preds.GreaterThanOrEqual(
+            codes, Literal(np.int64(lo), dts.INT64))
+
+    def _lower_in(self, e: preds.In):
+        ref = self.encoded_ref(e.children[0])
+        opts = e.children[1:]
+        if ref is None or not all(
+                isinstance(o, Literal) and isinstance(o.value, str)
+                for o in opts):
+            raise NotDistributable(
+                "string IN is only supported as encoded column IN "
+                "(literals...) on the mesh")
+        values = [v for v in self.enc[ref.ordinal] if v is not None]
+        codes = BoundReference(ref.ordinal, dts.INT64, name=ref.name,
+                               nullable=ref.nullable)
+        hits = []
+        for o in opts:
+            i = bisect.bisect_left(values, o.value)
+            if i < len(values) and values[i] == o.value:
+                hits.append(Literal(np.int64(i), dts.INT64))
+        if not hits:
+            hits = [Literal(np.int64(-1), dts.INT64)]
+        return preds.In(codes, hits)
+
+
+def _check_supported(exprs: Sequence[Expression], conf) -> None:
+    """Run the single-process support tagging over the LOWERED (numeric)
+    expressions so per-op disables and TypeSig checks apply on the mesh
+    too (RapidsMeta tagging, reused)."""
+    from spark_rapids_tpu.plan.overrides import ExprMeta, _deep_reasons
+    for e in exprs:
+        em = ExprMeta(e, conf)
+        em.tag()
+        if not em.can_replace:
+            raise NotDistributable(
+                f"expression {type(e).__name__}: "
+                + "; ".join(_deep_reasons(em)))
+
+
+# ------------------------------------------------------- kernel wrappers --
+
+def _mesh_sig(mesh):
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(str(d) for d in mesh.devices.flat))
+
+
+def _ones_like_validity(c: ColVal, cap: int):
+    return c.validity if c.validity is not None else \
+        jnp.ones(cap, dtype=jnp.bool_)
+
+
+def _run_project(f: ShardedFrame, exprs: Sequence[Expression], tag: str):
+    """Compiled shard_map projection; returns the output column pairs."""
+    import jax
+    from spark_rapids_tpu.ops.aggregates import widen_colval
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+    phys = f.phys_dtypes
+
+    def step(flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, phys)]
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        outs = [widen_colval(e.emit(ctx), cap) for e in exprs]
+        return tuple((c.values, _ones_like_validity(c, cap))
+                     for c in outs)
+
+    sig = (tag, _mesh_sig(f.mesh), tuple(dt.name for dt in phys),
+           tuple(e.cache_key() for e in exprs))
+    axis = f.mesh.axis_names[0]
+    return cached_jit(sig, lambda: jax.shard_map(
+        step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
+
+
+def _run_filter(f: ShardedFrame, cond: Expression):
+    import jax
+    from spark_rapids_tpu.ops import selection
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+    phys = f.phys_dtypes
+
+    def step(flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, phys)]
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        pred = cond.emit(ctx)
+        keep = pred.values
+        if pred.validity is not None:
+            keep = jnp.logical_and(keep, pred.validity)
+        keep = jnp.logical_and(keep, ctx.row_mask())
+        out, n = selection.compact(cols, keep)
+        return (tuple((c.values, _ones_like_validity(c, cap))
+                      for c in out),
+                n.astype(jnp.int32)[None])
+
+    sig = ("dplan_filter", _mesh_sig(f.mesh),
+           tuple(dt.name for dt in phys), cond.cache_key())
+    axis = f.mesh.axis_names[0]
+    return cached_jit(sig, lambda: jax.shard_map(
+        step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
+
+
+def _append_key_cols(f: ShardedFrame, key_exprs) -> ShardedFrame:
+    """Materialize key expressions as trailing columns (one compiled
+    projection), so join kernels take plain column indices."""
+    key_cols = _run_project(f, list(key_exprs), "dplan_keys")
+    return ShardedFrame(
+        f.mesh, f.names + [f"__k{i}" for i in range(len(key_exprs))],
+        f.log_dtypes + [e.dtype for e in key_exprs],
+        list(f.cols) + list(key_cols), f.nrows, f.enc)
+
+
+# ---------------------------------------------------------------- planner --
+
+class DistPlanner:
+    """Eager recursive executor with a dry pre-flight mode."""
+
+    # global cap on a distributed join's output buffer (rows across all
+    # shards); beyond this the planner falls back rather than allocate
+    MAX_OUT_ROWS = 1 << 27
+
+    def __init__(self, session, mesh):
+        self.session = session
+        self.mesh = mesh
+        self.conf = session.conf
+
+    def _emit_stats(self, op: str, stats, **extra) -> None:
+        ev = getattr(self.session, "events", None)
+        if ev is not None and ev.enabled and stats:
+            clean = {k: v.tolist() if hasattr(v, "tolist") else v
+                     for k, v in stats.items()}
+            ev.emit("DistExchange", op=op, stats=clean, **extra)
+
+    # -- recursion --------------------------------------------------------
+    def run(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
+        if isinstance(plan, (L.InMemoryRelation, L.FileRelation, L.Range)):
+            return self._scan(plan, dry)
+        if isinstance(plan, L.Filter):
+            return self._filter(plan, dry)
+        if isinstance(plan, L.Project):
+            return self._project(plan, dry)
+        if isinstance(plan, L.Aggregate):
+            return self._aggregate(plan, dry)
+        if isinstance(plan, L.Join):
+            return self._join(plan, dry)
+        if isinstance(plan, L.Sort):
+            return self._sort(plan, dry)
+        if isinstance(plan, L.Limit):
+            if isinstance(plan.child, L.Sort):
+                return self._topn(plan, dry)
+            return self._limit(plan, dry)
+        raise NotDistributable(
+            f"{type(plan).__name__} has no distributed lowering")
+
+    # -- scan -------------------------------------------------------------
+    def _scan(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
+        schema = list(plan.schema)
+        for name, dt in schema:
+            if not dt.is_string and (dt.has_offsets or dt.is_nested):
+                raise NotDistributable(
+                    f"scan column {name!r} of type {dt} not supported "
+                    "on the mesh")
+        names = [n for n, _ in schema]
+        log_dtypes = [dt for _, dt in schema]
+        if dry:
+            enc = {i: [] for i, dt in enumerate(log_dtypes)
+                   if dt.is_string}
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                enc)
+        from spark_rapids_tpu.ops.concat import concat_batches
+        from spark_rapids_tpu.ops.dictionary import ordered_dict_encode
+        exec_plan = self.session.plan(plan)
+        batches = list(exec_plan.execute())
+        nshards = self.mesh.devices.size
+        merged = concat_batches(batches) if batches else None
+        total = merged.nrows if merged is not None else 0
+        cap = bucket_capacity(max((total + nshards - 1) // nshards, 1),
+                              minimum=8)
+        base, rem = divmod(total, nshards)
+        counts = np.array([base + (1 if i < rem else 0)
+                           for i in range(nshards)], dtype=np.int32)
+        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        cols, enc = [], {}
+        for i, (name, dt) in enumerate(schema):
+            if merged is None:
+                host = np.zeros(0, dtype=_phys(dt).storage)
+                valid = np.ones(0, dtype=bool)
+                if dt.is_string:
+                    enc[i] = []
+            else:
+                col = merged.columns[name]
+                valid = col.validity_numpy()
+                if dt.is_string:
+                    host, enc[i] = ordered_dict_encode(col)
+                else:
+                    host = np.asarray(col.data[:total])
+            vbuf = np.zeros((nshards, cap),
+                            dtype=host.dtype if host.size
+                            else _phys(dt).storage)
+            mbuf = np.zeros((nshards, cap), dtype=bool)
+            for s in range(nshards):
+                sl = slice(offsets[s], offsets[s] + counts[s])
+                vbuf[s, :counts[s]] = host[sl]
+                mbuf[s, :counts[s]] = valid[sl]
+            cols.append((jnp.asarray(vbuf.reshape(-1)),
+                         jnp.asarray(mbuf.reshape(-1))))
+        return ShardedFrame(self.mesh, names, log_dtypes, cols,
+                            jnp.asarray(counts), enc)
+
+    # -- filter / project -------------------------------------------------
+    def _filter(self, plan: L.Filter, dry: bool) -> ShardedFrame:
+        f = self.run(plan.child, dry)
+        low = ExprLowering(f.enc)
+        cond = low.lower(plan.condition)
+        _check_supported([cond], self.conf)
+        if dry:
+            return f
+        out_cols, nrows = _run_filter(f, cond)
+        return f.replace(cols=list(out_cols), nrows=nrows)
+
+    def _project(self, plan: L.Project, dry: bool) -> ShardedFrame:
+        f = self.run(plan.child, dry)
+        low = ExprLowering(f.enc)
+        exprs, enc = [], {}
+        for i, e in enumerate(plan.exprs):
+            exprs.append(low.lower(e))
+            src = low.encoded_ref(e)
+            if src is not None:
+                enc[i] = f.enc[src.ordinal]
+        _check_supported(exprs, self.conf)
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        if dry:
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                enc)
+        out_cols = _run_project(f, exprs, "dplan_project")
+        return ShardedFrame(self.mesh, names, log_dtypes, list(out_cols),
+                            f.nrows, enc)
+
+    # -- aggregate --------------------------------------------------------
+    def _aggregate(self, plan: L.Aggregate, dry: bool) -> ShardedFrame:
+        from spark_rapids_tpu.ops import aggregates as agg
+        f = self.run(plan.child, dry)
+        low = ExprLowering(f.enc)
+        group_exprs = [low.lower(e) for e in plan.group_exprs]
+        nkeys = len(group_exprs)
+
+        # split agg outputs into bare aggregate calls + result exprs
+        # (the _plan_aggregate split, Catalyst's resultExpressions)
+        agg_list: List[AggregateExpression] = []
+
+        def extract(e):
+            if isinstance(e, AggregateExpression):
+                le = low.lower_agg(e)
+                idx = len(agg_list)
+                agg_list.append(le)
+                return BoundReference(nkeys + idx, le.dtype,
+                                      name=f"_a{idx}",
+                                      nullable=le.nullable)
+            if not e.children:
+                return low.lower(e)
+            return e.with_children([extract(c) for c in e.children])
+
+        out_named = []
+        trivial = True
+        for e in plan.agg_exprs:
+            inner = e.children[0] if isinstance(e, Alias) else e
+            rewritten = extract(inner)
+            if not isinstance(inner, AggregateExpression):
+                trivial = False
+            out_named.append((e.name, rewritten))
+        _check_supported(group_exprs, self.conf)
+        _check_supported(agg_list, self.conf)
+
+        # enc propagation: bare encoded group keys and min/max/first/last
+        # over bare encoded refs keep their dictionaries
+        agg_enc = {}
+        for i, orig in enumerate(plan.group_exprs):
+            src = low.encoded_ref(orig)
+            if src is not None:
+                agg_enc[i] = f.enc[src.ordinal]
+        for idx, a in enumerate(agg_list):
+            if isinstance(a.func, (agg.Min, agg.Max, agg.First, agg.Last)):
+                ch = a.func.child
+                if isinstance(ch, BoundReference) and \
+                        ch.ordinal in f.enc:
+                    agg_enc[nkeys + idx] = f.enc[ch.ordinal]
+        key_schema = [(e.name, e.dtype) for e in plan.group_exprs]
+        agg_schema = key_schema + [(f"_a{i}", a.dtype)
+                                   for i, a in enumerate(agg_list)]
+
+        if dry:
+            agg_frame = ShardedFrame(
+                self.mesh, [n for n, _ in agg_schema],
+                [dt for _, dt in agg_schema], None, None, agg_enc)
+        else:
+            from spark_rapids_tpu.parallel.distributed import (
+                DistributedAggregate)
+            dist = DistributedAggregate(
+                self.mesh, in_dtypes=f.phys_dtypes,
+                group_exprs=group_exprs,
+                funcs=[a.func for a in agg_list])
+            outs = dist([(v, val, None) for v, val in f.cols], f.nrows)
+            self._emit_stats("aggregate", dist.last_stats)
+            if not group_exprs:
+                # grand totals are replicated (psum) on every shard;
+                # count the single output row on shard 0 only
+                nrows = np.zeros(f.nshards, dtype=np.int32)
+                nrows[0] = 1
+                nrows = jnp.asarray(nrows)
+            else:
+                nrows = outs[0][2].reshape(-1)
+            agg_frame = ShardedFrame(
+                self.mesh, [n for n, _ in agg_schema],
+                [dt for _, dt in agg_schema],
+                [(v, val) for v, val, _ in outs], nrows, agg_enc)
+        if trivial:
+            # bare aggregates: rename outputs to the requested names
+            return agg_frame.replace(names=[n for n, _ in plan.schema])
+        # non-trivial outputs: project keys + result expressions
+        proj = [BoundReference(i, dt, name=n)
+                for i, (n, dt) in enumerate(agg_schema[:nkeys])]
+        proj += [Alias(rewritten, name) for name, rewritten in out_named]
+        # dictionaries follow bare references through the projection
+        # (group keys AND encoded min/max aggregate outputs)
+        agg_low = ExprLowering(agg_enc)
+        penc = {}
+        for i, e in enumerate(proj):
+            src = agg_low.encoded_ref(e)
+            if src is not None:
+                penc[i] = agg_enc[src.ordinal]
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        if dry:
+            _check_supported(proj, self.conf)
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                penc)
+        out_cols = _run_project(agg_frame, proj, "dplan_aggproj")
+        return ShardedFrame(self.mesh, names, log_dtypes, list(out_cols),
+                            agg_frame.nrows, penc)
+
+    # -- join -------------------------------------------------------------
+    def _join(self, plan: L.Join, dry: bool) -> ShardedFrame:
+        if not plan.left_keys:
+            raise NotDistributable(
+                "cross / pure-residual joins have no distributed "
+                "lowering")
+        if plan.condition is not None and plan.join_type != "inner":
+            raise NotDistributable(
+                "residual conditions only distribute for inner joins")
+        if plan.condition is not None and plan.using:
+            raise NotDistributable(
+                "residual conditions with USING joins not supported")
+        for lk, rk in zip(plan.left_keys, plan.right_keys):
+            if lk.dtype.is_string or rk.dtype.is_string:
+                raise NotDistributable(
+                    "string join keys not yet supported on the mesh "
+                    "(per-table dictionaries do not align)")
+        left = self.run(plan.left, dry)
+        right = self.run(plan.right, dry)
+        lkeys = [ExprLowering(left.enc).lower(e) for e in plan.left_keys]
+        rkeys = [ExprLowering(right.enc).lower(e) for e in plan.right_keys]
+        _check_supported(lkeys + rkeys, self.conf)
+
+        swapped = plan.join_type == "right"
+        join_type = "left" if swapped else plan.join_type
+        if swapped:
+            probe, build = right, left
+            probe_keys, build_keys = rkeys, lkeys
+        else:
+            probe, build = left, right
+            probe_keys, build_keys = lkeys, rkeys
+
+        # output layout before reorder: probe cols + build cols (or probe
+        # only for semi/anti); rebuild left+right then Join.schema order
+        if plan.join_type in ("semi", "anti"):
+            out_names = list(left.names)
+            out_dtypes = list(left.log_dtypes)
+            out_enc = dict(left.enc)
+        else:
+            out_names = list(left.names) + list(right.names)
+            out_dtypes = list(left.log_dtypes) + list(right.log_dtypes)
+            out_enc = dict(left.enc)
+            for o, d in right.enc.items():
+                out_enc[len(left.names) + o] = d
+
+        cond = None
+        if plan.condition is not None:
+            cond = ExprLowering(out_enc).lower(plan.condition)
+            _check_supported([cond], self.conf)
+
+        # USING joins dedup the key columns; the PRESERVED side supplies
+        # the key value (right for right joins, coalesce for full) —
+        # mirrors TpuHashJoinExec's stitch
+        proj = None
+        if plan.using and plan.join_type not in ("semi", "anti"):
+            keyset = set(plan.using)
+            nleft = len(left.names)
+            proj, penc = [], {}
+
+            def ref(i):
+                return BoundReference(i, out_dtypes[i], name=out_names[i])
+
+            for n in left.names:
+                if n not in keyset:
+                    continue
+                li = left.names.index(n)
+                ri = nleft + right.names.index(n)
+                if plan.join_type == "full":
+                    proj.append(Alias(preds.Coalesce(ref(li), ref(ri)), n))
+                elif swapped:
+                    proj.append(Alias(ref(ri), n))
+                else:
+                    proj.append(ref(li))
+            for i, n in enumerate(left.names):
+                if n not in keyset:
+                    if i in out_enc:
+                        penc[len(proj)] = out_enc[i]
+                    proj.append(ref(i))
+            for i, n in enumerate(right.names):
+                if n not in keyset:
+                    if nleft + i in out_enc:
+                        penc[len(proj)] = out_enc[nleft + i]
+                    proj.append(ref(nleft + i))
+            proj_schema = [(e.name, e.dtype) for e in proj]
+
+        if dry:
+            if proj is not None:
+                return ShardedFrame(self.mesh,
+                                    [n for n, _ in proj_schema],
+                                    [dt for _, dt in proj_schema],
+                                    None, None, penc)
+            return ShardedFrame(self.mesh, out_names, out_dtypes, None,
+                                None, out_enc)
+
+        from spark_rapids_tpu.parallel.distributed import (
+            DistributedHashJoin)
+        probe_m = _append_key_cols(probe, probe_keys)
+        build_m = _append_key_cols(build, build_keys)
+        pk_idx = list(range(len(probe.names),
+                            len(probe.names) + len(probe_keys)))
+        bk_idx = list(range(len(build.names),
+                            len(build.names) + len(build_keys)))
+        probe_cap = probe_m.capacity
+        out_factor = 1
+        while True:
+            join = DistributedHashJoin(
+                self.mesh, probe_dtypes=probe_m.phys_dtypes,
+                build_dtypes=build_m.phys_dtypes,
+                probe_key_idx=pk_idx, build_key_idx=bk_idx,
+                join_type=join_type, out_factor=out_factor)
+            flat, n_out, total = join(
+                probe_m.cols, probe_m.nrows, build_m.cols, build_m.nrows)
+            if bool(np.all(np.asarray(total) <= np.asarray(n_out))):
+                break
+            # size the retry from the observed truncation (the reference
+            # instead splits output batches, JoinGatherer.scala:36-60);
+            # out_cap is relative to the (possibly tiny) probe capacity,
+            # so the factor itself may legitimately grow large
+            need = int(np.asarray(total).max())
+            next_factor = out_factor * 2
+            while next_factor * probe_cap < need:
+                next_factor *= 2  # power-of-two: bounded compile cache
+            if (next_factor * probe_cap * self.mesh.devices.size
+                    > self.MAX_OUT_ROWS):
+                raise NotDistributable(
+                    f"join output ({need} rows/shard) exceeds the "
+                    f"{self.MAX_OUT_ROWS}-row distributed output cap")
+            out_factor = next_factor
+        self._emit_stats(f"join:{plan.join_type}", join.last_stats,
+                         out_factor=out_factor)
+        n_probe = len(probe.names)
+        n_build = len(build.names)
+        if plan.join_type in ("semi", "anti"):
+            cols = list(flat[:n_probe])
+        else:
+            probe_cols = list(flat[:n_probe])
+            build_cols = list(flat[len(probe_m.names):
+                                   len(probe_m.names) + n_build])
+            if swapped:
+                cols = build_cols + probe_cols
+            else:
+                cols = probe_cols + build_cols
+        frame = ShardedFrame(self.mesh, out_names, out_dtypes, cols,
+                             n_out.reshape(-1), out_enc)
+        if cond is not None:
+            out_cols, nrows = _run_filter(frame, cond)
+            frame = frame.replace(cols=list(out_cols),
+                                  nrows=nrows.reshape(-1))
+        if proj is not None:
+            out_cols = _run_project(frame, proj, "dplan_joinproj")
+            frame = ShardedFrame(self.mesh, [n for n, _ in proj_schema],
+                                 [dt for _, dt in proj_schema],
+                                 list(out_cols), frame.nrows, penc)
+        return frame
+
+    # -- sort / limit / topn ---------------------------------------------
+    def _lower_orders(self, orders, f: ShardedFrame):
+        low = ExprLowering(f.enc)
+        keys = [low.lower(e) for e, _, _ in orders]
+        _check_supported(keys, self.conf)
+        desc = [d for _, d, _ in orders]
+        nf = [n for _, _, n in orders]
+        return keys, desc, nf
+
+    def _sort(self, plan: L.Sort, dry: bool) -> ShardedFrame:
+        from spark_rapids_tpu.parallel.distsort import DistributedSort
+        f = self.run(plan.child, dry)
+        keys, desc, nf = self._lower_orders(plan.orders, f)
+        if dry:
+            return f
+        dist = DistributedSort(self.mesh, f.phys_dtypes, keys, desc, nf)
+        out_cols, nrows = dist(f.cols, f.nrows)
+        self._emit_stats("sort", dist.last_stats)
+        return f.replace(cols=list(out_cols), nrows=nrows.reshape(-1))
+
+    def _limit(self, plan: L.Limit, dry: bool) -> ShardedFrame:
+        f = self.run(plan.child, dry)
+        if dry:
+            return f
+        counts = np.asarray(f.nrows).copy()
+        left = plan.n
+        for i in range(len(counts)):
+            take = min(int(counts[i]), left)
+            counts[i] = take
+            left -= take
+        return f.replace(nrows=jnp.asarray(counts.astype(np.int32)))
+
+    def _topn(self, plan: L.Limit, dry: bool) -> ShardedFrame:
+        from spark_rapids_tpu.parallel.distsort import (
+            DistributedTopN, host_order)
+        sort = plan.child
+        f = self.run(sort.child, dry)
+        keys, desc, nf = self._lower_orders(sort.orders, f)
+        if dry:
+            return f
+        dist = DistributedTopN(self.mesh, f.phys_dtypes, keys, desc, nf,
+                               plan.n)
+        flat, key_flat, nrows = dist(f.cols, f.nrows)
+        nshards = f.nshards
+        counts = np.asarray(nrows).reshape(-1)
+        cap = int(flat[0][0].shape[0]) // nshards
+
+        def host_rows(pair):
+            v = np.asarray(pair[0]).reshape(nshards, cap)
+            m = np.asarray(pair[1]).reshape(nshards, cap)
+            vs = np.concatenate([v[i, :counts[i]] for i in range(nshards)])
+            ms = np.concatenate([m[i, :counts[i]] for i in range(nshards)])
+            return vs, ms
+
+        hkeys = [host_rows(p) for p in key_flat]
+        order = host_order([v for v, _ in hkeys], [m for _, m in hkeys],
+                           desc, nf)[:plan.n]
+        n = len(order)
+        out_cap = bucket_capacity(max(n, 1), minimum=8)
+        cols = []
+        for pair in flat:
+            vs, ms = host_rows(pair)
+            vbuf = np.zeros(nshards * out_cap, dtype=vs.dtype)
+            mbuf = np.zeros(nshards * out_cap, dtype=bool)
+            vbuf[:n] = vs[order]
+            mbuf[:n] = ms[order]
+            cols.append((jnp.asarray(vbuf), jnp.asarray(mbuf)))
+        out_counts = np.zeros(nshards, dtype=np.int32)
+        out_counts[0] = n
+        return f.replace(cols=cols, nrows=jnp.asarray(out_counts))
+
+    # -- collect ----------------------------------------------------------
+    def collect(self, f: ShardedFrame) -> ColumnarBatch:
+        nshards = f.nshards
+        cap = f.capacity
+        counts = np.asarray(f.nrows).reshape(-1)
+        total = int(counts.sum())
+        out = {}
+        for i, ((name, dt), (v, m)) in enumerate(zip(f.schema, f.cols)):
+            vals = np.asarray(v).reshape(nshards, cap)
+            mask = np.asarray(m).reshape(nshards, cap)
+            if total:
+                vs = np.concatenate(
+                    [vals[s, :counts[s]] for s in range(nshards)])
+                ms = np.concatenate(
+                    [mask[s, :counts[s]] for s in range(nshards)])
+            else:
+                vs = np.zeros(0, dtype=vals.dtype)
+                ms = np.zeros(0, dtype=bool)
+            if i in f.enc:
+                values = f.enc[i]
+                decoded = [values[int(c)] if ok else None
+                           for c, ok in zip(vs, ms)]
+                out[name] = Column.from_strings(decoded)
+            else:
+                storage = np.dtype(dt.storage)
+                out[name] = Column.from_numpy(
+                    vs.astype(storage, copy=False), dtype=dt,
+                    validity=None if bool(ms.all()) else ms)
+        return ColumnarBatch(out, total)
+
+
+def try_distributed(session, plan: L.LogicalPlan):
+    """Entry point from DataFrame execution: returns a list of
+    ColumnarBatches when the plan ran on the mesh, else None (single-
+    process fallback; reason on ``session.last_dist_explain``)."""
+    mesh = getattr(session, "mesh", None)
+    if mesh is None:
+        return None
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if not session.conf.get(rc.DISTRIBUTED_ENABLED):
+        session.last_dist_explain = "distributed disabled by conf"
+        return None
+    planner = DistPlanner(session, mesh)
+    try:
+        planner.run(plan, dry=True)  # support pre-flight: no data moves
+        # data-dependent limits (e.g. join fan-out vs output capacity)
+        # can only surface while executing; they fall back too
+        batch = planner.collect(planner.run(plan, dry=False))
+    except NotDistributable as e:
+        session.last_dist_explain = f"fallback: {e}"
+        ev = getattr(session, "events", None)
+        if ev is not None and ev.enabled:
+            ev.emit("DistFallback", reason=str(e))
+        return None
+    session.last_dist_explain = "distributed"
+    return [batch]

@@ -235,6 +235,32 @@ def _merge_kind(update_kind: str) -> str:
             "first": "first", "last": "last"}[update_kind]
 
 
+def concat_prefixes(cols_a: Sequence[ColVal], n_a,
+                    cols_b: Sequence[ColVal], n_b):
+    """Merge two dense-prefix column lists into one of capacity
+    cap_a + cap_b: rows [0, n_a) from a, [n_a, n_a + n_b) from b, dead
+    padding after.  Shared by the skew-join build merge and the
+    full-outer unmatched-build append."""
+    cap_a = cols_a[0].values.shape[0]
+    cap_b = cols_b[0].values.shape[0]
+    cap = cap_a + cap_b
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    first = pos < n_a
+    ia = jnp.clip(pos, 0, cap_a - 1)
+    ib = jnp.clip(pos - n_a, 0, cap_b - 1)
+    out = []
+    for a, b in zip(cols_a, cols_b):
+        vals = jnp.where(first, a.values[ia], b.values[ib])
+        av = a.validity if a.validity is not None else \
+            jnp.ones(cap_a, dtype=jnp.bool_)
+        bv = b.validity if b.validity is not None else \
+            jnp.ones(cap_b, dtype=jnp.bool_)
+        valid = jnp.where(first, av[ia],
+                          jnp.where(pos < n_a + n_b, bv[ib], False))
+        out.append(ColVal(a.dtype, vals, valid))
+    return out, (n_a + n_b).astype(jnp.int32)
+
+
 def _v(o: ColVal):
     if o.validity is None:
         return jnp.ones_like(o.values, dtype=jnp.bool_)
@@ -293,8 +319,14 @@ class DistributedHashJoin:
         skew_min_rows = _conf_default(skew_min_rows, rc.SKEW_JOIN_MIN_ROWS)
         self.skew_enabled = _conf_default(skew_enabled,
                                           rc.SKEW_JOIN_ENABLED)
-        if join_type not in ("inner", "left"):
-            raise ValueError("distributed join supports inner/left")
+        if join_type not in ("inner", "left", "semi", "anti", "full"):
+            # right joins run as a planner-side probe/build swap into
+            # "left" + column reorder (GpuHashJoin does the same
+            # buildSide flip for RightOuter)
+            raise ValueError(
+                "distributed join supports inner/left/semi/anti/full "
+                f"(got {join_type!r}); lower right joins by swapping "
+                "sides")
         if strategy not in ("auto", "broadcast", "shuffle"):
             raise ValueError(f"unknown strategy {strategy}")
         self.mesh = mesh
@@ -434,26 +466,7 @@ class DistributedHashJoin:
                     for c in sk_cols]
                 b2, bn2 = all_gather_cols(sk_sliced, n_sk, self.axis,
                                           self.nshards)
-                # merge the two dense prefixes into one
-                c1 = b1[0].values.shape[0]
-                c2 = b2[0].values.shape[0]
-                pos = jnp.arange(c1 + c2, dtype=jnp.int32)
-                idx = jnp.where(
-                    pos < bn1, jnp.clip(pos, 0, c1 - 1),
-                    c1 + jnp.clip(pos - bn1, 0, c2 - 1))
-                merged = []
-                for x, y in zip(b1, b2):
-                    vals = jnp.concatenate([x.values, y.values])
-                    validity = None
-                    if x.validity is not None or y.validity is not None:
-                        xv = x.validity if x.validity is not None else \
-                            jnp.ones(c1, dtype=jnp.bool_)
-                        yv = y.validity if y.validity is not None else \
-                            jnp.ones(c2, dtype=jnp.bool_)
-                        validity = jnp.concatenate([xv, yv])
-                    merged.append(ColVal(x.dtype, vals, validity))
-                bn = bn1 + bn2
-                build = selection.gather(merged, idx, bn.astype(jnp.int32))
+                build, bn = concat_prefixes(b1, bn1, b2, bn2)
             else:
                 probe, pn = exchange(probe, ppids, pn, self.axis,
                                      self.nshards, slot=slots[0])
@@ -463,7 +476,25 @@ class DistributedHashJoin:
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
         m = J.join_match(bkeys, pkeys, jnp.int32(bn), jnp.int32(pn))
-        outer = self.join_type == "left"
+
+        if self.join_type in ("semi", "anti"):
+            # existence joins: a compaction of the probe side, no phase B
+            # (GpuHashJoin existence path); null-keyed probe rows never
+            # match, so they survive anti (Spark LeftAnti semantics)
+            p_cap = probe[0].values.shape[0]
+            live_p = jnp.arange(p_cap, dtype=jnp.int32) < pn
+            has = m["probe_count"] > 0
+            keep = jnp.logical_and(
+                has if self.join_type == "semi" else ~has, live_p)
+            out_cols, n_out = selection.compact(probe, keep)
+            flat = [(c.values,
+                     c.validity if c.validity is not None
+                     else jnp.ones(p_cap, dtype=jnp.bool_))
+                    for c in out_cols]
+            n_out = n_out.astype(jnp.int32)
+            return flat, n_out[None], n_out[None]
+
+        outer = self.join_type in ("left", "full")
         count, starts, ends, total = J.join_out_starts(
             m["probe_count"], jnp.int32(pn), outer)
         out_cap = max(in_probe_cap,
@@ -474,6 +505,27 @@ class DistributedHashJoin:
         n_out = jnp.minimum(total, out_cap).astype(jnp.int32)
         probe_out = selection.gather(probe, p, n_out)
         build_out = J.gather_build_side(build, brow, matched, n_out)
+
+        if self.join_type == "full":
+            # append build rows that matched nothing, with null probe
+            # columns (shuffle strategy only: each build row lives on
+            # exactly one shard, so the never-matched set partitions
+            # cleanly across shards)
+            b_cap = build[0].values.shape[0]
+            live_b = jnp.arange(b_cap, dtype=jnp.int32) < bn
+            un_cols, un_n = selection.compact(
+                build, jnp.logical_and(~m["build_matched"], live_b))
+            null_probe = [
+                ColVal(c.dtype, jnp.zeros(b_cap, dtype=c.values.dtype),
+                       jnp.zeros(b_cap, dtype=jnp.bool_))
+                for c in probe_out]
+            merged, n_full = concat_prefixes(
+                list(probe_out) + list(build_out), n_out,
+                null_probe + list(un_cols), un_n.astype(jnp.int32))
+            flat = [(c.values, c.validity) for c in merged]
+            return flat, n_full[None], (total.astype(jnp.int32) +
+                                        un_n.astype(jnp.int32))[None]
+
         flat = [(c.values,
                  c.validity if c.validity is not None
                  else jnp.ones(out_cap, dtype=jnp.bool_))
@@ -488,10 +540,15 @@ class DistributedHashJoin:
                  build_nrows_per_shard):
         """probe_flat/build_flat: [(values, validity)] with leading-axis
         sharded arrays; nrows arrays have one entry per shard.  Returns
-        (flat output cols [probe cols then build cols], nrows per shard,
-        unclamped match total per shard).  Any shard where total > nrows
-        was truncated at out_factor * capacity rows: the caller must
-        retry with a larger out_factor.
+        (flat output cols, nrows per shard, unclamped match total per
+        shard).  Output columns by join type: inner/left/full are probe
+        cols then build cols; semi/anti are probe cols ONLY (an
+        existence compaction).  Output capacity per shard is
+        probe_capacity * out_factor for inner/left, plus build_capacity
+        for full (the unmatched-build append), and probe_capacity for
+        semi/anti.  Any shard where total > nrows was truncated (the
+        probe-match region hit out_factor * capacity): the caller must
+        retry with a larger out_factor; semi/anti never truncate.
 
         ``strategy='auto'`` picks broadcast vs shuffled-hash from the
         build-side row stats (the reference's planner picks
@@ -506,6 +563,10 @@ class DistributedHashJoin:
             strategy = "broadcast" \
                 if total_build <= self.broadcast_threshold_rows else \
                 "shuffle"
+        if self.join_type == "full":
+            # a replicated build side would emit its never-matched rows
+            # once per shard; full outer must co-partition
+            strategy = "shuffle"
         slots = (None, None)
         skewed = ()
         stats = {"strategy": strategy, "build_rows": total_build}
@@ -526,7 +587,7 @@ class DistributedHashJoin:
                 int(d) for d in np.nonzero(
                     (dest_p > self.skew_factor * med)
                     & (dest_p > self.skew_min_rows))[0]) \
-                if self.skew_enabled else ()
+                if self.skew_enabled and self.join_type != "full" else ()
             if skewed:
                 sk = np.zeros(self.nshards, dtype=bool)
                 sk[list(skewed)] = True

@@ -1,0 +1,317 @@
+"""Distributed sort + TopN over the mesh: range partition, then local sort.
+
+The reference sorts distributed data by range-partitioning on sampled
+bounds and sorting each partition locally (GpuRangePartitioning.scala +
+GpuSortExec under a shuffle; SURVEY.md section 2.4 "Partitioning").  The
+TPU formulation runs three compiled shard_map programs with two host
+syncs, mirroring the adaptive two-phase shape of ``DistributedAggregate``:
+
+1. **sample** — each shard strided-samples up to k key rows; the host
+   all-gathers the (tiny) sample and picks ``nshards-1`` splitter rows by
+   sorting the sample in the query's total order (desc / nulls-first /
+   NaN-largest / -0.0 == 0.0, exactly the single-node kernel's order).
+2. **stats** — per-destination histogram of range-partition ids against
+   the splitters (sizes the all-to-all slot like the aggregate's
+   histogram pass).
+3. **final** — exchange rows to their range bucket and lexsort each
+   shard locally.  Concatenating shards in mesh order yields the total
+   order.
+
+Splitter values ride in as traced array arguments, so recompilation
+happens per (schema, slot) — not per data.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops import selection
+from spark_rapids_tpu.ops.aggregates import sort_permutation
+from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.parallel.shuffle import exchange, pick_slot
+
+
+def _norm_one(v):
+    """(primary, nan_flag): normalized comparable pieces for one column.
+    NaN sorts largest; -0.0 == 0.0; ints/bools pass through."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        nan = jnp.isnan(v)
+        f = jnp.where(v == 0.0, 0.0, v)
+        f = jnp.where(nan, 0.0, f)
+        return f, nan
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.int8)
+    return v, jnp.zeros(v.shape, dtype=jnp.bool_)
+
+
+def _cmp_one(c: ColVal, desc: bool, nulls_first: bool, sv, svalid):
+    """(lt, eq) of each row's key vs one splitter scalar, in the total
+    order for this sort key (desc flips lt, nulls order by nulls_first,
+    null == null)."""
+    f, nan = _norm_one(c.values)
+    sf, snan = _norm_one(sv)
+    lt = (nan < snan) | ((nan == snan) & (f < sf))
+    eq = (nan == snan) & (f == sf)
+    if desc:
+        lt = ~lt & ~eq
+    rv = c.validity if c.validity is not None else \
+        jnp.ones(f.shape, dtype=jnp.bool_)
+    null_lt = jnp.bool_(nulls_first)  # null vs non-null
+    lt = jnp.where(rv & svalid, lt,
+                   jnp.where(~rv & svalid, null_lt,
+                             jnp.where(rv & ~svalid, ~null_lt, False)))
+    eq = jnp.where(rv & svalid, eq, ~rv & ~svalid)
+    return lt, eq
+
+
+def range_pids(key_cols: Sequence[ColVal], descending: Sequence[bool],
+               nulls_first: Sequence[bool], spl_vals, spl_valid,
+               nshards: int) -> jnp.ndarray:
+    """Destination shard of each row: the count of splitters <= the row
+    in the total order.  ``spl_vals[k]``: [nshards-1] raw splitter values
+    for key k; ``spl_valid[k]``: their validity."""
+    cap = key_cols[0].values.shape[0]
+    pid = jnp.zeros(cap, dtype=jnp.int32)
+    for s in range(nshards - 1):
+        lt = jnp.zeros(cap, dtype=jnp.bool_)
+        eq = jnp.ones(cap, dtype=jnp.bool_)
+        for k, c in enumerate(key_cols):
+            k_lt, k_eq = _cmp_one(c, descending[k], nulls_first[k],
+                                  spl_vals[k][s], spl_valid[k][s])
+            lt = lt | (eq & k_lt)
+            eq = eq & k_eq
+        pid = pid + jnp.where(lt, 0, 1).astype(jnp.int32)
+    return pid
+
+
+def host_order(cols: Sequence[np.ndarray], valids: Sequence[np.ndarray],
+               descending: Sequence[bool], nulls_first: Sequence[bool],
+               live: Optional[np.ndarray] = None) -> np.ndarray:
+    """np.lexsort permutation realizing the same total order host-side
+    (dead rows last).  Used for splitter selection and TopN final merge."""
+    n = cols[0].shape[0]
+    lex: List[np.ndarray] = []
+    for v, valid, desc, nf in zip(reversed(list(cols)),
+                                  reversed(list(valids)),
+                                  reversed(list(descending)),
+                                  reversed(list(nulls_first))):
+        if np.issubdtype(v.dtype, np.floating):
+            nan = np.isnan(v)
+            f = np.where(v == 0.0, 0.0, v)
+            f = np.where(nan, 0.0, f)
+            lex.extend([-f, -nan.astype(np.int8)] if desc
+                       else [f, nan.astype(np.int8)])
+        else:
+            iv = v.astype(np.int64) if v.dtype == np.bool_ else v
+            lex.append(~iv if desc else iv)
+        null_key = (~valid).astype(np.int8)
+        lex.append(-null_key if nf else null_key)
+    if live is not None:
+        lex.append((~live).astype(np.int8))
+    return np.lexsort(lex)
+
+
+class DistributedSort:
+    """Range-partitioned distributed sort.  Inputs/outputs are
+    leading-axis sharded ``[(values, validity)]`` columns + per-shard row
+    counts; after ``__call__`` shard i holds range bucket i, locally
+    sorted, so mesh-order concatenation is the total order."""
+
+    SAMPLE_PER_SHARD = 256
+
+    def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
+                 key_exprs: Sequence[Expression],
+                 descending: Sequence[bool],
+                 nulls_first: Sequence[bool]):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.nshards = mesh.devices.size
+        self.in_dtypes = list(in_dtypes)
+        self.key_exprs = list(key_exprs)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first)
+        self._cached_jit = cached_jit
+        self._sig = ("dist_sort", tuple(mesh.axis_names),
+                     tuple(mesh.devices.shape),
+                     tuple(str(d) for d in mesh.devices.flat),
+                     tuple(dt.name for dt in self.in_dtypes),
+                     tuple(e.cache_key() for e in self.key_exprs),
+                     tuple(self.descending), tuple(self.nulls_first))
+        self.last_stats: Optional[dict] = None
+
+    def _emit_keys(self, cols: List[ColVal], nrows) -> List[ColVal]:
+        from spark_rapids_tpu.ops.aggregates import widen_colval
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        return [widen_colval(e.emit(ctx), cap) for e in self.key_exprs]
+
+    def _cols_of(self, flat_cols) -> List[ColVal]:
+        return [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, self.in_dtypes)]
+
+    # phase 1: strided sample of the key columns
+    def _step_sample(self, flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = self._cols_of(flat_cols)
+        cap = cols[0].values.shape[0]
+        keys = self._emit_keys(cols, nrows)
+        k = min(self.SAMPLE_PER_SHARD, cap)
+        idx = jnp.clip(
+            (jnp.arange(k, dtype=jnp.int32) *
+             jnp.maximum(nrows, 1)) // k, 0, cap - 1)
+        live = idx < nrows
+        out = []
+        for c in keys:
+            sv = c.values[idx]
+            valid = c.validity[idx] if c.validity is not None else \
+                jnp.ones(k, dtype=jnp.bool_)
+            out.append((sv, jnp.where(live, valid, False)))
+        return tuple(out), live
+
+    # phase 2: histogram of range pids (for slot sizing)
+    def _step_stats(self, spl_vals, spl_valid, flat_cols, nrows_arr):
+        from spark_rapids_tpu.ops.pallas_kernels import histogram
+        nrows = nrows_arr[0]
+        cols = self._cols_of(flat_cols)
+        cap = cols[0].values.shape[0]
+        keys = self._emit_keys(cols, nrows)
+        pids = range_pids(keys, self.descending, self.nulls_first,
+                          spl_vals, spl_valid, self.nshards)
+        live = jnp.arange(cap, dtype=jnp.int32) < nrows
+        return histogram(pids, live, self.nshards)
+
+    # phase 3: exchange to range buckets + local sort
+    def _step_final(self, slot, spl_vals, spl_valid, flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = self._cols_of(flat_cols)
+        keys = self._emit_keys(cols, nrows)
+        pids = range_pids(keys, self.descending, self.nulls_first,
+                          spl_vals, spl_valid, self.nshards)
+        recv, recv_n = exchange(cols, pids, nrows, self.axis, self.nshards,
+                                slot=slot)
+        rcap = recv[0].values.shape[0]
+        rkeys = self._emit_keys(recv, recv_n)
+        valid_rows = jnp.arange(rcap, dtype=jnp.int32) < recv_n
+        perm = sort_permutation(rkeys, valid_rows, rcap, self.descending,
+                                self.nulls_first)
+        out = selection.gather(recv, perm, recv_n)
+        flat = []
+        for c in out:
+            validity = c.validity if c.validity is not None else \
+                jnp.ones(rcap, dtype=jnp.bool_)
+            flat.append((c.values, validity))
+        return tuple(flat), recv_n.astype(jnp.int32)[None]
+
+    def _splitters(self, flat_cols, nrows_per_shard):
+        """Host sync: run the sample pass, pick splitter rows."""
+        sample = self._cached_jit(
+            self._sig + ("sample",), lambda: jax.shard_map(
+                self._step_sample, mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))(
+            flat_cols, nrows_per_shard)
+        key_samples, live = sample
+        live = np.asarray(live)
+        cols = [np.asarray(v) for v, _ in key_samples]
+        valids = [np.where(live, np.asarray(val), False)
+                  for _, val in key_samples]
+        order = host_order(cols, valids, self.descending, self.nulls_first,
+                           live=live)
+        m = int(live.sum())
+        spl_vals, spl_valid = [], []
+        if m == 0:
+            idx = np.zeros(self.nshards - 1, dtype=np.int64)
+        else:
+            ranks = np.clip(
+                ((np.arange(1, self.nshards) * m) // self.nshards),
+                0, m - 1)
+            idx = order[ranks]
+        for v, valid in zip(cols, valids):
+            spl_vals.append(jnp.asarray(v[idx]))
+            spl_valid.append(jnp.asarray(
+                valid[idx] if m else np.ones(self.nshards - 1, bool)))
+        return spl_vals, spl_valid
+
+    def __call__(self, flat_cols, nrows_per_shard):
+        spl_vals, spl_valid = self._splitters(flat_cols, nrows_per_shard)
+        hist = self._cached_jit(
+            self._sig + ("stats",), lambda: jax.shard_map(
+                self._step_stats, mesh=self.mesh,
+                in_specs=(P(), P(), P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))(
+            spl_vals, spl_valid, flat_cols, nrows_per_shard)
+        counts = np.asarray(hist).reshape(self.nshards, self.nshards)
+        capacity = int(flat_cols[0][0].shape[0]) // self.nshards
+        slot = pick_slot(int(counts.max()), capacity)
+        self.last_stats = {"partition_counts": counts, "slot": slot}
+        return self._cached_jit(
+            self._sig + ("final", slot), lambda: jax.shard_map(
+                partial(self._step_final, slot), mesh=self.mesh,
+                in_specs=(P(), P(), P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))(
+            spl_vals, spl_valid, flat_cols, nrows_per_shard)
+
+
+class DistributedTopN:
+    """Per-shard TopN under shard_map (local sort + prefix); the tiny
+    per-shard winners are merged host-side by the caller (the reference's
+    TakeOrderedAndProject does the same partial-then-driver-merge).
+    Returns (flat cols, flat MATERIALIZED key cols, nrows) — the key
+    columns let the host merge without re-evaluating key expressions."""
+
+    def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
+                 key_exprs: Sequence[Expression],
+                 descending: Sequence[bool], nulls_first: Sequence[bool],
+                 n: int):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.nshards = mesh.devices.size
+        self.in_dtypes = list(in_dtypes)
+        self.key_exprs = list(key_exprs)
+        self.descending = list(descending)
+        self.nulls_first = list(nulls_first)
+        self.n = n
+        sig = ("dist_topn", tuple(mesh.axis_names),
+               tuple(mesh.devices.shape),
+               tuple(str(d) for d in mesh.devices.flat),
+               tuple(dt.name for dt in self.in_dtypes),
+               tuple(e.cache_key() for e in self.key_exprs),
+               tuple(self.descending), tuple(self.nulls_first), n)
+        self._jitted = cached_jit(sig, lambda: jax.shard_map(
+            self._step, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis)),
+            out_specs=P(self.axis), check_vma=False))
+
+    def _step(self, flat_cols, nrows_arr):
+        from spark_rapids_tpu.ops.aggregates import widen_colval
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, self.in_dtypes)]
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        keys = [widen_colval(e.emit(ctx), cap) for e in self.key_exprs]
+        valid_rows = jnp.arange(cap, dtype=jnp.int32) < nrows
+        perm = sort_permutation(keys, valid_rows, cap, self.descending,
+                                self.nulls_first)
+        n_out = jnp.minimum(nrows, jnp.int32(self.n))
+        out = selection.gather(cols, perm, n_out)
+        key_out = selection.gather(keys, perm, n_out)
+
+        def flatten(cs):
+            return tuple(
+                (c.values, c.validity if c.validity is not None
+                 else jnp.ones(cap, dtype=jnp.bool_)) for c in cs)
+
+        return flatten(out), flatten(key_out), n_out.astype(jnp.int32)[None]
+
+    def __call__(self, flat_cols, nrows_per_shard):
+        return self._jitted(flat_cols, nrows_per_shard)

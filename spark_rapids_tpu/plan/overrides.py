@@ -210,6 +210,17 @@ class ExprMeta(BaseMeta):
                         f"aggregate {expr.func.name} over array values "
                         "not supported (only collect_list/collect_set "
                         "produce arrays)")
+                child = expr.func.child
+                if child is not None and child.dtype.has_offsets and \
+                        expr.func.name != "count" and not getattr(
+                            expr.func, "single_pass", False):
+                    # min/max/first/last need row values; a chars+offsets
+                    # column has no order-preserving device code here
+                    # (the distributed planner's scan-wide dictionary
+                    # does support these — parallel/dist_planner.py)
+                    self.will_not_work(
+                        f"aggregate {expr.func.name} over "
+                        f"{child.dtype.name} values falls back to CPU")
             except (RuntimeError, TypeError, ValueError) as e:
                 self.will_not_work(str(e))
         if isinstance(expr, Cast):

@@ -1,0 +1,227 @@
+"""Distributed planner: session-level queries on the 8-device CPU mesh,
+oracle-diffed against the single-process engine.
+
+The reference's equivalent surface is the planner-inserted shuffle
+exchange executing every multi-partition query across executors
+(GpuShuffleExchangeExec.scala:120-199); here ``TpuSession(mesh=...)``
+routes supported plans through parallel/dist_planner.py and these tests
+pin the results to the single-process oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture()
+def dist_session(mesh):
+    return TpuSession(mesh=mesh)
+
+
+@pytest.fixture()
+def oracle_session():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def frames():
+    rng = np.random.default_rng(7)
+    n = 4000
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 50, n),
+        "k2": rng.integers(0, 5, n),
+        "v": rng.uniform(-10, 10, n).round(3),
+        "s": rng.choice(["ash", "birch", "cedar", "oak", None], n,
+                        p=[0.3, 0.3, 0.2, 0.15, 0.05]),
+    })
+    fact.loc[rng.choice(n, 100, replace=False), "v"] = np.nan
+    dim = pd.DataFrame({
+        "k": np.arange(0, 60, 2),          # half the fact keys match
+        "w": np.arange(0, 60, 2) * 1.5,
+        "tag": [f"t{i % 3}" for i in range(30)],
+    })
+    return fact, dim
+
+
+def _cmp(dist_df, oracle_df, sort_by=None):
+    a, b = dist_df.to_pandas(), oracle_df.to_pandas()
+    if sort_by:
+        a = a.sort_values(sort_by, ignore_index=True)
+        b = b.sort_values(sort_by, ignore_index=True)
+    else:
+        a = a.reset_index(drop=True)
+        b = b.reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, rtol=1e-9)
+
+
+def _both(dist_session, oracle_session, frames, build):
+    fact, dim = frames
+    d = build(dist_session.create_dataframe(fact),
+              dist_session.create_dataframe(dim))
+    o = build(oracle_session.create_dataframe(fact),
+              oracle_session.create_dataframe(dim))
+    return d, o
+
+
+def test_filter_project_distributed(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.filter(F.col("v") > 1.0)
+                 .select("k", (F.col("v") * 2 + 1).alias("w")))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_groupby_string_key(dist_session, oracle_session, frames):
+    d, o = _both(
+        dist_session, oracle_session, frames,
+        lambda f, _: f.groupBy("s").agg(
+            F.sum("v").alias("sv"), F.count("v").alias("c"),
+            F.avg("v").alias("av"), F.max("k").alias("mk")).orderBy("s"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_keyless_aggregate(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.agg(F.sum("v").alias("s"),
+                                    F.count().alias("n"),
+                                    F.min("v").alias("m")))
+    _cmp(d, o)
+
+
+def test_string_literal_filters(dist_session, oracle_session, frames):
+    for cond in (F.col("s") == "birch", F.col("s") < "cedar",
+                 F.col("s") >= "oak", F.col("s") == "no-such-value",
+                 F.col("s").isin("ash", "oak", "nope")):
+        d, o = _both(dist_session, oracle_session, frames,
+                     lambda f, _: f.filter(cond).agg(
+                         F.count().alias("n"), F.sum("v").alias("sv")))
+        _cmp(d, o)
+        assert dist_session.last_dist_explain == "distributed"
+
+
+def test_min_max_over_strings(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.groupBy("k2").agg(
+                     F.min("s").alias("lo"),
+                     F.max("s").alias("hi")).orderBy("k2"))
+    _cmp(d, o)
+
+
+def test_string_min_with_result_expression(dist_session, oracle_session,
+                                           frames):
+    """Non-trivial agg outputs (sum*2) force the post-agg projection;
+    the encoded min(s) output's dictionary must survive it."""
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.groupBy("k2").agg(
+                     F.min("s").alias("lo"),
+                     (F.sum("v") * 2).alias("s2")).orderBy("k2"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "semi", "anti"])
+def test_join_types_distributed(dist_session, oracle_session, frames,
+                                how):
+    hows = {"semi": "left_semi", "anti": "left_anti"}.get(how, how)
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, dd: f.join(dd, "k", how=hows)
+                 .orderBy("k", "v"))
+    _cmp(d, o, sort_by=None)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_join_then_aggregate(dist_session, oracle_session, frames):
+    d, o = _both(
+        dist_session, oracle_session, frames,
+        lambda f, dd: f.join(dd, "k")
+        .groupBy("tag").agg(F.sum((F.col("v") * F.col("w")).alias("p"))
+                            .alias("rev")).orderBy("tag"))
+    _cmp(d, o)
+
+
+def test_sort_desc_nulls(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.select("k", "v")
+                 .orderBy(F.col("v").desc(), "k"))
+    _cmp(d, o)
+
+
+def test_topn_and_limit(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.orderBy(F.col("v").desc()).limit(17)
+                 .select("k", "v"))
+    _cmp(d, o)
+    # bare limit: row content is order-dependent; compare count only
+    fact, _ = frames
+    n = dist_session.create_dataframe(fact).limit(123).count()
+    assert n == 123
+
+
+def test_unsupported_falls_back(dist_session, oracle_session, frames):
+    # string-producing expression: no distributed lowering -> fallback,
+    # same result
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.select(F.upper(F.col("s")).alias("u"))
+                 .groupBy("u").agg(F.count().alias("n")).orderBy("u"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain.startswith("fallback")
+
+
+def test_string_join_key_falls_back(dist_session, oracle_session, frames):
+    fact, dim = frames
+    dim2 = dim.assign(s=np.where(np.arange(len(dim)) % 2 == 0, "ash",
+                                 "oak"))
+    d = dist_session.create_dataframe(fact).join(
+        dist_session.create_dataframe(dim2).select("s", "w"), "s")
+    o = oracle_session.create_dataframe(fact).join(
+        oracle_session.create_dataframe(dim2).select("s", "w"), "s")
+    a = d.to_pandas().sort_values(["k", "v", "w"], ignore_index=True)
+    b = o.to_pandas().sort_values(["k", "v", "w"], ignore_index=True)
+    pd.testing.assert_frame_equal(a, b, rtol=1e-9)
+    assert dist_session.last_dist_explain.startswith("fallback")
+
+
+def test_tpch_headline_queries_distributed(dist_session, oracle_session):
+    """VERDICT r2 'done' criterion: session.sql TPC-H q1/q3/q5/q6
+    end-to-end on the mesh, oracle-diffed."""
+    from spark_rapids_tpu.models import tpch, tpch_sql
+    data = tpch.gen_tables(sf=0.002)
+    td = tpch.load(dist_session, data)
+    tpch_sql.register(dist_session, td)
+    to = tpch.load(oracle_session, data)
+    tpch_sql.register(oracle_session, to)
+    for q in ("q1", "q3", "q5", "q6"):
+        a = dist_session.sql(tpch_sql.QUERIES[q]).to_pandas()
+        assert dist_session.last_dist_explain == "distributed", \
+            (q, dist_session.last_dist_explain)
+        b = oracle_session.sql(tpch_sql.QUERIES[q]).to_pandas()
+        pd.testing.assert_frame_equal(a.reset_index(drop=True),
+                                      b.reset_index(drop=True), rtol=1e-9)
+
+
+def test_numshards_conf_builds_mesh():
+    s = TpuSession({"spark.rapids.sql.distributed.numShards": "8"})
+    assert s.mesh is not None and s.mesh.devices.size == 8
+    df = s.create_dataframe({"a": list(range(100))})
+    assert df.agg(F.sum("a").alias("s")).collect()[0][0] == 4950
+    assert s.last_dist_explain == "distributed"
+
+
+def test_distributed_disable_conf(mesh, frames):
+    fact, _ = frames
+    s = TpuSession({"spark.rapids.sql.distributed.enabled": "false"},
+                   mesh=mesh)
+    df = s.create_dataframe(fact)
+    assert df.count() == len(fact)
+    assert s.last_dist_explain == "distributed disabled by conf"
