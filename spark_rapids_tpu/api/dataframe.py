@@ -336,9 +336,98 @@ class DataFrame:
         return DataFrame(self.session, L.Project(exprs, self.plan))
 
     def groupBy(self, *cols: Union[Col, str]) -> "GroupedData":
-        return GroupedData(self, [_expr(c) for c in cols])
+        from spark_rapids_tpu.ops.datetime_ops import TimeWindow
+        from spark_rapids_tpu.ops.nested_ops import CreateNamedStruct
+        exprs: List[Expression] = []
+        for c in cols:
+            e = _expr(c)
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if isinstance(inner, CreateNamedStruct):
+                # struct group keys (e.g. F.window(...)) shred into one
+                # key per field; the shredded names reassemble into the
+                # struct column at the output boundary
+                first = inner.pairs[0][1]
+                if isinstance(first, TimeWindow) and \
+                        first.slide_us < first.window_us:
+                    if len(cols) != 1:
+                        raise ValueError(
+                            "sliding window(...) must be the only "
+                            "grouping column")
+                    return self._group_by_sliding_window(e, first)
+                name = e.name if isinstance(e, Alias) else "struct"
+                exprs.extend(Alias(fe, f"{name}.{fn}")
+                             for fn, fe in inner.pairs)
+                continue
+            exprs.append(e)
+        return GroupedData(self, exprs)
+
+    def _group_by_sliding_window(self, aliased, tw) -> "GroupedData":
+        """Sliding time windows: each row belongs to up to
+        ceil(window/slide) overlapping windows — expand one replica per
+        overlap (Spark's TimeWindowing rule lowers through Expand the
+        same way), keep replicas whose window really contains the
+        timestamp, then group by (start, end)."""
+        from spark_rapids_tpu.exec.expand import Expand
+        from spark_rapids_tpu.ops import predicates as preds
+        from spark_rapids_tpu.ops.datetime_ops import TimeWindow
+        name = aliased.name if isinstance(aliased, Alias) else "window"
+        s_col, e_col = f"{name}.start", f"{name}.end"
+        k = -(-tw.window_us // tw.slide_us)
+        base_names = [n for n, _ in self.plan.schema]
+        projections = []
+        for i in range(k):
+            shift = i * tw.slide_us
+            proj: List[Expression] = [UnresolvedColumn(n)
+                                      for n in base_names]
+            proj.append(Alias(TimeWindow(tw.child, tw.window_us,
+                                         tw.slide_us, tw.start_us,
+                                         "start", shift), s_col))
+            proj.append(Alias(TimeWindow(tw.child, tw.window_us,
+                                         tw.slide_us, tw.start_us,
+                                         "end", shift), e_col))
+            projections.append(proj)
+        expand = Expand(projections, base_names + [s_col, e_col],
+                        self.plan)
+        cond = preds.GreaterThan(UnresolvedColumn(e_col), tw.child)
+        filtered = L.Filter(cond, expand)
+        return GroupedData(DataFrame(self.session, filtered),
+                           [UnresolvedColumn(s_col),
+                            UnresolvedColumn(e_col)])
 
     group_by = groupBy
+
+    def rollup(self, *cols: Union[Col, str]) -> "GroupedData":
+        """GROUP BY ROLLUP: hierarchical subtotals (a,b) -> (a) -> ()
+        (lowered through Expand — GpuExpandExec analog)."""
+        from spark_rapids_tpu.exec.expand import rollup_sets
+        exprs = [_expr(c) for c in cols]
+        return GroupedData(self, exprs, sets=rollup_sets(len(exprs)))
+
+    def cube(self, *cols: Union[Col, str]) -> "GroupedData":
+        """GROUP BY CUBE: all 2^n grouping-column subsets."""
+        from spark_rapids_tpu.exec.expand import cube_sets
+        exprs = [_expr(c) for c in cols]
+        return GroupedData(self, exprs, sets=cube_sets(len(exprs)))
+
+    def groupingSets(self, sets, *cols: Union[Col, str]) -> "GroupedData":
+        """Explicit GROUPING SETS: ``sets`` is a list of lists of column
+        names (each a subset of ``cols``)."""
+        exprs = [_expr(c) for c in cols]
+        names = [e.name for e in exprs]
+        idx_sets = []
+        for s in sets:
+            idx = []
+            for item in s:
+                nm = item if isinstance(item, str) else _expr(item).name
+                if nm not in names:
+                    raise ValueError(
+                        f"grouping set column {nm!r} is not in the "
+                        f"grouping columns {names}")
+                idx.append(names.index(nm))
+            idx_sets.append(idx)
+        return GroupedData(self, exprs, sets=idx_sets)
+
+    grouping_sets = groupingSets
 
     def agg(self, *aggs: Col) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
@@ -633,12 +722,16 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, group_exprs: List[Expression]):
+    def __init__(self, df: DataFrame, group_exprs: List[Expression],
+                 sets: Optional[List[List[int]]] = None):
         self.df = df
         self.group_exprs = group_exprs
+        self.sets = sets  # rollup/cube/grouping-sets index lists
 
     def agg(self, *aggs: Col) -> DataFrame:
         from spark_rapids_tpu.api.functions import _PandasAggCall
+        if self.sets is not None:
+            return self._agg_grouping_sets(aggs)
         pandas_aggs = [a for a in aggs if isinstance(a, _PandasAggCall)]
         if pandas_aggs:
             if len(pandas_aggs) != len(aggs):
@@ -652,6 +745,106 @@ class GroupedData:
         agg_exprs = [_expr(a) for a in aggs]
         return DataFrame(self.df.session, L.Aggregate(
             self.group_exprs, agg_exprs, self.df.plan))
+
+    def _agg_grouping_sets(self, aggs) -> DataFrame:
+        """Lower rollup/cube/grouping sets: Expand (one projection per
+        grouping set, aggregated-away keys nulled, plus the grouping-id
+        literal) -> Aggregate keyed on (keys..., grouping_id) -> final
+        projection resolving grouping()/grouping_id() markers.
+        Reference: GpuExpandExec rule (GpuOverrides.scala:3170)."""
+        from spark_rapids_tpu.api.functions import (
+            _GroupingIdMarker, _GroupingMarker)
+        from spark_rapids_tpu.exec.expand import (
+            Expand, GROUPING_ID_COL)
+        from spark_rapids_tpu.ops import arithmetic as arith
+        from spark_rapids_tpu.ops.expressions import Literal
+        import numpy as np
+
+        child = self.df.plan
+        child_names = [n for n, _ in child.schema]
+        n = len(self.group_exprs)
+
+        # group columns: bare refs use the child column directly;
+        # computed keys materialize as hidden columns first
+        group_cols: List[str] = []
+        pre_exprs: List[Expression] = []
+        for i, e in enumerate(self.group_exprs):
+            if isinstance(e, UnresolvedColumn) and \
+                    e.col_name in child_names:
+                group_cols.append(e.col_name)
+            else:
+                hidden = e.name if e.name not in child_names \
+                    else f"__gs{i}"
+                pre_exprs.append(Alias(e, hidden))
+                group_cols.append(hidden)
+        base = child
+        if pre_exprs:
+            base = L.Project(
+                [UnresolvedColumn(c) for c in child_names] + pre_exprs,
+                child)
+        base_names = [nm for nm, _ in base.schema]
+
+        # key slots are SEPARATE copies of the grouping columns (nulled
+        # per set); the base columns pass through untouched so aggregate
+        # children over a grouping column still see the real values
+        # (Spark's Expand does the same duplication)
+        from spark_rapids_tpu.exec.expand import grouping_set_projections
+        key_exprs = [UnresolvedColumn(c).bind(base.schema)
+                     for c in group_cols]
+        projections = grouping_set_projections(
+            key_exprs, self.sets,
+            [UnresolvedColumn(nm) for nm in base_names])
+        key_slots = [f"__gk{i}" for i in range(n)]
+        expand = Expand(
+            projections, key_slots + base_names + [GROUPING_ID_COL],
+            base)
+
+        gid_ref = UnresolvedColumn(GROUPING_ID_COL)
+
+        def rewrite(e: Expression) -> Expression:
+            if isinstance(e, _GroupingIdMarker):
+                return gid_ref
+            if isinstance(e, _GroupingMarker):
+                target = e.children[0].name
+                if target not in group_cols:
+                    raise ValueError(
+                        f"grouping({target}) references a non-grouping "
+                        f"column; grouping columns: {group_cols}")
+                bit = n - 1 - group_cols.index(target)
+                from spark_rapids_tpu.ops.cast import Cast
+                from spark_rapids_tpu.columnar import dtypes as _dts
+                return Cast(
+                    arith.BitwiseAnd(
+                        arith.ShiftRight(gid_ref, Literal(bit)),
+                        Literal(np.int64(1))), _dts.INT32)
+            if not e.children:
+                return e
+            return e.with_children([rewrite(c) for c in e.children])
+
+        agg_items: List[Expression] = []
+        final_tail: List[Expression] = []  # post-agg select list tail
+
+        def has_marker(e):
+            if isinstance(e, (_GroupingIdMarker, _GroupingMarker)):
+                return True
+            return any(has_marker(c) for c in e.children)
+
+        for a in aggs:
+            e = _expr(a)
+            if has_marker(e):
+                r = rewrite(e)
+                final_tail.append(r if isinstance(r, Alias)
+                                  else Alias(r, e.name))
+            else:
+                agg_items.append(e)
+                final_tail.append(UnresolvedColumn(e.name))
+
+        agg_plan = L.Aggregate(
+            [Alias(UnresolvedColumn(s), c)
+             for s, c in zip(key_slots, group_cols)] + [gid_ref],
+            agg_items, expand)
+        final = [UnresolvedColumn(c) for c in group_cols] + final_tail
+        return DataFrame(self.df.session, L.Project(final, agg_plan))
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.api import functions as F

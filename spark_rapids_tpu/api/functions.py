@@ -126,6 +126,13 @@ class Col:
         return Col(preds.IsNotNull(self.expr))
 
     def isin(self, *values) -> "Col":
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        # large non-string literal sets use the sorted-table membership
+        # form (GpuInSet analog) instead of K chained equalities
+        if len(values) > 16 and not any(isinstance(v, str)
+                                        for v in values):
+            return Col(preds.InSet(self.expr, list(values)))
         return Col(preds.In(self.expr, [Literal(v) for v in values]))
 
     def between(self, lo, hi) -> "Col":
@@ -153,6 +160,14 @@ class Col:
     def getItem(self, key) -> "Col":
         if isinstance(key, str):
             return self.getField(key)
+        from spark_rapids_tpu.ops.json_ops import StringSplit
+        if isinstance(self.expr, StringSplit) and \
+                self.expr.limit == -1:
+            # split(c, d)[n] fuses to the device split_part kernel
+            # (array<string> itself stays a host-only type)
+            from spark_rapids_tpu.ops.regexops import SplitPart
+            return Col(SplitPart(self.expr.children[0],
+                                 self.expr.pattern, int(key)))
         from spark_rapids_tpu.ops.collections_ops import GetArrayItem
         from spark_rapids_tpu.ops.expressions import Literal
         return Col(GetArrayItem(self.expr, Literal(int(key))))
@@ -1045,3 +1060,137 @@ def pandas_agg_udf(f=None, returnType: str = "double"):
     if f is not None:
         return wrap(f)
     return wrap
+
+
+# --------------------------------------------- grouping sets (rollup/cube) --
+
+class _GroupingIdMarker(Expression):
+    """Placeholder for ``grouping_id()``; GroupedData.agg rewrites it to
+    the Expand-produced grouping-id column (GpuExpandExec lowering)."""
+
+    children = ()
+
+    @property
+    def dtype(self):
+        return dts.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def name(self):
+        return "grouping_id()"
+
+    def emit(self, ctx):
+        raise RuntimeError(
+            "grouping_id() is only valid in rollup/cube/groupingSets "
+            "aggregations")
+
+    def cache_key(self):
+        return ("_GroupingIdMarker",)
+
+
+class _GroupingMarker(Expression):
+    """Placeholder for ``grouping(col)`` (1 when the column is
+    aggregated away in this output row, else 0)."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return _GroupingMarker(children[0])
+
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def name(self):
+        return f"grouping({self.children[0].name})"
+
+    def emit(self, ctx):
+        raise RuntimeError(
+            "grouping() is only valid in rollup/cube/groupingSets "
+            "aggregations")
+
+    def cache_key(self):
+        return ("_GroupingMarker", self.children[0].cache_key())
+
+
+def grouping_id() -> Col:
+    """Bit vector of the aggregated-away grouping columns (Spark
+    ``grouping_id()``; bit i, MSB-first over the grouping columns, is 1
+    when column i is rolled up in this row)."""
+    return Col(_GroupingIdMarker())
+
+
+def grouping(c) -> Col:
+    """1 when the grouping column is aggregated away in this row, else 0
+    (Spark ``grouping``; returns int32 where Spark returns tinyint)."""
+    return Col(_GroupingMarker(_expr(c)))
+
+
+# ------------------------------------------------ expression-tail surface --
+
+def get_json_object(c, path: str) -> Col:
+    """Extract a JSONPath subset ($.field, $.a.b, $[n]) from a JSON
+    string column (host-evaluated: CPU fallback single-process, or the
+    dictionary lowering on a mesh)."""
+    from spark_rapids_tpu.ops.json_ops import GetJsonObject
+    return Col(GetJsonObject(_expr(c), path))
+
+
+def split(c, pattern: str, limit: int = -1) -> Col:
+    """split(str, regex) -> array<string> (host-evaluated; the indexed
+    device form is ``split_part``)."""
+    from spark_rapids_tpu.ops.json_ops import StringSplit
+    return Col(StringSplit(_expr(c), pattern, limit))
+
+
+def date_format(c, fmt: str) -> Col:
+    """Format a date/timestamp with a fixed-width pattern
+    (yyyy/MM/dd/HH/mm/ss + separators) on device; other patterns fall
+    back to CPU."""
+    from spark_rapids_tpu.ops.datetime_ops import DateFormatClass
+    return Col(DateFormatClass(_expr(c), fmt))
+
+
+def to_unix_timestamp(c, fmt: Optional[str] = None) -> Col:
+    """Seconds since the epoch; string inputs parse via the cast path
+    (default ISO format — like unix_timestamp with a format arg)."""
+    from spark_rapids_tpu.ops.datetime_ops import ToUnixTimestamp
+    return Col(ToUnixTimestamp(_expr(c)))
+
+
+def _parse_duration_us(s: str) -> int:
+    import re as _re
+    m = _re.fullmatch(
+        r"\s*(\d+)\s*(microsecond|millisecond|second|minute|hour|day|"
+        r"week)s?\s*", s)
+    if not m:
+        raise ValueError(f"cannot parse duration {s!r}")
+    n = int(m.group(1))
+    unit = {"microsecond": 1, "millisecond": 1_000, "second": 1_000_000,
+            "minute": 60_000_000, "hour": 3_600_000_000,
+            "day": 86_400_000_000, "week": 604_800_000_000}[m.group(2)]
+    return n * unit
+
+
+def window(c, window_duration: str, slide_duration: Optional[str] = None,
+           start_time: str = "0 seconds") -> Col:
+    """Tumbling/sliding time-window bucketing: a (start, end) struct
+    column for groupBy (GpuTimeWindow analog)."""
+    from spark_rapids_tpu.ops.datetime_ops import TimeWindow
+    from spark_rapids_tpu.ops.nested_ops import CreateNamedStruct
+    win = _parse_duration_us(window_duration)
+    slide = _parse_duration_us(slide_duration) if slide_duration else win
+    start = _parse_duration_us(start_time)
+    e = _expr(c)
+    return Col(Alias(CreateNamedStruct(
+        [("start", TimeWindow(e, win, slide, start, "start")),
+         ("end", TimeWindow(e, win, slide, start, "end"))]), "window"))

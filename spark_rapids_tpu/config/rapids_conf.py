@@ -208,6 +208,16 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     lambda v: None if v in ("none", "zrle", "lz4", "zstd")
     else "unknown codec")
 
+WINDOW_BATCH_ROWS = conf(
+    "spark.rapids.sql.window.batchRows", 1 << 20,
+    "Target rows per window-operator chunk when the input arrives "
+    "sorted (the planner inserts a sort under every partitioned "
+    "window). Chunks flush at partition boundaries (the "
+    "GpuKeyBatchingIterator analog); a single partition larger than "
+    "this streams with running-state carry when every window function "
+    "in the operator has a running frame, and otherwise grows the "
+    "chunk.", _to_int, _positive)
+
 DISTRIBUTED_ENABLED = conf(
     "spark.rapids.sql.distributed.enabled", True,
     "When the session holds a device mesh, offer every query plan to the "

@@ -1,0 +1,184 @@
+"""Expand: emit one output row per projection list per input row.
+
+Counterpart of ``GpuExpandExec`` (reference ``GpuOverrides.scala:3170``
+rule; ``GpuExpandExec.scala``): the lowering target for ROLLUP / CUBE /
+GROUPING SETS.  Spark plans ``GROUP BY ROLLUP(a, b)`` as::
+
+    Aggregate(keys = [a, b, spark_grouping_id])
+      Expand(projections = [[a, b, 0], [a, null, 1], [null, null, 3]])
+
+Where cudf evaluates each projection per batch and concatenates, the TPU
+formulation emits each projection as its own output batch (static
+shapes, K compiled projections per input batch) — the downstream
+hash-aggregate consumes multiple batches natively, so no concatenation
+is needed at all.
+
+``grouping_id`` bit semantics match Spark: bit i (MSB-first over the
+grouping columns) is 1 when grouping column i is aggregated away (null
+in that projection).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exec.base import Schema, TpuExec
+from spark_rapids_tpu.ops.compiler import StageFn
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, Expression, Literal)
+from spark_rapids_tpu.plan import logical as L
+
+
+class NullLiteral(Expression):
+    """A typed NULL column (the aggregated-away key slot in an Expand
+    projection).  Spark uses Literal(null, dataType); the engine's
+    ``Literal`` is non-null, so this emits a zero column with an all-
+    false validity mask."""
+
+    def __init__(self, dtype: DataType):
+        self._dtype = dtype
+        self.children = ()
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return "NULL"
+
+    def emit(self, ctx):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.expressions import ColVal
+        if self._dtype.is_string:
+            zeros = jnp.zeros(ctx.capacity, dtype=jnp.uint8)
+            offsets = jnp.zeros(ctx.capacity + 1, dtype=jnp.int32)
+            return ColVal(self._dtype, zeros,
+                          jnp.zeros(ctx.capacity, dtype=jnp.bool_),
+                          offsets)
+        zeros = jnp.zeros(ctx.capacity, dtype=self._dtype.storage)
+        return ColVal(self._dtype, zeros,
+                      jnp.zeros(ctx.capacity, dtype=jnp.bool_))
+
+    def cache_key(self):
+        return ("NullLiteral", self._dtype.name)
+
+    def __str__(self):
+        return f"NULL:{self._dtype.name}"
+
+
+class Expand(L.LogicalPlan):
+    """Logical Expand: ``projections[k][j]`` supplies output column j of
+    replica k; all projection lists share the output schema."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: L.LogicalPlan):
+        self.projections = [[e.bind(child.schema) for e in p]
+                            for p in projections]
+        self.names = list(names)
+        self.children = (child,)
+        first = self.projections[0]
+        for p in self.projections[1:]:
+            if len(p) != len(first):
+                raise ValueError("expand projections differ in arity")
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        # a column is nullable if ANY projection can make it null
+        out = []
+        for j, name in enumerate(self.names):
+            dt = self.projections[0][j].dtype
+            out.append((name, dt))
+        return out
+
+    def describe(self):
+        return f"Expand[{len(self.projections)} projections]"
+
+
+class TpuExpandExec(TpuExec):
+    """Physical Expand: K compiled projections per input batch, each
+    emitted as its own output batch."""
+
+    def __init__(self, node: Expand, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+        in_dtypes = [dt for _, dt in child.schema]
+        self._fns = [StageFn(list(p), in_dtypes)
+                     for p in node.projections]
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.node.schema
+
+    def describe(self):
+        return f"TpuExpandExec[{len(self._fns)} projections]"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        names = self.node.names
+        for batch in self.child.execute():
+            for fn in self._fns:
+                cols = fn(batch)
+                yield ColumnarBatch(
+                    {n: c for n, c in zip(names, cols)}, batch.nrows)
+
+
+GROUPING_ID_COL = "spark_grouping_id"
+
+
+def grouping_set_projections(group_exprs: Sequence[Expression],
+                             sets: Sequence[Sequence[int]],
+                             passthrough: Sequence[Expression]
+                             ) -> List[List[Expression]]:
+    """Build Expand projections for grouping sets.
+
+    ``group_exprs``: the N distinct grouping expressions;
+    ``sets``: per output replica, the indices of group_exprs that stay
+    live; ``passthrough``: non-key expressions the downstream aggregate
+    reads (agg children).  Output column order: group_exprs...,
+    passthrough..., grouping_id."""
+    import numpy as np
+    n = len(group_exprs)
+    out: List[List[Expression]] = []
+    for live in sets:
+        live_set = set(live)
+        proj: List[Expression] = []
+        gid = 0
+        for i, e in enumerate(group_exprs):
+            if i in live_set:
+                proj.append(e)
+            else:
+                proj.append(NullLiteral(e.dtype))
+                gid |= 1 << (n - 1 - i)
+        proj.extend(passthrough)
+        proj.append(Literal(np.int64(gid)))
+        out.append(proj)
+    return out
+
+
+def rollup_sets(n: int) -> List[List[int]]:
+    """ROLLUP(a,b,...) -> [(0..n-1), (0..n-2), ..., ()]."""
+    return [list(range(k)) for k in range(n, -1, -1)]
+
+
+def cube_sets(n: int) -> List[List[int]]:
+    """CUBE over n columns: all 2^n subsets, Spark's enumeration order
+    (subset bitmask descending by included-ness)."""
+    out = []
+    for mask in range((1 << n) - 1, -1, -1):
+        out.append([i for i in range(n) if mask & (1 << (n - 1 - i))])
+    return out

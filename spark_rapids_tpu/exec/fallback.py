@@ -145,12 +145,67 @@ def _eval_pandas(expr, df: pd.DataFrame):
     if isinstance(e, S.Length):
         child = _eval_pandas(e.child, df)
         return child.map(lambda v: None if _isnull(v) else len(v))
+    if isinstance(e, S.Substring):
+        child = _eval_pandas(e.child, df)
+
+        def sub(v):
+            pos, ln = e.pos, e.length
+            if ln < 0:
+                return ""
+            if pos > 0:
+                start = pos - 1
+            elif pos == 0:
+                start = 0
+            else:
+                start = max(len(v) + pos, 0)
+            return v[start:start + ln]
+
+        return child.map(lambda v: None if _isnull(v) else sub(v))
+    if isinstance(e, S.ConcatStrings):
+        parts = [_eval_pandas(c, df) for c in e.children]
+        return pd.Series([
+            None if any(_isnull(v) for v in row) else "".join(row)
+            for row in zip(*parts)])
     if isinstance(e, (S.StartsWith, S.EndsWith, S.Contains)):
         child = _eval_pandas(e.child, df)
         fn = {"StartsWith": str.startswith, "EndsWith": str.endswith,
               "Contains": str.__contains__}[type(e).__name__]
         return child.map(lambda v: None if _isnull(v)
                          else fn(v, e.pattern))
+    from spark_rapids_tpu.ops import json_ops as J
+    if isinstance(e, (J.GetJsonObject, J.StringSplit)):
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if _isnull(v)
+                         else e.eval_host(v))
+    from spark_rapids_tpu.ops import datetime_ops as DT
+    if isinstance(e, DT.DateFormatClass):
+        child = _eval_pandas(e.children[0], df)
+        strf = e.fmt
+        for a, b in (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                     ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+                     ("EEEE", "%A"), ("E", "%a"), ("DDD", "%j")):
+            strf = strf.replace(a, b)
+        return child.map(lambda v: None if _isnull(v)
+                         else pd.Timestamp(v).strftime(strf))
+    if isinstance(e, DT.TimeWindow):
+        child = _eval_pandas(e.children[0], df)
+
+        def edge(v):
+            ts = pd.Timestamp(v).value // 1000  # ns -> us
+            start = ts - (ts - e.start_us) % e.slide_us
+            out = start if e.field == "start" else start + e.window_us
+            return pd.Timestamp(out * 1000)
+
+        return child.map(lambda v: None if _isnull(v) else edge(v))
+    from spark_rapids_tpu.ops.predicates import InSet as _InSet
+    if isinstance(e, _InSet):
+        child = _eval_pandas(e.children[0], df)
+        hit = child.isin(list(e.table))
+        out = hit.astype(object)
+        if e.has_null:
+            out[~hit] = None
+        out[child.isna()] = None
+        return out
     from spark_rapids_tpu.ops import regexops as RX
     if isinstance(e, RX.RLike):
         import re

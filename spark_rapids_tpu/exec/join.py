@@ -125,17 +125,20 @@ class TpuHashJoinExec(TpuExec):
             else (self.left, self.right)
         probe_fn, build_fn = (self._rkey_fn, self._lkey_fn) if self._swap \
             else (self._lkey_fn, self._rkey_fn)
+        from spark_rapids_tpu.memory.coalesce import (
+            RequireSingleBatch, coalesce_iterator)
         from spark_rapids_tpu.memory.retry import (
             with_retry, with_retry_no_split)
-        build_batches = list(build_exec.execute())
-        if not build_batches:
+        # build side is a RequireSingleBatch coalesce: pending batches
+        # register spillable while accumulating (GpuCoalesceBatches with
+        # the single-batch goal feeding GpuShuffledHashJoin's build)
+        coalesced = coalesce_iterator(build_exec.execute(),
+                                      RequireSingleBatch())
+        # the join's single largest device allocation — guard it
+        build = with_retry_no_split(lambda: next(coalesced, None))
+        if build is None:
             from spark_rapids_tpu.columnar.batch import empty_batch
             build = empty_batch(build_exec.schema, capacity=1)
-        else:
-            # the join's single largest device allocation — guard it
-            build = with_retry_no_split(
-                lambda: concat_batches(build_batches))
-            del build_batches
         build_keys = with_retry_no_split(
             lambda: self._encoded_keys(build, build_fn))
         build_payload = _to_colvals(build)

@@ -142,10 +142,16 @@ class WindowExpression(Expression):
 
 
 class TpuWindowExec(TpuExec):
+    # frames a running carry can continue across chunk boundaries
+    _RUNNING_KINDS = ("sum", "count", "avg", "min", "max", "row_number")
+
     def __init__(self, window_exprs: Sequence[Tuple[str, WindowExpression]],
-                 child: TpuExec):
+                 child: TpuExec, presorted: bool = False,
+                 batch_rows: int = 1 << 20):
         super().__init__(child)
         self.window_exprs = list(window_exprs)
+        self.presorted = presorted
+        self.batch_rows = batch_rows
         self._register_metric(SORT_TIME)
         spec = self.window_exprs[0][1].spec
         for _, we in self.window_exprs[1:]:
@@ -172,8 +178,29 @@ class TpuWindowExec(TpuExec):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         sig = ("window",
                tuple(we.cache_key() for _, we in self.window_exprs),
-               tuple(dt.name for dt in in_dtypes))
+               tuple(dt.name for dt in in_dtypes), presorted)
         self._kernel = cached_jit(sig, lambda: self._run)
+
+    def _running_capable(self) -> bool:
+        """Every window function can carry running state across chunks
+        (needed to stream a partition larger than one chunk)."""
+        for _, we in self.window_exprs:
+            f = we.spec.frame
+            if we.kind == "row_number":
+                continue
+            if we.kind in self._RUNNING_KINDS and \
+                    f.lo is None and f.hi == 0:
+                continue
+            return False
+        return True
+
+    def _needs_run_aligned_split(self) -> bool:
+        """RANGE running frames include the full order-key tie run, so a
+        chunk split inside a run would emit rows missing later run
+        members — splits must land on run boundaries."""
+        return any(we.spec.frame.kind == "range"
+                   for _, we in self.window_exprs
+                   if we.kind != "row_number")
 
     @property
     def child(self) -> TpuExec:
@@ -195,19 +222,20 @@ class TpuWindowExec(TpuExec):
             (part_keys + order_keys)[0].values.shape[0]
         live = jnp.arange(capacity, dtype=jnp.int32) < nrows
         keys = list(part_keys) + list(order_keys)
-        if keys:
+        if keys and not self.presorted:
             perm = agg.sort_permutation(
                 keys, live, capacity,
                 descending=[False] * len(part_keys) +
                 [d for _, d, _ in self.spec.orders],
                 nulls_first=[True] * len(part_keys) +
                 [nf for _, _, nf in self.spec.orders])
+            s_part = selection.gather(part_keys, perm, nrows)
+            s_order = selection.gather(order_keys, perm, nrows)
+            s_extras = selection.gather(extras, perm, nrows)
+            s_payload = selection.gather(payload, perm, nrows)
         else:
-            perm = jnp.arange(capacity, dtype=jnp.int32)
-        s_part = selection.gather(part_keys, perm, nrows)
-        s_order = selection.gather(order_keys, perm, nrows)
-        s_extras = selection.gather(extras, perm, nrows)
-        s_payload = selection.gather(payload, perm, nrows)
+            s_part, s_order = part_keys, order_keys
+            s_extras, s_payload = extras, payload
         s_live = jnp.arange(capacity, dtype=jnp.int32) < nrows
 
         seg_boundary = _boundaries(s_part, s_live, capacity)
@@ -216,24 +244,31 @@ class TpuWindowExec(TpuExec):
         sp = W.SortedPartitions(seg_boundary, run_boundary, s_live, capacity)
 
         outs: List[ColVal] = []
+        auxs = []
         for i, (_, we) in enumerate(self.window_exprs):
             c = s_extras[self._extra_ofs[i]] if i in self._extra_ofs else None
-            outs.append(self._eval_window(we, sp, c, seg_boundary, capacity))
-        return s_payload, outs
+            out, aux = self._eval_window(we, sp, c, seg_boundary, capacity)
+            outs.append(out)
+            auxs.append(aux)
+        return s_payload, outs, tuple(auxs)
 
     def _eval_window(self, we: WindowExpression, sp: W.SortedPartitions,
                      c: Optional[ColVal], seg_boundary, capacity: int
-                     ) -> ColVal:
+                     ) -> Tuple[ColVal, tuple]:
+        """(output, aux): aux carries the running-state arrays used by
+        the chunked path to continue a partition across chunks (empty
+        for non-running frames)."""
         f = we.spec.frame
         kind = we.kind
         if kind == "row_number":
-            return W.row_number(sp)
+            rn = W.row_number(sp)
+            return rn, (rn.values,)
         if kind == "rank":
-            return W.rank(sp)
+            return W.rank(sp), ()
         if kind == "dense_rank":
-            return W.dense_rank(sp)
+            return W.dense_rank(sp), ()
         if kind == "percent_rank":
-            return W.percent_rank(sp)
+            return W.percent_rank(sp), ()
         if kind in ("lead", "lag"):
             off = we.offset if kind == "lead" else -we.offset
             # defaults are literals; emit standalone
@@ -242,7 +277,7 @@ class TpuWindowExec(TpuExec):
                 from spark_rapids_tpu.ops.expressions import EmitContext
                 dflt = we.default.emit(EmitContext([], jnp.int32(0),
                                                    capacity))
-            return W.lead_lag(sp, c, off, dflt)
+            return W.lead_lag(sp, c, off, dflt), ()
 
         rows = f.kind == "rows"
         result_dt = we.dtype
@@ -254,66 +289,271 @@ class TpuWindowExec(TpuExec):
             if kind == "avg":
                 vals = vals.astype(jnp.float64)
             cv = ColVal(cin.dtype, vals, cin.validity)
-            if not rows and f.hi == 0:
+            running = f.lo is None and f.hi == 0
+            if not rows and running:
                 # range running: include full tie run
-                s, n = W.frame_sum(sp, cv, None, None, rows=False)
-                s2, n2 = W.frame_sum(sp, cv, None, 0, rows=False)
-                s, n = s2, n2
+                s, n = W.frame_sum(sp, cv, None, 0, rows=False)
             else:
                 s, n = W.frame_sum(sp, cv, f.lo, f.hi, rows=True)
+            aux = (s, n) if running else ()
             if kind == "count":
-                return ColVal(dts.INT64, n)
+                return ColVal(dts.INT64, n), aux
             if kind == "avg":
                 return ColVal(dts.FLOAT64,
                               s / jnp.maximum(n, 1).astype(jnp.float64),
-                              n > 0)
-            return ColVal(result_dt, s, n > 0)
+                              n > 0), aux
+            return ColVal(result_dt, s, n > 0), aux
         if kind in ("min", "max"):
             whole = f.lo is None and f.hi is None
             if whole:
                 v, n = W.partition_reduce(sp, c, kind, capacity)
-            else:
-                v, n = W.running_minmax(sp, c, kind, seg_boundary)
-                if f.kind == "range":
-                    v = v[sp.run_end]
-                    n = n[sp.run_end]
-            return ColVal(result_dt, v, n > 0)
+                return ColVal(result_dt, v, n > 0), ()
+            v, n = W.running_minmax(sp, c, kind, seg_boundary)
+            if f.kind == "range":
+                v = v[sp.run_end]
+                n = n[sp.run_end]
+            return ColVal(result_dt, v, n > 0), (v, n)
         raise ValueError(kind)
 
     # ---- drive ---------------------------------------------------------------
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        batches = list(self.child.execute())
-        if not batches:
-            return
-        merged = concat_batches(batches)
-        with self.timer(SORT_TIME):
-            pre_cols = self._pre_fn(merged)
-            np_ = len(self.spec.partition_exprs)
-            no = len(self.spec.orders)
-            part_cols = pre_cols[:np_]
-            part_cols = [self._encoders[i].encode(c)
-                         if i in self._string_part_idx else c
-                         for i, c in enumerate(part_cols)]
-            part_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                         for c in part_cols]
-            order_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                          for c in pre_cols[np_:np_ + no]]
-            extras = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                      for c in pre_cols[np_ + no:]]
-            payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
-                       for c in merged.columns.values()]
-            s_payload, outs = self._kernel(part_keys, order_keys, extras,
-                                           payload, jnp.int32(merged.nrows))
-        n = merged.nrows
+    def _stage_inputs(self, merged: ColumnarBatch):
+        pre_cols = self._pre_fn(merged)
+        np_ = len(self.spec.partition_exprs)
+        no = len(self.spec.orders)
+        part_cols = pre_cols[:np_]
+        part_cols = [self._encoders[i].encode(c)
+                     if i in self._string_part_idx else c
+                     for i, c in enumerate(part_cols)]
+        part_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                     for c in part_cols]
+        order_keys = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                      for c in pre_cols[np_:np_ + no]]
+        extras = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                  for c in pre_cols[np_ + no:]]
+        payload = [ColVal(c.dtype, c.data, c.validity, c.offsets)
+                   for c in merged.columns.values()]
+        return part_keys, order_keys, extras, payload
+
+    def _make_batch(self, s_payload, outs, n: int,
+                    capacity: int) -> ColumnarBatch:
         names = [nm for nm, _ in self.schema]
         cols: Dict[str, Column] = {}
         for nm, o in zip(names, list(s_payload) + list(outs)):
             values = o.values
             if getattr(values, "ndim", 0) == 0:
-                values = jnp.broadcast_to(values, (merged.capacity,))
+                values = jnp.broadcast_to(values, (capacity,))
             cols[nm] = Column(o.dtype, values, n, validity=o.validity,
                               offsets=o.offsets)
-        yield ColumnarBatch(cols, n)
+        return ColumnarBatch(cols, n)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self.presorted and self.spec.partition_exprs:
+            yield from self._chunked_execute()
+            return
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        merged = concat_batches(batches)
+        with self.timer(SORT_TIME):
+            part_keys, order_keys, extras, payload = \
+                self._stage_inputs(merged)
+            s_payload, outs, _ = self._kernel(
+                part_keys, order_keys, extras, payload,
+                jnp.int32(merged.nrows))
+        yield self._make_batch(s_payload, outs, merged.nrows,
+                               merged.capacity)
+
+    # ---- chunked path (GpuKeyBatchingIterator + running-window analog) --
+    def _boundary_indices(self, part_keys, nrows: int,
+                          cutoff: Optional[int] = None,
+                          order_keys=None):
+        """(first, last) partition-start indices after row 0 within
+        rows ``[0, cutoff]`` (0 when none): one tiny device->host sync
+        per chunk.  With ``order_keys``, boundaries are partition OR
+        order-key-run starts (run-aligned split points)."""
+        cap = part_keys[0].values.shape[0]
+        live = jnp.arange(cap, dtype=jnp.int32) < nrows
+        b = _boundaries(part_keys, live, cap)
+        if order_keys:
+            b = jnp.logical_or(b, _boundaries(order_keys, live, cap))
+        b = b.at[0].set(False)
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        if cutoff is not None:
+            b = jnp.logical_and(b, pos <= cutoff)
+        first = jnp.min(jnp.where(b, pos, cap))
+        last = jnp.max(jnp.where(b, pos, 0))
+        import numpy as _np
+        first = int(_np.asarray(first))
+        return (0 if first >= cap else first), int(_np.asarray(last))
+
+    def _adjust(self, we: WindowExpression, out: ColVal, aux, carry,
+                mask):
+        """Combine a chunk's outputs with the carried running state for
+        rows continuing the previous chunk's last partition."""
+        kind = we.kind
+        if kind == "row_number":
+            return ColVal(out.dtype,
+                          jnp.where(mask, out.values + carry[0],
+                                    out.values), out.validity)
+        if kind == "count":
+            return ColVal(out.dtype,
+                          jnp.where(mask, out.values + carry[1],
+                                    out.values), out.validity)
+        if kind in ("sum", "avg"):
+            s, n = aux
+            cs, cn = carry
+            s2 = jnp.where(mask, s + cs, s)
+            n2 = jnp.where(mask, n + cn, n)
+            if kind == "sum":
+                return ColVal(out.dtype, s2, n2 > 0)
+            return ColVal(out.dtype,
+                          s2 / jnp.maximum(n2, 1).astype(jnp.float64),
+                          n2 > 0)
+        if kind in ("min", "max"):
+            v, n = aux
+            cv, cn = carry
+            op = jnp.minimum if kind == "min" else jnp.maximum
+            both = (n > 0) & (cn > 0)
+            v2 = jnp.where(mask & both, op(v, cv),
+                           jnp.where(mask & (n == 0) & (cn > 0), cv, v))
+            n2 = jnp.where(mask, n + cn, n)
+            return ColVal(out.dtype, v2, n2 > 0)
+        raise ValueError(kind)
+
+    def _carry_out(self, we: WindowExpression, aux, prev, last: int):
+        """New carry after emitting a chunk whose last partition is
+        still open: running totals at the chunk's last row, combined
+        with the previous carry when the chunk continued it."""
+        kind = we.kind
+        if kind == "row_number":
+            rn = aux[0][last]
+            return (rn + (prev[0] if prev is not None else 0),)
+        s, n = aux[0][last], aux[1][last]
+        if prev is not None:
+            if kind in ("min", "max"):
+                cv, cn = prev
+                op = jnp.minimum if kind == "min" else jnp.maximum
+                s = jnp.where((n > 0) & (cn > 0), op(s, cv),
+                              jnp.where(n > 0, s, cv))
+                n = n + cn
+            else:
+                s = s + prev[0]
+                n = n + prev[1]
+        return (s, n)
+
+    def _chunked_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.ops import selection as sel
+        buf: List[ColumnarBatch] = []
+        rows = 0
+        carry: Optional[List] = None  # per-expr carried state
+        running_ok = self._running_capable()
+        run_aligned = self._needs_run_aligned_split()
+
+        def process(chunk: ColumnarBatch, staged, n_emit: int,
+                    ends_open: bool, first_b: int):
+            """Run the kernel over chunk[:n_emit]; returns the output
+            batch, updating ``carry``.  ``ends_open``: the prefix's last
+            partition continues past n_emit; ``first_b``: first
+            partition-start index inside the prefix (0 = none — the
+            whole prefix continues the carried partition)."""
+            nonlocal carry
+            with self.timer(SORT_TIME):
+                part_keys, order_keys, extras, payload = staged
+                s_payload, outs, auxs = self._kernel(
+                    part_keys, order_keys, extras, payload,
+                    jnp.int32(n_emit))
+                if carry is not None:
+                    fb = first_b if first_b > 0 else n_emit
+                    mask = jnp.arange(chunk.capacity,
+                                      dtype=jnp.int32) < fb
+                    outs = [self._adjust(we, o, aux, c, mask)
+                            if c is not None else o
+                            for (_, we), o, aux, c in
+                            zip(self.window_exprs, outs, auxs, carry)]
+                if ends_open:
+                    # the prefix's open tail partition is the carried one
+                    # only when no boundary interrupted it
+                    prev = carry if first_b == 0 else None
+                    carry = [self._carry_out(we, aux, prev[i]
+                                             if prev is not None else None,
+                                             n_emit - 1)
+                             for i, ((_, we), aux) in enumerate(
+                                 zip(self.window_exprs, auxs))]
+                else:
+                    carry = None
+            return self._make_batch(s_payload, outs, n_emit,
+                                    chunk.capacity)
+
+        def tail_of(chunk: ColumnarBatch, start: int, total: int
+                    ) -> ColumnarBatch:
+            n_tail = total - start
+            cols = {}
+            idx = jnp.arange(chunk.capacity, dtype=jnp.int32) + start
+            idx = jnp.clip(idx, 0, chunk.capacity - 1)
+            for nm, c in chunk.columns.items():
+                cv = ColVal(c.dtype, c.data, c.validity, c.offsets)
+                g = sel.gather([cv], idx, jnp.int32(n_tail))[0]
+                cols[nm] = Column(g.dtype, g.values, n_tail,
+                                  validity=g.validity, offsets=g.offsets)
+            return ColumnarBatch(cols, n_tail)
+
+        for batch in self.child.execute():
+            if batch.nrows == 0:
+                continue
+            buf.append(batch)
+            rows += batch.nrows
+            while rows >= self.batch_rows:
+                chunk = concat_batches(buf)
+                staged = self._stage_inputs(chunk)
+                part_keys, order_keys = staged[0], staged[1]
+                first, last = self._boundary_indices(
+                    part_keys, rows, cutoff=self.batch_rows)
+                if last > 0:
+                    # emit up to the last partition boundary within the
+                    # target (complete partitions only)
+                    e, ends_open = last, False
+                elif running_ok:
+                    # partition longer than the target: emit a slice
+                    # and carry its running state forward; RANGE frames
+                    # may only split at an order-key run boundary (a
+                    # split inside a tie run would emit rows missing
+                    # later run members)
+                    if run_aligned:
+                        _, rb = self._boundary_indices(
+                            part_keys, rows, cutoff=self.batch_rows,
+                            order_keys=order_keys)
+                        if rb == 0:
+                            break  # one tie run fills the target: grow
+                        e, ends_open = rb, True
+                    else:
+                        e, ends_open = min(self.batch_rows, rows), True
+                else:
+                    first_any, _ = self._boundary_indices(
+                        part_keys, rows)
+                    if first_any > 0:
+                        # the oversized head partition completes later
+                        # in the buffer: emit exactly it
+                        e, ends_open, first = first_any, False, first_any
+                    else:
+                        # one open partition fills the whole buffer and
+                        # no running carry is possible: keep growing
+                        # (the reference's requirement too — a
+                        # partition must fit in memory)
+                        break
+                yield process(chunk, staged, e, ends_open,
+                              first if first < e else 0)
+                if e < rows:
+                    tail = tail_of(chunk, e, rows)
+                    buf = [tail]
+                    rows = tail.nrows
+                else:
+                    buf = []
+                    rows = 0
+        if rows:
+            chunk = concat_batches(buf)
+            staged = self._stage_inputs(chunk)
+            first, _ = self._boundary_indices(staged[0], rows)
+            yield process(chunk, staged, rows, False, first)
 
 
 def _boundaries(cols: List[ColVal], live, capacity: int):

@@ -300,3 +300,138 @@ class TimeAdd(BinaryExpression):
         r = self.right.emit(ctx)
         return ColVal(dts.TIMESTAMP_US, l.values + r.values.astype(jnp.int64),
                       combine_validity(l.validity, r.validity))
+
+
+class DateFormatClass(UnaryExpression):
+    """date_format(ts_or_date, pattern) — device string production for
+    fixed-width patterns (yyyy/MM/dd/HH/mm/ss plus literal separators,
+    the reference's GpuDateFormatClass common cases); other pattern
+    letters tag off to CPU fallback via ``supported``."""
+
+    _TOKENS = {"yyyy": 4, "MM": 2, "dd": 2, "HH": 2, "mm": 2, "ss": 2}
+
+    def __init__(self, child: Expression, fmt: str):
+        super().__init__(child)
+        self.fmt = fmt
+        self.tokens = []  # ("tok", name) | ("lit", byte)
+        self.supported = True
+        i = 0
+        while i < len(fmt):
+            for tok in ("yyyy", "MM", "dd", "HH", "mm", "ss"):
+                if fmt.startswith(tok, i):
+                    self.tokens.append(("tok", tok))
+                    i += len(tok)
+                    break
+            else:
+                ch = fmt[i]
+                if ch.isalpha():
+                    self.supported = False  # unknown pattern letter
+                self.tokens.append(("lit", ord(ch) & 0x7F))
+                i += 1
+        self.width = sum(self._TOKENS[t] if k == "tok" else 1
+                         for k, t in self.tokens)
+
+    def with_children(self, children):
+        return DateFormatClass(children[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return dts.STRING
+
+    def cache_key(self):
+        return ("DateFormatClass", self.child.cache_key(), self.fmt)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        cv = self.child.emit(ctx)
+        days = _to_days(cv)
+        y, m, d = _civil_from_days(days)
+        if cv.dtype.is_timestamp:
+            rem = jnp.mod(cv.values, US_PER_DAY)
+            hh = rem // 3_600_000_000
+            mi = jnp.mod(rem, 3_600_000_000) // 60_000_000
+            ss = jnp.mod(rem, 60_000_000) // US_PER_SEC
+        else:
+            hh = mi = ss = jnp.zeros_like(days)
+        vals = {"yyyy": jnp.clip(y, 0, 9999), "MM": m, "dd": d,
+                "HH": hh, "mm": mi, "ss": ss}
+        cols = []
+        for k, t in self.tokens:
+            if k == "lit":
+                cols.append(jnp.full(ctx.capacity, t, dtype=jnp.uint8))
+            else:
+                v = vals[t].astype(jnp.int64)
+                w = self._TOKENS[t]
+                for p in range(w - 1, -1, -1):
+                    digit = jnp.mod(v // (10 ** p), 10)
+                    cols.append((digit + ord("0")).astype(jnp.uint8))
+        mat = jnp.stack(cols, axis=1)  # [cap, width]
+        chars = mat.reshape(-1)
+        ccap = 1
+        while ccap < chars.shape[0]:
+            ccap <<= 1
+        if ccap > chars.shape[0]:
+            chars = jnp.concatenate(
+                [chars, jnp.zeros(ccap - chars.shape[0],
+                                  dtype=jnp.uint8)])
+        offsets = (jnp.arange(ctx.capacity + 1, dtype=jnp.int32)
+                   * self.width)
+        return ColVal(dts.STRING, chars, cv.validity, offsets)
+
+
+class TimeWindow(UnaryExpression):
+    """window(ts, windowDuration[, slideDuration[, startTime]]) bucket
+    edge (GpuTimeWindow analog): floor the timestamp to its slide bucket
+    and emit the start or end edge.  ``functions.window`` wraps a pair
+    of these into the (start, end) struct."""
+
+    def __init__(self, child: Expression, window_us: int, slide_us: int,
+                 start_us: int = 0, field: str = "start",
+                 shift_us: int = 0):
+        super().__init__(child)
+        self.window_us = int(window_us)
+        self.slide_us = int(slide_us)
+        self.start_us = int(start_us)
+        self.field = field
+        # sliding windows: the i-th overlapping window is the slide
+        # bucket shifted back by i slides (Spark expands rows per
+        # overlap via Expand; functions.window wires that up)
+        self.shift_us = int(shift_us)
+
+    def with_children(self, children):
+        return TimeWindow(children[0], self.window_us, self.slide_us,
+                          self.start_us, self.field, self.shift_us)
+
+    @property
+    def dtype(self):
+        return dts.TIMESTAMP_US
+
+    def cache_key(self):
+        return ("TimeWindow", self.child.cache_key(), self.window_us,
+                self.slide_us, self.start_us, self.field, self.shift_us)
+
+    def eval_values(self, v, cv):
+        ts = v.astype(jnp.int64) * 86_400 * 1_000_000 \
+            if cv.dtype.is_date else v.astype(jnp.int64)
+        off = jnp.mod(ts - self.start_us, self.slide_us)
+        start = ts - off - self.shift_us
+        if self.field == "start":
+            return start
+        return start + self.window_us
+
+
+class ToUnixTimestamp(UnaryExpression):
+    """to_unix_timestamp(x): strings parse via the string->timestamp
+    cast; dates/timestamps convert directly.  Resolves at bind time into
+    ``UnixTimestamp`` (optionally over a Cast), so the planner only ever
+    sees registered expressions."""
+
+    @property
+    def dtype(self):
+        return dts.INT64
+
+    def bind(self, schema):
+        from spark_rapids_tpu.ops.cast import Cast
+        bound = self.child.bind(schema)
+        if bound.dtype.is_string:
+            bound = Cast(bound, dts.TIMESTAMP_US)
+        return UnixTimestamp(bound)

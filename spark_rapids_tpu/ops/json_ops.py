@@ -1,0 +1,157 @@
+"""JSON + array-producing string expressions (CPU-fallback surface).
+
+``GetJsonObject`` (reference GpuGetJsonObject rule) and ``StringSplit``
+(array-producing split; the indexed form is the device-side
+``regexops.SplitPart``) have no dense device representation here —
+JSONPath needs a byte-level parser and array<string> needs two offset
+levels — so both are *tagged* expressions: the planner routes any node
+containing them to ``CpuFallbackExec`` where ``_eval_pandas``
+implements the semantics, and the distributed planner's dictionary
+lowering (``dist_planner._try_dict_lower``) still evaluates
+GetJsonObject host-side over the K distinct values so queries over
+encoded columns stay on the mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.expressions import Expression
+
+# array<string> exists only on the host surface (CPU-fallback frames
+# hold python lists); the device columnar layer is single-level, so the
+# type is constructed directly instead of via ArrayType's validator
+ARRAY_STRING = DataType("array<string>", np.dtype(np.uint8),
+                        element=dts.STRING)
+
+
+_PATH_RE = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\['([^']*)'\]")
+
+
+def parse_json_path(path: str) -> Optional[List[object]]:
+    """'$.a.b[0]' -> ['a', 'b', 0]; None when not the supported subset."""
+    if not path.startswith("$"):
+        return None
+    out: List[object] = []
+    i = 1
+    while i < len(path):
+        m = _PATH_RE.match(path, i)
+        if m is None:
+            return None
+        if m.group(1) is not None:
+            out.append(m.group(1))
+        elif m.group(2) is not None:
+            out.append(int(m.group(2)))
+        else:
+            out.append(m.group(3))
+        i = m.end()
+    return out
+
+
+def eval_json_path(doc: str, steps: List[object]) -> Optional[str]:
+    """Spark get_json_object semantics: strings come back raw, other
+    values as compact JSON text, missing paths/bad JSON as null."""
+    try:
+        v = json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or not 0 <= s < len(v):
+                return None
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None
+            v = v[s]
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    return json.dumps(v, separators=(",", ":"))
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json_str, '$.path') -> string."""
+
+    def __init__(self, child: Expression, path: str):
+        self.children = (child,)
+        self.path = path
+        self.steps = parse_json_path(path)
+
+    def with_children(self, children):
+        return GetJsonObject(children[0], self.path)
+
+    @property
+    def dtype(self):
+        return dts.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def name(self):
+        return f"get_json_object({self.children[0].name}, {self.path})"
+
+    def emit(self, ctx):
+        raise RuntimeError(
+            "GetJsonObject has no device kernel; it executes on the CPU "
+            "fallback (or via the distributed planner's dictionary "
+            "lowering)")
+
+    def cache_key(self):
+        return ("GetJsonObject", self.children[0].cache_key(), self.path)
+
+    def eval_host(self, value: Optional[str]) -> Optional[str]:
+        if value is None or self.steps is None:
+            return None
+        return eval_json_path(value, self.steps)
+
+
+class StringSplit(Expression):
+    """split(str, regex[, limit]) -> array<string> (Spark split)."""
+
+    def __init__(self, child: Expression, pattern: str, limit: int = -1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.limit = limit
+
+    def with_children(self, children):
+        return StringSplit(children[0], self.pattern, self.limit)
+
+    @property
+    def dtype(self):
+        return ARRAY_STRING
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def name(self):
+        return f"split({self.children[0].name}, {self.pattern!r})"
+
+    def emit(self, ctx):
+        raise RuntimeError(
+            "StringSplit (array-producing) has no device kernel; it "
+            "executes on the CPU fallback — use split_part for the "
+            "indexed device form")
+
+    def cache_key(self):
+        return ("StringSplit", self.children[0].cache_key(),
+                self.pattern, self.limit)
+
+    def eval_host(self, value: Optional[str]):
+        if value is None:
+            return None
+        # Spark split: regex semantics; limit<=0 keeps trailing empties
+        if self.limit > 0:
+            return re.split(self.pattern, value, self.limit - 1)
+        return re.split(self.pattern, value)

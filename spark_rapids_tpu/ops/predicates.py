@@ -415,6 +415,57 @@ class In(Expression):
         return ColVal(dts.BOOL, hit, validity)
 
 
+class InSet(Expression):
+    """value IN (large literal set) — the GpuInSet analog: instead of
+    chaining K equality ops (the ``In`` lowering), the distinct values
+    sit in a sorted device table and membership is one searchsorted +
+    gather per row.  Fixed-width types only; ``functions.isin`` switches
+    to this form past a size threshold."""
+
+    def __init__(self, child: Expression, values):
+        import numpy as np
+        self.children = (child,)
+        vals = [v for v in values if v is not None]
+        self.has_null = len(vals) != len(list(values))
+        self.table = np.unique(np.asarray(vals)) if vals else \
+            np.zeros(0, dtype=np.int64)
+
+    def with_children(self, children):
+        vals = list(self.table)
+        if self.has_null:
+            vals.append(None)
+        return InSet(children[0], vals)
+
+    @property
+    def dtype(self):
+        return dts.BOOL
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        v = self.children[0].emit(ctx)
+        if len(self.table) == 0:
+            hit = jnp.zeros(ctx.capacity, dtype=jnp.bool_)
+        else:
+            table = jnp.asarray(
+                self.table.astype(self.children[0].dtype.storage))
+            idx = jnp.searchsorted(table, v.values)
+            idx = jnp.clip(idx, 0, len(self.table) - 1)
+            hit = table[idx] == v.values
+        base = v.validity if v.validity is not None else jnp.bool_(True)
+        # match -> true; no match with a null in the set -> null
+        validity = jnp.logical_and(
+            base, jnp.logical_or(hit, not self.has_null))
+        if getattr(hit, "ndim", 0) == 0:
+            hit = jnp.broadcast_to(hit, (ctx.capacity,))
+        return ColVal(dts.BOOL, hit, validity)
+
+    def cache_key(self):
+        return ("InSet", self.children[0].cache_key(), self.has_null,
+                self.table.tobytes())
+
+    def __str__(self):
+        return f"{self.children[0]} INSET[{len(self.table)}]"
+
+
 class Greatest(Expression):
     def __init__(self, *children: Expression):
         self.children = tuple(children)

@@ -113,6 +113,74 @@ _CMP = (preds.EqualTo, preds.LessThan, preds.LessThanOrEqual,
         preds.GreaterThan, preds.GreaterThanOrEqual)
 
 
+class DictLookup(Expression):
+    """Gather through a per-dictionary lookup table: ``lut[codes]``.
+
+    The distributed lowering for ANY expression over a single encoded
+    string column (LIKE, regex, substring, length, ...): the original
+    expression is evaluated ONCE host-side over the K dictionary values
+    (K = distinct strings, tiny) and becomes an O(1)-per-row gather on
+    device.  String-valued results re-encode against a fresh sorted
+    dictionary (``dict_values``), so they stay sortable/groupable codes.
+    """
+
+    def __init__(self, child: Expression, lut_values, lut_valid,
+                 dtype: DataType, dict_values=None, label: str = "f"):
+        self.children = (child,)
+        self.lut_values = np.asarray(lut_values)
+        self.lut_valid = np.asarray(lut_valid, dtype=bool)
+        self._dtype = dtype
+        self.dict_values = dict_values  # set when result is encoded str
+        self.label = label
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return self.label
+
+    def with_children(self, children):
+        return DictLookup(children[0], self.lut_values, self.lut_valid,
+                          self._dtype, self.dict_values, self.label)
+
+    def emit(self, ctx) -> ColVal:
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops.expressions import combine_validity
+        c = self.children[0].emit(ctx)
+        k = max(len(self.lut_values), 1)
+        lut = jnp.asarray(self.lut_values) if len(self.lut_values) else \
+            jnp.zeros(1, dtype=self._dtype.storage)
+        lval = jnp.asarray(self.lut_valid) if len(self.lut_valid) else \
+            jnp.zeros(1, dtype=jnp.bool_)
+        idx = jnp.clip(c.values, 0, k - 1).astype(jnp.int32)
+        return ColVal(self._dtype, lut[idx],
+                      combine_validity(c.validity, lval[idx]))
+
+    def cache_key(self):
+        import hashlib
+        h = hashlib.sha1(self.lut_values.tobytes() +
+                         self.lut_valid.tobytes()).hexdigest()[:16]
+        return ("DictLookup", self.children[0].cache_key(),
+                self._dtype.name, h)
+
+    def __str__(self):
+        return f"DictLookup[{self.label}]"
+
+
+# register with the support-tagging framework (reused by
+# _check_supported); any fixed-width result type flows through
+from spark_rapids_tpu.plan import typechecks as _ts  # noqa: E402
+from spark_rapids_tpu.plan.overrides import expr_rule as _expr_rule  # noqa: E402
+
+_expr_rule(DictLookup, _ts.ALL)
+
+
 class ExprLowering:
     """Rewrite a bound expression for the encoded physical frame:
     references to string columns become int64 code references, and
@@ -121,8 +189,9 @@ class ExprLowering:
     dictionaries (dry mode) the rewrite still type-checks — codes just
     come out as never-matching sentinels."""
 
-    def __init__(self, enc: Dict[int, List[Optional[str]]]):
+    def __init__(self, enc: Dict[int, List[Optional[str]]], conf=None):
         self.enc = enc
+        self.conf = conf
 
     def lower(self, e: Expression) -> Expression:
         if isinstance(e, Alias):
@@ -146,19 +215,134 @@ class ExprLowering:
             return type(e)(self.lower(e.children[0]))
         if isinstance(e, AggregateExpression):
             return self.lower_agg(e)
-        for c in e.children:
-            if c.dtype.is_string:
-                raise NotDistributable(
-                    f"{type(e).__name__} over string operands has no "
-                    "code-space lowering (only =, <, <=, >, >=, IN, "
-                    "IS NULL against literals)")
-        if e.dtype.is_string:
+        if any(c.dtype.is_string for c in e.children) or e.dtype.is_string:
+            # expression over / producing strings: try the dictionary
+            # lowering (host-evaluate over the K distinct values, gather
+            # through a LUT on device)
+            d = self._try_dict_lower(e)
+            if d is not None:
+                return d
             raise NotDistributable(
-                f"{type(e).__name__} produces strings; string-producing "
-                "expressions do not run distributed")
+                f"{type(e).__name__} over strings has no code-space "
+                "lowering (not a function of one encoded column and "
+                "literals)")
         if not e.children:
             return e
         return e.with_children([self.lower(c) for c in e.children])
+
+    # -- dictionary lowering ---------------------------------------------
+    def _dict_lower_candidate(self, e: Expression) -> Optional[int]:
+        """The single encoded ordinal this subtree is a function of, or
+        None when it is not dict-lowerable (multiple columns, non-
+        literal leaves, aggregates/windows/UDFs inside)."""
+        from spark_rapids_tpu.exec.window import WindowExpression
+        ords = set()
+        ok = True
+
+        def walk(x):
+            nonlocal ok
+            if isinstance(x, (AggregateExpression, WindowExpression)):
+                ok = False
+                return
+            if type(x).__name__ in ("PythonUDF", "JaxUDF"):
+                ok = False
+                return
+            if isinstance(x, BoundReference):
+                if x.ordinal in self.enc:
+                    ords.add(x.ordinal)
+                else:
+                    ok = False  # mixed with a non-encoded column
+                return
+            for c in x.children:
+                walk(c)
+
+        walk(e)
+        if not ok or len(ords) != 1:
+            return None
+        if e.dtype.has_offsets and not e.dtype.is_string:
+            return None
+        if e.dtype.is_nested:
+            return None
+        return ords.pop()
+
+    def _try_dict_lower(self, e: Expression) -> Optional[Expression]:
+        """Evaluate ``e`` host-side over the dictionary of its single
+        encoded column; return a DictLookup, or None."""
+        ordinal = self._dict_lower_candidate(e)
+        if ordinal is None:
+            return None
+        # device-supported subtrees evaluate via the engine's own emit;
+        # CPU-fallback-only expressions (GetJsonObject, exotic regex...)
+        # evaluate via the pandas fallback evaluator instead — either
+        # way the work is O(K distinct values) on host
+        use_pandas = False
+        if self.conf is not None:
+            from spark_rapids_tpu.plan.overrides import ExprMeta
+            em = ExprMeta(e, self.conf)
+            em.tag()
+            use_pandas = not em.can_replace
+        values = [v for v in self.enc[ordinal] if v is not None]
+        k = len(values)
+        codes = BoundReference(ordinal, dts.INT64, name=f"_c{ordinal}")
+
+        def replace(x):
+            if isinstance(x, BoundReference) and x.ordinal == ordinal:
+                return BoundReference(0, x.dtype, name=x.name,
+                                      nullable=False)
+            if not x.children:
+                return x
+            return x.with_children([replace(c) for c in x.children])
+
+        label = f"{type(e).__name__}(dict)"
+        if k == 0:
+            if e.dtype.is_string:
+                return DictLookup(codes, np.zeros(0, np.int64),
+                                  np.zeros(0, bool), dts.INT64,
+                                  dict_values=[], label=label)
+            return DictLookup(codes, np.zeros(0, e.dtype.storage),
+                              np.zeros(0, bool), e.dtype, label=label)
+        if use_pandas:
+            import pandas as pd
+            from spark_rapids_tpu.exec.fallback import _eval_pandas
+            try:
+                res = _eval_pandas(replace(e),
+                                   pd.DataFrame({"_c": values}))
+            except NotImplementedError:
+                return None
+            if e.dtype.is_string:
+                strs = [None if pd.isna(r) else r for r in res]
+                return self._string_lut(codes, strs, label)
+            valid = res.notna().to_numpy()
+            vals = res.fillna(0).to_numpy().astype(e.dtype.storage)
+            return DictLookup(codes, vals, valid, e.dtype, label=label)
+        col = Column.from_strings(values)
+        cv = ColVal(dts.STRING, col.data, None, col.offsets)
+        ctx = EmitContext([cv], jnp.int32(k), col.capacity)
+        out = replace(e).emit(ctx)
+        if e.dtype.is_string:
+            res = Column(dts.STRING, out.values, k, validity=out.validity,
+                         offsets=out.offsets).to_pylist()
+            return self._string_lut(codes, res, label)
+        vo = np.asarray(out.values)
+        vals = np.broadcast_to(vo, (k,)) if vo.ndim == 0 else vo[:k]
+        if out.validity is None:
+            valid = np.ones(k, dtype=bool)
+        else:
+            vv = np.asarray(out.validity)
+            valid = np.broadcast_to(vv, (k,)) if vv.ndim == 0 else vv[:k]
+        return DictLookup(codes, vals.astype(e.dtype.storage), valid,
+                          e.dtype, label=label)
+
+    @staticmethod
+    def _string_lut(codes, res, label):
+        """Re-encode K string results against a fresh sorted dict."""
+        new_dict = sorted({r for r in res if r is not None})
+        lut = np.array(
+            [bisect.bisect_left(new_dict, r) if r is not None else 0
+             for r in res], dtype=np.int64)
+        lut_valid = np.array([r is not None for r in res], dtype=bool)
+        return DictLookup(codes, lut, lut_valid, dts.INT64,
+                          dict_values=new_dict, label=label)
 
     def lower_agg(self, e: AggregateExpression) -> AggregateExpression:
         import copy
@@ -183,6 +367,34 @@ class ExprLowering:
             return inner
         return None
 
+    def out_dict(self, lowered: Expression):
+        """Dictionary of a LOWERED expression's output codes, if it has
+        one (bare encoded ref pass-through, or a DictLookup re-encode)."""
+        inner = lowered.children[0] if isinstance(lowered, Alias) \
+            else lowered
+        if isinstance(inner, BoundReference) and inner.ordinal in self.enc:
+            return self.enc[inner.ordinal]
+        if isinstance(inner, DictLookup) and inner.dict_values is not None:
+            return inner.dict_values
+        return None
+
+    def _encoded_operand(self, e: Expression):
+        """(codes_expr, sorted_values) for a string subtree with a code
+        representation: a bare encoded ref, or a dict-lowerable function
+        of one (substring(c_phone, 1, 2), concat(s, '_x'), ...)."""
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, BoundReference) and inner.ordinal in self.enc:
+            codes = BoundReference(inner.ordinal, dts.INT64,
+                                   name=inner.name,
+                                   nullable=inner.nullable)
+            return codes, [v for v in self.enc[inner.ordinal]
+                           if v is not None]
+        if inner.dtype.is_string:
+            d = self._try_dict_lower(inner)
+            if d is not None and d.dict_values is not None:
+                return d, d.dict_values
+        return None
+
     def _ref_and_literal(self, e):
         l, r = e.children
         if isinstance(r, Literal) and not isinstance(l, Literal):
@@ -193,16 +405,17 @@ class ExprLowering:
 
     def _lower_cmp(self, e):
         pair = self._ref_and_literal(e)
-        ref = self.encoded_ref(pair[0]) if pair else None
-        if pair is None or ref is None or \
+        op = self._encoded_operand(pair[0]) if pair else None
+        if pair is None or op is None or \
                 not isinstance(pair[1].value, str):
+            d = self._try_dict_lower(e)
+            if d is not None:
+                return d
             raise NotDistributable(
-                f"string comparison {e} is not (encoded column vs "
+                f"string comparison {e} is not (encoded expression vs "
                 "literal); no code-space lowering")
         _, lit, flipped = pair
-        values = [v for v in self.enc[ref.ordinal] if v is not None]
-        codes = BoundReference(ref.ordinal, dts.INT64, name=ref.name,
-                               nullable=ref.nullable)
+        codes, values = op
         cls = type(e)
         if flipped:  # lit OP ref  ->  ref OP' lit
             cls = {preds.LessThan: preds.GreaterThan,
@@ -228,17 +441,18 @@ class ExprLowering:
             codes, Literal(np.int64(lo), dts.INT64))
 
     def _lower_in(self, e: preds.In):
-        ref = self.encoded_ref(e.children[0])
+        op = self._encoded_operand(e.children[0])
         opts = e.children[1:]
-        if ref is None or not all(
+        if op is None or not all(
                 isinstance(o, Literal) and isinstance(o.value, str)
                 for o in opts):
+            d = self._try_dict_lower(e)
+            if d is not None:
+                return d
             raise NotDistributable(
-                "string IN is only supported as encoded column IN "
-                "(literals...) on the mesh")
-        values = [v for v in self.enc[ref.ordinal] if v is not None]
-        codes = BoundReference(ref.ordinal, dts.INT64, name=ref.name,
-                               nullable=ref.nullable)
+                "string IN is only supported as an encoded expression "
+                "IN (literals...) on the mesh")
+        codes, values = op
         hits = []
         for o in opts:
             i = bisect.bisect_left(values, o.value)
@@ -440,7 +654,7 @@ class DistPlanner:
     # -- filter / project -------------------------------------------------
     def _filter(self, plan: L.Filter, dry: bool) -> ShardedFrame:
         f = self.run(plan.child, dry)
-        low = ExprLowering(f.enc)
+        low = ExprLowering(f.enc, self.conf)
         cond = low.lower(plan.condition)
         _check_supported([cond], self.conf)
         if dry:
@@ -450,13 +664,14 @@ class DistPlanner:
 
     def _project(self, plan: L.Project, dry: bool) -> ShardedFrame:
         f = self.run(plan.child, dry)
-        low = ExprLowering(f.enc)
+        low = ExprLowering(f.enc, self.conf)
         exprs, enc = [], {}
         for i, e in enumerate(plan.exprs):
-            exprs.append(low.lower(e))
-            src = low.encoded_ref(e)
-            if src is not None:
-                enc[i] = f.enc[src.ordinal]
+            le = low.lower(e)
+            exprs.append(le)
+            d = low.out_dict(le)
+            if d is not None:
+                enc[i] = d
         _check_supported(exprs, self.conf)
         names = [n for n, _ in plan.schema]
         log_dtypes = [dt for _, dt in plan.schema]
@@ -471,7 +686,7 @@ class DistPlanner:
     def _aggregate(self, plan: L.Aggregate, dry: bool) -> ShardedFrame:
         from spark_rapids_tpu.ops import aggregates as agg
         f = self.run(plan.child, dry)
-        low = ExprLowering(f.enc)
+        low = ExprLowering(f.enc, self.conf)
         group_exprs = [low.lower(e) for e in plan.group_exprs]
         nkeys = len(group_exprs)
 
@@ -502,19 +717,20 @@ class DistPlanner:
         _check_supported(group_exprs, self.conf)
         _check_supported(agg_list, self.conf)
 
-        # enc propagation: bare encoded group keys and min/max/first/last
-        # over bare encoded refs keep their dictionaries
+        # enc propagation: encoded group keys (bare or re-encoded) and
+        # min/max/first/last over encoded children keep their
+        # dictionaries
         agg_enc = {}
-        for i, orig in enumerate(plan.group_exprs):
-            src = low.encoded_ref(orig)
-            if src is not None:
-                agg_enc[i] = f.enc[src.ordinal]
+        for i, ge in enumerate(group_exprs):
+            d = low.out_dict(ge)
+            if d is not None:
+                agg_enc[i] = d
         for idx, a in enumerate(agg_list):
             if isinstance(a.func, (agg.Min, agg.Max, agg.First, agg.Last)):
-                ch = a.func.child
-                if isinstance(ch, BoundReference) and \
-                        ch.ordinal in f.enc:
-                    agg_enc[nkeys + idx] = f.enc[ch.ordinal]
+                d = low.out_dict(a.func.child) \
+                    if a.func.child is not None else None
+                if d is not None:
+                    agg_enc[nkeys + idx] = d
         key_schema = [(e.name, e.dtype) for e in plan.group_exprs]
         agg_schema = key_schema + [(f"_a{i}", a.dtype)
                                    for i, a in enumerate(agg_list)]
@@ -588,8 +804,10 @@ class DistPlanner:
                     "(per-table dictionaries do not align)")
         left = self.run(plan.left, dry)
         right = self.run(plan.right, dry)
-        lkeys = [ExprLowering(left.enc).lower(e) for e in plan.left_keys]
-        rkeys = [ExprLowering(right.enc).lower(e) for e in plan.right_keys]
+        lkeys = [ExprLowering(left.enc, self.conf).lower(e)
+                 for e in plan.left_keys]
+        rkeys = [ExprLowering(right.enc, self.conf).lower(e)
+                 for e in plan.right_keys]
         _check_supported(lkeys + rkeys, self.conf)
 
         swapped = plan.join_type == "right"
@@ -616,7 +834,7 @@ class DistPlanner:
 
         cond = None
         if plan.condition is not None:
-            cond = ExprLowering(out_enc).lower(plan.condition)
+            cond = ExprLowering(out_enc, self.conf).lower(plan.condition)
             _check_supported([cond], self.conf)
 
         # USING joins dedup the key columns; the PRESERVED side supplies
@@ -726,7 +944,7 @@ class DistPlanner:
 
     # -- sort / limit / topn ---------------------------------------------
     def _lower_orders(self, orders, f: ShardedFrame):
-        low = ExprLowering(f.enc)
+        low = ExprLowering(f.enc, self.conf)
         keys = [low.lower(e) for e, _, _ in orders]
         _check_supported(keys, self.conf)
         desc = [d for _, d, _ in orders]

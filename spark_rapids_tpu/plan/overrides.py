@@ -72,8 +72,11 @@ from spark_rapids_tpu.ops import datetime_ops as D  # noqa: E402
 for c in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek, D.WeekDay,
           D.DayOfYear, D.LastDay, D.Hour, D.Minute, D.Second, D.DateAdd,
           D.DateSub, D.DateDiff, D.AddMonths, D.MonthsBetween, D.TruncDate,
-          D.UnixTimestamp, D.FromUnixTime, D.TimeAdd):
+          D.UnixTimestamp, D.FromUnixTime, D.TimeAdd, D.DateFormatClass,
+          D.TimeWindow):
     expr_rule(c, ts.COMMON)
+# GetJsonObject / StringSplit (ops/json_ops.py) have NO rule on purpose:
+# they are host-only (CPU fallback + distributed dictionary lowering)
 
 # arithmetic + math (numeric only)
 for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
@@ -133,14 +136,21 @@ from spark_rapids_tpu.udf.python_exec import JaxUDF  # noqa: E402
 
 expr_rule(JaxUDF, ts.ALL)
 
+# Expand (rollup/cube/grouping sets lowering, GpuExpandExec rule analog
+# — reference GpuOverrides.scala:3170): typed NULL slots for the
+# aggregated-away keys
+from spark_rapids_tpu.exec.expand import NullLiteral  # noqa: E402
+
+expr_rule(NullLiteral, ts.ALL)
+
 # predicates / conditionals (any common type flows through)
 for c in (preds.EqualTo, preds.EqualNullSafe, preds.LessThan,
           preds.LessThanOrEqual, preds.GreaterThan, preds.GreaterThanOrEqual,
           preds.And, preds.Or, preds.Not, preds.IsNull, preds.IsNotNull,
           preds.IsNaN, preds.NaNvl, preds.Coalesce, preds.If, preds.CaseWhen,
-          preds.In, preds.Greatest, preds.Least, preds.AtLeastNNonNulls,
-          preds.KnownNotNull, preds.KnownFloatingPointNormalized,
-          preds.NormalizeNaNAndZero):
+          preds.In, preds.InSet, preds.Greatest, preds.Least,
+          preds.AtLeastNNonNulls, preds.KnownNotNull,
+          preds.KnownFloatingPointNormalized, preds.NormalizeNaNAndZero):
     expr_rule(c)
 
 
@@ -239,6 +249,15 @@ class ExprMeta(BaseMeta):
         if isinstance(expr, S.Like) and not expr.supported:
             self.will_not_work(
                 f"LIKE pattern {expr.pattern!r} too general for TPU")
+        if isinstance(expr, D.DateFormatClass) and not expr.supported:
+            self.will_not_work(
+                f"date_format pattern {expr.fmt!r} outside the "
+                "fixed-width device subset (yyyy/MM/dd/HH/mm/ss)")
+        if isinstance(expr, preds.InSet) and \
+                expr.children[0].dtype.is_string:
+            self.will_not_work(
+                "InSet over strings has no device table; use IN "
+                "(literals)")
         if isinstance(expr, (RX.RLike, RX.RegExpReplace, RX.StringReplace,
                              RX.Translate, RX.SplitPart)) and \
                 not expr.supported:
@@ -348,6 +367,9 @@ def _deep_reasons(meta: BaseMeta) -> List[str]:
 
 
 def _node_expressions(plan: L.LogicalPlan) -> List[Expression]:
+    from spark_rapids_tpu.exec.expand import Expand
+    if isinstance(plan, Expand):
+        return [e for p in plan.projections for e in p]
     if isinstance(plan, L.Project):
         return list(plan.exprs)
     if isinstance(plan, L.Generate):
@@ -541,9 +563,37 @@ def _conv_generate(node: L.Generate, children, conf):
                            generator2=node.generator2)
 
 
+def _register_expand_converter():
+    from spark_rapids_tpu.exec.expand import Expand, TpuExpandExec
+
+    @_converter(Expand)
+    def _conv_expand(node, children, conf):
+        return TpuExpandExec(node, children[0])
+
+
+_register_expand_converter()
+
+
 @_converter(L.Window)
 def _conv_window(node: L.Window, children, conf):
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.exec.sort import TpuSortExec
     from spark_rapids_tpu.exec.window import TpuWindowExec
+    spec = node.window_exprs[0][1].spec
+    if spec.partition_exprs or spec.orders:
+        # Spark plans WindowExec above a SortExec on (partition, order);
+        # the sort brings the engine's out-of-core machinery, and the
+        # window then streams key-aligned chunks instead of
+        # materializing its whole input (GpuWindowExec.scala:423-446 +
+        # GpuKeyBatchingIterator analog)
+        orders = [(e, False, True) for e in spec.partition_exprs] + \
+            list(spec.orders)
+        sort = TpuSortExec(
+            orders, children[0],
+            ooc_threshold_bytes=conf.get(rc.SORT_OOC_THRESHOLD),
+            ooc_window_rows=conf.get(rc.SORT_OOC_WINDOW_ROWS))
+        return TpuWindowExec(node.window_exprs, sort, presorted=True,
+                             batch_rows=conf.get(rc.WINDOW_BATCH_ROWS))
     return TpuWindowExec(node.window_exprs, children[0])
 
 

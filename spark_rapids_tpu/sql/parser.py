@@ -155,6 +155,9 @@ class SelectStmt:
     limit: Optional[int]
     distinct: bool
     union_all: Optional["SelectStmt"] = None
+    # ROLLUP/CUBE/GROUPING SETS: per output replica, the indices into
+    # group_by that stay live (None = plain GROUP BY)
+    group_sets: Optional[List[List[int]]] = None
 
 
 # -------------------------------------------------------------------- lexer --
@@ -297,11 +300,63 @@ class Parser:
                 joins.append(j)
         where = self.expr() if self.eat_kw("where") else None
         group_by: List[object] = []
+        group_sets: Optional[List[List[int]]] = None
         if self.eat_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
-            while self.eat_op(","):
+            # ROLLUP/CUBE/GROUPING are contextual (not reserved): they
+            # only take effect as the head of the GROUP BY list followed
+            # by "(", so columns named rollup/cube/grouping still work
+            if (self.cur.kind == "ident"
+                    and self.cur.value.lower() in ("rollup", "cube")
+                    and self.i + 1 < len(self.toks)
+                    and self.toks[self.i + 1].kind == "op"
+                    and self.toks[self.i + 1].value == "("):
+                kind = self.advance().value.lower()
+                self.expect_op("(")
                 group_by.append(self.expr())
+                while self.eat_op(","):
+                    group_by.append(self.expr())
+                self.expect_op(")")
+                n = len(group_by)
+                if kind == "rollup":
+                    group_sets = [list(range(k))
+                                  for k in range(n, -1, -1)]
+                else:
+                    group_sets = [
+                        [i for i in range(n) if m & (1 << (n - 1 - i))]
+                        for m in range((1 << n) - 1, -1, -1)]
+            elif (self.cur.kind == "ident"
+                  and self.cur.value.lower() == "grouping"
+                  and self.i + 1 < len(self.toks)
+                  and self.toks[self.i + 1].kind == "ident"
+                  and self.toks[self.i + 1].value.lower() == "sets"):
+                self.advance()  # GROUPING (contextual, stays a valid
+                self.advance()  # function name elsewhere) + SETS
+                self.expect_op("(")
+                group_sets = []
+                key_reprs: List[str] = []
+                while True:
+                    self.expect_op("(")
+                    one: List[int] = []
+                    if not self.at_op(")"):
+                        while True:
+                            e = self.expr()
+                            r = repr(e)
+                            if r not in key_reprs:
+                                key_reprs.append(r)
+                                group_by.append(e)
+                            one.append(key_reprs.index(r))
+                            if not self.eat_op(","):
+                                break
+                    self.expect_op(")")
+                    group_sets.append(one)
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                group_by.append(self.expr())
+                while self.eat_op(","):
+                    group_by.append(self.expr())
         having = self.expr() if self.eat_kw("having") else None
         order_by: List[OrderItem] = []
         if self.eat_kw("order"):
@@ -317,7 +372,8 @@ class Parser:
             self.expect_kw("all")
             union_all = self.select_stmt()
         return SelectStmt(projections, from_, joins, where, group_by,
-                          having, order_by, limit, distinct, union_all)
+                          having, order_by, limit, distinct, union_all,
+                          group_sets=group_sets)
 
     def projection(self) -> Projection:
         if self.at_op("*"):

@@ -124,9 +124,25 @@ class Resolver:
         agg_keys: Dict[str, str] = {}  # structural key -> hidden name
 
         def lift_aggs(node):
-            """Replace aggregate subtrees with hidden column refs."""
+            """Replace aggregate subtrees with hidden column refs.
+            Under ROLLUP/CUBE/GROUPING SETS, grouping()/grouping_id()
+            calls lift the same way — GroupedData.agg resolves their
+            markers against the Expand-produced grouping-id column."""
             if isinstance(node, A.ScalarSubquery):
                 return node  # opaque: its aggregates are its own
+            if isinstance(node, A.FuncCall) and node.window is None \
+                    and stmt.group_sets is not None \
+                    and node.name in ("grouping", "grouping_id"):
+                key = repr(node)
+                if key not in agg_keys:
+                    hidden = f"__a{len(aggs)}"
+                    agg_keys[key] = hidden
+                    if node.name == "grouping_id":
+                        aggs[hidden] = self.F.grouping_id().alias(hidden)
+                    else:
+                        aggs[hidden] = self.F.grouping(
+                            self._expr(node.args[0], scope)).alias(hidden)
+                return A.ColRef((agg_keys[key],))
             if isinstance(node, A.FuncCall) and node.window is None \
                     and node.name in AGG_FNS:
                 key = repr(node)
@@ -200,7 +216,12 @@ class Resolver:
                 if stmt.having is not None else None
             if not aggs and not key_cols:
                 raise ValueError("grouped query with no aggregates")
-            df = df.group_by(*key_cols).agg(*aggs.values())
+            if stmt.group_sets is not None:
+                df = df.groupingSets(
+                    [[key_cols[i] for i in s] for s in stmt.group_sets],
+                    *key_cols).agg(*aggs.values())
+            else:
+                df = df.group_by(*key_cols).agg(*aggs.values())
             # post-agg scope: original aliases keep their surviving
             # group keys so qualified refs (c.name) still resolve; the
             # anonymous source holds only the hidden names
@@ -621,6 +642,18 @@ class Resolver:
             return F.substring(args[0], int(lit_arg(1)),
                                int(lit_arg(2)) if len(args) > 2
                                else 2 ** 31 - 1)
+        if n == "get_json_object":
+            return F.get_json_object(args[0], lit_arg(1))
+        if n == "split":
+            return F.split(args[0], lit_arg(1),
+                           int(lit_arg(2)) if len(args) > 2 else -1)
+        if n == "date_format":
+            return F.date_format(args[0], lit_arg(1))
+        if n == "to_unix_timestamp":
+            return F.to_unix_timestamp(args[0])
+        if n == "window":
+            return F.window(args[0], lit_arg(1),
+                            lit_arg(2) if len(args) > 2 else None)
         if n == "concat_ws":
             return F.concat_ws(lit_arg(0), *args[1:])
         if n in ("lpad", "rpad"):

@@ -168,12 +168,56 @@ def test_topn_and_limit(dist_session, oracle_session, frames):
     assert n == 123
 
 
-def test_unsupported_falls_back(dist_session, oracle_session, frames):
-    # string-producing expression: no distributed lowering -> fallback,
-    # same result
+def test_string_function_dict_lowering(dist_session, oracle_session,
+                                       frames):
+    """String-producing functions of ONE encoded column lower to a
+    dictionary re-encode (DictLookup) and stay distributed."""
     d, o = _both(dist_session, oracle_session, frames,
                  lambda f, _: f.select(F.upper(F.col("s")).alias("u"))
                  .groupBy("u").agg(F.count().alias("n")).orderBy("u"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_like_filter_distributed(dist_session, oracle_session, frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.filter(F.col("s").like("%a%"))
+                 .groupBy("s").agg(F.count().alias("n")).orderBy("s"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_substring_groupby_distributed(dist_session, oracle_session,
+                                       frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.groupBy(
+                     F.substring(F.col("s"), 1, 1).alias("initial"))
+                 .agg(F.count().alias("n"), F.min("s").alias("lo"))
+                 .orderBy("initial"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_length_projection_distributed(dist_session, oracle_session,
+                                       frames):
+    d, o = _both(dist_session, oracle_session, frames,
+                 lambda f, _: f.select(F.length(F.col("s")).alias("n"),
+                                       "k").orderBy("n", "k"))
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_unsupported_falls_back(dist_session, oracle_session, frames):
+    # a string expression over TWO encoded columns has no dictionary
+    # lowering -> fallback, same result
+    fact, dim = frames
+    f2 = fact.assign(s2=np.where(fact.k % 2 == 0, "x", "y"))
+    d = dist_session.create_dataframe(f2).select(
+        F.concat(F.col("s"), F.col("s2")).alias("c")).groupBy("c").agg(
+        F.count().alias("n")).orderBy("c")
+    o = oracle_session.create_dataframe(f2).select(
+        F.concat(F.col("s"), F.col("s2")).alias("c")).groupBy("c").agg(
+        F.count().alias("n")).orderBy("c")
     _cmp(d, o)
     assert dist_session.last_dist_explain.startswith("fallback")
 

@@ -1,0 +1,182 @@
+"""Expand exec + ROLLUP/CUBE/GROUPING SETS (GpuExpandExec analog,
+reference GpuOverrides.scala:3170 rule; grouping_id bit semantics match
+Spark's spark_grouping_id)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def df(session):
+    rng = np.random.default_rng(3)
+    n = 500
+    return session.create_dataframe(pd.DataFrame({
+        "a": rng.choice(["x", "y", "z"], n),
+        "b": rng.integers(0, 4, n),
+        "v": rng.uniform(-5, 5, n).round(3),
+    }))
+
+
+def pandas_rollup(pdf, keys, include_gid=False):
+    """Oracle: union of groupbys over each rollup level."""
+    frames = []
+    for k in range(len(keys), -1, -1):
+        live = keys[:k]
+        gid = sum(1 << (len(keys) - 1 - i) for i in range(k, len(keys)))
+        if live:
+            g = (pdf.groupby(live, dropna=False)
+                 .agg(sv=("v", "sum"), n=("v", "count")).reset_index())
+        else:
+            g = pd.DataFrame([{"sv": pdf.v.sum(), "n": len(pdf)}])
+        for dead in keys[k:]:
+            g[dead] = None
+        if include_gid:
+            g["g"] = gid
+        frames.append(g)
+    cols = keys + ["sv", "n"] + (["g"] if include_gid else [])
+    return pd.concat(frames)[cols].reset_index(drop=True)
+
+
+def _sorted(f, cols):
+    return f.sort_values(cols, ignore_index=True, na_position="first")
+
+
+def test_rollup_matches_pandas(df):
+    got = df.rollup("a", "b").agg(
+        F.sum("v").alias("sv"), F.count("v").alias("n")).to_pandas()
+    exp = pandas_rollup(df.to_pandas(), ["a", "b"])
+    got = _sorted(got, ["a", "b"])
+    exp = _sorted(exp, ["a", "b"]).astype(got.dtypes)
+    pd.testing.assert_frame_equal(got, exp, rtol=1e-9)
+
+
+def test_rollup_grouping_id(df):
+    got = df.rollup("a", "b").agg(
+        F.sum("v").alias("sv"), F.count("v").alias("n"),
+        F.grouping_id().alias("g")).to_pandas()
+    exp = pandas_rollup(df.to_pandas(), ["a", "b"], include_gid=True)
+    got = _sorted(got, ["g", "a", "b"])
+    exp = _sorted(exp, ["g", "a", "b"]).astype(got.dtypes)
+    pd.testing.assert_frame_equal(got, exp, rtol=1e-9)
+
+
+def test_cube_counts(df):
+    got = df.cube("a", "b").agg(F.count().alias("n")).to_pandas()
+    pdf = df.to_pandas()
+    # 4 grouping sets: (a,b), (a), (b), ()
+    n_ab = len(pdf.groupby(["a", "b"]))
+    n_a = pdf.a.nunique()
+    n_b = pdf.b.nunique()
+    assert len(got) == n_ab + n_a + n_b + 1
+    assert got["n"].sum() == 4 * len(pdf)
+
+
+def test_grouping_sets_explicit(df):
+    got = df.groupingSets([["a"], ["b"]], "a", "b").agg(
+        F.count().alias("n")).to_pandas()
+    pdf = df.to_pandas()
+    assert len(got) == pdf.a.nunique() + pdf.b.nunique()
+    # every row has exactly one non-null key
+    assert ((got.a.notna() ^ got.b.notna())).all()
+
+
+def test_grouping_function(df):
+    got = df.rollup("a").agg(F.count().alias("n"),
+                             F.grouping("a").alias("ga")).to_pandas()
+    assert set(got[got.a.isna()].ga) == {1}
+    assert set(got[got.a.notna()].ga) == {0}
+
+
+def test_real_null_vs_rolled_up_null(session):
+    """A real NULL key groups separately from the rollup total (the
+    reason grouping_id exists)."""
+    df = session.create_dataframe(pd.DataFrame(
+        {"a": ["x", None, None], "v": [1.0, 2.0, 3.0]}))
+    got = df.rollup("a").agg(F.sum("v").alias("sv"),
+                             F.grouping_id().alias("g")).to_pandas()
+    real_null = got[got.a.isna() & (got.g == 0)]
+    total = got[got.a.isna() & (got.g == 1)]
+    assert float(real_null.sv.iloc[0]) == 5.0
+    assert float(total.sv.iloc[0]) == 6.0
+
+
+def test_aggregate_over_grouping_column(session):
+    """Aggregating a grouping column must see the ORIGINAL values in
+    rolled-up rows (Spark duplicates grouping columns in Expand)."""
+    df = session.create_dataframe(pd.DataFrame(
+        {"k": [1, 2], "v": [10.0, 20.0]}))
+    got = df.rollup("k").agg(F.sum("k").alias("sk"),
+                             F.sum("v").alias("sv")).to_pandas()
+    total = got[got.k.isna()]
+    assert float(total.sk.iloc[0]) == 3.0
+    assert float(total.sv.iloc[0]) == 30.0
+
+
+def test_sql_column_named_rollup(session):
+    """rollup/cube stay valid identifiers outside GROUP BY heads."""
+    df = session.create_dataframe(pd.DataFrame(
+        {"rollup": [1, 2, 3], "cube": [4.0, 5.0, 6.0]}))
+    df.createOrReplaceTempView("shapes")
+    got = session.sql(
+        "SELECT rollup, cube FROM shapes ORDER BY rollup").to_pandas()
+    assert list(got["rollup"]) == [1, 2, 3]
+    got2 = session.sql(
+        "SELECT rollup, sum(cube) AS s FROM shapes GROUP BY rollup "
+        "ORDER BY rollup").to_pandas()
+    assert list(got2.s) == [4.0, 5.0, 6.0]
+
+
+def test_rollup_with_expression_key(df):
+    got = df.rollup((F.col("b") % 2).alias("parity")).agg(
+        F.count().alias("n")).to_pandas()
+    pdf = df.to_pandas()
+    assert len(got) == pdf.b.mod(2).nunique() + 1
+    assert got.n.sum() == 2 * len(pdf)
+
+
+def test_sql_rollup(session, df):
+    df.createOrReplaceTempView("exp_t")
+    got = session.sql("""
+        SELECT a, b, sum(v) AS sv, count(*) AS n
+        FROM exp_t GROUP BY ROLLUP(a, b)""").to_pandas()
+    exp = pandas_rollup(df.to_pandas(), ["a", "b"])
+    got = _sorted(got, ["a", "b"])
+    exp = _sorted(exp, ["a", "b"]).astype(got.dtypes)
+    pd.testing.assert_frame_equal(got, exp, rtol=1e-9)
+
+
+def test_sql_cube_grouping_id_having(session, df):
+    df.createOrReplaceTempView("exp_t")
+    got = session.sql("""
+        SELECT a, count(*) AS n, grouping_id() AS g
+        FROM exp_t GROUP BY CUBE(a, b)
+        HAVING grouping_id() = 1 ORDER BY a""").to_pandas()
+    pdf = df.to_pandas()
+    assert list(got.a) == sorted(pdf.a.unique())
+    assert set(got.g) == {1}
+
+
+def test_sql_grouping_sets(session, df):
+    df.createOrReplaceTempView("exp_t")
+    got = session.sql("""
+        SELECT a, b, count(*) AS n FROM exp_t
+        GROUP BY GROUPING SETS ((a), (b), ())""").to_pandas()
+    pdf = df.to_pandas()
+    assert len(got) == pdf.a.nunique() + pdf.b.nunique() + 1
+
+
+def test_sql_grouping_fn(session, df):
+    df.createOrReplaceTempView("exp_t")
+    got = session.sql("""
+        SELECT a, grouping(a) AS ga, count(*) AS n
+        FROM exp_t GROUP BY ROLLUP(a) ORDER BY ga, a""").to_pandas()
+    assert list(got.ga) == [0] * df.to_pandas().a.nunique() + [1]

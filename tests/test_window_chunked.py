@@ -1,0 +1,174 @@
+"""Bounded-memory window: key-aligned chunking + running-state carry.
+
+The round-2 verdict's item 5: window must stop concatenating its entire
+input.  The planner now inserts the engine's (out-of-core) sort under
+every partitioned window and the operator streams key-aligned chunks
+(GpuKeyBatchingIterator analog) with running-state carry for
+unbounded-preceding frames (GpuWindowExec.scala:423-446 running path).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import Window
+from spark_rapids_tpu.api.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    n = 6000
+    pdf = pd.DataFrame({
+        "g": rng.integers(0, 37, n),
+        "s": rng.choice(["ash", "birch", "cedar"], n),
+        "o": rng.permutation(n),
+        "v": rng.uniform(-3, 3, n).round(3),
+    })
+    pdf.loc[rng.choice(n, 150, replace=False), "v"] = np.nan
+    return pdf
+
+
+def chunked_session(batch_rows=512, **extra):
+    conf = {"spark.rapids.sql.window.batchRows": str(batch_rows)}
+    conf.update(extra)
+    return TpuSession(conf)
+
+
+def oracle_running(pdf, keys):
+    """Spark running-frame semantics: null inputs are skipped (the row
+    still reports the frame's aggregate); the result is null only when
+    the frame holds no non-null value."""
+    exp = pdf.sort_values(keys + ["o"]).copy()
+    gb = exp.groupby(keys, dropna=False)
+    exp["rn"] = gb.cumcount() + 1
+    exp["rc"] = gb["v"].transform(lambda s: s.notna().cumsum())
+    exp["rs"] = gb["v"].transform(lambda s: s.fillna(0).cumsum())
+    exp["rm"] = gb["v"].transform(
+        lambda s: s.fillna(np.inf).cummin())
+    exp.loc[exp.rc == 0, ["rs", "rm"]] = np.nan
+    return exp
+
+
+def test_chunked_running_window_matches_pandas(data):
+    s = chunked_session()
+    df = s.create_dataframe(data)
+    w = Window.partitionBy("g").orderBy("o")
+    got = df.select(
+        "g", "o",
+        F.sum("v").over(w).alias("rs"),
+        F.row_number().over(w).alias("rn"),
+        F.count("v").over(w).alias("rc"),
+        F.min("v").over(w).alias("rm"),
+        F.avg("v").over(w).alias("ra"),
+    ).orderBy("g", "o").to_pandas()
+    exp = oracle_running(data, ["g"])
+    exp["ra"] = exp.rs / exp.rc.replace(0, np.nan)
+    exp = exp.sort_values(["g", "o"])[
+        ["g", "o", "rs", "rn", "rc", "rm", "ra"]].reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True), exp, rtol=1e-9, check_dtype=False)
+
+
+def test_giant_partition_running_carry(data):
+    """One partition many times the chunk target: the running-state
+    carry crosses every chunk boundary."""
+    pdf = data.assign(g=0)
+    s = chunked_session(batch_rows=256)
+    df = s.create_dataframe(pdf)
+    w = Window.partitionBy("g").orderBy("o")
+    got = df.select("o", F.sum("v").over(w).alias("rs"),
+                    F.row_number().over(w).alias("rn")
+                    ).orderBy("o").to_pandas()
+    exp = pdf.sort_values("o").copy()
+    exp["rs"] = exp.v.fillna(0).cumsum()
+    exp.loc[exp.v.notna().cumsum() == 0, "rs"] = np.nan
+    exp["rn"] = np.arange(len(exp)) + 1
+    pd.testing.assert_frame_equal(
+        got[["o", "rs", "rn"]].reset_index(drop=True),
+        exp[["o", "rs", "rn"]].reset_index(drop=True), rtol=1e-9,
+        check_dtype=False)
+
+
+def test_rank_key_aligned_chunks(data):
+    """Non-running functions flush only at partition boundaries, so
+    rank/percent_rank stay exact across chunks."""
+    s = chunked_session(batch_rows=256)
+    df = s.create_dataframe(data)
+    w = Window.partitionBy("g").orderBy("o")
+    got = df.select("g", "o", F.rank().over(w).alias("rk"),
+                    F.percent_rank().over(w).alias("pr")
+                    ).orderBy("g", "o").to_pandas()
+    exp = data.sort_values(["g", "o"]).copy()
+    exp["rk"] = exp.groupby("g").o.rank(method="min")
+    cnt = exp.groupby("g").o.transform("count")
+    exp["pr"] = (exp.rk - 1) / (cnt - 1).clip(lower=1)
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True),
+        exp[["g", "o", "rk", "pr"]].reset_index(drop=True), rtol=1e-9,
+        check_dtype=False)
+
+
+def test_string_partition_keys_chunked(data):
+    s = chunked_session(batch_rows=512)
+    df = s.create_dataframe(data)
+    w = Window.partitionBy("s").orderBy("o")
+    got = df.select("s", "o", F.sum("v").over(w).alias("rs")
+                    ).orderBy("s", "o").to_pandas()
+    exp = oracle_running(data, ["s"]).sort_values(["s", "o"])[
+        ["s", "o", "rs"]].reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  rtol=1e-9, check_dtype=False)
+
+
+def test_window_batches_bounded(data):
+    """The operator emits MULTIPLE batches (not one concatenation) when
+    the input exceeds the chunk target."""
+    s = chunked_session(batch_rows=512)
+    df = s.create_dataframe(data)
+    w = Window.partitionBy("g").orderBy("o")
+    q = df.select("g", F.sum("v").over(w).alias("rs"))
+    batches = list(q.to_device_batches())
+    assert len(batches) > 4, len(batches)
+    assert sum(b.nrows for b in batches) == len(data)
+
+
+def test_range_frame_tie_runs_across_chunks():
+    """Default RANGE running frames include the whole order-key tie
+    run; chunk splits must land on run boundaries even when one
+    partition spans many chunks."""
+    n = 200
+    pdf = pd.DataFrame({
+        "g": np.zeros(n, np.int64),
+        "o": np.repeat(np.arange(n // 5), 5),  # ties of width 5
+        "v": np.ones(n),
+    })
+    s = chunked_session(batch_rows=16)  # splits try to land mid-run
+    df = s.create_dataframe(pdf)
+    w = Window.partitionBy("g").orderBy("o")
+    got = df.select("o", F.sum("v").over(w).alias("rs")).to_pandas()
+    # range frame: every member of tie run r sees (r+1)*5
+    exp = (got.o.to_numpy() + 1) * 5.0
+    assert np.allclose(got.rs.to_numpy(), exp)
+
+
+def test_window_over_spilling_sort(data):
+    """Input >> one batch with the OOC sort spilling under the window
+    (the verdict's done-criterion: spill recorded, answer exact)."""
+    s = chunked_session(
+        batch_rows=512,
+        **{"spark.rapids.sql.sort.outOfCoreThresholdBytes": "20000",
+           "spark.rapids.sql.sort.outOfCoreWindowRows": "1024",
+           # tiny device pool so the sort's spillable runs actually
+           # evict to host (records spilledToHostBytes)
+           "spark.rapids.memory.tpu.deviceLimitBytes": "65536"})
+    df = s.create_dataframe(data)
+    w = Window.partitionBy("g").orderBy("o")
+    got = df.select("g", "o", F.sum("v").over(w).alias("rs")
+                    ).orderBy("g", "o").to_pandas()
+    exp = oracle_running(data, ["g"]).sort_values(["g", "o"])[
+        ["g", "o", "rs"]].reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  rtol=1e-9, check_dtype=False)
+    assert s.memory_catalog.spilled_to_host_total > 0
