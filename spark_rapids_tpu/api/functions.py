@@ -883,36 +883,6 @@ def translate(c, from_str: str, to_str: str) -> Col:
     return Col(Translate(_expr(c), from_str, to_str))
 
 
-class _SplitCol(Col):
-    """Result of F.split: only ``getItem(n)`` is usable (arrays hold
-    fixed-width elements, so a standalone array<string> has no device
-    representation — the split+getItem pair fuses into SplitPart)."""
-
-    def __init__(self, child_expr, pattern: str):
-        self._child = child_expr
-        self._pattern = pattern
-        # no super().__init__: using the column without getItem must fail
-        # loudly rather than produce a bogus expression
-
-    @property
-    def expr(self):
-        raise TypeError(
-            "split(...) produces array<string>, which has no TPU "
-            "representation; use split(...).getItem(n)")
-
-    @expr.setter
-    def expr(self, v):  # pragma: no cover - Col.__init__ compat
-        pass
-
-    def getItem(self, n: int) -> Col:
-        from spark_rapids_tpu.ops.regexops import SplitPart
-        return Col(SplitPart(self._child, self._pattern, int(n)))
-
-
-def split(c, pattern: str) -> _SplitCol:
-    return _SplitCol(_expr(c), pattern)
-
-
 # ---------------------------------------------------------------- misc ids --
 
 def hash(*cols) -> Col:  # noqa: A001 - Spark calls it hash()

@@ -142,7 +142,8 @@ class ColumnarBatch:
                 cols[n] = Column(
                     c.dtype, cache.get(id(c.data), c.data), c.nrows,
                     validity=cache.get(id(c.validity), c.validity),
-                    offsets=cache.get(id(c.offsets), c.offsets))
+                    offsets=cache.get(id(c.offsets), c.offsets),
+                    dictionary=c.dictionary)
             return pa.table({n: c.to_arrow() for n, c in cols.items()})
         return pa.table({n: c.to_arrow() for n, c in self.columns.items()})
 

@@ -44,14 +44,17 @@ def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
 class Column:
     """One device column with logical length ``nrows`` and static capacity."""
 
-    __slots__ = ("dtype", "data", "validity", "offsets", "nrows")
+    __slots__ = ("dtype", "data", "validity", "offsets", "nrows",
+                 "dictionary")
 
     def __init__(self, dtype: DataType, data, nrows: int,
-                 validity=None, offsets=None):
+                 validity=None, offsets=None, dictionary=None):
         self.dtype = dtype
         self.data = data          # fixed-width values, or uint8 chars for string
         self.validity = validity  # bool[capacity] or None (all valid)
         self.offsets = offsets    # int32[capacity+1] for strings else None
+        self.dictionary = dictionary  # host list[str] when elements are
+        #                               dictionary codes (array<string>)
         self.nrows = int(nrows)
         if dtype.has_offsets and offsets is None:
             raise ValueError(f"{dtype} column requires offsets")
@@ -194,23 +197,42 @@ class Column:
         offsets = np.zeros(nrows + 1, dtype=np.int32)
         np.cumsum(lens, out=offsets[1:] if nrows else None)
         total = int(offsets[-1]) if nrows else 0
-        flat = np.array([e for r in rows for e in r],
-                        dtype=element.storage) if total else             np.zeros(0, dtype=element.storage)
+        dictionary = None
+        if element.is_string:
+            # variable-width elements: store int32 dictionary codes with a
+            # host-side string table (array<string> is a host-surface type)
+            flat_strs = [e for r in rows for e in r]
+            dictionary = sorted(set(flat_strs))
+            code = {s: i for i, s in enumerate(dictionary)}
+            flat = np.array([code[s] for s in flat_strs], dtype=np.int32) \
+                if total else np.zeros(0, dtype=np.int32)
+            storage = np.dtype(np.int32)
+        else:
+            flat = np.array([e for r in rows for e in r],
+                            dtype=element.storage) if total else \
+                np.zeros(0, dtype=element.storage)
+            storage = element.storage
         cap = capacity or bucket_capacity(nrows)
         ecap = elem_capacity or bucket_capacity(max(total, 1))
         off_buf = np.zeros(cap + 1, dtype=np.int32)
         off_buf[: nrows + 1] = offsets
         off_buf[nrows + 1:] = offsets[-1] if nrows else 0
-        elem_buf = np.zeros(ecap, dtype=element.storage)
+        elem_buf = np.zeros(ecap, dtype=storage)
         elem_buf[:total] = flat
         dev_validity = None
         if not valid.all():
             v = np.zeros(cap, dtype=np.bool_)
             v[:nrows] = valid
             dev_validity = jnp.asarray(v)
-        from spark_rapids_tpu.columnar.dtypes import ArrayType
-        return cls(ArrayType(element), jnp.asarray(elem_buf), nrows,
-                   validity=dev_validity, offsets=jnp.asarray(off_buf))
+        if element.is_string:
+            from spark_rapids_tpu.ops.json_ops import ARRAY_STRING
+            adt = ARRAY_STRING
+        else:
+            from spark_rapids_tpu.columnar.dtypes import ArrayType
+            adt = ArrayType(element)
+        return cls(adt, jnp.asarray(elem_buf), nrows,
+                   validity=dev_validity, offsets=jnp.asarray(off_buf),
+                   dictionary=dictionary)
 
     @classmethod
     def from_arrow(cls, arr, capacity: Optional[int] = None) -> "Column":
@@ -266,6 +288,10 @@ class Column:
             offs = np.asarray(self.offsets[: self.nrows + 1])
             elems = np.asarray(self.data)
             edt = self.dtype.element
+            if self.dictionary is not None:
+                table = self.dictionary
+                return [[table[int(v)] for v in elems[offs[i]:offs[i + 1]]]
+                        if valid[i] else None for i in range(self.nrows)]
             def conv(x):
                 if edt.is_boolean:
                     return bool(x)
@@ -315,7 +341,7 @@ class Column:
     # ------------------------------------------------------------------- misc --
     def with_nrows(self, nrows: int) -> "Column":
         return Column(self.dtype, self.data, nrows, validity=self.validity,
-                      offsets=self.offsets)
+                      offsets=self.offsets, dictionary=self.dictionary)
 
     def __repr__(self) -> str:
         return (f"Column({self.dtype}, nrows={self.nrows}, "

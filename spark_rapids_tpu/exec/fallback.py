@@ -157,7 +157,11 @@ def _eval_pandas(expr, df: pd.DataFrame):
             elif pos == 0:
                 start = 0
             else:
-                start = max(len(v) + pos, 0)
+                # Spark substringSQL: the window is [len+pos, len+pos+ln)
+                # BEFORE clamping, so a far-negative pos eats into ln
+                start = len(v) + pos
+                end = start + ln
+                return v[max(start, 0):max(end, 0)]
             return v[start:start + ln]
 
         return child.map(lambda v: None if _isnull(v) else sub(v))
@@ -192,7 +196,7 @@ def _eval_pandas(expr, df: pd.DataFrame):
 
         def edge(v):
             ts = pd.Timestamp(v).value // 1000  # ns -> us
-            start = ts - (ts - e.start_us) % e.slide_us
+            start = ts - (ts - e.start_us) % e.slide_us - e.shift_us
             out = start if e.field == "start" else start + e.window_us
             return pd.Timestamp(out * 1000)
 
@@ -312,6 +316,11 @@ def _eval_pandas(expr, df: pd.DataFrame):
                           for v, x in zip(arr, val)])
     raise NotImplementedError(
         f"CPU fallback cannot evaluate {type(e).__name__}")
+
+
+def _is_expand(node) -> bool:
+    from spark_rapids_tpu.exec.expand import Expand
+    return isinstance(node, Expand)
 
 
 class CpuFallbackExec(TpuExec):
@@ -486,6 +495,22 @@ class CpuFallbackExec(TpuExec):
                     out_cols[name] = _eval_pandas(spec[1], agg_frame)
             out = pd.DataFrame(out_cols,
                                columns=[n for n, _ in node.schema])
+        elif _is_expand(node):
+            from spark_rapids_tpu.exec.expand import NullLiteral
+            df = self._child_pandas(0)
+            reps = []
+            for proj in node.projections:
+                cols = {}
+                for name, e in zip(node.names, proj):
+                    if isinstance(e, NullLiteral):
+                        cols[name] = pd.Series([None] * len(df),
+                                               dtype=object)
+                    else:
+                        cols[name] = _eval_pandas(e, df).reset_index(
+                            drop=True)
+                reps.append(pd.DataFrame(cols, columns=node.names))
+            out = pd.concat(reps, ignore_index=True) if reps else \
+                pd.DataFrame(columns=node.names)
         elif isinstance(node, L.Generate):
             df = self._child_pandas(0)
             arrs = _eval_pandas(node.generator, df)

@@ -14,6 +14,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dts
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -441,11 +442,35 @@ class TpuWindowExec(TpuExec):
                 n = n + prev[1]
         return (s, n)
 
+    @staticmethod
+    def _key_at(part_keys, i: int):
+        """Host (value, valid) tuple per partition key at row ``i`` (one
+        tiny sync; string keys are already stable dictionary codes)."""
+        out = []
+        for k in part_keys:
+            v = np.asarray(k.values[i]).item()
+            valid = True if k.validity is None \
+                else bool(np.asarray(k.validity[i]))
+            out.append((v, valid))
+        return out
+
+    @staticmethod
+    def _keys_equal(a, b) -> bool:
+        for (va, na), (vb, nb) in zip(a, b):
+            if na != nb:
+                return False
+            if na and va != vb:
+                if not (isinstance(va, float) and isinstance(vb, float)
+                        and va != va and vb != vb):  # NaN == NaN
+                    return False
+        return True
+
     def _chunked_execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.ops import selection as sel
         buf: List[ColumnarBatch] = []
         rows = 0
         carry: Optional[List] = None  # per-expr carried state
+        carry_key = None              # host key values of the open partition
         running_ok = self._running_capable()
         run_aligned = self._needs_run_aligned_split()
 
@@ -456,9 +481,17 @@ class TpuWindowExec(TpuExec):
             partition continues past n_emit; ``first_b``: first
             partition-start index inside the prefix (0 = none — the
             whole prefix continues the carried partition)."""
-            nonlocal carry
+            nonlocal carry, carry_key
             with self.timer(SORT_TIME):
                 part_keys, order_keys, extras, payload = staged
+                if carry is not None and not self._keys_equal(
+                        self._key_at(part_keys, 0), carry_key):
+                    # chunk boundary coincided with a partition boundary
+                    # (row 0 is excluded from boundary detection): the
+                    # carried partition ended exactly at the previous
+                    # chunk's edge — its state must not leak into this one
+                    carry = None
+                    carry_key = None
                 s_payload, outs, auxs = self._kernel(
                     part_keys, order_keys, extras, payload,
                     jnp.int32(n_emit))
@@ -479,8 +512,10 @@ class TpuWindowExec(TpuExec):
                                              n_emit - 1)
                              for i, ((_, we), aux) in enumerate(
                                  zip(self.window_exprs, auxs))]
+                    carry_key = self._key_at(part_keys, n_emit - 1)
                 else:
                     carry = None
+                    carry_key = None
             return self._make_batch(s_payload, outs, n_emit,
                                     chunk.capacity)
 

@@ -25,9 +25,12 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops.expressions import Expression
 
 # array<string> exists only on the host surface (CPU-fallback frames
-# hold python lists); the device columnar layer is single-level, so the
-# type is constructed directly instead of via ArrayType's validator
-ARRAY_STRING = DataType("array<string>", np.dtype(np.uint8),
+# hold python lists; Column stores int32 dictionary codes + a host
+# string table); the device columnar layer is single-level, so the
+# type is constructed directly instead of via ArrayType's validator.
+# Storage matches the code representation so any accidental device
+# buffer build stays dtype-consistent.
+ARRAY_STRING = DataType("array<string>", np.dtype(np.int32),
                         element=dts.STRING)
 
 

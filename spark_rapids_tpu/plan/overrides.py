@@ -314,6 +314,16 @@ class PlanMeta(BaseMeta):
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")
+        # array<string> exists only on the host surface (dictionary-coded
+        # Column with a host string table no device exec preserves): any
+        # node CONSUMING one must stay on the CPU fallback chain
+        for c in node.children:
+            for cn, cdt in c.schema:
+                if cdt.is_array and cdt.element is not None and \
+                        cdt.element.is_string:
+                    self.will_not_work(
+                        f"input column {cn!r} is array<string>, a "
+                        "host-only type (no device representation)")
         if isinstance(node, L.Sort) and any(
                 e.dtype.is_array for e, _, _ in node.orders):
             self.will_not_work("array sort keys not supported on TPU")
@@ -433,6 +443,7 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
 
     nkeys = len(group_exprs)
     agg_list: List[AggregateExpression] = []
+    group_keys = [ge.cache_key() for ge in group_exprs]
 
     def extract(e):
         if isinstance(e, AggregateExpression):
@@ -440,7 +451,23 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
             agg_list.append(e)
             return BoundReference(nkeys + idx, e.dtype, name=f"_a{idx}",
                                   nullable=e.nullable)
+        # non-aggregate subtrees matching a group expression read the
+        # agg frame's key column, not the child's ordinal (Catalyst
+        # rewrites resultExpressions the same way)
+        try:
+            ck = e.cache_key()
+        except Exception:
+            ck = None
+        if ck is not None and ck in group_keys:
+            ki = group_keys.index(ck)
+            ge = group_exprs[ki]
+            return BoundReference(ki, ge.dtype, name=ge.name,
+                                  nullable=ge.nullable)
         if not e.children:
+            if isinstance(e, BoundReference):
+                raise ValueError(
+                    f"column {e.name!r} in aggregate output is neither "
+                    "an aggregate nor in the GROUP BY")
             return e
         return e.with_children([extract(c) for c in e.children])
 

@@ -225,3 +225,29 @@ def test_parquet_scan_roundtrip(session, tmp_path):
     out = df.filter(F.col("a") >= 40).to_pandas()
     assert out["a"].tolist() == list(range(40, 50))
     assert out["s"].tolist() == [f"row{i}" for i in range(40, 50)]
+
+
+def test_agg_result_expr_references_group_key(session):
+    """Regression (round-3 advisor, medium): group-key references inside
+    a combined aggregate output must read the agg frame's key column,
+    not the child schema's ordinal."""
+    import pandas as pd
+    df = session.create_dataframe(pd.DataFrame(
+        {"a": [1, 2, 3, 4], "b": [10, 20, 10, 20]}))
+    out = df.groupBy("b").agg(
+        (F.sum("a") + F.col("b")).alias("s")).orderBy("b").to_pandas()
+    assert out["s"].tolist() == [14, 26]  # sum(a)+b: (1+3)+10, (2+4)+20
+    # key expression deeper in the output tree
+    out = df.groupBy("b").agg(
+        (F.sum("a") + F.col("b") * 2).alias("s")).orderBy("b").to_pandas()
+    assert out["s"].tolist() == [24, 46]
+
+
+def test_agg_output_not_in_group_by_raises(session):
+    import pandas as pd
+    import pytest
+    df = session.create_dataframe(pd.DataFrame(
+        {"a": [1, 2], "b": [10, 20]}))
+    with pytest.raises(Exception, match="GROUP BY|neither"):
+        df.groupBy("b").agg((F.sum("b") + F.col("a")).alias("s")) \
+            .to_pandas()

@@ -180,3 +180,42 @@ def test_sql_grouping_fn(session, df):
         SELECT a, grouping(a) AS ga, count(*) AS n
         FROM exp_t GROUP BY ROLLUP(a) ORDER BY ga, a""").to_pandas()
     assert list(got.ga) == [0] * df.to_pandas().a.nunique() + [1]
+
+
+def test_expand_cpu_fallback_branch():
+    """CpuFallbackExec must be able to execute an Expand node (round-3
+    advisor, low: previously raised NotImplementedError)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import TpuScanExec
+    from spark_rapids_tpu.exec.expand import Expand, NullLiteral
+    from spark_rapids_tpu.exec.fallback import CpuFallbackExec
+    from spark_rapids_tpu.ops.expressions import Literal, UnresolvedColumn
+    from spark_rapids_tpu.plan import logical as L
+
+    pdf = pd.DataFrame({"a": [1, 2], "v": [10.0, 20.0]})
+    batch = ColumnarBatch.from_pandas(pdf)
+    schema = [("a", dts.INT64), ("v", dts.FLOAT64)]
+    # bind against a stub logical child exposing the schema
+    class _Stub(L.LogicalPlan):
+        def __init__(self):
+            self.children = ()
+        @property
+        def schema(self):
+            return schema
+        def describe(self):
+            return "stub"
+    node = Expand(
+        [[UnresolvedColumn("a"), UnresolvedColumn("v"),
+          Literal(np.int64(0))],
+         [UnresolvedColumn("a"), NullLiteral(dts.FLOAT64),
+          Literal(np.int64(1))]],
+        ["a", "v", "gid"], _Stub())
+    exec_ = CpuFallbackExec(node, [TpuScanExec([batch], schema)])
+    out = pd.concat([b.to_pandas() for b in exec_.execute()],
+                    ignore_index=True)
+    assert len(out) == 4
+    assert sorted(out.gid.tolist()) == [0, 0, 1, 1]
+    assert out[out.gid == 1].v.isna().all()

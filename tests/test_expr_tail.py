@@ -157,3 +157,40 @@ def test_distributed_get_json_object():
     assert s.last_dist_explain == "distributed"
     assert {r.x: r.n for r in got.itertuples()} == \
         {"1": 40, "2": 40, None: 40} or got.n.sum() == 120
+
+
+# ---- round-3 advisor low-severity fallback fixes --------------------------
+
+def test_fallback_substring_negative_pos_clamps():
+    """substring('abc', -5, 3) is 'a' in Spark (window [-2, 1) clamped),
+    not 'abc' (round-3 advisor, low)."""
+    import pandas as pd
+    from spark_rapids_tpu.exec.fallback import _eval_pandas
+    from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+    from spark_rapids_tpu.ops.stringops import Substring
+
+    df = pd.DataFrame({"s": ["abc", "hello", "x"]})
+    out = _eval_pandas(Substring(UnresolvedColumn("s"), -5, 3), df)
+    assert out.tolist() == ["a", "hel", ""]
+    out = _eval_pandas(Substring(UnresolvedColumn("s"), -2, 2), df)
+    assert out.tolist() == ["bc", "lo", "x"]
+
+
+def test_fallback_time_window_shift():
+    """Shifted sliding-window replicas on the CPU fallback must apply
+    shift_us (round-3 advisor, low)."""
+    import pandas as pd
+    from spark_rapids_tpu.exec.fallback import _eval_pandas
+    from spark_rapids_tpu.ops.datetime_ops import TimeWindow
+    from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+
+    df = pd.DataFrame(
+        {"t": pd.to_datetime(["2021-01-01 00:00:07"])})
+    minute = 60_000_000
+    base = _eval_pandas(
+        TimeWindow(UnresolvedColumn("t"), 2 * minute, minute,
+                   field="start"), df)
+    shifted = _eval_pandas(
+        TimeWindow(UnresolvedColumn("t"), 2 * minute, minute,
+                   field="start", shift_us=minute), df)
+    assert shifted[0] == base[0] - pd.Timedelta(minutes=1)

@@ -169,9 +169,36 @@ def test_split_get_item(session):
                 assert pd.isna(out[col][i]), (s, j)
 
 
-def test_split_without_getitem_raises(session, sdf):
-    with pytest.raises(TypeError, match="getItem"):
-        sdf.select(F.split("s", ","))
+def test_split_standalone_array(session):
+    """Bare split (no getItem) yields array<string> host-side with Spark
+    limit=-1 semantics (trailing empties kept)."""
+    vals = ["a,b,c", "one", "", "x,,z", None, "1,2,", ",lead"]
+    df = session.create_dataframe({"s": vals})
+    out = df.select(F.split("s", ",").alias("a")).to_pandas()["a"]
+    for i, s in enumerate(vals):
+        if s is None:
+            assert out[i] is None or (not isinstance(out[i], list)
+                                      and pd.isna(out[i]))
+            continue
+        assert list(out[i]) == re.split(",", s), (s, out[i])
+
+
+def test_split_array_through_downstream_ops(session):
+    """A bare-split array<string> column consumed by downstream
+    operators (sort, explode) must route those operators to the CPU
+    fallback — device execs cannot preserve the host dictionary."""
+    vals = ["c,a", "b", "z,x,y", "a"]
+    df = session.create_dataframe(
+        {"k": [3, 1, 4, 0], "s": vals})
+    out = df.select("k", F.split("s", ",").alias("a")) \
+        .orderBy("k").to_pandas()
+    assert out["k"].tolist() == [0, 1, 3, 4]
+    assert [list(v) for v in out["a"]] == \
+        [["a"], ["b"], ["c", "a"], ["z", "x", "y"]]
+    # explode over the split array (the Spark-idiomatic combo)
+    out = df.select(F.explode(F.split("s", ",")).alias("e")).to_pandas()
+    assert sorted(out["e"]) == sorted(
+        [p for s in vals for p in s.split(",")])
 
 
 def test_rlike_col_method(session, sdf):

@@ -172,3 +172,54 @@ def test_window_over_spilling_sort(data):
     pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
                                   rtol=1e-9, check_dtype=False)
     assert s.memory_catalog.spilled_to_host_total > 0
+
+
+def _direct_window(pdfs, batch_rows):
+    """Drive TpuWindowExec directly (presorted, ROWS running sum +
+    row_number over g / order o) with one input batch per pdf, so chunk
+    edges land exactly where the test puts them."""
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import TpuScanExec
+    from spark_rapids_tpu.exec.window import (Frame, TpuWindowExec,
+                                              WindowExpression, WindowSpec)
+    from spark_rapids_tpu.ops.expressions import BoundReference
+    batches = [ColumnarBatch.from_pandas(p) for p in pdfs]
+    schema = [("g", dts.INT64), ("o", dts.INT64), ("v", dts.FLOAT64)]
+    child = TpuScanExec(batches, schema)
+    spec = WindowSpec([BoundReference(0, dts.INT64, "g")],
+                      [(BoundReference(1, dts.INT64, "o"), False, True)],
+                      Frame("rows", None, 0))
+    exprs = [("rs", WindowExpression("sum", spec,
+                                     BoundReference(2, dts.FLOAT64, "v"))),
+             ("rn", WindowExpression("row_number", spec))]
+    exec_ = TpuWindowExec(exprs, child, presorted=True,
+                          batch_rows=batch_rows)
+    out = pd.concat([b.to_pandas() for b in exec_.execute()],
+                    ignore_index=True)
+    return out
+
+
+def test_partition_ends_exactly_at_chunk_edge():
+    """Regression (round-3 advisor, high): when a chunk is consumed
+    exactly (e == rows) with the tail partition still open, the carry
+    must be dropped if the next chunk starts a NEW partition — row 0 is
+    excluded from boundary detection, so only the carried key can tell."""
+    out = _direct_window([
+        pd.DataFrame({"g": [0, 0, 0, 0], "o": [0, 1, 2, 3],
+                      "v": [1.0, 2.0, 3.0, 4.0]}),
+        pd.DataFrame({"g": [1, 1], "o": [0, 1], "v": [10.0, 20.0]}),
+    ], batch_rows=4)
+    assert out.rs.tolist() == [1.0, 3.0, 6.0, 10.0, 10.0, 30.0]
+    assert out.rn.tolist() == [1, 2, 3, 4, 1, 2]
+
+
+def test_same_partition_resumes_after_exact_chunk_edge():
+    """Counter-case: the partition genuinely continues into the next
+    chunk after an exact-edge split — the carry must survive."""
+    out = _direct_window([
+        pd.DataFrame({"g": [0] * 4, "o": [0, 1, 2, 3], "v": [1.0] * 4}),
+        pd.DataFrame({"g": [0] * 4, "o": [4, 5, 6, 7], "v": [1.0] * 4}),
+    ], batch_rows=4)
+    assert out.rs.tolist() == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0]
+    assert out.rn.tolist() == [1, 2, 3, 4, 5, 6, 7, 8]
