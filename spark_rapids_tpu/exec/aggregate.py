@@ -81,6 +81,48 @@ def _grouped_kernel(kinds: Tuple[str, ...], nkeys: int):
     return run
 
 
+@functools.lru_cache(maxsize=None)
+def _coded_kernel(kinds: Tuple[str, ...], k_bucket: int):
+    """Sort-free radix-coded group-by (stage B when the key-space
+    product fits ``k_bucket`` slots) — the hash-aggregation regime of
+    the reference (aggregate.scala:184-209), realized as direct
+    addressing + segment reduce."""
+
+    @jax.jit
+    def run(keys_flat, bufs_flat, mins, slot_ranges, mask):
+        capacity = keys_flat[0][0].shape[0]
+        keys = [ColVal(None, v, val) for v, val in keys_flat]
+        buf_inputs = [(k, ColVal(None, v, val))
+                      for k, (v, val) in zip(kinds, bufs_flat)]
+        out_keys, out_bufs, n = agg.groupby_aggregate_coded(
+            keys, buf_inputs, jnp.int32(0), capacity, mins, slot_ranges,
+            k_bucket, row_mask=mask)
+        return ([(k.values, k.validity) for k in out_keys],
+                [(b.values, b.validity) for b in out_bufs], n)
+
+    return run
+
+
+def _pow2_bucket(n: int) -> int:
+    from spark_rapids_tpu.columnar.column import bucket_capacity
+    return bucket_capacity(n, minimum=64)
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_kernel(nkeys: int):
+    """Key-range probe over pre-evaluated key columns (string path and
+    merge stage, where keys already exist as columns)."""
+
+    @jax.jit
+    def run(keys_flat, nrows):
+        capacity = keys_flat[0][0].shape[0]
+        keys = [ColVal(None, v, val) for v, val in keys_flat]
+        live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+        return agg.key_range_probe(keys, live)
+
+    return run
+
+
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Tuple[str, AggregateExpression]],
@@ -136,6 +178,14 @@ class TpuHashAggregateExec(TpuExec):
         base_sig = (tuple(dt.name for dt in self._in_dtypes),
                     tuple(e.cache_key() for e in self.group_exprs),
                     tuple(f.cache_key() for f in self.funcs))
+        self._base_sig = base_sig
+        # coded (sort-free) dispatch: all keys fixed-width integral after
+        # string dictionary encoding, all buffers fixed-width
+        key_dts = [dts.INT32 if i in self._string_key_idx else e.dtype
+                   for i, e in enumerate(self.group_exprs)]
+        self._coded_eligible = bool(self.group_exprs) and \
+            agg.coded_key_eligible(key_dts) and \
+            not any(s.dtype.has_offsets for s in self._buf_specs)
         if self._string_key_idx:
             # stage A evaluates keys + agg children; the group kernel runs in
             # stage B after host dictionary encoding of string keys
@@ -149,6 +199,17 @@ class TpuHashAggregateExec(TpuExec):
                 if self.pre_filter is not None else None,)
             self._update_fn = cached_jit(update_sig,
                                          lambda: self._update_fused)
+            if self._coded_eligible:
+                # stage A evaluates filter mask + key-range probe only
+                # (one cheap pass); stage B re-evaluates keys/buffers
+                # FUSED with the coded reduction, picked on the host from
+                # the probed key-space size (falls back to _update_fn's
+                # sort kernel when the space is too large)
+                stage_a_sig = ("agg_stage_a",) + base_sig + (
+                    self.pre_filter.cache_key()
+                    if self.pre_filter is not None else None,)
+                self._stage_a_fn = cached_jit(stage_a_sig,
+                                              lambda: self._stage_a)
         # merge never evaluates pre_filter: exclude it so queries differing
         # only in filter constants share the merge executable
         self._merge_fn = cached_jit(("agg_merge",) + base_sig,
@@ -225,6 +286,87 @@ class TpuHashAggregateExec(TpuExec):
         return ([(k.values, k.validity, k.offsets) for k in out_keys],
                 [(b.values, b.validity, b.offsets) for b in out_bufs], n)
 
+    def _stage_a(self, flat_cols, nrows):
+        """Filter mask + key-range probe: the cheap pass whose scalars
+        the host needs before picking stage B (coded path)."""
+        capacity = capacity_of(flat_cols)
+        inputs = flat_to_colvals(flat_cols, self._in_dtypes)
+        ctx = EmitContext(inputs, nrows, capacity)
+        mask = ctx.row_mask()
+        if self.pre_filter is not None:
+            pred = self.pre_filter.emit(ctx)
+            keep = pred.values
+            if getattr(keep, "ndim", 0) == 0:
+                keep = jnp.broadcast_to(keep, (capacity,))
+            if pred.validity is not None:
+                keep = jnp.logical_and(keep, pred.validity)
+            mask = jnp.logical_and(keep, mask)
+        keys = [agg.widen_colval(e.emit(ctx), capacity)
+                for e in self.group_exprs]
+        mins, maxs = agg.key_range_probe(keys, mask)
+        return mask, mins, maxs
+
+    def _coded_update(self, k_bucket: int):
+        """Build the coded stage-B body (cached_jit per k_bucket): key
+        and buffer expressions re-evaluate HERE, fused straight into the
+        segment reductions — no materialized intermediate columns."""
+
+        def run(flat_cols, nrows, mask, mins, slot_ranges):
+            capacity = capacity_of(flat_cols)
+            inputs = flat_to_colvals(flat_cols, self._in_dtypes)
+            ctx = EmitContext(inputs, nrows, capacity)
+            keys = [agg.widen_colval(e.emit(ctx), capacity)
+                    for e in self.group_exprs]
+            buf_inputs = self._eval_update_inputs(ctx)
+            out_keys, out_bufs, n = agg.groupby_aggregate_coded(
+                keys, buf_inputs, nrows, capacity, mins, slot_ranges,
+                k_bucket, row_mask=mask)
+            return ([(k.values, k.validity) for k in out_keys],
+                    [(b.values, b.validity) for b in out_bufs], n)
+
+        return run
+
+    def _coded_pick(self, mins, maxs):
+        """Sync the probe scalars and size the key space; None when the
+        coded path does not apply."""
+        mins_h = np.asarray(mins)
+        maxs_h = np.asarray(maxs)
+        pick = agg.coded_slot_ranges(mins_h, maxs_h)
+        if pick is None:
+            return None
+        slots, total = pick
+        return (_pow2_bucket(total),
+                jnp.asarray(np.minimum(mins_h, maxs_h)),
+                jnp.asarray(np.asarray(slots, dtype=np.int64)))
+
+    def _partial_coded(self, batch, names, dtypes):
+        flat = batch_to_flat(batch)
+        nrows = jnp.int32(batch.nrows)
+        mask, mins, maxs = self._stage_a_fn(flat, nrows)
+        pick = self._coded_pick(mins, maxs)
+        if pick is None:
+            # key space too large: the fully fused sort kernel
+            key_flat, buf_flat, n = self._update_fn(flat, nrows)
+            n = int(n)
+            outs = [ColVal(dt, v, val, offs)
+                    for dt, (v, val, offs) in
+                    zip(dtypes, list(key_flat) + list(buf_flat))]
+            cols = colvals_to_columns(outs, n, batch.capacity)
+            return ColumnarBatch(dict(zip(names, cols)), n)
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        k_bucket, mins_d, slots_d = pick
+        fn = cached_jit(
+            ("agg_coded_update", k_bucket) + self._base_sig,
+            lambda: self._coded_update(k_bucket))
+        key_out, buf_out, n = fn(flat, nrows, mask, mins_d, slots_d)
+        n = int(n)
+        outs = [ColVal(dt, v, val) for dt, (v, val) in
+                zip(dtypes, list(key_out) + list(buf_out))]
+        out_cap = key_out[0][0].shape[0] if key_out else \
+            buf_out[0][0].shape[0]
+        cols = colvals_to_columns(outs, n, out_cap)
+        return ColumnarBatch(dict(zip(names, cols)), n)
+
     def _partial_batches(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.retry import with_retry
         names = [n for n, _ in self._partial_schema]
@@ -242,6 +384,8 @@ class TpuHashAggregateExec(TpuExec):
                 if self._string_key_idx:
                     return self._partial_with_string_keys(
                         batch, names, dtypes)
+                if self._coded_eligible:
+                    return self._partial_coded(batch, names, dtypes)
                 key_flat, buf_flat, n = self._update_fn(
                     batch_to_flat(batch), jnp.int32(batch.nrows))
                 # keyless reductions have statically one output row;
@@ -271,15 +415,28 @@ class TpuHashAggregateExec(TpuExec):
             for spec, bi in zip(f.buffers(),
                                 f.update_inputs(cv, batch.capacity)):
                 buf_inputs.append((spec.kind, bi))
-        kernel = _grouped_kernel(self._update_kinds, nkeys)
-        key_flat, buf_flat, n = kernel(
-            [(c.data, c.validity) for c in enc_keys],
-            [(c.values, c.validity) for _, c in buf_inputs],
-            jnp.int32(batch.nrows))
+        key_flat_in = [(c.data, c.validity) for c in enc_keys]
+        buf_flat_in = [(c.values, c.validity) for _, c in buf_inputs]
+        pick = None
+        if self._coded_eligible:
+            nrows = jnp.int32(batch.nrows)
+            mins, maxs = _probe_kernel(nkeys)(key_flat_in, nrows)
+            pick = self._coded_pick(mins, maxs)
+        if pick is not None:
+            k_bucket, mins_d, slots_d = pick
+            mask = jnp.arange(batch.capacity, dtype=jnp.int32) < nrows
+            key_flat, buf_flat, n = _coded_kernel(
+                self._update_kinds, k_bucket)(
+                key_flat_in, buf_flat_in, mins_d, slots_d, mask)
+        else:
+            kernel = _grouped_kernel(self._update_kinds, nkeys)
+            key_flat, buf_flat, n = kernel(key_flat_in, buf_flat_in,
+                                           jnp.int32(batch.nrows))
         n = int(n)
         outs = [ColVal(dt, v, val) for dt, (v, val) in
                 zip(dtypes, list(key_flat) + list(buf_flat))]
-        cols = colvals_to_columns(outs, n, batch.capacity)
+        out_cap = key_flat[0][0].shape[0]
+        cols = colvals_to_columns(outs, n, out_cap)
         return ColumnarBatch(dict(zip(names, cols)), n)
 
     # ------------------------------------------------------------ merge stage --
@@ -313,6 +470,51 @@ class TpuHashAggregateExec(TpuExec):
         return ([(k.values, k.validity, k.offsets) for k in out_keys],
                 [(b.values, b.validity, b.offsets) for b in out_bufs], n)
 
+    def _merge_coded(self, k_bucket: int, finalize: bool):
+        """Build the coded (sort-free) merge kernel body for cached_jit."""
+        dtypes = [dt for _, dt in self._partial_schema]
+        nkeys = len(self.group_exprs)
+
+        def run(flat_cols, mins, slot_ranges, nrows):
+            capacity = capacity_of(flat_cols)
+            cols = flat_to_colvals(flat_cols, dtypes)
+            keys, bufs = cols[:nkeys], cols[nkeys:]
+            merge_inputs = [(k, c)
+                            for k, c in zip(self._merge_kinds, bufs)]
+            out_keys, out_bufs, n = agg.groupby_aggregate_coded(
+                keys, merge_inputs, nrows, capacity, mins, slot_ranges,
+                k_bucket)
+            if finalize:
+                results = [f.finalize(out_bufs[sl])
+                           for f, sl in zip(self.funcs, self._buf_slices)]
+            else:
+                results = out_bufs
+            return ([(k.values, k.validity, k.offsets) for k in out_keys],
+                    [(r.values, r.validity, r.offsets) for r in results],
+                    n)
+
+        return run
+
+    def _merge_exec(self, merged_in: ColumnarBatch, finalize: bool):
+        """Merge-stage dispatch mirroring the update stage: probe the
+        partials' key ranges, run the coded kernel when the space fits."""
+        flat = batch_to_flat(merged_in)
+        nrows = jnp.int32(merged_in.nrows)
+        nkeys = len(self.group_exprs)
+        if self._coded_eligible:
+            key_flat = [(v, val) for v, val, _ in flat[:nkeys]]
+            mins, maxs = _probe_kernel(nkeys)(key_flat, nrows)
+            pick = self._coded_pick(mins, maxs)
+            if pick is not None:
+                from spark_rapids_tpu.ops.jit_cache import cached_jit
+                kb, mins_d, slots_d = pick
+                fn = cached_jit(
+                    ("agg_merge_coded", finalize, kb) + self._base_sig,
+                    lambda: self._merge_coded(kb, finalize))
+                return fn(flat, mins_d, slots_d, nrows)
+        fn = self._merge_fn if finalize else self._merge_partial_fn
+        return fn(flat, nrows)
+
     def _tree_merge(self, handles, catalog):
         """Reduce partial handles until their total rows fit one merge
         chunk; each step merges >=2 partials into one (still-partial)
@@ -337,8 +539,8 @@ class TpuHashAggregateExec(TpuExec):
             for h in group:
                 h.close()
             with self.timer(AGG_TIME):
-                key_flat, buf_flat, n = self._merge_partial_fn(
-                    batch_to_flat(merged_in), jnp.int32(merged_in.nrows))
+                key_flat, buf_flat, n = self._merge_exec(
+                    merged_in, finalize=False)
                 n = 1 if not self.group_exprs else int(n)
             outs = [ColVal(dt, v, val, offs)
                     for dt, (v, val, offs) in
@@ -346,9 +548,11 @@ class TpuHashAggregateExec(TpuExec):
             # compact to the live row count before registering: n is
             # already concrete here, and keeping the concat capacity
             # would make padding, not rows, dominate the spill bytes
+            # (coded-path outputs are already key-space sized)
             from spark_rapids_tpu.columnar.column import bucket_capacity
-            out_cap = min(bucket_capacity(n), merged_in.capacity)
-            if out_cap < merged_in.capacity:
+            cur_cap = int(outs[0].values.shape[0])
+            out_cap = min(bucket_capacity(n), cur_cap)
+            if out_cap < cur_cap:
                 outs = [ColVal(c.dtype, c.values[:out_cap],
                                None if c.validity is None
                                else c.validity[:out_cap], c.offsets)
@@ -479,8 +683,8 @@ class TpuHashAggregateExec(TpuExec):
         for h in handles:
             h.close()
         with self.timer(AGG_TIME):
-            key_flat, res_flat, n = self._merge_fn(
-                batch_to_flat(merged_in), jnp.int32(merged_in.nrows))
+            key_flat, res_flat, n = self._merge_exec(
+                merged_in, finalize=True)
             n = 1 if not self.group_exprs else int(n)
         out_names = [name for name, _ in self.schema]
         outs: List[ColVal] = []
@@ -490,7 +694,10 @@ class TpuHashAggregateExec(TpuExec):
             outs.append(ColVal(dt, v, val, offs))
         for (name, ae), (v, val, offs) in zip(self.agg_exprs, res_flat):
             outs.append(ColVal(ae.dtype, v, val, offs))
-        cols = colvals_to_columns(outs, n, merged_in.capacity)
+        out_cap = next((int(o.values.shape[0]) for o in outs
+                        if getattr(o.values, "ndim", 0) >= 1),
+                       merged_in.capacity)
+        cols = colvals_to_columns(outs, n, out_cap)
         for i in self._string_key_idx:
             cols[i] = self._encoders[i].decode(cols[i])
         yield ColumnarBatch(dict(zip(out_names, cols)), n)

@@ -398,6 +398,189 @@ def groupby_aggregate(keys: Sequence[ColVal],
     return out_keys, out_bufs, num_groups
 
 
+# --------------------------------------------------- coded (sort-free) path
+# XLA's variadic sort is the dominant cost of the sort-based group-by
+# (seconds per multi-million-row batch on CPU, and serial on TPU's VPU);
+# when every key is fixed-width integral and the key-space product is
+# small, groups are addressed DIRECTLY: code = radix-mix of (key - min)
+# digits, one segment-reduce per buffer into the code table, then a
+# cumsum-compaction of occupied slots.  No sort anywhere.  The reference
+# reaches the same regime with cudf's hash aggregation
+# (aggregate.scala:184-209 hash first, sort only as fallback).
+
+MAX_CODED_GROUPS = 1 << 21
+
+
+def coded_key_eligible(dtypes) -> bool:
+    """Keys a radix code can address: fixed-width, non-float (floats
+    have no dense integer range)."""
+    return all(
+        not dt.has_offsets and not dt.is_floating
+        for dt in dtypes)
+
+
+def key_range_probe(keys: Sequence[ColVal], live):
+    """Per-key (min, max) over live valid rows as int64[nkeys] pair —
+    fused into stage A so range discovery costs one pass, synced to the
+    host to pick coded vs sort dispatch.  Reductions run in the key's
+    native width (the int64 cast is on the output scalars only)."""
+    mins, maxs = [], []
+    for c in keys:
+        v = c.values
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        info = jnp.iinfo(v.dtype)
+        valid = live if c.validity is None else \
+            jnp.logical_and(live, c.validity)
+        mins.append(jnp.min(jnp.where(valid, v, info.max))
+                    .astype(jnp.int64))
+        maxs.append(jnp.max(jnp.where(valid, v, info.min))
+                    .astype(jnp.int64))
+    return jnp.stack(mins), jnp.stack(maxs)
+
+
+def coded_slot_ranges(mins: np.ndarray, maxs: np.ndarray):
+    """Host-side: per-key slot count (digit 0 is ALWAYS the null slot,
+    whether or not the key is nullable — keeps the host sizing and the
+    traced validity structure trivially consistent) and the total
+    key-space size; None when the space is too large for the coded
+    path."""
+    slots = []
+    total = 1
+    for mn, mx in zip(mins.tolist(), maxs.tolist()):
+        rn = max(0, int(mx) - int(mn) + 1)
+        slots.append(rn + 1)
+        total *= rn + 1
+        if total > MAX_CODED_GROUPS:
+            return None
+    return slots, total
+
+
+def _segment_reduce_coded(kind: str, c: ColVal, code, ns: int,
+                          counts_of):
+    """One buffer reduction for the coded path.  Null/dead rows are
+    folded into the TRASH SEGMENT of the code vector instead of masking
+    the value column — an int32 pass (or none) replaces the full-width
+    ``where`` pass per buffer.  ``counts_of(validity)`` returns (cached)
+    per-slot live counts for a validity array."""
+    if c.validity is not None:
+        bcode = jnp.where(c.validity, code, ns - 1)
+    else:
+        bcode = code
+    counts = counts_of(c.validity, bcode)
+    capacity = code.shape[0]
+    vals = c.values
+    if getattr(vals, "ndim", 0) == 0:
+        vals = jnp.broadcast_to(vals, (capacity,))
+    if kind == "sum":
+        out = jax.ops.segment_sum(vals, bcode, num_segments=ns)
+    elif kind == "min":
+        out = jax.ops.segment_min(vals, bcode, num_segments=ns)
+    elif kind == "max":
+        out = jax.ops.segment_max(vals, bcode, num_segments=ns)
+    elif kind in ("first", "last"):
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        if kind == "first":
+            best = jax.ops.segment_min(idx, bcode, num_segments=ns)
+        else:
+            best = jax.ops.segment_max(idx, bcode, num_segments=ns)
+        safe = jnp.clip(best, 0, capacity - 1)
+        out = vals[safe]
+    else:
+        raise ValueError(f"unknown reduce kind {kind}")
+    return out[: ns - 1], counts
+
+
+def groupby_aggregate_coded(keys: Sequence[ColVal],
+                            buffer_inputs: Sequence[Tuple[str, ColVal]],
+                            nrows, capacity: int, mins, slot_ranges,
+                            k_bucket: int, row_mask=None):
+    """Sort-free group-by: keys must be fixed-width integral with the
+    key-space product <= ``k_bucket`` (static).  ``mins``/``slot_ranges``
+    are traced int64[nkeys] (data-dependent, but only k_bucket shapes the
+    program).  Output groups are ordered ascending with nulls first —
+    identical to the sort path's order.  Output arrays are sized by the
+    key space (max(k_bucket, 1024)), NOT the input capacity."""
+    nkeys = len(keys)
+    keys = [widen_colval(c, capacity) for c in keys]
+    live = _row_mask(nrows, capacity, row_mask)
+
+    # row codes: digit 0 = null (nulls first), 1.. = value - min + 1
+    # (digit 0 is reserved even for non-nullable keys — see
+    # coded_slot_ranges)
+    code = jnp.zeros(capacity, dtype=jnp.int64)
+    stride = jnp.int64(1)
+    strides_rev = []
+    for i in reversed(range(nkeys)):
+        c = keys[i]
+        v = c.values
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        v = v.astype(jnp.int64)
+        rn = slot_ranges[i] - 1
+        d = jnp.clip(v - mins[i], 0, jnp.maximum(rn - 1, 0)) + 1
+        if c.validity is not None:
+            d = jnp.where(c.validity, d, 0)
+        code = code + d * stride
+        strides_rev.append(stride)
+        stride = stride * slot_ranges[i]
+    strides = strides_rev[::-1]
+    code = jnp.where(live, code, k_bucket).astype(jnp.int32)
+    ns = k_bucket + 1
+
+    # per-slot live counts, shared by every buffer whose validity is None
+    slot_counts_all = jnp.bincount(code, length=ns)
+    counts_cache = {}
+
+    def counts_of(validity, bcode):
+        if validity is None:
+            return slot_counts_all[:k_bucket]
+        key = id(validity)
+        got = counts_cache.get(key)
+        if got is None:
+            got = jnp.bincount(bcode, length=ns)[:k_bucket]
+            counts_cache[key] = got
+        return got
+
+    slot_counts = slot_counts_all[:k_bucket]
+    occupied = slot_counts > 0
+    num_groups = occupied.sum().astype(jnp.int32)
+    pos = jnp.cumsum(occupied.astype(jnp.int32)) - 1
+    # compaction scatter target: occupied slot -> dense position,
+    # unoccupied -> out_cap (dropped); outputs are key-space sized
+    out_cap = max(k_bucket, 1024)
+    out_idx = jnp.where(occupied, pos, out_cap)
+
+    slots = jnp.arange(k_bucket, dtype=jnp.int64)
+    out_keys: List[ColVal] = []
+    for i, c in enumerate(keys):
+        digit = (slots // strides[i]) % jnp.maximum(slot_ranges[i], 1)
+        vals = mins[i] + digit - 1
+        if c.validity is not None:
+            vd = jnp.zeros(out_cap, dtype=jnp.bool_)
+            vd = vd.at[out_idx].set(digit > 0, mode="drop")
+        else:
+            vd = None  # digit 0 never occupied without nulls
+        out_dt = c.values.dtype
+        if out_dt == jnp.bool_:
+            vals = vals.astype(jnp.int64) != 0
+        dst = jnp.zeros(out_cap, dtype=out_dt)
+        dst = dst.at[out_idx].set(vals.astype(out_dt), mode="drop")
+        out_keys.append(ColVal(c.dtype, dst, vd))
+
+    out_bufs: List[ColVal] = []
+    for kind, c in buffer_inputs:
+        vals, counts = _segment_reduce_coded(kind, c, code, ns,
+                                             counts_of)
+        vals, counts = vals[:k_bucket], counts[:k_bucket]
+        dv = jnp.zeros(out_cap, dtype=vals.dtype)
+        dv = dv.at[out_idx].set(vals, mode="drop")
+        dvalid = jnp.zeros(out_cap, dtype=jnp.bool_)
+        dvalid = dvalid.at[out_idx].set(counts > 0, mode="drop")
+        out_bufs.append(ColVal(c.dtype, dv, dvalid))
+    return out_keys, out_bufs, num_groups
+
+
 def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
                      nrows, capacity: int, row_mask=None) -> List[ColVal]:
     """Grand-total (no keys) reduction: one output row per buffer.
