@@ -65,6 +65,7 @@ class TpuSession:
         self.cache_manager = CacheManager()
         self.overrides = TpuOverrides(self.conf, self.cache_manager)
         self.last_dist_explain = ""
+        self.last_scan_stats = None  # set by the sharded distributed scan
         self.mesh = mesh
         if self.mesh is None:
             from spark_rapids_tpu.config import rapids_conf as rc

@@ -37,8 +37,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_rapids_tpu.columnar import dtypes as dts
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -54,6 +55,35 @@ from spark_rapids_tpu.plan.logical import AggregateExpression
 class NotDistributable(Exception):
     """Plan (or expression) cannot lower onto the mesh; single-process
     fallback with this reason."""
+
+
+class _UnsplittableScan(Exception):
+    """Internal: the file list cannot be sharded (no footer row counts,
+    unlistable paths, or a shard overflowed its bound) — the scan falls
+    back to the controller-side read+scatter path."""
+
+
+def _file_row_bound(path: str, fmt: str) -> Optional[int]:
+    """Exact per-file row count from footer metadata (parquet/orc) — an
+    UPPER bound on post-pushdown rows, used to size shard capacity
+    without reading data."""
+    try:
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            return int(pq.ParquetFile(path).metadata.num_rows)
+        if fmt == "orc":
+            from pyarrow import orc
+            return int(orc.ORCFile(path).nrows)
+    except Exception:
+        return None
+    return None
+
+
+@jax.jit
+def _remap_codes(rank, vals):
+    """Elementwise lookup of a small replicated rank table over a
+    sharded codes array (stays sharded; no collectives)."""
+    return rank[vals]
 
 
 class ShardedFrame:
@@ -611,6 +641,12 @@ class DistPlanner:
                    if dt.is_string}
             return ShardedFrame(self.mesh, names, log_dtypes, None, None,
                                 enc)
+        if isinstance(plan, L.FileRelation) and \
+                plan.file_format in ("parquet", "orc"):
+            try:
+                return self._scan_sharded_files(plan, schema)
+            except _UnsplittableScan:
+                pass
         from spark_rapids_tpu.ops.concat import concat_batches
         from spark_rapids_tpu.ops.dictionary import ordered_dict_encode
         exec_plan = self.session.plan(plan)
@@ -648,6 +684,121 @@ class DistPlanner:
                 mbuf[s, :counts[s]] = valid[sl]
             cols.append((jnp.asarray(vbuf.reshape(-1)),
                          jnp.asarray(mbuf.reshape(-1))))
+        return ShardedFrame(self.mesh, names, log_dtypes, cols,
+                            jnp.asarray(counts), enc)
+
+    def _scan_sharded_files(self, plan, schema) -> ShardedFrame:
+        """Genuinely distributed scan: the FILE LIST is sharded across
+        the mesh (greedy by per-file row counts from parquet/orc footer
+        metadata) and each shard's split is read, encoded, and placed on
+        its device one shard at a time — the controller never holds more
+        than one shard's rows (the GpuMultiFileReader.scala:300 /
+        GpuParquetScan.scala:973-1199 role: every task reads its own
+        split).  Single-controller only for now: under multi-process
+        JAX the per-host split read is not yet implemented, so the scan
+        falls back instead of device_put-ing to a non-addressable
+        device.
+
+        String columns encode through a SHARED first-seen dictionary per
+        column while reading, then remap on device to the sorted
+        order-preserving codes the rest of the engine expects."""
+        from spark_rapids_tpu.io.readers import _dataset
+        from spark_rapids_tpu.ops.dictionary import dict_encode_stable
+        nshards = self.mesh.devices.size
+        devices = self.mesh.devices.reshape(-1)
+        axis = self.mesh.axis_names[0]
+        if jax.process_count() > 1 or any(
+                d.process_index != jax.process_index() for d in devices):
+            raise _UnsplittableScan("multi-process mesh")
+
+        dataset = _dataset(plan.paths, plan.file_format)
+        files = list(getattr(dataset, "files", None) or [])
+        if not files:
+            raise _UnsplittableScan("no listable files")
+        bounds = [_file_row_bound(f, plan.file_format) for f in files]
+        if any(b is None for b in bounds):
+            raise _UnsplittableScan("row bounds unavailable")
+
+        # greedy longest-first assignment of files to shards
+        order = sorted(range(len(files)), key=lambda i: -bounds[i])
+        shard_files: List[List[str]] = [[] for _ in range(nshards)]
+        shard_bound = np.zeros(nshards, dtype=np.int64)
+        for i in order:
+            s = int(np.argmin(shard_bound))
+            shard_files[s].append(files[i])
+            shard_bound[s] += bounds[i]
+        cap = bucket_capacity(max(int(shard_bound.max()), 1), minimum=8)
+
+        names = [n for n, _ in schema]
+        log_dtypes = [dt for _, dt in schema]
+        str_idx = [i for i, dt in enumerate(log_dtypes) if dt.is_string]
+        dicts = {i: ({}, []) for i in str_idx}  # codes, values
+        counts = np.zeros(nshards, dtype=np.int32)
+        peak_host_rows = 0
+        # per column, the per-shard single-device buffers
+        shard_bufs: List[List] = [[] for _ in range(2 * len(schema))]
+
+        for s in range(nshards):
+            if shard_files[s]:
+                sub = L.FileRelation(shard_files[s], plan.file_format,
+                                     plan._schema, plan.options,
+                                     plan.bucket_spec)
+                sub.pushed_filters = list(plan.pushed_filters)
+                sub.required_columns = plan.required_columns
+                sub.file_meta = set(plan.file_meta)
+                batches = list(self.session.plan(sub).execute())
+                rows = sum(b.nrows for b in batches)
+            else:
+                batches, rows = [], 0
+            if rows > cap:
+                raise _UnsplittableScan("row bound exceeded")
+            counts[s] = rows
+            peak_host_rows = max(peak_host_rows, rows)
+            for i, (name, dt) in enumerate(schema):
+                vbuf = np.zeros(cap, dtype=_phys(dt).storage)
+                mbuf = np.zeros(cap, dtype=bool)
+                at = 0
+                for b in batches:
+                    col = b.columns[name]
+                    nb = col.nrows
+                    if dt.is_string:
+                        codes, values = dicts[i]
+                        vbuf[at:at + nb] = dict_encode_stable(
+                            col, codes, values, null_code=0)
+                    else:
+                        vbuf[at:at + nb] = np.asarray(col.data[:nb])
+                    mbuf[at:at + nb] = col.validity_numpy()
+                    at += nb
+                dev = devices[s]
+                shard_bufs[2 * i].append(jax.device_put(vbuf, dev))
+                shard_bufs[2 * i + 1].append(jax.device_put(mbuf, dev))
+            del batches  # host copies of this shard are done
+
+        sharding = NamedSharding(self.mesh, P(axis))
+        gshape = (nshards * cap,)
+        cols, enc = [], {}
+        for i, (name, dt) in enumerate(schema):
+            vals = jax.make_array_from_single_device_arrays(
+                gshape, sharding, shard_bufs[2 * i])
+            mask = jax.make_array_from_single_device_arrays(
+                gshape, sharding, shard_bufs[2 * i + 1])
+            if dt.is_string:
+                codes_map, values = dicts[i]
+                if values:
+                    # remap first-seen codes -> sorted order-preserving
+                    order_v = np.argsort(
+                        np.array(values, dtype=object), kind="stable")
+                    rank = np.empty(len(values), dtype=np.int64)
+                    rank[order_v] = np.arange(len(values))
+                    vals = _remap_codes(jnp.asarray(rank), vals)
+                    enc[i] = [values[j] for j in order_v]
+                else:
+                    enc[i] = []
+            cols.append((vals, mask))
+        self.session.last_scan_stats = {
+            "sharded_files": True, "files": len(files),
+            "peak_host_rows": int(peak_host_rows),
+            "total_rows": int(counts.sum())}
         return ShardedFrame(self.mesh, names, log_dtypes, cols,
                             jnp.asarray(counts), enc)
 
@@ -694,6 +845,12 @@ class DistPlanner:
         # (the _plan_aggregate split, Catalyst's resultExpressions)
         agg_list: List[AggregateExpression] = []
 
+        group_keys = [ge.cache_key() for ge in group_exprs]
+
+        def _has_agg(e):
+            return isinstance(e, AggregateExpression) or \
+                any(_has_agg(c) for c in e.children)
+
         def extract(e):
             if isinstance(e, AggregateExpression):
                 le = low.lower_agg(e)
@@ -702,8 +859,22 @@ class DistPlanner:
                 return BoundReference(nkeys + idx, le.dtype,
                                       name=f"_a{idx}",
                                       nullable=le.nullable)
-            if not e.children:
-                return low.lower(e)
+            if not _has_agg(e):
+                # group-key subtrees read the agg frame's key column,
+                # not the child ordinal (Catalyst resultExpressions)
+                le = low.lower(e)
+                ck = le.cache_key()
+                if ck in group_keys:
+                    ki = group_keys.index(ck)
+                    ge = group_exprs[ki]
+                    return BoundReference(ki, ge.dtype, name=ge.name,
+                                          nullable=ge.nullable)
+                if not e.children:
+                    if isinstance(le, BoundReference):
+                        raise NotDistributable(
+                            f"column {le.name!r} in aggregate output is "
+                            "neither an aggregate nor in the GROUP BY")
+                    return le
             return e.with_children([extract(c) for c in e.children])
 
         out_named = []
@@ -1056,6 +1227,7 @@ def try_distributed(session, plan: L.LogicalPlan):
         session.last_dist_explain = "distributed disabled by conf"
         return None
     planner = DistPlanner(session, mesh)
+    session.last_scan_stats = None  # per-query: no stale sharded stats
     try:
         planner.run(plan, dry=True)  # support pre-flight: no data moves
         # data-dependent limits (e.g. join fan-out vs output capacity)

@@ -269,3 +269,72 @@ def test_distributed_disable_conf(mesh, frames):
     df = s.create_dataframe(fact)
     assert df.count() == len(fact)
     assert s.last_dist_explain == "distributed disabled by conf"
+
+
+def test_dist_agg_result_expr_references_group_key(dist_session,
+                                                   oracle_session, frames):
+    """Regression (round-3 advisor, medium): group-key references in a
+    combined aggregate output on the mesh must read the agg frame's key
+    column, not the child ordinal."""
+    fact, _ = frames
+    q = lambda s: s.create_dataframe(fact).groupBy("k2").agg(
+        (F.sum("v") + F.col("k2") * 10).alias("x"))
+    _cmp(q(dist_session), q(oracle_session), sort_by=["k2"])
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_sharded_file_scan(dist_session, oracle_session, tmp_path):
+    """The distributed scan shards the FILE LIST across the mesh: each
+    shard reads its own split, and the controller never holds more than
+    one shard's rows (round-3 verdict task #3; reference:
+    GpuMultiFileReader.scala:300 per-task splits)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(3)
+    n_files, rows_per = 16, 500
+    paths = []
+    for i in range(n_files):
+        t = pa.table({
+            "k": rng.integers(0, 40, rows_per),
+            "v": rng.uniform(-5, 5, rows_per).round(3),
+            "s": rng.choice(["ash", "birch", "cedar", None], rows_per),
+        })
+        p = tmp_path / f"part-{i:02d}.parquet"
+        pq.write_table(t, str(p))
+        paths.append(str(p))
+
+    q = lambda s: s.read.parquet(*paths).groupBy("k").agg(
+        F.sum("v").alias("sv"), F.count("v").alias("cv"),
+        F.min("s").alias("ms"))
+    _cmp(q(dist_session), q(oracle_session), sort_by=["k"])
+    assert dist_session.last_dist_explain == "distributed"
+    stats = dist_session.last_scan_stats
+    assert stats and stats["sharded_files"], stats
+    total = n_files * rows_per
+    assert stats["total_rows"] == total
+    # controller-resident peak is one shard's split, not the table
+    assert stats["peak_host_rows"] <= total // 4, stats
+
+    # string round trip: distinct + order by on the encoded column
+    q2 = lambda s: s.read.parquet(*paths).select("s").distinct() \
+        .orderBy("s")
+    _cmp(q2(dist_session), q2(oracle_session))
+
+
+def test_sharded_scan_with_pushdown(dist_session, oracle_session,
+                                    tmp_path):
+    """Filter pushdown rides into each shard's split read."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(4)
+    paths = []
+    for i in range(9):
+        t = pa.table({"id": np.arange(i * 100, (i + 1) * 100),
+                      "v": rng.uniform(0, 1, 100)})
+        p = tmp_path / f"f{i}.parquet"
+        pq.write_table(t, str(p))
+        paths.append(str(p))
+    q = lambda s: s.read.parquet(*paths).filter(
+        F.col("id") >= 450).groupBy().agg(F.count("id").alias("n"),
+                                          F.sum("v").alias("sv"))
+    _cmp(q(dist_session), q(oracle_session))
