@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Local premerge runner — the same gate as .github/workflows/ci.yml for
+# environments without GitHub runners (reference analog:
+# jenkins/spark-premerge-build.sh:31-52).  Fails on: any test failure,
+# generated-doc drift, or public-API manifest drift.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_ENABLE_X64=1
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast_math=false ${XLA_FLAGS:-}"
+
+echo "== unit tests (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q --maxfail=20
+
+echo "== docgen drift check =="
+tmp=$(mktemp -d)
+python -m spark_rapids_tpu.tools.docgen "$tmp"
+diff -u docs/configs.md "$tmp/configs.md"
+diff -u docs/supported_ops.md "$tmp/supported_ops.md"
+rm -rf "$tmp"
+
+echo "== API manifest audit =="
+python -m spark_rapids_tpu.tools.api_validation
+
+echo "== driver entry compile check =="
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+g.dryrun_multichip(8)
+print("entry + dryrun_multichip OK")
+PY
+
+echo "PREMERGE OK"
