@@ -119,7 +119,9 @@ class WindowExpression(Expression):
     def cache_key(self):
         return ("WindowExpression", self.kind, self.offset,
                 self.spec.cache_key(),
-                self.child_expr.cache_key() if self.child_expr else None)
+                self.child_expr.cache_key() if self.child_expr else None,
+                self.default.cache_key() if self.default is not None
+                else None)
 
     def supported_reason(self) -> Optional[str]:
         f = self.spec.frame
@@ -140,6 +142,70 @@ class WindowExpression(Expression):
                     "whole-partition frames"
             return None
         return f"unknown window function {self.kind}"
+
+
+def eval_window_expr(we: WindowExpression, sp: W.SortedPartitions,
+                 c: Optional[ColVal], seg_boundary, capacity: int
+                 ) -> Tuple[ColVal, tuple]:
+    """(output, aux): aux carries the running-state arrays used by
+    the chunked path to continue a partition across chunks (empty
+    for non-running frames)."""
+    f = we.spec.frame
+    kind = we.kind
+    if kind == "row_number":
+        rn = W.row_number(sp)
+        return rn, (rn.values,)
+    if kind == "rank":
+        return W.rank(sp), ()
+    if kind == "dense_rank":
+        return W.dense_rank(sp), ()
+    if kind == "percent_rank":
+        return W.percent_rank(sp), ()
+    if kind in ("lead", "lag"):
+        off = we.offset if kind == "lead" else -we.offset
+        # defaults are literals; emit standalone
+        dflt = None
+        if we.default is not None:
+            from spark_rapids_tpu.ops.expressions import EmitContext
+            dflt = we.default.emit(EmitContext([], jnp.int32(0),
+                                               capacity))
+        return W.lead_lag(sp, c, off, dflt), ()
+
+    rows = f.kind == "rows"
+    result_dt = we.dtype
+    if kind in ("sum", "count", "avg"):
+        cin = c if kind != "count" else (c or ColVal(
+            dts.INT64, jnp.ones(capacity, dtype=jnp.int64)))
+        vals = cin.values.astype(result_dt.storage) \
+            if kind == "sum" else cin.values
+        if kind == "avg":
+            vals = vals.astype(jnp.float64)
+        cv = ColVal(cin.dtype, vals, cin.validity)
+        running = f.lo is None and f.hi == 0
+        if not rows and running:
+            # range running: include full tie run
+            s, n = W.frame_sum(sp, cv, None, 0, rows=False)
+        else:
+            s, n = W.frame_sum(sp, cv, f.lo, f.hi, rows=True)
+        aux = (s, n) if running else ()
+        if kind == "count":
+            return ColVal(dts.INT64, n), aux
+        if kind == "avg":
+            return ColVal(dts.FLOAT64,
+                          s / jnp.maximum(n, 1).astype(jnp.float64),
+                          n > 0), aux
+        return ColVal(result_dt, s, n > 0), aux
+    if kind in ("min", "max"):
+        whole = f.lo is None and f.hi is None
+        if whole:
+            v, n = W.partition_reduce(sp, c, kind, capacity)
+            return ColVal(result_dt, v, n > 0), ()
+        v, n = W.running_minmax(sp, c, kind, seg_boundary)
+        if f.kind == "range":
+            v = v[sp.run_end]
+            n = n[sp.run_end]
+        return ColVal(result_dt, v, n > 0), (v, n)
+    raise ValueError(kind)
 
 
 class TpuWindowExec(TpuExec):
@@ -248,73 +314,11 @@ class TpuWindowExec(TpuExec):
         auxs = []
         for i, (_, we) in enumerate(self.window_exprs):
             c = s_extras[self._extra_ofs[i]] if i in self._extra_ofs else None
-            out, aux = self._eval_window(we, sp, c, seg_boundary, capacity)
+            out, aux = eval_window_expr(we, sp, c, seg_boundary,
+                                        capacity)
             outs.append(out)
             auxs.append(aux)
         return s_payload, outs, tuple(auxs)
-
-    def _eval_window(self, we: WindowExpression, sp: W.SortedPartitions,
-                     c: Optional[ColVal], seg_boundary, capacity: int
-                     ) -> Tuple[ColVal, tuple]:
-        """(output, aux): aux carries the running-state arrays used by
-        the chunked path to continue a partition across chunks (empty
-        for non-running frames)."""
-        f = we.spec.frame
-        kind = we.kind
-        if kind == "row_number":
-            rn = W.row_number(sp)
-            return rn, (rn.values,)
-        if kind == "rank":
-            return W.rank(sp), ()
-        if kind == "dense_rank":
-            return W.dense_rank(sp), ()
-        if kind == "percent_rank":
-            return W.percent_rank(sp), ()
-        if kind in ("lead", "lag"):
-            off = we.offset if kind == "lead" else -we.offset
-            # defaults are literals; emit standalone
-            dflt = None
-            if we.default is not None:
-                from spark_rapids_tpu.ops.expressions import EmitContext
-                dflt = we.default.emit(EmitContext([], jnp.int32(0),
-                                                   capacity))
-            return W.lead_lag(sp, c, off, dflt), ()
-
-        rows = f.kind == "rows"
-        result_dt = we.dtype
-        if kind in ("sum", "count", "avg"):
-            cin = c if kind != "count" else (c or ColVal(
-                dts.INT64, jnp.ones(capacity, dtype=jnp.int64)))
-            vals = cin.values.astype(result_dt.storage) \
-                if kind == "sum" else cin.values
-            if kind == "avg":
-                vals = vals.astype(jnp.float64)
-            cv = ColVal(cin.dtype, vals, cin.validity)
-            running = f.lo is None and f.hi == 0
-            if not rows and running:
-                # range running: include full tie run
-                s, n = W.frame_sum(sp, cv, None, 0, rows=False)
-            else:
-                s, n = W.frame_sum(sp, cv, f.lo, f.hi, rows=True)
-            aux = (s, n) if running else ()
-            if kind == "count":
-                return ColVal(dts.INT64, n), aux
-            if kind == "avg":
-                return ColVal(dts.FLOAT64,
-                              s / jnp.maximum(n, 1).astype(jnp.float64),
-                              n > 0), aux
-            return ColVal(result_dt, s, n > 0), aux
-        if kind in ("min", "max"):
-            whole = f.lo is None and f.hi is None
-            if whole:
-                v, n = W.partition_reduce(sp, c, kind, capacity)
-                return ColVal(result_dt, v, n > 0), ()
-            v, n = W.running_minmax(sp, c, kind, seg_boundary)
-            if f.kind == "range":
-                v = v[sp.run_end]
-                n = n[sp.run_end]
-            return ColVal(result_dt, v, n > 0), (v, n)
-        raise ValueError(kind)
 
     # ---- drive ---------------------------------------------------------------
     def _stage_inputs(self, merged: ColumnarBatch):

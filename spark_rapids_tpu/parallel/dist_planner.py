@@ -544,6 +544,93 @@ def _run_project(f: ShardedFrame, exprs: Sequence[Expression], tag: str):
         out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
 
 
+def _run_expand(f: ShardedFrame, projections, out_phys):
+    """Compiled shard_map Expand: K projection replicas per shard,
+    compacted to the shard's live prefix via a replica/row gather — no
+    exchange, capacity grows by K."""
+    import jax
+    from spark_rapids_tpu.ops.aggregates import widen_colval
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+    phys = f.phys_dtypes
+    K = len(projections)
+
+    def step(flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, phys)]
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        out_cap = cap * K
+        idx = jnp.arange(out_cap, dtype=jnp.int32)
+        n = jnp.maximum(nrows, 1)
+        rep = jnp.minimum(idx // n, K - 1)
+        row = jnp.minimum(idx % n, cap - 1)
+        outs = []
+        for j, dt in enumerate(out_phys):
+            stacked_v, stacked_m = [], []
+            for proj in projections:
+                c = widen_colval(proj[j].emit(ctx), cap)
+                stacked_v.append(c.values.astype(dt.storage))
+                stacked_m.append(_ones_like_validity(c, cap))
+            sv = jnp.stack(stacked_v)   # (K, cap)
+            sm = jnp.stack(stacked_m)
+            outs.append((sv[rep, row], sm[rep, row]))
+        return tuple(outs), (nrows * K).astype(jnp.int32)[None]
+
+    sig = ("dplan_expand", _mesh_sig(f.mesh),
+           tuple(dt.name for dt in phys),
+           tuple(tuple(e.cache_key() for e in p) for p in projections))
+    axis = f.mesh.axis_names[0]
+    cols, nrows = cached_jit(sig, lambda: jax.shard_map(
+        step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
+    return cols, nrows.reshape(-1)
+
+
+def _run_union(child_frames, out_phys, mesh):
+    """Compiled shard_map Union: shard i concatenates its slices of
+    every child's columns (live prefixes back to back) — no exchange."""
+    import jax
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+
+    def step(*args):
+        col_sets, nrow_arrs = args[0::2], args[1::2]
+        caps = [cs[0][0].shape[0] for cs in col_sets]
+        out_cap = sum(caps)
+        ns = [a[0] for a in nrow_arrs]
+        total = sum(ns)
+        idx = jnp.arange(out_cap, dtype=jnp.int32)
+        outs = []
+        for j, dt in enumerate(out_phys):
+            v = jnp.zeros(out_cap, dtype=dt.storage)
+            m = jnp.zeros(out_cap, dtype=jnp.bool_)
+            at = jnp.int32(0)
+            for cs, n, cap in zip(col_sets, ns, caps):
+                cv, cm = cs[j]
+                src_pos = idx - at
+                take = (src_pos >= 0) & (src_pos < n)
+                safe = jnp.clip(src_pos, 0, cap - 1)
+                v = jnp.where(take, cv.astype(dt.storage)[safe], v)
+                m = jnp.where(take, cm[safe], m)
+                at = at + n
+            outs.append((v, m))
+        return tuple(outs), total.astype(jnp.int32)[None]
+
+    sig = ("dplan_union", _mesh_sig(mesh),
+           tuple(dt.name for dt in out_phys),
+           tuple(int(cf[0][0][0].shape[0]) for cf in child_frames))
+    axis = mesh.axis_names[0]
+    ins = []
+    for cols, nrows in child_frames:
+        ins.append(tuple(cols))
+        ins.append(nrows)
+    in_specs = tuple(P(axis) for _ in ins)
+    cols, nrows = cached_jit(sig, lambda: jax.shard_map(
+        step, mesh=mesh, in_specs=in_specs,
+        out_specs=P(axis), check_vma=False))(*ins)
+    return cols, nrows.reshape(-1)
+
+
 def _run_filter(f: ShardedFrame, cond: Expression):
     import jax
     from spark_rapids_tpu.ops import selection
@@ -623,6 +710,13 @@ class DistPlanner:
             if isinstance(plan.child, L.Sort):
                 return self._topn(plan, dry)
             return self._limit(plan, dry)
+        if isinstance(plan, L.Window):
+            return self._window(plan, dry)
+        if isinstance(plan, L.Union):
+            return self._union(plan, dry)
+        from spark_rapids_tpu.exec.expand import Expand as _Expand
+        if isinstance(plan, _Expand):
+            return self._expand(plan, dry)
         raise NotDistributable(
             f"{type(plan).__name__} has no distributed lowering")
 
@@ -1132,6 +1226,138 @@ class DistPlanner:
         out_cols, nrows = dist(f.cols, f.nrows)
         self._emit_stats("sort", dist.last_stats)
         return f.replace(cols=list(out_cols), nrows=nrows.reshape(-1))
+
+    # -- window -----------------------------------------------------------
+    def _window(self, plan: L.Window, dry: bool) -> ShardedFrame:
+        """Window as an exchange consumer (GpuWindowExec role): range
+        partition on the PARTITION BY prefix via the distributed sort
+        (a partition never splits a shard), then shard-local windowed
+        evaluation with the single-process kernels."""
+        from spark_rapids_tpu.exec.window import (WindowExpression,
+                                                  WindowSpec)
+        from spark_rapids_tpu.ops import aggregates as agg
+        from spark_rapids_tpu.parallel.distwindow import DistributedWindow
+        f = self.run(plan.child, dry)
+        exprs = plan.window_exprs
+        spec0 = exprs[0][1].spec
+        for _, we in exprs[1:]:
+            if we.spec.cache_key() != spec0.cache_key():
+                raise NotDistributable(
+                    "multiple window specs in one node")
+        if not spec0.partition_exprs:
+            raise NotDistributable(
+                "window without PARTITION BY needs a global cross-shard "
+                "carry")
+        low = ExprLowering(f.enc, self.conf)
+        lspec = WindowSpec(
+            [low.lower(e) for e in spec0.partition_exprs],
+            [(low.lower(e), d, nf) for e, d, nf in spec0.orders],
+            spec0.frame)
+        _check_supported(list(lspec.partition_exprs) +
+                         [e for e, _, _ in lspec.orders], self.conf)
+        lowered = []
+        enc_new = {}
+        nchild = len(f.names)
+        for j, (name, we) in enumerate(exprs):
+            reason = we.supported_reason()
+            if reason:
+                raise NotDistributable(f"window {name}: {reason}")
+            ch = None
+            if we.child_expr is not None:
+                ch = low.lower(we.child_expr)
+                _check_supported([ch], self.conf)
+                d = low.out_dict(ch)
+                if d is not None:
+                    if we.kind in ("min", "max", "lead", "lag"):
+                        # order-preserving codes: the output is codes too
+                        enc_new[nchild + j] = d
+                    elif we.kind != "count":  # count reads only validity
+                        raise NotDistributable(
+                            f"window {we.kind} over strings not "
+                            "supported on the mesh")
+            dflt = low.lower(we.default) if we.default is not None \
+                else None
+            lowered.append((name, WindowExpression(
+                we.kind, lspec, ch, we.offset, dflt)))
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        enc = dict(f.enc)
+        enc.update(enc_new)
+        if dry:
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                enc)
+        dist = DistributedWindow(self.mesh, f.phys_dtypes, lowered)
+        out = dist(f.cols, f.nrows)
+        cols, nrows = out
+        self._emit_stats("window", dist.last_stats)
+        return ShardedFrame(self.mesh, names, log_dtypes, list(cols),
+                            nrows.reshape(-1), enc)
+
+    # -- expand / union ---------------------------------------------------
+    def _expand(self, plan, dry: bool) -> ShardedFrame:
+        """Expand is embarrassingly parallel: each shard emits its K
+        projection replicas locally; no exchange (GpuExpandExec role)."""
+        from spark_rapids_tpu.exec.expand import NullLiteral
+        f = self.run(plan.child, dry)
+        low = ExprLowering(f.enc, self.conf)
+        projections = []
+        enc_new = {}
+        for k, proj in enumerate(plan.projections):
+            lowered = []
+            for j, e in enumerate(proj):
+                if isinstance(e, NullLiteral):
+                    le = NullLiteral(_phys(e.dtype))
+                else:
+                    le = low.lower(e)
+                    d = low.out_dict(le)
+                    if d is not None:
+                        prev = enc_new.get(j)
+                        if prev is not None and prev is not d:
+                            raise NotDistributable(
+                                "expand projections disagree on a "
+                                "string column's dictionary")
+                        enc_new[j] = d
+                lowered.append(le)
+            projections.append(lowered)
+        for proj in projections:
+            _check_supported(
+                [e for e in proj
+                 if not isinstance(e, NullLiteral)], self.conf)
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        for j, dt in enumerate(log_dtypes):
+            if dt.is_string and j not in enc_new:
+                raise NotDistributable(
+                    f"expand string column {names[j]!r} has no "
+                    "dictionary on the mesh")
+        if dry:
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                enc_new)
+        cols, nrows = _run_expand(f, projections,
+                                  [_phys(dt) for dt in log_dtypes])
+        return ShardedFrame(self.mesh, names, log_dtypes, list(cols),
+                            nrows, enc_new)
+
+    def _union(self, plan: L.Union, dry: bool) -> ShardedFrame:
+        """Union keeps rows where they are: shard i's output is the
+        concatenation of shard i's slices of every child (no exchange)."""
+        frames = [self.run(c, dry) for c in plan.children]
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        # encoded string columns would need dictionary alignment across
+        # children; only distribute when no column is a string
+        if any(dt.is_string for dt in log_dtypes):
+            raise NotDistributable(
+                "union over string columns needs dictionary alignment "
+                "(not yet distributed)")
+        if dry:
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                {})
+        cols, nrows = _run_union([(fr.cols, fr.nrows) for fr in frames],
+                                 [_phys(dt) for dt in log_dtypes],
+                                 self.mesh)
+        return ShardedFrame(self.mesh, names, log_dtypes, list(cols),
+                            nrows, {})
 
     def _limit(self, plan: L.Limit, dry: bool) -> ShardedFrame:
         f = self.run(plan.child, dry)

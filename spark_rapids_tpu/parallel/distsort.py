@@ -129,7 +129,12 @@ class DistributedSort:
     def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
                  key_exprs: Sequence[Expression],
                  descending: Sequence[bool],
-                 nulls_first: Sequence[bool]):
+                 nulls_first: Sequence[bool],
+                 partition_prefix: Optional[int] = None):
+        """``partition_prefix``: range-partition on only the first N
+        keys (local sort still uses all of them), so rows equal on the
+        prefix are guaranteed to land on ONE shard — the window
+        lowering's requirement that a partition never splits."""
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
@@ -138,13 +143,16 @@ class DistributedSort:
         self.key_exprs = list(key_exprs)
         self.descending = list(descending)
         self.nulls_first = list(nulls_first)
+        self.prefix = len(self.key_exprs) if partition_prefix is None \
+            else int(partition_prefix)
         self._cached_jit = cached_jit
         self._sig = ("dist_sort", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
                      tuple(str(d) for d in mesh.devices.flat),
                      tuple(dt.name for dt in self.in_dtypes),
                      tuple(e.cache_key() for e in self.key_exprs),
-                     tuple(self.descending), tuple(self.nulls_first))
+                     tuple(self.descending), tuple(self.nulls_first),
+                     self.prefix)
         self.last_stats: Optional[dict] = None
 
     def _emit_keys(self, cols: List[ColVal], nrows) -> List[ColVal]:
@@ -157,12 +165,12 @@ class DistributedSort:
         return [ColVal(dt, v, val)
                 for (v, val), dt in zip(flat_cols, self.in_dtypes)]
 
-    # phase 1: strided sample of the key columns
+    # phase 1: strided sample of the (prefix) key columns
     def _step_sample(self, flat_cols, nrows_arr):
         nrows = nrows_arr[0]
         cols = self._cols_of(flat_cols)
         cap = cols[0].values.shape[0]
-        keys = self._emit_keys(cols, nrows)
+        keys = self._emit_keys(cols, nrows)[: self.prefix]
         k = min(self.SAMPLE_PER_SHARD, cap)
         idx = jnp.clip(
             (jnp.arange(k, dtype=jnp.int32) *
@@ -182,8 +190,9 @@ class DistributedSort:
         nrows = nrows_arr[0]
         cols = self._cols_of(flat_cols)
         cap = cols[0].values.shape[0]
-        keys = self._emit_keys(cols, nrows)
-        pids = range_pids(keys, self.descending, self.nulls_first,
+        keys = self._emit_keys(cols, nrows)[: self.prefix]
+        pids = range_pids(keys, self.descending[: self.prefix],
+                          self.nulls_first[: self.prefix],
                           spl_vals, spl_valid, self.nshards)
         live = jnp.arange(cap, dtype=jnp.int32) < nrows
         return histogram(pids, live, self.nshards)
@@ -192,8 +201,9 @@ class DistributedSort:
     def _step_final(self, slot, spl_vals, spl_valid, flat_cols, nrows_arr):
         nrows = nrows_arr[0]
         cols = self._cols_of(flat_cols)
-        keys = self._emit_keys(cols, nrows)
-        pids = range_pids(keys, self.descending, self.nulls_first,
+        keys = self._emit_keys(cols, nrows)[: self.prefix]
+        pids = range_pids(keys, self.descending[: self.prefix],
+                          self.nulls_first[: self.prefix],
                           spl_vals, spl_valid, self.nshards)
         recv, recv_n = exchange(cols, pids, nrows, self.axis, self.nshards,
                                 slot=slot)
@@ -223,8 +233,8 @@ class DistributedSort:
         cols = [np.asarray(v) for v, _ in key_samples]
         valids = [np.where(live, np.asarray(val), False)
                   for _, val in key_samples]
-        order = host_order(cols, valids, self.descending, self.nulls_first,
-                           live=live)
+        order = host_order(cols, valids, self.descending[: self.prefix],
+                           self.nulls_first[: self.prefix], live=live)
         m = int(live.sum())
         spl_vals, spl_valid = [], []
         if m == 0:

@@ -338,3 +338,71 @@ def test_sharded_scan_with_pushdown(dist_session, oracle_session,
         F.col("id") >= 450).groupBy().agg(F.count("id").alias("n"),
                                           F.sum("v").alias("sv"))
     _cmp(q(dist_session), q(oracle_session))
+
+
+# ---- round-4: window / expand / union lowerings ---------------------------
+
+def test_window_distributed(dist_session, oracle_session, frames):
+    """Windowed queries lower to range-partition-by-partition-key (a
+    partition never splits a shard) + shard-local window kernels
+    (round-3 verdict task #4; GpuWindowExec role)."""
+    from spark_rapids_tpu.api.functions import Window
+    w = Window.partitionBy("k2").orderBy("o_")
+
+    def build(f, _):
+        f = f.withColumn("o_", F.col("v"))
+        return f.select(
+            "k2", "o_",
+            F.sum("v").over(w).alias("rs"),
+            F.row_number().over(w).alias("rn"),
+            F.count("v").over(w).alias("rc"),
+        ).orderBy("k2", "o_", "rn")
+    d, o = _both(dist_session, oracle_session, frames, build)
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_window_rank_and_minmax_distributed(dist_session, oracle_session,
+                                            frames):
+    from spark_rapids_tpu.api.functions import Window
+    w = Window.partitionBy("k2").orderBy("k")
+
+    def build(f, _):
+        return f.select(
+            "k2", "k", "v",
+            F.rank().over(w).alias("rk"),
+            F.min("v").over(w).alias("rm"),
+        ).orderBy("k2", "k", "v", "rk")
+    d, o = _both(dist_session, oracle_session, frames, build)
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_rollup_distributed(dist_session, oracle_session, frames):
+    """Rollup lowers through the distributed Expand (embarrassingly
+    parallel replicas) + aggregate."""
+    def build(f, _):
+        return f.rollup("k2", "k").agg(
+            F.sum("v").alias("sv"), F.count("v").alias("n"))
+    d, o = _both(dist_session, oracle_session, frames, build)
+    _cmp(d, o, sort_by=["k2", "k"])
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_cube_distributed(dist_session, oracle_session, frames):
+    def build(f, _):
+        return f.cube("k2").agg(F.sum("v").alias("sv"))
+    d, o = _both(dist_session, oracle_session, frames, build)
+    _cmp(d, o, sort_by=["k2"])
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_union_distributed(dist_session, oracle_session, frames):
+    def build(f, _):
+        a = f.select("k", "v").filter(F.col("v") > 0)
+        b = f.select("k", "v").filter(F.col("v") <= 0)
+        return a.union(b).groupBy("k").agg(F.sum("v").alias("sv"),
+                                           F.count("v").alias("n"))
+    d, o = _both(dist_session, oracle_session, frames, build)
+    _cmp(d, o, sort_by=["k"])
+    assert dist_session.last_dist_explain == "distributed"

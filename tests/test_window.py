@@ -145,3 +145,22 @@ def test_range_running_with_ties(session):
     out = out.sort_values(["o", "rs"]).reset_index(drop=True)
     # ties share the frame: rows with o=1 both see 1+2; o=2 see 1+2+3+4
     assert out["rs"].tolist() == [3.0, 3.0, 10.0, 10.0, 15.0]
+
+
+def test_lead_lag_default_not_cache_aliased():
+    """Regression (round-4 review): two lag() calls differing only in
+    the DEFAULT literal must not share a cached executable."""
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import Window
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession()
+    df = s.create_dataframe(pd.DataFrame(
+        {"g": [0, 0, 0], "o": [0, 1, 2], "v": [1.0, 2.0, 3.0]}))
+    w = Window.partitionBy("g").orderBy("o")
+    a = df.select(F.lag("v", 1, 0.0).over(w).alias("x")) \
+        .to_pandas()["x"].tolist()
+    b = df.select(F.lag("v", 1, -1.0).over(w).alias("x")) \
+        .to_pandas()["x"].tolist()
+    assert a == [0.0, 1.0, 2.0]
+    assert b == [-1.0, 1.0, 2.0]
