@@ -146,15 +146,18 @@ HAS_NANS = conf(
     "refuse to run when set (reference RapidsConf.scala:549).", _to_bool)
 
 DECIMAL_ENABLED = conf(
-    "spark.rapids.sql.decimalType.enabled", False,
-    "Enable decimal (DECIMAL_64) processing "
+    "spark.rapids.sql.decimalType.enabled", True,
+    "Enable decimal (DECIMAL_64) processing: device arithmetic with "
+    "Spark result-type rules and overflow->null, sum up to "
+    "decimal(8,s) children; wider results and avg fall back to CPU "
     "(reference RapidsConf.scala:564).", _to_bool)
 
 OPTIMIZER_TRANSITION_COST = conf(
-    "spark.rapids.sql.optimizer.transitionRowCost", 2.0,
-    "Per-row cost weight of a host<->device transition used by the "
-    "cost-based optimizer (relative to ~1.0 per row of CPU operator "
-    "work).", _to_float)
+    "spark.rapids.sql.optimizer.transitionRowCost", 0.1,
+    "Microseconds per row charged for a host<->device transition by the "
+    "cost-based optimizer; operator costs come calibrated from "
+    "plan/cbo_weights.json (regenerate with "
+    "spark-rapids-tpu-cbo-calibrate).", _to_float)
 
 INCOMPAT_ENABLED = conf(
     "spark.rapids.sql.incompatibleOps.enabled", True,
@@ -373,11 +376,18 @@ METRICS_LEVEL = conf(
 # spark.rapids.sql.expression.<Name> / spark.rapids.sql.exec.<Name>
 _DYNAMIC_PREFIXES = ("spark.rapids.sql.expression.",
                      "spark.rapids.sql.exec.")
+# per-op cost-model overrides (any logical-plan op name): the CBO loads
+# calibrated defaults from plan/cbo_weights.json and these keys override
+_COST_PREFIXES = ("spark.rapids.sql.optimizer.tpuOpCost.",
+                  "spark.rapids.sql.optimizer.cpuOpCost.")
 
 
 def _known_key(key: str) -> bool:
     if key in _REGISTRY:
         return True
+    for p in _COST_PREFIXES:
+        if key.startswith(p):
+            return True
     for p in _DYNAMIC_PREFIXES:
         if key.startswith(p):
             suffix = key[len(p):]
@@ -403,6 +413,14 @@ class RapidsConf:
                 raise ValueError(
                     f"unknown configuration key {k!r}; see "
                     "RapidsConf.registry() for available keys")
+
+    def op_cost(self, side: str, name: str):
+        """Per-op cost override (us/row):
+        spark.rapids.sql.optimizer.<side>OpCost.<Op>; None = use the
+        calibrated default from plan/cbo_weights.json."""
+        raw = self.settings.get(
+            f"spark.rapids.sql.optimizer.{side}OpCost.{name}")
+        return None if raw is None else float(raw)
 
     def op_enabled(self, kind: str, name: str) -> bool:
         """Per-op enable key: spark.rapids.sql.<kind>.<Name>, default

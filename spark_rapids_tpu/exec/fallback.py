@@ -550,6 +550,19 @@ class CpuFallbackExec(TpuExec):
                         (not isinstance(v, (list, tuple, np.ndarray))
                          and pd.isna(v)) else list(v) for v in s]
                 cols[name] = Column.from_arrays(vals, dt.element)
+            elif dt.is_decimal:
+                # unscaled int64 at the declared scale (HALF_UP), not a
+                # value-truncating astype over Decimal objects
+                import decimal as _d
+                q = _d.Decimal(1).scaleb(-dt.scale)
+                valid = s.notna().to_numpy()
+                ints = [0 if (v is None or pd.isna(v)) else
+                        int(_d.Decimal(v).quantize(
+                            q, rounding=_d.ROUND_HALF_UP)
+                            .scaleb(dt.scale)) for v in s]
+                cols[name] = Column.from_numpy(
+                    np.asarray(ints, dtype=np.int64), dtype=dt,
+                    validity=None if valid.all() else valid)
             else:
                 valid = s.notna().to_numpy()
                 filled = s.fillna(0).to_numpy()

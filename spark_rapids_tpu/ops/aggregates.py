@@ -164,12 +164,21 @@ class AggregateFunction:
         return (type(self).__name__,
                 self.child.cache_key() if self.child is not None else None)
 
+    def supported_reason(self) -> Optional[str]:
+        """None when the device can run this aggregate; else why not
+        (the planner tags it and the query falls back)."""
+        return None
+
 
 def _sum_result_type(t: DataType) -> DataType:
     if t.is_floating:
         return dts.FLOAT64
     if t.is_decimal:
-        return t
+        # Spark: sum(decimal(p,s)) = decimal(p+10, s), capped at
+        # DECIMAL_64 (device eligibility is gated separately in
+        # supported_reason: p+10 > 18 falls back to CPU)
+        from spark_rapids_tpu.columnar.dtypes import DecimalType
+        return DecimalType(min(t.precision + 10, 18), t.scale)
     return dts.INT64
 
 
@@ -179,6 +188,15 @@ class Sum(AggregateFunction):
     @property
     def result_dtype(self):
         return _sum_result_type(self.child.dtype)
+
+    def supported_reason(self):
+        t = self.child.dtype
+        if t.is_decimal and t.precision + 10 > 18:
+            # the int64 accumulator could silently wrap past DECIMAL_64
+            # (the reference's DECIMAL_64 sum gate)
+            return (f"sum over {t} needs decimal({t.precision + 10},"
+                    f"{t.scale}) > DECIMAL_64; falls back to CPU")
+        return None
 
     def buffers(self):
         return [BufferSpec("sum", self.result_dtype)]
@@ -260,7 +278,20 @@ class Average(AggregateFunction):
 
     @property
     def result_dtype(self):
+        if self.child is not None and self.child.dtype.is_decimal:
+            # Spark avg(decimal(p,s)) = decimal(p+4, s+4) (capped)
+            from spark_rapids_tpu.ops.decimal_ops import (
+                adjust_precision_scale)
+            t = self.child.dtype
+            return adjust_precision_scale(t.precision + 4, t.scale + 4)
         return dts.FLOAT64
+
+    def supported_reason(self):
+        if self.child is not None and self.child.dtype.is_decimal:
+            # the rounded unscaled division needs a 128-bit intermediate
+            return (f"avg over {self.child.dtype} not supported on "
+                    "device; falls back to CPU")
+        return None
 
     def buffers(self):
         return [BufferSpec("sum", dts.FLOAT64), BufferSpec("sum", dts.INT64)]

@@ -18,25 +18,71 @@ from spark_rapids_tpu.ops.expressions import (
 )
 
 
-class Add(BinaryExpression):
+class _DecimalAwareBinary(BinaryExpression):
+    """Binary arithmetic with Spark's decimal result-type rules fused
+    in: PromotePrecision (operand rescale) + op + CheckOverflow
+    (overflow -> null) execute as one emit when either side is a
+    decimal (GpuOverrides.scala:824-838 wrapper pair, fused)."""
+
+    _dec_op: str = ""
+
+    def _decimal_mode(self) -> bool:
+        return self.left.dtype.is_decimal or self.right.dtype.is_decimal
+
+    def operand_type(self) -> DataType:
+        if self._decimal_mode():
+            from spark_rapids_tpu.ops import decimal_ops as D
+            a, b = self.left.dtype, self.right.dtype
+            if a.is_floating or b.is_floating:
+                return dts.FLOAT64  # decimal promotes to double
+            return D.binary_result(self._dec_op, a, b)
+        return super().operand_type()
+
+    @property
+    def dtype(self) -> DataType:
+        return self.operand_type()
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        if self._decimal_mode() and not (self.left.dtype.is_floating or
+                                         self.right.dtype.is_floating):
+            from spark_rapids_tpu.ops import decimal_ops as D
+            out = D.binary_result(self._dec_op, self.left.dtype,
+                                  self.right.dtype)
+            return D.emit_binary(self._dec_op, self.left.emit(ctx),
+                                 self.right.emit(ctx), out)
+        return super().emit(ctx)
+
+
+class Add(_DecimalAwareBinary):
+    _dec_op = "add"
+
     def eval_values(self, l, r):
         return l + r, None
 
 
-class Subtract(BinaryExpression):
+class Subtract(_DecimalAwareBinary):
+    _dec_op = "sub"
+
     def eval_values(self, l, r):
         return l - r, None
 
 
-class Multiply(BinaryExpression):
+class Multiply(_DecimalAwareBinary):
+    _dec_op = "mul"
+
     def eval_values(self, l, r):
         return l * r, None
 
 
-class Divide(BinaryExpression):
-    """Spark `/`: always double (fractional) division; x/0 -> null."""
+class Divide(_DecimalAwareBinary):
+    """Spark `/`: double (fractional) division — decimal division when
+    both sides are decimal-convertible; x/0 -> null."""
+
+    _dec_op = "div"
 
     def operand_type(self) -> DataType:
+        if self._decimal_mode():
+            return super().operand_type()
         return dts.FLOAT64
 
     def eval_values(self, l, r):

@@ -1,0 +1,295 @@
+"""Decimal arithmetic semantics (DECIMAL_64).
+
+The reference's decimal surface is the rule set around
+``GpuOverrides.scala:777-2826`` — ``PromotePrecision`` / ``CheckOverflow``
+wrappers Catalyst inserts around decimal arithmetic, plus
+``MakeDecimal`` / ``UnscaledValue`` used by partial aggregation — with
+storage capped at DECIMAL_64 (TypeChecks.scala DECIMAL_64 notes).  The
+TPU build stores decimals as unscaled int64 and implements the same
+Spark result-type rules (``allowPrecisionLoss`` defaults, capped at
+precision 18); anything wider tags off the device and runs on the CPU
+fallback, exactly like the reference falls back past DECIMAL_64.
+
+Rounding is HALF_UP (away from zero) wherever Spark rounds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.columnar.dtypes import DataType, DecimalType
+from spark_rapids_tpu.ops.expressions import (
+    ColVal, EmitContext, Expression, UnaryExpression, combine_validity,
+)
+
+MAX_PRECISION = 18  # DECIMAL_64
+
+
+def _dec_params(dt: DataType):
+    """(precision, scale) an operand contributes to decimal result-type
+    inference (Spark's DecimalPrecision integral conversions), or None
+    when it cannot participate.  bigint's (20, 0) exceeds DECIMAL_64 as
+    a stored type but is fine as an INFERENCE input — the adjusted
+    result caps at 18 with overflow -> null."""
+    if dt.is_decimal:
+        return dt.precision, dt.scale
+    return {"tinyint": (3, 0), "smallint": (5, 0), "int": (10, 0),
+            "bigint": (20, 0)}.get(dt.name)
+
+
+def as_decimal_type(dt: DataType) -> Optional[DataType]:
+    """The DECIMAL_64 type an operand implicitly converts to, or None."""
+    if dt.is_decimal:
+        return dt
+    ps = _dec_params(dt)
+    if ps is None or ps[0] > MAX_PRECISION:
+        return DecimalType(MAX_PRECISION, 0) if ps is not None else None
+    return DecimalType(*ps)
+
+
+def adjust_precision_scale(p: int, s: int) -> DataType:
+    """Spark's DecimalPrecision.adjustPrecisionScale with the cap at
+    DECIMAL_64's 18 instead of 38: keep integral digits, surrender
+    fractional digits down to min(scale, 6).  Results that still don't
+    fit produce overflow -> null at runtime (CheckOverflow)."""
+    if p <= MAX_PRECISION:
+        return DecimalType(p, s)
+    int_digits = p - s
+    min_scale = min(s, 6)
+    adj_scale = max(MAX_PRECISION - int_digits, min_scale)
+    return DecimalType(MAX_PRECISION, adj_scale)
+
+
+def binary_result(op: str, a: DataType, b: DataType) -> DataType:
+    """Spark's decimal result-type rules (+,-,*,/ and comparison
+    promotion), precision-adjusted to the DECIMAL_64 cap."""
+    pa_, pb = _dec_params(a), _dec_params(b)
+    if pa_ is None or pb is None:
+        raise TypeError(f"cannot run decimal {op} over {a} and {b} "
+                        "on DECIMAL_64")
+    p1, s1 = pa_
+    p2, s2 = pb
+    if op in ("add", "sub"):
+        s = max(s1, s2)
+        p = max(p1 - s1, p2 - s2) + s + 1
+    elif op == "mul":
+        p, s = p1 + p2 + 1, s1 + s2
+    elif op == "div":
+        s = max(6, s1 + p2 + 1)
+        p = p1 - s1 + s2 + s
+    elif op == "cmp":
+        s = max(s1, s2)
+        p = max(p1 - s1, p2 - s2) + s
+    else:
+        raise ValueError(op)
+    return adjust_precision_scale(p, s)
+
+
+def overflow_validity(values, precision: int):
+    """False where |unscaled| has more than ``precision`` digits (the
+    CheckOverflow role: overflow -> null in non-ANSI mode)."""
+    bound = 10 ** precision
+    return jnp.logical_and(values > -bound, values < bound)
+
+
+def rescale(values, from_scale: int, to_scale: int):
+    """Unscaled-value rescale; scale-down rounds HALF_UP."""
+    if to_scale >= from_scale:
+        return values * (10 ** (to_scale - from_scale))
+    f = 10 ** (from_scale - to_scale)
+    q = _trunc_div(values, jnp.int64(f))
+    rem = values - q * f
+    up = jnp.abs(rem) * 2 >= f
+    return jnp.where(up, q + jnp.sign(values), q)
+
+
+def _trunc_div(num, den):
+    """Integer division truncating toward zero (Java semantics)."""
+    q = num // den
+    rem = num - q * den
+    return jnp.where((rem != 0) & ((num < 0) != (den < 0)), q + 1, q)
+
+
+_I64_MAX = (1 << 63) - 1
+
+
+def _scale_up_guarded(v, factor: int):
+    """(v * factor, ok): int64 scale-up with overflow -> invalid (the
+    value existed in Spark's 128-bit world; here it nulls out)."""
+    if factor == 1:
+        return v, None
+    lim = _I64_MAX // factor
+    ok = jnp.logical_and(v >= -lim, v <= lim)
+    return v * factor, ok
+
+
+def to_unscaled(c: ColVal, dt: DataType, out: DataType):
+    """Operand -> (unscaled int64 at ``out.scale``, overflow-ok mask)
+    — the PromotePrecision role."""
+    v = c.values
+    if dt.is_decimal:
+        if out.scale >= dt.scale:
+            return _scale_up_guarded(v.astype(jnp.int64),
+                                     10 ** (out.scale - dt.scale))
+        return rescale(v.astype(jnp.int64), dt.scale, out.scale), None
+    return _scale_up_guarded(v.astype(jnp.int64), 10 ** out.scale)
+
+
+def emit_binary(op: str, left: ColVal, right: ColVal, out: DataType
+                ) -> ColVal:
+    """Device decimal +,-,*,/ over unscaled int64 with inline overflow
+    check (PromotePrecision + op + CheckOverflow fused).  int64
+    intermediate overflow nulls out like a CheckOverflow would."""
+    ldt, rdt = left.dtype, right.dtype
+    extra = []
+    if op in ("add", "sub"):
+        l, ok1 = to_unscaled(left, ldt, out)
+        r, ok2 = to_unscaled(right, rdt, out)
+        vals = l + r if op == "add" else l - r
+        extra += [ok1, ok2]
+    elif op == "mul":
+        # scales add: raw unscaled product (an integral operand is its
+        # own scale-0 unscaled value), guarded against int64 overflow
+        l = left.values.astype(jnp.int64)
+        r = right.values.astype(jnp.int64)
+        lim = _I64_MAX // jnp.maximum(jnp.abs(l), 1)
+        extra.append(jnp.logical_or(l == 0, jnp.abs(r) <= lim))
+        vals = l * r
+        ds = (ldt.scale if ldt.is_decimal else 0) + \
+            (rdt.scale if rdt.is_decimal else 0)
+        if ds != out.scale:  # precision-adjusted result: round down
+            vals = rescale(vals, ds, out.scale)
+    elif op == "div":
+        da, db = as_decimal_type(ldt), as_decimal_type(rdt)
+        l = left.values.astype(jnp.int64)
+        r = right.values.astype(jnp.int64)
+        # numerator scaled so the quotient lands at out.scale
+        shift = out.scale + db.scale - da.scale
+        if shift >= 0:
+            num, ok = _scale_up_guarded(l, 10 ** shift)
+        else:
+            num, ok = rescale(l, -shift, 0), None
+        extra.append(ok)
+        zero = r == 0
+        den = jnp.where(zero, 1, r)
+        q = _trunc_div(num, den)
+        rem = num - q * den
+        up = jnp.abs(rem) * 2 >= jnp.abs(den)
+        sign = jnp.where((num < 0) == (den < 0), 1, -1)
+        vals = jnp.where(up, q + sign, q)
+        extra.append(jnp.logical_not(zero))
+    else:
+        raise ValueError(op)
+    ok = overflow_validity(vals, out.precision)
+    validity = combine_validity(left.validity, right.validity, ok,
+                                *extra)
+    return ColVal(out, vals, validity)
+
+
+# --------------------------------------------------- named parity exprs --
+
+class PromotePrecision(UnaryExpression):
+    """Rescale a decimal child to a wider decimal type (the Catalyst
+    wrapper; arithmetic here fuses it, the class exists for parity and
+    for plans built programmatically).  Reference:
+    GpuOverrides.scala:824-830."""
+
+    def __init__(self, child: Expression, target: DataType):
+        super().__init__(child)
+        self.target = target
+
+    def with_children(self, children):
+        return PromotePrecision(children[0], self.target)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.target
+
+    def eval_values(self, v, cv):
+        return rescale(v.astype(jnp.int64), cv.dtype.scale,
+                       self.target.scale)
+
+    def cache_key(self):
+        return ("PromotePrecision", self.child.cache_key(),
+                self.target.name)
+
+
+class CheckOverflow(UnaryExpression):
+    """Null out values whose unscaled magnitude exceeds the declared
+    precision (non-ANSI overflow -> null).  Reference:
+    GpuOverrides.scala:831-838 GpuCheckOverflow."""
+
+    def __init__(self, child: Expression, target: DataType,
+                 null_on_overflow: bool = True):
+        super().__init__(child)
+        self.target = target
+        self.null_on_overflow = null_on_overflow
+
+    def with_children(self, children):
+        return CheckOverflow(children[0], self.target,
+                             self.null_on_overflow)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.target
+
+    def supported_reason(self) -> Optional[str]:
+        if not self.null_on_overflow:
+            return ("ANSI CheckOverflow (exception on overflow) runs on "
+                    "the CPU fallback")
+        return None
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        v = rescale(c.values.astype(jnp.int64), c.dtype.scale,
+                    self.target.scale)
+        ok = overflow_validity(v, self.target.precision)
+        return ColVal(self.target, v, combine_validity(c.validity, ok))
+
+    def cache_key(self):
+        return ("CheckOverflow", self.child.cache_key(),
+                self.target.name, self.null_on_overflow)
+
+
+class MakeDecimal(UnaryExpression):
+    """Reinterpret an int64 of unscaled values as a decimal (partial
+    aggregation plumbing; GpuOverrides GpuMakeDecimal analog)."""
+
+    def __init__(self, child: Expression, precision: int, scale: int):
+        super().__init__(child)
+        self.precision = int(precision)
+        self.scale = int(scale)
+
+    def with_children(self, children):
+        return MakeDecimal(children[0], self.precision, self.scale)
+
+    @property
+    def dtype(self) -> DataType:
+        return DecimalType(self.precision, self.scale)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        v = c.values.astype(jnp.int64)
+        ok = overflow_validity(v, self.precision)
+        return ColVal(self.dtype, v, combine_validity(c.validity, ok))
+
+    def cache_key(self):
+        return ("MakeDecimal", self.child.cache_key(), self.precision,
+                self.scale)
+
+
+class UnscaledValue(UnaryExpression):
+    """Decimal -> raw unscaled int64 (GpuUnscaledValue analog)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return dts.INT64
+
+    def eval_values(self, v, cv):
+        return v.astype(jnp.int64)
+
+    def cache_key(self):
+        return ("UnscaledValue", self.child.cache_key())

@@ -424,10 +424,13 @@ def promote_types(a: DataType, b: DataType) -> DataType:
     if a.name in order and b.name in order:
         return dts.dtype_from_name(order[max(order.index(a.name),
                                              order.index(b.name))])
-    if a.is_decimal and b.is_integral:
-        return a
-    if b.is_decimal and a.is_integral:
-        return b
+    if a.is_decimal or b.is_decimal:
+        if a.is_floating or b.is_floating:
+            return dts.FLOAT64  # decimal promotes to double
+        if (a.is_decimal or a.is_integral) and \
+                (b.is_decimal or b.is_integral):
+            from spark_rapids_tpu.ops.decimal_ops import binary_result
+            return binary_result("cmp", a, b)
     if a.is_datetime and b.is_datetime:
         return dts.TIMESTAMP_US
     raise TypeError(f"cannot promote {a} and {b}")

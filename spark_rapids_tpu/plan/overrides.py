@@ -93,6 +93,15 @@ for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand):
     expr_rule(c, ts.NUMERIC)
 
+# decimal plumbing (GpuOverrides.scala:824-838 PromotePrecision /
+# CheckOverflow pair + MakeDecimal / UnscaledValue); arithmetic fuses
+# the wrappers, the named forms exist for programmatic plans
+from spark_rapids_tpu.ops import decimal_ops as DEC  # noqa: E402
+
+for c in (DEC.PromotePrecision, DEC.CheckOverflow, DEC.MakeDecimal,
+          DEC.UnscaledValue):
+    expr_rule(c, ts.NUMERIC)
+
 # regex family + remaining string surface (stringFunctions.scala +
 # shim RegExpReplace rules; unsupported patterns tag off like the
 # reference's incompat flag)
@@ -214,6 +223,9 @@ class ExprMeta(BaseMeta):
                     "spark.rapids.sql.incompatibleOps.enabled is false")
         if isinstance(expr, AggregateExpression):
             try:
+                reason = expr.func.supported_reason()
+                if reason:
+                    self.will_not_work(reason)
                 if expr.dtype.is_array and not getattr(
                         expr.func, "single_pass", False):
                     self.will_not_work(
@@ -222,12 +234,13 @@ class ExprMeta(BaseMeta):
                         "produce arrays)")
                 child = expr.func.child
                 if child is not None and child.dtype.has_offsets and \
-                        expr.func.name != "count" and not getattr(
-                            expr.func, "single_pass", False):
-                    # min/max/first/last need row values; a chars+offsets
-                    # column has no order-preserving device code here
-                    # (the distributed planner's scan-wide dictionary
-                    # does support these — parallel/dist_planner.py)
+                        expr.func.name not in ("count", "min", "max",
+                                               "first", "last") and \
+                        not getattr(expr.func, "single_pass", False):
+                    # string min/max/first/last run via batch-local
+                    # order-preserving dictionary codes
+                    # (exec/aggregate.py); sum/avg over offset columns
+                    # have no numeric meaning on device
                     self.will_not_work(
                         f"aggregate {expr.func.name} over "
                         f"{child.dtype.name} values falls back to CPU")
@@ -253,11 +266,6 @@ class ExprMeta(BaseMeta):
             self.will_not_work(
                 f"date_format pattern {expr.fmt!r} outside the "
                 "fixed-width device subset (yyyy/MM/dd/HH/mm/ss)")
-        if isinstance(expr, preds.InSet) and \
-                expr.children[0].dtype.is_string:
-            self.will_not_work(
-                "InSet over strings has no device table; use IN "
-                "(literals)")
         if isinstance(expr, (RX.RLike, RX.RegExpReplace, RX.StringReplace,
                              RX.Translate, RX.SplitPart)) and \
                 not expr.supported:
@@ -330,6 +338,18 @@ class PlanMeta(BaseMeta):
         if isinstance(node, L.Aggregate) and any(
                 e.dtype.is_array for e in node.group_exprs):
             self.will_not_work("array group-by keys not supported on TPU")
+        if isinstance(node, L.Aggregate):
+            funcs = [x.func for e in node.agg_exprs
+                     for x in _walk_aggs(e)]
+            if any(getattr(f, "single_pass", False) for f in funcs) and \
+                    any(f.child is not None and f.child.dtype.has_offsets
+                        and not getattr(f, "single_pass", False)
+                        for f in funcs):
+                # the single-pass (collect) execution path has no
+                # dictionary staging for string min/max siblings
+                self.will_not_work(
+                    "collect aggregates combined with string-valued "
+                    "min/max/first/last fall back to CPU")
         if isinstance(node, L.Generate) and not \
                 node.generator.dtype.is_array:
             self.will_not_work(
@@ -365,6 +385,15 @@ class PlanMeta(BaseMeta):
             if em.reasons:
                 lines.extend(em.explain_lines(depth + 1, False))
         return lines
+
+
+def _walk_aggs(e: Expression) -> List[AggregateExpression]:
+    out = []
+    if isinstance(e, AggregateExpression):
+        out.append(e)
+    for c in e.children:
+        out.extend(_walk_aggs(c))
+    return out
 
 
 def _deep_reasons(meta: BaseMeta) -> List[str]:

@@ -104,3 +104,54 @@ def date_string_gen(null_rate=0.1):
                         "2021-1-1", "2021/01/01", "0001-01-01",
                         "9999-12-31"],
                null_rate=null_rate)
+
+
+def decimal_gen(precision=7, scale=2, null_rate=0.1):
+    """Decimal(p,s) values as ``decimal.Decimal`` with the precision
+    extremes the DECIMAL_64 arithmetic must survive (reference
+    data_gen.py DecimalGen)."""
+    import decimal
+    lim = 10 ** precision - 1
+
+    def base(rng):
+        return decimal.Decimal(
+            int(rng.integers(-lim, lim + 1))).scaleb(-scale)
+    edge = [decimal.Decimal(v).scaleb(-scale)
+            for v in (0, 1, -1, lim, -lim, lim - 1, -(lim - 1))]
+    return Gen(f"decimal({precision},{scale})", base, special=edge,
+               null_rate=null_rate)
+
+
+def timestamp_gen(null_rate=0.1):
+    """Microsecond timestamps as np.datetime64 across the representable
+    range (reference data_gen.py TimestampGen)."""
+    def base(rng):
+        us = int(rng.integers(-(1 << 50), 1 << 50))
+        return np.datetime64(us, "us")
+    edge = [np.datetime64(v, "us") for v in
+            (0, 1, -1, 1609459200000000,        # 2021-01-01
+             -62135596800000000,                # 0001-01-01
+             253402300799999999)]               # 9999-12-31T23:59:59.99
+    return Gen("timestamp", base, special=edge, null_rate=null_rate)
+
+
+def date_gen(null_rate=0.1):
+    """date32 values as np.datetime64[D] (reference DateGen)."""
+    def base(rng):
+        return np.datetime64(int(rng.integers(-200 * 365, 200 * 365)),
+                             "D")
+    edge = [np.datetime64(v, "D") for v in (0, 1, -1, -719162, 2932896)]
+    return Gen("date", base, special=edge, null_rate=null_rate)
+
+
+def array_gen(element_gen=None, max_len=5, null_rate=0.1):
+    """Single-level arrays of non-null fixed-width elements (the device
+    layout's supported shape; reference ArrayGen)."""
+    inner = element_gen or int_gen(null_rate=0.0)
+
+    def base(rng):
+        k = int(rng.integers(0, max_len + 1))
+        vals = inner.generate(rng, k)
+        return [0 if v is None else v for v in vals]
+    return Gen(f"array<{inner.name}>", base, special=[[]],
+               null_rate=null_rate)

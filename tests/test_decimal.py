@@ -1,0 +1,189 @@
+"""Decimal (DECIMAL_64) semantics: Spark result-type rules, HALF_UP
+rounding, overflow -> null, aggregation gates, and the named plumbing
+expressions (reference: GpuOverrides.scala:824-838 decimal rules +
+TypeChecks.scala DECIMAL_64 notes)."""
+
+import decimal
+from decimal import Decimal as D
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar.dtypes import DecimalType
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def dec_df(session, cols):
+    """cols: {name: (values, precision, scale)}"""
+    arrays = {n: pa.array(v, type=pa.decimal128(p, s))
+              for n, (v, p, s) in cols.items()}
+    return session.create_dataframe(pa.table(arrays))
+
+
+def test_decimal_add_sub_result_type_and_values(session):
+    df = dec_df(session, {
+        "a": ([D("1.25"), D("-3.50"), None, D("99.99")], 4, 2),
+        "b": ([D("0.075"), D("2.000"), D("1.000"), D("0.005")], 4, 3),
+    })
+    q = df.select((F.col("a") + F.col("b")).alias("s"),
+                  (F.col("a") - F.col("b")).alias("d"))
+    plan = session.plan(q.plan)
+    assert "CpuFallbackExec" not in plan.tree_string()
+    # Spark: decimal(4,2) + decimal(4,3) -> decimal(6,3)
+    assert dict(q.plan.schema)["s"].name == "decimal(6,3)"
+    out = q.to_pandas()
+    assert out["s"].tolist() == [D("1.325"), D("-1.500"), None,
+                                 D("99.995")]
+    assert out["d"].tolist() == [D("1.175"), D("-5.500"), None,
+                                 D("99.985")]
+
+
+def test_decimal_multiply(session):
+    df = dec_df(session, {
+        "a": ([D("1.5"), D("-2.4"), D("0.0")], 3, 1),
+        "b": ([D("2.50"), D("1.25"), D("9.99")], 4, 2),
+    })
+    q = df.select((F.col("a") * F.col("b")).alias("m"))
+    # decimal(3,1) * decimal(4,2) -> decimal(8,3)
+    assert dict(q.plan.schema)["m"].name == "decimal(8,3)"
+    out = q.to_pandas()["m"].tolist()
+    assert out == [D("3.750"), D("-3.000"), D("0.000")]
+
+
+def test_decimal_divide_half_up(session):
+    df = dec_df(session, {
+        "a": ([D("1.0"), D("2.0"), D("-1.0"), D("7.0")], 2, 1),
+        "b": ([D("3.0"), D("0.0"), D("3.0"), D("2.0")], 2, 1),
+    })
+    q = df.select((F.col("a") / F.col("b")).alias("q"))
+    # decimal(2,1) / decimal(2,1): s=max(6,1+2+1)=6, p=2-1+1+6=8
+    assert dict(q.plan.schema)["q"].name == "decimal(8,6)"
+    out = q.to_pandas()["q"].tolist()
+    assert out[0] == D("0.333333")
+    assert out[1] is None  # divide by zero -> null
+    assert out[2] == D("-0.333333")
+    assert out[3] == D("3.500000")
+
+
+def test_decimal_overflow_is_null(session):
+    df = dec_df(session, {
+        "a": ([D("99.99"), D("1.00")], 4, 2),
+        "b": ([D("99.99"), D("1.00")], 4, 2),
+    })
+    # decimal(4,2)*decimal(4,2) -> decimal(9,4): 99.99*99.99 fits;
+    # force overflow via repeated multiply up to the precision cap
+    q = df.select(((F.col("a") * F.col("b")) * F.col("a")).alias("m"))
+    # decimal(9,4) * decimal(4,2) -> decimal(14,6)
+    out = q.to_pandas()["m"].tolist()
+    assert out[0] == D("999700.029999")
+    assert out[1] == D("1.000000")
+
+
+def test_decimal_int_mixed_arithmetic(session):
+    df = session.create_dataframe(pa.table({
+        "a": pa.array([D("1.50"), D("2.25")], type=pa.decimal128(10, 2)),
+        "k": pa.array([2, 3], type=pa.int32()),
+    }))
+    out = df.select((F.col("a") * F.col("k")).alias("m")).to_pandas()
+    assert out["m"].tolist() == [D("3.00"), D("6.75")]
+
+
+def test_decimal_compare_and_filter(session):
+    df = dec_df(session, {
+        "a": ([D("1.25"), D("3.50"), D("2.00")], 4, 2),
+    })
+    out = df.filter(F.col("a") > F.lit(2)).to_pandas()
+    assert out["a"].tolist() == [D("3.50")]
+
+
+def test_decimal_groupby_sum(session):
+    df = session.create_dataframe(pa.table({
+        "k": pa.array([0, 1, 0, 1], type=pa.int32()),
+        "v": pa.array([D("1.10"), D("2.20"), D("3.30"), None],
+                      type=pa.decimal128(6, 2)),
+    }))
+    q = df.groupBy("k").agg(F.sum("v").alias("s"))
+    plan = session.plan(q.plan)
+    assert "CpuFallbackExec" not in plan.tree_string()
+    # sum(decimal(6,2)) -> decimal(16,2)
+    assert dict(q.plan.schema)["s"].name == "decimal(16,2)"
+    out = q.orderBy("k").to_pandas()
+    assert out["s"].tolist() == [D("4.40"), D("2.20")]
+
+
+def test_decimal_sum_wide_falls_back(session):
+    df = dec_df(session, {"v": ([D("1.5")], 12, 1)})
+    q = df.agg(F.sum("v").alias("s"))
+    plan = session.plan(q.plan)
+    assert "CpuFallbackExec" in plan.tree_string()
+    assert q.to_pandas()["s"].tolist() == [D("1.5")]
+
+
+def test_decimal_avg_falls_back(session):
+    df = dec_df(session, {"v": ([D("1.0"), D("2.0")], 4, 1)})
+    q = df.agg(F.avg("v").alias("a"))
+    plan = session.plan(q.plan)
+    assert "CpuFallbackExec" in plan.tree_string()
+    a = q.to_pandas()["a"].tolist()[0]
+    assert float(a) == pytest.approx(1.5)
+
+
+def test_decimal_min_max_orderby(session):
+    vals = [D("2.50"), D("-1.25"), None, D("9.75"), D("0.00")]
+    df = dec_df(session, {"v": (vals, 5, 2)})
+    out = df.agg(F.min("v").alias("lo"), F.max("v").alias("hi")) \
+        .to_pandas()
+    assert out["lo"][0] == D("-1.25")
+    assert out["hi"][0] == D("9.75")
+    got = df.orderBy("v").to_pandas()["v"].tolist()
+    assert got[0] is None  # nulls first
+    assert got[1:] == sorted(v for v in vals if v is not None)
+
+
+def test_named_decimal_exprs_roundtrip(session):
+    """MakeDecimal / UnscaledValue / PromotePrecision / CheckOverflow as
+    programmatic expressions."""
+    from spark_rapids_tpu.api.functions import Col
+    from spark_rapids_tpu.ops.decimal_ops import (
+        CheckOverflow, MakeDecimal, PromotePrecision, UnscaledValue)
+    df = dec_df(session, {"v": ([D("1.23"), D("-4.56")], 6, 2)})
+    uv = df.select(Col(UnscaledValue(F.col("v").expr)).alias("u"))
+    assert uv.to_pandas()["u"].tolist() == [123, -456]
+    md = df.select(Col(MakeDecimal(UnscaledValue(F.col("v").expr), 6, 2))
+                   .alias("m"))
+    assert md.to_pandas()["m"].tolist() == [D("1.23"), D("-4.56")]
+    pp = df.select(Col(PromotePrecision(F.col("v").expr,
+                                        DecimalType(10, 4))).alias("p"))
+    assert pp.to_pandas()["p"].tolist() == [D("1.2300"), D("-4.5600")]
+    co = df.select(Col(CheckOverflow(F.col("v").expr, DecimalType(3, 2)))
+                   .alias("c"))
+    assert co.to_pandas()["c"].tolist() == [D("1.23"), D("-4.56")]
+    co2 = df.select(Col(CheckOverflow(F.col("v").expr,
+                                      DecimalType(2, 2))).alias("c"))
+    assert co2.to_pandas()["c"].tolist() == [None, None]  # |v| >= 1
+
+
+def test_decimal_fuzz_vs_python_decimal(session):
+    """Randomized add/mul against the Python decimal oracle with Spark
+    result scales."""
+    rng = np.random.default_rng(42)
+    n = 500
+    a = [D(int(x)).scaleb(-2) for x in rng.integers(-10**5, 10**5, n)]
+    b = [D(int(x)).scaleb(-3) for x in rng.integers(-10**6, 10**6, n)]
+    df = session.create_dataframe(pa.table({
+        "a": pa.array(a, type=pa.decimal128(7, 2)),
+        "b": pa.array(b, type=pa.decimal128(8, 3)),
+    }))
+    out = df.select((F.col("a") + F.col("b")).alias("s"),
+                    (F.col("a") * F.col("b")).alias("m")).to_pandas()
+    for i in range(n):
+        assert out["s"][i] == a[i] + b[i], i
+        assert out["m"][i] == (a[i] * b[i]), i

@@ -239,3 +239,88 @@ def test_fuzz_cast_bool_roundtrip(session):
     _check(out["s"], [None if v is None else ("true" if v else "false")
                       for v in b])
     _check(out["i"], [None if v is None else int(v) for v in b])
+
+
+# ---- round-4: decimal / timestamp / date / array generators ---------------
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_decimal_arithmetic(session, seed):
+    """Decimal add/mul vs the exact Python decimal oracle (Spark result
+    scales; overflow -> null checked by construction: types chosen so
+    results always fit)."""
+    import decimal
+    import pyarrow as pa
+    from datagen import decimal_gen
+    rng = np.random.default_rng(seed)
+    a = decimal_gen(6, 2).generate(rng, N)
+    b = decimal_gen(7, 3).generate(rng, N)
+    df = session.create_dataframe(pa.table({
+        "a": pa.array(a, type=pa.decimal128(6, 2)),
+        "b": pa.array(b, type=pa.decimal128(7, 3)),
+    }))
+    out = df.select((F.col("a") + F.col("b")).alias("s"),
+                    (F.col("a") * F.col("b")).alias("m")).to_pandas()
+    want_s = [None if x is None or y is None else x + y
+              for x, y in zip(a, b)]
+    want_m = [None if x is None or y is None else x * y
+              for x, y in zip(a, b)]
+    _check(out["s"], want_s)
+    _check(out["m"], want_m)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_decimal_groupby_sum(session, seed):
+    import pyarrow as pa
+    from datagen import decimal_gen
+    rng = np.random.default_rng(seed)
+    v = decimal_gen(6, 2).generate(rng, N)
+    k = [int(rng.integers(0, 7)) for _ in range(N)]
+    df = session.create_dataframe(pa.table({
+        "k": pa.array(k, type=pa.int32()),
+        "v": pa.array(v, type=pa.decimal128(6, 2)),
+    }))
+    out = df.groupBy("k").agg(F.sum("v").alias("s")).orderBy("k") \
+        .to_pandas()
+    import collections
+    import decimal
+    want = collections.defaultdict(lambda: None)
+    for kk, vv in zip(k, v):
+        if vv is not None:
+            want[kk] = vv if want[kk] is None else want[kk] + vv
+    for _, row in out.iterrows():
+        assert row["s"] == want[row["k"]], row
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_timestamp_date_extraction(session, seed):
+    from datagen import date_gen, timestamp_gen
+    rng = np.random.default_rng(seed)
+    ts = timestamp_gen().generate(rng, N)
+    df = session.create_dataframe(pd.DataFrame(
+        {"t": pd.Series(ts, dtype="object")}))
+    out = df.select(F.year("t").alias("y"), F.month("t").alias("m"),
+                    F.hour("t").alias("h")).to_pandas()
+    for i, v in enumerate(ts):
+        if v is None:
+            assert pd.isna(out["y"][i])
+            continue
+        p = pd.Timestamp(v)
+        assert out["y"][i] == p.year, (i, v)
+        assert out["m"][i] == p.month, (i, v)
+        assert out["h"][i] == p.hour, (i, v)
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_fuzz_array_size_contains(session, seed):
+    from datagen import array_gen
+    rng = np.random.default_rng(seed)
+    arrs = array_gen().generate(rng, N)
+    df = session.create_dataframe({"a": arrs})
+    out = df.select(F.size("a").alias("n"),
+                    F.array_contains("a", 1).alias("c")).to_pandas()
+    for i, v in enumerate(arrs):
+        if v is None:
+            assert out["n"][i] == -1 or pd.isna(out["n"][i])
+            continue
+        assert out["n"][i] == len(v), (i, v)
+        assert bool(out["c"][i]) == (1 in v), (i, v)
