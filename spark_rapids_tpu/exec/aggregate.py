@@ -82,6 +82,22 @@ def _grouped_kernel(kinds: Tuple[str, ...], nkeys: int):
 
 
 @functools.lru_cache(maxsize=None)
+def _keyless_kernel(kinds: Tuple[str, ...]):
+    """Grand-total reduction over pre-evaluated buffer columns (the
+    staged path's keyless case, e.g. SELECT min(s))."""
+
+    @jax.jit
+    def run(bufs_flat, nrows):
+        capacity = bufs_flat[0][0].shape[0]
+        buf_inputs = [(k, ColVal(None, v, val))
+                      for k, (v, val) in zip(kinds, bufs_flat)]
+        outs = agg.reduce_aggregate(buf_inputs, nrows, capacity)
+        return [(o.values, o.validity) for o in outs]
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
 def _coded_kernel(kinds: Tuple[str, ...], k_bucket: int):
     """Sort-free radix-coded group-by (stage B when the key-space
     product fits ``k_bucket`` slots) — the hash-aggregation regime of
@@ -144,6 +160,7 @@ class TpuHashAggregateExec(TpuExec):
         self._register_metric(CONCAT_TIME)
 
         self._in_dtypes = [dt for _, dt in child.schema]
+        self._merge_dicts: Dict[int, List] = {}
         self._single_pass = any(getattr(f, "single_pass", False)
                                 for f in self.funcs)
         self._string_key_idx = [i for i, e in enumerate(self.group_exprs)
@@ -173,6 +190,14 @@ class TpuHashAggregateExec(TpuExec):
             self._buf_specs.extend(specs)
         self._update_kinds = tuple(s.kind for s in self._buf_specs)
         self._merge_kinds = tuple(_merge_kind(k) for k in self._update_kinds)
+        # string-valued min/max/first/last buffers: batch-local
+        # order-preserving dictionary codes on device, strings in the
+        # partial batches (buffer position -> func index)
+        self._string_buf_pos: Dict[int, int] = {
+            sl.start: j for j, (f, sl) in
+            enumerate(zip(self.funcs, self._buf_slices))
+            if f.child is not None and f.child.dtype.is_string and
+            f.name in ("min", "max", "first", "last")}
 
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         base_sig = (tuple(dt.name for dt in self._in_dtypes),
@@ -186,9 +211,10 @@ class TpuHashAggregateExec(TpuExec):
         self._coded_eligible = bool(self.group_exprs) and \
             agg.coded_key_eligible(key_dts) and \
             not any(s.dtype.has_offsets for s in self._buf_specs)
-        if self._string_key_idx:
+        if self._string_key_idx or self._string_buf_pos:
             # stage A evaluates keys + agg children; the group kernel runs in
-            # stage B after host dictionary encoding of string keys
+            # stage B after host dictionary encoding of string keys /
+            # string agg children
             pre_exprs = list(self.group_exprs) + \
                 [f.child for f in self.funcs if f.child is not None]
             self._pre_fn = StageFn(pre_exprs, self._in_dtypes)
@@ -381,7 +407,7 @@ class TpuHashAggregateExec(TpuExec):
 
         def compute(batch):
             with self.timer(AGG_TIME):
-                if self._string_key_idx:
+                if self._string_key_idx or self._string_buf_pos:
                     return self._partial_with_string_keys(
                         batch, names, dtypes)
                 if self._coded_eligible:
@@ -401,6 +427,7 @@ class TpuHashAggregateExec(TpuExec):
         yield from with_retry(tallied(), compute)
 
     def _partial_with_string_keys(self, batch, names, dtypes):
+        from spark_rapids_tpu.ops.dictionary import ordered_dict_encode
         nkeys = len(self.group_exprs)
         pre_cols = self._pre_fn(batch)
         key_cols, child_cols = pre_cols[:nkeys], pre_cols[nkeys:]
@@ -408,41 +435,85 @@ class TpuHashAggregateExec(TpuExec):
                     else c for i, c in enumerate(key_cols)]
         child_iter = iter(child_cols)
         buf_inputs: List[Tuple[str, ColVal]] = []
+        buf_dicts: Dict[int, List] = {}
         for f in self.funcs:
             cc = next(child_iter) if f.child is not None else None
-            cv = None if cc is None else \
-                ColVal(cc.dtype, cc.data, cc.validity, cc.offsets)
+            if cc is not None and len(buf_inputs) in self._string_buf_pos:
+                # batch-local ORDER-PRESERVING codes: min/max over codes
+                # equals min/max over strings within this batch
+                codes, d = ordered_dict_encode(cc)
+                buf_dicts[len(buf_inputs)] = d
+                pad = np.zeros(batch.capacity, dtype=np.int64)
+                pad[: len(codes)] = codes
+                cv = ColVal(dts.INT64, jnp.asarray(pad), cc.validity)
+            else:
+                cv = None if cc is None else \
+                    ColVal(cc.dtype, cc.data, cc.validity, cc.offsets)
             for spec, bi in zip(f.buffers(),
                                 f.update_inputs(cv, batch.capacity)):
                 buf_inputs.append((spec.kind, bi))
         key_flat_in = [(c.data, c.validity) for c in enc_keys]
         buf_flat_in = [(c.values, c.validity) for _, c in buf_inputs]
-        pick = None
-        if self._coded_eligible:
-            nrows = jnp.int32(batch.nrows)
-            mins, maxs = _probe_kernel(nkeys)(key_flat_in, nrows)
-            pick = self._coded_pick(mins, maxs)
-        if pick is not None:
-            k_bucket, mins_d, slots_d = pick
-            mask = jnp.arange(batch.capacity, dtype=jnp.int32) < nrows
-            key_flat, buf_flat, n = _coded_kernel(
-                self._update_kinds, k_bucket)(
-                key_flat_in, buf_flat_in, mins_d, slots_d, mask)
+        nrows = jnp.int32(batch.nrows)
+        if not enc_keys:
+            # keyless (e.g. SELECT min(s)): one output row
+            kernel = _keyless_kernel(self._update_kinds)
+            buf_flat = kernel(buf_flat_in, nrows)
+            key_flat, n = [], 1
+            out_cap = 1024
         else:
-            kernel = _grouped_kernel(self._update_kinds, nkeys)
-            key_flat, buf_flat, n = kernel(key_flat_in, buf_flat_in,
-                                           jnp.int32(batch.nrows))
-        n = int(n)
-        outs = [ColVal(dt, v, val) for dt, (v, val) in
-                zip(dtypes, list(key_flat) + list(buf_flat))]
-        out_cap = key_flat[0][0].shape[0]
-        cols = colvals_to_columns(outs, n, out_cap)
-        return ColumnarBatch(dict(zip(names, cols)), n)
+            pick = None
+            if self._coded_eligible:
+                mins, maxs = _probe_kernel(nkeys)(key_flat_in, nrows)
+                pick = self._coded_pick(mins, maxs)
+            if pick is not None:
+                k_bucket, mins_d, slots_d = pick
+                mask = jnp.arange(batch.capacity,
+                                  dtype=jnp.int32) < nrows
+                key_flat, buf_flat, n = _coded_kernel(
+                    self._update_kinds, k_bucket)(
+                    key_flat_in, buf_flat_in, mins_d, slots_d, mask)
+            else:
+                kernel = _grouped_kernel(self._update_kinds, nkeys)
+                key_flat, buf_flat, n = kernel(key_flat_in, buf_flat_in,
+                                               nrows)
+            n = int(n)
+            out_cap = key_flat[0][0].shape[0]
+        cols_out = {}
+        for name, dt, (v, val) in zip(names, dtypes,
+                                      list(key_flat) + list(buf_flat)):
+            pos = len(cols_out) - nkeys
+            if pos in buf_dicts:
+                d = buf_dicts[pos]
+                codes = np.asarray(v[:n] if getattr(v, "ndim", 0)
+                                   else jnp.broadcast_to(v, (1,)))
+                ok = np.ones(n, dtype=bool) if val is None else \
+                    np.asarray(val[:n] if getattr(val, "ndim", 0)
+                               else jnp.broadcast_to(val, (1,)))
+                strs = [d[int(c)] if o and d else None
+                        for c, o in zip(codes, ok)]
+                cols_out[name] = Column.from_strings(strs,
+                                                     capacity=out_cap)
+            else:
+                cv = ColVal(dt, v, val)
+                cols_out[name] = colvals_to_columns([cv], n, out_cap)[0]
+        return ColumnarBatch(cols_out, n)
 
     # ------------------------------------------------------------ merge stage --
+    @property
+    def _merge_dtypes(self) -> List:
+        """Partial-schema dtypes as the merge kernels see them: string
+        buffers arrive re-encoded as int64 codes."""
+        nkeys = len(self.group_exprs)
+        out = []
+        for i, (_, dt) in enumerate(self._partial_schema):
+            pos = i - nkeys
+            out.append(dts.INT64 if pos in self._string_buf_pos else dt)
+        return out
+
     def _merge_body(self, flat_cols, nrows):
         """Shared merge group-by/reduce over partial-schema columns."""
-        dtypes = [dt for _, dt in self._partial_schema]
+        dtypes = self._merge_dtypes
         nkeys = len(self.group_exprs)
         capacity = capacity_of(flat_cols)
         cols = flat_to_colvals(flat_cols, dtypes)
@@ -497,10 +568,26 @@ class TpuHashAggregateExec(TpuExec):
 
     def _merge_exec(self, merged_in: ColumnarBatch, finalize: bool):
         """Merge-stage dispatch mirroring the update stage: probe the
-        partials' key ranges, run the coded kernel when the space fits."""
+        partials' key ranges, run the coded kernel when the space fits.
+        String buffer columns (min/max/first/last partial winners) are
+        re-encoded to order-preserving codes over ALL partials first —
+        comparisons across batches are then exact; outputs decode via
+        ``self._merge_dicts``."""
         flat = batch_to_flat(merged_in)
         nrows = jnp.int32(merged_in.nrows)
         nkeys = len(self.group_exprs)
+        self._merge_dicts = {}
+        if self._string_buf_pos:
+            from spark_rapids_tpu.ops.dictionary import ordered_dict_encode
+            cols = list(merged_in.columns.values())
+            for pos in self._string_buf_pos:
+                ci = nkeys + pos
+                col = cols[ci]
+                codes, d = ordered_dict_encode(col)
+                self._merge_dicts[pos] = d
+                pad = np.zeros(col.capacity, dtype=np.int64)
+                pad[: len(codes)] = codes
+                flat[ci] = (jnp.asarray(pad), col.validity, None)
         if self._coded_eligible:
             key_flat = [(v, val) for v, val, _ in flat[:nkeys]]
             mins, maxs = _probe_kernel(nkeys)(key_flat, nrows)
@@ -557,10 +644,33 @@ class TpuHashAggregateExec(TpuExec):
                                None if c.validity is None
                                else c.validity[:out_cap], c.offsets)
                         for c in outs]
-            cols = colvals_to_columns(outs, n, out_cap)
+            nkeys = len(self.group_exprs)
+            cols = {}
+            for name, c in zip(names, outs):
+                pos = len(cols) - nkeys
+                if pos in self._merge_dicts:
+                    cols[name] = self._decode_codes(c, n, out_cap,
+                                                    self._merge_dicts[pos])
+                else:
+                    cols[name] = colvals_to_columns([c], n, out_cap)[0]
             handles.append(
-                catalog.register(ColumnarBatch(dict(zip(names, cols)), n)))
+                catalog.register(ColumnarBatch(cols, n)))
         return handles
+
+    @staticmethod
+    def _decode_codes(c: ColVal, n: int, out_cap: int, d: List) -> Column:
+        """codes ColVal -> string Column via a merge-stage dictionary."""
+        codes = np.asarray(c.values[:n]) if getattr(c.values, "ndim", 0) \
+            else np.broadcast_to(np.asarray(c.values), (n,))
+        if c.validity is None:
+            ok = np.ones(n, dtype=bool)
+        else:
+            ok = np.asarray(c.validity[:n]) \
+                if getattr(c.validity, "ndim", 0) else \
+                np.broadcast_to(np.asarray(c.validity), (n,))
+        strs = [d[int(v)] if o and d else None
+                for v, o in zip(codes, ok)]
+        return Column.from_strings(strs, capacity=out_cap)
 
     def _single_kernel(self, flat_cols, nrows):
         """Grouped pass mixing collect arrays with regular reductions."""
@@ -697,7 +807,16 @@ class TpuHashAggregateExec(TpuExec):
         out_cap = next((int(o.values.shape[0]) for o in outs
                         if getattr(o.values, "ndim", 0) >= 1),
                        merged_in.capacity)
-        cols = colvals_to_columns(outs, n, out_cap)
+        cols = []
+        for j, c in enumerate(outs):
+            fj = j - nkeys  # func index for agg outputs
+            bpos = self._buf_slices[fj].start if 0 <= fj < len(
+                self.funcs) else None
+            if bpos is not None and bpos in self._merge_dicts:
+                cols.append(self._decode_codes(c, n, out_cap,
+                                               self._merge_dicts[bpos]))
+            else:
+                cols.append(colvals_to_columns([c], n, out_cap)[0])
         for i in self._string_key_idx:
             cols[i] = self._encoders[i].decode(cols[i])
         yield ColumnarBatch(dict(zip(out_names, cols)), n)

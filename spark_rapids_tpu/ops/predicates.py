@@ -444,6 +444,23 @@ class InSet(Expression):
         v = self.children[0].emit(ctx)
         if len(self.table) == 0:
             hit = jnp.zeros(ctx.capacity, dtype=jnp.bool_)
+        elif self.children[0].dtype.is_string:
+            # byte-equality against each literal (the set came through
+            # the isin threshold, so K is user-list sized, not data
+            # sized); one length compare + |s| single-byte gathers per
+            # literal — no device string table needed
+            from spark_rapids_tpu.ops.stringops import (_literal_bytes,
+                                                        row_lengths)
+            lens = row_lengths(v)
+            ccap = v.values.shape[0]
+            hit = jnp.zeros(ctx.capacity, dtype=jnp.bool_)
+            for s in self.table:
+                pat = _literal_bytes(str(s))
+                ok = lens == len(pat)
+                for i, b in enumerate(pat):
+                    idx = jnp.clip(v.offsets[:-1] + i, 0, ccap - 1)
+                    ok = jnp.logical_and(ok, v.values[idx] == b)
+                hit = jnp.logical_or(hit, ok)
         else:
             table = jnp.asarray(
                 self.table.astype(self.children[0].dtype.storage))

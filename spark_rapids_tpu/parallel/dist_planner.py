@@ -631,6 +631,32 @@ def _run_union(child_frames, out_phys, mesh):
     return cols, nrows.reshape(-1)
 
 
+def _run_slice(f: ShardedFrame, los, his):
+    """Compiled shard_map row slice: each shard keeps its live rows in
+    [lo, hi), compacted to the prefix (probe-side chunking for the
+    chunked join emission)."""
+    import jax
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+
+    def step(flat_cols, lo_arr, hi_arr):
+        lo, hi = lo_arr[0], hi_arr[0]
+        cap = flat_cols[0][0].shape[0]
+        idx = jnp.arange(cap, dtype=jnp.int32) + lo
+        safe = jnp.clip(idx, 0, cap - 1)
+        outs = tuple((v[safe], m[safe]) for v, m in flat_cols)
+        n = jnp.maximum(hi - lo, 0)
+        return outs, n.astype(jnp.int32)[None]
+
+    sig = ("dplan_slice", _mesh_sig(f.mesh),
+           tuple(dt.name for dt in f.phys_dtypes))
+    axis = f.mesh.axis_names[0]
+    return cached_jit(sig, lambda: jax.shard_map(
+        step, mesh=f.mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))(
+        f.cols, jnp.asarray(np.asarray(los, dtype=np.int32)),
+        jnp.asarray(np.asarray(his, dtype=np.int32)))
+
+
 def _run_filter(f: ShardedFrame, cond: Expression):
     import jax
     from spark_rapids_tpu.ops import selection
@@ -1062,18 +1088,29 @@ class DistPlanner:
         if plan.condition is not None and plan.using:
             raise NotDistributable(
                 "residual conditions with USING joins not supported")
-        for lk, rk in zip(plan.left_keys, plan.right_keys):
-            if lk.dtype.is_string or rk.dtype.is_string:
-                raise NotDistributable(
-                    "string join keys not yet supported on the mesh "
-                    "(per-table dictionaries do not align)")
+        str_keys = [i for i, (lk, rk) in enumerate(
+            zip(plan.left_keys, plan.right_keys))
+            if lk.dtype.is_string or rk.dtype.is_string]
+        if str_keys and plan.using and plan.join_type == "full":
+            raise NotDistributable(
+                "full-outer USING join over string keys would coalesce "
+                "codes from two dictionaries")
         left = self.run(plan.left, dry)
         right = self.run(plan.right, dry)
-        lkeys = [ExprLowering(left.enc, self.conf).lower(e)
-                 for e in plan.left_keys]
-        rkeys = [ExprLowering(right.enc, self.conf).lower(e)
-                 for e in plan.right_keys]
+        low_l = ExprLowering(left.enc, self.conf)
+        low_r = ExprLowering(right.enc, self.conf)
+        lkeys = [low_l.lower(e) for e in plan.left_keys]
+        rkeys = [low_r.lower(e) for e in plan.right_keys]
         _check_supported(lkeys + rkeys, self.conf)
+        for i in str_keys:
+            # string keys join as codes: the probe side re-codes into
+            # the build side's dictionary below (GpuHashJoin.scala:96-150
+            # treats string keys first-class; here the exchanged payload
+            # stays int64)
+            if low_l.out_dict(lkeys[i]) is None or \
+                    low_r.out_dict(rkeys[i]) is None:
+                raise NotDistributable(
+                    "string join key has no dictionary on the mesh")
 
         swapped = plan.join_type == "right"
         join_type = "left" if swapped else plan.join_type
@@ -1122,8 +1159,12 @@ class DistPlanner:
                 if plan.join_type == "full":
                     proj.append(Alias(preds.Coalesce(ref(li), ref(ri)), n))
                 elif swapped:
+                    if ri in out_enc:
+                        penc[len(proj)] = out_enc[ri]
                     proj.append(Alias(ref(ri), n))
                 else:
+                    if li in out_enc:
+                        penc[len(proj)] = out_enc[li]
                     proj.append(ref(li))
             for i, n in enumerate(left.names):
                 if n not in keyset:
@@ -1146,42 +1187,33 @@ class DistPlanner:
             return ShardedFrame(self.mesh, out_names, out_dtypes, None,
                                 None, out_enc)
 
-        from spark_rapids_tpu.parallel.distributed import (
-            DistributedHashJoin)
         probe_m = _append_key_cols(probe, probe_keys)
         build_m = _append_key_cols(build, build_keys)
         pk_idx = list(range(len(probe.names),
                             len(probe.names) + len(probe_keys)))
         bk_idx = list(range(len(build.names),
                             len(build.names) + len(build_keys)))
-        probe_cap = probe_m.capacity
-        out_factor = 1
-        while True:
-            join = DistributedHashJoin(
-                self.mesh, probe_dtypes=probe_m.phys_dtypes,
-                build_dtypes=build_m.phys_dtypes,
-                probe_key_idx=pk_idx, build_key_idx=bk_idx,
-                join_type=join_type, out_factor=out_factor)
-            flat, n_out, total = join(
-                probe_m.cols, probe_m.nrows, build_m.cols, build_m.nrows)
-            if bool(np.all(np.asarray(total) <= np.asarray(n_out))):
-                break
-            # size the retry from the observed truncation (the reference
-            # instead splits output batches, JoinGatherer.scala:36-60);
-            # out_cap is relative to the (possibly tiny) probe capacity,
-            # so the factor itself may legitimately grow large
-            need = int(np.asarray(total).max())
-            next_factor = out_factor * 2
-            while next_factor * probe_cap < need:
-                next_factor *= 2  # power-of-two: bounded compile cache
-            if (next_factor * probe_cap * self.mesh.devices.size
-                    > self.MAX_OUT_ROWS):
-                raise NotDistributable(
-                    f"join output ({need} rows/shard) exceeds the "
-                    f"{self.MAX_OUT_ROWS}-row distributed output cap")
-            out_factor = next_factor
-        self._emit_stats(f"join:{plan.join_type}", join.last_stats,
-                         out_factor=out_factor)
+        if str_keys:
+            # re-code the probe side's string key codes into the build
+            # dictionary: value-equal codes become equal ints, values
+            # absent from the build side map to -1 (never a build code)
+            low_p, low_b = (low_r, low_l) if swapped else (low_l, low_r)
+            cols = list(probe_m.cols)
+            for i in str_keys:
+                pd_ = low_p.out_dict(probe_keys[i])
+                bd = low_b.out_dict(build_keys[i])
+                pos = {v: c for c, v in enumerate(bd)}
+                mapping = np.array([pos.get(v, -1) for v in pd_] or [-1],
+                                   dtype=np.int64)
+                vals, valid = cols[pk_idx[i]]
+                cols[pk_idx[i]] = (
+                    _remap_codes(jnp.asarray(mapping),
+                                 jnp.clip(vals, 0, len(mapping) - 1)),
+                    valid)
+            probe_m = probe_m.replace(cols=cols)
+        flat, n_out = self._exec_join(probe_m, build_m, pk_idx, bk_idx,
+                                      join_type, plan.join_type)
+        n_out = n_out.reshape(-1)
         n_probe = len(probe.names)
         n_build = len(build.names)
         if plan.join_type in ("semi", "anti"):
@@ -1206,6 +1238,78 @@ class DistPlanner:
                                  [dt for _, dt in proj_schema],
                                  list(out_cols), frame.nrows, penc)
         return frame
+
+    def _exec_join(self, probe_m, build_m, pk_idx, bk_idx, join_type,
+                   plan_join_type, depth: int = 0):
+        """Run the distributed hash join with output-size retry; when
+        the needed output exceeds MAX_OUT_ROWS, degrade to CHUNKED
+        emission (probe-side slices joined separately and unioned per
+        shard — the JoinGatherer.scala:36-60 role) instead of falling
+        off the mesh."""
+        from spark_rapids_tpu.parallel.distributed import (
+            DistributedHashJoin)
+        probe_cap = probe_m.capacity
+        nshards = self.mesh.devices.size
+        out_factor = 1
+        while True:
+            join = DistributedHashJoin(
+                self.mesh, probe_dtypes=probe_m.phys_dtypes,
+                build_dtypes=build_m.phys_dtypes,
+                probe_key_idx=pk_idx, build_key_idx=bk_idx,
+                join_type=join_type, out_factor=out_factor)
+            flat, n_out, total = join(
+                probe_m.cols, probe_m.nrows, build_m.cols,
+                build_m.nrows)
+            if bool(np.all(np.asarray(total) <= np.asarray(n_out))):
+                break
+            # size the retry from the observed truncation; out_cap is
+            # relative to the (possibly tiny) probe capacity, so the
+            # factor itself may legitimately grow large
+            need = int(np.asarray(total).max())
+            next_factor = out_factor * 2
+            while next_factor * probe_cap < need:
+                next_factor *= 2  # power-of-two: bounded compile cache
+            if (next_factor * probe_cap * nshards > self.MAX_OUT_ROWS):
+                return self._exec_join_chunked(
+                    probe_m, build_m, pk_idx, bk_idx, join_type,
+                    plan_join_type, depth)
+            out_factor = next_factor
+        self._emit_stats(f"join:{plan_join_type}", join.last_stats,
+                         out_factor=out_factor, depth=depth)
+        return flat, n_out
+
+    def _exec_join_chunked(self, probe_m, build_m, pk_idx, bk_idx,
+                           join_type, plan_join_type, depth: int):
+        if join_type == "full":
+            # probe-side chunking is linear only when each probe row's
+            # output is independent; a full join also emits
+            # unmatched-BUILD rows, which chunking would duplicate
+            raise NotDistributable(
+                "full-outer join output exceeds the distributed output "
+                "cap (chunked emission covers inner/left/semi/anti)")
+        if depth >= 6:
+            raise NotDistributable(
+                "join output exceeds the distributed output cap even "
+                "with 64-way chunked emission")
+        counts = np.asarray(probe_m.nrows).reshape(-1)
+        chunks = []
+        for i in range(2):
+            los = (counts * i) // 2
+            his = (counts * (i + 1)) // 2
+            cols, nr = _run_slice(probe_m, los, his)
+            sliced = probe_m.replace(cols=list(cols),
+                                     nrows=nr.reshape(-1))
+            flat, n_out = self._exec_join(sliced, build_m, pk_idx,
+                                          bk_idx, join_type,
+                                          plan_join_type, depth + 1)
+            chunks.append((list(flat), n_out.reshape(-1)))
+        if len(chunks[0][0]) > len(probe_m.names):
+            dtypes = probe_m.phys_dtypes + build_m.phys_dtypes
+        else:
+            dtypes = probe_m.phys_dtypes
+        dtypes = dtypes[: len(chunks[0][0])]
+        cols, nrows = _run_union(chunks, dtypes, self.mesh)
+        return tuple(cols), nrows
 
     # -- sort / limit / topn ---------------------------------------------
     def _lower_orders(self, orders, f: ShardedFrame):

@@ -155,3 +155,21 @@ def test_semi_join_with_residual_tags_off(session):
                how="semi")
     tree = session.plan(q.plan).tree_string()
     assert "CpuFallbackExec" in tree  # graceful, no bind KeyError
+
+
+def test_collect_with_string_minmax_falls_back():
+    """Regression (round-4 review): collect aggregates combined with
+    string min/max have no single-pass dictionary staging — the planner
+    must route the whole aggregate to the CPU fallback, not crash."""
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession()
+    df = s.create_dataframe(pd.DataFrame(
+        {"k": [0, 0, 1], "x": [1, 2, 3], "s": ["b", "a", "c"]}))
+    q = df.groupBy("k").agg(F.collect_list("x").alias("xs"),
+                            F.min("s").alias("lo"))
+    assert "CpuFallbackExec" in s.plan(q.plan).tree_string()
+    out = q.orderBy("k").to_pandas()
+    assert out["lo"].tolist() == ["a", "c"]
+    assert sorted(out["xs"][0]) == [1, 2]
