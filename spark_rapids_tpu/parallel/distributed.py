@@ -46,6 +46,12 @@ class DistributedAggregate:
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.nshards = mesh.devices.size
+        # AQE partition coalescing (GpuCustomShuffleReaderExec.scala:131
+        # role on collective slots): hash into FINER buckets than shards,
+        # then greedily pack buckets onto shards from the materialized
+        # histogram — small buckets coalesce, hot buckets spread apart,
+        # shrinking the all-to-all slot (= padding bandwidth)
+        self.buckets = 4 * self.nshards
         self.in_dtypes = list(in_dtypes)
         self.group_exprs = list(group_exprs)
         self.funcs = list(funcs)
@@ -133,17 +139,18 @@ class DistributedAggregate:
             flat_cols, nrows_arr)
         pkeys, pbufs, n_groups = agg.groupby_aggregate(
             keys, buf_inputs, nrows, capacity)
-        pids = hash_partition_ids(pkeys, self.nshards)
+        bids = hash_partition_ids(pkeys, self.buckets)
         live = jnp.arange(capacity, dtype=jnp.int32) < n_groups
-        hist = histogram(pids, live, self.nshards)
+        hist = histogram(bids, live, self.buckets)
         outs = list(pkeys) + list(pbufs)
         # validity stays None for non-nullable columns so phase 2's
         # exchange skips the per-column validity all_to_all entirely
         return (tuple((o.values, o.validity) for o in outs),
                 jnp.reshape(n_groups, (1,)), hist)
 
-    def _step_final(self, slot, partial_flat, n_groups_arr):
-        """Phase 2: exchange partials with the stats-sized slot, then the
+    def _step_final(self, slot, lut, partial_flat, n_groups_arr):
+        """Phase 2: exchange partials with the stats-sized slot (bucket
+        -> shard assignment rides in as the traced ``lut``), then the
         final merge + finalize on the receiving shard."""
         n_groups = n_groups_arr[0]
         nkeys = len(self.group_exprs)
@@ -152,7 +159,7 @@ class DistributedAggregate:
         cols = [ColVal(dt, v, val)
                 for dt, (v, val) in zip(dtypes, partial_flat)]
         pkeys, pbufs = cols[:nkeys], cols[nkeys:]
-        pids = hash_partition_ids(pkeys, self.nshards)
+        pids = lut[hash_partition_ids(pkeys, self.buckets)]
         recv, recv_n = exchange(list(pkeys) + list(pbufs), pids, n_groups,
                                 self.axis, self.nshards, slot=slot)
         rkeys = recv[:nkeys]
@@ -199,7 +206,7 @@ class DistributedAggregate:
         return self._cached_jit(
             self._sig + ("final", slot), lambda: jax.shard_map(
                 partial(self._step_final, slot), mesh=self.mesh,
-                in_specs=(P(self.axis), P(self.axis)),
+                in_specs=(P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
     def __call__(self, flat_cols, nrows_per_shard):
@@ -219,20 +226,44 @@ class DistributedAggregate:
         partial_flat, n_groups, hist = self._jitted_local(
             flat_cols, nrows_per_shard)
         from spark_rapids_tpu.parallel.shuffle import pick_slot
-        counts = np.asarray(hist).reshape(self.nshards, self.nshards)
+        counts = np.asarray(hist).reshape(self.nshards, self.buckets)
         capacity = int(partial_flat[0][0].shape[0]) // self.nshards
-        slot = pick_slot(int(counts.max()), capacity)
+        lut, dst_counts = coalesce_buckets(counts, self.nshards)
+        slot = pick_slot(int(dst_counts.max()), capacity)
         self.last_stats = {
-            "partition_counts": counts,  # [src_shard, dst_shard]
+            "bucket_counts": counts,     # [src_shard, bucket]
+            "bucket_map": lut,           # bucket -> dst shard
+            "partition_counts": dst_counts,  # [src_shard, dst_shard]
             "slot": slot,
             "capacity": capacity,
         }
-        return self._final_jitted(slot)(partial_flat, n_groups)
+        return self._final_jitted(slot)(jnp.asarray(lut), partial_flat,
+                                        n_groups)
 
 
 def _merge_kind(update_kind: str) -> str:
     return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
             "first": "first", "last": "last"}[update_kind]
+
+
+def coalesce_buckets(counts, nshards: int):
+    """Greedy balanced assignment of hash buckets to shards from the
+    materialized [src_shard, bucket] histogram (the AQE partition
+    coalescing / skew-spreading step).  Returns (lut int32[buckets],
+    dst_counts [src_shard, dst_shard])."""
+    import numpy as np
+    totals = counts.sum(axis=0)
+    buckets = counts.shape[1]
+    load = np.zeros(nshards, dtype=np.int64)
+    lut = np.zeros(buckets, dtype=np.int32)
+    for b in np.argsort(-totals, kind="stable"):
+        dst = int(np.argmin(load))
+        lut[b] = dst
+        load[dst] += int(totals[b])
+    dst_counts = np.zeros((counts.shape[0], nshards), dtype=np.int64)
+    for b in range(buckets):
+        dst_counts[:, lut[b]] += counts[:, b]
+    return lut, dst_counts
 
 
 def concat_prefixes(cols_a: Sequence[ColVal], n_a,

@@ -24,19 +24,53 @@ transition — device-resident sources are free.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import json
+import os
+from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.plan import logical as L
 
-# per-row work coefficients (arbitrary units; only ratios matter)
-_CPU_W = {
+# fallback coefficients when no calibration file is present (arbitrary
+# units; only ratios matter)
+_BUILTIN_CPU_W = {
     "Project": 1.0, "Filter": 1.0, "Aggregate": 4.0, "Join": 6.0,
     "Sort": 5.0, "Window": 8.0, "Generate": 2.0, "Limit": 0.1,
     "Union": 0.1, "default": 1.0,
 }
-# the TPU runs the columnar kernels far faster but pays a fixed per-batch
-# dispatch; the ratio vs _CPU_W encodes the measured ~5-8x engine speedup
-_TPU_W = {k: v / 6.0 for k, v in _CPU_W.items()}
+_BUILTIN_TPU_W = {k: v / 6.0 for k, v in _BUILTIN_CPU_W.items()}
+
+_WEIGHTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "cbo_weights.json")
+_loaded: Optional[Tuple[Dict[str, float], Dict[str, float]]] = None
+
+
+def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
+    """(tpu_w, cpu_w) in us/row from ``cbo_weights.json`` — MEASURED on
+    the build machine by ``tools/cbo_calibrate.py`` (re-run it on the
+    target device to recalibrate) — falling back to the built-in ratio
+    table when the file is absent."""
+    global _loaded
+    if _loaded is not None:
+        return _loaded
+    try:
+        with open(_WEIGHTS_PATH, encoding="utf-8") as f:
+            data = json.load(f)["weights"]
+        tpu = {k: float(v["tpu"]) for k, v in data.items()}
+        cpu = {k: float(v["cpu"]) for k, v in data.items()}
+        # unmeasured ops inherit the measured median ratio
+        ratios = [tpu[k] / cpu[k] for k in tpu if cpu[k] > 0]
+        ratios.sort()
+        med = ratios[len(ratios) // 2] if ratios else 1.0
+        for k, v in _BUILTIN_CPU_W.items():
+            cpu.setdefault(k, v * 0.05)   # us/row scale of the table
+            tpu.setdefault(k, cpu[k] * med)
+        _loaded = (tpu, cpu)
+    except (OSError, KeyError, ValueError, json.JSONDecodeError):
+        # scale the unit table into the same us/row domain the
+        # calibrated file (and transitionRowCost default) live in
+        _loaded = ({k: v * 0.05 for k, v in _BUILTIN_TPU_W.items()},
+                   {k: v * 0.05 for k, v in _BUILTIN_CPU_W.items()})
+    return _loaded
 
 
 def _estimate_rows(node, child_rows: List[float]) -> float:
@@ -70,6 +104,17 @@ class CostBasedOptimizer:
     def __init__(self, conf):
         from spark_rapids_tpu.config import rapids_conf as rc
         self.transition_w = conf.get(rc.OPTIMIZER_TRANSITION_COST)
+        tpu_w, cpu_w = load_weights()
+        self.tpu_w = dict(tpu_w)
+        self.cpu_w = dict(cpu_w)
+        # conf keys override calibrated values per op
+        for name in set(self.tpu_w) | set(self.cpu_w):
+            ov = conf.op_cost("tpu", name)
+            if ov is not None:
+                self.tpu_w[name] = ov
+            ov = conf.op_cost("cpu", name)
+            if ov is not None:
+                self.cpu_w[name] = ov
         self.explain: List[str] = []
 
     def optimize(self, meta) -> None:
@@ -99,8 +144,8 @@ class CostBasedOptimizer:
         device region rooted at meta."""
         rows = self._rows[id(meta)]
         w = self._op_name(meta)
-        tpu = rows * _TPU_W.get(w, _TPU_W["default"])
-        cpu = rows * _CPU_W.get(w, _CPU_W["default"])
+        tpu = rows * self.tpu_w.get(w, self.tpu_w["default"])
+        cpu = rows * self.cpu_w.get(w, self.cpu_w["default"])
         rows_in = 0.0
         nodes = [meta]
         for c in meta.child_metas:

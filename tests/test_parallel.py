@@ -470,3 +470,87 @@ def test_skew_strided_layout_no_overflow(mesh, rng):
     pd.testing.assert_frame_equal(
         got.sort_values(key).reset_index(drop=True),
         want.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+def test_aqe_bucket_coalescing_spreads_skew():
+    """AQE partition coalescing (GpuCustomShuffleReaderExec.scala:131
+    role): hot hash buckets that would pile onto one shard under plain
+    h % nshards are spread by the greedy bucket->shard assignment, and
+    small buckets coalesce — the all-to-all slot shrinks accordingly."""
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.ops import aggregates as agg
+    from spark_rapids_tpu.ops.expressions import BoundReference, ColVal
+    from spark_rapids_tpu.parallel.distributed import (
+        DistributedAggregate, coalesce_buckets)
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel.partitioning import hash_partition_ids
+
+    mesh = make_mesh(8)
+    nshards = 8
+    # find key values that collide on shard 0 under h % nshards but
+    # occupy distinct finer buckets (4x) — the coalescer must separate
+    # them
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.INT64, dts.FLOAT64],
+        group_exprs=[BoundReference(0, dts.INT64, name="k")],
+        funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v"))])
+    cand = np.arange(0, 4096, dtype=np.int64)
+    pids = np.asarray(hash_partition_ids(
+        [ColVal(dts.INT64, jnp.asarray(cand))], nshards))
+    bids = np.asarray(hash_partition_ids(
+        [ColVal(dts.INT64, jnp.asarray(cand))], dist.buckets))
+    shard0 = cand[pids == 0]
+    hot = []
+    seen_b = set()
+    for k in shard0:
+        b = int(bids[cand.tolist().index(int(k))])
+        if b not in seen_b:
+            seen_b.add(b)
+            hot.append(int(k))
+        if len(hot) == 3:
+            break
+    assert len(hot) == 3, "test setup: need 3 colliding-but-separable keys"
+
+    cap = 512
+    total = nshards * cap
+    rng = np.random.default_rng(0)
+    # 90% of rows in the 3 hot keys, the rest uniform
+    keys = np.where(rng.random(total) < 0.9,
+                    rng.choice(hot, total),
+                    rng.integers(0, 4000, total)).astype(np.int64)
+    vals = rng.uniform(0, 1, total)
+    flat = [(jnp.asarray(keys), None, None),
+            (jnp.asarray(vals), None, None)]
+    nrows = jnp.asarray(np.full(nshards, cap, dtype=np.int32))
+    outs = dist(flat, nrows)
+    np.asarray(outs[0][0])  # force execution
+
+    stats = dist.last_stats
+    counts = stats["bucket_counts"]
+    lut = stats["bucket_map"]
+    # the three hot buckets must NOT all map to one shard
+    hot_buckets = {int(bids[cand.tolist().index(k)]) for k in hot}
+    assert len({int(lut[b]) for b in hot_buckets}) > 1, \
+        (hot_buckets, lut[sorted(hot_buckets)])
+    # coalesced max load is no worse than the naive h%nshards mapping
+    naive = np.zeros((nshards, nshards), dtype=np.int64)
+    for b in range(dist.buckets):
+        naive[:, b % nshards] += counts[:, b]
+    assert stats["partition_counts"].max() <= naive.max()
+
+    # correctness: per-key sums match numpy
+    got = {}
+    nkeys_out = np.asarray(outs[0][2]).reshape(nshards, -1)[:, 0]
+    kv = np.asarray(outs[0][0]).reshape(nshards, -1)
+    sv = np.asarray(outs[1][0]).reshape(nshards, -1)
+    for s in range(nshards):
+        for i in range(int(nkeys_out[s])):
+            got[int(kv[s, i])] = got.get(int(kv[s, i]), 0.0) + sv[s, i]
+    import collections
+    want = collections.defaultdict(float)
+    for k, v in zip(keys, vals):
+        want[int(k)] += v
+    for k, w in want.items():
+        assert abs(got[k] - w) < 1e-6, k
