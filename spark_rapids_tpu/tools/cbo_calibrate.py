@@ -108,7 +108,6 @@ def calibrate(n: int = 1 << 20) -> Dict[str, Dict[str, float]]:
         }
         print(f"{name:10s} device {out[name]['tpu']:9.4f} us/row   "
               f"cpu {out[name]['cpu']:9.4f} us/row", file=sys.stderr)
-    import jax
     return {
         "provenance": {
             "platform": jax.devices()[0].platform,
