@@ -108,13 +108,28 @@ class TpuSession:
                 hbm = stats.get("bytes_limit", 16 << 30)
             except Exception:
                 hbm = 16 << 30
-            device_budget = int(hbm * self.conf.get(rc.MEM_POOL_FRACTION))
+            # GpuDeviceManager.scala:170-245 sizing contract: subtract
+            # the runtime reserve, apply alloc fraction, clamp to the
+            # max fraction, and fail fast below the min fraction
+            reserve = self.conf.get(rc.MEM_RESERVE)
+            usable = max(hbm - reserve, 0)
+            device_budget = int(usable * self.conf.get(rc.MEM_POOL_FRACTION))
+            max_budget = int(usable * self.conf.get(rc.MEM_MAX_ALLOC_FRACTION))
+            device_budget = min(device_budget, max_budget)
+            min_budget = int(hbm * self.conf.get(rc.MEM_MIN_ALLOC_FRACTION))
+            if device_budget < min_budget:
+                raise ValueError(
+                    f"device pool {device_budget} bytes is below "
+                    f"minAllocFraction*HBM ({min_budget}); lower "
+                    "spark.rapids.memory.tpu.reserve / raise "
+                    "allocFraction, or lower minAllocFraction")
         from spark_rapids_tpu import native
         self.memory_catalog = SpillableBatchCatalog(
             device_budget=device_budget,
             host_budget=self.conf.get(rc.HOST_SPILL_STORAGE_SIZE),
             frame_codec=native.codec_level(
-                self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC)))
+                self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC)),
+            disk_write_threads=self.conf.get(rc.SPILL_DISK_WRITE_THREADS))
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))
@@ -269,7 +284,21 @@ class TpuSession:
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan):
         from spark_rapids_tpu.config import rapids_conf as rc
-        exec_plan = self.overrides.apply(logical)
+        if self.conf.get(rc.SUPPRESS_PLANNING_FAILURE):
+            # sql.suppressPlanningFailure: a bug in TPU planning demotes
+            # the whole query to the CPU fallback chain instead of
+            # failing it (RapidsConf.scala suppressPlanningFailure)
+            try:
+                exec_plan = self.overrides.apply(logical)
+            except Exception:
+                from spark_rapids_tpu.exec.fallback import CpuFallbackExec
+
+                def whole_cpu(n):
+                    return CpuFallbackExec(
+                        n, [whole_cpu(c) for c in n.children])
+                exec_plan = whole_cpu(logical)
+        else:
+            exec_plan = self.overrides.apply(logical)
         if self.conf.get(rc.PROFILE_TRACE):
             def mark(node):
                 node.trace_ops = True

@@ -122,27 +122,43 @@ class ColumnarBatch:
     def to_arrow(self):
         import jax
         import pyarrow as pa
-        # One device_get for every buffer in the batch: each separate
-        # np.asarray(device_array) pays a full device->host round trip
-        # (dominant with a remote-tunnel device), so gather all columns'
-        # values/validity/offsets in a single transfer first.
+        # Host-built columns export their EXACT numpy buffers and never
+        # touch the device (the .data property would materialize a
+        # device copy — on emulated-f64 TPUs the round trip perturbs
+        # doubles, see Column's docstring).  For genuinely
+        # device-resident buffers, gather everything in ONE device_get:
+        # per-buffer np.asarray would pay a full round trip each
+        # (dominant with a remote-tunnel device).
+        def devbuf(c, kind):
+            if getattr(c, f"_np_{kind}") is not None:
+                return None
+            return getattr(c, f"_jax_{kind}")
+
         device_bufs = []
         seen = set()
         for c in self.columns.values():
-            for buf in (c.data, c.validity, c.offsets):
-                if buf is not None and not isinstance(buf, np.ndarray) \
-                        and id(buf) not in seen:
+            for kind in ("data", "validity", "offsets"):
+                buf = devbuf(c, kind)
+                if buf is not None and id(buf) not in seen:
                     seen.add(id(buf))
                     device_bufs.append(buf)
         if device_bufs:
             fetched = jax.device_get(device_bufs)
             cache = {id(d): h for d, h in zip(device_bufs, fetched)}
+
+            def pick(c, kind):
+                np_buf = getattr(c, f"_np_{kind}")
+                if np_buf is not None:
+                    return np_buf
+                jb = getattr(c, f"_jax_{kind}")
+                return cache.get(id(jb), jb) if jb is not None else None
+
             cols = {}
             for n, c in self.columns.items():
                 cols[n] = Column(
-                    c.dtype, cache.get(id(c.data), c.data), c.nrows,
-                    validity=cache.get(id(c.validity), c.validity),
-                    offsets=cache.get(id(c.offsets), c.offsets),
+                    c.dtype, pick(c, "data"), c.nrows,
+                    validity=pick(c, "validity"),
+                    offsets=pick(c, "offsets"),
                     dictionary=c.dictionary)
             return pa.table({n: c.to_arrow() for n, c in cols.items()})
         return pa.table({n: c.to_arrow() for n, c in self.columns.items()})

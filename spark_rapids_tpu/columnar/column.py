@@ -42,53 +42,130 @@ def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
 
 
 class Column:
-    """One device column with logical length ``nrows`` and static capacity."""
+    """One device column with logical length ``nrows`` and static capacity.
 
-    __slots__ = ("dtype", "data", "validity", "offsets", "nrows",
+    Buffers are HOST-LAZY: a column built from host data keeps the exact
+    numpy arrays and materializes the device (jax) copy only when a
+    device consumer touches ``.data``/``.validity``/``.offsets``.  On
+    real TPU hardware f64 is emulated (~48-bit mantissa), so an eager
+    host->device->host round trip silently perturbs doubles by ~1e-16 —
+    enough to flip boundary comparisons (0.05 >= 0.05) on any host-side
+    consumer (CPU fallback, writers, to_pandas).  Host-side export paths
+    therefore read ``host_values()`` and never touch the device."""
+
+    __slots__ = ("dtype", "_np_data", "_jax_data", "_np_validity",
+                 "_jax_validity", "_np_offsets", "_jax_offsets", "nrows",
                  "dictionary")
 
     def __init__(self, dtype: DataType, data, nrows: int,
                  validity=None, offsets=None, dictionary=None):
         self.dtype = dtype
-        self.data = data          # fixed-width values, or uint8 chars for string
-        self.validity = validity  # bool[capacity] or None (all valid)
-        self.offsets = offsets    # int32[capacity+1] for strings else None
+        # fixed-width values, or uint8 chars for string
+        self._np_data = data if isinstance(data, np.ndarray) else None
+        self._jax_data = None if self._np_data is not None else data
+        # bool[capacity] or None (all valid)
+        self._np_validity = validity if isinstance(validity, np.ndarray) \
+            else None
+        self._jax_validity = None if self._np_validity is not None \
+            else validity
+        # int32[capacity+1] for strings else None
+        self._np_offsets = offsets if isinstance(offsets, np.ndarray) \
+            else None
+        self._jax_offsets = None if self._np_offsets is not None \
+            else offsets
         self.dictionary = dictionary  # host list[str] when elements are
         #                               dictionary codes (array<string>)
         self.nrows = int(nrows)
-        if dtype.has_offsets and offsets is None:
+        if dtype.has_offsets and self._np_offsets is None and \
+                self._jax_offsets is None:
             raise ValueError(f"{dtype} column requires offsets")
+
+    # -------------------------------------------------------- buffer access --
+    @property
+    def data(self):
+        """Device view of the value buffer (materialized on demand)."""
+        if self._jax_data is None:
+            self._jax_data = jnp.asarray(self._np_data)
+        return self._jax_data
+
+    @property
+    def validity(self):
+        if self._jax_validity is None:
+            if self._np_validity is None:
+                return None
+            self._jax_validity = jnp.asarray(self._np_validity)
+        return self._jax_validity
+
+    @property
+    def offsets(self):
+        if self._jax_offsets is None:
+            if self._np_offsets is None:
+                return None
+            self._jax_offsets = jnp.asarray(self._np_offsets)
+        return self._jax_offsets
+
+    def host_values(self) -> np.ndarray:
+        """Exact host view of the full value buffer: the original numpy
+        when the column was built from host data (bit-exact), else a
+        device fetch."""
+        if self._np_data is not None:
+            return self._np_data
+        return np.asarray(self._jax_data)
+
+    def host_validity(self) -> Optional[np.ndarray]:
+        if self._np_validity is not None:
+            return self._np_validity
+        if self._jax_validity is None:
+            return None
+        return np.asarray(self._jax_validity)
+
+    def host_offsets(self) -> Optional[np.ndarray]:
+        if self._np_offsets is not None:
+            return self._np_offsets
+        if self._jax_offsets is None:
+            return None
+        return np.asarray(self._jax_offsets)
 
     # ------------------------------------------------------------------ shape --
     @property
     def capacity(self) -> int:
         if self.dtype.has_offsets:
-            return int(self.offsets.shape[0]) - 1
-        return int(self.data.shape[0])
+            off = self._np_offsets if self._np_offsets is not None \
+                else self._jax_offsets
+            return int(off.shape[0]) - 1
+        d = self._np_data if self._np_data is not None else self._jax_data
+        return int(d.shape[0])
 
     @property
     def char_capacity(self) -> int:
         """Element-buffer capacity (chars for strings, elements for
         arrays)."""
         assert self.dtype.has_offsets
-        return int(self.data.shape[0])
+        d = self._np_data if self._np_data is not None else self._jax_data
+        return int(d.shape[0])
 
     @property
     def has_nulls(self) -> bool:
-        return self.validity is not None
+        return self._np_validity is not None or \
+            self._jax_validity is not None
 
     def null_count(self) -> int:
-        if self.validity is None:
+        if not self.has_nulls:
             return 0
-        v = np.asarray(self.validity[: self.nrows])
+        v = self.host_validity()[: self.nrows]
         return int((~v).sum())
 
     def device_size_bytes(self) -> int:
-        n = self.data.size * self.data.dtype.itemsize
-        if self.validity is not None:
-            n += self.validity.size
-        if self.offsets is not None:
-            n += self.offsets.size * 4
+        d = self._np_data if self._np_data is not None else self._jax_data
+        n = d.size * d.dtype.itemsize
+        if self.has_nulls:
+            v = self._np_validity if self._np_validity is not None \
+                else self._jax_validity
+            n += v.size
+        off = self._np_offsets if self._np_offsets is not None \
+            else self._jax_offsets
+        if off is not None:
+            n += off.size * 4
         return int(n)
 
     # ----------------------------------------------------------- construction --
@@ -132,8 +209,8 @@ class Column:
             v = np.zeros(cap, dtype=np.bool_)
             v[:nrows] = validity
             if not v[:nrows].all():
-                dev_validity = jnp.asarray(v)
-        return cls(dtype, jnp.asarray(buf), nrows, validity=dev_validity)
+                dev_validity = v
+        return cls(dtype, buf, nrows, validity=dev_validity)
 
     @classmethod
     def from_strings(cls, values: Sequence[Optional[str]],
@@ -167,9 +244,9 @@ class Column:
         if not valid.all():
             v = np.zeros(cap, dtype=np.bool_)
             v[:nrows] = valid
-            dev_validity = jnp.asarray(v)
-        return cls(dts.STRING, jnp.asarray(char_buf), nrows,
-                   validity=dev_validity, offsets=jnp.asarray(off_buf))
+            dev_validity = v
+        return cls(dts.STRING, char_buf, nrows,
+                   validity=dev_validity, offsets=off_buf)
 
     @classmethod
     def from_arrays(cls, values, element: DataType,
@@ -223,15 +300,15 @@ class Column:
         if not valid.all():
             v = np.zeros(cap, dtype=np.bool_)
             v[:nrows] = valid
-            dev_validity = jnp.asarray(v)
+            dev_validity = v
         if element.is_string:
             from spark_rapids_tpu.ops.json_ops import ARRAY_STRING
             adt = ARRAY_STRING
         else:
             from spark_rapids_tpu.columnar.dtypes import ArrayType
             adt = ArrayType(element)
-        return cls(adt, jnp.asarray(elem_buf), nrows,
-                   validity=dev_validity, offsets=jnp.asarray(off_buf),
+        return cls(adt, elem_buf, nrows,
+                   validity=dev_validity, offsets=off_buf,
                    dictionary=dictionary)
 
     @classmethod
@@ -272,21 +349,24 @@ class Column:
 
     # ------------------------------------------------------------- host export --
     def to_numpy(self) -> np.ndarray:
-        """Valid-length values as numpy; nulls hold unspecified data."""
+        """Valid-length values as numpy; nulls hold unspecified data.
+        Reads the exact host buffer when one exists (never a device
+        round trip — see class docstring)."""
         if self.dtype.is_string:
             raise TypeError("use to_pylist for string columns")
-        return np.asarray(self.data[: self.nrows])
+        return self.host_values()[: self.nrows]
 
     def validity_numpy(self) -> np.ndarray:
-        if self.validity is None:
+        v = self.host_validity()
+        if v is None:
             return np.ones(self.nrows, dtype=np.bool_)
-        return np.asarray(self.validity[: self.nrows])
+        return v[: self.nrows]
 
     def to_pylist(self):
         valid = self.validity_numpy()
         if self.dtype.is_array:
-            offs = np.asarray(self.offsets[: self.nrows + 1])
-            elems = np.asarray(self.data)
+            offs = self.host_offsets()[: self.nrows + 1]
+            elems = self.host_values()
             edt = self.dtype.element
             if self.dictionary is not None:
                 table = self.dictionary
@@ -301,8 +381,8 @@ class Column:
             return [[conv(v) for v in elems[offs[i]:offs[i + 1]]]
                     if valid[i] else None for i in range(self.nrows)]
         if self.dtype.is_string:
-            offs = np.asarray(self.offsets[: self.nrows + 1])
-            chars = np.asarray(self.data)
+            offs = self.host_offsets()[: self.nrows + 1]
+            chars = self.host_values()
             blob = chars.tobytes()
             return [blob[offs[i]:offs[i + 1]].decode("utf-8")
                     if valid[i] else None for i in range(self.nrows)]
@@ -340,8 +420,19 @@ class Column:
 
     # ------------------------------------------------------------------- misc --
     def with_nrows(self, nrows: int) -> "Column":
-        return Column(self.dtype, self.data, nrows, validity=self.validity,
-                      offsets=self.offsets, dictionary=self.dictionary)
+        # slot copy so the clone keeps BOTH the exact host buffer and
+        # any already-materialized device copy (re-upload-free slicing)
+        c = Column.__new__(Column)
+        c.dtype = self.dtype
+        c._np_data = self._np_data
+        c._jax_data = self._jax_data
+        c._np_validity = self._np_validity
+        c._jax_validity = self._jax_validity
+        c._np_offsets = self._np_offsets
+        c._jax_offsets = self._jax_offsets
+        c.dictionary = self.dictionary
+        c.nrows = int(nrows)
+        return c
 
     def __repr__(self) -> str:
         return (f"Column({self.dtype}, nrows={self.nrows}, "

@@ -178,15 +178,86 @@ UDF_COMPILER_ENABLED = conf(
     "Compile Python UDF bytecode into TPU expression trees "
     "(reference udf-compiler, RapidsConf.scala:519).", _to_bool)
 
+REGEXP_ENABLED = conf(
+    "spark.rapids.sql.regexp.enabled", True,
+    "Evaluate regular-expression expressions (rlike, regexp_replace, "
+    "split_part) on device; when false every regex expression tags off "
+    "to the CPU fallback (reference `sql.regexp.enabled`, "
+    "RapidsConf.scala).", _to_bool)
+
+VARIABLE_FLOAT_AGG = conf(
+    "spark.rapids.sql.variableFloatAgg.enabled", True,
+    "Allow sum/avg over floating-point values even though chunked and "
+    "distributed evaluation reorders the additions, so results can "
+    "differ from CPU Spark in the last ulps (reference "
+    "`sql.variableFloatAgg.enabled`; defaults ON here because the "
+    "engine is chunk-parallel by construction).", _to_bool)
+
+CAST_STRING_TO_FLOAT = conf(
+    "spark.rapids.sql.castStringToFloat.enabled", True,
+    "Allow string->float casts on device (reference "
+    "`sql.castStringToFloat.enabled`; tiny-ulp differences possible "
+    "for values near the subnormal range).", _to_bool)
+
+CAST_FLOAT_TO_STRING = conf(
+    "spark.rapids.sql.castFloatToString.enabled", True,
+    "Allow float->string casts on device (reference "
+    "`sql.castFloatToString.enabled`; formatting of some exponents "
+    "differs from Java).", _to_bool)
+
+CAST_FLOAT_TO_DECIMAL = conf(
+    "spark.rapids.sql.castFloatToDecimal.enabled", True,
+    "Allow float->decimal casts on device (reference "
+    "`sql.castFloatToDecimal.enabled`).", _to_bool)
+
+CAST_STRING_TO_TIMESTAMP = conf(
+    "spark.rapids.sql.castStringToTimestamp.enabled", True,
+    "Allow string->timestamp/date casts on device (reference "
+    "`sql.castStringToTimestamp.enabled`; only the fixed-width ISO "
+    "subset parses on device).", _to_bool)
+
+SUPPRESS_PLANNING_FAILURE = conf(
+    "spark.rapids.sql.suppressPlanningFailure", False,
+    "When TPU planning itself raises, retry the whole query on the "
+    "CPU fallback chain instead of failing (reference "
+    "`sql.suppressPlanningFailure`, RapidsConf.scala).", _to_bool)
+
 MEM_POOL_FRACTION = conf(
     "spark.rapids.memory.tpu.allocFraction", 0.9,
     "Fraction of HBM this engine may retain in its batch pool before "
     "spilling (reference `memory.gpu.allocFraction`).", _to_float, _fraction)
 
+MEM_MIN_ALLOC_FRACTION = conf(
+    "spark.rapids.memory.tpu.minAllocFraction", 0.25,
+    "Minimum fraction of HBM the batch pool must be able to claim; "
+    "session init fails fast when reserve/limit squeeze the pool below "
+    "this (reference `memory.gpu.minAllocFraction`, "
+    "GpuDeviceManager.scala:170-245).", _to_float, _fraction)
+
+MEM_MAX_ALLOC_FRACTION = conf(
+    "spark.rapids.memory.tpu.maxAllocFraction", 1.0,
+    "Hard ceiling on the HBM fraction the batch pool may claim, "
+    "applied after the reserve is subtracted (reference "
+    "`memory.gpu.maxAllocFraction`).", _to_float, _fraction)
+
+MEM_RESERVE = conf(
+    "spark.rapids.memory.tpu.reserve", 640 << 20,
+    "Bytes of HBM held back from the pool for the XLA runtime and "
+    "compiled-program scratch (the CUDA-context reserve analog, "
+    "`memory.gpu.reserve`).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 HOST_SPILL_STORAGE_SIZE = conf(
     "spark.rapids.memory.host.spillStorageSize", 1 << 30,
     "Bytes of host memory used as the first spill tier before disk "
     "(reference RapidsConf.scala:357).", _to_int, _positive)
+
+SPILL_DISK_WRITE_THREADS = conf(
+    "spark.rapids.memory.spill.diskWriteThreads", 2,
+    "Concurrent writer threads used when demoting host-tier batches "
+    "to disk; the native pager releases the GIL so writes overlap "
+    "(reference spill-thread sizing, RapidsConf.scala:393).",
+    _to_int, _positive)
 
 SPILL_ENABLED = conf(
     "spark.rapids.memory.tpu.spillEnabled", True,
@@ -262,6 +333,36 @@ MAX_NUM_FILES_PARALLEL = conf(
     "spark.rapids.sql.format.parquet.multiThreadedRead.maxNumFilesParallel", 4,
     "Max files buffered in flight per task by the multithreaded reader "
     "(reference RapidsConf.scala:740).", _to_int, _positive)
+
+PARQUET_ENABLED = conf(
+    "spark.rapids.sql.format.parquet.enabled", True,
+    "Use the engine's columnar parquet scan; when false parquet scans "
+    "tag off and the whole read runs on the pandas fallback chain "
+    "(reference `sql.format.parquet.enabled`, RapidsConf.scala:664).",
+    _to_bool)
+
+PARQUET_READ_ENABLED = conf(
+    "spark.rapids.sql.format.parquet.read.enabled", True,
+    "Read side of the parquet format switch (reference "
+    "`sql.format.parquet.read.enabled`).", _to_bool)
+
+ORC_ENABLED = conf(
+    "spark.rapids.sql.format.orc.enabled", True,
+    "Use the engine's columnar ORC scan (reference "
+    "`sql.format.orc.enabled`).", _to_bool)
+
+ORC_READ_ENABLED = conf(
+    "spark.rapids.sql.format.orc.read.enabled", True,
+    "Read side of the ORC format switch.", _to_bool)
+
+CSV_ENABLED = conf(
+    "spark.rapids.sql.format.csv.enabled", True,
+    "Use the engine's columnar CSV scan (reference "
+    "`sql.format.csv.enabled`).", _to_bool)
+
+CSV_READ_ENABLED = conf(
+    "spark.rapids.sql.format.csv.read.enabled", True,
+    "Read side of the CSV format switch.", _to_bool)
 
 PARQUET_READER_TYPE = conf(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",

@@ -71,15 +71,18 @@ class SpillableHandle:
         b = self._device
         payload = {"__nrows": self._nrows}
         for name, col in b.columns.items():
-            payload[f"{name}.data"] = np.asarray(col.data)
-            if col.validity is not None:
-                payload[f"{name}.validity"] = np.asarray(col.validity)
-            if col.offsets is not None:
-                payload[f"{name}.offsets"] = np.asarray(col.offsets)
+            # host_* readers keep still-host columns bit-exact and skip
+            # the device fetch entirely
+            payload[f"{name}.data"] = col.host_values()
+            v = col.host_validity()
+            if v is not None:
+                payload[f"{name}.validity"] = v
+            o = col.host_offsets()
+            if o is not None:
+                payload[f"{name}.offsets"] = o
         return payload
 
     def _rebuild(self, get) -> ColumnarBatch:
-        import jax.numpy as jnp
         cols = {}
         for name, dt in self._schema:
             data = get(f"{name}.data")
@@ -87,16 +90,15 @@ class SpillableHandle:
                 # the frame codec stores zero-length buffers as absent
                 # (lens=0); a legitimately empty buffer (e.g. the chars of
                 # an all-empty string column) must round-trip as empty, not
-                # as None -> jnp.asarray(None) crash
+                # as None -> asarray(None) crash
                 data = np.zeros(
                     0, dtype=np.uint8 if dt.is_string else dt.storage)
-            data = jnp.asarray(data)
-            validity = get(f"{name}.validity")
-            offsets = get(f"{name}.offsets")
+            # hand the host buffers straight to Column: it materializes
+            # the device copy lazily on first device use
             cols[name] = Column(
-                dt, data, self._nrows,
-                validity=None if validity is None else jnp.asarray(validity),
-                offsets=None if offsets is None else jnp.asarray(offsets))
+                dt, np.ascontiguousarray(data), self._nrows,
+                validity=get(f"{name}.validity"),
+                offsets=get(f"{name}.offsets"))
         return ColumnarBatch(cols, self._nrows)
 
     def spill_to_host(self) -> int:
@@ -173,9 +175,14 @@ class SpillableBatchCatalog:
     def __init__(self, device_budget: int = 1 << 34,
                  host_budget: int = 1 << 30,
                  spill_dir: Optional[str] = None,
-                 frame_codec: int = 2):
+                 frame_codec: int = 2,
+                 disk_write_threads: int = 2):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # host->disk demotions overlap in a small writer pool: the
+        # native pager releases the GIL for serialize+write
+        # (spark.rapids.memory.spill.diskWriteThreads)
+        self.disk_write_threads = max(int(disk_write_threads), 1)
         # per-session frame codec level for spilled/cached frames
         # (0 raw / 1 zrle / 2 zrle+lzb); sessions set this from
         # spark.rapids.shuffle.compression.codec
@@ -253,23 +260,54 @@ class SpillableBatchCatalog:
         candidates = sorted(
             (h for h in self._handles.values() if h.tier == tier),
             key=lambda h: (h.priority, h.last_access, h.id))
-        for h in candidates:
-            if used <= budget:
-                break
-            if tier == DEVICE:
+        if tier == DEVICE:
+            for h in candidates:
+                if used <= budget:
+                    break
                 freed = h.spill_to_host()
                 self.device_bytes -= freed
                 self.host_bytes += freed
                 self.spilled_to_host_total += freed
                 used -= freed
-            else:
-                freed = h.spill_to_disk()
-                self.host_bytes -= freed
-                self.disk_bytes += freed
-                self.spilled_to_disk_total += freed
-                used -= freed
-        if tier == DEVICE and self.host_bytes > self.host_budget:
-            self._spill_tier(HOST, self.host_budget)
+            if self.host_bytes > self.host_budget:
+                self._spill_tier(HOST, self.host_budget)
+            return
+        # host -> disk: pick the victims first, then overlap the
+        # serialize+write calls in the writer pool (handles are
+        # disjoint; catalog counters update on this thread)
+        to_spill = []
+        for h in candidates:
+            if used <= budget:
+                break
+            to_spill.append(h)
+            used -= h.size_bytes
+        if not to_spill:
+            return
+        def account(freed):
+            self.host_bytes -= freed
+            self.disk_bytes += freed
+            self.spilled_to_disk_total += freed
+
+        if self.disk_write_threads > 1 and len(to_spill) > 1:
+            # account every COMPLETED demotion even when one writer
+            # fails mid-batch, else host/disk counters drift for the
+            # rest of the session
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=self.disk_write_threads) as pool:
+                futures = [pool.submit(h.spill_to_disk)
+                           for h in to_spill]
+                first_err = None
+                for fut in futures:
+                    try:
+                        account(fut.result())
+                    except BaseException as e:  # noqa: BLE001
+                        first_err = first_err or e
+                if first_err is not None:
+                    raise first_err
+        else:
+            for h in to_spill:
+                account(h.spill_to_disk())
 
     def stats(self) -> Dict[str, int]:
         return {

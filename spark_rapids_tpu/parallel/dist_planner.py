@@ -743,6 +743,15 @@ class DistPlanner:
         from spark_rapids_tpu.exec.expand import Expand as _Expand
         if isinstance(plan, _Expand):
             return self._expand(plan, dry)
+        if isinstance(plan, L.Generate):
+            # explode/posexplode: array columns have no mesh encoding
+            # yet, so the generate itself runs on the controller as a
+            # materialize barrier — but its OUTPUT is flat, and the
+            # post-explode pipeline (where row counts are largest) still
+            # distributes.  _scan executes the subtree single-process
+            # and scatters row blocks (GpuGenerateExec stays an
+            # exchange producer in the reference too).
+            return self._scan(plan, dry)
         raise NotDistributable(
             f"{type(plan).__name__} has no distributed lowering")
 

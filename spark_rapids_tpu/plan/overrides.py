@@ -273,6 +273,47 @@ class ExprMeta(BaseMeta):
                 f"{type(expr).__name__} arguments outside the TPU regex "
                 "subset (falls back to CPU, like the reference's regex "
                 "incompat flag)")
+        if isinstance(expr, (RX.RLike, RX.RegExpReplace, RX.SplitPart)):
+            from spark_rapids_tpu.config.rapids_conf import REGEXP_ENABLED
+            if not self.conf.get(REGEXP_ENABLED):
+                self.will_not_work(
+                    f"{name} disabled by "
+                    "spark.rapids.sql.regexp.enabled")
+        if isinstance(expr, AggregateExpression) and \
+                expr.func.name in ("sum", "avg", "average", "mean") and \
+                expr.func.child is not None:
+            try:
+                is_float = expr.func.child.dtype.is_floating
+            except (RuntimeError, TypeError, ValueError):
+                is_float = False  # dtype issues already tagged above
+            from spark_rapids_tpu.config.rapids_conf import \
+                VARIABLE_FLOAT_AGG
+            if is_float and not self.conf.get(VARIABLE_FLOAT_AGG):
+                self.will_not_work(
+                    f"float {expr.func.name} reorders additions across "
+                    "chunks/shards and "
+                    "spark.rapids.sql.variableFloatAgg.enabled is false")
+        if isinstance(expr, Cast):
+            from spark_rapids_tpu.config import rapids_conf as _rc
+            try:
+                src, dst = expr.child.dtype, expr.target
+                gates = (
+                    (src.is_string and dst.is_floating,
+                     _rc.CAST_STRING_TO_FLOAT),
+                    (src.is_floating and dst.is_string,
+                     _rc.CAST_FLOAT_TO_STRING),
+                    (src.is_floating and dst.is_decimal,
+                     _rc.CAST_FLOAT_TO_DECIMAL),
+                    (src.is_string and (dst.is_timestamp or dst.is_date),
+                     _rc.CAST_STRING_TO_TIMESTAMP),
+                )
+                for hit, entry in gates:
+                    if hit and not self.conf.get(entry):
+                        self.will_not_work(
+                            f"cast {src.name}->{dst.name} disabled by "
+                            f"{entry.key}")
+            except (RuntimeError, TypeError, ValueError):
+                pass
         if isinstance(expr, WindowExpression):
             reason = expr.supported_reason()
             if reason:
@@ -319,6 +360,20 @@ class PlanMeta(BaseMeta):
             self.will_not_work(
                 f"{type(node).__name__} disabled by "
                 f"spark.rapids.sql.exec.{type(node).__name__}")
+        if isinstance(node, L.FileRelation):
+            # per-format scan switches (sql.format.<fmt>.enabled /
+            # .read.enabled, RapidsConf.scala:664): a disabled format
+            # runs the whole read on the pandas fallback chain
+            from spark_rapids_tpu.config import rapids_conf as _rc
+            gates = {"parquet": (_rc.PARQUET_ENABLED,
+                                 _rc.PARQUET_READ_ENABLED),
+                     "orc": (_rc.ORC_ENABLED, _rc.ORC_READ_ENABLED),
+                     "csv": (_rc.CSV_ENABLED, _rc.CSV_READ_ENABLED)}
+            for entry in gates.get(node.file_format, ()):
+                if not self.conf.get(entry):
+                    self.will_not_work(
+                        f"{node.file_format} scan disabled by "
+                        f"{entry.key}")
         if type(node) not in _PLAN_CONVERTERS:
             self.will_not_work(
                 f"{type(node).__name__} has no TPU implementation")

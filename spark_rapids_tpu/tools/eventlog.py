@@ -23,6 +23,8 @@ class QueryInfo:
     explain: str = ""
     status: str = ""
     duration_ms: float = 0.0
+    start_ts: float = 0.0   # epoch seconds (QueryStart record ts)
+    end_ts: float = 0.0
     metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
     spill: Dict[str, int] = field(default_factory=dict)
     retry: Dict[str, int] = field(default_factory=dict)
@@ -49,10 +51,16 @@ class AppInfo:
     path: str
     conf: Dict[str, str] = field(default_factory=dict)
     queries: List[QueryInfo] = field(default_factory=list)
+    start_ts: float = 0.0   # SessionStart record ts
 
     @property
     def total_duration_ms(self) -> float:
         return sum(q.duration_ms for q in self.queries)
+
+    @property
+    def end_ts(self) -> float:
+        return max((q.end_ts for q in self.queries if q.end_ts),
+                   default=self.start_ts)
 
 
 def parse_event_log(path: str) -> AppInfo:
@@ -71,17 +79,20 @@ def parse_event_log(path: str) -> AppInfo:
             if ev == "SessionStart":
                 app.conf = rec.get("conf", {})
                 app.session_id = rec.get("sessionId", app.session_id)
+                app.start_ts = rec.get("ts", 0.0)
             elif ev == "QueryStart":
                 q = QueryInfo(rec["queryId"],
                               logical_plan=rec.get("logicalPlan", ""),
                               physical_plan=rec.get("physicalPlan", ""),
-                              explain=rec.get("explain", ""))
+                              explain=rec.get("explain", ""),
+                              start_ts=rec.get("ts", 0.0))
                 open_queries[q.query_id] = q
             elif ev == "QueryEnd":
                 q = open_queries.pop(rec["queryId"],
                                      QueryInfo(rec["queryId"]))
                 q.status = rec.get("status", "")
                 q.duration_ms = rec.get("durationMs", 0.0)
+                q.end_ts = rec.get("ts", 0.0)
                 q.metrics = rec.get("metrics", {})
                 q.spill = rec.get("spill", {})
                 q.retry = rec.get("retry", {})
@@ -102,3 +113,25 @@ def load_logs(log_dir_or_file: str) -> List[AppInfo]:
     else:
         return []
     return [parse_event_log(p) for p in paths]
+
+
+def filter_apps(apps: List[AppInfo],
+                match: Optional[str] = None,
+                started_after: Optional[float] = None,
+                newest: Optional[int] = None) -> List[AppInfo]:
+    """The AppFilterImpl role: narrow a log directory's sessions by id
+    regex, start time, and recency before analysis (reference
+    tools/.../AppFilterImpl.scala)."""
+    import re
+    out = list(apps)
+    if match:
+        rx = re.compile(match)
+        out = [a for a in out if rx.search(a.session_id) or
+               rx.search(os.path.basename(a.path))]
+    if started_after is not None:
+        out = [a for a in out if a.start_ts >= started_after]
+    if newest is not None and newest >= 0:
+        out.sort(key=lambda a: -a.start_ts)
+        out = out[:newest]
+        out.sort(key=lambda a: a.start_ts)
+    return out

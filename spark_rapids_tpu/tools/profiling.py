@@ -102,6 +102,96 @@ def plan_dot(q: QueryInfo) -> str:
     return "\n".join(out)
 
 
+def generate_timeline(apps: List[AppInfo]) -> str:
+    """SVG timeline: one lane per session, one bar per query, colored by
+    status (the GenerateTimeline.scala:494 role — theirs draws tasks per
+    executor; a single-controller SPMD engine's unit of work is the
+    query)."""
+    apps = [a for a in apps if a.queries]
+    if not apps:
+        return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+    t0 = min(min((q.start_ts or a.start_ts) for q in a.queries)
+             for a in apps)
+    t1 = max(a.end_ts for a in apps)
+    span = max(t1 - t0, 1e-3)
+    width, lane_h, pad, label_w = 900, 26, 6, 180
+    h = pad * 2 + lane_h * len(apps) + 30
+    scale = (width - label_w - pad * 2) / span
+
+    def x(ts):
+        return label_w + pad + (ts - t0) * scale
+
+    colors = {"success": "#4c956c", "incomplete": "#b8b8ff"}
+    out = [f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
+           f"height='{h}' font-family='monospace' font-size='11'>"]
+    for i, a in enumerate(apps):
+        y = pad + i * lane_h
+        out.append(f"<text x='{pad}' y='{y + lane_h - 10}'>"
+                   f"{a.session_id[:24]}</text>")
+        out.append(f"<line x1='{label_w}' y1='{y + lane_h - 4}' "
+                   f"x2='{width - pad}' y2='{y + lane_h - 4}' "
+                   f"stroke='#ddd'/>")
+        for q in a.queries:
+            qs = q.start_ts or a.start_ts
+            qe = q.end_ts or (qs + q.duration_ms / 1e3)
+            w = max((qe - qs) * scale, 2.0)
+            color = colors.get(q.status, "#d1495b")
+            out.append(
+                f"<rect x='{x(qs):.1f}' y='{y + 4}' width='{w:.1f}' "
+                f"height='{lane_h - 10}' fill='{color}'>"
+                f"<title>q{q.query_id}: {q.duration_ms:.1f} ms "
+                f"[{q.status}]</title></rect>")
+    axis_y = pad + len(apps) * lane_h + 14
+    out.append(f"<text x='{label_w}' y='{axis_y}'>0s</text>")
+    out.append(f"<text x='{width - 60}' y='{axis_y}'>{span:.1f}s</text>")
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def compare_apps(apps: List[AppInfo]) -> str:
+    """Side-by-side session comparison (CompareApplications.scala role):
+    per-app totals, then per-query durations matched across apps by
+    query id, flagging big regressions."""
+    out = ["-- Application comparison --",
+           f"{'session':28s} {'queries':>8s} {'total_ms':>10s} "
+           f"{'spill_B':>10s} {'fallbacks':>9s}"]
+    for a in apps:
+        spilled = sum(sum(q.spill.values()) for q in a.queries if q.spill)
+        fb = sum(len(q.fallback_ops()) for q in a.queries)
+        out.append(f"{a.session_id[:28]:28s} {len(a.queries):8d} "
+                   f"{a.total_duration_ms:10.1f} {spilled:10d} {fb:9d}")
+    # query ids are engine-global counters, so cross-session identity is
+    # the LOGICAL PLAN text (the role SQL ids play in
+    # CompareApplications.scala)
+    def plans(a):
+        import re
+        seen = {}
+        for q in a.queries:
+            # normalize data-dependent literals (row counts in relation
+            # describe strings) so the same query over different data
+            # sizes still matches
+            key = re.sub(r"\d+", "N", q.logical_plan.strip())
+            if key and key not in seen:
+                seen[key] = q
+        return seen
+    per_app = [plans(a) for a in apps]
+    keys = sorted(set.intersection(*[set(p) for p in per_app])) \
+        if len(apps) >= 2 else []
+    if keys:
+        out.append("\n-- Matched queries (by logical plan) --")
+        header = f"{'plan':34s}" + "".join(
+            f" {a.session_id[:14]:>16s}" for a in apps)
+        out.append(header + f" {'max/min':>8s}")
+        for key in keys:
+            durs = [p[key].duration_ms for p in per_app]
+            ratio = (max(durs) / min(durs)) if min(durs) else 0.0
+            flag = "  <-- regression" if ratio >= 2.0 else ""
+            label = key.splitlines()[0][:34]
+            out.append(f"{label:34s}" + "".join(
+                f" {d:16.1f}" for d in durs) + f" {ratio:8.2f}{flag}")
+    return "\n".join(out)
+
+
 def format_report(apps: List[AppInfo], top: int) -> str:
     out = ["=" * 72, "TPU Profiling Report", "=" * 72]
     out.append(f"\nSessions: {len(apps)}, queries: "
@@ -137,11 +227,33 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--top", type=int, default=10)
     ap.add_argument("--dot", type=int, default=None, metavar="QUERYID",
                     help="print a DOT graph of this query's physical plan")
+    ap.add_argument("--timeline", metavar="FILE.svg", default=None,
+                    help="write an SVG timeline of all sessions/queries")
+    ap.add_argument("--compare", action="store_true",
+                    help="side-by-side comparison of the loaded sessions")
+    ap.add_argument("--filter-app", metavar="REGEX", default=None,
+                    help="only sessions whose id matches the regex")
+    ap.add_argument("--started-after", type=float, default=None,
+                    metavar="EPOCH", help="only sessions started at/after "
+                    "this epoch-seconds timestamp")
+    ap.add_argument("--newest", type=int, default=None, metavar="N",
+                    help="only the N most recently started sessions")
     args = ap.parse_args(argv)
-    apps = load_logs(args.logdir)
+    from spark_rapids_tpu.tools.eventlog import filter_apps
+    apps = filter_apps(load_logs(args.logdir), match=args.filter_app,
+                       started_after=args.started_after,
+                       newest=args.newest)
     if not apps:
         print("no event logs found", file=sys.stderr)
         return 1
+    if args.timeline:
+        with open(args.timeline, "w", encoding="utf-8") as fh:
+            fh.write(generate_timeline(apps))
+        print(f"wrote {args.timeline}")
+        return 0
+    if args.compare:
+        print(compare_apps(apps))
+        return 0
     if args.dot is not None:
         for a in apps:
             for q in a.queries:

@@ -117,3 +117,116 @@ def test_per_format_reader_type_keys():
     c = RapidsConf({"spark.rapids.sql.format.orc.reader.type": "PERFILE"})
     assert c["spark.rapids.sql.format.orc.reader.type"] == "PERFILE"
     assert c["spark.rapids.sql.format.csv.reader.type"] == "AUTO"
+
+
+def test_memory_sizing_family():
+    """reserve/min/max alloc fractions shape the derived pool
+    (GpuDeviceManager.scala:170-245 sizing contract)."""
+    # squeeze the pool below minAllocFraction -> fail fast
+    with pytest.raises(ValueError, match="minAllocFraction"):
+        TpuSession({
+            "spark.rapids.memory.tpu.reserve": str(15 << 30),
+            "spark.rapids.memory.tpu.minAllocFraction": "0.5"})
+    # maxAllocFraction caps the pool
+    s = TpuSession({
+        "spark.rapids.memory.tpu.reserve": "0",
+        "spark.rapids.memory.tpu.allocFraction": "0.9",
+        "spark.rapids.memory.tpu.maxAllocFraction": "0.5",
+        "spark.rapids.memory.tpu.minAllocFraction": "0.1"})
+    s2 = TpuSession({
+        "spark.rapids.memory.tpu.reserve": "0",
+        "spark.rapids.memory.tpu.allocFraction": "0.9",
+        "spark.rapids.memory.tpu.minAllocFraction": "0.1"})
+    assert s.memory_catalog.device_budget < s2.memory_catalog.device_budget
+
+
+def test_format_enable_gate(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": list(range(10))}), p)
+    s = TpuSession({"spark.rapids.sql.format.parquet.enabled": "false"})
+    df = s.read.parquet(p)
+    tree = s.plan(df.plan).tree_string()
+    assert "CpuFallbackExec" in tree
+    assert sorted(df.to_pandas()["a"].tolist()) == list(range(10))
+    s2 = TpuSession()
+    assert "CpuFallbackExec" not in s2.plan(s2.read.parquet(p).plan
+                                            ).tree_string()
+
+
+def test_regexp_enable_gate():
+    s = TpuSession({"spark.rapids.sql.regexp.enabled": "false"})
+    df = s.create_dataframe({"x": ["a1", "bb"]})
+    q = df.select(F.rlike("x", r"\d").alias("m"))
+    assert "CpuFallbackExec" in s.plan(q.plan).tree_string()
+    assert q.to_pandas()["m"].tolist() == [True, False]
+
+
+def test_variable_float_agg_gate():
+    s = TpuSession(
+        {"spark.rapids.sql.variableFloatAgg.enabled": "false"})
+    df = s.create_dataframe({"g": [1, 1, 2], "v": [0.5, 0.25, 1.0]})
+    q = df.groupBy("g").agg(F.sum("v").alias("s"))
+    assert "CpuFallbackExec" in s.plan(q.plan).tree_string()
+    got = q.to_pandas().sort_values("g", ignore_index=True)
+    assert got["s"].tolist() == [0.75, 1.0]
+    # integer sums unaffected
+    q2 = df.groupBy("g").agg(F.count("v").alias("c"))
+    assert "CpuFallbackExec" not in s.plan(q2.plan).tree_string()
+
+
+def test_cast_config_gates():
+    s = TpuSession(
+        {"spark.rapids.sql.castStringToFloat.enabled": "false"})
+    df = s.create_dataframe({"x": ["1.5", "2.5"]})
+    q = df.select(F.col("x").cast("double").alias("d"))
+    assert "CpuFallbackExec" in s.plan(q.plan).tree_string()
+    assert q.to_pandas()["d"].tolist() == [1.5, 2.5]
+    s2 = TpuSession()
+    assert "CpuFallbackExec" not in s2.plan(q.plan).tree_string()
+
+
+def test_suppress_planning_failure():
+    s = TpuSession({"spark.rapids.sql.suppressPlanningFailure": "true"})
+    df = s.create_dataframe({"x": [2, 1]})
+    plan = df.orderBy("x").plan
+
+    class Boom:
+        def apply(self, logical):
+            raise RuntimeError("planner bug")
+    real = s.overrides
+    s.overrides = Boom()
+    try:
+        exec_plan = s.plan(plan)
+        assert "CpuFallbackExec" in exec_plan.tree_string()
+        import pyarrow as pa
+        out = pa.concat_tables(
+            [b.to_arrow() for b in exec_plan.execute()]).to_pandas()
+        assert out["x"].tolist() == [1, 2]
+    finally:
+        s.overrides = real
+    # default: the failure surfaces
+    s2 = TpuSession()
+    s2.overrides = Boom()
+    try:
+        with pytest.raises(RuntimeError, match="planner bug"):
+            s2.plan(plan)
+    finally:
+        pass
+
+
+def test_spill_disk_write_threads(tmp_path):
+    import numpy as np
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.spill import (
+        DISK, SpillableBatchCatalog)
+    cat = SpillableBatchCatalog(
+        device_budget=1, host_budget=1, spill_dir=str(tmp_path),
+        disk_write_threads=3)
+    hs = [cat.register(ColumnarBatch.from_pydict(
+        {"a": np.arange(2048) + i})) for i in range(4)]
+    assert all(h.tier == DISK for h in hs)
+    for h in hs:
+        got = h.materialize()
+        assert got.to_pydict()["a"][0] == hs.index(h)

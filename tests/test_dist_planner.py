@@ -500,3 +500,40 @@ def test_full_join_huge_output_falls_back(mesh, oracle_session):
         assert sess.last_dist_explain.startswith("fallback")
     finally:
         DistPlanner.MAX_OUT_ROWS = old
+
+
+def test_generate_distributed(dist_session, oracle_session):
+    """explode lowers as a controller-side materialize barrier whose
+    flat output scatters to the mesh; the post-explode aggregate (the
+    big-row-count side) runs distributed (round-3 verdict task #4 tail:
+    GpuGenerateExec as exchange producer)."""
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({
+        "id": np.arange(300),
+        "arr": [list(range(int(n))) for n in rng.integers(0, 6, 300)],
+    })
+
+    def build(s):
+        f = s.create_dataframe(df)
+        return (f.select("id", F.explode("arr"))
+                 .groupBy("col").agg(F.count("id").alias("n"),
+                                     F.sum("id").alias("sid")))
+    d, o = build(dist_session), build(oracle_session)
+    _cmp(d, o, sort_by=["col"])
+    assert dist_session.last_dist_explain == "distributed"
+
+
+def test_posexplode_distributed(dist_session, oracle_session):
+    df = pd.DataFrame({
+        "id": np.arange(64),
+        "arr": [[i, i + 1] for i in range(64)],
+    })
+
+    def build(s):
+        f = s.create_dataframe(df)
+        return (f.select("id", F.posexplode("arr"))
+                 .filter(F.col("pos") == 1)
+                 .agg(F.sum("col").alias("sc")))
+    d, o = build(dist_session), build(oracle_session)
+    _cmp(d, o)
+    assert dist_session.last_dist_explain == "distributed"

@@ -1,0 +1,187 @@
+"""CpuFallbackExec streaming discipline: per-row nodes must process one
+child batch at a time instead of collecting the whole child into a single
+pandas frame (the round-3 verdict's OOC gap; reference keeps CPU Spark's
+iterator contract at every fallback boundary)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exec.basic import TpuScanExec
+from spark_rapids_tpu.exec.fallback import CpuFallbackExec
+from spark_rapids_tpu.plan import logical as L
+
+N_BATCHES = 5
+BATCH_ROWS = 100
+
+
+class SpyScan(TpuExec):
+    """Counts how many batches downstream actually pulled."""
+
+    def __init__(self, batches, schema):
+        super().__init__()
+        self.inner = TpuScanExec(batches, schema)
+        self.pulled = 0
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    def describe(self):
+        return "SpyScan"
+
+    def do_execute(self):
+        for b in self.inner.execute():
+            self.pulled += 1
+            yield b
+
+
+def make_batches(n_batches=N_BATCHES, rows=BATCH_ROWS):
+    out = []
+    for i in range(n_batches):
+        a = np.arange(rows, dtype=np.int64) + i * rows
+        g = (np.arange(rows) + i) % 7
+        out.append(ColumnarBatch.from_pydict(
+            {"a": a, "g": g.astype(np.int64)}))
+    return out
+
+
+def relation(batches):
+    return L.InMemoryRelation(batches, batches[0].schema)
+
+
+@pytest.fixture
+def spy():
+    batches = make_batches()
+    return SpyScan(batches, batches[0].schema), batches
+
+
+def to_pandas(exec_node):
+    import pyarrow as pa
+    tables = [b.to_arrow() for b in exec_node.execute()]
+    return pa.concat_tables(tables).to_pandas()
+
+
+def oracle(batches):
+    import pyarrow as pa
+    return pa.concat_tables([b.to_arrow() for b in batches]).to_pandas()
+
+
+def test_project_streams_one_batch_per_chunk(spy):
+    scan, batches = spy
+    node = L.Project([F.col("a").expr], relation(batches))
+    fb = CpuFallbackExec(node, [scan])
+    n_out = 0
+    max_rows = 0
+    for b in fb.execute():
+        n_out += 1
+        max_rows = max(max_rows, b.nrows)
+    # one output batch per input batch, each bounded by the input batch
+    assert n_out == N_BATCHES
+    assert max_rows <= BATCH_ROWS
+    assert scan.pulled == N_BATCHES
+
+
+def test_filter_streams_and_matches_oracle(spy):
+    scan, batches = spy
+    node = L.Filter((F.col("a") < 250).expr, relation(batches))
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb)
+    want = oracle(batches).query("a < 250").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_limit_short_circuits_child_pull():
+    batches = make_batches()
+    scan = SpyScan(batches, batches[0].schema)
+    node = L.Limit(BATCH_ROWS + 10, relation(batches))
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb)
+    assert len(got) == BATCH_ROWS + 10
+    # limit satisfied inside batch 2 of 5: remaining batches never pulled
+    assert scan.pulled == 2
+
+
+def test_aggregate_chunked_partials_match_oracle(spy):
+    scan, batches = spy
+    aggs = [F.sum("a").alias("s").expr, F.count("a").alias("c").expr,
+            F.min("a").alias("lo").expr, F.max("a").alias("hi").expr,
+            F.avg("a").alias("m").expr]
+    node = L.Aggregate([F.col("g").expr], aggs, relation(batches))
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb).sort_values("g", ignore_index=True)
+    df = oracle(batches)
+    want = df.groupby("g", as_index=False).agg(
+        s=("a", "sum"), c=("a", "count"), lo=("a", "min"),
+        hi=("a", "max"), m=("a", "mean")).sort_values(
+            "g", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    # every batch folded into partial states (no whole-input frame)
+    assert scan.pulled == N_BATCHES
+
+
+def test_aggregate_global_empty_input_one_row():
+    schema = make_batches(1)[0].schema
+    scan = TpuScanExec([], schema)
+    node = L.Aggregate([], [F.count("a").alias("c").expr],
+                       L.InMemoryRelation([], schema))
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb)
+    assert len(got) == 1 and int(got["c"].iloc[0]) == 0
+
+
+def test_join_probe_side_streams():
+    batches = make_batches()
+    left_scan = SpyScan(batches, batches[0].schema)
+    build = ColumnarBatch.from_pydict(
+        {"g2": np.arange(7, dtype=np.int64),
+         "name": [f"g{i}" for i in range(7)]})
+    right_scan = TpuScanExec([build], build.schema)
+    node = L.Join(relation(batches),
+                  L.InMemoryRelation([build], build.schema),
+                  [F.col("g").expr], [F.col("g2").expr], "inner")
+    fb = CpuFallbackExec(node, [left_scan, right_scan])
+    got = to_pandas(fb)
+    assert len(got) == N_BATCHES * BATCH_ROWS  # every row matches
+    assert left_scan.pulled == N_BATCHES
+
+
+def test_null_group_keys_merge_across_chunks():
+    """NaN group keys from different chunks must land in ONE group."""
+    b1 = ColumnarBatch.from_pydict({"g": [1, None], "a": [10, 1]})
+    b2 = ColumnarBatch.from_pydict({"g": [None, 1], "a": [2, 30]})
+    scan = TpuScanExec([b1, b2], b1.schema)
+    node = L.Aggregate([F.col("g").expr], [F.sum("a").alias("s").expr],
+                       L.InMemoryRelation([b1, b2], b1.schema))
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb)
+    assert len(got) == 2  # group 1 and ONE null group
+    bykey = {(None if pd.isna(k) else int(k)): int(v)
+             for k, v in zip(got["g"], got["s"])}
+    assert bykey == {1: 40, None: 3}
+
+
+def test_host_export_never_touches_device():
+    """Host-built batches export through to_arrow/to_pandas from their
+    EXACT numpy buffers without materializing a device copy — on real
+    TPUs the emulated-f64 round trip perturbs doubles (~1e-16), which
+    flips boundary comparisons on every host-side consumer."""
+    b = ColumnarBatch.from_pydict(
+        {"d": np.array([0.05, 0.06, 0.07]),
+         "s": ["x", None, "z"],
+         "i": [1, None, 3]})
+    df = b.to_arrow().to_pandas()
+    for c in b.columns.values():
+        assert c._jax_data is None, "to_arrow materialized device data"
+    assert df["d"].tolist() == [0.05, 0.06, 0.07]
+    # device use materializes exactly once and caches; host copy stays
+    col = b.columns["d"]
+    dev = col.data
+    assert col.data is dev
+    assert col.host_values()[0] == 0.05
+    # slicing keeps both buffers (no re-upload, still exact)
+    sliced = col.with_nrows(2)
+    assert sliced._jax_data is dev and sliced._np_data is not None

@@ -108,3 +108,55 @@ def test_tolerates_torn_tail(tmp_path):
                  "\n{\"event\": \"QueryStart\", \"que")
     app = load_logs(str(p))[0]
     assert app.session_id == "x"
+
+
+def test_timeline_svg(logged_session, tmp_path):
+    s, d = logged_session
+    out = str(tmp_path / "timeline.svg")
+    rc = profiling.main([str(d), "--timeline", out])
+    assert rc == 0
+    svg = open(out).read()
+    assert svg.startswith("<svg")
+    # one bar per query, with status color + tooltip
+    assert svg.count("<rect") == 2
+    assert svg.count("[success]") == 2 and "#4c956c" in svg
+
+
+def test_compare_apps(tmp_path, capsys):
+    # two sessions running the same two queries, second one slower
+    for n in (200, 5000):
+        s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+        df = s.create_dataframe(pd.DataFrame(
+            {"k": (np.arange(n) % 7).astype(np.int64),
+             "v": np.arange(n, dtype=np.float64)}))
+        df.groupBy("k").agg(F.sum("v").alias("s")).collect()
+        df.agg(F.count().alias("n")).collect()
+    apps = load_logs(str(tmp_path))
+    assert len(apps) == 2
+    rc = profiling.main([str(tmp_path), "--compare"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Application comparison" in out
+    assert "Matched queries (by logical plan)" in out
+    assert "Aggregate" in out
+
+
+def test_app_filtering(tmp_path, capsys):
+    from spark_rapids_tpu.tools.eventlog import filter_apps
+    for _ in range(2):
+        s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+        s.create_dataframe(pd.DataFrame({"x": [1]})).collect()
+    apps = load_logs(str(tmp_path))
+    assert len(apps) == 2
+    first_id = apps[0].session_id
+    only = filter_apps(apps, match=first_id)
+    assert len(only) == 1 and only[0].session_id == first_id
+    newest = filter_apps(apps, newest=1)
+    assert len(newest) == 1
+    late = filter_apps(apps, started_after=max(
+        a.start_ts for a in apps) + 1e6)
+    assert late == []
+    # CLI path
+    rc = profiling.main([str(tmp_path), "--filter-app", first_id])
+    assert rc == 0
+    assert "queries: 1" in capsys.readouterr().out
