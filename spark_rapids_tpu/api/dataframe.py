@@ -151,6 +151,13 @@ def _is_window(e: Expression) -> bool:
     return isinstance(inner, WindowExpression)
 
 
+def _contains_window(e: Expression) -> bool:
+    from spark_rapids_tpu.exec.window import WindowExpression
+    if isinstance(e, WindowExpression):
+        return True
+    return any(_contains_window(c) for c in e.children)
+
+
 class DataFrame:
     def __init__(self, session, plan: L.LogicalPlan):
         self.session = session
@@ -188,25 +195,40 @@ class DataFrame:
         routed = self._route_batch_ids(exprs)
         if routed is not None:
             return routed
-        win = [(i, e) for i, e in enumerate(exprs) if _is_window(e)]
-        if win:
-            # route window expressions through a Window node, then project
+        win_idx = {i for i, e in enumerate(exprs) if _contains_window(e)}
+        if win_idx:
+            # lift every WindowExpression (top-level OR nested inside
+            # arithmetic, e.g. rev * 100 / sum(rev) over (...)) into a
+            # hidden column of one Window node, then project the
+            # rewritten expressions over it
+            from spark_rapids_tpu.exec.window import WindowExpression
             child_names = [n for n, _ in self.plan.schema]
-            wexprs = []
-            names = []
-            for i, e in win:
-                name = e.name if not isinstance(e, Alias) else e.alias
-                inner = e.children[0] if isinstance(e, Alias) else e
-                wexprs.append((name, inner))
-                names.append((i, name))
-            wplan = L.Window(wexprs, self.plan)
+            prefix = "__w"
+            while any(n.startswith(prefix) for n in child_names):
+                prefix += "_"
+            wexprs: List = []
+
+            def extract(e):
+                if isinstance(e, WindowExpression):
+                    h = f"{prefix}{len(wexprs)}"
+                    wexprs.append((h, e))
+                    return UnresolvedColumn(h)
+                if not e.children:
+                    return e
+                return e.with_children([extract(c) for c in e.children])
+
             final: List[Expression] = []
-            by_idx = dict(names)
             for i, e in enumerate(exprs):
-                if i in by_idx:
-                    final.append(UnresolvedColumn(by_idx[i]))
-                else:
+                if i not in win_idx:
                     final.append(e)
+                    continue
+                out_name = e.name if not isinstance(e, Alias) else None
+                r = extract(e)
+                # a bare window (or windowed arithmetic) keeps its
+                # pretty output name; Alias.with_children keeps its own
+                final.append(r if out_name is None else
+                             Alias(r, out_name))
+            wplan = L.Window(wexprs, self.plan)
             return DataFrame(self.session, L.Project(final, wplan))
         return DataFrame(self.session, L.Project(exprs, self.plan))
 

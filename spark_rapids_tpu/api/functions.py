@@ -337,6 +337,10 @@ def atan2(y, x) -> Col:
     return Col(arith.Atan2(_col_or_lit(y), _col_or_lit(x)))
 
 
+def hypot(a, b) -> Col:
+    return Col(arith.Hypot(_col_or_lit(a), _col_or_lit(b)))
+
+
 def bround(c, scale: int = 0) -> Col:
     return Col(arith.BRound(_expr(c), scale))
 
@@ -404,6 +408,30 @@ def max(c) -> Col:  # noqa: A001
 
 def first(c, ignore_nulls: bool = False) -> Col:
     return Col(AggregateExpression(agg.First(_expr(c), ignore_nulls)))
+
+
+def stddev(c) -> Col:
+    """Sample standard deviation (Spark stddev / stddev_samp)."""
+    return _agg(agg.StddevSamp, c)
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Col:
+    return _agg(agg.StddevPop, c)
+
+
+def variance(c) -> Col:
+    """Sample variance (Spark variance / var_samp)."""
+    return _agg(agg.VarianceSamp, c)
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Col:
+    return _agg(agg.VariancePop, c)
 
 
 def collect_list(c) -> Col:
@@ -609,6 +637,11 @@ def second(c) -> Col:
 
 def last_day(c) -> Col:
     return _dt("LastDay", c)
+
+
+def next_day(c, day_of_week: str) -> Col:
+    from spark_rapids_tpu.ops import datetime_ops as D
+    return Col(D.NextDay(_expr(c), day_of_week))
 
 
 def date_add(c, days) -> Col:
@@ -846,6 +879,33 @@ def get_map_value(c, key) -> Col:
 def sort_array(c, asc: bool = True) -> Col:
     from spark_rapids_tpu.ops.collections_ops import SortArray
     return Col(SortArray(_expr(c), asc))
+
+
+def array_min(c) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import ArrayMin
+    return Col(ArrayMin(_expr(c)))
+
+
+def array_max(c) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import ArrayMax
+    return Col(ArrayMax(_expr(c)))
+
+
+def reverse(c) -> Col:
+    """reverse() over arrays (element order) or strings (byte-wise;
+    ASCII-only incompat, like the engine's other byte kernels)."""
+    from spark_rapids_tpu.ops.collections_ops import Reverse
+    return Col(Reverse(_expr(c)))
+
+
+def ascii(c) -> Col:
+    from spark_rapids_tpu.ops.stringops import Ascii
+    return Col(Ascii(_expr(c)))
+
+
+def chr(c) -> Col:  # noqa: A001
+    from spark_rapids_tpu.ops.stringops import Chr
+    return Col(Chr(_expr(c)))
 
 
 def explode(c) -> Col:

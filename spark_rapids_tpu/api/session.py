@@ -66,6 +66,7 @@ class TpuSession:
         self.overrides = TpuOverrides(self.conf, self.cache_manager)
         self.last_dist_explain = ""
         self.last_scan_stats = None  # set by the sharded distributed scan
+        self.last_planning_error = None  # set by suppressPlanningFailure
         self.mesh = mesh
         if self.mesh is None:
             from spark_rapids_tpu.config import rapids_conf as rc
@@ -290,7 +291,17 @@ class TpuSession:
             # failing it (RapidsConf.scala suppressPlanningFailure)
             try:
                 exec_plan = self.overrides.apply(logical)
-            except Exception:
+            except Exception as exc:
+                import warnings
+                # surface the root cause: the CPU chain may itself lack
+                # a branch for some node, and that later error must not
+                # eat the actual planner bug
+                warnings.warn(
+                    f"TPU planning failed ({type(exc).__name__}: {exc}); "
+                    "demoting the whole query to the CPU fallback chain "
+                    "(spark.rapids.sql.suppressPlanningFailure)",
+                    RuntimeWarning, stacklevel=2)
+                self.last_planning_error = exc
                 from spark_rapids_tpu.exec.fallback import CpuFallbackExec
 
                 def whole_cpu(n):

@@ -33,8 +33,10 @@ def _ansi_fail(cast_expr, value):
 
 
 def _isnull(v) -> bool:
-    """Null test for scalar values out of pandas (None or NaN float)."""
-    return v is None or (isinstance(v, float) and pd.isna(v))
+    """Null test for scalar values out of pandas (None, pd.NA, or NaN —
+    python float AND numpy float32/float64 scalars)."""
+    return v is None or v is pd.NA or (
+        isinstance(v, (float, np.floating)) and pd.isna(v))
 
 
 def _align_datetime_operands(l: pd.Series, r: pd.Series):
@@ -362,6 +364,56 @@ def _eval_pandas(expr, df: pd.DataFrame):
         val = _eval_pandas(e.children[1], df)
         return pd.Series([None if _isnull(v) else (x in v)
                           for v, x in zip(arr, val)])
+    if isinstance(e, (C.ArrayMin, C.ArrayMax)):
+        import math
+        child = _eval_pandas(e.children[0], df)
+        want_max = isinstance(e, C.ArrayMax)
+
+        def extreme(v):
+            if _isnull(v) or not len(v):
+                return None
+            vals = list(v)
+            nans = [x for x in vals
+                    if isinstance(x, float) and math.isnan(x)]
+            if nans:
+                # Spark total order: NaN > everything
+                if want_max or len(nans) == len(vals):
+                    return float("nan")
+                vals = [x for x in vals
+                        if not (isinstance(x, float) and math.isnan(x))]
+            import builtins
+            return builtins.max(vals) if want_max else builtins.min(vals)
+        return child.map(extreme)
+    if isinstance(e, C.Reverse):
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if _isnull(v) else (
+            v[::-1] if isinstance(v, str) else list(reversed(v))))
+    from spark_rapids_tpu.ops.arithmetic import Hypot as _Hypot
+    if isinstance(e, _Hypot):
+        l = pd.to_numeric(_eval_pandas(e.children[0], df),
+                          errors="coerce")
+        r = pd.to_numeric(_eval_pandas(e.children[1], df),
+                          errors="coerce")
+        return pd.Series(np.hypot(l, r))
+    if isinstance(e, DT.NextDay):
+        child = _eval_pandas(e.children[0], df)
+
+        def nd(v):
+            if e.target is None:
+                return None
+            ts = pd.Timestamp(v)
+            ahead = (e.target - ts.weekday() + 7) % 7 or 7
+            return (ts + pd.Timedelta(days=ahead)).date()
+        return child.map(lambda v: None if _isnull(v) else nd(v))
+    if isinstance(e, S.Ascii):
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if _isnull(v)
+                         else (ord(v[0]) if v else 0))
+    if isinstance(e, S.Chr):
+        import builtins
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if _isnull(v) else
+                         ("" if int(v) < 0 else builtins.chr(int(v) % 256)))
     raise NotImplementedError(
         f"CPU fallback cannot evaluate {type(e).__name__}")
 
@@ -442,6 +494,12 @@ def _agg_update(func, state, sub: pd.DataFrame):
         st = set() if state is _UNSET else state
         st.update(s)
         return st
+    if k in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        st = (0.0, 0.0, 0) if state is _UNSET else state
+        if len(s):
+            x = s.astype(float)
+            st = (st[0] + x.sum(), st[1] + (x * x).sum(), st[2] + len(x))
+        return st
     raise NotImplementedError(f"CPU fallback aggregate {k}")
 
 
@@ -457,6 +515,16 @@ def _agg_finalize(func, state):
         return [] if state is _UNSET else state
     if k == "collect_set":
         return [] if state is _UNSET else sorted(state)
+    if k in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        if state is _UNSET or state[2] == 0:
+            return None
+        s1, s2, n = state
+        ddof = 1 if k.endswith("samp") else 0
+        if n - ddof <= 0:
+            return float("nan")  # Spark: sample stats of one row
+        m2 = max(s2 - s1 * s1 / n, 0.0)
+        out = m2 / (n - ddof)
+        return out ** 0.5 if k.startswith("stddev") else out
     return None if state is _UNSET else state
 
 

@@ -50,8 +50,9 @@ class _JoinKeyEncoder:
         out = dict_encode_stable(col, self.codes, self._values,
                                  null_code=-1)
         validity = None
-        if col.validity is not None:
-            validity = np.asarray(col.validity[:col.nrows])
+        hv = col.host_validity()
+        if hv is not None:
+            validity = hv[:col.nrows]
         return Column.from_numpy(out, dtype=dts.INT64, validity=validity,
                                  capacity=col.capacity)
 

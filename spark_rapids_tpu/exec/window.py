@@ -285,8 +285,14 @@ class TpuWindowExec(TpuExec):
     # ---- kernel --------------------------------------------------------------
     def _run(self, part_keys: List[ColVal], order_keys: List[ColVal],
              extras: List[ColVal], payload: List[ColVal], nrows):
-        capacity = payload[0].values.shape[0] if payload else \
-            (part_keys + order_keys)[0].values.shape[0]
+        # row capacity — via offsets for string/array ColVals, whose
+        # .values is the CHARS/element buffer (a different bucket)
+        def _cap(c):
+            if c.offsets is not None:
+                return int(c.offsets.shape[0]) - 1
+            return int(c.values.shape[0])
+        capacity = _cap(payload[0]) if payload else \
+            _cap((part_keys + order_keys)[0])
         live = jnp.arange(capacity, dtype=jnp.int32) < nrows
         keys = list(part_keys) + list(order_keys)
         if keys and not self.presorted:
@@ -376,7 +382,9 @@ class TpuWindowExec(TpuExec):
         rows ``[0, cutoff]`` (0 when none): one tiny device->host sync
         per chunk.  With ``order_keys``, boundaries are partition OR
         order-key-run starts (run-aligned split points)."""
-        cap = part_keys[0].values.shape[0]
+        k0 = part_keys[0]
+        cap = (int(k0.offsets.shape[0]) - 1 if k0.offsets is not None
+               else int(k0.values.shape[0]))
         live = jnp.arange(cap, dtype=jnp.int32) < nrows
         b = _boundaries(part_keys, live, cap)
         if order_keys:

@@ -1,0 +1,391 @@
+"""TPC-DS model family: schema subset, seeded data generator, and a
+10-query suite as SQL text.
+
+The reference validates against TPC-DS in its integration suite
+(integration_tests/src/main/python/tpcds_test.py; BASELINE.md's AQE
+north star is TPC-DS-shaped) — this module is the engine-native
+equivalent: the 12 tables and the columns the query subset touches,
+generated with seeded numpy at a scale factor, plus adapted query text
+exercising the TPC-DS-heavy features (multi-way star joins, rollup +
+grouping(), windowed quarterly averages via CTEs, CASE, IN-lists).
+
+Query text is adapted from the public TPC-DS specification queries,
+constrained to this engine's SQL grammar (explicit JOIN ... ON, CTEs
+instead of inline windowed aggregates).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+import pandas as pd
+
+_BASE_DATE = datetime.date(1998, 1, 1)
+_N_DAYS = 6 * 365  # 1998-01-01 .. 2003-12-29
+
+
+def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
+    """Seeded star-schema subset at scale factor ``sf``
+    (sf=0.01 -> ~6k store_sales rows; columns limited to the suite's
+    needs, names and domains per the TPC-DS spec)."""
+    rng = np.random.default_rng(seed)
+    out: Dict[str, pd.DataFrame] = {}
+
+    # ---- date_dim: one row per calendar day -------------------------------
+    days = np.arange(_N_DAYS)
+    dates = np.array([_BASE_DATE + datetime.timedelta(days=int(d))
+                      for d in days])
+    out["date_dim"] = pd.DataFrame({
+        "d_date_sk": 2450815 + days.astype(np.int64),
+        "d_date": pd.to_datetime(dates),
+        "d_year": np.array([d.year for d in dates], dtype=np.int64),
+        "d_moy": np.array([d.month for d in dates], dtype=np.int64),
+        "d_dom": np.array([d.day for d in dates], dtype=np.int64),
+        "d_qoy": np.array([(d.month - 1) // 3 + 1 for d in dates],
+                          dtype=np.int64),
+        "d_month_seq": np.array(
+            [(d.year - 1998) * 12 + d.month - 1 + 1189 for d in dates],
+            dtype=np.int64),
+        "d_day_name": np.array(
+            [d.strftime("%A") for d in dates], dtype=object),
+    })
+
+    # ---- time_dim: one row per minute of day ------------------------------
+    mins = np.arange(24 * 60)
+    out["time_dim"] = pd.DataFrame({
+        "t_time_sk": mins.astype(np.int64),
+        "t_hour": (mins // 60).astype(np.int64),
+        "t_minute": (mins % 60).astype(np.int64),
+    })
+
+    # ---- item -------------------------------------------------------------
+    n_item = max(int(200 * max(sf * 100, 1)), 60)
+    isk = np.arange(1, n_item + 1)
+    brand_id = rng.integers(1, 60, n_item) * 1000 + \
+        rng.integers(1, 10, n_item)
+    cats = np.array(["Books", "Electronics", "Home", "Jewelry", "Men",
+                     "Music", "Shoes", "Sports", "Children", "Women"])
+    cat_id = rng.integers(0, len(cats), n_item)
+    classes = np.array(["accent", "bathroom", "bedding", "blinds",
+                        "curtains", "decor", "fiction", "reference",
+                        "self-help", "romance"])
+    manufact_id = rng.integers(1, 200, n_item)
+    manager_id = rng.integers(1, 40, n_item)
+    # guarantee the suite's literal filters hit at every scale factor
+    manufact_id[0:6] = 128          # q3
+    manager_id[6:12] = 1            # q42 / q52
+    manager_id[12:18] = 8           # q19
+    manager_id[18:24] = 28          # q55
+    out["item"] = pd.DataFrame({
+        "i_item_sk": isk.astype(np.int64),
+        "i_item_id": np.array([f"AAAAAAAA{k:08d}" for k in isk],
+                              dtype=object),
+        "i_brand_id": brand_id.astype(np.int64),
+        "i_brand": np.array([f"brand#{b}" for b in brand_id],
+                            dtype=object),
+        "i_class": classes[rng.integers(0, len(classes), n_item)],
+        "i_category_id": (cat_id + 1).astype(np.int64),
+        "i_category": cats[cat_id],
+        "i_manufact_id": manufact_id.astype(np.int64),
+        "i_manufact": np.array(
+            [f"manufact#{m}" for m in manufact_id], dtype=object),
+        "i_manager_id": manager_id.astype(np.int64),
+        "i_current_price": (rng.integers(100, 9900, n_item) / 100.0),
+    })
+
+    # ---- store ------------------------------------------------------------
+    n_store = 6
+    states = np.array(["TN", "SD", "AL", "GA", "MN", "NC"])
+    out["store"] = pd.DataFrame({
+        "s_store_sk": np.arange(1, n_store + 1, dtype=np.int64),
+        "s_store_name": np.array(["ought", "able", "pri", "ese",
+                                  "anti", "cally"], dtype=object),
+        "s_state": states[:n_store],
+        "s_zip": np.array([f"{z:05d}" for z in
+                           rng.integers(10000, 99999, n_store)],
+                          dtype=object),
+        "s_number_employees": rng.integers(200, 300,
+                                           n_store).astype(np.int64),
+    })
+
+    # ---- customer_address / demographics ----------------------------------
+    n_ca = max(int(300 * max(sf * 100, 1)), 100)
+    out["customer_address"] = pd.DataFrame({
+        "ca_address_sk": np.arange(1, n_ca + 1, dtype=np.int64),
+        "ca_state": states[rng.integers(0, len(states), n_ca)],
+        "ca_zip": np.array([f"{z:05d}" for z in
+                            rng.integers(10000, 99999, n_ca)],
+                           dtype=object),
+        "ca_country": np.array(["United States"] * n_ca, dtype=object),
+    })
+    genders = np.array(["M", "F"])
+    marital = np.array(["S", "M", "D", "W", "U"])
+    edu = np.array(["Primary", "Secondary", "College",
+                    "2 yr Degree", "4 yr Degree", "Advanced Degree",
+                    "Unknown"])
+    n_cd = len(genders) * len(marital) * len(edu)
+    gg, mm, ee = np.meshgrid(np.arange(2), np.arange(5), np.arange(7),
+                             indexing="ij")
+    out["customer_demographics"] = pd.DataFrame({
+        "cd_demo_sk": np.arange(1, n_cd + 1, dtype=np.int64),
+        "cd_gender": genders[gg.ravel()],
+        "cd_marital_status": marital[mm.ravel()],
+        "cd_education_status": edu[ee.ravel()],
+    })
+    n_hd = 50
+    out["household_demographics"] = pd.DataFrame({
+        "hd_demo_sk": np.arange(1, n_hd + 1, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, n_hd).astype(np.int64),
+        "hd_vehicle_count": rng.integers(-1, 5, n_hd).astype(np.int64),
+    })
+
+    # ---- customer ---------------------------------------------------------
+    n_cust = max(int(500 * max(sf * 100, 1)), 200)
+    out["customer"] = pd.DataFrame({
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_current_addr_sk": rng.integers(1, n_ca + 1,
+                                          n_cust).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(1, n_cd + 1,
+                                           n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, n_hd + 1,
+                                           n_cust).astype(np.int64),
+        "c_birth_year": rng.integers(1920, 1995,
+                                     n_cust).astype(np.int64),
+    })
+
+    # ---- promotion --------------------------------------------------------
+    n_promo = 30
+    yn = np.array(["Y", "N"])
+    out["promotion"] = pd.DataFrame({
+        "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
+        "p_channel_email": yn[rng.integers(0, 2, n_promo)],
+        "p_channel_event": yn[rng.integers(0, 2, n_promo)],
+    })
+
+    # ---- store_sales (the fact table) -------------------------------------
+    n_ss = max(int(600000 * sf), 1000)
+    # mild skews so the suite's joint filters (manager x november,
+    # demographic-combo x state x year) keep hits at small scale
+    # factors: 15% of sales land on the pinned-attribute items, 12% in
+    # November, 10% on the (M, S, College) demographics row
+    item_fk = rng.integers(1, n_item + 1, n_ss)
+    pin = rng.random(n_ss) < 0.15
+    item_fk[pin] = rng.integers(1, 25, int(pin.sum()))
+    day_off = rng.integers(0, _N_DAYS, n_ss)
+    nov = rng.random(n_ss) < 0.12
+    nov_days = np.array([i for i in range(_N_DAYS)
+                         if (_BASE_DATE
+                             + datetime.timedelta(days=i)).month == 11])
+    day_off[nov] = rng.choice(nov_days, int(nov.sum()))
+    cdemo_fk = rng.integers(1, n_cd + 1, n_ss)
+    target_cd = out["customer_demographics"]
+    target_sk = int(target_cd[
+        (target_cd.cd_gender == "M")
+        & (target_cd.cd_marital_status == "S")
+        & (target_cd.cd_education_status == "College")
+    ]["cd_demo_sk"].iloc[0])
+    cdemo_fk[rng.random(n_ss) < 0.10] = target_sk
+    qty = rng.integers(1, 101, n_ss)
+    list_price = rng.integers(100, 20000, n_ss) / 100.0
+    pct = rng.integers(0, 101, n_ss) / 100.0
+    sales_price = np.round(list_price * pct, 2)
+    ext = np.round(sales_price * qty, 2)
+    coupon = np.where(rng.random(n_ss) < 0.1,
+                      np.round(ext * rng.random(n_ss) * 0.5, 2), 0.0)
+    wholesale = np.round(list_price * 0.6, 2)
+    out["store_sales"] = pd.DataFrame({
+        "ss_sold_date_sk": (2450815 + day_off).astype(np.int64),
+        "ss_sold_time_sk": rng.integers(0, 24 * 60,
+                                        n_ss).astype(np.int64),
+        "ss_item_sk": item_fk.astype(np.int64),
+        "ss_customer_sk": rng.integers(1, n_cust + 1,
+                                       n_ss).astype(np.int64),
+        "ss_cdemo_sk": cdemo_fk.astype(np.int64),
+        "ss_hdemo_sk": rng.integers(1, n_hd + 1, n_ss).astype(np.int64),
+        "ss_store_sk": rng.integers(1, n_store + 1,
+                                    n_ss).astype(np.int64),
+        "ss_promo_sk": rng.integers(1, n_promo + 1,
+                                    n_ss).astype(np.int64),
+        "ss_quantity": qty.astype(np.int64),
+        "ss_list_price": list_price,
+        "ss_sales_price": sales_price,
+        "ss_ext_sales_price": ext,
+        "ss_coupon_amt": coupon,
+        "ss_wholesale_cost": wholesale,
+        "ss_net_profit": np.round(ext - wholesale * qty - coupon, 2),
+    })
+    return out
+
+
+def load(session, data: Dict[str, pd.DataFrame]):
+    """Create engine DataFrames + temp views for every table."""
+    tables = {}
+    for name, df in data.items():
+        t = session.create_dataframe(df)
+        t.createOrReplaceTempView(name)
+        tables[name] = t
+    return tables
+
+
+# --------------------------------------------------------------- queries --
+
+QUERIES: Dict[str, str] = {}
+
+QUERIES["q3"] = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss.ss_ext_sales_price) sum_agg
+from store_sales ss
+join date_dim dt on dt.d_date_sk = ss.ss_sold_date_sk
+join item on ss.ss_item_sk = item.i_item_sk
+where item.i_manufact_id = 128 and dt.d_moy = 11
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, sum_agg desc, brand_id
+limit 100
+"""
+
+QUERIES["q7"] = """
+select i.i_item_id,
+       avg(ss.ss_quantity) agg1,
+       avg(ss.ss_list_price) agg2,
+       avg(ss.ss_coupon_amt) agg3,
+       avg(ss.ss_sales_price) agg4
+from store_sales ss
+join customer_demographics cd on ss.ss_cdemo_sk = cd.cd_demo_sk
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+join promotion p on ss.ss_promo_sk = p.p_promo_sk
+where cd.cd_gender = 'M' and cd.cd_marital_status = 'S'
+  and cd.cd_education_status = 'College'
+  and (p.p_channel_email = 'N' or p.p_channel_event = 'N')
+  and d.d_year = 2000
+group by i.i_item_id
+order by i.i_item_id
+limit 100
+"""
+
+QUERIES["q19"] = """
+select i.i_brand_id brand_id, i.i_brand brand, i.i_manufact_id,
+       i.i_manufact, sum(ss.ss_ext_sales_price) ext_price
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+join customer c on ss.ss_customer_sk = c.c_customer_sk
+join customer_address ca on c.c_current_addr_sk = ca.ca_address_sk
+join store s on ss.ss_store_sk = s.s_store_sk
+where i.i_manager_id = 8 and d.d_moy = 11 and d.d_year = 1998
+  and substr(ca.ca_zip, 1, 5) <> substr(s.s_zip, 1, 5)
+group by i.i_brand_id, i.i_brand, i.i_manufact_id, i.i_manufact
+order by ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES["q27"] = """
+select i.i_item_id, s.s_state, grouping(s.s_state) g_state,
+       avg(ss.ss_quantity) agg1,
+       avg(ss.ss_list_price) agg2,
+       avg(ss.ss_coupon_amt) agg3,
+       avg(ss.ss_sales_price) agg4
+from store_sales ss
+join customer_demographics cd on ss.ss_cdemo_sk = cd.cd_demo_sk
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+join store s on ss.ss_store_sk = s.s_store_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+where cd.cd_gender = 'M' and cd.cd_marital_status = 'S'
+  and cd.cd_education_status = 'College'
+  and d.d_year = 2002 and s.s_state in ('TN', 'SD', 'AL')
+group by rollup(i.i_item_id, s.s_state)
+order by i.i_item_id, s.s_state
+limit 100
+"""
+
+QUERIES["q42"] = """
+select dt.d_year, item.i_category_id, item.i_category,
+       sum(ss.ss_ext_sales_price) total
+from store_sales ss
+join date_dim dt on dt.d_date_sk = ss.ss_sold_date_sk
+join item on ss.ss_item_sk = item.i_item_sk
+where item.i_manager_id = 1 and dt.d_moy = 11 and dt.d_year = 2000
+group by dt.d_year, item.i_category_id, item.i_category
+order by total desc, dt.d_year, item.i_category_id, item.i_category
+limit 100
+"""
+
+QUERIES["q52"] = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss.ss_ext_sales_price) ext_price
+from store_sales ss
+join date_dim dt on dt.d_date_sk = ss.ss_sold_date_sk
+join item on ss.ss_item_sk = item.i_item_sk
+where item.i_manager_id = 1 and dt.d_moy = 11 and dt.d_year = 2000
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES["q53"] = """
+with quarterly as (
+  select i.i_manufact_id, d.d_qoy,
+         sum(ss.ss_sales_price) sum_sales
+  from item i
+  join store_sales ss on ss.ss_item_sk = i.i_item_sk
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  where d.d_year = 2001
+    and i.i_category in ('Books', 'Home', 'Sports')
+  group by i.i_manufact_id, d.d_qoy
+)
+select * from (
+  select i_manufact_id, sum_sales,
+         avg(sum_sales) over (partition by i_manufact_id)
+           avg_quarterly_sales
+  from quarterly
+) t
+where case when avg_quarterly_sales > 0
+           then abs(sum_sales - avg_quarterly_sales)
+                / avg_quarterly_sales
+           else null end > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id
+limit 100
+"""
+
+QUERIES["q55"] = """
+select i.i_brand_id brand_id, i.i_brand brand,
+       sum(ss.ss_ext_sales_price) ext_price
+from date_dim d
+join store_sales ss on d.d_date_sk = ss.ss_sold_date_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+where i.i_manager_id = 28 and d.d_moy = 11 and d.d_year = 1999
+group by i.i_brand_id, i.i_brand
+order by ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES["q96"] = """
+select count(*) cnt
+from store_sales ss
+join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+join time_dim t on ss.ss_sold_time_sk = t.t_time_sk
+join store s on ss.ss_store_sk = s.s_store_sk
+where t.t_hour = 20 and t.t_minute >= 30
+  and hd.hd_dep_count = 7 and s.s_store_name = 'ese'
+"""
+
+QUERIES["q98"] = """
+with rev as (
+  select i.i_item_id, i.i_category, i.i_class, i.i_current_price,
+         sum(ss.ss_ext_sales_price) itemrevenue
+  from store_sales ss
+  join item i on ss.ss_item_sk = i.i_item_sk
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  where i.i_category in ('Sports', 'Books', 'Home')
+    and d.d_year = 1999 and d.d_moy between 2 and 3
+  group by i.i_item_id, i.i_category, i.i_class, i.i_current_price
+)
+select i_item_id, i_category, i_class, i_current_price, itemrevenue,
+       itemrevenue * 100.0
+         / sum(itemrevenue) over (partition by i_class) revenueratio
+from rev
+order by i_category, i_class, i_item_id, revenueratio
+limit 100
+"""

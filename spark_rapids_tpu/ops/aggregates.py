@@ -309,6 +309,83 @@ class Average(AggregateFunction):
         return ColVal(dts.FLOAT64, s.values / cnt, validity)
 
 
+class _CentralMoment(AggregateFunction):
+    """Base for variance/stddev: buffers are sum(x), sum(x^2), n — all
+    merge-by-sum, so chunked partial merge and the mesh exchange work
+    unchanged.  Spark's CPU path uses Welford updates; the sum-of-squares
+    form fits the engine's single-pass variadic reduce and matches to
+    ~1e-9 relative on double inputs (documented incompat class, like
+    cudf's).  Reference: GpuStddevSamp/GpuVariancePop rules in
+    GpuOverrides.scala (aggregate section)."""
+
+    ddof = 0          # 0 = population, 1 = sample
+    sqrt_result = False
+
+    @property
+    def result_dtype(self):
+        return dts.FLOAT64
+
+    def supported_reason(self):
+        t = self.child.dtype
+        if not (t.is_numeric or t.is_boolean):
+            return (f"{self.name} over {t.name} values has no device "
+                    "implementation")
+        return None
+
+    def buffers(self):
+        return [BufferSpec("sum", dts.FLOAT64),
+                BufferSpec("sum", dts.FLOAT64),
+                BufferSpec("sum", dts.INT64)]
+
+    def update_inputs(self, c, capacity):
+        x = c.values.astype(jnp.float64)
+        ones = (c.validity.astype(jnp.int64) if c.validity is not None
+                else jnp.ones(capacity, dtype=jnp.int64))
+        return [ColVal(dts.FLOAT64, x, c.validity),
+                ColVal(dts.FLOAT64, x * x, c.validity),
+                ColVal(dts.INT64, ones)]
+
+    def finalize(self, bufs):
+        s, s2, n = bufs
+        cnt = n.values.astype(jnp.float64)
+        denom = cnt - self.ddof
+        safe_cnt = jnp.where(cnt == 0, 1.0, cnt)
+        safe_denom = jnp.where(denom <= 0, 1.0, denom)
+        m2 = s2.values - (s.values * s.values) / safe_cnt
+        m2 = jnp.maximum(m2, 0.0)  # clamp catastrophic cancellation
+        out = m2 / safe_denom
+        if self.sqrt_result:
+            out = jnp.sqrt(out)
+        # var_pop defined for n>=1; *_samp needs n>=2 (Spark returns
+        # NaN for n==1 sample variance, null for n==0)
+        nan = jnp.where(jnp.logical_and(self.ddof == 1, cnt == 1),
+                        jnp.float64(jnp.nan), out)
+        validity = combine_validity(s.validity, n.values > 0)
+        return ColVal(dts.FLOAT64, nan, validity)
+
+
+class VariancePop(_CentralMoment):
+    name = "var_pop"
+    ddof = 0
+
+
+class VarianceSamp(_CentralMoment):
+    name = "var_samp"
+    ddof = 1
+
+
+class StddevPop(_CentralMoment):
+    name = "stddev_pop"
+    ddof = 0
+    sqrt_result = True
+
+
+class StddevSamp(_CentralMoment):
+    name = "stddev_samp"
+    ddof = 1
+    sqrt_result = True
+
+
 class First(AggregateFunction):
     name = "first"
 

@@ -316,6 +316,16 @@ class Atan2(BinaryExpression):
         return jnp.arctan2(l, r), None
 
 
+class Hypot(BinaryExpression):
+    """sqrt(l^2 + r^2) without intermediate overflow (Spark HYPOT)."""
+
+    def operand_type(self):
+        return dts.FLOAT64
+
+    def eval_values(self, l, r):
+        return jnp.hypot(l, r), None
+
+
 class _RoundBase(Expression):
     def __init__(self, child: Expression, scale: int = 0):
         self.children = (child,)

@@ -249,3 +249,76 @@ class SortArray(Expression):
             elem_keys = [~v] if not self.ascending else [v]
         perm = jnp.lexsort(elem_keys + [row_key])
         return ColVal(c.dtype, v[perm], c.validity, c.offsets)
+
+
+class _ArrayExtreme(UnaryExpression):
+    """array_min / array_max: one segment reduction over (row, element)
+    — the segmented-reduce form of cudf's list min/max.  Empty arrays
+    yield null; float NaN follows Spark's total order (NaN greater than
+    every number: any NaN wins max, NaN wins min only when the row is
+    all-NaN)."""
+
+    is_max = False
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype.element
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        v = c.values
+        row = element_rows(c, cap)
+        live = jnp.arange(v.shape[0], dtype=jnp.int32) < c.offsets[cap]
+        seg = jnp.where(live, row, jnp.int32(cap))  # dead -> spare seg
+        lens = c.offsets[1:cap + 1] - c.offsets[:cap]
+        is_float = jnp.issubdtype(v.dtype, jnp.floating)
+        data = v
+        if is_float:
+            nan = jnp.isnan(v)
+            data = jnp.where(nan, -jnp.inf if self.is_max else jnp.inf, v)
+            nan_cnt = jax.ops.segment_sum(
+                jnp.where(live, nan.astype(jnp.int32), 0), seg,
+                num_segments=cap + 1)[:cap]
+        reduce = jax.ops.segment_max if self.is_max else \
+            jax.ops.segment_min
+        out = reduce(data, seg, num_segments=cap + 1)[:cap]
+        if is_float:
+            # Spark total order: NaN > everything
+            all_nan = nan_cnt == lens
+            out = jnp.where(all_nan & (lens > 0), jnp.nan, out)
+            if self.is_max:
+                out = jnp.where(nan_cnt > 0, jnp.nan, out)
+        validity = combine_validity(c.validity, lens > 0)
+        return ColVal(self.dtype, out.astype(v.dtype), validity)
+
+
+class ArrayMin(_ArrayExtreme):
+    is_max = False
+
+
+class ArrayMax(_ArrayExtreme):
+    is_max = True
+
+
+class Reverse(UnaryExpression):
+    """reverse() over arrays (element order) and strings (bytes — the
+    ASCII-only incompat class, like the engine's other byte-semantics
+    string kernels)."""
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        v = c.values
+        row = element_rows(c, cap)
+        i = jnp.arange(v.shape[0], dtype=jnp.int32)
+        live = i < c.offsets[cap]
+        start = c.offsets[row]
+        end = c.offsets[row + 1]
+        j = jnp.where(live, start + end - 1 - i, i)
+        j = jnp.clip(j, 0, v.shape[0] - 1)
+        return ColVal(c.dtype, v[j], c.validity, c.offsets)

@@ -115,6 +115,47 @@ class LastDay(UnaryExpression):
         return (first_next - 1).astype(jnp.int32)
 
 
+_DOW_NAMES = {"mo": 0, "tu": 1, "we": 2, "th": 3, "fr": 4, "sa": 5,
+              "su": 6}  # Monday=0 ... Sunday=6 (ISO)
+
+
+class NextDay(UnaryExpression):
+    """First date later than the input that falls on the given weekday
+    (Spark next_day; the day-of-week argument must be a literal — the
+    reference requires a literal too, GpuOverrides dateExpressions)."""
+
+    def __init__(self, child, day_of_week: str):
+        super().__init__(child)
+        self.day_of_week = str(day_of_week)
+        key = self.day_of_week.strip().lower()[:2]
+        self.target = _DOW_NAMES.get(key)  # None = invalid -> all null
+
+    def with_children(self, children):
+        return type(self)(children[0], self.day_of_week)
+
+    def cache_key(self):
+        return (type(self).__name__, self.day_of_week,
+                self.child.cache_key())
+
+    @property
+    def dtype(self):
+        return dts.DATE32
+
+    def emit(self, ctx):
+        c = self.child.emit(ctx)
+        days = _to_days(c)
+        if self.target is None:  # Spark returns null for bad names
+            zeros = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+            return ColVal(dts.DATE32, zeros,
+                          jnp.zeros(ctx.capacity, dtype=jnp.bool_))
+        # 1970-01-01 was a Thursday: ISO dow (Mon=0) = (days + 3) % 7
+        dow = jnp.mod(days + 3, 7)
+        ahead = jnp.mod(self.target - dow + 7, 7)
+        ahead = jnp.where(ahead == 0, 7, ahead)  # strictly later
+        return ColVal(dts.DATE32, (days + ahead).astype(jnp.int32),
+                      c.validity)
+
+
 class Hour(UnaryExpression):
     @property
     def dtype(self):

@@ -38,8 +38,10 @@ def row_byte_matrix(col: Column) -> Tuple[np.ndarray, np.ndarray]:
     Null rows encode as all-zero (callers mask them via validity).
     """
     n = col.nrows
-    offs = np.asarray(col.offsets[: n + 1]).astype(np.int64)
-    chars = np.asarray(col.data)
+    # host_* readers: exact numpy when the column is still host-built,
+    # no device round trip (see Column docstring)
+    offs = col.host_offsets()[: n + 1].astype(np.int64)
+    chars = col.host_values()
     valid = col.validity_numpy()
     lens = (offs[1:] - offs[:-1]) if n else np.zeros(0, dtype=np.int64)
     if not valid.all():
@@ -108,8 +110,8 @@ def _arrow_dictionary(col: Column):
     n = col.nrows
     valid = col.validity_numpy()
     offs = np.ascontiguousarray(
-        np.asarray(col.offsets[: n + 1], dtype=np.int32))
-    chars = np.ascontiguousarray(np.asarray(col.data))
+        col.host_offsets()[: n + 1].astype(np.int32, copy=False))
+    chars = np.ascontiguousarray(col.host_values())
     validity_buf = None
     if not valid.all():
         validity_buf = pa.py_buffer(np.packbits(valid, bitorder="little"))

@@ -1271,3 +1271,66 @@ def _format_date(c: ColVal, ctx: EmitContext) -> ColVal:
                                    _next_pow2(10 * ctx.capacity),
                                    ctx.capacity)
     return ColVal(dts.STRING, chars, c.validity, offsets)
+
+
+class Ascii(UnaryExpression):
+    """Code point of the first character (Spark ascii(); full UTF-8
+    decode of the leading character, 0 for the empty string —
+    stringFunctions.scala GpuAscii role)."""
+
+    @property
+    def dtype(self):
+        return dts.INT32
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        nbytes = int(c.values.shape[0])
+        starts = c.offsets[:cap]
+        lens = c.offsets[1:cap + 1] - starts
+        if nbytes == 0:
+            return ColVal(dts.INT32,
+                          jnp.zeros(cap, dtype=jnp.int32), c.validity)
+
+        def byte(k):
+            return c.values[jnp.clip(starts + k, 0, nbytes - 1)] \
+                .astype(jnp.int32)
+
+        b0 = byte(0)
+        cp = jnp.where(
+            b0 < 0x80, b0,
+            jnp.where(
+                b0 < 0xE0,
+                ((b0 & 0x1F) << 6) | (byte(1) & 0x3F),
+                jnp.where(
+                    b0 < 0xF0,
+                    ((b0 & 0x0F) << 12) | ((byte(1) & 0x3F) << 6)
+                    | (byte(2) & 0x3F),
+                    ((b0 & 0x07) << 18) | ((byte(1) & 0x3F) << 12)
+                    | ((byte(2) & 0x3F) << 6) | (byte(3) & 0x3F))))
+        cp = jnp.where(lens > 0, cp, 0)
+        return ColVal(dts.INT32, cp, c.validity)
+
+
+class Chr(UnaryExpression):
+    """Character for a code point modulo 256 (Spark chr(): negative
+    input yields the empty string; 128-255 encode as 2-byte UTF-8)."""
+
+    @property
+    def dtype(self):
+        return dts.STRING
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        n = c.values.astype(jnp.int64)
+        b = jnp.mod(n, 256).astype(jnp.int32)
+        lens = jnp.where(n < 0, 0, jnp.where(b < 128, 1, 2))
+        lens = jnp.where(ctx.row_mask(), lens, 0).astype(jnp.int32)
+        first = jnp.where(b < 128, b, 0xC0 | (b >> 6)).astype(jnp.uint8)
+        second = (0x80 | (b & 0x3F)).astype(jnp.uint8)
+        pool = jnp.stack([first, second], axis=1).reshape(-1)
+        chars, offsets = build_strings(
+            lens, lambda pos, row, k: row * 2 + k, pool,
+            _next_pow2(2 * cap), cap)
+        return ColVal(dts.STRING, chars, c.validity, offsets)

@@ -802,7 +802,7 @@ class DistPlanner:
                 if dt.is_string:
                     host, enc[i] = ordered_dict_encode(col)
                 else:
-                    host = np.asarray(col.data[:total])
+                    host = col.host_values()[:total]
             vbuf = np.zeros((nshards, cap),
                             dtype=host.dtype if host.size
                             else _phys(dt).storage)
@@ -895,7 +895,7 @@ class DistPlanner:
                         vbuf[at:at + nb] = dict_encode_stable(
                             col, codes, values, null_code=0)
                     else:
-                        vbuf[at:at + nb] = np.asarray(col.data[:nb])
+                        vbuf[at:at + nb] = col.host_values()[:nb]
                     mbuf[at:at + nb] = col.validity_numpy()
                     at += nb
                 dev = devices[s]

@@ -65,6 +65,8 @@ for c in (S.Length, S.OctetLength, S.StartsWith, S.EndsWith, S.Contains,
     expr_rule(c, ts.COMMON)
 for c in (S.Upper, S.Lower, S.InitCap):
     expr_rule(c, ts.COMMON, incompat="ASCII-only case mapping")
+expr_rule(S.Ascii, ts.COMMON)
+expr_rule(S.Chr, ts.COMMON)
 
 # date/time (datetimeExpressions.scala analog)
 from spark_rapids_tpu.ops import datetime_ops as D  # noqa: E402
@@ -73,7 +75,7 @@ for c in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek, D.WeekDay,
           D.DayOfYear, D.LastDay, D.Hour, D.Minute, D.Second, D.DateAdd,
           D.DateSub, D.DateDiff, D.AddMonths, D.MonthsBetween, D.TruncDate,
           D.UnixTimestamp, D.FromUnixTime, D.TimeAdd, D.DateFormatClass,
-          D.TimeWindow):
+          D.TimeWindow, D.NextDay):
     expr_rule(c, ts.COMMON)
 # GetJsonObject / StringSplit (ops/json_ops.py) have NO rule on purpose:
 # they are host-only (CPU fallback + distributed dictionary lowering)
@@ -90,7 +92,8 @@ for c in (arith.Add, arith.Subtract, arith.Multiply, arith.Divide,
           arith.Floor, arith.Ceil, arith.Pow, arith.Logarithm, arith.Atan2,
           arith.Round, arith.BRound, arith.BitwiseAnd, arith.BitwiseOr,
           arith.BitwiseXor, arith.BitwiseNot, arith.ShiftLeft,
-          arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand):
+          arith.ShiftRight, arith.ShiftRightUnsigned, arith.Rand,
+          arith.Hypot):
     expr_rule(c, ts.NUMERIC)
 
 # decimal plumbing (GpuOverrides.scala:824-838 PromotePrecision /
@@ -122,6 +125,10 @@ expr_rule(C.Size, ts.COMMON)
 expr_rule(C.ArrayContains, ts.COMMON)
 expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
+expr_rule(C.ArrayMin, ts.ARRAY)
+expr_rule(C.ArrayMax, ts.ARRAY)
+expr_rule(C.Reverse, ts.COMMON,
+          incompat="string reverse is byte-wise (ASCII-only)")
 
 # nested struct/map (complexTypeCreator/Extractors analog; most of these
 # compile away at bind time — see ops/nested_ops.py)
@@ -280,7 +287,9 @@ class ExprMeta(BaseMeta):
                     f"{name} disabled by "
                     "spark.rapids.sql.regexp.enabled")
         if isinstance(expr, AggregateExpression) and \
-                expr.func.name in ("sum", "avg", "average", "mean") and \
+                expr.func.name in ("sum", "avg", "average", "mean",
+                                   "var_pop", "var_samp", "stddev_pop",
+                                   "stddev_samp") and \
                 expr.func.child is not None:
             try:
                 is_float = expr.func.child.dtype.is_floating

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # ---------------------------------------------------------------------- AST --
 
@@ -178,7 +178,7 @@ KEYWORDS = {
     "cross", "on", "using", "union", "all", "true", "false", "asc",
     "desc", "nulls", "first", "last", "date", "timestamp", "interval",
     "over", "partition", "rows", "unbounded", "preceding", "following",
-    "current", "row",
+    "current", "row", "with",
 }
 
 
@@ -278,9 +278,28 @@ class Parser:
 
     # -- statements --------------------------------------------------------
     def parse(self) -> SelectStmt:
+        ctes: Dict[str, SelectStmt] = {}
+        if self.eat_kw("with"):
+            # non-recursive CTEs, substituted as derived tables at parse
+            # time (each reference gets its own deep copy: the resolver
+            # mutates ASTs in place when lifting aggregates)
+            while True:
+                name = self.ident().lower()
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.select_stmt()
+                self.expect_op(")")
+                if name in ctes:
+                    raise ValueError(f"duplicate CTE name {name!r}")
+                _substitute_ctes(q, ctes)  # earlier CTEs visible here
+                ctes[name] = q
+                if not self.eat_op(","):
+                    break
         stmt = self.select_stmt()
         if self.cur.kind != "eof":
             raise ValueError(f"unexpected trailing input at {self.cur}")
+        if ctes:
+            _substitute_ctes(stmt, ctes)
         return stmt
 
     def select_stmt(self) -> SelectStmt:
@@ -689,6 +708,53 @@ class Parser:
             return -n
         self.expect_kw("following")
         return n
+
+
+def _substitute_ctes(node, ctes: Dict[str, SelectStmt]) -> None:
+    """Replace TableRefs naming a CTE with SubqueryRef copies, walking
+    every nested SelectStmt (joins, derived tables, IN/scalar
+    subqueries, UNION ALL branches)."""
+    import copy
+
+    def sub_table(ref):
+        if isinstance(ref, TableRef) and ref.name.lower() in ctes:
+            return SubqueryRef(copy.deepcopy(ctes[ref.name.lower()]),
+                               ref.alias or ref.name)
+        if isinstance(ref, SubqueryRef):
+            _substitute_ctes(ref.query, ctes)
+        return ref
+
+    def walk_expr(e):
+        if isinstance(e, (InSubquery,)):
+            _substitute_ctes(e.query, ctes)
+        elif isinstance(e, ScalarSubquery):
+            _substitute_ctes(e.query, ctes)
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, list):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        walk_expr(x)
+            elif hasattr(v, "__dataclass_fields__") and \
+                    not isinstance(v, SelectStmt):
+                walk_expr(v)
+
+    stmt = node
+    while stmt is not None:
+        stmt.from_ = sub_table(stmt.from_) if stmt.from_ is not None \
+            else None
+        for j in stmt.joins:
+            j.right = sub_table(j.right)
+            if j.on is not None:
+                walk_expr(j.on)
+        for p in stmt.projections:
+            if hasattr(p.expr, "__dataclass_fields__"):
+                walk_expr(p.expr)
+        if stmt.where is not None:
+            walk_expr(stmt.where)
+        if stmt.having is not None:
+            walk_expr(stmt.having)
+        stmt = stmt.union_all
 
 
 def parse(text: str) -> SelectStmt:

@@ -20,7 +20,8 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.sql import parser as A
 
 AGG_FNS = {"sum", "count", "avg", "mean", "min", "max", "first", "last",
-           "collect_list", "collect_set"}
+           "collect_list", "collect_set", "stddev", "stddev_samp",
+           "stddev_pop", "variance", "var_samp", "var_pop"}
 
 WINDOW_RANK_FNS = {"row_number", "rank", "dense_rank", "percent_rank"}
 
@@ -528,7 +529,10 @@ class Resolver:
               "mean": F.avg, "min": F.min, "max": F.max,
               "first": F.first, "last": F.last,
               "collect_list": F.collect_list,
-              "collect_set": F.collect_set}[node.name]
+              "collect_set": F.collect_set,
+              "stddev": F.stddev, "stddev_samp": F.stddev_samp,
+              "stddev_pop": F.stddev_pop, "variance": F.variance,
+              "var_samp": F.var_samp, "var_pop": F.var_pop}[node.name]
         if node.name == "count" and (not node.args or
                                      isinstance(node.args[0], A.Star)):
             return F.count("*")
@@ -616,6 +620,9 @@ class Resolver:
             "months_between": F.months_between, "pow": F.pow,
             "power": F.pow, "element_at": F.element_at,
             "map_keys": F.map_keys, "map_values": F.map_values,
+            "hypot": F.hypot, "ascii": F.ascii, "char": F.chr,
+            "chr": F.chr, "array_min": F.array_min,
+            "array_max": F.array_max, "reverse": F.reverse,
         }
         if n in simple:
             return simple[n](*args)
@@ -625,6 +632,8 @@ class Resolver:
         if n == "bround":
             return F.bround(args[0], int(lit_arg(1)) if len(args) > 1
                             else 0)
+        if n == "next_day":
+            return F.next_day(args[0], str(lit_arg(1)))
         if n == "shiftleft":
             return F.shiftleft(args[0], int(lit_arg(1)))
         if n == "shiftright":
@@ -771,8 +780,12 @@ class Resolver:
             for cond, val in node.whens[1:]:
                 b = b.when(self._expr(cond, scope),
                            self._expr(val, scope))
-            if node.else_ is not None:
+            if node.else_ is not None and not (
+                    isinstance(node.else_, A.Lit)
+                    and node.else_.value is None):
                 return b.otherwise(self._expr(node.else_, scope))
+            # ELSE NULL == no else: CaseWhen emits a typed null from the
+            # first branch's dtype post-bind
             return b
         if isinstance(node, A.CastExpr):
             return self._expr(node.child, scope).cast(node.type_name)

@@ -194,3 +194,116 @@ def test_fallback_time_window_shift():
         TimeWindow(UnresolvedColumn("t"), 2 * minute, minute,
                    field="start", shift_us=minute), df)
     assert shifted[0] == base[0] - pd.Timedelta(minutes=1)
+
+
+# ---- round-4 expression tail ----------------------------------------------
+
+def test_stddev_variance_family(session):
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({"g": rng.integers(0, 4, 503),
+                       "v": rng.normal(5, 2, 503)})
+    got = session.create_dataframe(df).groupBy("g").agg(
+        F.stddev("v").alias("sd"), F.stddev_pop("v").alias("sp"),
+        F.variance("v").alias("vs"), F.var_pop("v").alias("vp"),
+    ).to_pandas().sort_values("g", ignore_index=True)
+    want = df.groupby("g", as_index=False).agg(
+        sd=("v", "std"), sp=("v", lambda x: x.std(ddof=0)),
+        vs=("v", "var"), vp=("v", lambda x: x.var(ddof=0)),
+    ).sort_values("g", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want, rtol=1e-9)
+    # stays on device
+    q = session.create_dataframe(df).groupBy("g").agg(
+        F.stddev("v").alias("sd"))
+    assert "CpuFallbackExec" not in session.plan(q.plan).tree_string()
+
+
+def test_stddev_sql_and_edge_counts(session):
+    df = session.create_dataframe(pd.DataFrame(
+        {"g": [1, 1, 2, 3], "v": [1.0, 3.0, 5.0, None]}))
+    df.createOrReplaceTempView("sdt")
+    got = session.sql(
+        "select g, stddev(v) as sd, var_pop(v) as vp from sdt "
+        "group by g").to_pandas().sort_values("g", ignore_index=True)
+    # g=1: sd of [1,3] = sqrt(2); g=2: single value -> NaN (Spark);
+    # g=3: all-null -> null
+    assert got.sd[0] == pytest.approx(2 ** 0.5)
+    assert np.isnan(got.sd[1])
+    assert pd.isna(got.sd[2])
+    assert got.vp[0] == pytest.approx(1.0)
+    assert got.vp[1] == pytest.approx(0.0)
+
+
+def test_hypot(session):
+    df = session.create_dataframe(pd.DataFrame(
+        {"x": [3.0, 1e200, None], "y": [4.0, 1e200, 2.0]}))
+    got = df.select(F.hypot("x", "y").alias("h")).to_pandas()
+    assert got.h[0] == pytest.approx(5.0)
+    assert got.h[1] == pytest.approx(1.4142135623730951e200)  # no overflow
+    assert pd.isna(got.h[2])
+
+
+def test_next_day(session):
+    import datetime
+    df = session.create_dataframe(pd.DataFrame(
+        {"d": [datetime.date(2015, 1, 14),    # a Wednesday
+               datetime.date(2015, 7, 27),    # a Monday
+               None]}))
+    got = df.select(F.next_day("d", "TU").alias("n")).to_pandas()
+    assert pd.Timestamp(got.n[0]).date() == datetime.date(2015, 1, 20)
+    assert pd.Timestamp(got.n[1]).date() == datetime.date(2015, 7, 28)
+    assert pd.isna(got.n[2])
+    # same-weekday input advances a full week (strictly later)
+    got2 = df.select(F.next_day("d", "wednesday").alias("n")).to_pandas()
+    assert pd.Timestamp(got2.n[0]).date() == datetime.date(2015, 1, 21)
+    # invalid day name -> null (Spark)
+    got3 = df.select(F.next_day("d", "nope").alias("n")).to_pandas()
+    assert got3.n.isna().all()
+
+
+def test_ascii_chr(session):
+    df = session.create_dataframe(pd.DataFrame(
+        {"s": ["abc", "", "日本", None], "n": [65, 233, -5, 0]}))
+    got = df.select(F.ascii("s").alias("a"),
+                    F.chr("n").alias("c")).to_pandas()
+    assert vals(got.a) == [97, 0, ord("日"), None]
+    assert vals(got.c) == ["A", chr(233), "", "\x00"]
+    # sql names
+    df.createOrReplaceTempView("act")
+    q = session.sql("select ascii(s) as a, char(n) as c from act"
+                    ).to_pandas()
+    assert vals(q.a) == vals(got.a)
+    # device path (no fallback)
+    tree = session.plan(df.select(F.ascii("s"), F.chr("n")).plan
+                        ).tree_string()
+    assert "CpuFallbackExec" not in tree
+
+
+def test_array_min_max_reverse(session):
+    df = session.create_dataframe(pd.DataFrame({
+        "a": [[3, 1, 2], [], [7], None],
+        "s": ["abc", "", None, "xy"]}))
+    got = df.select(F.array_min("a").alias("lo"),
+                    F.array_max("a").alias("hi"),
+                    F.reverse("a").alias("ra"),
+                    F.reverse("s").alias("rs")).to_pandas()
+    assert vals(got.lo) == [1, None, 7, None]
+    assert vals(got.hi) == [3, None, 7, None]
+    arrs = [None if v is None else list(v) for v in got.ra]
+    assert arrs == [[2, 1, 3], [], [7], None]
+    assert vals(got.rs) == ["cba", "", None, "yx"]
+
+
+def test_array_extreme_nan_order(session):
+    # build arrays on device via array() — a pandas NaN inside a list
+    # would arrive as a null ELEMENT, which the engine rejects
+    nan = float("nan")
+    df = session.create_dataframe(pd.DataFrame({
+        "x": [1.0, nan, 0.5], "y": [nan, nan, 3.0], "z": [2.0, nan, 1.0]}))
+    df = df.select(F.array("x", "y", "z").alias("a"))
+    got = df.select(F.array_min("a").alias("lo"),
+                    F.array_max("a").alias("hi")).to_pandas()
+    # Spark total order: NaN greater than every number; rows now are
+    # [1, nan, 2], [nan, nan, nan], [0.5, 3, 1]
+    assert got.lo[0] == 1.0 and np.isnan(got.hi[0])
+    assert np.isnan(got.lo[1]) and np.isnan(got.hi[1])
+    assert got.lo[2] == 0.5 and got.hi[2] == 3.0

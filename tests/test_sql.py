@@ -419,3 +419,61 @@ def test_distinct_with_qualified_order(session):
     got = session.sql(
         "SELECT DISTINCT cust FROM orders o ORDER BY o.cust").to_pandas()
     assert got["cust"].tolist() == sorted(got["cust"].tolist())
+
+
+def test_cte_basic_and_chained(session):
+    orders = session._test_orders
+    got = session.sql("""
+        WITH by_cust AS (
+            SELECT cust, sum(amount) AS total FROM orders GROUP BY cust
+        ),
+        big AS (SELECT cust, total FROM by_cust WHERE total > 2000)
+        SELECT b.cust, b.total FROM big b ORDER BY b.cust
+    """).to_pandas()
+    want = orders.groupby("cust", as_index=False).agg(
+        total=("amount", "sum"))
+    want = want[want.total > 2000].sort_values(
+        "cust", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                  rtol=1e-9)
+
+
+def test_cte_referenced_twice(session):
+    got = session.sql("""
+        WITH t AS (SELECT cust, sum(amount) AS s FROM orders
+                   GROUP BY cust)
+        SELECT a.cust, a.s, b.s AS s2 FROM t a JOIN t b
+          ON a.cust = b.cust ORDER BY a.cust
+    """).to_pandas()
+    assert (got.s == got.s2).all()
+    assert len(got) == 12
+
+
+def test_cte_in_subquery_predicate(session):
+    got = session.sql("""
+        WITH rich AS (SELECT cust FROM orders GROUP BY cust
+                      HAVING sum(amount) > 2500)
+        SELECT count(*) AS n FROM orders WHERE cust IN (SELECT cust
+                                                        FROM rich)
+    """).to_pandas()
+    orders = session._test_orders
+    by = orders.groupby("cust").amount.sum()
+    rich = set(by[by > 2500].index)
+    assert int(got.n[0]) == int(orders.cust.isin(rich).sum())
+
+
+def test_window_nested_in_arithmetic(session):
+    """A window function inside arithmetic lifts into a hidden Window
+    column (the TPC-DS q98 revenueratio shape)."""
+    got = session.sql("""
+        SELECT cust, amount * 100.0 / sum(amount) OVER
+               (PARTITION BY cust) AS pct
+        FROM orders
+    """).to_pandas()
+    orders = session._test_orders
+    want = (orders.amount * 100.0
+            / orders.groupby("cust").amount.transform("sum"))
+    assert got.pct.sum() == pytest.approx(want.sum())
+    # per-cust percentages total 100
+    tot = got.groupby("cust").pct.sum()
+    assert np.allclose(tot, 100.0)
