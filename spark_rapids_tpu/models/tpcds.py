@@ -1,13 +1,15 @@
-"""TPC-DS model family: schema subset, seeded data generator, and a
-10-query suite as SQL text.
+"""TPC-DS model family: schema subset, seeded data generator, and an
+18-query suite as SQL text.
 
 The reference validates against TPC-DS in its integration suite
 (integration_tests/src/main/python/tpcds_test.py; BASELINE.md's AQE
 north star is TPC-DS-shaped) — this module is the engine-native
 equivalent: the 12 tables and the columns the query subset touches,
-generated with seeded numpy at a scale factor, plus adapted query text
-exercising the TPC-DS-heavy features (multi-way star joins, rollup +
-grouping(), windowed quarterly averages via CTEs, CASE, IN-lists).
+generated with seeded numpy at a scale factor (store_sales rows
+cluster into per-ticket trips), plus adapted query text exercising the
+TPC-DS-heavy features (multi-way star joins, rollup + grouping(),
+windowed monthly/quarterly averages via CTEs, per-ticket trip counts,
+scalar-subquery promo ratios, CASE, IN-lists).
 
 Query text is adapted from the public TPC-DS specification queries,
 constrained to this engine's SQL grammar (explicit JOIN ... ON, CTEs
@@ -50,6 +52,8 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
             dtype=np.int64),
         "d_day_name": np.array(
             [d.strftime("%A") for d in dates], dtype=object),
+        "d_dow": np.array([(d.weekday() + 1) % 7 for d in dates],
+                          dtype=np.int64),  # 0 = Sunday (TPC-DS)
     })
 
     # ---- time_dim: one row per minute of day ------------------------------
@@ -93,6 +97,8 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
             [f"manufact#{m}" for m in manufact_id], dtype=object),
         "i_manager_id": manager_id.astype(np.int64),
         "i_current_price": (rng.integers(100, 9900, n_item) / 100.0),
+        "i_item_desc": np.array(
+            [f"desc of item {k}" for k in isk], dtype=object),
     })
 
     # ---- store ------------------------------------------------------------
@@ -103,6 +109,8 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "s_store_name": np.array(["ought", "able", "pri", "ese",
                                   "anti", "cally"], dtype=object),
         "s_state": states[:n_store],
+        "s_city": np.array(["Midway", "Fairview", "Midway", "Oakland",
+                            "Fairview", "Glendale"], dtype=object),
         "s_zip": np.array([f"{z:05d}" for z in
                            rng.integers(10000, 99999, n_store)],
                           dtype=object),
@@ -135,16 +143,26 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "cd_education_status": edu[ee.ravel()],
     })
     n_hd = 50
+    buy_pot = np.array(["0-500", "501-1000", "1001-5000", ">10000",
+                        "Unknown"])
     out["household_demographics"] = pd.DataFrame({
         "hd_demo_sk": np.arange(1, n_hd + 1, dtype=np.int64),
         "hd_dep_count": rng.integers(0, 10, n_hd).astype(np.int64),
         "hd_vehicle_count": rng.integers(-1, 5, n_hd).astype(np.int64),
+        "hd_buy_potential": buy_pot[rng.integers(0, len(buy_pot),
+                                                 n_hd)],
     })
 
     # ---- customer ---------------------------------------------------------
     n_cust = max(int(500 * max(sf * 100, 1)), 200)
     out["customer"] = pd.DataFrame({
         "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_first_name": np.array(
+            [f"First{i % 97}" for i in range(n_cust)], dtype=object),
+        "c_last_name": np.array(
+            [f"Last{i % 89}" for i in range(n_cust)], dtype=object),
+        "c_salutation": np.array(["Mr.", "Ms.", "Dr.", "Mrs.", "Sir"]
+                                 )[rng.integers(0, 5, n_cust)],
         "c_current_addr_sk": rng.integers(1, n_ca + 1,
                                           n_cust).astype(np.int64),
         "c_current_cdemo_sk": rng.integers(1, n_cd + 1,
@@ -166,27 +184,39 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
 
     # ---- store_sales (the fact table) -------------------------------------
     n_ss = max(int(600000 * sf), 1000)
+    # rows cluster into TRIPS (one ticket number per trip, the TPC-DS
+    # ss_ticket_number grain): items of a trip share the customer,
+    # date, time, store, and demographics — q34/q73/q79 group by ticket
+    n_trip = max(n_ss // 3, 1)
+    trip_day = rng.integers(0, _N_DAYS, n_trip)
     # mild skews so the suite's joint filters (manager x november,
     # demographic-combo x state x year) keep hits at small scale
-    # factors: 15% of sales land on the pinned-attribute items, 12% in
-    # November, 10% on the (M, S, College) demographics row
-    item_fk = rng.integers(1, n_item + 1, n_ss)
-    pin = rng.random(n_ss) < 0.15
-    item_fk[pin] = rng.integers(1, 25, int(pin.sum()))
-    day_off = rng.integers(0, _N_DAYS, n_ss)
-    nov = rng.random(n_ss) < 0.12
+    # factors: 12% of trips in November, 10% on the (M, S, College)
+    # demographics row, 15% of items pinned-attribute
     nov_days = np.array([i for i in range(_N_DAYS)
                          if (_BASE_DATE
                              + datetime.timedelta(days=i)).month == 11])
-    day_off[nov] = rng.choice(nov_days, int(nov.sum()))
-    cdemo_fk = rng.integers(1, n_cd + 1, n_ss)
+    nov = rng.random(n_trip) < 0.12
+    trip_day[nov] = rng.choice(nov_days, int(nov.sum()))
+    trip_cust = rng.integers(1, n_cust + 1, n_trip)
+    trip_store = rng.integers(1, n_store + 1, n_trip)
+    trip_hd = rng.integers(1, n_hd + 1, n_trip)
+    trip_time = rng.integers(0, 24 * 60, n_trip)
+    trip_cd = rng.integers(1, n_cd + 1, n_trip)
     target_cd = out["customer_demographics"]
     target_sk = int(target_cd[
         (target_cd.cd_gender == "M")
         & (target_cd.cd_marital_status == "S")
         & (target_cd.cd_education_status == "College")
     ]["cd_demo_sk"].iloc[0])
-    cdemo_fk[rng.random(n_ss) < 0.10] = target_sk
+    trip_cd[rng.random(n_trip) < 0.10] = target_sk
+
+    trip_of = rng.integers(0, n_trip, n_ss)
+    day_off = trip_day[trip_of]
+    cdemo_fk = trip_cd[trip_of]
+    item_fk = rng.integers(1, n_item + 1, n_ss)
+    pin = rng.random(n_ss) < 0.15
+    item_fk[pin] = rng.integers(1, 25, int(pin.sum()))
     qty = rng.integers(1, 101, n_ss)
     list_price = rng.integers(100, 20000, n_ss) / 100.0
     pct = rng.integers(0, 101, n_ss) / 100.0
@@ -197,15 +227,13 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
     wholesale = np.round(list_price * 0.6, 2)
     out["store_sales"] = pd.DataFrame({
         "ss_sold_date_sk": (2450815 + day_off).astype(np.int64),
-        "ss_sold_time_sk": rng.integers(0, 24 * 60,
-                                        n_ss).astype(np.int64),
+        "ss_sold_time_sk": trip_time[trip_of].astype(np.int64),
+        "ss_ticket_number": (trip_of + 1).astype(np.int64),
         "ss_item_sk": item_fk.astype(np.int64),
-        "ss_customer_sk": rng.integers(1, n_cust + 1,
-                                       n_ss).astype(np.int64),
+        "ss_customer_sk": trip_cust[trip_of].astype(np.int64),
         "ss_cdemo_sk": cdemo_fk.astype(np.int64),
-        "ss_hdemo_sk": rng.integers(1, n_hd + 1, n_ss).astype(np.int64),
-        "ss_store_sk": rng.integers(1, n_store + 1,
-                                    n_ss).astype(np.int64),
+        "ss_hdemo_sk": trip_hd[trip_of].astype(np.int64),
+        "ss_store_sk": trip_store[trip_of].astype(np.int64),
         "ss_promo_sk": rng.integers(1, n_promo + 1,
                                     n_ss).astype(np.int64),
         "ss_quantity": qty.astype(np.int64),
@@ -387,5 +415,183 @@ select i_item_id, i_category, i_class, i_current_price, itemrevenue,
          / sum(itemrevenue) over (partition by i_class) revenueratio
 from rev
 order by i_category, i_class, i_item_id, revenueratio
+limit 100
+"""
+
+QUERIES["q34"] = """
+with dn as (
+  select ss.ss_ticket_number, ss.ss_customer_sk, count(*) cnt
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  where (d.d_dom between 1 and 3 or d.d_dom between 25 and 28)
+    and hd.hd_buy_potential = '>10000'
+    and hd.hd_vehicle_count > 0
+    and s.s_state in ('TN', 'SD', 'AL')
+  group by ss.ss_ticket_number, ss.ss_customer_sk
+)
+select c.c_last_name, c.c_first_name, c.c_salutation,
+       dn.ss_ticket_number, dn.cnt
+from dn
+join customer c on dn.ss_customer_sk = c.c_customer_sk
+where dn.cnt between 2 and 6
+order by c.c_last_name, c.c_first_name, dn.ss_ticket_number
+limit 100
+"""
+
+QUERIES["q36"] = """
+select sum(ss.ss_net_profit) / sum(ss.ss_ext_sales_price) gross_margin,
+       i.i_category, i.i_class,
+       grouping(i.i_category) + grouping(i.i_class) lochierarchy
+from store_sales ss
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+join store s on ss.ss_store_sk = s.s_store_sk
+where d.d_year = 2001 and s.s_state in ('TN', 'SD', 'AL', 'GA')
+group by rollup(i.i_category, i.i_class)
+order by lochierarchy desc, i.i_category, i.i_class
+limit 100
+"""
+
+QUERIES["q48"] = """
+select sum(ss.ss_quantity) q
+from store_sales ss
+join store s on ss.ss_store_sk = s.s_store_sk
+join customer_demographics cd on ss.ss_cdemo_sk = cd.cd_demo_sk
+join customer c on ss.ss_customer_sk = c.c_customer_sk
+join customer_address ca on c.c_current_addr_sk = ca.ca_address_sk
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+where d.d_year = 2000
+  and ((cd.cd_marital_status = 'M'
+        and cd.cd_education_status = '4 yr Degree'
+        and ss.ss_sales_price between 100.00 and 150.00)
+    or (cd.cd_marital_status = 'D'
+        and cd.cd_education_status = '2 yr Degree'
+        and ss.ss_sales_price between 50.00 and 100.00)
+    or (cd.cd_marital_status = 'S'
+        and cd.cd_education_status = 'College'
+        and ss.ss_sales_price between 150.00 and 200.00))
+  and ((ca.ca_state in ('TN', 'SD', 'GA')
+        and ss.ss_net_profit between 0 and 2000)
+    or (ca.ca_state in ('AL', 'MN', 'NC')
+        and ss.ss_net_profit between 150 and 3000))
+"""
+
+QUERIES["q61"] = """
+select (select sum(ss.ss_ext_sales_price)
+        from store_sales ss
+        join promotion p on ss.ss_promo_sk = p.p_promo_sk
+        join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+        where (p.p_channel_email = 'Y' or p.p_channel_event = 'Y')
+          and d.d_year = 1998 and d.d_moy = 11) promotions,
+       (select sum(ss.ss_ext_sales_price)
+        from store_sales ss
+        join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+        where d.d_year = 1998 and d.d_moy = 11) total,
+       (select sum(ss.ss_ext_sales_price)
+        from store_sales ss
+        join promotion p on ss.ss_promo_sk = p.p_promo_sk
+        join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+        where (p.p_channel_email = 'Y' or p.p_channel_event = 'Y')
+          and d.d_year = 1998 and d.d_moy = 11) * 100.0 /
+       (select sum(ss.ss_ext_sales_price)
+        from store_sales ss
+        join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+        where d.d_year = 1998 and d.d_moy = 11) ratio
+"""
+
+QUERIES["q65"] = """
+with sa as (
+  select ss.ss_store_sk, ss.ss_item_sk,
+         sum(ss.ss_sales_price) revenue
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  where d.d_month_seq between 1200 and 1211
+  group by ss.ss_store_sk, ss.ss_item_sk
+),
+sb as (
+  select ss_store_sk, avg(revenue) ave from sa group by ss_store_sk
+)
+select s.s_store_name, i.i_item_desc, sa.revenue, i.i_current_price,
+       i.i_brand
+from sa
+join sb on sa.ss_store_sk = sb.ss_store_sk
+join store s on sa.ss_store_sk = s.s_store_sk
+join item i on sa.ss_item_sk = i.i_item_sk
+where sa.revenue <= 0.1 * sb.ave
+order by s.s_store_name, i.i_item_desc
+limit 100
+"""
+
+QUERIES["q73"] = """
+with dn as (
+  select ss.ss_ticket_number, ss.ss_customer_sk, count(*) cnt
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  where d.d_dom between 1 and 2
+    and (hd.hd_buy_potential = '>10000'
+         or hd.hd_buy_potential = 'Unknown')
+    and hd.hd_vehicle_count > 0
+    and s.s_city in ('Midway', 'Fairview')
+  group by ss.ss_ticket_number, ss.ss_customer_sk
+)
+select c.c_last_name, c.c_first_name, c.c_salutation,
+       dn.ss_ticket_number, dn.cnt
+from dn
+join customer c on dn.ss_customer_sk = c.c_customer_sk
+where dn.cnt between 1 and 5
+order by dn.cnt desc, c.c_last_name
+limit 100
+"""
+
+QUERIES["q79"] = """
+with pt as (
+  select ss.ss_ticket_number, ss.ss_customer_sk, s.s_city,
+         sum(ss.ss_coupon_amt) amt, sum(ss.ss_net_profit) profit
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  where (hd.hd_dep_count = 7 or hd.hd_vehicle_count > 1)
+    and d.d_dow = 1
+    and d.d_year in (1998, 1999, 2000)
+    and s.s_number_employees between 200 and 295
+  group by ss.ss_ticket_number, ss.ss_customer_sk, s.s_city
+)
+select c.c_last_name, c.c_first_name,
+       substr(pt.s_city, 1, 30) city, pt.ss_ticket_number, pt.amt,
+       pt.profit
+from pt
+join customer c on pt.ss_customer_sk = c.c_customer_sk
+order by c.c_last_name, c.c_first_name, city, pt.profit
+limit 100
+"""
+
+QUERIES["q89"] = """
+with msales as (
+  select i.i_category, i.i_class, i.i_brand, s.s_store_name, d.d_moy,
+         sum(ss.ss_sales_price) sum_sales
+  from item i
+  join store_sales ss on ss.ss_item_sk = i.i_item_sk
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where d.d_year = 1999
+    and i.i_category in ('Books', 'Electronics', 'Sports',
+                         'Men', 'Jewelry', 'Women')
+  group by i.i_category, i.i_class, i.i_brand, s.s_store_name, d.d_moy
+)
+select * from (
+  select i_category, i_class, i_brand, s_store_name, d_moy, sum_sales,
+         avg(sum_sales) over (partition by i_category, i_brand,
+                              s_store_name) avg_monthly_sales
+  from msales
+) t
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name, d_moy
 limit 100
 """

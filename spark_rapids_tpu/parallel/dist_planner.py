@@ -1269,12 +1269,17 @@ class DistPlanner:
             flat, n_out, total = join(
                 probe_m.cols, probe_m.nrows, build_m.cols,
                 build_m.nrows)
-            if bool(np.all(np.asarray(total) <= np.asarray(n_out))):
+            # process_count-aware fetch: the retry decision must be
+            # identical on every controller (host_sync allgathers under
+            # multi-process SPMD)
+            from spark_rapids_tpu.parallel.distributed import host_sync
+            h_total, h_nout = host_sync((total, n_out))
+            if bool(np.all(h_total <= h_nout)):
                 break
             # size the retry from the observed truncation; out_cap is
             # relative to the (possibly tiny) probe capacity, so the
             # factor itself may legitimately grow large
-            need = int(np.asarray(total).max())
+            need = int(h_total.max())
             next_factor = out_factor * 2
             while next_factor * probe_cap < need:
                 next_factor *= 2  # power-of-two: bounded compile cache
@@ -1300,7 +1305,8 @@ class DistPlanner:
             raise NotDistributable(
                 "join output exceeds the distributed output cap even "
                 "with 64-way chunked emission")
-        counts = np.asarray(probe_m.nrows).reshape(-1)
+        from spark_rapids_tpu.parallel.distributed import host_sync
+        counts = host_sync(probe_m.nrows).reshape(-1)
         chunks = []
         for i in range(2):
             los = (counts * i) // 2

@@ -30,6 +30,23 @@ from spark_rapids_tpu.parallel.partitioning import hash_partition_ids
 from spark_rapids_tpu.parallel.shuffle import exchange
 
 
+def host_sync(x):
+    """Host copy of sharded stats array(s) for the phase boundary.
+
+    Single-process: a plain device fetch.  Multi-process SPMD (one
+    controller per host, the multi-host pod layout): every process
+    holds only its addressable shards, so the stats all-gather across
+    processes — each controller then makes the IDENTICAL slot/LUT
+    decision, which the SPMD contract requires.  Accepts a pytree so
+    co-located stats pay ONE cross-host collective."""
+    import numpy as np
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return jax.tree_util.tree_map(
+            np.asarray, multihost_utils.process_allgather(x, tiled=True))
+    return jax.tree_util.tree_map(np.asarray, x)
+
+
 class DistributedAggregate:
     """filter? -> partial group-by -> all-to-all by key hash -> final agg.
 
@@ -226,7 +243,7 @@ class DistributedAggregate:
         partial_flat, n_groups, hist = self._jitted_local(
             flat_cols, nrows_per_shard)
         from spark_rapids_tpu.parallel.shuffle import pick_slot
-        counts = np.asarray(hist).reshape(self.nshards, self.buckets)
+        counts = host_sync(hist).reshape(self.nshards, self.buckets)
         capacity = int(partial_flat[0][0].shape[0]) // self.nshards
         lut, dst_counts = coalesce_buckets(counts, self.nshards)
         slot = pick_slot(int(dst_counts.max()), capacity)
@@ -589,7 +606,7 @@ class DistributedHashJoin:
         """
         import numpy as np
         strategy = self.strategy
-        total_build = int(np.asarray(build_nrows_per_shard).sum())
+        total_build = int(host_sync(build_nrows_per_shard).sum())
         if strategy == "auto":
             strategy = "broadcast" \
                 if total_build <= self.broadcast_threshold_rows else \
@@ -605,8 +622,9 @@ class DistributedHashJoin:
             phist, bhist = self._stats_jitted()(
                 probe_flat, probe_nrows_per_shard,
                 build_flat, build_nrows_per_shard)
-            pcounts = np.asarray(phist).reshape(self.nshards, self.nshards)
-            bcounts = np.asarray(bhist).reshape(self.nshards, self.nshards)
+            pcounts, bcounts = host_sync((phist, bhist))
+            pcounts = pcounts.reshape(self.nshards, self.nshards)
+            bcounts = bcounts.reshape(self.nshards, self.nshards)
             from spark_rapids_tpu.parallel.shuffle import pick_slot
             cap_p = int(probe_flat[0][0].shape[0]) // self.nshards
             cap_b = int(build_flat[0][0].shape[0]) // self.nshards

@@ -1,0 +1,85 @@
+"""Worker process for the multi-host distributed-aggregate test.
+
+Run as:  python multihost_worker.py <process_id> <num_processes> <port>
+
+Each process contributes its local CPU devices to a GLOBAL mesh (the
+jax.distributed multi-controller layout real TPU pods use), builds its
+local shard data, and runs the engine's DistributedAggregate SPMD —
+the all-to-all exchange crosses the process boundary (Gloo collectives
+here; ICI/DCN on a pod).  Emits per-group results from the process's
+addressable shards for the parent to merge and oracle-check.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.setdefault("JAX_CPU_COLLECTIVES", "gloo")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.ops import aggregates as agg
+    from spark_rapids_tpu.ops.expressions import BoundReference
+    from spark_rapids_tpu.parallel.distributed import DistributedAggregate
+
+    devs = jax.devices()
+    nshards = len(devs)
+    local_shards = jax.local_device_count()
+    assert nshards == nproc * local_shards
+    mesh = Mesh(np.array(devs), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    cap = 128
+    # deterministic per-process data (the parent recomputes the oracle
+    # from the same seeds)
+    rng = np.random.default_rng(100 + pid)
+    keys_local = rng.integers(0, 11, local_shards * cap).astype(np.int64)
+    vals_local = rng.normal(10, 3, local_shards * cap)
+    nrows_local = np.full(local_shards, cap, dtype=np.int32)
+
+    def glob(a):
+        return jax.make_array_from_process_local_data(sharding, a)
+
+    flat_cols = [(glob(keys_local), None, None),
+                 (glob(vals_local), None, None)]
+    key = BoundReference(0, dts.INT64, name="k")
+    val = BoundReference(1, dts.FLOAT64, name="v")
+    dist = DistributedAggregate(
+        mesh, in_dtypes=[dts.INT64, dts.FLOAT64], group_exprs=[key],
+        funcs=[agg.Sum(val), agg.Count(val), agg.Min(val)])
+    outs = dist(flat_cols, glob(nrows_local))
+
+    # outs = [keys..., results...] as (values, validity, ngroups); pull
+    # the process's addressable shards only
+    def local_parts(x):
+        return [np.asarray(s.data) for s in x.addressable_shards]
+
+    key_shards = local_parts(outs[0][0])
+    sum_shards = local_parts(outs[1][0])
+    cnt_shards = local_parts(outs[2][0])
+    min_shards = local_parts(outs[3][0])
+    ng_shards = local_parts(outs[0][2])
+    rows = []
+    for ks, ss, cs, ms, ng in zip(key_shards, sum_shards, cnt_shards,
+                                  min_shards, ng_shards):
+        n = int(ng[0])
+        for i in range(n):
+            rows.append([int(ks[i]), float(ss[i]), int(cs[i]),
+                         float(ms[i])])
+    print("RESULT " + json.dumps(rows), flush=True)
+    print(f"p{pid}: OK ({len(rows)} groups on "
+          f"{local_shards} local shards)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -257,3 +257,181 @@ def test_distributed_sweep(data):
         cmp(got, want)
         assert dist.last_dist_explain == "distributed", \
             (q, dist.last_dist_explain)
+
+
+# ---- round-4 batch 3: CTE-era queries -------------------------------------
+
+def _trips(data):
+    return _star(data, item=False, store=True, hd=True)
+
+
+def test_q34(session, data):
+    m = _trips(data)
+    m = m[((m.d_dom.between(1, 3)) | (m.d_dom.between(25, 28)))
+          & (m.hd_buy_potential == ">10000") & (m.hd_vehicle_count > 0)
+          & (m.s_state.isin(["TN", "SD", "AL"]))]
+    dn = m.groupby(["ss_ticket_number", "ss_customer_sk"],
+                   as_index=False).size().rename(columns={"size": "cnt"})
+    dn = dn[dn.cnt.between(2, 6)]
+    want = dn.merge(data["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")[
+        ["c_last_name", "c_first_name", "c_salutation",
+         "ss_ticket_number", "cnt"]]
+    want = want.sort_values(
+        ["c_last_name", "c_first_name", "ss_ticket_number"],
+        ignore_index=True).head(100)
+    got = run_q(session, "q34")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q36(session, data):
+    m = _star(data, store=True)
+    m = m[(m.d_year == 2001)
+          & (m.s_state.isin(["TN", "SD", "AL", "GA"]))]
+
+    def level(keys, loch):
+        g = m.groupby(keys, as_index=False).agg(
+            np_=("ss_net_profit", "sum"),
+            sp=("ss_ext_sales_price", "sum"))
+        g["gross_margin"] = g.np_ / g.sp
+        for c in ("i_category", "i_class"):
+            if c not in keys:
+                g[c] = None
+        g["lochierarchy"] = loch
+        return g[["gross_margin", "i_category", "i_class",
+                  "lochierarchy"]]
+
+    total = pd.DataFrame([{
+        "gross_margin": m.ss_net_profit.sum() / m.ss_ext_sales_price.sum(),
+        "i_category": None, "i_class": None, "lochierarchy": 2}])
+    want = pd.concat([level(["i_category", "i_class"], 0),
+                      level(["i_category"], 1), total],
+                     ignore_index=True)
+    want = want.sort_values(
+        ["lochierarchy", "i_category", "i_class"],
+        ascending=[False, True, True], na_position="first",
+        ignore_index=True).head(100)
+    got = run_q(session, "q36")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q48(session, data):
+    m = _star(data, cd=True, store=True, cust=True, ca=True)
+    m = m[(m.d_year == 2000)
+          & (((m.cd_marital_status == "M")
+              & (m.cd_education_status == "4 yr Degree")
+              & m.ss_sales_price.between(100.0, 150.0))
+             | ((m.cd_marital_status == "D")
+                & (m.cd_education_status == "2 yr Degree")
+                & m.ss_sales_price.between(50.0, 100.0))
+             | ((m.cd_marital_status == "S")
+                & (m.cd_education_status == "College")
+                & m.ss_sales_price.between(150.0, 200.0)))
+          & ((m.ca_state.isin(["TN", "SD", "GA"])
+              & m.ss_net_profit.between(0, 2000))
+             | (m.ca_state.isin(["AL", "MN", "NC"])
+                & m.ss_net_profit.between(150, 3000)))]
+    got = run_q(session, "q48")
+    assert int(got["q"].iloc[0]) == int(m.ss_quantity.sum())
+
+
+def test_q61(session, data):
+    m = _star(data, item=False, promo=True)
+    nov98 = m[(m.d_year == 1998) & (m.d_moy == 11)]
+    promo = nov98[(nov98.p_channel_email == "Y")
+                  | (nov98.p_channel_event == "Y")]
+    got = run_q(session, "q61")
+    assert got["promotions"].iloc[0] == pytest.approx(
+        promo.ss_ext_sales_price.sum(), rel=1e-9)
+    assert got["total"].iloc[0] == pytest.approx(
+        nov98.ss_ext_sales_price.sum(), rel=1e-9)
+    assert got["ratio"].iloc[0] == pytest.approx(
+        promo.ss_ext_sales_price.sum() * 100.0
+        / nov98.ss_ext_sales_price.sum(), rel=1e-9)
+
+
+def test_q65(session, data):
+    m = _star(data, item=False)
+    m = m[m.d_month_seq.between(1200, 1211)]
+    sa = m.groupby(["ss_store_sk", "ss_item_sk"], as_index=False).agg(
+        revenue=("ss_sales_price", "sum"))
+    sa["ave"] = sa.groupby("ss_store_sk").revenue.transform("mean")
+    low = sa[sa.revenue <= 0.1 * sa.ave]
+    want = low.merge(data["store"], left_on="ss_store_sk",
+                     right_on="s_store_sk").merge(
+        data["item"], left_on="ss_item_sk", right_on="i_item_sk")[
+        ["s_store_name", "i_item_desc", "revenue", "i_current_price",
+         "i_brand"]]
+    want = want.sort_values(["s_store_name", "i_item_desc"],
+                            ignore_index=True).head(100)
+    got = run_q(session, "q65")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q73(session, data):
+    m = _trips(data)
+    m = m[(m.d_dom.between(1, 2))
+          & (m.hd_buy_potential.isin([">10000", "Unknown"]))
+          & (m.hd_vehicle_count > 0)
+          & (m.s_city.isin(["Midway", "Fairview"]))]
+    dn = m.groupby(["ss_ticket_number", "ss_customer_sk"],
+                   as_index=False).size().rename(columns={"size": "cnt"})
+    dn = dn[dn.cnt.between(1, 5)]
+    want = dn.merge(data["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")[
+        ["c_last_name", "c_first_name", "c_salutation",
+         "ss_ticket_number", "cnt"]]
+    got = run_q(session, "q73")
+    # under the LIMIT at this sf: compare full sets
+    assert 0 < len(got) < 100 and len(want) == len(got)
+    cmp(got, want)
+
+
+def test_q79(session, data):
+    m = _trips(data)
+    m = m[((m.hd_dep_count == 7) | (m.hd_vehicle_count > 1))
+          & (m.d_dow == 1) & (m.d_year.isin([1998, 1999, 2000]))
+          & (m.s_number_employees.between(200, 295))]
+    pt = m.groupby(["ss_ticket_number", "ss_customer_sk", "s_city"],
+                   as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                       profit=("ss_net_profit", "sum"))
+    want = pt.merge(data["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+    want["city"] = want.s_city.str[:30]
+    want = want[["c_last_name", "c_first_name", "city",
+                 "ss_ticket_number", "amt", "profit"]]
+    want = want.sort_values(
+        ["c_last_name", "c_first_name", "city", "profit"],
+        ignore_index=True).head(100)
+    got = run_q(session, "q79")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q89(session, data):
+    m = _star(data, store=True)
+    m = m[(m.d_year == 1999)
+          & (m.i_category.isin(["Books", "Electronics", "Sports",
+                                "Men", "Jewelry", "Women"]))]
+    ms = m.groupby(["i_category", "i_class", "i_brand", "s_store_name",
+                    "d_moy"], as_index=False).agg(
+        sum_sales=("ss_sales_price", "sum"))
+    ms["avg_monthly_sales"] = ms.groupby(
+        ["i_category", "i_brand", "s_store_name"]
+    ).sum_sales.transform("mean")
+    ratio = np.where(ms.avg_monthly_sales > 0,
+                     np.abs(ms.sum_sales - ms.avg_monthly_sales)
+                     / ms.avg_monthly_sales, np.nan)
+    want = ms[ratio > 0.1][["i_category", "i_class", "i_brand",
+                            "s_store_name", "d_moy", "sum_sales",
+                            "avg_monthly_sales"]]
+    want = want.assign(_k=want.sum_sales - want.avg_monthly_sales)
+    want = want.sort_values(["_k", "s_store_name", "d_moy"],
+                            ignore_index=True).head(100).drop(
+                                columns="_k")
+    got = run_q(session, "q89")
+    assert len(got) > 0
+    cmp(got, want)
