@@ -891,6 +891,16 @@ def array_max(c) -> Col:
     return Col(ArrayMax(_expr(c)))
 
 
+def slice(c, start: int, length: int) -> Col:  # noqa: A001
+    from spark_rapids_tpu.ops.collections_ops import Slice
+    return Col(Slice(_expr(c), start, length))
+
+
+def array_repeat(c, times: int) -> Col:
+    from spark_rapids_tpu.ops.collections_ops import ArrayRepeat
+    return Col(ArrayRepeat(_lit_expr(c), times))
+
+
 def reverse(c) -> Col:
     """reverse() over arrays (element order) or strings (byte-wise;
     ASCII-only incompat, like the engine's other byte kernels)."""

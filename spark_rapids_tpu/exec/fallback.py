@@ -388,6 +388,19 @@ def _eval_pandas(expr, df: pd.DataFrame):
         child = _eval_pandas(e.children[0], df)
         return child.map(lambda v: None if _isnull(v) else (
             v[::-1] if isinstance(v, str) else list(reversed(v))))
+    if isinstance(e, C.Slice):
+        child = _eval_pandas(e.children[0], df)
+
+        def sl(v):
+            s = e.start - 1 if e.start > 0 else len(v) + e.start
+            if s < 0:  # Spark: out-of-range negative start -> empty
+                return []
+            return list(v[s:s + e.length])
+        return child.map(lambda v: None if _isnull(v) else sl(v))
+    if isinstance(e, C.ArrayRepeat):
+        child = _eval_pandas(e.children[0], df)
+        return child.map(lambda v: None if _isnull(v)
+                         else [v] * e.times)
     from spark_rapids_tpu.ops.arithmetic import Hypot as _Hypot
     if isinstance(e, _Hypot):
         l = pd.to_numeric(_eval_pandas(e.children[0], df),
@@ -421,6 +434,23 @@ def _eval_pandas(expr, df: pd.DataFrame):
 def _is_expand(node) -> bool:
     from spark_rapids_tpu.exec.expand import Expand
     return isinstance(node, Expand)
+
+
+class _Neg:
+    """Order-inverting wrapper so descending keys ride the same
+    ascending k-way merge (works for any comparable type, unlike
+    numeric negation)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, o):
+        return o.v < self.v
+
+    def __eq__(self, o):
+        return self.v == o.v
 
 
 class _Unset:
@@ -663,18 +693,124 @@ class CpuFallbackExec(TpuExec):
             # whole input never lives in one frame
             yield self._build_batch(self._aggregate_frame(node))
             return
-        # ---- blocking nodes: semantics need the whole input ----
         if isinstance(node, L.Sort):
-            df = self._child_pandas(0)
-            by = [e.name for e, _, _ in node.orders]
-            ascending = [not d for _, d, _ in node.orders]
-            na_position = "first" if node.orders[0][2] else "last"
-            out = df.sort_values(by=by, ascending=ascending,
-                                 na_position=na_position, kind="stable")
+            yield from self._execute_sort(node)
+            return
+        raise NotImplementedError(
+            f"no CPU fallback for {type(node).__name__}")
+
+    # sorted-run spill threshold: inputs under this many rows sort in
+    # one in-memory pass; larger inputs run an external merge sort
+    SORT_RUN_ROWS = 1 << 20
+
+    def _execute_sort(self, node) -> Iterator[ColumnarBatch]:
+        """External merge sort: accumulate bounded sorted runs, spill
+        each to a parquet file, then stream a k-way merge — the
+        host-side analog of the engine's out-of-core sort
+        (exec/sort.py), so even a fallback SORT never holds the whole
+        input (CPU Spark's UnsafeExternalSorter role)."""
+        by = [e.name for e, _, _ in node.orders]
+        ascending = [not d for _, d, _ in node.orders]
+        na_position = "first" if node.orders[0][2] else "last"
+
+        def sort_frame(df):
+            return df.sort_values(by=by, ascending=ascending,
+                                  na_position=na_position,
+                                  kind="stable")
+
+        # spill dir cleanup must survive an early-stopped consumer
+        # (GeneratorExit at a mid-merge yield) or a merge exception
+        state = {"tmpdir": None}
+        try:
+            yield from self._sort_body(node, sort_frame, by, ascending,
+                                       na_position, state)
+        finally:
+            if state["tmpdir"] is not None:
+                import shutil
+                shutil.rmtree(state["tmpdir"], ignore_errors=True)
+
+    def _sort_body(self, node, sort_frame, by, ascending, na_position,
+                   state) -> Iterator[ColumnarBatch]:
+        import heapq
+        import tempfile
+
+        pend: List[pd.DataFrame] = []
+        pend_rows = 0
+        runs: List[str] = []
+        tmpdir = None
+        for df in self._child_frames(0):
+            pend.append(df)
+            pend_rows += len(df)
+            if pend_rows >= self.SORT_RUN_ROWS:
+                if tmpdir is None:
+                    tmpdir = tempfile.mkdtemp(prefix="tpu-fbsort-")
+                    state["tmpdir"] = tmpdir
+                run = sort_frame(pd.concat(pend, ignore_index=True))
+                path = f"{tmpdir}/run-{len(runs)}.parquet"
+                run.to_parquet(path, index=False)
+                runs.append(path)
+                pend, pend_rows = [], 0
+        tail = sort_frame(pd.concat(pend, ignore_index=True)) if pend \
+            else None
+        if not runs:
+            yield self._build_batch(
+                tail if tail is not None
+                else pd.DataFrame(columns=[n for n, _ in node.schema]))
+            return
+
+        # k-way merge over sorted sources: rows keyed by a tuple that
+        # encodes asc/desc and the shared na_position per column
+        def is_null_scalar(v):
+            if v is None:
+                return True
+            try:
+                return bool(pd.isna(v))
+            except (TypeError, ValueError):
+                return False
+
+        null_rank = 0 if na_position == "first" else 1
+
+        def keyify(kr):
+            out = []
+            for v, asc in zip(kr, ascending):
+                if is_null_scalar(v):
+                    out.append((null_rank, 0))
+                else:
+                    out.append((1 - null_rank,
+                                v if asc else _Neg(v)))
+            return tuple(out)
+
+        def rows_of(source):
+            """(key, full-row) pairs streamed from one sorted run."""
+            import pyarrow.parquet as pq
+            if isinstance(source, str):
+                f = pq.ParquetFile(source)
+                frames = (b.to_pandas()
+                          for b in f.iter_batches(batch_size=1 << 16))
+            else:
+                frames = iter([source])
+            for fr in frames:
+                keys = fr[by].itertuples(index=False, name=None)
+                full = fr.itertuples(index=False, name=None)
+                for kr, row in zip(keys, full):
+                    yield keyify(kr), row
+
+        sources = list(runs) + ([tail] if tail is not None else [])
+        if tail is not None:
+            cols = list(tail.columns)
         else:
-            raise NotImplementedError(
-                f"no CPU fallback for {type(node).__name__}")
-        yield self._build_batch(out)
+            import pyarrow.parquet as pq
+            cols = pq.ParquetFile(runs[0]).schema_arrow.names
+        merged = heapq.merge(*[rows_of(s) for s in sources],
+                             key=lambda kv: kv[0])
+        buf = []
+        for _, row in merged:
+            buf.append(row)
+            if len(buf) >= (1 << 16):
+                yield self._build_batch(
+                    pd.DataFrame(buf, columns=cols))
+                buf = []
+        yield self._build_batch(pd.DataFrame(buf, columns=cols))
 
     def _execute_join(self, node) -> Iterator[ColumnarBatch]:
         lk = [e.name for e in node.left_keys]

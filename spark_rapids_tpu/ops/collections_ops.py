@@ -322,3 +322,106 @@ class Reverse(UnaryExpression):
         j = jnp.where(live, start + end - 1 - i, i)
         j = jnp.clip(j, 0, v.shape[0] - 1)
         return ColVal(c.dtype, v[j], c.validity, c.offsets)
+
+
+class Slice(UnaryExpression):
+    """slice(arr, start, length) with LITERAL bounds (1-based start,
+    negative counts from the end — Spark semantics; the reference's
+    GpuSlice also requires literal bounds for the common case)."""
+
+    def __init__(self, child: Expression, start: int, length: int):
+        super().__init__(child)
+        if start == 0:
+            raise ValueError("slice start must not be 0 (SQL is "
+                             "1-based)")
+        if length < 0:
+            raise ValueError("slice length must be >= 0")
+        self.start = int(start)
+        self.length = int(length)
+
+    def with_children(self, children):
+        return Slice(children[0], self.start, self.length)
+
+    def cache_key(self):
+        return ("Slice", self.start, self.length,
+                self.child.cache_key())
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        lens = (c.offsets[1:cap + 1] - c.offsets[:cap]).astype(jnp.int32)
+        if self.start > 0:
+            s_raw = jnp.full(cap, self.start - 1, dtype=jnp.int32)
+        else:
+            s_raw = lens + self.start
+        # Spark: a negative start reaching before the array yields the
+        # EMPTY array (collectionOperations.scala startIndex < 0 check)
+        s = jnp.clip(s_raw, 0, lens)
+        out_len = jnp.clip(jnp.int32(self.length), 0, lens - s)
+        out_len = jnp.where(s_raw < 0, 0, out_len)
+        out_len = jnp.where(ctx.row_mask(), out_len, 0)
+        out_offsets = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32),
+             jnp.cumsum(out_len, dtype=jnp.int32)])
+        ecap = int(c.values.shape[0])
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        row = jnp.clip(
+            jnp.searchsorted(out_offsets, pos, side="right") - 1,
+            0, cap - 1)
+        k = pos - out_offsets[row]
+        src = jnp.clip(c.offsets[row] + s[row] + k, 0, ecap - 1)
+        vals = jnp.where(pos < out_offsets[cap], c.values[src],
+                         jnp.zeros((), dtype=c.values.dtype))
+        return ColVal(c.dtype, vals, c.validity, out_offsets)
+
+
+class ArrayRepeat(Expression):
+    """array_repeat(value, n) with a LITERAL count: fixed-stride array
+    construction (every row length n)."""
+
+    def __init__(self, child: Expression, times: int):
+        if times < 0:
+            times = 0
+        self.children = (child,)
+        self.times = int(times)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        return ArrayRepeat(children[0], self.times)
+
+    def cache_key(self):
+        return ("ArrayRepeat", self.times, self.child.cache_key())
+
+    @property
+    def dtype(self) -> DataType:
+        return ArrayType(self.child.dtype)
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        c = self.child.emit(ctx)
+        cap = ctx.capacity
+        n = self.times
+        lens = jnp.where(ctx.row_mask(), jnp.int32(n), 0)
+        out_offsets = jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32),
+             jnp.cumsum(lens, dtype=jnp.int32)])
+        ecap = 1
+        while ecap < max(n, 1) * cap:
+            ecap <<= 1
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        row = jnp.clip(
+            jnp.searchsorted(out_offsets, pos, side="right") - 1,
+            0, cap - 1)
+        vals = jnp.where(pos < out_offsets[cap], c.values[row],
+                         jnp.zeros((), dtype=c.values.dtype))
+        return ColVal(ArrayType(c.dtype), vals, c.validity, out_offsets)

@@ -127,6 +127,11 @@ expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
 expr_rule(C.ArrayMin, ts.ARRAY)
 expr_rule(C.ArrayMax, ts.ARRAY)
+expr_rule(C.Slice, ts.ARRAY)
+expr_rule(C.ArrayRepeat, ts.ARRAY,
+          incompat="array_repeat(NULL, n) yields a NULL row, not an "
+                   "array of nulls (null elements have no device "
+                   "representation)")
 expr_rule(C.Reverse, ts.COMMON,
           incompat="string reverse is byte-wise (ASCII-only)")
 

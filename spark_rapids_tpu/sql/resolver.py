@@ -632,6 +632,10 @@ class Resolver:
         if n == "bround":
             return F.bround(args[0], int(lit_arg(1)) if len(args) > 1
                             else 0)
+        if n == "slice":
+            return F.slice(args[0], int(lit_arg(1)), int(lit_arg(2)))
+        if n == "array_repeat":
+            return F.array_repeat(args[0], int(lit_arg(1)))
         if n == "next_day":
             return F.next_day(args[0], str(lit_arg(1)))
         if n == "shiftleft":

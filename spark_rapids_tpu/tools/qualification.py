@@ -34,17 +34,49 @@ class QualSummary:
     not_on_tpu_reasons: Counter
     score: float               # 0..100 recommendation
     recommendation: str
+    estimated_speedup: float = 1.0  # vs a CPU (pandas-class) run
 
 
 _REASON_RE = re.compile(r"because (.+)$")
+
+# exec metric name -> calibrated operator family (plan/cbo_weights.json,
+# MEASURED by tools/cbo_calibrate.py — the role operatorsScore.csv plays
+# for the reference's qualification estimates)
+_EXEC_TO_OP = {
+    "TpuHashAggregateExec": "Aggregate",
+    "TpuFilterExec": "Filter",
+    "TpuProjectExec": "Project",
+    "TpuHashJoinExec": "Join",
+    "TpuBroadcastHashJoinExec": "Join",
+    "TpuSortExec": "Sort",
+    "TpuTopNExec": "Sort",
+    "TpuWindowExec": "Window",
+    "TpuGenerateExec": "Generate",
+    "TpuExpandExec": "Project",
+}
+
+
+def _op_speedups() -> Dict[str, float]:
+    """cpu_cost/tpu_cost per operator family from the measured weights;
+    empty when no calibration exists (estimate degrades to 1x for
+    unknown ops)."""
+    try:
+        from spark_rapids_tpu.plan.cbo import load_weights
+        tpu_w, cpu_w = load_weights()
+    except Exception:
+        return {}
+    return {k: cpu_w[k] / tpu_w[k]
+            for k in tpu_w if k in cpu_w and tpu_w[k] > 0}
 
 
 def qualify_app(app: AppInfo) -> QualSummary:
     tpu_ns = 0
     cpu_ns = 0
+    cpu_equiv_ns = 0.0  # estimated runtime of the same work on CPU
     fallbacks = 0
     reasons: Counter = Counter()
     failed = 0
+    speedups = _op_speedups()
     for q in app.queries:
         if not q.succeeded:
             failed += 1
@@ -55,8 +87,11 @@ def qualify_app(app: AppInfo) -> QualSummary:
             t = m.get("opTimeSelf", m.get("opTime", 0))
             if name.startswith("CpuFallback"):
                 cpu_ns += t
+                cpu_equiv_ns += t  # already CPU
             else:
                 tpu_ns += t
+                cpu_equiv_ns += t * speedups.get(
+                    _EXEC_TO_OP.get(name, ""), 1.0)
         fallbacks += len(q.fallback_ops())
         for line in q.explain.splitlines():
             mm = _REASON_RE.search(line)
@@ -79,9 +114,10 @@ def qualify_app(app: AppInfo) -> QualSummary:
         rec = "Not Recommended"
     else:
         rec = "Not Applicable"
+    est = (cpu_equiv_ns / total) if total else 1.0
     return QualSummary(app.session_id, len(app.queries), failed,
                        app.total_duration_ms, share, fallbacks, reasons,
-                       score, rec)
+                       score, rec, estimated_speedup=est)
 
 
 def format_report(summaries: List[QualSummary]) -> str:
@@ -94,6 +130,8 @@ def format_report(summaries: List[QualSummary]) -> str:
                    f"  wall: {s.total_duration_ms:.0f} ms")
         out.append(f"  TPU op-time share: {s.tpu_op_time_share * 100:.1f}%"
                    f"  CPU-fallback ops: {s.fallback_op_count}")
+        out.append(f"  estimated speedup vs CPU: "
+                   f"{s.estimated_speedup:.2f}x (measured per-op weights)")
         out.append(f"  score: {s.score:.1f}  -> {s.recommendation}")
         for reason, n in s.not_on_tpu_reasons.most_common(5):
             out.append(f"    not-on-TPU ({n}x): {reason}")
@@ -105,11 +143,13 @@ def write_csv(summaries: List[QualSummary], path: str) -> None:
         w = csv.writer(fh)
         w.writerow(["session_id", "num_queries", "failed_queries",
                     "total_duration_ms", "tpu_op_time_share",
-                    "fallback_op_count", "score", "recommendation"])
+                    "fallback_op_count", "estimated_speedup", "score",
+                    "recommendation"])
         for s in summaries:
             w.writerow([s.session_id, s.num_queries, s.failed_queries,
                         f"{s.total_duration_ms:.3f}",
                         f"{s.tpu_op_time_share:.4f}", s.fallback_op_count,
+                        f"{s.estimated_speedup:.3f}",
                         f"{s.score:.2f}", s.recommendation])
 
 

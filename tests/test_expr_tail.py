@@ -307,3 +307,29 @@ def test_array_extreme_nan_order(session):
     assert got.lo[0] == 1.0 and np.isnan(got.hi[0])
     assert np.isnan(got.lo[1]) and np.isnan(got.hi[1])
     assert got.lo[2] == 0.5 and got.hi[2] == 3.0
+
+
+def test_slice_and_array_repeat(session):
+    df = session.create_dataframe(pd.DataFrame({
+        "a": [[1, 2, 3, 4, 5], [9], [], None],
+        "n": [7, 8, 9, 10]}))
+    got = df.select(F.slice("a", 2, 2).alias("s2"),
+                    F.slice("a", -2, 2).alias("sn"),
+                    F.array_repeat(F.col("n"), 3).alias("r")).to_pandas()
+    s2 = [None if v is None else list(v) for v in got.s2]
+    sn = [None if v is None else list(v) for v in got.sn]
+    r = [None if v is None else list(v) for v in got.r]
+    assert s2 == [[2, 3], [], [], None]
+    # -2 reaches before the 1-element array: Spark yields [] there
+    assert sn == [[4, 5], [], [], None]
+    assert r == [[7] * 3, [8] * 3, [9] * 3, [10] * 3]
+    # SQL names
+    df.createOrReplaceTempView("slt")
+    q = session.sql("select slice(a, 2, 2) as s2, "
+                    "array_repeat(n, 2) as r from slt").to_pandas()
+    assert [None if v is None else list(v) for v in q.s2] == s2
+    assert [None if v is None else list(v) for v in q.r] == \
+        [[7, 7], [8, 8], [9, 9], [10, 10]]
+    # device path
+    tree = session.plan(df.select(F.slice("a", 2, 2)).plan).tree_string()
+    assert "CpuFallbackExec" not in tree

@@ -185,3 +185,79 @@ def test_host_export_never_touches_device():
     # slicing keeps both buffers (no re-upload, still exact)
     sliced = col.with_nrows(2)
     assert sliced._jax_data is dev and sliced._np_data is not None
+
+
+def _fb_sort(batches, orders_cols, descending=None, nulls_first=True,
+             run_rows=None):
+    scan = TpuScanExec(batches, batches[0].schema)
+    rel = L.InMemoryRelation(batches, batches[0].schema)
+    descending = descending or [False] * len(orders_cols)
+    orders = [(F.col(c).expr.bind(rel.schema), d, nulls_first)
+              for c, d in zip(orders_cols, descending)]
+    node = L.Sort(orders, rel)
+    fb = CpuFallbackExec(node, [scan])
+    if run_rows is not None:
+        fb.SORT_RUN_ROWS = run_rows
+    return to_pandas(fb)
+
+
+def test_sort_external_merge_matches_in_memory():
+    """Forcing tiny sorted runs (external merge path) must produce the
+    identical order as the one-pass in-memory sort."""
+    rng = np.random.default_rng(5)
+    batches = [ColumnarBatch.from_pydict(
+        {"a": rng.integers(0, 50, 97).astype(np.int64),
+         "b": rng.normal(size=97)}) for _ in range(6)]
+    small = _fb_sort(batches, ["a", "b"])
+    ext = _fb_sort(batches, ["a", "b"], run_rows=100)
+    pd.testing.assert_frame_equal(small, ext)
+    assert small["a"].is_monotonic_increasing
+
+
+def test_sort_external_descending_with_nulls():
+    batches = [
+        ColumnarBatch.from_pydict({"a": [3.0, None, 1.0]}),
+        ColumnarBatch.from_pydict({"a": [None, 7.0, 2.0]}),
+        ColumnarBatch.from_pydict({"a": [5.0, 0.5, None]}),
+    ]
+    got = _fb_sort(batches, ["a"], descending=[True],
+                   nulls_first=False, run_rows=3)
+    vals = [None if pd.isna(v) else v for v in got["a"]]
+    assert vals == [7.0, 5.0, 3.0, 2.0, 1.0, 0.5, None, None, None]
+    got2 = _fb_sort(batches, ["a"], descending=[True],
+                    nulls_first=True, run_rows=3)
+    vals2 = [None if pd.isna(v) else v for v in got2["a"]]
+    assert vals2 == [None, None, None, 7.0, 5.0, 3.0, 2.0, 1.0, 0.5]
+
+
+def test_sort_external_strings():
+    batches = [
+        ColumnarBatch.from_pydict({"s": ["pear", "apple", None]}),
+        ColumnarBatch.from_pydict({"s": ["fig", None, "plum"]}),
+    ]
+    got = _fb_sort(batches, ["s"], run_rows=2)
+    vals = [None if v is None or (not isinstance(v, str) and
+                                  pd.isna(v)) else v for v in got["s"]]
+    assert vals == [None, None, "apple", "fig", "pear", "plum"]
+
+
+def test_sort_external_cleans_tmpdir_on_early_stop(tmp_path, monkeypatch):
+    """An early-stopped consumer (GeneratorExit mid-merge) must not
+    leak the spilled sorted-run files."""
+    import tempfile
+    monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
+    rng = np.random.default_rng(9)
+    batches = [ColumnarBatch.from_pydict(
+        {"a": rng.integers(0, 50, 100).astype(np.int64)})
+        for _ in range(5)]
+    scan = TpuScanExec(batches, batches[0].schema)
+    rel = L.InMemoryRelation(batches, batches[0].schema)
+    node = L.Sort([(F.col("a").expr.bind(rel.schema), False, True)],
+                  rel)
+    fb = CpuFallbackExec(node, [scan])
+    fb.SORT_RUN_ROWS = 100
+    it = fb.execute()
+    next(it)          # first merged batch
+    it.close()        # consumer stops early
+    assert not list(tmp_path.glob("tpu-fbsort-*")), \
+        list(tmp_path.iterdir())

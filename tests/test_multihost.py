@@ -87,3 +87,31 @@ def test_cross_process_aggregate_exchange():
         assert c == sel.size
         np.testing.assert_allclose(s, sel.sum(), rtol=1e-12)
         np.testing.assert_allclose(m, sel.min(), rtol=1e-12)
+
+
+def test_missing_peer_detected_within_timeout():
+    """Failure detection at the coordination layer (the §5 elasticity
+    story's first line of defense): a controller whose peer never
+    arrives must ERROR within the configured timeout, not hang — the
+    reference's analog is executor heartbeat loss failing the stage."""
+    port = _free_port()
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=2'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.distributed.initialize("
+        f"'localhost:{port}', num_processes=2, process_id=0, "
+        "initialization_timeout=15)\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    # peer process 1 never starts: initialize must raise, visibly
+    assert p.returncode != 0
+    assert "timed out" in (p.stderr + p.stdout).lower() or \
+        "deadline" in (p.stderr + p.stdout).lower(), \
+        (p.stderr + p.stdout)[-1500:]

@@ -160,3 +160,13 @@ def test_app_filtering(tmp_path, capsys):
     rc = profiling.main([str(tmp_path), "--filter-app", first_id])
     assert rc == 0
     assert "queries: 1" in capsys.readouterr().out
+
+
+def test_qualification_estimated_speedup(logged_session):
+    s, d = logged_session
+    summary = qualification.qualify_app(load_logs(str(d))[0])
+    # estimated from MEASURED per-op weights: an all-TPU aggregate
+    # workload must estimate > 1x vs CPU
+    assert summary.estimated_speedup > 1.0
+    report = qualification.format_report([summary])
+    assert "estimated speedup" in report
