@@ -897,8 +897,10 @@ def slice(c, start: int, length: int) -> Col:  # noqa: A001
 
 
 def array_repeat(c, times: int) -> Col:
+    """Bare strings are COLUMN references (PySpark semantics); use
+    F.lit("x") to repeat a literal string."""
     from spark_rapids_tpu.ops.collections_ops import ArrayRepeat
-    return Col(ArrayRepeat(_lit_expr(c), times))
+    return Col(ArrayRepeat(_expr(c), times))
 
 
 def reverse(c) -> Col:

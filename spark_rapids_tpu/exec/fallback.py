@@ -719,44 +719,44 @@ class CpuFallbackExec(TpuExec):
                                   kind="stable")
 
         # spill dir cleanup must survive an early-stopped consumer
-        # (GeneratorExit at a mid-merge yield) or a merge exception
-        state = {"tmpdir": None}
-        try:
-            yield from self._sort_body(node, sort_frame, by, ascending,
-                                       na_position, state)
-        finally:
-            if state["tmpdir"] is not None:
-                import shutil
-                shutil.rmtree(state["tmpdir"], ignore_errors=True)
-
-    def _sort_body(self, node, sort_frame, by, ascending, na_position,
-                   state) -> Iterator[ColumnarBatch]:
-        import heapq
+        # (GeneratorExit at a mid-merge yield) or a merge exception:
+        # the finally below wraps every yield
         import tempfile
 
         pend: List[pd.DataFrame] = []
         pend_rows = 0
         runs: List[str] = []
         tmpdir = None
-        for df in self._child_frames(0):
-            pend.append(df)
-            pend_rows += len(df)
-            if pend_rows >= self.SORT_RUN_ROWS:
-                if tmpdir is None:
-                    tmpdir = tempfile.mkdtemp(prefix="tpu-fbsort-")
-                    state["tmpdir"] = tmpdir
-                run = sort_frame(pd.concat(pend, ignore_index=True))
-                path = f"{tmpdir}/run-{len(runs)}.parquet"
-                run.to_parquet(path, index=False)
-                runs.append(path)
-                pend, pend_rows = [], 0
-        tail = sort_frame(pd.concat(pend, ignore_index=True)) if pend \
-            else None
-        if not runs:
-            yield self._build_batch(
-                tail if tail is not None
-                else pd.DataFrame(columns=[n for n, _ in node.schema]))
-            return
+        try:
+            for df in self._child_frames(0):
+                pend.append(df)
+                pend_rows += len(df)
+                if pend_rows >= self.SORT_RUN_ROWS:
+                    if tmpdir is None:
+                        tmpdir = tempfile.mkdtemp(prefix="tpu-fbsort-")
+                    run = sort_frame(pd.concat(pend, ignore_index=True))
+                    path = f"{tmpdir}/run-{len(runs)}.parquet"
+                    run.to_parquet(path, index=False)
+                    runs.append(path)
+                    pend, pend_rows = [], 0
+            tail = sort_frame(pd.concat(pend, ignore_index=True)) \
+                if pend else None
+            if not runs:
+                yield self._build_batch(
+                    tail if tail is not None
+                    else pd.DataFrame(
+                        columns=[n for n, _ in node.schema]))
+                return
+            yield from self._sort_merge(runs, tail, by, ascending,
+                                        na_position)
+        finally:
+            if tmpdir is not None:
+                import shutil
+                shutil.rmtree(tmpdir, ignore_errors=True)
+
+    def _sort_merge(self, runs, tail, by, ascending, na_position
+                    ) -> Iterator[ColumnarBatch]:
+        import heapq
 
         # k-way merge over sorted sources: rows keyed by a tuple that
         # encodes asc/desc and the shared na_position per column

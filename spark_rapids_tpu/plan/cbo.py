@@ -42,6 +42,15 @@ _BUILTIN_TPU_W = {k: v / 6.0 for k, v in _BUILTIN_CPU_W.items()}
 _WEIGHTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "cbo_weights.json")
 _loaded: Optional[Tuple[Dict[str, float], Dict[str, float]]] = None
+_calibrated: bool = False
+
+
+def weights_calibrated() -> bool:
+    """True when load_weights() served a calibration MEASURED on this
+    backend; False when it fell back to the built-in ratio table
+    (missing/corrupt file or platform-mismatch provenance)."""
+    load_weights()
+    return _calibrated
 
 
 def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
@@ -77,6 +86,7 @@ def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
             cpu.setdefault(k, v * 0.05)   # us/row scale of the table
             tpu.setdefault(k, cpu[k] * med)
         _loaded = (tpu, cpu)
+        globals()["_calibrated"] = True
     except (OSError, KeyError, TypeError, ValueError,
             json.JSONDecodeError):
         # scale the unit table into the same us/row domain the

@@ -18,7 +18,7 @@ import re
 import sys
 from collections import Counter
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 from spark_rapids_tpu.tools.eventlog import AppInfo, load_logs
 
@@ -35,6 +35,7 @@ class QualSummary:
     score: float               # 0..100 recommendation
     recommendation: str
     estimated_speedup: float = 1.0  # vs a CPU (pandas-class) run
+    speedup_calibrated: bool = False  # measured weights vs builtin table
 
 
 _REASON_RE = re.compile(r"because (.+)$")
@@ -47,7 +48,6 @@ _EXEC_TO_OP = {
     "TpuFilterExec": "Filter",
     "TpuProjectExec": "Project",
     "TpuHashJoinExec": "Join",
-    "TpuBroadcastHashJoinExec": "Join",
     "TpuSortExec": "Sort",
     "TpuTopNExec": "Sort",
     "TpuWindowExec": "Window",
@@ -56,17 +56,20 @@ _EXEC_TO_OP = {
 }
 
 
-def _op_speedups() -> Dict[str, float]:
-    """cpu_cost/tpu_cost per operator family from the measured weights;
-    empty when no calibration exists (estimate degrades to 1x for
-    unknown ops)."""
+def _op_speedups() -> Tuple[Dict[str, float], bool]:
+    """(cpu_cost/tpu_cost per operator family, calibrated?) — the flag
+    distinguishes a calibration measured on THIS backend from the
+    built-in ratio table so the report never claims measured numbers
+    it does not have."""
     try:
-        from spark_rapids_tpu.plan.cbo import load_weights
+        from spark_rapids_tpu.plan.cbo import (load_weights,
+                                               weights_calibrated)
         tpu_w, cpu_w = load_weights()
+        cal = weights_calibrated()
     except Exception:
-        return {}
-    return {k: cpu_w[k] / tpu_w[k]
-            for k in tpu_w if k in cpu_w and tpu_w[k] > 0}
+        return {}, False
+    return ({k: cpu_w[k] / tpu_w[k]
+             for k in tpu_w if k in cpu_w and tpu_w[k] > 0}, cal)
 
 
 def qualify_app(app: AppInfo) -> QualSummary:
@@ -76,7 +79,7 @@ def qualify_app(app: AppInfo) -> QualSummary:
     fallbacks = 0
     reasons: Counter = Counter()
     failed = 0
-    speedups = _op_speedups()
+    speedups, calibrated = _op_speedups()
     for q in app.queries:
         if not q.succeeded:
             failed += 1
@@ -117,7 +120,8 @@ def qualify_app(app: AppInfo) -> QualSummary:
     est = (cpu_equiv_ns / total) if total else 1.0
     return QualSummary(app.session_id, len(app.queries), failed,
                        app.total_duration_ms, share, fallbacks, reasons,
-                       score, rec, estimated_speedup=est)
+                       score, rec, estimated_speedup=est,
+                       speedup_calibrated=calibrated)
 
 
 def format_report(summaries: List[QualSummary]) -> str:
@@ -130,8 +134,11 @@ def format_report(summaries: List[QualSummary]) -> str:
                    f"  wall: {s.total_duration_ms:.0f} ms")
         out.append(f"  TPU op-time share: {s.tpu_op_time_share * 100:.1f}%"
                    f"  CPU-fallback ops: {s.fallback_op_count}")
+        basis = ("measured per-op weights" if s.speedup_calibrated
+                 else "builtin ratio table; run "
+                      "spark-rapids-tpu-cbo-calibrate to measure")
         out.append(f"  estimated speedup vs CPU: "
-                   f"{s.estimated_speedup:.2f}x (measured per-op weights)")
+                   f"{s.estimated_speedup:.2f}x ({basis})")
         out.append(f"  score: {s.score:.1f}  -> {s.recommendation}")
         for reason, n in s.not_on_tpu_reasons.most_common(5):
             out.append(f"    not-on-TPU ({n}x): {reason}")

@@ -1,0 +1,102 @@
+"""Distributed fuzz: seeded random query pipelines run on the 8-shard
+mesh AND the single-process engine, frames compared — the
+dist-vs-oracle property net over the planner's lowering surface
+(filters, projections, group-bys with the full aggregate family,
+joins, rollup, sort+limit)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+
+N = 600
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.default_rng(4242)
+    fact = pd.DataFrame({
+        "k": rng.integers(0, 23, N),
+        "k2": rng.integers(0, 4, N),
+        "v": np.round(rng.normal(50, 20, N), 3),
+        "w": rng.integers(-100, 100, N).astype(np.int64),
+        "s": rng.choice(["red", "green", "blue", "teal", None], N,
+                        p=[0.3, 0.3, 0.2, 0.15, 0.05]),
+    })
+    fact.loc[rng.choice(N, 40, replace=False), "v"] = np.nan
+    dim = pd.DataFrame({
+        "k": np.arange(0, 30, 2),
+        "label": [f"L{i}" for i in range(15)],
+        "factor": np.arange(15) * 1.5,
+    })
+    dist = TpuSession(mesh=make_mesh(8))
+    single = TpuSession()
+    return dist, single, fact, dim
+
+
+AGGS = [
+    lambda c: F.sum(c), lambda c: F.count(c), lambda c: F.min(c),
+    lambda c: F.max(c), lambda c: F.avg(c), lambda c: F.stddev(c),
+    lambda c: F.var_pop(c),
+]
+
+
+def build(rng, session, fact, dim):
+    df = session.create_dataframe(fact)
+    steps = []
+    # 0-2 filters
+    for _ in range(rng.integers(0, 3)):
+        col = rng.choice(["k", "v", "w"])
+        thr = {"k": int(rng.integers(0, 23)),
+               "v": float(np.round(rng.uniform(0, 100), 2)),
+               "w": int(rng.integers(-100, 100))}[col]
+        op = rng.choice(["<", ">=", "!="])
+        c = F.col(col)
+        cond = (c < thr) if op == "<" else \
+            (c >= thr) if op == ">=" else (c != thr)
+        df = df.filter(cond)
+        steps.append(f"filter {col}{op}{thr}")
+    # optional projection
+    if rng.random() < 0.5:
+        df = df.withColumn("p", F.col("v") * 2.0 + F.col("w"))
+        steps.append("project p")
+    # optional join
+    if rng.random() < 0.5:
+        df = df.join(session.create_dataframe(dim), on="k",
+                     how=str(rng.choice(["inner", "left"])))
+        steps.append("join")
+    # aggregate or sort tail
+    if rng.random() < 0.7:
+        keys = ["k2"] if rng.random() < 0.5 else ["k2", "s"]
+        n_agg = int(rng.integers(1, 4))
+        # deterministic per-seed choice of agg fns
+        fns = [AGGS[int(i)] for i in
+               rng.integers(0, len(AGGS), n_agg)]
+        aggs = [fn("v").alias(f"a{j}") for j, fn in enumerate(fns)]
+        aggs.append(F.count().alias("n"))
+        df = df.groupBy(*keys).agg(*aggs)
+        steps.append(f"groupBy {keys} x{n_agg}")
+    else:
+        df = df.orderBy("w", "k").limit(50)
+        steps.append("sort+limit")
+    return df, steps
+
+
+@pytest.mark.parametrize("seed", range(18))
+def test_random_pipeline_dist_matches_single(env, seed):
+    dist, single, fact, dim = env
+    rng_a = np.random.default_rng(1000 + seed)
+    rng_b = np.random.default_rng(1000 + seed)
+    da, steps = build(rng_a, dist, fact, dim)
+    db, _ = build(rng_b, single, fact, dim)
+    a = da.to_pandas()
+    b = db.to_pandas()
+    cols = list(b.columns)
+    assert list(a.columns) == cols, (steps, list(a.columns), cols)
+    a = a.sort_values(cols, ignore_index=True, na_position="last")
+    b = b.sort_values(cols, ignore_index=True, na_position="last")
+    pd.testing.assert_frame_equal(a, b, check_dtype=False, rtol=1e-9,
+                                  obj=f"steps={steps}")

@@ -333,3 +333,10 @@ def test_slice_and_array_repeat(session):
     # device path
     tree = session.plan(df.select(F.slice("a", 2, 2)).plan).tree_string()
     assert "CpuFallbackExec" not in tree
+
+
+def test_array_repeat_string_column_reference(session):
+    """A bare string names a COLUMN (PySpark semantics), not a literal."""
+    df = session.create_dataframe(pd.DataFrame({"n": [3, 4]}))
+    got = df.select(F.array_repeat("n", 2).alias("r")).to_pandas()
+    assert [list(v) for v in got.r] == [[3, 3], [4, 4]]
