@@ -22,6 +22,34 @@ from spark_rapids_tpu.ops.compiler import FilterStageFn, StageFn
 from spark_rapids_tpu.ops.expressions import BoundReference, Expression
 
 
+class TpuCoalesceBatchesExec(TpuExec):
+    """Planner-inserted batch coalescing: accumulate undersized
+    upstream batches to the goal before handing them downstream — the
+    GpuCoalesceBatches.scala operator in the position
+    GpuTransitionOverrides.scala:57-64 inserts it (above multi-file
+    scans here, where PERFILE readers emit one small batch per
+    file)."""
+
+    def __init__(self, child: TpuExec, goal):
+        super().__init__(child)
+        self.goal = goal
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"TpuCoalesceBatchesExec[{self.goal}]"
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.coalesce import coalesce_iterator
+        return coalesce_iterator(self.child.execute(), self.goal)
+
+
 class TpuScanExec(TpuExec):
     """In-memory relation scan: re-chunks host/device batches to target rows."""
 

@@ -58,7 +58,7 @@ def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
     the build machine by ``tools/cbo_calibrate.py`` (re-run it on the
     target device to recalibrate) — falling back to the built-in ratio
     table when the file is absent."""
-    global _loaded
+    global _loaded, _calibrated
     if _loaded is not None:
         return _loaded
     try:
@@ -86,9 +86,10 @@ def load_weights() -> Tuple[Dict[str, float], Dict[str, float]]:
             cpu.setdefault(k, v * 0.05)   # us/row scale of the table
             tpu.setdefault(k, cpu[k] * med)
         _loaded = (tpu, cpu)
-        globals()["_calibrated"] = True
+        _calibrated = True
     except (OSError, KeyError, TypeError, ValueError,
             json.JSONDecodeError):
+        _calibrated = False
         # scale the unit table into the same us/row domain the
         # calibrated file (and transitionRowCost default) live in
         _loaded = ({k: v * 0.05 for k, v in _BUILTIN_TPU_W.items()},

@@ -516,7 +516,22 @@ def _conv_inmemory(node: L.InMemoryRelation, children, conf):
 @_converter(L.FileRelation)
 def _conv_file(node: L.FileRelation, children, conf):
     from spark_rapids_tpu.io.readers import make_file_scan_exec
-    return make_file_scan_exec(node, conf)
+    scan = make_file_scan_exec(node, conf)
+    # PERFILE readers emit one undersized batch per file: planner-
+    # inserted coalesce to the batch goal (GpuTransitionOverrides.
+    # scala:57-64).  Other reader types already merge to goal-sized
+    # batches, and array<string> columns carry PER-BATCH dictionary
+    # codes that concatenation would corrupt — leave those bare.
+    if len(node.paths) > 1 and \
+            getattr(scan, "reader_type", "") == "PERFILE" and \
+            not any(dt.is_array and dt.element is not None
+                    and dt.element.is_string for _, dt in node.schema):
+        from spark_rapids_tpu.config import rapids_conf as _rc
+        from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
+        from spark_rapids_tpu.memory.coalesce import TargetSize
+        return TpuCoalesceBatchesExec(
+            scan, TargetSize(conf.get(_rc.BATCH_SIZE_BYTES)))
+    return scan
 
 
 @_converter(L.Project)

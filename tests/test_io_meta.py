@@ -177,3 +177,35 @@ def _walk(node):
     yield node
     for c in node.children:
         yield from _walk(c)
+
+
+def test_planner_inserts_coalesce_above_multifile_scan(tmp_path):
+    """Multi-file scans get a planner-inserted TpuCoalesceBatchesExec
+    (the GpuTransitionOverrides post-scan coalesce role): many PERFILE
+    batches merge up to the batch goal before downstream ops."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.api.session import TpuSession
+    paths = []
+    for i in range(6):
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(pa.table({"a": list(range(i * 10, i * 10 + 10))}),
+                       p)
+        paths.append(p)
+    s = TpuSession({"spark.rapids.sql.format.parquet.reader.type":
+                    "PERFILE"})
+    df = s.read.parquet(*paths)
+    plan = s.plan(df.plan)
+    tree = plan.tree_string()
+    assert "TpuCoalesceBatchesExec" in tree
+    batches = list(plan.execute())
+    # six 10-row files coalesce into one batch under the 2 GiB goal
+    assert len(batches) == 1 and batches[0].nrows == 60
+    assert sorted(df.to_pandas()["a"]) == list(range(60))
+    # single-file scans stay bare, and so do non-PERFILE readers
+    # (their multifile paths already merge to goal-sized batches)
+    s2 = TpuSession()
+    tree2 = s2.plan(s2.read.parquet(paths[0]).plan).tree_string()
+    assert "TpuCoalesceBatchesExec" not in tree2
+    tree3 = s2.plan(s2.read.parquet(*paths).plan).tree_string()
+    assert "TpuCoalesceBatchesExec" not in tree3  # AUTO reader
