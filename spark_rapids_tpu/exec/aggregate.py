@@ -58,9 +58,7 @@ class _StringKeyEncoder:
                                    capacity=col.capacity)
 
 
-def _merge_kind(update_kind: str) -> str:
-    return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
-            "first": "first", "last": "last"}[update_kind]
+from spark_rapids_tpu.ops.aggregates import merge_kind as _merge_kind  # noqa: E402
 
 
 @functools.lru_cache(maxsize=None)

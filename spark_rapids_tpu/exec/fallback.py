@@ -62,7 +62,18 @@ def _align_datetime_operands(l: pd.Series, r: pd.Series):
         return None
 
     kl, kr = kind(l), kind(r)
-    if not (kl and kr) or kl == kr == "ts":
+    if not (kl and kr):
+        return l, r
+    if kl == kr == "ts":
+        # both already datetime64 — still align tz-awareness (an
+        # arrow-bridge tz-aware series vs a fallback-cast naive one
+        # raises TypeError in pandas otherwise)
+        ltz = getattr(l.dtype, "tz", None)
+        rtz = getattr(r.dtype, "tz", None)
+        if ltz is not None and rtz is None:
+            return l, r.dt.tz_localize(ltz)
+        if rtz is not None and ltz is None:
+            return l.dt.tz_localize(rtz), r
         return l, r
     def norm(s, k):
         if k != "obj":
@@ -485,8 +496,13 @@ def _agg_update(func, state, sub: pd.DataFrame):
     aggregate function — the host-side partial/merge split that keeps
     the fallback from ever holding the whole input in one frame."""
     k = func.name
-    s = (_eval_pandas(func.child, sub).dropna()
-         if func.child is not None else None)
+    s = _eval_pandas(func.child, sub) if func.child is not None else None
+    if s is not None and (k not in ("first", "last") or
+                          getattr(func, "ignore_nulls", False)):
+        # first/last keep nulls unless ignoreNulls was requested
+        # (Spark default ignoreNulls=false); every other aggregate is
+        # null-skipping by definition
+        s = s.dropna()
     if k == "count":
         n = len(s) if s is not None else len(sub)
         return n if state is _UNSET else state + n
@@ -711,12 +727,18 @@ class CpuFallbackExec(TpuExec):
         input (CPU Spark's UnsafeExternalSorter role)."""
         by = [e.name for e, _, _ in node.orders]
         ascending = [not d for _, d, _ in node.orders]
-        na_position = "first" if node.orders[0][2] else "last"
+        # 0 = nulls sort before values, 1 = after, PER KEY.  pandas
+        # sort_values cannot express per-key na_position in one call, so
+        # the run sort applies one stable single-key pass per key in
+        # reverse order (classic lexicographic composition) — this keeps
+        # run ordering byte-identical with the merge's keyify tuples.
+        null_ranks = [0 if nf else 1 for _, _, nf in node.orders]
+
+        from spark_rapids_tpu.utils.hostsort import sort_per_key_nulls
 
         def sort_frame(df):
-            return df.sort_values(by=by, ascending=ascending,
-                                  na_position=na_position,
-                                  kind="stable")
+            return sort_per_key_nulls(
+                df, by, ascending, [nr == 0 for nr in null_ranks])
 
         # spill dir cleanup must survive an early-stopped consumer
         # (GeneratorExit at a mid-merge yield) or a merge exception:
@@ -748,18 +770,18 @@ class CpuFallbackExec(TpuExec):
                         columns=[n for n, _ in node.schema]))
                 return
             yield from self._sort_merge(runs, tail, by, ascending,
-                                        na_position)
+                                        null_ranks)
         finally:
             if tmpdir is not None:
                 import shutil
                 shutil.rmtree(tmpdir, ignore_errors=True)
 
-    def _sort_merge(self, runs, tail, by, ascending, na_position
+    def _sort_merge(self, runs, tail, by, ascending, null_ranks
                     ) -> Iterator[ColumnarBatch]:
         import heapq
 
         # k-way merge over sorted sources: rows keyed by a tuple that
-        # encodes asc/desc and the shared na_position per column
+        # encodes asc/desc and the per-key null rank
         def is_null_scalar(v):
             if v is None:
                 return True
@@ -768,16 +790,13 @@ class CpuFallbackExec(TpuExec):
             except (TypeError, ValueError):
                 return False
 
-        null_rank = 0 if na_position == "first" else 1
-
         def keyify(kr):
             out = []
-            for v, asc in zip(kr, ascending):
+            for v, asc, nr in zip(kr, ascending, null_ranks):
                 if is_null_scalar(v):
-                    out.append((null_rank, 0))
+                    out.append((nr, 0))
                 else:
-                    out.append((1 - null_rank,
-                                v if asc else _Neg(v)))
+                    out.append((1 - nr, v if asc else _Neg(v)))
             return tuple(out)
 
         def rows_of(source):

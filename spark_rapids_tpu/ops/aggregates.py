@@ -129,8 +129,22 @@ def _keys_equal_prev(sorted_keys: Sequence[ColVal], capacity: int):
 @dataclasses.dataclass(frozen=True)
 class BufferSpec:
     """One reduction buffer: how to seed it from input and re-reduce it."""
-    kind: str          # 'sum' | 'min' | 'max' | 'count' | 'first' | 'last'
+    kind: str          # 'sum' | 'min' | 'max' | 'count' | 'first' |
+    #                    'last' | 'first_any' | 'last_any'
     dtype: DataType
+
+
+def merge_kind(update_kind: str) -> str:
+    """Reduction kind applied when re-reducing PARTIAL buffer rows
+    (chunked merge and the mesh exchange).  The one mapping both the
+    single-host merge (exec/aggregate.py) and the distributed merge
+    (parallel/distributed.py) import — the *_any update kinds collapse
+    to plain first/last because their partial validity means
+    "observed >=1 live row" (presence), and first-present IS the
+    ignoreNulls=false merge rule."""
+    return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+            "first": "first", "last": "last",
+            "first_any": "first", "last_any": "last"}[update_kind]
 
 
 class AggregateFunction:
@@ -397,21 +411,52 @@ class First(AggregateFunction):
     def result_dtype(self):
         return self.child.dtype
 
+    _any_kind = "first_any"
+
+    def cache_key(self):
+        # the buffer schema depends on _classic, so jit-cache keys must
+        # distinguish ignoreNulls and child nullability
+        return (type(self).__name__, self._classic,
+                self.child.cache_key() if self.child is not None else None)
+
     def buffers(self):
-        return [BufferSpec("first", self.child.dtype)]
+        # Spark default ignoreNulls=false: the group's first ROW wins,
+        # null or not.  Two buffers: the value at the first live row
+        # (buffer validity = "this partial observed >=1 live row", so a
+        # filtered-empty partial can never win the merge) plus the
+        # selected row's validity bit as a VALUE.  Merge reduces both
+        # with plain first/last over partial presence.  With
+        # ignoreNulls the single classic first-valid buffer suffices.
+        if self._classic:
+            return [BufferSpec(self.name, self.child.dtype)]
+        return [BufferSpec(self._any_kind, self.child.dtype),
+                BufferSpec(self._any_kind, dts.BOOL)]
+
+    @property
+    def _classic(self) -> bool:
+        """Single first-valid buffer suffices: ignoreNulls requested, or
+        the child is statically non-nullable (first-valid == first-row)."""
+        return self.ignore_nulls or not self.child.nullable
 
     def update_inputs(self, c, capacity):
-        return [c]
+        if self._classic:
+            return [c]
+        vbit = c.validity if c.validity is not None else \
+            jnp.ones(capacity, dtype=jnp.bool_)
+        return [ColVal(c.dtype, c.values, None),
+                ColVal(dts.BOOL, vbit, None)]
 
     def finalize(self, bufs):
-        return bufs[0]
+        if self._classic:
+            return bufs[0]
+        v, bit = bufs
+        validity = combine_validity(v.validity, bit.values)
+        return ColVal(v.dtype, v.values, validity)
 
 
 class Last(First):
     name = "last"
-
-    def buffers(self):
-        return [BufferSpec("last", self.child.dtype)]
+    _any_kind = "last_any"
 
 
 # ------------------------------------------------------------ reduction cores
@@ -455,6 +500,28 @@ def _segment_reduce(kind: str, c: ColVal, seg_ids, num_segments: int,
             best = jax.ops.segment_max(pick, seg_ids, num_segments=num_segments)
         safe = jnp.clip(best, 0, n - 1).astype(jnp.int32)
         out = c.values[safe]
+    elif kind in ("first_any", "last_any"):
+        # ignoreNulls=false update: the first/last LIVE row wins
+        # regardless of value validity.  counts = LIVE rows, so the
+        # buffer's validity means "this partial observed any row"
+        # (presence) — the merge then reduces with plain first/last
+        # over presence and First.finalize re-applies the selected
+        # row's validity bit from the companion buffer.
+        n = c.values.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        if kind == "first_any":
+            pick = jnp.where(valid_rows, idx, n)
+            best = jax.ops.segment_min(pick, seg_ids,
+                                       num_segments=num_segments)
+        else:
+            pick = jnp.where(valid_rows, idx, -1)
+            best = jax.ops.segment_max(pick, seg_ids,
+                                       num_segments=num_segments)
+        safe = jnp.clip(best, 0, n - 1).astype(jnp.int32)
+        out = c.values[safe]
+        counts = jax.ops.segment_sum(
+            valid_rows.astype(jnp.int64), seg_ids,
+            num_segments=num_segments)
     else:
         raise ValueError(f"unknown reduce kind {kind}")
     return out, counts
@@ -584,15 +651,24 @@ def _segment_reduce_coded(kind: str, c: ColVal, code, ns: int,
     the value column — an int32 pass (or none) replaces the full-width
     ``where`` pass per buffer.  ``counts_of(validity)`` returns (cached)
     per-slot live counts for a validity array."""
+    capacity = code.shape[0]
+    vals = c.values
+    if getattr(vals, "ndim", 0) == 0:
+        vals = jnp.broadcast_to(vals, (capacity,))
+    if kind in ("first_any", "last_any"):
+        # ignoreNulls=false update: route by the LIVE code (null-valued
+        # rows stay in their group); counts = live rows (presence)
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        seg_op = jax.ops.segment_min if kind == "first_any" \
+            else jax.ops.segment_max
+        best = seg_op(idx, code, num_segments=ns)
+        safe = jnp.clip(best, 0, capacity - 1)
+        return vals[safe][: ns - 1], counts_of(None, code)
     if c.validity is not None:
         bcode = jnp.where(c.validity, code, ns - 1)
     else:
         bcode = code
     counts = counts_of(c.validity, bcode)
-    capacity = code.shape[0]
-    vals = c.values
-    if getattr(vals, "ndim", 0) == 0:
-        vals = jnp.broadcast_to(vals, (capacity,))
     if kind == "sum":
         out = jax.ops.segment_sum(vals, bcode, num_segments=ns)
     elif kind == "min":
@@ -754,10 +830,14 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
     for kind, c in buffer_inputs:
         contrib_valid = valid_rows if c.validity is None else \
             jnp.logical_and(valid_rows, c.validity)
-        vkey = id(c.validity) if c.validity is not None else None
+        # *_any kinds count LIVE rows (presence), not valid values
+        vkey = id(c.validity) if (
+            c.validity is not None and
+            kind not in ("first_any", "last_any")) else None
         if vkey not in count_slot:
             count_slot[vkey] = add_slot(
-                contrib_valid.astype(jnp.int64), jnp.int64(0), "add")
+                (contrib_valid if vkey is not None else valid_rows
+                 ).astype(jnp.int64), jnp.int64(0), "add")
         v = c.values
         if getattr(v, "ndim", 0) == 0:
             v = jnp.broadcast_to(v, (capacity,))
@@ -779,6 +859,16 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
             else:
                 slot = add_slot(jnp.where(contrib_valid, idx, -1),
                                 jnp.int64(-1), "max")
+        elif kind in ("first_any", "last_any"):
+            # ignoreNulls=false: pick by row liveness alone (the count
+            # slot above already rides liveness via vkey=None)
+            idx = jnp.arange(capacity, dtype=jnp.int64)
+            if kind == "first_any":
+                slot = add_slot(jnp.where(valid_rows, idx, capacity),
+                                jnp.int64(capacity), "min")
+            else:
+                slot = add_slot(jnp.where(valid_rows, idx, -1),
+                                jnp.int64(-1), "max")
         else:
             raise ValueError(f"unknown reduce kind {kind}")
         plan.append((kind, c, vkey, slot))
@@ -799,7 +889,7 @@ def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],
     outs: List[ColVal] = []
     for kind, c, vkey, slot in plan:
         count = res[count_slot[vkey]]
-        if kind in ("first", "last"):
+        if kind in ("first", "last", "first_any", "last_any"):
             best = jnp.clip(res[slot], 0, capacity - 1).astype(jnp.int32)
             v = c.values
             if getattr(v, "ndim", 0) == 0:

@@ -209,8 +209,30 @@ class DistributedAggregate:
                 mv = jax.lax.pmax(
                     jnp.where(valid, v, agg._sentinel("max", v.dtype)),
                     self.axis)
+            elif kind in ("first", "last"):
+                # first/last over shards: the winner is the lowest/
+                # highest shard index holding a VALID (present) partial
+                # — a dead shard (all rows filtered out locally) must
+                # never surface its garbage local value (the keyless
+                # flavor of the dead-partial bug; shard order is global
+                # row order because shards are contiguous leading-axis
+                # chunks)
+                idx = jax.lax.axis_index(self.axis)
+                if kind == "first":
+                    rank = jnp.where(valid, idx, self.nshards)
+                    best = jax.lax.pmin(rank, self.axis)
+                else:
+                    rank = jnp.where(valid, idx, -1)
+                    best = jax.lax.pmax(rank, self.axis)
+                pick = jnp.logical_and(valid, rank == best)
+                vz = v.astype(jnp.int8) if v.dtype == jnp.bool_ else v
+                mv = jax.lax.psum(
+                    jnp.where(pick, vz, jnp.zeros((), dtype=vz.dtype)),
+                    self.axis)
+                if v.dtype == jnp.bool_:
+                    mv = mv != 0
             else:
-                mv = v  # first/last over shards: keep local
+                raise ValueError(f"unknown grand-total merge kind {kind}")
             any_valid = jax.lax.pmax(valid.astype(jnp.int8), self.axis) > 0
             merged.append(ColVal(o.dtype, mv, any_valid))
         # finalize per function
@@ -258,9 +280,7 @@ class DistributedAggregate:
                                         n_groups)
 
 
-def _merge_kind(update_kind: str) -> str:
-    return {"sum": "sum", "count": "sum", "min": "min", "max": "max",
-            "first": "first", "last": "last"}[update_kind]
+from spark_rapids_tpu.ops.aggregates import merge_kind as _merge_kind  # noqa: E402
 
 
 def coalesce_buckets(counts, nshards: int):

@@ -125,14 +125,19 @@ expr_rule(C.Size, ts.COMMON)
 expr_rule(C.ArrayContains, ts.COMMON)
 expr_rule(C.GetArrayItem, ts.COMMON)
 expr_rule(C.ElementAt, ts.COMMON)
-expr_rule(C.ArrayMin, ts.ARRAY)
-expr_rule(C.ArrayMax, ts.ARRAY)
+# ArrayMin/ArrayMax output the ELEMENT type (the sig check runs against
+# expr.dtype) — a fixed-width scalar sig both admits the rule and
+# constrains the array's element type to what the segment-reduce kernel
+# handles (round-4 advisor: ts.ARRAY rejected every scalar output, so
+# these silently fell back to CPU).
+expr_rule(C.ArrayMin, ts.BOOLEAN + ts.NUMERIC)
+expr_rule(C.ArrayMax, ts.BOOLEAN + ts.NUMERIC)
 expr_rule(C.Slice, ts.ARRAY)
 expr_rule(C.ArrayRepeat, ts.ARRAY,
           incompat="array_repeat(NULL, n) yields a NULL row, not an "
                    "array of nulls (null elements have no device "
                    "representation)")
-expr_rule(C.Reverse, ts.COMMON,
+expr_rule(C.Reverse, ts.COMMON + ts.ARRAY,
           incompat="string reverse is byte-wise (ASCII-only)")
 
 # nested struct/map (complexTypeCreator/Extractors analog; most of these

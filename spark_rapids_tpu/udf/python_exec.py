@@ -461,11 +461,11 @@ class TpuWindowInPandasExec(TpuExec):
     def _eval_one_group(g: pd.DataFrame, fn, arg: str, orders, frame
                         ) -> pd.Series:
         if orders:
-            g = g.sort_values(
-                [n for n, _, _ in orders],
-                ascending=[not d for _, d, _ in orders],
-                na_position="first" if orders[0][2] else "last",
-                kind="stable")
+            from spark_rapids_tpu.utils.hostsort import sort_per_key_nulls
+            g = sort_per_key_nulls(
+                g, [n for n, _, _ in orders],
+                [not d for _, d, _ in orders],
+                [nf for _, _, nf in orders], reset_index=False)
         s = g[arg].reset_index(drop=True)
         n = len(s)
         out = np.empty(n, dtype=object)

@@ -1,0 +1,36 @@
+"""Host-side (pandas) multi-key sort with PER-KEY null placement.
+
+pandas ``sort_values`` accepts only one ``na_position`` for all keys;
+Spark orders allow NULLS FIRST/LAST per key.  When the placement is
+uniform this is one multi-key call; otherwise stable single-key passes
+compose in reverse key order (classic lexicographic composition).
+Shared by the CPU-fallback external sort (exec/fallback.py) and the
+window-in-pandas group sort (udf/python_exec.py) — the round-4 advisor
+found the same per-key bug independently at both sites.
+"""
+
+from typing import Sequence
+
+import pandas as pd
+
+
+def sort_per_key_nulls(df: pd.DataFrame, names: Sequence[str],
+                       ascending: Sequence[bool],
+                       nulls_first: Sequence[bool],
+                       reset_index: bool = True) -> pd.DataFrame:
+    if len(set(nulls_first)) <= 1:
+        out = df.sort_values(
+            by=list(names), ascending=list(ascending),
+            na_position="first" if (not nulls_first or nulls_first[0])
+            else "last",
+            kind="stable")
+    else:
+        out = df
+        for name, asc, nf in zip(reversed(list(names)),
+                                 reversed(list(ascending)),
+                                 reversed(list(nulls_first))):
+            out = out.sort_values(
+                name, ascending=asc,
+                na_position="first" if nf else "last",
+                kind="stable")
+    return out.reset_index(drop=True) if reset_index else out

@@ -187,3 +187,23 @@ def test_array_sort_key_falls_back(session):
     d = session.create_dataframe({"a": [[1], [2]]})
     tree = session.plan(d.orderBy("a").plan).tree_string()
     assert "CpuFallbackExec" in tree
+
+
+def test_array_min_max_reverse_stay_on_device(session, df):
+    """Round-4 advisor (medium): ArrayMin/ArrayMax were registered with
+    an arrays-only sig checked against their SCALAR output type, so the
+    device segment-reduce kernel was unreachable and every call silently
+    fell back to CPU.  Reverse over arrays had the inverse problem."""
+    for e in (F.array_min("arr"), F.array_max("arr"),
+              F.reverse("arr")):
+        d = df.select(e.alias("o"))
+        tree = session.plan(d.plan).tree_string()
+        assert "CpuFallbackExec" not in tree, tree
+    got = df.select(F.array_min("arr").alias("mn"),
+                    F.array_max("arr").alias("mx")).to_pandas()
+    want_mn = [None if not a else min(a) for a in ARRS]
+    want_mx = [None if not a else max(a) for a in ARRS]
+    assert [None if pd.isna(v) else int(v)
+            for v in got["mn"]] == want_mn
+    assert [None if pd.isna(v) else int(v)
+            for v in got["mx"]] == want_mx

@@ -537,3 +537,21 @@ def test_posexplode_distributed(dist_session, oracle_session):
     d, o = build(dist_session), build(oracle_session)
     _cmp(d, o)
     assert dist_session.last_dist_explain == "distributed"
+
+
+def test_keyless_first_last_dead_shards(dist_session, oracle_session):
+    """Keyless first/last (ignoreNulls=false) across the mesh: shards
+    whose rows are ALL filtered out emit dead partials that must never
+    win the grand-total merge — and a real trailing null must."""
+    n = 16
+    pdf = pd.DataFrame({
+        "p": [1] * 8 + [2] * 8,
+        "v": [5.0] * 8 + [9.0] + [None] * 7,
+    })
+    def q(f, _=None):
+        return f.filter(F.col("p") == 2).agg(
+            F.first("v").alias("f"), F.last("v").alias("l"))
+    d = q(dist_session.create_dataframe(pdf)).to_pandas()
+    o = q(oracle_session.create_dataframe(pdf)).to_pandas()
+    assert d["f"].iloc[0] == o["f"].iloc[0] == 9.0
+    assert pd.isna(d["l"].iloc[0]) and pd.isna(o["l"].iloc[0])

@@ -260,3 +260,59 @@ def test_shift_and_bitwise_fns():
     assert out["sr"].tolist() == [0, 2, 6]
     assert out["bn"].tolist() == [-2, -5, -13]
     assert out["pm"].tolist() == [1, 4, 2]
+
+
+def test_first_last_keep_nulls_on_device():
+    """Spark first/last default ignoreNulls=false: the group's first/
+    last ROW wins, null or not — exercised through the coded group-by,
+    the sorted group-by (string keys), and the keyless reduction."""
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession()
+    df = session.create_dataframe(
+        {"g": [1, 1, 2, 2], "ks": ["a", "a", "b", "b"],
+         "v": [None, 10.0, None, 7.0]})
+    for keys in (["g"], ["ks"]):
+        out = df.groupBy(*keys).agg(
+            F.first("v").alias("f"), F.last("v").alias("l"),
+            F.first("v", ignore_nulls=True).alias("fi"),
+            F.last("v", ignore_nulls=True).alias("li")).to_pandas()
+        out = out.sort_values(keys[0], ignore_index=True)
+        assert out["f"].isna().all(), keys     # leading nulls kept
+        assert out["l"].tolist() == [10.0, 7.0]
+        assert out["fi"].tolist() == [10.0, 7.0]
+        assert out["li"].tolist() == [10.0, 7.0]
+    keyless = df.agg(F.first("v").alias("f"),
+                     F.last("v").alias("l")).to_pandas()
+    assert pd.isna(keyless["f"].iloc[0]) and keyless["l"].iloc[0] == 7.0
+    # strings: leading null string survives as the group's first
+    sdf = session.create_dataframe({"g": [1, 1, 2], "s": [None, "b", "c"]})
+    sout = sdf.groupBy("g").agg(F.first("s").alias("f")).to_pandas()
+    sout = sout.sort_values("g", ignore_index=True)
+    assert sout["f"].iloc[0] is None or pd.isna(sout["f"].iloc[0])
+    assert sout["f"].iloc[1] == "c"
+
+
+def test_first_dead_partial_does_not_win():
+    """A chunk whose rows are all filtered out emits a partial with
+    validity=False; the keyless ignoreNulls=false merge must not
+    mistake that dead partial for a legitimate null first row."""
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.plan import logical as L
+    session = TpuSession()
+    b1 = ColumnarBatch.from_pydict({"p": [1, 1], "v": [5.0, 6.0]})
+    b2 = ColumnarBatch.from_pydict({"p": [2, 2], "v": [9.0, None]})
+    rel = L.InMemoryRelation([b1, b2], b1.schema)
+    df = DataFrame(session, rel)
+    got = df.filter(F.col("p") == 2).agg(
+        F.first("v").alias("f"), F.last("v").alias("l")).to_pandas()
+    assert got["f"].iloc[0] == 9.0          # batch-1 dead partial skipped
+    assert pd.isna(got["l"].iloc[0])        # real trailing null kept
+    # grouped flavor through the same multi-batch pipeline
+    gg = df.filter(F.col("p") == 2).groupBy("p").agg(
+        F.first("v").alias("f")).to_pandas()
+    assert gg["f"].iloc[0] == 9.0

@@ -192,8 +192,10 @@ def _fb_sort(batches, orders_cols, descending=None, nulls_first=True,
     scan = TpuScanExec(batches, batches[0].schema)
     rel = L.InMemoryRelation(batches, batches[0].schema)
     descending = descending or [False] * len(orders_cols)
-    orders = [(F.col(c).expr.bind(rel.schema), d, nulls_first)
-              for c, d in zip(orders_cols, descending)]
+    if not isinstance(nulls_first, (list, tuple)):
+        nulls_first = [nulls_first] * len(orders_cols)
+    orders = [(F.col(c).expr.bind(rel.schema), d, nf)
+              for c, d, nf in zip(orders_cols, descending, nulls_first)]
     node = L.Sort(orders, rel)
     fb = CpuFallbackExec(node, [scan])
     if run_rows is not None:
@@ -261,3 +263,48 @@ def test_sort_external_cleans_tmpdir_on_early_stop(tmp_path, monkeypatch):
     it.close()        # consumer stops early
     assert not list(tmp_path.glob("tpu-fbsort-*")), \
         list(tmp_path.iterdir())
+
+
+def test_sort_external_per_key_null_position():
+    """Round-4 advisor: the merge keyify applied orders[0]'s nulls flag
+    to every key.  Primary key nulls-last, secondary key nulls-first
+    must hold in BOTH the in-memory and external-merge paths."""
+    batches = [
+        ColumnarBatch.from_pydict({"a": [1.0, None, 1.0, 2.0],
+                                   "b": [5.0, 1.0, None, None]}),
+        ColumnarBatch.from_pydict({"a": [2.0, 1.0, None, 2.0],
+                                   "b": [3.0, 2.0, 9.0, 1.0]}),
+    ]
+    for rr in (None, 3):
+        got = _fb_sort(batches, ["a", "b"], nulls_first=[False, True],
+                       run_rows=rr)
+        rows = [(None if pd.isna(a) else a, None if pd.isna(b) else b)
+                for a, b in zip(got["a"], got["b"])]
+        assert rows == [(1.0, None), (1.0, 2.0), (1.0, 5.0),
+                        (2.0, None), (2.0, 1.0), (2.0, 3.0),
+                        (None, 1.0), (None, 9.0)], (rr, rows)
+
+
+def test_fallback_first_last_keep_nulls():
+    """Spark first/last default ignoreNulls=false: a leading/trailing
+    null is the answer.  Round-4 advisor: _agg_update dropna()d
+    unconditionally."""
+    batches = [
+        ColumnarBatch.from_pydict({"g": [1, 1, 2],
+                                   "v": [None, 10.0, None]}),
+        ColumnarBatch.from_pydict({"g": [2, 1], "v": [7.0, None]}),
+    ]
+    scan = TpuScanExec(batches, batches[0].schema)
+    rel = L.InMemoryRelation(batches, batches[0].schema)
+    aggs = [F.first("v").alias("f").expr,
+            F.last("v").alias("l").expr,
+            F.first("v", ignore_nulls=True).alias("fi").expr,
+            F.last("v", ignore_nulls=True).alias("li").expr]
+    node = L.Aggregate([F.col("g").expr], aggs, rel)
+    fb = CpuFallbackExec(node, [scan])
+    got = to_pandas(fb)
+    by = {int(r.g): r for r in got.itertuples()}
+    assert pd.isna(by[1].f) and pd.isna(by[1].l)        # null first+last
+    assert by[1].fi == 10.0 and by[1].li == 10.0
+    assert pd.isna(by[2].f) and by[2].l == 7.0
+    assert by[2].fi == 7.0 and by[2].li == 7.0

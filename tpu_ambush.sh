@@ -1,0 +1,48 @@
+#!/bin/bash
+# Continuous TPU ambush loop (VERDICT r4 Next #1): probe every cycle with
+# a short timeout; the moment jax.devices() answers with a TPU, fire
+# tpu_capture.py.  Exits 0 on a successful capture (BENCH_tpu_capture.json
+# with device=tpu and a nonzero value), 1 when MAX_SECONDS elapse.
+#
+# Each dark probe costs ~PROBE_TIMEOUT of a hung subprocess — cheap.
+# Logs to tpu_ambush.log.
+
+set -u
+cd "$(dirname "$0")"
+MAX_SECONDS=${MAX_SECONDS:-39600}   # 11h
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-50}
+CAPTURE_TIMEOUT=${CAPTURE_TIMEOUT:-900}
+LOG=tpu_ambush.log
+T0=$(date +%s)
+
+log() { echo "ambush[$(( $(date +%s) - T0 ))s]: $*" >> "$LOG"; }
+
+log "start (probe ${PROBE_TIMEOUT}s, capture ${CAPTURE_TIMEOUT}s, max ${MAX_SECONDS}s)"
+n=0
+while true; do
+  now=$(date +%s)
+  if (( now - T0 > MAX_SECONDS )); then
+    log "budget exhausted after $n probes; giving up"
+    exit 1
+  fi
+  n=$((n+1))
+  plat=$(timeout "$PROBE_TIMEOUT" python -c \
+    'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)
+  if [ "$plat" = "tpu" ]; then
+    log "probe #$n LIVE — firing capture"
+    timeout "$CAPTURE_TIMEOUT" python tpu_capture.py >> "$LOG" 2>&1
+    rc=$?
+    log "capture rc=$rc"
+    if python - <<'EOF' 2>/dev/null
+import json, sys
+d = json.load(open("BENCH_tpu_capture.json"))
+sys.exit(0 if d.get("device") == "tpu" and d.get("value", 0) > 0 else 1)
+EOF
+    then
+      log "capture SUCCESS"
+      exit 0
+    fi
+    log "capture incomplete; continuing to probe"
+  fi
+  sleep 15
+done

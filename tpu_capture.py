@@ -1,0 +1,197 @@
+"""TPU live-window capture: run the moment the axon tunnel is up.
+
+The chip appears in ~5-minute windows (NOTES_r4.md); four rounds have
+produced zero captured TPU numbers.  This script is the pre-warmed
+"ambush" payload (VERDICT round 4, Next #1): given a live device it
+executes, in priority order, saving artifacts incrementally so a window
+that dies mid-way still leaves evidence:
+
+  (a) TPC-H q6 + q1-shaped coded group-by  -> BENCH_tpu_capture.json
+  (b) both Pallas kernels executed for real -> same file, "pallas" key
+  (c) CBO calibration with TPU provenance   -> plan/cbo_weights.json
+  (d) a jax profiler trace for MFU analysis -> tpu_trace/ dir
+
+Each phase is wrapped so a tunnel death mid-phase keeps earlier
+results.  Run under a timeout from tpu_ambush.sh; never probes — the
+caller already did.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(REPO, "BENCH_tpu_capture.json")
+_T0 = time.monotonic()
+
+state = {"captured_at_s": 0.0, "phases": []}
+
+
+def log(msg):
+    print(f"capture[{time.monotonic() - _T0:6.1f}s]: {msg}",
+          file=sys.stderr, flush=True)
+
+
+def save():
+    state["captured_at_s"] = round(time.monotonic() - _T0, 1)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def phase(name):
+    def deco(fn):
+        def run(*a, **k):
+            t0 = time.monotonic()
+            try:
+                fn(*a, **k)
+                state["phases"].append(
+                    {"name": name, "ok": True,
+                     "s": round(time.monotonic() - t0, 1)})
+                log(f"phase {name} ok ({time.monotonic() - t0:.1f}s)")
+            except Exception as e:  # noqa: BLE001 - salvage everything
+                state["phases"].append(
+                    {"name": name, "ok": False, "error": repr(e)[:300],
+                     "s": round(time.monotonic() - t0, 1)})
+                log(f"phase {name} FAILED: {e!r}")
+            save()
+        return run
+    return deco
+
+
+def main():
+    sys.path.insert(0, REPO)
+    import jax
+    dev = jax.devices()[0]
+    state["device"] = dev.platform
+    state["device_kind"] = getattr(dev, "device_kind", "?")
+    state["n_devices"] = len(jax.devices())
+    save()
+    log(f"device: {dev.platform}:{state['device_kind']}")
+    if dev.platform != "tpu":
+        log("not a TPU; aborting (ambush mis-probe)")
+        state["error"] = "not_tpu"
+        save()
+        return
+
+    import numpy as np
+
+    import bench as B
+    from spark_rapids_tpu.api.session import TpuSession
+
+    session = TpuSession()
+
+    # ---- (a) headline bench: q6 + coded group-by ----------------------
+    @phase("bench_q6_q1")
+    def bench_phase():
+        small = B.gen_host(1 << 16)
+        eng, _ = B.time_query(
+            B.make_q6(session, session.create_dataframe(small)),
+            budget=5.0, max_iters=1)
+        ref, _ = B.pandas_q6(small, max_iters=1)
+        rel = abs(eng - ref) / max(abs(ref), 1e-9)
+        state["correctness"] = "ok" if rel < 1e-6 else f"rel={rel:.2e}"
+        save()
+
+        pd_n = 1 << 21
+        data = B.gen_host(pd_n)
+        _, t6 = B.pandas_q6(data, max_iters=2)
+        _, t1 = B.pandas_q1(data, max_iters=2)
+        q6_base, q1_base = pd_n / t6, pd_n / t1
+        state["pandas_q6_rows_per_sec"] = round(q6_base)
+        state["pandas_q1_rows_per_sec"] = round(q1_base)
+        del data
+        save()
+
+        for shift in (22, 24, 26):
+            n = 1 << shift
+            batch = B.gen_device_batch(n)
+            df = session.create_dataframe(batch)
+            r6, t6 = B.time_query(B.make_q6(session, df), budget=10.0)
+            assert np.isfinite(r6) and r6 > 0
+            state.update(metric="tpch_q6_rows_per_sec",
+                         value=round(n / t6), unit="rows/s",
+                         vs_baseline=round(n / t6 / q6_base, 3))
+            save()
+            log(f"q6 n=2^{shift}: {n / t6 / 1e6:.1f}M rows/s "
+                f"({state['vs_baseline']}x pandas)")
+            r1, t1 = B.time_query(B.make_q1(session, df), budget=10.0)
+            assert len(r1) == 6
+            state["groupby_rows_per_sec"] = round(n / t1)
+            state["groupby_vs_baseline"] = round(n / t1 / q1_base, 3)
+            save()
+            log(f"q1 n=2^{shift}: {n / t1 / 1e6:.1f}M rows/s "
+                f"({state['groupby_vs_baseline']}x pandas)")
+
+    bench_phase()
+
+    # ---- (b) Pallas kernels on silicon --------------------------------
+    @phase("pallas")
+    def pallas_phase():
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops import pallas_kernels as pk
+        n = 1 << 20
+        rng = np.random.default_rng(0)
+        pids = jnp.asarray(rng.integers(0, 64, n).astype(np.int32))
+        mask = jnp.asarray(rng.random(n) < 0.9)
+        got = np.asarray(pk.partition_histogram(pids, mask, 64))
+        want = np.asarray(pk.partition_histogram_xla(pids, mask, 64))
+        assert (got == want).all(), "partition_histogram mismatch"
+        t0 = time.perf_counter()
+        pk.partition_histogram(pids, mask, 64)[0].block_until_ready()
+        hist_ms = (time.perf_counter() - t0) * 1e3
+
+        vals = [jnp.asarray(rng.uniform(-10, 10, n)) for _ in range(4)]
+        vmask = [jnp.asarray(rng.random(n) < 0.95) for _ in range(4)]
+        g2 = pk.masked_multi_reduce(vals, vmask, mask)
+        w2 = pk.masked_multi_reduce_xla(vals, vmask, mask)
+        for a, b in zip(np.asarray(g2).ravel(), np.asarray(w2).ravel()):
+            assert abs(a - b) / max(abs(b), 1e-9) < 1e-6
+        t0 = time.perf_counter()
+        jax.block_until_ready(pk.masked_multi_reduce(vals, vmask, mask))
+        reduce_ms = (time.perf_counter() - t0) * 1e3
+        state["pallas"] = {"partition_histogram_ms": round(hist_ms, 3),
+                           "masked_multi_reduce_ms": round(reduce_ms, 3),
+                           "used_pallas": bool(pk.use_pallas()),
+                           "verified": True}
+
+    pallas_phase()
+
+    # ---- (c) CBO calibration with TPU provenance ----------------------
+    @phase("cbo_calibrate")
+    def cbo_phase():
+        from spark_rapids_tpu.tools import cbo_calibrate as cc
+        result = cc.calibrate(n=1 << 19)
+        out = cc.DEFAULT_OUT
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        state["cbo_weights"] = {
+            "platform": result["provenance"]["platform"],
+            "n_ops": len(result["weights"])}
+
+    cbo_phase()
+
+    # ---- (d) profiler trace for MFU -----------------------------------
+    @phase("profiler_trace")
+    def trace_phase():
+        trace_dir = os.path.join(REPO, "tpu_trace")
+        batch = B.gen_device_batch(1 << 24)
+        df = session.create_dataframe(batch)
+        q = B.make_q6(session, df)
+        q()  # warm
+        with jax.profiler.trace(trace_dir):
+            q()
+        state["trace_dir"] = trace_dir
+
+    trace_phase()
+    state["done"] = True
+    save()
+    log("capture complete")
+
+
+if __name__ == "__main__":
+    main()
