@@ -144,6 +144,22 @@ class WindowExpression(Expression):
         return f"unknown window function {self.kind}"
 
 
+def group_by_spec(window_exprs):
+    """[(orig_idx, name, we)] groups, one per distinct window spec, in
+    first-appearance order — shared by the single-process converter
+    (plan/overrides._conv_window) and the distributed planner
+    (dist_planner._window) so both split multi-spec Window nodes
+    identically."""
+    groups, by_key = [], {}
+    for j, (name, we) in enumerate(window_exprs):
+        k = we.spec.cache_key()
+        if k not in by_key:
+            by_key[k] = len(groups)
+            groups.append([])
+        groups[by_key[k]].append((j, name, we))
+    return groups
+
+
 def eval_window_expr(we: WindowExpression, sp: W.SortedPartitions,
                  c: Optional[ColVal], seg_boundary, capacity: int
                  ) -> Tuple[ColVal, tuple]:

@@ -1348,69 +1348,110 @@ class DistPlanner:
 
     # -- window -----------------------------------------------------------
     def _window(self, plan: L.Window, dry: bool) -> ShardedFrame:
-        """Window as an exchange consumer (GpuWindowExec role): range
-        partition on the PARTITION BY prefix via the distributed sort
-        (a partition never splits a shard), then shard-local windowed
-        evaluation with the single-process kernels."""
+        """Window as an exchange consumer (GpuWindowExec role).
+
+        Expressions are grouped by their window spec; each spec group
+        runs one distributed pass — partitioned specs range-partition on
+        the PARTITION BY prefix (a partition never splits a shard) then
+        evaluate shard-locally; GLOBAL specs (no PARTITION BY) sort
+        globally and fix up with the collective cross-shard carry
+        (parallel/distwindow.DistributedGlobalWindow, the mesh analog of
+        GpuWindowExec.scala:423-446's running-window optimization).
+        Later groups see earlier groups' outputs as ordinary payload
+        columns; the final column order is restored to plan.schema."""
         from spark_rapids_tpu.exec.window import (WindowExpression,
-                                                  WindowSpec)
-        from spark_rapids_tpu.ops import aggregates as agg
-        from spark_rapids_tpu.parallel.distwindow import DistributedWindow
+                                                  WindowSpec,
+                                                  group_by_spec)
+        from spark_rapids_tpu.parallel.distwindow import (
+            DistributedGlobalWindow, DistributedWindow)
         f = self.run(plan.child, dry)
         exprs = plan.window_exprs
-        spec0 = exprs[0][1].spec
-        for _, we in exprs[1:]:
-            if we.spec.cache_key() != spec0.cache_key():
-                raise NotDistributable(
-                    "multiple window specs in one node")
-        if not spec0.partition_exprs:
-            raise NotDistributable(
-                "window without PARTITION BY needs a global cross-shard "
-                "carry")
-        low = ExprLowering(f.enc, self.conf)
-        lspec = WindowSpec(
-            [low.lower(e) for e in spec0.partition_exprs],
-            [(low.lower(e), d, nf) for e, d, nf in spec0.orders],
-            spec0.frame)
-        _check_supported(list(lspec.partition_exprs) +
-                         [e for e, _, _ in lspec.orders], self.conf)
-        lowered = []
-        enc_new = {}
         nchild = len(f.names)
-        for j, (name, we) in enumerate(exprs):
-            reason = we.supported_reason()
-            if reason:
-                raise NotDistributable(f"window {name}: {reason}")
-            ch = None
-            if we.child_expr is not None:
-                ch = low.lower(we.child_expr)
-                _check_supported([ch], self.conf)
-                d = low.out_dict(ch)
-                if d is not None:
-                    if we.kind in ("min", "max", "lead", "lag"):
-                        # order-preserving codes: the output is codes too
-                        enc_new[nchild + j] = d
-                    elif we.kind != "count":  # count reads only validity
-                        raise NotDistributable(
-                            f"window {we.kind} over strings not "
-                            "supported on the mesh")
-            dflt = low.lower(we.default) if we.default is not None \
-                else None
-            lowered.append((name, WindowExpression(
-                we.kind, lspec, ch, we.offset, dflt)))
+        groups = group_by_spec(exprs)
+
         names = [n for n, _ in plan.schema]
         log_dtypes = [dt for _, dt in plan.schema]
-        enc = dict(f.enc)
-        enc.update(enc_new)
+        cur_names = list(f.names)
+        cur_dts = list(f.log_dtypes)
+        cur_enc = dict(f.enc)
+        cur_cols, cur_nrows = f.cols, f.nrows
+        appended_pos: Dict[int, int] = {}
+        for grp in groups:
+            spec0 = grp[0][2].spec
+            is_global = not spec0.partition_exprs
+            low = ExprLowering(cur_enc, self.conf)
+            lspec = WindowSpec(
+                [low.lower(e) for e in spec0.partition_exprs],
+                [(low.lower(e), d, nf) for e, d, nf in spec0.orders],
+                spec0.frame)
+            _check_supported(list(lspec.partition_exprs) +
+                             [e for e, _, _ in lspec.orders], self.conf)
+            lowered = []
+            enc_new = {}
+            base = len(cur_names)
+            for i, (j, name, we) in enumerate(grp):
+                reason = we.supported_reason()
+                if reason:
+                    raise NotDistributable(f"window {name}: {reason}")
+                if is_global:
+                    fr = we.spec.frame
+                    if we.kind in ("lead", "lag"):
+                        raise NotDistributable(
+                            "global lead/lag needs a cross-shard halo "
+                            "exchange")
+                    if we.kind in ("sum", "count", "avg") and not (
+                            fr.lo is None and fr.hi in (0, None)):
+                        raise NotDistributable(
+                            "global window frames with finite row "
+                            "offsets need a cross-shard halo exchange")
+                ch = None
+                if we.child_expr is not None:
+                    ch = low.lower(we.child_expr)
+                    _check_supported([ch], self.conf)
+                    d = low.out_dict(ch)
+                    if d is not None:
+                        if we.kind in ("min", "max", "lead", "lag"):
+                            # order-preserving codes: output is codes too
+                            enc_new[base + i] = d
+                        elif we.kind != "count":  # count: validity only
+                            raise NotDistributable(
+                                f"window {we.kind} over strings not "
+                                "supported on the mesh")
+                dflt = low.lower(we.default) if we.default is not None \
+                    else None
+                lowered.append((name, WindowExpression(
+                    we.kind, lspec, ch, we.offset, dflt)))
+            phys_before = [_phys(dt) for dt in cur_dts]
+            for i, (j, name, we) in enumerate(grp):
+                appended_pos[j] = base + i
+                cur_names.append(name)
+                cur_dts.append(log_dtypes[nchild + j])
+            cur_enc.update(enc_new)
+            if not dry:
+                cls = DistributedWindow if not is_global \
+                    else DistributedGlobalWindow
+                dist = cls(self.mesh, phys_before, lowered)
+                cols, nrows2 = dist(cur_cols, cur_nrows)
+                cur_cols = list(cols)
+                cur_nrows = jnp.asarray(nrows2).reshape(-1)
+                self._emit_stats("window", dist.last_stats,
+                                 window_global=is_global)
+
+        # restore plan.schema order: child columns stay first, window
+        # columns return to their original expression order
+        perm = list(range(nchild)) + \
+            [appended_pos[j] for j in range(len(exprs))]
+        enc = {o: d for o, d in cur_enc.items() if o < nchild}
+        inv = {p: nchild + j for j, p in appended_pos.items()}
+        for p, d in cur_enc.items():
+            if p >= nchild and p in inv:
+                enc[inv[p]] = d
         if dry:
             return ShardedFrame(self.mesh, names, log_dtypes, None, None,
                                 enc)
-        dist = DistributedWindow(self.mesh, f.phys_dtypes, lowered)
-        out = dist(f.cols, f.nrows)
-        cols, nrows = out
-        self._emit_stats("window", dist.last_stats)
-        return ShardedFrame(self.mesh, names, log_dtypes, list(cols),
-                            nrows.reshape(-1), enc)
+        out_cols = [cur_cols[p] for p in perm]
+        return ShardedFrame(self.mesh, names, log_dtypes, out_cols,
+                            cur_nrows, enc)
 
     # -- expand / union ---------------------------------------------------
     def _expand(self, plan, dry: bool) -> ShardedFrame:

@@ -21,11 +21,410 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from spark_rapids_tpu.columnar import dtypes as dts
 from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import window as W
 from spark_rapids_tpu.ops.aggregates import widen_colval
 from spark_rapids_tpu.ops.expressions import ColVal, EmitContext
 from spark_rapids_tpu.parallel.distsort import DistributedSort
+
+
+def _key_eq(a_vals, a_valid, b_vals, b_valid):
+    """Spark-order equality of two gathered key scalars/vectors: nulls
+    equal each other, NaN equals NaN (peers), else value equality."""
+    if jnp.issubdtype(a_vals.dtype, jnp.floating):
+        veq = jnp.logical_or(
+            a_vals == b_vals,
+            jnp.logical_and(jnp.isnan(a_vals), jnp.isnan(b_vals)))
+    else:
+        veq = a_vals == b_vals
+    both_valid = jnp.logical_and(a_valid, b_valid)
+    both_null = jnp.logical_and(jnp.logical_not(a_valid),
+                                jnp.logical_not(b_valid))
+    return jnp.logical_or(jnp.logical_and(both_valid, veq), both_null)
+
+
+class DistributedGlobalWindow:
+    """Window WITHOUT partition by across the mesh: one global partition
+    spanning every shard, evaluated with a collective cross-shard carry.
+
+    The reference's running-window optimization carries running state
+    across batches on one device (GpuWindowExec.scala:423-446 fixup);
+    the mesh analog: globally range-partition + locally sort by the
+    ORDER BY keys (shards hold contiguous chunks of the global order),
+    evaluate every window expression shard-locally, then fix up with
+    gathered per-shard statistics — an exclusive prefix combine for
+    running frames, order-key tie CHAINS across shard boundaries for
+    rank/dense_rank and RANGE frames (a tie run may span any number of
+    shards), and a plain psum/pmin/pmax for whole-partition frames.
+
+    Supported kinds: row_number, rank, dense_rank, percent_rank, and
+    sum/count/avg/min/max over running (UNBOUNDED PRECEDING..CURRENT
+    ROW, rows or range) or whole-partition frames.  lead/lag and
+    finite rows-frame offsets would need a halo exchange — the planner
+    rejects them (NotDistributable) before building this.
+    """
+
+    def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
+                 window_exprs: Sequence[Tuple[str, "WindowExpression"]]):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.nshards = mesh.devices.size
+        self.in_dtypes = list(in_dtypes)
+        self.window_exprs = list(window_exprs)
+        spec = self.window_exprs[0][1].spec
+        self.spec = spec
+        if spec.partition_exprs:
+            raise ValueError("DistributedGlobalWindow is the "
+                             "no-PARTITION-BY path")
+        sort_keys = [e for e, _, _ in spec.orders]
+        self.sort = DistributedSort(
+            mesh, in_dtypes, sort_keys,
+            [d for _, d, _ in spec.orders],
+            [n for _, _, n in spec.orders]) if sort_keys else None
+        self._cached_jit = cached_jit
+        self._sig = ("dist_gwindow", tuple(mesh.axis_names),
+                     tuple(mesh.devices.shape),
+                     tuple(str(d) for d in mesh.devices.flat),
+                     tuple(dt.name for dt in self.in_dtypes),
+                     tuple(we.cache_key()
+                           for _, we in self.window_exprs))
+        self.last_stats: Optional[dict] = None
+
+    # -- cross-shard tie chains -------------------------------------------
+    def _gather_key_edges(self, order, nrows, cap):
+        """Per order key column: (first, last) gathered values+validity
+        per shard, forward-filled through EMPTY shards so pairwise
+        equality composes across them; plus eqpair[u] = shard u's last
+        key ties with shard u+1's first key."""
+        n = self.nshards
+        g_n = jax.lax.all_gather(nrows, self.axis)       # (n,)
+        empty = g_n == 0
+        last_i = jnp.clip(nrows - 1, 0, cap - 1)
+        eqpair = jnp.ones(max(n - 1, 1), dtype=jnp.bool_)
+        for c in order:
+            v, val = c.values, c.validity
+            if val is None:
+                val = jnp.ones(cap, dtype=jnp.bool_)
+            fv = jax.lax.all_gather(v[0], self.axis)
+            fb = jax.lax.all_gather(val[0], self.axis)
+            lv = jax.lax.all_gather(v[last_i], self.axis)
+            lb = jax.lax.all_gather(val[last_i], self.axis)
+            # forward-fill last-edge through empty shards; an empty
+            # shard's first edge inherits the fill too, so eqpair
+            # composes across it; track whether any real row exists
+            # at-or-before each shard (no spurious ties off garbage)
+            lv_f, lb_f = [lv[0]], [lb[0]]
+            fv_f, fb_f = [fv[0]], [fb[0]]
+            exists = [jnp.logical_not(empty[0])]
+            for k in range(1, n):
+                lv_f.append(jnp.where(empty[k], lv_f[k - 1], lv[k]))
+                lb_f.append(jnp.where(empty[k], lb_f[k - 1], lb[k]))
+                fv_f.append(jnp.where(empty[k], lv_f[k - 1], fv[k]))
+                fb_f.append(jnp.where(empty[k], lb_f[k - 1], fb[k]))
+                exists.append(jnp.logical_or(exists[k - 1],
+                                             jnp.logical_not(empty[k])))
+            if n > 1:
+                pair = jnp.stack([
+                    jnp.logical_and(
+                        _key_eq(lv_f[u], lb_f[u], fv_f[u + 1],
+                                fb_f[u + 1]),
+                        exists[u])
+                    for u in range(n - 1)])
+                eqpair = jnp.logical_and(eqpair, pair)
+        return g_n, empty, eqpair
+
+    def _chains(self, eqpair, fully):
+        """chain[j][t] (j<t): shard t's leading order-key run is a
+        continuation of shard j's trailing run — every boundary in
+        between ties and every interior shard is one single run."""
+        n = self.nshards
+        chain = [[None] * n for _ in range(n)]
+        for j in range(n - 1):
+            acc = eqpair[j]
+            chain[j][j + 1] = acc
+            for t in range(j + 2, n):
+                acc = jnp.logical_and(
+                    acc, jnp.logical_and(fully[t - 1], eqpair[t - 1]))
+                chain[j][t] = acc
+        return chain
+
+    @staticmethod
+    def _masked_sum(g, mask_rows):
+        return jnp.sum(jnp.where(mask_rows, g, jnp.zeros((), g.dtype)))
+
+    def _step(self, flat_cols, nrows_arr):
+        from spark_rapids_tpu.exec.window import (_boundaries,
+                                                  eval_window_expr)
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, self.in_dtypes)]
+        cap = cols[0].values.shape[0]
+        n = self.nshards
+        ctx = EmitContext(cols, nrows, cap)
+        order = [widen_colval(e.emit(ctx), cap)
+                 for e, _, _ in self.spec.orders]
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        live = pos < nrows
+        seg_b = jnp.logical_and(live, pos == 0)   # one global partition
+        run_b = _boundaries(order, live, cap) if order else \
+            jnp.zeros(cap, dtype=jnp.bool_)
+        sp = W.SortedPartitions(seg_b, run_b, live, cap)
+
+        idx = jax.lax.axis_index(self.axis)
+        shard_rank = jnp.arange(n)
+        before = shard_rank < idx
+        after = shard_rank > idx
+        g_n, empty, eqpair = self._gather_key_edges(order, nrows, cap) \
+            if order else (jax.lax.all_gather(nrows, self.axis),
+                           None, None)
+        rows_before = self._masked_sum(g_n.astype(jnp.int64), before)
+        total_rows = jnp.sum(g_n.astype(jnp.int64))
+        chain = None
+        if order and n > 1:
+            # run structure: count of runs, leading/trailing masks
+            nruns = jnp.sum(jnp.logical_and(
+                jnp.logical_or(run_b, seg_b), live).astype(jnp.int32))
+            fully_l = jax.lax.all_gather(nruns <= 1, self.axis)
+            chain = self._chains(eqpair, fully_l)
+            last_live = jnp.clip(nrows - 1, 0, cap - 1)
+            lead_mask = jnp.logical_and(live, sp.run_start == 0)
+            trail_mask = jnp.logical_and(
+                live, sp.run_end == last_live)
+            trail_mask = jnp.logical_and(trail_mask, nrows > 0)
+            g_trail_len = jax.lax.all_gather(
+                jnp.sum(trail_mask.astype(jnp.int64)), self.axis)
+            # rows of previous shards belonging to MY leading run
+            pre_tied = jnp.zeros((), jnp.int64)
+            for j in range(n - 1):
+                c_js = [chain[j][t] for t in range(j + 1, n)]
+                hit = jnp.zeros((), jnp.bool_)
+                for t, cjt in zip(range(j + 1, n), c_js):
+                    hit = jnp.logical_or(hit, jnp.logical_and(
+                        cjt, t == idx))
+                pre_tied = pre_tied + jnp.where(hit, g_trail_len[j], 0)
+            merged_lead = jnp.zeros((), jnp.bool_)
+            for j in range(n - 1):
+                for t in range(j + 1, n):
+                    merged_lead = jnp.logical_or(
+                        merged_lead,
+                        jnp.logical_and(chain[j][t], t == idx))
+            # an empty shard merges nothing (its gathered edges are
+            # forward-fill artifacts)
+            merged_lead = jnp.logical_and(merged_lead, nrows > 0)
+        else:
+            lead_mask = trail_mask = None
+            pre_tied = jnp.zeros((), jnp.int64)
+            merged_lead = jnp.zeros((), jnp.bool_)
+
+        outs = []
+        for _, we in self.window_exprs:
+            c = None
+            if we.child_expr is not None:
+                c = widen_colval(we.child_expr.emit(ctx), cap)
+            # only kinds whose LOCAL output feeds the carry need the
+            # local kernel; aggregates/percent_rank recompute inside
+            # _fixup from the frame kernels directly
+            if we.kind in ("row_number", "rank", "dense_rank"):
+                out, _ = eval_window_expr(we, sp, c, seg_b, cap)
+            else:
+                out = None
+            out = self._fixup(we, out, sp, c, live, lead_mask,
+                              trail_mask, rows_before, total_rows,
+                              pre_tied, merged_lead, chain, empty,
+                              idx, before, cap)
+            v = out.values
+            if getattr(v, "ndim", 0) == 0:
+                v = jnp.broadcast_to(v, (cap,))
+            valid = out.validity
+            if valid is None:
+                valid = jnp.ones(cap, dtype=jnp.bool_)
+            elif getattr(valid, "ndim", 1) == 0:
+                valid = jnp.broadcast_to(valid, (cap,))
+            outs.append((v, jnp.logical_and(valid, live)))
+        return tuple(flat_cols) + tuple(outs), nrows_arr
+
+    def _fixup(self, we, out, sp, c, live, lead_mask, trail_mask,
+               rows_before, total_rows, pre_tied, merged_lead, chain,
+               empty, idx, before, cap):
+        """Combine shard-local window output with the global carry."""
+        from spark_rapids_tpu.ops.aggregates import _sentinel
+        kind = we.kind
+        f = we.spec.frame
+        n = self.nshards
+        if kind == "row_number":
+            return ColVal(out.dtype,
+                          jnp.where(live, out.values +
+                                    rows_before.astype(out.values.dtype),
+                                    out.values), out.validity)
+        if kind in ("rank", "percent_rank"):
+            # global rank = local rank + rows before this shard, except
+            # rows of a leading run that CONTINUES an earlier shard's
+            # trailing run: their run started pre_tied rows earlier
+            local_rank = out.values if kind == "rank" else \
+                W.rank(sp).values
+            if lead_mask is not None:
+                adj = jnp.where(
+                    jnp.logical_and(lead_mask, merged_lead),
+                    rows_before - pre_tied, rows_before)
+            else:
+                adj = jnp.broadcast_to(rows_before, (cap,))
+            rank_g = local_rank + adj.astype(local_rank.dtype)
+            if kind == "rank":
+                return ColVal(out.dtype,
+                              jnp.where(live, rank_g, local_rank),
+                              out.validity)
+            denom = jnp.maximum(total_rows - 1, 1).astype(jnp.float64)
+            pr = (rank_g.astype(jnp.float64) - 1.0) / denom
+            pr = jnp.where(total_rows <= 1, jnp.zeros_like(pr), pr)
+            return ColVal(we.dtype, jnp.where(live, pr, 0.0), None)
+        if kind == "dense_rank":
+            # local dense + distinct runs in previous shards, counting
+            # each boundary-merged run once
+            rb = jnp.logical_and(sp.run_start == sp.pos, live)
+            my_runs = jnp.sum(rb.astype(jnp.int64))
+            g_runs = jax.lax.all_gather(my_runs, self.axis)
+            g_merged = jax.lax.all_gather(merged_lead, self.axis)
+            distinct_before = self._masked_sum(g_runs, before) - \
+                self._masked_sum(g_merged.astype(jnp.int64), before)
+            dv = out.values + distinct_before.astype(out.values.dtype) \
+                - jnp.where(merged_lead, 1, 0).astype(out.values.dtype)
+            return ColVal(out.dtype, jnp.where(live, dv, out.values),
+                          out.validity)
+
+        whole = f.lo is None and f.hi is None
+        rows_frame = f.kind == "rows"
+        result_dt = we.dtype   # aggregates skip the local kernel
+        if kind in ("sum", "count", "avg"):
+            cin = c if kind != "count" else (c or ColVal(
+                dts.INT64, jnp.ones(cap, dtype=jnp.int64)))
+            vals = cin.values.astype(result_dt.storage) \
+                if kind == "sum" else cin.values
+            if kind == "avg":
+                vals = vals.astype(jnp.float64)
+            valid = live if cin.validity is None else \
+                jnp.logical_and(live, cin.validity)
+            zero = jnp.zeros((), vals.dtype)
+            s_tot = jnp.sum(jnp.where(valid, vals, zero))
+            n_tot = jnp.sum(valid.astype(jnp.int64))
+            g_s = jax.lax.all_gather(s_tot, self.axis)
+            g_c = jax.lax.all_gather(n_tot, self.axis)
+            if whole:
+                s_all = jnp.sum(g_s)
+                n_all = jnp.sum(g_c)
+                return self._sum_result(kind, result_dt,
+                                        jnp.broadcast_to(s_all, (cap,)),
+                                        jnp.broadcast_to(n_all, (cap,)),
+                                        live)
+            cs = self._masked_sum(g_s, before)
+            cn = self._masked_sum(g_c, before)
+            # local running (s, n) per row — recompute cheaply from the
+            # frame formulation the local kernel used
+            s_loc, n_loc = W.frame_sum(
+                sp, ColVal(cin.dtype, vals, cin.validity), None, 0,
+                rows=rows_frame)
+            s2 = s_loc + cs
+            n2 = n_loc + cn
+            if not rows_frame and chain is not None and \
+                    trail_mask is not None:
+                # RANGE running: the trailing tie run extends into
+                # following shards — add their chained leading-run sums
+                lead_s = jnp.sum(jnp.where(
+                    jnp.logical_and(lead_mask, valid), vals, zero))
+                lead_n = jnp.sum(jnp.logical_and(
+                    lead_mask, valid).astype(jnp.int64))
+                g_ls = jax.lax.all_gather(lead_s, self.axis)
+                g_ln = jax.lax.all_gather(lead_n, self.axis)
+                ext_s = jnp.zeros((), vals.dtype)
+                ext_n = jnp.zeros((), jnp.int64)
+                for t in range(1, n):
+                    hit = jnp.zeros((), jnp.bool_)
+                    for j in range(t):
+                        hit = jnp.logical_or(hit, jnp.logical_and(
+                            chain[j][t], j == idx))
+                    ext_s = ext_s + jnp.where(hit, g_ls[t], zero)
+                    ext_n = ext_n + jnp.where(hit, g_ln[t], 0)
+                s2 = jnp.where(trail_mask, s2 + ext_s, s2)
+                n2 = jnp.where(trail_mask, n2 + ext_n, n2)
+            return self._sum_result(kind, result_dt, s2, n2, live)
+
+        if kind in ("min", "max"):
+            op = jnp.minimum if kind == "min" else jnp.maximum
+            valid = live if c.validity is None else \
+                jnp.logical_and(live, c.validity)
+            sent = jnp.asarray(_sentinel(kind, c.values.dtype),
+                               dtype=c.values.dtype)
+            masked = jnp.where(valid, c.values, sent)
+            v_tot = (jnp.min if kind == "min" else jnp.max)(masked)
+            n_tot = jnp.sum(valid.astype(jnp.int64))
+            g_v = jax.lax.all_gather(v_tot, self.axis)
+            g_c = jax.lax.all_gather(n_tot, self.axis)
+            if whole:
+                v_all = (jnp.min if kind == "min" else jnp.max)(g_v)
+                n_all = jnp.sum(g_c)
+                return ColVal(result_dt,
+                              jnp.broadcast_to(v_all, (cap,)),
+                              jnp.logical_and(live, n_all > 0))
+            cv = (jnp.min if kind == "min" else jnp.max)(
+                jnp.where(before, g_v, sent))
+            cn = self._masked_sum(g_c, before)
+            v_loc, n_loc = W.running_minmax(
+                sp, c, kind,
+                jnp.logical_and(sp.pos == 0, live))
+            if not rows_frame:
+                v_loc = v_loc[sp.run_end]
+                n_loc = n_loc[sp.run_end]
+            v2 = jnp.where(cn > 0, op(v_loc, cv), v_loc)
+            n2 = n_loc + cn
+            if not rows_frame and chain is not None and \
+                    trail_mask is not None:
+                lead_v = (jnp.min if kind == "min" else jnp.max)(
+                    jnp.where(jnp.logical_and(lead_mask, valid),
+                              c.values, sent))
+                lead_n = jnp.sum(jnp.logical_and(
+                    lead_mask, valid).astype(jnp.int64))
+                g_lv = jax.lax.all_gather(lead_v, self.axis)
+                g_ln = jax.lax.all_gather(lead_n, self.axis)
+                ext_v = sent
+                ext_n = jnp.zeros((), jnp.int64)
+                for t in range(1, self.nshards):
+                    hit = jnp.zeros((), jnp.bool_)
+                    for j in range(t):
+                        hit = jnp.logical_or(hit, jnp.logical_and(
+                            chain[j][t], j == idx))
+                    ext_v = op(ext_v, jnp.where(hit, g_lv[t], sent))
+                    ext_n = ext_n + jnp.where(hit, g_ln[t], 0)
+                v2 = jnp.where(jnp.logical_and(trail_mask, ext_n > 0),
+                               op(v2, ext_v), v2)
+                n2 = jnp.where(trail_mask, n2 + ext_n, n2)
+            return ColVal(result_dt, v2, jnp.logical_and(live, n2 > 0))
+        raise ValueError(f"global distributed window kind {kind}")
+
+    @staticmethod
+    def _sum_result(kind, result_dt, s, ncount, live):
+        if kind == "count":
+            return ColVal(dts.INT64, ncount, live)
+        if kind == "avg":
+            return ColVal(dts.FLOAT64,
+                          s / jnp.maximum(ncount, 1).astype(jnp.float64),
+                          jnp.logical_and(live, ncount > 0))
+        return ColVal(result_dt, s, jnp.logical_and(live, ncount > 0))
+
+    def __call__(self, flat_cols, nrows_per_shard):
+        if self.sort is not None:
+            s_cols, s_n = self.sort(flat_cols, nrows_per_shard)
+            self.last_stats = self.sort.last_stats
+        else:
+            s_cols, s_n = flat_cols, nrows_per_shard
+            self.last_stats = {"sorted": False}
+        out = self._cached_jit(
+            self._sig + ("eval",), lambda: jax.shard_map(
+                self._step, mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))(
+            tuple(s_cols), jnp.asarray(s_n).reshape(-1))
+        return out
 
 
 class DistributedWindow:

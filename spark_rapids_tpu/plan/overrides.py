@@ -719,12 +719,11 @@ def _register_expand_converter():
 _register_expand_converter()
 
 
-@_converter(L.Window)
-def _conv_window(node: L.Window, children, conf):
+def _window_one_spec(window_exprs, child_exec, conf):
     from spark_rapids_tpu.config import rapids_conf as rc
     from spark_rapids_tpu.exec.sort import TpuSortExec
     from spark_rapids_tpu.exec.window import TpuWindowExec
-    spec = node.window_exprs[0][1].spec
+    spec = window_exprs[0][1].spec
     if spec.partition_exprs or spec.orders:
         # Spark plans WindowExec above a SortExec on (partition, order);
         # the sort brings the engine's out-of-core machinery, and the
@@ -734,12 +733,45 @@ def _conv_window(node: L.Window, children, conf):
         orders = [(e, False, True) for e in spec.partition_exprs] + \
             list(spec.orders)
         sort = TpuSortExec(
-            orders, children[0],
+            orders, child_exec,
             ooc_threshold_bytes=conf.get(rc.SORT_OOC_THRESHOLD),
             ooc_window_rows=conf.get(rc.SORT_OOC_WINDOW_ROWS))
-        return TpuWindowExec(node.window_exprs, sort, presorted=True,
+        return TpuWindowExec(window_exprs, sort, presorted=True,
                              batch_rows=conf.get(rc.WINDOW_BATCH_ROWS))
-    return TpuWindowExec(node.window_exprs, children[0])
+    return TpuWindowExec(window_exprs, child_exec)
+
+
+@_converter(L.Window)
+def _conv_window(node: L.Window, children, conf):
+    from spark_rapids_tpu.exec.basic import TpuProjectExec
+    from spark_rapids_tpu.exec.window import group_by_spec
+    from spark_rapids_tpu.ops.expressions import Alias, BoundReference
+    exprs = node.window_exprs
+    nchild = len(children[0].schema)
+    groups = group_by_spec(exprs)
+    if len(groups) == 1:
+        return _window_one_spec(exprs, children[0], conf)
+    # multiple specs: chain one TpuWindowExec per spec (later specs see
+    # earlier outputs as payload; bound ordinals into the child are
+    # unchanged because outputs append at the end), then restore the
+    # node's column order (WindowExecBase handles one spec per exec in
+    # the reference too — Spark splits them the same way)
+    cur = children[0]
+    appended_pos: Dict[int, int] = {}
+    base = nchild
+    for grp in groups:
+        cur = _window_one_spec([(n, we) for _, n, we in grp], cur, conf)
+        for i, (j, _, _) in enumerate(grp):
+            appended_pos[j] = base + i
+        base += len(grp)
+    cur_schema = cur.schema
+    perm = list(range(nchild)) + \
+        [appended_pos[j] for j in range(len(exprs))]
+    projs = []
+    for want_name, p in zip([n for n, _ in node.schema], perm):
+        pname, pdt = cur_schema[p]
+        projs.append(Alias(BoundReference(p, pdt, pname), want_name))
+    return TpuProjectExec(projs, cur)
 
 
 @_converter(L.MapInPandas)

@@ -1,0 +1,212 @@
+"""Global (no PARTITION BY) windows on the mesh + multi-spec window
+nodes — the round-4 verdict's Next #3.
+
+The mesh analog of the reference's running-window optimization
+(GpuWindowExec.scala:423-446): global sort, shard-local evaluation,
+then a collective cross-shard carry with order-key tie CHAINS across
+shard boundaries (a tie run may span any number of shards).  Every
+case is oracle-diffed against the single-process engine, which itself
+is oracle-diffed against pandas elsewhere."""
+
+import numpy as np
+import pandas as pd
+import pandas.testing as pt
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import Window
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture()
+def dist_session(mesh):
+    return TpuSession(mesh=mesh)
+
+
+@pytest.fixture()
+def oracle_session():
+    return TpuSession()
+
+
+def _pdf(n=4000, tie_card=300, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 40, n),
+        "o": rng.integers(0, tie_card, n),
+        "u": rng.permutation(n),   # unique: rows frames need total order
+        "v": np.where(rng.random(n) < 0.1, np.nan,
+                      rng.uniform(-5, 5, n).round(2)),
+        "s": rng.choice(["ash", "birch", "cedar", None], n),
+    })
+
+
+def _cmp(dist_session, oracle_session, pdf, build):
+    d = build(dist_session.create_dataframe(pdf)).to_pandas()
+    o = build(oracle_session.create_dataframe(pdf)).to_pandas()
+    assert dist_session.last_dist_explain == "distributed", \
+        dist_session.last_dist_explain
+    pt.assert_frame_equal(d.reset_index(drop=True),
+                          o.reset_index(drop=True),
+                          check_dtype=False, rtol=1e-9)
+    return d
+
+
+def test_global_rank_family_with_ties(dist_session, oracle_session):
+    w = Window().orderBy("o")
+
+    def q(df):
+        return df.select(
+            "o", "k",
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr"),
+            F.percent_rank().over(w).alias("pr"),
+            F.row_number().over(Window().orderBy("o", "k")).alias("rn"),
+        ).orderBy("o", "k", "rn")
+
+    d = _cmp(dist_session, oracle_session, _pdf(), q)
+    assert d["rn"].tolist() == list(range(1, len(d) + 1))
+
+
+def test_global_running_sums_rows_and_range(dist_session, oracle_session):
+    wr = Window().orderBy(F.col("u")).rowsBetween(None, 0)
+    wg = Window().orderBy("o")   # range running with ties
+
+    def q(df):
+        return df.select(
+            "o", "u",
+            F.sum("v").over(wr).alias("rsum"),
+            F.count("v").over(wr).alias("rcnt"),
+            F.avg("v").over(wr).alias("ravg"),
+            F.sum("v").over(wg).alias("tsum"),
+            F.min("v").over(wg).alias("tmin"),
+            F.max("v").over(wg).alias("tmax"),
+        ).orderBy("u")
+
+    _cmp(dist_session, oracle_session, _pdf(), q)
+
+
+def test_global_whole_frame(dist_session, oracle_session):
+    w = Window().orderBy("o").rowsBetween(None, None)
+
+    def q(df):
+        return df.select(
+            "o", F.sum("v").over(w).alias("gs"),
+            F.min("v").over(w).alias("gm"),
+        ).orderBy("o", "gs").limit(50)
+
+    _cmp(dist_session, oracle_session, _pdf(), q)
+
+
+def test_global_heavy_ties_span_shards(dist_session, oracle_session):
+    """Order key with only 3 distinct values: every tie run spans
+    multiple shards, driving the cross-shard chain logic."""
+    pdf = _pdf(n=3000, tie_card=3, seed=11)
+    w = Window().orderBy("o")
+
+    def q(df):
+        return df.select(
+            "o", "k", F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr"),
+            F.sum("v").over(w).alias("ts"),
+        ).orderBy("o", "k", "rk")
+
+    _cmp(dist_session, oracle_session, pdf, q)
+
+
+def test_global_single_value_order_key(dist_session, oracle_session):
+    """One global tie run across EVERY shard (fully-tied chains)."""
+    pdf = pd.DataFrame({"o": np.zeros(777, dtype=np.int64),
+                        "v": np.arange(777, dtype=np.float64)})
+    w = Window().orderBy("o")
+
+    def q(df):
+        return df.select(
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr"),
+            F.count("v").over(w).alias("c"),
+        ).orderBy("rk").limit(5)
+
+    d = _cmp(dist_session, oracle_session, pdf, q)
+    assert d["rk"].tolist() == [1] * 5
+    assert d["dr"].tolist() == [1] * 5
+    assert d["c"].tolist() == [777] * 5
+
+
+def test_global_desc_nulls_order(dist_session, oracle_session):
+    pdf = _pdf(n=2000, seed=5)
+    pdf.loc[pdf.index % 17 == 0, "o"] = None
+    w = Window().orderBy(F.col("o").desc())
+
+    def q(df):
+        return df.select(
+            "o", F.rank().over(w).alias("rk"),
+            F.sum("v").over(w).alias("ts"),
+        ).orderBy("rk", "ts")
+
+    _cmp(dist_session, oracle_session, pdf, q)
+
+
+def test_multiple_specs_one_node(dist_session, oracle_session):
+    """Partitioned + global specs in ONE select: sequential mesh
+    passes, later groups see earlier outputs as payload, final column
+    order restored."""
+    wp = Window.partitionBy("k").orderBy("o").rowsBetween(None, 0)
+    wg = Window().orderBy("o")
+    wp2 = Window.partitionBy("k")
+
+    def q(df):
+        return df.select(
+            "k", "o",
+            F.sum("v").over(wp).alias("psum"),
+            F.rank().over(wg).alias("grk"),
+            F.count("v").over(wp2).alias("pc"),
+        ).orderBy("k", "o", "psum", "grk")
+
+    _cmp(dist_session, oracle_session, _pdf(n=2500, seed=7), q)
+
+
+def test_multiple_specs_single_process_chain():
+    """The single-process converter also chains one exec per spec."""
+    s = TpuSession()
+    pdf = _pdf(n=500, seed=9)
+    wp = Window.partitionBy("k")
+    wg = Window().orderBy("o", "k")
+    out = s.create_dataframe(pdf).select(
+        "k", "o",
+        F.sum("v").over(wp).alias("ps"),
+        F.row_number().over(wg).alias("rn")).to_pandas()
+    want_ps = pdf.groupby("k")["v"].transform(
+        lambda x: x.sum(skipna=True))
+    merged = out.sort_values(["o", "k"], ignore_index=True)
+    assert merged["rn"].tolist() == sorted(merged["rn"].tolist())
+    got = out.sort_values(["k", "o", "rn"], ignore_index=True)
+    want = pdf.assign(ps=want_ps).sort_values(
+        ["k", "o"], ignore_index=True)
+    np.testing.assert_allclose(
+        got.groupby("k")["ps"].first().values,
+        want.groupby("k")["ps"].first().values, rtol=1e-9)
+
+
+def test_global_lead_lag_rejected_with_fallback(dist_session,
+                                               oracle_session):
+    """Global lead/lag needs a halo exchange — must fall back, not
+    miscompute."""
+    pdf = _pdf(n=300, seed=13)
+    w = Window().orderBy("o", "k")
+
+    def q(df):
+        return df.select("o", "k",
+                         F.lead("v", 1).over(w).alias("nx")
+                         ).orderBy("o", "k")
+
+    d = q(dist_session.create_dataframe(pdf)).to_pandas()
+    o = q(oracle_session.create_dataframe(pdf)).to_pandas()
+    assert dist_session.last_dist_explain != "distributed"
+    pt.assert_frame_equal(d.reset_index(drop=True),
+                          o.reset_index(drop=True), check_dtype=False)
