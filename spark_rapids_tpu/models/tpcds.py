@@ -50,6 +50,9 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "d_month_seq": np.array(
             [(d.year - 1998) * 12 + d.month - 1 + 1189 for d in dates],
             dtype=np.int64),
+        # weeks count from the Sunday on/before the base date (TPC-DS
+        # weeks start Sunday; base 1998-01-01 was a Thursday -> offset 4)
+        "d_week_seq": ((days + 4) // 7 + 5270).astype(np.int64),
         "d_day_name": np.array(
             [d.strftime("%A") for d in dates], dtype=object),
         "d_dow": np.array([(d.weekday() + 1) % 7 for d in dates],
@@ -86,6 +89,8 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "i_item_sk": isk.astype(np.int64),
         "i_item_id": np.array([f"AAAAAAAA{k:08d}" for k in isk],
                               dtype=object),
+        "i_product_name": np.array([f"product#{k}" for k in isk],
+                                   dtype=object),
         "i_brand_id": brand_id.astype(np.int64),
         "i_brand": np.array([f"brand#{b}" for b in brand_id],
                             dtype=object),
@@ -120,9 +125,13 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
 
     # ---- customer_address / demographics ----------------------------------
     n_ca = max(int(300 * max(sf * 100, 1)), 100)
+    cities = np.array(["Midway", "Fairview", "Oakland", "Glendale",
+                       "Springdale", "Riverside", "Centerville",
+                       "Pleasant Hill"])
     out["customer_address"] = pd.DataFrame({
         "ca_address_sk": np.arange(1, n_ca + 1, dtype=np.int64),
         "ca_state": states[rng.integers(0, len(states), n_ca)],
+        "ca_city": cities[rng.integers(0, len(cities), n_ca)],
         "ca_zip": np.array([f"{z:05d}" for z in
                             rng.integers(10000, 99999, n_ca)],
                            dtype=object),
@@ -225,6 +234,13 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
     coupon = np.where(rng.random(n_ss) < 0.1,
                       np.round(ext * rng.random(n_ss) * 0.5, 2), 0.0)
     wholesale = np.round(list_price * 0.6, 2)
+    # ~2% null store fks (q76's null-channel accounting; inner joins on
+    # the store dim drop them identically in engine and oracle)
+    store_fk = pd.array(trip_store[trip_of].astype(np.int64),
+                        dtype="Int64")
+    store_fk[rng.random(n_ss) < 0.02] = pd.NA
+    # per-trip purchase address (q46/q68's bought-city vs current-city)
+    trip_addr = rng.integers(1, n_ca + 1, n_trip)
     out["store_sales"] = pd.DataFrame({
         "ss_sold_date_sk": (2450815 + day_off).astype(np.int64),
         "ss_sold_time_sk": trip_time[trip_of].astype(np.int64),
@@ -233,7 +249,8 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "ss_customer_sk": trip_cust[trip_of].astype(np.int64),
         "ss_cdemo_sk": cdemo_fk.astype(np.int64),
         "ss_hdemo_sk": trip_hd[trip_of].astype(np.int64),
-        "ss_store_sk": trip_store[trip_of].astype(np.int64),
+        "ss_addr_sk": trip_addr[trip_of].astype(np.int64),
+        "ss_store_sk": store_fk,
         "ss_promo_sk": rng.integers(1, n_promo + 1,
                                     n_ss).astype(np.int64),
         "ss_quantity": qty.astype(np.int64),
@@ -243,6 +260,150 @@ def gen_tables(sf: float = 0.01, seed: int = 42) -> Dict[str, pd.DataFrame]:
         "ss_coupon_amt": coupon,
         "ss_wholesale_cost": wholesale,
         "ss_net_profit": np.round(ext - wholesale * qty - coupon, 2),
+    })
+
+    # ---- reason / call_center / warehouse / ship_mode / web_page ----------
+    out["reason"] = pd.DataFrame({
+        "r_reason_sk": np.arange(1, 11, dtype=np.int64),
+        "r_reason_desc": np.array(
+            ["Package was damaged", "Stopped working",
+             "Did not get it on time", "Not the product that was "
+             "ordred", "Parts missing", "Does not work with a product "
+             "that I have", "Gift exchange", "Did not like the color",
+             "Did not like the model", "Did not fit"], dtype=object),
+    })
+    out["call_center"] = pd.DataFrame({
+        "cc_call_center_sk": np.arange(1, 5, dtype=np.int64),
+        "cc_name": np.array(["NY Metro", "Mid Atlantic",
+                             "North Midwest", "Pacific NW"],
+                            dtype=object),
+        "cc_county": np.array(["Ziebach County"] * 4, dtype=object),
+    })
+    out["warehouse"] = pd.DataFrame({
+        "w_warehouse_sk": np.arange(1, 6, dtype=np.int64),
+        "w_warehouse_name": np.array(
+            ["Conventional childr", "Important issues liv",
+             "Doors canno", "Bad cards must make", "Rooms cook"],
+            dtype=object),
+        "w_warehouse_sq_ft": rng.integers(50000, 1000000,
+                                          5).astype(np.int64),
+        "w_state": states[rng.integers(0, len(states), 5)],
+    })
+    out["ship_mode"] = pd.DataFrame({
+        "sm_ship_mode_sk": np.arange(1, 11, dtype=np.int64),
+        "sm_type": np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                             "REGULAR", "TWO DAY"] * 2, dtype=object),
+        "sm_code": np.array(["AIR", "SURFACE", "SEA", "AIR", "SURFACE",
+                             "SEA", "AIR", "SURFACE", "SEA", "AIR"],
+                            dtype=object),
+    })
+    out["web_page"] = pd.DataFrame({
+        "wp_web_page_sk": np.arange(1, 21, dtype=np.int64),
+        "wp_char_count": rng.integers(100, 8000, 20).astype(np.int64),
+    })
+
+    # ---- store_returns: ~9% of store_sales rows come back ------------------
+    ret_of = np.flatnonzero(rng.random(n_ss) < 0.09)
+    ss = out["store_sales"]
+    ret_delta = rng.integers(1, 60, len(ret_of))
+    ret_qty = np.minimum(qty[ret_of],
+                         rng.integers(1, 101, len(ret_of)))
+    ret_amt = np.round(sales_price[ret_of] * ret_qty, 2)
+    out["store_returns"] = pd.DataFrame({
+        "sr_returned_date_sk": np.minimum(
+            ss["ss_sold_date_sk"].to_numpy()[ret_of] + ret_delta,
+            2450815 + _N_DAYS - 1).astype(np.int64),
+        "sr_item_sk": ss["ss_item_sk"].to_numpy()[ret_of],
+        "sr_customer_sk": ss["ss_customer_sk"].to_numpy()[ret_of],
+        "sr_ticket_number": ss["ss_ticket_number"].to_numpy()[ret_of],
+        "sr_store_sk": ss["ss_store_sk"].to_numpy(
+            dtype=np.float64, na_value=np.nan)[ret_of],
+        "sr_reason_sk": rng.integers(1, 11,
+                                     len(ret_of)).astype(np.int64),
+        "sr_return_quantity": ret_qty.astype(np.int64),
+        "sr_return_amt": ret_amt,
+        "sr_net_loss": np.round(ret_amt * 0.1 + 5.0, 2),
+    })
+    out["store_returns"]["sr_store_sk"] = \
+        out["store_returns"]["sr_store_sk"].astype("Int64")
+
+    # ---- catalog_sales ----------------------------------------------------
+    n_cs = max(int(n_ss * 0.5), 500)
+    cs_day = rng.integers(0, _N_DAYS - 8, n_cs)
+    cs_ship_addr = pd.array(rng.integers(1, n_ca + 1,
+                                         n_cs).astype(np.int64),
+                            dtype="Int64")
+    cs_ship_addr[rng.random(n_cs) < 0.02] = pd.NA
+    cs_qty = rng.integers(1, 101, n_cs)
+    cs_list = rng.integers(100, 20000, n_cs) / 100.0
+    cs_price = np.round(cs_list * (rng.integers(0, 101, n_cs) / 100.0),
+                        2)
+    cs_ext = np.round(cs_price * cs_qty, 2)
+    cs_whole = np.round(cs_list * 0.6, 2)
+    cs_item = rng.integers(1, n_item + 1, n_cs)
+    pin2 = rng.random(n_cs) < 0.15
+    cs_item[pin2] = rng.integers(1, 25, int(pin2.sum()))
+    out["catalog_sales"] = pd.DataFrame({
+        "cs_sold_date_sk": (2450815 + cs_day).astype(np.int64),
+        "cs_ship_date_sk": (2450815 + cs_day +
+                            rng.integers(1, 8, n_cs)).astype(np.int64),
+        "cs_item_sk": cs_item.astype(np.int64),
+        "cs_bill_customer_sk": rng.integers(1, n_cust + 1,
+                                            n_cs).astype(np.int64),
+        "cs_bill_cdemo_sk": np.where(
+            rng.random(n_cs) < 0.10, target_sk,
+            rng.integers(1, n_cd + 1, n_cs)).astype(np.int64),
+        "cs_bill_addr_sk": rng.integers(1, n_ca + 1,
+                                        n_cs).astype(np.int64),
+        "cs_ship_addr_sk": cs_ship_addr,
+        "cs_call_center_sk": rng.integers(1, 5, n_cs).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(1, 11, n_cs).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(1, 6, n_cs).astype(np.int64),
+        "cs_promo_sk": rng.integers(1, n_promo + 1,
+                                    n_cs).astype(np.int64),
+        "cs_quantity": cs_qty.astype(np.int64),
+        "cs_list_price": cs_list,
+        "cs_sales_price": cs_price,
+        "cs_ext_sales_price": cs_ext,
+        "cs_wholesale_cost": cs_whole,
+        "cs_net_profit": np.round(cs_ext - cs_whole * cs_qty, 2),
+    })
+
+    # ---- web_sales --------------------------------------------------------
+    n_ws = max(int(n_ss * 0.35), 400)
+    ws_day = rng.integers(0, _N_DAYS, n_ws)
+    ws_ship_cust = pd.array(rng.integers(1, n_cust + 1,
+                                         n_ws).astype(np.int64),
+                            dtype="Int64")
+    ws_ship_cust[rng.random(n_ws) < 0.02] = pd.NA
+    ws_qty = rng.integers(1, 101, n_ws)
+    ws_list = rng.integers(100, 20000, n_ws) / 100.0
+    ws_price = np.round(ws_list * (rng.integers(0, 101, n_ws) / 100.0),
+                        2)
+    ws_ext = np.round(ws_price * ws_qty, 2)
+    ws_whole = np.round(ws_list * 0.6, 2)
+    ws_item = rng.integers(1, n_item + 1, n_ws)
+    pin3 = rng.random(n_ws) < 0.15
+    ws_item[pin3] = rng.integers(1, 25, int(pin3.sum()))
+    out["web_sales"] = pd.DataFrame({
+        "ws_sold_date_sk": (2450815 + ws_day).astype(np.int64),
+        "ws_sold_time_sk": rng.integers(0, 24 * 60,
+                                        n_ws).astype(np.int64),
+        "ws_item_sk": ws_item.astype(np.int64),
+        "ws_bill_customer_sk": rng.integers(1, n_cust + 1,
+                                            n_ws).astype(np.int64),
+        "ws_bill_addr_sk": rng.integers(1, n_ca + 1,
+                                        n_ws).astype(np.int64),
+        "ws_ship_customer_sk": ws_ship_cust,
+        "ws_web_page_sk": rng.integers(1, 21, n_ws).astype(np.int64),
+        "ws_promo_sk": rng.integers(1, n_promo + 1,
+                                    n_ws).astype(np.int64),
+        "ws_quantity": ws_qty.astype(np.int64),
+        "ws_list_price": ws_list,
+        "ws_sales_price": ws_price,
+        "ws_ext_sales_price": ws_ext,
+        "ws_wholesale_cost": ws_whole,
+        "ws_net_profit": np.round(ws_ext - ws_whole * ws_qty, 2),
     })
     return out
 
@@ -593,5 +754,309 @@ where case when avg_monthly_sales > 0
            then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
            else null end > 0.1
 order by sum_sales - avg_monthly_sales, s_store_name, d_moy
+limit 100
+"""
+
+# ---- round-5 batch A: store-channel breadth -------------------------------
+
+QUERIES["q43"] = """
+select s.s_store_name,
+       sum(case when d.d_day_name = 'Sunday'
+                then ss.ss_sales_price else null end) sun_sales,
+       sum(case when d.d_day_name = 'Monday'
+                then ss.ss_sales_price else null end) mon_sales,
+       sum(case when d.d_day_name = 'Tuesday'
+                then ss.ss_sales_price else null end) tue_sales,
+       sum(case when d.d_day_name = 'Wednesday'
+                then ss.ss_sales_price else null end) wed_sales,
+       sum(case when d.d_day_name = 'Thursday'
+                then ss.ss_sales_price else null end) thu_sales,
+       sum(case when d.d_day_name = 'Friday'
+                then ss.ss_sales_price else null end) fri_sales,
+       sum(case when d.d_day_name = 'Saturday'
+                then ss.ss_sales_price else null end) sat_sales
+from date_dim d
+join store_sales ss on d.d_date_sk = ss.ss_sold_date_sk
+join store s on ss.ss_store_sk = s.s_store_sk
+where d.d_year = 2000
+group by s.s_store_name
+order by s.s_store_name
+limit 100
+"""
+
+QUERIES["q44"] = """
+with profits as (
+  select ss.ss_item_sk item_sk, avg(ss.ss_net_profit) rank_col
+  from store_sales ss
+  where ss.ss_store_sk = 4
+  group by ss.ss_item_sk
+),
+asceding as (
+  select item_sk, rank() over (order by rank_col) rnk from profits
+),
+descending as (
+  select item_sk, rank() over (order by rank_col desc) rnk
+  from profits
+)
+select asceding.rnk,
+       i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from asceding
+join descending on asceding.rnk = descending.rnk
+join item i1 on i1.i_item_sk = asceding.item_sk
+join item i2 on i2.i_item_sk = descending.item_sk
+where asceding.rnk < 11
+order by asceding.rnk
+"""
+
+QUERIES["q46"] = """
+with dn as (
+  select ss.ss_ticket_number, ss.ss_customer_sk,
+         ca.ca_city bought_city,
+         sum(ss.ss_coupon_amt) amt, sum(ss.ss_net_profit) profit
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join customer_address ca on ss.ss_addr_sk = ca.ca_address_sk
+  where (hd.hd_dep_count = 7 or hd.hd_vehicle_count = 3)
+    and d.d_dow in (6, 0)
+    and d.d_year in (1999, 2000, 2001)
+    and s.s_city in ('Fairview', 'Midway')
+  group by ss.ss_ticket_number, ss.ss_customer_sk, ca.ca_city
+)
+select c.c_last_name, c.c_first_name, ca.ca_city current_city,
+       dn.bought_city, dn.ss_ticket_number, dn.amt, dn.profit
+from dn
+join customer c on dn.ss_customer_sk = c.c_customer_sk
+join customer_address ca on c.c_current_addr_sk = ca.ca_address_sk
+where dn.bought_city <> ca.ca_city
+order by c.c_last_name, c.c_first_name, ca.ca_city, dn.bought_city,
+         dn.ss_ticket_number
+limit 100
+"""
+
+QUERIES["q47"] = """
+with v1 as (
+  select i.i_category, i.i_brand, s.s_store_name,
+         d.d_year, d.d_moy, sum(ss.ss_sales_price) sum_sales
+  from item i
+  join store_sales ss on ss.ss_item_sk = i.i_item_sk
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where d.d_year = 2000
+     or (d.d_year = 1999 and d.d_moy = 12)
+     or (d.d_year = 2001 and d.d_moy = 1)
+  group by i.i_category, i.i_brand, s.s_store_name, d.d_year, d.d_moy
+),
+v2 as (
+  select i_category, i_brand, s_store_name, d_year, d_moy, sum_sales,
+         avg(sum_sales) over (partition by i_category, i_brand,
+                              s_store_name, d_year) avg_monthly_sales,
+         lag(sum_sales, 1) over (partition by i_category, i_brand,
+                                 s_store_name
+                                 order by d_year, d_moy) psum,
+         lead(sum_sales, 1) over (partition by i_category, i_brand,
+                                  s_store_name
+                                  order by d_year, d_moy) nsum
+  from v1
+)
+select i_category, i_brand, s_store_name, d_year, d_moy, sum_sales,
+       avg_monthly_sales, psum, nsum
+from v2
+where d_year = 2000 and avg_monthly_sales > 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name, d_moy
+limit 100
+"""
+
+QUERIES["q59"] = """
+with wss as (
+  select d.d_week_seq d_week_seq, ss.ss_store_sk ss_store_sk,
+         sum(case when d.d_day_name = 'Sunday'
+                  then ss.ss_sales_price else null end) sun_sales,
+         sum(case when d.d_day_name = 'Monday'
+                  then ss.ss_sales_price else null end) mon_sales,
+         sum(case when d.d_day_name = 'Wednesday'
+                  then ss.ss_sales_price else null end) wed_sales,
+         sum(case when d.d_day_name = 'Friday'
+                  then ss.ss_sales_price else null end) fri_sales
+  from store_sales ss
+  join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+  group by d.d_week_seq, ss.ss_store_sk
+)
+select s.s_store_name s_store_name1, y.d_week_seq d_week_seq1,
+       y.sun_sales / x.sun_sales sun_ratio,
+       y.mon_sales / x.mon_sales mon_ratio,
+       y.wed_sales / x.wed_sales wed_ratio,
+       y.fri_sales / x.fri_sales fri_ratio
+from wss y
+join wss x on y.ss_store_sk = x.ss_store_sk
+          and y.d_week_seq = x.d_week_seq - 52
+join store s on y.ss_store_sk = s.s_store_sk
+where y.d_week_seq between 5270 and 5322
+order by s.s_store_name, y.d_week_seq
+limit 100
+"""
+
+QUERIES["q63"] = """
+with monthly as (
+  select i.i_manager_id, d.d_moy, sum(ss.ss_sales_price) sum_sales
+  from item i
+  join store_sales ss on ss.ss_item_sk = i.i_item_sk
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  where d.d_year = 2001
+    and i.i_category in ('Books', 'Children', 'Electronics')
+  group by i.i_manager_id, d.d_moy
+)
+select i_manager_id, sum_sales, avg_monthly_sales
+from (
+  select i_manager_id, sum_sales,
+         avg(sum_sales) over (partition by i_manager_id)
+           avg_monthly_sales
+  from monthly
+) t
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales)
+                / avg_monthly_sales
+           else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+"""
+
+QUERIES["q67"] = """
+select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+       d_moy, s_store_name, sumsales, rk
+from (
+  select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_name, sumsales,
+         rank() over (partition by i_category
+                      order by sumsales desc) rk
+  from (
+    select i.i_category, i.i_class, i.i_brand, i.i_product_name,
+           d.d_year, d.d_qoy, d.d_moy, s.s_store_name,
+           sum(ss.ss_sales_price * ss.ss_quantity) sumsales
+    from store_sales ss
+    join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+    join store s on ss.ss_store_sk = s.s_store_sk
+    join item i on ss.ss_item_sk = i.i_item_sk
+    where d.d_month_seq between 1200 and 1211
+    group by rollup(i.i_category, i.i_class, i.i_brand,
+                    i.i_product_name, d.d_year, d.d_qoy, d.d_moy,
+                    s.s_store_name)
+  ) dw1
+) dw2
+where rk <= 3
+order by i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_name, sumsales, rk
+"""
+
+QUERIES["q68"] = """
+with dn as (
+  select ss.ss_ticket_number, ss.ss_customer_sk,
+         ca.ca_city bought_city,
+         sum(ss.ss_ext_sales_price) extended_price,
+         sum(ss.ss_coupon_amt) amt,
+         sum(ss.ss_net_profit) profit
+  from store_sales ss
+  join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join customer_address ca on ss.ss_addr_sk = ca.ca_address_sk
+  where d.d_dom between 1 and 2
+    and (hd.hd_dep_count = 7 or hd.hd_vehicle_count = 3)
+    and d.d_year in (1998, 1999, 2000)
+    and s.s_city in ('Midway', 'Fairview')
+  group by ss.ss_ticket_number, ss.ss_customer_sk, ca.ca_city
+)
+select c.c_last_name, c.c_first_name, ca.ca_city current_city,
+       dn.bought_city, dn.extended_price, dn.amt, dn.profit,
+       dn.ss_ticket_number
+from dn
+join customer c on dn.ss_customer_sk = c.c_customer_sk
+join customer_address ca on c.c_current_addr_sk = ca.ca_address_sk
+where dn.bought_city <> ca.ca_city
+order by c.c_last_name, dn.ss_ticket_number
+limit 100
+"""
+
+QUERIES["q88"] = """
+select
+ (select count(*) from store_sales ss
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join time_dim t on ss.ss_sold_time_sk = t.t_time_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where t.t_hour = 8 and t.t_minute >= 30 and hd.hd_dep_count = 4
+    and s.s_store_name = 'ese') h8_30_to_9,
+ (select count(*) from store_sales ss
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join time_dim t on ss.ss_sold_time_sk = t.t_time_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where t.t_hour = 9 and t.t_minute < 30 and hd.hd_dep_count = 4
+    and s.s_store_name = 'ese') h9_to_9_30,
+ (select count(*) from store_sales ss
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join time_dim t on ss.ss_sold_time_sk = t.t_time_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where t.t_hour = 9 and t.t_minute >= 30 and hd.hd_dep_count = 4
+    and s.s_store_name = 'ese') h9_30_to_10,
+ (select count(*) from store_sales ss
+  join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+  join time_dim t on ss.ss_sold_time_sk = t.t_time_sk
+  join store s on ss.ss_store_sk = s.s_store_sk
+  where t.t_hour = 10 and t.t_minute < 30 and hd.hd_dep_count = 4
+    and s.s_store_name = 'ese') h10_to_10_30
+"""
+
+QUERIES["q13"] = """
+select avg(ss.ss_quantity) a1, avg(ss.ss_ext_sales_price) a2,
+       avg(ss.ss_wholesale_cost) a3, sum(ss.ss_wholesale_cost) s1
+from store_sales ss
+join store s on s.s_store_sk = ss.ss_store_sk
+join customer_demographics cd on cd.cd_demo_sk = ss.ss_cdemo_sk
+join household_demographics hd on ss.ss_hdemo_sk = hd.hd_demo_sk
+join customer_address ca on ss.ss_addr_sk = ca.ca_address_sk
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+where d.d_year = 2001
+  and ((cd.cd_marital_status = 'M'
+        and cd.cd_education_status = '4 yr Degree'
+        and ss.ss_sales_price between 100.00 and 150.00
+        and hd.hd_dep_count = 3)
+    or (cd.cd_marital_status = 'S'
+        and cd.cd_education_status = 'College'
+        and ss.ss_sales_price between 50.00 and 100.00
+        and hd.hd_dep_count = 1)
+    or (cd.cd_marital_status = 'W'
+        and cd.cd_education_status = '2 yr Degree'
+        and ss.ss_sales_price between 150.00 and 200.00
+        and hd.hd_dep_count = 1))
+  and ((ca.ca_country = 'United States'
+        and ca.ca_state in ('TN', 'SD', 'GA')
+        and ss.ss_net_profit between 100 and 200)
+    or (ca.ca_country = 'United States'
+        and ca.ca_state in ('AL', 'MN', 'NC')
+        and ss.ss_net_profit between 150 and 300)
+    or (ca.ca_country = 'United States'
+        and ca.ca_state in ('TN', 'MN', 'NC')
+        and ss.ss_net_profit between 50 and 250))
+"""
+
+QUERIES["q6"] = """
+with ia as (
+  select i_category cat, avg(i_current_price) avg_price
+  from item group by i_category
+)
+select ca.ca_state state, count(*) cnt
+from customer_address ca
+join customer c on ca.ca_address_sk = c.c_current_addr_sk
+join store_sales ss on c.c_customer_sk = ss.ss_customer_sk
+join date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+join item i on ss.ss_item_sk = i.i_item_sk
+join ia on i.i_category = ia.cat
+where d.d_year = 2001 and d.d_moy = 1
+  and i.i_current_price > 1.2 * ia.avg_price
+group by ca.ca_state
+having count(*) >= 10
+order by cnt, ca.ca_state
 limit 100
 """

@@ -435,3 +435,306 @@ def test_q89(session, data):
     got = run_q(session, "q89")
     assert len(got) > 0
     cmp(got, want)
+
+
+# ---- round-5 batch A: store-channel breadth --------------------------------
+
+_DAYNAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
+
+
+def _dow_pivot(m, names, price="ss_sales_price"):
+    out = m.groupby("s_store_name" if "s_store_name" in names else
+                    names, as_index=False).size()[names] \
+        if False else None
+    return out
+
+
+def test_q43(session, data):
+    m = _star(data, item=False, store=True)
+    m = m[m.d_year == 2000]
+    g = m.groupby("s_store_name", as_index=False)
+    want = g.size()[["s_store_name"]]
+    for day, col in zip(_DAYNAMES,
+                        ["sun_sales", "mon_sales", "tue_sales",
+                         "wed_sales", "thu_sales", "fri_sales",
+                         "sat_sales"]):
+        day_sum = m[m.d_day_name == day].groupby(
+            "s_store_name")["ss_sales_price"].sum()
+        want[col] = want["s_store_name"].map(day_sum)
+    got = run_q(session, "q43")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q44(session, data):
+    ss = data["store_sales"]
+    m = ss[ss.ss_store_sk.eq(4).fillna(False)]
+    prof = m.groupby("ss_item_sk")["ss_net_profit"].mean()
+    asc = prof.rank(method="min", ascending=True)
+    desc = prof.rank(method="min", ascending=False)
+    names = data["item"].set_index("i_item_sk")["i_product_name"]
+    rows = []
+    a_by_rank = {int(r): sk for sk, r in asc.items()}
+    d_by_rank = {int(r): sk for sk, r in desc.items()}
+    for rnk in range(1, 11):
+        if rnk in a_by_rank and rnk in d_by_rank:
+            rows.append({"rnk": rnk,
+                         "best_performing": names[a_by_rank[rnk]],
+                         "worst_performing": names[d_by_rank[rnk]]})
+    want = pd.DataFrame(rows)
+    got = run_q(session, "q44")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def _city_trips(data, dom=None, dow=None, years=(), cities=(),
+                hd_pred=None):
+    m = _star(data, item=False, store=True, hd=True)
+    m = m.merge(data["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+    if dom is not None:
+        m = m[m.d_dom.between(*dom)]
+    if dow is not None:
+        m = m[m.d_dow.isin(dow)]
+    if years:
+        m = m[m.d_year.isin(years)]
+    if cities:
+        m = m[m.s_city.isin(cities)]
+    if hd_pred is not None:
+        m = m[hd_pred(m)]
+    return m
+
+
+def test_q46(session, data):
+    m = _city_trips(data, dow=[6, 0], years=(1999, 2000, 2001),
+                    cities=("Fairview", "Midway"),
+                    hd_pred=lambda m: (m.hd_dep_count == 7)
+                    | (m.hd_vehicle_count == 3))
+    dn = m.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city"],
+                   as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                       profit=("ss_net_profit", "sum"))
+    dn = dn.rename(columns={"ca_city": "bought_city"})
+    cur = data["customer"].merge(
+        data["customer_address"], left_on="c_current_addr_sk",
+        right_on="ca_address_sk")
+    out = dn.merge(cur, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+    out = out[out.bought_city != out.ca_city]
+    want = out.rename(columns={"ca_city": "current_city"})[
+        ["c_last_name", "c_first_name", "current_city", "bought_city",
+         "ss_ticket_number", "amt", "profit"]]
+    want = want.sort_values(
+        ["c_last_name", "c_first_name", "current_city", "bought_city",
+         "ss_ticket_number"], na_position="first",
+        ignore_index=True).head(100)
+    got = run_q(session, "q46")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q47(session, data):
+    m = _star(data, store=True)
+    m = m[(m.d_year == 2000) | ((m.d_year == 1999) & (m.d_moy == 12))
+          | ((m.d_year == 2001) & (m.d_moy == 1))]
+    keys = ["i_category", "i_brand", "s_store_name"]
+    v1 = m.groupby(keys + ["d_year", "d_moy"], as_index=False).agg(
+        sum_sales=("ss_sales_price", "sum"))
+    v1["avg_monthly_sales"] = v1.groupby(
+        keys + ["d_year"])["sum_sales"].transform("mean")
+    v1 = v1.sort_values(keys + ["d_year", "d_moy"],
+                        ignore_index=True)
+    v1["psum"] = v1.groupby(keys)["sum_sales"].shift(1)
+    v1["nsum"] = v1.groupby(keys)["sum_sales"].shift(-1)
+    v2 = v1[(v1.d_year == 2000) & (v1.avg_monthly_sales > 0)]
+    v2 = v2[(v2.sum_sales - v2.avg_monthly_sales).abs()
+            / v2.avg_monthly_sales > 0.1]
+    want = v2[["i_category", "i_brand", "s_store_name", "d_year",
+               "d_moy", "sum_sales", "avg_monthly_sales", "psum",
+               "nsum"]]
+    want = want.sort_values(
+        ["sum_sales", "s_store_name", "d_moy"],
+        key=lambda s: s if s.name != "sum_sales"
+        else want.sum_sales - want.avg_monthly_sales,
+        ignore_index=True).head(100)
+    got = run_q(session, "q47")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q59(session, data):
+    m = _star(data, item=False)
+    wss = m.groupby(["d_week_seq", "ss_store_sk"], as_index=False,
+                    dropna=False).size()[["d_week_seq", "ss_store_sk"]]
+    for day, col in zip(["Sunday", "Monday", "Wednesday", "Friday"],
+                        ["sun_sales", "mon_sales", "wed_sales",
+                         "fri_sales"]):
+        s = m[m.d_day_name == day].groupby(
+            ["d_week_seq", "ss_store_sk"], dropna=False)[
+            "ss_sales_price"].sum()
+        wss[col] = pd.MultiIndex.from_frame(
+            wss[["d_week_seq", "ss_store_sk"]]).map(s)
+    y = wss[wss.d_week_seq.between(5270, 5322)]
+    x = wss.copy()
+    x["d_week_seq"] = x["d_week_seq"] - 52
+    j = y.merge(x, on=["ss_store_sk", "d_week_seq"],
+                suffixes=("_y", "_x"))
+    j = j.merge(data["store"], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    want = pd.DataFrame({
+        "s_store_name1": j.s_store_name,
+        "d_week_seq1": j.d_week_seq,
+        "sun_ratio": j.sun_sales_y / j.sun_sales_x,
+        "mon_ratio": j.mon_sales_y / j.mon_sales_x,
+        "wed_ratio": j.wed_sales_y / j.wed_sales_x,
+        "fri_ratio": j.fri_sales_y / j.fri_sales_x,
+    })
+    want = want.sort_values(["s_store_name1", "d_week_seq1"],
+                            ignore_index=True).head(100)
+    got = run_q(session, "q59")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q63(session, data):
+    m = _star(data)
+    m = m[(m.d_year == 2001)
+          & m.i_category.isin(["Books", "Children", "Electronics"])]
+    g = m.groupby(["i_manager_id", "d_moy"], as_index=False).agg(
+        sum_sales=("ss_sales_price", "sum"))
+    g["avg_monthly_sales"] = g.groupby(
+        "i_manager_id")["sum_sales"].transform("mean")
+    g = g[g.avg_monthly_sales > 0]
+    g = g[(g.sum_sales - g.avg_monthly_sales).abs()
+          / g.avg_monthly_sales > 0.1]
+    want = g[["i_manager_id", "sum_sales", "avg_monthly_sales"]]
+    want = want.sort_values(
+        ["i_manager_id", "avg_monthly_sales", "sum_sales"],
+        ignore_index=True).head(100)
+    got = run_q(session, "q63")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q67(session, data):
+    m = _star(data, store=True)
+    m = m[m.d_month_seq.between(1200, 1211)].copy()
+    m["sales"] = m.ss_sales_price * m.ss_quantity
+    cols = ["i_category", "i_class", "i_brand", "i_product_name",
+            "d_year", "d_qoy", "d_moy", "s_store_name"]
+    levels = []
+    for k in range(len(cols), -1, -1):
+        keys = cols[:k]
+        if keys:
+            g = m.groupby(keys, as_index=False).agg(
+                sumsales=("sales", "sum"))
+        else:
+            g = pd.DataFrame([{"sumsales": m.sales.sum()}])
+        for c in cols:
+            if c not in keys:
+                g[c] = None
+        levels.append(g[cols + ["sumsales"]])
+    allv = pd.concat(levels, ignore_index=True)
+    allv["rk"] = allv.groupby("i_category", dropna=False)[
+        "sumsales"].rank(method="min", ascending=False).astype(int)
+    want = allv[allv.rk <= 3]
+    got = run_q(session, "q67")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q68(session, data):
+    m = _city_trips(data, dom=(1, 2), years=(1998, 1999, 2000),
+                    cities=("Midway", "Fairview"),
+                    hd_pred=lambda m: (m.hd_dep_count == 7)
+                    | (m.hd_vehicle_count == 3))
+    dn = m.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city"],
+                   as_index=False).agg(
+        extended_price=("ss_ext_sales_price", "sum"),
+        amt=("ss_coupon_amt", "sum"),
+        profit=("ss_net_profit", "sum"))
+    dn = dn.rename(columns={"ca_city": "bought_city"})
+    cur = data["customer"].merge(
+        data["customer_address"], left_on="c_current_addr_sk",
+        right_on="ca_address_sk")
+    out = dn.merge(cur, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+    out = out[out.bought_city != out.ca_city]
+    want = out.rename(columns={"ca_city": "current_city"})[
+        ["c_last_name", "c_first_name", "current_city", "bought_city",
+         "extended_price", "amt", "profit", "ss_ticket_number"]]
+    want = want.sort_values(["c_last_name", "ss_ticket_number"],
+                            na_position="first",
+                            ignore_index=True).head(100)
+    got = run_q(session, "q68")
+    assert len(got) > 0
+    cmp(got, want)
+
+
+def test_q88(session, data):
+    m = _star(data, dd=False, item=False, store=True, hd=True, td=True)
+    m = m[(m.hd_dep_count == 4) & (m.s_store_name == "ese")]
+
+    def bucket(h, half):
+        if half == "lo":
+            return len(m[(m.t_hour == h) & (m.t_minute < 30)])
+        return len(m[(m.t_hour == h) & (m.t_minute >= 30)])
+
+    want = pd.DataFrame([{
+        "h8_30_to_9": bucket(8, "hi"),
+        "h9_to_9_30": bucket(9, "lo"),
+        "h9_30_to_10": bucket(9, "hi"),
+        "h10_to_10_30": bucket(10, "lo"),
+    }])
+    got = run_q(session, "q88")
+    cmp(got, want)
+
+
+def test_q13(session, data):
+    m = _star(data, item=False, cd=True, store=True, hd=True)
+    m = m.merge(data["customer_address"], left_on="ss_addr_sk",
+                right_on="ca_address_sk")
+    m = m[m.d_year == 2001]
+    demo = (((m.cd_marital_status == "M")
+             & (m.cd_education_status == "4 yr Degree")
+             & m.ss_sales_price.between(100.0, 150.0)
+             & (m.hd_dep_count == 3))
+            | ((m.cd_marital_status == "S")
+               & (m.cd_education_status == "College")
+               & m.ss_sales_price.between(50.0, 100.0)
+               & (m.hd_dep_count == 1))
+            | ((m.cd_marital_status == "W")
+               & (m.cd_education_status == "2 yr Degree")
+               & m.ss_sales_price.between(150.0, 200.0)
+               & (m.hd_dep_count == 1)))
+    addr = (((m.ca_country == "United States")
+             & m.ca_state.isin(["TN", "SD", "GA"])
+             & m.ss_net_profit.between(100, 200))
+            | ((m.ca_country == "United States")
+               & m.ca_state.isin(["AL", "MN", "NC"])
+               & m.ss_net_profit.between(150, 300))
+            | ((m.ca_country == "United States")
+               & m.ca_state.isin(["TN", "MN", "NC"])
+               & m.ss_net_profit.between(50, 250)))
+    m = m[demo & addr]
+    want = pd.DataFrame([{
+        "a1": m.ss_quantity.mean(), "a2": m.ss_ext_sales_price.mean(),
+        "a3": m.ss_wholesale_cost.mean(),
+        "s1": m.ss_wholesale_cost.sum() if len(m) else None,
+    }])
+    got = run_q(session, "q13")
+    cmp(got, want)
+
+
+def test_q6(session, data):
+    item = data["item"].copy()
+    ia = item.groupby("i_category")["i_current_price"].mean()
+    m = _star(data, cust=True, ca=True)
+    m = m[(m.d_year == 2001) & (m.d_moy == 1)]
+    m = m[m.i_current_price > 1.2 * m.i_category.map(ia)]
+    g = m.groupby("ca_state", as_index=False).size().rename(
+        columns={"size": "cnt", "ca_state": "state"})
+    want = g[g.cnt >= 10][["state", "cnt"]]
+    got = run_q(session, "q6")
+    assert len(got) > 0
+    cmp(got, want)
