@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Chaos gate: run the fault-injection suite standalone so the injection
+# points and the recovery ladder cannot silently rot (tests/test_chaos.py
+# arms every named point in robustness/inject.py and requires the query
+# to answer with clean-run results).  CPU-only — the virtual 8-device
+# mesh exercises the distributed demotion rungs without TPU hardware.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export JAX_ENABLE_X64=1
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast_math=false ${XLA_FLAGS:-}"
+
+echo "== chaos suite (fault injection + recovery ladder) =="
+python -m pytest tests/ -q -m chaos --maxfail=5
+
+echo "CHAOS OK"

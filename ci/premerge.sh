@@ -11,7 +11,10 @@ export JAX_ENABLE_X64=1
 export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast_math=false ${XLA_FLAGS:-}"
 
 echo "== unit tests (virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q --maxfail=20
+python -m pytest tests/ -q --maxfail=20 -m 'not chaos'
+
+echo "== chaos suite (fault injection + recovery ladder) =="
+python -m pytest tests/ -q -m chaos --maxfail=5
 
 echo "== docgen drift check =="
 tmp=$(mktemp -d)

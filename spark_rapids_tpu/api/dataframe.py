@@ -572,15 +572,38 @@ class DataFrame:
 
     # --------------------------------------------------------------- actions --
     def _execute_batches(self) -> List[ColumnarBatch]:
+        # every query action runs under the recovery driver: classified
+        # transient faults re-drive the plan down the degradation
+        # ladder (retry -> spill -> smaller batches -> single device ->
+        # CPU); fatal faults re-raise untouched (robustness/driver.py)
+        from spark_rapids_tpu.robustness.driver import QueryRetryDriver
+        return QueryRetryDriver(self.session).run(self._attempt_batches)
+
+    def _attempt_batches(self, mode) -> List[ColumnarBatch]:
         import time as _time
         from spark_rapids_tpu.api.session import TpuSession
         # conf resolved at call time (retry budget, semaphore) follows
         # the session EXECUTING the query, not the last-constructed one
         TpuSession._active = self.session
-        if getattr(self.session, "mesh", None) is not None:
+        # a failure before this attempt draws its qid must not inherit
+        # the previous query's id on its RecoveryAction events
+        self.session._current_qid = None
+        mesh = getattr(self.session, "mesh", None)
+        if mesh is not None and \
+                (not mode.use_mesh or mode.batch_scale != 1.0):
+            self.session.last_dist_explain = (
+                "demoted: single-device replan (query recovery)"
+                if mode.batch_scale == 1.0 else
+                "demoted: single-device split-batch replan "
+                "(query recovery)")
+        if mode.use_mesh and mode.batch_scale == 1.0 and \
+                mesh is not None:
             # mesh session: offer the plan to the distributed planner
             # first (planner-inserted exchange analog); unsupported plans
-            # fall through to the single-process engine
+            # fall through to the single-process engine.  The split
+            # rung (batch_scale < 1) skips this branch: the distributed
+            # plan has no batch knob, so re-offering it would re-run
+            # the identical plan that just failed
             from spark_rapids_tpu.parallel.dist_planner import (
                 try_distributed)
             events = getattr(self.session, "events", None)
@@ -592,6 +615,7 @@ class DataFrame:
                     # event log keeps per-query attribution (the
                     # DistExchange events carry the stage stats)
                     qid = next(self.session._query_ids)
+                    self.session._current_qid = qid
                     events.emit(
                         "QueryStart", queryId=qid,
                         logicalPlan=self.plan.tree_string(),
@@ -604,16 +628,46 @@ class DataFrame:
                         metrics={}, spill={}, retry={},
                         distributed=True)
                 return dist
-        exec_plan = self.session.plan(self.plan)
+        overrides = None
+        if mode.batch_scale != 1.0:
+            # split-batch rung: re-plan with the scan/coalesce batch
+            # sizes scaled down so every operator's working set
+            # shrinks.  Planned through a one-off TpuOverrides — batch
+            # sizes are captured into the exec nodes at plan time — so
+            # the session's conf is never mutated and concurrent
+            # queries on other threads keep their own sizes
+            from spark_rapids_tpu.config import rapids_conf as rc
+            from spark_rapids_tpu.plan.overrides import TpuOverrides
+            conf = self.session.conf
+            for entry in (rc.READER_BATCH_SIZE_ROWS,
+                          rc.BATCH_SIZE_BYTES):
+                conf = conf.set(entry.key, max(
+                    1, int(conf.get(entry) * mode.batch_scale)))
+            overrides = TpuOverrides(conf, self.session.cache_manager)
+        return self._run_single_process(mode, overrides)
+
+    def _run_single_process(self, mode,
+                            overrides=None) -> List[ColumnarBatch]:
+        import time as _time
+        if mode.cpu_only:
+            exec_plan = self.session.plan_cpu_only(self.plan)
+        else:
+            exec_plan = self.session.plan(self.plan,
+                                          overrides=overrides)
         self._last_exec = exec_plan
         events = getattr(self.session, "events", None)
         if events is None or not events.enabled:
+            self.session._current_qid = None
             return list(exec_plan.execute())
         qid = next(self.session._query_ids)
+        # the recovery driver stamps RecoveryAction events with the qid
+        # of the attempt that failed
+        self.session._current_qid = qid
         events.emit("QueryStart", queryId=qid,
                     logicalPlan=self.plan.tree_string(),
                     physicalPlan=exec_plan.tree_string(),
-                    explain=self.session.overrides.last_explain)
+                    explain=(overrides or
+                             self.session.overrides).last_explain)
         cat = getattr(self.session, "memory_catalog", None)
         host0 = cat.spilled_to_host_total if cat else 0
         disk0 = cat.spilled_to_disk_total if cat else 0

@@ -85,6 +85,11 @@ class TpuSession:
         from spark_rapids_tpu.utils.events import EventLogger
         self._query_ids = itertools.count(1)
         self.session_id = uuid.uuid4().hex[:12]
+        # recovery actions (robustness/driver.py) in arrival order —
+        # the in-memory mirror of the RecoveryAction event stream, so
+        # tests and tools can read the trail without an event-log dir
+        self.recovery_log = []
+        self._current_qid = None  # qid of the attempt in flight
         self.events = EventLogger(
             self.conf.get(rc.EVENT_LOG_DIR) or None, self.session_id,
             conf_snapshot=dict(self.conf.settings))
@@ -283,14 +288,18 @@ class TpuSession:
         return resolve(self, parse(query))
 
     # --------------------------------------------------------------- planning --
-    def plan(self, logical: L.LogicalPlan):
+    def plan(self, logical: L.LogicalPlan, overrides=None):
         from spark_rapids_tpu.config import rapids_conf as rc
+        # a caller may plan through a one-off TpuOverrides (the recovery
+        # driver's split-batch rung scales batch sizes this way) without
+        # mutating session state under concurrent queries
+        ov = overrides if overrides is not None else self.overrides
         if self.conf.get(rc.SUPPRESS_PLANNING_FAILURE):
             # sql.suppressPlanningFailure: a bug in TPU planning demotes
             # the whole query to the CPU fallback chain instead of
             # failing it (RapidsConf.scala suppressPlanningFailure)
             try:
-                exec_plan = self.overrides.apply(logical)
+                exec_plan = ov.apply(logical)
             except Exception as exc:
                 import warnings
                 # surface the root cause: the CPU chain may itself lack
@@ -302,14 +311,9 @@ class TpuSession:
                     "(spark.rapids.sql.suppressPlanningFailure)",
                     RuntimeWarning, stacklevel=2)
                 self.last_planning_error = exc
-                from spark_rapids_tpu.exec.fallback import CpuFallbackExec
-
-                def whole_cpu(n):
-                    return CpuFallbackExec(
-                        n, [whole_cpu(c) for c in n.children])
-                exec_plan = whole_cpu(logical)
+                exec_plan = self.plan_cpu_only(logical)
         else:
-            exec_plan = self.overrides.apply(logical)
+            exec_plan = ov.apply(logical)
         if self.conf.get(rc.PROFILE_TRACE):
             def mark(node):
                 node.trace_ops = True
@@ -317,6 +321,16 @@ class TpuSession:
                     mark(c)
             mark(exec_plan)
         return exec_plan
+
+    def plan_cpu_only(self, logical: L.LogicalPlan):
+        """Plan the whole query onto the CPU fallback chain — the
+        terminal rung of the recovery ladder (robustness/driver.py)
+        and the suppressPlanningFailure demotion target."""
+        from spark_rapids_tpu.exec.fallback import CpuFallbackExec
+
+        def whole_cpu(n):
+            return CpuFallbackExec(n, [whole_cpu(c) for c in n.children])
+        return whole_cpu(logical)
 
 
 class SessionBuilder:

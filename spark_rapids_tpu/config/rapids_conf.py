@@ -417,6 +417,27 @@ OOM_RETRY_MAX = conf(
     "failing (memory/retry.py split-and-retry framework).",
     _to_int, lambda v: None if v >= 0 else "must be >= 0")
 
+QUERY_RECOVERY_ENABLED = conf(
+    "spark.rapids.sql.recovery.enabled", True,
+    "Enable the query-level recovery/degradation driver: classified "
+    "transient faults (device OOM, reader/transport hiccups, "
+    "preemption) re-drive the query down a bounded ladder — retry, "
+    "spill-and-retry, smaller batches, single-device replan, CPU "
+    "fallback — instead of failing it (robustness/driver.py).",
+    _to_bool)
+
+QUERY_RECOVERY_MAX_RETRIES = conf(
+    "spark.rapids.sql.recovery.maxRetries", 2,
+    "Plain same-plan retries (with backoff) before the recovery "
+    "ladder escalates to degradation.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+QUERY_RECOVERY_BACKOFF_MS = conf(
+    "spark.rapids.sql.recovery.backoffMs", 25,
+    "Base backoff between same-plan query retries, doubled per "
+    "retry and capped at 2s.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 SKEW_JOIN_ENABLED = conf(
     "spark.rapids.sql.join.skew.enabled", True,
     "Enable skew-join mitigation in the distributed exchange "

@@ -207,6 +207,15 @@ class TpuFileScanExec(TpuExec):
                     ColumnarBatch.from_arrow(chunk), frag.path))
 
     def do_execute(self) -> Iterator[ColumnarBatch]:
+        # "io.read" fires once per produced batch, so chaos tests can
+        # kill a scan mid-stream; recovery is query-level (the
+        # QueryRetryDriver re-drives the whole plan — scans re-read)
+        from spark_rapids_tpu.robustness.inject import fire
+        for batch in self._scan_batches():
+            fire("io.read")
+            yield batch
+
+    def _scan_batches(self) -> Iterator[ColumnarBatch]:
         if not self.paths:
             # bucket pruning eliminated every file
             return

@@ -59,34 +59,32 @@ def is_oom(exc: BaseException) -> bool:
 
 
 # ---------------------------------------------------------------- injection --
-class _Injector(threading.local):
-    def __init__(self):
-        self.remaining = 0
-        self.skip = 0
+# the OOM checkpoint is one named point in the unified injection
+# registry (robustness/inject.py); inject_oom stays as the deprecated
+# shim the existing retry tests (and users of the old hook) call
+from spark_rapids_tpu.robustness import inject as _inject
 
-
-_injector = _Injector()
+_inject.register_point("memory.oom", InjectedOomError)
 
 
 def inject_oom(num_ooms: int = 1, skip: int = 0) -> None:
-    """Force the next ``num_ooms`` guarded attempts (after skipping
-    ``skip``) on this thread to raise ``InjectedOomError``."""
-    _injector.remaining = num_ooms
-    _injector.skip = skip
+    """Deprecated shim over ``robustness.inject``: force the next
+    ``num_ooms`` guarded attempts (after skipping ``skip``) on this
+    thread to raise ``InjectedOomError``.  Equivalent to
+    ``inject("memory.oom", count=num_ooms, skip=skip)``."""
+    # last-call-wins per thread, like the old threading.local injector:
+    # re-arming here must never disarm another thread's rule
+    _inject.clear("memory.oom", this_thread_only=True)
+    _inject.inject("memory.oom", count=num_ooms, skip=skip,
+                   exc=InjectedOomError)
 
 
 def clear_injected_oom() -> None:
-    _injector.remaining = 0
-    _injector.skip = 0
+    _inject.clear("memory.oom", this_thread_only=True)
 
 
 def _checkpoint() -> None:
-    if _injector.remaining > 0:
-        if _injector.skip > 0:
-            _injector.skip -= 1
-            return
-        _injector.remaining -= 1
-        raise InjectedOomError("injected OOM (test hook)")
+    _inject.fire("memory.oom")
 
 
 # ------------------------------------------------------------------ metrics --

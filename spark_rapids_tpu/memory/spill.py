@@ -111,6 +111,12 @@ class SpillableHandle:
     def spill_to_disk(self) -> int:
         assert self.tier == HOST
         from spark_rapids_tpu import native
+        from spark_rapids_tpu.robustness.faults import SpillIOError
+        from spark_rapids_tpu.robustness.inject import fire
+        # "spill.disk" fires before any state moves: on failure the
+        # batch is still intact at the HOST tier, nothing is lost, and
+        # the query driver can retry the whole query
+        fire("spill.disk")
         path = os.path.join(self.catalog.spill_dir, f"buf-{self.id}.tcf")
         cols = []
         for name, dt in self._schema:
@@ -120,7 +126,13 @@ class SpillableHandle:
                          self._host.get(f"{name}.offsets")))
         blob = native.serialize_batch(self._nrows, cols,
                                       compress=self.catalog.frame_codec)
-        native.write_spill_file(path, blob)
+        try:
+            native.write_spill_file(path, blob)
+        except OSError as e:
+            # disk full / unreachable: re-type for the fault taxonomy
+            # (retryable — the host copy is untouched)
+            raise SpillIOError(
+                f"disk spill of buf-{self.id} failed: {e}") from e
         self._disk_path = path
         self._host = None
         self.tier = DISK
@@ -138,7 +150,12 @@ class SpillableHandle:
             batch = self._rebuild(lambda k: payload.get(k))
         else:
             from spark_rapids_tpu import native
-            blob = native.read_spill_file(self._disk_path)
+            from spark_rapids_tpu.robustness.faults import SpillIOError
+            try:
+                blob = native.read_spill_file(self._disk_path)
+            except OSError as e:
+                raise SpillIOError(
+                    f"disk unspill of buf-{self.id} failed: {e}") from e
             _, cols = native.deserialize_batch(blob)
             payload = {}
             for (name, dt), (_, d, v, o) in zip(self._schema, cols):

@@ -48,6 +48,7 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import predicates as preds
 from spark_rapids_tpu.ops.expressions import (
     Alias, BoundReference, ColVal, EmitContext, Expression, Literal)
+from spark_rapids_tpu.parallel.mesh import shard_map as _shard_map
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.logical import AggregateExpression
 
@@ -539,7 +540,7 @@ def _run_project(f: ShardedFrame, exprs: Sequence[Expression], tag: str):
     sig = (tag, _mesh_sig(f.mesh), tuple(dt.name for dt in phys),
            tuple(e.cache_key() for e in exprs))
     axis = f.mesh.axis_names[0]
-    return cached_jit(sig, lambda: jax.shard_map(
+    return cached_jit(sig, lambda: _shard_map(
         step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
         out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
 
@@ -581,7 +582,7 @@ def _run_expand(f: ShardedFrame, projections, out_phys):
            tuple(dt.name for dt in phys),
            tuple(tuple(e.cache_key() for e in p) for p in projections))
     axis = f.mesh.axis_names[0]
-    cols, nrows = cached_jit(sig, lambda: jax.shard_map(
+    cols, nrows = cached_jit(sig, lambda: _shard_map(
         step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
         out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
     return cols, nrows.reshape(-1)
@@ -625,7 +626,7 @@ def _run_union(child_frames, out_phys, mesh):
         ins.append(tuple(cols))
         ins.append(nrows)
     in_specs = tuple(P(axis) for _ in ins)
-    cols, nrows = cached_jit(sig, lambda: jax.shard_map(
+    cols, nrows = cached_jit(sig, lambda: _shard_map(
         step, mesh=mesh, in_specs=in_specs,
         out_specs=P(axis), check_vma=False))(*ins)
     return cols, nrows.reshape(-1)
@@ -650,7 +651,7 @@ def _run_slice(f: ShardedFrame, los, his):
     sig = ("dplan_slice", _mesh_sig(f.mesh),
            tuple(dt.name for dt in f.phys_dtypes))
     axis = f.mesh.axis_names[0]
-    return cached_jit(sig, lambda: jax.shard_map(
+    return cached_jit(sig, lambda: _shard_map(
         step, mesh=f.mesh, in_specs=(P(axis), P(axis), P(axis)),
         out_specs=P(axis), check_vma=False))(
         f.cols, jnp.asarray(np.asarray(los, dtype=np.int32)),
@@ -682,7 +683,7 @@ def _run_filter(f: ShardedFrame, cond: Expression):
     sig = ("dplan_filter", _mesh_sig(f.mesh),
            tuple(dt.name for dt in phys), cond.cache_key())
     axis = f.mesh.axis_names[0]
-    return cached_jit(sig, lambda: jax.shard_map(
+    return cached_jit(sig, lambda: _shard_map(
         step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
         out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
 

@@ -26,6 +26,7 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops import selection
 from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.parallel.mesh import shard_map as _shard_map
 from spark_rapids_tpu.parallel.partitioning import hash_partition_ids
 from spark_rapids_tpu.parallel.shuffle import exchange
 
@@ -40,10 +41,29 @@ def host_sync(x):
     decision, which the SPMD contract requires.  Accepts a pytree so
     co-located stats pay ONE cross-host collective."""
     import numpy as np
+    from spark_rapids_tpu.robustness.faults import HostSyncError
+    from spark_rapids_tpu.robustness.inject import fire
+    fire("dist.host_sync")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        return jax.tree_util.tree_map(
-            np.asarray, multihost_utils.process_allgather(x, tiled=True))
+        try:
+            return jax.tree_util.tree_map(
+                np.asarray,
+                multihost_utils.process_allgather(x, tiled=True))
+        except (RuntimeError, OSError) as e:
+            # a dead/slow peer surfaces as a DEADLINE_EXCEEDED /
+            # UNAVAILABLE XlaRuntimeError (a RuntimeError) or a socket
+            # error; type it so the query driver knows the phase
+            # boundary (not the query) failed.  Only transport-shaped
+            # errors are re-typed: an error the taxonomy already names
+            # — device OOM (enters the ladder at the spill rung) or a
+            # marker-less XlaRuntimeError (a real bug, FATAL) — must
+            # keep its own classification, never become retryable
+            from spark_rapids_tpu.robustness.faults import classify
+            if classify(e).kind in ("preemption", "unknown"):
+                raise HostSyncError(
+                    f"multi-host stats all-gather failed: {e}") from e
+            raise
     return jax.tree_util.tree_map(np.asarray, x)
 
 
@@ -94,12 +114,12 @@ class DistributedAggregate:
                      if self.filter_cond is not None else None)
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
-            self._sig + ("keyless",), lambda: jax.shard_map(
+            self._sig + ("keyless",), lambda: _shard_map(
                 self._step_keyless, mesh=mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
         self._jitted_local = cached_jit(
-            self._sig + ("local",), lambda: jax.shard_map(
+            self._sig + ("local",), lambda: _shard_map(
                 self._step_local, mesh=mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
@@ -243,7 +263,7 @@ class DistributedAggregate:
     # ---- host API ------------------------------------------------------------
     def _final_jitted(self, slot: int):
         return self._cached_jit(
-            self._sig + ("final", slot), lambda: jax.shard_map(
+            self._sig + ("final", slot), lambda: _shard_map(
                 partial(self._step_final, slot), mesh=self.mesh,
                 in_specs=(P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
@@ -429,7 +449,7 @@ class DistributedHashJoin:
         """Compiled program per (strategy, exchange slots, skew set)."""
         return self._cached_jit(
             self._sig + (strategy, slots, tuple(skewed)),
-            lambda: jax.shard_map(
+            lambda: _shard_map(
                 partial(self._step, strategy, slots, tuple(skewed)),
                 mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis),
@@ -458,7 +478,7 @@ class DistributedHashJoin:
                     histogram(bpids, blive, self.nshards))
 
         return self._cached_jit(
-            self._sig + ("stats",), lambda: jax.shard_map(
+            self._sig + ("stats",), lambda: _shard_map(
                 stats, mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis),
                           P(self.axis), P(self.axis)),

@@ -35,6 +35,7 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import selection
 from spark_rapids_tpu.ops.aggregates import sort_permutation
 from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.parallel.mesh import shard_map as _shard_map
 from spark_rapids_tpu.parallel.shuffle import exchange, pick_slot
 
 
@@ -223,7 +224,7 @@ class DistributedSort:
     def _splitters(self, flat_cols, nrows_per_shard):
         """Host sync: run the sample pass, pick splitter rows."""
         sample = self._cached_jit(
-            self._sig + ("sample",), lambda: jax.shard_map(
+            self._sig + ("sample",), lambda: _shard_map(
                 self._step_sample, mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))(
@@ -253,7 +254,7 @@ class DistributedSort:
     def __call__(self, flat_cols, nrows_per_shard):
         spl_vals, spl_valid = self._splitters(flat_cols, nrows_per_shard)
         hist = self._cached_jit(
-            self._sig + ("stats",), lambda: jax.shard_map(
+            self._sig + ("stats",), lambda: _shard_map(
                 self._step_stats, mesh=self.mesh,
                 in_specs=(P(), P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))(
@@ -263,7 +264,7 @@ class DistributedSort:
         slot = pick_slot(int(counts.max()), capacity)
         self.last_stats = {"partition_counts": counts, "slot": slot}
         return self._cached_jit(
-            self._sig + ("final", slot), lambda: jax.shard_map(
+            self._sig + ("final", slot), lambda: _shard_map(
                 partial(self._step_final, slot), mesh=self.mesh,
                 in_specs=(P(), P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))(
@@ -296,7 +297,7 @@ class DistributedTopN:
                tuple(dt.name for dt in self.in_dtypes),
                tuple(e.cache_key() for e in self.key_exprs),
                tuple(self.descending), tuple(self.nulls_first), n)
-        self._jitted = cached_jit(sig, lambda: jax.shard_map(
+        self._jitted = cached_jit(sig, lambda: _shard_map(
             self._step, mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis)),
             out_specs=P(self.axis), check_vma=False))

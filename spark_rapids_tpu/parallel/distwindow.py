@@ -26,6 +26,7 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import window as W
 from spark_rapids_tpu.ops.aggregates import widen_colval
 from spark_rapids_tpu.ops.expressions import ColVal, EmitContext
+from spark_rapids_tpu.parallel.mesh import shard_map as _shard_map
 from spark_rapids_tpu.parallel.distsort import DistributedSort
 
 
@@ -419,7 +420,7 @@ class DistributedGlobalWindow:
             s_cols, s_n = flat_cols, nrows_per_shard
             self.last_stats = {"sorted": False}
         out = self._cached_jit(
-            self._sig + ("eval",), lambda: jax.shard_map(
+            self._sig + ("eval",), lambda: _shard_map(
                 self._step, mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))(
@@ -502,7 +503,7 @@ class DistributedWindow:
         s_cols, s_n = self.sort(flat_cols, nrows_per_shard)
         self.last_stats = self.sort.last_stats
         out = self._cached_jit(
-            self._sig + ("eval",), lambda: jax.shard_map(
+            self._sig + ("eval",), lambda: _shard_map(
                 self._step, mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))(

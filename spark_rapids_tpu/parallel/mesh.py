@@ -22,6 +22,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 
 
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma=False):
+    """Version portability wrapper for ``jax.shard_map``: older jax
+    releases ship it as ``jax.experimental.shard_map.shard_map`` with
+    the ``check_vma`` knob still named ``check_rep``.  Every SPMD
+    module routes through here so the engine runs on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = DATA_AXIS) -> Mesh:
     devices = jax.devices()

@@ -30,6 +30,12 @@ def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:
     """Slot size for ``exchange`` from a materialized per-destination
     histogram: the true max slice count bucketed up to a power of two
     (<= 2x the ideal bytes on ICI), capped at the full capacity."""
+    # "shuffle.exchange" also fires here: pick_slot runs on the host
+    # once per exchange-bearing program launch (agg/join/sort), so an
+    # armed rule hits even when the traced program is already in the
+    # jit cache and exchange() below never re-enters
+    from spark_rapids_tpu.robustness.inject import fire
+    fire("shuffle.exchange")
     s = floor
     while s < max_slice:
         s <<= 1
@@ -45,6 +51,13 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     received nrows); received capacity is ``num_parts * slot``.
     Only fixed-width columns (strings must be dictionary-encoded upstream).
     """
+    # "shuffle.exchange" fires at trace time: the collective is
+    # compiled into the XLA program, so a failure here surfaces on the
+    # host exactly where a UCX transport failure would have in the
+    # reference — at the stage launch — and the query driver re-drives
+    # (a failed trace caches nothing, so the retry re-enters here)
+    from spark_rapids_tpu.robustness.inject import fire
+    fire("shuffle.exchange")
     capacity = pids.shape[0]
     slot = slot or capacity
     sorted_cols, counts, starts = layout_by_partition(

@@ -1,0 +1,27 @@
+"""Robustness subsystem: fault taxonomy, scoped fault injection, and the
+query-level recovery/degradation driver.
+
+The reference engine spreads resilience across RMM OOM callbacks, UCX
+shuffle retry/heartbeats, and per-operator split-and-retry (SURVEY.md
+section 2.5).  This package centralises the TPU port's answer:
+
+- ``faults``  — classify every failure the engine can see into
+  retryable / degradable / fatal (absorbing ``memory/retry.is_oom``).
+- ``inject``  — named injection points threaded through the I/O,
+  shuffle, multi-host sync, spill, and UDF layers, generalizing the
+  ad-hoc ``inject_oom(n)`` test hook.
+- ``driver``  — ``QueryRetryDriver``: wraps plan execution with a
+  bounded degradation ladder (retry -> spill-retry -> split-batch ->
+  single-device replan -> CPU fallback) and records every recovery
+  action as a structured event.
+"""
+
+from spark_rapids_tpu.robustness.faults import (  # noqa: F401
+    DEGRADABLE, FATAL, RETRYABLE, Fault, HostSyncError, InjectedFault,
+    SpillIOError, classify)
+# NOTE: the ``inject`` submodule is imported as a module (its main
+# entry point is also named ``inject``, which would shadow it here);
+# use ``from spark_rapids_tpu.robustness import inject`` and call
+# ``inject.inject(...)`` / ``inject.injected(...)``.
+from spark_rapids_tpu.robustness.inject import (  # noqa: F401
+    fire, injected, injection_points)

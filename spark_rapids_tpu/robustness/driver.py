@@ -1,0 +1,224 @@
+"""QueryRetryDriver: query-level recovery with a bounded degradation
+ladder.
+
+Operator-level recovery (memory/retry.py) absorbs faults that stay
+inside one exec node.  Whatever escapes to the query boundary lands
+here, where the recovery options get progressively more drastic:
+
+  retry      — re-run the same plan after a short backoff (transient
+               reader/transport/preemption faults)
+  spill      — demote the whole device store to host (memory/retry's
+               ``_spill_device_store``) and re-run (device OOM)
+  split      — re-plan with the scan/coalesce batch sizes halved so
+               every operator sees smaller working sets (the query-
+               level face of split-and-retry)
+  demote     — re-plan the distributed query onto a single device
+               (mesh sessions only; shuffle/host-sync faults that
+               survive retries)
+  cpu        — re-plan the whole query onto the CPU fallback chain
+               (exec/fallback.py) — slow, but it answers
+
+The ladder only ever moves forward (a fault during the split attempt
+never goes back to plain retries), every action is appended to
+``session.recovery_log`` and emitted as a ``RecoveryAction`` event on
+the session's event log, and FATAL faults re-raise immediately — the
+driver exists to absorb classified infrastructure failures, never to
+mask bugs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from spark_rapids_tpu.robustness import faults as F
+
+# ladder rungs, in escalation order
+RETRY = "retry"
+SPILL_RETRY = "spill"
+SPLIT_RETRY = "split"
+DEMOTE_SINGLE_DEVICE = "demote"
+CPU_FALLBACK = "cpu"
+
+
+@dataclass
+class AttemptMode:
+    """What the next execution attempt is allowed to look like.  The
+    attempt callable receives this and shapes planning accordingly."""
+
+    rung: str = "initial"
+    use_mesh: bool = True
+    cpu_only: bool = False
+    batch_scale: float = 1.0
+
+
+class RecoveryMetrics:
+    """Process-wide recovery counters (per-action), surfaced by
+    tools/profiling.py alongside the OOM retry counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+
+    def bump(self, action: str) -> None:
+        with self._lock:
+            self.counts[action] = self.counts.get(action, 0) + 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts.clear()
+
+
+recovery_metrics = RecoveryMetrics()
+
+
+def record_degradation(session, kind: str, action: str, error: str
+                       ) -> None:
+    """Record a recovery action handled *locally* by a subsystem (e.g.
+    the UDF worker pool degrading to inline evaluation) so it shows up
+    in the same trail/event stream as driver-level recoveries."""
+    recovery_metrics.bump(action)
+    rec = {"action": action, "fault": kind, "error": error,
+           "rung": "local"}
+    if session is not None:
+        getattr(session, "recovery_log", []).append(rec)
+        ev = getattr(session, "events", None)
+        if ev is not None and ev.enabled:
+            ev.emit("RecoveryAction",
+                    queryId=getattr(session, "_current_qid", None),
+                    action=action, fault=kind, error=error,
+                    rung="local")
+
+
+class QueryRetryDriver:
+    """Drives one query's execution attempts down the degradation
+    ladder.  ``run(attempt)`` calls ``attempt(mode)`` until it returns,
+    the ladder is exhausted, or a FATAL fault surfaces."""
+
+    def __init__(self, session, label: str = ""):
+        self.session = session
+        self.label = label
+        self.trail: List[dict] = []
+        from spark_rapids_tpu.config import rapids_conf as rc
+        conf = session.conf
+        self.enabled = conf.get(rc.QUERY_RECOVERY_ENABLED)
+        self.max_retries = conf.get(rc.QUERY_RECOVERY_MAX_RETRIES)
+        self.backoff_s = conf.get(rc.QUERY_RECOVERY_BACKOFF_MS) / 1e3
+
+    # ------------------------------------------------------------ ladder --
+    def _ladder(self) -> List[str]:
+        rungs = [RETRY] * self.max_retries + [SPILL_RETRY, SPLIT_RETRY]
+        if getattr(self.session, "mesh", None) is not None:
+            rungs.append(DEMOTE_SINGLE_DEVICE)
+        rungs.append(CPU_FALLBACK)
+        return rungs
+
+    @staticmethod
+    def _entry_rung(fault: F.Fault) -> str:
+        if fault.severity == F.DEGRADABLE:
+            # identical re-execution is pointless; jump to plan changes
+            return SPLIT_RETRY if fault.kind == "device_oom" \
+                else DEMOTE_SINGLE_DEVICE
+        if fault.kind == "device_oom":
+            # a bare retry without freeing HBM would just OOM again
+            return SPILL_RETRY
+        return RETRY
+
+    def _mode_for(self, rung: str, prev: AttemptMode) -> AttemptMode:
+        mode = AttemptMode(rung=rung, use_mesh=prev.use_mesh,
+                           cpu_only=prev.cpu_only,
+                           batch_scale=prev.batch_scale)
+        if rung == SPLIT_RETRY:
+            mode.batch_scale = prev.batch_scale / 2
+        elif rung == DEMOTE_SINGLE_DEVICE:
+            mode.use_mesh = False
+        elif rung == CPU_FALLBACK:
+            mode.use_mesh = False
+            mode.cpu_only = True
+        return mode
+
+    # ------------------------------------------------------------ events --
+    def _record(self, action: str, fault: F.Fault,
+                exc: BaseException) -> None:
+        recovery_metrics.bump(action)
+        rec = {"action": action, "fault": fault.kind,
+               "severity": fault.severity,
+               "error": f"{type(exc).__name__}: {exc}"}
+        self.trail.append(rec)
+        getattr(self.session, "recovery_log", []).append(rec)
+        ev = getattr(self.session, "events", None)
+        if ev is not None and ev.enabled:
+            ev.emit("RecoveryAction",
+                    queryId=getattr(self.session, "_current_qid", None),
+                    action=action, fault=fault.kind,
+                    severity=fault.severity, error=rec["error"],
+                    label=self.label)
+
+    def _emit_summary(self, status: str) -> None:
+        if not self.trail:
+            return
+        ev = getattr(self.session, "events", None)
+        if ev is not None and ev.enabled:
+            ev.emit("QueryRecovery",
+                    queryId=getattr(self.session, "_current_qid", None),
+                    status=status, actions=self.trail,
+                    label=self.label)
+
+    # --------------------------------------------------------------- run --
+    def run(self, attempt: Callable[[AttemptMode], Any]) -> Any:
+        mode = AttemptMode()
+        if not self.enabled:
+            return attempt(mode)
+        ladder = self._ladder()
+        pos = 0  # next rung to use on failure; only moves forward
+        backoffs = 0
+        while True:
+            try:
+                result = attempt(mode)
+                self._emit_summary("recovered")
+                return result
+            except Exception as exc:  # noqa: BLE001 - classified below
+                fault = F.classify(exc)
+                if fault.fatal:
+                    self._emit_summary("fatal")
+                    raise
+                # advance at least to the fault's entry rung (a device
+                # OOM never burns plain-retry budget, a degradable
+                # fault never burns the spill/split budget); an entry
+                # rung missing from this ladder (demote without a
+                # mesh) escalates to the next rung present
+                order = [RETRY, SPILL_RETRY, SPLIT_RETRY,
+                         DEMOTE_SINGLE_DEVICE, CPU_FALLBACK]
+                level = order.index(self._entry_rung(fault))
+                entry_pos = next(
+                    (i for i, r in enumerate(ladder)
+                     if order.index(r) >= level), len(ladder))
+                pos = max(pos, entry_pos)
+                if pos >= len(ladder):
+                    self._emit_summary("exhausted")
+                    raise
+                rung = ladder[pos]
+                pos += 1
+                self._record(rung, fault, exc)
+                mode = self._mode_for(rung, mode)
+                if rung == SPILL_RETRY:
+                    self._spill_device_store()
+                if rung == RETRY and self.backoff_s > 0:
+                    # exponential backoff, capped — chaos tests and
+                    # real preemptions both stay responsive
+                    time.sleep(min(self.backoff_s * (2 ** backoffs),
+                                   2.0))
+                    backoffs += 1
+
+    @staticmethod
+    def _spill_device_store() -> None:
+        import gc
+        gc.collect()  # drop dead device buffers so XLA can reuse HBM
+        from spark_rapids_tpu.memory.retry import _spill_device_store
+        _spill_device_store()

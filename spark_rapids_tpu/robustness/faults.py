@@ -1,0 +1,147 @@
+"""Fault taxonomy: classify every failure the engine can see.
+
+The reference keeps failure handling scattered — RMM alloc callbacks
+decide what an OOM means, the UCX transport decides what a peer death
+means, each operator decides what its own retry means.  This module is
+the single classification authority for the TPU port: ``classify``
+turns any exception into a ``Fault`` with a *kind* (what broke) and a
+*severity* (what recovery is allowed to do about it):
+
+- ``RETRYABLE``  — transient; re-running the same work can succeed
+  (device OOM after a spill, a reader hiccup, a preempted step).
+- ``DEGRADABLE`` — deterministic at this plan shape; only *changing*
+  the plan can succeed (demote the distributed plan to one device,
+  fall back to CPU, evaluate a UDF inline).
+- ``FATAL``      — a real error (user bug, corrupted input, host
+  memory exhaustion); recovery must re-raise, never mask it.
+
+Anything unrecognized is FATAL by default — the ladder never eats an
+exception it cannot name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# severities (ordered by how much the recovery path may change the plan)
+RETRYABLE = "RETRYABLE"
+DEGRADABLE = "DEGRADABLE"
+FATAL = "FATAL"
+
+# markers jax/XLA use for preemption-style runtime failures that are
+# worth re-driving (TPU maintenance events, donated-buffer races after
+# an aborted step, transport resets) — deliberately NOT including
+# RESOURCE_EXHAUSTED, which is_oom owns
+_PREEMPTION_MARKERS = ("UNAVAILABLE", "ABORTED", "DATA_LOSS",
+                       "DEADLINE_EXCEEDED", "preempted",
+                       "Socket closed", "Connection reset")
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One classified failure: ``kind`` names the subsystem/failure
+    mode, ``severity`` bounds what recovery may do."""
+
+    kind: str
+    severity: str
+
+    @property
+    def retryable(self) -> bool:
+        return self.severity == RETRYABLE
+
+    @property
+    def fatal(self) -> bool:
+        return self.severity == FATAL
+
+
+# ---------------------------------------------------------- fault types --
+class InjectedFault(Exception):
+    """Base for faults raised by the injection registry (inject.py).
+    Subclasses pin the kind/severity the real failure would have, so
+    the recovery path under test is exactly the production one."""
+
+    kind = "injected"
+    severity = RETRYABLE
+
+    def __init__(self, point: str, note: str = ""):
+        super().__init__(f"injected fault at {point!r}"
+                         + (f": {note}" if note else ""))
+        self.point = point
+
+
+class InjectedReaderFault(InjectedFault, OSError):
+    """Synthetic transient I/O error in a file scan."""
+    kind = "io_read"
+
+
+class InjectedShuffleFault(InjectedFault):
+    """Synthetic failure inside the all-to-all shuffle exchange."""
+    kind = "shuffle"
+
+
+class InjectedHostSyncFault(InjectedFault):
+    """Synthetic multi-host phase-boundary sync failure."""
+    kind = "host_sync"
+
+
+class InjectedSpillFault(InjectedFault, OSError):
+    """Synthetic disk-tier spill I/O error."""
+    kind = "spill_io"
+
+
+class InjectedWorkerFault(InjectedFault):
+    """Synthetic UDF worker-pool death (BrokenProcessPool analog)."""
+    kind = "udf_worker"
+    severity = DEGRADABLE
+
+
+class HostSyncError(RuntimeError):
+    """Multi-host phase boundary failed: the cross-process stats
+    all-gather timed out or the controllers diverged.  Retryable — the
+    SPMD contract re-establishes on the next collective."""
+
+
+class SpillIOError(OSError):
+    """Disk-tier spill I/O failed (write or read-back).  Retryable:
+    the batch is still resident at its previous tier, nothing is
+    lost, and the disk may only be transiently full/unreachable."""
+
+
+def _is_xla_runtime_error(exc: BaseException) -> bool:
+    # by name, not import: jaxlib moves this class between releases,
+    # and classification must not hard-depend on jaxlib internals
+    return any(c.__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+               for c in type(exc).__mro__)
+
+
+def classify(exc: BaseException) -> Fault:
+    """Map an exception to the taxonomy.  Precedence: injected faults
+    declare themselves; device OOM (via ``memory/retry.is_oom``) next;
+    then the engine's own typed failures; unknown -> FATAL."""
+    if isinstance(exc, InjectedFault):
+        return Fault(exc.kind, exc.severity)
+    from spark_rapids_tpu.memory.retry import SplitAndRetryOOM, is_oom
+    if isinstance(exc, SplitAndRetryOOM):
+        # operator-level split already bottomed out at the 1-row floor;
+        # only a plan change (smaller scan batches, CPU) can help
+        return Fault("device_oom", DEGRADABLE)
+    if is_oom(exc):
+        return Fault("device_oom", RETRYABLE)
+    if isinstance(exc, HostSyncError):
+        return Fault("host_sync", RETRYABLE)
+    if isinstance(exc, SpillIOError):
+        return Fault("spill_io", RETRYABLE)
+    try:
+        from concurrent.futures.process import BrokenProcessPool
+        if isinstance(exc, BrokenProcessPool):
+            # pool infrastructure death; the worker pool usually
+            # degrades inline before this escapes to a query
+            return Fault("udf_worker", DEGRADABLE)
+    except ImportError:  # torn-down interpreter only
+        pass
+    if _is_xla_runtime_error(exc):
+        text = str(exc)
+        if any(m in text for m in _PREEMPTION_MARKERS):
+            return Fault("preemption", RETRYABLE)
+        return Fault("xla_runtime", FATAL)
+    return Fault("unknown", FATAL)

@@ -1,0 +1,176 @@
+"""Scoped fault-injection registry: named points, count/probability
+rules, context-manager scoping.
+
+Generalizes the ad-hoc ``memory/retry.inject_oom(n)`` pattern (the
+RmmSpark force-retry analog) to every failure surface the taxonomy
+names.  Each subsystem threads one cheap ``fire(point)`` checkpoint
+through its hot path; tests arm rules against those points:
+
+    with injected("shuffle.exchange", count=2):
+        df.to_pandas()          # first two exchanges die, driver recovers
+
+Rules are thread-scoped by default (a rule armed on the test thread
+never fires in another session's worker thread); points that execute
+on pool threads — the disk spill writers — take ``all_threads=True``.
+
+Adding an injection point is two lines: ``register_point(name,
+default_exc)`` here (or at the subsystem's import time), and a
+``fire(name)`` call at the failure site.  The default exception class
+pins the fault kind/severity the real failure would classify as, so
+the recovery path under test is the production one.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu.robustness import faults as F
+
+# known points -> the fault each raises by default.  "memory.oom" is
+# the legacy inject_oom surface; its exception type lives in
+# memory/retry.py (it must stay a MemoryError for is_oom), so it
+# registers lazily from there.
+_POINTS: Dict[str, Optional[Type[BaseException]]] = {
+    "io.read": F.InjectedReaderFault,
+    "shuffle.exchange": F.InjectedShuffleFault,
+    "dist.host_sync": F.InjectedHostSyncFault,
+    "spill.disk": F.InjectedSpillFault,
+    "udf.worker": F.InjectedWorkerFault,
+}
+
+
+def register_point(name: str,
+                   default_exc: Optional[Type[BaseException]] = None
+                   ) -> None:
+    """Declare an injection point (idempotent).  Subsystems call this
+    at import time so ``injection_points()`` is the live catalog."""
+    _POINTS.setdefault(name, default_exc)
+    if default_exc is not None and _POINTS[name] is None:
+        _POINTS[name] = default_exc
+
+
+def injection_points() -> List[str]:
+    return sorted(_POINTS)
+
+
+class InjectionRule:
+    """One armed rule.  Count-based by default (fire the next ``count``
+    checkpoints after skipping ``skip``); with ``probability`` set,
+    each checkpoint fires with that chance (seeded — chaos runs must
+    replay) until ``count`` faults have fired."""
+
+    def __init__(self, point: str, *, count: int = 1, skip: int = 0,
+                 probability: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 exc: Optional[Callable[..., BaseException]] = None,
+                 all_threads: bool = False):
+        if point not in _POINTS:
+            raise KeyError(
+                f"unknown injection point {point!r}; known: "
+                f"{injection_points()} (register_point to add one)")
+        self.point = point
+        self.remaining = int(count)
+        self.skip = int(skip)
+        self.probability = probability
+        self._rng = random.Random(seed)
+        self.exc = exc or _POINTS[point] or F.InjectedFault
+        self.thread_id = None if all_threads else threading.get_ident()
+        self.fired = 0
+
+    def _matches_thread(self) -> bool:
+        return self.thread_id is None or \
+            self.thread_id == threading.get_ident()
+
+    def _should_fire(self) -> bool:
+        if self.remaining <= 0 or not self._matches_thread():
+            return False
+        if self.probability is not None:
+            return self._rng.random() < self.probability
+        if self.skip > 0:
+            self.skip -= 1
+            return False
+        return True
+
+    def make_exc(self, note: str) -> BaseException:
+        if isinstance(self.exc, type) and \
+                issubclass(self.exc, F.InjectedFault):
+            return self.exc(self.point, note)
+        # plain exception classes (e.g. the legacy InjectedOomError)
+        # take a single message
+        return self.exc(f"injected fault at {self.point!r}"
+                        + (f": {note}" if note else ""))
+
+
+_lock = threading.Lock()
+_rules: List[InjectionRule] = []
+# cheap hot-path guard: fire() is threaded through per-batch loops and
+# must cost one attribute read when nothing is armed
+_armed = False
+
+
+def inject(point: str, **kw) -> InjectionRule:
+    """Arm a rule; see ``InjectionRule`` for the knobs.  Returns the
+    rule (pass to ``remove``/inspect ``fired``)."""
+    global _armed
+    rule = InjectionRule(point, **kw)
+    with _lock:
+        _rules.append(rule)
+        _armed = True
+    return rule
+
+
+def remove(rule: InjectionRule) -> None:
+    global _armed
+    with _lock:
+        if rule in _rules:
+            _rules.remove(rule)
+        _armed = bool(_rules)
+
+
+def clear(point: Optional[str] = None, *,
+          this_thread_only: bool = False) -> None:
+    """Disarm every rule (or just one point's).  With
+    ``this_thread_only``, only rules armed by the calling thread are
+    removed — the inject_oom shim needs the old ``threading.local``
+    semantics where one thread's re-arm never disarms another's."""
+    global _armed
+    tid = threading.get_ident()
+
+    def _keep(r: InjectionRule) -> bool:
+        if point is not None and r.point != point:
+            return True
+        return this_thread_only and r.thread_id != tid
+
+    with _lock:
+        _rules[:] = [r for r in _rules if _keep(r)]
+        _armed = bool(_rules)
+
+
+@contextmanager
+def injected(point: str, **kw):
+    """Scope a rule to a ``with`` block — the chaos-test idiom."""
+    rule = inject(point, **kw)
+    try:
+        yield rule
+    finally:
+        remove(rule)
+
+
+def fire(point: str, note: str = "") -> None:
+    """Checkpoint: raise the armed fault for ``point``, if any.  Called
+    on the engine's hot paths — the unarmed cost is one global read."""
+    if not _armed:
+        return
+    with _lock:
+        for rule in _rules:
+            if rule.point == point and rule._should_fire():
+                rule.remaining -= 1
+                rule.fired += 1
+                exc = rule.make_exc(note)
+                break
+        else:
+            return
+    raise exc

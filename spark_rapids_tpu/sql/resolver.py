@@ -165,6 +165,7 @@ class Resolver:
         has_aggs = stmt.group_by or any(
             self._contains_agg(p.expr) for p in projections) or (
             stmt.having is not None and self._contains_agg(stmt.having))
+        group_alias: Dict[str, str] = {}  # flat group key -> out alias
 
         if has_aggs:
             # group keys: plain column refs group directly; computed
@@ -243,6 +244,13 @@ class Resolver:
                 name = p.alias or self._default_name(p.expr)
                 out_cols.append(self._expr(ast, post_scope).alias(name))
                 out_names.append(name)
+                # a bare projection of a group key under an alias
+                # (SELECT ca.ca_state state ... GROUP BY ca.ca_state):
+                # remember flat-key -> output-alias so a qualified
+                # ORDER BY ref to the key can find its output column
+                if isinstance(ast, A.ColRef) and len(ast.parts) == 1 \
+                        and ast.parts[0] in key_cols:
+                    group_alias.setdefault(ast.parts[0], name)
             df = df.select(*out_cols)
         else:
             if stmt.having is not None:
@@ -296,7 +304,7 @@ class Resolver:
             df = df.orderBy(*[
                 self._order_key(o, out_names,
                                 grouped=has_aggs or stmt.distinct,
-                                scope=scope)
+                                scope=scope, key_alias=group_alias)
                 for o in stmt.order_by])
         if stmt.limit is not None:
             df = df.limit(stmt.limit)
@@ -449,13 +457,18 @@ class Resolver:
 
     def _order_name(self, o: A.OrderItem, out_names: List[str],
                     allow_qualified: bool = False,
-                    scope: Optional[Scope] = None) -> Optional[str]:
+                    scope: Optional[Scope] = None,
+                    key_alias: Optional[Dict[str, str]] = None
+                    ) -> Optional[str]:
         """Output-column name an ORDER BY item refers to, or None when
         it must resolve against the pre-projection input.  In grouped/
         DISTINCT queries (``allow_qualified``) there is no input to
         fall back to, so a qualified ref (c.name) matches the output
         column its last part named — after validating the qualifier
-        actually owns that column in ``scope``."""
+        actually owns that column in ``scope``.  ``key_alias`` maps a
+        GROUP BY key's flat column to the alias its projection gave it
+        (SELECT ca.ca_state state ... ORDER BY ca.ca_state — Spark
+        resolves the qualified ref against the grouping expression)."""
         if isinstance(o.expr, A.Lit) and isinstance(o.expr.value, int):
             pos = o.expr.value
             if not 1 <= pos <= len(out_names):
@@ -493,6 +506,11 @@ class Resolver:
                     # output — b.v must not silently sort by a's v
                     if flat in out_names:
                         return flat
+                    # ... or a GROUP BY key whose aliased output is
+                    # projected (Spark resolves a qualified ORDER BY
+                    # ref against the grouping expressions)
+                    if key_alias and flat in key_alias:
+                        return key_alias[flat]
                     raise KeyError(
                         f"ORDER BY {parts[0]}.{parts[1]}: that "
                         "relation's column is not among the outputs")
@@ -502,13 +520,14 @@ class Resolver:
 
     def _order_key(self, o: A.OrderItem, out_names: List[str],
                    grouped: bool = False,
-                   scope: Optional[Scope] = None):
+                   scope: Optional[Scope] = None,
+                   key_alias: Optional[Dict[str, str]] = None):
         """Post-projection sort key.  Qualified refs (t.c) may match
         output columns by last part only in GROUPED/DISTINCT queries,
         where no input relation survives to resolve them against."""
         F = self.F
         name = self._order_name(o, out_names, allow_qualified=grouped,
-                                scope=scope)
+                                scope=scope, key_alias=key_alias)
         if name is None:
             raise ValueError(
                 "ORDER BY supports output columns/aliases/positions "

@@ -58,6 +58,10 @@ def collect_surface() -> Dict[str, List[str]]:
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.config import rapids_conf as rc
     from spark_rapids_tpu.plan import logical as L
+    # registers its DictLookup expression rule at import time; import
+    # it here so the audited surface does not depend on whether a
+    # distributed query ran first in this process
+    from spark_rapids_tpu.parallel import dist_planner  # noqa: F401
     from spark_rapids_tpu.plan.overrides import (
         _EXPR_RULES, _PLAN_CONVERTERS)
 

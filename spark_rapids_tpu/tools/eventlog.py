@@ -28,6 +28,9 @@ class QueryInfo:
     metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
     spill: Dict[str, int] = field(default_factory=dict)
     retry: Dict[str, int] = field(default_factory=dict)
+    # query-level recovery ladder actions (robustness/driver.py
+    # RecoveryAction events stamped with this query's id)
+    recovery: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -52,6 +55,9 @@ class AppInfo:
     conf: Dict[str, str] = field(default_factory=dict)
     queries: List[QueryInfo] = field(default_factory=list)
     start_ts: float = 0.0   # SessionStart record ts
+    # recovery actions not attributable to a query (no qid yet when
+    # the attempt died before its QueryStart)
+    recovery: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def total_duration_ms(self) -> float:
@@ -66,6 +72,7 @@ class AppInfo:
 def parse_event_log(path: str) -> AppInfo:
     app = AppInfo(session_id=os.path.basename(path), path=path)
     open_queries: Dict[int, QueryInfo] = {}
+    all_queries: Dict[int, QueryInfo] = {}  # incl. completed, last wins
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -87,6 +94,17 @@ def parse_event_log(path: str) -> AppInfo:
                               explain=rec.get("explain", ""),
                               start_ts=rec.get("ts", 0.0))
                 open_queries[q.query_id] = q
+                all_queries[q.query_id] = q
+            elif ev == "RecoveryAction":
+                # emitted AFTER the failed attempt's QueryEnd, so match
+                # completed queries too; un-attributed actions go on the
+                # app
+                info = {k: rec[k] for k in ("action", "fault",
+                                            "severity", "error", "rung")
+                        if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                (q.recovery if q is not None
+                 else app.recovery).append(info)
             elif ev == "QueryEnd":
                 q = open_queries.pop(rec["queryId"],
                                      QueryInfo(rec["queryId"]))

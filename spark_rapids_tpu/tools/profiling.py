@@ -79,6 +79,15 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                 problems.append(
                     f"{a.session_id} query {q.query_id}: device OOM "
                     f"recovered — {retries} retries, {splits} splits")
+            for r in q.recovery:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: recovery "
+                    f"action {r.get('action')} after "
+                    f"{r.get('fault')} fault")
+        for r in a.recovery:
+            problems.append(
+                f"{a.session_id}: recovery action {r.get('action')} "
+                f"after {r.get('fault')} fault")
     return problems
 
 

@@ -88,12 +88,36 @@ def worth_trying(fn, nrows: int, num_workers: int,
     return True
 
 
+def _record_pool_degradation(error: str) -> None:
+    """Surface the pool->inline degradation on the unified recovery
+    trail (robustness/driver.py) — it is a recovery action even though
+    no exception ever reaches the query driver."""
+    from spark_rapids_tpu.robustness.driver import record_degradation
+    try:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    except ImportError:  # torn-down interpreter only
+        session = None
+    record_degradation(session, "udf_worker", "inline_fallback", error)
+
+
 def eval_rows(fn, rows: List[tuple], num_workers: int,
               min_rows_per_worker: int = 256) -> Optional[list]:
     """Evaluate ``fn`` over rows on the worker pool; None when the pool
     path does not apply (disabled, too few rows, unpicklable fn) and
     the caller should evaluate inline."""
     if not worth_trying(fn, len(rows), num_workers, min_rows_per_worker):
+        return None
+    # "udf.worker" models the pool dying before any row evaluates (the
+    # spawn-broken / worker-killed class): degrade to inline evaluation
+    # exactly like the real BrokenProcessPool handler below
+    from spark_rapids_tpu.robustness.faults import InjectedWorkerFault
+    from spark_rapids_tpu.robustness.inject import fire
+    try:
+        fire("udf.worker")
+    except InjectedWorkerFault as e:
+        shutdown_pool()
+        _record_pool_degradation(f"{type(e).__name__}: {e}")
         return None
     try:
         fn_bytes = pickle.dumps(fn)
@@ -123,10 +147,11 @@ def eval_rows(fn, rows: List[tuple], num_workers: int,
         except TypeError:
             pass
         return None
-    except BrokenProcessPool:
+    except BrokenProcessPool as e:
         # pool infrastructure failure (worker killed, spawn broken)
         # degrades to inline evaluation rather than failing the query
         shutdown_pool()
+        _record_pool_degradation(f"{type(e).__name__}: {e}")
         return None
     # any other (user UDF) exception propagates — re-running inline
     # would duplicate side effects the completed rows already had

@@ -1,0 +1,345 @@
+"""Chaos suite: every named injection point is triggered and the
+query-level recovery driver must absorb it — results identical to the
+clean run, with the expected recovery trail recorded.
+
+Oracle pattern (the RmmSpark force-retry analog, generalized): arm a
+fault rule, run the query, diff against the uninjected run.  Marked
+``chaos`` so CI can run the injection paths standalone
+(``pytest -m chaos``) and they cannot silently rot.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.models import tpch
+from spark_rapids_tpu.robustness import faults as FT
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    yield
+    I.clear()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.gen_tables(sf=0.002)
+
+
+@pytest.fixture(scope="module")
+def lineitem_parquet(tmp_path_factory, data):
+    path = tmp_path_factory.mktemp("tpch") / "lineitem.parquet"
+    data["lineitem"].to_parquet(path, index=False)
+    return str(path)
+
+
+def _actions(session):
+    return [r["action"] for r in session.recovery_log]
+
+
+def _faults(session):
+    return [r["fault"] for r in session.recovery_log]
+
+
+def _norm(df, keys):
+    return df.sort_values(keys, ignore_index=True)
+
+
+# --------------------------------------------------------------- taxonomy --
+def test_classify_taxonomy():
+    from spark_rapids_tpu.memory.retry import (InjectedOomError,
+                                               SplitAndRetryOOM)
+    assert FT.classify(InjectedOomError("x")).kind == "device_oom"
+    assert FT.classify(InjectedOomError("x")).retryable
+    assert FT.classify(
+        RuntimeError("RESOURCE_EXHAUSTED: oom")).retryable
+    # host memory pressure must never enter the recovery ladder
+    assert FT.classify(MemoryError("host")).fatal
+    assert FT.classify(ValueError("user bug")).fatal
+    assert FT.classify(SplitAndRetryOOM("floor")).severity == \
+        FT.DEGRADABLE
+    assert FT.classify(FT.HostSyncError("t/o")).kind == "host_sync"
+    assert FT.classify(FT.SpillIOError("disk")).retryable
+    f = FT.classify(FT.InjectedWorkerFault("udf.worker"))
+    assert (f.kind, f.severity) == ("udf_worker", FT.DEGRADABLE)
+
+
+def test_registry_count_skip_and_scope():
+    fired = []
+    with I.injected("io.read", count=2, skip=1) as rule:
+        for _ in range(5):
+            try:
+                I.fire("io.read")
+            except FT.InjectedReaderFault:
+                fired.append(True)
+        assert rule.fired == 2
+    assert len(fired) == 2  # skip=1 passed the first checkpoint
+    I.fire("io.read")  # disarmed on scope exit
+
+
+def test_registry_probability_is_seeded():
+    def run():
+        hits = 0
+        with I.injected("io.read", count=100, probability=0.5, seed=7):
+            for _ in range(50):
+                try:
+                    I.fire("io.read")
+                except FT.InjectedReaderFault:
+                    hits += 1
+        return hits
+    a, b = run(), run()
+    assert a == b and 0 < a < 50  # replayable, and actually random
+
+
+def test_registry_unknown_point_rejected():
+    with pytest.raises(KeyError):
+        I.inject("no.such.point")
+
+
+# ---------------------------------------------------------- reader faults --
+def test_reader_fault_recovers(lineitem_parquet):
+    s = TpuSession()
+    df = (s.read.parquet(lineitem_parquet)
+          .group_by("l_returnflag")
+          .agg(F.sum(F.col("l_extendedprice")).alias("rev"),
+               F.count(F.col("l_quantity")).alias("n")))
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    with I.injected("io.read", count=2):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["l_returnflag"]),
+                                  _norm(want, ["l_returnflag"]))
+    assert _actions(s) == ["retry", "retry"]
+    assert set(_faults(s)) == {"io_read"}
+
+
+def test_reader_fault_exhausts_to_degradation(lineitem_parquet):
+    # a reader that NEVER succeeds must still answer (CPU fallback
+    # reads through a different code path with no injection point)
+    s = TpuSession()
+    df = (s.read.parquet(lineitem_parquet)
+          .group_by("l_returnflag")
+          .agg(F.sum(F.col("l_extendedprice")).alias("rev")))
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    with I.injected("io.read", count=1000):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["l_returnflag"]),
+                                  _norm(want, ["l_returnflag"]),
+                                  check_dtype=False)
+    assert _actions(s)[-1] == "cpu"
+
+
+# ----------------------------------------------------------- mesh faults --
+@pytest.fixture()
+def mesh_session():
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    return TpuSession(mesh=make_mesh(8))
+
+
+def _mesh_agg(session, data, extra_count=False):
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame({
+        "k": rng.integers(0, 40, 4096),
+        "v": rng.normal(size=4096),
+    })
+    df = session.create_dataframe(pdf).group_by("k")
+    if extra_count:
+        return df.agg(F.sum(F.col("v")).alias("s"),
+                      F.count(F.col("v")).alias("c"))
+    return df.agg(F.sum(F.col("v")).alias("s"))
+
+
+def test_shuffle_fault_recovers_distributed(mesh_session, data):
+    s = mesh_session
+    df = _mesh_agg(s, data)
+    # injected run FIRST: the exchange checkpoint fires at trace time,
+    # and a clean run would warm the jit cache past it
+    s.recovery_log.clear()
+    with I.injected("shuffle.exchange", count=1):
+        got = df.to_pandas()
+    assert _actions(s) == ["retry"]
+    assert _faults(s) == ["shuffle"]
+    # recovered on the mesh, not by falling off it
+    assert s.last_dist_explain == "distributed"
+    oracle = TpuSession()
+    want = _mesh_agg(oracle, data).to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]),
+                                  check_dtype=False)
+
+
+def test_host_sync_fault_demotes_to_single_device(mesh_session, data):
+    s = mesh_session
+    df = _mesh_agg(s, data, extra_count=True)
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    # a phase boundary that NEVER heals: the ladder must take the plan
+    # off the mesh (the split rung replans single-device, where no
+    # host_sync ever fires) and still answer
+    with I.injected("dist.host_sync", count=10_000):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]),
+                                  check_dtype=False)
+    assert _actions(s) == ["retry", "retry", "spill", "split"]
+    assert set(_faults(s)) == {"host_sync"}
+    assert s.last_dist_explain.startswith("demoted")
+
+
+def test_driver_demote_rung_replans_off_mesh():
+    # the demote rung itself: a DEGRADABLE non-OOM fault enters the
+    # ladder at DEMOTE and the attempt succeeds once off the mesh
+    from spark_rapids_tpu.robustness.driver import QueryRetryDriver
+
+    s = TpuSession()
+    s.mesh = object()  # enough for the driver to offer the demote rung
+    calls = []
+
+    def attempt(mode):
+        calls.append(mode.rung)
+        if mode.use_mesh:
+            raise FT.InjectedWorkerFault("udf.worker")  # DEGRADABLE
+        return "answer"
+
+    assert QueryRetryDriver(s).run(attempt) == "answer"
+    assert calls == ["initial", "demote"]
+    assert _actions(s) == ["demote"]
+
+
+# ----------------------------------------------------------- spill faults --
+def test_spill_disk_fault_recovers():
+    # budgets so tiny every registered batch cascades to the disk tier
+    s = TpuSession({
+        "spark.rapids.memory.tpu.deviceLimitBytes": 4096,
+        "spark.rapids.memory.host.spillStorageSize": 4096,
+        "spark.rapids.memory.spill.diskWriteThreads": 1,
+    })
+    rng = np.random.default_rng(5)
+    pdf = pd.DataFrame({"k": rng.integers(0, 1000, 3000),
+                        "v": rng.normal(size=3000)})
+    df = s.create_dataframe(pdf).orderBy("k")
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    with I.injected("spill.disk", count=1, all_threads=True):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(
+        _norm(got, ["k", "v"]), _norm(want, ["k", "v"]))
+    assert "retry" in _actions(s)
+    assert "spill_io" in _faults(s)
+
+
+# ------------------------------------------------------------- UDF faults --
+def _blackbox_half(x):
+    # dict indirection keeps the UDF compiler from lowering this to a
+    # device expression — it must take the worker-pool/inline path
+    return {"f": x * 0.5}["f"]
+
+
+def test_udf_worker_death_degrades_inline():
+    s = TpuSession({"spark.rapids.sql.python.numWorkers": 2})
+    pdf = pd.DataFrame({"x": np.arange(2000, dtype=np.float64)})
+    half = F.udf(_blackbox_half, returnType="double")
+    df = s.create_dataframe(pdf).select(half(F.col("x")).alias("h"))
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    with I.injected("udf.worker", count=1):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(got, want)
+    # degradation was local (inline fallback), not a query re-drive
+    assert ("inline_fallback", "udf_worker") in [
+        (r["action"], r["fault"]) for r in s.recovery_log]
+    from spark_rapids_tpu.udf.worker_pool import shutdown_pool
+    shutdown_pool()
+
+
+# ------------------------------------------------------------ OOM ladder --
+def test_persistent_oom_degrades_down_ladder():
+    from spark_rapids_tpu.memory import retry as R
+    s = TpuSession()
+    rng = np.random.default_rng(11)
+    pdf = pd.DataFrame({"k": rng.integers(0, 20, 1000),
+                        "v": rng.normal(size=1000)})
+    df = (s.create_dataframe(pdf).group_by("k")
+          .agg(F.sum(F.col("v")).alias("sv")))
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    R.inject_oom(10_000)  # outlives every operator + query retry budget
+    try:
+        got = df.to_pandas()
+    finally:
+        R.clear_injected_oom()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]),
+                                  check_dtype=False)
+    assert _actions(s)[-1] == "cpu"  # bottom of the ladder answered
+
+
+# ------------------------------------------------------------ event trail --
+def test_recovery_actions_land_in_event_log(tmp_path, lineitem_parquet):
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path)})
+    df = (s.read.parquet(lineitem_parquet)
+          .group_by("l_linestatus")
+          .agg(F.sum(F.col("l_tax")).alias("t")))
+    with I.injected("io.read", count=1):
+        df.to_pandas()
+    s.stop()
+    apps = load_logs(str(tmp_path))
+    assert apps
+    recs = [r for a in apps
+            for r in a.recovery +
+            [r for q in a.queries for r in q.recovery]]
+    assert any(r.get("action") == "retry" and r.get("fault") == "io_read"
+               for r in recs)
+    # per-query attribution: the failed attempt's qid carries the action
+    assert any(q.recovery for a in apps for q in a.queries)
+
+
+# ------------------------------------------------------------- fuzz spray --
+def test_fuzz_spray_tpch_q1(data, lineitem_parquet):
+    """Randomly spray retryable faults through TPC-H q1 and require the
+    answer to match the clean run bit-for-bit (modulo row order)."""
+    from spark_rapids_tpu.memory import retry as R
+    s = TpuSession()
+    t = {"lineitem": s.create_dataframe(data["lineitem"])}
+    q = tpch.q1(t)
+    want = q.to_pandas()
+    keys = ["l_returnflag", "l_linestatus"]
+    rules = []
+    s.recovery_log.clear()
+    try:
+        rules.append(I.inject("memory.oom", count=50, probability=0.2,
+                              seed=13))
+        rules.append(I.inject("spill.disk", count=50, probability=0.2,
+                              seed=17, all_threads=True))
+        got = q.to_pandas()
+    finally:
+        for r in rules:
+            I.remove(r)
+        R.clear_injected_oom()
+    pd.testing.assert_frame_equal(_norm(got, keys), _norm(want, keys),
+                                  check_dtype=False)
+
+
+def test_fuzz_spray_reader(lineitem_parquet):
+    s = TpuSession()
+    df = (s.read.parquet(lineitem_parquet)
+          .group_by("l_returnflag", "l_linestatus")
+          .agg(F.sum(F.col("l_extendedprice")).alias("rev"),
+               F.avg(F.col("l_discount")).alias("d")))
+    want = df.to_pandas()
+    keys = ["l_returnflag", "l_linestatus"]
+    with I.injected("io.read", count=20, probability=0.4, seed=23):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, keys), _norm(want, keys),
+                                  check_dtype=False)

@@ -35,18 +35,37 @@ def cmp(got: pd.DataFrame, want: pd.DataFrame):
         (list(got.columns), list(want.columns))
     cols = list(got.columns)
 
-    def norm(df):
-        out = df.sort_values(cols, ignore_index=True,
-                             na_position="last")
-        for c in out.columns:
-            if not pd.api.types.is_numeric_dtype(out[c]):
-                # one null spelling (arrow string arrays say nan,
-                # object frames say None)
-                out[c] = out[c].astype(object).where(
-                    out[c].notna(), None)
-        return out
+    def sort(df):
+        return df.sort_values(cols, ignore_index=True,
+                              na_position="last")
 
-    pd.testing.assert_frame_equal(norm(got), norm(want),
+    got, want = sort(got), sort(want)
+    for c in cols:
+        if pd.api.types.is_numeric_dtype(got[c]) and \
+                pd.api.types.is_numeric_dtype(want[c]):
+            continue  # numeric vs numeric: rtol compare, NaN == NaN
+        # one null spelling for EVERY other column pairing: rollup-null
+        # key columns come back float64 NaN from the engine but object
+        # None from the pandas oracle (and arrow string arrays say nan
+        # where object frames say None).  Numpy scalars unbox to plain
+        # python numbers; ints stay ints (1998 == 1998.0 already holds
+        # under object equality, and float-coercing would let int64s
+        # past 2^53 spuriously compare equal)
+
+        def canon(s):
+            def c(v):
+                if pd.isna(v):
+                    return None
+                if isinstance(v, np.floating):
+                    return float(v)
+                if isinstance(v, np.integer):
+                    return int(v)
+                return v
+            return s.astype(object).map(c)
+
+        got[c], want[c] = canon(got[c]), canon(want[c])
+
+    pd.testing.assert_frame_equal(got, want,
                                   check_dtype=False, rtol=1e-9)
 
 

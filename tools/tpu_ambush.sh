@@ -8,7 +8,7 @@
 # Logs to tpu_ambush.log.
 
 set -u
-cd "$(dirname "$0")"
+cd "$(dirname "$0")/.."
 MAX_SECONDS=${MAX_SECONDS:-39600}   # 11h
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-50}
 CAPTURE_TIMEOUT=${CAPTURE_TIMEOUT:-900}
@@ -30,7 +30,7 @@ while true; do
     'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)
   if [ "$plat" = "tpu" ]; then
     log "probe #$n LIVE — firing capture"
-    timeout "$CAPTURE_TIMEOUT" python tpu_capture.py >> "$LOG" 2>&1
+    timeout "$CAPTURE_TIMEOUT" python tools/tpu_capture.py >> "$LOG" 2>&1
     rc=$?
     log "capture rc=$rc"
     if python - <<'EOF' 2>/dev/null

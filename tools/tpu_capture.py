@@ -21,7 +21,7 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_tpu_capture.json")
 _T0 = time.monotonic()
 
