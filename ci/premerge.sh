@@ -16,6 +16,9 @@ python -m pytest tests/ -q --maxfail=20 -m 'not chaos'
 echo "== chaos suite (fault injection + recovery ladder) =="
 python -m pytest tests/ -q -m chaos --maxfail=5
 
+echo "== perf smoke (deterministic host-sync budgets, no timing) =="
+python -m pytest tests/ -q -m perf --maxfail=5
+
 echo "== docgen drift check =="
 tmp=$(mktemp -d)
 python -m spark_rapids_tpu.tools.docgen "$tmp"

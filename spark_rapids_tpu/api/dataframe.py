@@ -646,6 +646,30 @@ class DataFrame:
             overrides = TpuOverrides(conf, self.session.cache_manager)
         return self._run_single_process(mode, overrides)
 
+    def _drive(self, exec_plan) -> List[ColumnarBatch]:
+        """Materialize the plan's batches — through the asynchronous
+        pipeline driver (exec/pipeline.py) when enabled, else the
+        sequential pull loop.  Pipeline stats land on
+        ``session.last_pipeline_stats`` either way (None when
+        sequential) so benches and the event log can attribute overlap
+        wins."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.session.last_pipeline_stats = None
+        conf = self.session.conf
+        if not conf.get(rc.PIPELINE_ENABLED):
+            return list(exec_plan.execute())
+        from spark_rapids_tpu.exec.pipeline import (
+            PipelineStats, pipelined)
+        stats = PipelineStats(conf.get(rc.PIPELINE_DEPTH))
+        try:
+            return list(pipelined(
+                exec_plan.execute(), stats.depth,
+                catalog=getattr(self.session, "memory_catalog", None),
+                stats=stats,
+                semaphore=getattr(self.session, "semaphore", None)))
+        finally:
+            self.session.last_pipeline_stats = stats
+
     def _run_single_process(self, mode,
                             overrides=None) -> List[ColumnarBatch]:
         import time as _time
@@ -658,7 +682,7 @@ class DataFrame:
         events = getattr(self.session, "events", None)
         if events is None or not events.enabled:
             self.session._current_qid = None
-            return list(exec_plan.execute())
+            return self._drive(exec_plan)
         qid = next(self.session._query_ids)
         # the recovery driver stamps RecoveryAction events with the qid
         # of the attempt that failed
@@ -675,10 +699,12 @@ class DataFrame:
         # thread-local view: concurrent queries on other threads must not
         # contaminate this query's attribution
         retry0 = retry_metrics.snapshot_local()
+        from spark_rapids_tpu.ops.jit_cache import cache_info
+        jit0 = cache_info()
         t0 = _time.perf_counter()
         status = "success"
         try:
-            return list(exec_plan.execute())
+            return self._drive(exec_plan)
         except Exception as e:
             status = f"failed: {type(e).__name__}: {e}"
             raise
@@ -689,11 +715,18 @@ class DataFrame:
                 "spilledToDiskBytes": cat.spilled_to_disk_total - disk0,
             }
             retry1 = retry_metrics.snapshot_local()
+            ps = getattr(self.session, "last_pipeline_stats", None)
+            jit1 = cache_info()
+            pipeline = ps.as_dict() if ps is not None else {}
+            pipeline["jitCacheHits"] = jit1["hits"] - jit0["hits"]
+            pipeline["jitCacheMisses"] = \
+                jit1["misses"] - jit0["misses"]
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
                 durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
                 metrics=exec_plan.collect_metrics(), spill=spill,
-                retry={k: retry1[k] - retry0[k] for k in retry1})
+                retry={k: retry1[k] - retry0[k] for k in retry1},
+                pipeline=pipeline)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -66,6 +66,7 @@ class TpuSession:
         self.overrides = TpuOverrides(self.conf, self.cache_manager)
         self.last_dist_explain = ""
         self.last_scan_stats = None  # set by the sharded distributed scan
+        self.last_pipeline_stats = None  # exec/pipeline.py PipelineStats
         self.last_planning_error = None  # set by suppressPlanningFailure
         self.mesh = mesh
         if self.mesh is None:

@@ -14,26 +14,44 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dts
-from spark_rapids_tpu.columnar.column import Column, bucket_capacity
+from spark_rapids_tpu.columnar.column import (
+    Column, RowCount, bucket_capacity)
 from spark_rapids_tpu.columnar.dtypes import DataType
 
 Schema = Sequence[Tuple[str, DataType]]
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "nrows")
+    __slots__ = ("columns", "_row_count")
 
-    def __init__(self, columns: Dict[str, Column], nrows: Optional[int] = None):
+    def __init__(self, columns: Dict[str, Column], nrows=None):
         self.columns: Dict[str, Column] = dict(columns)
         if nrows is None:
             if not columns:
                 raise ValueError("empty batch needs explicit nrows")
-            nrows = next(iter(columns.values())).nrows
-        self.nrows = int(nrows)
-        for name, col in self.columns.items():
-            if col.nrows != self.nrows:
-                raise ValueError(
-                    f"column {name} nrows {col.nrows} != batch {self.nrows}")
+            nrows = next(iter(columns.values())).row_count
+        self._row_count = RowCount.wrap(nrows)
+        if self._row_count.is_concrete:
+            # deferred counts skip the cross-column check: forcing each
+            # column's device scalar here would defeat the deferral (the
+            # count is shared from one kernel output anyway)
+            n = int(self._row_count)
+            for name, col in self.columns.items():
+                if col.row_count.is_concrete and col.nrows != n:
+                    raise ValueError(
+                        f"column {name} nrows {col.nrows} != batch {n}")
+
+    @property
+    def nrows(self) -> int:
+        """Concrete row count (syncs once if carried lazily on device)."""
+        return int(self._row_count)
+
+    @property
+    def row_count(self) -> RowCount:
+        """The possibly-lazy count; device paths use
+        ``row_count.device_i32()`` instead of ``nrows`` so a deferred
+        aggregate count never forces a host sync."""
+        return self._row_count
 
     # ------------------------------------------------------------------ basics --
     @property
@@ -143,7 +161,8 @@ class ColumnarBatch:
                     seen.add(id(buf))
                     device_bufs.append(buf)
         if device_bufs:
-            fetched = jax.device_get(device_bufs)
+            from spark_rapids_tpu.utils import hostsync
+            fetched = hostsync.fetch_all(device_bufs)
             cache = {id(d): h for d, h in zip(device_bufs, fetched)}
 
             def pick(c, kind):
@@ -171,16 +190,18 @@ class ColumnarBatch:
 
     # --------------------------------------------------------------- reshaping --
     def select(self, names: Iterable[str]) -> "ColumnarBatch":
-        return ColumnarBatch({n: self.columns[n] for n in names}, self.nrows)
+        return ColumnarBatch({n: self.columns[n] for n in names},
+                             self._row_count)
 
     def rename(self, mapping: Dict[str, str]) -> "ColumnarBatch":
         return ColumnarBatch({mapping.get(n, n): c
-                              for n, c in self.columns.items()}, self.nrows)
+                              for n, c in self.columns.items()},
+                             self._row_count)
 
     def with_column(self, name: str, col: Column) -> "ColumnarBatch":
         cols = dict(self.columns)
         cols[name] = col
-        return ColumnarBatch(cols, self.nrows)
+        return ColumnarBatch(cols, self._row_count)
 
 
 def empty_batch(schema: Schema, capacity: int = 0) -> ColumnarBatch:

@@ -41,6 +41,79 @@ def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
     return cap
 
 
+class RowCount:
+    """Lazy, possibly device-resident row count.
+
+    The per-batch ``int(n)`` on an aggregation's group count costs a
+    full tunnel round trip (device->host sync) — the dominant
+    serialization in the r05 group-by bench.  A RowCount carries the
+    count as a device scalar through the batch pipeline and only
+    materializes (``int(rc)``) at true host decision points; the
+    materialized value is cached, so one RowCount never syncs twice.
+
+    ``materialize_all`` resolves many RowCounts in ONE device transfer
+    (one counted sync) — the end-of-query metric resolution path.
+    """
+
+    __slots__ = ("_value", "_device", "_device_i32")
+
+    def __init__(self, value=None, device=None):
+        if value is None and device is None:
+            raise ValueError("RowCount needs a value or a device scalar")
+        self._value = None if value is None else int(value)
+        self._device = device
+        self._device_i32 = None
+
+    @property
+    def is_concrete(self) -> bool:
+        return self._value is not None
+
+    def __int__(self) -> int:
+        if self._value is None:
+            from spark_rapids_tpu.utils import hostsync
+            hostsync.count_sync()
+            self._value = int(np.asarray(self._device))
+        return self._value
+
+    __index__ = __int__
+
+    def device_i32(self):
+        """The count as an int32 device scalar (no sync)."""
+        if self._device_i32 is None:
+            import jax.numpy as jnp
+            if self._device is not None:
+                d = self._device
+                self._device_i32 = d if d.dtype == jnp.int32 \
+                    else d.astype(jnp.int32)
+            else:
+                self._device_i32 = jnp.int32(self._value)
+        return self._device_i32
+
+    @staticmethod
+    def wrap(n) -> "RowCount":
+        if isinstance(n, RowCount):
+            return n
+        return RowCount(value=int(n))
+
+    @staticmethod
+    def materialize_all(counts) -> None:
+        """Resolve every unmaterialized RowCount in ``counts`` with one
+        batched device fetch (one counted sync)."""
+        from spark_rapids_tpu.utils import hostsync
+        lazy = [rc for rc in counts
+                if isinstance(rc, RowCount) and rc._value is None]
+        if not lazy:
+            return
+        values = hostsync.fetch_all([rc._device for rc in lazy])
+        for rc, v in zip(lazy, values):
+            rc._value = int(v)
+
+    def __repr__(self) -> str:
+        if self._value is not None:
+            return f"RowCount({self._value})"
+        return "RowCount(<device>)"
+
+
 class Column:
     """One device column with logical length ``nrows`` and static capacity.
 
@@ -54,10 +127,10 @@ class Column:
     therefore read ``host_values()`` and never touch the device."""
 
     __slots__ = ("dtype", "_np_data", "_jax_data", "_np_validity",
-                 "_jax_validity", "_np_offsets", "_jax_offsets", "nrows",
-                 "dictionary")
+                 "_jax_validity", "_np_offsets", "_jax_offsets",
+                 "_row_count", "dictionary")
 
-    def __init__(self, dtype: DataType, data, nrows: int,
+    def __init__(self, dtype: DataType, data, nrows,
                  validity=None, offsets=None, dictionary=None):
         self.dtype = dtype
         # fixed-width values, or uint8 chars for string
@@ -75,17 +148,43 @@ class Column:
             else offsets
         self.dictionary = dictionary  # host list[str] when elements are
         #                               dictionary codes (array<string>)
-        self.nrows = int(nrows)
+        self._row_count = RowCount.wrap(nrows)
         if dtype.has_offsets and self._np_offsets is None and \
                 self._jax_offsets is None:
             raise ValueError(f"{dtype} column requires offsets")
 
+    @property
+    def nrows(self) -> int:
+        """Concrete row count (syncs once if carried lazily on device)."""
+        return int(self._row_count)
+
+    @nrows.setter
+    def nrows(self, n) -> None:
+        self._row_count = RowCount.wrap(n)
+
+    @property
+    def row_count(self) -> RowCount:
+        """The possibly-lazy count; use ``row_count.device_i32()`` on
+        device paths to avoid forcing a host sync."""
+        return self._row_count
+
     # -------------------------------------------------------- buffer access --
+    def _upload(self, np_buf):
+        """Host->device materialization (once per buffer).  Timed into
+        the pipeline's upload-overlap accounting when this thread is a
+        pipeline worker (utils/hostsync.watch_uploads)."""
+        import time
+        from spark_rapids_tpu.utils import hostsync
+        t0 = time.perf_counter_ns()
+        out = jnp.asarray(np_buf)
+        hostsync.note_upload(time.perf_counter_ns() - t0)
+        return out
+
     @property
     def data(self):
         """Device view of the value buffer (materialized on demand)."""
         if self._jax_data is None:
-            self._jax_data = jnp.asarray(self._np_data)
+            self._jax_data = self._upload(self._np_data)
         return self._jax_data
 
     @property
@@ -93,7 +192,7 @@ class Column:
         if self._jax_validity is None:
             if self._np_validity is None:
                 return None
-            self._jax_validity = jnp.asarray(self._np_validity)
+            self._jax_validity = self._upload(self._np_validity)
         return self._jax_validity
 
     @property
@@ -101,7 +200,7 @@ class Column:
         if self._jax_offsets is None:
             if self._np_offsets is None:
                 return None
-            self._jax_offsets = jnp.asarray(self._np_offsets)
+            self._jax_offsets = self._upload(self._np_offsets)
         return self._jax_offsets
 
     def host_values(self) -> np.ndarray:
@@ -110,6 +209,8 @@ class Column:
         device fetch."""
         if self._np_data is not None:
             return self._np_data
+        from spark_rapids_tpu.utils import hostsync
+        hostsync.count_sync()
         return np.asarray(self._jax_data)
 
     def host_validity(self) -> Optional[np.ndarray]:
@@ -117,6 +218,8 @@ class Column:
             return self._np_validity
         if self._jax_validity is None:
             return None
+        from spark_rapids_tpu.utils import hostsync
+        hostsync.count_sync()
         return np.asarray(self._jax_validity)
 
     def host_offsets(self) -> Optional[np.ndarray]:
@@ -124,6 +227,8 @@ class Column:
             return self._np_offsets
         if self._jax_offsets is None:
             return None
+        from spark_rapids_tpu.utils import hostsync
+        hostsync.count_sync()
         return np.asarray(self._jax_offsets)
 
     # ------------------------------------------------------------------ shape --
@@ -431,7 +536,7 @@ class Column:
         c._np_offsets = self._np_offsets
         c._jax_offsets = self._jax_offsets
         c.dictionary = self.dictionary
-        c.nrows = int(nrows)
+        c._row_count = RowCount.wrap(nrows)
         return c
 
     def __repr__(self) -> str:

@@ -468,6 +468,42 @@ PYTHON_NUM_WORKERS = conf(
     "fall back to inline.", _to_int,
     lambda v: None if v >= 0 else "must be >= 0")
 
+PIPELINE_ENABLED = conf(
+    "spark.rapids.tpu.pipeline.enabled", True,
+    "Drive query execution through the asynchronous pipeline "
+    "(exec/pipeline.py): a worker thread pulls operator batches — "
+    "overlapping reader decode, host->device upload and XLA dispatch — "
+    "while the driving thread consumes results.  Pure overlap "
+    "optimization: batch contents and order are identical to the "
+    "sequential pull loop.", _to_bool)
+
+PIPELINE_DEPTH = conf(
+    "spark.rapids.tpu.pipeline.depth", 2,
+    "Maximum batches in flight between the pipeline worker and the "
+    "consuming thread.  In-flight batches stay registered in the spill "
+    "catalog, so depth bounds pinned HBM, not just queue length; depth "
+    "1 still overlaps one producer step with the consumer.",
+    _to_int, _positive)
+
+PIPELINE_DONATION = conf(
+    "spark.rapids.tpu.pipeline.donation.enabled", True,
+    "Donate input HBM to XLA on fused filter/project stages whose "
+    "input batches are pipeline-ephemeral (produced by the upstream "
+    "operator and dropped after the stage), letting outputs reuse the "
+    "input buffers.  No-op on the CPU backend (XLA:CPU ignores "
+    "donation); donated stages skip operator-level OOM retry and "
+    "escalate straight to query-level recovery, which re-runs from "
+    "source (docs/performance.md#donation).", _to_bool)
+
+PIPELINE_DEFER_SYNCS = conf(
+    "spark.rapids.tpu.pipeline.deferSyncs", True,
+    "Carry per-batch row/group counts as device-resident scalars "
+    "(columnar RowCount) and only materialize them at true host "
+    "decision points, collapsing the per-batch int(n) device->host "
+    "round trips in the aggregation path.  False restores the eager "
+    "per-batch syncs (the sequential baseline tests/test_pipeline.py "
+    "measures against).", _to_bool)
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

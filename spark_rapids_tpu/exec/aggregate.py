@@ -24,7 +24,8 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dts
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, empty_batch
-from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.column import Column, RowCount
+from spark_rapids_tpu.utils import hostsync
 from spark_rapids_tpu.exec.base import (
     AGG_TIME, CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, Schema, TpuExec)
 from spark_rapids_tpu.ops import aggregates as agg
@@ -138,16 +139,31 @@ def _probe_kernel(nkeys: int):
 
 
 class TpuHashAggregateExec(TpuExec):
+    ephemeral_output = True
+
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Tuple[str, AggregateExpression]],
                  child: TpuExec,
                  pre_filter: Optional[Expression] = None,
-                 merge_chunk_rows: int = 1 << 22):
+                 merge_chunk_rows: int = 1 << 22,
+                 defer_syncs: bool = True,
+                 spec_slots: int = 4096):
         """``pre_filter``: a fused upstream Filter condition (whole-stage
         fusion: predicate becomes a row mask inside the aggregation kernel —
-        no compaction pass at all)."""
+        no compaction pass at all).
+
+        ``defer_syncs``: carry per-batch group counts as device-resident
+        ``RowCount``s and dispatch the coded path speculatively
+        (``spec_slots`` slots, one sync per batch instead of
+        probe+count), so XLA dispatch never serializes against the host.
+        ``defer_syncs=False`` restores the eager two-pass sequential
+        behavior (the baseline tests/test_pipeline.py measures against).
+        """
         super().__init__(child)
         self.merge_chunk_rows = merge_chunk_rows
+        self.defer_syncs = defer_syncs
+        self.spec_slots = spec_slots
+        self._spec_misses = 0
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
         self.pre_filter = pre_filter
@@ -350,11 +366,43 @@ class TpuHashAggregateExec(TpuExec):
 
         return run
 
-    def _coded_pick(self, mins, maxs):
-        """Sync the probe scalars and size the key space; None when the
-        coded path does not apply."""
-        mins_h = np.asarray(mins)
-        maxs_h = np.asarray(maxs)
+    def _coded_update_auto(self, k_bucket: int):
+        """Speculative stage body (cached_jit per k_bucket): filter
+        mask, key-range discovery, fit check AND the coded reduction in
+        ONE XLA computation — the probe pass and its host round trip
+        only ever happen on a speculation miss."""
+
+        def run(flat_cols, nrows):
+            capacity = capacity_of(flat_cols)
+            inputs = flat_to_colvals(flat_cols, self._in_dtypes)
+            ctx = EmitContext(inputs, nrows, capacity)
+            mask = ctx.row_mask()
+            if self.pre_filter is not None:
+                pred = self.pre_filter.emit(ctx)
+                keep = pred.values
+                if getattr(keep, "ndim", 0) == 0:
+                    keep = jnp.broadcast_to(keep, (capacity,))
+                if pred.validity is not None:
+                    keep = jnp.logical_and(keep, pred.validity)
+                mask = jnp.logical_and(keep, mask)
+            keys = [agg.widen_colval(e.emit(ctx), capacity)
+                    for e in self.group_exprs]
+            buf_inputs = self._eval_update_inputs(ctx)
+            out_keys, out_bufs, n, fits, mins, maxs = \
+                agg.groupby_aggregate_coded_auto(
+                    keys, buf_inputs, nrows, capacity, k_bucket,
+                    row_mask=mask)
+            return ([(k.values, k.validity) for k in out_keys],
+                    [(b.values, b.validity) for b in out_bufs],
+                    n, fits, mins, maxs, mask)
+
+        return run
+
+    def _coded_pick_host(self, mins_h, maxs_h):
+        """Size the key space from host-resident probe results; None
+        when the coded path does not apply."""
+        mins_h = np.asarray(mins_h)
+        maxs_h = np.asarray(maxs_h)
         pick = agg.coded_slot_ranges(mins_h, maxs_h)
         if pick is None:
             return None
@@ -363,33 +411,75 @@ class TpuHashAggregateExec(TpuExec):
                 jnp.asarray(np.minimum(mins_h, maxs_h)),
                 jnp.asarray(np.asarray(slots, dtype=np.int64)))
 
+    def _coded_pick(self, mins, maxs):
+        """Sync the probe scalars (one batched transfer when syncs are
+        deferred, the legacy two when not) and size the key space."""
+        if self.defer_syncs:
+            mins_h, maxs_h = hostsync.fetch(mins, maxs)
+        else:
+            mins_h = np.asarray(mins)
+            maxs_h = np.asarray(maxs)
+            hostsync.count_sync(2)
+        return self._coded_pick_host(mins_h, maxs_h)
+
+    def _wrap_count(self, n) -> RowCount:
+        """Device group count -> RowCount; eager mode forces (and
+        counts) the sync immediately, preserving the sequential
+        baseline's behavior."""
+        rc = RowCount(device=n)
+        if not self.defer_syncs:
+            int(rc)
+        return rc
+
     def _partial_coded(self, batch, names, dtypes):
+        from spark_rapids_tpu.ops.jit_cache import cached_jit
         flat = batch_to_flat(batch)
-        nrows = jnp.int32(batch.nrows)
-        mask, mins, maxs = self._stage_a_fn(flat, nrows)
-        pick = self._coded_pick(mins, maxs)
+        nrows = batch.row_count.device_i32()
+        # speculative single-pass dispatch: stop speculating after two
+        # misses (the operator's key space clearly exceeds the bucket)
+        spec_k = self.spec_slots if self.defer_syncs else 0
+        if spec_k and self._spec_misses < 2:
+            fn = cached_jit(
+                ("agg_coded_auto", spec_k) + self._base_sig + (
+                    self.pre_filter.cache_key()
+                    if self.pre_filter is not None else None,),
+                lambda: self._coded_update_auto(spec_k))
+            key_out, buf_out, n, fits, mins, maxs, mask = fn(flat, nrows)
+            fits_h, mins_h, maxs_h = hostsync.fetch(fits, mins, maxs)
+            if bool(fits_h):
+                outs = [ColVal(dt, v, val) for dt, (v, val) in
+                        zip(dtypes, list(key_out) + list(buf_out))]
+                out_cap = key_out[0][0].shape[0] if key_out else \
+                    buf_out[0][0].shape[0]
+                n_rc = self._wrap_count(n)
+                cols = colvals_to_columns(outs, n_rc, out_cap)
+                return ColumnarBatch(dict(zip(names, cols)), n_rc)
+            self._spec_misses += 1
+            pick = self._coded_pick_host(mins_h, maxs_h)
+        else:
+            mask, mins, maxs = self._stage_a_fn(flat, nrows)
+            pick = self._coded_pick(mins, maxs)
         if pick is None:
             # key space too large: the fully fused sort kernel
             key_flat, buf_flat, n = self._update_fn(flat, nrows)
-            n = int(n)
+            n_rc = self._wrap_count(n)
             outs = [ColVal(dt, v, val, offs)
                     for dt, (v, val, offs) in
                     zip(dtypes, list(key_flat) + list(buf_flat))]
-            cols = colvals_to_columns(outs, n, batch.capacity)
-            return ColumnarBatch(dict(zip(names, cols)), n)
-        from spark_rapids_tpu.ops.jit_cache import cached_jit
+            cols = colvals_to_columns(outs, n_rc, batch.capacity)
+            return ColumnarBatch(dict(zip(names, cols)), n_rc)
         k_bucket, mins_d, slots_d = pick
         fn = cached_jit(
             ("agg_coded_update", k_bucket) + self._base_sig,
             lambda: self._coded_update(k_bucket))
         key_out, buf_out, n = fn(flat, nrows, mask, mins_d, slots_d)
-        n = int(n)
+        n_rc = self._wrap_count(n)
         outs = [ColVal(dt, v, val) for dt, (v, val) in
                 zip(dtypes, list(key_out) + list(buf_out))]
         out_cap = key_out[0][0].shape[0] if key_out else \
             buf_out[0][0].shape[0]
-        cols = colvals_to_columns(outs, n, out_cap)
-        return ColumnarBatch(dict(zip(names, cols)), n)
+        cols = colvals_to_columns(outs, n_rc, out_cap)
+        return ColumnarBatch(dict(zip(names, cols)), n_rc)
 
     def _partial_batches(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.retry import with_retry
@@ -398,9 +488,12 @@ class TpuHashAggregateExec(TpuExec):
 
         def tallied():
             for batch in self.child.execute():
-                self.metrics[NUM_INPUT_ROWS] += batch.nrows
+                # row_count: deferred upstream counts accumulate lazily
+                # in the metric and skip the per-batch empty check (not
+                # worth a round trip — the kernels mask empty input)
+                self.metrics[NUM_INPUT_ROWS] += batch.row_count
                 self.metrics[NUM_INPUT_BATCHES] += 1
-                if batch.nrows:
+                if not batch.row_count.is_concrete or batch.nrows:
                     yield batch
 
         def compute(batch):
@@ -411,11 +504,11 @@ class TpuHashAggregateExec(TpuExec):
                 if self._coded_eligible:
                     return self._partial_coded(batch, names, dtypes)
                 key_flat, buf_flat, n = self._update_fn(
-                    batch_to_flat(batch), jnp.int32(batch.nrows))
+                    batch_to_flat(batch), batch.row_count.device_i32())
                 # keyless reductions have statically one output row;
-                # skip the device->host sync (it costs a full tunnel
-                # round-trip per batch)
-                n = 1 if not self.group_exprs else int(n)
+                # grouped counts stay device-resident (deferred) — the
+                # per-batch int(n) costs a full tunnel round trip
+                n = 1 if not self.group_exprs else self._wrap_count(n)
                 outs = [ColVal(dt, v, val, offs)
                         for dt, (v, val, offs) in
                         zip(dtypes, list(key_flat) + list(buf_flat))]
@@ -452,7 +545,7 @@ class TpuHashAggregateExec(TpuExec):
                 buf_inputs.append((spec.kind, bi))
         key_flat_in = [(c.data, c.validity) for c in enc_keys]
         buf_flat_in = [(c.values, c.validity) for _, c in buf_inputs]
-        nrows = jnp.int32(batch.nrows)
+        nrows = batch.row_count.device_i32()
         if not enc_keys:
             # keyless (e.g. SELECT min(s)): one output row
             kernel = _keyless_kernel(self._update_kinds)
@@ -475,7 +568,9 @@ class TpuHashAggregateExec(TpuExec):
                 kernel = _grouped_kernel(self._update_kinds, nkeys)
                 key_flat, buf_flat, n = kernel(key_flat_in, buf_flat_in,
                                                nrows)
-            n = int(n)
+            # string buffers re-decode per batch below: a genuine host
+            # decision point, so the count syncs (and is counted) here
+            n = int(RowCount(device=n))
             out_cap = key_flat[0][0].shape[0]
         cols_out = {}
         for name, dt, (v, val) in zip(names, dtypes,
@@ -572,7 +667,7 @@ class TpuHashAggregateExec(TpuExec):
         comparisons across batches are then exact; outputs decode via
         ``self._merge_dicts``."""
         flat = batch_to_flat(merged_in)
-        nrows = jnp.int32(merged_in.nrows)
+        nrows = merged_in.row_count.device_i32()
         nkeys = len(self.group_exprs)
         self._merge_dicts = {}
         if self._string_buf_pos:
@@ -607,8 +702,16 @@ class TpuHashAggregateExec(TpuExec):
         names = [n for n, _ in self._partial_schema]
         dtypes = [dt for _, dt in self._partial_schema]
         chunk = self.merge_chunk_rows
+        # merge sizing is a host decision point — but first check the
+        # sync-free capacity bound: when even the upper bound fits one
+        # merge chunk (the common coded-path case), no deferred count
+        # ever materializes here.  Otherwise resolve every handle's
+        # count in ONE batched transfer.
+        if len(handles) > 1 and \
+                sum(h.nrows_bound for h in handles) > chunk:
+            RowCount.materialize_all([h.row_count for h in handles])
         while len(handles) > 1 and \
-                sum(h.nrows for h in handles) > chunk:
+                sum(h.nrows_bound for h in handles) > chunk:
             group = []
             rows = 0
             while handles and (len(group) < 2 or
@@ -626,7 +729,9 @@ class TpuHashAggregateExec(TpuExec):
             with self.timer(AGG_TIME):
                 key_flat, buf_flat, n = self._merge_exec(
                     merged_in, finalize=False)
-                n = 1 if not self.group_exprs else int(n)
+                # compaction below sizes the spill registration from n:
+                # a genuine host decision point (counted sync)
+                n = 1 if not self.group_exprs else int(RowCount(device=n))
             outs = [ColVal(dt, v, val, offs)
                     for dt, (v, val, offs) in
                     zip(dtypes, list(key_flat) + list(buf_flat))]
@@ -726,7 +831,7 @@ class TpuHashAggregateExec(TpuExec):
         catalog = default_catalog()
         handles = []
         for b in self.child.execute():
-            self.metrics[NUM_INPUT_ROWS] += b.nrows
+            self.metrics[NUM_INPUT_ROWS] += b.row_count
             self.metrics[NUM_INPUT_BATCHES] += 1
             handles.append(catalog.register(b))
         if not handles:
@@ -743,8 +848,10 @@ class TpuHashAggregateExec(TpuExec):
             h.close()
         with self.timer(AGG_TIME):
             out_flat, n = self._single_fn(batch_to_flat(merged),
-                                          jnp.int32(merged.nrows))
-            n = int(n)
+                                          merged.row_count.device_i32())
+            # collect arrays re-decode on the host right below: the
+            # count is needed concretely either way (counted sync)
+            n = int(RowCount(device=n))
         if n == 0 and not self.group_exprs:
             yield self._keyless_empty_result()
             return
@@ -793,7 +900,15 @@ class TpuHashAggregateExec(TpuExec):
         with self.timer(AGG_TIME):
             key_flat, res_flat, n = self._merge_exec(
                 merged_in, finalize=True)
-            n = 1 if not self.group_exprs else int(n)
+            if not self.group_exprs:
+                n = 1
+            elif self._string_key_idx or self._merge_dicts:
+                # string re-decode below walks codes on the host: a
+                # genuine host decision point (counted sync)
+                n = int(RowCount(device=n))
+            else:
+                # fully deferred: the final count rides to collect()
+                n = self._wrap_count(n)
         out_names = [name for name, _ in self.schema]
         outs: List[ColVal] = []
         for i, (e, (v, val, offs)) in enumerate(zip(self.group_exprs,

@@ -21,6 +21,11 @@ MODERATE = 1
 DEBUG = 2
 
 # standard metric names (GpuExec.scala:43-160)
+# pipeline-level names (exec/pipeline.py PipelineStats -> QueryEnd
+# "pipeline" dict -> tools/eventlog.QueryInfo.pipeline)
+PIPELINE_FILL_RATIO = "pipelineFillRatio"
+HOST_SYNC_COUNT = "hostSyncCount"
+UPLOAD_OVERLAP_MS = "uploadOverlapMs"
 NUM_OUTPUT_ROWS = "numOutputRows"
 NUM_OUTPUT_BATCHES = "numOutputBatches"
 OP_TIME = "opTime"
@@ -34,18 +39,48 @@ SPILL_AMOUNT = "spillData"
 
 
 class TpuMetric:
-    __slots__ = ("name", "level", "value")
+    """One counter.  Accepts lazy ``RowCount`` additions: deferred
+    device-resident counts accumulate unmaterialized and resolve in a
+    single batched device fetch when ``value`` is first read (at
+    QueryEnd metric collection), so per-batch row tallies never force
+    a per-batch host sync."""
+
+    __slots__ = ("name", "level", "_value", "_pending")
 
     def __init__(self, name: str, level: int = MODERATE):
         self.name = name
         self.level = level
-        self.value = 0
+        self._value = 0
+        self._pending = None  # deferred RowCounts, resolved on read
+
+    @property
+    def value(self):
+        if self._pending:
+            from spark_rapids_tpu.columnar.column import RowCount
+            RowCount.materialize_all(self._pending)
+            self._value += sum(int(rc) for rc in self._pending)
+            self._pending = None
+        return self._value
+
+    @value.setter
+    def value(self, v) -> None:
+        self._value = v
+        self._pending = None
 
     def add(self, v) -> None:
-        self.value += v
+        from spark_rapids_tpu.columnar.column import RowCount
+        if isinstance(v, RowCount):
+            if v.is_concrete:
+                self._value += int(v)
+            else:
+                if self._pending is None:
+                    self._pending = []
+                self._pending.append(v)
+            return
+        self._value += v
 
     def __iadd__(self, v):
-        self.value += v
+        self.add(v)
         return self
 
 
@@ -69,6 +104,13 @@ class TpuExec:
     # when True each iteration step wraps in a jax.profiler
     # TraceAnnotation (NVTX-range analog)
     trace_ops = False
+
+    # True when every batch this operator yields is freshly allocated
+    # per pull and never retained by the operator (or anyone upstream) —
+    # the safety precondition for a consumer stage to DONATE the batch's
+    # buffers to XLA (ops/compiler.py).  Retaining scans (in-memory,
+    # cache) and pass-through operators keep the default False.
+    ephemeral_output = False
 
     def __init__(self, *children: "TpuExec"):
         self.children: Tuple[TpuExec, ...] = tuple(children)
@@ -116,7 +158,9 @@ class TpuExec:
                 timer.add(time.perf_counter_ns() - t0)
                 return
             timer.add(time.perf_counter_ns() - t0)
-            self.metrics[NUM_OUTPUT_ROWS] += batch.nrows
+            # row_count, not nrows: a deferred device-resident count
+            # accumulates lazily instead of forcing a per-batch sync
+            self.metrics[NUM_OUTPUT_ROWS] += batch.row_count
             self.metrics[NUM_OUTPUT_BATCHES] += 1
             yield batch
 

@@ -79,10 +79,14 @@ class TpuScanExec(TpuExec):
 
 
 class TpuProjectExec(TpuExec):
-    def __init__(self, exprs: Sequence[Expression], child: TpuExec):
+    ephemeral_output = True
+
+    def __init__(self, exprs: Sequence[Expression], child: TpuExec,
+                 donate: bool = False):
         super().__init__(child)
         self.exprs = list(exprs)
-        self._fn = StageFn(self.exprs, [dt for _, dt in child.schema])
+        self._fn = StageFn(self.exprs, [dt for _, dt in child.schema],
+                           donate=donate and child.ephemeral_output)
 
     @property
     def child(self) -> TpuExec:
@@ -98,8 +102,19 @@ class TpuProjectExec(TpuExec):
 
         def compute(batch):
             cols = self._fn(batch)
-            return ColumnarBatch(dict(zip(names, cols)), batch.nrows)
+            # row_count, not nrows: a deferred upstream count passes
+            # through without forcing a host sync
+            return ColumnarBatch(dict(zip(names, cols)),
+                                 batch.row_count)
 
+        if self._fn.donate:
+            # donated inputs are consumed by the kernel, so operator-
+            # level OOM retry (which re-runs over the same batch) is
+            # unsafe; faults escalate to query-level recovery, which
+            # re-executes from source (docs/performance.md#donation)
+            for batch in self.child.execute():
+                yield compute(batch)
+            return
         yield from with_retry(self.child.execute(), compute)
 
     def describe(self):
@@ -109,14 +124,18 @@ class TpuProjectExec(TpuExec):
 class TpuFilterExec(TpuExec):
     """Fused predicate + compaction (+ pass-through projection)."""
 
-    def __init__(self, condition: Expression, child: TpuExec):
+    ephemeral_output = True
+
+    def __init__(self, condition: Expression, child: TpuExec,
+                 donate: bool = False):
         super().__init__(child)
         self.condition = condition
         in_schema = child.schema
         passthrough = [BoundReference(i, dt, name=n)
                        for i, (n, dt) in enumerate(in_schema)]
         self._fn = FilterStageFn(condition, passthrough,
-                                 [dt for _, dt in in_schema])
+                                 [dt for _, dt in in_schema],
+                                 donate=donate and child.ephemeral_output)
         self._register_metric(NUM_INPUT_ROWS)
 
     @property
@@ -133,7 +152,7 @@ class TpuFilterExec(TpuExec):
 
         def tallied():
             for batch in self.child.execute():
-                self.metrics[NUM_INPUT_ROWS] += batch.nrows
+                self.metrics[NUM_INPUT_ROWS] += batch.row_count
                 yield batch
 
         def compute(batch):
@@ -141,6 +160,13 @@ class TpuFilterExec(TpuExec):
             return None if n == 0 else \
                 ColumnarBatch(dict(zip(names, cols)), n)
 
+        if self._fn.donate:
+            # see TpuProjectExec: donation forfeits operator-level retry
+            for batch in tallied():
+                out = compute(batch)
+                if out is not None:
+                    yield out
+            return
         for out in with_retry(tallied(), compute):
             if out is not None:
                 yield out
@@ -151,6 +177,8 @@ class TpuFilterExec(TpuExec):
 
 class TpuRangeExec(TpuExec):
     """range(start, end, step) -> bigint id column (GpuRangeExec:358)."""
+
+    ephemeral_output = True
 
     def __init__(self, start: int, end: int, step: int,
                  max_rows: int = 1 << 20):
@@ -180,6 +208,11 @@ class TpuUnionExec(TpuExec):
         super().__init__(*children)
 
     @property
+    def ephemeral_output(self) -> bool:
+        # pass-through: output batches share every child's buffers
+        return all(c.ephemeral_output for c in self.children)
+
+    @property
     def schema(self) -> Schema:
         return self.children[0].schema
 
@@ -188,7 +221,7 @@ class TpuUnionExec(TpuExec):
         for child in self.children:
             for batch in child.execute():
                 cols = dict(zip(names, batch.columns.values()))
-                yield ColumnarBatch(cols, batch.nrows)
+                yield ColumnarBatch(cols, batch.row_count)
 
 
 class TpuLocalLimitExec(TpuExec):

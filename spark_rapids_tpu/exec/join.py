@@ -58,6 +58,8 @@ class _JoinKeyEncoder:
 
 
 class TpuHashJoinExec(TpuExec):
+    ephemeral_output = True
+
     def __init__(self, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], join_type: str,
                  left: TpuExec, right: TpuExec,

@@ -33,6 +33,8 @@ Order = Tuple[Expression, bool, bool]
 
 
 class TpuSortExec(TpuExec):
+    ephemeral_output = True
+
     def __init__(self, orders: Sequence[Order], child: TpuExec,
                  ooc_threshold_bytes: int = 256 << 20,
                  ooc_window_rows: int = 1 << 16):

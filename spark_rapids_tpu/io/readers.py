@@ -109,6 +109,10 @@ META_COLUMN_NAMES = frozenset({
 
 
 class TpuFileScanExec(TpuExec):
+    # each pull decodes + uploads a fresh batch; nothing is retained,
+    # so downstream stages may donate these buffers
+    ephemeral_output = True
+
     def __init__(self, paths: List[str], file_format: str, schema: Schema,
                  batch_rows: int = 1 << 20,
                  columns: Optional[List[str]] = None,

@@ -49,18 +49,30 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch], goal: CoalesceGoal,
         pending_bytes = 0
         return concat_batches(got) if len(got) > 1 else got[0]
 
-    for batch in batches:
-        if batch.nrows == 0:
-            continue
-        size = batch.device_size_bytes()
-        if target is not None and pending and \
-                pending_bytes + size > target:
-            out = flush()
-            if out is not None:
-                yield out
-        pending.append(catalog.register(
-            batch, AGGREGATE_INTERMEDIATE_PRIORITY))
-        pending_bytes += size
-    out = flush()
-    if out is not None:
-        yield out
+    try:
+        for batch in batches:
+            # only skip KNOWN-empty batches: forcing a deferred
+            # (device-resident) count here would cost the per-batch
+            # round trip this path exists to avoid — concat_batches
+            # handles lazy counts natively
+            if batch.row_count.is_concrete and batch.nrows == 0:
+                continue
+            size = batch.device_size_bytes()
+            if target is not None and pending and \
+                    pending_bytes + size > target:
+                out = flush()
+                if out is not None:
+                    yield out
+            pending.append(catalog.register(
+                batch, AGGREGATE_INTERMEDIATE_PRIORITY))
+            pending_bytes += size
+        out = flush()
+        if out is not None:
+            yield out
+    finally:
+        # early generator close (LIMIT upstream, consumer exception):
+        # unregister still-pending spillables so the catalog never
+        # carries dead registrations for the rest of the session
+        for h in pending:
+            h.close()
+        pending = []

@@ -101,17 +101,25 @@ class RetryMetrics:
         self.retry_count = 0
         self.split_count = 0
         self.spilled_on_retry = 0
-        self._local = threading.local()
+        self._per_thread = {}  # effective ident -> counter dict
+        self._owner = {}       # worker ident -> owning (driving) ident
+
+    def _effective_ident(self) -> int:
+        ident = threading.get_ident()
+        return self._owner.get(ident, ident)
 
     def _bump(self, retries=0, splits=0, spilled=0) -> None:
         with self.lock:
             self.retry_count += retries
             self.split_count += splits
             self.spilled_on_retry += spilled
-        loc = self._local
-        loc.retry_count = getattr(loc, "retry_count", 0) + retries
-        loc.split_count = getattr(loc, "split_count", 0) + splits
-        loc.spilled_on_retry = getattr(loc, "spilled_on_retry", 0) + spilled
+            loc = self._per_thread.setdefault(
+                self._effective_ident(),
+                {"retryCount": 0, "splitAndRetryCount": 0,
+                 "spilledOnRetryBytes": 0})
+            loc["retryCount"] += retries
+            loc["splitAndRetryCount"] += splits
+            loc["spilledOnRetryBytes"] += spilled
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -120,18 +128,29 @@ class RetryMetrics:
                     "spilledOnRetryBytes": self.spilled_on_retry}
 
     def snapshot_local(self) -> dict:
-        """This thread's counters — the per-query attribution view."""
-        loc = self._local
-        return {"retryCount": getattr(loc, "retry_count", 0),
-                "splitAndRetryCount": getattr(loc, "split_count", 0),
-                "spilledOnRetryBytes": getattr(loc, "spilled_on_retry", 0)}
+        """This thread's counters — the per-query attribution view.  A
+        pipeline worker (exec/pipeline.py) adopts its driving thread,
+        so retries inside the pipelined iterator still land here."""
+        with self.lock:
+            loc = self._per_thread.get(self._effective_ident())
+            return dict(loc) if loc else \
+                {"retryCount": 0, "splitAndRetryCount": 0,
+                 "spilledOnRetryBytes": 0}
+
+    def adopt(self, owner_ident: int) -> None:
+        with self.lock:
+            self._owner[threading.get_ident()] = owner_ident
+
+    def release(self) -> None:
+        with self.lock:
+            self._owner.pop(threading.get_ident(), None)
 
     def reset(self) -> None:
         with self.lock:
             self.retry_count = 0
             self.split_count = 0
             self.spilled_on_retry = 0
-        self._local = threading.local()
+            self._per_thread.clear()
 
 
 retry_metrics = RetryMetrics()

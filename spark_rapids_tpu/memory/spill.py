@@ -59,17 +59,32 @@ class SpillableHandle:
         self._host: Optional[dict] = None
         self._disk_path: Optional[str] = None
         self._schema = batch.schema
-        self._nrows = batch.nrows
+        self._capacity = batch.capacity
+        # deferred (device-resident) counts stay deferred while the
+        # batch sits at the DEVICE tier; spilling materializes (the
+        # host payload needs the concrete count anyway)
+        self._row_count = batch.row_count
         self.closed = False
 
     @property
     def nrows(self) -> int:
-        return self._nrows
+        return int(self._row_count)
+
+    @property
+    def row_count(self):
+        return self._row_count
+
+    @property
+    def nrows_bound(self) -> int:
+        """Sync-free upper bound on nrows (capacity when deferred)."""
+        if self._row_count.is_concrete:
+            return int(self._row_count)
+        return self._capacity
 
     # -------------------------------------------------------------- movement --
     def _to_host_payload(self) -> dict:
         b = self._device
-        payload = {"__nrows": self._nrows}
+        payload = {"__nrows": self.nrows}
         for name, col in b.columns.items():
             # host_* readers keep still-host columns bit-exact and skip
             # the device fetch entirely
@@ -96,10 +111,10 @@ class SpillableHandle:
             # hand the host buffers straight to Column: it materializes
             # the device copy lazily on first device use
             cols[name] = Column(
-                dt, np.ascontiguousarray(data), self._nrows,
+                dt, np.ascontiguousarray(data), self.nrows,
                 validity=get(f"{name}.validity"),
                 offsets=get(f"{name}.offsets"))
-        return ColumnarBatch(cols, self._nrows)
+        return ColumnarBatch(cols, self.nrows)
 
     def spill_to_host(self) -> int:
         assert self.tier == DEVICE
@@ -124,7 +139,7 @@ class SpillableHandle:
                          self._host.get(f"{name}.data"),
                          self._host.get(f"{name}.validity"),
                          self._host.get(f"{name}.offsets")))
-        blob = native.serialize_batch(self._nrows, cols,
+        blob = native.serialize_batch(self.nrows, cols,
                                       compress=self.catalog.frame_codec)
         try:
             native.write_spill_file(path, blob)
@@ -375,6 +390,15 @@ class TpuSemaphore:
             self._held.count = count - 1
             if self._held.count == 0:
                 self._sem.release()
+
+    def release_all_held(self) -> None:
+        """Drop this thread's whole admission count (end-of-task hook:
+        the pipeline worker calls this before exiting, else a permit
+        acquired by a UDF exec's re-admission would die with the thread
+        and deadlock the next query's worker)."""
+        if getattr(self._held, "count", 0) > 0:
+            self._held.count = 0
+            self._sem.release()
 
     def __enter__(self):
         self.acquire_if_necessary()

@@ -18,7 +18,7 @@ distributed exchange needs (partial agg -> shuffle by key -> final agg).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -722,11 +722,54 @@ def groupby_aggregate_coded(keys: Sequence[ColVal],
         strides_rev.append(stride)
         stride = stride * slot_ranges[i]
     strides = strides_rev[::-1]
+    # clamp before the narrowing cast: the speculative path
+    # (groupby_aggregate_coded_auto) runs this body even when the key
+    # space overflows the bucket — codes must stay in-range garbage
+    # (the trash segment), never wrap through int32
+    code = jnp.clip(code, 0, k_bucket)
     code = jnp.where(live, code, k_bucket).astype(jnp.int32)
     ns = k_bucket + 1
 
+    # ---- batched sum scatter -------------------------------------------
+    # Same-dtype/same-validity "sum" buffers stack into ONE 2D
+    # segment-sum: the scatter index is computed once per row for all of
+    # them instead of once per buffer.  A validity-free float64/integer
+    # group additionally carries a ones column, so the per-slot live
+    # counts (the bincount) ride the same scatter — q1's four sums plus
+    # its counts collapse from five scatters to one.  (ones ride only in
+    # dtypes where the count sums exactly: f64 up to 2^53, integers.)
+    sum_groups: Dict[tuple, List[int]] = {}
+    for j, (kind, c) in enumerate(buffer_inputs):
+        v = c.values
+        if kind == "sum" and getattr(v, "ndim", 0) == 1:
+            key = (v.dtype,
+                   id(c.validity) if c.validity is not None else None)
+            sum_groups.setdefault(key, []).append(j)
+    slot_counts_all = None
+    batched_sums: Dict[int, Tuple] = {}  # j -> (per-slot sums, validity)
+    for (dt, vid), idxs in sum_groups.items():
+        exact_ones = dt == jnp.float64 or jnp.issubdtype(dt, jnp.integer)
+        fuse_counts = vid is None and exact_ones and \
+            slot_counts_all is None
+        if len(idxs) < 2 and not fuse_counts:
+            continue
+        cs = [buffer_inputs[j][1] for j in idxs]
+        validity = cs[0].validity
+        bcode = code if validity is None else \
+            jnp.where(validity, code, ns - 1)
+        cols = [c.values for c in cs]
+        if fuse_counts:
+            cols = cols + [jnp.ones(capacity, dtype=dt)]
+        stacked = jnp.stack(cols, axis=1)
+        summed = jax.ops.segment_sum(stacked, bcode, num_segments=ns)
+        if fuse_counts:
+            slot_counts_all = summed[:, -1].astype(jnp.int64)
+        for col_i, j in enumerate(idxs):
+            batched_sums[j] = (summed[:, col_i], validity)
+
     # per-slot live counts, shared by every buffer whose validity is None
-    slot_counts_all = jnp.bincount(code, length=ns)
+    if slot_counts_all is None:
+        slot_counts_all = jnp.bincount(code, length=ns)
     counts_cache = {}
 
     def counts_of(validity, bcode):
@@ -751,7 +794,8 @@ def groupby_aggregate_coded(keys: Sequence[ColVal],
     slots = jnp.arange(k_bucket, dtype=jnp.int64)
     out_keys: List[ColVal] = []
     for i, c in enumerate(keys):
-        digit = (slots // strides[i]) % jnp.maximum(slot_ranges[i], 1)
+        digit = (slots // jnp.maximum(strides[i], 1)) % \
+            jnp.maximum(slot_ranges[i], 1)
         vals = mins[i] + digit - 1
         if c.validity is not None:
             vd = jnp.zeros(out_cap, dtype=jnp.bool_)
@@ -765,17 +809,74 @@ def groupby_aggregate_coded(keys: Sequence[ColVal],
         dst = dst.at[out_idx].set(vals.astype(out_dt), mode="drop")
         out_keys.append(ColVal(c.dtype, dst, vd))
 
-    out_bufs: List[ColVal] = []
-    for kind, c in buffer_inputs:
-        vals, counts = _segment_reduce_coded(kind, c, code, ns,
-                                             counts_of)
+    def compact(c, vals, counts):
         vals, counts = vals[:k_bucket], counts[:k_bucket]
         dv = jnp.zeros(out_cap, dtype=vals.dtype)
         dv = dv.at[out_idx].set(vals, mode="drop")
         dvalid = jnp.zeros(out_cap, dtype=jnp.bool_)
         dvalid = dvalid.at[out_idx].set(counts > 0, mode="drop")
-        out_bufs.append(ColVal(c.dtype, dv, dvalid))
+        return ColVal(c.dtype, dv, dvalid)
+
+    out_bufs: List[Optional[ColVal]] = [None] * len(buffer_inputs)
+    for j, (kind, c) in enumerate(buffer_inputs):
+        got = batched_sums.get(j)
+        if got is not None:
+            summed_col, validity = got
+            bcode = code if validity is None else \
+                jnp.where(validity, code, ns - 1)
+            out_bufs[j] = compact(c, summed_col[: ns - 1],
+                                  counts_of(validity, bcode))
+            continue
+        vals, counts = _segment_reduce_coded(kind, c, code, ns,
+                                             counts_of)
+        out_bufs[j] = compact(c, vals, counts)
     return out_keys, out_bufs, num_groups
+
+
+def coded_ranges_on_device(keys: Sequence[ColVal], live, k_bucket: int):
+    """On-device analog of probe + ``coded_slot_ranges``: per-key
+    (min, max), clamped per-key slot counts, and a ``fits`` flag for
+    ``total key space <= k_bucket``.  Everything stays device-resident,
+    so the coded-vs-sort dispatch needs ONE host sync (the flag) instead
+    of a probe round trip followed by a second kernel launch.
+
+    Overflow discipline: slot counts and the running product are clamped
+    (the clamps only bite when ``fits`` is already False, where the coded
+    output is discarded anyway), so the arithmetic never wraps into a
+    spuriously-fitting total."""
+    mins, maxs = key_range_probe(keys, live)
+    rn = jnp.maximum(maxs - mins + 1, 0)
+    slot_ranges = rn + 1  # +1: digit 0 is always the null slot
+    total = jnp.int64(1)
+    total_cap = jnp.int64(1) << 40
+    for i in range(len(keys)):
+        s = jnp.clip(slot_ranges[i], 1, jnp.int64(1) << 20)
+        total = jnp.minimum(total * s, total_cap)
+    fits = total <= k_bucket
+    safe_ranges = jnp.minimum(slot_ranges, jnp.int64(k_bucket) + 1)
+    return mins, maxs, safe_ranges, fits
+
+
+def groupby_aggregate_coded_auto(keys: Sequence[ColVal],
+                                 buffer_inputs: Sequence[Tuple[str, ColVal]],
+                                 nrows, capacity: int, k_bucket: int,
+                                 row_mask=None):
+    """Single-pass speculative coded group-by: range discovery, fit
+    check and the coded reduction run in ONE computation against a
+    fixed speculative ``k_bucket``.  Returns
+    (out_keys, out_bufs, num_groups, fits, mins, maxs): when ``fits``
+    is True the outputs are exact (identical ordering to the sort
+    path); when False they are garbage to discard, and the caller
+    re-dispatches from the already-computed (mins, maxs) — the old
+    two-pass probe cost is only ever paid on speculation misses."""
+    keys = [widen_colval(c, capacity) for c in keys]
+    live = _row_mask(nrows, capacity, row_mask)
+    mins, maxs, safe_ranges, fits = coded_ranges_on_device(
+        keys, live, k_bucket)
+    out_keys, out_bufs, num_groups = groupby_aggregate_coded(
+        keys, buffer_inputs, nrows, capacity, mins, safe_ranges,
+        k_bucket, row_mask=row_mask)
+    return out_keys, out_bufs, num_groups, fits, mins, maxs
 
 
 def reduce_aggregate(buffer_inputs: Sequence[Tuple[str, ColVal]],

@@ -31,6 +31,25 @@ from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
 FlatCol = Tuple
 
 
+def donation_supported() -> bool:
+    """Buffer donation is a no-op on the CPU backend (XLA:CPU ignores
+    donated buffers and warns); only request it where it frees HBM."""
+    import jax
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def _donate_kwargs(donate: bool) -> dict:
+    """jit kwargs for a stage whose flat-column arg (argument 0) may be
+    donated.  The effective flag — not the requested one — is folded
+    into cache signatures, so a CPU process and a TPU process never
+    share a signature with different donation semantics."""
+    return {"donate_argnums": (0,)} if donate else {}
+
+
+def effective_donate(donate: bool) -> bool:
+    return bool(donate) and donation_supported()
+
+
 def batch_to_flat(batch: ColumnarBatch) -> List[FlatCol]:
     return [(c.data, c.validity, c.offsets) for c in batch.columns.values()]
 
@@ -84,13 +103,17 @@ class StageFn:
     """
 
     def __init__(self, exprs: Sequence[Expression],
-                 input_dtypes: Sequence[DataType]):
+                 input_dtypes: Sequence[DataType],
+                 donate: bool = False):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.exprs = list(exprs)
         self.input_dtypes = list(input_dtypes)
+        self.donate = effective_donate(donate)
         self._sig = ("stage", tuple(e.cache_key() for e in self.exprs),
-                     tuple(dt.name for dt in self.input_dtypes))
-        self._jitted = cached_jit(self._sig, lambda: self._run)
+                     tuple(dt.name for dt in self.input_dtypes),
+                     ("donate", self.donate))
+        self._jitted = cached_jit(self._sig, lambda: self._run,
+                                  **_donate_kwargs(self.donate))
 
     def _run(self, flat_cols, nrows):
         capacity = capacity_of(flat_cols) if flat_cols else 0
@@ -105,12 +128,14 @@ class StageFn:
 
     def __call__(self, batch: ColumnarBatch) -> List[Column]:
         flat = batch_to_flat(batch)
-        nrows = jnp.int32(batch.nrows)
+        # device_i32: a deferred upstream count flows straight into the
+        # stage without a host sync
+        nrows = batch.row_count.device_i32()
         out_flat, check_flags = self._jitted(flat, nrows)
         raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
         outs = [ColVal(e.dtype, v, validity, offsets)
                 for e, (v, validity, offsets) in zip(self.exprs, out_flat)]
-        return colvals_to_columns(outs, batch.nrows, batch.capacity)
+        return colvals_to_columns(outs, batch.row_count, batch.capacity)
 
 
 class FilterStageFn:
@@ -121,15 +146,19 @@ class FilterStageFn:
     """
 
     def __init__(self, predicate: Expression, project: Sequence[Expression],
-                 input_dtypes: Sequence[DataType]):
+                 input_dtypes: Sequence[DataType],
+                 donate: bool = False):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         self.predicate = predicate
         self.project = list(project)
         self.input_dtypes = list(input_dtypes)
+        self.donate = effective_donate(donate)
         self._sig = ("filter_stage", self.predicate.cache_key(),
                      tuple(e.cache_key() for e in self.project),
-                     tuple(dt.name for dt in self.input_dtypes))
-        self._jitted = cached_jit(self._sig, lambda: self._run)
+                     tuple(dt.name for dt in self.input_dtypes),
+                     ("donate", self.donate))
+        self._jitted = cached_jit(self._sig, lambda: self._run,
+                                  **_donate_kwargs(self.donate))
 
     def _run(self, flat_cols, nrows):
         from spark_rapids_tpu.ops import selection
@@ -156,11 +185,14 @@ class FilterStageFn:
                 new_nrows, tuple(flag for _, flag in ctx.checks))
 
     def __call__(self, batch: ColumnarBatch) -> Tuple[List[Column], int]:
+        from spark_rapids_tpu.columnar.column import RowCount
         flat = batch_to_flat(batch)
         out_flat, new_nrows, check_flags = self._jitted(
-            flat, jnp.int32(batch.nrows))
+            flat, batch.row_count.device_i32())
         raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
-        n = int(new_nrows)
+        # the selected-row count is a genuine host decision (empty-batch
+        # skip); RowCount makes the sync visible to the accounting
+        n = int(RowCount(device=new_nrows))
         outs = [ColVal(e.dtype, v, validity, offsets)
                 for e, (v, validity, offsets) in zip(self.project, out_flat)]
         return colvals_to_columns(outs, n, batch.capacity), n

@@ -64,10 +64,28 @@ def _ensure_validity(col: Column):
 
 
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
-    """Concatenate same-schema batches into one device batch."""
-    batches = [b for b in batches if b.nrows > 0] or list(batches[:1])
+    """Concatenate same-schema batches into one device batch.
+
+    Batches carrying deferred (device-resident) row counts concatenate
+    WITHOUT forcing a host sync: appends run off the device scalars and
+    the output capacity is bounded by the input capacities (offset
+    columns are the exception — char-buffer sizing is a host decision,
+    so string batches resolve their counts in one batched transfer).
+    """
+    from spark_rapids_tpu.columnar.column import RowCount
+    # drop only KNOWN-empty batches; a deferred count is not worth a
+    # round trip just to skip an empty input
+    batches = [b for b in batches
+               if not (b.row_count.is_concrete and b.nrows == 0)] \
+        or list(batches[:1])
     if len(batches) == 1:
         return batches[0]
+    lazy = any(not b.row_count.is_concrete for b in batches)
+    if lazy and any(dt.has_offsets for _, dt in batches[0].schema):
+        RowCount.materialize_all([b.row_count for b in batches])
+        lazy = False
+    if lazy:
+        return _concat_batches_lazy(batches)
     total = sum(b.nrows for b in batches)
     cap = bucket_capacity(total)
     names = batches[0].names
@@ -107,3 +125,36 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
             out_cols[name] = Column(dt, vals, total,
                                     validity=valid if any_nulls else None)
     return ColumnarBatch(out_cols, total)
+
+
+def _concat_batches_lazy(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Sync-free concat for fixed-width batches with deferred counts:
+    append positions come from the device-resident counts, the output
+    capacity from the (host-known) input capacities — an upper bound, so
+    rows beyond the true total stay padding exactly as shape-bucket
+    padding always does."""
+    from spark_rapids_tpu.columnar.column import RowCount
+    cap = bucket_capacity(sum(b.capacity for b in batches))
+    names = batches[0].names
+    counts = [b.row_count.device_i32() for b in batches]
+    total_dev = counts[0]
+    for c in counts[1:]:
+        total_dev = total_dev + c
+    total_rc = RowCount(device=total_dev)
+    out_cols = {}
+    for name in names:
+        dt = batches[0].column(name).dtype
+        any_nulls = any(b.column(name).validity is not None
+                        for b in batches)
+        vals = jnp.zeros(cap, dtype=dt.storage)
+        valid = jnp.zeros(cap, dtype=jnp.bool_)
+        n_dev = None
+        for b, c in zip(batches, counts):
+            col = b.column(name)
+            vals, valid = _append_fixed(
+                vals, valid, jnp.int32(0) if n_dev is None else n_dev,
+                col.data, _ensure_validity(col), c)
+            n_dev = c if n_dev is None else n_dev + c
+        out_cols[name] = Column(dt, vals, total_rc,
+                                validity=valid if any_nulls else None)
+    return ColumnarBatch(out_cols, total_rc)

@@ -10,10 +10,22 @@ cache_key`` includes literal values, so constants bake correctly).
 
 The cached callable still goes through jax.jit's own shape-bucket cache, so
 one signature may hold several XLA executables (one per input capacity).
+
+Thread safety: the pipeline driver (exec/pipeline.py) and concurrent
+sessions hit the cache from multiple threads, so every map access holds
+``_LOCK``.  ``jax.jit`` construction happens OUTSIDE the lock (it only
+wraps, tracing is deferred to first call); on a build race the first
+insert wins so every thread shares one executable.
+
+Donation: callers pass ``jit_kwargs`` (e.g. ``donate_argnums``) through to
+``jax.jit``; anything that changes the compiled program MUST be part of
+``signature`` (stage compilers fold their donation flag in — see
+ops/compiler.py).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable
 
@@ -25,6 +37,9 @@ import jax
 # of queries while keeping retention bounded.
 _MAX_ENTRIES = 256
 _CACHE: "OrderedDict[Hashable, Callable]" = OrderedDict()
+_LOCK = threading.Lock()
+_HITS = 0
+_MISSES = 0
 
 
 def cached_jit(signature: Hashable, make: Callable[[], Callable],
@@ -34,20 +49,37 @@ def cached_jit(signature: Hashable, make: Callable[[], Callable],
     only invoked when the signature is new, so closures over a freshly
     constructed plan instance are safe as long as everything the function's
     trace depends on is captured in the signature."""
-    fn = _CACHE.get(signature)
-    if fn is None:
-        fn = jax.jit(make(), **jit_kwargs)
-        _CACHE[signature] = fn
+    global _HITS, _MISSES
+    with _LOCK:
+        fn = _CACHE.get(signature)
+        if fn is not None:
+            _CACHE.move_to_end(signature)
+            _HITS += 1
+            return fn
+    built = jax.jit(make(), **jit_kwargs)
+    with _LOCK:
+        fn = _CACHE.get(signature)
+        if fn is not None:
+            # lost the build race: share the winner's executable (its
+            # jit shape-cache is what every thread must hit)
+            _CACHE.move_to_end(signature)
+            _HITS += 1
+            return fn
+        _MISSES += 1
+        _CACHE[signature] = built
         while len(_CACHE) > _MAX_ENTRIES:
             _CACHE.popitem(last=False)
-    else:
-        _CACHE.move_to_end(signature)
-    return fn
+    return built
 
 
 def cache_info() -> Dict[str, int]:
-    return {"entries": len(_CACHE)}
+    with _LOCK:
+        return {"entries": len(_CACHE), "hits": _HITS, "misses": _MISSES}
 
 
 def clear() -> None:
-    _CACHE.clear()
+    global _HITS, _MISSES
+    with _LOCK:
+        _CACHE.clear()
+        _HITS = 0
+        _MISSES = 0

@@ -541,18 +541,23 @@ def _conv_file(node: L.FileRelation, children, conf):
 
 @_converter(L.Project)
 def _conv_project(node: L.Project, children, conf):
+    from spark_rapids_tpu.config import rapids_conf as _rc
     from spark_rapids_tpu.exec.basic import TpuProjectExec
-    return TpuProjectExec(node.exprs, children[0])
+    return TpuProjectExec(node.exprs, children[0],
+                          donate=conf.get(_rc.PIPELINE_DONATION))
 
 
 @_converter(L.Filter)
 def _conv_filter(node: L.Filter, children, conf):
+    from spark_rapids_tpu.config import rapids_conf as _rc
     from spark_rapids_tpu.exec.basic import TpuFilterExec
-    return TpuFilterExec(node.condition, children[0])
+    return TpuFilterExec(node.condition, children[0],
+                         donate=conf.get(_rc.PIPELINE_DONATION))
 
 
 def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
-                    pre_filter=None, merge_chunk_rows=1 << 22):
+                    pre_filter=None, merge_chunk_rows=1 << 22,
+                    defer_syncs=True):
     """Build the aggregate exec, plus a result projection when outputs
     combine aggregates in larger expressions (sum(x)*100, sum(a)/sum(b)...
     — Catalyst's resultExpressions split)."""
@@ -605,11 +610,11 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
             group_exprs,
             [(name, a) for (name, _), a in zip(out_named, agg_list)],
             child_exec, pre_filter=pre_filter,
-            merge_chunk_rows=merge_chunk_rows)
+            merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs)
     agg_exec = TpuHashAggregateExec(
         group_exprs, [(f"_a{i}", a) for i, a in enumerate(agg_list)],
         child_exec, pre_filter=pre_filter,
-        merge_chunk_rows=merge_chunk_rows)
+        merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs)
     proj = [BoundReference(i, dt, name=n)
             for i, (n, dt) in enumerate(agg_exec.schema[:nkeys])]
     proj += [Alias(rewritten, name) for name, rewritten in out_named]
@@ -620,7 +625,8 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
 def _conv_aggregate(node: L.Aggregate, children, conf):
     from spark_rapids_tpu.config import rapids_conf as rc
     return _plan_aggregate(node.group_exprs, node.agg_exprs, children[0],
-                           merge_chunk_rows=conf.get(rc.AGG_MERGE_CHUNK_ROWS))
+                           merge_chunk_rows=conf.get(rc.AGG_MERGE_CHUNK_ROWS),
+                           defer_syncs=conf.get(rc.PIPELINE_DEFER_SYNCS))
 
 
 @_converter(L.Limit)
@@ -1000,7 +1006,8 @@ class TpuOverrides:
         base = self._convert(child_meta)
         return _plan_aggregate(
             group, aggs, base, pre_filter=cond,
-            merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS))
+            merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS),
+            defer_syncs=self.conf.get(rc.PIPELINE_DEFER_SYNCS))
 
 
 def valid_op_names():

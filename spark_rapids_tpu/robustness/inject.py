@@ -81,8 +81,13 @@ class InjectionRule:
         self.fired = 0
 
     def _matches_thread(self) -> bool:
-        return self.thread_id is None or \
-            self.thread_id == threading.get_ident()
+        if self.thread_id is None:
+            return True
+        ident = threading.get_ident()
+        # a pipeline worker adopts its driving thread's identity, so
+        # rules armed on the test/driver thread still fire inside the
+        # pipelined iterator (exec/pipeline.py)
+        return self.thread_id == _adopted.get(ident, ident)
 
     def _should_fire(self) -> bool:
         if self.remaining <= 0 or not self._matches_thread():
@@ -106,6 +111,21 @@ class InjectionRule:
 
 _lock = threading.Lock()
 _rules: List[InjectionRule] = []
+# worker thread ident -> the driving thread it acts for (plain dict:
+# int-keyed put/get/del are atomic under the GIL, and _matches_thread
+# runs on the hot path)
+_adopted: Dict[int, int] = {}
+
+
+def adopt_thread(owner_ident: int) -> None:
+    """Make rules armed by ``owner_ident`` fire on the calling thread.
+    Used by exec/pipeline.py so a fault injected for a query keeps
+    firing when the operator iterator moves to the pipeline worker."""
+    _adopted[threading.get_ident()] = owner_ident
+
+
+def release_thread() -> None:
+    _adopted.pop(threading.get_ident(), None)
 # cheap hot-path guard: fire() is threaded through per-batch loops and
 # must cost one attribute read when nothing is armed
 _armed = False

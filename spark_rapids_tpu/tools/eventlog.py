@@ -28,6 +28,11 @@ class QueryInfo:
     metrics: Dict[str, Dict[str, int]] = field(default_factory=dict)
     spill: Dict[str, int] = field(default_factory=dict)
     retry: Dict[str, int] = field(default_factory=dict)
+    # async pipeline stats (exec/pipeline.py PipelineStats.as_dict():
+    # depth, batches, pipelineFillRatio, hostSyncCount, uploadOverlapMs,
+    # consumerWaitMs, jitCacheHits/Misses); empty when the query ran
+    # sequential
+    pipeline: Dict[str, float] = field(default_factory=dict)
     # query-level recovery ladder actions (robustness/driver.py
     # RecoveryAction events stamped with this query's id)
     recovery: List[Dict[str, str]] = field(default_factory=list)
@@ -114,6 +119,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.metrics = rec.get("metrics", {})
                 q.spill = rec.get("spill", {})
                 q.retry = rec.get("retry", {})
+                q.pipeline = rec.get("pipeline", {})
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed
     for q in open_queries.values():

@@ -61,6 +61,38 @@ def skew_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def pipeline_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Aggregate async-pipeline effectiveness across queries: mean fill
+    ratio (batch-weighted), total host syncs, overlap time, and jit
+    cache hit rate (ops/jit_cache.py counters)."""
+    fill_w, batches, syncs, overlap_ms = 0.0, 0, 0, 0.0
+    hits, misses, piped = 0, 0, 0
+    for a in apps:
+        for q in a.queries:
+            p = q.pipeline
+            if not p:
+                continue
+            piped += 1
+            b = p.get("batches", 0)
+            fill_w += p.get("pipelineFillRatio", 0.0) * b
+            batches += b
+            syncs += p.get("hostSyncCount", 0)
+            overlap_ms += p.get("uploadOverlapMs", 0.0)
+            hits += p.get("jitCacheHits", 0)
+            misses += p.get("jitCacheMisses", 0)
+    if not piped:
+        return {}
+    return {
+        "queries": piped,
+        "batches": batches,
+        "fill_ratio": (fill_w / batches) if batches else 0.0,
+        "host_sync_count": syncs,
+        "upload_overlap_ms": overlap_ms,
+        "jit_cache_hits": hits,
+        "jit_cache_misses": misses,
+    }
+
+
 def health_check(apps: List[AppInfo]) -> List[str]:
     problems = []
     for a in apps:
@@ -68,6 +100,23 @@ def health_check(apps: List[AppInfo]) -> List[str]:
             if not q.succeeded:
                 problems.append(
                     f"{a.session_id} query {q.query_id}: {q.status}")
+            p = q.pipeline
+            if p and p.get("batches", 0) >= 4 and \
+                    p.get("pipelineFillRatio", 1.0) < 0.25:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: pipeline "
+                    f"starved (fill ratio "
+                    f"{p['pipelineFillRatio']:.2f} over "
+                    f"{p['batches']} batches) — the producer is the "
+                    "bottleneck; check reader threads / host decode")
+            if p and p.get("batches", 0) > 0 and \
+                    p.get("hostSyncCount", 0) > 4 * p["batches"]:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{p['hostSyncCount']} host syncs over "
+                    f"{p['batches']} batches — per-batch device->host "
+                    "round trips serialize the pipeline "
+                    "(docs/performance.md sync-point discipline)")
             spilled = sum(q.spill.values()) if q.spill else 0
             if spilled:
                 problems.append(
@@ -220,6 +269,20 @@ def format_report(apps: List[AppInfo], top: int) -> str:
         out.append(f"  n={sk['queries']} mean={sk['mean_ms']:.1f}ms "
                    f"p50={sk['p50_ms']:.1f}ms max={sk['max_ms']:.1f}ms "
                    f"skew={sk['skew_ratio']:.2f}x")
+    pl = pipeline_stats(apps)
+    if pl:
+        out.append("\n-- Async pipeline --")
+        out.append(
+            f"  pipelined queries={pl['queries']} "
+            f"batches={pl['batches']} "
+            f"fill={pl['fill_ratio']:.2f} "
+            f"hostSyncs={pl['host_sync_count']} "
+            f"uploadOverlap={pl['upload_overlap_ms']:.1f}ms")
+        total = pl["jit_cache_hits"] + pl["jit_cache_misses"]
+        if total:
+            out.append(
+                f"  jit cache: {pl['jit_cache_hits']}/{total} hits "
+                f"({pl['jit_cache_hits'] / total:.0%})")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

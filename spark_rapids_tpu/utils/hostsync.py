@@ -1,0 +1,126 @@
+"""Host-sync accounting: every device->host scalar/buffer fetch counts.
+
+A device->host synchronization costs a full tunnel round trip on real
+TPU hardware (the r05 bench attributes the group-by path's 10x gap to
+per-batch ``int(n)`` syncs), so the engine treats syncs as a budgeted
+resource: every site that materializes device data on the host goes
+through :func:`fetch` / :func:`count_sync`, and the counters surface in
+QueryEnd events (``pipeline.hostSyncCount``), ``bench.py`` JSON and
+``tests/test_pipeline.py``'s regression assertions.
+
+The discipline for when a sync is allowed lives in
+``docs/performance.md`` ("when is ``int(x)`` on a device value
+allowed"); the short form: only at true host decision points —
+coded-vs-sort dispatch, spill/merge sizing, string re-decode, and the
+final collect.
+
+Process-wide totals plus a thread-local mirror (the RetryMetrics
+pattern): a query runs its operator pipeline on one thread, so
+per-query deltas read the thread-local view and concurrent sessions
+don't contaminate each other's attribution.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+
+class HostSyncMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sync_count = 0
+        self._per_thread = {}  # effective thread ident -> count
+        self._owner = {}       # worker ident -> owning (driving) ident
+
+    def _effective_ident(self) -> int:
+        ident = threading.get_ident()
+        return self._owner.get(ident, ident)
+
+    def bump(self, n: int = 1) -> None:
+        with self._lock:
+            self.sync_count += n
+            ident = self._effective_ident()
+            self._per_thread[ident] = self._per_thread.get(ident, 0) + n
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self.sync_count
+
+    def snapshot_local(self) -> int:
+        with self._lock:
+            return self._per_thread.get(self._effective_ident(), 0)
+
+    def adopt(self, owner_ident: int) -> None:
+        """Attribute this thread's syncs to ``owner_ident``'s view.
+        The pipeline worker (exec/pipeline.py) adopts its driving
+        thread so per-query deltas keep working when the operator
+        iterator runs on the worker."""
+        with self._lock:
+            self._owner[threading.get_ident()] = owner_ident
+
+    def release(self) -> None:
+        with self._lock:
+            self._owner.pop(threading.get_ident(), None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.sync_count = 0
+            self._per_thread.clear()
+
+
+host_sync_metrics = HostSyncMetrics()
+
+
+def count_sync(n: int = 1) -> None:
+    """Record ``n`` device->host synchronizations."""
+    host_sync_metrics.bump(n)
+
+
+# ------------------------------------------------------ upload accounting --
+# Thread-local sink for host->device upload timing: the pipeline worker
+# (exec/pipeline.py) registers its PipelineStats here, and the columnar
+# materialization sites (columnar/column.py ``jnp.asarray``) report in.
+# Measures host-side dispatch+staging time (device transfer itself is
+# async) — the work the sequential loop would serialize against
+# consumption.
+_upload_sink = threading.local()
+
+
+def watch_uploads(stats) -> None:
+    """Route this thread's upload timings into ``stats``
+    (any object with an ``upload_overlap_ns`` attribute)."""
+    _upload_sink.sink = stats
+
+
+def unwatch_uploads() -> None:
+    _upload_sink.sink = None
+
+
+def note_upload(ns: int) -> None:
+    sink = getattr(_upload_sink, "sink", None)
+    if sink is not None:
+        sink.upload_overlap_ns += ns
+
+
+def fetch(*buffers):
+    """Fetch device buffers to host in ONE transfer (one counted sync).
+
+    Per-buffer ``np.asarray`` pays a full round trip each — dominant
+    with a remote-tunnel device; batching through ``jax.device_get``
+    amortizes them into a single sync.  Returns numpy arrays in input
+    order (a single buffer returns the bare array).
+    """
+    import jax
+    host_sync_metrics.bump(1)
+    got = jax.device_get(list(buffers))
+    return got[0] if len(buffers) == 1 else got
+
+
+def fetch_all(buffers: Sequence):
+    """List form of :func:`fetch` (always returns a list)."""
+    import jax
+    if not buffers:
+        return []
+    host_sync_metrics.bump(1)
+    return jax.device_get(list(buffers))

@@ -1,0 +1,377 @@
+"""Async pipelined executor suite (exec/pipeline.py + deferred syncs).
+
+Three layers, mirroring ISSUE 2's acceptance criteria:
+
+* identity — the pipelined drive yields batch-for-batch identical
+  results to the sequential pull loop across TPC-H q1/q6 and TPC-DS
+  q3/q55/q96 (the pipeline is a pure overlap optimization);
+* sync budget (``perf`` marker, deterministic — counts, not timing) —
+  the deferred-sync aggregation path does >=50% fewer device->host
+  syncs than the eager per-batch ``int(n)`` baseline on the q1 shape;
+* chaos (``chaos`` marker) — faults injected at reader/shuffle points
+  while the pipeline is driving still walk the recovery ladder and
+  match the clean run: worker-thread exceptions re-raise on the
+  driving thread with their injection context intact.
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.models import tpch, tpcds
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.utils.hostsync import host_sync_metrics
+
+PIPE_ON = {"spark.rapids.tpu.pipeline.enabled": True}
+PIPE_OFF = {"spark.rapids.tpu.pipeline.enabled": False}
+# the sequential-era baseline: no pipeline, eager per-batch int(n)
+SEQUENTIAL = {"spark.rapids.tpu.pipeline.enabled": False,
+              "spark.rapids.tpu.pipeline.deferSyncs": False}
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    yield
+    I.clear()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return tpch.gen_tables(sf=0.002)
+
+
+@pytest.fixture(scope="module")
+def ds_data():
+    return tpcds.gen_tables(sf=0.003)
+
+
+@pytest.fixture(scope="module")
+def lineitem_files(tmp_path_factory, data):
+    """lineitem split over 8 parquet files: the multi-batch reader
+    shape the pipeline exists for."""
+    d = tmp_path_factory.mktemp("pipeline-tpch")
+    li = data["lineitem"]
+    n = len(li)
+    paths = []
+    for i in range(8):
+        p = str(d / f"lineitem-{i}.parquet")
+        li.iloc[i * n // 8:(i + 1) * n // 8].to_parquet(p, index=False)
+        paths.append(p)
+    return paths
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    return df.sort_values(list(df.columns), ignore_index=True,
+                          na_position="last")
+
+
+# ------------------------------------------------------------- identity --
+def _batches_of(conf, build):
+    s = TpuSession(dict(conf))
+    frames = build(s)
+    return s, frames._execute_batches()
+
+
+def _assert_batchwise_equal(conf_a, conf_b, build):
+    """The strong form: same batch COUNT, same per-batch row counts,
+    same per-batch contents — not just equal concatenations."""
+    _, got = _batches_of(conf_a, build)
+    _, want = _batches_of(conf_b, build)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.nrows == w.nrows
+        ga, wa = g.to_arrow(), w.to_arrow()
+        assert ga.equals(wa), f"batch diverged: {ga} vs {wa}"
+
+
+def test_pipelined_multibatch_scan_identical(lineitem_files):
+    # 8-file MULTITHREADED scan + filter: many batches flow through the
+    # pipeline queue; every one must come out identical and in order
+    conf = {"spark.rapids.sql.format.parquet.reader.type":
+            "MULTITHREADED"}
+
+    def build(s):
+        return s.read.parquet(*lineitem_files).filter(
+            F.col("l_quantity") < 24.0)
+
+    _assert_batchwise_equal({**conf, **PIPE_ON}, {**conf, **PIPE_OFF},
+                            build)
+
+
+@pytest.mark.parametrize("q", ["q1", "q6"])
+def test_pipelined_tpch_identical(data, q):
+    def build(s):
+        t = tpch.load(s, data)
+        return getattr(tpch, q)(t)
+
+    _assert_batchwise_equal(PIPE_ON, SEQUENTIAL, build)
+
+
+@pytest.mark.parametrize("q", ["q3", "q55", "q96"])
+def test_pipelined_tpcds_identical(ds_data, q):
+    on = TpuSession(dict(PIPE_ON))
+    tpcds.load(on, ds_data)
+    off = TpuSession(dict(SEQUENTIAL))
+    tpcds.load(off, ds_data)
+    got = on.sql(tpcds.QUERIES[q]).to_pandas()
+    want = off.sql(tpcds.QUERIES[q]).to_pandas()
+    pd.testing.assert_frame_equal(_norm(got), _norm(want))
+    assert on.last_pipeline_stats is not None
+    assert off.last_pipeline_stats is None
+
+
+def test_pipeline_stats_populated(lineitem_files):
+    s = TpuSession({"spark.rapids.sql.format.parquet.reader.type":
+                    "MULTITHREADED",
+                    "spark.rapids.tpu.pipeline.depth": 3})
+    df = s.read.parquet(*lineitem_files).group_by("l_returnflag").agg(
+        F.sum(F.col("l_extendedprice")).alias("rev"))
+    df.to_pandas()
+    st = s.last_pipeline_stats
+    assert st is not None and st.depth == 3
+    assert st.batches >= 1
+    assert 0.0 <= st.fill_ratio <= 1.0
+    d = st.as_dict()
+    assert {"depth", "batches", "pipelineFillRatio", "hostSyncCount",
+            "uploadOverlapMs"} <= set(d)
+
+
+# ------------------------------------------------------ driver mechanics --
+def _mini_batches(k=6, n=64):
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+    rng = np.random.default_rng(7)
+    for _ in range(k):
+        yield ColumnarBatch(
+            {"v": Column(dts.FLOAT64, rng.normal(size=n), n)})
+
+
+def test_pipelined_preserves_order_and_count():
+    from spark_rapids_tpu.exec.pipeline import PipelineStats, pipelined
+    src = list(_mini_batches())
+    stats = PipelineStats(2)
+    out = list(pipelined(iter(src), 2, stats=stats))
+    assert [b.nrows for b in out] == [b.nrows for b in src]
+    for a, b in zip(out, src):
+        np.testing.assert_array_equal(a.column("v").host_values(),
+                                      b.column("v").host_values())
+    assert stats.batches == len(src)
+
+
+def test_pipelined_early_close_releases_registrations():
+    from spark_rapids_tpu.memory.spill import default_catalog
+    from spark_rapids_tpu.exec.pipeline import pipelined
+    cat = default_catalog()
+    before = cat.stats()["num_handles"]
+    gen = pipelined(_mini_batches(k=10), 3)
+    next(gen)
+    gen.close()  # LIMIT-style early exit
+    assert cat.stats()["num_handles"] == before
+
+
+def test_pipelined_worker_exception_reraises_with_context():
+    from spark_rapids_tpu.exec.pipeline import pipelined
+    from spark_rapids_tpu.robustness import faults as FT
+
+    def source():
+        yield from _mini_batches(k=2)
+        raise FT.InjectedReaderFault("io.read", "mid-stream")
+
+    with pytest.raises(FT.InjectedReaderFault) as ei:
+        list(pipelined(source(), 2))
+    # the injection context survives the thread hop: the recovery
+    # ladder classifies the re-raise exactly like a sequential fault
+    assert ei.value.point == "io.read"
+    assert FT.classify(ei.value).retryable
+
+
+def test_pipelined_worker_inherits_injection_rules():
+    # rules are thread-scoped; the worker must adopt the driving
+    # thread's identity or armed chaos rules would silently not fire
+    from spark_rapids_tpu.exec.pipeline import pipelined
+    from spark_rapids_tpu.robustness import faults as FT
+
+    def source():
+        yield from _mini_batches(k=1)
+        I.fire("io.read")  # runs on the pipeline worker thread
+        yield from _mini_batches(k=1)
+
+    with I.injected("io.read", count=1):
+        with pytest.raises(FT.InjectedReaderFault):
+            list(pipelined(source(), 2))
+
+
+def test_jit_cache_thread_safety_and_counters():
+    import jax
+    from spark_rapids_tpu.ops import jit_cache
+
+    sig = ("test_pipeline", "threaded")
+    jit_cache.clear()
+    base = jit_cache.cache_info()
+    assert base == {"entries": 0, "hits": 0, "misses": 0}
+    got = []
+
+    def hit_it():
+        fn = jit_cache.cached_jit(sig, lambda: (lambda x: x + 1))
+        got.append(fn)
+
+    threads = [threading.Thread(target=hit_it) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # all callers share ONE executable (one shape-bucket cache)
+    assert len(set(id(f) for f in got)) == 1
+    info = jit_cache.cache_info()
+    assert info["entries"] == 1
+    assert info["misses"] == 1
+    assert info["hits"] == 7
+    assert int(got[0](jax.numpy.int32(1))) == 2
+    jit_cache.clear()
+
+
+def test_donation_disabled_on_cpu_backend():
+    # tier-1 runs on CPU, where donation must be a no-op folded OUT of
+    # the cache signature (a CPU and a TPU process never share one)
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.ops.compiler import StageFn, donation_supported
+    from spark_rapids_tpu.ops.expressions import BoundReference
+    assert not donation_supported()
+    fn = StageFn([BoundReference(0, dts.FLOAT64, name="x")],
+                 [dts.FLOAT64], donate=True)
+    assert fn.donate is False
+    assert ("donate", False) in fn._sig
+
+
+# ------------------------------------------------------------ sync budget --
+@pytest.fixture(scope="module")
+def coded_lineitem_files(tmp_path_factory):
+    """The bench's q1 shape (BASELINE.md config 2): numeric lineitem
+    with dictionary-coded group keys, split over 8 parquet files so the
+    aggregation sees a stream of batches.  String keys would measure
+    the host dict-encode path instead of the deferred-count path."""
+    rng = np.random.default_rng(42)
+    n = 1 << 14
+    pdf = pd.DataFrame({
+        "l_extendedprice": rng.uniform(1000.0, 100000.0, n),
+        "l_discount": rng.uniform(0.0, 0.11, n).round(2),
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_shipdate": rng.integers(8766, 10957, n).astype(np.int32),
+        "l_returnflag_code": rng.integers(0, 3, n),
+        "l_linestatus_code": rng.integers(0, 2, n),
+    })
+    d = tmp_path_factory.mktemp("pipeline-coded")
+    paths = []
+    for i in range(8):
+        p = str(d / f"li-{i}.parquet")
+        pdf.iloc[i * n // 8:(i + 1) * n // 8].to_parquet(p, index=False)
+        paths.append(p)
+    return paths
+
+
+def _q1_shape(s, paths):
+    return (s.read.parquet(*paths)
+            .filter(F.col("l_shipdate") <= 10471)
+            .group_by("l_returnflag_code", "l_linestatus_code")
+            .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                 F.sum(F.col("l_extendedprice")).alias("sum_base"),
+                 F.avg(F.col("l_discount")).alias("avg_disc"),
+                 F.count(F.col("l_quantity")).alias("n")))
+
+
+@pytest.mark.perf
+def test_q1_shape_host_sync_reduction(coded_lineitem_files):
+    """The tentpole's measurable core: deferred RowCounts + the
+    speculative coded dispatch cut device->host syncs on a multi-batch
+    group-by by >=50% vs the eager sequential baseline.  Counts only —
+    no timing — so the assertion is deterministic on any backend."""
+    conf = {"spark.rapids.sql.format.parquet.reader.type":
+            "MULTITHREADED"}
+
+    def measure(extra):
+        s = TpuSession({**conf, **extra})
+        df = _q1_shape(s, coded_lineitem_files)
+        want = df.to_pandas()  # warm the jit cache
+        s0 = host_sync_metrics.snapshot()
+        got = df.to_pandas()
+        syncs = host_sync_metrics.snapshot() - s0
+        pd.testing.assert_frame_equal(_norm(got), _norm(want))
+        return syncs
+
+    eager = measure(SEQUENTIAL)
+    deferred = measure(PIPE_ON)
+    assert deferred <= eager / 2, \
+        f"deferred path made {deferred} syncs vs eager {eager} " \
+        f"(needs >=50% reduction)"
+
+
+@pytest.mark.perf
+def test_eventlog_carries_pipeline_metrics(tmp_path, coded_lineitem_files):
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import pipeline_stats
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path),
+                    "spark.rapids.sql.format.parquet.reader.type":
+                    "MULTITHREADED"})
+    _q1_shape(s, coded_lineitem_files).to_pandas()
+    s.stop()
+    apps = load_logs(str(tmp_path))
+    assert apps and apps[0].queries
+    p = apps[0].queries[-1].pipeline
+    assert p["depth"] >= 1 and p["batches"] >= 1
+    assert "pipelineFillRatio" in p and "hostSyncCount" in p \
+        and "uploadOverlapMs" in p
+    assert p["jitCacheHits"] + p["jitCacheMisses"] > 0
+    agg = pipeline_stats(apps)
+    assert agg["queries"] >= 1
+
+
+# ------------------------------------------------------------------ chaos --
+@pytest.mark.chaos
+def test_pipeline_reader_fault_walks_ladder(coded_lineitem_files):
+    # the fault fires on the PIPELINE WORKER (the reader runs there
+    # now); the ladder must see it on the driving thread and retry
+    s = TpuSession({"spark.rapids.sql.format.parquet.reader.type":
+                    "MULTITHREADED"})
+    df = _q1_shape(s, coded_lineitem_files)
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    with I.injected("io.read", count=2):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got), _norm(want))
+    assert [r["action"] for r in s.recovery_log] == ["retry", "retry"]
+    assert {r["fault"] for r in s.recovery_log} == {"io_read"}
+
+
+@pytest.mark.chaos
+def test_pipeline_shuffle_fault_demotes_into_pipeline():
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    s = TpuSession(mesh=make_mesh(8))
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame({"k": rng.integers(0, 40, 4096),
+                        "v": rng.normal(size=4096)})
+    df = (s.create_dataframe(pdf).group_by("k")
+          .agg(F.sum(F.col("v")).alias("sv"),
+               F.count(F.col("v")).alias("c")))
+    want = df.to_pandas()
+    s.recovery_log.clear()
+    # a shuffle boundary that never heals: the ladder demotes off the
+    # mesh and the final rung executes through the PIPELINED
+    # single-process engine — the answer must still match
+    with I.injected("dist.host_sync", count=10_000):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(
+        _norm(got).astype(want.dtypes.to_dict()), _norm(want))
+    assert [r["action"] for r in s.recovery_log] == \
+        ["retry", "retry", "spill", "split"]
+    assert s.last_dist_explain.startswith("demoted")
+    # the recovered (single-process) attempt ran pipelined
+    assert s.last_pipeline_stats is not None
