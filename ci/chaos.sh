@@ -14,4 +14,48 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast
 echo "== chaos suite (fault injection + recovery ladder) =="
 python -m pytest tests/ -q -m chaos --maxfail=5
 
+echo "== hang/corruption spray (delay + corrupt rules, short deadlines) =="
+# bounded wedges (0.2s) at EVERY registered injection point plus bit
+# flips on both spill restore tiers, under tight CPU-scale watchdog
+# deadlines; the query must still answer with clean-run results
+python - <<'PY'
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.robustness import inject as I
+
+s = TpuSession({
+    "spark.rapids.tpu.watchdog.defaultDeadlineMs": 500,
+    "spark.rapids.tpu.watchdog.queryDeadlineMs": 30_000,
+    "spark.rapids.memory.tpu.deviceLimitBytes": 65536,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+})
+rng = np.random.default_rng(0)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+df = (s.create_dataframe(pdf).group_by("k")
+      .agg(F.sum(F.col("v")).alias("sv"),
+           F.count(F.col("v")).alias("c")))
+want = df.to_pandas().sort_values("k", ignore_index=True)
+rules = []
+try:
+    for point in I.injection_points():
+        rules.append(I.inject(point, kind="delay", delay_s=0.2,
+                              count=2, probability=0.5, seed=7,
+                              all_threads=True))
+    for point in ("spill.corrupt.host", "spill.corrupt.disk"):
+        rules.append(I.inject(point, kind="corrupt", count=2,
+                              probability=0.5, seed=11,
+                              all_threads=True))
+    got = df.to_pandas().sort_values("k", ignore_index=True)
+finally:
+    for r in rules:
+        I.remove(r)
+pd.testing.assert_frame_equal(got, want)
+print("hang/corruption spray OK "
+      f"(recovery trail: {[r['action'] for r in s.recovery_log]})")
+PY
+
 echo "CHAOS OK"

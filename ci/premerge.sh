@@ -13,8 +13,8 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast
 echo "== unit tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q --maxfail=20 -m 'not chaos'
 
-echo "== chaos suite (fault injection + recovery ladder) =="
-python -m pytest tests/ -q -m chaos --maxfail=5
+echo "== chaos suite (fault injection + recovery ladder + hang/corruption spray) =="
+bash ci/chaos.sh
 
 echo "== perf smoke (deterministic host-sync budgets, no timing) =="
 python -m pytest tests/ -q -m perf --maxfail=5

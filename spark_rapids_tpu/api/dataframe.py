@@ -580,6 +580,17 @@ class DataFrame:
         return QueryRetryDriver(self.session).run(self._attempt_batches)
 
     def _attempt_batches(self, mode) -> List[ColumnarBatch]:
+        # every attempt runs in a watchdog query scope: stale
+        # cancellation tokens from a previous attempt are cleared, and
+        # spark.rapids.tpu.watchdog.queryDeadlineMs (when set) bounds
+        # this attempt's wall time — an overrun is a retryable
+        # TimeoutFault delivered at the next checkpoint, so a hung
+        # attempt re-drives down the ladder instead of blocking forever
+        from spark_rapids_tpu.robustness import watchdog
+        with watchdog.query_scope(self.session):
+            return self._attempt_batches_impl(mode)
+
+    def _attempt_batches_impl(self, mode) -> List[ColumnarBatch]:
         import time as _time
         from spark_rapids_tpu.api.session import TpuSession
         # conf resolved at call time (retry budget, semaphore) follows

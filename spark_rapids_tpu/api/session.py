@@ -96,8 +96,14 @@ class TpuSession:
             conf_snapshot=dict(self.conf.settings))
 
     def stop(self) -> None:
-        """Close the session's observability resources (SessionEnd)."""
+        """Close the session's observability resources (SessionEnd)
+        and sweep its spill tier — live handles close, orphaned
+        ``buf-*`` spill/temp files are deleted, and the catalog's own
+        temp dir is removed (the RapidsDiskStore shutdown analog)."""
         self.events.close()
+        cat = getattr(self, "memory_catalog", None)
+        if cat is not None:
+            cat.close()
         if TpuSession._active is self:
             TpuSession._active = None
 
@@ -136,7 +142,8 @@ class TpuSession:
             host_budget=self.conf.get(rc.HOST_SPILL_STORAGE_SIZE),
             frame_codec=native.codec_level(
                 self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC)),
-            disk_write_threads=self.conf.get(rc.SPILL_DISK_WRITE_THREADS))
+            disk_write_threads=self.conf.get(rc.SPILL_DISK_WRITE_THREADS),
+            integrity_check=self.conf.get(rc.SPILL_INTEGRITY_ENABLED))
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))

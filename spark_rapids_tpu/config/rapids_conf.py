@@ -434,9 +434,59 @@ QUERY_RECOVERY_MAX_RETRIES = conf(
 
 QUERY_RECOVERY_BACKOFF_MS = conf(
     "spark.rapids.sql.recovery.backoffMs", 25,
-    "Base backoff between same-plan query retries, doubled per "
-    "retry and capped at 2s.", _to_int,
+    "Base backoff between same-plan query retries, doubled per retry, "
+    "jittered (deterministically, seeded per driver), and capped at "
+    "spark.rapids.sql.recovery.backoffCapMs.", _to_int,
     lambda v: None if v >= 0 else "must be >= 0")
+
+QUERY_RECOVERY_BACKOFF_CAP_MS = conf(
+    "spark.rapids.sql.recovery.backoffCapMs", 2000,
+    "Ceiling on the exponential retry backoff (before jitter). Chaos "
+    "tests lower it so ladders stay fast; long-haul batch jobs may "
+    "raise it to ride out minutes-long maintenance events.", _to_int,
+    _positive)
+
+WATCHDOG_ENABLED = conf(
+    "spark.rapids.tpu.watchdog.enabled", True,
+    "Enable the hang watchdog (robustness/watchdog.py): monitored "
+    "sections around reader decode, shuffle program launch, host "
+    "syncs, UDF worker calls and the pipeline worker heartbeat "
+    "convert deadline overruns into classified retryable TimeoutFault"
+    "s delivered at the next cooperative cancellation checkpoint, so "
+    "the recovery ladder absorbs hangs the same way it absorbs "
+    "exceptions (the UCX transport heartbeat/timeout analog).",
+    _to_bool)
+
+WATCHDOG_DEFAULT_DEADLINE_MS = conf(
+    "spark.rapids.tpu.watchdog.defaultDeadlineMs", 300_000,
+    "Deadline applied to every monitored section without a per-point "
+    "override (spark.rapids.tpu.watchdog.deadline.<point>). 0 "
+    "disables monitoring for sections without an override.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+WATCHDOG_QUERY_DEADLINE_MS = conf(
+    "spark.rapids.tpu.watchdog.queryDeadlineMs", 0,
+    "Wall-time deadline for one query execution attempt; an overrun "
+    "is a retryable TimeoutFault, so the recovery ladder re-drives "
+    "(and ultimately degrades) rather than hanging forever. 0 "
+    "disables the whole-query deadline.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+WATCHDOG_POLL_MS = conf(
+    "spark.rapids.tpu.watchdog.pollMs", 25,
+    "Target poll interval of the watchdog monitor thread; the "
+    "effective cadence also adapts to the shortest active deadline "
+    "so short test deadlines detect promptly.", _to_int, _positive)
+
+SPILL_INTEGRITY_ENABLED = conf(
+    "spark.rapids.memory.spill.integrityCheck.enabled", True,
+    "Verify a crc32 checksum (computed when a batch leaves the "
+    "device) on every HOST and DISK tier spill restore; a mismatch "
+    "drops the batch and raises a degradable CorruptionFault so the "
+    "recovery ladder re-runs from source — wrong bytes are never "
+    "returned. Disk spill files are always written atomically "
+    "(temp file + fsync + rename) regardless of this flag.",
+    _to_bool)
 
 SKEW_JOIN_ENABLED = conf(
     "spark.rapids.sql.join.skew.enabled", True,
@@ -538,10 +588,16 @@ _DYNAMIC_PREFIXES = ("spark.rapids.sql.expression.",
 # calibrated defaults from plan/cbo_weights.json and these keys override
 _COST_PREFIXES = ("spark.rapids.sql.optimizer.tpuOpCost.",
                   "spark.rapids.sql.optimizer.cpuOpCost.")
+# per-point watchdog deadline overrides (any monitored section name,
+# e.g. io.reader / shuffle.exchange / pipeline.worker); values in ms,
+# 0 disables that point
+_WATCHDOG_DEADLINE_PREFIX = "spark.rapids.tpu.watchdog.deadline."
 
 
 def _known_key(key: str) -> bool:
     if key in _REGISTRY:
+        return True
+    if key.startswith(_WATCHDOG_DEADLINE_PREFIX):
         return True
     for p in _COST_PREFIXES:
         if key.startswith(p):
@@ -579,6 +635,15 @@ class RapidsConf:
         raw = self.settings.get(
             f"spark.rapids.sql.optimizer.{side}OpCost.{name}")
         return None if raw is None else float(raw)
+
+    def watchdog_deadline_ms(self, point: str) -> int:
+        """Per-point watchdog deadline:
+        spark.rapids.tpu.watchdog.deadline.<point>, falling back to
+        the defaultDeadlineMs entry.  0 disables the point."""
+        raw = self.settings.get(_WATCHDOG_DEADLINE_PREFIX + point)
+        if raw is None:
+            return self.get(WATCHDOG_DEFAULT_DEADLINE_MS)
+        return int(raw)
 
     def op_enabled(self, kind: str, name: str) -> bool:
         """Per-op enable key: spark.rapids.sql.<kind>.<Name>, default

@@ -60,9 +60,10 @@ def worker_attribution(owner_ident: int, stats=None):
     release pairs, where forgetting one silently mis-attributes
     metrics or stops thread-scoped chaos rules from firing."""
     from spark_rapids_tpu.memory.retry import retry_metrics
-    from spark_rapids_tpu.robustness import inject
+    from spark_rapids_tpu.robustness import inject, watchdog
     from spark_rapids_tpu.utils import hostsync
     inject.adopt_thread(owner_ident)
+    watchdog.adopt_thread(owner_ident)
     hostsync.host_sync_metrics.adopt(owner_ident)
     retry_metrics.adopt(owner_ident)
     if stats is not None:
@@ -74,7 +75,24 @@ def worker_attribution(owner_ident: int, stats=None):
             hostsync.unwatch_uploads()
         retry_metrics.release()
         hostsync.host_sync_metrics.release()
+        watchdog.release_thread()
         inject.release_thread()
+
+
+def disown_worker(ident: int) -> None:
+    """Sever a worker thread's adopted identity in EVERY registry
+    worker_attribution enrolled it in — the counterpart operation,
+    invoked by a driver abandoning a wedged worker.  The zombie must
+    not consume the driver's next attempt's cancellation token or
+    rule budgets, nor mis-attribute its dying syncs/retries into the
+    next query's thread-local deltas."""
+    from spark_rapids_tpu.memory.retry import retry_metrics
+    from spark_rapids_tpu.robustness import inject, watchdog
+    from spark_rapids_tpu.utils import hostsync
+    watchdog.disown(ident)
+    inject.disown(ident)
+    hostsync.host_sync_metrics.disown(ident)
+    retry_metrics.disown(ident)
 
 
 class PipelineStats:
@@ -159,23 +177,39 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
         # materialization) time themselves into stats while this
         # thread runs the iterator — that is work the sequential loop
         # would have serialized against consumption.
+        from spark_rapids_tpu.robustness import watchdog
         try:
             with worker_attribution(owner_ident, stats):
                 try:
-                    for batch in source:
-                        if stop.is_set():
-                            break
-                        handle = catalog.register(
-                            batch, ACTIVE_ON_DECK_PRIORITY)
-                        while not stop.is_set():
-                            try:
-                                q.put(handle, timeout=0.1)
+                    # heartbeat section: the deadline measures SILENCE
+                    # (time since the last produced batch / queue
+                    # wait), so a worker wedged inside the operator
+                    # iterator trips while a merely busy one never
+                    # does.  The trip cancels the DRIVING thread's
+                    # token (this thread adopted its identity), which
+                    # the consumer's queue-wait checkpoint delivers as
+                    # a retryable TimeoutFault.
+                    with watchdog.section("pipeline.worker") as beat:
+                        for batch in source:
+                            if beat is not None:
+                                beat.beat()
+                            if stop.is_set():
                                 break
-                            except queue.Full:
-                                continue
-                        else:
-                            handle.close()
-                            break
+                            handle = catalog.register(
+                                batch, ACTIVE_ON_DECK_PRIORITY)
+                            while not stop.is_set():
+                                if beat is not None:
+                                    # backpressure (full queue) is a
+                                    # slow consumer, not a hang
+                                    beat.beat()
+                                try:
+                                    q.put(handle, timeout=0.1)
+                                    break
+                                except queue.Full:
+                                    continue
+                            else:
+                                handle.close()
+                                break
                     _put_final(q, stop, _DONE)
                 except BaseException as exc:  # noqa: BLE001 — re-raised
                     _put_final(q, stop, exc)
@@ -186,6 +220,8 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
                 # must not die with it
                 semaphore.release_all_held()
 
+    from spark_rapids_tpu.robustness import watchdog
+
     t = threading.Thread(target=worker, name="tpu-pipeline", daemon=True)
     t.start()
     try:
@@ -193,7 +229,17 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
             stats.fill_sum += min(q.qsize() / depth, 1.0)
             stats.gets += 1
             t0 = time.perf_counter_ns()
-            item = q.get()
+            # the queue wait is the driving thread's cancellation
+            # checkpoint: when the watchdog trips (wedged worker, query
+            # deadline) the TimeoutFault is raised HERE instead of
+            # blocking forever on a queue no one will ever fill
+            while True:
+                watchdog.checkpoint()
+                try:
+                    item = q.get(timeout=0.05)
+                    break
+                except queue.Empty:
+                    continue
             stats.wait_ns += time.perf_counter_ns() - t0
             if item is _DONE:
                 break
@@ -228,9 +274,23 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
                         not isinstance(leftover, BaseException):
                     leftover.close()
 
-        while t.is_alive():
+        # bound the join: waiting forever on a WEDGED worker would
+        # re-create the very hang the watchdog just converted into a
+        # fault.  A healthy worker exits within the grace period; an
+        # abandoned one is a daemon that self-cleans when it unwedges
+        # (sees ``stop`` set, closes its in-flight registration, drops
+        # its terminal put — the drain above already made delivery
+        # moot).
+        grace_until = time.monotonic() + 1.0
+        while t.is_alive() and time.monotonic() < grace_until:
             drain()
             t.join(timeout=0.05)
         drain()
+        if t.is_alive() and t.ident is not None:
+            # sever the zombie's adopted identity everywhere: when it
+            # unwedges it must not consume the driver's NEXT attempt's
+            # one-shot cancellation token, its armed rule budgets, or
+            # its per-thread metric attribution
+            disown_worker(t.ident)
         stats.host_sync_count = \
             host_sync_metrics.snapshot_local() - sync0

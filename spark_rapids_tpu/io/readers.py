@@ -213,10 +213,21 @@ class TpuFileScanExec(TpuExec):
     def do_execute(self) -> Iterator[ColumnarBatch]:
         # "io.read" fires once per produced batch, so chaos tests can
         # kill a scan mid-stream; recovery is query-level (the
-        # QueryRetryDriver re-drives the whole plan — scans re-read)
+        # QueryRetryDriver re-drives the whole plan — scans re-read).
+        # Each pull runs under an "io.reader" watchdog section: a
+        # stalled decode (slow object store, wedged reader pool
+        # thread) overruns its deadline and the monitor converts the
+        # hang into a retryable TimeoutFault at the next checkpoint
+        from spark_rapids_tpu.robustness import watchdog
         from spark_rapids_tpu.robustness.inject import fire
-        for batch in self._scan_batches():
-            fire("io.read")
+        it = self._scan_batches()
+        while True:
+            with watchdog.section("io.reader"):
+                batch = next(it, None)
+                if batch is not None:
+                    fire("io.read")
+            if batch is None:
+                return
             yield batch
 
     def _scan_batches(self) -> Iterator[ColumnarBatch]:

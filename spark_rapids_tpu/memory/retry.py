@@ -145,6 +145,12 @@ class RetryMetrics:
         with self.lock:
             self._owner.pop(threading.get_ident(), None)
 
+    def disown(self, ident: int) -> None:
+        """Sever ``ident``'s adoption from the outside (a driver
+        abandoning a wedged worker thread)."""
+        with self.lock:
+            self._owner.pop(ident, None)
+
     def reset(self) -> None:
         with self.lock:
             self.retry_count = 0

@@ -22,6 +22,7 @@ import itertools
 import os
 import tempfile
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -42,6 +43,59 @@ AGGREGATE_INTERMEDIATE_PRIORITY = 0
 ACTIVE_ON_DECK_PRIORITY = 1000
 
 
+class IntegrityMetrics:
+    """Process-wide spill-integrity counters (checksum verification
+    failures per tier), surfaced by tools/profiling."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.corruption_counts: Dict[str, int] = {}
+
+    def bump(self, tier: str) -> None:
+        with self._lock:
+            self.corruption_counts[tier] = \
+                self.corruption_counts.get(tier, 0) + 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.corruption_counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.corruption_counts.clear()
+
+
+integrity_metrics = IntegrityMetrics()
+
+
+def _payload_checksum(payload: dict, nrows: int) -> int:
+    """crc32 over the host payload in canonical form: buffer keys in
+    sorted order, every buffer's raw bytes, plus the row count — so
+    any single flipped bit anywhere fails verification.  Canonical
+    means identical across representations of the same batch: non-
+    array entries and zero-length buffers are skipped (the disk frame
+    codec stores empty buffers as absent, and ``__nrows`` rides the
+    handle, not the restored dict)."""
+    crc = zlib.crc32(str(int(nrows)).encode())
+    for key in sorted(payload):
+        v = payload[key]
+        if not isinstance(v, np.ndarray) or v.size == 0:
+            continue
+        crc = zlib.crc32(key.encode(), crc)
+        a = np.ascontiguousarray(v)
+        crc = zlib.crc32(a.view(np.uint8).reshape(-1), crc)
+    return crc & 0xFFFFFFFF
+
+
+def _emit_corruption(tier: str, buf_id: int, detail: str) -> None:
+    """Count + event-log a checksum failure (SpillCorruption events
+    feed the profiling health check with per-query attribution)."""
+    integrity_metrics.bump(tier)
+    from spark_rapids_tpu.utils.events import emit_on_session
+    emit_on_session("SpillCorruption", tier=tier, bufId=buf_id,
+                    detail=detail)
+
+
 class SpillableHandle:
     """One registered batch, resident at exactly one tier."""
 
@@ -58,6 +112,10 @@ class SpillableHandle:
         self._device: Optional[ColumnarBatch] = batch
         self._host: Optional[dict] = None
         self._disk_path: Optional[str] = None
+        # crc32 of the host payload, stamped when the batch leaves
+        # DEVICE and verified on every HOST->DEVICE / DISK->HOST
+        # restore (None until first spill, or with integrity off)
+        self._integrity_crc: Optional[int] = None
         self._schema = batch.schema
         self._capacity = batch.capacity
         # deferred (device-resident) counts stay deferred while the
@@ -119,6 +177,11 @@ class SpillableHandle:
     def spill_to_host(self) -> int:
         assert self.tier == DEVICE
         self._host = self._to_host_payload()
+        if self.catalog.integrity_check:
+            # stamped exactly once, when the bytes leave the device:
+            # every later restore (host or disk) verifies against this
+            self._integrity_crc = _payload_checksum(self._host,
+                                                    self.nrows)
         self._device = None
         self.tier = HOST
         return self.size_bytes
@@ -141,9 +204,25 @@ class SpillableHandle:
                          self._host.get(f"{name}.offsets")))
         blob = native.serialize_batch(self.nrows, cols,
                                       compress=self.catalog.frame_codec)
+        # torn-write-proof: stage to a temp file, fsync, then rename
+        # into place.  A crash anywhere before the rename leaves no
+        # file at ``path``, so a partial frame is never restorable.
+        tmp = path + ".tmp"
         try:
-            native.write_spill_file(path, blob)
+            os.makedirs(self.catalog.spill_dir, exist_ok=True)
+            native.write_spill_file(tmp, blob)
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, path)
         except OSError as e:
+            try:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            except OSError:
+                pass
             # disk full / unreachable: re-type for the fault taxonomy
             # (retryable — the host copy is untouched)
             raise SpillIOError(
@@ -153,6 +232,24 @@ class SpillableHandle:
         self.tier = DISK
         return self.size_bytes
 
+    def _verify_payload(self, payload: dict, tier: str) -> None:
+        """Checksum gate on every restore: a mismatch DROPS the batch
+        (close unlinks any disk file and deregisters) and raises a
+        degradable CorruptionFault — the ladder re-runs from source;
+        wrong bytes are never returned."""
+        if not self.catalog.integrity_check or \
+                self._integrity_crc is None:
+            return
+        got = _payload_checksum(payload, self.nrows)
+        if got == self._integrity_crc:
+            return
+        detail = (f"buf-{self.id}: crc {got:#010x} != stored "
+                  f"{self._integrity_crc:#010x}")
+        self.close()
+        _emit_corruption(tier, self.id, detail)
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
+        raise CorruptionFault(tier, detail)
+
     def materialize(self) -> ColumnarBatch:
         """Get the batch back on device (unspilling if needed)."""
         if self.closed:
@@ -160,18 +257,34 @@ class SpillableHandle:
         self.last_access = self.catalog.next_access_stamp()
         if self.tier == DEVICE:
             return self._device
+        from spark_rapids_tpu.robustness.inject import fire_mutate
         if self.tier == HOST:
-            payload = self._host
+            payload = self._corrupt_point(self._host,
+                                          "spill.corrupt.host")
+            self._verify_payload(payload, HOST)
             batch = self._rebuild(lambda k: payload.get(k))
         else:
             from spark_rapids_tpu import native
-            from spark_rapids_tpu.robustness.faults import SpillIOError
+            from spark_rapids_tpu.robustness.faults import (
+                CorruptionFault, SpillIOError)
             try:
                 blob = native.read_spill_file(self._disk_path)
             except OSError as e:
                 raise SpillIOError(
                     f"disk unspill of buf-{self.id} failed: {e}") from e
-            _, cols = native.deserialize_batch(blob)
+            blob = fire_mutate("spill.corrupt.disk", blob)
+            try:
+                _, cols = native.deserialize_batch(blob)
+            except OSError:
+                raise
+            except Exception as e:
+                # a frame that no longer decodes IS corruption (a
+                # flipped bit in the compressed stream): drop the
+                # batch, never guess at bytes
+                detail = f"buf-{self.id}: frame decode failed: {e}"
+                self.close()
+                _emit_corruption(DISK, self.id, detail)
+                raise CorruptionFault(DISK, detail) from e
             payload = {}
             for (name, dt), (_, d, v, o) in zip(self._schema, cols):
                 if d is not None:
@@ -181,9 +294,29 @@ class SpillableHandle:
                     payload[f"{name}.validity"] = v.view(np.bool_)
                 if o is not None:
                     payload[f"{name}.offsets"] = o.view(np.int32)
+            self._verify_payload(payload, DISK)
             batch = self._rebuild(lambda k: payload.get(k))
         self.catalog.unspill(self, batch)
         return batch
+
+    @staticmethod
+    def _corrupt_point(payload: dict, point: str) -> dict:
+        """Chaos hook: offer ONE payload buffer (the first data buffer
+        in canonical order) to an armed corrupt rule.  The mutated copy
+        replaces the buffer in a shallow-copied dict — the restore sees
+        rot, the stored payload object itself is untouched."""
+        from spark_rapids_tpu.robustness.inject import fire_mutate
+        key = next((k for k in sorted(payload)
+                    if isinstance(payload[k], np.ndarray)
+                    and payload[k].size > 0), None)
+        if key is None:
+            return payload
+        mutated = fire_mutate(point, payload[key])
+        if mutated is payload[key]:
+            return payload
+        payload = dict(payload)
+        payload[key] = mutated
+        return payload
 
     def close(self) -> None:
         if self.closed:
@@ -191,9 +324,18 @@ class SpillableHandle:
         self.closed = True
         self._device = None
         self._host = None
-        if self._disk_path and os.path.exists(self._disk_path):
-            os.unlink(self._disk_path)
-        self.catalog.remove(self)
+        try:
+            if self._disk_path and os.path.exists(self._disk_path):
+                os.unlink(self._disk_path)
+        except OSError:
+            # the catalog's session-close sweep collects stragglers a
+            # racing unlink left behind
+            pass
+        finally:
+            # deregistration must survive an unlink failure, else the
+            # dead handle pins catalog counters for the session's life
+            self._disk_path = None
+            self.catalog.remove(self)
 
 
 class SpillableBatchCatalog:
@@ -208,9 +350,15 @@ class SpillableBatchCatalog:
                  host_budget: int = 1 << 30,
                  spill_dir: Optional[str] = None,
                  frame_codec: int = 2,
-                 disk_write_threads: int = 2):
+                 disk_write_threads: int = 2,
+                 integrity_check: bool = True):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # spark.rapids.memory.spill.integrityCheck.enabled: checksum
+        # every payload leaving DEVICE, verify on every restore
+        self.integrity_check = bool(integrity_check)
+        # only a directory this catalog created gets rmdir'd at close
+        self._owns_spill_dir = spill_dir is None
         # host->disk demotions overlap in a small writer pool: the
         # native pager releases the GIL for serialize+write
         # (spark.rapids.memory.spill.diskWriteThreads)
@@ -227,6 +375,10 @@ class SpillableBatchCatalog:
         native.available()
         self._lock = threading.Lock()
         self._handles: Dict[int, SpillableHandle] = {}
+        # every handle id THIS catalog ever issued: close()'s orphan
+        # sweep is scoped to these, so two catalogs sharing a spill
+        # dir can never unlink each other's live frames
+        self._issued_ids: set = set()
         self.device_bytes = 0
         self.host_bytes = 0
         self.disk_bytes = 0
@@ -244,6 +396,7 @@ class SpillableBatchCatalog:
         h = SpillableHandle(self, batch, priority)
         with self._lock:
             self._handles[h.id] = h
+            self._issued_ids.add(h.id)
             self.device_bytes += h.size_bytes
         self.ensure_budget()
         return h
@@ -323,23 +476,79 @@ class SpillableBatchCatalog:
         if self.disk_write_threads > 1 and len(to_spill) > 1:
             # account every COMPLETED demotion even when one writer
             # fails mid-batch, else host/disk counters drift for the
-            # rest of the session
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(
-                    max_workers=self.disk_write_threads) as pool:
-                futures = [pool.submit(h.spill_to_disk)
+            # rest of the session.  The wait is watchdog-cooperative:
+            # a wedged writer (stalled NFS, an unbounded delay rule on
+            # "spill.disk") trips the section deadline and the fault
+            # delivers HERE — a bare fut.result() under the catalog
+            # lock would deadlock the whole process unrecoverably.
+            import concurrent.futures as cf
+            from spark_rapids_tpu.robustness import watchdog
+            pool = cf.ThreadPoolExecutor(
+                max_workers=self.disk_write_threads)
+            first_err = None
+            try:
+                pending = [pool.submit(h.spill_to_disk)
                            for h in to_spill]
-                first_err = None
-                for fut in futures:
-                    try:
-                        account(fut.result())
-                    except BaseException as e:  # noqa: BLE001
-                        first_err = first_err or e
-                if first_err is not None:
-                    raise first_err
+                with watchdog.section("spill.disk") as sect:
+                    while pending:
+                        watchdog.checkpoint()
+                        done = [f for f in pending if f.done()]
+                        if not done:
+                            cf.wait(pending, timeout=0.05,
+                                    return_when=cf.FIRST_COMPLETED)
+                            continue
+                        if sect is not None:
+                            sect.beat()  # progress, not a hang
+                        for fut in done:
+                            pending.remove(fut)
+                            try:
+                                account(fut.result())
+                            except BaseException as e:  # noqa: BLE001
+                                first_err = first_err or e
+            finally:
+                # never wait=True: joining a wedged writer re-creates
+                # the hang the cooperative wait just escaped
+                pool.shutdown(wait=False, cancel_futures=True)
+            if first_err is not None:
+                raise first_err
         else:
             for h in to_spill:
                 account(h.spill_to_disk())
+
+    def close(self) -> None:
+        """Session-teardown sweep: close every live handle (unlinking
+        their disk files), then collect any orphaned spill artifacts —
+        ``buf-*.tcf`` left by a crashed restore, ``*.tmp`` staging
+        files from a torn write — and remove the temp dir if this
+        catalog created it.  Idempotent; the catalog stays usable
+        afterwards (spill_to_disk re-creates the directory)."""
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            h.close()
+
+        def _mine(name: str) -> bool:
+            # only artifacts THIS catalog issued (buf-<id>.tcf[.tmp]):
+            # a shared spill_dir may hold another live catalog's frames
+            if not name.startswith("buf-") or not (
+                    name.endswith(".tcf") or name.endswith(".tcf.tmp")):
+                return False
+            try:
+                return int(name[4:].split(".", 1)[0]) in self._issued_ids
+            except ValueError:
+                return False
+
+        try:
+            for name in os.listdir(self.spill_dir):
+                if _mine(name):
+                    try:
+                        os.unlink(os.path.join(self.spill_dir, name))
+                    except OSError:
+                        pass
+            if self._owns_spill_dir:
+                os.rmdir(self.spill_dir)
+        except OSError:
+            pass
 
     def stats(self) -> Dict[str, int]:
         return {

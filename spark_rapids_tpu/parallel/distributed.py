@@ -40,6 +40,15 @@ def host_sync(x):
     processes — each controller then makes the IDENTICAL slot/LUT
     decision, which the SPMD contract requires.  Accepts a pytree so
     co-located stats pay ONE cross-host collective."""
+    from spark_rapids_tpu.robustness import watchdog
+    # deadline on the phase boundary: a dead peer that never answers
+    # the stats all-gather becomes a TimeoutFault instead of an
+    # eternal wait (the transport-heartbeat analog)
+    with watchdog.section("dist.host_sync"):
+        return _host_sync_body(x)
+
+
+def _host_sync_body(x):
     import numpy as np
     from spark_rapids_tpu.robustness.faults import HostSyncError
     from spark_rapids_tpu.robustness.inject import fire
@@ -296,8 +305,10 @@ class DistributedAggregate:
             "slot": slot,
             "capacity": capacity,
         }
-        return self._final_jitted(slot)(jnp.asarray(lut), partial_flat,
-                                        n_groups)
+        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        with launch_checkpoint():
+            return self._final_jitted(slot)(jnp.asarray(lut),
+                                            partial_flat, n_groups)
 
 
 from spark_rapids_tpu.ops.aggregates import merge_kind as _merge_kind  # noqa: E402
@@ -704,6 +715,13 @@ class DistributedHashJoin:
             stats.update(probe_counts=pcounts, build_counts=bcounts,
                          slots=slots, skewed=skewed)
         self.last_stats = stats
-        return self._jitted(strategy, slots, skewed)(
-            probe_flat, probe_nrows_per_shard,
-            build_flat, build_nrows_per_shard)
+        import contextlib
+        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        # only the shuffle strategy launches an exchange; broadcast is
+        # a bare all-gather with no "shuffle.exchange" checkpoint
+        cp = launch_checkpoint() if strategy == "shuffle" \
+            else contextlib.nullcontext()
+        with cp:
+            return self._jitted(strategy, slots, skewed)(
+                probe_flat, probe_nrows_per_shard,
+                build_flat, build_nrows_per_shard)

@@ -263,12 +263,14 @@ class DistributedSort:
         capacity = int(flat_cols[0][0].shape[0]) // self.nshards
         slot = pick_slot(int(counts.max()), capacity)
         self.last_stats = {"partition_counts": counts, "slot": slot}
-        return self._cached_jit(
-            self._sig + ("final", slot), lambda: _shard_map(
-                partial(self._step_final, slot), mesh=self.mesh,
-                in_specs=(P(), P(), P(self.axis), P(self.axis)),
-                out_specs=P(self.axis), check_vma=False))(
-            spl_vals, spl_valid, flat_cols, nrows_per_shard)
+        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        with launch_checkpoint():
+            return self._cached_jit(
+                self._sig + ("final", slot), lambda: _shard_map(
+                    partial(self._step_final, slot), mesh=self.mesh,
+                    in_specs=(P(), P(), P(self.axis), P(self.axis)),
+                    out_specs=P(self.axis), check_vma=False))(
+                spl_vals, spl_valid, flat_cols, nrows_per_shard)
 
 
 class DistributedTopN:

@@ -17,6 +17,7 @@ skew-free data can pass a smaller slot to cut the padding bandwidth.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -26,16 +27,28 @@ from spark_rapids_tpu.ops.expressions import ColVal
 from spark_rapids_tpu.parallel.partitioning import layout_by_partition
 
 
+@contextmanager
+def launch_checkpoint():
+    """The single host-side checkpoint per exchange-bearing program
+    launch: fires the "shuffle.exchange" injection point exactly once
+    (count-based chaos rules see one checkpoint per launch whether the
+    traced program was cached or not) and runs the host-side launch
+    (trace + dispatch) under a watchdog deadline.  XLA dispatch is
+    asynchronous, so a collective that wedges DURING execution
+    surfaces at the stage's host sync / the whole-query deadline
+    instead — cancellation is cooperative and only host-touching
+    checkpoints can deliver it (robustness/watchdog.py)."""
+    from spark_rapids_tpu.robustness import watchdog
+    from spark_rapids_tpu.robustness.inject import fire
+    with watchdog.section("shuffle.exchange"):
+        fire("shuffle.exchange")
+        yield
+
+
 def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:
     """Slot size for ``exchange`` from a materialized per-destination
     histogram: the true max slice count bucketed up to a power of two
     (<= 2x the ideal bytes on ICI), capped at the full capacity."""
-    # "shuffle.exchange" also fires here: pick_slot runs on the host
-    # once per exchange-bearing program launch (agg/join/sort), so an
-    # armed rule hits even when the traced program is already in the
-    # jit cache and exchange() below never re-enters
-    from spark_rapids_tpu.robustness.inject import fire
-    fire("shuffle.exchange")
     s = floor
     while s < max_slice:
         s <<= 1
@@ -50,14 +63,14 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     Every shard sends row r to shard ``pids[r]``.  Returns (received cols,
     received nrows); received capacity is ``num_parts * slot``.
     Only fixed-width columns (strings must be dictionary-encoded upstream).
+
+    The "shuffle.exchange" injection point does NOT fire here: this
+    body runs at trace time (and not at all on a jit-cache hit), and a
+    launch with several exchanges (shuffle join) would multi-fire.
+    ``launch_checkpoint`` below is the single host-side checkpoint per
+    exchange-bearing program launch — callers invoke it right before
+    dispatching the compiled program.
     """
-    # "shuffle.exchange" fires at trace time: the collective is
-    # compiled into the XLA program, so a failure here surfaces on the
-    # host exactly where a UCX transport failure would have in the
-    # reference — at the stage launch — and the query driver re-drives
-    # (a failed trace caches nothing, so the retry re-enters here)
-    from spark_rapids_tpu.robustness.inject import fire
-    fire("shuffle.exchange")
     capacity = pids.shape[0]
     slot = slot or capacity
     sorted_cols, counts, starts = layout_by_partition(

@@ -9,16 +9,21 @@ section 2.5).  This package centralises the TPU port's answer:
   retryable / degradable / fatal (absorbing ``memory/retry.is_oom``).
 - ``inject``  — named injection points threaded through the I/O,
   shuffle, multi-host sync, spill, and UDF layers, generalizing the
-  ad-hoc ``inject_oom(n)`` test hook.
+  ad-hoc ``inject_oom(n)`` test hook; rules can raise, delay/hang,
+  or corrupt payload bits.
 - ``driver``  — ``QueryRetryDriver``: wraps plan execution with a
   bounded degradation ladder (retry -> spill-retry -> split-batch ->
   single-device replan -> CPU fallback) and records every recovery
   action as a structured event.
+- ``watchdog`` — deadlines over monitored engine sections with a
+  heartbeat from the pipeline worker; overruns become retryable
+  ``TimeoutFault``s delivered at cooperative cancellation
+  checkpoints, so hangs enter the same ladder as exceptions.
 """
 
 from spark_rapids_tpu.robustness.faults import (  # noqa: F401
-    DEGRADABLE, FATAL, RETRYABLE, Fault, HostSyncError, InjectedFault,
-    SpillIOError, classify)
+    DEGRADABLE, FATAL, RETRYABLE, CorruptionFault, Fault,
+    HostSyncError, InjectedFault, SpillIOError, TimeoutFault, classify)
 # NOTE: the ``inject`` submodule is imported as a module (its main
 # entry point is also named ``inject``, which would shadow it here);
 # use ``from spark_rapids_tpu.robustness import inject`` and call

@@ -28,12 +28,17 @@ mask bugs.
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from spark_rapids_tpu.robustness import faults as F
+
+# distinct jitter seeds for unlabeled drivers (see QueryRetryDriver)
+_jitter_seeds = itertools.count(1)
 
 # ladder rungs, in escalation order
 RETRY = "retry"
@@ -102,6 +107,8 @@ class QueryRetryDriver:
     the ladder is exhausted, or a FATAL fault surfaces."""
 
     def __init__(self, session, label: str = ""):
+        import random
+        import zlib
         self.session = session
         self.label = label
         self.trail: List[dict] = []
@@ -110,6 +117,17 @@ class QueryRetryDriver:
         self.enabled = conf.get(rc.QUERY_RECOVERY_ENABLED)
         self.max_retries = conf.get(rc.QUERY_RECOVERY_MAX_RETRIES)
         self.backoff_s = conf.get(rc.QUERY_RECOVERY_BACKOFF_MS) / 1e3
+        self.backoff_cap_s = \
+            conf.get(rc.QUERY_RECOVERY_BACKOFF_CAP_MS) / 1e3
+        # jitter de-synchronizes retry herds (every SPMD controller
+        # re-driving the same preempted step at once).  A labeled
+        # driver seeds from its label so chaos runs replay the exact
+        # same sleep sequence; unlabeled (production) drivers each
+        # draw a distinct seed, else every driver would jitter in
+        # lockstep and the herd would survive
+        seed = zlib.crc32(label.encode()) if label else \
+            (os.getpid() << 20) ^ next(_jitter_seeds)
+        self._rng = random.Random(seed)
 
     # ------------------------------------------------------------ ladder --
     def _ladder(self) -> List[str]:
@@ -122,8 +140,12 @@ class QueryRetryDriver:
     @staticmethod
     def _entry_rung(fault: F.Fault) -> str:
         if fault.severity == F.DEGRADABLE:
-            # identical re-execution is pointless; jump to plan changes
-            return SPLIT_RETRY if fault.kind == "device_oom" \
+            # identical re-execution is pointless; jump to plan
+            # changes.  Spill corruption enters at SPLIT: the dropped
+            # batch's bytes only exist at the source, and a re-planned
+            # attempt re-reads inputs (demoting off the mesh would not)
+            return SPLIT_RETRY \
+                if fault.kind in ("device_oom", "spill_corruption") \
                 else DEMOTE_SINGLE_DEVICE
         if fault.kind == "device_oom":
             # a bare retry without freeing HBM would just OOM again
@@ -210,10 +232,13 @@ class QueryRetryDriver:
                 if rung == SPILL_RETRY:
                     self._spill_device_store()
                 if rung == RETRY and self.backoff_s > 0:
-                    # exponential backoff, capped — chaos tests and
-                    # real preemptions both stay responsive
-                    time.sleep(min(self.backoff_s * (2 ** backoffs),
-                                   2.0))
+                    # exponential backoff, capped (backoffCapMs) and
+                    # jittered into [0.5, 1.0]x — chaos tests and real
+                    # preemptions both stay responsive, and concurrent
+                    # drivers never retry in lockstep
+                    base = min(self.backoff_s * (2 ** backoffs),
+                               self.backoff_cap_s)
+                    time.sleep(base * (0.5 + 0.5 * self._rng.random()))
                     backoffs += 1
 
     @staticmethod

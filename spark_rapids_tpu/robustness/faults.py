@@ -95,6 +95,48 @@ class InjectedWorkerFault(InjectedFault):
     severity = DEGRADABLE
 
 
+class TimeoutFault(Exception):
+    """A watchdog deadline fired: a monitored section (reader decode,
+    shuffle launch, the pipeline worker heartbeat, whole-query wall
+    time) overran its budget and the overrun was delivered at the
+    driving thread's next cooperative checkpoint
+    (robustness/watchdog.py).  Retryable — a hang is the transport/
+    preemption failure mode that doesn't bother to raise, and
+    re-driving the query re-establishes the stuck collective/reader
+    exactly like a preemption retry does."""
+
+    kind = "timeout"
+    severity = RETRYABLE
+
+    def __init__(self, point: str, deadline_ms: float,
+                 elapsed_ms: float):
+        super().__init__(
+            f"watchdog deadline exceeded at {point!r}: "
+            f"{elapsed_ms:.0f}ms elapsed > {deadline_ms:.0f}ms deadline")
+        self.point = point
+        self.deadline_ms = deadline_ms
+        self.elapsed_ms = elapsed_ms
+
+
+class CorruptionFault(Exception):
+    """A spill payload failed checksum verification on restore (or its
+    disk frame no longer decodes).  The corrupt batch is dropped by the
+    raising site before this propagates — wrong bytes must never reach
+    an operator.  Degradable: the stored replica is gone, so only
+    re-running from source (a re-planned attempt re-reads inputs) can
+    produce the data again; retrying the same restore would re-read
+    the same rot."""
+
+    kind = "spill_corruption"
+    severity = DEGRADABLE
+
+    def __init__(self, tier: str, detail: str = ""):
+        super().__init__(
+            f"spill payload corruption detected at {tier} tier"
+            + (f": {detail}" if detail else ""))
+        self.tier = tier
+
+
 class HostSyncError(RuntimeError):
     """Multi-host phase boundary failed: the cross-process stats
     all-gather timed out or the controllers diverged.  Retryable — the
@@ -119,6 +161,10 @@ def classify(exc: BaseException) -> Fault:
     declare themselves; device OOM (via ``memory/retry.is_oom``) next;
     then the engine's own typed failures; unknown -> FATAL."""
     if isinstance(exc, InjectedFault):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, TimeoutFault):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, CorruptionFault):
         return Fault(exc.kind, exc.severity)
     from spark_rapids_tpu.memory.retry import SplitAndRetryOOM, is_oom
     if isinstance(exc, SplitAndRetryOOM):

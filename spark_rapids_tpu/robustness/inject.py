@@ -13,6 +13,16 @@ Rules are thread-scoped by default (a rule armed on the test thread
 never fires in another session's worker thread); points that execute
 on pool threads — the disk spill writers — take ``all_threads=True``.
 
+Beyond the default ``kind="raise"``, rules can **delay/hang**
+(``kind="delay"``: the checkpoint wedges for ``delay_s`` seconds, or
+until the rule is disarmed when ``delay_s`` is None — the hang the
+watchdog must detect; each wedge slice is a cooperative cancellation
+checkpoint, so a tripped deadline aborts the stuck caller exactly like
+the runtime aborting a dead collective) or **corrupt**
+(``kind="corrupt"``: flips one seeded bit in the payload offered at a
+``fire_mutate`` site — the spill-tier restore paths — so checksum
+verification has real rot to catch).
+
 Adding an injection point is two lines: ``register_point(name,
 default_exc)`` here (or at the subsystem's import time), and a
 ``fire(name)`` call at the failure site.  The default exception class
@@ -24,10 +34,14 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Type
 
 from spark_rapids_tpu.robustness import faults as F
+from spark_rapids_tpu.robustness import watchdog as _watchdog
+
+RULE_KINDS = ("raise", "delay", "corrupt")
 
 # known points -> the fault each raises by default.  "memory.oom" is
 # the legacy inject_oom surface; its exception type lives in
@@ -38,6 +52,10 @@ _POINTS: Dict[str, Optional[Type[BaseException]]] = {
     "shuffle.exchange": F.InjectedShuffleFault,
     "dist.host_sync": F.InjectedHostSyncFault,
     "spill.disk": F.InjectedSpillFault,
+    # mutate-capable restore points (memory/spill.py fire_mutate):
+    # corrupt rules flip payload bits here; raise/delay rules also apply
+    "spill.corrupt.host": F.InjectedSpillFault,
+    "spill.corrupt.disk": F.InjectedSpillFault,
     "udf.worker": F.InjectedWorkerFault,
 }
 
@@ -66,11 +84,18 @@ class InjectionRule:
                  probability: Optional[float] = None,
                  seed: Optional[int] = None,
                  exc: Optional[Callable[..., BaseException]] = None,
-                 all_threads: bool = False):
+                 all_threads: bool = False, kind: str = "raise",
+                 delay_s: Optional[float] = None):
         if point not in _POINTS:
             raise KeyError(
                 f"unknown injection point {point!r}; known: "
                 f"{injection_points()} (register_point to add one)")
+        if kind not in RULE_KINDS:
+            raise ValueError(
+                f"unknown rule kind {kind!r}; known: {RULE_KINDS}")
+        self.kind = kind
+        # delay kind: wedge this long; None = hang until disarmed
+        self.delay_s = delay_s
         self.point = point
         self.remaining = int(count)
         self.skip = int(skip)
@@ -126,6 +151,13 @@ def adopt_thread(owner_ident: int) -> None:
 
 def release_thread() -> None:
     _adopted.pop(threading.get_ident(), None)
+
+
+def disown(ident: int) -> None:
+    """Sever ``ident``'s adoption from the outside (a driver
+    abandoning a wedged worker): the zombie must not keep consuming
+    rule budgets armed for the driving thread's next attempt."""
+    _adopted.pop(ident, None)
 # cheap hot-path guard: fire() is threaded through per-batch loops and
 # must cost one attribute read when nothing is armed
 _armed = False
@@ -179,18 +211,90 @@ def injected(point: str, **kw):
         remove(rule)
 
 
-def fire(point: str, note: str = "") -> None:
-    """Checkpoint: raise the armed fault for ``point``, if any.  Called
-    on the engine's hot paths — the unarmed cost is one global read."""
-    if not _armed:
-        return
+def _pick(point: str, mutating: bool) -> Optional[InjectionRule]:
+    """Select-and-consume the next firing rule for ``point``.  Corrupt
+    rules only apply at mutate-capable sites (``fire_mutate``)."""
     with _lock:
         for rule in _rules:
-            if rule.point == point and rule._should_fire():
+            if rule.point != point:
+                continue
+            if rule.kind == "corrupt" and not mutating:
+                continue
+            if rule._should_fire():
                 rule.remaining -= 1
                 rule.fired += 1
-                exc = rule.make_exc(note)
-                break
-        else:
+                return rule
+    return None
+
+
+def _wedge(rule: InjectionRule) -> None:
+    """The delay/hang kind: sleep in slices until the rule's duration
+    elapses or the rule is disarmed (tests un-wedge by removing it).
+    Each slice is a watchdog cancellation checkpoint, so a tripped
+    deadline aborts the stuck caller — the cooperative analog of the
+    runtime tearing down a dead collective with DEADLINE_EXCEEDED."""
+    t_end = None if rule.delay_s is None else \
+        time.monotonic() + rule.delay_s
+    while True:
+        _watchdog.checkpoint()
+        if t_end is not None and time.monotonic() >= t_end:
             return
-    raise exc
+        with _lock:
+            if rule not in _rules:
+                return
+        time.sleep(0.005)
+
+
+def fire(point: str, note: str = "") -> None:
+    """Checkpoint: apply the armed rule for ``point``, if any (raise
+    its fault, or wedge for a delay rule).  Called on the engine's hot
+    paths — the unarmed cost is one global read.  Every fire site is
+    also a watchdog cancellation checkpoint."""
+    _watchdog.checkpoint()
+    if not _armed:
+        return
+    rule = _pick(point, mutating=False)
+    if rule is None:
+        return
+    if rule.kind == "delay":
+        _wedge(rule)
+        return
+    raise rule.make_exc(note)
+
+
+def fire_mutate(point: str, data):
+    """Mutate-capable checkpoint: offered a payload (bytes or a numpy
+    array), a corrupt rule returns a copy with one seeded bit flipped;
+    raise/delay rules behave as at ``fire``.  Returns ``data``
+    unchanged when nothing fires."""
+    _watchdog.checkpoint()
+    if not _armed:
+        return data
+    rule = _pick(point, mutating=True)
+    if rule is None:
+        return data
+    if rule.kind == "delay":
+        _wedge(rule)
+        return data
+    if rule.kind == "corrupt":
+        return _flip_bit(data, rule._rng)
+    raise rule.make_exc("")
+
+
+def _flip_bit(data, rng: random.Random):
+    """One seeded bit flip in a COPY of the payload (the stored
+    original must rot, not the caller's live view — callers pass the
+    stored buffer and adopt the return value)."""
+    import numpy as np
+    if isinstance(data, (bytes, bytearray)):
+        if not data:
+            return data
+        arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()
+        arr[rng.randrange(arr.size)] ^= 1 << rng.randrange(8)
+        return arr.tobytes()
+    out = np.ascontiguousarray(data).copy()
+    flat = out.view(np.uint8).reshape(-1)
+    if not flat.size:
+        return data
+    flat[rng.randrange(flat.size)] ^= 1 << rng.randrange(8)
+    return out

@@ -36,6 +36,12 @@ class QueryInfo:
     # query-level recovery ladder actions (robustness/driver.py
     # RecoveryAction events stamped with this query's id)
     recovery: List[Dict[str, str]] = field(default_factory=list)
+    # watchdog trips/cancellations (robustness/watchdog.py
+    # WatchdogTrip / WatchdogCancel events; "kind" is trip|cancel)
+    watchdog: List[Dict[str, str]] = field(default_factory=list)
+    # spill-integrity checksum failures (memory/spill.py
+    # SpillCorruption events: tier, bufId, detail)
+    corruption: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -63,6 +69,9 @@ class AppInfo:
     # recovery actions not attributable to a query (no qid yet when
     # the attempt died before its QueryStart)
     recovery: List[Dict[str, str]] = field(default_factory=list)
+    # un-attributed watchdog / corruption events (same reason)
+    watchdog: List[Dict[str, str]] = field(default_factory=list)
+    corruption: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def total_duration_ms(self) -> float:
@@ -110,6 +119,21 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.recovery if q is not None
                  else app.recovery).append(info)
+            elif ev in ("WatchdogTrip", "WatchdogCancel"):
+                info = {k: rec[k] for k in
+                        ("point", "deadlineMs", "elapsedMs",
+                         "overrunMs") if k in rec}
+                info["kind"] = "trip" if ev == "WatchdogTrip" \
+                    else "cancel"
+                q = all_queries.get(rec.get("queryId"))
+                (q.watchdog if q is not None
+                 else app.watchdog).append(info)
+            elif ev == "SpillCorruption":
+                info = {k: rec[k] for k in ("tier", "bufId", "detail")
+                        if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                (q.corruption if q is not None
+                 else app.corruption).append(info)
             elif ev == "QueryEnd":
                 q = open_queries.pop(rec["queryId"],
                                      QueryInfo(rec["queryId"]))

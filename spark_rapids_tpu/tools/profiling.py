@@ -133,11 +133,49 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     f"{a.session_id} query {q.query_id}: recovery "
                     f"action {r.get('action')} after "
                     f"{r.get('fault')} fault")
+            problems.extend(_watchdog_problems(
+                f"{a.session_id} query {q.query_id}", q.watchdog))
+            problems.extend(_corruption_problems(
+                f"{a.session_id} query {q.query_id}", q.corruption))
         for r in a.recovery:
             problems.append(
                 f"{a.session_id}: recovery action {r.get('action')} "
                 f"after {r.get('fault')} fault")
+        problems.extend(_watchdog_problems(a.session_id, a.watchdog))
+        problems.extend(_corruption_problems(a.session_id,
+                                             a.corruption))
     return problems
+
+
+def _watchdog_problems(who: str, events: List[dict]) -> List[str]:
+    """Hang-detection lines: per-point trips with deadline margin, and
+    delivered cancellations."""
+    out = []
+    for w in events:
+        point = w.get("point", "?")
+        if w.get("kind") == "trip":
+            out.append(
+                f"{who}: hang detected at {point} — exceeded its "
+                f"{w.get('deadlineMs', 0):.0f}ms deadline by "
+                f"{w.get('overrunMs', 0):.0f}ms")
+        else:
+            out.append(
+                f"{who}: watchdog cancellation delivered for {point} "
+                f"({w.get('elapsedMs', 0):.0f}ms elapsed) — query "
+                "re-driven by the recovery ladder")
+    return out
+
+
+def _corruption_problems(who: str, events: List[dict]) -> List[str]:
+    out = []
+    if events:
+        tiers = sorted({c.get("tier", "?") for c in events})
+        out.append(
+            f"{who}: {len(events)} spill payload(s) failed checksum "
+            f"verification (tier {', '.join(tiers)}) — batches "
+            "dropped and re-run from source; check spill storage "
+            "health")
+    return out
 
 
 def plan_dot(q: QueryInfo) -> str:

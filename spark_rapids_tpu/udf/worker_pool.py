@@ -133,11 +133,38 @@ def eval_rows(fn, rows: List[tuple], num_workers: int,
     chunk = max(min_rows_per_worker, -(-len(rows) // num_workers))
     futures = [pool.submit(_eval_chunk, fn_bytes, rows[i:i + chunk])
                for i in range(0, len(rows), chunk)]
+    import concurrent.futures as cf
     from concurrent.futures.process import BrokenProcessPool
+    from spark_rapids_tpu.robustness import watchdog
+
+    def _result(f):
+        # poll instead of a bare f.result(): the result wait is the
+        # driving thread's cancellation checkpoint, so a worker
+        # process stuck in user code trips the deadline and the
+        # TimeoutFault is actually deliverable HERE (a blocked
+        # result() could never observe it).  cf.wait (not
+        # result(timeout=...)) so a UDF that itself raised
+        # TimeoutError is re-raised, not mistaken for "still running"
+        # (on 3.11+ cf.TimeoutError IS the builtin TimeoutError)
+        while not f.done():
+            watchdog.checkpoint()
+            cf.wait([f], timeout=0.05)
+        return f.result()
+
     try:
-        out: list = []
-        for f in futures:
-            out.extend(f.result())
+        # "udf.worker" section: a stuck worker process (or a dead pool
+        # that never errors) trips the deadline; the TimeoutFault
+        # re-drives the query, whose retry re-evaluates — rows are
+        # pure per the UDF contract used by the pool path.  Heartbeat
+        # per completed chunk: the deadline measures silence, so a
+        # merely SLOW multi-chunk stage that keeps finishing futures
+        # never trips while a wedged worker does.
+        with watchdog.section("udf.worker") as sect:
+            out: list = []
+            for f in futures:
+                out.extend(_result(f))
+                if sect is not None:
+                    sect.beat()
         return out
     except WorkerUnpicklable:
         # pickled fine by reference but the worker cannot reconstruct

@@ -56,6 +56,10 @@ class EventLogger:
         rec.update(fields)
         line = json.dumps(rec, default=str)
         with self._lock:
+            # re-check under the lock: the watchdog monitor thread may
+            # emit concurrently with a close() on the session thread
+            if self._fh is None:
+                return
             self._fh.write(line + "\n")
             self._fh.flush()
 
@@ -63,9 +67,30 @@ class EventLogger:
         if self._fh is not None:
             import atexit
             self.emit("SessionEnd")
-            self._fh.close()
-            self._fh = None
+            with self._lock:
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
             try:  # release the atexit pin so the logger can be GC'd
                 atexit.unregister(self.close)
             except Exception:
                 pass
+
+
+def emit_on_session(event: str, session=None, **fields: Any) -> None:
+    """Emit ``event`` on the given (or active) session's event log,
+    stamped with the in-flight query id.  No-op without an enabled
+    logger.  The one shared resolver for subsystems that emit from
+    arbitrary threads (the watchdog monitor, spill integrity) — keeps
+    the session lookup / torn-interpreter guard in one place."""
+    if session is None:
+        try:
+            from spark_rapids_tpu.api.session import TpuSession
+            session = TpuSession._active
+        except ImportError:  # torn-down interpreter only
+            return
+    ev = getattr(session, "events", None) if session is not None else None
+    if ev is not None and ev.enabled:
+        fields.setdefault("queryId",
+                          getattr(session, "_current_qid", None))
+        ev.emit(event, **fields)

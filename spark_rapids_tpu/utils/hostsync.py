@@ -63,6 +63,12 @@ class HostSyncMetrics:
         with self._lock:
             self._owner.pop(threading.get_ident(), None)
 
+    def disown(self, ident: int) -> None:
+        """Sever ``ident``'s adoption from the outside (a driver
+        abandoning a wedged worker thread)."""
+        with self._lock:
+            self._owner.pop(ident, None)
+
     def reset(self) -> None:
         with self._lock:
             self.sync_count = 0
@@ -73,7 +79,13 @@ host_sync_metrics = HostSyncMetrics()
 
 
 def count_sync(n: int = 1) -> None:
-    """Record ``n`` device->host synchronizations."""
+    """Record ``n`` device->host synchronizations.  Every counted sync
+    is also a watchdog cancellation checkpoint — host syncs are the
+    places the driving thread provably touches the host, so a tripped
+    deadline surfaces here rather than after minutes of dead pipeline.
+    """
+    from spark_rapids_tpu.robustness import watchdog
+    watchdog.checkpoint()
     host_sync_metrics.bump(n)
 
 
@@ -112,6 +124,8 @@ def fetch(*buffers):
     order (a single buffer returns the bare array).
     """
     import jax
+    from spark_rapids_tpu.robustness import watchdog
+    watchdog.checkpoint()
     host_sync_metrics.bump(1)
     got = jax.device_get(list(buffers))
     return got[0] if len(buffers) == 1 else got
@@ -120,7 +134,9 @@ def fetch(*buffers):
 def fetch_all(buffers: Sequence):
     """List form of :func:`fetch` (always returns a list)."""
     import jax
+    from spark_rapids_tpu.robustness import watchdog
     if not buffers:
         return []
+    watchdog.checkpoint()
     host_sync_metrics.bump(1)
     return jax.device_get(list(buffers))

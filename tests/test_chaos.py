@@ -180,6 +180,28 @@ def test_shuffle_fault_recovers_distributed(mesh_session, data):
                                   check_dtype=False)
 
 
+def test_shuffle_exchange_fires_once_per_launch(mesh_session, data):
+    # regression: pick_slot() and exchange() used to BOTH fire
+    # "shuffle.exchange", so count-based rules triggered at half the
+    # configured count on the uncached path.  With exactly one
+    # host-side checkpoint per exchange launch, a skip=1 rule must be
+    # fully consumed by one clean launch and never raise...
+    s = mesh_session
+    df = _mesh_agg(s, data)
+    s.recovery_log.clear()
+    with I.injected("shuffle.exchange", count=1, skip=1) as rule:
+        df.to_pandas()
+        assert rule.fired == 0
+        assert rule.skip == 0  # the single launch consumed the skip
+        assert _actions(s) == []
+        # ...and the SECOND launch (jit-cached program — the fire is
+        # host-side, not trace-time) must fire exactly once
+        df.to_pandas()
+        assert rule.fired == 1
+    assert _actions(s) == ["retry"]
+    assert _faults(s) == ["shuffle"]
+
+
 def test_host_sync_fault_demotes_to_single_device(mesh_session, data):
     s = mesh_session
     df = _mesh_agg(s, data, extra_count=True)
