@@ -341,7 +341,18 @@ def child_main() -> None:
 
     best_file = os.environ.get("BENCH_BEST_FILE")
     best = {"metric": "tpch_q6_rows_per_sec", "value": 0, "unit": "rows/s",
-            "vs_baseline": 0.0}
+            "vs_baseline": 0.0,
+            # shuffle-wire attribution (parallel/shuffle.py): stays 0
+            # for single-device runs; on a mesh the padding ratio is
+            # the fused packed exchange's headline diagnostic
+            "shuffle_bytes_moved": 0, "shuffle_padding_ratio": 0.0}
+
+    def wire_fields(session):
+        from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+        w = metrics_for_session(session).snapshot()
+        best["shuffle_bytes_moved"] = w["bytesMoved"]
+        best["shuffle_padding_ratio"] = round(
+            w["rowsMoved"] / max(w["rowsUseful"], 1), 3)
 
     def save():
         if best_file:
@@ -446,6 +457,7 @@ def child_main() -> None:
         except Exception as e:
             log(f"child: n=2^{shift} failed: {e!r}")
             break
+    wire_fields(session)
     save()
 
 

@@ -16,7 +16,7 @@ python -m pytest tests/ -q --maxfail=20 -m 'not chaos'
 echo "== chaos suite (fault injection + recovery ladder + hang/corruption spray) =="
 bash ci/chaos.sh
 
-echo "== perf smoke (deterministic host-sync budgets, no timing) =="
+echo "== perf smoke (deterministic budgets: host-sync counts + shuffle collective-count — packed q3-shape exchange <= 3 all_to_all vs >= 8 unpacked; no timing) =="
 python -m pytest tests/ -q -m perf --maxfail=5
 
 echo "== docgen drift check =="

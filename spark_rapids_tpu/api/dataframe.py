@@ -617,10 +617,27 @@ class DataFrame:
             # the identical plan that just failed
             from spark_rapids_tpu.parallel.dist_planner import (
                 try_distributed)
+            from spark_rapids_tpu.parallel.shuffle import (
+                ShuffleWireMetrics, metrics_for_session)
             events = getattr(self.session, "events", None)
             t0 = _time.perf_counter()
+            wire = metrics_for_session(self.session)
+            wire0 = wire.snapshot()
             dist = try_distributed(self.session, self.plan)
             if dist is not None:
+                # per-query shuffle-wire delta: collectives launched,
+                # bytes moved, padding ratio, overflow retries —
+                # QueryInfo.shuffle in the eventlog tools, flagged by
+                # the profiling health check when padding > 4x or an
+                # exchange fell back to per-column collectives
+                shuffle = ShuffleWireMetrics.summarize(
+                    ShuffleWireMetrics.delta(wire.snapshot(), wire0))
+                # session attribute contract: None when the query never
+                # exchanged (a distributed scan/filter); the event log
+                # still gets the (zeros) dict so every distributed
+                # query's QueryInfo.shuffle is present
+                self.session.last_shuffle_stats = \
+                    shuffle if shuffle.get("exchanges") else None
                 if events is not None and events.enabled:
                     # full query envelope for distributed runs so the
                     # event log keeps per-query attribution (the
@@ -637,7 +654,7 @@ class DataFrame:
                         durationMs=round(
                             (_time.perf_counter() - t0) * 1e3, 3),
                         metrics={}, spill={}, retry={},
-                        distributed=True)
+                        distributed=True, shuffle=shuffle)
                 return dist
         overrides = None
         if mode.batch_scale != 1.0:

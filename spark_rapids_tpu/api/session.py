@@ -67,6 +67,11 @@ class TpuSession:
         self.last_dist_explain = ""
         self.last_scan_stats = None  # set by the sharded distributed scan
         self.last_pipeline_stats = None  # exec/pipeline.py PipelineStats
+        # per-query shuffle-wire summary (parallel/shuffle.py
+        # ShuffleWireMetrics.summarize): collectives, bytes moved,
+        # padding ratio, slot-overflow retries of the last distributed
+        # query; None when the query never exchanged
+        self.last_shuffle_stats = None
         self.last_planning_error = None  # set by suppressPlanningFailure
         self.mesh = mesh
         if self.mesh is None:

@@ -22,10 +22,18 @@ Schema = Sequence[Tuple[str, DataType]]
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "_row_count")
+    __slots__ = ("columns", "_row_count", "transient_wire_bytes")
 
     def __init__(self, columns: Dict[str, Column], nrows=None):
         self.columns: Dict[str, Column] = dict(columns)
+        # transient headroom a shuffle-received batch still pins in HBM
+        # beyond its own columns: the packed exchange's lane payloads
+        # live until the next program launch reuses their buffers, so
+        # spill registration (memory/spill.py) counts this against the
+        # DEVICE budget while the batch is device-resident.  Consumed
+        # once — the first downstream materialization (pipeline /
+        # coalesce) zeroes it.
+        self.transient_wire_bytes: int = 0
         if nrows is None:
             if not columns:
                 raise ValueError("empty batch needs explicit nrows")

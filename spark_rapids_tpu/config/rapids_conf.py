@@ -316,6 +316,39 @@ SHUFFLE_TRANSPORT_ENABLED = conf(
     "mesh (the UCX-transport analog, reference RapidsConf.scala:986); "
     "otherwise serialize through the host shuffle store.", _to_bool)
 
+SHUFFLE_PACKED_ENABLED = conf(
+    "spark.rapids.tpu.shuffle.packed.enabled", True,
+    "Fused packed shuffle wire format: byte-reinterpret all fixed-width "
+    "columns of an exchange into width-homogeneous lane payloads (uint32 "
+    "lanes for 4/8-byte columns, uint8 lanes for bool/small ints, "
+    "validity masks bit-packed eight to a lane) and move each payload "
+    "with ONE all_to_all — O(distinct widths) <= 2 collectives per "
+    "exchange instead of O(columns + masks). False restores per-column "
+    "collectives (the A/B baseline, and the automatic fallback for "
+    "exchanges carrying unpackable columns). See docs/performance.md "
+    "\"Shuffle wire format\".", _to_bool)
+
+SHUFFLE_SLOT_MODE = conf(
+    "spark.rapids.tpu.shuffle.slot.mode", "adaptive",
+    "All-to-all slot (padding) sizing per exchange site: 'adaptive' "
+    "smooths the power-of-two slot with a per-site EMA of observed max "
+    "slices (stable slots keep jit-cache keys stable) and lets warm "
+    "sites launch speculatively without the stats hostsync — a slot "
+    "overflow re-runs the launch at full capacity and records a "
+    "degradable recovery action instead of dropping rows; 'fixed' sizes "
+    "every launch from its own histogram only; 'capacity' restores "
+    "full-capacity padding (always correct, numShards x the useful "
+    "bytes on ICI).", str,
+    lambda v: None if v in ("adaptive", "fixed", "capacity") else
+    "must be adaptive, fixed or capacity")
+
+SHUFFLE_SLOT_OVERFLOW_GROWTH = conf(
+    "spark.rapids.tpu.shuffle.slot.overflowGrowth", 2.0,
+    "Multiplier applied to an exchange site's slot EMA after a "
+    "speculative-slot overflow, so the next stats-sized launch carries "
+    "headroom above the slice that overflowed.", _to_float,
+    lambda v: None if v >= 1.0 else "must be >= 1.0")
+
 _READER_TYPES = ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
 
 

@@ -195,6 +195,15 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
                                 beat.beat()
                             if stop.is_set():
                                 break
+                            # registration charges the DEVICE budget
+                            # with the batch PLUS any transient wire
+                            # reservation (a shuffle-received batch's
+                            # packed exchange payload,
+                            # memory/spill.py SpillableHandle), so
+                            # depth x footprint backpressure can't
+                            # undercount mid-exchange; the handle
+                            # consumes the reservation, releasing it
+                            # when the batch leaves DEVICE
                             handle = catalog.register(
                                 batch, ACTIVE_ON_DECK_PRIORITY)
                             while not stop.is_set():

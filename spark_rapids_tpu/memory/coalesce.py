@@ -57,7 +57,12 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch], goal: CoalesceGoal,
             # handles lazy counts natively
             if batch.row_count.is_concrete and batch.nrows == 0:
                 continue
-            size = batch.device_size_bytes()
+            # a shuffle-received batch still pins its packed exchange
+            # payload (ColumnarBatch.transient_wire_bytes) — the goal
+            # accounting must see the true HBM footprint or a long
+            # accumulation right after an exchange undercounts by ~2x
+            size = batch.device_size_bytes() + \
+                int(getattr(batch, "transient_wire_bytes", 0) or 0)
             if target is not None and pending and \
                     pending_bytes + size > target:
                 out = flush()

@@ -108,6 +108,14 @@ class SpillableHandle:
         self.priority = priority
         self.tier = DEVICE
         self.size_bytes = batch.device_size_bytes()
+        # transient shuffle-wire reservation (ColumnarBatch
+        # .transient_wire_bytes): a just-received exchange batch still
+        # pins its packed lane payloads in HBM, so backpressure must
+        # see the larger footprint while the batch sits at DEVICE.  The
+        # payload is never spilled — it dies with the exchange program
+        # — so leaving DEVICE releases the reservation for good.
+        self.wire_bytes = int(
+            getattr(batch, "transient_wire_bytes", 0) or 0)
         self.last_access = 0
         self._device: Optional[ColumnarBatch] = batch
         self._host: Optional[dict] = None
@@ -175,6 +183,9 @@ class SpillableHandle:
         return ColumnarBatch(cols, self.nrows)
 
     def spill_to_host(self) -> int:
+        """Demote to HOST; returns the DEVICE bytes released (the batch
+        plus any transient wire reservation — the wire headroom never
+        follows the batch to the host tier)."""
         assert self.tier == DEVICE
         self._host = self._to_host_payload()
         if self.catalog.integrity_check:
@@ -184,7 +195,9 @@ class SpillableHandle:
                                                     self.nrows)
         self._device = None
         self.tier = HOST
-        return self.size_bytes
+        released = self.size_bytes + self.wire_bytes
+        self.wire_bytes = 0
+        return released
 
     def spill_to_disk(self) -> int:
         assert self.tier == HOST
@@ -397,7 +410,12 @@ class SpillableBatchCatalog:
         with self._lock:
             self._handles[h.id] = h
             self._issued_ids.add(h.id)
-            self.device_bytes += h.size_bytes
+            self.device_bytes += h.size_bytes + h.wire_bytes
+        # the wire reservation is consumed by registration: a later
+        # re-registration of the same batch (coalesce after pipeline)
+        # must not re-reserve the exchange payload headroom
+        if h.wire_bytes:
+            batch.transient_wire_bytes = 0
         self.ensure_budget()
         return h
 
@@ -424,7 +442,7 @@ class SpillableBatchCatalog:
                 return
             del self._handles[h.id]
             if h.tier == DEVICE:
-                self.device_bytes -= h.size_bytes
+                self.device_bytes -= h.size_bytes + h.wire_bytes
             elif h.tier == HOST:
                 self.host_bytes -= h.size_bytes
             else:
@@ -449,10 +467,12 @@ class SpillableBatchCatalog:
             for h in candidates:
                 if used <= budget:
                     break
+                # freed = batch + wire reservation (device side); only
+                # the batch payload itself lands on the host tier
                 freed = h.spill_to_host()
                 self.device_bytes -= freed
-                self.host_bytes += freed
-                self.spilled_to_host_total += freed
+                self.host_bytes += h.size_bytes
+                self.spilled_to_host_total += h.size_bytes
                 used -= freed
             if self.host_bytes > self.host_budget:
                 self._spill_tier(HOST, self.host_budget)

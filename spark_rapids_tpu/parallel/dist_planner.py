@@ -711,6 +711,12 @@ class DistPlanner:
         self.session = session
         self.mesh = mesh
         self.conf = session.conf
+        # wire-bytes watermark for this query: collect() stamps the
+        # output batch with the exchange payload footprint recorded
+        # between here and the final materialization (the transient-2x
+        # HBM accounting, memory/spill.py SpillableHandle.wire_bytes)
+        from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+        self._wire0 = metrics_for_session(session).snapshot()
 
     def _emit_stats(self, op: str, stats, **extra) -> None:
         ev = getattr(self.session, "events", None)
@@ -1599,7 +1605,29 @@ class DistPlanner:
                 out[name] = Column.from_numpy(
                     vs.astype(storage, copy=False), dtype=dt,
                     validity=None if bool(ms.all()) else ms)
-        return ColumnarBatch(out, total)
+        batch = ColumnarBatch(out, total)
+        # per-device share of the LAST exchange's payload bytes: the
+        # ShardedFrame's device arrays (and the exchange lane buffers
+        # backing them) stay alive until this result drops, so a
+        # consumer that spill-registers the batch (pipeline / coalesce)
+        # reserves that headroom.  Today the distributed result is
+        # usually consumed straight by collect/to_arrow — the
+        # reservation engages when the batch re-enters the engine (an
+        # InMemoryRelation scan of a distributed result) and is the
+        # wiring point for a future device-resident handoff that skips
+        # the host round trip entirely.  Only when this query exchanged
+        # at all (delta guard) — and only the most recent launch's
+        # payload, never the query's cumulative bytes (earlier
+        # exchanges' buffers are already reused; summing them would
+        # overstate the reservation and trigger spurious spills).
+        from spark_rapids_tpu.parallel.shuffle import (
+            ShuffleWireMetrics, metrics_for_session)
+        m = metrics_for_session(self.session)
+        delta = ShuffleWireMetrics.delta(m.snapshot(), self._wire0)
+        if delta.get("exchanges", 0):
+            batch.transient_wire_bytes = \
+                m.last_exchange_bytes // max(self.mesh.devices.size, 1)
+        return batch
 
 
 def try_distributed(session, plan: L.LogicalPlan):

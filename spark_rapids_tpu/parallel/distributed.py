@@ -52,7 +52,13 @@ def _host_sync_body(x):
     import numpy as np
     from spark_rapids_tpu.robustness.faults import HostSyncError
     from spark_rapids_tpu.robustness.inject import fire
+    from spark_rapids_tpu.utils.hostsync import count_sync
     fire("dist.host_sync")
+    # the phase boundary is a device->host round trip like any other:
+    # count it so per-site sync budgets (the adaptive slot planner's
+    # "<= 1 hostsync per exchange site") are assertable via the same
+    # host_sync_count attribution as the pipeline's deferred syncs
+    count_sync()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         try:
@@ -112,7 +118,11 @@ class DistributedAggregate:
             self._buf_specs.extend(specs)
 
         from spark_rapids_tpu.ops.jit_cache import cached_jit
+        from spark_rapids_tpu.parallel.shuffle import packed_enabled
         self._cached_jit = cached_jit
+        # resolved at construction and baked into the jit signature: a
+        # packed.enabled flip must retrace, never hit a stale cache
+        self.packed = packed_enabled()
         self._sig = ("dist_agg", tuple(self.mesh.axis_names),
                      tuple(self.mesh.devices.shape),
                      tuple(str(d) for d in self.mesh.devices.flat),
@@ -120,7 +130,8 @@ class DistributedAggregate:
                      tuple(e.cache_key() for e in self.group_exprs),
                      tuple(f.cache_key() for f in self.funcs),
                      self.filter_cond.cache_key()
-                     if self.filter_cond is not None else None)
+                     if self.filter_cond is not None else None,
+                     ("packed", self.packed))
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
             self._sig + ("keyless",), lambda: _shard_map(
@@ -197,7 +208,10 @@ class DistributedAggregate:
     def _step_final(self, slot, lut, partial_flat, n_groups_arr):
         """Phase 2: exchange partials with the stats-sized slot (bucket
         -> shard assignment rides in as the traced ``lut``), then the
-        final merge + finalize on the receiving shard."""
+        final merge + finalize on the receiving shard.  The trailing
+        output leaf is the per-shard slot-overflow flag — nonzero when
+        a speculative (EMA-predicted) slot was too small and the launch
+        must be re-run (rows would otherwise be dropped)."""
         n_groups = n_groups_arr[0]
         nkeys = len(self.group_exprs)
         dtypes = [e.dtype for e in self.group_exprs] + \
@@ -206,8 +220,10 @@ class DistributedAggregate:
                 for dt, (v, val) in zip(dtypes, partial_flat)]
         pkeys, pbufs = cols[:nkeys], cols[nkeys:]
         pids = lut[hash_partition_ids(pkeys, self.buckets)]
-        recv, recv_n = exchange(list(pkeys) + list(pbufs), pids, n_groups,
-                                self.axis, self.nshards, slot=slot)
+        recv, recv_n, overflow = exchange(
+            list(pkeys) + list(pbufs), pids, n_groups, self.axis,
+            self.nshards, slot=slot, packed=self.packed,
+            with_overflow=True, report_site=self._sig + ("final",))
         rkeys = recv[:nkeys]
         rbufs = recv[nkeys:]
         merge_inputs = [(_merge_kind(s.kind), c)
@@ -218,7 +234,8 @@ class DistributedAggregate:
                    for f, sl in zip(self.funcs, self._buf_slices)]
         outs = list(fkeys) + list(results)
         n_out = jnp.reshape(fn_groups, (1,))
-        return tuple((o.values, _v(o), n_out) for o in outs)
+        return tuple((o.values, _v(o), n_out) for o in outs) + \
+            (jnp.reshape(overflow.astype(jnp.int32), (1,)),)
 
     def _merge_grand_totals(self, outs: List[ColVal]) -> List[ColVal]:
         """psum/pmin/pmax the single-row locals across the mesh."""
@@ -277,38 +294,126 @@ class DistributedAggregate:
                 in_specs=(P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
+    def _wire_dtypes(self):
+        return [e.dtype for e in self.group_exprs] + \
+            [s.dtype for s in self._buf_specs]
+
     def __call__(self, flat_cols, nrows_per_shard):
         """flat_cols: [(values, validity, offsets)] with leading dim
         nshards*capacity; nrows_per_shard: int32[nshards].
 
-        Adaptive in two compiled phases: the local phase materializes the
-        per-destination histogram, the host sizes the all-to-all slot
-        from the TRUE max slice count (power-of-two bucketed, so at most
-        2x the ideal bytes ride ICI instead of the old full-capacity
-        padding = nshards x ideal), and the exchange phase runs with that
-        static slot."""
+        Adaptive in two compiled phases: the local phase materializes
+        the per-destination histogram; the host sizes the all-to-all
+        slot through the session's SlotPlanner (power-of-two bucketed
+        from the TRUE max slice, EMA-smoothed so the jit-cache key is
+        sticky) and the exchange phase runs with that static slot.
+        Warm adaptive sites skip the stats hostsync entirely and launch
+        speculatively with the cached slot + bucket LUT, verifying a
+        per-shard overflow flag afterwards — an overflow re-runs the
+        launch at full capacity (rows are never dropped) and records a
+        degradable recovery action.  Either way the exchange site pays
+        at most ONE budgeted hostsync per launch."""
         import numpy as np
+        from spark_rapids_tpu.parallel.shuffle import (
+            launch_checkpoint, metrics_for_session, planner_for_session,
+            record_exchange_metrics)
         if not self.group_exprs:
             self.last_stats = {"keyless": True}
             return self._jitted_keyless(flat_cols, nrows_per_shard)
         partial_flat, n_groups, hist = self._jitted_local(
             flat_cols, nrows_per_shard)
-        from spark_rapids_tpu.parallel.shuffle import pick_slot
-        counts = host_sync(hist).reshape(self.nshards, self.buckets)
         capacity = int(partial_flat[0][0].shape[0]) // self.nshards
-        lut, dst_counts = coalesce_buckets(counts, self.nshards)
-        slot = pick_slot(int(dst_counts.max()), capacity)
-        self.last_stats = {
-            "bucket_counts": counts,     # [src_shard, bucket]
-            "bucket_map": lut,           # bucket -> dst shard
-            "partition_counts": dst_counts,  # [src_shard, dst_shard]
-            "slot": slot,
-            "capacity": capacity,
-        }
-        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        planner = planner_for_session()
+        metrics = metrics_for_session()
+        site = self._sig
+
+        spec = planner.speculative(site, capacity)
+        if spec is not None and "lut" in spec and \
+                len(spec["lut"]) == self.buckets:
+            outs = self._launch_speculative(site, spec, partial_flat,
+                                            n_groups, capacity, planner,
+                                            metrics)
+        else:
+            counts = host_sync(hist).reshape(self.nshards, self.buckets)
+            lut, dst_counts = coalesce_buckets(counts, self.nshards)
+            max_slice = int(dst_counts.max())
+            rows = int(dst_counts.sum())
+            slot = planner.plan(site, max_slice, capacity)
+            planner.observe(site, max_slice, slot, capacity, lut=lut,
+                            rows=rows)
+            self.last_stats = {
+                "bucket_counts": counts,     # [src_shard, bucket]
+                "bucket_map": lut,           # bucket -> dst shard
+                "partition_counts": dst_counts,  # [src, dst_shard]
+                "slot": slot,
+                "capacity": capacity,
+                "packed": self.packed,
+            }
+            with launch_checkpoint():
+                raw = self._final_jitted(slot)(jnp.asarray(lut),
+                                               partial_flat, n_groups)
+            outs = raw[:-1]  # drop the overflow flag (slot >= max_slice)
+            record_exchange_metrics(
+                metrics, dtypes=self._wire_dtypes(), slot=slot,
+                num_parts=self.nshards, nshards=self.nshards,
+                rows_useful=rows, packed=self.packed,
+                site=self._sig + ("final",))
+        self.last_stats["wire"] = metrics.snapshot()
+        return outs
+
+    def _launch_speculative(self, site, spec, partial_flat, n_groups,
+                            capacity, planner, metrics):
+        """Steady-state launch: cached slot + bucket LUT, no stats
+        hostsync; the post-launch overflow check is the site's single
+        budgeted sync.  Overflow re-runs at full capacity and records a
+        degradable recovery action — never dropped rows."""
+        import numpy as np
+        from spark_rapids_tpu.parallel.shuffle import (
+            launch_checkpoint, record_exchange_metrics)
+        slot, lut = spec["slot"], spec["lut"]
+        self.last_stats = {"slot": slot, "capacity": capacity,
+                           "speculative": True, "packed": self.packed}
         with launch_checkpoint():
-            return self._final_jitted(slot)(jnp.asarray(lut),
-                                            partial_flat, n_groups)
+            raw = self._final_jitted(slot)(jnp.asarray(lut),
+                                           partial_flat, n_groups)
+        outs, ovf = raw[:-1], raw[-1]
+        record_exchange_metrics(
+            metrics, dtypes=self._wire_dtypes(), slot=slot,
+            num_parts=self.nshards, nshards=self.nshards,
+            rows_useful=spec.get("rows", 0), packed=self.packed,
+            site=self._sig + ("final",))
+        # the overflow check IS this launch's phase boundary: route it
+        # through host_sync so (a) multi-process controllers all see
+        # the same flags and make the identical rerun decision, (b) a
+        # dead peer surfaces here under the dist.host_sync watchdog
+        # deadline, and (c) chaos rules armed on the phase boundary
+        # keep firing on warm (speculative) sites — at most ONE counted
+        # hostsync per exchange site per launch either way
+        if not bool(np.asarray(host_sync(ovf)).any()):
+            return outs
+        # slot overflow: the EMA prediction was too small for this
+        # launch's skew.  Re-run at full capacity (always correct) and
+        # surface the event on the recovery trail as a locally-handled
+        # degradable fault; the planner grows the site's EMA and forces
+        # the next launch back onto the stats-sized path.
+        planner.observe_overflow(site)
+        metrics.record_overflow()
+        from spark_rapids_tpu.api.session import TpuSession
+        from spark_rapids_tpu.robustness.driver import record_degradation
+        from spark_rapids_tpu.robustness.faults import ShuffleSlotOverflow
+        err = ShuffleSlotOverflow("aggregate", slot, capacity)
+        record_degradation(TpuSession._active, err.kind,
+                           "shuffle-slot-capacity-rerun", str(err))
+        self.last_stats["overflow"] = True
+        with launch_checkpoint():
+            raw = self._final_jitted(capacity)(jnp.asarray(lut),
+                                               partial_flat, n_groups)
+        record_exchange_metrics(
+            metrics, dtypes=self._wire_dtypes(), slot=capacity,
+            num_parts=self.nshards, nshards=self.nshards,
+            rows_useful=spec.get("rows", 0), packed=self.packed,
+            site=self._sig + ("final",))
+        return raw[:-1]
 
 
 from spark_rapids_tpu.ops.aggregates import merge_kind as _merge_kind  # noqa: E402
@@ -447,13 +552,15 @@ class DistributedHashJoin:
         self.skew_factor = skew_factor
         self.skew_min_rows = skew_min_rows
         self._cached_jit = cached_jit
+        from spark_rapids_tpu.parallel.shuffle import packed_enabled
+        self.packed = packed_enabled()
         self._sig = ("dist_join", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
                      tuple(str(d) for d in mesh.devices.flat),
                      tuple(dt.name for dt in self.probe_dtypes),
                      tuple(dt.name for dt in self.build_dtypes),
                      tuple(self.probe_key_idx), tuple(self.build_key_idx),
-                     join_type, out_factor)
+                     join_type, out_factor, ("packed", self.packed))
         self.last_stats: Optional[dict] = None
 
     def _jitted(self, strategy: str, slots, skewed=()):
@@ -520,7 +627,10 @@ class DistributedHashJoin:
         in_probe_cap = probe[0].values.shape[0]
 
         if strategy == "broadcast":
-            build, bn = all_gather_cols(build, bn, self.axis, self.nshards)
+            build, bn = all_gather_cols(build, bn, self.axis, self.nshards,
+                                        packed=self.packed,
+                                        report_site=self._sig
+                                        + ("bcast",))
         else:
             pkeys = [probe[i] for i in self.probe_key_idx]
             bkeys = [build[i] for i in self.build_key_idx]
@@ -548,12 +658,16 @@ class DistributedHashJoin:
                 sk_cols, n_sk = selection.compact(
                     build, jnp.logical_and(live_b, sk_b))
                 probe, pn = exchange(probe, ppids, pn, self.axis,
-                                     self.nshards, slot=slots[0])
+                                     self.nshards, slot=slots[0],
+                                     packed=self.packed,
+                                     report_site=self._sig + ("probe",))
                 norm_keys = [norm_cols[i] for i in self.build_key_idx]
                 b1, bn1 = exchange(
                     norm_cols, hash_partition_ids(norm_keys,
                                                   self.nshards),
-                    n_norm, self.axis, self.nshards, slot=slots[1])
+                    n_norm, self.axis, self.nshards, slot=slots[1],
+                    packed=self.packed,
+                    report_site=self._sig + ("build",))
                 # gather only a bounded prefix: the host sized
                 # slots[2] from the true max per-shard skewed build
                 # count, so the full cap_b column never rides ICI
@@ -564,13 +678,20 @@ class DistributedHashJoin:
                            else c.validity[:gcap])
                     for c in sk_cols]
                 b2, bn2 = all_gather_cols(sk_sliced, n_sk, self.axis,
-                                          self.nshards)
+                                          self.nshards,
+                                          packed=self.packed,
+                                          report_site=self._sig
+                                          + ("gather",))
                 build, bn = concat_prefixes(b1, bn1, b2, bn2)
             else:
                 probe, pn = exchange(probe, ppids, pn, self.axis,
-                                     self.nshards, slot=slots[0])
+                                     self.nshards, slot=slots[0],
+                                     packed=self.packed,
+                                     report_site=self._sig + ("probe",))
                 build, bn = exchange(build, bpids, bn, self.axis,
-                                     self.nshards, slot=slots[1])
+                                     self.nshards, slot=slots[1],
+                                     packed=self.packed,
+                                     report_site=self._sig + ("build",))
 
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
@@ -656,6 +777,9 @@ class DistributedHashJoin:
         from per-destination histograms instead of full-capacity padding.
         """
         import numpy as np
+        from spark_rapids_tpu.parallel.shuffle import (
+            metrics_for_session, planner_for_session,
+            record_exchange_metrics)
         strategy = self.strategy
         total_build = int(host_sync(build_nrows_per_shard).sum())
         if strategy == "auto":
@@ -666,9 +790,19 @@ class DistributedHashJoin:
             # a replicated build side would emit its never-matched rows
             # once per shard; full outer must co-partition
             strategy = "shuffle"
+        planner = planner_for_session()
+        metrics = metrics_for_session()
         slots = (None, None)
         skewed = ()
         stats = {"strategy": strategy, "build_rows": total_build}
+        if strategy == "broadcast":
+            # the all-gather moves every shard's full build capacity
+            cap_b = int(build_flat[0][0].shape[0]) // self.nshards
+            record_exchange_metrics(
+                metrics, dtypes=self.build_dtypes, slot=cap_b,
+                num_parts=self.nshards, nshards=self.nshards,
+                rows_useful=total_build, packed=self.packed,
+                site=self._sig + ("bcast",))
         if strategy == "shuffle":
             phist, bhist = self._stats_jitted()(
                 probe_flat, probe_nrows_per_shard,
@@ -688,6 +822,13 @@ class DistributedHashJoin:
                     (dest_p > self.skew_factor * med)
                     & (dest_p > self.skew_min_rows))[0]) \
                 if self.skew_enabled and self.join_type != "full" else ()
+            # both sides' slots go through the SlotPlanner (EMA-sticky
+            # power-of-two buckets per site, so a stable workload keeps
+            # a stable jit-cache key); the histograms are mandatory
+            # here regardless — skew detection needs them — so the join
+            # never launches speculatively
+            p_site = self._sig + ("probe", bool(skewed))
+            b_site = self._sig + ("build", bool(skewed))
             if skewed:
                 sk = np.zeros(self.nshards, dtype=bool)
                 sk[list(skewed)] = True
@@ -706,14 +847,41 @@ class DistributedHashJoin:
                 # prefix (max skewed build rows on any one shard)
                 gather_cap = pick_slot(
                     int(bcounts[:, sk].sum(axis=1).max()), cap_b)
-                slots = (pick_slot(int(padj.max()), cap_p),
-                         pick_slot(int(badj.max()), cap_b),
+                slots = (planner.plan(p_site, int(padj.max()), cap_p),
+                         planner.plan(b_site, int(badj.max()), cap_b),
                          gather_cap)
+                planner.observe(p_site, int(padj.max()), slots[0], cap_p)
+                planner.observe(b_site, int(badj.max()), slots[1], cap_b)
+                # the skewed-build bounded all-gather is a third data
+                # movement on ICI (gather_cap rows replicated to every
+                # shard) — it can dominate a heavily skewed build side,
+                # so it must show up in the wire accounting too
+                record_exchange_metrics(
+                    metrics, dtypes=self.build_dtypes, slot=gather_cap,
+                    num_parts=self.nshards, nshards=self.nshards,
+                    rows_useful=int(bcounts[:, sk].sum()),
+                    packed=self.packed,
+                    site=self._sig + ("gather",))
             else:
-                slots = (pick_slot(int(pcounts.max()), cap_p),
-                         pick_slot(int(bcounts.max()), cap_b))
+                slots = (planner.plan(p_site, int(pcounts.max()), cap_p),
+                         planner.plan(b_site, int(bcounts.max()), cap_b))
+                planner.observe(p_site, int(pcounts.max()), slots[0],
+                                cap_p)
+                planner.observe(b_site, int(bcounts.max()), slots[1],
+                                cap_b)
+            record_exchange_metrics(
+                metrics, dtypes=self.probe_dtypes, slot=slots[0],
+                num_parts=self.nshards, nshards=self.nshards,
+                rows_useful=int(pcounts.sum()), packed=self.packed,
+                site=self._sig + ("probe",))
+            record_exchange_metrics(
+                metrics, dtypes=self.build_dtypes, slot=slots[1],
+                num_parts=self.nshards, nshards=self.nshards,
+                rows_useful=int(bcounts.sum()), packed=self.packed,
+                site=self._sig + ("build",))
             stats.update(probe_counts=pcounts, build_counts=bcounts,
                          slots=slots, skewed=skewed)
+        stats["wire"] = metrics.snapshot()
         self.last_stats = stats
         import contextlib
         from spark_rapids_tpu.parallel.shuffle import launch_checkpoint

@@ -137,6 +137,7 @@ class DistributedSort:
         prefix are guaranteed to land on ONE shard — the window
         lowering's requirement that a partition never splits."""
         from spark_rapids_tpu.ops.jit_cache import cached_jit
+        from spark_rapids_tpu.parallel.shuffle import packed_enabled
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.nshards = mesh.devices.size
@@ -147,13 +148,14 @@ class DistributedSort:
         self.prefix = len(self.key_exprs) if partition_prefix is None \
             else int(partition_prefix)
         self._cached_jit = cached_jit
+        self.packed = packed_enabled()
         self._sig = ("dist_sort", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
                      tuple(str(d) for d in mesh.devices.flat),
                      tuple(dt.name for dt in self.in_dtypes),
                      tuple(e.cache_key() for e in self.key_exprs),
                      tuple(self.descending), tuple(self.nulls_first),
-                     self.prefix)
+                     self.prefix, ("packed", self.packed))
         self.last_stats: Optional[dict] = None
 
     def _emit_keys(self, cols: List[ColVal], nrows) -> List[ColVal]:
@@ -207,7 +209,8 @@ class DistributedSort:
                           self.nulls_first[: self.prefix],
                           spl_vals, spl_valid, self.nshards)
         recv, recv_n = exchange(cols, pids, nrows, self.axis, self.nshards,
-                                slot=slot)
+                                slot=slot, packed=self.packed,
+                                report_site=self._sig + ("final",))
         rcap = recv[0].values.shape[0]
         rkeys = self._emit_keys(recv, recv_n)
         valid_rows = jnp.arange(rcap, dtype=jnp.int32) < recv_n
@@ -252,6 +255,9 @@ class DistributedSort:
         return spl_vals, spl_valid
 
     def __call__(self, flat_cols, nrows_per_shard):
+        from spark_rapids_tpu.parallel.shuffle import (
+            metrics_for_session, planner_for_session,
+            record_exchange_metrics)
         spl_vals, spl_valid = self._splitters(flat_cols, nrows_per_shard)
         hist = self._cached_jit(
             self._sig + ("stats",), lambda: _shard_map(
@@ -261,8 +267,22 @@ class DistributedSort:
             spl_vals, spl_valid, flat_cols, nrows_per_shard)
         counts = np.asarray(hist).reshape(self.nshards, self.nshards)
         capacity = int(flat_cols[0][0].shape[0]) // self.nshards
-        slot = pick_slot(int(counts.max()), capacity)
-        self.last_stats = {"partition_counts": counts, "slot": slot}
+        # slot through the planner: EMA-sticky power-of-two bucket per
+        # sort site (stable jit keys); the stats pass is mandatory here
+        # — splitters are data-dependent every launch — so the sort
+        # never launches speculatively
+        planner = planner_for_session()
+        max_slice = int(counts.max())
+        slot = planner.plan(self._sig, max_slice, capacity)
+        planner.observe(self._sig, max_slice, slot, capacity,
+                        rows=int(counts.sum()))
+        record_exchange_metrics(
+            metrics_for_session(), dtypes=self.in_dtypes, slot=slot,
+            num_parts=self.nshards, nshards=self.nshards,
+            rows_useful=int(counts.sum()), packed=self.packed,
+            site=self._sig + ("final",))
+        self.last_stats = {"partition_counts": counts, "slot": slot,
+                           "packed": self.packed}
         from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
         with launch_checkpoint():
             return self._cached_jit(

@@ -11,6 +11,14 @@ the full (partition, order) key.  One more compiled shard_map step then
 evaluates every window expression shard-locally with the same kernels
 the single-process operator uses (``exec.window.eval_window_expr``) —
 no cross-shard carry is ever needed.
+
+Wire format: both window lowerings ride the embedded
+``DistributedSort``'s exchange, so they inherit the fused packed
+all-to-all (one collective per width group, shared compaction gather)
+and the SlotPlanner's EMA-sticky slot sizing for free — the window's
+exchange site is the sort's jit signature, which embeds the window's
+(partition, order) key set.  Shuffle-wire metrics recorded by the sort
+therefore attribute the window's exchange too (parallel/shuffle.py).
 """
 
 from __future__ import annotations

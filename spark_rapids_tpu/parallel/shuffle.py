@@ -1,24 +1,53 @@
-"""Shuffle as an SPMD collective: padded ragged all-to-all over the mesh.
+"""Shuffle as an SPMD collective: ONE fused packed all-to-all per exchange.
 
 This replaces the reference's entire UCX transport stack (shuffle-plugin/,
 RapidsShuffleClient/Server, bounce buffers, heartbeats — SURVEY.md section
 2.5): instead of point-to-point pull with metadata requests, every shard
-partitions its rows by destination, lays them out contiguously, and one
-``lax.all_to_all`` moves all slices across ICI simultaneously.  Peer
-discovery, connection management, and retry logic disappear — the collective
-is compiled into the XLA program.
+partitions its rows by destination, lays them out contiguously, and a
+collective moves all slices across ICI simultaneously.  Peer discovery,
+connection management, and retry logic disappear — the collective is
+compiled into the XLA program.
 
-Raggedness: all_to_all needs equal-sized slices, so each (src, dst) slice is
-padded to ``slot`` rows, with true counts exchanged alongside (an int vector
-all_to_all).  Receivers compact the slices back to a dense batch.  ``slot``
-defaults to the full per-shard capacity (always correct); callers with
-skew-free data can pass a smaller slot to cut the padding bandwidth.
+Wire format (the fused data path): all fixed-width columns of a batch are
+byte-reinterpreted (``jax.lax.bitcast_convert_type`` — always to *narrower*
+lanes, because the TPU X64 rewriter cannot lower 64<->64 float/int
+bitcasts) into width-homogeneous lane groups:
+
+* **u32 group** — 4-byte columns contribute one uint32 lane, 8-byte
+  columns two; payload shape ``[num_parts, slot, lanes32]``.
+* **u8 group** — bool/int8 columns contribute one uint8 lane, int16 two,
+  and every validity mask is bit-packed eight-to-a-lane at the tail;
+  payload shape ``[num_parts, slot, lanes8]``.
+
+Each group moves with ONE ``all_to_all`` and the slice→dense compaction
+index map is computed once per exchange and shared by every lane — an
+exchange costs O(distinct widths) ≤ 2 collectives plus the counts vector,
+instead of O(columns + validity masks).  ``packed.enabled=false`` (or an
+unpackable column) falls back to the per-column collectives, which still
+reuse the shared compaction indices.
+
+Raggedness: all_to_all needs equal-sized slices, so each (src, dst) slice
+is padded to ``slot`` rows, with true counts exchanged alongside.  Slot
+sizing is the :class:`SlotPlanner`'s job (modes ``adaptive`` / ``fixed`` /
+``capacity``): exchange sites feed it their materialized per-destination
+histogram max, it answers with a power-of-two slot smoothed by a per-site
+EMA (stable slots = stable jit-cache keys), and warm ``adaptive`` sites
+may launch *speculatively* — skipping the stats hostsync entirely — with
+a slot-overflow check after the launch that re-runs at full capacity and
+records a degradable recovery action rather than ever dropping rows.
+
+Every exchange also reports wire observability (collectives launched,
+payload bytes, padding ratio, overflow retries) through
+:class:`ShuffleWireMetrics` → eventlog ``QueryInfo.shuffle`` →
+``tools/profiling`` health checks (docs/performance.md "Shuffle wire
+format").
 """
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,12 +61,12 @@ def launch_checkpoint():
     """The single host-side checkpoint per exchange-bearing program
     launch: fires the "shuffle.exchange" injection point exactly once
     (count-based chaos rules see one checkpoint per launch whether the
-    traced program was cached or not) and runs the host-side launch
-    (trace + dispatch) under a watchdog deadline.  XLA dispatch is
-    asynchronous, so a collective that wedges DURING execution
-    surfaces at the stage's host sync / the whole-query deadline
-    instead — cancellation is cooperative and only host-touching
-    checkpoints can deliver it (robustness/watchdog.py)."""
+    traced program was cached or not — packed or per-column alike) and
+    runs the host-side launch (trace + dispatch) under a watchdog
+    deadline.  XLA dispatch is asynchronous, so a collective that
+    wedges DURING execution surfaces at the stage's host sync / the
+    whole-query deadline instead — cancellation is cooperative and only
+    host-touching checkpoints can deliver it (robustness/watchdog.py)."""
     from spark_rapids_tpu.robustness import watchdog
     from spark_rapids_tpu.robustness.inject import fire
     with watchdog.section("shuffle.exchange"):
@@ -55,24 +84,236 @@ def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:
     return min(s, capacity)
 
 
+def packed_enabled(conf=None) -> bool:
+    """Resolve spark.rapids.tpu.shuffle.packed.enabled: explicit conf >
+    active session > entry default.  Exchange consumers resolve this at
+    construction and bake it into their jit-cache signatures, so a conf
+    flip can never be masked by a cached trace."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        if s is None:
+            return rc.SHUFFLE_PACKED_ENABLED.default
+        conf = s.conf
+    return conf.get(rc.SHUFFLE_PACKED_ENABLED)
+
+
+# ------------------------------------------------------------- lane packing --
+
+_U32 = "u32"
+_U8 = "u8"
+
+
+class _PackPlan:
+    """Lane assignment for one exchange's columns: which width group and
+    lane range each column occupies, plus the bit position of every
+    validity mask in the u8 group's packed-validity tail lanes."""
+
+    def __init__(self, cols: Sequence[ColVal]):
+        self.col_group: List[str] = []
+        self.col_start: List[int] = []
+        self.col_lanes: List[int] = []
+        self.col_dtype = [c.values.dtype for c in cols]
+        self.valid_bit: List[Optional[int]] = []
+        import numpy as np
+        n32 = n8 = nbits = 0
+        for c in cols:
+            w = np.dtype(c.values.dtype).itemsize
+            if w in (4, 8):
+                grp, lanes, n32 = _U32, w // 4, n32 + w // 4
+                self.col_start.append(n32 - w // 4)
+            elif w in (1, 2):
+                grp, lanes, n8 = _U8, w, n8 + w
+                self.col_start.append(n8 - w)
+            else:
+                raise _Unpackable(f"column width {w} has no lane group")
+            self.col_group.append(grp)
+            self.col_lanes.append(lanes)
+            if c.validity is not None:
+                self.valid_bit.append(nbits)
+                nbits += 1
+            else:
+                self.valid_bit.append(None)
+        self.n32 = n32
+        self.n8_data = n8
+        self.n8 = n8 + (nbits + 7) // 8
+
+    @property
+    def collectives(self) -> int:
+        """Data collectives this plan launches (counts vector excluded)."""
+        return (1 if self.n32 else 0) + (1 if self.n8 else 0)
+
+
+class _Unpackable(Exception):
+    """A column the lane packer cannot transport (non-fixed-width)."""
+
+
+# site -> trace-time lane report ({"collectives", "row_bytes"}): the
+# EXACT wire cost of the program a consumer site compiled, recorded by
+# the exchange body itself (it alone sees runtime dtypes/nullability).
+# Keyed by the consumer's jit signature, so it persists across consumer
+# reconstruction exactly as long as the compiled program does; metrics
+# fall back to the conservative estimate only before first trace.
+_WIRE_REPORTS: Dict[Hashable, dict] = {}
+
+
+def wire_report(site) -> Optional[dict]:
+    return _WIRE_REPORTS.get(site)
+
+
+def _record_wire_report(site, cols, plan) -> None:
+    import numpy as np
+    if site is None:
+        return
+    nullable = sum(1 for c in cols if c.validity is not None)
+    if plan is not None:
+        collectives = 1 + plan.collectives
+        row_bytes = 4 * plan.n32 + plan.n8
+    else:
+        # per-column wire: one collective per column + mask; validity
+        # rides as full bool lanes (1 byte/row), not bit-packed
+        collectives = 1 + len(cols) + nullable
+        row_bytes = sum(
+            max(np.dtype(c.values.dtype).itemsize, 1) for c in cols) \
+            + nullable
+    _WIRE_REPORTS[site] = {"collectives": collectives,
+                           "row_bytes": row_bytes}
+
+
+def _plan_pack(cols: Sequence[ColVal]) -> Optional[_PackPlan]:
+    if not cols:
+        return None
+    try:
+        for c in cols:
+            if c.offsets is not None or getattr(c.values, "ndim", 0) != 1:
+                raise _Unpackable("offsets / non-vector column")
+        return _PackPlan(cols)
+    except _Unpackable:
+        return None
+
+
+def _pack_payloads(cols: Sequence[ColVal], plan: _PackPlan, sel=None):
+    """Build the (u32, u8) lane payloads.  ``sel`` is an optional gather
+    index array (the padded-slot send layout); lanes inherit its shape
+    with one trailing lane axis."""
+
+    def take(a):
+        return a if sel is None else a[sel]
+
+    lanes32: List[jnp.ndarray] = [None] * plan.n32
+    lanes8: List[jnp.ndarray] = [None] * plan.n8
+    shape = None
+    for c, grp, start, nlanes in zip(cols, plan.col_group, plan.col_start,
+                                     plan.col_lanes):
+        send = take(c.values)
+        shape = send.shape
+        if grp == _U32:
+            if nlanes == 1:
+                lanes32[start] = jax.lax.bitcast_convert_type(
+                    send, jnp.uint32)
+            else:
+                w = jax.lax.bitcast_convert_type(send, jnp.uint32)
+                for i in range(nlanes):
+                    lanes32[start + i] = w[..., i]
+        elif send.dtype == jnp.bool_:
+            lanes8[start] = send.astype(jnp.uint8)
+        elif nlanes == 1:
+            lanes8[start] = jax.lax.bitcast_convert_type(send, jnp.uint8)
+        else:
+            w = jax.lax.bitcast_convert_type(send, jnp.uint8)
+            for i in range(nlanes):
+                lanes8[start + i] = w[..., i]
+    # validity tail: eight masks per uint8 lane
+    for lane in range(plan.n8_data, plan.n8):
+        lanes8[lane] = jnp.zeros(shape, dtype=jnp.uint8)
+    for c, bit in zip(cols, plan.valid_bit):
+        if bit is None:
+            continue
+        lane = plan.n8_data + bit // 8
+        lanes8[lane] = lanes8[lane] | jnp.left_shift(
+            take(c.validity).astype(jnp.uint8), jnp.uint8(bit % 8))
+    p32 = jnp.stack(lanes32, axis=-1) if lanes32 else None
+    p8 = jnp.stack(lanes8, axis=-1) if lanes8 else None
+    return p32, p8
+
+
+def _unpack_payloads(cols: Sequence[ColVal], plan: _PackPlan,
+                     flat32, flat8, in_range) -> List[ColVal]:
+    """Invert :func:`_pack_payloads` on already index-compacted lane
+    matrices (``flat32``: [cap, lanes32], ``flat8``: [cap, lanes8])."""
+    out: List[ColVal] = []
+    for c, grp, start, nlanes, bit in zip(
+            cols, plan.col_group, plan.col_start, plan.col_lanes,
+            plan.valid_bit):
+        if grp == _U32:
+            sub = flat32[:, start:start + nlanes]
+            vals = jax.lax.bitcast_convert_type(
+                sub[:, 0] if nlanes == 1 else sub, c.values.dtype)
+        elif c.values.dtype == jnp.bool_:
+            vals = flat8[:, start] != 0
+        else:
+            sub = flat8[:, start:start + nlanes]
+            vals = jax.lax.bitcast_convert_type(
+                sub[:, 0] if nlanes == 1 else sub, c.values.dtype)
+        validity = None
+        if bit is not None:
+            bits = jnp.bitwise_and(
+                jnp.right_shift(flat8[:, plan.n8_data + bit // 8],
+                                jnp.uint8(bit % 8)), jnp.uint8(1))
+            validity = jnp.where(in_range, bits != 0, False)
+        out.append(ColVal(c.dtype, vals, validity))
+    return out
+
+
+# ---------------------------------------------------------------- exchange --
+
+def _compaction_indices(recv_counts, total, num_parts: int, slot: int):
+    """Slice→dense map shared by every lane/column of one exchange:
+    for each dense output position, the (source slice, offset) it reads
+    and whether it is a live row."""
+    recv_starts = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(recv_counts)[:-1]])
+    pos = jnp.arange(num_parts * slot, dtype=jnp.int32)
+    part = jnp.searchsorted(recv_starts, pos, side="right") - 1
+    part = jnp.clip(part, 0, num_parts - 1)
+    offset = jnp.clip(pos - recv_starts[part], 0, slot - 1)
+    in_range = pos < total
+    return part, offset, in_range
+
+
 def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
              axis_name: str, num_parts: int,
-             slot: Optional[int] = None) -> Tuple[List[ColVal], jnp.ndarray]:
+             slot: Optional[int] = None,
+             packed: Optional[bool] = None,
+             with_overflow: bool = False,
+             report_site=None):
     """All-to-all exchange inside shard_map.
 
-    Every shard sends row r to shard ``pids[r]``.  Returns (received cols,
-    received nrows); received capacity is ``num_parts * slot``.
-    Only fixed-width columns (strings must be dictionary-encoded upstream).
+    Every shard sends row r to shard ``pids[r]``.  Returns (received
+    cols, received nrows) — plus a per-shard overflow flag (any local
+    (src, dst) slice larger than ``slot``, i.e. rows were dropped and
+    the launch must be re-run with a bigger slot) when
+    ``with_overflow`` is set.  Received capacity is
+    ``num_parts * slot``.  Only fixed-width columns (strings must be
+    dictionary-encoded upstream).
+
+    ``packed`` selects the fused lane-payload wire format (module
+    docstring); None resolves the session conf.  Callers that jit-cache
+    programs containing this body must bake the resolved flag into
+    their cache signature.
 
     The "shuffle.exchange" injection point does NOT fire here: this
     body runs at trace time (and not at all on a jit-cache hit), and a
     launch with several exchanges (shuffle join) would multi-fire.
-    ``launch_checkpoint`` below is the single host-side checkpoint per
+    ``launch_checkpoint`` above is the single host-side checkpoint per
     exchange-bearing program launch — callers invoke it right before
     dispatching the compiled program.
     """
     capacity = pids.shape[0]
     slot = slot or capacity
+    if packed is None:
+        packed = packed_enabled()
     sorted_cols, counts, starts = layout_by_partition(
         cols, pids, nrows, num_parts)
 
@@ -82,60 +323,73 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         concat_axis=0).reshape(num_parts)
 
     # gather each destination's rows into its padded slot: send[d, j]
-    d = jnp.arange(num_parts, dtype=jnp.int32)[:, None]
     j = jnp.arange(slot, dtype=jnp.int32)[None, :]
     src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
-    slot_valid = j < counts[:, None]
 
-    out_cols: List[ColVal] = []
     total = recv_counts.sum()
-    # positions of received valid rows after compaction
-    recv_starts = jnp.concatenate(
-        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(recv_counts)[:-1]])
-    for c in sorted_cols:
-        send = c.values[src]                      # [num_parts, slot]
-        recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
-                                  concat_axis=0)
-        flat, validity = _compact_received(
-            recv, None if c.validity is None else c.validity, src, slot_valid,
-            recv_counts, recv_starts, axis_name, num_parts, slot)
-        out_cols.append(ColVal(c.dtype, flat, validity))
+    # the slice→dense compaction map, computed ONCE and shared by every
+    # lane (packed) or column (fallback)
+    part, offset, in_range = _compaction_indices(
+        recv_counts, total, num_parts, slot)
+
+    plan = _plan_pack(sorted_cols) if packed else None
+    _record_wire_report(report_site, sorted_cols, plan)
+    if packed and plan is None and cols:
+        # trace-time breadcrumb: the fused wire was requested but these
+        # columns are unpackable, so this program runs per-column
+        # collectives.  Counted here (not at the consumer, which only
+        # knows the conf flag) so perColumnFallbacks — and the
+        # profiling health check built on it — reflects the EFFECTIVE
+        # wire format.  Trace-time means once per compiled program, not
+        # per launch; a nonzero count is the signal, not a launch tally.
+        metrics_for_session().record_fallback()
+    if plan is not None:
+        p32, p8 = _pack_payloads(sorted_cols, plan, sel=src)
+        flat32 = flat8 = None
+        if p32 is not None:
+            r32 = jax.lax.all_to_all(p32, axis_name, split_axis=0,
+                                     concat_axis=0)
+            flat32 = r32[part, offset]
+        if p8 is not None:
+            r8 = jax.lax.all_to_all(p8, axis_name, split_axis=0,
+                                    concat_axis=0)
+            flat8 = r8[part, offset]
+        out_cols = _unpack_payloads(sorted_cols, plan, flat32, flat8,
+                                    in_range)
+    else:
+        out_cols = []
+        for c in sorted_cols:
+            recv = jax.lax.all_to_all(c.values[src], axis_name,
+                                      split_axis=0, concat_axis=0)
+            flat = recv[part, offset]
+            validity = None
+            if c.validity is not None:
+                vrecv = jax.lax.all_to_all(c.validity[src], axis_name,
+                                           split_axis=0, concat_axis=0)
+                validity = jnp.where(in_range, vrecv[part, offset], False)
+            out_cols.append(ColVal(c.dtype, flat, validity))
+    if with_overflow:
+        return out_cols, total, jnp.any(counts > slot)
     return out_cols, total
 
 
-def _compact_received(recv, send_validity, src, slot_valid, recv_counts,
-                      recv_starts, axis_name, num_parts, slot):
-    """Flatten [num_parts, slot] received rows into a dense prefix."""
-    validity_flat = None
-    if send_validity is not None:
-        vsend = send_validity[src]
-        vrecv = jax.lax.all_to_all(vsend, axis_name, split_axis=0,
-                                   concat_axis=0)
-    cap = num_parts * slot
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    # source slice for each dense output position
-    part = jnp.searchsorted(recv_starts, pos, side="right") - 1
-    part = jnp.clip(part, 0, num_parts - 1)
-    offset = pos - recv_starts[part]
-    in_range = pos < recv_counts.sum()
-    flat = recv[part, jnp.clip(offset, 0, slot - 1)]
-    if send_validity is not None:
-        validity_flat = jnp.where(
-            in_range, vrecv[part, jnp.clip(offset, 0, slot - 1)], False)
-    return flat, validity_flat
-
-
 def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
-                    num_parts: int) -> Tuple[List[ColVal], jnp.ndarray]:
+                    num_parts: int,
+                    packed: Optional[bool] = None,
+                    report_site=None
+                    ) -> Tuple[List[ColVal], jnp.ndarray]:
     """Broadcast-style collective: every shard receives every shard's rows.
 
     The TPU analog of GpuBroadcastExchangeExec (one-to-all replication,
     SURVEY.md section 2.4 "Exchanges") — except all-gather is symmetric, so
     "broadcast" of a small table costs one collective, no driver round trip.
+    Rides the same lane-packed wire format as ``exchange``: one
+    ``all_gather`` per width group instead of one per column + mask.
     """
     capacity = cols[0].values.shape[0] if cols else 0
+    if packed is None:
+        packed = packed_enabled()
     counts = jax.lax.all_gather(nrows, axis_name)  # [num_parts]
-    out_cols: List[ColVal] = []
     starts = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
                               jnp.cumsum(counts)[:-1].astype(jnp.int32)])
     total = counts.sum()
@@ -144,12 +398,281 @@ def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
     part = jnp.searchsorted(starts, pos, side="right") - 1
     part = jnp.clip(part, 0, num_parts - 1)
     offset = jnp.clip(pos - starts[part], 0, capacity - 1)
+    in_range = pos < total
+    plan = _plan_pack(cols) if packed else None
+    _record_wire_report(report_site, cols, plan)
+    if packed and plan is None and cols:
+        metrics_for_session().record_fallback()  # see exchange()
+    if plan is not None:
+        p32, p8 = _pack_payloads(cols, plan)
+        flat32 = flat8 = None
+        if p32 is not None:
+            flat32 = jax.lax.all_gather(p32, axis_name)[part, offset]
+        if p8 is not None:
+            flat8 = jax.lax.all_gather(p8, axis_name)[part, offset]
+        return _unpack_payloads(cols, plan, flat32, flat8,
+                                in_range), total
+    out_cols: List[ColVal] = []
     for c in cols:
         g = jax.lax.all_gather(c.values, axis_name)  # [num_parts, capacity]
         flat = g[part, offset]
         validity = None
         if c.validity is not None:
             gv = jax.lax.all_gather(c.validity, axis_name)
-            validity = jnp.where(pos < total, gv[part, offset], False)
+            validity = jnp.where(in_range, gv[part, offset], False)
         out_cols.append(ColVal(c.dtype, flat, validity))
     return out_cols, total
+
+
+# ------------------------------------------------------------- slot planner --
+
+class SlotPlanner:
+    """Per-exchange-site all-to-all slot sizing.
+
+    One instance per session (``planner_for_session``), one entry per
+    exchange *site* (the consumer's jit signature).  Modes
+    (spark.rapids.tpu.shuffle.slot.mode):
+
+    * ``adaptive`` (default) — slots come from the launch's histogram
+      max smoothed with a per-site EMA of observed maxima, so the
+      power-of-two bucket is STICKY across launches (a stable slot is a
+      stable jit-cache key — no recompile churn when data sizes wobble).
+      Warm sites may also launch *speculatively*: skip the stats
+      hostsync, reuse the cached slot (and bucket LUT), and verify a
+      per-shard overflow flag after the launch — at most ONE budgeted
+      hostsync per exchange site either way.
+    * ``fixed`` — every launch sized from its own histogram only (the
+      pre-EMA behavior; recompiles whenever the bucket moves).
+    * ``capacity`` — full-capacity padding, always correct,
+      ``num_parts``x the useful bytes on the wire (the A/B baseline).
+
+    A speculative overflow multiplies the site's EMA by
+    ``slot.overflowGrowth`` and disables speculation until the next
+    observed (stats-sized) launch re-arms it.  Warm sites also return
+    to the stats-sized path every ``REFRESH_EVERY`` speculative
+    launches so the EMA keeps sampling — without the refresh a site
+    that once saw a skewed batch would ship its inflated slot forever
+    (successful speculative launches observe nothing).
+    """
+
+    REFRESH_EVERY = 16
+
+    def __init__(self, mode: str = "adaptive", growth: float = 2.0):
+        self.mode = mode
+        self.growth = growth
+        self._lock = threading.Lock()
+        self.sites: Dict[Hashable, dict] = {}
+
+    def plan(self, site: Hashable, max_slice: int, capacity: int) -> int:
+        """Slot for a stats-sized launch (histogram max in hand)."""
+        if self.mode == "capacity":
+            return capacity
+        if self.mode == "fixed":
+            return pick_slot(max_slice, capacity)
+        with self._lock:
+            e = self.sites.get(site)
+            ema = e["ema"] if e and e.get("capacity") == capacity else 0.0
+        return pick_slot(max(int(max_slice), int(ema)), capacity)
+
+    def observe(self, site: Hashable, max_slice: int, slot: int,
+                capacity: int, lut=None, rows: int = 0) -> None:
+        """Record a stats-sized launch: update the EMA, cache the slot
+        (+ optional bucket LUT) for speculative reuse, clear any
+        overflow latch."""
+        with self._lock:
+            e = self.sites.setdefault(site, {})
+            prev = e.get("ema", 0.0)
+            e["ema"] = float(max_slice) if not prev else \
+                0.7 * prev + 0.3 * float(max_slice)
+            e["slot"] = slot
+            e["capacity"] = capacity
+            e["rows"] = rows
+            if lut is not None:
+                e["lut"] = lut
+            e.pop("overflowed", None)
+
+    def speculative(self, site: Hashable, capacity: int
+                    ) -> Optional[dict]:
+        """Steady-state entry for a warm adaptive site (slot + cached
+        LUT), or None when the site must run the stats hostsync: cold,
+        capacity changed, non-adaptive mode, an unresolved overflow, or
+        the periodic EMA refresh (every REFRESH_EVERY warm launches)."""
+        if self.mode != "adaptive":
+            return None
+        with self._lock:
+            e = self.sites.get(site)
+            if not e or e.get("capacity") != capacity or \
+                    e.get("overflowed") or "slot" not in e:
+                return None
+            e["warm"] = e.get("warm", 0) + 1
+            if e["warm"] % self.REFRESH_EVERY == 0:
+                return None  # periodic re-observation keeps the EMA live
+            return dict(e)
+
+    def observe_overflow(self, site: Hashable) -> None:
+        """A speculative slot dropped rows: grow the EMA by the
+        configured factor and force the next launch back onto the
+        stats-sized path."""
+        with self._lock:
+            e = self.sites.setdefault(site, {})
+            e["overflowed"] = True
+            e["ema"] = max(e.get("ema", 0.0) * self.growth,
+                           e.get("slot", 8) * self.growth)
+
+
+_default_planner = SlotPlanner()
+_default_metrics = None  # built lazily below
+
+
+def planner_for_session(session=None) -> SlotPlanner:
+    """The session's SlotPlanner (created on first use; mode/growth
+    re-read from the conf each call so tests can flip them live).
+    Without an active session (bare kernel tests) a process-global
+    default planner is shared."""
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        return _default_planner
+    from spark_rapids_tpu.config import rapids_conf as rc
+    p = getattr(session, "shuffle_planner", None)
+    if p is None:
+        p = SlotPlanner()
+        session.shuffle_planner = p
+    p.mode = session.conf.get(rc.SHUFFLE_SLOT_MODE)
+    p.growth = session.conf.get(rc.SHUFFLE_SLOT_OVERFLOW_GROWTH)
+    return p
+
+
+# ---------------------------------------------------------- wire observability --
+
+class ShuffleWireMetrics:
+    """Cumulative shuffle-wire counters (one per session; process-global
+    fallback for bare kernel use).  Exchange consumers record each
+    launch host-side; per-query deltas land in the QueryEnd ``shuffle``
+    dict → eventlog ``QueryInfo.shuffle`` → profiling health checks."""
+
+    FIELDS = ("exchanges", "collectives", "rowsMoved", "rowsUseful",
+              "bytesMoved", "slotOverflowRetries", "perColumnFallbacks")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {k: 0 for k in self.FIELDS}
+        # payload bytes of the most recently recorded exchange — the
+        # launch whose lane buffers are still resident, which is what
+        # the transient_wire_bytes HBM reservation should reflect (a
+        # query's CUMULATIVE bytes would overstate it several-fold on
+        # multi-exchange plans; earlier payloads were already reused)
+        self.last_exchange_bytes = 0
+
+    def record_exchange(self, collectives: int, rows_moved: int,
+                        rows_useful: int, bytes_moved: int,
+                        packed: bool = True) -> None:
+        with self._lock:
+            c = self.counters
+            c["exchanges"] += 1
+            c["collectives"] += int(collectives)
+            c["rowsMoved"] += int(rows_moved)
+            c["rowsUseful"] += int(rows_useful)
+            c["bytesMoved"] += int(bytes_moved)
+            self.last_exchange_bytes = int(bytes_moved)
+            if not packed:
+                c["perColumnFallbacks"] += 1
+
+    def record_overflow(self) -> None:
+        with self._lock:
+            self.counters["slotOverflowRetries"] += 1
+
+    def record_fallback(self) -> None:
+        """An exchange that requested the packed wire but traced the
+        per-column path (unpackable columns).  Fired at trace time by
+        the exchange body itself — once per compiled program."""
+        with self._lock:
+            self.counters["perColumnFallbacks"] += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    @staticmethod
+    def delta(after: Dict[str, int], before: Dict[str, int]
+              ) -> Dict[str, int]:
+        return {k: after.get(k, 0) - before.get(k, 0) for k in after}
+
+    @staticmethod
+    def summarize(d: Dict[str, int]) -> Dict[str, float]:
+        """Attach the derived padding ratio (wire rows / useful rows —
+        1.0 is a perfectly dense exchange, ``num_parts`` is
+        full-capacity padding)."""
+        out = dict(d)
+        out["paddingRatio"] = round(
+            d.get("rowsMoved", 0) / max(d.get("rowsUseful", 0), 1), 3)
+        return out
+
+
+def metrics_for_session(session=None) -> ShuffleWireMetrics:
+    global _default_metrics
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        if _default_metrics is None:
+            _default_metrics = ShuffleWireMetrics()
+        return _default_metrics
+    m = getattr(session, "shuffle_metrics", None)
+    if m is None:
+        m = ShuffleWireMetrics()
+        session.shuffle_metrics = m
+    return m
+
+
+def wire_row_bytes(dtypes, nullable: Optional[int] = None) -> int:
+    """Estimated wire bytes per row for a column set (data lanes plus
+    bit-packed validity; ``nullable`` defaults to every column, an
+    upper bound — exact nullability is a trace-time property)."""
+    import numpy as np
+    data = sum(max(np.dtype(dt.storage).itemsize, 1) for dt in dtypes)
+    n = len(dtypes) if nullable is None else nullable
+    return data + (n + 7) // 8
+
+
+def estimate_collectives(dtypes, packed: bool,
+                         nullable: Optional[int] = None) -> int:
+    """Collectives one exchange launches: the counts vector plus one per
+    width group (packed) or one per column + validity mask (fallback)."""
+    import numpy as np
+    n = len(dtypes) if nullable is None else nullable
+    if not packed:
+        return 1 + len(dtypes) + n
+    widths = [np.dtype(dt.storage).itemsize for dt in dtypes]
+    has32 = any(w in (4, 8) for w in widths)
+    has8 = any(w in (1, 2) for w in widths) or n > 0
+    return 1 + int(has32) + int(has8)
+
+
+def record_exchange_metrics(metrics: ShuffleWireMetrics, *, dtypes,
+                            slot: int, num_parts: int, nshards: int,
+                            rows_useful: int, packed: bool,
+                            nullable: Optional[int] = None,
+                            site=None, exchanges: int = 1) -> None:
+    """One consumer-side accounting call per exchange launch: wire rows
+    are the padded slots every shard puts on ICI; useful rows come from
+    the site's histogram (or the planner's last observation on
+    speculative launches).  When the site's compiled program recorded
+    its trace-time lane report (``report_site`` on the exchange), the
+    EXACT collective count and row bytes are used; the all-nullable
+    static estimate only covers launches before first trace."""
+    rows_moved = nshards * num_parts * slot * exchanges
+    rep = wire_report(site)
+    if rep is not None:
+        collectives = rep["collectives"]
+        row_bytes = rep["row_bytes"]
+    else:
+        collectives = estimate_collectives(dtypes, packed, nullable)
+        row_bytes = wire_row_bytes(dtypes, nullable)
+    metrics.record_exchange(
+        collectives=collectives * exchanges,
+        rows_moved=rows_moved,
+        rows_useful=int(rows_useful),
+        bytes_moved=rows_moved * row_bytes,
+        packed=packed)

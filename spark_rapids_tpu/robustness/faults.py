@@ -137,6 +137,29 @@ class CorruptionFault(Exception):
         self.tier = tier
 
 
+class ShuffleSlotOverflow(Exception):
+    """A speculative (EMA-predicted) all-to-all slot was smaller than
+    the launch's true max slice — rows would have been dropped.  The
+    exchange site handles this LOCALLY: it re-runs the launch at full
+    capacity (never wrong bytes) and records the fault on the recovery
+    trail as a degradable action (the plan was fine; only the slot
+    prediction was stale, and the planner has already grown/reset it).
+    Degradable if it ever escapes: identical re-execution with the same
+    stale slot cannot succeed, a re-planned attempt re-sizes."""
+
+    kind = "shuffle_slot"
+    severity = DEGRADABLE
+
+    def __init__(self, site: str, slot: int, capacity: int):
+        super().__init__(
+            f"speculative shuffle slot overflow at {site}: slot {slot} "
+            f"< true max slice (capacity {capacity}); re-running at "
+            "full capacity")
+        self.site = site
+        self.slot = slot
+        self.capacity = capacity
+
+
 class HostSyncError(RuntimeError):
     """Multi-host phase boundary failed: the cross-process stats
     all-gather timed out or the controllers diverged.  Retryable — the
@@ -165,6 +188,8 @@ def classify(exc: BaseException) -> Fault:
     if isinstance(exc, TimeoutFault):
         return Fault(exc.kind, exc.severity)
     if isinstance(exc, CorruptionFault):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, ShuffleSlotOverflow):
         return Fault(exc.kind, exc.severity)
     from spark_rapids_tpu.memory.retry import SplitAndRetryOOM, is_oom
     if isinstance(exc, SplitAndRetryOOM):

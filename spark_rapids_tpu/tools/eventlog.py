@@ -33,6 +33,11 @@ class QueryInfo:
     # consumerWaitMs, jitCacheHits/Misses); empty when the query ran
     # sequential
     pipeline: Dict[str, float] = field(default_factory=dict)
+    # shuffle-wire summary of a distributed query (parallel/shuffle.py
+    # ShuffleWireMetrics.summarize: exchanges, collectives, rowsMoved,
+    # rowsUseful, bytesMoved, paddingRatio, slotOverflowRetries,
+    # perColumnFallbacks); empty when the query never exchanged
+    shuffle: Dict[str, float] = field(default_factory=dict)
     # query-level recovery ladder actions (robustness/driver.py
     # RecoveryAction events stamped with this query's id)
     recovery: List[Dict[str, str]] = field(default_factory=list)
@@ -144,6 +149,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.spill = rec.get("spill", {})
                 q.retry = rec.get("retry", {})
                 q.pipeline = rec.get("pipeline", {})
+                q.shuffle = rec.get("shuffle", {})
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed
     for q in open_queries.values():

@@ -93,6 +93,39 @@ def pipeline_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Aggregate shuffle-wire effectiveness across distributed queries:
+    exchanges, collectives launched, bytes moved and the overall
+    padding ratio (wire rows / useful rows — 1.0 is a perfectly dense
+    exchange; numShards is full-capacity padding)."""
+    exchanged, exch, coll, moved, useful, bytes_, ovf, fb = \
+        0, 0, 0, 0, 0, 0, 0, 0
+    for a in apps:
+        for q in a.queries:
+            s = q.shuffle
+            if not s or not s.get("exchanges"):
+                continue
+            exchanged += 1
+            exch += s.get("exchanges", 0)
+            coll += s.get("collectives", 0)
+            moved += s.get("rowsMoved", 0)
+            useful += s.get("rowsUseful", 0)
+            bytes_ += s.get("bytesMoved", 0)
+            ovf += s.get("slotOverflowRetries", 0)
+            fb += s.get("perColumnFallbacks", 0)
+    if not exchanged:
+        return {}
+    return {
+        "queries": exchanged,
+        "exchanges": exch,
+        "collectives": coll,
+        "bytes_moved": bytes_,
+        "padding_ratio": moved / max(useful, 1),
+        "slot_overflow_retries": ovf,
+        "per_column_fallbacks": fb,
+    }
+
+
 def health_check(apps: List[AppInfo]) -> List[str]:
     problems = []
     for a in apps:
@@ -117,6 +150,30 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     f"{p['batches']} batches — per-batch device->host "
                     "round trips serialize the pipeline "
                     "(docs/performance.md sync-point discipline)")
+            sh = q.shuffle
+            if sh and sh.get("exchanges"):
+                pr = sh.get("paddingRatio", 0.0)
+                if pr > 4.0:
+                    problems.append(
+                        f"{a.session_id} query {q.query_id}: shuffle "
+                        f"padding ratio {pr:.1f}x over "
+                        f"{sh.get('exchanges', 0)} exchange(s) — most "
+                        "ICI bytes are padding; the slot planner is "
+                        "oversizing (skewed partitions, or slot.mode="
+                        "capacity left on)")
+                if sh.get("perColumnFallbacks", 0):
+                    problems.append(
+                        f"{a.session_id} query {q.query_id}: "
+                        f"{sh['perColumnFallbacks']} exchange(s) fell "
+                        "back to per-column collectives — an unpackable "
+                        "column or packed.enabled=false defeats the "
+                        "fused shuffle wire format")
+                if sh.get("slotOverflowRetries", 0):
+                    problems.append(
+                        f"{a.session_id} query {q.query_id}: "
+                        f"{sh['slotOverflowRetries']} speculative slot "
+                        "overflow(s) re-ran at full capacity — data "
+                        "skew shifted under a warm exchange site")
             spilled = sum(q.spill.values()) if q.spill else 0
             if spilled:
                 problems.append(
@@ -321,6 +378,17 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             out.append(
                 f"  jit cache: {pl['jit_cache_hits']}/{total} hits "
                 f"({pl['jit_cache_hits'] / total:.0%})")
+    sw = shuffle_wire_stats(apps)
+    if sw:
+        out.append("\n-- Shuffle wire --")
+        out.append(
+            f"  distributed queries={sw['queries']} "
+            f"exchanges={sw['exchanges']} "
+            f"collectives={sw['collectives']} "
+            f"bytes={sw['bytes_moved']} "
+            f"padding={sw['padding_ratio']:.2f}x "
+            f"overflowRetries={sw['slot_overflow_retries']} "
+            f"perColumnFallbacks={sw['per_column_fallbacks']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

@@ -227,6 +227,7 @@ def test_adaptive_exchange_slot_bounded(mesh, rng):
     per-destination histogram — at most 2x the true max slice (power-of-2
     bucket), never the old full-capacity padding (which moved nshards x
     the needed bytes over ICI)."""
+    from spark_rapids_tpu.parallel.shuffle import planner_for_session
     keys = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
     vals = rng.normal(size=(NSHARDS, CAP))
     nrows = np.full(NSHARDS, CAP, dtype=np.int32)
@@ -235,6 +236,10 @@ def test_adaptive_exchange_slot_bounded(mesh, rng):
         group_exprs=[BoundReference(0, dts.INT64, name="k",
                                     nullable=False)],
         funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v"))])
+    # cold exchange site: the assertion below reads the stats-sized
+    # launch's histogram, so a warm EMA/speculative entry from another
+    # test sharing this signature must not preempt it
+    planner_for_session().sites.pop(dist._sig, None)
     flat_cols = [(_make_sharded(keys), None, None),
                  (_make_sharded(vals, np.float64), None, None)]
     outs = dist(flat_cols, jnp.asarray(nrows))
@@ -513,6 +518,8 @@ def test_aqe_bucket_coalescing_spreads_skew():
             break
     assert len(hot) == 3, "test setup: need 3 colliding-but-separable keys"
 
+    from spark_rapids_tpu.parallel.shuffle import planner_for_session
+    planner_for_session().sites.pop(dist._sig, None)  # force stats path
     cap = 512
     total = nshards * cap
     rng = np.random.default_rng(0)

@@ -1,0 +1,532 @@
+"""Fused packed shuffle wire format (parallel/shuffle.py).
+
+Pins the exchange data-path rebuild: ONE all_to_all per width group
+(jaxpr-level collective budgets), bit-identical results vs the
+per-column path for mixed/nullable columns across the virtual 8-device
+CPU mesh, adaptive slot planning (speculative launches, hostsync
+budget, slot-overflow -> degradable recovery -> correct result), the
+transient wire-bytes HBM accounting, and the QueryInfo.shuffle
+observability trail.
+"""
+
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expressions import BoundReference, ColVal
+from spark_rapids_tpu.parallel.mesh import make_mesh, shard_map
+from spark_rapids_tpu.parallel.shuffle import (
+    SlotPlanner, all_gather_cols, exchange, metrics_for_session,
+    planner_for_session)
+
+NSHARDS = 8
+CAP = 64
+
+# the q3-shape exchange: join keys + aggregation payloads, all nullable
+# (two i64 keys, two f64 measures, an i32 date, an f32 discount)
+Q3_DTYPES = [dts.INT64, dts.INT64, dts.FLOAT64, dts.FLOAT64,
+             dts.INT32, dts.FLOAT32]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(NSHARDS)
+
+
+def _exchange_fn(mesh, dtypes, packed, slot=None):
+    axis = mesh.axis_names[0]
+
+    def step(flat, pids, nrows_arr):
+        cols = [ColVal(dt, v, val) for (v, val), dt in zip(flat, dtypes)]
+        out, total = exchange(cols, pids, nrows_arr[0], axis, NSHARDS,
+                              slot=slot, packed=packed)
+        res = tuple(
+            (c.values, c.validity if c.validity is not None
+             else jnp.ones_like(c.values, dtype=jnp.bool_))
+            for c in out)
+        return res + (jnp.reshape(total.astype(jnp.int32), (1,)),)
+
+    return shard_map(step, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis)),
+                     out_specs=P(axis), check_vma=False)
+
+
+def _q3_data(rng, nullable=True):
+    flat = []
+    for dt in Q3_DTYPES:
+        storage = np.dtype(dt.storage)
+        if np.issubdtype(storage, np.floating):
+            v = rng.normal(size=NSHARDS * CAP).astype(storage)
+        else:
+            v = rng.integers(-1000, 1000,
+                             NSHARDS * CAP).astype(storage)
+        m = jnp.asarray(rng.random(NSHARDS * CAP) < 0.85) \
+            if nullable else None
+        flat.append((jnp.asarray(v), m))
+    pids = jnp.asarray(
+        rng.integers(0, NSHARDS, NSHARDS * CAP).astype(np.int32))
+    nrows = jnp.asarray(
+        rng.integers(0, CAP + 1, NSHARDS).astype(np.int32))
+    return tuple(flat), pids, nrows
+
+
+def _count_collectives(fn, args, prim="all_to_all"):
+    # match the primitive INVOCATION (`= all_gather[`), not its params
+    # (`all_gather_dimension=...` would double-count)
+    return len(re.findall(rf"= {prim}\[",
+                          str(jax.make_jaxpr(fn)(*args))))
+
+
+@pytest.mark.perf
+def test_packed_collective_budget_q3_shape(mesh, rng):
+    """The premerge collective-count budget: a packed q3-shape
+    (6-column nullable) exchange compiles to <= 3 all_to_all ops —
+    counts vector + u32 payload + u8 validity payload — where the
+    per-column path launches >= 8 (here 13: counts + 6 columns + 6
+    masks)."""
+    args = _q3_data(rng)
+    n_packed = _count_collectives(
+        _exchange_fn(mesh, Q3_DTYPES, packed=True), args)
+    n_percol = _count_collectives(
+        _exchange_fn(mesh, Q3_DTYPES, packed=False), args)
+    assert n_packed <= 3, n_packed
+    assert n_percol >= 8, n_percol
+    # acceptance: >= 7 per-column collectives collapse to <= 3
+    assert n_percol >= 7 > n_packed
+
+
+def _bits(a):
+    """Bit view for exact (NaN-payload-preserving) comparison."""
+    if a.dtype == np.bool_:
+        return a.view(np.uint8)
+    kind = a.dtype.str.replace("f", "u").replace("i", "u")
+    return a.view(kind)
+
+
+def _assert_identical(rp, ru, ncols):
+    tot_p = np.asarray(rp[ncols]).reshape(NSHARDS, -1)[:, 0]
+    tot_u = np.asarray(ru[ncols]).reshape(NSHARDS, -1)[:, 0]
+    np.testing.assert_array_equal(tot_p, tot_u)
+    for i in range(ncols):
+        vp, mp = np.asarray(rp[i][0]), np.asarray(rp[i][1])
+        vu, mu = np.asarray(ru[i][0]), np.asarray(ru[i][1])
+        rcap = vp.shape[0] // NSHARDS
+        for s in range(NSHARDS):
+            n = tot_p[s]
+            a = vp.reshape(NSHARDS, rcap)[s, :n]
+            b = vu.reshape(NSHARDS, rcap)[s, :n]
+            np.testing.assert_array_equal(_bits(a), _bits(b),
+                                          err_msg=f"col {i} shard {s}")
+            np.testing.assert_array_equal(
+                mp.reshape(NSHARDS, rcap)[s, :n],
+                mu.reshape(NSHARDS, rcap)[s, :n],
+                err_msg=f"validity {i} shard {s}")
+
+
+def test_packed_roundtrip_bit_identical(mesh, rng):
+    """Mixed i32/i64/f32/f64 + bool + nullable columns, ragged row
+    counts including an empty shard: the packed wire format is
+    bit-identical to the per-column path (NaN payloads included)."""
+    dtypes = [dts.INT32, dts.INT64, dts.FLOAT32, dts.FLOAT64,
+              dts.BOOL, dts.INT64]
+    flat = []
+    for k, dt in enumerate(dtypes):
+        storage = np.dtype(dt.storage)
+        if storage == np.bool_:
+            v = rng.random(NSHARDS * CAP) < 0.5
+        elif np.issubdtype(storage, np.floating):
+            v = np.where(rng.random(NSHARDS * CAP) < 0.1, np.nan,
+                         rng.normal(size=NSHARDS * CAP)).astype(storage)
+        else:
+            v = rng.integers(-10**6, 10**6,
+                             NSHARDS * CAP).astype(storage)
+        m = jnp.asarray(rng.random(NSHARDS * CAP) < 0.8) \
+            if k % 2 == 0 else None  # mix nullable / non-nullable
+        flat.append((jnp.asarray(v), m))
+    pids = jnp.asarray(
+        rng.integers(0, NSHARDS, NSHARDS * CAP).astype(np.int32))
+    nrows = np.array([CAP, 50, 0, 33, CAP, 1, 17, 60], dtype=np.int32)
+    args = (tuple(flat), pids, jnp.asarray(nrows))
+    rp = _exchange_fn(mesh, dtypes, packed=True)(*args)
+    ru = _exchange_fn(mesh, dtypes, packed=False)(*args)
+    _assert_identical(rp, ru, len(dtypes))
+
+
+def test_packed_skewed_one_hot_shard(mesh, rng):
+    """Every row bound for ONE destination (the worst skew): totals are
+    exact, the hot shard receives every live row, cold shards receive
+    zero, and packed == per-column."""
+    dtypes = [dts.INT64, dts.FLOAT64]
+    vals = rng.normal(size=NSHARDS * CAP)
+    keys = rng.integers(0, 100, NSHARDS * CAP).astype(np.int64)
+    flat = ((jnp.asarray(keys), None),
+            (jnp.asarray(vals), jnp.asarray(
+                rng.random(NSHARDS * CAP) < 0.9)))
+    pids = jnp.asarray(np.full(NSHARDS * CAP, 3, dtype=np.int32))
+    nrows = np.array([CAP, 0, CAP, 10, 0, CAP, 7, CAP], dtype=np.int32)
+    args = (flat, pids, jnp.asarray(nrows))
+    # full-capacity slot: a single destination takes every live row
+    rp = _exchange_fn(mesh, dtypes, packed=True, slot=CAP)(*args)
+    ru = _exchange_fn(mesh, dtypes, packed=False, slot=CAP)(*args)
+    _assert_identical(rp, ru, 2)
+    totals = np.asarray(rp[2]).reshape(NSHARDS, -1)[:, 0]
+    assert totals[3] == nrows.sum()
+    assert all(totals[s] == 0 for s in range(NSHARDS) if s != 3)
+
+
+def test_all_gather_cols_packed(mesh, rng):
+    """The broadcast collective rides the same lane packing: one
+    all_gather per width group (+ the counts gather) instead of one per
+    column + mask, results identical."""
+    dtypes = [dts.INT64, dts.FLOAT64, dts.INT32, dts.BOOL]
+    axis = mesh.axis_names[0]
+
+    def make(packed):
+        def step(flat, nrows_arr):
+            cols = [ColVal(dt, v, val)
+                    for (v, val), dt in zip(flat, dtypes)]
+            out, total = all_gather_cols(cols, nrows_arr[0], axis,
+                                         NSHARDS, packed=packed)
+            res = tuple(
+                (c.values, c.validity if c.validity is not None
+                 else jnp.ones_like(c.values, dtype=jnp.bool_))
+                for c in out)
+            return res + (jnp.reshape(total.astype(jnp.int32), (1,)),)
+        return shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                         out_specs=P(axis), check_vma=False)
+
+    flat = []
+    for dt in dtypes:
+        storage = np.dtype(dt.storage)
+        if storage == np.bool_:
+            v = rng.random(NSHARDS * CAP) < 0.5
+        elif np.issubdtype(storage, np.floating):
+            v = rng.normal(size=NSHARDS * CAP).astype(storage)
+        else:
+            v = rng.integers(-99, 99, NSHARDS * CAP).astype(storage)
+        flat.append((jnp.asarray(v),
+                     jnp.asarray(rng.random(NSHARDS * CAP) < 0.8)))
+    nrows = jnp.asarray(
+        np.array([10, 0, CAP, 5, 9, 0, 31, 2], dtype=np.int32))
+    args = (tuple(flat), nrows)
+    n_packed = _count_collectives(make(True), args, prim="all_gather")
+    n_percol = _count_collectives(make(False), args, prim="all_gather")
+    assert n_packed <= 3, n_packed       # counts + u32 + u8 payloads
+    assert n_percol >= 1 + 2 * len(dtypes), n_percol
+    rp, ru = make(True)(*args), make(False)(*args)
+    _assert_identical(rp, ru, len(dtypes))
+
+
+# ------------------------------------------------------- slot planner --
+
+def test_slot_planner_modes():
+    cap = 1024
+    p = SlotPlanner(mode="capacity")
+    assert p.plan("s", 10, cap) == cap
+    p = SlotPlanner(mode="fixed")
+    assert p.plan("s", 100, cap) == 128
+    p = SlotPlanner(mode="adaptive", growth=2.0)
+    assert p.plan("s", 100, cap) == 128
+    p.observe("s", 100, 128, cap, lut=np.zeros(4, np.int32), rows=500)
+    # EMA keeps the bucket sticky for nearby maxima
+    assert p.plan("s", 70, cap) == 128
+    spec = p.speculative("s", cap)
+    assert spec is not None and spec["slot"] == 128
+    # capacity change invalidates the cached prediction
+    assert p.speculative("s", cap * 2) is None
+    # an overflow latches the site off the speculative path and grows
+    # the EMA by the configured factor
+    p.observe_overflow("s")
+    assert p.speculative("s", cap) is None
+    assert p.plan("s", 100, cap) >= 256
+    # the next observed (stats-sized) launch re-arms speculation
+    p.observe("s", 300, 512, cap, lut=np.zeros(4, np.int32))
+    assert p.speculative("s", cap)["slot"] == 512
+
+
+from spark_rapids_tpu.parallel.distributed import DistributedAggregate  # noqa: E402
+
+
+def _agg_for(mesh, key_name):
+    return DistributedAggregate(
+        mesh, in_dtypes=[dts.INT64, dts.FLOAT64],
+        group_exprs=[BoundReference(0, dts.INT64, name=key_name,
+                                    nullable=False)],
+        funcs=[agg.Sum(BoundReference(1, dts.FLOAT64, name="v"))])
+
+
+def _run_agg(dist, keys, vals, nrows):
+    flat = [(jnp.asarray(keys.reshape(-1)), None, None),
+            (jnp.asarray(vals.reshape(-1)), None, None)]
+    outs = dist(flat, jnp.asarray(nrows))
+    (kv, _, kn), (sv, _, _) = outs
+    recv_cap = np.asarray(kv).shape[0] // NSHARDS
+    ngroups = np.asarray(kn).reshape(NSHARDS, -1)[:, 0]
+    got = {}
+    kvs = np.asarray(kv).reshape(NSHARDS, recv_cap)
+    svs = np.asarray(sv).reshape(NSHARDS, recv_cap)
+    for s in range(NSHARDS):
+        for i in range(ngroups[s]):
+            got[int(kvs[s, i])] = svs[s, i]
+    return got
+
+
+def _check_agg(got, keys, vals, nrows):
+    dfs = [pd.DataFrame({"k": keys[s, :nrows[s]],
+                         "v": vals[s, :nrows[s]]})
+           for s in range(NSHARDS)]
+    want = pd.concat(dfs).groupby("k")["v"].sum()
+    assert set(got) == set(want.index)
+    for k, v in want.items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-9)
+
+
+def test_adaptive_speculative_launch_and_overflow(mesh, rng):
+    """The steady-state path: launch #1 sizes from the histogram
+    hostsync and warms the site; launch #2 (same shape) goes
+    speculative — NO stats sync, exactly one budgeted hostsync (the
+    overflow-flag fetch); launch #3 shifts to heavy skew, the
+    speculative slot overflows, the site re-runs at full capacity
+    (results stay exact — rows are never dropped) and the event lands
+    on the recovery trail as a degradable local action."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.utils.hostsync import host_sync_metrics
+    session = TpuSession()
+    try:
+        dist = _agg_for(mesh, "spec_ovf_key")
+        planner = planner_for_session(session)
+        planner.sites.pop(dist._sig, None)
+        nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+
+        # launch 1: cold -> stats-sized (observes the site)
+        keys = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
+        vals = rng.normal(size=(NSHARDS, CAP))
+        _check_agg(_run_agg(dist, keys, vals, nrows), keys, vals, nrows)
+        assert dist.last_stats.get("speculative") is None
+        warm_slot = dist.last_stats["slot"]
+
+        # launch 2: warm -> speculative, hostsync budget == 1
+        keys2 = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
+        vals2 = rng.normal(size=(NSHARDS, CAP))
+        s0 = host_sync_metrics.snapshot_local()
+        got = _run_agg(dist, keys2, vals2, nrows)
+        syncs = host_sync_metrics.snapshot_local() - s0
+        _check_agg(got, keys2, vals2, nrows)
+        assert dist.last_stats.get("speculative") is True
+        assert "overflow" not in dist.last_stats
+        assert syncs <= 1, \
+            f"speculative launch made {syncs} counted hostsyncs"
+
+        # launch 3: CAP *distinct* keys per shard, ALL hashing into one
+        # bucket — the stale LUT funnels every group through a single
+        # (src, dst) slice of CAP rows, far past the warm slot -> the
+        # speculative launch overflows -> full-capacity re-run, exact
+        # results, and a degradable action on the recovery trail
+        from spark_rapids_tpu.parallel.partitioning import (
+            hash_partition_ids)
+        assert warm_slot < CAP
+        cand = np.arange(100_000, 400_000, dtype=np.int64)
+        bids = np.asarray(hash_partition_ids(
+            [ColVal(dts.INT64, jnp.asarray(cand))], dist.buckets))
+        hot = cand[bids == bids[0]][:NSHARDS * CAP]
+        assert hot.size == NSHARDS * CAP, "need one full hot bucket"
+        keys3 = hot.reshape(NSHARDS, CAP)
+        vals3 = rng.normal(size=(NSHARDS, CAP))
+        n_recovery = len(session.recovery_log)
+        got3 = _run_agg(dist, keys3, vals3, nrows)
+        assert dist.last_stats.get("overflow") is True, dist.last_stats
+        _check_agg(got3, keys3, vals3, nrows)  # no dropped rows, ever
+        trail = session.recovery_log[n_recovery:]
+        assert any(r["action"] == "shuffle-slot-capacity-rerun"
+                   and r["fault"] == "shuffle_slot"
+                   for r in trail), trail
+        assert metrics_for_session(session).snapshot()[
+            "slotOverflowRetries"] >= 1
+        # the planner latched the site off speculation; the next launch
+        # re-sizes from its histogram
+        assert planner.speculative(dist._sig, CAP) is None
+        keys4 = rng.integers(0, 40, (NSHARDS, CAP)).astype(np.int64)
+        vals4 = rng.normal(size=(NSHARDS, CAP))
+        _check_agg(_run_agg(dist, keys4, vals4, nrows), keys4, vals4,
+                   nrows)
+        assert dist.last_stats.get("speculative") is None
+    finally:
+        session.stop()
+
+
+def test_packed_toggle_results_equal(mesh, rng):
+    """A/B knob: the same aggregation with packed.enabled=false matches
+    the packed default bit-for-bit (per-column collectives are kept as
+    a first-class fallback, with their own jit-cache signature)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    keys = rng.integers(0, 30, (NSHARDS, CAP)).astype(np.int64)
+    vals = rng.normal(size=(NSHARDS, CAP))
+    nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    results = {}
+    for enabled in (True, False):
+        session = TpuSession({
+            "spark.rapids.tpu.shuffle.packed.enabled": enabled})
+        try:
+            dist = _agg_for(mesh, "toggle_key")
+            assert dist.packed is enabled
+            results[enabled] = _run_agg(dist, keys, vals, nrows)
+        finally:
+            session.stop()
+    assert results[True] == results[False]
+    _check_agg(results[True], keys, vals, nrows)
+
+
+# ------------------------------------------- wire accounting + events --
+
+def test_transient_wire_accounting():
+    """Spill registration reserves a shuffle-received batch's transient
+    payload bytes against the DEVICE budget; the reservation is
+    consumed once, never follows the batch to the host tier, and is
+    released when the batch leaves the device."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.spill import SpillableBatchCatalog
+    cat = SpillableBatchCatalog(device_budget=1 << 30,
+                                host_budget=1 << 30)
+    batch = ColumnarBatch.from_pydict(
+        {"a": np.arange(1000, dtype=np.int64)})
+    base = batch.device_size_bytes()
+    batch.transient_wire_bytes = 4096
+    h = cat.register(batch, priority=0)
+    assert cat.device_bytes == base + 4096
+    assert batch.transient_wire_bytes == 0  # consumed by registration
+    # demotion releases the wire reservation; only the batch payload
+    # lands on the host tier
+    freed = h.spill_to_host()
+    cat.device_bytes -= freed
+    cat.host_bytes += h.size_bytes
+    assert freed == base + 4096
+    assert h.wire_bytes == 0
+    assert cat.device_bytes == 0
+    h.close()
+    assert cat.host_bytes == 0
+    cat.close()
+
+
+def test_coalesce_counts_wire_bytes():
+    """The coalesce goal accounting sees the transient footprint: a
+    wire-stamped batch fills the byte target sooner, so accumulation
+    right after an exchange cannot pin ~2x the goal in HBM."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.coalesce import (
+        TargetSize, coalesce_iterator)
+    from spark_rapids_tpu.memory.spill import SpillableBatchCatalog
+    cat = SpillableBatchCatalog(device_budget=1 << 30,
+                                host_budget=1 << 30)
+
+    def batches():
+        for _ in range(4):
+            b = ColumnarBatch.from_pydict(
+                {"a": np.arange(256, dtype=np.int64)})
+            b.transient_wire_bytes = b.device_size_bytes() * 8
+            yield b
+
+    plain = ColumnarBatch.from_pydict(
+        {"a": np.arange(256, dtype=np.int64)})
+    target = plain.device_size_bytes() * 4
+    out = list(coalesce_iterator(batches(), TargetSize(target),
+                                 catalog=cat))
+    # wire-stamped batches are ~9x their payload, so each flush holds
+    # ONE batch instead of coalescing all four under the byte target
+    assert len(out) == 4
+    assert sum(b.nrows for b in out) == 4 * 256
+    cat.close()
+
+
+def test_distributed_query_stamps_wire_bytes(mesh):
+    """End to end: a distributed query's collected batch carries the
+    exchange payload reservation for downstream spill registration."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession(mesh=mesh)
+    try:
+        rng = np.random.default_rng(3)
+        pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                            "v": rng.normal(size=4000)})
+        df = (session.create_dataframe(pdf).group_by("k")
+              .agg(F.sum(F.col("v")).alias("sv")))
+        batches = df._execute_batches()
+        assert session.last_dist_explain == "distributed"
+        assert len(batches) == 1
+        # consumed-once reservation stamped by DistPlanner.collect
+        assert batches[0].transient_wire_bytes > 0
+        assert session.last_shuffle_stats["bytesMoved"] > 0
+    finally:
+        session.stop()
+
+
+def test_eventlog_queryinfo_shuffle_tpch_dryrun(mesh, tmp_path):
+    """Every distributed TPC-H dryrun query's QueryEnd carries the
+    shuffle wire summary (padding ratio + bytes moved), parsed into
+    QueryInfo.shuffle and aggregated by the profiling report."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.models import tpch, tpch_sql
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import shuffle_wire_stats
+    session = TpuSession(
+        {"spark.rapids.tpu.eventLog.dir": str(tmp_path)}, mesh=mesh)
+    try:
+        data = tpch.gen_tables(sf=0.002)
+        tpch_sql.register(session, tpch.load(session, data))
+        for q in ("q1", "q3"):
+            session.sql(tpch_sql.QUERIES[q]).to_pandas()
+            assert session.last_dist_explain == "distributed", q
+    finally:
+        session.stop()
+    apps = load_logs(str(tmp_path))
+    assert len(apps) == 1
+    dist_queries = [q for a in apps for q in a.queries
+                    if q.explain == "distributed"]
+    assert len(dist_queries) >= 2
+    for q in dist_queries:
+        assert q.shuffle, f"query {q.query_id} missing shuffle summary"
+        assert q.shuffle["bytesMoved"] > 0
+        assert q.shuffle["paddingRatio"] >= 1.0
+        assert q.shuffle["collectives"] >= 1
+    agg_stats = shuffle_wire_stats(apps)
+    assert agg_stats["queries"] >= 2
+    assert agg_stats["bytes_moved"] > 0
+
+
+# --------------------------------------------------------------- chaos --
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("packed", [True, False])
+def test_chaos_packed_exchange_injection_once_per_launch(mesh, packed):
+    """The "shuffle.exchange" checkpoint fires exactly once per packed
+    (or per-column) launch: an armed count=1 rule kills the first
+    exchange-bearing launch, the recovery ladder re-drives, and the
+    answer matches the clean run."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.robustness import inject as I
+    session = TpuSession({
+        "spark.rapids.tpu.shuffle.packed.enabled": packed,
+        "spark.rapids.sql.recovery.backoffMs": 1}, mesh=mesh)
+    try:
+        rng = np.random.default_rng(11)
+        pdf = pd.DataFrame({"k": rng.integers(0, 40, 3000),
+                            "v": rng.normal(size=3000)})
+        df = (session.create_dataframe(pdf).group_by("k")
+              .agg(F.sum(F.col("v")).alias("sv")))
+        want = df.to_pandas().sort_values("k", ignore_index=True)
+        with I.injected("shuffle.exchange", count=1) as rule:
+            got = df.to_pandas().sort_values("k", ignore_index=True)
+            assert rule.fired == 1
+        pd.testing.assert_frame_equal(got, want)
+        faults = [r["fault"] for r in session.recovery_log]
+        assert "shuffle" in faults, faults
+    finally:
+        session.stop()
