@@ -345,14 +345,23 @@ def child_main() -> None:
             # shuffle-wire attribution (parallel/shuffle.py): stays 0
             # for single-device runs; on a mesh the padding ratio is
             # the fused packed exchange's headline diagnostic
-            "shuffle_bytes_moved": 0, "shuffle_padding_ratio": 0.0}
+            "shuffle_bytes_moved": 0, "shuffle_padding_ratio": 0.0,
+            # stage-checkpoint recovery attribution
+            # (robustness/checkpoint.py): resumes stay 0 on clean runs;
+            # bytes written show what the lineage log cost
+            "checkpoint_resume_count": 0, "checkpoint_bytes_written": 0}
 
     def wire_fields(session):
         from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+        from spark_rapids_tpu.robustness.checkpoint import \
+            checkpoint_metrics
         w = metrics_for_session(session).snapshot()
         best["shuffle_bytes_moved"] = w["bytesMoved"]
         best["shuffle_padding_ratio"] = round(
             w["rowsMoved"] / max(w["rowsUseful"], 1), 3)
+        c = checkpoint_metrics.snapshot()
+        best["checkpoint_resume_count"] = c["resumes"]
+        best["checkpoint_bytes_written"] = c["bytesWritten"]
 
     def save():
         if best_file:

@@ -58,4 +58,56 @@ print("hang/corruption spray OK "
       f"(recovery trail: {[r['action'] for r in s.recovery_log]})")
 PY
 
+echo "== checkpoint spray (delay + corrupt + oom across exchange/spill points, checkpointing on AND off) =="
+# distributed two-stage plan on the virtual 8-device mesh; sprayed
+# faults land mid-plan so stage checkpoints actually resume.  Both
+# checkpoint settings must answer with clean-run results — partial
+# recovery is an optimization, never a correctness knob.
+python - <<'PY'
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.memory import retry as _retry  # registers memory.oom
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.checkpoint import checkpoint_metrics
+
+rng = np.random.default_rng(1)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+
+SPRAY = (("shuffle.exchange", "raise"), ("shuffle.exchange", "delay"),
+         ("checkpoint.write", "delay"), ("checkpoint.restore", "corrupt"),
+         ("spill.corrupt.host", "corrupt"), ("memory.oom", "raise"))
+
+for enabled in (True, False):
+    s = TpuSession({
+        "spark.rapids.sql.recovery.checkpoint.enabled": enabled,
+        "spark.rapids.tpu.watchdog.defaultDeadlineMs": 500,
+        "spark.rapids.sql.recovery.backoffMs": 5,
+    }, mesh=make_mesh(8))
+    df = (s.create_dataframe(pdf).group_by("k")
+          .agg(F.sum(F.col("v")).alias("sv"),
+               F.count(F.col("v")).alias("c")).orderBy("k"))
+    want = df.to_pandas()
+    checkpoint_metrics.reset()
+    with I.scoped_rules():
+        for point, kind in SPRAY:
+            I.inject(point, kind=kind, count=2, probability=0.5,
+                     seed=29, delay_s=0.2, all_threads=True)
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(
+        got.sort_values("k", ignore_index=True),
+        want.sort_values("k", ignore_index=True))
+    m = checkpoint_metrics.snapshot()
+    if not enabled:
+        assert m["writes"] == 0, m
+    print(f"checkpoint spray OK (enabled={enabled}, "
+          f"writes={m['writes']} resumes={m['resumes']} "
+          f"invalid={m['invalid']}, "
+          f"trail: {[r['action'] for r in s.recovery_log]})")
+PY
+
 echo "CHAOS OK"

@@ -575,9 +575,44 @@ class DataFrame:
         # every query action runs under the recovery driver: classified
         # transient faults re-drive the plan down the degradation
         # ladder (retry -> spill -> smaller batches -> single device ->
-        # CPU); fatal faults re-raise untouched (robustness/driver.py)
+        # CPU); fatal faults re-raise untouched (robustness/driver.py).
+        # Mesh sessions additionally carry a per-query stage-checkpoint
+        # lineage log so retry-class re-attempts resume from the last
+        # completed exchange stage instead of re-running from source
+        from spark_rapids_tpu.robustness.checkpoint import (
+            CheckpointManager)
         from spark_rapids_tpu.robustness.driver import QueryRetryDriver
-        return QueryRetryDriver(self.session).run(self._attempt_batches)
+        driver = QueryRetryDriver(self.session)
+        mgr = CheckpointManager.for_query(self.session)
+        try:
+            return driver.run(self._attempt_batches)
+        except Exception as exc:
+            # a fatal/exhausted ladder still flushes its full
+            # recovery/watchdog/checkpoint trail to the eventlog, so
+            # post-mortems see what was tried — QueryInfo.recovery is
+            # no longer complete only when the ladder succeeds
+            self._flush_fatal_trail(driver, exc)
+            raise
+        finally:
+            if mgr is not None:
+                mgr.finish()
+
+    def _flush_fatal_trail(self, driver, exc: BaseException) -> None:
+        ev = getattr(self.session, "events", None)
+        if ev is None or not ev.enabled:
+            return
+        from spark_rapids_tpu.robustness.watchdog import watchdog_metrics
+        mgr = getattr(self.session, "checkpoints", None)
+        try:
+            ev.emit(
+                "QueryFatal",
+                queryId=getattr(self.session, "_current_qid", None),
+                error=f"{type(exc).__name__}: {exc}",
+                recovery=list(getattr(driver, "trail", [])),
+                watchdog=watchdog_metrics.snapshot(),
+                checkpoint=mgr.snapshot() if mgr is not None else {})
+        except Exception:
+            pass  # the post-mortem record must never mask the fault
 
     def _attempt_batches(self, mode) -> List[ColumnarBatch]:
         # every attempt runs in a watchdog query scope: stale
@@ -623,7 +658,39 @@ class DataFrame:
             t0 = _time.perf_counter()
             wire = metrics_for_session(self.session)
             wire0 = wire.snapshot()
-            dist = try_distributed(self.session, self.plan)
+            # the envelope opens BEFORE execution so everything the
+            # attempt emits mid-flight — CheckpointWrite/Resume,
+            # RecoveryAction, WatchdogTrip — carries this attempt's
+            # qid and parses into the right QueryInfo (a failed
+            # distributed attempt used to leave them unattributed);
+            # QueryEnd restates the final explain once it is known
+            qid = None
+            if events is not None and events.enabled:
+                qid = next(self.session._query_ids)
+                self.session._current_qid = qid
+                events.emit(
+                    "QueryStart", queryId=qid,
+                    logicalPlan=self.plan.tree_string(),
+                    physicalPlan="DistributedPlan",
+                    explain="distributed attempt")
+
+            def _end(status, shuffle):
+                if qid is not None:
+                    events.emit(
+                        "QueryEnd", queryId=qid, status=status,
+                        durationMs=round(
+                            (_time.perf_counter() - t0) * 1e3, 3),
+                        metrics={}, spill={}, retry={},
+                        distributed=True, shuffle=shuffle,
+                        explain=self.session.last_dist_explain)
+
+            try:
+                dist = try_distributed(
+                    self.session, self.plan,
+                    resume=getattr(mode, "resume", False))
+            except Exception as exc:
+                _end(f"failed: {type(exc).__name__}: {exc}", {})
+                raise
             if dist is not None:
                 # per-query shuffle-wire delta: collectives launched,
                 # bytes moved, padding ratio, overflow retries —
@@ -638,24 +705,13 @@ class DataFrame:
                 # query's QueryInfo.shuffle is present
                 self.session.last_shuffle_stats = \
                     shuffle if shuffle.get("exchanges") else None
-                if events is not None and events.enabled:
-                    # full query envelope for distributed runs so the
-                    # event log keeps per-query attribution (the
-                    # DistExchange events carry the stage stats)
-                    qid = next(self.session._query_ids)
-                    self.session._current_qid = qid
-                    events.emit(
-                        "QueryStart", queryId=qid,
-                        logicalPlan=self.plan.tree_string(),
-                        physicalPlan="DistributedPlan",
-                        explain=self.session.last_dist_explain)
-                    events.emit(
-                        "QueryEnd", queryId=qid, status="success",
-                        durationMs=round(
-                            (_time.perf_counter() - t0) * 1e3, 3),
-                        metrics={}, spill={}, retry={},
-                        distributed=True, shuffle=shuffle)
+                _end("success", shuffle)
                 return dist
+            # unsupported plan: close the envelope cleanly (the
+            # fallback reason rides in explain — not a failure) and
+            # fall through to the single-process engine, which opens
+            # its own
+            _end("success", {})
         overrides = None
         if mode.batch_scale != 1.0:
             # split-batch rung: re-plan with the scan/coalesce batch

@@ -479,6 +479,39 @@ QUERY_RECOVERY_BACKOFF_CAP_MS = conf(
     "raise it to ride out minutes-long maintenance events.", _to_int,
     _positive)
 
+RECOVERY_CHECKPOINT_ENABLED = conf(
+    "spark.rapids.sql.recovery.checkpoint.enabled", True,
+    "Register the post-shuffle output of every completed distributed "
+    "exchange stage (aggregate/join/sort/window) as a stage checkpoint "
+    "in a per-query lineage log (robustness/checkpoint.py). On a "
+    "retryable fault the recovery ladder's re-attempt resumes from the "
+    "last good checkpoint — completed subtrees splice in from the "
+    "spill catalog instead of re-reading sources and re-running "
+    "collectives; recovery cost becomes proportional to the FAILED "
+    "stage, not the whole query. Checkpoints are CRC-verified on "
+    "restore; a corrupt or evicted one is dropped and its subtree "
+    "re-runs.", _to_bool)
+
+RECOVERY_CHECKPOINT_MAX_BYTES = conf(
+    "spark.rapids.sql.recovery.checkpoint.maxBytes", 1 << 30,
+    "Ceiling on the bytes one query's stage-checkpoint lineage log may "
+    "pin across all spill tiers; oldest checkpoints evict first "
+    "(CheckpointEvict events) and their subtrees simply re-run on "
+    "resume. Payloads are additionally counted against the spill "
+    "catalog's device budget while HBM-resident, so checkpoints "
+    "demote under the same watermark pressure as live batches.",
+    _to_int, _positive)
+
+RECOVERY_CHECKPOINT_TIERS = conf(
+    "spark.rapids.sql.recovery.checkpoint.tiers", "device,host,disk",
+    "Spill tiers a stage-checkpoint payload may occupy. "
+    "'device,host,disk' (default) registers at DEVICE and lets "
+    "watermark pressure demote; 'host,disk' demotes to host "
+    "immediately at write (checkpoints never compete for HBM); 'disk' "
+    "pushes straight to the atomic disk frames.", str,
+    lambda v: None if v in ("device,host,disk", "host,disk", "disk")
+    else "must be 'device,host,disk', 'host,disk' or 'disk'")
+
 WATCHDOG_ENABLED = conf(
     "spark.rapids.tpu.watchdog.enabled", True,
     "Enable the hang watchdog (robustness/watchdog.py): monitored "

@@ -448,6 +448,28 @@ class SpillableBatchCatalog:
             else:
                 self.disk_bytes -= h.size_bytes
 
+    def demote(self, h: SpillableHandle, target: str) -> None:
+        """Push one handle down to ``target`` tier immediately,
+        independent of the watermark loop (the checkpoint tier policy:
+        payloads whose conf excludes DEVICE residency leave HBM at
+        registration instead of waiting for pressure).  No-op for a
+        closed/foreign handle or a tier at/below the current one."""
+        if target not in (HOST, DISK):
+            return
+        with self._lock:
+            if h.closed or h.id not in self._handles:
+                return
+            if h.tier == DEVICE:
+                freed = h.spill_to_host()
+                self.device_bytes -= freed
+                self.host_bytes += h.size_bytes
+                self.spilled_to_host_total += h.size_bytes
+            if h.tier == HOST and target == DISK:
+                freed = h.spill_to_disk()
+                self.host_bytes -= freed
+                self.disk_bytes += freed
+                self.spilled_to_disk_total += freed
+
     def ensure_budget(self, extra_needed: int = 0) -> None:
         """Demote coldest handles until budgets hold (the synchronousSpill
         loop, RapidsBufferStore.scala:146)."""

@@ -707,7 +707,11 @@ class DistPlanner:
     # shards); beyond this the planner falls back rather than allocate
     MAX_OUT_ROWS = 1 << 27
 
-    def __init__(self, session, mesh):
+    # exchange-consuming operators: their completed output is a stage
+    # boundary the lineage log may checkpoint (robustness/checkpoint.py)
+    _STAGE_OPS = None  # built lazily (L.Window import order)
+
+    def __init__(self, session, mesh, resume: bool = False):
         self.session = session
         self.mesh = mesh
         self.conf = session.conf
@@ -715,8 +719,38 @@ class DistPlanner:
         # output batch with the exchange payload footprint recorded
         # between here and the final materialization (the transient-2x
         # HBM accounting, memory/spill.py SpillableHandle.wire_bytes)
-        from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+        from spark_rapids_tpu.parallel.shuffle import (
+            metrics_for_session, packed_enabled)
         self._wire0 = metrics_for_session(session).snapshot()
+        # stage-checkpoint lineage: the per-query manager the driver
+        # installed on the session (None when disabled / no catalog);
+        # resume=True only on a retry-class re-attempt — the first
+        # attempt never restores, it only writes
+        self._ckpt = getattr(session, "checkpoints", None)
+        self._resume = bool(resume) and self._ckpt is not None and \
+            self._ckpt.enabled
+        self._packed = packed_enabled()
+
+    @classmethod
+    def _stage_ops(cls):
+        if cls._STAGE_OPS is None:
+            cls._STAGE_OPS = (L.Aggregate, L.Join, L.Sort, L.Window)
+        return cls._STAGE_OPS
+
+    def _checkpointable(self, plan: L.LogicalPlan) -> bool:
+        """Stage boundaries worth checkpointing: every exchange
+        consumer, plus top-N (a Limit over a Sort lowers into one
+        distributed pass of its own)."""
+        if isinstance(plan, self._stage_ops()):
+            return True
+        return isinstance(plan, L.Limit) and \
+            isinstance(plan.child, L.Sort)
+
+    def _count_stages(self, plan: L.LogicalPlan) -> int:
+        """Exchange stages inside a subtree — what a resume of this
+        checkpoint saves (CheckpointResume.stagesSaved)."""
+        n = 1 if self._checkpointable(plan) else 0
+        return n + sum(self._count_stages(c) for c in plan.children)
 
     def _emit_stats(self, op: str, stats, **extra) -> None:
         ev = getattr(self.session, "events", None)
@@ -727,6 +761,27 @@ class DistPlanner:
 
     # -- recursion --------------------------------------------------------
     def run(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
+        """Execute (or dry-run) one subtree, splicing in / registering
+        stage checkpoints at exchange boundaries: on a resume attempt a
+        completed subtree restores from the lineage log — its readers,
+        stages, and collectives never run — and every freshly completed
+        exchange stage registers its post-shuffle frame for the next
+        attempt.  A checkpoint that fails verification or was evicted
+        is dropped by the manager and the subtree re-runs here."""
+        if dry or self._ckpt is None or not self._ckpt.enabled or \
+                not self._checkpointable(plan):
+            return self._dispatch(plan, dry)
+        from spark_rapids_tpu.robustness import checkpoint as cp
+        sid = cp.stage_id(plan, self.mesh, self._packed)
+        if self._resume:
+            frame = self._ckpt.restore(sid, self.mesh)
+            if frame is not None:
+                return frame
+        frame = self._dispatch(plan, dry)
+        self._ckpt.save(sid, frame, stages=self._count_stages(plan))
+        return frame
+
+    def _dispatch(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
         if isinstance(plan, (L.InMemoryRelation, L.FileRelation, L.Range)):
             return self._scan(plan, dry)
         if isinstance(plan, L.Filter):
@@ -1630,10 +1685,12 @@ class DistPlanner:
         return batch
 
 
-def try_distributed(session, plan: L.LogicalPlan):
+def try_distributed(session, plan: L.LogicalPlan, resume: bool = False):
     """Entry point from DataFrame execution: returns a list of
     ColumnarBatches when the plan ran on the mesh, else None (single-
-    process fallback; reason on ``session.last_dist_explain``)."""
+    process fallback; reason on ``session.last_dist_explain``).
+    ``resume=True`` on a recovery re-attempt lets the planner splice in
+    stage checkpoints recorded by the failed attempt."""
     mesh = getattr(session, "mesh", None)
     if mesh is None:
         return None
@@ -1641,7 +1698,7 @@ def try_distributed(session, plan: L.LogicalPlan):
     if not session.conf.get(rc.DISTRIBUTED_ENABLED):
         session.last_dist_explain = "distributed disabled by conf"
         return None
-    planner = DistPlanner(session, mesh)
+    planner = DistPlanner(session, mesh, resume=resume)
     session.last_scan_stats = None  # per-query: no stale sharded stats
     try:
         planner.run(plan, dry=True)  # support pre-flight: no data moves

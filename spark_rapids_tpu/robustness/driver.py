@@ -47,6 +47,14 @@ SPLIT_RETRY = "split"
 DEMOTE_SINGLE_DEVICE = "demote"
 CPU_FALLBACK = "cpu"
 
+# canonical escalation order (every ladder is a subsequence of this)
+RUNG_ORDER = [RETRY, SPILL_RETRY, SPLIT_RETRY, DEMOTE_SINGLE_DEVICE,
+              CPU_FALLBACK]
+
+# rungs that change the plan's shard layout: stage-checkpoint lineage
+# keyed to the mesh layout is stale once any of these runs
+_LAYOUT_CHANGING = (SPLIT_RETRY, DEMOTE_SINGLE_DEVICE, CPU_FALLBACK)
+
 
 @dataclass
 class AttemptMode:
@@ -57,6 +65,13 @@ class AttemptMode:
     use_mesh: bool = True
     cpu_only: bool = False
     batch_scale: float = 1.0
+    # resume capability, orthogonal to the rungs: when True the
+    # distributed planner consults the query's stage-checkpoint lineage
+    # log (robustness/checkpoint.py) and splices completed subtrees in
+    # from the spill catalog instead of re-running them.  Armed for
+    # retry-class re-attempts that keep the shard layout; rungs that
+    # change it (split/demote/cpu) clear the log instead
+    resume: bool = False
 
 
 class RecoveryMetrics:
@@ -128,6 +143,10 @@ class QueryRetryDriver:
         seed = zlib.crc32(label.encode()) if label else \
             (os.getpid() << 20) ^ next(_jitter_seeds)
         self._rng = random.Random(seed)
+        # ladder cursor state (reset by run(); initialized here so
+        # _advance_to is exercisable standalone in unit tests)
+        self._rungs: List[str] = self._ladder()
+        self._pos = 0
 
     # ------------------------------------------------------------ ladder --
     def _ladder(self) -> List[str]:
@@ -192,13 +211,42 @@ class QueryRetryDriver:
                     status=status, actions=self.trail,
                     label=self.label)
 
+    def _advance_to(self, level: str) -> None:
+        """Move the ladder cursor FORWARD (never backward) to the
+        first rung at or above ``level`` in the canonical escalation
+        order — the single place rung-reentry position is computed.  A
+        device OOM never burns plain-retry budget, a degradable fault
+        never burns the spill/split budget, and an entry rung missing
+        from this ladder (demote without a mesh) escalates to the next
+        rung present.  A cursor already past the requested level stays
+        where it is: the ladder only ever moves forward."""
+        want = RUNG_ORDER.index(level)
+        entry_pos = next(
+            (i for i, r in enumerate(self._rungs)
+             if RUNG_ORDER.index(r) >= want), len(self._rungs))
+        self._pos = max(self._pos, entry_pos)
+
+    def _update_lineage(self, rung: str, mode: AttemptMode) -> None:
+        """Stage-checkpoint wiring: retry-class re-attempts keep the
+        shard layout and may resume from the lineage log; layout-
+        changing rungs (split/demote/cpu) invalidate the whole log —
+        its stage ids are keyed to a layout that no longer exists."""
+        mgr = getattr(self.session, "checkpoints", None)
+        if rung in _LAYOUT_CHANGING or not mode.use_mesh or \
+                mode.cpu_only or mode.batch_scale != 1.0:
+            mode.resume = False
+            if mgr is not None:
+                mgr.clear(f"rung:{rung}")
+        elif mgr is not None:
+            mode.resume = True
+
     # --------------------------------------------------------------- run --
     def run(self, attempt: Callable[[AttemptMode], Any]) -> Any:
         mode = AttemptMode()
         if not self.enabled:
             return attempt(mode)
-        ladder = self._ladder()
-        pos = 0  # next rung to use on failure; only moves forward
+        self._rungs = self._ladder()
+        self._pos = 0  # next rung to use on failure; only moves forward
         backoffs = 0
         while True:
             try:
@@ -210,25 +258,15 @@ class QueryRetryDriver:
                 if fault.fatal:
                     self._emit_summary("fatal")
                     raise
-                # advance at least to the fault's entry rung (a device
-                # OOM never burns plain-retry budget, a degradable
-                # fault never burns the spill/split budget); an entry
-                # rung missing from this ladder (demote without a
-                # mesh) escalates to the next rung present
-                order = [RETRY, SPILL_RETRY, SPLIT_RETRY,
-                         DEMOTE_SINGLE_DEVICE, CPU_FALLBACK]
-                level = order.index(self._entry_rung(fault))
-                entry_pos = next(
-                    (i for i, r in enumerate(ladder)
-                     if order.index(r) >= level), len(ladder))
-                pos = max(pos, entry_pos)
-                if pos >= len(ladder):
+                self._advance_to(self._entry_rung(fault))
+                if self._pos >= len(self._rungs):
                     self._emit_summary("exhausted")
                     raise
-                rung = ladder[pos]
-                pos += 1
+                rung = self._rungs[self._pos]
+                self._pos += 1
                 self._record(rung, fault, exc)
                 mode = self._mode_for(rung, mode)
+                self._update_lineage(rung, mode)
                 if rung == SPILL_RETRY:
                     self._spill_device_store()
                 if rung == RETRY and self.backoff_s > 0:

@@ -201,6 +201,14 @@ def clear(point: Optional[str] = None, *,
         _armed = bool(_rules)
 
 
+def clear_point(point: str) -> None:
+    """Disarm every rule armed on one point, regardless of which
+    thread armed it — the targeted cleanup for a test that sprayed a
+    single point across threads (``clear(point)`` sugar, named so the
+    intent reads at the call site)."""
+    clear(point)
+
+
 @contextmanager
 def injected(point: str, **kw):
     """Scope a rule to a ``with`` block — the chaos-test idiom."""
@@ -209,6 +217,26 @@ def injected(point: str, **kw):
         yield rule
     finally:
         remove(rule)
+
+
+@contextmanager
+def scoped_rules():
+    """Hard containment scope: every rule armed inside the block —
+    including rules the body leaked by never removing them, or armed
+    on worker threads with ``all_threads=True`` — is disarmed on exit.
+    Rules armed BEFORE the scope survive it (and stay removable inside
+    it).  Test fixtures wrap each test in one of these so injection
+    rules can never leak across tests, whatever the teardown order."""
+    global _armed
+    with _lock:
+        before = list(_rules)
+    try:
+        yield
+    finally:
+        with _lock:
+            survivors = [r for r in _rules if r in before]
+            _rules[:] = survivors
+            _armed = bool(_rules)
 
 
 def _pick(point: str, mutating: bool) -> Optional[InjectionRule]:

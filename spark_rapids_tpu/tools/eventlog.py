@@ -47,6 +47,14 @@ class QueryInfo:
     # spill-integrity checksum failures (memory/spill.py
     # SpillCorruption events: tier, bufId, detail)
     corruption: List[Dict[str, str]] = field(default_factory=list)
+    # stage-checkpoint lineage events (robustness/checkpoint.py
+    # CheckpointWrite/Resume/Evict/Invalid; "kind" is
+    # write|resume|evict|invalid)
+    checkpoint: List[Dict[str, str]] = field(default_factory=list)
+    # full post-mortem trail of a fatally-failed query (QueryFatal:
+    # error, recovery actions, watchdog + checkpoint snapshots) —
+    # present even when the ladder never succeeded
+    fatal: Dict[str, object] = field(default_factory=dict)
 
     @property
     def succeeded(self) -> bool:
@@ -74,9 +82,12 @@ class AppInfo:
     # recovery actions not attributable to a query (no qid yet when
     # the attempt died before its QueryStart)
     recovery: List[Dict[str, str]] = field(default_factory=list)
-    # un-attributed watchdog / corruption events (same reason)
+    # un-attributed watchdog / corruption / checkpoint / fatal events
+    # (same reason)
     watchdog: List[Dict[str, str]] = field(default_factory=list)
     corruption: List[Dict[str, str]] = field(default_factory=list)
+    checkpoint: List[Dict[str, str]] = field(default_factory=list)
+    fatal: List[Dict[str, object]] = field(default_factory=list)
 
     @property
     def total_duration_ms(self) -> float:
@@ -139,12 +150,34 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.corruption if q is not None
                  else app.corruption).append(info)
+            elif ev in ("CheckpointWrite", "CheckpointResume",
+                        "CheckpointEvict", "CheckpointInvalid"):
+                info = {k: rec[k] for k in
+                        ("stageId", "bytes", "stages", "stagesSaved",
+                         "tier", "reason") if k in rec}
+                info["kind"] = ev[len("Checkpoint"):].lower()
+                q = all_queries.get(rec.get("queryId"))
+                (q.checkpoint if q is not None
+                 else app.checkpoint).append(info)
+            elif ev == "QueryFatal":
+                info = {k: rec[k] for k in
+                        ("error", "recovery", "watchdog", "checkpoint")
+                        if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                if q is not None:
+                    q.fatal = info
+                else:
+                    app.fatal.append(info)
             elif ev == "QueryEnd":
                 q = open_queries.pop(rec["queryId"],
                                      QueryInfo(rec["queryId"]))
                 q.status = rec.get("status", "")
                 q.duration_ms = rec.get("durationMs", 0.0)
                 q.end_ts = rec.get("ts", 0.0)
+                # distributed envelopes open before execution (so
+                # mid-flight events attribute) and restate the final
+                # explain at the end, once it is known
+                q.explain = rec.get("explain") or q.explain
                 q.metrics = rec.get("metrics", {})
                 q.spill = rec.get("spill", {})
                 q.retry = rec.get("retry", {})

@@ -126,6 +126,42 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def checkpoint_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Aggregate stage-checkpoint effectiveness across queries: writes
+    and bytes persisted, resumes and the exchange stages they skipped,
+    evictions and invalidations (robustness/checkpoint.py)."""
+    writes = bytes_ = resumes = skipped = evicts = invalid = 0
+    touched = 0
+    for a in apps:
+        events = list(a.checkpoint) + [c for q in a.queries
+                                       for c in q.checkpoint]
+        if not events:
+            continue
+        touched += 1
+        for c in events:
+            kind = c.get("kind")
+            if kind == "write":
+                writes += 1
+                bytes_ += c.get("bytes", 0)
+            elif kind == "resume":
+                resumes += 1
+                skipped += c.get("stagesSaved", 0)
+            elif kind == "evict":
+                evicts += 1
+            elif kind == "invalid":
+                invalid += 1
+    if not touched:
+        return {}
+    return {
+        "writes": writes,
+        "bytes_written": bytes_,
+        "resumes": resumes,
+        "stages_skipped": skipped,
+        "evictions": evicts,
+        "invalidations": invalid,
+    }
+
+
 def health_check(apps: List[AppInfo]) -> List[str]:
     problems = []
     for a in apps:
@@ -194,6 +230,16 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                 f"{a.session_id} query {q.query_id}", q.watchdog))
             problems.extend(_corruption_problems(
                 f"{a.session_id} query {q.query_id}", q.corruption))
+            problems.extend(_checkpoint_problems(
+                f"{a.session_id} query {q.query_id}", q.checkpoint,
+                recovered=bool(q.recovery)))
+            if q.fatal:
+                acts = [r.get("action") for r in
+                        q.fatal.get("recovery", [])]
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: fatal after "
+                    f"ladder [{', '.join(a for a in acts if a)}] — "
+                    f"{q.fatal.get('error', '?')}")
         for r in a.recovery:
             problems.append(
                 f"{a.session_id}: recovery action {r.get('action')} "
@@ -201,7 +247,47 @@ def health_check(apps: List[AppInfo]) -> List[str]:
         problems.extend(_watchdog_problems(a.session_id, a.watchdog))
         problems.extend(_corruption_problems(a.session_id,
                                              a.corruption))
+        problems.extend(_checkpoint_problems(
+            a.session_id, a.checkpoint, recovered=bool(a.recovery)))
+        for f in a.fatal:
+            problems.append(
+                f"{a.session_id}: fatal query (no attributed id) — "
+                f"{f.get('error', '?')}")
     return problems
+
+
+def _checkpoint_problems(who: str, events: List[dict],
+                         recovered: bool = False) -> List[str]:
+    """Stage-checkpoint health: eviction thrash (the lineage budget
+    cannot hold one stage, so resumes always fall back to full
+    re-runs), recoveries that paid the write cost but resumed nothing
+    (<1 stage saved across the whole ladder), and payloads that
+    failed verification (dropped + subtree re-run — informative, the
+    data was never wrong)."""
+    out = []
+    writes = sum(1 for c in events if c.get("kind") == "write")
+    evicts = sum(1 for c in events if c.get("kind") == "evict")
+    resumes = sum(1 for c in events if c.get("kind") == "resume")
+    crc = [c for c in events if c.get("kind") == "invalid"
+           and str(c.get("reason", "")).startswith("crc")]
+    if writes and evicts >= writes:
+        out.append(
+            f"{who}: checkpoint eviction thrash — {evicts} evictions "
+            f"over {writes} writes; recovery.checkpoint.maxBytes "
+            "cannot hold one stage, so resumes degrade to full "
+            "re-runs")
+    if recovered and writes and not resumes:
+        out.append(
+            f"{who}: recovery re-drove the query but resumed <1 "
+            f"stage from {writes} written checkpoint(s) — the write "
+            "cost bought nothing (evicted/invalidated lineage, or "
+            "the fault landed in the first stage)")
+    if crc:
+        out.append(
+            f"{who}: {len(crc)} checkpoint payload(s) failed "
+            "verification — dropped and re-run from source (never "
+            "wrong bytes); check spill storage health")
+    return out
 
 
 def _watchdog_problems(who: str, events: List[dict]) -> List[str]:
@@ -389,6 +475,16 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"padding={sw['padding_ratio']:.2f}x "
             f"overflowRetries={sw['slot_overflow_retries']} "
             f"perColumnFallbacks={sw['per_column_fallbacks']}")
+    cp = checkpoint_stats(apps)
+    if cp:
+        out.append("\n-- Stage checkpoints --")
+        out.append(
+            f"  writes={cp['writes']} "
+            f"bytes={cp['bytes_written']} "
+            f"resumes={cp['resumes']} "
+            f"stagesSkipped={cp['stages_skipped']} "
+            f"evictions={cp['evictions']} "
+            f"invalidations={cp['invalidations']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

@@ -24,10 +24,14 @@ pytestmark = pytest.mark.chaos
 
 @pytest.fixture(autouse=True)
 def _clean_registry():
+    # scoped_rules() guarantees nothing armed inside a test survives
+    # it, even when the test body leaks a rule or a worker thread armed
+    # one with all_threads=True — teardown ordering is no longer the
+    # only guard against cross-test injection leaks
     I.clear()
     recovery_metrics.reset()
-    yield
-    I.clear()
+    with I.scoped_rules():
+        yield
 
 
 @pytest.fixture(scope="module")

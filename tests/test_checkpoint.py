@@ -1,0 +1,417 @@
+"""Stage-boundary checkpoint suite: partial query recovery.
+
+Oracle pattern as in test_chaos.py — arm a fault, run the query, diff
+against the clean run — plus COUNTER PINS proving partial recovery:
+reader batch pulls and shuffle collectives are counted through the
+injection registry's skip-consumption and the shuffle wire metrics, so
+a resume that silently re-ran completed stages fails the test, not
+just a slower one.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.checkpoint import checkpoint_metrics
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+
+pytestmark = pytest.mark.chaos
+
+NSHARDS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    checkpoint_metrics.reset()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    if jax.device_count() < NSHARDS:
+        pytest.skip("needs the virtual 8-device mesh")
+    return make_mesh(NSHARDS)
+
+
+@pytest.fixture(scope="module")
+def tpch_parquet(tmp_path_factory):
+    from spark_rapids_tpu.models import tpch
+    data = tpch.gen_tables(sf=0.002)
+    d = tmp_path_factory.mktemp("tpch_ckpt")
+    paths = {}
+    for t in ("customer", "orders", "lineitem"):
+        p = d / f"{t}.parquet"
+        data[t].to_parquet(p, index=False)
+        paths[t] = str(p)
+    return paths
+
+
+def _q3(session, paths):
+    from spark_rapids_tpu.models import tpch
+    return tpch.q3({k: session.read.parquet(p)
+                    for k, p in paths.items()})
+
+
+def _two_stage(session, n=4096):
+    """agg -> sort: two exchange stages, the minimal resume shape."""
+    rng = np.random.default_rng(3)
+    pdf = pd.DataFrame({"k": rng.integers(0, 40, n),
+                        "v": rng.normal(size=n)})
+    return (session.create_dataframe(pdf).group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv")).orderBy("k"))
+
+
+def _norm(df, keys):
+    return df.sort_values(keys, ignore_index=True)
+
+
+def _session(mesh, **conf):
+    base = {"spark.rapids.sql.recovery.backoffMs": 1}
+    base.update(conf)
+    return TpuSession(base, mesh=mesh)
+
+
+def _count_rule(point):
+    """Skip-consumption counter: every fire() at ``point`` decrements
+    ``skip`` without ever raising, so (start - rule.skip) is an exact
+    checkpoint-hit count."""
+    return I.inject(point, count=1, skip=1_000_000, all_threads=True)
+
+
+def _hits(rule):
+    return 1_000_000 - rule.skip
+
+
+# ------------------------------------------------------------- lineage keys --
+def test_stage_id_stable_and_layout_sensitive(mesh):
+    from spark_rapids_tpu.robustness import checkpoint as cp
+    s = _session(mesh)
+    df = _two_stage(s)
+    a = cp.stage_id(df.plan, mesh, packed=True)
+    b = cp.stage_id(df.plan, mesh, packed=True)
+    assert a == b  # structural, replayable across attempts
+    assert cp.stage_id(df.plan, mesh, packed=False) != a  # wire layout
+    assert cp.stage_id(df.plan.child, mesh, packed=True) != a  # subtree
+
+
+# --------------------------------------------------------- partial recovery --
+def test_partial_recovery_two_stage_counter_pinned(mesh):
+    """Fault after the first exchange: the aggregate stage's checkpoint
+    resumes, only the sort re-runs — pinned by the exchange-launch
+    counter (exactly ONE extra launch vs the clean run, the re-run of
+    the failed stage), and results are bit-identical."""
+    s = _session(mesh)
+    df = _two_stage(s)
+    launches = _count_rule("shuffle.exchange")
+    want = df.to_pandas()
+    clean = _hits(launches)
+    I.remove(launches)
+    assert clean >= 2  # agg + sort both exchange
+
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    launches = _count_rule("shuffle.exchange")
+    with I.injected("shuffle.exchange", count=1, skip=1):
+        got = df.to_pandas()
+    faulted = _hits(launches)
+    I.remove(launches)
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    assert [r["action"] for r in s.recovery_log] == ["retry"]
+    m = checkpoint_metrics.snapshot()
+    assert m["resumes"] >= 1 and m["stagesSkipped"] >= 1
+    # exact pin: attempt 1 launched everything up to the fault, the
+    # resume re-launched ONLY the failed stage — one extra launch
+    # total, not a full second run
+    assert faulted == clean + 1
+
+
+def test_partial_recovery_tpch_q3(mesh, tpch_parquet):
+    """The acceptance scenario: distributed TPC-H q3, fault at the
+    first shuffle launch (both join exchanges already completed and
+    checkpointed).  The resume must not re-pull a single source batch
+    (io.read checkpoint-hit count stays at the clean run's) nor re-run
+    the completed join collectives, and the answer is identical to the
+    fault-free run."""
+    s = _session(mesh)
+    df = _q3(s, tpch_parquet)
+    wire = metrics_for_session(s)
+    reads = _count_rule("io.read")
+    c0 = wire.snapshot()["collectives"]
+    want = df.to_pandas()
+    clean_reads = _hits(reads)
+    clean_coll = wire.snapshot()["collectives"] - c0
+    I.remove(reads)
+    assert clean_reads > 0 and clean_coll > 0
+    assert s.last_dist_explain == "distributed"
+
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    reads = _count_rule("io.read")
+    c1 = wire.snapshot()["collectives"]
+    with I.injected("shuffle.exchange", count=1):
+        got = df.to_pandas()
+    faulted_reads = _hits(reads)
+    faulted_coll = wire.snapshot()["collectives"] - c1
+    I.remove(reads)
+    pd.testing.assert_frame_equal(got, want)  # incl. row order (top-N)
+    assert s.last_dist_explain == "distributed"
+    m = checkpoint_metrics.snapshot()
+    # the restored join-subtree checkpoint contains both join stages
+    assert m["resumes"] >= 1 and m["stagesSkipped"] >= 2
+    # counter pins: sources were pulled exactly once across BOTH
+    # attempts, and the completed join/broadcast collectives did not
+    # re-run (only the faulted aggregate stage's did)
+    assert faulted_reads == clean_reads
+    assert faulted_coll < 2 * clean_coll
+
+
+def test_checkpoint_disabled_behavior_unchanged(mesh):
+    """checkpoint.enabled=false is HEAD behavior: the retry re-runs
+    from source (collectives double), no checkpoint events or metrics,
+    and the answer is still correct."""
+    s = _session(
+        mesh, **{"spark.rapids.sql.recovery.checkpoint.enabled": False})
+    df = _two_stage(s)
+    launches = _count_rule("shuffle.exchange")
+    want = df.to_pandas()
+    clean = _hits(launches)
+    I.remove(launches)
+
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    launches = _count_rule("shuffle.exchange")
+    with I.injected("shuffle.exchange", count=1, skip=1):
+        got = df.to_pandas()
+    faulted = _hits(launches)
+    I.remove(launches)
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    assert [r["action"] for r in s.recovery_log] == ["retry"]
+    m = checkpoint_metrics.snapshot()
+    assert m["writes"] == 0 and m["resumes"] == 0
+    # full re-run from source: the retry repeats every launch attempt
+    # 1 made (including the one the fault killed)
+    assert faulted == 2 * clean
+
+
+# ----------------------------------------------------------- wrong bytes --
+def test_corrupt_checkpoint_payload_reruns_subtree(mesh, tmp_path):
+    """A fire_mutate bit flip on the checkpoint payload at restore:
+    CRC verification drops the checkpoint (CheckpointInvalid on the
+    eventlog trail), the subtree re-runs, and the result is correct —
+    wrong bytes never surface."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    s = _session(mesh, **{"spark.rapids.tpu.eventLog.dir":
+                          str(tmp_path)})
+    df = _two_stage(s)
+    want = df.to_pandas()
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    with I.injected("checkpoint.restore", kind="corrupt", count=1), \
+            I.injected("shuffle.exchange", count=1, skip=1):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    m = checkpoint_metrics.snapshot()
+    assert m["invalid"] >= 1
+    assert m["resumes"] == 0  # the flipped payload never resumed
+    s.stop()
+    apps = load_logs(str(tmp_path))
+    events = [c for a in apps
+              for c in a.checkpoint +
+              [c for q in a.queries for c in q.checkpoint]]
+    kinds = {c["kind"] for c in events}
+    assert "write" in kinds and "invalid" in kinds
+    assert any(c["kind"] == "invalid" and
+               str(c.get("reason", "")).startswith("crc")
+               for c in events)
+
+
+def test_spill_tier_corruption_drops_checkpoint(mesh):
+    """Checkpoints forced off the DEVICE tier (tiers=host,disk) ride
+    the spill catalog's own CRC gate: a host-restore bit flip raises
+    CorruptionFault inside the manager, which converts it to a dropped
+    checkpoint + full re-run — never a ladder entry, never wrong
+    bytes."""
+    s = _session(
+        mesh,
+        **{"spark.rapids.sql.recovery.checkpoint.tiers": "host,disk"})
+    df = _two_stage(s)
+    want = df.to_pandas()
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    with I.injected("spill.corrupt.host", kind="corrupt", count=1,
+                    all_threads=True), \
+            I.injected("shuffle.exchange", count=1, skip=1):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    m = checkpoint_metrics.snapshot()
+    assert m["invalid"] >= 1
+    # spill_corruption never escaped to the ladder (that would enter
+    # at SPLIT and clear the lineage): only the injected shuffle fault
+    # drove recovery
+    assert set(r["fault"] for r in s.recovery_log) == {"shuffle"}
+
+
+def test_eviction_under_pressure_graceful_full_rerun(mesh):
+    """maxBytes too small for one stage: every write evicts
+    immediately, the resume finds nothing, and the ladder degrades to
+    a clean full re-run — correct answer, CheckpointEvict trail."""
+    s = _session(
+        mesh, **{"spark.rapids.sql.recovery.checkpoint.maxBytes": 1})
+    df = _two_stage(s)
+    want = df.to_pandas()
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    with I.injected("shuffle.exchange", count=1, skip=1):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    m = checkpoint_metrics.snapshot()
+    assert m["evictions"] >= 1
+    assert m["resumes"] == 0
+    assert [r["action"] for r in s.recovery_log] == ["retry"]
+
+
+# ------------------------------------------------------ lineage invalidation --
+def test_layout_changing_rung_clears_lineage(mesh):
+    """A second-stage exchange fault that never heals walks the ladder
+    through resume-armed retries (the aggregate checkpoint restores
+    each time) to the split rung, whose single-device replan changes
+    the layout: the lineage log is cleared — stale stage ids keyed to
+    the mesh must not resurface — and the query still answers."""
+    s = _session(mesh)
+    df = _two_stage(s)
+    want = df.to_pandas()
+    checkpoint_metrics.reset()
+    s.recovery_log.clear()
+    # skip the aggregate's launch so stage 1 completes and
+    # checkpoints; every later exchange launch dies until the plan
+    # leaves the mesh (split replans single-device — no exchange)
+    with I.injected("shuffle.exchange", count=10_000, skip=1):
+        got = df.to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]),
+                                  check_dtype=False)
+    assert [r["action"] for r in s.recovery_log][-1] == "split"
+    assert s.last_dist_explain.startswith("demoted")
+    m = checkpoint_metrics.snapshot()
+    assert m["writes"] >= 1
+    assert m["resumes"] >= 1  # the retry rungs spliced stage 1 back in
+    assert m["invalid"] >= 1  # the clear on the layout-changing rung
+
+
+# ------------------------------------------------------------ driver helper --
+def test_advance_to_forward_only():
+    """The rung-reentry cursor (one _advance_to helper now) only ever
+    moves forward: a lower entry level never rewinds past a rung the
+    ladder already burned, and missing rungs escalate to the next one
+    present."""
+    from spark_rapids_tpu.robustness import driver as D
+    s = TpuSession()
+    d = D.QueryRetryDriver(s)
+    d._rungs = [D.RETRY, D.RETRY, D.SPILL_RETRY, D.SPLIT_RETRY,
+                D.CPU_FALLBACK]
+    d._pos = 0
+    d._advance_to(D.SPILL_RETRY)
+    assert d._pos == 2
+    d._advance_to(D.RETRY)  # never backward
+    assert d._pos == 2
+    # demote is missing from this ladder: escalate to the next rung
+    # at-or-above it (cpu)
+    d._advance_to(D.DEMOTE_SINGLE_DEVICE)
+    assert d._rungs[d._pos] == D.CPU_FALLBACK
+    d._advance_to(D.CPU_FALLBACK)
+    assert d._pos == 4
+    # past the end = exhausted, still never backward
+    d._pos = len(d._rungs)
+    d._advance_to(D.RETRY)
+    assert d._pos == len(d._rungs)
+
+
+# --------------------------------------------------------- injection scope --
+def test_scoped_rules_contains_leaks():
+    outer = I.inject("io.read", count=5)
+    try:
+        with I.scoped_rules():
+            leaked = I.inject("io.read", count=100, all_threads=True)
+            assert leaked in I._rules
+        assert leaked not in I._rules  # scope exit disarmed the leak
+        assert outer in I._rules       # pre-existing rules survive
+        I.fire("io.read")  # consumes outer...
+    except Exception:
+        pass
+    finally:
+        I.clear()
+
+
+def test_clear_point_only_disarms_that_point():
+    a = I.inject("io.read", count=5)
+    b = I.inject("spill.disk", count=5, all_threads=True)
+    I.clear_point("io.read")
+    assert a not in I._rules
+    assert b in I._rules
+    I.clear()
+
+
+# ------------------------------------------------------------- fatal trail --
+def test_fatal_query_flushes_full_trail(mesh, tmp_path):
+    """A ladder that dies on a FATAL fault still flushes its complete
+    recovery trail to the eventlog (QueryFatal), so post-mortems of
+    failed queries see what recovery tried — not just the successful
+    ladders."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": str(tmp_path),
+                    "spark.rapids.sql.recovery.backoffMs": 1})
+    pdf = pd.DataFrame({"x": np.arange(100, dtype=np.float64)})
+
+    def boom(x):
+        raise ValueError("user bug")
+
+    bad = F.udf(boom, returnType="double")
+    df = s.create_dataframe(pdf).select(bad(F.col("x")).alias("y"))
+    with pytest.raises(Exception):
+        df.to_pandas()
+    s.stop()
+    apps = load_logs(str(tmp_path))
+    fatals = [q.fatal for a in apps for q in a.queries if q.fatal] + \
+        [f for a in apps for f in a.fatal]
+    assert fatals, "fatal query left no QueryFatal post-mortem record"
+    assert any("error" in f for f in fatals)
+
+
+# ----------------------------------------------------------- profiling view --
+def test_profiling_checkpoint_sections(mesh, tmp_path):
+    """CheckpointWrite/Resume land in QueryInfo.checkpoint and the
+    profiling report's stage-checkpoint section; eviction thrash is a
+    health-check finding."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (checkpoint_stats,
+                                                  format_report,
+                                                  health_check)
+    s = _session(mesh, **{"spark.rapids.tpu.eventLog.dir":
+                          str(tmp_path)})
+    df = _two_stage(s)
+    with I.injected("shuffle.exchange", count=1, skip=1):
+        df.to_pandas()
+    s.stop()
+    apps = load_logs(str(tmp_path))
+    stats = checkpoint_stats(apps)
+    assert stats["writes"] >= 1 and stats["resumes"] >= 1
+    assert stats["bytes_written"] > 0
+    report = format_report(apps, top=5)
+    assert "Stage checkpoints" in report
+
+    # eviction thrash flagged
+    s2 = _session(mesh, **{
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path / "thrash"),
+        "spark.rapids.sql.recovery.checkpoint.maxBytes": 1})
+    _two_stage(s2).to_pandas()
+    s2.stop()
+    apps2 = load_logs(str(tmp_path / "thrash"))
+    assert any("eviction thrash" in p for p in health_check(apps2))

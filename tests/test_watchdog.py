@@ -30,12 +30,14 @@ TOLERANCE_S = 5.0
 
 @pytest.fixture(autouse=True)
 def _clean_registry():
+    # injection rules are hard-scoped to the test (inject.scoped_rules)
+    # so a leaked delay/corrupt rule can never wedge a later test
     I.clear()
     W.clear_thread()
     W.watchdog_metrics.reset()
     recovery_metrics.reset()
-    yield
-    I.clear()
+    with I.scoped_rules():
+        yield
     W.clear_thread()
 
 
