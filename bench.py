@@ -470,9 +470,73 @@ def child_main() -> None:
     save()
 
 
+# ------------------------------------------------------------- concurrency --
+def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
+    """Serving-mode bench: N client threads hammer TPC-H q6 through one
+    session's admission layer.  Emits ONE JSON line with aggregate
+    rows/s, p50/p95 per-query latency, and admission wait — the
+    metrics the multi-tenant ROADMAP item is judged on.  Runs
+    in-process on whatever platform jax resolves (set JAX_PLATFORMS=cpu
+    for the tunnel-proof CPU-fallback number)."""
+    import threading
+
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession()
+    n_rows = 1 << 20
+    df = session.create_dataframe(gen_host(n_rows))
+    query = make_q6(session, df)
+    query()  # warm the jit cache outside the measured window
+    latencies = []
+    lock = threading.Lock()
+    stop_at = time.monotonic() + seconds
+
+    def client():
+        local = []
+        while time.monotonic() < stop_at:
+            t0 = time.perf_counter()
+            query()
+            local.append(time.perf_counter() - t0)
+        with lock:
+            latencies.extend(local)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client)
+               for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    latencies.sort()
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+
+    def pct(p):
+        return nearest_rank(latencies, p) * 1e3
+
+    adm = session.admission.snapshot() if session.admission else {}
+    print(json.dumps({
+        "metric": "concurrent_q6_rows_per_sec",
+        "value": round(len(latencies) * n_rows / max(wall, 1e-9)),
+        "unit": "rows/s",
+        "concurrency": n_clients,
+        "queries": len(latencies),
+        "p50_latency_ms": round(pct(0.50), 3),
+        "p95_latency_ms": round(pct(0.95), 3),
+        "admission_wait_ms": adm.get("totalWaitMs", 0.0),
+        "admission_peak_concurrent": adm.get("peakConcurrent", 0),
+        "admission_rejected": adm.get("totalRejected", 0),
+    }))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main()
+    elif "--concurrency" in sys.argv:
+        idx = sys.argv.index("--concurrency")
+        n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 4
+        secs = float(os.environ.get("BENCH_CONCURRENCY_SECONDS", "10"))
+        concurrency_main(n, secs)
     else:
         _install_safety_net()
         main()

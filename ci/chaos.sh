@@ -110,4 +110,91 @@ for enabled in (True, False):
           f"trail: {[r['action'] for r in s.recovery_log]})")
 PY
 
+echo "== concurrent spray (N clients, faults keyed per query, isolation gate) =="
+# 8 client threads share one session through the admission layer; half
+# carry injected faults scoped to THEIR query via keyed injection
+# scopes.  The isolation gate: every clean client's result is
+# bit-identical to solo execution, zero robustness events float
+# unattributed, and no clean query's trail shows recovery/corruption.
+python - <<'PY'
+import threading
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.memory import retry as _retry  # registers memory.oom
+from spark_rapids_tpu.robustness import inject as I
+import tempfile
+
+logdir = tempfile.mkdtemp(prefix="tpu-chaos-events-")
+s = TpuSession({
+    "spark.rapids.tpu.eventLog.dir": logdir,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+    # generous: the deadline must catch only the injected wedges, not
+    # honest cold-compile slowness under 8-way thread contention
+    "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000,
+    "spark.rapids.memory.tpu.deviceLimitBytes": 1 << 16,
+})
+rng = np.random.default_rng(3)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+df = (s.create_dataframe(pdf).group_by("k")
+      .agg(F.sum(F.col("v")).alias("sv"),
+           F.count(F.col("v")).alias("c")))
+want = df.to_pandas().sort_values("k", ignore_index=True)
+FLAVORS = {1: ("memory.oom", dict(count=8, all_threads=True)),
+           # wedge LONGER than the 15s deadline so the timeout path is
+           # genuinely exercised under concurrency: the trip must
+           # cancel THIS client's token only (adds ~2x15s to the pass)
+           3: ("memory.oom", dict(count=2, kind="delay", delay_s=20.0,
+                                  all_threads=True)),
+           5: ("spill.corrupt.host", dict(count=2, kind="corrupt",
+                                          all_threads=True)),
+           7: ("io.read", dict(count=2, all_threads=True))}
+results, failures = {}, {}
+
+def client(i):
+    try:
+        if i in FLAVORS:
+            point, kw = FLAVORS[i]
+            with I.scoped_rules(key=f"client{i}"):
+                I.inject(point, **kw)
+                got = df.to_pandas()
+        else:
+            got = df.to_pandas()
+        results[i] = got.sort_values("k", ignore_index=True)
+    except Exception as e:
+        failures[i] = e
+
+ts = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+for i in range(8):
+    if i in results:
+        pd.testing.assert_frame_equal(results[i], want)
+    else:
+        assert i in FLAVORS, f"clean client {i} failed: {failures[i]}"
+        from spark_rapids_tpu.robustness.faults import classify
+        assert classify(failures[i]).kind != "unknown", failures[i]
+s.stop()
+from spark_rapids_tpu.tools.eventlog import load_logs
+app = load_logs(logdir)[0]
+assert app.recovery == [], f"unattributed recovery: {app.recovery}"
+assert app.corruption == [], f"unattributed corruption: {app.corruption}"
+INJECTED = {"device_oom", "io_read", "spill_corruption", "timeout"}
+dirty = [q.query_id for q in app.queries
+         if q.recovery or q.corruption or q.budget]
+for q in app.queries:
+    kinds = {r.get("fault") for r in q.recovery}
+    assert kinds <= INJECTED, (q.query_id, q.recovery)
+clean_ok = [q.query_id for q in app.queries
+            if q.succeeded and not q.recovery and not q.corruption
+            and not q.watchdog and not q.budget]
+assert len(clean_ok) >= 8 - len(FLAVORS) + 1, clean_ok
+print(f"concurrent spray OK ({len(results)}/8 answered, "
+      f"dirty queries={dirty}, maxConcurrent={app.max_concurrent()})")
+PY
+
 echo "CHAOS OK"

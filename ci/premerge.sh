@@ -13,7 +13,7 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_fast
 echo "== unit tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q --maxfail=20 -m 'not chaos'
 
-echo "== chaos suite (fault injection + recovery ladder + hang/corruption spray) =="
+echo "== chaos suite (fault injection + recovery ladder + hang/corruption + concurrent spray w/ isolation gate) =="
 bash ci/chaos.sh
 
 echo "== perf smoke (deterministic budgets: host-sync counts + shuffle collective-count — packed q3-shape exchange <= 3 all_to_all vs >= 8 unpacked; no timing) =="

@@ -572,30 +572,43 @@ class DataFrame:
 
     # --------------------------------------------------------------- actions --
     def _execute_batches(self) -> List[ColumnarBatch]:
-        # every query action runs under the recovery driver: classified
-        # transient faults re-drive the plan down the degradation
-        # ladder (retry -> spill -> smaller batches -> single device ->
-        # CPU); fatal faults re-raise untouched (robustness/driver.py).
-        # Mesh sessions additionally carry a per-query stage-checkpoint
-        # lineage log so retry-class re-attempts resume from the last
-        # completed exchange stage instead of re-running from source
+        # every query action runs inside a QueryContext (serving/): the
+        # per-query scope for event attribution, checkpoint lineage,
+        # budgets and injection scoping — its exit purges stale
+        # thread-ident adoptions so nothing of this query leaks into
+        # the next one that recycles a thread.  Admission (the
+        # byte-weighted fair semaphore) is acquired before any device
+        # work and released on completion or fatal exit; a rejection
+        # is a typed AdmissionFault for THIS query only.
+        #
+        # Under the context, the recovery driver re-drives classified
+        # transient faults down the degradation ladder (retry -> spill
+        # -> smaller batches -> single device -> CPU); fatal faults
+        # re-raise untouched (robustness/driver.py).  Mesh sessions
+        # additionally carry a per-query stage-checkpoint lineage log
+        # so retry-class re-attempts resume from the last completed
+        # exchange stage instead of re-running from source
         from spark_rapids_tpu.robustness.checkpoint import (
             CheckpointManager)
         from spark_rapids_tpu.robustness.driver import QueryRetryDriver
-        driver = QueryRetryDriver(self.session)
-        mgr = CheckpointManager.for_query(self.session)
-        try:
-            return driver.run(self._attempt_batches)
-        except Exception as exc:
-            # a fatal/exhausted ladder still flushes its full
-            # recovery/watchdog/checkpoint trail to the eventlog, so
-            # post-mortems see what was tried — QueryInfo.recovery is
-            # no longer complete only when the ladder succeeds
-            self._flush_fatal_trail(driver, exc)
-            raise
-        finally:
-            if mgr is not None:
-                mgr.finish()
+        from spark_rapids_tpu.serving.context import QueryContext
+        with QueryContext(self.session) as ctx:
+            ctx.admit()
+            driver = QueryRetryDriver(self.session)
+            mgr = CheckpointManager.for_query(self.session)
+            try:
+                return driver.run(self._attempt_batches)
+            except Exception as exc:
+                # a fatal/exhausted ladder still flushes its full
+                # recovery/watchdog/checkpoint trail to the eventlog,
+                # so post-mortems see what was tried —
+                # QueryInfo.recovery is no longer complete only when
+                # the ladder succeeds
+                self._flush_fatal_trail(driver, exc)
+                raise
+            finally:
+                if mgr is not None:
+                    mgr.finish()
 
     def _flush_fatal_trail(self, driver, exc: BaseException) -> None:
         ev = getattr(self.session, "events", None)
@@ -617,13 +630,24 @@ class DataFrame:
     def _attempt_batches(self, mode) -> List[ColumnarBatch]:
         # every attempt runs in a watchdog query scope: stale
         # cancellation tokens from a previous attempt are cleared, and
-        # spark.rapids.tpu.watchdog.queryDeadlineMs (when set) bounds
-        # this attempt's wall time — an overrun is a retryable
-        # TimeoutFault delivered at the next checkpoint, so a hung
-        # attempt re-drives down the ladder instead of blocking forever
+        # the query's deadline budget (serving.deadlineBudgetMs, else
+        # spark.rapids.tpu.watchdog.queryDeadlineMs) bounds this
+        # attempt's wall time — an overrun is a retryable TimeoutFault
+        # delivered at the next checkpoint, so a hung attempt
+        # re-drives down the ladder instead of blocking forever
         from spark_rapids_tpu.robustness import watchdog
-        with watchdog.query_scope(self.session):
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        deadline = ctx.deadline_budget_ms \
+            if ctx is not None and ctx.deadline_budget_ms else None
+        with watchdog.query_scope(self.session, deadline_ms=deadline):
             return self._attempt_batches_impl(mode)
+
+    def _admission_info(self) -> dict:
+        """What admission cost this query (QueryEnd payload)."""
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        return ctx.admission_info() if ctx is not None else {}
 
     def _attempt_batches_impl(self, mode) -> List[ColumnarBatch]:
         import time as _time
@@ -682,6 +706,7 @@ class DataFrame:
                             (_time.perf_counter() - t0) * 1e3, 3),
                         metrics={}, spill={}, retry={},
                         distributed=True, shuffle=shuffle,
+                        admission=self._admission_info(),
                         explain=self.session.last_dist_explain)
 
             try:
@@ -810,7 +835,7 @@ class DataFrame:
                 durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
                 metrics=exec_plan.collect_metrics(), spill=spill,
                 retry={k: retry1[k] - retry0[k] for k in retry1},
-                pipeline=pipeline)
+                pipeline=pipeline, admission=self._admission_info())
 
     def to_arrow(self):
         import pyarrow as pa

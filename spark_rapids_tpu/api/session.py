@@ -95,10 +95,58 @@ class TpuSession:
         # the in-memory mirror of the RecoveryAction event stream, so
         # tests and tools can read the trail without an event-log dir
         self.recovery_log = []
+        # thread-keyed backing stores for the _current_qid /
+        # checkpoints properties: one session serves concurrent
+        # queries, each on its own driving thread, and a single
+        # session-global "the qid in flight" would stamp query A's
+        # recovery/watchdog/checkpoint events with query B's id
+        self._qid_by_ident = {}
+        self._checkpoints_by_ident = {}
         self._current_qid = None  # qid of the attempt in flight
         self.events = EventLogger(
             self.conf.get(rc.EVENT_LOG_DIR) or None, self.session_id,
             conf_snapshot=dict(self.conf.settings))
+
+    # per-query state views: call sites keep reading/writing
+    # ``session._current_qid`` / ``session.checkpoints`` and get the
+    # CALLING query's value — resolution is by effective thread ident
+    # (worker threads adopted via exec/pipeline.worker_attribution
+    # resolve to their driving query)
+    @property
+    def _current_qid(self):
+        from spark_rapids_tpu.serving import context as qc
+        return getattr(self, "_qid_by_ident", {}).get(
+            qc.effective_ident())
+
+    @_current_qid.setter
+    def _current_qid(self, qid) -> None:
+        from spark_rapids_tpu.serving import context as qc
+        ident = qc.effective_ident()
+        if qid is None:
+            self._qid_by_ident.pop(ident, None)
+        else:
+            self._qid_by_ident[ident] = qid
+        ctx = qc.current()
+        if ctx is not None:
+            ctx.set_qid(qid)
+
+    @property
+    def checkpoints(self):
+        from spark_rapids_tpu.serving import context as qc
+        return getattr(self, "_checkpoints_by_ident", {}).get(
+            qc.effective_ident())
+
+    @checkpoints.setter
+    def checkpoints(self, mgr) -> None:
+        from spark_rapids_tpu.serving import context as qc
+        ident = qc.effective_ident()
+        if mgr is None:
+            self._checkpoints_by_ident.pop(ident, None)
+        else:
+            self._checkpoints_by_ident[ident] = mgr
+        ctx = qc.current()
+        if ctx is not None:
+            ctx.checkpoints = mgr
 
     def stop(self) -> None:
         """Close the session's observability resources (SessionEnd)
@@ -148,10 +196,31 @@ class TpuSession:
             frame_codec=native.codec_level(
                 self.conf.get(rc.SHUFFLE_COMPRESSION_CODEC)),
             disk_write_threads=self.conf.get(rc.SPILL_DISK_WRITE_THREADS),
-            integrity_check=self.conf.get(rc.SPILL_INTEGRITY_ENABLED))
+            integrity_check=self.conf.get(rc.SPILL_INTEGRITY_ENABLED),
+            checkpoint_floor=self.conf.get(
+                rc.SERVING_CHECKPOINT_FLOOR_BYTES))
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))
+        # session-level admission control (serving/admission.py): the
+        # query-granularity GpuSemaphore — at most concurrentQueries
+        # in flight, their memory weights fitting in
+        # hbmAdmissionFraction of the device budget; 0 disables
+        n_adm = self.conf.get(rc.SERVING_CONCURRENT_QUERIES)
+        if n_adm > 0:
+            from spark_rapids_tpu.serving.admission import (
+                AdmissionController)
+            self.admission = AdmissionController(
+                max_queries=n_adm,
+                hbm_bytes=int(device_budget * self.conf.get(
+                    rc.SERVING_HBM_ADMISSION_FRACTION)),
+                default_weight=self.conf.get(
+                    rc.SERVING_QUERY_MEMORY_BUDGET),
+                timeout_ms=self.conf.get(
+                    rc.SERVING_ADMISSION_TIMEOUT_MS),
+                max_queue=self.conf.get(rc.SERVING_MAX_QUEUED_QUERIES))
+        else:
+            self.admission = None
 
     # --------------------------------------------------------------- builders --
     @classmethod

@@ -620,6 +620,82 @@ PIPELINE_DEFER_SYNCS = conf(
     "per-batch syncs (the sequential baseline tests/test_pipeline.py "
     "measures against).", _to_bool)
 
+SERVING_CONCURRENT_QUERIES = conf(
+    "spark.rapids.tpu.serving.concurrentQueries", 4,
+    "Maximum queries admitted onto the device concurrently by the "
+    "session-level admission controller (serving/admission.py — the "
+    "query-granularity face of the reference's GpuSemaphore). Queries "
+    "past the limit wait in a fair FIFO queue; 0 disables admission "
+    "control entirely (every query runs immediately, the pre-serving "
+    "behavior).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_HBM_ADMISSION_FRACTION = conf(
+    "spark.rapids.tpu.serving.hbmAdmissionFraction", 0.8,
+    "Fraction of the spill catalog's device budget that admitted "
+    "queries' declared memory weights may claim together — the "
+    "byte-weighted half of the admission semaphore. A query whose "
+    "weight does not fit waits (FIFO) until admitted queries release; "
+    "a single query heavier than the whole budget still admits alone "
+    "rather than deadlocking.", _to_float, _fraction)
+
+SERVING_ADMISSION_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serving.admissionTimeoutMs", 0,
+    "Longest one query may wait in the admission queue before it is "
+    "rejected with a typed AdmissionFault (the queue->reject rung of "
+    "the budget ladder). 0 waits indefinitely.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_MAX_QUEUED_QUERIES = conf(
+    "spark.rapids.tpu.serving.maxQueuedQueries", 0,
+    "Bound on the admission queue depth; a query arriving at a full "
+    "queue is rejected immediately with AdmissionFault('queue-full') "
+    "instead of piling onto a session that is already saturated. 0 "
+    "leaves the queue unbounded.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_QUERY_MEMORY_BUDGET = conf(
+    "spark.rapids.tpu.serving.queryMemoryBudgetBytes", 0,
+    "Per-query ceiling on spill-catalog bytes the query's own batches "
+    "may pin at the DEVICE tier. Exhaustion degrades THAT query: its "
+    "own coldest handles spill to host first (BudgetExhausted event, "
+    "action=spill); a query whose device-resident set still exceeds "
+    "the budget after self-spilling is rejected with a typed "
+    "BudgetExhaustedFault. 0 disables enforcement (the admission "
+    "weight then derives from hbmAdmissionFraction / "
+    "concurrentQueries).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_SYNC_BUDGET = conf(
+    "spark.rapids.tpu.serving.syncBudget", 0,
+    "Per-query ceiling on counted device->host synchronizations "
+    "(utils/hostsync.py). A query that exceeds it is rejected with a "
+    "typed BudgetExhaustedFault at the offending sync — a runaway "
+    "sync loop in one query must not serialize the whole session's "
+    "tunnel. 0 disables.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_DEADLINE_BUDGET_MS = conf(
+    "spark.rapids.tpu.serving.deadlineBudgetMs", 0,
+    "Wall-time deadline applied to EACH execution attempt of a query "
+    "admitted through the serving layer (overrides "
+    "spark.rapids.tpu.watchdog.queryDeadlineMs when set). An overrun "
+    "is a retryable TimeoutFault for that query only; a query that "
+    "overruns on every rung can therefore hold its admission slot "
+    "for up to ladder-length x this budget before exhausting. 0 "
+    "defers to the watchdog conf.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SERVING_CHECKPOINT_FLOOR_BYTES = conf(
+    "spark.rapids.tpu.serving.checkpointEvictionFloorBytes", 0,
+    "Cross-query isolation floor for stage checkpoints: device-tier "
+    "pressure originating from one query demotes that query's own "
+    "handles first, and may not demote ANOTHER query's "
+    "checkpoint-priority payloads below this many device-resident "
+    "bytes (unless the budget cannot be met any other way). 0 "
+    "disables the floor (pure priority order).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

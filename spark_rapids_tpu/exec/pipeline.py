@@ -61,9 +61,11 @@ def worker_attribution(owner_ident: int, stats=None):
     metrics or stops thread-scoped chaos rules from firing."""
     from spark_rapids_tpu.memory.retry import retry_metrics
     from spark_rapids_tpu.robustness import inject, watchdog
+    from spark_rapids_tpu.serving import context as qcontext
     from spark_rapids_tpu.utils import hostsync
     inject.adopt_thread(owner_ident)
     watchdog.adopt_thread(owner_ident)
+    qcontext.adopt_thread(owner_ident)
     hostsync.host_sync_metrics.adopt(owner_ident)
     retry_metrics.adopt(owner_ident)
     if stats is not None:
@@ -75,6 +77,7 @@ def worker_attribution(owner_ident: int, stats=None):
             hostsync.unwatch_uploads()
         retry_metrics.release()
         hostsync.host_sync_metrics.release()
+        qcontext.release_thread()
         watchdog.release_thread()
         inject.release_thread()
 
@@ -88,9 +91,11 @@ def disown_worker(ident: int) -> None:
     next query's thread-local deltas."""
     from spark_rapids_tpu.memory.retry import retry_metrics
     from spark_rapids_tpu.robustness import inject, watchdog
+    from spark_rapids_tpu.serving import context as qcontext
     from spark_rapids_tpu.utils import hostsync
     watchdog.disown(ident)
     inject.disown(ident)
+    qcontext.disown(ident)
     hostsync.host_sync_metrics.disown(ident)
     retry_metrics.disown(ident)
 

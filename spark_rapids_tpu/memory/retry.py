@@ -151,6 +151,15 @@ class RetryMetrics:
         with self.lock:
             self._owner.pop(ident, None)
 
+    def purge_owner(self, owner_ident: int) -> None:
+        """Drop every adoption mapping TO ``owner_ident`` — the
+        query-exit counterpart of disown(): OS ident reuse must not
+        let a finished worker's stale adoption attribute a new
+        query's retries to this dead query
+        (serving/context.QueryContext.__exit__)."""
+        with self.lock:
+            _inject.purge_adoptions(self._owner, owner_ident)
+
     def reset(self) -> None:
         with self.lock:
             self.retry_count = 0

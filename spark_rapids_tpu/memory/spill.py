@@ -41,6 +41,10 @@ DISK = "DISK"
 OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY = -1000
 AGGREGATE_INTERMEDIATE_PRIORITY = 0
 ACTIVE_ON_DECK_PRIORITY = 1000
+# stage checkpoints register at or below this priority
+# (robustness/checkpoint.py CHECKPOINT_PRIORITY); the cross-query
+# eviction floor applies to handles in this class
+CHECKPOINT_TIER_MAX = -1500
 
 
 class IntegrityMetrics:
@@ -102,10 +106,15 @@ class SpillableHandle:
     _ids = itertools.count()
 
     def __init__(self, catalog: "SpillableBatchCatalog",
-                 batch: ColumnarBatch, priority: int):
+                 batch: ColumnarBatch, priority: int,
+                 owner: Optional[int] = None):
         self.id = next(SpillableHandle._ids)
         self.catalog = catalog
         self.priority = priority
+        # owning query (the QueryContext owner ident that registered
+        # this batch; None outside any query scope).  Drives per-owner
+        # budgets and cross-query eviction-floor isolation.
+        self.owner = owner
         self.tier = DEVICE
         self.size_bytes = batch.device_size_bytes()
         # transient shuffle-wire reservation (ColumnarBatch
@@ -364,9 +373,23 @@ class SpillableBatchCatalog:
                  spill_dir: Optional[str] = None,
                  frame_codec: int = 2,
                  disk_write_threads: int = 2,
-                 integrity_check: bool = True):
+                 integrity_check: bool = True,
+                 checkpoint_floor: int = 0):
         self.device_budget = device_budget
         self.host_budget = host_budget
+        # cross-query isolation floor: device pressure originating
+        # from one owner may not demote ANOTHER owner's checkpoint-
+        # priority handles below this many device-resident bytes
+        # (spark.rapids.tpu.serving.checkpointEvictionFloorBytes)
+        self.checkpoint_floor = int(checkpoint_floor)
+        # per-owner DEVICE-tier byte budgets (QueryContext installs
+        # one for the duration of its query when
+        # serving.queryMemoryBudgetBytes is set)
+        self._owner_budgets: Dict[int, int] = {}
+        # incremental per-owner DEVICE-tier byte counters, maintained
+        # alongside device_bytes at every tier transition so the
+        # per-register budget check is O(1), not a catalog scan
+        self._owner_device: Dict[int, int] = {}
         # spark.rapids.memory.spill.integrityCheck.enabled: checksum
         # every payload leaving DEVICE, verify on every restore
         self.integrity_check = bool(integrity_check)
@@ -404,20 +427,115 @@ class SpillableBatchCatalog:
 
     # ------------------------------------------------------------- interface --
     def register(self, batch: ColumnarBatch,
-                 priority: int = AGGREGATE_INTERMEDIATE_PRIORITY
-                 ) -> SpillableHandle:
-        h = SpillableHandle(self, batch, priority)
+                 priority: int = AGGREGATE_INTERMEDIATE_PRIORITY,
+                 owner: Optional[int] = None) -> SpillableHandle:
+        if owner is None:
+            # auto-tag with the registering query's scope (covers the
+            # pipeline worker, checkpoint saves, coalesce — any site
+            # running inside, or adopted into, a QueryContext)
+            from spark_rapids_tpu.serving import context as _qc
+            ctx = _qc.current()
+            owner = ctx.owner_ident if ctx is not None else None
+        h = SpillableHandle(self, batch, priority, owner=owner)
         with self._lock:
             self._handles[h.id] = h
             self._issued_ids.add(h.id)
             self.device_bytes += h.size_bytes + h.wire_bytes
+            self._owner_device_adjust(h.owner,
+                                      h.size_bytes + h.wire_bytes)
         # the wire reservation is consumed by registration: a later
         # re-registration of the same batch (coalesce after pipeline)
         # must not re-reserve the exchange payload headroom
         if h.wire_bytes:
             batch.transient_wire_bytes = 0
-        self.ensure_budget()
+        self.ensure_budget(for_owner=owner)
+        self._enforce_owner_budget(h)
         return h
+
+    # ------------------------------------------------------- per-owner budgets --
+    def set_owner_budget(self, owner: int, budget: int) -> None:
+        with self._lock:
+            self._owner_budgets[owner] = int(budget)
+
+    def clear_owner_budget(self, owner: int) -> None:
+        with self._lock:
+            self._owner_budgets.pop(owner, None)
+
+    def owner_device_bytes(self, owner: int) -> int:
+        with self._lock:
+            return self._owner_device_bytes(owner)
+
+    def _owner_device_bytes(self, owner: int) -> int:
+        return self._owner_device.get(owner, 0)
+
+    def _owner_device_adjust(self, owner: Optional[int],
+                             delta: int) -> None:
+        """Mirror every DEVICE-tier byte movement into the per-owner
+        counter (called wherever ``device_bytes`` changes)."""
+        if owner is None:
+            return
+        new = self._owner_device.get(owner, 0) + delta
+        if new:
+            self._owner_device[owner] = new
+        else:
+            self._owner_device.pop(owner, None)
+
+    def _demote_to_host_locked(self, h: SpillableHandle) -> int:
+        """One DEVICE->HOST transition with all its accounting (caller
+        holds the lock).  Returns the device bytes freed — the batch
+        plus any transient wire reservation; only the batch payload
+        itself lands on the host tier."""
+        freed = h.spill_to_host()
+        self.device_bytes -= freed
+        self._owner_device_adjust(h.owner, -freed)
+        self.host_bytes += h.size_bytes
+        self.spilled_to_host_total += h.size_bytes
+        return freed
+
+    def _enforce_owner_budget(self, h: SpillableHandle) -> None:
+        """The per-query memory-budget ladder, run after each of the
+        owner's registrations: over budget, the owner's OWN coldest
+        device handles demote to host (degrade — other queries'
+        batches are untouched); when self-spilling everything else
+        still leaves the owner over (the new batch alone busts the
+        budget), the owning query is rejected with a typed
+        BudgetExhaustedFault.  Other queries never pay."""
+        owner = h.owner
+        if owner is None:
+            return
+        with self._lock:
+            budget = self._owner_budgets.get(owner)
+            if budget is None or self._owner_device_bytes(owner) <= budget:
+                return
+            victims = sorted(
+                (x for x in self._handles.values()
+                 if x.owner == owner and x.tier == DEVICE
+                 and x.id != h.id),
+                key=lambda x: (x.priority, x.last_access, x.id))
+            used = self._owner_device_bytes(owner)
+            for v in victims:
+                if used <= budget:
+                    break
+                used -= self._demote_to_host_locked(v)
+            spilled = bool(victims)
+            over = used > budget
+        if spilled:
+            # the self-spill may push the HOST tier over ITS watermark
+            self.ensure_budget(for_owner=owner)
+        from spark_rapids_tpu.serving import context as _qc
+        ctx = _qc.current()
+        if ctx is None:
+            return
+        if spilled:
+            ctx.note_memory_pressure(used, spilled=True)
+        if over:
+            # the rejection propagates out of register(): the caller
+            # never receives the handle, so it must not stay in the
+            # catalog — a leaked registration would pin its bytes for
+            # the session's life and bill spurious pressure to the
+            # NEXT query on a recycled thread ident
+            self.remove(h)
+            ctx.note_memory_pressure(used, spilled=False)  # raises
 
     def unspill(self, h: SpillableHandle, batch: ColumnarBatch) -> None:
         """Promote back to DEVICE after materialize (shouldUnspill=true
@@ -434,7 +552,8 @@ class SpillableBatchCatalog:
             h._device = batch
             h._host = None
             self.device_bytes += h.size_bytes
-        self.ensure_budget()
+            self._owner_device_adjust(h.owner, h.size_bytes)
+        self.ensure_budget(for_owner=h.owner)
 
     def remove(self, h: SpillableHandle) -> None:
         with self._lock:
@@ -443,6 +562,8 @@ class SpillableBatchCatalog:
             del self._handles[h.id]
             if h.tier == DEVICE:
                 self.device_bytes -= h.size_bytes + h.wire_bytes
+                self._owner_device_adjust(
+                    h.owner, -(h.size_bytes + h.wire_bytes))
             elif h.tier == HOST:
                 self.host_bytes -= h.size_bytes
             else:
@@ -460,42 +581,83 @@ class SpillableBatchCatalog:
             if h.closed or h.id not in self._handles:
                 return
             if h.tier == DEVICE:
-                freed = h.spill_to_host()
-                self.device_bytes -= freed
-                self.host_bytes += h.size_bytes
-                self.spilled_to_host_total += h.size_bytes
+                self._demote_to_host_locked(h)
             if h.tier == HOST and target == DISK:
                 freed = h.spill_to_disk()
                 self.host_bytes -= freed
                 self.disk_bytes += freed
                 self.spilled_to_disk_total += freed
 
-    def ensure_budget(self, extra_needed: int = 0) -> None:
+    def ensure_budget(self, extra_needed: int = 0,
+                      for_owner: Optional[int] = None) -> None:
         """Demote coldest handles until budgets hold (the synchronousSpill
-        loop, RapidsBufferStore.scala:146)."""
+        loop, RapidsBufferStore.scala:146).  ``for_owner`` attributes
+        the pressure to the query that caused it: that owner's own
+        handles demote first, and other owners' checkpoint-priority
+        payloads are protected by the eviction floor."""
         with self._lock:
-            self._spill_tier(DEVICE, self.device_budget - extra_needed)
+            self._spill_tier(DEVICE, self.device_budget - extra_needed,
+                             for_owner)
             self._spill_tier(HOST, self.host_budget)
 
-    def _spill_tier(self, tier: str, budget: int) -> None:
+    def _floor_protected(self, h: SpillableHandle,
+                         for_owner: Optional[int],
+                         device_left: Dict[int, int]) -> bool:
+        """Cross-query checkpoint floor: pressure from ``for_owner``
+        may not demote ANOTHER owner's checkpoint-priority handle once
+        that owner's device-resident checkpoint bytes would drop below
+        the floor.  An owner's own handles are never protected from
+        its own pressure."""
+        if not self.checkpoint_floor or h.owner is None or \
+                h.owner == for_owner or h.priority > CHECKPOINT_TIER_MAX:
+            return False
+        left = device_left.get(h.owner)
+        if left is None:
+            left = sum(x.size_bytes for x in self._handles.values()
+                       if x.owner == h.owner and x.tier == DEVICE
+                       and x.priority <= CHECKPOINT_TIER_MAX)
+            device_left[h.owner] = left
+        if left - h.size_bytes < self.checkpoint_floor:
+            return True
+        device_left[h.owner] = left - h.size_bytes
+        return False
+
+    def _spill_tier(self, tier: str, budget: int,
+                    for_owner: Optional[int] = None) -> None:
         used = self.device_bytes if tier == DEVICE else self.host_bytes
         if used <= budget:
             return
-        # coldest first: lowest priority, then least-recently accessed
+        # coldest first: lowest priority, then — under attributed
+        # pressure — the CAUSING owner's handles before a co-tenant's
+        # within the same priority class, then least-recently
+        # accessed.  Priority stays dominant: a neighbor's cold
+        # shuffle output must still demote before the causing query's
+        # own pinned on-deck batch, else every registration under
+        # pressure would thrash its own working set device<->host
+        def key(h: SpillableHandle):
+            foreign = 1 if (for_owner is not None and
+                            h.owner != for_owner) else 0
+            return (h.priority, foreign, h.last_access, h.id)
+
         candidates = sorted(
             (h for h in self._handles.values() if h.tier == tier),
-            key=lambda h: (h.priority, h.last_access, h.id))
+            key=key)
         if tier == DEVICE:
+            device_left: Dict[int, int] = {}
+            deferred = []
             for h in candidates:
                 if used <= budget:
                     break
-                # freed = batch + wire reservation (device side); only
-                # the batch payload itself lands on the host tier
-                freed = h.spill_to_host()
-                self.device_bytes -= freed
-                self.host_bytes += h.size_bytes
-                self.spilled_to_host_total += h.size_bytes
-                used -= freed
+                if self._floor_protected(h, for_owner, device_left):
+                    deferred.append(h)
+                    continue
+                used -= self._demote_to_host_locked(h)
+            # the floor is isolation, not a leak: if the budget cannot
+            # be met any other way, protected handles demote after all
+            for h in deferred:
+                if used <= budget:
+                    break
+                used -= self._demote_to_host_locked(h)
             if self.host_bytes > self.host_budget:
                 self._spill_tier(HOST, self.host_budget)
             return

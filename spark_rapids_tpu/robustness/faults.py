@@ -160,6 +160,42 @@ class ShuffleSlotOverflow(Exception):
         self.capacity = capacity
 
 
+class AdmissionFault(Exception):
+    """The serving layer rejected this query at (or after) admission:
+    the fair admission queue timed out / overflowed, or the query blew
+    through a per-query budget after the in-query degradations (queue,
+    then spill) were exhausted.  FATAL *for this query* by design — a
+    rejection is a typed answer the client must see, and re-driving it
+    down the ladder would re-consume the very capacity the admission
+    layer is protecting.  Other queries on the session are untouched:
+    that containment is the whole point (serving/admission.py)."""
+
+    kind = "admission"
+    severity = FATAL
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(
+            f"query rejected by admission control ({reason})"
+            + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class BudgetExhaustedFault(AdmissionFault):
+    """A per-query budget (memory bytes, host syncs, deadline) ran out
+    and the degradation ladder for budgets — queue, spill own batches,
+    reject — reached its last rung.  Carries which budget died so the
+    BudgetExhausted event and the client error are actionable."""
+
+    kind = "budget"
+
+    def __init__(self, budget: str, used, limit):
+        super().__init__(
+            "budget", f"{budget} budget exhausted ({used} > {limit})")
+        self.budget = budget
+        self.used = used
+        self.limit = limit
+
+
 class HostSyncError(RuntimeError):
     """Multi-host phase boundary failed: the cross-process stats
     all-gather timed out or the controllers diverged.  Retryable — the
@@ -186,6 +222,10 @@ def classify(exc: BaseException) -> Fault:
     if isinstance(exc, InjectedFault):
         return Fault(exc.kind, exc.severity)
     if isinstance(exc, TimeoutFault):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, AdmissionFault):
+        # covers BudgetExhaustedFault too: a typed per-query rejection
+        # the ladder must hand back, never absorb
         return Fault(exc.kind, exc.severity)
     if isinstance(exc, CorruptionFault):
         return Fault(exc.kind, exc.severity)

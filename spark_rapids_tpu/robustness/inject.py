@@ -85,7 +85,8 @@ class InjectionRule:
                  seed: Optional[int] = None,
                  exc: Optional[Callable[..., BaseException]] = None,
                  all_threads: bool = False, kind: str = "raise",
-                 delay_s: Optional[float] = None):
+                 delay_s: Optional[float] = None,
+                 scope: Optional[str] = None):
         if point not in _POINTS:
             raise KeyError(
                 f"unknown injection point {point!r}; known: "
@@ -103,9 +104,23 @@ class InjectionRule:
         self._rng = random.Random(seed)
         self.exc = exc or _POINTS[point] or F.InjectedFault
         self.thread_id = None if all_threads else threading.get_ident()
+        # who armed this rule (effective ident: an adopted pipeline
+        # worker arms on behalf of its driving thread) — scoped_rules
+        # containment removes exactly ITS thread-tree's rules on exit,
+        # so concurrent scopes on other threads never clobber each
+        # other's armed rules
+        _ident = threading.get_ident()
+        self.armer = _adopted.get(_ident, _ident)
+        # keyed scope (multi-tenant chaos): the rule only fires on
+        # threads whose active scope key matches — explicit arg, or
+        # inherited from the enclosing scoped_rules(key=...) block
+        self.scope = scope if scope is not None else \
+            _arming_scope()
         self.fired = 0
 
     def _matches_thread(self) -> bool:
+        if self.scope is not None and self.scope != _active_scope():
+            return False
         if self.thread_id is None:
             return True
         ident = threading.get_ident()
@@ -158,6 +173,54 @@ def disown(ident: int) -> None:
     abandoning a wedged worker): the zombie must not keep consuming
     rule budgets armed for the driving thread's next attempt."""
     _adopted.pop(ident, None)
+
+
+def purge_adoptions(mapping: Dict[int, int], owner_ident: int) -> None:
+    """Drop every entry of a worker->owner adoption dict that maps TO
+    ``owner_ident`` — THE query-exit cleanup shared by every adoption
+    registry (inject, watchdog, hostsync, retry, serving/context): the
+    OS reuses thread idents, so an adoption a finished worker left
+    behind would bind a future thread with the recycled ident to a
+    dead query.  Callers holding a lock call this under it; the
+    module-level dicts rely on GIL-atomic dict ops as everywhere else.
+    """
+    for ident in [i for i, o in list(mapping.items())
+                  if o == owner_ident]:
+        mapping.pop(ident, None)
+
+
+def purge_owner(owner_ident: int) -> None:
+    """This registry's query-exit cleanup (see :func:`purge_adoptions`
+    and serving/context.QueryContext.__exit__)."""
+    purge_adoptions(_adopted, owner_ident)
+
+
+# scope keys (multi-tenant chaos): owner thread ident -> active key.
+# Worker threads resolve through _adopted, so a rule scoped to one
+# query fires in that query's pipeline worker but never in another
+# query's — even with all_threads=True.
+_scopes: Dict[int, str] = {}
+# thread ident -> key new rules armed by that thread inherit
+_arming: Dict[int, str] = {}
+
+
+def _active_scope() -> Optional[str]:
+    ident = threading.get_ident()
+    return _scopes.get(_adopted.get(ident, ident))
+
+
+def _arming_scope() -> Optional[str]:
+    return _arming.get(threading.get_ident())
+
+
+# thread trees (owner idents) with a scoped_rules block currently
+# open: a rule armed by a tree with its OWN open scope is that
+# scope's to clean up; a rule armed by any other thread is an orphan
+# the enclosing scope removes on exit (the test-fixture containment
+# guarantee)
+_open_scopes: Dict[int, int] = {}
+
+
 # cheap hot-path guard: fire() is threaded through per-batch loops and
 # must cost one attribute read when nothing is armed
 _armed = False
@@ -220,21 +283,69 @@ def injected(point: str, **kw):
 
 
 @contextmanager
-def scoped_rules():
+def scoped_rules(key: Optional[str] = None):
     """Hard containment scope: every rule armed inside the block —
     including rules the body leaked by never removing them, or armed
-    on worker threads with ``all_threads=True`` — is disarmed on exit.
-    Rules armed BEFORE the scope survive it (and stay removable inside
-    it).  Test fixtures wrap each test in one of these so injection
-    rules can never leak across tests, whatever the teardown order."""
+    on worker threads with ``all_threads=True`` — is disarmed on exit,
+    UNLESS the arming thread tree has its own scoped_rules block still
+    open (then that scope's exit owns the cleanup — one concurrent
+    client finishing must never disarm another client's still-armed
+    rules).  Rules armed BEFORE the scope survive it (and stay
+    removable inside it).  Test fixtures wrap each test in one of
+    these so injection rules can never leak across tests, whatever
+    the teardown order.
+
+    With ``key``, the scope is **keyed** (the multi-tenant chaos
+    form): rules armed inside the block carry the key and only fire on
+    threads whose active scope matches — this thread for the duration
+    of the block, plus any worker adopted into it.  Concurrent clients
+    each wrap their query in ``scoped_rules(key=client_id)``: client
+    A's rules provably cannot fire inside client B's query, whatever
+    ``all_threads``/probability knobs the rules use."""
     global _armed
+    ident = threading.get_ident()
+    my_tree = _adopted.get(ident, ident)
+    prev_scope = _scopes.get(ident)
+    prev_arming = _arming.get(ident)
+    if key is not None:
+        _scopes[ident] = key
+        _arming[ident] = key
     with _lock:
         before = list(_rules)
+        _open_scopes[my_tree] = _open_scopes.get(my_tree, 0) + 1
     try:
         yield
     finally:
+        if key is not None:
+            if prev_scope is None:
+                _scopes.pop(ident, None)
+            else:
+                _scopes[ident] = prev_scope
+            if prev_arming is None:
+                _arming.pop(ident, None)
+            else:
+                _arming[ident] = prev_arming
         with _lock:
-            survivors = [r for r in _rules if r in before]
+            # close MY scope first so my own rules are not protected
+            # by it, then remove every rule armed inside the block
+            # except those owned by another LIVE tree's still-open
+            # scope.  The liveness check keeps the fixture guarantee
+            # against scopes whose thread died without exiting (a
+            # killed client can never run its own cleanup), and prunes
+            # their stale _open_scopes entries so a recycled ident
+            # cannot inherit the protection
+            n = _open_scopes.get(my_tree, 1) - 1
+            if n:
+                _open_scopes[my_tree] = n
+            else:
+                _open_scopes.pop(my_tree, None)
+            live = {t.ident for t in threading.enumerate()}
+            for tree in [t for t in _open_scopes if t not in live]:
+                del _open_scopes[tree]
+            survivors = [r for r in _rules
+                         if r in before or
+                         (r.armer != my_tree and
+                          _open_scopes.get(r.armer, 0) > 0)]
             _rules[:] = survivors
             _armed = bool(_rules)
 

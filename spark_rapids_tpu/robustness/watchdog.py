@@ -167,6 +167,22 @@ def disown(ident: int) -> None:
             del _sections[sid]
 
 
+def purge_owner(owner_ident: int) -> None:
+    """Drop every adoption mapping TO ``owner_ident`` plus any token
+    still parked for it — the query-exit counterpart of
+    :func:`disown` (serving/context.QueryContext.__exit__).  The OS
+    reuses thread idents: a stale worker adoption would deliver a NEW
+    query's cancellation to this dead query's token, and a stale
+    parked token would cancel whatever unrelated query next runs on a
+    recycled owner ident."""
+    global _any_pending
+    from spark_rapids_tpu.robustness.inject import purge_adoptions
+    purge_adoptions(_adopted, owner_ident)
+    with _lock:
+        _pending.pop(owner_ident, None)
+        _any_pending = bool(_pending)
+
+
 def _effective_ident() -> int:
     ident = threading.get_ident()
     return _adopted.get(ident, ident)
@@ -269,7 +285,14 @@ def _monitor_loop() -> None:
                     _any_pending = True
                 watchdog_metrics.trip(s.point, overrun_ms)
                 try:
+                    # stamp the OWNING query's id: the monitor thread
+                    # has no query of its own, and under concurrent
+                    # queries a session-global "current qid" would
+                    # attribute this trip to whichever query last
+                    # started (serving/context resolves by owner)
+                    from spark_rapids_tpu.serving import context as qc
                     _emit(s.session, "WatchdogTrip", point=s.point,
+                          queryId=qc.qid_for_ident(s.owner, s.session),
                           deadlineMs=s.deadline_s * 1e3,
                           elapsedMs=round(elapsed_ms, 3),
                           overrunMs=round(overrun_ms, 3))

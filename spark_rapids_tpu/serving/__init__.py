@@ -1,0 +1,24 @@
+"""Session-level serving layer: admission control and query isolation.
+
+Multi-tenant serving (ROADMAP open item 3) means hundreds of
+concurrent small queries sharing one mesh, and the robustness contract
+tightens from "this query recovers" to "this query's failure cannot
+become another query's wrong answer or crash".  Two pieces:
+
+- ``admission`` — the byte-weighted, fair FIFO admission semaphore
+  (the reference GpuSemaphore at query granularity): bounds concurrent
+  queries and their summed memory weights, with typed
+  ``AdmissionFault`` rejection on queue overflow / wait timeout.
+- ``context`` — ``QueryContext``: scopes every formerly-global piece
+  of robustness state (query-id event attribution, checkpoint lineage,
+  injection scoping, watchdog tokens, host-sync/retry attribution,
+  spill ownership and budgets) to one query, and purges stale
+  thread-ident adoptions at exit so OS ident reuse can never splice
+  two queries' state.
+
+See docs/robustness.md "Admission control & query isolation".
+"""
+
+from spark_rapids_tpu.serving.admission import (  # noqa: F401
+    AdmissionController, AdmissionTicket)
+from spark_rapids_tpu.serving.context import QueryContext  # noqa: F401

@@ -55,6 +55,12 @@ class QueryInfo:
     # error, recovery actions, watchdog + checkpoint snapshots) —
     # present even when the ladder never succeeded
     fatal: Dict[str, object] = field(default_factory=dict)
+    # serving-layer admission cost (QueryEnd admission dict:
+    # waitMs, weightBytes); empty when admission control is off
+    admission: Dict[str, float] = field(default_factory=dict)
+    # per-query budget ladder events (serving BudgetExhausted:
+    # budget, used, limit, action=spill|reject)
+    budget: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -88,6 +94,28 @@ class AppInfo:
     corruption: List[Dict[str, str]] = field(default_factory=list)
     checkpoint: List[Dict[str, str]] = field(default_factory=list)
     fatal: List[Dict[str, object]] = field(default_factory=list)
+    # serving-layer admission stream (Admission grants are emitted
+    # before the query draws its id, so they live at session level)
+    # and typed rejections (AdmissionReject: reason, waitMs)
+    admission: List[Dict[str, float]] = field(default_factory=list)
+    rejections: List[Dict[str, str]] = field(default_factory=list)
+    # un-attributed BudgetExhausted events
+    budget: List[Dict[str, str]] = field(default_factory=list)
+
+    def max_concurrent(self) -> int:
+        """Peak number of simultaneously-open query envelopes — the
+        per-session concurrency timeline's headline number, computed
+        from QueryStart/QueryEnd timestamps."""
+        edges = []
+        for q in self.queries:
+            if q.start_ts and q.end_ts:
+                edges.append((q.start_ts, 1))
+                edges.append((q.end_ts, -1))
+        peak = cur = 0
+        for _, d in sorted(edges):
+            cur += d
+            peak = max(peak, cur)
+        return peak
 
     @property
     def total_duration_ms(self) -> float:
@@ -159,6 +187,20 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.checkpoint if q is not None
                  else app.checkpoint).append(info)
+            elif ev == "Admission":
+                app.admission.append(
+                    {k: rec[k] for k in ("waitMs", "weightBytes",
+                                         "active", "queued")
+                     if k in rec})
+            elif ev == "AdmissionReject":
+                app.rejections.append(
+                    {k: rec[k] for k in ("reason", "waitMs", "queued")
+                     if k in rec})
+            elif ev == "BudgetExhausted":
+                info = {k: rec[k] for k in ("budget", "used", "limit",
+                                            "action") if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                (q.budget if q is not None else app.budget).append(info)
             elif ev == "QueryFatal":
                 info = {k: rec[k] for k in
                         ("error", "recovery", "watchdog", "checkpoint")
@@ -183,6 +225,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.retry = rec.get("retry", {})
                 q.pipeline = rec.get("pipeline", {})
                 q.shuffle = rec.get("shuffle", {})
+                q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed
     for q in open_queries.values():

@@ -162,6 +162,61 @@ def checkpoint_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def nearest_rank(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an ascending list — shared by the
+    concurrency report and ``bench.py --concurrency`` so the two can
+    never silently diverge."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(p * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
+def concurrency_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Serving-layer concurrency report: peak simultaneously-open
+    query envelopes per the event timeline, admission grants/waits and
+    typed rejections, and budget-ladder activity — the observability
+    face of the admission semaphore (serving/admission.py)."""
+    grants = rejects = budget_events = 0
+    wait_ms = 0.0
+    waits: List[float] = []
+    peak = 0
+    for a in apps:
+        peak = max(peak, a.max_concurrent())
+        grants += len(a.admission)
+        rejects += len(a.rejections)
+        budget_events += len(a.budget)
+        # one wait sample per admitted query: the grant events are the
+        # complete population (every admission emits one, whether or
+        # not the query later reaches QueryEnd); the per-query
+        # QueryEnd dicts restate the same waits, so counting both
+        # would double the percentile multiset
+        for g in a.admission:
+            w = g.get("waitMs", 0.0)
+            wait_ms += w
+            waits.append(w)
+        if not a.admission:
+            for q in a.queries:
+                if q.admission:
+                    w = q.admission.get("waitMs", 0.0)
+                    wait_ms += w
+                    waits.append(w)
+        for q in a.queries:
+            budget_events += len(q.budget)
+    if not grants and not rejects and peak <= 1:
+        return {}
+    waits.sort()
+    return {
+        "max_concurrent": peak,
+        "admitted": grants,
+        "rejected": rejects,
+        "total_wait_ms": round(wait_ms, 3),
+        "p50_wait_ms": round(nearest_rank(waits, 0.50), 3),
+        "p95_wait_ms": round(nearest_rank(waits, 0.95), 3),
+        "budget_events": budget_events,
+    }
+
+
 def health_check(apps: List[AppInfo]) -> List[str]:
     problems = []
     for a in apps:
@@ -240,6 +295,43 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     f"{a.session_id} query {q.query_id}: fatal after "
                     f"ladder [{', '.join(a for a in acts if a)}] — "
                     f"{q.fatal.get('error', '?')}")
+            for b in q.budget:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{b.get('budget')} budget "
+                    f"{'exhausted — rejected' if b.get('action') == 'reject' else 'pressure — self-spilled'} "
+                    f"({b.get('used')} > {b.get('limit')})")
+            adm = q.admission
+            if adm and q.duration_ms and \
+                    adm.get("waitMs", 0.0) > max(
+                        5 * q.duration_ms, 1000.0):
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: admission "
+                    f"starvation — waited {adm['waitMs']:.0f}ms to run "
+                    f"{q.duration_ms:.0f}ms; raise serving."
+                    "concurrentQueries or spread the tenant load")
+        for r in a.rejections:
+            problems.append(
+                f"{a.session_id}: query rejected at admission "
+                f"({r.get('reason')}) — the session was saturated; "
+                "the rejection is the isolation working, but clients "
+                "saw a typed AdmissionFault")
+        for b in a.budget:
+            problems.append(
+                f"{a.session_id}: {b.get('budget')} budget event "
+                f"without query attribution (action={b.get('action')})")
+        if a.max_concurrent() > 1 and (a.recovery or a.watchdog or
+                                       a.corruption):
+            kinds = [k for k, evs in (("recovery", a.recovery),
+                                      ("watchdog", a.watchdog),
+                                      ("corruption", a.corruption))
+                     if evs]
+            problems.append(
+                f"{a.session_id}: {'/'.join(kinds)} events without "
+                "query attribution while queries ran concurrently — "
+                "possible cross-query interference; every robustness "
+                "event should carry the owning query's id "
+                "(serving/context.py)")
         for r in a.recovery:
             problems.append(
                 f"{a.session_id}: recovery action {r.get('action')} "
@@ -475,6 +567,16 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"padding={sw['padding_ratio']:.2f}x "
             f"overflowRetries={sw['slot_overflow_retries']} "
             f"perColumnFallbacks={sw['per_column_fallbacks']}")
+    cc = concurrency_stats(apps)
+    if cc:
+        out.append("\n-- Concurrency & admission --")
+        out.append(
+            f"  maxConcurrent={cc['max_concurrent']} "
+            f"admitted={cc['admitted']} rejected={cc['rejected']} "
+            f"waitTotal={cc['total_wait_ms']:.1f}ms "
+            f"p50={cc['p50_wait_ms']:.1f}ms "
+            f"p95={cc['p95_wait_ms']:.1f}ms "
+            f"budgetEvents={cc['budget_events']}")
     cp = checkpoint_stats(apps)
     if cp:
         out.append("\n-- Stage checkpoints --")

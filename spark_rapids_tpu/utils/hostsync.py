@@ -69,6 +69,17 @@ class HostSyncMetrics:
         with self._lock:
             self._owner.pop(ident, None)
 
+    def purge_owner(self, owner_ident: int) -> None:
+        """Drop every adoption mapping TO ``owner_ident`` — the
+        query-exit counterpart of disown(): the OS reuses idents, so a
+        stale entry would attribute a NEW query's syncs to this dead
+        query's view (serving/context.QueryContext.__exit__).  The
+        per-thread counters themselves survive: callers take deltas
+        across queries on long-lived client threads."""
+        from spark_rapids_tpu.robustness.inject import purge_adoptions
+        with self._lock:
+            purge_adoptions(self._owner, owner_ident)
+
     def reset(self) -> None:
         with self._lock:
             self.sync_count = 0
@@ -76,6 +87,18 @@ class HostSyncMetrics:
 
 
 host_sync_metrics = HostSyncMetrics()
+
+
+def _charge_budget(n: int) -> None:
+    """Serving-layer sync budget: the owning QueryContext counts every
+    sync against spark.rapids.tpu.serving.syncBudget and rejects THIS
+    query (typed BudgetExhaustedFault) past the limit — a runaway sync
+    loop in one tenant must not serialize the shared tunnel.  Free
+    (one dict probe) when no context is active."""
+    from spark_rapids_tpu.serving import context as qc
+    ctx = qc.current()
+    if ctx is not None:
+        ctx.charge_syncs(n)
 
 
 def count_sync(n: int = 1) -> None:
@@ -87,6 +110,7 @@ def count_sync(n: int = 1) -> None:
     from spark_rapids_tpu.robustness import watchdog
     watchdog.checkpoint()
     host_sync_metrics.bump(n)
+    _charge_budget(n)
 
 
 # ------------------------------------------------------ upload accounting --
@@ -127,6 +151,7 @@ def fetch(*buffers):
     from spark_rapids_tpu.robustness import watchdog
     watchdog.checkpoint()
     host_sync_metrics.bump(1)
+    _charge_budget(1)
     got = jax.device_get(list(buffers))
     return got[0] if len(buffers) == 1 else got
 
@@ -139,4 +164,5 @@ def fetch_all(buffers: Sequence):
         return []
     watchdog.checkpoint()
     host_sync_metrics.bump(1)
+    _charge_budget(1)
     return jax.device_get(list(buffers))
