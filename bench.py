@@ -470,6 +470,97 @@ def child_main() -> None:
     save()
 
 
+# ------------------------------------------------------------------ ingest --
+def ingest_main(n_ticks: int) -> None:
+    """Continuous-ingest bench: one standing aggregation query, one
+    appended file per tick (robustness/incremental.py).  Emits ONE
+    JSON line with cold-query latency vs steady-state tick latency
+    plus the state-size/reuse diagnostics — the ROADMAP item-5 success
+    metric (steady-state micro-batch latency << cold query latency)
+    lands in BENCH_*.json here.  Runs in-process on whatever platform
+    jax resolves (set JAX_PLATFORMS=cpu for the tunnel-proof number)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.robustness.incremental import \
+        incremental_metrics
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+
+    rows_per_file = 1 << 17
+    d = tempfile.mkdtemp(prefix="tpu-ingest-bench-")
+    rng = np.random.default_rng(7)
+
+    def write(i: int) -> str:
+        pdf = pd.DataFrame({
+            "k": rng.integers(0, 64, rows_per_file),
+            "v": rng.integers(0, 10_000,
+                              rows_per_file).astype(np.float64)})
+        p = os.path.join(d, f"batch-{i:04d}.parquet")
+        pdf.to_parquet(p, index=False)
+        return p
+
+    try:
+        session = TpuSession()
+        incremental_metrics.reset()
+        first = [write(0), write(1)]
+
+        def make_df():
+            return (session.read.parquet(*first)
+                    .groupBy("k")
+                    .agg(F.sum("v").alias("sv"),
+                         F.count("v").alias("n"),
+                         F.avg("v").alias("av"))
+                    .orderBy("k"))
+
+        # cold latency: the full query, end to end, jit-warm (second
+        # run — compile time is the fusion ROADMAP item, not this one)
+        cold_df = make_df()
+        cold_df.to_pandas()
+        t0 = time.perf_counter()
+        cold_df.to_pandas()
+        cold_ms = (time.perf_counter() - t0) * 1e3
+
+        runner = session.incremental(make_df())
+        t0 = time.perf_counter()
+        runner.tick()
+        first_tick_ms = (time.perf_counter() - t0) * 1e3
+        ticks_ms = []
+        for i in range(n_ticks):
+            p = write(2 + i)
+            t0 = time.perf_counter()
+            runner.tick([p])
+            ticks_ms.append((time.perf_counter() - t0) * 1e3)
+        ticks_ms.sort()
+        m = incremental_metrics.snapshot()
+        ingested = rows_per_file * (2 + n_ticks)
+        steady = nearest_rank(ticks_ms, 0.50)
+        print(json.dumps({
+            "metric": "ingest_steady_tick_ms",
+            "value": round(steady, 3),
+            "unit": "ms",
+            "ticks": n_ticks,
+            "rows_ingested": ingested,
+            "cold_query_ms": round(cold_ms, 3),
+            "first_tick_ms": round(first_tick_ms, 3),
+            "p95_tick_ms": round(nearest_rank(ticks_ms, 0.95), 3),
+            "cold_vs_steady": round(cold_ms / max(steady, 1e-9), 3),
+            "incremental_state_bytes": m["stateBytes"],
+            "incremental_reuse_ratio": round(
+                m["incrementalTicks"] / max(m["ticks"], 1), 3),
+            "rollbacks": m["rollbacks"],
+        }))
+        sys.stdout.flush()
+        runner.close()
+        session.stop()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # ------------------------------------------------------------- concurrency --
 def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
     """Serving-mode bench: N client threads hammer TPC-H q6 through one
@@ -537,6 +628,10 @@ if __name__ == "__main__":
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 4
         secs = float(os.environ.get("BENCH_CONCURRENCY_SECONDS", "10"))
         concurrency_main(n, secs)
+    elif "--ingest-ticks" in sys.argv:
+        idx = sys.argv.index("--ingest-ticks")
+        n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8
+        ingest_main(n)
     else:
         _install_safety_net()
         main()

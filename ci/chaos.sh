@@ -110,6 +110,99 @@ for enabled in (True, False):
           f"trail: {[r['action'] for r in s.recovery_log]})")
 PY
 
+echo "== continuous-ingest soak (N ticks under chaos spray, exact-result + bounded-memory gate) =="
+# a standing aggregation query ingests one appended parquet file per
+# tick while delay/raise/corrupt/oom rules spray every tick's
+# executions.  Gates: every tick's answer is EXACTLY the one-shot
+# recompute over everything ingested so far (epoch rollback may
+# degrade a tick to full recompute — never to wrong bytes), and memory
+# is bounded — spill-catalog device bytes and process RSS plateau
+# instead of growing monotonically across ticks.
+python - <<'PY'
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.memory import retry as _retry  # registers memory.oom
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness import incremental as _inc  # registers points
+from spark_rapids_tpu.robustness.incremental import incremental_metrics
+
+TICKS = 6
+SPRAY = (("io.read", dict(kind="raise", count=2, probability=0.4)),
+         ("shuffle.exchange", dict(kind="raise", count=2,
+                                   probability=0.4)),
+         ("shuffle.exchange", dict(kind="delay", delay_s=0.2, count=1,
+                                   probability=0.3)),
+         ("memory.oom", dict(kind="raise", count=1, probability=0.3)),
+         ("incremental.state.restore", dict(kind="corrupt", count=1,
+                                            probability=0.3)),
+         ("spill.corrupt.host", dict(kind="corrupt", count=1,
+                                     probability=0.3)))
+
+def rss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+d = tempfile.mkdtemp(prefix="tpu-ingest-soak-")
+rng = np.random.default_rng(13)
+def write(i):
+    pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                        "v": rng.integers(0, 1000, 4000).astype(np.float64)})
+    p = os.path.join(d, f"b{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+s = TpuSession({"spark.rapids.sql.recovery.backoffMs": 5,
+                "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000},
+               mesh=make_mesh(8))
+incremental_metrics.reset()
+first = [write(0), write(1)]
+df = (s.read.parquet(*first).groupBy("k")
+      .agg(F.sum("v").alias("sv"), F.count("v").alias("c"),
+           F.avg("v").alias("av")).orderBy("k"))
+runner = s.incremental(df)
+runner.tick()  # cold epoch, no chaos
+dev, rss = [], []
+try:
+    for t in range(TICKS):
+        p = write(2 + t)
+        with I.scoped_rules():
+            for point, kw in SPRAY:
+                I.inject(point, seed=100 + t, all_threads=True, **kw)
+            got = runner.tick([p]).to_pandas()
+        # one-shot recompute oracle over everything ingested (runner
+        # keeps the standing df's scan in step), chaos disarmed
+        want = df.to_pandas()
+        pd.testing.assert_frame_equal(got, want)
+        dev.append(s.memory_catalog.stats()["device_bytes"])
+        rss.append(rss_mb())
+finally:
+    runner.close()
+    s.stop()
+    shutil.rmtree(d, ignore_errors=True)
+
+m = incremental_metrics.snapshot()
+# bounded memory: state size is per-group, not per-ingested-row — the
+# device watermark and RSS must plateau, not grow with tick count
+assert dev[-1] <= max(dev[:2]) + (16 << 20), dev
+assert rss[-1] - rss[1] < 400.0, rss
+assert m["commits"] >= TICKS, m
+print(f"ingest soak OK ({TICKS} chaos ticks exact, "
+      f"incremental={m['incrementalTicks']} full={m['fullRecomputes']} "
+      f"rollbacks={m['rollbacks']} stateBytes={m['stateBytes']}, "
+      f"device_bytes={dev[-1]} rssΔ={rss[-1]-rss[1]:.0f}MB)")
+PY
+
 echo "== concurrent spray (N clients, faults keyed per query, isolation gate) =="
 # 8 client threads share one session through the admission layer; half
 # carry injected faults scoped to THEIR query via keyed injection

@@ -369,6 +369,20 @@ class TpuSession:
         from spark_rapids_tpu.sql import parse, resolve
         return resolve(self, parse(query))
 
+    # --------------------------------------------------- continuous ingest --
+    def incremental(self, df: DataFrame):
+        """Stand ``df`` up as a continuous-ingest micro-batch query
+        (robustness/incremental.py): the returned
+        :class:`MicroBatchRunner`'s ``tick(new_paths)`` ingests
+        appended files and answers over everything ingested so far,
+        re-executing only the delta and merging with crash-consistent
+        committed state — any mid-tick fault rolls back to the last
+        committed epoch and the tick degrades to a full recompute.
+        Governed by ``spark.rapids.tpu.incremental.*``."""
+        from spark_rapids_tpu.robustness.incremental import (
+            MicroBatchRunner)
+        return MicroBatchRunner(self, df)
+
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan, overrides=None):
         from spark_rapids_tpu.config import rapids_conf as rc

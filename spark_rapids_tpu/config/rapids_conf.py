@@ -696,6 +696,42 @@ SERVING_CHECKPOINT_FLOOR_BYTES = conf(
     "disables the floor (pure priority order).", _to_int,
     lambda v: None if v >= 0 else "must be >= 0")
 
+INCREMENTAL_ENABLED = conf(
+    "spark.rapids.tpu.incremental.enabled", True,
+    "Enable incremental state for continuous micro-batch ingest "
+    "(robustness/incremental.py, session.incremental(df).tick(paths)): "
+    "a tick executes against the last COMMITTED state epoch — "
+    "aggregation plans re-aggregate only the appended files and merge "
+    "with the standing partial-aggregate state, other plans splice "
+    "unchanged (input-fingerprinted) stage checkpoints from the "
+    "session-persistent lineage store — and commits the new epoch "
+    "atomically only when the tick completes. Any fault mid-tick rolls "
+    "back to the committed epoch and the tick degrades to a full "
+    "recompute; state is never half-updated. False makes every tick a "
+    "plain full re-execution with no standing state.", _to_bool)
+
+INCREMENTAL_MAX_STATE_BYTES = conf(
+    "spark.rapids.tpu.incremental.maxStateBytes", 1 << 30,
+    "Ceiling on the bytes one standing query's incremental state "
+    "(partial-aggregate epochs plus persistent stage checkpoints) may "
+    "pin across all spill tiers. Oldest stage entries evict first, "
+    "then the aggregate state itself (StateEvict events); an evicted "
+    "entry degrades the next tick to full recompute — never a wrong "
+    "or failed tick. Per-owner spill accounting (serving layer) keeps "
+    "one standing query's state from starving co-tenants regardless.",
+    _to_int, _positive)
+
+INCREMENTAL_TIERS = conf(
+    "spark.rapids.tpu.incremental.tiers", "device,host,disk",
+    "Spill tiers incremental state may occupy (same semantics as "
+    "spark.rapids.sql.recovery.checkpoint.tiers): 'device,host,disk' "
+    "registers at DEVICE and lets watermark pressure demote; "
+    "'host,disk' demotes to host immediately at commit so standing "
+    "state never competes with live batches for HBM; 'disk' pushes "
+    "straight to the atomic disk frames.", str,
+    lambda v: None if v in ("device,host,disk", "host,disk", "disk")
+    else "must be 'device,host,disk', 'host,disk' or 'disk'")
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

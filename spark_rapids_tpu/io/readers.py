@@ -44,6 +44,49 @@ def infer_file_schema(paths: List[str], file_format: str) -> Schema:
     return [(f.name, dts.from_arrow_type(f.type)) for f in dataset.schema]
 
 
+def scan_input_meta(paths: List[str]) -> List[tuple]:
+    """Sorted ``(path, size_bytes, mtime_ns)`` triples for a scan's
+    input file set — the identity of what a FileRelation will actually
+    read, without opening a single footer.  Folded into
+    stage-checkpoint lineage keys (robustness/checkpoint.py) so
+    appending a file — or mutating one: new size, or a SAME-SIZE
+    in-place rewrite, which only the mtime catches — invalidates
+    exactly the scan-adjacent subtrees, and used by the
+    incremental-ingest runner to detect out-of-band input mutation.
+    (A touch without a content change forces a spurious recompute;
+    degradation is always allowed, wrong bytes never are.)
+    Unstattable paths fingerprint as (-1, -1) — a vanished file still
+    changes the key."""
+    import os
+
+    def stat(p):
+        try:
+            st = os.stat(p)
+            return (p, st.st_size, st.st_mtime_ns)
+        except OSError:
+            return (p, -1, -1)
+
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            # hive-partitioned dataset root: the file set IS the input
+            for root, _dirs, names in sorted(os.walk(p)):
+                out.extend(stat(os.path.join(root, name))
+                           for name in sorted(names))
+            continue
+        out.append(stat(p))
+    return sorted(out)
+
+
+def input_signature(meta: List[tuple]) -> str:
+    """Canonical string form of a ``scan_input_meta`` result — THE one
+    encoding of input identity, shared by the stage-lineage keys
+    (checkpoint.input_fingerprint) and the incremental runner's
+    state-staleness check so their invalidation rules can never
+    silently diverge."""
+    return ";".join(f"{p}={s}@{m}" for p, s, m in meta)
+
+
 def to_arrow_filter(expr: Expression):
     """Translate a supported predicate subtree to a pyarrow expression;
     returns None when any part is untranslatable (the caller keeps the full

@@ -45,6 +45,11 @@ ACTIVE_ON_DECK_PRIORITY = 1000
 # (robustness/checkpoint.py CHECKPOINT_PRIORITY); the cross-query
 # eviction floor applies to handles in this class
 CHECKPOINT_TIER_MAX = -1500
+# session-persistent incremental-ingest state (robustness/incremental.py)
+# is the coldest class of all: standing state outlives any one query, so
+# under HBM pressure it leaves the device before even per-query
+# checkpoints — restores pay a host round trip, live queries never wait
+INCREMENTAL_STATE_PRIORITY = -2000
 
 
 class IntegrityMetrics:

@@ -725,10 +725,22 @@ class DistPlanner:
         # stage-checkpoint lineage: the per-query manager the driver
         # installed on the session (None when disabled / no catalog);
         # resume=True only on a retry-class re-attempt — the first
-        # attempt never restores, it only writes
+        # attempt never restores, it only writes.  A session-persistent
+        # store (robustness/incremental.py) sets always_resume: its
+        # input-fingerprinted stage ids are safe to splice across
+        # queries, so continuous-ingest ticks restore on attempt one
         self._ckpt = getattr(session, "checkpoints", None)
-        self._resume = bool(resume) and self._ckpt is not None and \
-            self._ckpt.enabled
+        self._resume = self._ckpt is not None and self._ckpt.enabled \
+            and (bool(resume) or
+                 getattr(self._ckpt, "always_resume", False))
+        # input fingerprints are folded into stage ids only for a
+        # session-persistent store (cross-query splice needs input
+        # identity); the per-query manager skips the stat walk — its
+        # keys only need intra-query stability.  The memo caches each
+        # scan node's walk for one planner run (inputs cannot change
+        # mid-attempt)
+        self._fp_inputs = getattr(self._ckpt, "always_resume", False)
+        self._fp_memo: Dict[int, str] = {}
         self._packed = packed_enabled()
 
     @classmethod
@@ -772,7 +784,8 @@ class DistPlanner:
                 not self._checkpointable(plan):
             return self._dispatch(plan, dry)
         from spark_rapids_tpu.robustness import checkpoint as cp
-        sid = cp.stage_id(plan, self.mesh, self._packed)
+        sid = cp.stage_id(plan, self.mesh, self._packed,
+                          memo=self._fp_memo, inputs=self._fp_inputs)
         if self._resume:
             frame = self._ckpt.restore(sid, self.mesh)
             if frame is not None:
@@ -1712,4 +1725,8 @@ def try_distributed(session, plan: L.LogicalPlan, resume: bool = False):
             ev.emit("DistFallback", reason=str(e))
         return None
     session.last_dist_explain = "distributed"
+    if planner._ckpt is not None:
+        # per-execution completion signal, delivered on THIS query's
+        # thread (robustness/checkpoint.py note_distributed_complete)
+        planner._ckpt.note_distributed_complete()
     return [batch]

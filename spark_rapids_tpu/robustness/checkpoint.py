@@ -91,19 +91,77 @@ class CheckpointMetrics:
 checkpoint_metrics = CheckpointMetrics()
 
 
-def stage_id(plan, mesh, packed: bool = True) -> str:
+def input_fingerprint(plan, memo: Optional[dict] = None) -> str:
+    """Identity of everything a subtree READS: for every FileRelation
+    leaf the sorted (path, size, mtime_ns) triples of its input files
+    (appending a file — or mutating one: new size, or a SAME-SIZE
+    in-place rewrite, which only the mtime catches — changes the
+    fingerprint), and for every InMemoryRelation the identity of its
+    live batch objects (two relations alive at once can never share an
+    id; the owning plan keeps its batches alive, so a recycled id
+    cannot alias).  Folded into the stage lineage key of the
+    session-persistent store (robustness/incremental.py) so a
+    cross-query splice can only ever use a frame computed from
+    byte-identical inputs; the per-query log skips the fold — its ids
+    only need intra-query stability, and inputs cannot change
+    mid-query.
+
+    ``memo`` (a per-planner-run dict) caches each scan node's stat
+    walk: a deep plan stats every file once per EXECUTION ATTEMPT, not
+    once per enclosing checkpointable subtree — safe because inputs
+    may not change mid-attempt (the existing lineage contract), and
+    the memo dies with the planner, so a later attempt (or tick)
+    re-observes the filesystem."""
+    from spark_rapids_tpu.plan import logical as L
+    parts = []
+
+    def scan_part(node):
+        if memo is not None and id(node) in memo:
+            return memo[id(node)]
+        from spark_rapids_tpu.io.readers import (input_signature,
+                                                 scan_input_meta)
+        part = "files:" + input_signature(scan_input_meta(node.paths))
+        if memo is not None:
+            memo[id(node)] = part
+        return part
+
+    def walk(node):
+        if isinstance(node, L.FileRelation):
+            parts.append(scan_part(node))
+        elif isinstance(node, L.InMemoryRelation):
+            parts.append("mem:" + ";".join(
+                f"{id(b)}={b.nrows}" for b in node.batches))
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return "\x1e".join(parts)
+
+
+def stage_id(plan, mesh, packed: bool = True,
+             memo: Optional[dict] = None, inputs: bool = True) -> str:
     """Stable lineage key for one plan subtree on one shard layout.
     Structural, not object identity: every re-planned attempt of the
     same query resolves the same subtree to the same id, and two
     occurrences of an identical subtree (a self-join) legitimately
-    share one checkpoint — same plan, same layout, same bytes.  A
-    full-width sha256 digest, not a 32-bit crc: a lineage-key
+    share one checkpoint — same plan, same layout, same bytes.  With
+    ``inputs`` (the default, and what the session-persistent store
+    needs) the key also folds in the subtree's INPUT fingerprint
+    (file list + sizes + mtimes; see input_fingerprint) so a lineage store
+    resuming ACROSS queries can never splice a frame computed from
+    different bytes: appending files moves exactly the scan-adjacent
+    subtrees' ids and leaves static subtrees resumable.  The
+    per-query manager passes ``inputs=False`` — its keys only need
+    intra-query stability (inputs cannot change mid-query), and the
+    fingerprint's stat walk is pure planning-path overhead there.
+    A full-width sha256 digest, not a 32-bit crc: a lineage-key
     collision between two different subtrees would splice the WRONG
     stage's (individually valid) bytes into a resumed plan, the one
     failure the payload checksum cannot catch."""
     import hashlib
     sig = "\x1f".join([
         plan.tree_string(),
+        input_fingerprint(plan, memo) if inputs else "",
         ",".join(mesh.axis_names),
         "x".join(str(d) for d in mesh.devices.shape),
         ",".join(str(d) for d in mesh.devices.flat),
@@ -168,6 +226,16 @@ class CheckpointManager:
     on layout-changing rungs; the planner saves after every completed
     exchange stage and restores on resume attempts."""
 
+    # the session-persistent subclass (robustness/incremental.py
+    # IncrementalStateStore) sets this True: the planner then consults
+    # the log on FIRST attempts too, not only recovery re-attempts —
+    # input-fingerprinted stage ids make the cross-query splice safe
+    always_resume = False
+    # spill priority stage payloads register at (the persistent store
+    # registers colder still — standing state never competes with a
+    # live query's checkpoints for HBM)
+    priority = CHECKPOINT_PRIORITY
+
     def __init__(self, session):
         from spark_rapids_tpu.config import rapids_conf as rc
         self.session = session
@@ -227,6 +295,14 @@ class CheckpointManager:
         out["liveBytes"] = self.live_bytes
         return out
 
+    def note_distributed_complete(self) -> None:
+        """Hook called by ``try_distributed`` on the executing thread
+        when a query ANSWERS distributed (the final successful
+        attempt, by construction).  No-op here; the session-persistent
+        store uses it as the thread-safe signal that stale-entry
+        pruning is sound — a shared session attribute like
+        ``last_dist_explain`` would race under concurrent queries."""
+
     @property
     def live_bytes(self) -> int:
         return sum(e.size_bytes for e in self._entries.values())
@@ -268,8 +344,7 @@ class CheckpointManager:
             cols[f"c{i}"] = Column(dt, payload[f"c{i}.data"], total,
                                    validity=payload[f"c{i}.validity"])
         batch = ColumnarBatch(cols, nrows=total)
-        handle = self.catalog.register(batch,
-                                       priority=CHECKPOINT_PRIORITY)
+        handle = self.catalog.register(batch, priority=self.priority)
         entry = StageCheckpoint(
             sid, handle, frame.names, frame.log_dtypes, frame.enc,
             frame.nshards, frame.capacity, crc, handle.size_bytes,

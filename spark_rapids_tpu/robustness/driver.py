@@ -229,8 +229,13 @@ class QueryRetryDriver:
     def _update_lineage(self, rung: str, mode: AttemptMode) -> None:
         """Stage-checkpoint wiring: retry-class re-attempts keep the
         shard layout and may resume from the lineage log; layout-
-        changing rungs (split/demote/cpu) invalidate the whole log —
-        its stage ids are keyed to a layout that no longer exists."""
+        changing rungs (split/demote/cpu) invalidate the log via
+        ``clear()`` — for the per-query manager that wipes everything
+        (its stage ids are keyed to a layout this query no longer
+        runs on), while the session-persistent incremental store
+        overrides clear() to drop only this tick's PROVISIONAL
+        entries: its committed epochs stay keyed to the mesh layout,
+        which survives the rung and serves the next tick."""
         mgr = getattr(self.session, "checkpoints", None)
         if rung in _LAYOUT_CHANGING or not mode.use_mesh or \
                 mode.cpu_only or mode.batch_scale != 1.0:

@@ -1,0 +1,892 @@
+"""Continuous micro-batch ingest on checkpoint lineage: crash-consistent
+incremental state with epoch semantics.
+
+PR5 made completed exchange stages durable *within* one query (the
+per-query :class:`~spark_rapids_tpu.robustness.checkpoint.CheckpointManager`);
+this module promotes that log into a **session-persistent
+IncrementalStateStore** and turns the checkpoint subsystem from a
+failure feature into a latency feature (ROADMAP item 5): a standing
+query over an append-only input re-executes only what the appended
+files can change, and resumes everything else from state.
+
+The unit of standing work is a :class:`MicroBatchRunner`
+(``session.incremental(df)``); each ``runner.tick(new_paths)`` is one
+micro-batch with **epoch semantics**:
+
+- the tick executes against the last *committed* epoch; everything it
+  writes — the new partial-aggregate state, fresh stage checkpoints —
+  lands in a *provisional* epoch;
+- the provisional epoch **commits atomically only when the tick
+  completes**; any fault mid-tick (chaos-injected or real: reader
+  fault, shuffle wedge, spill corruption, watchdog timeout, admission
+  reject) **rolls back** to the committed epoch and the tick degrades
+  to a full recompute — standing state is never half-updated, a
+  degraded tick answers with recomputed (correct) bytes, never wrong
+  ones;
+- the full robustness stack is live the whole time: every execution
+  inside a tick runs through ``DataFrame._execute_batches`` — admission
+  control, per-query budgets, the recovery ladder, watchdog deadlines,
+  spill integrity and per-query stage checkpoints all apply unchanged.
+
+Two reuse mechanisms compose:
+
+1. **Delta re-aggregation** (the streaming-aggregation workload class):
+   plans of shape ``[Sort|Limit|Filter]* <- Aggregate <-
+   [Filter|Project]* <- FileRelation`` decompose into mergeable
+   partials (sum→sum, count→sum, min→min, max→max, avg→(sum,count)).
+   The tick aggregates ONLY the appended files and merges
+   (old-state ⊕ delta) through the engine's own aggregate merge
+   discipline — zero re-pulls of already-ingested source files.
+2. **Lineage splice** for everything else: the store subclasses the
+   PR5 CheckpointManager with ``always_resume`` — stage ids now fold in
+   an **input fingerprint** (file list + sizes + mtimes,
+   ``checkpoint.input_fingerprint``), so appending files invalidates
+   exactly the scan-adjacent subtrees and a full-recompute tick still
+   splices unchanged subtrees (a static dimension side of a join, a
+   pre-aggregated reference table) via the existing
+   ``try_distributed(resume=True)`` machinery.
+
+State lives in the spill catalog at ``INCREMENTAL_STATE_PRIORITY``
+(colder than per-query checkpoints — standing state never competes
+with live queries for HBM) under its own budget/tier confs
+(``spark.rapids.tpu.incremental.enabled`` / ``.maxStateBytes`` /
+``.tiers``); eviction or CRC failure of a state entry degrades the
+next tick to recompute — it never fails a tick and never returns wrong
+bytes.  Observable end to end: ``StateCommit`` / ``StateRollback`` /
+``StateEvict`` / ``IncrementalResume`` events → eventlog
+``QueryInfo.incremental`` → profiling "Continuous ingest" section and
+health checks.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.robustness.checkpoint import (CheckpointManager,
+                                                    CheckpointMetrics)
+from spark_rapids_tpu.robustness.inject import (fire, fire_mutate,
+                                                register_point)
+
+# chaos surface: a raise/delay rule on the write covers a wedged state
+# commit; a corrupt rule on the restore flips state bytes so the CRC
+# gate has real rot to catch (fire_mutate site)
+register_point("incremental.state.write")
+register_point("incremental.state.restore")
+
+
+class IncrementalMetrics(CheckpointMetrics):
+    """Process-wide continuous-ingest counters (bench.py --ingest-ticks
+    and the profiling tool read these alongside the checkpoint/recovery
+    counters).  Same lock/bump/snapshot discipline as the checkpoint
+    counters, wider field set; ``stateBytes`` is a gauge (last
+    committed epoch's size), everything else is a counter."""
+
+    FIELDS = ("ticks", "incrementalTicks", "fullRecomputes", "commits",
+              "rollbacks", "writes", "bytesWritten", "resumes",
+              "stagesSkipped", "evictions", "invalid", "stateBytes")
+
+    def set(self, field: str, value: int) -> None:
+        with self._lock:
+            self.counters[field] = int(value)
+
+
+incremental_metrics = IncrementalMetrics()
+
+
+def _batch_payload(batch) -> dict:
+    """Canonical host payload of a ColumnarBatch (the spill module's
+    key layout) for the store's own checksum — a DEVICE-resident state
+    batch is verified on restore even though the catalog's CRC only
+    stamps at tier crossings.  Host-backed buffers are used bit-exact;
+    every still-on-device buffer comes down in ONE budgeted transfer
+    (utils/hostsync.fetch_all — syncs are a counted resource, and a
+    per-buffer ``np.asarray`` would pay a tunnel round trip per column
+    on real hardware, the checkpoint._frame_payload discipline)."""
+    payload = {}
+    pending = []  # (payload key, device buffer)
+    for name, col in batch.columns.items():
+        for suffix, np_buf, jax_buf in (
+                ("data", col._np_data, col._jax_data),
+                ("validity", col._np_validity, col._jax_validity),
+                ("offsets", col._np_offsets, col._jax_offsets)):
+            if np_buf is not None:
+                payload[f"{name}.{suffix}"] = \
+                    np.ascontiguousarray(np_buf)
+            elif jax_buf is not None:
+                pending.append((f"{name}.{suffix}", jax_buf))
+    if pending:
+        from spark_rapids_tpu.utils.hostsync import fetch_all
+        fetched = fetch_all([b for _, b in pending])
+        for (key, _), host in zip(pending, fetched):
+            payload[key] = np.ascontiguousarray(np.asarray(host))
+    return payload
+
+
+class AggState:
+    """One epoch's partial-aggregate state: the spill-catalog handle
+    holding the merged partial batch plus the input fingerprint it was
+    computed from."""
+
+    __slots__ = ("handle", "nrows", "crc", "size_bytes", "fingerprint",
+                 "epoch")
+
+    def __init__(self, handle, nrows: int, crc: int, size_bytes: int,
+                 fingerprint: str, epoch: int):
+        self.handle = handle
+        self.nrows = nrows
+        self.crc = crc
+        self.size_bytes = size_bytes
+        self.fingerprint = fingerprint
+        self.epoch = epoch
+
+
+class IncrementalStateStore(CheckpointManager):
+    """Session-persistent lineage + aggregate state with epochs.
+
+    The PR5 CheckpointManager, promoted: entries outlive a query, stage
+    ids are input-fingerprinted (safe to splice across queries —
+    ``always_resume``), and every mutation lands provisionally until
+    :meth:`commit` — :meth:`rollback` restores the committed epoch
+    exactly.  Committed entries are only ever *dropped* outside the
+    epoch discipline (CRC failure, eviction) — a drop degrades a future
+    tick to recompute, which is always correct."""
+
+    always_resume = True
+
+    def __init__(self, session):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.memory.spill import (
+            INCREMENTAL_STATE_PRIORITY)
+        # base wiring (session/catalog/entry log/counters) is the
+        # manager's; only the governing confs and the priority differ
+        super().__init__(session)
+        conf = session.conf
+        self.enabled = bool(conf.get(rc.INCREMENTAL_ENABLED))
+        self.max_bytes = int(conf.get(rc.INCREMENTAL_MAX_STATE_BYTES))
+        self.tiers = tuple(
+            t.strip().upper()
+            for t in conf.get(rc.INCREMENTAL_TIERS).split(",")
+            if t.strip())
+        self.priority = INCREMENTAL_STATE_PRIORITY
+        self.epoch = 0
+        self._agg: Optional[AggState] = None
+        self._agg_prov: Optional[AggState] = None
+        self._provisional: set = set()
+        self._touched: set = set()
+        self._splice_active = False
+        # True only when a splice execution ran DISTRIBUTED end to end
+        # — the precondition for stale-entry pruning at commit: an
+        # attempt that fell off the mesh (ladder demotion, fallback)
+        # touched nothing, and "untouched" must not read as "stale"
+        self._splice_complete = False
+
+    # ------------------------------------------------------- metric/event taps --
+    # the base class's save/restore/drop machinery is reused verbatim;
+    # only where its counters and events land changes
+    _EVENT_MAP = {"CheckpointWrite": None,  # commit carries the bytes
+                  "CheckpointResume": "IncrementalResume",
+                  "CheckpointEvict": "StateEvict",
+                  "CheckpointInvalid": "StateEvict"}
+
+    def _bump(self, field: str, by: int = 1) -> None:
+        incremental_metrics.bump(field, by)
+        if field in self.local:
+            self.local[field] += int(by)
+
+    def _emit(self, event: str, **fields) -> None:
+        mapped = self._EVENT_MAP.get(event, event)
+        if mapped is None:
+            return
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session(mapped, session=self.session, **fields)
+
+    # ------------------------------------------------------------ stage lineage --
+    def save(self, sid: str, frame, stages: int = 1) -> None:
+        known = sid in self._entries
+        super().save(sid, frame, stages)
+        if not known and sid in self._entries:
+            self._provisional.add(sid)
+        self._touched.add(sid)
+
+    def restore(self, sid: str, mesh):
+        frame = super().restore(sid, mesh)
+        if frame is not None:
+            self._touched.add(sid)
+        return frame
+
+    def drop(self, sid: str, reason: str, evict: bool = False) -> None:
+        self._provisional.discard(sid)
+        super().drop(sid, reason, evict=evict)
+
+    def note_distributed_complete(self) -> None:
+        """The planner's on-thread completion signal: the final
+        attempt of a splice execution really ran distributed, so
+        untouched entries are provably stale at commit.  clear() (a
+        layout rung) can only be followed by off-mesh attempts, which
+        never reach this hook — the veto sticks."""
+        if self._splice_active:
+            self._splice_complete = True
+
+    def clear(self, reason: str) -> None:
+        """A layout-changing ladder rung inside one tick invalidates
+        only that tick's PROVISIONAL work: committed entries are keyed
+        to (subtree, mesh layout, input fingerprint), all of which
+        survive the rung — the next tick runs on the mesh again and
+        they splice correctly.  (The per-query manager clears its whole
+        log here; a persistent store that did the same would throw away
+        every standing epoch on one transient demotion.)"""
+        self._splice_complete = False  # a layout rung ran: this tick
+        # can no longer vouch for which committed entries are stale
+        for sid in list(self._provisional):
+            entry = self._entries.pop(sid, None)
+            self._provisional.discard(sid)
+            if entry is not None:
+                try:
+                    entry.handle.close()
+                except Exception:
+                    pass
+        if self._agg_prov is not None:
+            try:
+                self._agg_prov.handle.close()
+            except Exception:
+                pass
+            self._agg_prov = None
+
+    # ------------------------------------------------------------ agg state I/O --
+    def put_state(self, batch, fingerprint: str) -> None:
+        """Register the tick's merged partial-aggregate batch as the
+        PROVISIONAL epoch's state (replacing any earlier provisional
+        from the same tick — a degraded tick overwrites its own
+        half-built state, never the committed epoch)."""
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        fire("incremental.state.write")
+        if self._agg_prov is not None:
+            try:
+                self._agg_prov.handle.close()
+            except Exception:
+                pass
+            self._agg_prov = None
+        payload = _batch_payload(batch)
+        crc = _payload_checksum(payload, batch.nrows)
+        # put_state runs BETWEEN a tick's query executions (no
+        # QueryContext to auto-tag from), but the standing state must
+        # still bill its tenant: the tick thread's ident is the same
+        # owner ident every QueryContext of this tick registers its
+        # budgets under, so per-owner accounting and the eviction
+        # floor see the state as the standing query's, not nobody's
+        handle = self.catalog.register(batch, priority=self.priority,
+                                       owner=threading.get_ident())
+        if "DEVICE" not in self.tiers:
+            self.catalog.demote(
+                handle, self.tiers[0] if self.tiers else "HOST")
+        self._agg_prov = AggState(handle, batch.nrows, crc,
+                                  handle.size_bytes, fingerprint,
+                                  self.epoch + 1)
+        self._bump("writes")
+        self._bump("bytesWritten", handle.size_bytes)
+        self._evict_over_budget()
+
+    def get_state(self):
+        """The COMMITTED epoch's state batch, or None when the next
+        tick must full-recompute (no state, evicted, CRC mismatch,
+        undecodable spill frame).  Wrong bytes are never returned: any
+        verification failure drops the state and lands a StateEvict on
+        the trail."""
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
+        st = self._agg
+        if st is None:
+            return None
+        try:
+            batch = st.handle.materialize()
+        except (CorruptionFault, OSError, ValueError) as e:
+            self.drop_state(f"{type(e).__name__}: {e}")
+            return None
+        payload = _batch_payload(batch)
+        key = next((k for k in sorted(payload)
+                    if payload[k].size > 0), None)
+        if key is not None:
+            mutated = fire_mutate("incremental.state.restore",
+                                  payload[key])
+            if mutated is not payload[key]:
+                payload = dict(payload)
+                payload[key] = mutated
+        got = _payload_checksum(payload, st.nrows)
+        if got != st.crc:
+            self.drop_state(f"crc {got:#010x} != stored {st.crc:#010x}")
+            return None
+        return batch
+
+    def drop_state(self, reason: str, evict: bool = False,
+                   provisional: bool = False) -> None:
+        """Release one aggregate-state slot (committed by default, the
+        in-flight provisional one under budget pressure) with the
+        shared eviction accounting — both paths must emit the same
+        StateEvict shape."""
+        if provisional:
+            st, self._agg_prov = self._agg_prov, None
+        else:
+            st, self._agg = self._agg, None
+        if st is None:
+            return
+        try:
+            st.handle.close()
+        except Exception:
+            pass
+        self._bump("evictions" if evict else "invalid")
+        self._emit("StateEvict", kind="aggState", reason=reason,
+                   bytes=st.size_bytes, epoch=st.epoch)
+
+    @property
+    def state_fingerprint(self) -> Optional[str]:
+        return self._agg.fingerprint if self._agg is not None else None
+
+    @property
+    def state_bytes(self) -> int:
+        n = self.live_bytes
+        for st in (self._agg, self._agg_prov):
+            if st is not None:
+                n += st.size_bytes
+        return n
+
+    # -------------------------------------------------------------------- epochs --
+    def commit(self, mode: str, delta_files: int, reused: bool) -> int:
+        """Atomically promote the provisional epoch: the new aggregate
+        state replaces the old (whose payload is released), provisional
+        stage entries become committed, and — when this tick spliced —
+        committed entries the tick never touched are pruned (their
+        input fingerprints have moved on; they can never match again).
+        The commit is the LAST step of a tick: everything before it is
+        invisible to the next tick until this returns."""
+        self.epoch += 1
+        if self._agg_prov is not None:
+            old, self._agg = self._agg, self._agg_prov
+            self._agg_prov = None
+            if old is not None:
+                try:
+                    old.handle.close()
+                except Exception:
+                    pass
+        if self._splice_active and self._splice_complete:
+            # lifecycle GC, not pressure: a DISTRIBUTED splice tick
+            # that completed on the mesh and never touched an entry
+            # proves its input fingerprint moved on — the key can
+            # never match again.  Removed silently (no StateEvict, no
+            # eviction counter): routine pruning on a healthy standing
+            # query must not trip the eviction-thrash health check.
+            # Guarded by _splice_complete: a tick whose final attempt
+            # left the mesh (layout rung, planner fallback) touched
+            # nothing, and pruning then would wipe still-valid lineage
+            for sid in [s for s in self._entries
+                        if s not in self._touched]:
+                entry = self._entries.pop(sid)
+                self._provisional.discard(sid)
+                try:
+                    entry.handle.close()
+                except Exception:
+                    pass
+        self._provisional.clear()
+        self._touched.clear()
+        self._splice_active = False
+        self._splice_complete = False
+        self._evict_over_budget()
+        incremental_metrics.bump("commits")
+        incremental_metrics.set("stateBytes", self.state_bytes)
+        self._emit("StateCommit", epoch=self.epoch,
+                   stateBytes=self.state_bytes,
+                   entries=len(self._entries), mode=mode,
+                   deltaFiles=delta_files, reusedState=bool(reused))
+        return self.epoch
+
+    def rollback(self, reason: str) -> None:
+        """Discard every provisional write; the committed epoch is
+        untouched — a chaos-killed tick leaves the standing state
+        exactly as the last commit left it."""
+        self.clear(reason)
+        self._touched.clear()
+        self._splice_active = False
+        self._splice_complete = False
+        incremental_metrics.bump("rollbacks")
+        self._emit("StateRollback", epoch=self.epoch, reason=reason)
+
+    def _evict_over_budget(self) -> None:
+        """maxStateBytes over ALL state: oldest stage entries evict
+        first (stale lineage is the cheapest loss), then the committed
+        aggregate state (superseded at the next commit anyway), and
+        only then the provisional one — each eviction degrades a
+        future tick to recompute, never fails one."""
+        while self.state_bytes > self.max_bytes and self._entries:
+            victim = min(self._entries.values(), key=lambda e: e.seq)
+            self.drop(victim.stage_id, reason="max-state-bytes",
+                      evict=True)
+        if self.state_bytes > self.max_bytes and self._agg is not None:
+            self.drop_state("max-state-bytes", evict=True)
+        if self.state_bytes > self.max_bytes and \
+                self._agg_prov is not None:
+            self.drop_state("max-state-bytes", evict=True,
+                            provisional=True)
+
+    def close(self) -> None:
+        """Release every payload (runner teardown / session stop)."""
+        self.clear("store-closed")
+        for sid in list(self._entries):
+            entry = self._entries.pop(sid)
+            try:
+                entry.handle.close()
+            except Exception:
+                pass
+        if self._agg is not None:
+            try:
+                self._agg.handle.close()
+            except Exception:
+                pass
+            self._agg = None
+
+
+# ------------------------------------------------------------- plan analysis --
+
+def _single_file_scan(plan):
+    """The unique FileRelation leaf of a plan, or None (no scan, or
+    more than one — appending paths would be ambiguous)."""
+    from spark_rapids_tpu.plan import logical as L
+    scans = []
+
+    def walk(node):
+        if isinstance(node, L.FileRelation):
+            scans.append(node)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return scans[0] if len(scans) == 1 else None
+
+
+def _replace_scan(plan, scan, paths):
+    """Clone ``plan`` with ``scan``'s path list swapped for ``paths``.
+    Expressions stay shared (they are bound by ordinal and the delta
+    scan exposes the identical schema); only the node spine is
+    copied."""
+    from spark_rapids_tpu.plan import logical as L
+    if plan is scan:
+        new = copy.copy(plan)
+        new.paths = list(paths)
+        new.pushed_filters = list(plan.pushed_filters)
+        new.file_meta = set(plan.file_meta)
+        return new
+    if not plan.children:
+        return plan
+    new = copy.copy(plan)
+    new.children = tuple(_replace_scan(c, scan, paths)
+                         for c in plan.children)
+    return new
+
+
+class _AggSpec:
+    """Decomposition of an aggregation plan into mergeable partials.
+
+    ``[Sort|Limit|Filter]* <- Aggregate <- [Filter|Project]* <- scan``
+    splits into: a partial-aggregate plan template (run over the delta
+    files only), a merge aggregate (re-reduce (old-state ⊕ delta)
+    partial rows — the same update/merge split the engine's chunked and
+    distributed aggregates use, ops/aggregates.merge_kind), a finalize
+    projection (avg = sum/count), and the post-aggregate operator chain
+    re-applied on top.  ``None`` from :meth:`analyze` means the plan
+    has no delta form — ticks then full-recompute (with lineage
+    splice), which is always correct."""
+
+    def __init__(self, agg, pre_chain_root, post_ops, partial_aggs,
+                 merge_keys, merge_aggs, final_exprs, partial_schema):
+        self.agg = agg
+        self.pre_root = pre_chain_root  # plan node directly above scan
+        self.post_ops = post_ops        # outermost-first [Sort|Limit|Filter]
+        self.partial_aggs = partial_aggs
+        self.merge_keys = merge_keys
+        self.merge_aggs = merge_aggs
+        self.final_exprs = final_exprs
+        self.partial_schema = partial_schema
+
+    @classmethod
+    def analyze(cls, plan, scan):
+        from spark_rapids_tpu.columnar import dtypes as dts
+        from spark_rapids_tpu.ops import aggregates as ag
+        from spark_rapids_tpu.ops.arithmetic import Divide
+        from spark_rapids_tpu.ops.cast import Cast
+        from spark_rapids_tpu.ops.expressions import (Alias,
+                                                      UnresolvedColumn)
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.plan.logical import AggregateExpression
+        if scan is None:
+            return None
+        post, node = [], plan
+        while isinstance(node, (L.Sort, L.Limit, L.Filter)):
+            post.append(node)
+            node = node.children[0]
+        if not isinstance(node, L.Aggregate):
+            return None
+        agg = node
+        pre = agg.child
+        c = pre
+        while isinstance(c, (L.Filter, L.Project)):
+            c = c.children[0]
+        if c is not scan:
+            return None
+
+        keys = [(ge.name, ge.dtype) for ge in agg.group_exprs]
+        if len({n for n, _ in keys}) != len(keys):
+            return None  # duplicate key names would mis-merge
+        if any(n.startswith("__p") for n, _ in keys):
+            return None  # reserved partial-column prefix
+        partial_aggs: List = []   # Alias(AggregateExpression, pname)
+        merge_aggs: List = []
+        final_tail: List = []
+        partial_cols: List[Tuple[str, object]] = []
+
+        def add(pname, update_func, merge_cls):
+            ae = AggregateExpression(update_func)
+            partial_aggs.append(Alias(ae, pname))
+            partial_cols.append((pname, ae.dtype))
+            merge_aggs.append(Alias(AggregateExpression(
+                merge_cls(UnresolvedColumn(pname))), pname))
+
+        for i, e in enumerate(agg.agg_exprs):
+            name = e.name
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if not isinstance(inner, AggregateExpression):
+                return None
+            func = inner.func
+            child = func.child
+            if child is not None and child.dtype.is_decimal:
+                return None  # sum(decimal) widens per level; no merge form
+            if isinstance(func, ag.Average):
+                sname, cname = f"__p{i}s", f"__p{i}c"
+                add(sname, ag.Sum(Cast(child, dts.FLOAT64)), ag.Sum)
+                add(cname, ag.Count(child), ag.Sum)
+                final_tail.append(Alias(
+                    Divide(UnresolvedColumn(sname),
+                           UnresolvedColumn(cname)), name))
+            elif isinstance(func, ag.Sum):
+                add(f"__p{i}", ag.Sum(child), ag.Sum)
+                final_tail.append(Alias(UnresolvedColumn(f"__p{i}"),
+                                        name))
+            elif isinstance(func, ag.Count):
+                add(f"__p{i}", ag.Count(child), ag.Sum)
+                final_tail.append(Alias(UnresolvedColumn(f"__p{i}"),
+                                        name))
+            elif isinstance(func, ag.Min):
+                add(f"__p{i}", ag.Min(child), ag.Min)
+                final_tail.append(Alias(UnresolvedColumn(f"__p{i}"),
+                                        name))
+            elif isinstance(func, ag.Max):
+                add(f"__p{i}", ag.Max(child), ag.Max)
+                final_tail.append(Alias(UnresolvedColumn(f"__p{i}"),
+                                        name))
+            else:
+                return None  # first/last/collect/moments: order- or
+                #               shape-dependent; no safe delta merge yet
+
+        partial_schema = keys + partial_cols
+        merge_keys = [Alias(UnresolvedColumn(n), n) for n, _ in keys]
+        final_exprs = [UnresolvedColumn(n) for n, _ in keys] + final_tail
+        spec = cls(agg, pre, post, partial_aggs, merge_keys, merge_aggs,
+                   final_exprs, partial_schema)
+        # the decomposition must reproduce the original output schema
+        # exactly — name or dtype drift means the merge form is not the
+        # same query, so refuse it rather than answer differently
+        try:
+            probe = spec.result_plan([])
+        except Exception:
+            return None
+        if [(n, dt.name) for n, dt in probe.schema] != \
+                [(n, dt.name) for n, dt in plan.schema]:
+            return None
+        return spec
+
+    # -- plan builders ----------------------------------------------------
+    def partial_plan(self, scan, paths):
+        """Partial aggregate over ONLY ``paths`` (the delta)."""
+        from spark_rapids_tpu.plan import logical as L
+        child = _replace_scan(self.pre_root, scan, paths)
+        return L.Aggregate(list(self.agg.group_exprs),
+                           list(self.partial_aggs), child)
+
+    def merge_plan(self, batches):
+        """Re-aggregate (old-state ⊕ delta) partial rows into the next
+        epoch's state — the aggregate merge discipline over an
+        in-memory union of partial batches."""
+        from spark_rapids_tpu.plan import logical as L
+        rel = L.InMemoryRelation(batches, self.partial_schema)
+        return L.Aggregate(list(self.merge_keys), list(self.merge_aggs),
+                           rel)
+
+    def result_plan(self, state_batches):
+        """Finalize projection over the merged state (avg = sum/count)
+        with the post-aggregate operator chain re-applied."""
+        from spark_rapids_tpu.plan import logical as L
+        rel = L.InMemoryRelation(state_batches, self.partial_schema)
+        node = L.Project(list(self.final_exprs), rel)
+        for op in reversed(self.post_ops):
+            if isinstance(op, L.Sort):
+                node = L.Sort(list(op.orders), node)
+            elif isinstance(op, L.Limit):
+                node = L.Limit(op.n, node)
+            else:
+                node = L.Filter(op.condition, node)
+        return node
+
+
+# ---------------------------------------------------------------- the runner --
+
+class _TickDegraded(Exception):
+    """Internal: the incremental path cannot proceed (no state, state
+    dropped, fingerprint moved) — fall through to full recompute
+    WITHOUT counting a rollback (nothing provisional was written)."""
+
+
+class MicroBatchRunner:
+    """One standing query over an append-only input.
+
+    ``session.incremental(df)`` → runner; ``runner.tick(new_paths)``
+    ingests the appended files and returns the query's result over
+    everything ingested so far, as a DataFrame over the materialized
+    result (cheap to ``collect()``/``to_pandas()``).  Ticks serialize
+    per runner; each execution inside a tick is an ordinary query to
+    the rest of the engine (admission, budgets, ladder, watchdog)."""
+
+    def __init__(self, session, df):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.session = session
+        self.df = df
+        conf = session.conf
+        self.enabled = bool(conf.get(rc.INCREMENTAL_ENABLED)) and \
+            getattr(session, "memory_catalog", None) is not None
+        self.store: Optional[IncrementalStateStore] = \
+            IncrementalStateStore(session) if self.enabled else None
+        self._scan = _single_file_scan(df.plan)
+        self._spec = _AggSpec.analyze(df.plan, self._scan) \
+            if self.enabled else None
+        self._initial = list(self._scan.paths) if self._scan is not None \
+            else []
+        self._paths: List[str] = []   # committed (ingested) input set
+        self._ticked = False
+        self._lock = threading.Lock()
+        self.last_tick_info: Dict[str, object] = {}
+
+    # ------------------------------------------------------------- helpers --
+    def _fingerprint(self, paths) -> str:
+        from spark_rapids_tpu.io.readers import scan_input_meta
+        return self._meta_fingerprint(scan_input_meta(paths))
+
+    @staticmethod
+    def _meta_fingerprint(meta) -> str:
+        """Fingerprint of an already-statted ``scan_input_meta``
+        result — lets one stat walk serve both the staleness check and
+        the new epoch's fingerprint within a tick."""
+        from spark_rapids_tpu.io.readers import input_signature
+        return hashlib.sha256(
+            input_signature(sorted(meta)).encode()).hexdigest()
+
+    def _run(self, plan, splice: bool = False) -> list:
+        """Execute one logical plan through the full robustness stack.
+        With ``splice`` the persistent store rides as the query's
+        checkpoint manager, so unchanged (input-fingerprinted) subtrees
+        restore instead of re-running."""
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        df = DataFrame(self.session, plan)
+        if splice and self.store is not None and \
+                getattr(self.session, "mesh", None) is not None:
+            self.store._splice_active = True
+            self.session.checkpoints = self.store
+            try:
+                # stale-entry pruning at commit is only sound when the
+                # FINAL attempt really ran on the mesh; the planner
+                # signals that via note_distributed_complete on THIS
+                # thread (a shared session attribute would race with
+                # concurrent queries), and clear() (layout rung)
+                # vetoes it for the rest of the tick
+                return df._execute_batches()
+            finally:
+                self.session.checkpoints = None
+        return df._execute_batches()
+
+    @staticmethod
+    def _concat(batches):
+        from spark_rapids_tpu.ops.concat import concat_batches
+        live = [b for b in batches if b.nrows]
+        if not live:
+            return None
+        return concat_batches(live) if len(live) > 1 else live[0]
+
+    def _result_df(self, batches, schema):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.plan import logical as L
+        return DataFrame(self.session,
+                         L.InMemoryRelation(batches, list(schema)))
+
+    # ---------------------------------------------------------------- ticks --
+    def tick(self, new_paths=()):
+        """Ingest ``new_paths`` (appended files) and return the result
+        over everything ingested so far."""
+        with self._lock:
+            return self._tick([new_paths] if isinstance(new_paths, str)
+                              else list(new_paths))
+
+    def _tick(self, new_paths):
+        from spark_rapids_tpu.plan import logical as L
+        if new_paths and self._scan is None:
+            raise ValueError(
+                "tick(new_paths) needs a plan with exactly one file "
+                "scan to append to; this plan has none (or several)")
+        base = list(self._paths) if self._ticked else list(self._initial)
+        seen = set(base)
+        delta = []
+        for p in new_paths:
+            if p not in seen:  # dedupe within the call too: a watcher
+                seen.add(p)    # emitting [p, p] must not ingest twice
+                delta.append(p)
+        target = base + delta
+        if not self._ticked:
+            delta = list(target)  # the first tick ingests everything
+        incremental_metrics.bump("ticks")
+        info: Dict[str, object] = {"deltaFiles": len(delta),
+                                   "mode": "full", "reused": False}
+
+        if self.store is None:
+            # incremental.enabled=false parity: every tick is a plain
+            # full execution, no standing state
+            out = self._run(self._full_plan(target))
+            self._finish(target, info)
+            return self._result_df(out, self.df.plan.schema)
+
+        try:
+            out = self._tick_body(target, delta, info)
+        except _TickDegraded:
+            out = self._full_or_rollback(target, info)
+        except Exception as exc:  # noqa: BLE001 - every escape degrades
+            # mid-tick fault (exhausted ladder, fatal, admission
+            # reject): roll back to the committed epoch, then answer
+            # with a full recompute — never partial state, never wrong
+            # bytes.  A full recompute that ALSO fails re-raises with
+            # the epoch still intact.
+            self.store.rollback(f"{type(exc).__name__}: {exc}")
+            info["rollbackFrom"] = f"{type(exc).__name__}: {exc}"
+            out = self._full_or_rollback(target, info)
+        self.store.commit(info["mode"], info["deltaFiles"],
+                          info["reused"])
+        self._finish(target, info)
+        return self._result_df(out, self.df.plan.schema)
+
+    def _finish(self, target, info) -> None:
+        self._paths = list(target)
+        if self._scan is not None:
+            # keep the standing plan's own scan in step, so a direct
+            # df.to_pandas() (the oracle form) sees the ingested set
+            self._scan.paths = list(target)
+        self._ticked = True
+        info["epoch"] = self.store.epoch if self.store is not None else 0
+        self.last_tick_info = dict(info)
+
+    def _full_plan(self, paths):
+        if self._scan is None:
+            return self.df.plan
+        return _replace_scan(self.df.plan, self._scan, paths)
+
+    def _tick_body(self, target, delta, info) -> list:
+        """The incremental path; raises _TickDegraded when the
+        committed epoch cannot carry this tick."""
+        if self._spec is None or not self._ticked:
+            raise _TickDegraded
+        state = self.store.get_state()
+        if state is None:
+            raise _TickDegraded
+        from spark_rapids_tpu.io.readers import scan_input_meta
+        # one stat walk per file per tick: the committed-set walk
+        # serves the staleness check, and the target fingerprint
+        # derives from it plus the (small) delta walk
+        meta_committed = scan_input_meta(self._paths)
+        if self.store.state_fingerprint != \
+                self._meta_fingerprint(meta_committed):
+            # an already-ingested file changed out-of-band (rewritten,
+            # truncated, even same-size — mtime catches it): the state
+            # no longer describes the input
+            self.store.drop_state("input-fingerprint-moved")
+            raise _TickDegraded
+        if delta:
+            # stat BEFORE read: if a delta file mutates between the
+            # stat and the scan, the committed fingerprint describes
+            # the PRE-mutation bytes and the next tick's staleness
+            # check drops the state — the safe failure mode.  Statting
+            # after the read would stamp post-mutation identity onto
+            # pre-mutation state and hide the mutation forever.
+            meta_delta = scan_input_meta(delta)
+            partial = self._run(self._spec.partial_plan(self._scan,
+                                                        delta))
+            merged = self._run(self._spec.merge_plan(
+                [state] + [b for b in partial if b.nrows]))
+            state = self._concat(merged)
+            if state is None:
+                from spark_rapids_tpu.columnar.batch import empty_batch
+                state = empty_batch(self._spec.partial_schema)
+            self.store.put_state(state, self._meta_fingerprint(
+                meta_committed + meta_delta))
+        out = self._run(self._spec.result_plan([state]))
+        # counted only once the WHOLE incremental path answered: a
+        # finalize-run fault degrades this tick to full recompute and
+        # must not leave it double-counted in the reuse ratio
+        info["mode"] = "incremental"
+        info["reused"] = True
+        incremental_metrics.bump("incrementalTicks")
+        return out
+
+    def _full_or_rollback(self, target, info) -> list:
+        """Degraded recompute with the leak guard: a full recompute
+        that dies mid-flight must not leave ITS provisional writes
+        (the rebuilt state it put before the finalize run failed)
+        pinned in the catalog — roll them back before re-raising, so
+        the tick fails with the committed epoch exactly intact."""
+        try:
+            return self._tick_full(target, info)
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            self.store.rollback(
+                f"degraded-recompute-failed: {type(exc).__name__}: "
+                f"{exc}")
+            raise
+
+    def _tick_full(self, target, info) -> list:
+        """Full recompute: correct under every degradation.  With a
+        delta-capable plan the state rebuilds from one partial pass
+        over ALL inputs (result derives from it); otherwise the
+        original plan re-runs with the lineage splice restoring
+        unchanged subtrees."""
+        incremental_metrics.bump("fullRecomputes")
+        info["mode"] = "full"
+        if self._spec is not None:
+            # stat before read (see _tick_body): a mid-scan mutation
+            # must leave the state stamped with PRE-mutation identity
+            fp = self._fingerprint(target)
+            partial = self._run(self._spec.partial_plan(self._scan,
+                                                        target))
+            state = self._concat(partial)
+            if state is None:
+                from spark_rapids_tpu.columnar.batch import empty_batch
+                state = empty_batch(self._spec.partial_schema)
+            self.store.put_state(state, fp)
+            return self._run(self._spec.result_plan([state]))
+        # reuse detection reads the STORE-LOCAL resume counter, not the
+        # process-global one: concurrent runners must not contaminate
+        # each other's reusedState flag
+        r0 = self.store.local["resumes"]
+        out = self._run(self._full_plan(target), splice=True)
+        info["reused"] = self.store.local["resumes"] > r0
+        return out
+
+    def close(self) -> None:
+        """Release the standing state (the runner's epochs die here;
+        the session's catalog sweep would collect them at stop()
+        anyway)."""
+        if self.store is not None:
+            self.store.close()

@@ -51,6 +51,12 @@ class QueryInfo:
     # CheckpointWrite/Resume/Evict/Invalid; "kind" is
     # write|resume|evict|invalid)
     checkpoint: List[Dict[str, str]] = field(default_factory=list)
+    # continuous-ingest events (robustness/incremental.py
+    # StateCommit/StateRollback/StateEvict/IncrementalResume; "kind"
+    # is commit|rollback|evict|resume) — resumes land here (they fire
+    # inside a tick's query envelope); commit/rollback usually land on
+    # the app (they fire between the tick's executions)
+    incremental: List[Dict[str, str]] = field(default_factory=list)
     # full post-mortem trail of a fatally-failed query (QueryFatal:
     # error, recovery actions, watchdog + checkpoint snapshots) —
     # present even when the ladder never succeeded
@@ -93,6 +99,7 @@ class AppInfo:
     watchdog: List[Dict[str, str]] = field(default_factory=list)
     corruption: List[Dict[str, str]] = field(default_factory=list)
     checkpoint: List[Dict[str, str]] = field(default_factory=list)
+    incremental: List[Dict[str, str]] = field(default_factory=list)
     fatal: List[Dict[str, object]] = field(default_factory=list)
     # serving-layer admission stream (Admission grants are emitted
     # before the query draws its id, so they live at session level)
@@ -187,6 +194,20 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.checkpoint if q is not None
                  else app.checkpoint).append(info)
+            elif ev in ("StateCommit", "StateRollback", "StateEvict",
+                        "IncrementalResume"):
+                info = {k: rec[k] for k in
+                        ("epoch", "stateBytes", "entries", "mode",
+                         "deltaFiles", "reusedState", "reason",
+                         "bytes", "stageId", "stagesSaved")
+                        if k in rec}
+                info["kind"] = {"StateCommit": "commit",
+                                "StateRollback": "rollback",
+                                "StateEvict": "evict",
+                                "IncrementalResume": "resume"}[ev]
+                q = all_queries.get(rec.get("queryId"))
+                (q.incremental if q is not None
+                 else app.incremental).append(info)
             elif ev == "Admission":
                 app.admission.append(
                     {k: rec[k] for k in ("waitMs", "weightBytes",

@@ -162,6 +162,47 @@ def checkpoint_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Continuous-ingest effectiveness across sessions
+    (robustness/incremental.py): committed epochs split by mode
+    (incremental vs full-recompute), rollbacks, state evictions,
+    lineage-splice resumes, and the standing state's last committed
+    size.  ``reuse_ratio`` is the headline: the fraction of ticks that
+    actually rode the committed epoch instead of recomputing."""
+    commits = inc = full = rollbacks = evicts = resumes = 0
+    state_bytes = 0
+    for a in apps:
+        events = list(a.incremental) + [e for q in a.queries
+                                        for e in q.incremental]
+        for e in events:
+            kind = e.get("kind")
+            if kind == "commit":
+                commits += 1
+                if e.get("mode") == "incremental" or e.get("reusedState"):
+                    inc += 1
+                else:
+                    full += 1
+                state_bytes = e.get("stateBytes", state_bytes)
+            elif kind == "rollback":
+                rollbacks += 1
+            elif kind == "evict":
+                evicts += 1
+            elif kind == "resume":
+                resumes += 1
+    if not commits and not rollbacks:
+        return {}
+    return {
+        "commits": commits,
+        "incremental_ticks": inc,
+        "full_recomputes": full,
+        "rollbacks": rollbacks,
+        "state_evictions": evicts,
+        "splice_resumes": resumes,
+        "state_bytes": state_bytes,
+        "reuse_ratio": inc / commits if commits else 0.0,
+    }
+
+
 def nearest_rank(sorted_vals: List[float], p: float) -> float:
     """Nearest-rank percentile over an ascending list — shared by the
     concurrency report and ``bench.py --concurrency`` so the two can
@@ -341,6 +382,10 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                                              a.corruption))
         problems.extend(_checkpoint_problems(
             a.session_id, a.checkpoint, recovered=bool(a.recovery)))
+        problems.extend(_incremental_problems(
+            a.session_id,
+            list(a.incremental) + [e for q in a.queries
+                                   for e in q.incremental]))
         for f in a.fatal:
             problems.append(
                 f"{a.session_id}: fatal query (no attributed id) — "
@@ -379,6 +424,42 @@ def _checkpoint_problems(who: str, events: List[dict],
             f"{who}: {len(crc)} checkpoint payload(s) failed "
             "verification — dropped and re-run from source (never "
             "wrong bytes); check spill storage health")
+    return out
+
+
+def _incremental_problems(who: str, events: List[dict]) -> List[str]:
+    """Continuous-ingest health: ticks that reused zero state after
+    the first epoch (the standing query pays full-recompute latency —
+    the whole point of incremental state bought nothing), a high
+    rollback rate (faults keep killing ticks mid-flight), and
+    state-eviction thrash (maxStateBytes cannot hold one epoch, so
+    every tick recomputes)."""
+    out = []
+    commits = [e for e in events if e.get("kind") == "commit"]
+    rollbacks = sum(1 for e in events if e.get("kind") == "rollback")
+    evicts = sum(1 for e in events if e.get("kind") == "evict")
+    cold = [e for e in commits
+            if e.get("epoch", 1) > 1 and e.get("mode") == "full"
+            and not e.get("reusedState")]
+    if cold:
+        out.append(
+            f"{who}: {len(cold)} ingest tick(s) after the first epoch "
+            "reused ZERO standing state (full recompute) — evicted/"
+            "invalidated state or a fingerprint that moves every tick; "
+            "incremental.maxStateBytes and input stability are the "
+            "knobs")
+    if commits and rollbacks > max(1, len(commits) // 2):
+        out.append(
+            f"{who}: {rollbacks} epoch rollback(s) over {len(commits)} "
+            "commit(s) — mid-tick faults keep discarding provisional "
+            "state; the ingest answers correctly but pays "
+            "rollback + full-recompute latency every time")
+    if commits and evicts >= len(commits):
+        out.append(
+            f"{who}: incremental state eviction thrash — {evicts} "
+            f"evictions over {len(commits)} commit(s); "
+            "incremental.maxStateBytes cannot hold one epoch, so "
+            "every tick degrades to full recompute")
     return out
 
 
@@ -587,6 +668,18 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"stagesSkipped={cp['stages_skipped']} "
             f"evictions={cp['evictions']} "
             f"invalidations={cp['invalidations']}")
+    ic = incremental_stats(apps)
+    if ic:
+        out.append("\n-- Continuous ingest --")
+        out.append(
+            f"  epochs={ic['commits']} "
+            f"incremental={ic['incremental_ticks']} "
+            f"fullRecomputes={ic['full_recomputes']} "
+            f"reuse={ic['reuse_ratio']:.2f} "
+            f"rollbacks={ic['rollbacks']} "
+            f"stateEvictions={ic['state_evictions']} "
+            f"spliceResumes={ic['splice_resumes']} "
+            f"stateBytes={ic['state_bytes']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

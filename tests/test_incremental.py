@@ -1,0 +1,433 @@
+"""Continuous micro-batch ingest suite: crash-consistent incremental
+state (robustness/incremental.py).
+
+Counter-pinned like test_checkpoint.py: source pulls are counted
+through the injection registry's skip-consumption rules, so a tick
+that silently re-read already-ingested files fails the test, not just
+a slower one.  Results use integer-valued doubles so partial-sum
+merges are bit-identical to the one-shot recompute oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+from spark_rapids_tpu.robustness.incremental import incremental_metrics
+
+pytestmark = pytest.mark.chaos
+
+NSHARDS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    incremental_metrics.reset()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    if jax.device_count() < NSHARDS:
+        pytest.skip("needs the virtual 8-device mesh")
+    return make_mesh(NSHARDS)
+
+
+_RNG = np.random.default_rng(17)
+
+
+def _write(d, i, n=2000):
+    pdf = pd.DataFrame({
+        "k": _RNG.integers(0, 20, n),
+        "v": _RNG.integers(0, 1000, n).astype(np.float64)})
+    p = str(d / f"batch-{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+
+def _session(mesh, **conf):
+    base = {"spark.rapids.sql.recovery.backoffMs": 1}
+    base.update(conf)
+    return TpuSession(base, mesh=mesh)
+
+
+def _agg_df(session, paths):
+    return (session.read.parquet(*paths)
+            .groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count("v").alias("c"),
+                 F.min("v").alias("mn"), F.avg("v").alias("av"))
+            .orderBy("k"))
+
+
+def _count_rule(point):
+    """Skip-consumption counter (test_checkpoint.py idiom): every
+    fire() decrements ``skip`` without raising, so (start - skip) is an
+    exact hit count."""
+    return I.inject(point, count=1, skip=1_000_000, all_threads=True)
+
+
+def _hits(rule):
+    return 1_000_000 - rule.skip
+
+
+# ------------------------------------------------------------- counter pins --
+def test_tick_counter_pinned_delta_only(mesh, tmp_path):
+    """The acceptance pin: tick k+1 over unchanged-plus-appended input
+    pulls ONLY the new file (zero re-pulls of old sources) and
+    launches only delta + merge stages; the answer is bit-identical to
+    the one-shot recompute oracle."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+    assert runner.last_tick_info["mode"] == "full"  # cold epoch
+
+    p3 = _write(tmp_path, 3)
+    reads = _count_rule("io.read")
+    launches = _count_rule("shuffle.exchange")
+    got = runner.tick([p3]).to_pandas()
+    tick_reads, tick_launches = _hits(reads), _hits(launches)
+    I.remove(reads)
+    I.remove(launches)
+    assert runner.last_tick_info["mode"] == "incremental"
+    # exact pins: the delta file is one reader batch — the ONLY source
+    # pull of the whole tick — and the tick launches exactly the
+    # delta-aggregate, state-merge, and finalize-sort exchanges
+    assert tick_reads == 1, tick_reads
+    assert tick_launches == 3, tick_launches
+
+    oracle = _agg_df(s, [p1, p2, p3]).to_pandas()
+    pd.testing.assert_frame_equal(got, oracle)  # bit-identical
+
+    # zero-delta tick: the standing result re-derives from state alone
+    reads = _count_rule("io.read")
+    again = runner.tick().to_pandas()
+    assert _hits(reads) == 0
+    I.remove(reads)
+    pd.testing.assert_frame_equal(again, oracle)
+
+    # duplicate paths — within one call AND re-passing ingested files —
+    # must not double-ingest (a file watcher emitting [p, p] twice)
+    p4 = _write(tmp_path, 4)
+    dup = runner.tick([p4, p4, p3]).to_pandas()
+    assert runner._paths.count(p4) == 1 and runner._paths.count(p3) == 1
+    pd.testing.assert_frame_equal(
+        dup, _agg_df(s, [p1, p2, p3, p4]).to_pandas())
+    runner.close()
+    s.stop()
+
+
+# ------------------------------------------------------- epoch crash safety --
+def test_midtick_fault_rolls_back_then_full_recomputes(mesh, tmp_path):
+    """A fault escaping a tick's execution (recovery ladder disabled so
+    nothing absorbs it) rolls the store back to the committed epoch and
+    the SAME tick answers via full recompute — correct bytes, never
+    partial state; the next tick rides the rebuilt state again."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh, **{"spark.rapids.sql.recovery.enabled": False})
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    with I.injected("io.read", count=1):
+        got = runner.tick([p3]).to_pandas()
+    m1 = incremental_metrics.snapshot()
+    assert m1["rollbacks"] - m0["rollbacks"] == 1
+    assert m1["fullRecomputes"] - m0["fullRecomputes"] == 1
+    assert runner.last_tick_info["mode"] == "full"
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+
+    p4 = _write(tmp_path, 4)
+    got = runner.tick([p4]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, _agg_df(s, [p1, p2, p3, p4]).to_pandas())
+    runner.close()
+    s.stop()
+
+
+def test_chaos_killed_tick_leaves_committed_epoch(mesh, tmp_path):
+    """The acceptance pin: a chaos-killed mid-tick run (both the delta
+    attempt AND the degraded full recompute die) raises — and the NEXT
+    tick answers bit-identically to the full-recompute oracle, because
+    the committed epoch was never half-updated."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh, **{"spark.rapids.sql.recovery.enabled": False})
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    with pytest.raises(Exception):
+        with I.injected("io.read", count=10):
+            runner.tick([p3])
+    m1 = incremental_metrics.snapshot()
+    assert m1["rollbacks"] - m0["rollbacks"] >= 1
+    # the failed tick committed nothing: epoch and ingested set are the
+    # pre-tick ones, so the retry re-ingests p3
+    got = runner.tick([p3]).to_pandas()
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+    runner.close()
+    s.stop()
+
+
+def test_state_corruption_degrades_to_full_recompute(mesh, tmp_path):
+    """A bit flip on the state-restore path (fire_mutate chaos hook):
+    CRC verification drops the state, the tick degrades to full
+    recompute — never wrong bytes, never a failed tick — and the next
+    tick is incremental again over the rebuilt epoch."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+
+    p3 = _write(tmp_path, 3)
+    with I.injected("incremental.state.restore", kind="corrupt",
+                    count=1, all_threads=True):
+        got = runner.tick([p3]).to_pandas()
+    assert runner.last_tick_info["mode"] == "full"
+    m = incremental_metrics.snapshot()
+    assert m["invalid"] >= 1
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+
+    p4 = _write(tmp_path, 4)
+    got = runner.tick([p4]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, _agg_df(s, [p1, p2, p3, p4]).to_pandas())
+    runner.close()
+    s.stop()
+
+
+def test_out_of_band_input_mutation_detected(mesh, tmp_path):
+    """Rewriting an already-ingested file moves the input fingerprint:
+    the committed state no longer describes the input, so the next tick
+    drops it and full-recomputes — exact result over the NEW bytes."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+
+    # rewrite p2 in place (different rows, different size)
+    pdf = pd.DataFrame({"k": _RNG.integers(0, 20, 3000),
+                        "v": _RNG.integers(0, 1000, 3000)
+                        .astype(np.float64)})
+    pdf.to_parquet(p2, index=False)
+    p3 = _write(tmp_path, 3)
+    got = runner.tick([p3]).to_pandas()
+    assert runner.last_tick_info["mode"] == "full"
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+    runner.close()
+    s.stop()
+
+
+# ---------------------------------------------------------------- eviction --
+def test_eviction_under_pressure_graceful_full_recompute(mesh,
+                                                         tmp_path):
+    """maxStateBytes too small for one epoch: every commit evicts the
+    state, every tick gracefully full-recomputes (StateEvict trail),
+    and the answers stay exact."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(
+        mesh, **{"spark.rapids.tpu.incremental.maxStateBytes": 1})
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+    p3 = _write(tmp_path, 3)
+    got = runner.tick([p3]).to_pandas()
+    assert runner.last_tick_info["mode"] == "full"
+    m = incremental_metrics.snapshot()
+    assert m["evictions"] >= 1
+    assert m["incrementalTicks"] == 0
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+    runner.close()
+    s.stop()
+
+
+# ------------------------------------------------------------------ parity --
+def test_enabled_false_parity(mesh, tmp_path):
+    """incremental.enabled=false: every tick is a plain full
+    re-execution — identical results, no standing state, no epochs."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(
+        mesh, **{"spark.rapids.tpu.incremental.enabled": False})
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    assert runner.store is None
+    r1 = runner.tick().to_pandas()
+    pd.testing.assert_frame_equal(r1, _agg_df(s, [p1, p2]).to_pandas())
+    p3 = _write(tmp_path, 3)
+    r2 = runner.tick([p3]).to_pandas()
+    pd.testing.assert_frame_equal(r2, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+    m = incremental_metrics.snapshot()
+    assert m["commits"] == 0 and m["writes"] == 0
+    runner.close()
+    s.stop()
+
+
+# ---------------------------------------------------------- lineage splice --
+def test_splice_restores_static_subtree(mesh, tmp_path):
+    """Plans with no delta form (a join) still reuse: the static
+    dimension side's aggregate subtree keeps its input-fingerprinted
+    stage id across ticks, so the full-recompute tick splices it from
+    the persistent lineage store instead of re-running it."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    dim = pd.DataFrame({"k": np.arange(20),
+                        "w": _RNG.integers(1, 5, 20)
+                        .astype(np.float64)})
+    dim_agg = (s.create_dataframe(dim).groupBy("k")
+               .agg(F.max("w").alias("w")))
+    fact = s.read.parquet(p1, p2)
+    df = (fact.join(dim_agg, "k").groupBy("k")
+          .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
+               .alias("s")).orderBy("k"))
+    runner = s.incremental(df)
+    assert runner._spec is None  # no delta form — splice path
+    runner.tick()
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    got = runner.tick([p3]).to_pandas()
+    m1 = incremental_metrics.snapshot()
+    assert m1["resumes"] - m0["resumes"] >= 1  # dim subtree spliced
+    assert runner.last_tick_info["reused"] is True
+    # stale-fingerprint pruning at commit is lifecycle GC, not
+    # pressure: a HEALTHY splice query must not count evictions (the
+    # eviction-thrash health check would misfire on every tick)
+    assert m1["evictions"] - m0["evictions"] == 0
+    pd.testing.assert_frame_equal(got, df.to_pandas())
+    runner.close()
+    s.stop()
+
+
+# --------------------------------------------------------------- lineage key --
+def test_stage_id_folds_input_fingerprint(mesh, tmp_path):
+    """Appending to a scan's file list (or appending TO a file: same
+    name, new size) moves exactly that subtree's lineage key; an
+    unrelated static plan's key is unchanged."""
+    from spark_rapids_tpu.robustness import checkpoint as cp
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    df = _agg_df(s, [p1])
+    a = cp.stage_id(df.plan, mesh)
+    assert a == cp.stage_id(df.plan, mesh)  # stable
+    df2 = _agg_df(s, [p1, p2])
+    assert cp.stage_id(df2.plan, mesh) != a  # appended file
+    # the per-query manager's form (inputs=False) skips the stat walk
+    # and must stay stable across input mutation — intra-query ids
+    # only need structural identity
+    b = cp.stage_id(df.plan, mesh, inputs=False)
+    with open(p1, "ab") as f:
+        f.write(b"x")  # same path, new size
+    assert cp.stage_id(df.plan, mesh) != a
+    assert cp.stage_id(df.plan, mesh, inputs=False) == b
+    s.stop()
+
+
+def test_splice_prune_requires_distributed_completion(mesh, tmp_path):
+    """Stale-entry pruning at commit is gated on the splice having run
+    DISTRIBUTED end to end: a tick whose final attempt left the mesh
+    (layout rung, planner fallback) touched nothing, and treating
+    'untouched' as 'stale' would wipe still-valid standing lineage."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    dim = pd.DataFrame({"k": np.arange(20),
+                        "w": np.ones(20)})
+    dim_agg = (s.create_dataframe(dim).groupBy("k")
+               .agg(F.max("w").alias("w")))
+    df = (s.read.parquet(p1, p2).join(dim_agg, "k").groupBy("k")
+          .agg(F.sum("v").alias("sv")).orderBy("k"))
+    runner = s.incremental(df)
+    runner.tick()
+    store = runner.store
+    committed = set(store._entries)
+    assert committed  # the splice tick persisted stage lineage
+
+    # a splice tick that never completed distributed: commit must NOT
+    # prune the untouched committed entries
+    store._splice_active, store._splice_complete = True, False
+    store._touched.clear()
+    store.commit("full", 0, False)
+    assert set(store._entries) == committed
+
+    # a DISTRIBUTED splice tick that really touched nothing: its
+    # untouched entries are provably stale and DO prune
+    store._splice_active, store._splice_complete = True, True
+    store._touched.clear()
+    store.commit("full", 0, False)
+    assert not store._entries
+    runner.close()
+    s.stop()
+
+
+# ------------------------------------------------------------ observability --
+def test_events_profiling_and_health(mesh, tmp_path):
+    """StateCommit/StateRollback/StateEvict/IncrementalResume flow into
+    the eventlog tools ("Continuous ingest" profiling section) and the
+    eviction-thrash / zero-reuse health checks fire."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (format_report,
+                                                  health_check,
+                                                  incremental_stats)
+    logdir = tmp_path / "events"
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.eventLog.dir": str(logdir),
+        "spark.rapids.sql.recovery.enabled": False})
+    df = _agg_df(s, [p1, p2])
+    runner = s.incremental(df)
+    runner.tick()
+    p3 = _write(tmp_path, 3)
+    with I.injected("io.read", count=1):
+        runner.tick([p3])  # rollback + degraded full recompute
+    p4 = _write(tmp_path, 4)
+    runner.tick([p4])      # incremental again
+    runner.close()
+    s.stop()
+
+    apps = load_logs(str(logdir))
+    events = [e for a in apps
+              for e in list(a.incremental) +
+              [x for q in a.queries for x in q.incremental]]
+    kinds = {e["kind"] for e in events}
+    assert "commit" in kinds and "rollback" in kinds
+    stats = incremental_stats(apps)
+    assert stats["commits"] >= 3
+    assert stats["rollbacks"] >= 1
+    assert stats["incremental_ticks"] >= 1
+    report = format_report(apps, top=5)
+    assert "Continuous ingest" in report
+
+    # eviction thrash flagged
+    logdir2 = tmp_path / "events2"
+    s2 = _session(mesh, **{
+        "spark.rapids.tpu.eventLog.dir": str(logdir2),
+        "spark.rapids.tpu.incremental.maxStateBytes": 1})
+    runner2 = s2.incremental(_agg_df(s2, [p1, p2]))
+    runner2.tick()
+    runner2.tick([p3])
+    runner2.close()
+    s2.stop()
+    problems = health_check(load_logs(str(logdir2)))
+    assert any("state eviction thrash" in p or
+               "reused ZERO standing state" in p for p in problems)
