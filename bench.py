@@ -561,6 +561,89 @@ def ingest_main(n_ticks: int) -> None:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# ------------------------------------------------------------------ repeat --
+def repeat_main(n_repeats: int) -> None:
+    """Warm-start bench (whole-stage fusion + persistent jit cache):
+    TPC-H q6 + the q1 group-by shape through a session with
+    ``spark.rapids.tpu.jitCache.dir`` set.  Phase 1 runs COLD (empty
+    store: trace + compile + persist).  Phase 2 simulates a fresh
+    process — the in-memory jit cache is cleared so every stage re-binds
+    — and repeats the queries N times against the on-disk executables.
+    Emits ONE JSON line: cold_compile_ms (cold minus warm — the
+    trace/compile cost the persistent tier deletes on repeat runs), warm
+    p50/p95, persistent hit/miss counters (misses in phase 2 mean the
+    warm start bought nothing) and fused_stage_count.  Runs in-process
+    on whatever platform jax resolves (set JAX_PLATFORMS=cpu for the
+    tunnel-proof number)."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.fusion import fusion_metrics
+    from spark_rapids_tpu.ops import jit_cache
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+
+    cache_dir = os.environ.get("BENCH_JITCACHE_DIR") or \
+        tempfile.mkdtemp(prefix="tpu-jitcache-bench-")
+    n_rows = 1 << 20
+    try:
+        session = TpuSession(
+            {"spark.rapids.tpu.jitCache.dir": cache_dir})
+        df = session.create_dataframe(gen_host(n_rows))
+        q6 = make_q6(session, df)
+        q1 = make_q1(session, df)
+        fm0 = fusion_metrics.snapshot()
+
+        jit_cache.clear()
+        t0 = time.perf_counter()
+        q6()
+        q1()
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        p_cold = jit_cache.persistent_info()
+
+        # "fresh process": drop every in-memory executable; phase 2 may
+        # only reuse what phase 1 persisted to disk
+        jit_cache.clear()
+        jit_cache.configure_persistent(None)
+        jit_cache.configure_persistent(
+            cache_dir, session.conf.get(rc.JIT_CACHE_MAX_BYTES))
+        warm = []
+        for _ in range(max(n_repeats, 1)):
+            t0 = time.perf_counter()
+            q6()
+            q1()
+            warm.append((time.perf_counter() - t0) * 1e3)
+        warm.sort()
+        p_warm = jit_cache.persistent_info()
+        fm1 = fusion_metrics.snapshot()
+        warm_p50 = nearest_rank(warm, 0.50)
+        print(json.dumps({
+            "metric": "warm_repeat_ms",
+            "value": round(warm_p50, 3),
+            "unit": "ms",
+            "repeats": len(warm),
+            "rows": n_rows,
+            "cold_ms": round(cold_ms, 3),
+            "cold_compile_ms": round(max(cold_ms - warm_p50, 0.0), 3),
+            "warm_p50_ms": round(warm_p50, 3),
+            "warm_p95_ms": round(nearest_rank(warm, 0.95), 3),
+            "jit_cache_persistent_hits": p_warm["hits"],
+            "jit_cache_persistent_misses": p_warm["misses"],
+            "jit_cache_persistent_stores": p_cold["stores"],
+            "jit_cache_persistent_invalid": p_warm["invalid"],
+            "fused_stage_count":
+                fm1["fusedStages"] - fm0["fusedStages"],
+            "fused_operator_count":
+                fm1["fusedOperators"] - fm0["fusedOperators"],
+        }))
+        sys.stdout.flush()
+        session.stop()
+    finally:
+        if not os.environ.get("BENCH_JITCACHE_DIR"):
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 # ------------------------------------------------------------- concurrency --
 def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
     """Serving-mode bench: N client threads hammer TPC-H q6 through one
@@ -632,6 +715,10 @@ if __name__ == "__main__":
         idx = sys.argv.index("--ingest-ticks")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8
         ingest_main(n)
+    elif "--repeat" in sys.argv:
+        idx = sys.argv.index("--repeat")
+        n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 5
+        repeat_main(n)
     else:
         _install_safety_net()
         main()

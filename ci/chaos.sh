@@ -203,6 +203,94 @@ print(f"ingest soak OK ({TICKS} chaos ticks exact, "
       f"device_bytes={dev[-1]} rssΔ={rss[-1]-rss[1]:.0f}MB)")
 PY
 
+echo "== jit-cache corruption/version spray (persistent tier degraded, exact results) =="
+# populate a persistent jit-cache dir, then attack it every way the
+# tier must survive: seeded bit flips at the jitcache.load fire_mutate
+# hook, on-disk truncation, a header stamped by a different jax
+# version, and raise/delay rules on the load path.  Every degraded
+# load must fall back to a fresh compile — the query answers with
+# clean-run results, wrong executables are never run.
+python - <<'PY'
+import glob
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.ops import jit_cache
+from spark_rapids_tpu.robustness import inject as I
+
+d = tempfile.mkdtemp(prefix="tpu-jitcache-chaos-")
+rng = np.random.default_rng(5)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+try:
+    s = TpuSession({"spark.rapids.tpu.jitCache.dir": d,
+                    "spark.rapids.sql.recovery.backoffMs": 5})
+    df = (s.create_dataframe(pdf)
+          .filter(F.col("v") > -1.0)
+          .select((F.col("v") * 2.0).alias("v2"), F.col("k"))
+          .group_by("k").agg(F.sum(F.col("v2")).alias("sv"),
+                             F.count(F.col("v2")).alias("c")))
+    jit_cache.clear()
+    want = df.to_pandas().sort_values("k", ignore_index=True)
+    entries = glob.glob(os.path.join(d, "*.jit"))
+    assert entries, "persistent tier wrote nothing"
+
+    def fresh():  # simulate a fresh process against the same dir
+        jit_cache.clear()
+        jit_cache.configure_persistent(None)
+        jit_cache.configure_persistent(d)
+
+    # pass 1: seeded bit flips via the fire_mutate hook (CRC gate)
+    fresh()
+    with I.scoped_rules():
+        I.inject("jitcache.load", kind="corrupt", count=3,
+                 probability=0.7, seed=17, all_threads=True)
+        got = df.to_pandas().sort_values("k", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+    inv1 = jit_cache.persistent_info()["invalid"]
+    assert inv1 >= 1, "corrupt rule never hit a load"
+
+    # pass 2: on-disk truncation + a foreign-version header
+    entries = sorted(glob.glob(os.path.join(d, "*.jit")))
+    assert len(entries) >= 2, entries
+    with open(entries[0], "r+b") as f:
+        f.truncate(max(os.path.getsize(entries[0]) // 2, 8))
+    raw = open(entries[1], "rb").read()
+    head, _, payload = raw.partition(b"\n")
+    hdr = json.loads(head)
+    hdr["env"]["jax"] = "0.0.0-elsewhere"
+    with open(entries[1], "wb") as f:
+        f.write(json.dumps(hdr).encode() + b"\n" + payload)
+    fresh()
+    got = df.to_pandas().sort_values("k", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+    assert jit_cache.persistent_info()["invalid"] >= 2, \
+        jit_cache.persistent_info()
+
+    # pass 3: raise + bounded-delay rules on the load path
+    fresh()
+    with I.scoped_rules():
+        I.inject("jitcache.load", count=2, probability=0.5, seed=23,
+                 all_threads=True)
+        I.inject("jitcache.load", kind="delay", delay_s=0.2, count=2,
+                 probability=0.5, seed=29, all_threads=True)
+        got = df.to_pandas().sort_values("k", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+    s.stop()
+    print(f"jit-cache spray OK (invalid={jit_cache.persistent_info()['invalid']}, "
+          f"entries={len(glob.glob(os.path.join(d, '*.jit')))})")
+finally:
+    jit_cache.configure_persistent(None)
+    shutil.rmtree(d, ignore_errors=True)
+PY
+
 echo "== concurrent spray (N clients, faults keyed per query, isolation gate) =="
 # 8 client threads share one session through the admission layer; half
 # carry injected faults scoped to THEIR query via keyed injection

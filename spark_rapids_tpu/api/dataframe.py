@@ -145,6 +145,25 @@ def _attach_file_meta(plan: L.LogicalPlan, needs: set):
     return None
 
 
+def _persistent_delta(before: dict, after: dict) -> dict:
+    """Per-query persistent jit-cache deltas for the QueryEnd fusion
+    dict (ops/jit_cache.persistent_info snapshots).  Process-global
+    counters, same attribution contract as the pipeline dict's
+    jitCacheHits/Misses: under concurrent queries the deltas smear
+    across overlapping envelopes — fine for the health checks (which
+    key on zero-vs-nonzero), wrong tool for per-tenant billing."""
+    return {
+        "persistentEnabled": bool(after.get("enabled")),
+        "persistentHits": after.get("hits", 0) - before.get("hits", 0),
+        "persistentMisses":
+            after.get("misses", 0) - before.get("misses", 0),
+        "persistentInvalid":
+            after.get("invalid", 0) - before.get("invalid", 0),
+        "persistentStores":
+            after.get("stores", 0) - before.get("stores", 0),
+    }
+
+
 def _is_window(e: Expression) -> bool:
     from spark_rapids_tpu.exec.window import WindowExpression
     inner = e.children[0] if isinstance(e, Alias) else e
@@ -674,6 +693,7 @@ class DataFrame:
             # rung (batch_scale < 1) skips this branch: the distributed
             # plan has no batch knob, so re-offering it would re-run
             # the identical plan that just failed
+            from spark_rapids_tpu.ops.jit_cache import persistent_info
             from spark_rapids_tpu.parallel.dist_planner import (
                 try_distributed)
             from spark_rapids_tpu.parallel.shuffle import (
@@ -682,6 +702,7 @@ class DataFrame:
             t0 = _time.perf_counter()
             wire = metrics_for_session(self.session)
             wire0 = wire.snapshot()
+            pjit0 = persistent_info()
             # the envelope opens BEFORE execution so everything the
             # attempt emits mid-flight — CheckpointWrite/Resume,
             # RecoveryAction, WatchdogTrip — carries this attempt's
@@ -700,12 +721,18 @@ class DataFrame:
 
             def _end(status, shuffle):
                 if qid is not None:
+                    fusion = dict(getattr(self.session,
+                                          "last_fusion_stats", None)
+                                  or {})
+                    fusion.update(_persistent_delta(pjit0,
+                                                    persistent_info()))
                     events.emit(
                         "QueryEnd", queryId=qid, status=status,
                         durationMs=round(
                             (_time.perf_counter() - t0) * 1e3, 3),
                         metrics={}, spill={}, retry={},
                         distributed=True, shuffle=shuffle,
+                        fusion=fusion,
                         admission=self._admission_info(),
                         explain=self.session.last_dist_explain)
 
@@ -790,8 +817,22 @@ class DataFrame:
         self._last_exec = exec_plan
         events = getattr(self.session, "events", None)
         if events is None or not events.enabled:
+            from spark_rapids_tpu.exec.fusion import \
+                collect_runtime_savings
+            from spark_rapids_tpu.ops.jit_cache import persistent_info
             self.session._current_qid = None
-            return self._drive(exec_plan)
+            p0 = persistent_info()
+            try:
+                return self._drive(exec_plan)
+            finally:
+                # session attribute contract matches the distributed
+                # path: last_fusion_stats is set whether or not an
+                # event log is attached (bench/tests read it)
+                ov = overrides or self.session.overrides
+                fusion = dict(getattr(ov, "last_fusion", None) or {})
+                fusion.update(collect_runtime_savings(exec_plan))
+                fusion.update(_persistent_delta(p0, persistent_info()))
+                self.session.last_fusion_stats = fusion
         qid = next(self.session._query_ids)
         # the recovery driver stamps RecoveryAction events with the qid
         # of the attempt that failed
@@ -808,8 +849,10 @@ class DataFrame:
         # thread-local view: concurrent queries on other threads must not
         # contaminate this query's attribution
         retry0 = retry_metrics.snapshot_local()
-        from spark_rapids_tpu.ops.jit_cache import cache_info
+        from spark_rapids_tpu.ops.jit_cache import (cache_info,
+                                                    persistent_info)
         jit0 = cache_info()
+        pjit0 = persistent_info()
         t0 = _time.perf_counter()
         status = "success"
         try:
@@ -830,12 +873,23 @@ class DataFrame:
             pipeline["jitCacheHits"] = jit1["hits"] - jit0["hits"]
             pipeline["jitCacheMisses"] = \
                 jit1["misses"] - jit0["misses"]
+            # per-query whole-stage fusion attribution: planned chains
+            # from the planner, runtime dispatch savings from the
+            # executed tree, persistent-tier deltas from the jit cache
+            from spark_rapids_tpu.exec.fusion import \
+                collect_runtime_savings
+            ov = overrides or self.session.overrides
+            fusion = dict(getattr(ov, "last_fusion", None) or {})
+            fusion.update(collect_runtime_savings(exec_plan))
+            fusion.update(_persistent_delta(pjit0, persistent_info()))
+            self.session.last_fusion_stats = fusion
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
                 durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
                 metrics=exec_plan.collect_metrics(), spill=spill,
                 retry={k: retry1[k] - retry0[k] for k in retry1},
-                pipeline=pipeline, admission=self._admission_info())
+                pipeline=pipeline, fusion=fusion,
+                admission=self._admission_info())
 
     def to_arrow(self):
         import pyarrow as pa

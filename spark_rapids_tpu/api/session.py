@@ -72,7 +72,19 @@ class TpuSession:
         # padding ratio, slot-overflow retries of the last distributed
         # query; None when the query never exchanged
         self.last_shuffle_stats = None
+        # per-query whole-stage fusion summary (exec/fusion.py):
+        # fusedStages/fusedOperators/dispatchesSaved + persistent
+        # jit-cache hit/miss deltas; None before the first query
+        self.last_fusion_stats = None
         self.last_planning_error = None  # set by suppressPlanningFailure
+        # persistent jit-cache tier (ops/jit_cache.py): process-global,
+        # (re)configured from this session's conf — AOT-serialized
+        # executables survive the process under jitCache.dir
+        from spark_rapids_tpu.config import rapids_conf as _rc
+        from spark_rapids_tpu.ops import jit_cache as _jc
+        _jc.configure_persistent(
+            self.conf.get(_rc.JIT_CACHE_DIR) or None,
+            self.conf.get(_rc.JIT_CACHE_MAX_BYTES))
         self.mesh = mesh
         if self.mesh is None:
             from spark_rapids_tpu.config import rapids_conf as rc

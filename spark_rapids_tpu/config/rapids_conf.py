@@ -611,6 +611,47 @@ PIPELINE_DONATION = conf(
     "escalate straight to query-level recovery, which re-runs from "
     "source (docs/performance.md#donation).", _to_bool)
 
+FUSION_ENABLED = conf(
+    "spark.rapids.tpu.fusion.enabled", True,
+    "Whole-stage fusion (exec/fusion.py): the planner collapses maximal "
+    "Filter/Project chains — and the chain feeding a (pre-shuffle) "
+    "aggregate — into ONE compiled XLA computation per pipeline stage, so "
+    "intermediates stay in registers/VMEM and each batch costs one jit "
+    "dispatch instead of one per operator (selection travels as a mask "
+    "inside the trace, compacted once at the stage boundary). Fusion "
+    "never crosses an exchange, a cached plan node, or an operator the "
+    "fuser cannot ingest (black-box UDFs, CPU-fallback expressions) — "
+    "those chains auto-fall-back to unfused execution. False restores "
+    "one-dispatch-per-operator execution (the A/B baseline; results are "
+    "bit-identical either way).", _to_bool)
+
+FUSION_MAX_OPS = conf(
+    "spark.rapids.tpu.fusion.maxChainOps", 16,
+    "Ceiling on the operators one fused stage may collapse. Bounds the "
+    "size of the traced computation (compile time grows with the fused "
+    "expression forest); chains longer than this split into multiple "
+    "fused stages.", _to_int, _positive)
+
+JIT_CACHE_DIR = conf(
+    "spark.rapids.tpu.jitCache.dir", "",
+    "Directory for the PERSISTENT jit-cache tier (ops/jit_cache.py): "
+    "compiled stages are AOT-serialized via jax.export, keyed by "
+    "sha256(structural signature, input shapes, backend, jax/jaxlib "
+    "versions), and loaded before tracing on a miss — a second process "
+    "running the same query compiles nothing. Entries are CRC-verified "
+    "and environment-checked on load; truncation, bit rot, or a store "
+    "written by a different jax/jaxlib falls back to a fresh compile "
+    "(JitCacheInvalid event), never a failed or wrong query. Cold runs "
+    "pay one extra Python trace per stage to produce the export — the "
+    "price of the zero-trace warm start. Empty disables the tier (the "
+    "in-memory cache still applies).", str)
+
+JIT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.jitCache.maxBytes", 1 << 30,
+    "Ceiling on the persistent jit-cache directory's total size; "
+    "oldest entries evict first (their signatures simply recompile "
+    "next cold run).", _to_int, _positive)
+
 PIPELINE_DEFER_SYNCS = conf(
     "spark.rapids.tpu.pipeline.deferSyncs", True,
     "Carry per-batch row/group counts as device-resident scalars "

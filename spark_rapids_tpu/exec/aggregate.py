@@ -166,7 +166,14 @@ class TpuHashAggregateExec(TpuExec):
         self._spec_misses = 0
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
-        self.pre_filter = pre_filter
+        # fused upstream predicates, BOTTOM-FIRST chain order: each
+        # conjunct's ANSI checks are masked by the conjuncts below it
+        # (_pre_filter_mask — the FilterStageFn discipline)
+        self.pre_filters = list(pre_filter) if isinstance(
+            pre_filter, (list, tuple)) else (
+            [pre_filter] if pre_filter is not None else [])
+        self._pre_sig = tuple(c.cache_key() for c in self.pre_filters) \
+            if self.pre_filters else None
         self.funcs = [ae.func for _, ae in agg_exprs]
         self._register_metric(NUM_INPUT_ROWS)
         self._register_metric(NUM_INPUT_BATCHES)
@@ -190,8 +197,7 @@ class TpuHashAggregateExec(TpuExec):
                    tuple(dt.name for dt in self._in_dtypes),
                    tuple(e.cache_key() for e in self.group_exprs),
                    tuple(f.cache_key() for f in self.funcs),
-                   self.pre_filter.cache_key()
-                   if self.pre_filter is not None else None)
+                   self._pre_sig)
             self._single_fn = cached_jit(sig, lambda: self._single_kernel)
             return
         # buffer layout: per func, a slice of the flat buffer-column list
@@ -235,8 +241,7 @@ class TpuHashAggregateExec(TpuExec):
         else:
             self._pre_fn = None
             update_sig = ("agg_update",) + base_sig + (
-                self.pre_filter.cache_key()
-                if self.pre_filter is not None else None,)
+                self._pre_sig,)
             self._update_fn = cached_jit(update_sig,
                                          lambda: self._update_fused)
             if self._coded_eligible:
@@ -246,8 +251,7 @@ class TpuHashAggregateExec(TpuExec):
                 # the probed key-space size (falls back to _update_fn's
                 # sort kernel when the space is too large)
                 stage_a_sig = ("agg_stage_a",) + base_sig + (
-                    self.pre_filter.cache_key()
-                    if self.pre_filter is not None else None,)
+                    self._pre_sig,)
                 self._stage_a_fn = cached_jit(stage_a_sig,
                                               lambda: self._stage_a)
         # merge never evaluates pre_filter: exclude it so queries differing
@@ -297,6 +301,17 @@ class TpuHashAggregateExec(TpuExec):
                 pairs.append((spec.kind, cv))
         return pairs
 
+    def _pre_filter_mask(self, ctx: EmitContext):
+        """Row mask from the fused pre-filter conjuncts (bottom-first,
+        progressive ANSI-check masking: each conjunct — and finally the
+        keys/agg children — only checks rows the conjuncts below it
+        kept, exactly the rows the unfused stages would have
+        evaluated).  None when there is no fused filter."""
+        from spark_rapids_tpu.ops.expressions import fold_conjuncts
+        if not self.pre_filters:
+            return None
+        return fold_conjuncts(ctx, self.pre_filters)
+
     def _update_fused(self, flat_cols, nrows):
         """No string keys: key eval + buffer eval + group-by, one computation.
 
@@ -305,15 +320,7 @@ class TpuHashAggregateExec(TpuExec):
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
-        row_mask = None
-        if self.pre_filter is not None:
-            pred = self.pre_filter.emit(ctx)
-            keep = pred.values
-            if getattr(keep, "ndim", 0) == 0:
-                keep = jnp.broadcast_to(keep, (capacity,))
-            if pred.validity is not None:
-                keep = jnp.logical_and(keep, pred.validity)
-            row_mask = jnp.logical_and(keep, ctx.row_mask())
+        row_mask = self._pre_filter_mask(ctx)
         keys = [e.emit(ctx) for e in self.group_exprs]
         buf_inputs = self._eval_update_inputs(ctx)
         if not keys:
@@ -332,15 +339,9 @@ class TpuHashAggregateExec(TpuExec):
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
-        mask = ctx.row_mask()
-        if self.pre_filter is not None:
-            pred = self.pre_filter.emit(ctx)
-            keep = pred.values
-            if getattr(keep, "ndim", 0) == 0:
-                keep = jnp.broadcast_to(keep, (capacity,))
-            if pred.validity is not None:
-                keep = jnp.logical_and(keep, pred.validity)
-            mask = jnp.logical_and(keep, mask)
+        mask = self._pre_filter_mask(ctx)
+        if mask is None:
+            mask = ctx.row_mask()
         keys = [agg.widen_colval(e.emit(ctx), capacity)
                 for e in self.group_exprs]
         mins, maxs = agg.key_range_probe(keys, mask)
@@ -355,6 +356,8 @@ class TpuHashAggregateExec(TpuExec):
             capacity = capacity_of(flat_cols)
             inputs = flat_to_colvals(flat_cols, self._in_dtypes)
             ctx = EmitContext(inputs, nrows, capacity)
+            if self.pre_filters:
+                ctx.extra_check_mask = mask
             keys = [agg.widen_colval(e.emit(ctx), capacity)
                     for e in self.group_exprs]
             buf_inputs = self._eval_update_inputs(ctx)
@@ -376,15 +379,9 @@ class TpuHashAggregateExec(TpuExec):
             capacity = capacity_of(flat_cols)
             inputs = flat_to_colvals(flat_cols, self._in_dtypes)
             ctx = EmitContext(inputs, nrows, capacity)
-            mask = ctx.row_mask()
-            if self.pre_filter is not None:
-                pred = self.pre_filter.emit(ctx)
-                keep = pred.values
-                if getattr(keep, "ndim", 0) == 0:
-                    keep = jnp.broadcast_to(keep, (capacity,))
-                if pred.validity is not None:
-                    keep = jnp.logical_and(keep, pred.validity)
-                mask = jnp.logical_and(keep, mask)
+            mask = self._pre_filter_mask(ctx)
+            if mask is None:
+                mask = ctx.row_mask()
             keys = [agg.widen_colval(e.emit(ctx), capacity)
                     for e in self.group_exprs]
             buf_inputs = self._eval_update_inputs(ctx)
@@ -441,8 +438,7 @@ class TpuHashAggregateExec(TpuExec):
         if spec_k and self._spec_misses < 2:
             fn = cached_jit(
                 ("agg_coded_auto", spec_k) + self._base_sig + (
-                    self.pre_filter.cache_key()
-                    if self.pre_filter is not None else None,),
+                    self._pre_sig,),
                 lambda: self._coded_update_auto(spec_k))
             key_out, buf_out, n, fits, mins, maxs, mask = fn(flat, nrows)
             fits_h, mins_h, maxs_h = hostsync.fetch(fits, mins, maxs)
@@ -780,13 +776,7 @@ class TpuHashAggregateExec(TpuExec):
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
-        row_mask = None
-        if self.pre_filter is not None:
-            pred = self.pre_filter.emit(ctx)
-            keep = pred.values
-            if pred.validity is not None:
-                keep = jnp.logical_and(keep, pred.validity)
-            row_mask = jnp.logical_and(keep, ctx.row_mask())
+        row_mask = self._pre_filter_mask(ctx)
         keys = [e.emit(ctx) for e in self.group_exprs]
         keyless = not keys
         if keyless:

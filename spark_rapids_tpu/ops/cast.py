@@ -157,7 +157,10 @@ class Cast(Expression):
         return out
 
     def _ansi_checks(self, c: ColVal, out: ColVal, ctx: EmitContext):
-        live = ctx.row_mask()
+        # check_mask, not row_mask: inside a fused stage, rows a fused
+        # upstream filter drops must not raise (the unfused plan would
+        # have compacted them away before this cast ever ran)
+        live = ctx.check_mask()
         src, dst = c.dtype, self.target
         bad = None
         if src.is_string and out.validity is not None:

@@ -84,8 +84,11 @@ def colvals_to_columns(outs: Sequence[ColVal], nrows: int,
 
 # ANSI check messages per stage signature: the jit cache shares traced
 # functions across StageFn instances with the same signature, so messages
-# recorded at trace time must be shared the same way
-_CHECK_MSGS = {}
+# recorded at trace time must be shared the same way.  The canonical dict
+# lives in ops/jit_cache.py (STAGE_CHECKS) so the persistent tier can
+# serialize messages into entry headers — a warm start that never traces
+# still raises the exact ANSI message.
+from spark_rapids_tpu.ops.jit_cache import STAGE_CHECKS as _CHECK_MSGS
 
 
 def raise_failed_checks(messages, flags) -> None:
@@ -139,21 +142,34 @@ class StageFn:
 
 
 class FilterStageFn:
-    """Fused predicate + compaction: batch -> (columns, new_nrows).
+    """Fused predicate(s) + compaction: batch -> (columns, new_nrows).
 
     The predicate and the gather-to-dense run in one XLA computation; only the
     selected-row count syncs back to the host (to set the logical length).
+
+    ``predicate`` may be a LIST of conjuncts in bottom-first chain order
+    (whole-stage fusion, exec/fusion.py): each conjunct evaluates with
+    the mask of the conjuncts BELOW it as its ANSI check mask, so a
+    fused chain's checks fire for exactly the rows the corresponding
+    unfused filter stage would have evaluated.  Rows dropped by LATER
+    members may skip their checks — the same latitude Spark's optimizer
+    takes when collapsing projects and reordering filters; a bad value
+    can never reach the output (the final keep mask gates everything).
     """
 
-    def __init__(self, predicate: Expression, project: Sequence[Expression],
+    def __init__(self, predicate, project: Sequence[Expression],
                  input_dtypes: Sequence[DataType],
                  donate: bool = False):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
-        self.predicate = predicate
+        conjuncts = list(predicate) if isinstance(
+            predicate, (list, tuple)) else [predicate]
+        self.conjuncts = conjuncts  # bottom-first evaluation order
+        self.predicate = conjuncts[0]
         self.project = list(project)
         self.input_dtypes = list(input_dtypes)
         self.donate = effective_donate(donate)
-        self._sig = ("filter_stage", self.predicate.cache_key(),
+        self._sig = ("filter_stage",
+                     tuple(p.cache_key() for p in conjuncts),
                      tuple(e.cache_key() for e in self.project),
                      tuple(dt.name for dt in self.input_dtypes),
                      ("donate", self.donate))
@@ -162,22 +178,27 @@ class FilterStageFn:
 
     def _run(self, flat_cols, nrows):
         from spark_rapids_tpu.ops import selection
+        from spark_rapids_tpu.ops.expressions import fold_conjuncts
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self.input_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
-        pred = self.predicate.emit(ctx)
-        keep = pred.values
-        if getattr(keep, "ndim", 0) == 0:
-            keep = jnp.broadcast_to(keep, (capacity,))
-        if pred.validity is not None:
-            keep = jnp.logical_and(keep, pred.validity)
-        keep = jnp.logical_and(keep, ctx.row_mask())
+        # projections then evaluate over PRE-filter rows (compaction is
+        # one pass at the end): fold_conjuncts leaves the check mask at
+        # the survivor set, so ANSI checks only fire for survivors
+        keep = fold_conjuncts(ctx, self.conjuncts)
         outs = [e.emit(ctx) for e in self.project]
+        # scalar projection outputs (literals, scalar-validity
+        # expressions) widen to the capacity before compaction — the
+        # gather indexes every buffer, including validity (fused chains
+        # project arbitrary expressions here, not just passthroughs)
         outs = [ColVal(o.dtype,
                        jnp.broadcast_to(o.values, (capacity,))
                        if getattr(o.values, "ndim", 0) == 0 and
                        o.offsets is None else o.values,
-                       o.validity, o.offsets)
+                       jnp.broadcast_to(o.validity, (capacity,))
+                       if o.validity is not None and
+                       getattr(o.validity, "ndim", 1) == 0
+                       else o.validity, o.offsets)
                 for o in outs]
         compacted, new_nrows = selection.compact(outs, keep)
         _CHECK_MSGS[self._sig] = [m for m, _ in ctx.checks]

@@ -78,6 +78,14 @@ class EmitContext:
         # expressions; stage runners surface them and raise host-side
         # (Spark ANSI throws, GpuCast ansi mode)
         self.checks = []
+        # fused stages evaluate downstream expressions over PRE-filter
+        # rows (the predicate travels as a mask, compaction happens
+        # once at the stage boundary): the stage sets this to its keep
+        # mask so ANSI checks only fire for rows that SURVIVE — exactly
+        # the rows the unfused plan would have evaluated.  Checks only;
+        # value semantics are untouched (dropped rows never reach the
+        # output either way).
+        self.extra_check_mask = None
 
     def add_check(self, message: str, failed) -> None:
         self.checks.append((message, failed))
@@ -85,6 +93,38 @@ class EmitContext:
     def row_mask(self):
         """bool[capacity], True for rows < nrows (padding mask)."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows
+
+    def check_mask(self):
+        """Rows whose failures ANSI checks may report: live rows, minus
+        rows a fused upstream filter already dropped."""
+        m = self.row_mask()
+        if self.extra_check_mask is not None:
+            m = jnp.logical_and(m, self.extra_check_mask)
+        return m
+
+
+def fold_conjuncts(ctx: "EmitContext", conds) -> "jnp.ndarray":
+    """AND a BOTTOM-FIRST conjunct list into one keep mask with
+    progressive ANSI-check masking: each conjunct (and, afterwards,
+    anything else emitted under ``ctx``) only checks rows the conjuncts
+    below it kept — exactly the rows the corresponding unfused filter
+    stages would have evaluated.  The one shared implementation for
+    every fused-stage body (FilterStageFn, the aggregate pre-filter,
+    the distributed fused kernels): the masking discipline must not be
+    able to diverge between them.  Leaves ``ctx.extra_check_mask`` set
+    to the returned mask."""
+    mask = ctx.row_mask()
+    for p in conds:
+        ctx.extra_check_mask = mask
+        pred = p.emit(ctx)
+        keep = pred.values
+        if getattr(keep, "ndim", 0) == 0:
+            keep = jnp.broadcast_to(keep, (ctx.capacity,))
+        if pred.validity is not None:
+            keep = jnp.logical_and(keep, pred.validity)
+        mask = jnp.logical_and(mask, keep)
+    ctx.extra_check_mask = mask
+    return mask
 
 
 class Expression:

@@ -658,6 +658,55 @@ def _run_slice(f: ShardedFrame, los, his):
         jnp.asarray(np.asarray(his, dtype=np.int32)))
 
 
+def _run_fused(f: ShardedFrame, exprs: Sequence[Expression],
+               conds: Sequence[Expression]):
+    """Compiled shard_map for a FUSED Filter/Project chain: every
+    member's expressions evaluate in ONE computation — the member
+    predicates (bottom-first) AND into a row mask carried inside the
+    trace (each conjunct's ANSI checks masked by the conjuncts below
+    it, the FilterStageFn discipline), projections stay in registers,
+    and the selection compacts once at the stage boundary.  One
+    dispatch per chain instead of one per member (exec/fusion.py; the
+    distributed face of whole-stage fusion)."""
+    import jax
+    from spark_rapids_tpu.ops import selection
+    from spark_rapids_tpu.ops.aggregates import widen_colval
+    from spark_rapids_tpu.ops.jit_cache import cached_jit
+    phys = f.phys_dtypes
+
+    def step(flat_cols, nrows_arr):
+        nrows = nrows_arr[0]
+        cols = [ColVal(dt, v, val)
+                for (v, val), dt in zip(flat_cols, phys)]
+        cap = cols[0].values.shape[0]
+        ctx = EmitContext(cols, nrows, cap)
+        keep = None
+        if conds:
+            from spark_rapids_tpu.ops.expressions import fold_conjuncts
+            # leaves the ANSI check mask at the survivor set for the
+            # projections below (expressions.fold_conjuncts)
+            keep = fold_conjuncts(ctx, conds)
+        outs = [widen_colval(e.emit(ctx), cap) for e in exprs]
+        if keep is None:
+            return (tuple((c.values, _ones_like_validity(c, cap))
+                          for c in outs),
+                    nrows.astype(jnp.int32)[None])
+        compacted, n = selection.compact(outs, keep)
+        return (tuple((c.values, _ones_like_validity(c, cap))
+                      for c in compacted),
+                n.astype(jnp.int32)[None])
+
+    sig = ("dplan_fused", _mesh_sig(f.mesh),
+           tuple(dt.name for dt in phys),
+           tuple(e.cache_key() for e in exprs),
+           tuple(c.cache_key() for c in conds))
+    axis = f.mesh.axis_names[0]
+    cols, nrows = cached_jit(sig, lambda: _shard_map(
+        step, mesh=f.mesh, in_specs=(P(axis), P(axis)),
+        out_specs=P(axis), check_vma=False))(f.cols, f.nrows)
+    return cols, nrows.reshape(-1)
+
+
 def _run_filter(f: ShardedFrame, cond: Expression):
     import jax
     from spark_rapids_tpu.ops import selection
@@ -742,6 +791,22 @@ class DistPlanner:
         self._fp_inputs = getattr(self._ckpt, "always_resume", False)
         self._fp_memo: Dict[int, str] = {}
         self._packed = packed_enabled()
+        # whole-stage fusion (exec/fusion.py, the distributed face):
+        # Filter/Project chains — and the chain feeding an Aggregate —
+        # collapse into one shard_map dispatch.  Never across an
+        # exchange: fusion happens strictly BELOW the stage boundaries
+        # the checkpoint lineage keys on, so stage_ids are untouched.
+        from spark_rapids_tpu.config import rapids_conf as _rc
+        self._fusion = bool(self.conf.get(_rc.FUSION_ENABLED))
+        self._fusion_max = int(self.conf.get(_rc.FUSION_MAX_OPS))
+        self.fusion: Dict[str, int] = {
+            "enabled": self._fusion, "fusedStages": 0,
+            "fusedOperators": 0, "dispatchesSaved": 0,
+            "fusibleChains": 0, "fallbacks": 0}
+        # chain members already counted as fusible (when fusion is off
+        # the members still convert one-by-one — the inner run must not
+        # re-count as its own, shorter chain)
+        self._counted_chain: set = set()
 
     @classmethod
     def _stage_ops(cls):
@@ -797,6 +862,10 @@ class DistPlanner:
     def _dispatch(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
         if isinstance(plan, (L.InMemoryRelation, L.FileRelation, L.Range)):
             return self._scan(plan, dry)
+        if isinstance(plan, (L.Filter, L.Project)):
+            fused = self._fused_chain(plan, dry)
+            if fused is not None:
+                return fused
         if isinstance(plan, L.Filter):
             return self._filter(plan, dry)
         if isinstance(plan, L.Project):
@@ -1006,9 +1075,12 @@ class DistPlanner:
         return ShardedFrame(self.mesh, names, log_dtypes, cols,
                             jnp.asarray(counts), enc)
 
-    # -- filter / project -------------------------------------------------
+    # -- filter / project / fused chains ---------------------------------
     def _filter(self, plan: L.Filter, dry: bool) -> ShardedFrame:
-        f = self.run(plan.child, dry)
+        return self._filter_frame(self.run(plan.child, dry), plan, dry)
+
+    def _filter_frame(self, f: ShardedFrame, plan: L.Filter,
+                      dry: bool) -> ShardedFrame:
         low = ExprLowering(f.enc, self.conf)
         cond = low.lower(plan.condition)
         _check_supported([cond], self.conf)
@@ -1018,7 +1090,10 @@ class DistPlanner:
         return f.replace(cols=list(out_cols), nrows=nrows)
 
     def _project(self, plan: L.Project, dry: bool) -> ShardedFrame:
-        f = self.run(plan.child, dry)
+        return self._project_frame(self.run(plan.child, dry), plan, dry)
+
+    def _project_frame(self, f: ShardedFrame, plan: L.Project,
+                       dry: bool) -> ShardedFrame:
         low = ExprLowering(f.enc, self.conf)
         exprs, enc = [], {}
         for i, e in enumerate(plan.exprs):
@@ -1037,12 +1112,135 @@ class DistPlanner:
         return ShardedFrame(self.mesh, names, log_dtypes, list(out_cols),
                             f.nrows, enc)
 
+    def _chain_members(self, plan: L.LogicalPlan):
+        """Maximal Filter/Project run starting at ``plan`` (top-down)
+        and the tail node feeding it."""
+        members: List[L.LogicalPlan] = []
+        node = plan
+        while isinstance(node, (L.Filter, L.Project)) and \
+                len(members) < self._fusion_max:
+            members.append(node)
+            node = node.child
+        return members, node
+
+    def _replay_members(self, f: ShardedFrame, members,
+                        dry: bool) -> ShardedFrame:
+        """Unfused fallback: apply the chain member-by-member over the
+        already-computed tail frame (the tail never re-runs)."""
+        for node in reversed(members):
+            if isinstance(node, L.Filter):
+                f = self._filter_frame(f, node, dry)
+            else:
+                f = self._project_frame(f, node, dry)
+        return f
+
+    def _fused_chain(self, plan: L.LogicalPlan,
+                     dry: bool) -> Optional[ShardedFrame]:
+        """Collapse a Filter/Project chain into one shard_map dispatch;
+        None when there is no chain (single member) — a member the
+        composed lowering cannot ingest falls back to per-member
+        execution over the same tail frame."""
+        from spark_rapids_tpu.exec.fusion import compose_chain
+        members, tail = self._chain_members(plan)
+        if len(members) < 2:
+            return None
+        if not dry and id(plan) not in self._counted_chain:
+            self.fusion["fusibleChains"] += 1
+            self._counted_chain.update(id(m) for m in members)
+        if not self._fusion:
+            return None
+        f = self.run(tail, dry)
+        exprs, conds = None, []
+        for node in members:
+            exprs, conds = compose_chain(exprs, conds, node, node.schema)
+        try:
+            frame = self._fused_frame(f, exprs, conds, plan, dry)
+        except NotDistributable:
+            if not dry:
+                self.fusion["fallbacks"] += 1
+            return self._replay_members(f, members, dry)
+        if not dry:
+            self.fusion["fusedStages"] += 1
+            self.fusion["fusedOperators"] += len(members)
+            self.fusion["dispatchesSaved"] += len(members) - 1
+        return frame
+
+    def _fused_frame(self, f: ShardedFrame, exprs, conds, plan,
+                     dry: bool) -> ShardedFrame:
+        low = ExprLowering(f.enc, self.conf)
+        lexprs, enc = [], {}
+        for i, e in enumerate(exprs):
+            le = low.lower(e)
+            lexprs.append(le)
+            d = low.out_dict(le)
+            if d is not None:
+                enc[i] = d
+        lconds = [low.lower(c) for c in conds]
+        _check_supported(lexprs + lconds, self.conf)
+        names = [n for n, _ in plan.schema]
+        log_dtypes = [dt for _, dt in plan.schema]
+        if dry:
+            return ShardedFrame(self.mesh, names, log_dtypes, None, None,
+                                enc)
+        out_cols, nrows = _run_fused(f, lexprs, lconds)
+        return ShardedFrame(self.mesh, names, log_dtypes, list(out_cols),
+                            nrows, enc)
+
     # -- aggregate --------------------------------------------------------
     def _aggregate(self, plan: L.Aggregate, dry: bool) -> ShardedFrame:
+        """Aggregate, with the PRE-SHUFFLE fusion fold: a Filter/Project
+        chain under the Aggregate composes into the aggregation kernel
+        itself (projections substitute into key/agg expressions, the
+        combined predicate rides as DistributedAggregate's filter_cond
+        row mask) — filter, project, partial aggregate AND the
+        partition-id computation all launch as ONE program per shard.
+        A chain the composed lowering cannot ingest replays unfused
+        over the same tail frame."""
+        members, tail = self._chain_members(plan.child)
+        if members and not self._fusion:
+            # A/B baseline: the chain (even a single member — the
+            # aggregate fold would absorb it) ran unfused; count it for
+            # the health check and keep the members from re-counting as
+            # their own chain during the per-op dispatch below
+            if not dry and id(plan.child) not in self._counted_chain:
+                self.fusion["fusibleChains"] += 1
+                self._counted_chain.update(id(m) for m in members)
+            members = []
+        if not members:
+            return self._aggregate_frame(
+                plan, self.run(plan.child, dry), plan.group_exprs,
+                plan.agg_exprs, None, dry)
+        from spark_rapids_tpu.exec.fusion import compose_chain
+        from spark_rapids_tpu.ops.expressions import substitute_bound
+        if not dry:
+            self.fusion["fusibleChains"] += 1
+        exprs, conds = None, []
+        for node in members:
+            exprs, conds = compose_chain(exprs, conds, node, node.schema)
+        group2 = [substitute_bound(e, exprs) for e in plan.group_exprs]
+        aggs2 = [substitute_bound(e, exprs) for e in plan.agg_exprs]
+        f = self.run(tail, dry)
+        try:
+            frame = self._aggregate_frame(plan, f, group2, aggs2,
+                                          conds or None, dry)
+        except NotDistributable:
+            if not dry:
+                self.fusion["fallbacks"] += 1
+            f = self._replay_members(f, members, dry)
+            return self._aggregate_frame(plan, f, plan.group_exprs,
+                                         plan.agg_exprs, None, dry)
+        if not dry:
+            self.fusion["fusedStages"] += 1
+            self.fusion["fusedOperators"] += len(members) + 1
+            self.fusion["dispatchesSaved"] += len(members)
+        return frame
+
+    def _aggregate_frame(self, plan: L.Aggregate, f: ShardedFrame,
+                         group_in, agg_in, pre_cond,
+                         dry: bool) -> ShardedFrame:
         from spark_rapids_tpu.ops import aggregates as agg
-        f = self.run(plan.child, dry)
         low = ExprLowering(f.enc, self.conf)
-        group_exprs = [low.lower(e) for e in plan.group_exprs]
+        group_exprs = [low.lower(e) for e in group_in]
         nkeys = len(group_exprs)
 
         # split agg outputs into bare aggregate calls + result exprs
@@ -1083,7 +1281,7 @@ class DistPlanner:
 
         out_named = []
         trivial = True
-        for e in plan.agg_exprs:
+        for e in agg_in:
             inner = e.children[0] if isinstance(e, Alias) else e
             rewritten = extract(inner)
             if not isinstance(inner, AggregateExpression):
@@ -1091,6 +1289,12 @@ class DistPlanner:
             out_named.append((e.name, rewritten))
         _check_supported(group_exprs, self.conf)
         _check_supported(agg_list, self.conf)
+        # fused pre-shuffle chain: the upstream predicates (bottom-first
+        # conjuncts) ride into the update kernel as a row mask with
+        # progressive ANSI-check masking (exec/fusion.py)
+        lcond = [low.lower(c) for c in pre_cond] if pre_cond else None
+        if lcond:
+            _check_supported(lcond, self.conf)
 
         # enc propagation: encoded group keys (bare or re-encoded) and
         # min/max/first/last over encoded children keep their
@@ -1120,7 +1324,8 @@ class DistPlanner:
             dist = DistributedAggregate(
                 self.mesh, in_dtypes=f.phys_dtypes,
                 group_exprs=group_exprs,
-                funcs=[a.func for a in agg_list])
+                funcs=[a.func for a in agg_list],
+                filter_cond=lcond)
             outs = dist([(v, val, None) for v, val in f.cols], f.nrows)
             self._emit_stats("aggregate", dist.last_stats)
             if not group_exprs:
@@ -1713,6 +1918,7 @@ def try_distributed(session, plan: L.LogicalPlan, resume: bool = False):
         return None
     planner = DistPlanner(session, mesh, resume=resume)
     session.last_scan_stats = None  # per-query: no stale sharded stats
+    session.last_fusion_stats = None  # per-query fusion attribution
     try:
         planner.run(plan, dry=True)  # support pre-flight: no data moves
         # data-dependent limits (e.g. join fan-out vs output capacity)
@@ -1725,6 +1931,7 @@ def try_distributed(session, plan: L.LogicalPlan, resume: bool = False):
             ev.emit("DistFallback", reason=str(e))
         return None
     session.last_dist_explain = "distributed"
+    session.last_fusion_stats = dict(planner.fusion)
     if planner._ckpt is not None:
         # per-execution completion signal, delivered on THIS query's
         # thread (robustness/checkpoint.py note_distributed_complete)

@@ -107,7 +107,12 @@ class DistributedAggregate:
         self.in_dtypes = list(in_dtypes)
         self.group_exprs = list(group_exprs)
         self.funcs = list(funcs)
-        self.filter_cond = filter_cond
+        # fused upstream predicates, BOTTOM-FIRST chain order (whole-
+        # stage fusion, exec/fusion.py): evaluated with progressive
+        # ANSI-check masking before the in-trace compaction
+        self.filter_conds = list(filter_cond) if isinstance(
+            filter_cond, (list, tuple)) else (
+            [filter_cond] if filter_cond is not None else [])
 
         self._buf_specs = []
         self._buf_slices = []
@@ -129,8 +134,8 @@ class DistributedAggregate:
                      tuple(dt.name for dt in self.in_dtypes),
                      tuple(e.cache_key() for e in self.group_exprs),
                      tuple(f.cache_key() for f in self.funcs),
-                     self.filter_cond.cache_key()
-                     if self.filter_cond is not None else None,
+                     tuple(c.cache_key() for c in self.filter_conds)
+                     if self.filter_conds else None,
                      ("packed", self.packed))
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
@@ -157,12 +162,9 @@ class DistributedAggregate:
                   for (v, val, offs), dt in zip(flat_cols, self.in_dtypes)]
         ctx = EmitContext(inputs, nrows, capacity)
 
-        if self.filter_cond is not None:
-            pred = self.filter_cond.emit(ctx)
-            keep = pred.values
-            if pred.validity is not None:
-                keep = jnp.logical_and(keep, pred.validity)
-            keep = jnp.logical_and(keep, ctx.row_mask())
+        if self.filter_conds:
+            from spark_rapids_tpu.ops.expressions import fold_conjuncts
+            keep = fold_conjuncts(ctx, self.filter_conds)
             compacted, nrows = selection.compact(inputs, keep)
             ctx = EmitContext(compacted, nrows, capacity)
 

@@ -846,15 +846,61 @@ class TpuOverrides:
 
     def __init__(self, conf: Optional[RapidsConf] = None,
                  cache_manager=None):
+        from spark_rapids_tpu.config import rapids_conf as _rc
         self.conf = conf or RapidsConf()
         self.last_explain: str = ""
         self.last_cbo: List[str] = []
         self.cache_manager = cache_manager
+        self.fusion_enabled = self.conf.get(_rc.FUSION_ENABLED)
+        self.fusion_max_ops = self.conf.get(_rc.FUSION_MAX_OPS)
+        # per-apply fusion accounting (QueryEnd "fusion" dict): stages/
+        # operators actually fused, plus chains that COULD have fused
+        # (the health-check signal when fusion is disabled).  Keyed by
+        # effective thread ident (the PR6 _current_qid discipline): one
+        # overrides instance serves concurrent queries, and a single
+        # shared dict would stamp query A's QueryEnd with query B's
+        # planned chains.  Bounded: idents recycle, stale entries are
+        # pruned once the map outgrows any plausible thread count.
+        self._fusion_by_ident: Dict[int, Dict[str, int]] = {}
+        self._chain_nodes_by_ident: Dict[int, set] = {}
+
+    @staticmethod
+    def _ident() -> int:
+        from spark_rapids_tpu.serving import context as qc
+        return qc.effective_ident()
+
+    def _fresh_fusion(self) -> Dict[str, int]:
+        return {"enabled": self.fusion_enabled, "fusedStages": 0,
+                "fusedOperators": 0, "fusibleChains": 0}
+
+    @property
+    def last_fusion(self) -> Dict[str, int]:
+        # setdefault, not get: a concurrent apply()'s oversized-map
+        # prune may drop this ident's dict mid-plan — recreate so a
+        # counter bump degrades the metrics, never the query
+        return self._fusion_by_ident.setdefault(self._ident(),
+                                                self._fresh_fusion())
+
+    @property
+    def _counted_chain_nodes(self) -> set:
+        return self._chain_nodes_by_ident.setdefault(self._ident(),
+                                                     set())
 
     def apply(self, plan: L.LogicalPlan):
         _pushdown_pass(plan, self.cache_manager)
         meta = PlanMeta(plan, self.conf)
         meta.tag()
+        ident = self._ident()
+        for m in (self._fusion_by_ident, self._chain_nodes_by_ident):
+            if len(m) > 256:
+                # recycled-ident flood: drop stale entries but keep the
+                # concurrently-planning threads' live state (the
+                # last_fusion property self-heals regardless)
+                for k in list(m)[:128]:
+                    if k != ident:
+                        m.pop(k, None)
+        self._fusion_by_ident[ident] = self._fresh_fusion()
+        self._chain_nodes_by_ident[ident] = set()
         from spark_rapids_tpu.config import rapids_conf as rc
         self.last_cbo = []
         if self.conf.get(rc.CBO_ENABLED):
@@ -906,6 +952,10 @@ class TpuOverrides:
             sort_meta = meta.child_metas[0]
             base = self._convert(sort_meta.child_metas[0])
             return TpuTopNExec(node.n, sort_meta.wrapped.orders, base)
+        if isinstance(node, (L.Project, L.Filter)) and not meta.reasons:
+            fused = self._try_fuse_chain(meta)
+            if fused is not None:
+                return fused
         children = [self._convert(c) for c in meta.child_metas]
         own_ok = not meta.reasons
         if own_ok and type(node) in _PLAN_CONVERTERS:
@@ -958,6 +1008,62 @@ class TpuOverrides:
                 return False
         return found
 
+    def _fusible_member(self, child_meta: PlanMeta) -> bool:
+        """A chain member the fuser can ingest: Project/Filter, fully
+        TPU-supported, and not a cache boundary (materialized batches
+        must be consumed — and populated — there)."""
+        if not isinstance(child_meta.wrapped, (L.Project, L.Filter)):
+            return False
+        if child_meta.reasons or any(
+                not em.can_replace for em in child_meta.expr_metas):
+            return False
+        if self.cache_manager is not None and \
+                self.cache_manager.lookup(child_meta.wrapped) is not None:
+            return False
+        return True
+
+    def _try_fuse_chain(self, meta: PlanMeta):
+        """Whole-stage chain fusion: collapse a maximal Project/Filter
+        run into ONE FusedStageExec — projections substitute through,
+        predicates AND into a single in-trace row mask, one compaction
+        at the stage boundary, one jit dispatch per batch
+        (exec/fusion.py).  Chains the fuser cannot ingest (UDF-only
+        projections, CPU-fallback expressions, cached members) stop the
+        walk and run unfused."""
+        from spark_rapids_tpu.exec.fusion import (FusedStageExec,
+                                                  compose_chain,
+                                                  fusion_metrics)
+        if id(meta.wrapped) in self._counted_chain_nodes:
+            return None  # inner member of an already-detected chain
+        exprs = None
+        conds: List = []
+        cur = meta
+        members: List[str] = []
+        node_ids: List[int] = []
+        while self._fusible_member(cur) and \
+                len(members) < self.fusion_max_ops:
+            exprs, conds = compose_chain(exprs, conds, cur.wrapped,
+                                         cur.wrapped.schema)
+            members.append(type(cur.wrapped).__name__)
+            node_ids.append(id(cur.wrapped))
+            cur = cur.child_metas[0]
+        if len(members) < 2:
+            return None  # a lone operator is already one stage
+        self._counted_chain_nodes.update(node_ids)
+        self.last_fusion["fusibleChains"] += 1
+        fusion_metrics.bump("fusibleChains")
+        if not self.fusion_enabled:
+            return None
+        base = self._convert(cur)
+        self.last_fusion["fusedStages"] += 1
+        self.last_fusion["fusedOperators"] += len(members)
+        fusion_metrics.bump("fusedStages")
+        fusion_metrics.bump("fusedOperators", len(members))
+        from spark_rapids_tpu.config import rapids_conf as rc
+        return FusedStageExec(
+            exprs, conds, base, members,
+            donate=self.conf.get(rc.PIPELINE_DONATION))
+
     def _try_fuse_aggregate(self, meta: PlanMeta):
         """Whole-stage fusion: collapse Project/Filter chains under an
         Aggregate into the aggregation kernel (predicate becomes a row mask,
@@ -966,48 +1072,65 @@ class TpuOverrides:
         fully fused stage if we hand it one computation.
         """
         from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.exec.fusion import fusion_metrics
         from spark_rapids_tpu.ops.expressions import substitute_bound
-        from spark_rapids_tpu.ops.predicates import And
 
         node: L.Aggregate = meta.wrapped
         group = list(node.group_exprs)
         aggs = list(node.agg_exprs)
-        cond = None
+        # bottom-first conjunct list (the aggregate's _pre_filter_mask
+        # applies progressive ANSI-check masking, exec/fusion.py)
+        conds: List = []
         child_meta = meta.child_metas[0]
         hops = 0
-        while isinstance(child_meta.wrapped, (L.Project, L.Filter)):
-            if child_meta.reasons or any(
-                    not em.can_replace for em in child_meta.expr_metas):
-                break
-            # don't fuse across a cached node: its materialized batches
-            # must be consumed (and populated) at that boundary
-            if self.cache_manager is not None and \
-                    self.cache_manager.lookup(child_meta.wrapped) is not None:
-                break
+        node_ids: List[int] = []
+        while self._fusible_member(child_meta) and \
+                hops < self.fusion_max_ops:
             inner = child_meta.wrapped
             if isinstance(inner, L.Project):
                 repl = inner.exprs
                 group = [substitute_bound(e, repl) for e in group]
                 aggs = [substitute_bound(e, repl) for e in aggs]
-                if cond is not None:
-                    cond = substitute_bound(cond, repl)
+                conds = [substitute_bound(c, repl) for c in conds]
             else:
-                c = inner.condition
-                cond = c if cond is None else And(c, cond)
+                conds = [inner.condition] + conds
+            node_ids.append(id(inner))
             child_meta = child_meta.child_metas[0]
             hops += 1
-        if hops == 0 or cond is None:
-            # fusing projections alone adds nothing (already one stage)
-            if hops == 0:
-                return None
+        if hops == 0:
+            return None  # nothing upstream to fuse
         if any(e.dtype.is_string for e in group):
             return None  # string keys take the host dict-encode path
+        from spark_rapids_tpu.exec.fusion import has_check_exprs
+        if has_check_exprs(group + aggs + conds):
+            # the aggregation kernels have no ANSI check-flag channel:
+            # the chain fuses as a FusedStageExec below instead
+            return None
+        self.last_fusion["fusibleChains"] += 1
+        fusion_metrics.bump("fusibleChains")
+        if not self.fusion_enabled:
+            # A/B baseline: count the lost fusion (health check) and
+            # keep the chain members from re-counting as their own
+            # chain during normal conversion
+            self._counted_chain_nodes.update(node_ids)
+            return None
+        self.last_fusion["fusedStages"] += 1
+        self.last_fusion["fusedOperators"] += hops + 1
+        fusion_metrics.bump("fusedStages")
+        fusion_metrics.bump("fusedOperators", hops + 1)
         from spark_rapids_tpu.config import rapids_conf as rc
         base = self._convert(child_meta)
-        return _plan_aggregate(
-            group, aggs, base, pre_filter=cond,
+        fused = _plan_aggregate(
+            group, aggs, base, pre_filter=conds or None,
             merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS),
             defer_syncs=self.conf.get(rc.PIPELINE_DEFER_SYNCS))
+        # runtime dispatch-savings attribution (QueryEnd fusion dict):
+        # each folded operator would have cost one dispatch per batch
+        agg_exec = fused if isinstance(fused, TpuHashAggregateExec) \
+            else fused.children[0]
+        if isinstance(agg_exec, TpuHashAggregateExec):
+            agg_exec.fused_ops = hops
+        return fused
 
 
 def valid_op_names():

@@ -57,6 +57,11 @@ _POINTS: Dict[str, Optional[Type[BaseException]]] = {
     "spill.corrupt.host": F.InjectedSpillFault,
     "spill.corrupt.disk": F.InjectedSpillFault,
     "udf.worker": F.InjectedWorkerFault,
+    # persistent jit-cache load (ops/jit_cache.py): raise/delay rules
+    # simulate unreadable entries, corrupt rules flip payload bits at
+    # the fire_mutate site so the CRC gate has rot to catch — every
+    # flavor degrades to a fresh compile, never a failed query
+    "jitcache.load": F.InjectedFault,
 }
 
 

@@ -67,6 +67,13 @@ class QueryInfo:
     # per-query budget ladder events (serving BudgetExhausted:
     # budget, used, limit, action=spill|reject)
     budget: List[Dict[str, str]] = field(default_factory=list)
+    # whole-stage fusion + persistent jit cache (QueryEnd fusion dict,
+    # exec/fusion.py: fusedStages/fusedOperators/dispatchesSaved/
+    # fusibleChains + persistentHits/Misses/Invalid/Stores deltas)
+    fusion: Dict[str, float] = field(default_factory=dict)
+    # dropped persistent jit-cache entries (JitCacheInvalid events:
+    # reason, entry) — informative; the query recompiled fresh
+    jitcache: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -108,6 +115,9 @@ class AppInfo:
     rejections: List[Dict[str, str]] = field(default_factory=list)
     # un-attributed BudgetExhausted events
     budget: List[Dict[str, str]] = field(default_factory=list)
+    # un-attributed JitCacheInvalid events (a load outside any query
+    # envelope)
+    jitcache: List[Dict[str, str]] = field(default_factory=list)
 
     def max_concurrent(self) -> int:
         """Peak number of simultaneously-open query envelopes — the
@@ -222,6 +232,12 @@ def parse_event_log(path: str) -> AppInfo:
                                             "action") if k in rec}
                 q = all_queries.get(rec.get("queryId"))
                 (q.budget if q is not None else app.budget).append(info)
+            elif ev == "JitCacheInvalid":
+                info = {k: rec[k] for k in ("reason", "entry")
+                        if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                (q.jitcache if q is not None
+                 else app.jitcache).append(info)
             elif ev == "QueryFatal":
                 info = {k: rec[k] for k in
                         ("error", "recovery", "watchdog", "checkpoint")
@@ -246,6 +262,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.retry = rec.get("retry", {})
                 q.pipeline = rec.get("pipeline", {})
                 q.shuffle = rec.get("shuffle", {})
+                q.fusion = rec.get("fusion", {})
                 q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed

@@ -203,6 +203,42 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Whole-stage fusion + persistent jit-cache effectiveness across
+    queries (exec/fusion.py, ops/jit_cache.py): stages/operators fused,
+    jit dispatches saved, chains that COULD have fused but ran unfused,
+    and the persistent tier's warm-start hit rate."""
+    touched = stages = ops = saved = chains = 0
+    phits = pmisses = pinvalid = pstores = 0
+    for a in apps:
+        for q in a.queries:
+            fu = q.fusion
+            if not fu:
+                continue
+            touched += 1
+            stages += fu.get("fusedStages", 0)
+            ops += fu.get("fusedOperators", 0)
+            saved += fu.get("dispatchesSaved", 0)
+            chains += fu.get("fusibleChains", 0)
+            phits += fu.get("persistentHits", 0)
+            pmisses += fu.get("persistentMisses", 0)
+            pinvalid += fu.get("persistentInvalid", 0)
+            pstores += fu.get("persistentStores", 0)
+    if not touched:
+        return {}
+    return {
+        "queries": touched,
+        "fused_stages": stages,
+        "fused_operators": ops,
+        "dispatches_saved": saved,
+        "fusible_chains": chains,
+        "persistent_hits": phits,
+        "persistent_misses": pmisses,
+        "persistent_invalid": pinvalid,
+        "persistent_stores": pstores,
+    }
+
+
 def nearest_rank(sorted_vals: List[float], p: float) -> float:
     """Nearest-rank percentile over an ascending list — shared by the
     concurrency report and ``bench.py --concurrency`` so the two can
@@ -306,6 +342,25 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                         f"{sh['slotOverflowRetries']} speculative slot "
                         "overflow(s) re-ran at full capacity — data "
                         "skew shifted under a warm exchange site")
+            fu = q.fusion
+            if fu and fu.get("fusibleChains", 0) > \
+                    fu.get("fusedStages", 0):
+                lost = fu["fusibleChains"] - fu.get("fusedStages", 0)
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: {lost} fusible "
+                    "operator chain(s) ran UNFUSED — each pays one jit "
+                    "dispatch + device materialization per operator per "
+                    "batch; check spark.rapids.tpu.fusion.enabled (or "
+                    "an unfusible chain member forced the fallback)")
+            if q.jitcache:
+                reasons = sorted({j.get("reason", "?").split(":")[0]
+                                  for j in q.jitcache})
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{len(q.jitcache)} persistent jit-cache entr"
+                    f"{'y' if len(q.jitcache) == 1 else 'ies'} dropped "
+                    f"({', '.join(reasons)}) — recompiled fresh (never "
+                    "wrong results); check jitCache.dir storage health")
             spilled = sum(q.spill.values()) if q.spill else 0
             if spilled:
                 problems.append(
@@ -351,6 +406,34 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     f"starvation — waited {adm['waitMs']:.0f}ms to run "
                     f"{q.duration_ms:.0f}ms; raise serving."
                     "concurrentQueries or spread the tenant load")
+        # persistent-cache thrash: a REPEAT of the same plan (matched by
+        # normalized logical plan, the compare_apps discipline) that
+        # still compiled fresh with zero warm hits — the tier is
+        # configured but buying nothing (wrong dir, version churn, or
+        # every entry failing verification)
+        import re as _re
+        seen_plans: Dict[str, int] = {}
+        for q in a.queries:
+            fu = q.fusion
+            if not fu or not fu.get("persistentEnabled"):
+                continue
+            key = _re.sub(r"\d+", "N", q.logical_plan.strip())
+            if not key:
+                continue
+            if key in seen_plans and fu.get("persistentMisses", 0) > 0 \
+                    and fu.get("persistentHits", 0) == 0:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: persistent jit "
+                    "cache 0% hit on a REPEAT of query "
+                    f"{seen_plans[key]} ({fu['persistentMisses']} "
+                    "misses, 0 hits) — warm start bought nothing; "
+                    "check jitCache.dir persistence and jax/jaxlib "
+                    "version churn")
+            seen_plans.setdefault(key, q.query_id)
+        for j in a.jitcache:
+            problems.append(
+                f"{a.session_id}: persistent jit-cache entry dropped "
+                f"without query attribution ({j.get('reason', '?')})")
         for r in a.rejections:
             problems.append(
                 f"{a.session_id}: query rejected at admission "
@@ -648,6 +731,20 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"padding={sw['padding_ratio']:.2f}x "
             f"overflowRetries={sw['slot_overflow_retries']} "
             f"perColumnFallbacks={sw['per_column_fallbacks']}")
+    fu = fusion_stats(apps)
+    if fu:
+        out.append("\n-- Whole-stage fusion & compile cache --")
+        out.append(
+            f"  fusedStages={fu['fused_stages']} "
+            f"fusedOperators={fu['fused_operators']} "
+            f"dispatchesSaved={fu['dispatches_saved']} "
+            f"fusibleChains={fu['fusible_chains']}")
+        ptotal = fu["persistent_hits"] + fu["persistent_misses"]
+        if ptotal or fu["persistent_stores"]:
+            out.append(
+                f"  persistent jit cache: {fu['persistent_hits']}/"
+                f"{ptotal} warm hits, stores={fu['persistent_stores']} "
+                f"invalid={fu['persistent_invalid']}")
     cc = concurrency_stats(apps)
     if cc:
         out.append("\n-- Concurrency & admission --")
