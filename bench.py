@@ -349,9 +349,21 @@ def child_main() -> None:
             # stage-checkpoint recovery attribution
             # (robustness/checkpoint.py): resumes stay 0 on clean runs;
             # bytes written show what the lineage log cost
-            "checkpoint_resume_count": 0, "checkpoint_bytes_written": 0}
+            "checkpoint_resume_count": 0, "checkpoint_bytes_written": 0,
+            # persistent AOT executable cache (ops/jit_cache.py): the
+            # warm-start counters ride EVERY bench emission (not just
+            # --repeat) so BENCH_* artifacts show whether this process
+            # compiled anything a previous session had already exported
+            "jit_cache_persistent_hits": 0,
+            "jit_cache_persistent_misses": 0,
+            "jit_cache_persistent_stores": 0,
+            # async exchange/compute overlap (parallel/exchange_async.py)
+            "exchange_overlap_ms": 0.0, "exchange_overlap_fraction": 0.0}
 
     def wire_fields(session):
+        from spark_rapids_tpu.ops.jit_cache import persistent_info
+        from spark_rapids_tpu.parallel.exchange_async import \
+            overlap_metrics_for_session
         from spark_rapids_tpu.parallel.shuffle import metrics_for_session
         from spark_rapids_tpu.robustness.checkpoint import \
             checkpoint_metrics
@@ -362,6 +374,15 @@ def child_main() -> None:
         c = checkpoint_metrics.snapshot()
         best["checkpoint_resume_count"] = c["resumes"]
         best["checkpoint_bytes_written"] = c["bytesWritten"]
+        p = persistent_info()
+        best["jit_cache_persistent_hits"] = p["hits"]
+        best["jit_cache_persistent_misses"] = p["misses"]
+        best["jit_cache_persistent_stores"] = p["stores"]
+        ov = overlap_metrics_for_session(session).snapshot()
+        best["exchange_overlap_ms"] = ov["exchangeOverlapMs"]
+        best["exchange_overlap_fraction"] = round(
+            ov["exchangeOverlapMs"] / ov["exchangeWallMs"], 3) \
+            if ov["exchangeWallMs"] else 0.0
 
     def save():
         if best_file:

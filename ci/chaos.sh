@@ -378,4 +378,102 @@ print(f"concurrent spray OK ({len(results)}/8 answered, "
       f"dirty queries={dirty}, maxConcurrent={app.max_concurrent()})")
 PY
 
+echo "== async exchange spray (2 concurrent clients, faults keyed per query, overlap + staging paths) =="
+# Two client threads share one MESH session with the PR-9 data-movement
+# features live (async exchange window + ragged slots, then host-RAM
+# staging).  One client carries raise/delay rules scoped to ITS query on
+# the async-exchange injection points; the other runs clean.  The gate:
+# zero wrong results (both clients bit-identical to solo execution),
+# zero unattributed robustness events, and the clean client's trail
+# shows no recovery — cross-query interference is a failure.
+python - <<'PY'
+import tempfile
+import threading
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+
+rng = np.random.default_rng(9)
+n = 4000
+keys = np.where(rng.random(n) < 0.8, 1,
+                rng.integers(0, 200, n)).astype(np.int64)
+pdf = pd.DataFrame({"k": keys, "v": rng.normal(size=n)})
+dim = pd.DataFrame({"k": np.arange(200, dtype=np.int64),
+                    "w": rng.normal(size=200)})
+
+def q(s):
+    return (s.create_dataframe(pdf)
+            .join(s.create_dataframe(dim), on="k")
+            .group_by("k").agg(F.sum(F.col("v")).alias("sv"),
+                               F.sum(F.col("w")).alias("sw"))
+            .to_pandas().sort_values("k", ignore_index=True))
+
+PASSES = [
+    ("async+ragged", {
+        "spark.rapids.tpu.exchange.async.enabled": True,
+        "spark.rapids.tpu.shuffle.slot.ragged.enabled": True,
+    }, [("exchange.async.resolve", dict(count=2, probability=0.7)),
+        ("exchange.async.resolve", dict(count=1, kind="delay",
+                                        delay_s=0.3)),
+        ("dist.host_sync", dict(count=1, probability=0.5))]),
+    ("host-staging", {
+        "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+    }, [("exchange.host_staging", dict(count=2, probability=0.7)),
+        ("exchange.host_staging", dict(count=1, kind="delay",
+                                       delay_s=0.3))]),
+]
+for name, extra, spray in PASSES:
+    logdir = tempfile.mkdtemp(prefix="tpu-async-chaos-")
+    s = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": logdir,
+        "spark.rapids.sql.recovery.backoffMs": 5,
+        "spark.rapids.sql.join.broadcastThresholdRows": 1,
+        "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000,
+        **extra}, mesh=make_mesh(8))
+    want = q(s)  # solo warm-up is also the oracle
+    results, failures = {}, {}
+
+    def client(i):
+        try:
+            if i == 0:
+                with I.scoped_rules(key="faulted"):
+                    for point, kw in spray:
+                        I.inject(point, seed=41 + i, **kw)
+                    results[i] = q(s)
+            else:
+                results[i] = q(s)
+        except Exception as e:  # noqa: BLE001 — gate below
+            failures[i] = e
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not failures, f"{name}: {failures}"
+    for i in range(2):
+        pd.testing.assert_frame_equal(results[i], want)
+    s.stop()
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    app = load_logs(logdir)[0]
+    assert app.recovery == [], f"unattributed recovery: {app.recovery}"
+    dirty = [qq.query_id for qq in app.queries if qq.recovery]
+    for qq in app.queries:
+        kinds = {r.get("fault") for r in qq.recovery}
+        assert kinds <= {"shuffle", "host_sync", "timeout"}, \
+            (qq.query_id, qq.recovery)
+    clean_ok = [qq.query_id for qq in app.queries
+                if qq.succeeded and not qq.recovery
+                and not qq.corruption]
+    # warm-up + at least the clean client answered without recovery
+    assert len(clean_ok) >= 2, (name, clean_ok, dirty)
+    ov = s.exchange_overlap_metrics.snapshot()
+    print(f"async exchange spray [{name}] OK (2 clients exact, "
+          f"dirty={dirty}, async={int(ov['asyncExchanges'])} "
+          f"staged={int(ov['hostStagedExchanges'])})")
+PY
+
 echo "CHAOS OK"

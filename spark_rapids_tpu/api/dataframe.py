@@ -696,12 +696,16 @@ class DataFrame:
             from spark_rapids_tpu.ops.jit_cache import persistent_info
             from spark_rapids_tpu.parallel.dist_planner import (
                 try_distributed)
+            from spark_rapids_tpu.parallel.exchange_async import (
+                ExchangeOverlapMetrics, overlap_metrics_for_session)
             from spark_rapids_tpu.parallel.shuffle import (
                 ShuffleWireMetrics, metrics_for_session)
             events = getattr(self.session, "events", None)
             t0 = _time.perf_counter()
             wire = metrics_for_session(self.session)
             wire0 = wire.snapshot()
+            overlap = overlap_metrics_for_session(self.session)
+            overlap0 = overlap.snapshot()
             pjit0 = persistent_info()
             # the envelope opens BEFORE execution so everything the
             # attempt emits mid-flight — CheckpointWrite/Resume,
@@ -751,6 +755,12 @@ class DataFrame:
                 # exchange fell back to per-column collectives
                 shuffle = ShuffleWireMetrics.summarize(
                     ShuffleWireMetrics.delta(wire.snapshot(), wire0))
+                # async exchange/compute overlap + host-staging deltas
+                # ride the same QueryInfo.shuffle dict (the
+                # exchangeOverlapMs metric the MULTICHIP tail and the
+                # profiling "exchange overlap" line report)
+                shuffle.update(ExchangeOverlapMetrics.delta(
+                    overlap.snapshot(), overlap0))
                 # session attribute contract: None when the query never
                 # exchanged (a distributed scan/filter); the event log
                 # still gets the (zeros) dict so every distributed

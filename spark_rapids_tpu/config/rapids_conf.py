@@ -349,6 +349,72 @@ SHUFFLE_SLOT_OVERFLOW_GROWTH = conf(
     "headroom above the slice that overflowed.", _to_float,
     lambda v: None if v >= 1.0 else "must be >= 1.0")
 
+SHUFFLE_SLOT_RAGGED_ENABLED = conf(
+    "spark.rapids.tpu.shuffle.slot.ragged.enabled", False,
+    "Skew-adaptive RAGGED slot plans for stats-sized exchanges: when "
+    "the per-destination histogram shows a few hot (src, dst) slices, "
+    "the base all_to_all is sized from the COLD slices and the hot "
+    "surplus rides per-pair collective-permutes that transmit only on "
+    "their own link — padded wire bytes stop scaling with the hottest "
+    "destination times every slice (parallel/shuffle.py RaggedPlan). "
+    "False (default) keeps one uniform slot per exchange (current "
+    "behavior). The overflow-retry rung stays the safety net: a slice "
+    "exceeding its ragged limit re-runs at full capacity, rows are "
+    "never dropped.", _to_bool)
+
+SHUFFLE_SLOT_RAGGED_FACTOR = conf(
+    "spark.rapids.tpu.shuffle.slot.ragged.minSavings", 1.5,
+    "Minimum wire-rows reduction (uniform / ragged) a ragged plan must "
+    "buy before it is used; below this the uniform slot wins (fewer "
+    "collectives, stable jit keys).", _to_float,
+    lambda v: None if v >= 1.0 else "must be >= 1.0")
+
+EXCHANGE_ASYNC_ENABLED = conf(
+    "spark.rapids.tpu.exchange.async.enabled", False,
+    "Asynchronous exchange/compute overlap (parallel/exchange_async.py): "
+    "exchange-bearing launches are dispatched, not blocked on — the "
+    "post-launch verification (speculative slot-overflow flag) defers "
+    "into an AsyncExchangeHandle resolved at the next stage boundary, "
+    "so downstream fused compute dispatches while the collective is "
+    "still in flight.  Bounded by the in-flight window below; a "
+    "deferred overflow (or an injected fault at resolve time) degrades "
+    "to the synchronous path through the recovery ladder — results are "
+    "never wrong, only re-driven.  False (default) keeps every "
+    "exchange synchronous (current behavior).", _to_bool)
+
+EXCHANGE_INFLIGHT_WINDOW_BYTES = conf(
+    "spark.rapids.tpu.exchange.async.inflightWindowBytes", 1 << 28,
+    "Budget on unresolved exchange payload bytes in flight at once "
+    "(the async window's backpressure): admitting a handle past the "
+    "budget resolves the oldest pending handles first, so a deep plan "
+    "cannot pin unbounded HBM in unverified exchange buffers.  "
+    "In-flight bytes are also charged to the query's serving memory "
+    "budget (serving/context.py).", _to_int, _positive)
+
+EXCHANGE_HOST_STAGING_THRESHOLD = conf(
+    "spark.rapids.tpu.exchange.hostStaging.thresholdBytes", 0,
+    "When a single exchange's estimated payload exceeds this many "
+    "bytes, stage it through host RAM instead of the device collective: "
+    "rows round-trip through the spill tier's frame codec (compressed, "
+    "pinned-host analog) and come back already co-located, so an "
+    "oversized shuffle lands in host memory instead of failing over to "
+    "the recovery ladder's split rung.  0 (default) disables staging "
+    "(current behavior).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+SHUFFLE_TOPOLOGY_STRATEGY = conf(
+    "spark.rapids.tpu.shuffle.topology.strategy", "auto",
+    "Collective strategy per mesh axis: 'all_to_all' always uses the "
+    "ICI-style padded all-to-all; 'gather' uses gather-then-"
+    "redistribute (ONE all-gather per width group, each shard compacts "
+    "its own rows locally — fewer, larger transfers, the DCN-friendly "
+    "shape); 'auto' (default) picks all_to_all on single-slice (ICI) "
+    "axes and gather on axes that span hosts/slices "
+    "(parallel/mesh.py axis_link_kind) — i.e. current behavior on a "
+    "single-slice mesh.", str,
+    lambda v: None if v in ("auto", "all_to_all", "gather") else
+    "must be auto, all_to_all or gather")
+
 _READER_TYPES = ("PERFILE", "COALESCING", "MULTITHREADED", "AUTO")
 
 

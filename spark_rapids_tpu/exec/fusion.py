@@ -202,10 +202,19 @@ class FusedStageExec(TpuExec):
         saved_per_batch = max(len(self.members) - 1, 0)
 
         def tallied():
+            from spark_rapids_tpu.parallel.exchange_async import (
+                resolve_pending)
             for batch in self.child.execute():
                 self.metrics[NUM_INPUT_ROWS] += batch.row_count
                 self.metrics[NUM_INPUT_BATCHES] += 1
                 yield batch
+                # fused-stage batch boundary = async-exchange resolution
+                # point: the stage's compute for this batch has been
+                # dispatched, so any in-flight exchange on this thread
+                # (a distributed sub-execution feeding the stage)
+                # verifies NOW, behind that dispatch — no-op when the
+                # thread holds no window (parallel/exchange_async.py)
+                resolve_pending()
 
         def compute(batch):
             # one jit dispatch where the unfused chain pays one per

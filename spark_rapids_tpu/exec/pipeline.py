@@ -246,9 +246,17 @@ def pipelined(source: Iterator[ColumnarBatch], depth: int,
             # the queue wait is the driving thread's cancellation
             # checkpoint: when the watchdog trips (wedged worker, query
             # deadline) the TimeoutFault is raised HERE instead of
-            # blocking forever on a queue no one will ever fill
+            # blocking forever on a queue no one will ever fill.  It is
+            # also a stage boundary: any async exchange this thread
+            # still has in flight (a distributed sub-execution feeding
+            # this pipeline) verifies here, after downstream work was
+            # dispatched — the exchange/compute-overlap contract
+            # (parallel/exchange_async.py)
+            from spark_rapids_tpu.parallel.exchange_async import (
+                resolve_pending)
             while True:
                 watchdog.checkpoint()
+                resolve_pending()
                 try:
                     item = q.get(timeout=0.05)
                     break

@@ -799,6 +799,19 @@ class DistPlanner:
         from spark_rapids_tpu.config import rapids_conf as _rc
         self._fusion = bool(self.conf.get(_rc.FUSION_ENABLED))
         self._fusion_max = int(self.conf.get(_rc.FUSION_MAX_OPS))
+        # async exchange/compute overlap (parallel/exchange_async.py):
+        # exchange-bearing launches admit a handle into this bounded
+        # window instead of blocking on their post-launch verification;
+        # handles resolve at the next stage boundary (checkpoint save,
+        # the next exchange under byte pressure, collect).  OFF on
+        # recovery re-attempts (resume=True): a re-driven attempt runs
+        # the synchronous path — AsyncExchangeOverflow's contract
+        self._xwindow = None
+        if self.conf.get(_rc.EXCHANGE_ASYNC_ENABLED) and not resume:
+            from spark_rapids_tpu.parallel import exchange_async as _xa
+            self._xwindow = _xa.ExchangeWindow(
+                int(self.conf.get(_rc.EXCHANGE_INFLIGHT_WINDOW_BYTES)),
+                metrics=_xa.overlap_metrics_for_session(session))
         self.fusion: Dict[str, int] = {
             "enabled": self._fusion, "fusedStages": 0,
             "fusedOperators": 0, "dispatchesSaved": 0,
@@ -856,6 +869,12 @@ class DistPlanner:
             if frame is not None:
                 return frame
         frame = self._dispatch(plan, dry)
+        # async-exchange barrier BEFORE the checkpoint write: a frame
+        # with an unverified speculative slot must never enter the
+        # lineage log (a later resume would splice truncated bytes —
+        # the one wrong-results hole the deferred overflow check opens)
+        if self._xwindow is not None:
+            self._xwindow.resolve_all()
         self._ckpt.save(sid, frame, stages=self._count_stages(plan))
         return frame
 
@@ -1326,7 +1345,8 @@ class DistPlanner:
                 group_exprs=group_exprs,
                 funcs=[a.func for a in agg_list],
                 filter_cond=lcond)
-            outs = dist([(v, val, None) for v, val in f.cols], f.nrows)
+            outs = dist([(v, val, None) for v, val in f.cols], f.nrows,
+                        window=self._xwindow)
             self._emit_stats("aggregate", dist.last_stats)
             if not group_exprs:
                 # grand totals are replicated (psum) on every shard;
@@ -1548,7 +1568,7 @@ class DistPlanner:
                 join_type=join_type, out_factor=out_factor)
             flat, n_out, total = join(
                 probe_m.cols, probe_m.nrows, build_m.cols,
-                build_m.nrows)
+                build_m.nrows, window=self._xwindow)
             # process_count-aware fetch: the retry decision must be
             # identical on every controller (host_sync allgathers under
             # multi-process SPMD)
@@ -1852,6 +1872,12 @@ class DistPlanner:
 
     # -- collect ----------------------------------------------------------
     def collect(self, f: ShardedFrame) -> ColumnarBatch:
+        # final stage boundary: every in-flight exchange must verify
+        # before its bytes materialize to the host (a deferred overflow
+        # raises here and the ladder re-drives — truncated frames never
+        # reach a client)
+        if self._xwindow is not None:
+            self._xwindow.resolve_all()
         nshards = f.nshards
         cap = f.capacity
         counts = np.asarray(f.nrows).reshape(-1)
@@ -1919,17 +1945,32 @@ def try_distributed(session, plan: L.LogicalPlan, resume: bool = False):
     planner = DistPlanner(session, mesh, resume=resume)
     session.last_scan_stats = None  # per-query: no stale sharded stats
     session.last_fusion_stats = None  # per-query fusion attribution
+    from spark_rapids_tpu.parallel import exchange_async as _xa
+    _xa.set_current_window(planner._xwindow)
     try:
         planner.run(plan, dry=True)  # support pre-flight: no data moves
         # data-dependent limits (e.g. join fan-out vs output capacity)
         # can only surface while executing; they fall back too
         batch = planner.collect(planner.run(plan, dry=False))
     except NotDistributable as e:
+        # an unverified exchange from a partially-executed attempt is
+        # moot — the single-process fallback recomputes from source
+        if planner._xwindow is not None:
+            planner._xwindow.discard_all()
         session.last_dist_explain = f"fallback: {e}"
         ev = getattr(session, "events", None)
         if ev is not None and ev.enabled:
             ev.emit("DistFallback", reason=str(e))
         return None
+    except BaseException:
+        # failed attempt: the recovery ladder re-drives the whole query
+        # (on the synchronous path); pending handles just release their
+        # window bytes, nothing to verify
+        if planner._xwindow is not None:
+            planner._xwindow.discard_all()
+        raise
+    finally:
+        _xa.set_current_window(None)
     session.last_dist_explain = "distributed"
     session.last_fusion_stats = dict(planner.fusion)
     if planner._ckpt is not None:

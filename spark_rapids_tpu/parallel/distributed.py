@@ -123,11 +123,17 @@ class DistributedAggregate:
             self._buf_specs.extend(specs)
 
         from spark_rapids_tpu.ops.jit_cache import cached_jit
-        from spark_rapids_tpu.parallel.shuffle import packed_enabled
+        from spark_rapids_tpu.parallel.shuffle import (
+            packed_enabled, ragged_enabled, topology_strategy)
         self._cached_jit = cached_jit
         # resolved at construction and baked into the jit signature: a
         # packed.enabled flip must retrace, never hit a stale cache
         self.packed = packed_enabled()
+        # topology-aware collective selection (parallel/mesh.py): ICI
+        # axes keep the padded all_to_all, DCN-spanning axes lower the
+        # exchange to gather-then-redistribute
+        self.exchange_strategy = topology_strategy(mesh)
+        self.ragged, self.ragged_min_savings = ragged_enabled()
         self._sig = ("dist_agg", tuple(self.mesh.axis_names),
                      tuple(self.mesh.devices.shape),
                      tuple(str(d) for d in self.mesh.devices.flat),
@@ -136,7 +142,8 @@ class DistributedAggregate:
                      tuple(f.cache_key() for f in self.funcs),
                      tuple(c.cache_key() for c in self.filter_conds)
                      if self.filter_conds else None,
-                     ("packed", self.packed))
+                     ("packed", self.packed),
+                     ("exch", self.exchange_strategy))
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
             self._sig + ("keyless",), lambda: _shard_map(
@@ -207,13 +214,18 @@ class DistributedAggregate:
         return (tuple((o.values, o.validity) for o in outs),
                 jnp.reshape(n_groups, (1,)), hist)
 
-    def _step_final(self, slot, lut, partial_flat, n_groups_arr):
+    def _step_final(self, slot, ragged, lut, partial_flat, n_groups_arr):
         """Phase 2: exchange partials with the stats-sized slot (bucket
         -> shard assignment rides in as the traced ``lut``), then the
-        final merge + finalize on the receiving shard.  The trailing
-        output leaf is the per-shard slot-overflow flag — nonzero when
-        a speculative (EMA-predicted) slot was too small and the launch
-        must be re-run (rows would otherwise be dropped)."""
+        final merge + finalize on the receiving shard.  ``ragged`` (a
+        static RaggedPlan, part of the jit key) routes hot-slice
+        surplus over collective-permutes; the "gather" exchange
+        strategy replaces the all_to_all with gather-then-redistribute
+        on DCN-spanning axes.  The trailing output leaf is the
+        per-shard slot-overflow flag — nonzero when a speculative
+        (EMA-predicted) slot was too small and the launch must be
+        re-run (rows would otherwise be dropped)."""
+        from spark_rapids_tpu.parallel.shuffle import exchange_via_gather
         n_groups = n_groups_arr[0]
         nkeys = len(self.group_exprs)
         dtypes = [e.dtype for e in self.group_exprs] + \
@@ -222,12 +234,34 @@ class DistributedAggregate:
                 for dt, (v, val) in zip(dtypes, partial_flat)]
         pkeys, pbufs = cols[:nkeys], cols[nkeys:]
         pids = lut[hash_partition_ids(pkeys, self.buckets)]
-        recv, recv_n, overflow = exchange(
-            list(pkeys) + list(pbufs), pids, n_groups, self.axis,
-            self.nshards, slot=slot, packed=self.packed,
-            with_overflow=True, report_site=self._sig + ("final",))
-        rkeys = recv[:nkeys]
-        rbufs = recv[nkeys:]
+        if self.exchange_strategy == "gather":
+            recv, recv_n, overflow = exchange_via_gather(
+                list(pkeys) + list(pbufs), pids, n_groups, self.axis,
+                self.nshards, packed=self.packed, with_overflow=True,
+                report_site=self._sig + ("final",))
+        else:
+            recv, recv_n, overflow = exchange(
+                list(pkeys) + list(pbufs), pids, n_groups, self.axis,
+                self.nshards, slot=slot, packed=self.packed,
+                with_overflow=True, report_site=self._sig + ("final",),
+                ragged=ragged)
+        return self._merge_finalize(recv[:nkeys], recv[nkeys:],
+                                    recv_n, overflow)
+
+    def _step_final_local(self, partial_flat, n_rows_arr):
+        """Final merge over ALREADY co-located partials (the host-RAM
+        staging path repartitioned them off-device): no exchange, one
+        merge + finalize program per shard."""
+        n_rows = n_rows_arr[0]
+        nkeys = len(self.group_exprs)
+        dtypes = [e.dtype for e in self.group_exprs] + \
+            [s.dtype for s in self._buf_specs]
+        cols = [ColVal(dt, v, val)
+                for dt, (v, val) in zip(dtypes, partial_flat)]
+        return self._merge_finalize(cols[:nkeys], cols[nkeys:], n_rows,
+                                    jnp.zeros((), dtype=jnp.bool_))
+
+    def _merge_finalize(self, rkeys, rbufs, recv_n, overflow):
         merge_inputs = [(_merge_kind(s.kind), c)
                         for s, c in zip(self._buf_specs, rbufs)]
         fkeys, fbufs, fn_groups = agg.groupby_aggregate(
@@ -289,18 +323,26 @@ class DistributedAggregate:
         return results
 
     # ---- host API ------------------------------------------------------------
-    def _final_jitted(self, slot: int):
+    def _final_jitted(self, slot: int, ragged=None):
+        rkey = ragged.cache_key() if ragged is not None else None
         return self._cached_jit(
-            self._sig + ("final", slot), lambda: _shard_map(
-                partial(self._step_final, slot), mesh=self.mesh,
+            self._sig + ("final", slot, rkey), lambda: _shard_map(
+                partial(self._step_final, slot, ragged), mesh=self.mesh,
                 in_specs=(P(), P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+
+    def _final_local_jitted(self):
+        return self._cached_jit(
+            self._sig + ("final_local",), lambda: _shard_map(
+                self._step_final_local, mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
     def _wire_dtypes(self):
         return [e.dtype for e in self.group_exprs] + \
             [s.dtype for s in self._buf_specs]
 
-    def __call__(self, flat_cols, nrows_per_shard):
+    def __call__(self, flat_cols, nrows_per_shard, window=None):
         """flat_cols: [(values, validity, offsets)] with leading dim
         nshards*capacity; nrows_per_shard: int32[nshards].
 
@@ -314,11 +356,24 @@ class DistributedAggregate:
         per-shard overflow flag afterwards — an overflow re-runs the
         launch at full capacity (rows are never dropped) and records a
         degradable recovery action.  Either way the exchange site pays
-        at most ONE budgeted hostsync per launch."""
+        at most ONE budgeted hostsync per launch.
+
+        Three PR-9 refinements ride the stats-sized path: a skewed
+        histogram lowers to a RaggedPlan (hot-slice surplus over
+        collective-permutes); a payload past the host-staging threshold
+        repartitions through host RAM + the frame codec instead of the
+        device collective; and when ``window`` (an ExchangeWindow) is
+        passed, the launch's host-side tail is deferred into an
+        AsyncExchangeHandle the window owns — resolved at the next
+        stage boundary (checkpoint save, collect, window pressure), so
+        downstream compute dispatches while the collective is in
+        flight."""
         import numpy as np
+        from spark_rapids_tpu.parallel.exchange_async import (
+            overlap_metrics_for_session, staging_threshold)
         from spark_rapids_tpu.parallel.shuffle import (
-            launch_checkpoint, metrics_for_session, planner_for_session,
-            record_exchange_metrics)
+            launch_checkpoint, metrics_for_session, plan_ragged,
+            planner_for_session, record_exchange_metrics, wire_row_bytes)
         if not self.group_exprs:
             self.last_stats = {"keyless": True}
             return self._jitted_keyless(flat_cols, nrows_per_shard)
@@ -329,18 +384,36 @@ class DistributedAggregate:
         metrics = metrics_for_session()
         site = self._sig
 
+        thr = staging_threshold() \
+            if self.exchange_strategy != "gather" else 0
+        row_bytes = wire_row_bytes(self._wire_dtypes())
         spec = planner.speculative(site, capacity)
+        if spec is not None and thr and \
+                self.nshards * self.nshards * spec["slot"] * row_bytes \
+                > thr:
+            # a payload past the staging threshold must NEVER ride the
+            # device collective — a warm site's cached slot proves the
+            # estimate, so fall through to the stats path, which stages
+            spec = None
         if spec is not None and "lut" in spec and \
                 len(spec["lut"]) == self.buckets:
             outs = self._launch_speculative(site, spec, partial_flat,
                                             n_groups, capacity, planner,
-                                            metrics)
+                                            metrics, window=window)
         else:
             counts = host_sync(hist).reshape(self.nshards, self.buckets)
             lut, dst_counts = coalesce_buckets(counts, self.nshards)
             max_slice = int(dst_counts.max())
             rows = int(dst_counts.sum())
             slot = planner.plan(site, max_slice, capacity)
+            est_bytes = self.nshards * self.nshards * slot * row_bytes
+            if thr and est_bytes > thr:
+                return self._launch_staged(partial_flat, lut,
+                                           dst_counts, metrics)
+            ragged = None
+            if self.ragged and self.exchange_strategy != "gather":
+                ragged = plan_ragged(dst_counts, capacity,
+                                     self.ragged_min_savings)
             planner.observe(site, max_slice, slot, capacity, lut=lut,
                             rows=rows)
             self.last_stats = {
@@ -351,25 +424,81 @@ class DistributedAggregate:
                 "capacity": capacity,
                 "packed": self.packed,
             }
+            if ragged is not None:
+                self.last_stats["ragged"] = repr(ragged)
             with launch_checkpoint():
-                raw = self._final_jitted(slot)(jnp.asarray(lut),
-                                               partial_flat, n_groups)
+                raw = self._final_jitted(slot, ragged)(
+                    jnp.asarray(lut), partial_flat, n_groups)
             outs = raw[:-1]  # drop the overflow flag (slot >= max_slice)
             record_exchange_metrics(
-                metrics, dtypes=self._wire_dtypes(), slot=slot,
+                metrics, dtypes=self._wire_dtypes(),
+                # the gather strategy moves full-capacity buffers (slot
+                # planning does not apply to an all_gather)
+                slot=capacity if self.exchange_strategy == "gather"
+                else slot,
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=rows, packed=self.packed,
-                site=self._sig + ("final",))
+                site=self._sig + ("final",), ragged=ragged,
+                counts=dst_counts)
+            if window is not None:
+                # stats-sized slots are proven (slot >= true max / the
+                # ragged limits cover every pair): no verification to
+                # defer, the handle only tracks in-flight overlap
+                window.admit(site + ("final",),
+                             metrics.last_exchange_bytes)
+            else:
+                overlap_metrics_for_session().record_sync()
         self.last_stats["wire"] = metrics.snapshot()
         return outs
 
+    def _launch_staged(self, partial_flat, lut, dst_counts, metrics):
+        """Host-RAM staging: the exchange payload exceeded the staging
+        threshold, so partials repartition through host memory (frame-
+        codec round trip — compressed, pinned-host analog) and the
+        final merge runs a no-exchange program over the co-located
+        rows.  The oversized shuffle lands in host RAM instead of
+        marching into the recovery ladder's split rung.  The stats
+        branch already paid this launch's ONE counted hostsync (the
+        histogram): per-shard live group counts derive from
+        ``dst_counts`` — no second sync."""
+        from spark_rapids_tpu.parallel.exchange_async import (
+            stage_host_side)
+        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        nkeys = len(self.group_exprs)
+        staged, dest_counts, staged_bytes = stage_host_side(
+            partial_flat, dst_counts, range(nkeys), self.buckets,
+            self.nshards, lut=lut)
+        rows = int(dest_counts.sum())
+        # staged rows move dense (no padding) — account them so the
+        # wire trail shows the exchange happened, in compressed bytes
+        metrics.record_exchange(
+            collectives=0, rows_moved=rows, rows_useful=rows,
+            bytes_moved=staged_bytes, packed=self.packed)
+        flat = tuple((jnp.asarray(v), jnp.asarray(m))
+                     for v, m in staged)
+        with launch_checkpoint():
+            raw = self._final_local_jitted()(
+                flat, jnp.asarray(dest_counts))
+        self.last_stats = {"staged": True, "stagedBytes": staged_bytes,
+                           "partition_counts": dst_counts,
+                           "packed": self.packed,
+                           "wire": metrics.snapshot()}
+        return raw[:-1]
+
     def _launch_speculative(self, site, spec, partial_flat, n_groups,
-                            capacity, planner, metrics):
+                            capacity, planner, metrics, window=None):
         """Steady-state launch: cached slot + bucket LUT, no stats
         hostsync; the post-launch overflow check is the site's single
         budgeted sync.  Overflow re-runs at full capacity and records a
-        degradable recovery action — never dropped rows."""
+        degradable recovery action — never dropped rows.  With an async
+        ``window`` the overflow check itself defers into a handle the
+        window owns: downstream compute dispatches first, and a
+        deferred overflow surfaces as a RETRYABLE AsyncExchangeOverflow
+        at resolve time (the ladder re-drives; the planner has latched
+        the site back onto the stats-sized synchronous path)."""
         import numpy as np
+        from spark_rapids_tpu.parallel.exchange_async import (
+            overlap_metrics_for_session)
         from spark_rapids_tpu.parallel.shuffle import (
             launch_checkpoint, record_exchange_metrics)
         slot, lut = spec["slot"], spec["lut"]
@@ -380,10 +509,37 @@ class DistributedAggregate:
                                            partial_flat, n_groups)
         outs, ovf = raw[:-1], raw[-1]
         record_exchange_metrics(
-            metrics, dtypes=self._wire_dtypes(), slot=slot,
+            metrics, dtypes=self._wire_dtypes(),
+            slot=capacity if self.exchange_strategy == "gather"
+            else slot,
             num_parts=self.nshards, nshards=self.nshards,
             rows_useful=spec.get("rows", 0), packed=self.packed,
             site=self._sig + ("final",))
+        if window is not None:
+            overlap = overlap_metrics_for_session()
+
+            def verify():
+                if not bool(np.asarray(host_sync(ovf)).any()):
+                    return
+                # the truncated frame already fed downstream dispatches:
+                # the local capacity re-run cannot help anymore.  Latch
+                # the site off speculation and re-drive the attempt.
+                planner.observe_overflow(site)
+                metrics.record_overflow()
+                overlap.record_deferred_overflow()
+                from spark_rapids_tpu.api.session import TpuSession
+                from spark_rapids_tpu.robustness.driver import (
+                    record_degradation)
+                from spark_rapids_tpu.robustness.faults import (
+                    AsyncExchangeOverflow)
+                err = AsyncExchangeOverflow("aggregate", slot, capacity)
+                record_degradation(TpuSession._active, err.kind,
+                                   "shuffle-slot-async-replan", str(err))
+                raise err
+
+            window.admit(site + ("final",),
+                         metrics.last_exchange_bytes, verify)
+            return outs
         # the overflow check IS this launch's phase boundary: route it
         # through host_sync so (a) multi-process controllers all see
         # the same flags and make the identical rerun decision, (b) a
@@ -391,6 +547,7 @@ class DistributedAggregate:
         # deadline, and (c) chaos rules armed on the phase boundary
         # keep firing on warm (speculative) sites — at most ONE counted
         # hostsync per exchange site per launch either way
+        overlap_metrics_for_session().record_sync()
         if not bool(np.asarray(host_sync(ovf)).any()):
             return outs
         # slot overflow: the EMA prediction was too small for this
@@ -554,21 +711,33 @@ class DistributedHashJoin:
         self.skew_factor = skew_factor
         self.skew_min_rows = skew_min_rows
         self._cached_jit = cached_jit
-        from spark_rapids_tpu.parallel.shuffle import packed_enabled
+        from spark_rapids_tpu.parallel.shuffle import (
+            packed_enabled, ragged_enabled, topology_strategy)
         self.packed = packed_enabled()
+        # topology-aware collective selection + skew-adaptive ragged
+        # slots (see DistributedAggregate); both bake into the jit sig
+        self.exchange_strategy = topology_strategy(mesh)
+        self.ragged, self.ragged_min_savings = ragged_enabled()
         self._sig = ("dist_join", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
                      tuple(str(d) for d in mesh.devices.flat),
                      tuple(dt.name for dt in self.probe_dtypes),
                      tuple(dt.name for dt in self.build_dtypes),
                      tuple(self.probe_key_idx), tuple(self.build_key_idx),
-                     join_type, out_factor, ("packed", self.packed))
+                     join_type, out_factor, ("packed", self.packed),
+                     ("exch", self.exchange_strategy))
         self.last_stats: Optional[dict] = None
 
     def _jitted(self, strategy: str, slots, skewed=()):
-        """Compiled program per (strategy, exchange slots, skew set)."""
+        """Compiled program per (strategy, exchange slots, skew set).
+        A slot entry may be a RaggedPlan; its cache_key stands in for
+        it in the jit signature."""
+        from spark_rapids_tpu.parallel.shuffle import RaggedPlan
+        slots_sig = tuple(
+            s.cache_key() if isinstance(s, RaggedPlan) else s
+            for s in slots)
         return self._cached_jit(
-            self._sig + (strategy, slots, tuple(skewed)),
+            self._sig + (strategy, slots_sig, tuple(skewed)),
             lambda: _shard_map(
                 partial(self._step, strategy, slots, tuple(skewed)),
                 mesh=self.mesh,
@@ -612,6 +781,30 @@ class DistributedHashJoin:
             m = jnp.logical_or(m, pids == s)
         return m
 
+    def _exchange_one(self, cols, pids, n, slot, site_tag):
+        """One side's exchange under the resolved collective strategy:
+        gather-then-redistribute on DCN-ish axes, ragged (RaggedPlan
+        slot) or uniform all_to_all otherwise.  The uniform fallback
+        slot for a ragged plan is base+surplus — an upper bound on
+        every slice, used only when the lane packer cannot ingest the
+        columns (trace-time consistent)."""
+        from spark_rapids_tpu.parallel.shuffle import (
+            RaggedPlan, exchange_via_gather)
+        if self.exchange_strategy == "gather":
+            return exchange_via_gather(
+                cols, pids, n, self.axis, self.nshards,
+                packed=self.packed,
+                report_site=self._sig + (site_tag,))
+        if isinstance(slot, RaggedPlan):
+            return exchange(
+                cols, pids, n, self.axis, self.nshards,
+                slot=slot.base_slot + slot.surplus_slot,
+                packed=self.packed,
+                report_site=self._sig + (site_tag,), ragged=slot)
+        return exchange(cols, pids, n, self.axis, self.nshards,
+                        slot=slot, packed=self.packed,
+                        report_site=self._sig + (site_tag,))
+
     def _step(self, strategy, slots, skewed, probe_flat, probe_nrows_arr,
               build_flat, build_nrows_arr):
         from spark_rapids_tpu.ops import joins as J
@@ -628,7 +821,11 @@ class DistributedHashJoin:
         # PRE-exchange capacity (the adaptive slot must not shrink it)
         in_probe_cap = probe[0].values.shape[0]
 
-        if strategy == "broadcast":
+        if strategy == "local":
+            # host-staged exchange already co-located both sides by key
+            # hash off-device: no collective, straight local join
+            pass
+        elif strategy == "broadcast":
             build, bn = all_gather_cols(build, bn, self.axis, self.nshards,
                                         packed=self.packed,
                                         report_site=self._sig
@@ -659,17 +856,13 @@ class DistributedHashJoin:
                     build, jnp.logical_and(live_b, ~sk_b))
                 sk_cols, n_sk = selection.compact(
                     build, jnp.logical_and(live_b, sk_b))
-                probe, pn = exchange(probe, ppids, pn, self.axis,
-                                     self.nshards, slot=slots[0],
-                                     packed=self.packed,
-                                     report_site=self._sig + ("probe",))
+                probe, pn = self._exchange_one(probe, ppids, pn,
+                                               slots[0], "probe")
                 norm_keys = [norm_cols[i] for i in self.build_key_idx]
-                b1, bn1 = exchange(
+                b1, bn1 = self._exchange_one(
                     norm_cols, hash_partition_ids(norm_keys,
                                                   self.nshards),
-                    n_norm, self.axis, self.nshards, slot=slots[1],
-                    packed=self.packed,
-                    report_site=self._sig + ("build",))
+                    n_norm, slots[1], "build")
                 # gather only a bounded prefix: the host sized
                 # slots[2] from the true max per-shard skewed build
                 # count, so the full cap_b column never rides ICI
@@ -686,14 +879,10 @@ class DistributedHashJoin:
                                           + ("gather",))
                 build, bn = concat_prefixes(b1, bn1, b2, bn2)
             else:
-                probe, pn = exchange(probe, ppids, pn, self.axis,
-                                     self.nshards, slot=slots[0],
-                                     packed=self.packed,
-                                     report_site=self._sig + ("probe",))
-                build, bn = exchange(build, bpids, bn, self.axis,
-                                     self.nshards, slot=slots[1],
-                                     packed=self.packed,
-                                     report_site=self._sig + ("build",))
+                probe, pn = self._exchange_one(probe, ppids, pn,
+                                               slots[0], "probe")
+                build, bn = self._exchange_one(build, bpids, bn,
+                                               slots[1], "build")
 
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
@@ -759,7 +948,7 @@ class DistributedHashJoin:
         return flat, n_out[None], total.astype(jnp.int32)[None]
 
     def __call__(self, probe_flat, probe_nrows_per_shard, build_flat,
-                 build_nrows_per_shard):
+                 build_nrows_per_shard, window=None):
         """probe_flat/build_flat: [(values, validity)] with leading-axis
         sharded arrays; nrows arrays have one entry per shard.  Returns
         (flat output cols, nrows per shard, unclamped match total per
@@ -779,6 +968,8 @@ class DistributedHashJoin:
         from per-destination histograms instead of full-capacity padding.
         """
         import numpy as np
+        from spark_rapids_tpu.parallel.exchange_async import (
+            overlap_metrics_for_session)
         from spark_rapids_tpu.parallel.shuffle import (
             metrics_for_session, planner_for_session,
             record_exchange_metrics)
@@ -797,6 +988,10 @@ class DistributedHashJoin:
         slots = (None, None)
         skewed = ()
         stats = {"strategy": strategy, "build_rows": total_build}
+        # payload bytes of EVERY exchange this launch puts in flight at
+        # once (probe + build + any skew-gather) — what the async
+        # window's in-flight budget must charge
+        launch_bytes = 0
         if strategy == "broadcast":
             # the all-gather moves every shard's full build capacity
             cap_b = int(build_flat[0][0].shape[0]) // self.nshards
@@ -815,6 +1010,25 @@ class DistributedHashJoin:
             from spark_rapids_tpu.parallel.shuffle import pick_slot
             cap_p = int(probe_flat[0][0].shape[0]) // self.nshards
             cap_b = int(build_flat[0][0].shape[0]) // self.nshards
+            # host-RAM staging: a payload past the threshold never
+            # rides the device collective — both sides repartition
+            # through host memory + the frame codec and the join runs
+            # the no-exchange "local" program (the split-rung dodge)
+            from spark_rapids_tpu.parallel.exchange_async import (
+                staging_threshold)
+            from spark_rapids_tpu.parallel.shuffle import wire_row_bytes
+            thr = staging_threshold()
+            if thr and self.exchange_strategy != "gather":
+                est = (self.nshards * self.nshards
+                       * pick_slot(int(pcounts.max()), cap_p)
+                       * wire_row_bytes(self.probe_dtypes)
+                       + self.nshards * self.nshards
+                       * pick_slot(int(bcounts.max()), cap_b)
+                       * wire_row_bytes(self.build_dtypes))
+                if est > thr:
+                    return self._staged_call(
+                        probe_flat, pcounts, build_flat, bcounts,
+                        metrics)
             # skew detection on the probe destination totals
             # (OptimizeSkewedJoin: partition > factor * median)
             dest_p = pcounts.sum(axis=0)
@@ -864,25 +1078,55 @@ class DistributedHashJoin:
                     rows_useful=int(bcounts[:, sk].sum()),
                     packed=self.packed,
                     site=self._sig + ("gather",))
+                launch_bytes += metrics.last_exchange_bytes
             else:
-                slots = (planner.plan(p_site, int(pcounts.max()), cap_p),
-                         planner.plan(b_site, int(bcounts.max()), cap_b))
-                planner.observe(p_site, int(pcounts.max()), slots[0],
-                                cap_p)
-                planner.observe(b_site, int(bcounts.max()), slots[1],
-                                cap_b)
+                u_p = planner.plan(p_site, int(pcounts.max()), cap_p)
+                u_b = planner.plan(b_site, int(bcounts.max()), cap_b)
+                planner.observe(p_site, int(pcounts.max()), u_p, cap_p)
+                planner.observe(b_site, int(bcounts.max()), u_b, cap_b)
+                slots = (u_p, u_b)
+                if self.ragged and self.exchange_strategy != "gather":
+                    # skew-adaptive ragged wire: the [src, dst]
+                    # histograms are already materialized for slot
+                    # sizing, so a hot destination lowers to a
+                    # RaggedPlan per side — base all_to_all sized from
+                    # the cold slices, hot-pair surplus over
+                    # collective-permutes (parallel/shuffle.py)
+                    from spark_rapids_tpu.parallel.shuffle import \
+                        plan_ragged
+                    rp = plan_ragged(pcounts, cap_p,
+                                     self.ragged_min_savings)
+                    rb = plan_ragged(bcounts, cap_b,
+                                     self.ragged_min_savings)
+                    slots = (rp or u_p, rb or u_b)
+            from spark_rapids_tpu.parallel.shuffle import RaggedPlan
+            rag_p = slots[0] if isinstance(slots[0], RaggedPlan) else None
+            rag_b = slots[1] if isinstance(slots[1], RaggedPlan) else None
+            # the gather strategy all-gathers full-capacity buffers
+            # (slot planning does not apply), so account capacity
+            gather = self.exchange_strategy == "gather"
             record_exchange_metrics(
-                metrics, dtypes=self.probe_dtypes, slot=slots[0],
+                metrics, dtypes=self.probe_dtypes,
+                slot=cap_p if gather
+                else (slots[0] if rag_p is None else 0),
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=int(pcounts.sum()), packed=self.packed,
-                site=self._sig + ("probe",))
+                site=self._sig + ("probe",), ragged=rag_p,
+                counts=pcounts)
+            launch_bytes += metrics.last_exchange_bytes
             record_exchange_metrics(
-                metrics, dtypes=self.build_dtypes, slot=slots[1],
+                metrics, dtypes=self.build_dtypes,
+                slot=cap_b if gather
+                else (slots[1] if rag_b is None else 0),
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=int(bcounts.sum()), packed=self.packed,
-                site=self._sig + ("build",))
+                site=self._sig + ("build",), ragged=rag_b,
+                counts=bcounts)
+            launch_bytes += metrics.last_exchange_bytes
             stats.update(probe_counts=pcounts, build_counts=bcounts,
-                         slots=slots, skewed=skewed)
+                         slots=tuple(repr(s) if isinstance(s, RaggedPlan)
+                                     else s for s in slots),
+                         skewed=skewed)
         stats["wire"] = metrics.snapshot()
         self.last_stats = stats
         import contextlib
@@ -892,6 +1136,53 @@ class DistributedHashJoin:
         cp = launch_checkpoint() if strategy == "shuffle" \
             else contextlib.nullcontext()
         with cp:
-            return self._jitted(strategy, slots, skewed)(
+            out = self._jitted(strategy, slots, skewed)(
                 probe_flat, probe_nrows_per_shard,
                 build_flat, build_nrows_per_shard)
+        if strategy == "shuffle":
+            if window is not None:
+                # join slots are stats-sized (histograms are mandatory
+                # for skew detection), so there is no deferred
+                # verification — the handle tracks the in-flight bytes
+                # (BOTH sides' payloads, plus any skew-gather, are
+                # resident at once) and the dispatch->resolve overlap
+                window.admit(self._sig + ("exchange",), launch_bytes)
+            else:
+                overlap_metrics_for_session().record_sync()
+        return out
+
+    def _staged_call(self, probe_flat, probe_hist, build_flat,
+                     build_hist, metrics):
+        """Host-RAM staging for an oversized shuffle join: BOTH sides
+        repartition through host memory (frame-codec round trip — the
+        pinned-bounce-buffer analog) with the same murmur mix the
+        device kernels use, then the no-collective "local" program
+        joins the already co-located rows.  The oversized exchange
+        lands in host RAM instead of marching into the recovery
+        ladder's split rung.  Per-shard live rows derive from the
+        ``[src, dst]`` histograms the stats pass already synced — no
+        extra counted hostsyncs."""
+        from spark_rapids_tpu.parallel.exchange_async import (
+            stage_host_side)
+        from spark_rapids_tpu.parallel.shuffle import launch_checkpoint
+        staged_p, pcounts, pbytes = stage_host_side(
+            probe_flat, probe_hist, self.probe_key_idx, self.nshards,
+            self.nshards)
+        staged_b, bcounts, bbytes = stage_host_side(
+            build_flat, build_hist, self.build_key_idx, self.nshards,
+            self.nshards)
+        rows = int(pcounts.sum()) + int(bcounts.sum())
+        # staged rows move dense (no padding); bytes are the compressed
+        # frames that actually crossed host RAM
+        metrics.record_exchange(
+            collectives=0, rows_moved=rows, rows_useful=rows,
+            bytes_moved=pbytes + bbytes, packed=self.packed)
+        pf = tuple((jnp.asarray(v), jnp.asarray(m)) for v, m in staged_p)
+        bf = tuple((jnp.asarray(v), jnp.asarray(m)) for v, m in staged_b)
+        self.last_stats = {"strategy": "local", "staged": True,
+                           "stagedBytes": pbytes + bbytes,
+                           "build_rows": int(bcounts.sum()),
+                           "wire": metrics.snapshot()}
+        with launch_checkpoint():
+            return self._jitted("local", (None, None))(
+                pf, jnp.asarray(pcounts), bf, jnp.asarray(bcounts))

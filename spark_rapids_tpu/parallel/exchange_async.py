@@ -1,0 +1,431 @@
+"""Asynchronous exchange: dispatch collectives, verify at stage
+boundaries, stage oversized payloads through host RAM.
+
+Three coordinated pieces (ROADMAP open item 4, "Theseus: ... Optimized
+for Efficient Data Movement", PAPERS.md):
+
+* :class:`AsyncExchangeHandle` — the deferred tail of one
+  exchange-bearing launch.  XLA dispatch is asynchronous by
+  construction; what serializes the host today is the POST-launch
+  verification (the speculative slot-overflow flag fetch).  A handle
+  captures that verification as a callback and the planner resolves it
+  at the next stage boundary instead of inline, so the fused compute of
+  the next stage dispatches while the collective for this one is still
+  in flight.  ``exchangeOverlapMs`` (dispatch -> resolve start) over
+  ``exchangeWallMs`` (dispatch -> resolve end) is the overlap fraction
+  the MULTICHIP dryrun reports.
+
+* :class:`ExchangeWindow` — the budgeted in-flight window.  Admitting a
+  handle past ``inflightWindowBytes`` resolves the oldest pending
+  handles first (FIFO), so a deep plan cannot pin unbounded HBM in
+  unverified exchange buffers.  In-flight bytes are charged to the
+  query's serving context (serving/context.py) while pending.
+
+* :func:`host_staged_partition` — the host-RAM staging tier.  When a
+  payload exceeds the staging threshold the exchange never rides the
+  device collective: rows are pulled to host, repartitioned with the
+  same murmur mix the device kernels use, round-tripped through the
+  spill tier's frame codec (compressed — the pinned-bounce-buffer
+  analog), and pushed back already co-located.  An oversized shuffle
+  lands in host RAM instead of failing over to the split rung.
+
+Cooperative cancellation: ``resolve`` runs a watchdog checkpoint and
+fires the ``exchange.async.resolve`` injection point under a watchdog
+section, so the recovery ladder and deadline monitor keep firing on the
+async path exactly as they do on the synchronous one.  A deferred
+overflow (the EMA slot was too small and downstream compute already
+consumed the truncated frame) raises
+:class:`~spark_rapids_tpu.robustness.faults.AsyncExchangeOverflow` —
+RETRYABLE: the ladder re-drives the whole attempt (synchronously — the
+window is never armed on recovery re-attempts) and the slot planner has
+already latched the site back onto the stats-sized path: results are
+never wrong, only re-driven.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ------------------------------------------------------ overlap metrics --
+
+class ExchangeOverlapMetrics:
+    """Cumulative async-exchange counters (one per session, process
+    fallback for bare kernel use — the ShuffleWireMetrics discipline).
+    Per-query deltas ride the QueryEnd ``shuffle`` dict."""
+
+    FIELDS = ("asyncExchanges", "syncExchanges", "exchangeOverlapMs",
+              "exchangeWallMs", "deferredOverflows", "windowEvictions",
+              "hostStagedExchanges", "hostStagedBytes",
+              "hostStagedRawBytes", "inflightPeakBytes")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {k: 0 for k in self.FIELDS}
+
+    def record_resolve(self, overlap_ns: int, wall_ns: int) -> None:
+        with self._lock:
+            c = self.counters
+            c["asyncExchanges"] += 1
+            c["exchangeOverlapMs"] += overlap_ns / 1e6
+            c["exchangeWallMs"] += wall_ns / 1e6
+
+    def record_sync(self) -> None:
+        with self._lock:
+            self.counters["syncExchanges"] += 1
+
+    def record_deferred_overflow(self) -> None:
+        with self._lock:
+            self.counters["deferredOverflows"] += 1
+
+    def record_eviction(self) -> None:
+        with self._lock:
+            self.counters["windowEvictions"] += 1
+
+    def record_staging(self, staged_bytes: int, raw_bytes: int) -> None:
+        with self._lock:
+            c = self.counters
+            c["hostStagedExchanges"] += 1
+            c["hostStagedBytes"] += int(staged_bytes)
+            c["hostStagedRawBytes"] += int(raw_bytes)
+
+    def note_inflight(self, inflight_bytes: int) -> None:
+        with self._lock:
+            c = self.counters
+            c["inflightPeakBytes"] = max(c["inflightPeakBytes"],
+                                         int(inflight_bytes))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in self.counters.items()}
+
+    @staticmethod
+    def delta(after: Dict[str, float], before: Dict[str, float]
+              ) -> Dict[str, float]:
+        out = {}
+        for k in after:
+            d = after.get(k, 0) - before.get(k, 0)
+            out[k] = round(d, 3) if isinstance(d, float) else d
+        # peak is a high-water mark, not a counter: report the absolute
+        out["inflightPeakBytes"] = after.get("inflightPeakBytes", 0)
+        return out
+
+
+_default_overlap = None
+
+
+def overlap_metrics_for_session(session=None) -> ExchangeOverlapMetrics:
+    global _default_overlap
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        if _default_overlap is None:
+            _default_overlap = ExchangeOverlapMetrics()
+        return _default_overlap
+    m = getattr(session, "exchange_overlap_metrics", None)
+    if m is None:
+        m = ExchangeOverlapMetrics()
+        session.exchange_overlap_metrics = m
+    return m
+
+
+# -------------------------------------------------------------- handles --
+
+class AsyncExchangeHandle:
+    """The deferred tail of one exchange-bearing launch.
+
+    ``verify`` is the host-side verification the synchronous path would
+    have run inline (overflow-flag fetch + rerun decision); None for
+    stats-sized launches whose slot is already proven.  ``resolve`` is
+    idempotent and is the ONLY place the verification runs — it fires
+    the ``exchange.async.resolve`` injection point under a watchdog
+    section and runs a cancellation checkpoint first, so chaos rules
+    and query deadlines land here exactly as on a synchronous sync."""
+
+    def __init__(self, site, payload_bytes: int = 0,
+                 verify: Optional[Callable[[], None]] = None,
+                 metrics: Optional[ExchangeOverlapMetrics] = None,
+                 on_done: Optional[Callable[["AsyncExchangeHandle"],
+                                            None]] = None):
+        self.site = site
+        self.payload_bytes = int(payload_bytes)
+        self._verify = verify
+        self._metrics = metrics or overlap_metrics_for_session()
+        self._on_done = on_done
+        self.dispatch_ns = time.perf_counter_ns()
+        self.resolved = False
+        self.overflowed = False
+
+    def resolve(self) -> None:
+        if self.resolved:
+            return
+        self.resolved = True
+        t_start = time.perf_counter_ns()
+        try:
+            from spark_rapids_tpu.robustness import watchdog
+            from spark_rapids_tpu.robustness.inject import fire
+            watchdog.checkpoint()
+            with watchdog.section("exchange.async.resolve"):
+                fire("exchange.async.resolve")
+                if self._verify is not None:
+                    self._verify()
+        finally:
+            t_end = time.perf_counter_ns()
+            self._metrics.record_resolve(
+                overlap_ns=t_start - self.dispatch_ns,
+                wall_ns=t_end - self.dispatch_ns)
+            if self._on_done is not None:
+                self._on_done(self)
+
+    def discard(self) -> None:
+        """Drop without verifying — only for an attempt that is already
+        failing (the ladder re-runs everything; unverified buffers just
+        release).  Counted as resolved so the window's byte budget
+        frees."""
+        if self.resolved:
+            return
+        self.resolved = True
+        if self._on_done is not None:
+            self._on_done(self)
+
+
+class ExchangeWindow:
+    """Budgeted FIFO window of unresolved exchange handles.
+
+    One per planner run.  ``admit`` resolves the oldest pending handles
+    until the new payload fits the byte budget (backpressure by
+    verification, not by blocking — everything runs on the driving
+    thread, so resolving IS yielding the window).  Pending bytes are
+    charged to the query's serving context while in flight."""
+
+    def __init__(self, max_bytes: int,
+                 metrics: Optional[ExchangeOverlapMetrics] = None):
+        self.max_bytes = max(int(max_bytes), 1)
+        self.metrics = metrics or overlap_metrics_for_session()
+        self.pending: "deque[AsyncExchangeHandle]" = deque()
+        self.inflight_bytes = 0
+
+    def _charge(self, delta: int) -> None:
+        self.inflight_bytes += delta
+        if delta > 0:
+            self.metrics.note_inflight(self.inflight_bytes)
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        if ctx is not None:
+            ctx.charge_exchange_inflight(delta)
+
+    def _done(self, handle: AsyncExchangeHandle) -> None:
+        try:
+            self.pending.remove(handle)
+        except ValueError:
+            pass
+        self._charge(-handle.payload_bytes)
+
+    def admit(self, site, payload_bytes: int = 0,
+              verify: Optional[Callable[[], None]] = None
+              ) -> AsyncExchangeHandle:
+        """Create, budget, and enqueue a handle for a just-dispatched
+        exchange.  Over-budget admission resolves oldest-first (the
+        bounded in-flight window)."""
+        while self.pending and \
+                self.inflight_bytes + payload_bytes > self.max_bytes:
+            self.metrics.record_eviction()
+            self.pending[0].resolve()
+        h = AsyncExchangeHandle(site, payload_bytes, verify,
+                                metrics=self.metrics, on_done=self._done)
+        self.pending.append(h)
+        self._charge(h.payload_bytes)
+        return h
+
+    def resolve_all(self) -> None:
+        """The stage-boundary barrier: verify every pending exchange
+        (FIFO).  Raises the first verification fault — the recovery
+        ladder re-drives the query; remaining handles are discarded by
+        the caller's ``discard_all``."""
+        while self.pending:
+            self.pending[0].resolve()
+
+    def discard_all(self) -> None:
+        while self.pending:
+            self.pending[0].discard()
+
+
+# The driving thread's active window (one per distributed attempt,
+# parallel/dist_planner.py).  Thread-local on purpose: a window's
+# handles verify on the thread that dispatched them — concurrent
+# queries (serving/) each carry their own — and stage-boundary hooks on
+# OTHER threads (a pipeline worker) see None and no-op.
+_tls = threading.local()
+
+
+def current_window() -> Optional[ExchangeWindow]:
+    return getattr(_tls, "window", None)
+
+
+def set_current_window(window: Optional[ExchangeWindow]) -> None:
+    _tls.window = window
+
+
+def resolve_pending() -> None:
+    """Stage-boundary hook: verify every pending async exchange of the
+    calling thread's active window.  No-op without one — safe to call
+    from any engine stage boundary (exec/pipeline.py batch gets,
+    exec/fusion.py fused-stage batch loops, DistPlanner checkpoint
+    saves and collect)."""
+    w = current_window()
+    if w is not None and w.pending:
+        w.resolve_all()
+
+
+# -------------------------------------------------- host-RAM staging --
+
+def staging_threshold(session=None) -> int:
+    """Effective host-staging threshold in bytes (0 = staging off —
+    the conf knob is the ONLY opt-in; defaults must bit-reproduce the
+    pre-staging engine).  When staging IS enabled, the query's serving
+    memory budget tightens it (an exchange the budget could never hold
+    should stage, not march into the spill/reject ladder)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        return 0
+    thr = int(session.conf.get(rc.EXCHANGE_HOST_STAGING_THRESHOLD))
+    if not thr:
+        return 0
+    from spark_rapids_tpu.serving import context as qc
+    ctx = qc.current()
+    if ctx is not None and ctx.memory_budget:
+        thr = min(thr, int(ctx.memory_budget))
+    return thr
+
+
+# the host-side murmur port lives NEXT TO the device kernels it must
+# stay bit-parity with (parallel/partitioning.py); staging callers
+# import it from here
+from spark_rapids_tpu.parallel.partitioning import (  # noqa: E402,F401
+    host_hash_partition_ids)
+
+
+def frame_roundtrip(cols: Sequence[Tuple[np.ndarray, np.ndarray]]
+                    ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]],
+                               int, int]:
+    """Round-trip column buffers through the spill tier's frame codec
+    (native zero-RLE/LZB when built, pure-python fallback otherwise) —
+    the pinned-host transit.  Returns (cols back, compressed bytes,
+    raw bytes); CRC/structure verification is the codec's own."""
+    from spark_rapids_tpu.native import deserialize_batch, serialize_batch
+    nrows = int(cols[0][0].shape[0]) if cols else 0
+    payload = []
+    raw = 0
+    for values, validity in cols:
+        payload.append((0, values, validity, None))
+        raw += values.nbytes + (validity.nbytes
+                               if validity is not None else 0)
+    blob = serialize_batch(nrows, payload, compress=True)
+    _, back = deserialize_batch(blob)
+    out = []
+    for (values, validity), (_, data_u8, valid_u8, _) in zip(cols, back):
+        v = np.frombuffer(bytes(data_u8), dtype=values.dtype) \
+            if data_u8 is not None and len(data_u8) \
+            else np.zeros(0, dtype=values.dtype)
+        m = np.frombuffer(bytes(valid_u8), dtype=np.bool_) \
+            if valid_u8 is not None and len(valid_u8) \
+            else np.ones(v.shape[0], dtype=np.bool_)
+        out.append((v.copy(), m.copy()))
+    return out, len(blob), raw
+
+
+def host_staged_partition(cols_host: Sequence[Tuple[np.ndarray,
+                                                    np.ndarray]],
+                          counts: np.ndarray,
+                          pids_host: np.ndarray,
+                          nshards: int,
+                          out_capacity: Optional[int] = None,
+                          session=None):
+    """Repartition leading-axis-sharded host columns by destination —
+    the host-RAM staging path for an oversized exchange.
+
+    ``cols_host``: [(values [nshards*cap], validity [nshards*cap])];
+    ``counts``: live rows per shard; ``pids_host``: destination per row
+    (same layout).  Rows round-trip through the frame codec; the result
+    is the post-exchange layout ([nshards*out_cap] buffers + per-shard
+    counts) ready for jnp.asarray placement.  Fires the
+    ``exchange.host_staging`` injection point under a watchdog section
+    (retryable through the ladder like any exchange fault)."""
+    from spark_rapids_tpu.columnar.column import bucket_capacity
+    from spark_rapids_tpu.robustness import watchdog
+    from spark_rapids_tpu.robustness.inject import fire
+    with watchdog.section("exchange.host_staging"):
+        fire("exchange.host_staging")
+        cap = pids_host.shape[0] // nshards
+        live = np.zeros(nshards * cap, dtype=bool)
+        for s in range(nshards):
+            live[s * cap: s * cap + int(counts[s])] = True
+        pids = np.where(live, pids_host, nshards)  # dead rows sort last
+        # stable destination sort keeps source-shard row order within a
+        # destination (same order the collective's compaction produces)
+        order = np.argsort(pids, kind="stable")
+        order = order[: int(live.sum())]
+        dest = pids[order]
+        dest_counts = np.bincount(dest, minlength=nshards)[:nshards]
+        staged = [(np.ascontiguousarray(v[order]),
+                   np.ascontiguousarray(
+                       m[order] if m is not None
+                       else np.ones(order.shape[0], dtype=bool)))
+                  for v, m in cols_host]
+        staged, staged_bytes, raw_bytes = frame_roundtrip(staged)
+        overlap_metrics_for_session(session).record_staging(
+            staged_bytes, raw_bytes)
+        out_cap = out_capacity or bucket_capacity(
+            max(int(dest_counts.max()) if dest_counts.size else 1, 1),
+            minimum=8)
+        starts = np.concatenate([[0], np.cumsum(dest_counts)[:-1]])
+        out_cols = []
+        for v, m in staged:
+            vbuf = np.zeros(nshards * out_cap, dtype=v.dtype)
+            mbuf = np.zeros(nshards * out_cap, dtype=bool)
+            for d in range(nshards):
+                n = int(dest_counts[d])
+                sl = slice(int(starts[d]), int(starts[d]) + n)
+                vbuf[d * out_cap: d * out_cap + n] = v[sl]
+                mbuf[d * out_cap: d * out_cap + n] = m[sl]
+            out_cols.append((vbuf, mbuf))
+        return out_cols, dest_counts.astype(np.int32), staged_bytes
+
+
+def stage_host_side(flat, hist, key_idx, num_buckets: int, nshards: int,
+                    lut=None):
+    """Materialize one exchange side's device buffers on host, recompute
+    its partition ids with the bit-parity murmur mix, and repartition
+    through the frame codec — shared by the aggregate and join staging
+    paths so the host-side hashing/validity discipline cannot diverge.
+
+    ``flat``: [(values, validity-or-None)] device buffers; ``hist``:
+    the side's [src, dst] histogram (live rows per shard = row sums);
+    ``key_idx``: positions of the key columns in ``flat``; ``lut``
+    (bucket -> dst shard) maps hashed bucket ids when the caller
+    buckets first (aggregates), None hashes straight to shards.
+    Returns (staged cols, per-dest counts, compressed bytes)."""
+    host = []
+    for v, val in flat:
+        hv = np.asarray(v)
+        hm = np.asarray(val) if val is not None else \
+            np.ones(hv.shape[0], dtype=bool)
+        host.append((hv, hm))
+    counts = np.asarray(hist).sum(axis=1).astype(np.int64)
+    # hash parity with the device kernels: validity participates only
+    # where the trace saw one (None hashes as always-live)
+    keys = [(host[i][0], host[i][1] if flat[i][1] is not None else None)
+            for i in key_idx]
+    bids = host_hash_partition_ids(keys, num_buckets)
+    pids = bids if lut is None else np.asarray(lut, dtype=np.int32)[bids]
+    return host_staged_partition(host, counts, pids, nshards)

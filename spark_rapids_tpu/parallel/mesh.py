@@ -55,6 +55,39 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devices), (axis_name,))
 
 
+def axis_link_kind(mesh: Mesh, axis_name: Optional[str] = None) -> str:
+    """Link class of one mesh axis: ``"ici"`` when every device on the
+    axis lives in one process AND one pod slice (chip-to-chip
+    interconnect — all_to_all is cheap), ``"dcn"`` when the axis spans
+    processes or slices (data-center network — prefer fewer, larger
+    transfers: gather-then-redistribute).  The virtual CPU mesh used by
+    tests/dryruns is single-process single-slice, so it reads "ici"
+    and topology-auto keeps today's collective selection."""
+    axis_name = axis_name or mesh.axis_names[0]
+    try:
+        ax = mesh.axis_names.index(axis_name)
+    except ValueError:
+        return "ici"
+    # representative devices along this axis, other axes fixed at 0
+    idx = [0] * mesh.devices.ndim
+    devs = []
+    for i in range(mesh.devices.shape[ax]):
+        idx[ax] = i
+        devs.append(mesh.devices[tuple(idx)])
+    procs = {getattr(d, "process_index", 0) for d in devs}
+    slices = {getattr(d, "slice_index", 0) for d in devs}
+    return "dcn" if len(procs) > 1 or len(slices) > 1 else "ici"
+
+
+def topology(mesh: Mesh) -> dict:
+    """Topology metadata for planner/metrics consumption: per-axis link
+    kinds plus device count (docs/performance.md "Topology-aware
+    collective selection")."""
+    return {"devices": int(mesh.devices.size),
+            "axes": {name: axis_link_kind(mesh, name)
+                     for name in mesh.axis_names}}
+
+
 def shard_spec(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
 

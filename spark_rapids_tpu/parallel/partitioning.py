@@ -13,6 +13,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.ops.expressions import ColVal
 
@@ -70,6 +71,58 @@ def hash_partition_ids(key_cols: Sequence[ColVal], num_parts: int
                        ) -> jnp.ndarray:
     h = hash_columns(key_cols)
     return (h % jnp.uint32(num_parts)).astype(jnp.int32)
+
+
+# -- host-side parity port (numpy) ----------------------------------------
+# The host-RAM staging tier (parallel/exchange_async.py) repartitions
+# OFF-device, so its placement must be bit-identical to the device
+# kernels above.  The numpy port lives here, next to the jnp original,
+# so the two mixes cannot drift apart silently.
+
+def _np_mix32(h):
+    h = np.uint32(h)
+    h = (h ^ (h >> np.uint32(16))) * np.uint32(0x85EBCA6B)
+    h = (h ^ (h >> np.uint32(13))) * np.uint32(0xC2B2AE35)
+    return h ^ (h >> np.uint32(16))
+
+
+def _np_column_words(values: np.ndarray):
+    """numpy port of :func:`_column_words` — bit-identical (lo, hi)
+    words so host-staged placement matches the device collective's."""
+    v = values
+    if np.issubdtype(v.dtype, np.floating):
+        v = np.where(v == 0.0, 0.0, v).astype(np.float64)
+        v = np.where(np.isnan(v), np.float64(0.0), v)
+        top = v.astype(np.float32)
+        resid = ((v - top.astype(np.float64)).astype(np.float32)
+                 * np.float32(2.0) ** 29)
+        return top.view(np.uint32), resid.view(np.uint32)
+    if v.dtype == np.bool_:
+        return v.astype(np.uint32), np.zeros_like(v, dtype=np.uint32)
+    w = v.astype(np.int64)
+    lo = (w & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (w >> 32).astype(np.uint32)
+    return lo, hi
+
+
+def host_hash_partition_ids(key_cols, num_parts: int,
+                            seed: int = 42) -> np.ndarray:
+    """Host-side murmur-mix partition ids matching
+    :func:`hash_partition_ids` row for row (same mix, same null
+    sentinel).  ``key_cols``: [(values ndarray, validity ndarray|None)].
+    Parity is pinned by tests/test_shuffle_packed.py."""
+    acc = None
+    with np.errstate(over="ignore"):
+        for values, validity in key_cols:
+            lo, hi = _np_column_words(values)
+            h = _np_mix32(lo ^ np.uint32(seed))
+            h = _np_mix32(h * np.uint32(31)
+                          + _np_mix32(hi ^ np.uint32(seed)))
+            if validity is not None:
+                h = np.where(validity, h, np.uint32(0x9E3779B9))
+            acc = h if acc is None else _np_mix32(
+                acc * np.uint32(31) + h)
+    return (acc % np.uint32(num_parts)).astype(np.int32)
 
 
 def round_robin_partition_ids(capacity: int, num_parts: int,

@@ -84,6 +84,138 @@ def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:
     return min(s, capacity)
 
 
+class RaggedPlan:
+    """Skew-adaptive slot plan for one stats-sized exchange.
+
+    The base all_to_all is sized from the COLD (src, dst) slices; the
+    few hot slices' surplus rows (beyond ``base_slot``) ride
+    collective-permutes that transmit only on their own link — wire
+    rows stop scaling as ``num_parts * hottest_slice``.  ``pairs`` is
+    the static hot set; rounds decompose it into partial permutations
+    (each src/dst at most once per round, the ppermute contract).
+    Hashable: a plan is part of the consumer's jit-cache signature.
+    """
+
+    def __init__(self, num_parts: int, base_slot: int, surplus_slot: int,
+                 pairs):
+        import numpy as np
+        self.num_parts = num_parts
+        self.base_slot = int(base_slot)
+        self.surplus_slot = int(surplus_slot)
+        self.pairs = tuple(sorted(tuple(map(int, p)) for p in pairs))
+        # greedy round decomposition into partial permutations
+        remaining = list(self.pairs)
+        rounds = []
+        while remaining:
+            used_s, used_d, rnd = set(), set(), []
+            for p in list(remaining):
+                s, d = p
+                if s not in used_s and d not in used_d:
+                    rnd.append(p)
+                    used_s.add(s)
+                    used_d.add(d)
+                    remaining.remove(p)
+            rounds.append(tuple(rnd))
+        self.rounds = tuple(rounds)
+        # static lookup tables the SPMD trace indexes by axis_index
+        n = num_parts
+        self.round_dst_by_src = np.zeros((len(rounds), n), dtype=np.int32)
+        self.round_for_src = np.zeros((n, n), dtype=np.int32)
+        self.limits = np.full((n, n), self.base_slot, dtype=np.int32)
+        pairs_per_dest = np.zeros(n, dtype=np.int64)
+        for r, rnd in enumerate(self.rounds):
+            for s, d in rnd:
+                self.round_dst_by_src[r, s] = d
+                self.round_for_src[d, s] = r
+                self.limits[s, d] = self.base_slot + self.surplus_slot
+                pairs_per_dest[d] += 1
+        self.max_pairs_per_dest = int(pairs_per_dest.max()) if n else 0
+
+    @property
+    def out_capacity(self) -> int:
+        """Static receive capacity every shard allocates: the base
+        slices plus the worst destination's surplus buffers."""
+        return self.num_parts * self.base_slot + \
+            self.max_pairs_per_dest * self.surplus_slot
+
+    def wire_rows(self, nshards: int) -> int:
+        """Exact wire rows one launch moves: every shard transmits the
+        full base payload; each surplus pair transmits once (a
+        collective-permute only moves the named link)."""
+        return nshards * self.num_parts * self.base_slot + \
+            len(self.pairs) * self.surplus_slot
+
+    def cache_key(self):
+        return ("ragged", self.num_parts, self.base_slot,
+                self.surplus_slot, self.pairs)
+
+    def __repr__(self):
+        return (f"RaggedPlan(base={self.base_slot}, "
+                f"surplus={self.surplus_slot}x{len(self.pairs)}, "
+                f"rounds={len(self.rounds)})")
+
+
+def plan_ragged(counts, capacity: int, min_savings: float = 1.5,
+                max_pairs: Optional[int] = None) -> Optional[RaggedPlan]:
+    """Ragged plan from a materialized [src, dst] histogram, or None
+    when the uniform slot wins (no skew, too many hot pairs, or the
+    wire-rows saving is below ``min_savings``)."""
+    import numpy as np
+    counts = np.asarray(counts)
+    if counts.ndim != 2 or not counts.size:
+        return None
+    n_src, n_dst = counts.shape
+    max_pairs = max_pairs if max_pairs is not None else 2 * n_dst
+    u_slot = pick_slot(int(counts.max()), capacity)
+    uniform_rows = n_src * n_dst * u_slot
+    best = None
+    best_rows = uniform_rows
+    base = 8
+    while base < u_slot:
+        pairs = np.argwhere(counts > base)
+        if 0 < len(pairs) <= max_pairs:
+            surplus = pick_slot(int((counts - base).max()), capacity)
+            rows = n_src * n_dst * base + len(pairs) * surplus
+            if rows < best_rows:
+                best_rows = rows
+                best = (base, surplus, [tuple(p) for p in pairs])
+        base <<= 1
+    if best is None or uniform_rows / max(best_rows, 1) < min_savings:
+        return None
+    return RaggedPlan(n_dst, best[0], best[1], best[2])
+
+
+def ragged_enabled(conf=None) -> Tuple[bool, float]:
+    """(enabled, minSavings) for skew-adaptive ragged slot planning."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        if s is None:
+            return (rc.SHUFFLE_SLOT_RAGGED_ENABLED.default,
+                    rc.SHUFFLE_SLOT_RAGGED_FACTOR.default)
+        conf = s.conf
+    return (conf.get(rc.SHUFFLE_SLOT_RAGGED_ENABLED),
+            conf.get(rc.SHUFFLE_SLOT_RAGGED_FACTOR))
+
+
+def topology_strategy(mesh, conf=None) -> str:
+    """Collective strategy for the mesh's exchange axis: the conf knob,
+    with 'auto' resolving by link kind (all_to_all on ICI, gather-then-
+    redistribute on a DCN-spanning axis) — parallel/mesh.py topology."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        conf = s.conf if s is not None else None
+    strategy = conf.get(rc.SHUFFLE_TOPOLOGY_STRATEGY) if conf is not None \
+        else rc.SHUFFLE_TOPOLOGY_STRATEGY.default
+    if strategy != "auto":
+        return strategy
+    from spark_rapids_tpu.parallel.mesh import axis_link_kind
+    return "gather" if axis_link_kind(mesh) == "dcn" else "all_to_all"
+
+
 def packed_enabled(conf=None) -> bool:
     """Resolve spark.rapids.tpu.shuffle.packed.enabled: explicit conf >
     active session > entry default.  Exchange consumers resolve this at
@@ -149,12 +281,13 @@ class _Unpackable(Exception):
     """A column the lane packer cannot transport (non-fixed-width)."""
 
 
-# site -> trace-time lane report ({"collectives", "row_bytes"}): the
-# EXACT wire cost of the program a consumer site compiled, recorded by
-# the exchange body itself (it alone sees runtime dtypes/nullability).
-# Keyed by the consumer's jit signature, so it persists across consumer
-# reconstruction exactly as long as the compiled program does; metrics
-# fall back to the conservative estimate only before first trace.
+# site -> trace-time lane report ({"collectives", "row_bytes",
+# "row_bytes32", "row_bytes8"}): the EXACT wire cost of the program a
+# consumer site compiled, recorded by the exchange body itself (it
+# alone sees runtime dtypes/nullability).  Keyed by the consumer's jit
+# signature, so it persists across consumer reconstruction exactly as
+# long as the compiled program does; metrics fall back to the
+# conservative estimate only before first trace.
 _WIRE_REPORTS: Dict[Hashable, dict] = {}
 
 
@@ -162,14 +295,27 @@ def wire_report(site) -> Optional[dict]:
     return _WIRE_REPORTS.get(site)
 
 
-def _record_wire_report(site, cols, plan) -> None:
+def _ragged_site(site, rp: "RaggedPlan"):
+    """Report key for the RAGGED variant of an exchange site: the same
+    consumer site compiles distinct uniform/ragged programs (different
+    collectives, same jit-sig prefix), so their trace-time reports must
+    not overwrite each other.  Derived identically by the exchange body
+    (write) and record_exchange_metrics (read)."""
+    return None if site is None else (site, "ragged", rp.cache_key())
+
+
+def _record_wire_report(site, cols, plan, surplus_rounds: int = 0,
+                        fallback: bool = False) -> None:
     import numpy as np
     if site is None:
         return
     nullable = sum(1 for c in cols if c.validity is not None)
     if plan is not None:
-        collectives = 1 + plan.collectives
+        # a ragged plan adds one collective-permute per surplus round
+        # per width group on top of the base all_to_alls
+        collectives = 1 + plan.collectives * (1 + surplus_rounds)
         row_bytes = 4 * plan.n32 + plan.n8
+        rb32, rb8 = 4 * plan.n32, plan.n8
     else:
         # per-column wire: one collective per column + mask; validity
         # rides as full bool lanes (1 byte/row), not bit-packed
@@ -177,8 +323,11 @@ def _record_wire_report(site, cols, plan) -> None:
         row_bytes = sum(
             max(np.dtype(c.values.dtype).itemsize, 1) for c in cols) \
             + nullable
+        rb32, rb8 = 0, 0
     _WIRE_REPORTS[site] = {"collectives": collectives,
-                           "row_bytes": row_bytes}
+                           "row_bytes": row_bytes,
+                           "row_bytes32": rb32, "row_bytes8": rb8,
+                           "fallback": fallback}
 
 
 def _plan_pack(cols: Sequence[ColVal]) -> Optional[_PackPlan]:
@@ -287,7 +436,8 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
              slot: Optional[int] = None,
              packed: Optional[bool] = None,
              with_overflow: bool = False,
-             report_site=None):
+             report_site=None,
+             ragged: Optional[RaggedPlan] = None):
     """All-to-all exchange inside shard_map.
 
     Every shard sends row r to shard ``pids[r]``.  Returns (received
@@ -322,6 +472,27 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         counts.reshape(num_parts, 1), axis_name, split_axis=0,
         concat_axis=0).reshape(num_parts)
 
+    plan = _plan_pack(sorted_cols) if packed else None
+    if ragged is not None and plan is not None:
+        # skew-adaptive ragged wire (needs the lane-packed format; an
+        # unpackable column set falls through to the uniform slot the
+        # caller also passed — trace-time consistent either way)
+        _record_wire_report(_ragged_site(report_site, ragged),
+                            sorted_cols, plan,
+                            surplus_rounds=len(ragged.rounds))
+        return _exchange_ragged(sorted_cols, plan, counts, recv_counts,
+                                starts, capacity, axis_name, num_parts,
+                                ragged, with_overflow)
+    if ragged is not None:
+        # ragged was requested but the lane packer refused the columns:
+        # this program runs the uniform per-column wire at the caller's
+        # fallback slot.  Mark the RAGGED report key at trace time so
+        # consumer accounting bills the program that actually moved
+        # bytes (the plain-site report may belong to a different
+        # variant compiled at the same signature).
+        _record_wire_report(_ragged_site(report_site, ragged),
+                            sorted_cols, None, fallback=True)
+
     # gather each destination's rows into its padded slot: send[d, j]
     j = jnp.arange(slot, dtype=jnp.int32)[None, :]
     src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
@@ -332,7 +503,6 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     part, offset, in_range = _compaction_indices(
         recv_counts, total, num_parts, slot)
 
-    plan = _plan_pack(sorted_cols) if packed else None
     _record_wire_report(report_site, sorted_cols, plan)
     if packed and plan is None and cols:
         # trace-time breadcrumb: the fused wire was requested but these
@@ -371,6 +541,113 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     if with_overflow:
         return out_cols, total, jnp.any(counts > slot)
     return out_cols, total
+
+
+def _exchange_ragged(sorted_cols, plan, counts, recv_counts, starts,
+                     capacity, axis_name: str, num_parts: int,
+                     rp: RaggedPlan, with_overflow: bool):
+    """Ragged exchange body: base all_to_all at the cold slot plus one
+    collective-permute round per partial permutation of hot pairs.
+    Every shard traces the same program (SPMD); per-shard differences
+    ride static tables indexed by ``axis_index``.  A slice exceeding
+    its static limit (base + surplus for hot pairs, base for cold)
+    raises the overflow flag — the caller's full-capacity re-run rung,
+    rows are never dropped."""
+    base, sur = rp.base_slot, rp.surplus_slot
+    me = jax.lax.axis_index(axis_name)
+    cap_out = rp.out_capacity
+
+    total = recv_counts.sum()
+    recv_starts = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32),
+         jnp.cumsum(recv_counts)[:-1].astype(jnp.int32)])
+    pos = jnp.arange(cap_out, dtype=jnp.int32)
+    part = jnp.searchsorted(recv_starts, pos, side="right") - 1
+    part = jnp.clip(part, 0, num_parts - 1)
+    offset = jnp.clip(pos - recv_starts[part], 0, base + sur - 1)
+    in_range = pos < total
+
+    # base payloads: the uniform wire at the COLD slot
+    j = jnp.arange(base, dtype=jnp.int32)[None, :]
+    src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
+    p32, p8 = _pack_payloads(sorted_cols, plan, sel=src)
+    r32 = jax.lax.all_to_all(p32, axis_name, split_axis=0,
+                             concat_axis=0) if p32 is not None else None
+    r8 = jax.lax.all_to_all(p8, axis_name, split_axis=0,
+                            concat_axis=0) if p8 is not None else None
+
+    # surplus rounds: each round is a partial permutation; a shard not
+    # in the round still traces the (garbage) buffer but the
+    # collective-permute transmits only the named links
+    s32_rounds, s8_rounds = [], []
+    jj = jnp.arange(sur, dtype=jnp.int32)
+    for r, rnd in enumerate(rp.rounds):
+        my_dst = jnp.asarray(rp.round_dst_by_src[r])[me]
+        sel = jnp.clip(starts[my_dst] + base + jj, 0, capacity - 1)
+        q32, q8 = _pack_payloads(sorted_cols, plan, sel=sel)
+        perm = [tuple(p) for p in rnd]
+        if q32 is not None:
+            s32_rounds.append(jax.lax.ppermute(q32, axis_name, perm=perm))
+        if q8 is not None:
+            s8_rounds.append(jax.lax.ppermute(q8, axis_name, perm=perm))
+
+    # receive: offset < base reads the all_to_all slice; beyond it, the
+    # surplus buffer of the (src -> me) pair via the static round table
+    my_rounds = jnp.asarray(rp.round_for_src)[me]     # [n_src]
+    sur_round = my_rounds[part]
+    so = jnp.clip(offset - base, 0, sur - 1)
+
+    def combine(rbase, rounds_list):
+        if rbase is None:
+            return None
+        if rounds_list:
+            stacked = jnp.stack(rounds_list)          # [rounds, sur, l]
+        else:
+            stacked = jnp.zeros((1, sur) + rbase.shape[2:], rbase.dtype)
+        base_v = rbase[part, jnp.clip(offset, 0, base - 1)]
+        sur_v = stacked[sur_round, so]
+        pick = (offset < base)
+        return jnp.where(pick[:, None], base_v, sur_v)
+
+    flat32 = combine(r32, s32_rounds)
+    flat8 = combine(r8, s8_rounds)
+    out_cols = _unpack_payloads(sorted_cols, plan, flat32, flat8,
+                                in_range)
+    if with_overflow:
+        limits = jnp.asarray(rp.limits)[me]           # [n_dst]
+        return out_cols, total, jnp.any(counts > limits)
+    return out_cols, total
+
+
+def exchange_via_gather(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
+                        axis_name: str, num_parts: int,
+                        packed: Optional[bool] = None,
+                        with_overflow: bool = False,
+                        report_site=None):
+    """Gather-then-redistribute exchange: ONE all_gather per width
+    group (rows + their destination ids), then every shard compacts its
+    own rows locally — no all_to_all on the wire.  Fewer, larger
+    transfers: the DCN-friendly strategy topology-auto picks for axes
+    spanning hosts/slices ("Theseus" data-movement shape; see
+    docs/performance.md "Topology-aware collective selection").  Slot
+    planning does not apply (the gather moves full capacity), so the
+    overflow flag is constant-false."""
+    from spark_rapids_tpu.columnar import dtypes as dts
+    from spark_rapids_tpu.ops import selection
+    pid_col = ColVal(dts.INT32, pids.astype(jnp.int32))
+    gathered, total = all_gather_cols(
+        list(cols) + [pid_col], nrows, axis_name, num_parts,
+        packed=packed, report_site=report_site)
+    out_pids = gathered[-1].values
+    me = jax.lax.axis_index(axis_name)
+    cap = out_pids.shape[0]
+    keep = jnp.logical_and(out_pids == me,
+                           jnp.arange(cap, dtype=jnp.int32) < total)
+    out_cols, n_mine = selection.compact(list(gathered[:-1]), keep)
+    n_mine = n_mine.astype(jnp.int32)
+    if with_overflow:
+        return out_cols, n_mine, jnp.zeros((), dtype=jnp.bool_)
+    return out_cols, n_mine
 
 
 def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
@@ -553,11 +830,17 @@ class ShuffleWireMetrics:
     dict → eventlog ``QueryInfo.shuffle`` → profiling health checks."""
 
     FIELDS = ("exchanges", "collectives", "rowsMoved", "rowsUseful",
-              "bytesMoved", "slotOverflowRetries", "perColumnFallbacks")
+              "bytesMoved", "slotOverflowRetries", "perColumnFallbacks",
+              "raggedExchanges")
 
     def __init__(self):
         self._lock = threading.Lock()
         self.counters = {k: 0 for k in self.FIELDS}
+        # per-width-group and per-destination breakdowns (padding is a
+        # property of a destination's slot, not of the exchange as a
+        # whole — one hot destination must not hide behind the mean)
+        self.per_group: Dict[str, Dict[str, int]] = {}
+        self.per_dest: Dict[str, Dict[str, int]] = {}
         # payload bytes of the most recently recorded exchange — the
         # launch whose lane buffers are still resident, which is what
         # the transient_wire_bytes HBM reservation should reflect (a
@@ -567,7 +850,9 @@ class ShuffleWireMetrics:
 
     def record_exchange(self, collectives: int, rows_moved: int,
                         rows_useful: int, bytes_moved: int,
-                        packed: bool = True) -> None:
+                        packed: bool = True, ragged: bool = False,
+                        group_bytes: Optional[Dict[str, int]] = None,
+                        per_dest=None) -> None:
         with self._lock:
             c = self.counters
             c["exchanges"] += 1
@@ -575,9 +860,21 @@ class ShuffleWireMetrics:
             c["rowsMoved"] += int(rows_moved)
             c["rowsUseful"] += int(rows_useful)
             c["bytesMoved"] += int(bytes_moved)
+            if ragged:
+                c["raggedExchanges"] += 1
             self.last_exchange_bytes = int(bytes_moved)
             if not packed:
                 c["perColumnFallbacks"] += 1
+            for g, b in (group_bytes or {}).items():
+                e = self.per_group.setdefault(
+                    g, {"bytesMoved": 0, "rowsMoved": 0})
+                e["bytesMoved"] += int(b)
+                e["rowsMoved"] += int(rows_moved)
+            for d, (wire, useful) in (per_dest or {}).items():
+                e = self.per_dest.setdefault(
+                    str(d), {"rowsMoved": 0, "rowsUseful": 0})
+                e["rowsMoved"] += int(wire)
+                e["rowsUseful"] += int(useful)
 
     def record_overflow(self) -> None:
         with self._lock:
@@ -592,21 +889,47 @@ class ShuffleWireMetrics:
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self.counters)
+            out = dict(self.counters)
+            out["perGroup"] = {g: dict(v)
+                               for g, v in self.per_group.items()}
+            out["perDestination"] = {d: dict(v)
+                                     for d, v in self.per_dest.items()}
+            return out
 
     @staticmethod
     def delta(after: Dict[str, int], before: Dict[str, int]
               ) -> Dict[str, int]:
-        return {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        out = {}
+        for k, v in after.items():
+            if isinstance(v, dict):
+                b = before.get(k, {}) or {}
+                out[k] = {
+                    sub: {f: sv.get(f, 0) - b.get(sub, {}).get(f, 0)
+                          for f in sv}
+                    for sub, sv in v.items()}
+            else:
+                out[k] = v - before.get(k, 0)
+        return out
 
     @staticmethod
     def summarize(d: Dict[str, int]) -> Dict[str, float]:
-        """Attach the derived padding ratio (wire rows / useful rows —
+        """Attach the derived padding ratios (wire rows / useful rows —
         1.0 is a perfectly dense exchange, ``num_parts`` is
-        full-capacity padding)."""
+        full-capacity padding): the aggregate, plus the per-width-group
+        and per-destination breakdowns when recorded."""
         out = dict(d)
         out["paddingRatio"] = round(
             d.get("rowsMoved", 0) / max(d.get("rowsUseful", 0), 1), 3)
+        pd_ = d.get("perDestination") or {}
+        if pd_:
+            out["paddingRatioPerDestination"] = {
+                k: round(v.get("rowsMoved", 0)
+                         / max(v.get("rowsUseful", 0), 1), 3)
+                for k, v in sorted(pd_.items(), key=lambda kv: int(kv[0]))}
+        pg = d.get("perGroup") or {}
+        if pg:
+            out["perGroupBytes"] = {g: v.get("bytesMoved", 0)
+                                    for g, v in sorted(pg.items())}
         return out
 
 
@@ -654,25 +977,74 @@ def record_exchange_metrics(metrics: ShuffleWireMetrics, *, dtypes,
                             slot: int, num_parts: int, nshards: int,
                             rows_useful: int, packed: bool,
                             nullable: Optional[int] = None,
-                            site=None, exchanges: int = 1) -> None:
+                            site=None, exchanges: int = 1,
+                            ragged: Optional[RaggedPlan] = None,
+                            counts=None) -> None:
     """One consumer-side accounting call per exchange launch: wire rows
-    are the padded slots every shard puts on ICI; useful rows come from
-    the site's histogram (or the planner's last observation on
-    speculative launches).  When the site's compiled program recorded
-    its trace-time lane report (``report_site`` on the exchange), the
-    EXACT collective count and row bytes are used; the all-nullable
-    static estimate only covers launches before first trace."""
-    rows_moved = nshards * num_parts * slot * exchanges
-    rep = wire_report(site)
+    are the padded slots every shard puts on ICI (for a ragged plan,
+    the base slots plus each surplus pair's one transmitted buffer);
+    useful rows come from the site's histogram (or the planner's last
+    observation on speculative launches).  When the site's compiled
+    program recorded its trace-time lane report (``report_site`` on the
+    exchange), the EXACT collective count and row bytes are used; the
+    all-nullable static estimate only covers launches before first
+    trace.  ``counts`` (the [src, dst] histogram, when materialized)
+    feeds the per-destination padding breakdown."""
+    import numpy as np
+    rep = None
+    if ragged is not None:
+        rep = wire_report(_ragged_site(site, ragged))
+        if rep is not None and rep.get("fallback"):
+            # the compiled program fell back to the uniform wire (the
+            # lane packer refused the columns — exchange() takes the
+            # ragged branch only when packing succeeds): account the
+            # program that actually moved bytes.  The exchange body
+            # marks the RAGGED report key ``fallback`` at trace time;
+            # that breadcrumb is the ONLY valid evidence — the plain
+            # -site report may belong to a different variant compiled
+            # at the same signature (e.g. a uniform-slot session), and
+            # accounting runs before the launch, so a first launch
+            # trusts the caller's plan until the program traces.
+            # Callers that sized the program from the plan pass slot=0;
+            # the fallback program ran at the plan's base+surplus
+            # upper bound.
+            slot = slot or (ragged.base_slot + ragged.surplus_slot)
+            ragged = None
+    if ragged is not None:
+        rows_moved = ragged.wire_rows(nshards) * exchanges
+    else:
+        rows_moved = nshards * num_parts * slot * exchanges
+        if rep is None:
+            rep = wire_report(site)
     if rep is not None:
         collectives = rep["collectives"]
         row_bytes = rep["row_bytes"]
+        rb32, rb8 = rep.get("row_bytes32", 0), rep.get("row_bytes8", 0)
     else:
         collectives = estimate_collectives(dtypes, packed, nullable)
         row_bytes = wire_row_bytes(dtypes, nullable)
+        rb32 = rb8 = 0
+    if rb32 or rb8:
+        group_bytes = {g: rows_moved * rb
+                       for g, rb in (("u32", rb32), ("u8", rb8)) if rb}
+    else:
+        group_bytes = {"percol": rows_moved * row_bytes}
+    per_dest = None
+    if counts is not None:
+        counts = np.asarray(counts)
+        per_dest = {}
+        for d in range(counts.shape[1]):
+            if ragged is not None:
+                pairs_to_d = sum(1 for _, dd in ragged.pairs if dd == d)
+                wire = (nshards * ragged.base_slot
+                        + pairs_to_d * ragged.surplus_slot) * exchanges
+            else:
+                wire = nshards * slot * exchanges
+            per_dest[d] = (wire, int(counts[:, d].sum()) * exchanges)
     metrics.record_exchange(
         collectives=collectives * exchanges,
         rows_moved=rows_moved,
         rows_useful=int(rows_useful),
         bytes_moved=rows_moved * row_bytes,
-        packed=packed)
+        packed=packed, ragged=ragged is not None,
+        group_bytes=group_bytes, per_dest=per_dest)

@@ -160,6 +160,23 @@ class ShuffleSlotOverflow(Exception):
         self.capacity = capacity
 
 
+class AsyncExchangeOverflow(ShuffleSlotOverflow):
+    """A DEFERRED slot verification (async exchange window,
+    parallel/exchange_async.py) found the speculative slot too small
+    AFTER downstream compute already consumed the truncated frame — the
+    local full-capacity re-run is no longer enough, the whole attempt
+    must re-drive.  RETRYABLE, not degradable: the slot planner latched
+    the site off speculation when the flag came back, and the planner
+    runs recovery re-attempts on the synchronous stats-sized path, so
+    the re-driven attempt is NOT identical re-execution and succeeds on
+    the mesh."""
+
+    severity = RETRYABLE
+
+    def __init__(self, site: str, slot: int, capacity: int):
+        super().__init__(site, slot, capacity)
+
+
 class AdmissionFault(Exception):
     """The serving layer rejected this query at (or after) admission:
     the fair admission queue timed out / overflowed, or the query blew

@@ -57,6 +57,13 @@ _POINTS: Dict[str, Optional[Type[BaseException]]] = {
     "spill.corrupt.host": F.InjectedSpillFault,
     "spill.corrupt.disk": F.InjectedSpillFault,
     "udf.worker": F.InjectedWorkerFault,
+    # async exchange path (parallel/exchange_async.py): the deferred
+    # resolve-time verification of an in-flight exchange, and the
+    # host-RAM staging round trip for oversized payloads.  Both
+    # retryable shuffle faults: the ladder re-drives and the planner
+    # degrades to the synchronous path on recovery re-attempts
+    "exchange.async.resolve": F.InjectedShuffleFault,
+    "exchange.host_staging": F.InjectedShuffleFault,
     # persistent jit-cache load (ops/jit_cache.py): raise/delay rules
     # simulate unreadable entries, corrupt rules flip payload bits at
     # the fire_mutate site so the CRC gate has rot to catch — every

@@ -114,6 +114,13 @@ class QueryContext:
         self.checkpoints = None       # per-query CheckpointManager
         self.budget_events: list = []  # BudgetExhausted facts emitted
         self._budget_spilled = False   # memory ladder: spill fired once
+        # unresolved async-exchange payload bytes charged to this query
+        # (parallel/exchange_async.ExchangeWindow): in-flight exchange
+        # buffers are real HBM the memory budget must see, tracked here
+        # so QueryEnd can attribute the high-water mark per query
+        self.exchange_inflight = 0
+        self.exchange_inflight_peak = 0
+        self._exchange_budget_noted = False
         self._lock = threading.Lock()
 
     # --------------------------------------------------------------- scope --
@@ -185,10 +192,14 @@ class QueryContext:
 
     def admission_info(self) -> dict:
         """QueryEnd payload: what admission cost this query."""
-        if not self.admission_weight and not self.admission_wait_ms:
+        if not self.admission_weight and not self.admission_wait_ms \
+                and not self.exchange_inflight_peak:
             return {}
-        return {"waitMs": round(self.admission_wait_ms, 3),
+        info = {"waitMs": round(self.admission_wait_ms, 3),
                 "weightBytes": self.admission_weight}
+        if self.exchange_inflight_peak:
+            info["exchangeInflightPeak"] = self.exchange_inflight_peak
+        return info
 
     # ------------------------------------------------------------- budgets --
     def set_qid(self, qid: Optional[int]) -> None:
@@ -211,6 +222,27 @@ class QueryContext:
             from spark_rapids_tpu.robustness.faults import (
                 BudgetExhaustedFault)
             raise BudgetExhaustedFault("syncs", used, limit)
+
+    def charge_exchange_inflight(self, delta: int) -> None:
+        """Account unresolved exchange payload bytes against this
+        query.  Exceeding the memory budget is NOT a rejection — the
+        in-flight window resolves oldest-first and the staging tier
+        routes oversized payloads through host RAM — but the overrun
+        is recorded once as a budget fact so the QueryEnd trail
+        explains why staging/eviction engaged."""
+        with self._lock:
+            self.exchange_inflight = max(
+                0, self.exchange_inflight + int(delta))
+            self.exchange_inflight_peak = max(
+                self.exchange_inflight_peak, self.exchange_inflight)
+            over = (self.memory_budget
+                    and self.exchange_inflight > self.memory_budget
+                    and not self._exchange_budget_noted)
+            if over:
+                self._exchange_budget_noted = True
+        if over:
+            self._emit_budget("exchangeInflight", self.exchange_inflight,
+                              self.memory_budget, action="stage")
 
     def note_memory_pressure(self, used: int, spilled: bool) -> None:
         """Memory budget ladder, called by the spill catalog: the

@@ -100,6 +100,7 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
     exchange; numShards is full-capacity padding)."""
     exchanged, exch, coll, moved, useful, bytes_, ovf, fb = \
         0, 0, 0, 0, 0, 0, 0, 0
+    overlap_ms, wall_ms, async_n, ragged_n, staged_b = 0.0, 0.0, 0, 0, 0
     for a in apps:
         for q in a.queries:
             s = q.shuffle
@@ -113,6 +114,11 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
             bytes_ += s.get("bytesMoved", 0)
             ovf += s.get("slotOverflowRetries", 0)
             fb += s.get("perColumnFallbacks", 0)
+            overlap_ms += s.get("exchangeOverlapMs", 0.0)
+            wall_ms += s.get("exchangeWallMs", 0.0)
+            async_n += s.get("asyncExchanges", 0)
+            ragged_n += s.get("raggedExchanges", 0)
+            staged_b += s.get("hostStagedBytes", 0)
     if not exchanged:
         return {}
     return {
@@ -123,6 +129,17 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
         "padding_ratio": moved / max(useful, 1),
         "slot_overflow_retries": ovf,
         "per_column_fallbacks": fb,
+        # async exchange/compute overlap (parallel/exchange_async.py):
+        # overlap_fraction is the headline — how much of the exchange
+        # tail the host spent dispatching downstream work instead of
+        # blocking on verification
+        "exchange_overlap_ms": round(overlap_ms, 3),
+        "exchange_wall_ms": round(wall_ms, 3),
+        "overlap_fraction": round(overlap_ms / wall_ms, 3)
+        if wall_ms else 0.0,
+        "async_exchanges": async_n,
+        "ragged_exchanges": ragged_n,
+        "host_staged_bytes": staged_b,
     }
 
 
@@ -731,6 +748,15 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"padding={sw['padding_ratio']:.2f}x "
             f"overflowRetries={sw['slot_overflow_retries']} "
             f"perColumnFallbacks={sw['per_column_fallbacks']}")
+        if sw.get("async_exchanges") or sw.get("host_staged_bytes") \
+                or sw.get("ragged_exchanges"):
+            out.append(
+                f"  exchange overlap={sw['exchange_overlap_ms']:.1f}ms"
+                f"/{sw['exchange_wall_ms']:.1f}ms "
+                f"({sw['overlap_fraction']:.0%}) "
+                f"async={sw['async_exchanges']} "
+                f"ragged={sw['ragged_exchanges']} "
+                f"hostStaged={sw['host_staged_bytes']}B")
     fu = fusion_stats(apps)
     if fu:
         out.append("\n-- Whole-stage fusion & compile cache --")

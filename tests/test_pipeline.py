@@ -375,3 +375,174 @@ def test_pipeline_shuffle_fault_demotes_into_pipeline():
     assert s.last_dist_explain.startswith("demoted")
     # the recovered (single-process) attempt ran pipelined
     assert s.last_pipeline_stats is not None
+
+
+# ------------------------------------------- async exchange overlap --
+
+from spark_rapids_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+ASYNC_ON = {"spark.rapids.tpu.exchange.async.enabled": True,
+            "spark.rapids.sql.join.broadcastThresholdRows": 1,
+            "spark.rapids.sql.recovery.backoffMs": 1}
+
+
+def _skew_join_q(session, fact, dim):
+    return (session.create_dataframe(fact)
+            .join(session.create_dataframe(dim), on="k")
+            .group_by("k").agg(F.sum(F.col("v")).alias("sv"),
+                               F.sum(F.col("w")).alias("sw"))
+            .to_pandas().sort_values("k", ignore_index=True))
+
+
+@pytest.fixture(scope="module")
+def join_frames():
+    rng = np.random.default_rng(31)
+    fact = pd.DataFrame({"k": rng.integers(0, 200, 4000).astype(np.int64),
+                         "v": rng.normal(size=4000)})
+    dim = pd.DataFrame({"k": np.arange(200, dtype=np.int64),
+                        "w": rng.normal(size=200)})
+    return fact, dim
+
+
+def test_async_exchange_overlap_clean(join_frames):
+    """Exchange-bearing launches admit handles instead of blocking:
+    overlap >= 50% of exchange wall-clock, results exact, and the
+    per-query QueryEnd shuffle dict carries the overlap metrics."""
+    fact, dim = join_frames
+    session = TpuSession(dict(ASYNC_ON), mesh=make_mesh(8))
+    oracle = TpuSession()
+    try:
+        got = _skew_join_q(session, fact, dim)
+        assert session.last_dist_explain == "distributed"
+        pd.testing.assert_frame_equal(got, _skew_join_q(oracle, fact,
+                                                        dim))
+        ov = session.exchange_overlap_metrics.snapshot()
+        assert ov["asyncExchanges"] >= 2, ov  # join launch + aggregate
+        assert ov["exchangeOverlapMs"] > 0, ov
+        assert ov["exchangeOverlapMs"] >= 0.5 * ov["exchangeWallMs"], ov
+        # the per-query trail exposes the same numbers
+        sh = session.last_shuffle_stats
+        assert sh and sh["asyncExchanges"] >= 2, sh
+        assert sh["exchangeOverlapMs"] > 0, sh
+    finally:
+        session.stop()
+        oracle.stop()
+
+
+def test_async_window_budget_resolves_oldest():
+    """A 1-byte in-flight window cannot hold two handles: admitting the
+    second resolves the first (FIFO backpressure), counted as a window
+    eviction — in-flight HBM stays bounded."""
+    from spark_rapids_tpu.parallel.exchange_async import (
+        ExchangeOverlapMetrics, ExchangeWindow)
+    m = ExchangeOverlapMetrics()
+    win = ExchangeWindow(max_bytes=1, metrics=m)
+    resolved = []
+    h1 = win.admit("site1", 1024, verify=lambda: resolved.append(1))
+    assert win.inflight_bytes == 1024
+    h2 = win.admit("site2", 2048, verify=lambda: resolved.append(2))
+    assert resolved == [1] and h1.resolved and not h2.resolved
+    win.resolve_all()
+    assert resolved == [1, 2]
+    assert win.inflight_bytes == 0 and not win.pending
+    snap = m.snapshot()
+    assert snap["windowEvictions"] == 1
+    assert snap["asyncExchanges"] == 2
+    assert snap["inflightPeakBytes"] >= 2048
+
+
+def test_async_deferred_overflow_rediscovers_sync(join_frames):
+    """The one async-specific failure mode: a SPECULATIVE slot
+    overflows and the deferred verification only sees the flag after
+    downstream compute consumed the truncated frame.  The resolve
+    raises a RETRYABLE AsyncExchangeOverflow, the ladder re-drives on
+    the synchronous stats-sized path (the planner latched the site off
+    speculation), and the answer is exact — rows are never dropped."""
+    from spark_rapids_tpu.parallel.shuffle import planner_for_session
+    session = TpuSession(dict(ASYNC_ON), mesh=make_mesh(8))
+    oracle = TpuSession()
+    try:
+        rng = np.random.default_rng(37)
+
+        def frame(skew):
+            n = 4000
+            if skew:
+                # CAP distinct keys all landing in few buckets: the
+                # stale warm LUT funnels them through slices far past
+                # the EMA slot
+                k = (rng.integers(0, 64, n) * 32).astype(np.int64)
+            else:
+                k = rng.integers(0, 64, n).astype(np.int64)
+            return pd.DataFrame({"k": k, "v": rng.normal(size=n)})
+
+        def q(s, pdf):
+            return (s.create_dataframe(pdf).group_by("k")
+                    .agg(F.sum(F.col("v")).alias("sv"),
+                         F.count(F.col("v")).alias("c"))
+                    .to_pandas().sort_values("k", ignore_index=True))
+
+        warm = frame(skew=False)
+        q(session, warm)          # launch 1: stats-sized, warms site
+        q(session, warm)          # launch 2: speculative, fits
+        skewed = frame(skew=True)
+        got = q(session, skewed)  # launch 3: speculative overflow,
+        #                           deferred -> retry -> sync re-drive
+        pd.testing.assert_frame_equal(got, q(oracle, skewed))
+        ov = session.exchange_overlap_metrics.snapshot()
+        assert ov["deferredOverflows"] >= 1, ov
+        # the ladder absorbed it as a retry (never a wrong answer)...
+        faults = [r["fault"] for r in session.recovery_log]
+        assert "shuffle_slot" in faults, session.recovery_log
+        actions = [r["action"] for r in session.recovery_log]
+        assert "shuffle-slot-async-replan" in actions, actions
+        # ...and the re-driven attempt ran its exchange synchronously
+        assert ov["syncExchanges"] >= 1, ov
+    finally:
+        session.stop()
+        oracle.stop()
+
+
+@pytest.mark.chaos
+def test_async_exchange_fault_degrades_to_sync(join_frames):
+    """A fault injected at the mid-flight resolve point degrades
+    cleanly: the recovery ladder re-drives the query on the SYNCHRONOUS
+    path (async is off on resume attempts) and the answer matches the
+    clean run exactly."""
+    fact, dim = join_frames
+    session = TpuSession(dict(ASYNC_ON), mesh=make_mesh(8))
+    try:
+        want = _skew_join_q(session, fact, dim)
+        ov0 = session.exchange_overlap_metrics.snapshot()
+        with I.injected("exchange.async.resolve", count=1) as rule:
+            got = _skew_join_q(session, fact, dim)
+            assert rule.fired == 1
+        pd.testing.assert_frame_equal(got, want)
+        faults = [r["fault"] for r in session.recovery_log]
+        assert "shuffle" in faults, session.recovery_log
+        ov = session.exchange_overlap_metrics.snapshot()
+        # the re-driven attempt ran its exchanges synchronously
+        assert ov["syncExchanges"] > ov0["syncExchanges"], (ov0, ov)
+    finally:
+        session.stop()
+
+
+@pytest.mark.chaos
+def test_host_staging_fault_walks_ladder(join_frames):
+    """A fault at the host-staging round trip is an ordinary retryable
+    shuffle fault: the ladder re-drives and the staged answer matches
+    the clean run."""
+    fact, dim = join_frames
+    session = TpuSession({
+        "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+        "spark.rapids.sql.join.broadcastThresholdRows": 1,
+        "spark.rapids.sql.recovery.backoffMs": 1}, mesh=make_mesh(8))
+    try:
+        want = _skew_join_q(session, fact, dim)
+        with I.injected("exchange.host_staging", count=1) as rule:
+            got = _skew_join_q(session, fact, dim)
+            assert rule.fired == 1
+        pd.testing.assert_frame_equal(got, want)
+        faults = [r["fault"] for r in session.recovery_log]
+        assert "shuffle" in faults, session.recovery_log
+    finally:
+        session.stop()

@@ -646,3 +646,35 @@ def test_concurrent_throughput_scales(tmp_path):
     wall = time.perf_counter() - t0
     assert wall < max(4 * serial * 0.75, serial + 5.0), \
         f"4 clients took {wall:.3f}s vs serial {serial:.3f}s"
+
+
+def test_exchange_inflight_bytes_charged_to_query_budget():
+    """In-flight async-exchange payload bytes are real HBM the serving
+    memory budget must see (parallel/exchange_async.ExchangeWindow):
+    the query context tracks the high-water mark, an overrun past the
+    memory budget records ONE budget fact with action='stage' (staging/
+    eviction engage — never a rejection), and the peak rides the
+    QueryEnd admission payload."""
+    from spark_rapids_tpu.parallel.exchange_async import (
+        ExchangeOverlapMetrics, ExchangeWindow)
+    s = TpuSession({
+        "spark.rapids.tpu.serving.queryMemoryBudgetBytes": 1000})
+    with QueryContext(s) as ctx:
+        win = ExchangeWindow(max_bytes=1 << 20,
+                             metrics=ExchangeOverlapMetrics())
+        win.admit("site_a", 600)
+        assert ctx.exchange_inflight == 600
+        assert not ctx.budget_events
+        win.admit("site_b", 600)  # 1200 > the 1000-byte budget
+        assert ctx.exchange_inflight == 1200
+        facts = [b for b in ctx.budget_events
+                 if b["budget"] == "exchangeInflight"]
+        assert len(facts) == 1 and facts[0]["action"] == "stage", \
+            ctx.budget_events
+        win.admit("site_c", 600)  # overrun noted once, not per admit
+        assert len([b for b in ctx.budget_events
+                    if b["budget"] == "exchangeInflight"]) == 1
+        win.resolve_all()
+        assert ctx.exchange_inflight == 0
+        assert ctx.exchange_inflight_peak == 1800
+        assert ctx.admission_info()["exchangeInflightPeak"] == 1800

@@ -530,3 +530,357 @@ def test_chaos_packed_exchange_injection_once_per_launch(mesh, packed):
         assert "shuffle" in faults, faults
     finally:
         session.stop()
+
+
+# ------------------------------------------------- ragged / topology --
+
+def _skewed_args(rng, dtypes, hot=3, hot_frac=0.8):
+    """Sharded columns + pids with ~hot_frac of live rows bound for ONE
+    destination, plus the true [src, dst] histogram."""
+    flat = []
+    for k, dt in enumerate(dtypes):
+        storage = np.dtype(dt.storage)
+        if np.issubdtype(storage, np.floating):
+            v = rng.normal(size=NSHARDS * CAP).astype(storage)
+        else:
+            v = rng.integers(-1000, 1000, NSHARDS * CAP).astype(storage)
+        m = jnp.asarray(rng.random(NSHARDS * CAP) < 0.85) \
+            if k % 2 == 0 else None
+        flat.append((jnp.asarray(v), m))
+    pids_h = np.where(rng.random(NSHARDS * CAP) < hot_frac, hot,
+                      rng.integers(0, NSHARDS, NSHARDS * CAP)
+                      ).astype(np.int32)
+    nrows = np.full(NSHARDS, CAP, dtype=np.int32)
+    counts = np.zeros((NSHARDS, NSHARDS), dtype=np.int64)
+    for s in range(NSHARDS):
+        row = pids_h.reshape(NSHARDS, CAP)[s, :nrows[s]]
+        counts[s] = np.bincount(row, minlength=NSHARDS)
+    return tuple(flat), jnp.asarray(pids_h), jnp.asarray(nrows), counts
+
+
+def _ragged_fn(mesh, dtypes, rp, site=None):
+    axis = mesh.axis_names[0]
+
+    def step(flat, pids, nrows_arr):
+        cols = [ColVal(dt, v, val) for (v, val), dt in zip(flat, dtypes)]
+        out, total = exchange(cols, pids, nrows_arr[0], axis, NSHARDS,
+                              slot=rp.base_slot + rp.surplus_slot,
+                              packed=True, ragged=rp, report_site=site)
+        res = tuple(
+            (c.values, c.validity if c.validity is not None
+             else jnp.ones_like(c.values, dtype=jnp.bool_))
+            for c in out)
+        return res + (jnp.reshape(total.astype(jnp.int32), (1,)),)
+
+    return shard_map(step, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis)),
+                     out_specs=P(axis), check_vma=False)
+
+
+def test_ragged_exchange_bit_identical(mesh, rng):
+    """One hot destination (~80% of rows): the ragged wire (cold base
+    all_to_all + hot-pair collective-permutes) delivers bit-identical
+    rows to the per-column uniform-slot path, while moving strictly —
+    and at this skew >= 2x — fewer wire rows.  The same traced program
+    then pins the wire accounting as EXACT (one compile serves both)."""
+    from spark_rapids_tpu.parallel.shuffle import pick_slot, plan_ragged
+    # one 8-byte + one 4-byte column, first nullable: covers both width
+    # groups (u32 lanes + bit-packed masks in u8) at a fraction of the
+    # compile cost of a wide column set — the surplus-round ppermutes
+    # replicate per lane, so program size scales with the lane count
+    dtypes = [dts.INT64, dts.FLOAT32]
+    flat, pids, nrows, counts = _skewed_args(rng, dtypes)
+    rp = plan_ragged(counts, CAP)
+    assert rp is not None, f"no ragged plan for skew {counts.max(axis=0)}"
+    args = (flat, pids, nrows)
+    site = ("ragged_bytes_site",)
+    r_ragged = _ragged_fn(mesh, dtypes, rp, site=site)(*args)
+    u_slot = pick_slot(int(counts.max()), CAP)
+    # packed uniform baseline: bit-identity of packed-vs-per-column is
+    # already pinned by test_packed_roundtrip_bit_identical, and the
+    # packed program compiles in a fraction of the per-column one
+    r_uniform = _exchange_fn(mesh, dtypes, packed=True,
+                             slot=u_slot)(*args)
+    # receive capacities legitimately differ (ragged: base slices +
+    # worst destination's surplus buffers); compare live prefixes
+    tot_r = np.asarray(r_ragged[len(dtypes)]).reshape(NSHARDS, -1)[:, 0]
+    tot_u = np.asarray(r_uniform[len(dtypes)]).reshape(NSHARDS, -1)[:, 0]
+    np.testing.assert_array_equal(tot_r, tot_u)
+    for i in range(len(dtypes)):
+        vr = np.asarray(r_ragged[i][0]).reshape(NSHARDS, -1)
+        vu = np.asarray(r_uniform[i][0]).reshape(NSHARDS, -1)
+        mr = np.asarray(r_ragged[i][1]).reshape(NSHARDS, -1)
+        mu = np.asarray(r_uniform[i][1]).reshape(NSHARDS, -1)
+        for s in range(NSHARDS):
+            n = tot_r[s]
+            np.testing.assert_array_equal(
+                _bits(vr[s, :n]), _bits(vu[s, :n]),
+                err_msg=f"col {i} shard {s}")
+            np.testing.assert_array_equal(mr[s, :n], mu[s, :n],
+                                          err_msg=f"validity {i} "
+                                                  f"shard {s}")
+    uniform_rows = NSHARDS * NSHARDS * u_slot
+    assert rp.wire_rows(NSHARDS) * 2 <= uniform_rows, \
+        (rp.wire_rows(NSHARDS), uniform_rows)
+
+    # -- exact wire accounting (satellite gate: reported bytesMoved ==
+    # the payload bytes the traced ragged program actually transmits,
+    # derived here from first principles: base all_to_all moves every
+    # (src, dst) slice at the cold slot; each hot pair's surplus buffer
+    # crosses its one link once) --
+    from spark_rapids_tpu.parallel.shuffle import (
+        ShuffleWireMetrics, _ragged_site, record_exchange_metrics,
+        wire_report)
+    # hand-derived packed row bytes for [i64, f32]: u32 lanes
+    # = 2+1 = 3 -> 12B; u8 lanes = ceil(1 nullable / 8) = 1 -> 1B
+    row_bytes = 4 * 3 + 1
+    # the ragged variant records under its OWN report key — a uniform
+    # trace at the same site must not clobber it (and vice versa)
+    assert wire_report(site) is None
+    rep = wire_report(_ragged_site(site, rp))
+    assert rep["row_bytes"] == row_bytes, rep
+    assert rep["collectives"] == 1 + 2 * (1 + len(rp.rounds)), rep
+    # wire rows from the plan geometry: every shard sends the full base
+    # payload; each hot pair's surplus crosses its one link once
+    wire_rows = NSHARDS * NSHARDS * rp.base_slot \
+        + len(rp.pairs) * rp.surplus_slot
+    assert rp.wire_rows(NSHARDS) == wire_rows
+    metrics = ShuffleWireMetrics()
+    record_exchange_metrics(
+        metrics, dtypes=dtypes, slot=0, num_parts=NSHARDS,
+        nshards=NSHARDS, rows_useful=int(counts.sum()), packed=True,
+        site=site, ragged=rp, counts=counts)
+    snap = metrics.snapshot()
+    assert snap["bytesMoved"] == wire_rows * row_bytes, snap
+    assert snap["rowsMoved"] == wire_rows
+    assert snap["rowsUseful"] == int(counts.sum())
+    assert snap["raggedExchanges"] == 1
+    # per-destination wire rows must sum to the aggregate (no
+    # destination hides behind the mean)
+    pd_rows = sum(v["rowsMoved"]
+                  for v in snap["perDestination"].values())
+    assert pd_rows == wire_rows, snap["perDestination"]
+    assert sum(v["rowsUseful"]
+               for v in snap["perDestination"].values()) \
+        == int(counts.sum())
+    # width-group bytes partition the total exactly
+    assert sum(v["bytesMoved"] for v in snap["perGroup"].values()) \
+        == snap["bytesMoved"]
+
+
+def test_ragged_fallback_accounting():
+    """A ragged-requested exchange whose columns the lane packer
+    refuses runs the uniform per-column wire at the base+surplus slot.
+    The exchange body marks the RAGGED report key ``fallback`` at trace
+    time; the consumer must then account the uniform program — not the
+    ragged plan geometry — and keep the fallback report's exact
+    per-column collectives/row bytes (the plain-site report may belong
+    to a different variant compiled at the same signature)."""
+    from spark_rapids_tpu.parallel.shuffle import (
+        ShuffleWireMetrics, _ragged_site, _record_wire_report,
+        plan_ragged, record_exchange_metrics, wire_report)
+    counts = np.full((NSHARDS, NSHARDS), 4, dtype=np.int64)
+    counts[:, 0] = CAP - 4 * (NSHARDS - 1)  # hot destination 0
+    rp = plan_ragged(counts, CAP)
+    assert rp is not None
+    site = ("ragged_fallback_site",)
+    # what exchange() records when _plan_pack refuses the columns
+    cols = [ColVal(dts.INT64, jnp.arange(8, dtype=jnp.int64), None)]
+    _record_wire_report(_ragged_site(site, rp), cols, None,
+                        fallback=True)
+    assert wire_report(_ragged_site(site, rp))["fallback"]
+    metrics = ShuffleWireMetrics()
+    record_exchange_metrics(
+        metrics, dtypes=[dts.INT64], slot=0, num_parts=NSHARDS,
+        nshards=NSHARDS, rows_useful=int(counts.sum()), packed=True,
+        site=site, ragged=rp, counts=counts)
+    snap = metrics.snapshot()
+    # uniform wire at the plan's upper-bound slot, NOT ragged geometry
+    slot = rp.base_slot + rp.surplus_slot
+    rows = NSHARDS * NSHARDS * slot
+    assert snap["raggedExchanges"] == 0, snap
+    assert snap["rowsMoved"] == rows, snap
+    assert snap["bytesMoved"] == rows * 8, snap  # one i64, no mask
+    assert snap["collectives"] == 2, snap  # counts vector + 1 column
+    # per-destination wire reflects the uniform slot for every dest
+    assert all(v["rowsMoved"] == rows // NSHARDS
+               for v in snap["perDestination"].values()), snap
+
+
+def test_padding_ratio_per_destination(mesh, rng):
+    """Per-destination padding under a UNIFORM slot: the hot
+    destination is nearly dense while cold destinations pad toward
+    num_parts x — the aggregate ratio alone would hide both."""
+    from spark_rapids_tpu.parallel.shuffle import (
+        ShuffleWireMetrics, pick_slot, record_exchange_metrics)
+    dtypes = [dts.INT64, dts.FLOAT64]
+    _, _, _, counts = _skewed_args(rng, dtypes)
+    slot = pick_slot(int(counts.max()), CAP)
+    metrics = ShuffleWireMetrics()
+    record_exchange_metrics(
+        metrics, dtypes=dtypes, slot=slot, num_parts=NSHARDS,
+        nshards=NSHARDS, rows_useful=int(counts.sum()), packed=True,
+        counts=counts)
+    summary = ShuffleWireMetrics.summarize(metrics.snapshot())
+    per_dest = summary["paddingRatioPerDestination"]
+    assert set(per_dest) == {str(d) for d in range(NSHARDS)}
+    hot = per_dest["3"]
+    cold = [v for d, v in per_dest.items() if d != "3"]
+    assert hot < min(cold), per_dest
+    assert all(v >= 1.0 for v in per_dest.values())
+    # the aggregate ratio is the wire-rows-weighted blend, so it sits
+    # between the dense hot destination and the padded cold ones
+    assert hot <= summary["paddingRatio"] <= max(cold)
+
+
+def test_exchange_via_gather_matches_all_to_all(mesh, rng):
+    """Topology strategy 'gather' (gather-then-redistribute, the
+    DCN-friendly shape): identical delivered rows to the uniform
+    all_to_all path, zero all_to_all primitives in the compiled
+    program."""
+    from spark_rapids_tpu.parallel.shuffle import exchange_via_gather
+    # both width groups at minimal lane count (compile cost, see
+    # test_ragged_exchange_bit_identical)
+    dtypes = [dts.INT64, dts.FLOAT32]
+    flat, pids, nrows, counts = _skewed_args(rng, dtypes)
+    axis = mesh.axis_names[0]
+
+    def gather_step(flat, pids, nrows_arr):
+        cols = [ColVal(dt, v, val) for (v, val), dt in zip(flat, dtypes)]
+        out, total = exchange_via_gather(cols, pids, nrows_arr[0], axis,
+                                         NSHARDS, packed=True)
+        res = tuple(
+            (c.values, c.validity if c.validity is not None
+             else jnp.ones_like(c.values, dtype=jnp.bool_))
+            for c in out)
+        return res + (jnp.reshape(total.astype(jnp.int32), (1,)),)
+
+    gfn = shard_map(gather_step, mesh=mesh,
+                    in_specs=(P(axis), P(axis), P(axis)),
+                    out_specs=P(axis), check_vma=False)
+    args = (flat, pids, nrows)
+    assert _count_collectives(gfn, args, prim="all_to_all") == 0
+    assert _count_collectives(gfn, args, prim="all_gather") >= 1
+    rg = gfn(*args)
+    # packed uniform baseline (see test_ragged_exchange_bit_identical)
+    ru = _exchange_fn(mesh, dtypes, packed=True, slot=CAP)(*args)
+    _assert_identical(rg, ru, len(dtypes))
+
+
+def test_topology_strategy_resolution(mesh):
+    """'auto' resolves by mesh axis link kind: the virtual CPU mesh is
+    single-process single-slice (ici) -> all_to_all; explicit conf
+    overrides win; mesh.topology() reports the axis map."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.parallel.mesh import axis_link_kind, topology
+    from spark_rapids_tpu.parallel.shuffle import topology_strategy
+    assert axis_link_kind(mesh) == "ici"
+    topo = topology(mesh)
+    assert topo["devices"] == NSHARDS
+    assert topo["axes"] == {mesh.axis_names[0]: "ici"}
+    assert topology_strategy(mesh, conf=None) == "all_to_all"
+    for want in ("gather", "all_to_all"):
+        s = TpuSession({"spark.rapids.tpu.shuffle.topology.strategy":
+                        want})
+        try:
+            assert topology_strategy(mesh, conf=s.conf) == want
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------- host staging --
+
+def test_host_hash_partition_parity(mesh, rng):
+    """The host-side murmur mix must place every row exactly where the
+    device kernels would — the invariant host-RAM staging correctness
+    rests on.  Mixed dtypes, NaN/-0.0 canonicalization, null
+    sentinels."""
+    from spark_rapids_tpu.parallel.exchange_async import (
+        host_hash_partition_ids)
+    from spark_rapids_tpu.parallel.partitioning import hash_partition_ids
+    n = 512
+    vals_i = rng.integers(-10**9, 10**9, n).astype(np.int64)
+    vals_f = rng.normal(size=n)
+    vals_f[rng.choice(n, 30, replace=False)] = np.nan
+    vals_f[rng.choice(n, 30, replace=False)] = -0.0
+    vals_b = rng.random(n) < 0.5
+    valid = rng.random(n) < 0.9
+    cols_dev = [ColVal(dts.INT64, jnp.asarray(vals_i),
+                       jnp.asarray(valid)),
+                ColVal(dts.FLOAT64, jnp.asarray(vals_f), None),
+                ColVal(dts.BOOL, jnp.asarray(vals_b), None)]
+    dev = np.asarray(hash_partition_ids(cols_dev, NSHARDS))
+    host = host_hash_partition_ids(
+        [(vals_i, valid), (vals_f, None), (vals_b, None)], NSHARDS)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_host_staged_partition_layout(rng):
+    """host_staged_partition delivers the post-exchange layout: every
+    live row lands on its destination shard (stable source order),
+    dead padding stays dead, and the staged bytes are the compressed
+    frame size (> 0, <= raw)."""
+    from spark_rapids_tpu.parallel.exchange_async import (
+        host_staged_partition)
+    cap = 32
+    vals = rng.normal(size=NSHARDS * cap)
+    mask = rng.random(NSHARDS * cap) < 0.9
+    counts = rng.integers(0, cap + 1, NSHARDS).astype(np.int32)
+    pids = rng.integers(0, NSHARDS, NSHARDS * cap).astype(np.int32)
+    out_cols, dest_counts, staged_bytes = host_staged_partition(
+        [(vals, mask)], counts, pids, NSHARDS)
+    live = np.zeros(NSHARDS * cap, dtype=bool)
+    for s in range(NSHARDS):
+        live[s * cap: s * cap + counts[s]] = True
+    assert int(dest_counts.sum()) == int(live.sum())
+    (ov, om), = out_cols
+    out_cap = ov.shape[0] // NSHARDS
+    for d in range(NSHARDS):
+        want = vals[live & (pids == d)]  # stable source order
+        got = ov.reshape(NSHARDS, out_cap)[d, :dest_counts[d]]
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(
+            om.reshape(NSHARDS, out_cap)[d, :dest_counts[d]],
+            mask[live & (pids == d)])
+    assert 0 < staged_bytes
+    raw = vals.nbytes + mask.nbytes
+    assert staged_bytes <= raw + 256  # frame header overhead bound
+
+
+def test_oversized_exchange_host_stages_not_split(mesh):
+    """E2E acceptance: a payload past the staging threshold routes
+    through host RAM — the query stays distributed, answers exactly,
+    records hostStagedExchanges, and the recovery ladder's split rung
+    NEVER fires."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession({
+        "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+        "spark.rapids.sql.join.broadcastThresholdRows": 1,
+    }, mesh=mesh)
+    oracle = TpuSession()
+    try:
+        rng = np.random.default_rng(5)
+        pdf = pd.DataFrame({"k": rng.integers(0, 300, 4000),
+                            "v": rng.normal(size=4000)})
+        dim = pd.DataFrame({"k": np.arange(300),
+                            "w": rng.normal(size=300)})
+
+        def q(s):
+            return (s.create_dataframe(pdf)
+                    .join(s.create_dataframe(dim), on="k")
+                    .group_by("k")
+                    .agg(F.sum(F.col("v")).alias("sv"),
+                         F.sum(F.col("w")).alias("sw"))
+                    .to_pandas().sort_values("k", ignore_index=True))
+
+        got = q(session)
+        assert session.last_dist_explain == "distributed"
+        pd.testing.assert_frame_equal(got, q(oracle))
+        ov = session.exchange_overlap_metrics.snapshot()
+        assert ov["hostStagedExchanges"] >= 2, ov  # join + aggregate
+        assert 0 < ov["hostStagedBytes"]
+        assert not session.recovery_log, session.recovery_log
+    finally:
+        session.stop()
+        oracle.stop()
