@@ -163,7 +163,13 @@ def write(i):
     return p
 
 s = TpuSession({"spark.rapids.sql.recovery.backoffMs": 5,
-                "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000},
+                "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000,
+                # ISSUE 11: the state/spill frames this soak's corrupt
+                # rules flip are COMPRESSED (the shared host codec) —
+                # the incremental.state.restore spray therefore covers
+                # the compressed-state leg of the codec-corruption gate
+                "spark.rapids.tpu.encoding.storage.hostCodec": "lz4",
+                "spark.rapids.tpu.incremental.tiers": "host,disk"},
                mesh=make_mesh(8))
 incremental_metrics.reset()
 first = [write(0), write(1)]
@@ -474,6 +480,85 @@ for name, extra, spray in PASSES:
     print(f"async exchange spray [{name}] OK (2 clients exact, "
           f"dirty={dirty}, async={int(ov['asyncExchanges'])} "
           f"staged={int(ov['hostStagedExchanges'])})")
+PY
+
+echo "== codec-corruption spray (compressed storage frames + wire dictionary, encoded knobs ON) =="
+# ISSUE 11 gate: with every encoding knob on — compressed HOST spill
+# frames (storage.hostCodec), encoded execution, and the compressed
+# wire — bit flips in compressed spill/checkpoint/state frames and the
+# wire dictionary-delta broadcast must degrade to recompute/decoded
+# paths with typed events and EXACT results; never wrong bytes.
+python - <<'PY'
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.memory.spill import integrity_metrics
+from spark_rapids_tpu.robustness import inject as I
+
+# -- compressed spill frames --------------------------------------------
+integrity_metrics.reset()
+s = TpuSession({
+    "spark.rapids.tpu.encoding.storage.hostCodec": "lz4",
+    "spark.rapids.tpu.encoding.execution.enabled": True,
+    "spark.rapids.memory.tpu.deviceLimitBytes": 65536,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+})
+rng = np.random.default_rng(3)
+pdf = pd.DataFrame({"k": np.array(["g%02d" % v for v in
+                                   rng.integers(0, 40, 6000)]),
+                    "v": rng.normal(size=6000)})
+df = (s.create_dataframe(pdf).group_by("k")
+      .agg(F.sum(F.col("v")).alias("sv"), F.count(F.col("v")).alias("c")))
+want = df.to_pandas().sort_values("k", ignore_index=True)
+rules = []
+try:
+    # single-process spill tiers only here — compressed checkpoint and
+    # incremental-state frames are sprayed by the continuous-ingest
+    # soak above, whose session now runs the host codec
+    for point in ("spill.corrupt.host", "spill.corrupt.disk"):
+        rules.append(I.inject(point, kind="corrupt", count=3,
+                              probability=0.7, seed=13,
+                              all_threads=True))
+    got = df.to_pandas().sort_values("k", ignore_index=True)
+finally:
+    for r in rules:
+        I.remove(r)
+pd.testing.assert_frame_equal(got, want)
+corr = sum(integrity_metrics.snapshot().values())
+assert corr >= 1, "no compressed-frame corruption was ever detected"
+print("codec storage spray OK (compressed-frame corruptions "
+      f"detected={corr}, recovery trail: "
+      f"{[r['action'] for r in s.recovery_log]})")
+s.stop()
+
+# -- wire dictionary-delta broadcast ------------------------------------
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+
+s = TpuSession({
+    "spark.rapids.tpu.encoding.wire.enabled": True,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+}, mesh=make_mesh(8))
+df2 = (s.create_dataframe(pdf).group_by("k")
+       .agg(F.sum(F.col("v")).alias("sv")))
+# corrupt the FIRST launch's delta (it carries the full dictionary; a
+# later launch's delta would be empty — nothing left to broadcast)
+with I.scoped_rules():
+    I.inject("shuffle.wire.dict", kind="corrupt", count=2,
+             probability=1.0, seed=17, all_threads=True)
+    got2 = df2.to_pandas().sort_values("k", ignore_index=True)
+wm = metrics_for_session(s).snapshot()
+assert wm["wireDictFallbacks"] >= 1, wm
+want2 = df2.to_pandas().sort_values("k", ignore_index=True)
+pd.testing.assert_frame_equal(got2, want2)
+wm2 = metrics_for_session(s).snapshot()
+assert wm2["encodedBytesSaved"] > wm["encodedBytesSaved"], \
+    "post-corruption launch did not return to the encoded wire"
+print("codec wire-dict spray OK (fallbacks="
+      f"{wm['wireDictFallbacks']}, encoded wire re-armed)")
+s.stop()
 PY
 
 echo "CHAOS OK"

@@ -210,7 +210,9 @@ class TpuSession:
             disk_write_threads=self.conf.get(rc.SPILL_DISK_WRITE_THREADS),
             integrity_check=self.conf.get(rc.SPILL_INTEGRITY_ENABLED),
             checkpoint_floor=self.conf.get(
-                rc.SERVING_CHECKPOINT_FLOOR_BYTES))
+                rc.SERVING_CHECKPOINT_FLOOR_BYTES),
+            host_codec=native.codec_level(
+                self.conf.get(rc.ENCODING_STORAGE_HOST_CODEC)))
         set_default_catalog(self.memory_catalog)
         self.semaphore = TpuSemaphore(
             self.conf.get(rc.CONCURRENT_TPU_TASKS))

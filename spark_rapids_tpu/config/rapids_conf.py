@@ -839,6 +839,63 @@ INCREMENTAL_TIERS = conf(
     lambda v: None if v in ("device,host,disk", "host,disk", "disk")
     else "must be 'device,host,disk', 'host,disk' or 'disk'")
 
+ENCODING_EXECUTION_ENABLED = conf(
+    "spark.rapids.tpu.encoding.execution.enabled", False,
+    "Encoded execution: string GROUP BY keys that are bare column "
+    "references dictionary-encode ONCE per batch (stable codes across "
+    "batches) and the whole filter+project+partial-aggregate stage "
+    "evaluates on i32 codes inside the fused kernels "
+    "(exec/aggregate.py), with the strings materialized only at the "
+    "stage boundary that needs them (the final key decode). This is "
+    "what lets string-heavy group-bys (TPC-H q1 shape) ride the "
+    "whole-stage fusion path. Any shape the encoder cannot prove "
+    "equality-faithful (computed string keys, a key column consumed "
+    "by another expression, string-valued min/max buffers) falls back "
+    "to the decoded host-dictionary path — never wrong bytes. False "
+    "(default) keeps the decoded path everywhere (bit-identical A/B).",
+    _to_bool)
+
+ENCODING_EXECUTION_MAX_DICT = conf(
+    "spark.rapids.tpu.encoding.execution.maxDictSize", (1 << 31) - 1,
+    "Ceiling on distinct values one encoded-execution dictionary may "
+    "hold. Exceeding it mid-query raises a RETRYABLE "
+    "EncodingOverflowFault after latching encoded execution OFF for "
+    "the session, so the recovery ladder's re-planned attempt runs "
+    "the decoded path — exact results, never wrong bytes. The hard "
+    "bound is i32 code space; lower values bound host dictionary "
+    "memory.", _to_int, _positive)
+
+ENCODING_WIRE_ENABLED = conf(
+    "spark.rapids.tpu.encoding.wire.enabled", False,
+    "Compressed device wire for dictionary-coded columns: exchange "
+    "payload columns that carry int64 dictionary codes (string group "
+    "keys, encoded min/max partials, string join keys) narrow to ONE "
+    "i32 lane on the packed wire (half the bytes per code column) and "
+    "widen back after the collective, and each exchange site "
+    "broadcasts only its dictionary DELTA (frame-codec compressed, "
+    "crc-verified) instead of materialized rows. A corrupt delta "
+    "broadcast degrades that launch to the wide (unnarrowed) wire "
+    "with a typed EncodedWireInvalid event — exact results either "
+    "way. Savings are attributed as encodedBytesSaved in the QueryEnd "
+    "shuffle dict. False (default) ships codes at their storage width "
+    "(bit-identical A/B).", _to_bool)
+
+ENCODING_STORAGE_HOST_CODEC = conf(
+    "spark.rapids.tpu.encoding.storage.hostCodec", "none",
+    "Frame codec for HOST-tier spill payloads (and therefore "
+    "checkpoint and incremental-state frames, which demote through "
+    "the same catalog): none keeps raw numpy buffers (current "
+    "behavior); zrle / lz4 / zstd compress the payload through the "
+    "shared native frame codec the DISK tier already uses — the "
+    "integrity crc32 is still stamped and verified over the DECODED "
+    "canonical bytes, so PR3 corruption semantics are unchanged and a "
+    "frame that no longer decodes is dropped as corruption. "
+    "Compressed host frames also mean checkpoint.maxBytes and "
+    "incremental.maxStateBytes meter STORED bytes, buying several "
+    "times more standing state per byte.", str,
+    lambda v: None if v in ("none", "zrle", "lz4", "zstd")
+    else "unknown codec")
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "

@@ -32,7 +32,8 @@ from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops.compiler import (
     StageFn, batch_to_flat, capacity_of, colvals_to_columns, flat_to_colvals)
 from spark_rapids_tpu.ops.concat import concat_batches
-from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.ops.expressions import (
+    Alias, BoundReference, ColVal, EmitContext, Expression)
 from spark_rapids_tpu.plan.logical import AggregateExpression
 
 
@@ -60,6 +61,13 @@ class _StringKeyEncoder:
 
 
 from spark_rapids_tpu.ops.aggregates import merge_kind as _merge_kind  # noqa: E402
+
+
+def _collect_bound_ordinals(e: Expression, out: set) -> None:
+    if isinstance(e, BoundReference):
+        out.add(e.ordinal)
+    for c in e.children:
+        _collect_bound_ordinals(c, out)
 
 
 @functools.lru_cache(maxsize=None)
@@ -147,7 +155,9 @@ class TpuHashAggregateExec(TpuExec):
                  pre_filter: Optional[Expression] = None,
                  merge_chunk_rows: int = 1 << 22,
                  defer_syncs: bool = True,
-                 spec_slots: int = 4096):
+                 spec_slots: int = 4096,
+                 encoded_exec: bool = False,
+                 max_dict_size: int = (1 << 31) - 1):
         """``pre_filter``: a fused upstream Filter condition (whole-stage
         fusion: predicate becomes a row mask inside the aggregation kernel —
         no compaction pass at all).
@@ -158,7 +168,19 @@ class TpuHashAggregateExec(TpuExec):
         probe+count), so XLA dispatch never serializes against the host.
         ``defer_syncs=False`` restores the eager two-pass sequential
         behavior (the baseline tests/test_pipeline.py measures against).
-        """
+
+        ``encoded_exec``: encoded execution (ISSUE 11) — string group
+        keys that are bare input references dictionary-encode to stable
+        i32 codes BEFORE the kernels, so the whole
+        filter+project+partial-aggregate stage runs the fully fused
+        (speculative coded) path and strings materialize only at the
+        final key decode.  Shapes the encoder cannot prove
+        equality-faithful (computed keys, a key column consumed by any
+        other expression, string min/max buffers) silently keep the
+        decoded host-dictionary path.  A dictionary outgrowing
+        ``max_dict_size`` latches encoded execution off on the session
+        and raises a retryable EncodingOverflowFault (the re-planned
+        attempt runs decoded — exact results)."""
         super().__init__(child)
         self.merge_chunk_rows = merge_chunk_rows
         self.defer_syncs = defer_syncs
@@ -188,6 +210,14 @@ class TpuHashAggregateExec(TpuExec):
                                 if e.dtype.is_string]
         self._encoders = {i: _StringKeyEncoder()
                           for i in self._string_key_idx}
+        # encoded execution state (set up below, after the buffer
+        # layout is known): kernel-side group exprs default to the
+        # logical ones; schema/decode always read self.group_exprs
+        self._encoded_exec = False
+        self._enc_ords: List[int] = []
+        self._ord_encoders: Dict[int, _StringKeyEncoder] = {}
+        self._kgroup: List[Expression] = list(self.group_exprs)
+        self.max_dict_size = int(max_dict_size)
 
         if self._single_pass:
             # collect aggregates: one grouped pass over the concatenated
@@ -219,10 +249,45 @@ class TpuHashAggregateExec(TpuExec):
             if f.child is not None and f.child.dtype.is_string and
             f.name in ("min", "max", "first", "last")}
 
+        if encoded_exec and self._string_key_idx and \
+                not self._string_buf_pos:
+            ords = self.encoded_key_ordinals(
+                self.group_exprs,
+                [f.child for f in self.funcs if f.child is not None]
+                + self.pre_filters)
+            if ords is not None:
+                # rewrite: the kernels see the key columns as i32 codes
+                # (stable across batches, nulls interned as a code that
+                # decodes back to None) — the fused/speculative update
+                # path applies; the decoded strings reappear only at
+                # the final key decode in do_execute
+                self._encoded_exec = True
+                self._enc_ords = sorted(set(ords))
+                self._ord_encoders = {o: _StringKeyEncoder()
+                                      for o in self._enc_ords}
+                for i, o in zip(self._string_key_idx, ords):
+                    self._encoders[i] = self._ord_encoders[o]
+                    e = self.group_exprs[i]
+                    self._kgroup[i] = BoundReference(
+                        o, dts.INT32, name=e.name, nullable=False)
+                self._in_dtypes = [
+                    dts.INT32 if j in self._enc_ords else dt
+                    for j, dt in enumerate(self._in_dtypes)]
+        if self.pre_filters and self._needs_string_stage:
+            # planner invariant: a fused pre_filter never reaches the
+            # two-stage string path (which cannot apply it) — the
+            # planner either proves encoded eligibility or leaves the
+            # chain unfused
+            raise ValueError(
+                "fused pre_filter with string keys/buffers requires "
+                "encoded execution; plan the chain unfused instead")
+
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         base_sig = (tuple(dt.name for dt in self._in_dtypes),
-                    tuple(e.cache_key() for e in self.group_exprs),
+                    tuple(e.cache_key() for e in self._kgroup),
                     tuple(f.cache_key() for f in self.funcs))
+        if self._encoded_exec:
+            base_sig += (("encexec", tuple(self._enc_ords)),)
         self._base_sig = base_sig
         # coded (sort-free) dispatch: all keys fixed-width integral after
         # string dictionary encoding, all buffers fixed-width
@@ -231,7 +296,7 @@ class TpuHashAggregateExec(TpuExec):
         self._coded_eligible = bool(self.group_exprs) and \
             agg.coded_key_eligible(key_dts) and \
             not any(s.dtype.has_offsets for s in self._buf_specs)
-        if self._string_key_idx or self._string_buf_pos:
+        if self._needs_string_stage:
             # stage A evaluates keys + agg children; the group kernel runs in
             # stage B after host dictionary encoding of string keys /
             # string agg children
@@ -273,9 +338,85 @@ class TpuHashAggregateExec(TpuExec):
         return out
 
     def describe(self):
+        enc = ", encoded" if self._encoded_exec else ""
         return (f"TpuHashAggregateExec[keys="
                 f"{[e.name for e in self.group_exprs]}, aggs="
-                f"{[n for n, _ in self.agg_exprs]}]")
+                f"{[n for n, _ in self.agg_exprs]}{enc}]")
+
+    @property
+    def _needs_string_stage(self) -> bool:
+        """True when the two-stage (pre-eval + host dictionary) string
+        path must run: string keys NOT rewritten to codes, or
+        string-valued min/max/first/last buffers."""
+        return ((bool(self._string_key_idx) and not self._encoded_exec)
+                or bool(getattr(self, "_string_buf_pos", None)))
+
+    @staticmethod
+    def encoded_key_ordinals(group_exprs, consumers
+                             ) -> Optional[List[int]]:
+        """Input ordinals behind the string group keys when encoded
+        execution is equality-faithful, else None.  Faithful means:
+        every string key is a bare input reference (optionally
+        aliased), and no other kernel consumer — non-string keys, agg
+        children, fused predicates (``consumers``) — reads those
+        columns, so replacing them with stable dense codes changes no
+        evaluated value.  The SAME test gates the planner's fused-chain
+        fold and the exec's own rewrite: they must not diverge."""
+        ords: List[int] = []
+        for e in group_exprs:
+            if not e.dtype.is_string:
+                continue
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if not isinstance(inner, BoundReference):
+                return None  # computed key: codes are not the value
+            ords.append(inner.ordinal)
+        if not ords:
+            return None
+        refs: set = set()
+        for e in list(consumers) + [g for g in group_exprs
+                                    if not g.dtype.is_string]:
+            if e is not None:
+                _collect_bound_ordinals(e, refs)
+        if refs & set(ords):
+            return None  # the column's BYTES are consumed elsewhere
+        return ords
+
+    def _encode_input_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Replace encoded-ordinal string columns with stable i32 code
+        columns (codes stable across batches via the per-ordinal
+        encoder; null rows intern as their own code and decode back to
+        None, so validity is folded into the code space).  The code
+        Column carries its dictionary.  Python work is O(distinct per
+        batch) — ops/dictionary vectorized encode."""
+        names = list(batch.columns)
+        cols = dict(batch.columns)
+        for o in self._enc_ords:
+            name = names[o]
+            enc = self._ord_encoders[o]
+            ncol = enc.encode(cols[name])
+            if len(enc.values) > self.max_dict_size:
+                self._latch_encoding_off(len(enc.values))
+            ncol.dictionary = enc.values
+            cols[name] = ncol
+        return ColumnarBatch(cols, batch.row_count)
+
+    def _latch_encoding_off(self, size: int) -> None:
+        """Dictionary overflow: latch encoded execution off for the
+        session and raise the retryable fault — the ladder's re-planned
+        attempt takes the decoded path (exact results; codes already
+        issued die with this attempt)."""
+        from spark_rapids_tpu.api.session import TpuSession
+        from spark_rapids_tpu.robustness.driver import record_degradation
+        from spark_rapids_tpu.robustness.faults import (
+            EncodingOverflowFault)
+        s = TpuSession._active
+        if s is not None:
+            s.encoding_exec_latched = True
+        err = EncodingOverflowFault(self.describe(), size,
+                                    self.max_dict_size)
+        record_degradation(s, err.kind, "encoded-exec-latched-off",
+                           str(err))
+        raise err
 
     @property
     def _partial_schema(self) -> Schema:
@@ -321,7 +462,7 @@ class TpuHashAggregateExec(TpuExec):
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
         ctx = EmitContext(inputs, nrows, capacity)
         row_mask = self._pre_filter_mask(ctx)
-        keys = [e.emit(ctx) for e in self.group_exprs]
+        keys = [e.emit(ctx) for e in self._kgroup]
         buf_inputs = self._eval_update_inputs(ctx)
         if not keys:
             outs = agg.reduce_aggregate(buf_inputs, nrows, capacity,
@@ -343,7 +484,7 @@ class TpuHashAggregateExec(TpuExec):
         if mask is None:
             mask = ctx.row_mask()
         keys = [agg.widen_colval(e.emit(ctx), capacity)
-                for e in self.group_exprs]
+                for e in self._kgroup]
         mins, maxs = agg.key_range_probe(keys, mask)
         return mask, mins, maxs
 
@@ -359,7 +500,7 @@ class TpuHashAggregateExec(TpuExec):
             if self.pre_filters:
                 ctx.extra_check_mask = mask
             keys = [agg.widen_colval(e.emit(ctx), capacity)
-                    for e in self.group_exprs]
+                    for e in self._kgroup]
             buf_inputs = self._eval_update_inputs(ctx)
             out_keys, out_bufs, n = agg.groupby_aggregate_coded(
                 keys, buf_inputs, nrows, capacity, mins, slot_ranges,
@@ -383,7 +524,7 @@ class TpuHashAggregateExec(TpuExec):
             if mask is None:
                 mask = ctx.row_mask()
             keys = [agg.widen_colval(e.emit(ctx), capacity)
-                    for e in self.group_exprs]
+                    for e in self._kgroup]
             buf_inputs = self._eval_update_inputs(ctx)
             out_keys, out_bufs, n, fits, mins, maxs = \
                 agg.groupby_aggregate_coded_auto(
@@ -494,7 +635,9 @@ class TpuHashAggregateExec(TpuExec):
 
         def compute(batch):
             with self.timer(AGG_TIME):
-                if self._string_key_idx or self._string_buf_pos:
+                if self._encoded_exec:
+                    batch = self._encode_input_batch(batch)
+                if self._needs_string_stage:
                     return self._partial_with_string_keys(
                         batch, names, dtypes)
                 if self._coded_eligible:

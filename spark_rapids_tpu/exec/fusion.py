@@ -128,19 +128,24 @@ def collect_runtime_savings(exec_root: TpuExec) -> Dict[str, int]:
     run (one per collapsed operator per batch) — the runtime half of the
     QueryEnd ``fusion`` dict."""
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
-    out = {"fusedStages": 0, "fusedOperators": 0, "dispatchesSaved": 0}
+    out = {"fusedStages": 0, "fusedOperators": 0, "dispatchesSaved": 0,
+           "encodedStages": 0}
 
     def rec(n):
         if isinstance(n, FusedStageExec):
             out["fusedStages"] += 1
             out["fusedOperators"] += len(n.members)
             out["dispatchesSaved"] += n.metrics[DISPATCHES_SAVED].value
-        elif isinstance(n, TpuHashAggregateExec) and \
-                getattr(n, "fused_ops", 0):
-            out["fusedStages"] += 1
-            out["fusedOperators"] += n.fused_ops + 1
-            out["dispatchesSaved"] += \
-                n.fused_ops * n.metrics[NUM_INPUT_BATCHES].value
+        elif isinstance(n, TpuHashAggregateExec):
+            if getattr(n, "fused_ops", 0):
+                out["fusedStages"] += 1
+                out["fusedOperators"] += n.fused_ops + 1
+                out["dispatchesSaved"] += \
+                    n.fused_ops * n.metrics[NUM_INPUT_BATCHES].value
+            if getattr(n, "_encoded_exec", False):
+                # encoded execution: the stage ran on dictionary codes
+                # (bench encoded_stage_count / QueryEnd fusion dict)
+                out["encodedStages"] += 1
         for c in n.children:
             rec(c)
 

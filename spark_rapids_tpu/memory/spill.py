@@ -133,6 +133,13 @@ class SpillableHandle:
         self.last_access = 0
         self._device: Optional[ColumnarBatch] = batch
         self._host: Optional[dict] = None
+        # HOST tier, compressed form: when the catalog's host codec is
+        # on, the payload lives as ONE frame-codec blob (the same
+        # self-describing frame format the DISK tier writes) instead of
+        # raw numpy buffers — checkpoints and incremental state demote
+        # through this catalog, so they inherit the codec for free
+        self._host_frame: Optional[bytes] = None
+        self._host_stored = 0
         self._disk_path: Optional[str] = None
         # crc32 of the host payload, stamped when the batch leaves
         # DEVICE and verified on every HOST->DEVICE / DISK->HOST
@@ -196,17 +203,69 @@ class SpillableHandle:
                 offsets=get(f"{name}.offsets"))
         return ColumnarBatch(cols, self.nrows)
 
+    def _frame_columns(self, payload: dict):
+        """(dtype_code, data, validity, offsets) per schema column —
+        the native frame codec's input layout."""
+        from spark_rapids_tpu import native
+        return [(native.dtype_code(dt),
+                 payload.get(f"{name}.data"),
+                 payload.get(f"{name}.validity"),
+                 payload.get(f"{name}.offsets"))
+                for name, dt in self._schema]
+
+    def _payload_from_frame(self, blob: bytes) -> dict:
+        """Decode a self-describing frame blob back into the canonical
+        payload dict (raises on a frame that no longer decodes — the
+        caller converts that into CorruptionFault)."""
+        from spark_rapids_tpu import native
+        _, cols = native.deserialize_batch(blob)
+        payload = {}
+        for (name, dt), (_, d, v, o) in zip(self._schema, cols):
+            if d is not None:
+                payload[f"{name}.data"] = d if dt.is_string else \
+                    d.view(dt.storage)
+            if v is not None:
+                payload[f"{name}.validity"] = v.view(np.bool_)
+            if o is not None:
+                payload[f"{name}.offsets"] = o.view(np.int32)
+        return payload
+
+    @property
+    def stored_bytes(self) -> int:
+        """Bytes this handle actually occupies at its current tier —
+        the encoded frame size at HOST (codec on) / DISK, the device
+        size otherwise.  Budget consumers that meter STANDING state
+        (checkpoint.maxBytes, incremental.maxStateBytes) read this so
+        compression buys proportionally more retained state."""
+        if self.tier == HOST and self._host_frame is not None:
+            return self._host_stored
+        if self.tier == DISK and self._host_stored:
+            return self._host_stored
+        return self.size_bytes
+
     def spill_to_host(self) -> int:
         """Demote to HOST; returns the DEVICE bytes released (the batch
         plus any transient wire reservation — the wire headroom never
-        follows the batch to the host tier)."""
+        follows the batch to the host tier).  With the catalog's host
+        codec on, the payload is kept as ONE compressed frame blob; the
+        integrity crc is stamped over the DECODED canonical bytes
+        BEFORE encoding, so verification semantics are unchanged."""
         assert self.tier == DEVICE
-        self._host = self._to_host_payload()
+        payload = self._to_host_payload()
         if self.catalog.integrity_check:
             # stamped exactly once, when the bytes leave the device:
             # every later restore (host or disk) verifies against this
-            self._integrity_crc = _payload_checksum(self._host,
-                                                    self.nrows)
+            self._integrity_crc = _payload_checksum(payload, self.nrows)
+        if self.catalog.host_codec:
+            from spark_rapids_tpu import native
+            blob = native.serialize_batch(
+                self.nrows, self._frame_columns(payload),
+                compress=self.catalog.host_codec)
+            self._host_frame = blob
+            self._host_stored = len(blob)
+            self.catalog.note_host_encoding(self.size_bytes, len(blob))
+        else:
+            self._host = payload
         self._device = None
         self.tier = HOST
         released = self.size_bytes + self.wire_bytes
@@ -223,14 +282,15 @@ class SpillableHandle:
         # the query driver can retry the whole query
         fire("spill.disk")
         path = os.path.join(self.catalog.spill_dir, f"buf-{self.id}.tcf")
-        cols = []
-        for name, dt in self._schema:
-            cols.append((native.dtype_code(dt),
-                         self._host.get(f"{name}.data"),
-                         self._host.get(f"{name}.validity"),
-                         self._host.get(f"{name}.offsets")))
-        blob = native.serialize_batch(self.nrows, cols,
-                                      compress=self.catalog.frame_codec)
+        if self._host_frame is not None:
+            # already a self-describing frame (compressed host tier):
+            # the disk write is a straight page-out, no re-encode
+            blob = self._host_frame
+        else:
+            blob = native.serialize_batch(
+                self.nrows, self._frame_columns(self._host),
+                compress=self.catalog.frame_codec)
+            self._host_stored = len(blob)
         # torn-write-proof: stage to a temp file, fsync, then rename
         # into place.  A crash anywhere before the rename leaves no
         # file at ``path``, so a partial frame is never restorable.
@@ -256,6 +316,7 @@ class SpillableHandle:
                 f"disk spill of buf-{self.id} failed: {e}") from e
         self._disk_path = path
         self._host = None
+        self._host_frame = None
         self.tier = DISK
         return self.size_bytes
 
@@ -284,16 +345,31 @@ class SpillableHandle:
         self.last_access = self.catalog.next_access_stamp()
         if self.tier == DEVICE:
             return self._device
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
         from spark_rapids_tpu.robustness.inject import fire_mutate
         if self.tier == HOST:
-            payload = self._corrupt_point(self._host,
-                                          "spill.corrupt.host")
+            if self._host_frame is not None:
+                # compressed host tier: the chaos hook mutates the
+                # frame bytes (as on disk); a frame that no longer
+                # decodes is corruption — drop, never guess at bytes
+                blob = fire_mutate("spill.corrupt.host",
+                                   self._host_frame)
+                try:
+                    payload = self._payload_from_frame(blob)
+                except Exception as e:
+                    detail = (f"buf-{self.id}: host frame decode "
+                              f"failed: {e}")
+                    self.close()
+                    _emit_corruption(HOST, self.id, detail)
+                    raise CorruptionFault(HOST, detail) from e
+            else:
+                payload = self._corrupt_point(self._host,
+                                              "spill.corrupt.host")
             self._verify_payload(payload, HOST)
             batch = self._rebuild(lambda k: payload.get(k))
         else:
             from spark_rapids_tpu import native
-            from spark_rapids_tpu.robustness.faults import (
-                CorruptionFault, SpillIOError)
+            from spark_rapids_tpu.robustness.faults import SpillIOError
             try:
                 blob = native.read_spill_file(self._disk_path)
             except OSError as e:
@@ -301,7 +377,7 @@ class SpillableHandle:
                     f"disk unspill of buf-{self.id} failed: {e}") from e
             blob = fire_mutate("spill.corrupt.disk", blob)
             try:
-                _, cols = native.deserialize_batch(blob)
+                payload = self._payload_from_frame(blob)
             except OSError:
                 raise
             except Exception as e:
@@ -312,15 +388,6 @@ class SpillableHandle:
                 self.close()
                 _emit_corruption(DISK, self.id, detail)
                 raise CorruptionFault(DISK, detail) from e
-            payload = {}
-            for (name, dt), (_, d, v, o) in zip(self._schema, cols):
-                if d is not None:
-                    payload[f"{name}.data"] = d if dt.is_string else \
-                        d.view(dt.storage)
-                if v is not None:
-                    payload[f"{name}.validity"] = v.view(np.bool_)
-                if o is not None:
-                    payload[f"{name}.offsets"] = o.view(np.int32)
             self._verify_payload(payload, DISK)
             batch = self._rebuild(lambda k: payload.get(k))
         self.catalog.unspill(self, batch)
@@ -351,6 +418,7 @@ class SpillableHandle:
         self.closed = True
         self._device = None
         self._host = None
+        self._host_frame = None
         try:
             if self._disk_path and os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
@@ -379,7 +447,8 @@ class SpillableBatchCatalog:
                  frame_codec: int = 2,
                  disk_write_threads: int = 2,
                  integrity_check: bool = True,
-                 checkpoint_floor: int = 0):
+                 checkpoint_floor: int = 0,
+                 host_codec: int = 0):
         self.device_budget = device_budget
         self.host_budget = host_budget
         # cross-query isolation floor: device pressure originating
@@ -408,6 +477,19 @@ class SpillableBatchCatalog:
         # (0 raw / 1 zrle / 2 zrle+lzb); sessions set this from
         # spark.rapids.shuffle.compression.codec
         self.frame_codec = frame_codec
+        # HOST-tier codec level (spark.rapids.tpu.encoding.storage.
+        # hostCodec): 0 keeps raw numpy payloads; >0 stores host-tier
+        # payloads as compressed frame blobs (checkpoints and
+        # incremental state inherit this — the one shared codec layer)
+        self.host_codec = int(host_codec)
+        # raw vs encoded host-frame byte totals (bench
+        # state_bytes_raw/compressed and the profiling storage line).
+        # Own lock: note_host_encoding is called from spill_to_host,
+        # which may run UNDER the catalog lock (demote/_spill_tier) —
+        # re-taking the non-reentrant catalog lock would deadlock
+        self._enc_lock = threading.Lock()
+        self.host_raw_bytes_total = 0
+        self.host_encoded_bytes_total = 0
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpu-spill-")
         # warm the native library now: its first load may shell out to g++
         # (up to ~2min); doing it lazily inside spill_to_disk would stall
@@ -429,6 +511,14 @@ class SpillableBatchCatalog:
 
     def next_access_stamp(self) -> int:
         return next(self._access_counter)
+
+    def note_host_encoding(self, raw: int, encoded: int) -> None:
+        """Cumulative raw->encoded attribution for host-tier frames
+        (called by the handle on each compressed demotion, possibly
+        under the catalog lock — see _enc_lock)."""
+        with self._enc_lock:
+            self.host_raw_bytes_total += int(raw)
+            self.host_encoded_bytes_total += int(encoded)
 
     # ------------------------------------------------------------- interface --
     def register(self, batch: ColumnarBatch,
@@ -556,6 +646,7 @@ class SpillableBatchCatalog:
             h.tier = DEVICE
             h._device = batch
             h._host = None
+            h._host_frame = None
             self.device_bytes += h.size_bytes
             self._owner_device_adjust(h.owner, h.size_bytes)
         self.ensure_budget(for_owner=h.owner)
@@ -766,6 +857,8 @@ class SpillableBatchCatalog:
             "disk_bytes": self.disk_bytes,
             "spilled_to_host_total": self.spilled_to_host_total,
             "spilled_to_disk_total": self.spilled_to_disk_total,
+            "host_raw_bytes_total": self.host_raw_bytes_total,
+            "host_encoded_bytes_total": self.host_encoded_bytes_total,
             "num_handles": len(self._handles),
         }
 

@@ -1344,7 +1344,15 @@ class DistPlanner:
                 self.mesh, in_dtypes=f.phys_dtypes,
                 group_exprs=group_exprs,
                 funcs=[a.func for a in agg_list],
-                filter_cond=lcond)
+                filter_cond=lcond,
+                # compressed wire: the exchanged partial frame's code
+                # columns (encoded group keys + encoded min/max/first/
+                # last partials) with their dictionaries
+                encoded_keys={i: d for i, d in agg_enc.items()
+                              if i < nkeys},
+                encoded_funcs={i - nkeys: d
+                               for i, d in agg_enc.items()
+                               if i >= nkeys})
             outs = dist([(v, val, None) for v, val in f.cols], f.nrows,
                         window=self._xwindow)
             self._emit_stats("aggregate", dist.last_stats)
@@ -1502,6 +1510,12 @@ class DistPlanner:
                             len(probe.names) + len(probe_keys)))
         bk_idx = list(range(len(build.names),
                             len(build.names) + len(build_keys)))
+        # compressed wire: every code-valued exchanged column — body
+        # columns from each side's frame enc, plus the appended string
+        # key columns (the probe key's dictionary is the BUILD side's
+        # after the remap below)
+        probe_enc = dict(probe_m.enc)
+        build_enc = dict(build_m.enc)
         if str_keys:
             # re-code the probe side's string key codes into the build
             # dictionary: value-equal codes become equal ints, values
@@ -1519,9 +1533,13 @@ class DistPlanner:
                     _remap_codes(jnp.asarray(mapping),
                                  jnp.clip(vals, 0, len(mapping) - 1)),
                     valid)
+                probe_enc[pk_idx[i]] = bd
+                build_enc[bk_idx[i]] = bd
             probe_m = probe_m.replace(cols=cols)
         flat, n_out = self._exec_join(probe_m, build_m, pk_idx, bk_idx,
-                                      join_type, plan.join_type)
+                                      join_type, plan.join_type,
+                                      probe_enc=probe_enc,
+                                      build_enc=build_enc)
         n_out = n_out.reshape(-1)
         n_probe = len(probe.names)
         n_build = len(build.names)
@@ -1549,7 +1567,8 @@ class DistPlanner:
         return frame
 
     def _exec_join(self, probe_m, build_m, pk_idx, bk_idx, join_type,
-                   plan_join_type, depth: int = 0):
+                   plan_join_type, depth: int = 0,
+                   probe_enc=None, build_enc=None):
         """Run the distributed hash join with output-size retry; when
         the needed output exceeds MAX_OUT_ROWS, degrade to CHUNKED
         emission (probe-side slices joined separately and unioned per
@@ -1565,7 +1584,8 @@ class DistPlanner:
                 self.mesh, probe_dtypes=probe_m.phys_dtypes,
                 build_dtypes=build_m.phys_dtypes,
                 probe_key_idx=pk_idx, build_key_idx=bk_idx,
-                join_type=join_type, out_factor=out_factor)
+                join_type=join_type, out_factor=out_factor,
+                probe_encoded=probe_enc, build_encoded=build_enc)
             flat, n_out, total = join(
                 probe_m.cols, probe_m.nrows, build_m.cols,
                 build_m.nrows, window=self._xwindow)
@@ -1586,14 +1606,16 @@ class DistPlanner:
             if (next_factor * probe_cap * nshards > self.MAX_OUT_ROWS):
                 return self._exec_join_chunked(
                     probe_m, build_m, pk_idx, bk_idx, join_type,
-                    plan_join_type, depth)
+                    plan_join_type, depth, probe_enc=probe_enc,
+                    build_enc=build_enc)
             out_factor = next_factor
         self._emit_stats(f"join:{plan_join_type}", join.last_stats,
                          out_factor=out_factor, depth=depth)
         return flat, n_out
 
     def _exec_join_chunked(self, probe_m, build_m, pk_idx, bk_idx,
-                           join_type, plan_join_type, depth: int):
+                           join_type, plan_join_type, depth: int,
+                           probe_enc=None, build_enc=None):
         if join_type == "full":
             # probe-side chunking is linear only when each probe row's
             # output is independent; a full join also emits
@@ -1616,7 +1638,9 @@ class DistPlanner:
                                      nrows=nr.reshape(-1))
             flat, n_out = self._exec_join(sliced, build_m, pk_idx,
                                           bk_idx, join_type,
-                                          plan_join_type, depth + 1)
+                                          plan_join_type, depth + 1,
+                                          probe_enc=probe_enc,
+                                          build_enc=build_enc)
             chunks.append((list(flat), n_out.reshape(-1)))
         if len(chunks[0][0]) > len(probe_m.names):
             dtypes = probe_m.phys_dtypes + build_m.phys_dtypes

@@ -94,7 +94,14 @@ class DistributedAggregate:
     def __init__(self, mesh: Mesh, in_dtypes: Sequence[DataType],
                  group_exprs: Sequence[Expression],
                  funcs: Sequence[agg.AggregateFunction],
-                 filter_cond: Optional[Expression] = None):
+                 filter_cond: Optional[Expression] = None,
+                 encoded_keys=None, encoded_funcs=None):
+        """``encoded_keys`` / ``encoded_funcs``: dictionaries behind
+        group-key positions / function positions whose exchanged
+        values are int64 dictionary codes — with
+        spark.rapids.tpu.encoding.wire.enabled those columns narrow to
+        i32 lanes on the wire (codes + a once-per-site dictionary
+        delta broadcast instead of materialized rows)."""
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.nshards = mesh.devices.size
@@ -124,7 +131,8 @@ class DistributedAggregate:
 
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.parallel.shuffle import (
-            packed_enabled, ragged_enabled, topology_strategy)
+            packed_enabled, ragged_enabled, topology_strategy,
+            wire_encoding_enabled)
         self._cached_jit = cached_jit
         # resolved at construction and baked into the jit signature: a
         # packed.enabled flip must retrace, never hit a stale cache
@@ -134,6 +142,18 @@ class DistributedAggregate:
         # exchange to gather-then-redistribute
         self.exchange_strategy = topology_strategy(mesh)
         self.ragged, self.ragged_min_savings = ragged_enabled()
+        # compressed wire: exchange-column index -> dictionary for
+        # every code-valued column in the exchanged payload (group
+        # keys + single-buffer min/max/first/last partials)
+        nkeys = len(self.group_exprs)
+        self._encoded_cols = {int(i): d
+                              for i, d in (encoded_keys or {}).items()}
+        for j, d in (encoded_funcs or {}).items():
+            self._encoded_cols[nkeys + self._buf_slices[j].start] = d
+        self.wire_encoding = wire_encoding_enabled() and \
+            bool(self._encoded_cols)
+        self._wire_encode = tuple(sorted(self._encoded_cols)) \
+            if self.wire_encoding else ()
         self._sig = ("dist_agg", tuple(self.mesh.axis_names),
                      tuple(self.mesh.devices.shape),
                      tuple(str(d) for d in self.mesh.devices.flat),
@@ -143,7 +163,8 @@ class DistributedAggregate:
                      tuple(c.cache_key() for c in self.filter_conds)
                      if self.filter_conds else None,
                      ("packed", self.packed),
-                     ("exch", self.exchange_strategy))
+                     ("exch", self.exchange_strategy),
+                     ("wenc", self.wire_encoding))
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
             self._sig + ("keyless",), lambda: _shard_map(
@@ -214,7 +235,8 @@ class DistributedAggregate:
         return (tuple((o.values, o.validity) for o in outs),
                 jnp.reshape(n_groups, (1,)), hist)
 
-    def _step_final(self, slot, ragged, lut, partial_flat, n_groups_arr):
+    def _step_final(self, slot, ragged, wenc, lut, partial_flat,
+                    n_groups_arr):
         """Phase 2: exchange partials with the stats-sized slot (bucket
         -> shard assignment rides in as the traced ``lut``), then the
         final merge + finalize on the receiving shard.  ``ragged`` (a
@@ -238,13 +260,15 @@ class DistributedAggregate:
             recv, recv_n, overflow = exchange_via_gather(
                 list(pkeys) + list(pbufs), pids, n_groups, self.axis,
                 self.nshards, packed=self.packed, with_overflow=True,
-                report_site=self._sig + ("final",))
+                report_site=self._sig + ("final", wenc),
+                wire_encode=wenc)
         else:
             recv, recv_n, overflow = exchange(
                 list(pkeys) + list(pbufs), pids, n_groups, self.axis,
                 self.nshards, slot=slot, packed=self.packed,
-                with_overflow=True, report_site=self._sig + ("final",),
-                ragged=ragged)
+                with_overflow=True,
+                report_site=self._sig + ("final", wenc),
+                ragged=ragged, wire_encode=wenc)
         return self._merge_finalize(recv[:nkeys], recv[nkeys:],
                                     recv_n, overflow)
 
@@ -323,11 +347,12 @@ class DistributedAggregate:
         return results
 
     # ---- host API ------------------------------------------------------------
-    def _final_jitted(self, slot: int, ragged=None):
+    def _final_jitted(self, slot: int, ragged=None, wenc=()):
         rkey = ragged.cache_key() if ragged is not None else None
         return self._cached_jit(
-            self._sig + ("final", slot, rkey), lambda: _shard_map(
-                partial(self._step_final, slot, ragged), mesh=self.mesh,
+            self._sig + ("final", slot, rkey, wenc), lambda: _shard_map(
+                partial(self._step_final, slot, ragged, wenc),
+                mesh=self.mesh,
                 in_specs=(P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
@@ -372,8 +397,9 @@ class DistributedAggregate:
         from spark_rapids_tpu.parallel.exchange_async import (
             overlap_metrics_for_session, staging_threshold)
         from spark_rapids_tpu.parallel.shuffle import (
-            launch_checkpoint, metrics_for_session, plan_ragged,
-            planner_for_session, record_exchange_metrics, wire_row_bytes)
+            broadcast_wire_dicts, launch_checkpoint,
+            metrics_for_session, plan_ragged, planner_for_session,
+            record_exchange_metrics, wire_row_bytes)
         if not self.group_exprs:
             self.last_stats = {"keyless": True}
             return self._jitted_keyless(flat_cols, nrows_per_shard)
@@ -384,9 +410,33 @@ class DistributedAggregate:
         metrics = metrics_for_session()
         site = self._sig
 
+        # compressed wire: the dictionary-DELTA broadcast runs only
+        # when a DEVICE-collective launch is imminent (the join-path
+        # rule — a host-staged launch ships nothing on the wire, so it
+        # must not mark deltas sent or account wireDictBytes); a
+        # corrupt delta degrades this launch to the wide wire (typed
+        # EncodedWireInvalid, full rebroadcast next launch), and an
+        # encodable payload shipping decoded counts the health signal
+        wenc = ()
+
+        def resolve_wire() -> None:
+            nonlocal wenc
+            if not self._encoded_cols:
+                return
+            if not self._wire_encode:
+                metrics.record_encodable_decoded()
+                return
+            dicts = [self._encoded_cols[i] for i in self._wire_encode]
+            if broadcast_wire_dicts(site + ("dict",), dicts, metrics):
+                wenc = self._wire_encode
+
         thr = staging_threshold() \
             if self.exchange_strategy != "gather" else 0
-        row_bytes = wire_row_bytes(self._wire_dtypes())
+        # sizing uses the INTENDED wire; a corrupt-delta wide fallback
+        # only makes the estimate conservative-side wrong for one launch
+        row_bytes = max(
+            wire_row_bytes(self._wire_dtypes())
+            - 4 * len(self._wire_encode), 1)
         spec = planner.speculative(site, capacity)
         if spec is not None and thr and \
                 self.nshards * self.nshards * spec["slot"] * row_bytes \
@@ -397,9 +447,11 @@ class DistributedAggregate:
             spec = None
         if spec is not None and "lut" in spec and \
                 len(spec["lut"]) == self.buckets:
+            resolve_wire()
             outs = self._launch_speculative(site, spec, partial_flat,
                                             n_groups, capacity, planner,
-                                            metrics, window=window)
+                                            metrics, window=window,
+                                            wenc=wenc)
         else:
             counts = host_sync(hist).reshape(self.nshards, self.buckets)
             lut, dst_counts = coalesce_buckets(counts, self.nshards)
@@ -410,6 +462,7 @@ class DistributedAggregate:
             if thr and est_bytes > thr:
                 return self._launch_staged(partial_flat, lut,
                                            dst_counts, metrics)
+            resolve_wire()
             ragged = None
             if self.ragged and self.exchange_strategy != "gather":
                 ragged = plan_ragged(dst_counts, capacity,
@@ -427,7 +480,7 @@ class DistributedAggregate:
             if ragged is not None:
                 self.last_stats["ragged"] = repr(ragged)
             with launch_checkpoint():
-                raw = self._final_jitted(slot, ragged)(
+                raw = self._final_jitted(slot, ragged, wenc)(
                     jnp.asarray(lut), partial_flat, n_groups)
             outs = raw[:-1]  # drop the overflow flag (slot >= max_slice)
             record_exchange_metrics(
@@ -438,8 +491,8 @@ class DistributedAggregate:
                 else slot,
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=rows, packed=self.packed,
-                site=self._sig + ("final",), ragged=ragged,
-                counts=dst_counts)
+                site=self._sig + ("final", wenc), ragged=ragged,
+                counts=dst_counts, wire_encode_cols=len(wenc))
             if window is not None:
                 # stats-sized slots are proven (slot >= true max / the
                 # ragged limits cover every pair): no verification to
@@ -486,7 +539,8 @@ class DistributedAggregate:
         return raw[:-1]
 
     def _launch_speculative(self, site, spec, partial_flat, n_groups,
-                            capacity, planner, metrics, window=None):
+                            capacity, planner, metrics, window=None,
+                            wenc=()):
         """Steady-state launch: cached slot + bucket LUT, no stats
         hostsync; the post-launch overflow check is the site's single
         budgeted sync.  Overflow re-runs at full capacity and records a
@@ -505,8 +559,8 @@ class DistributedAggregate:
         self.last_stats = {"slot": slot, "capacity": capacity,
                            "speculative": True, "packed": self.packed}
         with launch_checkpoint():
-            raw = self._final_jitted(slot)(jnp.asarray(lut),
-                                           partial_flat, n_groups)
+            raw = self._final_jitted(slot, wenc=wenc)(
+                jnp.asarray(lut), partial_flat, n_groups)
         outs, ovf = raw[:-1], raw[-1]
         record_exchange_metrics(
             metrics, dtypes=self._wire_dtypes(),
@@ -514,7 +568,8 @@ class DistributedAggregate:
             else slot,
             num_parts=self.nshards, nshards=self.nshards,
             rows_useful=spec.get("rows", 0), packed=self.packed,
-            site=self._sig + ("final",))
+            site=self._sig + ("final", wenc),
+            wire_encode_cols=len(wenc))
         if window is not None:
             overlap = overlap_metrics_for_session()
 
@@ -565,13 +620,14 @@ class DistributedAggregate:
                            "shuffle-slot-capacity-rerun", str(err))
         self.last_stats["overflow"] = True
         with launch_checkpoint():
-            raw = self._final_jitted(capacity)(jnp.asarray(lut),
-                                               partial_flat, n_groups)
+            raw = self._final_jitted(capacity, wenc=wenc)(
+                jnp.asarray(lut), partial_flat, n_groups)
         record_exchange_metrics(
             metrics, dtypes=self._wire_dtypes(), slot=capacity,
             num_parts=self.nshards, nshards=self.nshards,
             rows_useful=spec.get("rows", 0), packed=self.packed,
-            site=self._sig + ("final",))
+            site=self._sig + ("final", wenc),
+            wire_encode_cols=len(wenc))
         return raw[:-1]
 
 
@@ -664,7 +720,8 @@ class DistributedHashJoin:
                  broadcast_threshold_rows: Optional[int] = None,
                  skew_factor: Optional[float] = None,
                  skew_min_rows: Optional[int] = None,
-                 skew_enabled: Optional[bool] = None):
+                 skew_enabled: Optional[bool] = None,
+                 probe_encoded=None, build_encoded=None):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.config import rapids_conf as rc
 
@@ -712,12 +769,26 @@ class DistributedHashJoin:
         self.skew_min_rows = skew_min_rows
         self._cached_jit = cached_jit
         from spark_rapids_tpu.parallel.shuffle import (
-            packed_enabled, ragged_enabled, topology_strategy)
+            packed_enabled, ragged_enabled, topology_strategy,
+            wire_encoding_enabled)
         self.packed = packed_enabled()
         # topology-aware collective selection + skew-adaptive ragged
         # slots (see DistributedAggregate); both bake into the jit sig
         self.exchange_strategy = topology_strategy(mesh)
         self.ragged, self.ragged_min_savings = ragged_enabled()
+        # compressed wire: per-side ordinal -> dictionary for columns
+        # exchanged as int64 codes (string keys AND code-valued payload
+        # columns both narrow)
+        self._probe_encoded = {int(i): d
+                               for i, d in (probe_encoded or {}).items()}
+        self._build_encoded = {int(i): d
+                               for i, d in (build_encoded or {}).items()}
+        self.wire_encoding = wire_encoding_enabled() and \
+            bool(self._probe_encoded or self._build_encoded)
+        self._p_wenc = tuple(sorted(self._probe_encoded)) \
+            if self.wire_encoding else ()
+        self._b_wenc = tuple(sorted(self._build_encoded)) \
+            if self.wire_encoding else ()
         self._sig = ("dist_join", tuple(mesh.axis_names),
                      tuple(mesh.devices.shape),
                      tuple(str(d) for d in mesh.devices.flat),
@@ -725,21 +796,23 @@ class DistributedHashJoin:
                      tuple(dt.name for dt in self.build_dtypes),
                      tuple(self.probe_key_idx), tuple(self.build_key_idx),
                      join_type, out_factor, ("packed", self.packed),
-                     ("exch", self.exchange_strategy))
+                     ("exch", self.exchange_strategy),
+                     ("wenc", self.wire_encoding))
         self.last_stats: Optional[dict] = None
 
-    def _jitted(self, strategy: str, slots, skewed=()):
-        """Compiled program per (strategy, exchange slots, skew set).
-        A slot entry may be a RaggedPlan; its cache_key stands in for
-        it in the jit signature."""
+    def _jitted(self, strategy: str, slots, skewed=(), wencs=((), ())):
+        """Compiled program per (strategy, exchange slots, skew set,
+        per-side wire-encoding).  A slot entry may be a RaggedPlan; its
+        cache_key stands in for it in the jit signature."""
         from spark_rapids_tpu.parallel.shuffle import RaggedPlan
         slots_sig = tuple(
             s.cache_key() if isinstance(s, RaggedPlan) else s
             for s in slots)
         return self._cached_jit(
-            self._sig + (strategy, slots_sig, tuple(skewed)),
+            self._sig + (strategy, slots_sig, tuple(skewed), wencs),
             lambda: _shard_map(
-                partial(self._step, strategy, slots, tuple(skewed)),
+                partial(self._step, strategy, slots, tuple(skewed),
+                        wencs),
                 mesh=self.mesh,
                 in_specs=(P(self.axis), P(self.axis),
                           P(self.axis), P(self.axis)),
@@ -781,32 +854,36 @@ class DistributedHashJoin:
             m = jnp.logical_or(m, pids == s)
         return m
 
-    def _exchange_one(self, cols, pids, n, slot, site_tag):
+    def _exchange_one(self, cols, pids, n, slot, site_tag, wenc=()):
         """One side's exchange under the resolved collective strategy:
         gather-then-redistribute on DCN-ish axes, ragged (RaggedPlan
         slot) or uniform all_to_all otherwise.  The uniform fallback
         slot for a ragged plan is base+surplus — an upper bound on
         every slice, used only when the lane packer cannot ingest the
-        columns (trace-time consistent)."""
+        columns (trace-time consistent).  ``wenc``: code-column
+        indices narrowing on the wire."""
         from spark_rapids_tpu.parallel.shuffle import (
             RaggedPlan, exchange_via_gather)
         if self.exchange_strategy == "gather":
             return exchange_via_gather(
                 cols, pids, n, self.axis, self.nshards,
                 packed=self.packed,
-                report_site=self._sig + (site_tag,))
+                report_site=self._sig + (site_tag, wenc),
+                wire_encode=wenc)
         if isinstance(slot, RaggedPlan):
             return exchange(
                 cols, pids, n, self.axis, self.nshards,
                 slot=slot.base_slot + slot.surplus_slot,
                 packed=self.packed,
-                report_site=self._sig + (site_tag,), ragged=slot)
+                report_site=self._sig + (site_tag, wenc), ragged=slot,
+                wire_encode=wenc)
         return exchange(cols, pids, n, self.axis, self.nshards,
                         slot=slot, packed=self.packed,
-                        report_site=self._sig + (site_tag,))
+                        report_site=self._sig + (site_tag, wenc),
+                        wire_encode=wenc)
 
-    def _step(self, strategy, slots, skewed, probe_flat, probe_nrows_arr,
-              build_flat, build_nrows_arr):
+    def _step(self, strategy, slots, skewed, wencs, probe_flat,
+              probe_nrows_arr, build_flat, build_nrows_arr):
         from spark_rapids_tpu.ops import joins as J
         from spark_rapids_tpu.parallel.shuffle import all_gather_cols
 
@@ -821,6 +898,7 @@ class DistributedHashJoin:
         # PRE-exchange capacity (the adaptive slot must not shrink it)
         in_probe_cap = probe[0].values.shape[0]
 
+        wenc_p, wenc_b = wencs
         if strategy == "local":
             # host-staged exchange already co-located both sides by key
             # hash off-device: no collective, straight local join
@@ -829,7 +907,8 @@ class DistributedHashJoin:
             build, bn = all_gather_cols(build, bn, self.axis, self.nshards,
                                         packed=self.packed,
                                         report_site=self._sig
-                                        + ("bcast",))
+                                        + ("bcast", wenc_b),
+                                        wire_encode=wenc_b)
         else:
             pkeys = [probe[i] for i in self.probe_key_idx]
             bkeys = [build[i] for i in self.build_key_idx]
@@ -857,12 +936,13 @@ class DistributedHashJoin:
                 sk_cols, n_sk = selection.compact(
                     build, jnp.logical_and(live_b, sk_b))
                 probe, pn = self._exchange_one(probe, ppids, pn,
-                                               slots[0], "probe")
+                                               slots[0], "probe",
+                                               wenc=wenc_p)
                 norm_keys = [norm_cols[i] for i in self.build_key_idx]
                 b1, bn1 = self._exchange_one(
                     norm_cols, hash_partition_ids(norm_keys,
                                                   self.nshards),
-                    n_norm, slots[1], "build")
+                    n_norm, slots[1], "build", wenc=wenc_b)
                 # gather only a bounded prefix: the host sized
                 # slots[2] from the true max per-shard skewed build
                 # count, so the full cap_b column never rides ICI
@@ -876,13 +956,16 @@ class DistributedHashJoin:
                                           self.nshards,
                                           packed=self.packed,
                                           report_site=self._sig
-                                          + ("gather",))
+                                          + ("gather", wenc_b),
+                                          wire_encode=wenc_b)
                 build, bn = concat_prefixes(b1, bn1, b2, bn2)
             else:
                 probe, pn = self._exchange_one(probe, ppids, pn,
-                                               slots[0], "probe")
+                                               slots[0], "probe",
+                                               wenc=wenc_p)
                 build, bn = self._exchange_one(build, bpids, bn,
-                                               slots[1], "build")
+                                               slots[1], "build",
+                                               wenc=wenc_b)
 
         pkeys = [probe[i] for i in self.probe_key_idx]
         bkeys = [build[i] for i in self.build_key_idx]
@@ -971,8 +1054,8 @@ class DistributedHashJoin:
         from spark_rapids_tpu.parallel.exchange_async import (
             overlap_metrics_for_session)
         from spark_rapids_tpu.parallel.shuffle import (
-            metrics_for_session, planner_for_session,
-            record_exchange_metrics)
+            broadcast_wire_dicts, metrics_for_session,
+            planner_for_session, record_exchange_metrics)
         strategy = self.strategy
         total_build = int(host_sync(build_nrows_per_shard).sum())
         if strategy == "auto":
@@ -985,6 +1068,32 @@ class DistributedHashJoin:
             strategy = "shuffle"
         planner = planner_for_session()
         metrics = metrics_for_session()
+        # compressed wire (see DistributedAggregate.__call__): one
+        # dictionary-delta broadcast per launch, covering ONLY the
+        # sides that actually ship encoded under the resolved strategy
+        # (broadcast joins never exchange the probe side; host-staged
+        # launches exchange nothing on the device wire) — a failed
+        # verification degrades this launch to the wide wire
+        wenc_p, wenc_b = (), ()
+
+        def resolve_wire(probe_side: bool, build_side: bool) -> None:
+            nonlocal wenc_p, wenc_b
+            if not (self._probe_encoded or self._build_encoded):
+                return
+            if not self.wire_encoding:
+                metrics.record_encodable_decoded()
+                return
+            sel_p = self._p_wenc if probe_side else ()
+            sel_b = self._b_wenc if build_side else ()
+            dicts = [self._probe_encoded[i] for i in sel_p] \
+                + [self._build_encoded[i] for i in sel_b]
+            if not dicts:
+                return
+            if broadcast_wire_dicts(
+                    self._sig + ("dict", probe_side, build_side),
+                    dicts, metrics):
+                wenc_p, wenc_b = sel_p, sel_b
+
         slots = (None, None)
         skewed = ()
         stats = {"strategy": strategy, "build_rows": total_build}
@@ -993,13 +1102,15 @@ class DistributedHashJoin:
         # window's in-flight budget must charge
         launch_bytes = 0
         if strategy == "broadcast":
+            resolve_wire(False, True)
             # the all-gather moves every shard's full build capacity
             cap_b = int(build_flat[0][0].shape[0]) // self.nshards
             record_exchange_metrics(
                 metrics, dtypes=self.build_dtypes, slot=cap_b,
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=total_build, packed=self.packed,
-                site=self._sig + ("bcast",))
+                site=self._sig + ("bcast", wenc_b),
+                wire_encode_cols=len(wenc_b))
         if strategy == "shuffle":
             phist, bhist = self._stats_jitted()(
                 probe_flat, probe_nrows_per_shard,
@@ -1019,16 +1130,23 @@ class DistributedHashJoin:
             from spark_rapids_tpu.parallel.shuffle import wire_row_bytes
             thr = staging_threshold()
             if thr and self.exchange_strategy != "gather":
+                # staging sized from POST-encoding byte counts: the
+                # narrowed wire halves each code column's contribution
+                # (the INTENDED wire — the dict broadcast below runs
+                # only when the launch stays on the device collective)
                 est = (self.nshards * self.nshards
                        * pick_slot(int(pcounts.max()), cap_p)
-                       * wire_row_bytes(self.probe_dtypes)
+                       * max(wire_row_bytes(self.probe_dtypes)
+                             - 4 * len(self._p_wenc), 1)
                        + self.nshards * self.nshards
                        * pick_slot(int(bcounts.max()), cap_b)
-                       * wire_row_bytes(self.build_dtypes))
+                       * max(wire_row_bytes(self.build_dtypes)
+                             - 4 * len(self._b_wenc), 1))
                 if est > thr:
                     return self._staged_call(
                         probe_flat, pcounts, build_flat, bcounts,
                         metrics)
+            resolve_wire(True, True)
             # skew detection on the probe destination totals
             # (OptimizeSkewedJoin: partition > factor * median)
             dest_p = pcounts.sum(axis=0)
@@ -1077,7 +1195,8 @@ class DistributedHashJoin:
                     num_parts=self.nshards, nshards=self.nshards,
                     rows_useful=int(bcounts[:, sk].sum()),
                     packed=self.packed,
-                    site=self._sig + ("gather",))
+                    site=self._sig + ("gather", wenc_b),
+                    wire_encode_cols=len(wenc_b))
                 launch_bytes += metrics.last_exchange_bytes
             else:
                 u_p = planner.plan(p_site, int(pcounts.max()), cap_p)
@@ -1111,8 +1230,8 @@ class DistributedHashJoin:
                 else (slots[0] if rag_p is None else 0),
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=int(pcounts.sum()), packed=self.packed,
-                site=self._sig + ("probe",), ragged=rag_p,
-                counts=pcounts)
+                site=self._sig + ("probe", wenc_p), ragged=rag_p,
+                counts=pcounts, wire_encode_cols=len(wenc_p))
             launch_bytes += metrics.last_exchange_bytes
             record_exchange_metrics(
                 metrics, dtypes=self.build_dtypes,
@@ -1120,8 +1239,8 @@ class DistributedHashJoin:
                 else (slots[1] if rag_b is None else 0),
                 num_parts=self.nshards, nshards=self.nshards,
                 rows_useful=int(bcounts.sum()), packed=self.packed,
-                site=self._sig + ("build",), ragged=rag_b,
-                counts=bcounts)
+                site=self._sig + ("build", wenc_b), ragged=rag_b,
+                counts=bcounts, wire_encode_cols=len(wenc_b))
             launch_bytes += metrics.last_exchange_bytes
             stats.update(probe_counts=pcounts, build_counts=bcounts,
                          slots=tuple(repr(s) if isinstance(s, RaggedPlan)
@@ -1136,7 +1255,8 @@ class DistributedHashJoin:
         cp = launch_checkpoint() if strategy == "shuffle" \
             else contextlib.nullcontext()
         with cp:
-            out = self._jitted(strategy, slots, skewed)(
+            out = self._jitted(strategy, slots, skewed,
+                               (wenc_p, wenc_b))(
                 probe_flat, probe_nrows_per_shard,
                 build_flat, build_nrows_per_shard)
         if strategy == "shuffle":
@@ -1184,5 +1304,6 @@ class DistributedHashJoin:
                            "build_rows": int(bcounts.sum()),
                            "wire": metrics.snapshot()}
         with launch_checkpoint():
-            return self._jitted("local", (None, None))(
+            return self._jitted("local", (None, None),
+                                wencs=((), ()))(
                 pf, jnp.asarray(pcounts), bf, jnp.asarray(bcounts))

@@ -54,6 +54,12 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.ops.expressions import ColVal
 from spark_rapids_tpu.parallel.partitioning import layout_by_partition
+from spark_rapids_tpu.robustness.inject import register_point
+
+# chaos surface: bit-flip the compressed dictionary-delta broadcast a
+# wire-encoded exchange ships (WireDictBroadcast) — verification failure
+# degrades that launch to the wide wire, exact results either way
+register_point("shuffle.wire.dict")
 
 
 @contextmanager
@@ -216,6 +222,21 @@ def topology_strategy(mesh, conf=None) -> str:
     return "gather" if axis_link_kind(mesh) == "dcn" else "all_to_all"
 
 
+def wire_encoding_enabled(conf=None) -> bool:
+    """Resolve spark.rapids.tpu.encoding.wire.enabled (the compressed
+    device wire for dictionary-code columns); consumers resolve at
+    construction and bake the narrowed column set into their jit
+    signatures."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        if s is None:
+            return rc.ENCODING_WIRE_ENABLED.default
+        conf = s.conf
+    return conf.get(rc.ENCODING_WIRE_ENABLED)
+
+
 def packed_enabled(conf=None) -> bool:
     """Resolve spark.rapids.tpu.shuffle.packed.enabled: explicit conf >
     active session > entry default.  Exchange consumers resolve this at
@@ -305,7 +326,8 @@ def _ragged_site(site, rp: "RaggedPlan"):
 
 
 def _record_wire_report(site, cols, plan, surplus_rounds: int = 0,
-                        fallback: bool = False) -> None:
+                        fallback: bool = False,
+                        saved_per_row: int = 0) -> None:
     import numpy as np
     if site is None:
         return
@@ -324,10 +346,48 @@ def _record_wire_report(site, cols, plan, surplus_rounds: int = 0,
             max(np.dtype(c.values.dtype).itemsize, 1) for c in cols) \
             + nullable
         rb32, rb8 = 0, 0
+    # saved_per_row: bytes/row the wire-encoding narrow transform shaved
+    # BEFORE packing — cols already hold the narrowed dtypes, so
+    # row_bytes above is the true (post-encoding) wire cost and this
+    # field attributes the delta (encodedBytesSaved)
     _WIRE_REPORTS[site] = {"collectives": collectives,
                            "row_bytes": row_bytes,
                            "row_bytes32": rb32, "row_bytes8": rb8,
+                           "row_bytes_saved": saved_per_row,
                            "fallback": fallback}
+
+
+def _narrow_wire_cols(cols: Sequence[ColVal],
+                      wire_encode) -> Tuple[List[ColVal], Tuple[int, ...]]:
+    """Trace-time wire transform for dictionary-code columns: an int64
+    code column ships as ONE i32 lane instead of two (codes are dense
+    dictionary ranks, so they fit i32 by construction — the encoders
+    bound dictionaries far below 2^31).  Returns the transformed list
+    plus the indices actually narrowed (for the inverse widen)."""
+    if not wire_encode:
+        return list(cols), ()
+    out = list(cols)
+    narrowed = []
+    for i in wire_encode:
+        c = out[i]
+        if getattr(c.values, "dtype", None) == jnp.int64:
+            out[i] = ColVal(c.dtype, c.values.astype(jnp.int32),
+                            c.validity, c.offsets)
+            narrowed.append(int(i))
+    return out, tuple(narrowed)
+
+
+def _widen_wire_cols(out_cols: List[ColVal],
+                     narrowed: Tuple[int, ...]) -> List[ColVal]:
+    """Invert :func:`_narrow_wire_cols` on the received columns —
+    downstream consumers see the exact int64 code values (dead padding
+    rows widen to different-but-dead garbage; validity/in-range masks
+    already exclude them)."""
+    for i in narrowed:
+        c = out_cols[i]
+        out_cols[i] = ColVal(c.dtype, c.values.astype(jnp.int64),
+                             c.validity, c.offsets)
+    return out_cols
 
 
 def _plan_pack(cols: Sequence[ColVal]) -> Optional[_PackPlan]:
@@ -437,7 +497,8 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
              packed: Optional[bool] = None,
              with_overflow: bool = False,
              report_site=None,
-             ragged: Optional[RaggedPlan] = None):
+             ragged: Optional[RaggedPlan] = None,
+             wire_encode: Sequence[int] = ()):
     """All-to-all exchange inside shard_map.
 
     Every shard sends row r to shard ``pids[r]``.  Returns (received
@@ -466,6 +527,13 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         packed = packed_enabled()
     sorted_cols, counts, starts = layout_by_partition(
         cols, pids, nrows, num_parts)
+    # compressed wire (encoding.wire.enabled): caller-marked dictionary
+    # code columns narrow to i32 lanes here — AFTER partitioning (pids
+    # were computed on the original values) and BEFORE lane packing, so
+    # every wire variant below (packed/ragged/per-column) ships the
+    # narrow form and the trace-time report meters post-encoding bytes
+    sorted_cols, narrowed = _narrow_wire_cols(sorted_cols, wire_encode)
+    saved_pr = 4 * len(narrowed)
 
     # counts for my slices on every peer: all_to_all of the counts vector
     recv_counts = jax.lax.all_to_all(
@@ -479,10 +547,16 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         # caller also passed — trace-time consistent either way)
         _record_wire_report(_ragged_site(report_site, ragged),
                             sorted_cols, plan,
-                            surplus_rounds=len(ragged.rounds))
-        return _exchange_ragged(sorted_cols, plan, counts, recv_counts,
-                                starts, capacity, axis_name, num_parts,
-                                ragged, with_overflow)
+                            surplus_rounds=len(ragged.rounds),
+                            saved_per_row=saved_pr)
+        res = _exchange_ragged(sorted_cols, plan, counts, recv_counts,
+                               starts, capacity, axis_name, num_parts,
+                               ragged, with_overflow)
+        if with_overflow:
+            rcols, rtotal, rovf = res
+            return _widen_wire_cols(rcols, narrowed), rtotal, rovf
+        rcols, rtotal = res
+        return _widen_wire_cols(rcols, narrowed), rtotal
     if ragged is not None:
         # ragged was requested but the lane packer refused the columns:
         # this program runs the uniform per-column wire at the caller's
@@ -491,7 +565,8 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         # bytes (the plain-site report may belong to a different
         # variant compiled at the same signature).
         _record_wire_report(_ragged_site(report_site, ragged),
-                            sorted_cols, None, fallback=True)
+                            sorted_cols, None, fallback=True,
+                            saved_per_row=saved_pr)
 
     # gather each destination's rows into its padded slot: send[d, j]
     j = jnp.arange(slot, dtype=jnp.int32)[None, :]
@@ -503,7 +578,8 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     part, offset, in_range = _compaction_indices(
         recv_counts, total, num_parts, slot)
 
-    _record_wire_report(report_site, sorted_cols, plan)
+    _record_wire_report(report_site, sorted_cols, plan,
+                        saved_per_row=saved_pr)
     if packed and plan is None and cols:
         # trace-time breadcrumb: the fused wire was requested but these
         # columns are unpackable, so this program runs per-column
@@ -538,6 +614,7 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
                                            split_axis=0, concat_axis=0)
                 validity = jnp.where(in_range, vrecv[part, offset], False)
             out_cols.append(ColVal(c.dtype, flat, validity))
+    out_cols = _widen_wire_cols(out_cols, narrowed)
     if with_overflow:
         return out_cols, total, jnp.any(counts > slot)
     return out_cols, total
@@ -623,7 +700,8 @@ def exchange_via_gather(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
                         axis_name: str, num_parts: int,
                         packed: Optional[bool] = None,
                         with_overflow: bool = False,
-                        report_site=None):
+                        report_site=None,
+                        wire_encode: Sequence[int] = ()):
     """Gather-then-redistribute exchange: ONE all_gather per width
     group (rows + their destination ids), then every shard compacts its
     own rows locally — no all_to_all on the wire.  Fewer, larger
@@ -637,7 +715,8 @@ def exchange_via_gather(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     pid_col = ColVal(dts.INT32, pids.astype(jnp.int32))
     gathered, total = all_gather_cols(
         list(cols) + [pid_col], nrows, axis_name, num_parts,
-        packed=packed, report_site=report_site)
+        packed=packed, report_site=report_site,
+        wire_encode=wire_encode)
     out_pids = gathered[-1].values
     me = jax.lax.axis_index(axis_name)
     cap = out_pids.shape[0]
@@ -653,7 +732,8 @@ def exchange_via_gather(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
 def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
                     num_parts: int,
                     packed: Optional[bool] = None,
-                    report_site=None
+                    report_site=None,
+                    wire_encode: Sequence[int] = ()
                     ) -> Tuple[List[ColVal], jnp.ndarray]:
     """Broadcast-style collective: every shard receives every shard's rows.
 
@@ -666,6 +746,7 @@ def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
     capacity = cols[0].values.shape[0] if cols else 0
     if packed is None:
         packed = packed_enabled()
+    cols, narrowed = _narrow_wire_cols(cols, wire_encode)
     counts = jax.lax.all_gather(nrows, axis_name)  # [num_parts]
     starts = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
                               jnp.cumsum(counts)[:-1].astype(jnp.int32)])
@@ -677,7 +758,8 @@ def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
     offset = jnp.clip(pos - starts[part], 0, capacity - 1)
     in_range = pos < total
     plan = _plan_pack(cols) if packed else None
-    _record_wire_report(report_site, cols, plan)
+    _record_wire_report(report_site, cols, plan,
+                        saved_per_row=4 * len(narrowed))
     if packed and plan is None and cols:
         metrics_for_session().record_fallback()  # see exchange()
     if plan is not None:
@@ -687,8 +769,9 @@ def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
             flat32 = jax.lax.all_gather(p32, axis_name)[part, offset]
         if p8 is not None:
             flat8 = jax.lax.all_gather(p8, axis_name)[part, offset]
-        return _unpack_payloads(cols, plan, flat32, flat8,
-                                in_range), total
+        return _widen_wire_cols(
+            _unpack_payloads(cols, plan, flat32, flat8, in_range),
+            narrowed), total
     out_cols: List[ColVal] = []
     for c in cols:
         g = jax.lax.all_gather(c.values, axis_name)  # [num_parts, capacity]
@@ -698,7 +781,7 @@ def all_gather_cols(cols: Sequence[ColVal], nrows, axis_name: str,
             gv = jax.lax.all_gather(c.validity, axis_name)
             validity = jnp.where(in_range, gv[part, offset], False)
         out_cols.append(ColVal(c.dtype, flat, validity))
-    return out_cols, total
+    return _widen_wire_cols(out_cols, narrowed), total
 
 
 # ------------------------------------------------------------- slot planner --
@@ -831,7 +914,8 @@ class ShuffleWireMetrics:
 
     FIELDS = ("exchanges", "collectives", "rowsMoved", "rowsUseful",
               "bytesMoved", "slotOverflowRetries", "perColumnFallbacks",
-              "raggedExchanges")
+              "raggedExchanges", "encodedBytesSaved", "wireDictBytes",
+              "encodableDecodedExchanges", "wireDictFallbacks")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -852,7 +936,7 @@ class ShuffleWireMetrics:
                         rows_useful: int, bytes_moved: int,
                         packed: bool = True, ragged: bool = False,
                         group_bytes: Optional[Dict[str, int]] = None,
-                        per_dest=None) -> None:
+                        per_dest=None, encoded_saved: int = 0) -> None:
         with self._lock:
             c = self.counters
             c["exchanges"] += 1
@@ -860,6 +944,7 @@ class ShuffleWireMetrics:
             c["rowsMoved"] += int(rows_moved)
             c["rowsUseful"] += int(rows_useful)
             c["bytesMoved"] += int(bytes_moved)
+            c["encodedBytesSaved"] += int(encoded_saved)
             if ragged:
                 c["raggedExchanges"] += 1
             self.last_exchange_bytes = int(bytes_moved)
@@ -879,6 +964,22 @@ class ShuffleWireMetrics:
     def record_overflow(self) -> None:
         with self._lock:
             self.counters["slotOverflowRetries"] += 1
+
+    def record_encodable_decoded(self) -> None:
+        """An exchange whose payload carries dictionary-code columns
+        ran with wire encoding OFF — bytes that were free to crush
+        shipped wide (the profiling health-check signal)."""
+        with self._lock:
+            self.counters["encodableDecodedExchanges"] += 1
+
+    def record_wire_dict(self, delta_bytes: int, ok: bool) -> None:
+        """One dictionary-delta broadcast for an encoded exchange
+        launch (ok=False: the delta frame failed verification and the
+        launch degraded to the wide wire)."""
+        with self._lock:
+            self.counters["wireDictBytes"] += int(delta_bytes)
+            if not ok:
+                self.counters["wireDictFallbacks"] += 1
 
     def record_fallback(self) -> None:
         """An exchange that requested the packed wire but traced the
@@ -930,6 +1031,13 @@ class ShuffleWireMetrics:
         if pg:
             out["perGroupBytes"] = {g: v.get("bytesMoved", 0)
                                     for g, v in sorted(pg.items())}
+        saved = d.get("encodedBytesSaved", 0)
+        if saved:
+            # decoded-wire bytes / encoded-wire bytes (>= 1.0): the
+            # headline wire-compression number bench emits
+            out["wireCompressionRatio"] = round(
+                (d.get("bytesMoved", 0) + saved)
+                / max(d.get("bytesMoved", 0), 1), 3)
         return out
 
 
@@ -947,6 +1055,141 @@ def metrics_for_session(session=None) -> ShuffleWireMetrics:
         m = ShuffleWireMetrics()
         session.shuffle_metrics = m
     return m
+
+
+class WireDictBroadcast:
+    """Once-per-exchange dictionary DELTA broadcast for the compressed
+    wire (one instance per session).
+
+    An encoded exchange ships i32 codes; the receive side's eventual
+    decode needs the dictionary.  On a single-controller mesh the
+    dictionary is host-shared, so what actually moves is the DELTA —
+    the entries this exchange *site* has not broadcast yet — and this
+    registry makes that edge real: the delta serializes through the
+    shared frame codec (a real compressed payload, accounted as
+    ``wireDictBytes``), passes the ``shuffle.wire.dict`` fire_mutate
+    chaos point, and round-trips with a crc32 gate.  A delta frame
+    that fails verification degrades THAT launch to the wide
+    (unnarrowed) wire, emits a typed ``EncodedWireInvalid`` event, and
+    resets the site so the next launch rebroadcasts the full
+    dictionary — exact results either way, never wrong bytes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # site -> per-column (entries_broadcast, crc_of_those_entries,
+        # last_seen_list): holding the REFERENCE (not id() — CPython
+        # recycles addresses after GC) lets the steady-state launch
+        # (same dictionary object, nothing appended) skip hashing
+        # entirely, and the crc chains incrementally over the delta —
+        # per-launch host work is O(delta), not O(dictionary)
+        self.sent: Dict[Hashable, List[tuple]] = {}
+
+    @staticmethod
+    def _crc(entries, start: int = 0) -> int:
+        import zlib
+        crc = start
+        for v in entries:
+            b = b"\x00" if v is None else v.encode("utf-8")
+            crc = zlib.crc32(len(b).to_bytes(4, "big") + b, crc)
+        return crc & 0xFFFFFFFF
+
+    def broadcast(self, site, dicts, codec_level: int = 2
+                  ) -> Tuple[int, bool]:
+        """(delta_bytes, ok) for one encoded launch at ``site`` over
+        the exchange's code-column dictionaries."""
+        import numpy as np
+        import zlib
+        from spark_rapids_tpu import native
+        from spark_rapids_tpu.robustness.inject import fire_mutate
+        with self._lock:
+            state = self.sent.get(site)
+            if state is None or len(state) != len(dicts):
+                state = [(0, 0, None)] * len(dicts)
+            deltas = []
+            new_state = []
+            for (n_sent, crc_sent, last_ref), d in zip(state, dicts):
+                if last_ref is d and n_sent <= len(d):
+                    # the SAME append-only list: identity proves the
+                    # sent prefix unchanged — no prefix re-hash; an
+                    # unchanged length is a zero-cost empty delta
+                    if len(d) == n_sent:
+                        deltas.append([])
+                        new_state.append((n_sent, crc_sent, last_ref))
+                        continue
+                elif n_sent > len(d) or \
+                        self._crc(d[:n_sent]) != crc_sent:
+                    # the dictionary diverged from what this site
+                    # already broadcast (a different query's dict at
+                    # the same site): full rebroadcast
+                    n_sent, crc_sent = 0, 0
+                deltas.append(list(d[n_sent:]))
+                # chain the crc over ONLY the delta entries
+                new_state.append((len(d),
+                                  self._crc(d[n_sent:], crc_sent), d))
+        flat = [v for delta in deltas for v in delta]
+        payload = b"\x00".join(
+            b"\x01" if v is None else v.encode("utf-8") for v in flat)
+        want_crc = zlib.crc32(payload) & 0xFFFFFFFF
+        blob = b""
+        ok = True
+        if payload:
+            blob = native.serialize_batch(
+                1, [(0, np.frombuffer(payload, dtype=np.uint8), None,
+                     None)], compress=codec_level)
+            blob = fire_mutate("shuffle.wire.dict", blob)
+            try:
+                _, cols = native.deserialize_batch(blob)
+                got = cols[0][1]
+                got_crc = zlib.crc32(
+                    b"" if got is None else got.tobytes()) & 0xFFFFFFFF
+                ok = got_crc == want_crc
+            except Exception:
+                ok = False
+        with self._lock:
+            if ok:
+                self.sent[site] = new_state
+            else:
+                # force a full rebroadcast next launch; this launch
+                # ships wide
+                self.sent.pop(site, None)
+        return len(blob), ok
+
+
+_default_wire_dicts: Optional[WireDictBroadcast] = None
+
+
+def wire_dicts_for_session(session=None) -> WireDictBroadcast:
+    global _default_wire_dicts
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        if _default_wire_dicts is None:
+            _default_wire_dicts = WireDictBroadcast()
+        return _default_wire_dicts
+    w = getattr(session, "wire_dicts", None)
+    if w is None:
+        w = WireDictBroadcast()
+        session.wire_dicts = w
+    return w
+
+
+def broadcast_wire_dicts(site, dicts, metrics) -> bool:
+    """Consumer-side helper: run the dictionary-delta broadcast for an
+    encoded launch, account the bytes, and on a failed verification
+    emit the typed event and report False (the caller launches the
+    wide-wire program variant)."""
+    if not dicts:
+        return True
+    from spark_rapids_tpu import native
+    delta_bytes, ok = wire_dicts_for_session().broadcast(
+        site, dicts, codec_level=native.frame_codec_level())
+    metrics.record_wire_dict(delta_bytes, ok)
+    if not ok:
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session("EncodedWireInvalid", site=str(site),
+                        deltaBytes=delta_bytes)
+    return ok
 
 
 def wire_row_bytes(dtypes, nullable: Optional[int] = None) -> int:
@@ -979,7 +1222,8 @@ def record_exchange_metrics(metrics: ShuffleWireMetrics, *, dtypes,
                             nullable: Optional[int] = None,
                             site=None, exchanges: int = 1,
                             ragged: Optional[RaggedPlan] = None,
-                            counts=None) -> None:
+                            counts=None,
+                            wire_encode_cols: int = 0) -> None:
     """One consumer-side accounting call per exchange launch: wire rows
     are the padded slots every shard puts on ICI (for a ragged plan,
     the base slots plus each surplus pair's one transmitted buffer);
@@ -1020,9 +1264,13 @@ def record_exchange_metrics(metrics: ShuffleWireMetrics, *, dtypes,
         collectives = rep["collectives"]
         row_bytes = rep["row_bytes"]
         rb32, rb8 = rep.get("row_bytes32", 0), rep.get("row_bytes8", 0)
+        saved_pr = rep.get("row_bytes_saved", 0)
     else:
         collectives = estimate_collectives(dtypes, packed, nullable)
-        row_bytes = wire_row_bytes(dtypes, nullable)
+        # pre-trace estimate: each wire-encoded int64 code column ships
+        # one i32 lane instead of two
+        saved_pr = 4 * int(wire_encode_cols)
+        row_bytes = max(wire_row_bytes(dtypes, nullable) - saved_pr, 0)
         rb32 = rb8 = 0
     if rb32 or rb8:
         group_bytes = {g: rows_moved * rb
@@ -1047,4 +1295,5 @@ def record_exchange_metrics(metrics: ShuffleWireMetrics, *, dtypes,
         rows_useful=int(rows_useful),
         bytes_moved=rows_moved * row_bytes,
         packed=packed, ragged=ragged is not None,
-        group_bytes=group_bytes, per_dest=per_dest)
+        group_bytes=group_bytes, per_dest=per_dest,
+        encoded_saved=rows_moved * saved_pr)

@@ -555,9 +555,56 @@ def _conv_filter(node: L.Filter, children, conf):
                          donate=conf.get(_rc.PIPELINE_DONATION))
 
 
+def _encoding_exec_enabled(conf) -> bool:
+    """Encoded execution conf, minus the session's overflow latch (a
+    dictionary that outgrew maxDictSize latched the session back onto
+    the decoded path; every attempt re-plans, so the latch takes
+    effect on the ladder's next rung)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if not conf.get(rc.ENCODING_EXECUTION_ENABLED):
+        return False
+    from spark_rapids_tpu.api.session import TpuSession
+    return not getattr(TpuSession._active, "encoding_exec_latched",
+                       False)
+
+
+def _agg_kernel_children(agg_out_exprs) -> List[Expression]:
+    """The aggregate-function children inside the output expressions —
+    the subtrees the aggregation KERNELS evaluate (everything else in
+    an output either matches a group key or reads the agg frame after
+    the kernels)."""
+    out: List[Expression] = []
+
+    def walk(e):
+        if isinstance(e, AggregateExpression):
+            if e.func.child is not None:
+                out.append(e.func.child)
+            return
+        for c in e.children:
+            walk(c)
+
+    for e in agg_out_exprs:
+        walk(e)
+    return out
+
+
+def _agg_fold_encodable(group, aggs, conds) -> bool:
+    """True when the fused aggregate fold may run ENCODED over string
+    group keys: no string-valued aggregate buffers (those force the
+    two-stage string path, which cannot carry a fused predicate) and
+    the keys pass the exec's own equality-faithfulness test."""
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    children = _agg_kernel_children(aggs)
+    if any(c.dtype.is_string for c in children):
+        return False
+    return TpuHashAggregateExec.encoded_key_ordinals(
+        group, children + list(conds)) is not None
+
+
 def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
                     pre_filter=None, merge_chunk_rows=1 << 22,
-                    defer_syncs=True):
+                    defer_syncs=True, encoded_exec=False,
+                    max_dict_size=(1 << 31) - 1):
     """Build the aggregate exec, plus a result projection when outputs
     combine aggregates in larger expressions (sum(x)*100, sum(a)/sum(b)...
     — Catalyst's resultExpressions split)."""
@@ -610,11 +657,13 @@ def _plan_aggregate(group_exprs, agg_out_exprs, child_exec,
             group_exprs,
             [(name, a) for (name, _), a in zip(out_named, agg_list)],
             child_exec, pre_filter=pre_filter,
-            merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs)
+            merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs,
+            encoded_exec=encoded_exec, max_dict_size=max_dict_size)
     agg_exec = TpuHashAggregateExec(
         group_exprs, [(f"_a{i}", a) for i, a in enumerate(agg_list)],
         child_exec, pre_filter=pre_filter,
-        merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs)
+        merge_chunk_rows=merge_chunk_rows, defer_syncs=defer_syncs,
+        encoded_exec=encoded_exec, max_dict_size=max_dict_size)
     proj = [BoundReference(i, dt, name=n)
             for i, (n, dt) in enumerate(agg_exec.schema[:nkeys])]
     proj += [Alias(rewritten, name) for name, rewritten in out_named]
@@ -626,7 +675,10 @@ def _conv_aggregate(node: L.Aggregate, children, conf):
     from spark_rapids_tpu.config import rapids_conf as rc
     return _plan_aggregate(node.group_exprs, node.agg_exprs, children[0],
                            merge_chunk_rows=conf.get(rc.AGG_MERGE_CHUNK_ROWS),
-                           defer_syncs=conf.get(rc.PIPELINE_DEFER_SYNCS))
+                           defer_syncs=conf.get(rc.PIPELINE_DEFER_SYNCS),
+                           encoded_exec=_encoding_exec_enabled(conf),
+                           max_dict_size=conf.get(
+                               rc.ENCODING_EXECUTION_MAX_DICT))
 
 
 @_converter(L.Limit)
@@ -1099,8 +1151,21 @@ class TpuOverrides:
             hops += 1
         if hops == 0:
             return None  # nothing upstream to fuse
+        enc_exec = _encoding_exec_enabled(self.conf)
         if any(e.dtype.is_string for e in group):
-            return None  # string keys take the host dict-encode path
+            # string keys fuse ONLY under encoded execution, and only
+            # when the exec's faithfulness test passes (bare refs, key
+            # columns consumed nowhere else, no string agg buffers) —
+            # otherwise the host dict-encode path runs unfused
+            if not (enc_exec and _agg_fold_encodable(group, aggs,
+                                                     conds)):
+                return None
+        elif conds and any(
+                c.dtype.is_string for c in _agg_kernel_children(aggs)):
+            # string-valued min/max buffers run the two-stage string
+            # path, which cannot carry a fused predicate: leave the
+            # chain unfused (the predicate compacts before the agg)
+            return None
         from spark_rapids_tpu.exec.fusion import has_check_exprs
         if has_check_exprs(group + aggs + conds):
             # the aggregation kernels have no ANSI check-flag channel:
@@ -1123,7 +1188,9 @@ class TpuOverrides:
         fused = _plan_aggregate(
             group, aggs, base, pre_filter=conds or None,
             merge_chunk_rows=self.conf.get(rc.AGG_MERGE_CHUNK_ROWS),
-            defer_syncs=self.conf.get(rc.PIPELINE_DEFER_SYNCS))
+            defer_syncs=self.conf.get(rc.PIPELINE_DEFER_SYNCS),
+            encoded_exec=enc_exec,
+            max_dict_size=self.conf.get(rc.ENCODING_EXECUTION_MAX_DICT))
         # runtime dispatch-savings attribution (QueryEnd fusion dict):
         # each folded operator would have cost one dispatch per batch
         agg_exec = fused if isinstance(fused, TpuHashAggregateExec) \

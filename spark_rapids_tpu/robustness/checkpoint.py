@@ -293,6 +293,7 @@ class CheckpointManager:
         out = dict(self.local)
         out["live"] = len(self._entries)
         out["liveBytes"] = self.live_bytes
+        out["liveBytesRaw"] = self.live_bytes_raw
         return out
 
     def note_distributed_complete(self) -> None:
@@ -303,8 +304,26 @@ class CheckpointManager:
         pruning is sound — a shared session attribute like
         ``last_dist_explain`` would race under concurrent queries."""
 
+    @staticmethod
+    def _entry_bytes(entry) -> int:
+        """Bytes an entry occupies at its CURRENT tier: compressed
+        host/disk frames (encoding.storage.hostCodec) meter their
+        encoded size, so maxBytes buys proportionally more retained
+        lineage when the codec is on."""
+        h = getattr(entry, "handle", None)
+        if h is not None and not h.closed:
+            return h.stored_bytes
+        return entry.size_bytes
+
     @property
     def live_bytes(self) -> int:
+        return sum(self._entry_bytes(e)
+                   for e in self._entries.values())
+
+    @property
+    def live_bytes_raw(self) -> int:
+        """Decoded (device-canonical) size of the same entries — the
+        raw side of the storage-compression ratio."""
         return sum(e.size_bytes for e in self._entries.values())
 
     # ------------------------------------------------------------------ write --

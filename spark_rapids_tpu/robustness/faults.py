@@ -177,6 +177,30 @@ class AsyncExchangeOverflow(ShuffleSlotOverflow):
         super().__init__(site, slot, capacity)
 
 
+class EncodingOverflowFault(Exception):
+    """An encoded-execution dictionary outgrew
+    ``spark.rapids.tpu.encoding.execution.maxDictSize`` mid-query.
+    Codes already issued are stable and correct, but the operator
+    cannot un-encode batches it has processed, so the raising site
+    LATCHES encoded execution off for the session before raising.
+    RETRYABLE, not degradable: every attempt re-plans from the logical
+    plan, and with the latch set the re-planned attempt takes the
+    decoded host-dictionary path — not identical re-execution, exact
+    results."""
+
+    kind = "encoding_overflow"
+    severity = RETRYABLE
+
+    def __init__(self, site: str, size: int, limit: int):
+        super().__init__(
+            f"encoded-execution dictionary at {site} grew to {size} "
+            f"distinct values > maxDictSize {limit}; encoded execution "
+            "latched off, re-planning on the decoded path")
+        self.site = site
+        self.size = size
+        self.limit = limit
+
+
 class AdmissionFault(Exception):
     """The serving layer rejected this query at (or after) admission:
     the fair admission queue timed out / overflowed, or the query blew
@@ -247,6 +271,8 @@ def classify(exc: BaseException) -> Fault:
     if isinstance(exc, CorruptionFault):
         return Fault(exc.kind, exc.severity)
     if isinstance(exc, ShuffleSlotOverflow):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, EncodingOverflowFault):
         return Fault(exc.kind, exc.severity)
     from spark_rapids_tpu.memory.retry import SplitAndRetryOOM, is_oom
     if isinstance(exc, SplitAndRetryOOM):

@@ -88,7 +88,8 @@ class IncrementalMetrics(CheckpointMetrics):
 
     FIELDS = ("ticks", "incrementalTicks", "fullRecomputes", "commits",
               "rollbacks", "writes", "bytesWritten", "resumes",
-              "stagesSkipped", "evictions", "invalid", "stateBytes")
+              "stagesSkipped", "evictions", "invalid", "stateBytes",
+              "stateBytesRaw")
 
     def set(self, field: str, value: int) -> None:
         with self._lock:
@@ -348,7 +349,18 @@ class IncrementalStateStore(CheckpointManager):
 
     @property
     def state_bytes(self) -> int:
+        """STORED bytes of all standing state — compressed host/disk
+        frames meter their encoded size, so maxStateBytes holds
+        proportionally more state when the storage codec is on."""
         n = self.live_bytes
+        for st in (self._agg, self._agg_prov):
+            if st is not None:
+                n += self._entry_bytes(st)
+        return n
+
+    @property
+    def state_bytes_raw(self) -> int:
+        n = self.live_bytes_raw
         for st in (self._agg, self._agg_prov):
             if st is not None:
                 n += st.size_bytes
@@ -397,6 +409,7 @@ class IncrementalStateStore(CheckpointManager):
         self._evict_over_budget()
         incremental_metrics.bump("commits")
         incremental_metrics.set("stateBytes", self.state_bytes)
+        incremental_metrics.set("stateBytesRaw", self.state_bytes_raw)
         self._emit("StateCommit", epoch=self.epoch,
                    stateBytes=self.state_bytes,
                    entries=len(self._entries), mode=mode,

@@ -101,6 +101,7 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
     exchanged, exch, coll, moved, useful, bytes_, ovf, fb = \
         0, 0, 0, 0, 0, 0, 0, 0
     overlap_ms, wall_ms, async_n, ragged_n, staged_b = 0.0, 0.0, 0, 0, 0
+    enc_saved, dict_b, enc_decoded, dict_fb = 0, 0, 0, 0
     for a in apps:
         for q in a.queries:
             s = q.shuffle
@@ -119,6 +120,10 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
             async_n += s.get("asyncExchanges", 0)
             ragged_n += s.get("raggedExchanges", 0)
             staged_b += s.get("hostStagedBytes", 0)
+            enc_saved += s.get("encodedBytesSaved", 0)
+            dict_b += s.get("wireDictBytes", 0)
+            enc_decoded += s.get("encodableDecodedExchanges", 0)
+            dict_fb += s.get("wireDictFallbacks", 0)
     if not exchanged:
         return {}
     return {
@@ -129,6 +134,12 @@ def shuffle_wire_stats(apps: List[AppInfo]) -> Dict[str, float]:
         "padding_ratio": moved / max(useful, 1),
         "slot_overflow_retries": ovf,
         "per_column_fallbacks": fb,
+        # compressed wire (encoding.wire.enabled): bytes the code
+        # narrowing shaved plus the dictionary-delta broadcast cost
+        "encoded_bytes_saved": enc_saved,
+        "wire_dict_bytes": dict_b,
+        "wire_dict_fallbacks": dict_fb,
+        "encodable_decoded_exchanges": enc_decoded,
         # async exchange/compute overlap (parallel/exchange_async.py):
         # overlap_fraction is the headline — how much of the exchange
         # tail the host spent dispatching downstream work instead of
@@ -225,7 +236,7 @@ def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
     queries (exec/fusion.py, ops/jit_cache.py): stages/operators fused,
     jit dispatches saved, chains that COULD have fused but ran unfused,
     and the persistent tier's warm-start hit rate."""
-    touched = stages = ops = saved = chains = 0
+    touched = stages = ops = saved = chains = encoded = 0
     phits = pmisses = pinvalid = pstores = 0
     for a in apps:
         for q in a.queries:
@@ -237,6 +248,7 @@ def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
             ops += fu.get("fusedOperators", 0)
             saved += fu.get("dispatchesSaved", 0)
             chains += fu.get("fusibleChains", 0)
+            encoded += fu.get("encodedStages", 0)
             phits += fu.get("persistentHits", 0)
             pmisses += fu.get("persistentMisses", 0)
             pinvalid += fu.get("persistentInvalid", 0)
@@ -249,6 +261,7 @@ def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
         "fused_operators": ops,
         "dispatches_saved": saved,
         "fusible_chains": chains,
+        "encoded_stages": encoded,
         "persistent_hits": phits,
         "persistent_misses": pmisses,
         "persistent_invalid": pinvalid,
@@ -353,6 +366,22 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                         "back to per-column collectives — an unpackable "
                         "column or packed.enabled=false defeats the "
                         "fused shuffle wire format")
+                if sh.get("encodableDecodedExchanges", 0):
+                    problems.append(
+                        f"{a.session_id} query {q.query_id}: "
+                        f"{sh['encodableDecodedExchanges']} exchange(s) "
+                        "carried dictionary-code columns but shipped "
+                        "them DECODED (wide) — enable spark.rapids.tpu"
+                        ".encoding.wire.enabled to crush the free "
+                        "bytes (docs/performance.md \"Encoded "
+                        "execution\")")
+                if sh.get("wireDictFallbacks", 0):
+                    problems.append(
+                        f"{a.session_id} query {q.query_id}: "
+                        f"{sh['wireDictFallbacks']} wire dictionary-"
+                        "delta broadcast(s) failed verification — the "
+                        "launch degraded to the wide wire and the "
+                        "dictionary rebroadcasts in full next launch")
                 if sh.get("slotOverflowRetries", 0):
                     problems.append(
                         f"{a.session_id} query {q.query_id}: "
@@ -757,6 +786,15 @@ def format_report(apps: List[AppInfo], top: int) -> str:
                 f"async={sw['async_exchanges']} "
                 f"ragged={sw['ragged_exchanges']} "
                 f"hostStaged={sw['host_staged_bytes']}B")
+        if sw.get("encoded_bytes_saved") or \
+                sw.get("encodable_decoded_exchanges"):
+            total = sw["bytes_moved"] + sw["encoded_bytes_saved"]
+            out.append(
+                f"  encoded wire: saved={sw['encoded_bytes_saved']}B "
+                f"({sw['encoded_bytes_saved'] / max(total, 1):.0%} of "
+                f"decoded) dictDelta={sw['wire_dict_bytes']}B "
+                f"dictFallbacks={sw['wire_dict_fallbacks']} "
+                f"shippedDecoded={sw['encodable_decoded_exchanges']}")
     fu = fusion_stats(apps)
     if fu:
         out.append("\n-- Whole-stage fusion & compile cache --")
@@ -764,7 +802,8 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"  fusedStages={fu['fused_stages']} "
             f"fusedOperators={fu['fused_operators']} "
             f"dispatchesSaved={fu['dispatches_saved']} "
-            f"fusibleChains={fu['fusible_chains']}")
+            f"fusibleChains={fu['fusible_chains']} "
+            f"encodedStages={fu['encoded_stages']}")
         ptotal = fu["persistent_hits"] + fu["persistent_misses"]
         if ptotal or fu["persistent_stores"]:
             out.append(
