@@ -201,6 +201,39 @@ def main() -> None:
 
 
 # ------------------------------------------------------------------- child --
+def trace_conf(extra=None):
+    """Session conf for a bench main: BENCH_TRACE=1 arms span tracing
+    so emissions carry the phase-fraction breakdown."""
+    conf = dict(extra or {})
+    if os.environ.get("BENCH_TRACE"):
+        conf["spark.rapids.tpu.trace.enabled"] = True
+    return conf or None
+
+
+def span_frac_fields(session) -> dict:
+    """Span-derived phase fractions (utils/tracing.py, ISSUE 12) for a
+    bench emission: compile / exchange / spill / unattributed wall
+    fractions of the session's LAST traced query.  Empty when tracing
+    is off — a zero fraction must mean "measured zero", never "not
+    measured"."""
+    from spark_rapids_tpu.utils import tracing
+    sp = getattr(session, "last_span_stats", None)
+    if not tracing.armed() or not sp:
+        return {}
+    wall = sp.get("wallMs") or 0.0
+
+    def frac(ms):
+        return round(ms / wall, 4) if wall else 0.0
+
+    ph = sp.get("phases") or {}
+    return {
+        "compile_ms_frac": frac(ph.get("compile", 0.0)),
+        "exchange_ms_frac": frac(ph.get("exchange", 0.0)),
+        "spill_ms_frac": frac(ph.get("spill", 0.0)),
+        "unattributed_ms_frac": frac(sp.get("unattributedMs", 0.0)),
+    }
+
+
 def gen_host(n: int, seed: int = 42):
     import numpy as np
     rng = np.random.default_rng(seed)
@@ -408,6 +441,7 @@ def child_main() -> None:
             best["state_bytes_raw"] = st["host_raw_bytes_total"]
             best["state_bytes_compressed"] = \
                 st["host_encoded_bytes_total"]
+        best.update(span_frac_fields(session))
 
     def save():
         if best_file:
@@ -417,7 +451,11 @@ def child_main() -> None:
             os.replace(tmp, best_file)
 
     from spark_rapids_tpu.api.session import TpuSession
-    session = TpuSession()
+    # BENCH_TRACE=1 arms span tracing on the measured session: every
+    # emission then carries compile/exchange/spill/unattributed phase
+    # fractions (span_frac_fields).  Off by default — the tracing-off
+    # p50 is the number the overhead pin compares against.
+    session = TpuSession(trace_conf())
     import jax
     dev = jax.devices()[0]
     best["device"] = dev.platform
@@ -632,7 +670,7 @@ def ingest_main(n_ticks: int) -> None:
         return p
 
     try:
-        session = TpuSession()
+        session = TpuSession(trace_conf())
         incremental_metrics.reset()
         first = [write(0), write(1)]
 
@@ -682,6 +720,7 @@ def ingest_main(n_ticks: int) -> None:
             "incremental_reuse_ratio": round(
                 m["incrementalTicks"] / max(m["ticks"], 1), 3),
             "rollbacks": m["rollbacks"],
+            **span_frac_fields(session),
         }))
         sys.stdout.flush()
         runner.close()
@@ -717,8 +756,8 @@ def repeat_main(n_repeats: int) -> None:
         tempfile.mkdtemp(prefix="tpu-jitcache-bench-")
     n_rows = 1 << 20
     try:
-        session = TpuSession(
-            {"spark.rapids.tpu.jitCache.dir": cache_dir})
+        session = TpuSession(trace_conf(
+            {"spark.rapids.tpu.jitCache.dir": cache_dir}))
         df = session.create_dataframe(gen_host(n_rows))
         q6 = make_q6(session, df)
         q1 = make_q1(session, df)
@@ -765,6 +804,7 @@ def repeat_main(n_repeats: int) -> None:
                 fm1["fusedStages"] - fm0["fusedStages"],
             "fused_operator_count":
                 fm1["fusedOperators"] - fm0["fusedOperators"],
+            **span_frac_fields(session),
         }))
         sys.stdout.flush()
         session.stop()
@@ -784,7 +824,7 @@ def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
     import threading
 
     from spark_rapids_tpu.api.session import TpuSession
-    session = TpuSession()
+    session = TpuSession(trace_conf())
     n_rows = 1 << 20
     df = session.create_dataframe(gen_host(n_rows))
     query = make_q6(session, df)
@@ -828,6 +868,7 @@ def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
         "admission_wait_ms": adm.get("totalWaitMs", 0.0),
         "admission_peak_concurrent": adm.get("peakConcurrent", 0),
         "admission_rejected": adm.get("totalRejected", 0),
+        **span_frac_fields(session),
     }))
     sys.stdout.flush()
 

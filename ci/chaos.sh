@@ -561,4 +561,90 @@ print("codec wire-dict spray OK (fallbacks="
 s.stop()
 PY
 
+echo "== tracing-on spray (raise/delay/corrupt with trace.dir set: results bit-identical, traces well-formed even for faulted queries, truncation marker honored at maxEvents=64) =="
+# ISSUE 12 gate: the span runtime must be a pure observer.  The same
+# spray as the hang/corruption pass runs with tracing ARMED and a tiny
+# maxEvents bound; the answer must equal the tracing-off clean run,
+# every exported trace (including the faulted attempts') must validate
+# against the Chrome trace-event schema, and the bounded buffers must
+# announce truncation explicitly.
+python - <<'PY'
+import glob
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.tools.traceview import load_trace, validate_chrome_trace
+from spark_rapids_tpu.utils import tracing
+
+rng = np.random.default_rng(0)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+# many batches (4 files x 256-row reader batches) so one attempt
+# yields well over 64 spans — maxEvents=64 must really truncate
+ddir = tempfile.mkdtemp(prefix="tpu-trace-chaos-data-")
+paths = []
+for i in range(4):
+    p = os.path.join(ddir, f"part-{i}.parquet")
+    pdf.iloc[i * 1000:(i + 1) * 1000].to_parquet(p, index=False)
+    paths.append(p)
+
+def build(s):
+    return (s.read.parquet(*paths)
+            .filter(F.col("v") > -3.0)
+            .group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv"),
+                 F.count(F.col("v")).alias("c")))
+
+# oracle: tracing OFF, no chaos
+s0 = TpuSession({"spark.rapids.sql.reader.batchSizeRows": 256})
+want = build(s0).to_pandas().sort_values("k", ignore_index=True)
+s0.stop()
+
+td = tempfile.mkdtemp(prefix="tpu-trace-chaos-")
+s = TpuSession({
+    "spark.rapids.tpu.trace.dir": td,
+    "spark.rapids.tpu.trace.maxEvents": 64,
+    "spark.rapids.sql.reader.batchSizeRows": 256,
+    "spark.rapids.tpu.watchdog.defaultDeadlineMs": 500,
+    "spark.rapids.memory.tpu.deviceLimitBytes": 65536,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+})
+df = build(s)
+with I.scoped_rules():
+    for point in I.injection_points():
+        I.inject(point, kind="delay", delay_s=0.2, count=2,
+                 probability=0.5, seed=7, all_threads=True)
+    for point in ("spill.corrupt.host", "spill.corrupt.disk"):
+        I.inject(point, kind="corrupt", count=2, probability=0.5,
+                 seed=11, all_threads=True)
+    got = df.to_pandas().sort_values("k", ignore_index=True)
+pd.testing.assert_frame_equal(got, want)  # bit-identical under tracing
+sp = s.last_span_stats
+assert sp and sp["events"], sp
+s.stop()
+tracing.configure(enabled=False)
+files = glob.glob(os.path.join(td, "*.json"))
+assert files, "no trace files under chaos"
+truncated = 0
+for f in files:
+    obj = load_trace(f)
+    problems = validate_chrome_trace(obj)
+    assert not problems, (f, problems)
+    if obj.get("truncated"):
+        truncated += 1
+        assert any(e.get("name") == "trace-truncated"
+                   for e in obj["traceEvents"]), f
+assert truncated >= 1, \
+    "maxEvents=64 under a recovery ladder never truncated"
+print(f"tracing-on spray OK (exact results, {len(files)} trace(s) "
+      f"well-formed, {truncated} truncated with marker, "
+      f"recovery trail: {[r['action'] for r in s.recovery_log]})")
+PY
+
 echo "CHAOS OK"

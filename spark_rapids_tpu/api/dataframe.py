@@ -700,6 +700,7 @@ class DataFrame:
                 ExchangeOverlapMetrics, overlap_metrics_for_session)
             from spark_rapids_tpu.parallel.shuffle import (
                 ShuffleWireMetrics, metrics_for_session)
+            from spark_rapids_tpu.utils import tracing
             events = getattr(self.session, "events", None)
             t0 = _time.perf_counter()
             wire = metrics_for_session(self.session)
@@ -724,6 +725,12 @@ class DataFrame:
                     explain="distributed attempt")
 
             def _end(status, shuffle):
+                # the span drain runs for EVERY envelope exit — events
+                # on or off, success or failure — so trace files exist
+                # for faulted attempts and buffers never pile up
+                wall_ms = (_time.perf_counter() - t0) * 1e3
+                spans = tracing.finish_query(self.session, qid,
+                                             wall_ms, status)
                 if qid is not None:
                     fusion = dict(getattr(self.session,
                                           "last_fusion_stats", None)
@@ -732,11 +739,10 @@ class DataFrame:
                                                     persistent_info()))
                     events.emit(
                         "QueryEnd", queryId=qid, status=status,
-                        durationMs=round(
-                            (_time.perf_counter() - t0) * 1e3, 3),
+                        durationMs=round(wall_ms, 3),
                         metrics={}, spill={}, retry={},
                         distributed=True, shuffle=shuffle,
-                        fusion=fusion,
+                        fusion=fusion, spans=spans,
                         admission=self._admission_info(),
                         explain=self.session.last_dist_explain)
 
@@ -825,6 +831,7 @@ class DataFrame:
             exec_plan = self.session.plan(self.plan,
                                           overrides=overrides)
         self._last_exec = exec_plan
+        from spark_rapids_tpu.utils import tracing
         events = getattr(self.session, "events", None)
         if events is None or not events.enabled:
             from spark_rapids_tpu.exec.fusion import \
@@ -832,8 +839,13 @@ class DataFrame:
             from spark_rapids_tpu.ops.jit_cache import persistent_info
             self.session._current_qid = None
             p0 = persistent_info()
+            t0 = _time.perf_counter()
+            status = "success"
             try:
                 return self._drive(exec_plan)
+            except Exception as e:
+                status = f"failed: {type(e).__name__}"
+                raise
             finally:
                 # session attribute contract matches the distributed
                 # path: last_fusion_stats is set whether or not an
@@ -843,6 +855,12 @@ class DataFrame:
                 fusion.update(collect_runtime_savings(exec_plan))
                 fusion.update(_persistent_delta(p0, persistent_info()))
                 self.session.last_fusion_stats = fusion
+                # span drain runs with or without an event log: bench
+                # reads session.last_span_stats, and trace files must
+                # exist for logless sessions too
+                tracing.finish_query(
+                    self.session, None,
+                    (_time.perf_counter() - t0) * 1e3, status)
         qid = next(self.session._query_ids)
         # the recovery driver stamps RecoveryAction events with the qid
         # of the attempt that failed
@@ -893,12 +911,15 @@ class DataFrame:
             fusion.update(collect_runtime_savings(exec_plan))
             fusion.update(_persistent_delta(pjit0, persistent_info()))
             self.session.last_fusion_stats = fusion
+            wall_ms = (_time.perf_counter() - t0) * 1e3
+            spans = tracing.finish_query(self.session, qid, wall_ms,
+                                         status)
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
-                durationMs=round((_time.perf_counter() - t0) * 1e3, 3),
+                durationMs=round(wall_ms, 3),
                 metrics=exec_plan.collect_metrics(), spill=spill,
                 retry={k: retry1[k] - retry0[k] for k in retry1},
-                pipeline=pipeline, fusion=fusion,
+                pipeline=pipeline, fusion=fusion, spans=spans,
                 admission=self._admission_info())
 
     def to_arrow(self):

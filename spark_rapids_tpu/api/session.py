@@ -117,7 +117,22 @@ class TpuSession:
         self._current_qid = None  # qid of the attempt in flight
         self.events = EventLogger(
             self.conf.get(rc.EVENT_LOG_DIR) or None, self.session_id,
-            conf_snapshot=dict(self.conf.settings))
+            conf_snapshot=dict(self.conf.settings),
+            flush_ms=self.conf.get(rc.EVENT_LOG_FLUSH_MS))
+        # span-tracing runtime (utils/tracing.py): process-global, the
+        # jitCache-tier discipline — this session's trace conf wins.
+        # The observation store persists beside the AOT cache dir when
+        # one is configured (warm starts get warm evidence), else
+        # beside the trace exports.
+        from spark_rapids_tpu.utils import tracing
+        trace_dir = self.conf.get(rc.TRACE_DIR) or None
+        self.last_span_stats = None  # QueryEnd spans rollup mirror
+        tracing.configure(
+            enabled=bool(self.conf.get(rc.TRACE_ENABLED) or trace_dir),
+            trace_dir=trace_dir,
+            max_events=self.conf.get(rc.TRACE_MAX_EVENTS),
+            obs_dir=(self.conf.get(rc.JIT_CACHE_DIR) or trace_dir
+                     or None))
 
     # per-query state views: call sites keep reading/writing
     # ``session._current_qid`` / ``session.checkpoints`` and get the
@@ -166,6 +181,10 @@ class TpuSession:
         ``buf-*`` spill/temp files are deleted, and the catalog's own
         temp dir is removed (the RapidsDiskStore shutdown analog)."""
         self.events.close()
+        from spark_rapids_tpu.utils import tracing
+        obs = tracing.observation_store()
+        if obs is not None:
+            obs.flush()
         cat = getattr(self, "memory_catalog", None)
         if cat is not None:
             cat.close()

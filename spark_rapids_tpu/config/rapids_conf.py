@@ -96,6 +96,50 @@ EVENT_LOG_DIR = conf(
     "qualification/profiling tools (reference analog: Spark event logs + "
     "GpuMetric -> SQLMetrics).", str)
 
+EVENT_LOG_FLUSH_MS = conf(
+    "spark.rapids.tpu.eventLog.flushMs", 0,
+    "Batched event-log flushing: lines are written immediately but "
+    "fsync-class flush()es are coalesced to at most one per this many "
+    "milliseconds, so hot-path emitters (the watchdog monitor, spill "
+    "integrity) stop paying a flush per line. 0 (default) keeps "
+    "flush-per-line (today's behavior). QueryEnd/QueryFatal/SessionEnd "
+    "always flush explicitly, so crash post-mortems still see the "
+    "tail.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+TRACE_ENABLED = conf(
+    "spark.rapids.tpu.trace.enabled", False,
+    "Arm the span-tracing runtime (utils/tracing.py): thread-aware, "
+    "query-attributed wall-clock spans over operator batch loops, "
+    "fused-stage dispatch, jit trace/AOT-cache loads, host syncs, "
+    "exchange launch/resolve, spill tier transitions, checkpoint "
+    "write/resume, incremental tick phases, admission and UDF-pool "
+    "waits. Spans drain at QueryEnd into the QueryEnd 'spans' rollup "
+    "(eventlog QueryInfo.spans -> profiling \"Where the time went\"), "
+    "the per-site observation store, and — with trace.dir set — a "
+    "Perfetto-loadable Chrome trace file per query. Default off; when "
+    "off every span site costs a single branch and results are "
+    "bit-identical either way. Setting trace.dir also arms tracing. "
+    "Process-global (the jitCache.dir discipline): the last-"
+    "constructed session's setting wins.", _to_bool)
+
+TRACE_DIR = conf(
+    "spark.rapids.tpu.trace.dir", "",
+    "Directory for per-query Chrome-trace-event JSON exports "
+    "(tools/traceview.py; open at ui.perfetto.dev). One file per "
+    "query envelope, written at QueryEnd — including failed and fatal "
+    "envelopes, so post-mortems get a timeline. Empty disables export "
+    "(the spans rollup and observation store still work when "
+    "trace.enabled is set). Setting this implies trace.enabled.", str)
+
+TRACE_MAX_EVENTS = conf(
+    "spark.rapids.tpu.trace.maxEvents", 100_000,
+    "Bound on span records per query: per-thread buffers stop "
+    "recording past this many events and the exported trace carries "
+    "an explicit trace-truncated marker with the dropped count — a "
+    "bounded trace never silently reads as complete.", _to_int,
+    _positive)
+
 PROFILE_TRACE = conf(
     "spark.rapids.tpu.profile.trace", False,
     "Wrap each operator's execution in a jax.profiler TraceAnnotation so "

@@ -140,17 +140,32 @@ class TpuExec:
         opTime covers the operator's own iteration steps (the pull of each
         batch), not just generator construction — generators return
         instantly, the work happens in ``next()``."""
+        from spark_rapids_tpu.utils import tracing
         trace = None
         if self.trace_ops:
             from jax.profiler import TraceAnnotation
             trace = TraceAnnotation
         it = self.do_execute()
         timer = self.metrics[OP_TIME]
+        name = self.node_name()
         while True:
             t0 = time.perf_counter_ns()
             try:
-                if trace is not None:
-                    with trace(self.node_name()):
+                # single branch per pull when tracing is off; spans
+                # nest through the child iterator pulls, so the
+                # rollup's exclusive time per operator matches the
+                # opTimeSelf discipline at span granularity.  The
+                # profile.trace jax annotation composes (nests inside
+                # the span) rather than being displaced by it.
+                if tracing._armed:
+                    with tracing.span("operator.batch", op=name):
+                        if trace is not None:
+                            with trace(name):
+                                batch = next(it)
+                        else:
+                            batch = next(it)
+                elif trace is not None:
+                    with trace(name):
                         batch = next(it)
                 else:
                     batch = next(it)

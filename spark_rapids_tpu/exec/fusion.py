@@ -201,6 +201,16 @@ class FusedStageExec(TpuExec):
                 + (", filtered" if self.condition is not None else "")
                 + "]")
 
+    def _compute_batch(self, batch, names):
+        """One fused dispatch (span point ``stage.fused``)."""
+        if self.condition is None:
+            cols = self._fn(batch)
+            return ColumnarBatch(dict(zip(names, cols)),
+                                 batch.row_count)
+        cols, n = self._fn(batch)
+        return None if n == 0 else \
+            ColumnarBatch(dict(zip(names, cols)), n)
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.retry import with_retry
         names = [e.name for e in self.exprs]
@@ -221,6 +231,9 @@ class FusedStageExec(TpuExec):
                 # thread holds no window (parallel/exchange_async.py)
                 resolve_pending()
 
+        from spark_rapids_tpu.utils import tracing
+        stage_op = "+".join(self.members)
+
         def compute(batch):
             # one jit dispatch where the unfused chain pays one per
             # member — the saving the QueryEnd fusion dict reports.
@@ -229,13 +242,10 @@ class FusedStageExec(TpuExec):
             # metric can legitimately exceed members-1 x inputBatches
             # on retried queries
             self.metrics[DISPATCHES_SAVED] += saved_per_batch
-            if self.condition is None:
-                cols = self._fn(batch)
-                return ColumnarBatch(dict(zip(names, cols)),
-                                     batch.row_count)
-            cols, n = self._fn(batch)
-            return None if n == 0 else \
-                ColumnarBatch(dict(zip(names, cols)), n)
+            if tracing._armed:
+                with tracing.span("stage.fused", op=stage_op):
+                    return self._compute_batch(batch, names)
+            return self._compute_batch(batch, names)
 
         if self._fn.donate:
             # donated inputs are consumed by the kernel: operator-level

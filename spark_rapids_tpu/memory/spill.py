@@ -345,6 +345,13 @@ class SpillableHandle:
         self.last_access = self.catalog.next_access_stamp()
         if self.tier == DEVICE:
             return self._device
+        from spark_rapids_tpu.utils import tracing
+        if tracing._armed:
+            with tracing.span(f"spill.restore.{self.tier.lower()}"):
+                return self._materialize_cold()
+        return self._materialize_cold()
+
+    def _materialize_cold(self) -> ColumnarBatch:
         from spark_rapids_tpu.robustness.faults import CorruptionFault
         from spark_rapids_tpu.robustness.inject import fire_mutate
         if self.tier == HOST:
@@ -531,6 +538,14 @@ class SpillableBatchCatalog:
             from spark_rapids_tpu.serving import context as _qc
             ctx = _qc.current()
             owner = ctx.owner_ident if ctx is not None else None
+        from spark_rapids_tpu.utils import tracing
+        if tracing._armed:
+            with tracing.span("spill.register"):
+                return self._register_impl(batch, priority, owner)
+        return self._register_impl(batch, priority, owner)
+
+    def _register_impl(self, batch: ColumnarBatch, priority: int,
+                       owner: Optional[int]) -> SpillableHandle:
         h = SpillableHandle(self, batch, priority, owner=owner)
         with self._lock:
             self._handles[h.id] = h
@@ -580,7 +595,9 @@ class SpillableBatchCatalog:
         holds the lock).  Returns the device bytes freed — the batch
         plus any transient wire reservation; only the batch payload
         itself lands on the host tier."""
-        freed = h.spill_to_host()
+        from spark_rapids_tpu.utils import tracing
+        with tracing.span("spill.demote.host"):
+            freed = h.spill_to_host()
         self.device_bytes -= freed
         self._owner_device_adjust(h.owner, -freed)
         self.host_bytes += h.size_bytes

@@ -297,7 +297,8 @@ class _Entry:
     each input-shape bucket to either the in-process jitted function or
     a warm executable deserialized from the persistent tier."""
 
-    __slots__ = ("sig", "_jit", "_bound", "_lock", "dispatches")
+    __slots__ = ("sig", "_jit", "_bound", "_lock", "dispatches",
+                 "_cold")
 
     def __init__(self, sig, jitted):
         self.sig = sig
@@ -305,6 +306,7 @@ class _Entry:
         self._bound: Dict[Tuple, Callable] = {}
         self._lock = threading.Lock()
         self.dispatches = 0
+        self._cold = True  # first dispatch = trace+compile (span site)
 
     def rebind(self) -> None:
         with self._lock:
@@ -315,6 +317,22 @@ class _Entry:
         # losing a rare racing increment beats serializing every
         # dispatch in the process on one mutex
         self.dispatches += 1
+        if self._cold:
+            # the entry's first dispatch pays the Python trace + XLA
+            # compile (or the AOT deserialize): span it and feed the
+            # site's compile_ms observation.  Later shape-bucket
+            # recompiles (rare) ride untraced — warm dispatches stay a
+            # single branch.  The flag flips even when tracing is off
+            # so arming mid-process never mis-labels a warm site.
+            from spark_rapids_tpu.utils import tracing
+            self._cold = False
+            if tracing._armed:
+                with tracing.span("jit.trace", site=self.sig,
+                                  observe="compile_ms"):
+                    return self._dispatch(args)
+        return self._dispatch(args)
+
+    def _dispatch(self, args):
         tier = _TIER
         if tier is None:
             return self._jit(*args)
@@ -325,11 +343,13 @@ class _Entry:
         return fn(*args)
 
     def _bind(self, key, args, tier: PersistentJitCache) -> Callable:
+        from spark_rapids_tpu.utils import tracing
         store = False
         with self._lock:
             fn = self._bound.get(key)
             if fn is None:
-                exported = tier.load(self.sig, key)
+                with tracing.span("jit.aotLoad", site=self.sig):
+                    exported = tier.load(self.sig, key)
                 if exported is not None:
                     fn = self._guarded(key, jax.jit(exported.call))
                 else:
@@ -345,7 +365,8 @@ class _Entry:
             # cold run with the tier on pays the Python trace twice —
             # the documented price of a zero-trace warm start; holding
             # the lock here would also stall concurrent dispatches
-            tier.store(self.sig, key, self._jit, args)
+            with tracing.span("jit.aotStore", site=self.sig):
+                tier.store(self.sig, key, self._jit, args)
         return fn
 
     def _guarded(self, key, loaded: Callable) -> Callable:

@@ -862,13 +862,24 @@ class DistPlanner:
                 not self._checkpointable(plan):
             return self._dispatch(plan, dry)
         from spark_rapids_tpu.robustness import checkpoint as cp
+        from spark_rapids_tpu.utils import tracing
         sid = cp.stage_id(plan, self.mesh, self._packed,
                           memo=self._fp_memo, inputs=self._fp_inputs)
         if self._resume:
             frame = self._ckpt.restore(sid, self.mesh)
             if frame is not None:
                 return frame
-        frame = self._dispatch(plan, dry)
+        if tracing._armed:
+            # per-stage span keyed by the structural stage id: nested
+            # stages subtract, so the rollup's per-site exclusive time
+            # is each exchange stage's own cost — and the observation
+            # store gets span_ms evidence under the same id the
+            # checkpoint/jit machinery uses
+            with tracing.span("stage.dist", site=sid,
+                              op=type(plan).__name__):
+                frame = self._dispatch(plan, dry)
+        else:
+            frame = self._dispatch(plan, dry)
         # async-exchange barrier BEFORE the checkpoint write: a frame
         # with an unverified speculative slot must never enter the
         # lineage log (a later resume would splice truncated bytes —

@@ -178,9 +178,22 @@ class AsyncExchangeHandle:
                     self._verify()
         finally:
             t_end = time.perf_counter_ns()
+            overlap_ns = t_start - self.dispatch_ns
             self._metrics.record_resolve(
-                overlap_ns=t_start - self.dispatch_ns,
+                overlap_ns=overlap_ns,
                 wall_ns=t_end - self.dispatch_ns)
+            # the in-flight window (dispatch -> resolve start) is the
+            # span-level form of exchangeOverlapMs: exported on the
+            # async track and recorded as the site's overlap_ms
+            # observation, so the PR9 overlap number is reproducible
+            # from spans alone
+            from spark_rapids_tpu.utils import tracing
+            if tracing._armed:
+                tracing.emit_span("exchange.async.inflight",
+                                  self.dispatch_ns, overlap_ns,
+                                  site=self.site)
+                tracing.observe_site(self.site,
+                                     overlap_ms=overlap_ns / 1e6)
             if self._on_done is not None:
                 self._on_done(self)
 
@@ -234,10 +247,17 @@ class ExchangeWindow:
         """Create, budget, and enqueue a handle for a just-dispatched
         exchange.  Over-budget admission resolves oldest-first (the
         bounded in-flight window)."""
-        while self.pending and \
+        if self.pending and \
                 self.inflight_bytes + payload_bytes > self.max_bytes:
-            self.metrics.record_eviction()
-            self.pending[0].resolve()
+            # the in-window wait: verification of older handles is the
+            # backpressure this admit pays before dispatching onward
+            from spark_rapids_tpu.utils import tracing
+            with tracing.span("exchange.window.wait"):
+                while self.pending and \
+                        self.inflight_bytes + payload_bytes > \
+                        self.max_bytes:
+                    self.metrics.record_eviction()
+                    self.pending[0].resolve()
         h = AsyncExchangeHandle(site, payload_bytes, verify,
                                 metrics=self.metrics, on_done=self._done)
         self.pending.append(h)

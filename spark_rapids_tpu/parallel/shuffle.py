@@ -850,6 +850,19 @@ class SlotPlanner:
             if lut is not None:
                 e["lut"] = lut
             e.pop("overflowed", None)
+        from spark_rapids_tpu.utils import tracing
+        if tracing._armed and rows:
+            # per-site evidence for the observation store (ROADMAP
+            # item 3 producer): observed rows, hottest-slice fraction
+            # (1.0 = every row in one (src,dst) slice), and — once the
+            # exchange body has trace-reported its lane layout — the
+            # payload bytes this site moves per launch
+            fields = {"rows": float(rows),
+                      "skew": round(max_slice / max(rows, 1), 4)}
+            rep = wire_report(site)
+            if rep:
+                fields["bytes"] = float(rows * rep["row_bytes"])
+            tracing.observe_site(site, **fields)
 
     def speculative(self, site: Hashable, capacity: int
                     ) -> Optional[dict]:

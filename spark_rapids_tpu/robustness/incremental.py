@@ -687,6 +687,7 @@ class MicroBatchRunner:
         self._paths: List[str] = []   # committed (ingested) input set
         self._ticked = False
         self._lock = threading.Lock()
+        self._phase_log: list = []  # (name, t0_ns, dur_ns) per tick
         self.last_tick_info: Dict[str, object] = {}
 
     # ------------------------------------------------------------- helpers --
@@ -748,7 +749,42 @@ class MicroBatchRunner:
             return self._tick([new_paths] if isinstance(new_paths, str)
                               else list(new_paths))
 
+    def _phased(self, name: str, fn, *args, **kwargs):
+        """Run one tick phase, timing it for the span runtime.  Phase
+        records are EMITTED only at tick end (_tick): a phase contains
+        whole query envelopes whose own spans drain mid-tick, so an
+        open phase span would smear into an inner query's trace —
+        deferred emission keeps tick phases in the tick's own scope."""
+        from spark_rapids_tpu.utils import tracing
+        if not tracing._armed:
+            return fn(*args, **kwargs)
+        import time as _t
+        t0 = _t.perf_counter_ns()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._phase_log.append(
+                (name, t0, _t.perf_counter_ns() - t0))
+
     def _tick(self, new_paths):
+        from spark_rapids_tpu.utils import tracing
+        if not tracing._armed:
+            return self._tick_impl(new_paths)
+        import time as _t
+        self._phase_log = []
+        t0 = _t.perf_counter()
+        try:
+            return self._tick_impl(new_paths)
+        finally:
+            for name, t0_ns, dur_ns in self._phase_log:
+                tracing.emit_span(f"incremental.{name}", t0_ns,
+                                  dur_ns, is_async=False)
+            self._phase_log = []
+            ep = self.store.epoch if self.store is not None else 0
+            tracing.finish_scope(self.session, f"tick-e{ep}",
+                                 (_t.perf_counter() - t0) * 1e3)
+
+    def _tick_impl(self, new_paths):
         from spark_rapids_tpu.plan import logical as L
         if new_paths and self._scan is None:
             raise ValueError(
@@ -788,8 +824,8 @@ class MicroBatchRunner:
             self.store.rollback(f"{type(exc).__name__}: {exc}")
             info["rollbackFrom"] = f"{type(exc).__name__}: {exc}"
             out = self._full_or_rollback(target, info)
-        self.store.commit(info["mode"], info["deltaFiles"],
-                          info["reused"])
+        self._phased("commit", self.store.commit, info["mode"],
+                     info["deltaFiles"], info["reused"])
         self._finish(target, info)
         return self._result_df(out, self.df.plan.schema)
 
@@ -836,17 +872,20 @@ class MicroBatchRunner:
             # after the read would stamp post-mutation identity onto
             # pre-mutation state and hide the mutation forever.
             meta_delta = scan_input_meta(delta)
-            partial = self._run(self._spec.partial_plan(self._scan,
-                                                        delta))
-            merged = self._run(self._spec.merge_plan(
-                [state] + [b for b in partial if b.nrows]))
+            partial = self._phased(
+                "delta", self._run,
+                self._spec.partial_plan(self._scan, delta))
+            merged = self._phased(
+                "merge", self._run, self._spec.merge_plan(
+                    [state] + [b for b in partial if b.nrows]))
             state = self._concat(merged)
             if state is None:
                 from spark_rapids_tpu.columnar.batch import empty_batch
                 state = empty_batch(self._spec.partial_schema)
             self.store.put_state(state, self._meta_fingerprint(
                 meta_committed + meta_delta))
-        out = self._run(self._spec.result_plan([state]))
+        out = self._phased("finalize", self._run,
+                           self._spec.result_plan([state]))
         # counted only once the WHOLE incremental path answered: a
         # finalize-run fault degrades this tick to full recompute and
         # must not leave it double-counted in the reuse ratio
@@ -881,19 +920,22 @@ class MicroBatchRunner:
             # stat before read (see _tick_body): a mid-scan mutation
             # must leave the state stamped with PRE-mutation identity
             fp = self._fingerprint(target)
-            partial = self._run(self._spec.partial_plan(self._scan,
-                                                        target))
+            partial = self._phased(
+                "recompute", self._run,
+                self._spec.partial_plan(self._scan, target))
             state = self._concat(partial)
             if state is None:
                 from spark_rapids_tpu.columnar.batch import empty_batch
                 state = empty_batch(self._spec.partial_schema)
             self.store.put_state(state, fp)
-            return self._run(self._spec.result_plan([state]))
+            return self._phased("finalize", self._run,
+                                self._spec.result_plan([state]))
         # reuse detection reads the STORE-LOCAL resume counter, not the
         # process-global one: concurrent runners must not contaminate
         # each other's reusedState flag
         r0 = self.store.local["resumes"]
-        out = self._run(self._full_plan(target), splice=True)
+        out = self._phased("recompute", self._run,
+                           self._full_plan(target), splice=True)
         info["reused"] = self.store.local["resumes"] > r0
         return out
 

@@ -50,6 +50,7 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from spark_rapids_tpu.robustness import faults as F
+from spark_rapids_tpu.utils import tracing
 
 # monitor cadence bounds: never poll faster than 2ms (a busy loop) or
 # slower than 100ms (a 150ms test deadline must still detect promptly)
@@ -349,8 +350,18 @@ def section(point: str, deadline_ms: Optional[float] = None,
     if session is None:
         session = _active_session()
     ms = _resolve_deadline_ms(point, deadline_ms, session)
+    # every monitored section doubles as a tracing span (the section
+    # taxonomy IS most of the span taxonomy: reader pulls, exchange
+    # launches, host syncs, UDF/pipeline waits, checkpoint writes).
+    # "query" is excluded — it stays open across the QueryEnd drain,
+    # whose wall clock already covers it.
+    sp = tracing.span(point) if point != "query" else None
     if ms <= 0:
-        yield None
+        if sp is None:
+            yield None
+        else:
+            with sp:
+                yield None
         return
     s = Section(point, ms / 1e3, _effective_ident(), session)
     with _lock:
@@ -358,7 +369,11 @@ def section(point: str, deadline_ms: Optional[float] = None,
     _monitor_wake.set()
     _ensure_monitor()
     try:
-        yield s
+        if sp is None:
+            yield s
+        else:
+            with sp:
+                yield s
     finally:
         with _lock:
             _sections.pop(s.id, None)

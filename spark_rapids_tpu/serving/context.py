@@ -179,8 +179,10 @@ class QueryContext:
         ctrl = getattr(self.session, "admission", None)
         if ctrl is None or self.ticket is not None:
             return
+        from spark_rapids_tpu.utils import tracing
         t0 = time.perf_counter()
-        self.ticket = ctrl.acquire(session=self.session)
+        with tracing.span("admission.wait"):
+            self.ticket = ctrl.acquire(session=self.session)
         self.admission_wait_ms = (time.perf_counter() - t0) * 1e3
         self.admission_weight = self.ticket.weight_bytes
 

@@ -74,6 +74,10 @@ class QueryInfo:
     # dropped persistent jit-cache entries (JitCacheInvalid events:
     # reason, entry) — informative; the query recompiled fresh
     jitcache: List[Dict[str, str]] = field(default_factory=list)
+    # span-tracing rollup (QueryEnd spans dict, utils/tracing.py:
+    # wallMs, exclusiveMs, unattributedMs/Frac, overlapMs, phases,
+    # points, operators, sites); empty when tracing was off
+    spans: Dict[str, object] = field(default_factory=dict)
 
     @property
     def succeeded(self) -> bool:
@@ -263,6 +267,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.pipeline = rec.get("pipeline", {})
                 q.shuffle = rec.get("shuffle", {})
                 q.fusion = rec.get("fusion", {})
+                q.spans = rec.get("spans", {}) or {}
                 q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed

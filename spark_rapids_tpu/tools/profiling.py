@@ -269,6 +269,80 @@ def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def span_stats(apps: List[AppInfo]) -> Dict[str, object]:
+    """"Where the time went": aggregate the span rollups (QueryEnd
+    ``spans`` dicts, utils/tracing.py) across traced queries — wall vs
+    attributed exclusive time, the phase stripes, and the top span
+    points by exclusive time.  ``unattributed_frac`` is the headline
+    health metric: wall the taxonomy never covered."""
+    traced = 0
+    wall = excl = unattr = overlap = 0.0
+    phases: Dict[str, float] = defaultdict(float)
+    points: Dict[str, float] = defaultdict(float)
+    for a in apps:
+        for q in a.queries:
+            sp = q.spans
+            if not sp or not sp.get("events"):
+                continue
+            traced += 1
+            wall += sp.get("wallMs", 0.0)
+            excl += sp.get("exclusiveMs", 0.0)
+            unattr += sp.get("unattributedMs", 0.0)
+            overlap += sp.get("overlapMs", 0.0)
+            for ph, ms in (sp.get("phases") or {}).items():
+                phases[ph] += ms
+            for pt, v in (sp.get("points") or {}).items():
+                points[pt] += v.get("exclusiveMs", 0.0)
+    if not traced:
+        return {}
+    return {
+        "queries": traced,
+        "wall_ms": round(wall, 3),
+        "exclusive_ms": round(excl, 3),
+        "unattributed_ms": round(unattr, 3),
+        "unattributed_frac": round(unattr / wall, 4) if wall else 0.0,
+        "overlap_ms": round(overlap, 3),
+        "phases": {k: round(v, 3) for k, v in sorted(phases.items())},
+        "top_points": sorted(points.items(), key=lambda kv: -kv[1]),
+    }
+
+
+# a query whose spans cover less than this fraction of its wall is an
+# instrumentation blind spot — the health check that keeps future
+# instrumentation honest (ISSUE 12 contract: wall - sum(exclusive)
+# > 20% flags)
+UNATTRIBUTED_FRAC_LIMIT = 0.20
+# ignore sub-5ms envelopes: fixed per-query overheads (planning,
+# envelope bookkeeping) legitimately dominate trivial queries
+_UNATTRIBUTED_MIN_WALL_MS = 5.0
+
+
+def site_history(obs_dir: str, top: int = 20) -> str:
+    """Per-site observation history (utils/tracing.ObservationStore):
+    the persisted evidence the self-tuning planner will consume —
+    rendered so a human can consume it first."""
+    from spark_rapids_tpu.utils.tracing import ObservationStore
+    records = ObservationStore.read(obs_dir)
+    if not records:
+        return f"no observation store under {obs_dir}"
+    out = [f"-- Per-site observation history ({obs_dir}) --",
+           f"{'site':18s} {'n':>5s} {'rows':>10s} {'bytes':>12s} "
+           f"{'skew':>6s} {'compile_ms':>10s} {'overlap_ms':>10s} "
+           f"{'span_ms':>9s}"]
+    ranked = sorted(records.items(),
+                    key=lambda kv: -kv[1].get("span_ms", 0.0))
+    for sid, r in ranked[:top]:
+        out.append(
+            f"{sid:18s} {int(r.get('n', 0)):5d} "
+            f"{int(r.get('rows', 0)):10d} {int(r.get('bytes', 0)):12d} "
+            f"{r.get('skew', 0.0):6.3f} {r.get('compile_ms', 0.0):10.1f} "
+            f"{r.get('overlap_ms', 0.0):10.1f} "
+            f"{r.get('span_ms', 0.0):9.1f}")
+    if len(ranked) > top:
+        out.append(f"  ... {len(ranked) - top} more site(s)")
+    return "\n".join(out)
+
+
 def nearest_rank(sorted_vals: List[float], p: float) -> float:
     """Nearest-rank percentile over an ascending list — shared by the
     concurrency report and ``bench.py --concurrency`` so the two can
@@ -388,6 +462,19 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                         f"{sh['slotOverflowRetries']} speculative slot "
                         "overflow(s) re-ran at full capacity — data "
                         "skew shifted under a warm exchange site")
+            sp = q.spans
+            if sp and sp.get("events") and \
+                    sp.get("wallMs", 0.0) >= _UNATTRIBUTED_MIN_WALL_MS \
+                    and sp.get("unattributedFrac", 0.0) > \
+                    UNATTRIBUTED_FRAC_LIMIT:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{sp.get('unattributedMs', 0):.0f}ms of "
+                    f"{sp.get('wallMs', 0):.0f}ms wall "
+                    f"({sp['unattributedFrac']:.0%}) is UNATTRIBUTED "
+                    "by the span taxonomy — an instrumentation blind "
+                    "spot; whatever runs there is invisible to every "
+                    "perf tool (docs/observability.md)")
             fu = q.fusion
             if fu and fu.get("fusibleChains", 0) > \
                     fu.get("fusedStages", 0):
@@ -643,11 +730,58 @@ def plan_dot(q: QueryInfo) -> str:
     return "\n".join(out)
 
 
+# phase stripe palette for span-traced query bars (fixed order so
+# every bar reads the same left-to-right: compile, exchange, compute,
+# spill, wait, then the unattributed remainder in grey)
+_PHASE_COLORS = (("compile", "#e9c46a"), ("exchange", "#2a9d8f"),
+                 ("compute", "#4c956c"), ("spill", "#d1495b"),
+                 ("wait", "#b8b8ff"))
+_UNATTRIBUTED_COLOR = "#cccccc"
+
+
+def _phase_stripes(q: QueryInfo, x0: float, y: int, w: float,
+                   h: int) -> List[str]:
+    """Per-query phase stripes from the span rollup: each phase's
+    exclusive time becomes a proportional segment of the query bar.
+    Returns [] when the query has no span rollup (pre-span logs fall
+    back to the solid status bar)."""
+    sp = q.spans
+    phases = (sp or {}).get("phases") or {}
+    wall = (sp or {}).get("wallMs", 0.0)
+    if not phases or wall <= 0 or not q.succeeded:
+        return []  # failed/pre-span queries keep the solid status bar
+    out = []
+    x = x0
+    segs = [(name, phases.get(name, 0.0)) for name, _ in _PHASE_COLORS
+            if phases.get(name, 0.0) > 0]
+    covered = sum(ms for _, ms in segs)
+    segs.append(("unattributed", max(wall - covered, 0.0)))
+    # worker-thread spans overlap the driver's wall, so summed phase
+    # time can exceed it: normalize by the larger of the two so the
+    # stripes always fill exactly the query's bar
+    total = max(covered, wall)
+    colors = dict(_PHASE_COLORS)
+    colors["unattributed"] = _UNATTRIBUTED_COLOR
+    for name, ms in segs:
+        seg_w = w * min(ms / total, 1.0)
+        if seg_w < 0.1:
+            continue
+        out.append(
+            f"<rect x='{x:.1f}' y='{y + 4}' width='{seg_w:.1f}' "
+            f"height='{h - 10}' fill='{colors[name]}'>"
+            f"<title>q{q.query_id} {name}: {ms:.1f} ms</title></rect>")
+        x += seg_w
+    return out
+
+
 def generate_timeline(apps: List[AppInfo]) -> str:
     """SVG timeline: one lane per session, one bar per query, colored by
     status (the GenerateTimeline.scala:494 role — theirs draws tasks per
     executor; a single-controller SPMD engine's unit of work is the
-    query)."""
+    query).  Queries carrying a span rollup (QueryInfo.spans) render as
+    phase stripes — compile / exchange / compute / spill / wait — with
+    the unattributed remainder in grey; pre-span logs keep the old
+    solid bars."""
     apps = [a for a in apps if a.queries]
     if not apps:
         return "<svg xmlns='http://www.w3.org/2000/svg'/>"
@@ -676,6 +810,10 @@ def generate_timeline(apps: List[AppInfo]) -> str:
             qs = q.start_ts or a.start_ts
             qe = q.end_ts or (qs + q.duration_ms / 1e3)
             w = max((qe - qs) * scale, 2.0)
+            stripes = _phase_stripes(q, x(qs), y, w, lane_h)
+            if stripes:
+                out.extend(stripes)
+                continue
             color = colors.get(q.status, "#d1495b")
             out.append(
                 f"<rect x='{x(qs):.1f}' y='{y + 4}' width='{w:.1f}' "
@@ -810,6 +948,21 @@ def format_report(apps: List[AppInfo], top: int) -> str:
                 f"  persistent jit cache: {fu['persistent_hits']}/"
                 f"{ptotal} warm hits, stores={fu['persistent_stores']} "
                 f"invalid={fu['persistent_invalid']}")
+    ss = span_stats(apps)
+    if ss:
+        out.append("\n-- Where the time went (span tracing) --")
+        out.append(
+            f"  traced queries={ss['queries']} "
+            f"wall={ss['wall_ms']:.1f}ms "
+            f"attributed={ss['exclusive_ms']:.1f}ms "
+            f"unattributed={ss['unattributed_ms']:.1f}ms "
+            f"({ss['unattributed_frac']:.0%}) "
+            f"asyncOverlap={ss['overlap_ms']:.1f}ms")
+        if ss["phases"]:
+            out.append("  phases: " + "  ".join(
+                f"{k}={v:.1f}ms" for k, v in ss["phases"].items()))
+        for pt, ms in ss["top_points"][:8]:
+            out.append(f"    {pt:36s} {ms:10.2f} ms")
     cc = concurrency_stats(apps)
     if cc:
         out.append("\n-- Concurrency & admission --")
@@ -869,7 +1022,15 @@ def main(argv: List[str] = None) -> int:
                     "this epoch-seconds timestamp")
     ap.add_argument("--newest", type=int, default=None, metavar="N",
                     help="only the N most recently started sessions")
+    ap.add_argument("--site-history", metavar="OBS_DIR", default=None,
+                    help="also print the per-site observation history "
+                    "persisted beside the AOT cache dir "
+                    "(utils/tracing.ObservationStore)")
     args = ap.parse_args(argv)
+    if args.site_history and args.logdir == "-":
+        # site history needs no event log: allow '-' as the logdir
+        print(site_history(args.site_history))
+        return 0
     from spark_rapids_tpu.tools.eventlog import filter_apps
     apps = filter_apps(load_logs(args.logdir), match=args.filter_app,
                        started_after=args.started_after,
@@ -894,6 +1055,9 @@ def main(argv: List[str] = None) -> int:
         print(f"query {args.dot} not found", file=sys.stderr)
         return 1
     print(format_report(apps, args.top))
+    if args.site_history:
+        print()
+        print(site_history(args.site_history))
     return 0
 
 

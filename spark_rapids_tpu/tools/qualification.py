@@ -36,6 +36,15 @@ class QualSummary:
     recommendation: str
     estimated_speedup: float = 1.0  # vs a CPU (pandas-class) run
     speedup_calibrated: bool = False  # measured weights vs builtin table
+    # speedup evidence the engine actually measured (PR8/PR10 signals,
+    # QueryEnd fusion + shuffle dicts): whole-stage fusion, encoded
+    # execution, jit dispatches the fused stages saved, and device-wire
+    # bytes the encoded wire shaved — concrete mechanisms behind the
+    # estimate, not another model
+    fused_stages: int = 0
+    encoded_stages: int = 0
+    dispatches_saved: int = 0
+    encoded_bytes_saved: int = 0
 
 
 _REASON_RE = re.compile(r"because (.+)$")
@@ -80,9 +89,15 @@ def qualify_app(app: AppInfo) -> QualSummary:
     reasons: Counter = Counter()
     failed = 0
     speedups, calibrated = _op_speedups()
+    fused = encoded = saved = wire_saved = 0
     for q in app.queries:
         if not q.succeeded:
             failed += 1
+        fu = q.fusion or {}
+        fused += fu.get("fusedStages", 0)
+        encoded += fu.get("encodedStages", 0)
+        saved += fu.get("dispatchesSaved", 0)
+        wire_saved += (q.shuffle or {}).get("encodedBytesSaved", 0)
         for path, m in q.metrics.items():
             name = path.rsplit(".", 1)[-1]
             # self time (exclusive of children) so nested ops don't
@@ -121,7 +136,11 @@ def qualify_app(app: AppInfo) -> QualSummary:
     return QualSummary(app.session_id, len(app.queries), failed,
                        app.total_duration_ms, share, fallbacks, reasons,
                        score, rec, estimated_speedup=est,
-                       speedup_calibrated=calibrated)
+                       speedup_calibrated=calibrated,
+                       fused_stages=int(fused),
+                       encoded_stages=int(encoded),
+                       dispatches_saved=int(saved),
+                       encoded_bytes_saved=int(wire_saved))
 
 
 def format_report(summaries: List[QualSummary]) -> str:
@@ -139,6 +158,13 @@ def format_report(summaries: List[QualSummary]) -> str:
                       "spark-rapids-tpu-cbo-calibrate to measure")
         out.append(f"  estimated speedup vs CPU: "
                    f"{s.estimated_speedup:.2f}x ({basis})")
+        if s.fused_stages or s.encoded_stages or s.dispatches_saved \
+                or s.encoded_bytes_saved:
+            out.append(
+                f"  measured evidence: fusedStages={s.fused_stages} "
+                f"encodedStages={s.encoded_stages} "
+                f"dispatchesSaved={s.dispatches_saved} "
+                f"encodedWireBytesSaved={s.encoded_bytes_saved}")
         out.append(f"  score: {s.score:.1f}  -> {s.recommendation}")
         for reason, n in s.not_on_tpu_reasons.most_common(5):
             out.append(f"    not-on-TPU ({n}x): {reason}")
@@ -150,13 +176,17 @@ def write_csv(summaries: List[QualSummary], path: str) -> None:
         w = csv.writer(fh)
         w.writerow(["session_id", "num_queries", "failed_queries",
                     "total_duration_ms", "tpu_op_time_share",
-                    "fallback_op_count", "estimated_speedup", "score",
+                    "fallback_op_count", "estimated_speedup",
+                    "fused_stages", "encoded_stages",
+                    "dispatches_saved", "encoded_bytes_saved", "score",
                     "recommendation"])
         for s in summaries:
             w.writerow([s.session_id, s.num_queries, s.failed_queries,
                         f"{s.total_duration_ms:.3f}",
                         f"{s.tpu_op_time_share:.4f}", s.fallback_op_count,
                         f"{s.estimated_speedup:.3f}",
+                        s.fused_stages, s.encoded_stages,
+                        s.dispatches_saved, s.encoded_bytes_saved,
                         f"{s.score:.2f}", s.recommendation])
 
 

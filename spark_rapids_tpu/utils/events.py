@@ -25,13 +25,29 @@ import time
 from typing import Any, Dict, Optional
 
 
+# events that always flush through to disk immediately, whatever
+# flushMs says: the records a crash post-mortem cannot live without
+_FLUSH_EVENTS = frozenset({"QueryEnd", "QueryFatal", "SessionEnd"})
+
+
 class EventLogger:
-    """Append-only JSON-lines writer; no-op when dir is empty."""
+    """Append-only JSON-lines writer; no-op when dir is empty.
+
+    ``flush_ms`` (spark.rapids.tpu.eventLog.flushMs) batches flushes:
+    lines still write() immediately (a crash loses at most the OS
+    buffer tail), but the explicit flush() — which hot-path emitters
+    like the watchdog monitor and spill integrity used to pay per
+    line under the lock — is coalesced to one per window.  0 keeps
+    flush-per-line; QueryEnd/QueryFatal/SessionEnd and close() always
+    flush so the tail is durable at every envelope boundary."""
 
     def __init__(self, log_dir: Optional[str], session_id: str,
-                 conf_snapshot: Optional[Dict[str, Any]] = None):
+                 conf_snapshot: Optional[Dict[str, Any]] = None,
+                 flush_ms: int = 0):
         self._lock = threading.Lock()
         self._fh = None
+        self.flush_ms = max(int(flush_ms), 0)
+        self._last_flush = 0.0
         self.path: Optional[str] = None
         if log_dir:
             import atexit
@@ -61,7 +77,19 @@ class EventLogger:
             if self._fh is None:
                 return
             self._fh.write(line + "\n")
-            self._fh.flush()
+            now = time.monotonic()
+            if self.flush_ms == 0 or event in _FLUSH_EVENTS or \
+                    (now - self._last_flush) * 1e3 >= self.flush_ms:
+                self._fh.flush()
+                self._last_flush = now
+
+    def flush(self) -> None:
+        """Force the buffered tail to disk (QueryEnd/close do this
+        implicitly)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._last_flush = time.monotonic()
 
     def close(self) -> None:
         if self._fh is not None:

@@ -149,10 +149,12 @@ def fetch(*buffers):
     """
     import jax
     from spark_rapids_tpu.robustness import watchdog
+    from spark_rapids_tpu.utils import tracing
     watchdog.checkpoint()
     host_sync_metrics.bump(1)
     _charge_budget(1)
-    got = jax.device_get(list(buffers))
+    with tracing.span("hostsync.fetch"):
+        got = jax.device_get(list(buffers))
     return got[0] if len(buffers) == 1 else got
 
 
@@ -160,9 +162,11 @@ def fetch_all(buffers: Sequence):
     """List form of :func:`fetch` (always returns a list)."""
     import jax
     from spark_rapids_tpu.robustness import watchdog
+    from spark_rapids_tpu.utils import tracing
     if not buffers:
         return []
     watchdog.checkpoint()
     host_sync_metrics.bump(1)
     _charge_budget(1)
-    return jax.device_get(list(buffers))
+    with tracing.span("hostsync.fetch"):
+        return jax.device_get(list(buffers))
