@@ -873,6 +873,151 @@ def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
     sys.stdout.flush()
 
 
+# ------------------------------------------------------- overlap workload --
+def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
+    """Overlapping-workload serving bench (the ISSUE 13 acceptance
+    gate): N client threads draw round-robin from a TPC-H q3/q6-family
+    pool over SHARED parquet scans — the near-duplicate dashboard
+    traffic shape.  Phase 1 measures the N-independent baseline (all
+    reuse knobs off, FIFO occupancy); phase 2 re-runs the identical
+    workload with the fair interleaver + result cache + shared stage
+    cache on.  Emits ONE JSON line with aggregate queries/s + rows/s
+    for both phases, the speedup, and the reuse counters
+    (``result_cache_hits``, ``stage_splice_count``).  Both phases warm
+    every pool entry once before their measured window so jit compile
+    cost (process-global cache) cancels out.  Run with
+    ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
+    for the tunnel-proof distributed number (the stage cache needs a
+    mesh; without one only the result cache engages)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import pandas as pd
+
+    import jax
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+
+    d = tempfile.mkdtemp(prefix="tpu-bench-overlap-")
+    n_rows = 1 << 17
+    nfiles = 4
+    try:
+        rng = np.random.default_rng(7)
+        per = n_rows // nfiles
+        files = []
+        for i in range(nfiles):
+            p = os.path.join(d, f"lineitem-{i}.parquet")
+            pd.DataFrame({
+                "l_extendedprice":
+                    rng.uniform(1000.0, 100000.0, per),
+                "l_discount": rng.uniform(0.0, 0.11, per).round(2),
+                "l_quantity":
+                    rng.integers(1, 51, per).astype(np.float64),
+                "l_shipdate":
+                    rng.integers(8766, 10957, per).astype(np.int32),
+                "l_orderkey":
+                    rng.integers(0, 512, per).astype(np.int64),
+            }).to_parquet(p)
+            files.append(p)
+
+        def make_pool(session):
+            lineitem = session.read.parquet(*files)
+
+            def q6(lo, hi):  # q6 family: filter + grand aggregate
+                return (lineitem
+                        .filter((F.col("l_shipdate") >= lo) &
+                                (F.col("l_shipdate") < hi) &
+                                (F.col("l_discount") >= 0.05) &
+                                (F.col("l_quantity") < 24))
+                        .agg(F.sum((F.col("l_extendedprice") *
+                                    F.col("l_discount"))
+                                   .alias("r")).alias("revenue")))
+
+            def q3_agg():  # q3 family: filter + grouped revenue
+                return (lineitem
+                        .filter(F.col("l_shipdate") > 9500)
+                        .group_by("l_orderkey")
+                        .agg(F.sum((F.col("l_extendedprice") *
+                                    (F.lit(1.0) -
+                                     F.col("l_discount")))
+                                   .alias("r")).alias("revenue")))
+
+            def q3_top():  # shares q3_agg's aggregate subtree
+                return q3_agg().orderBy(
+                    F.col("revenue").desc()).limit(10)
+
+            return [lambda: q6(9000, 9500), lambda: q6(9500, 10000),
+                    q3_agg, q3_top, lambda: q6(9000, 10000)]
+
+        def run_phase(conf_extra):
+            mesh = None
+            if jax.device_count() >= 2:
+                from spark_rapids_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(jax.device_count())
+            session = TpuSession(trace_conf(conf_extra), mesh=mesh)
+            pool = make_pool(session)
+            for q in pool:  # warm compile outside the window
+                q().collect()
+            counts = []
+            lock = threading.Lock()
+            stop_at = time.monotonic() + seconds
+
+            def client(ci):
+                i, n = ci, 0
+                while time.monotonic() < stop_at:
+                    pool[i % len(pool)]().collect()
+                    i += 1
+                    n += 1
+                with lock:
+                    counts.append(n)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            rc = session.result_cache.snapshot() \
+                if session.result_cache else {}
+            ss = session.shared_stages.snapshot() \
+                if session.shared_stages else {}
+            il = session.interleaver.snapshot() \
+                if session.interleaver else {}
+            session.stop()
+            return sum(counts) / max(wall, 1e-9), rc, ss, il
+
+        base_qps, _, _, _ = run_phase({})
+        shared_qps, rc, ss, il = run_phase({
+            "spark.rapids.tpu.serving.interleave.enabled": True,
+            "spark.rapids.tpu.serving.resultCache.enabled": True,
+            "spark.rapids.tpu.serving.sharedStage.enabled": True,
+        })
+        print(json.dumps({
+            "metric": "overlap_concurrent_rows_per_sec",
+            "value": round(shared_qps * n_rows),
+            "unit": "rows/s",
+            "concurrency": n_clients,
+            "shared_queries_per_sec": round(shared_qps, 3),
+            "baseline_queries_per_sec": round(base_qps, 3),
+            "speedup_vs_independent": round(
+                shared_qps / max(base_qps, 1e-9), 3),
+            "result_cache_hits": rc.get("hits", 0),
+            "result_cache_invalidations": rc.get("invalidations", 0),
+            "stage_splice_count": ss.get("resumes", 0),
+            "stage_cache_writes": ss.get("writes", 0),
+            "interleave_timeslices": il.get("totalSlices", 0),
+            "interleave_wait_ms": il.get("totalWaitMs", 0.0),
+            "distributed": bool(jax.device_count() >= 2),
+        }))
+        sys.stdout.flush()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main()
@@ -880,7 +1025,11 @@ if __name__ == "__main__":
         idx = sys.argv.index("--concurrency")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 4
         secs = float(os.environ.get("BENCH_CONCURRENCY_SECONDS", "10"))
-        concurrency_main(n, secs)
+        if "--overlap" in sys.argv:
+            overlap_main(n, float(os.environ.get(
+                "BENCH_OVERLAP_SECONDS", str(min(secs, 8.0)))))
+        else:
+            concurrency_main(n, secs)
     elif "--ingest-ticks" in sys.argv:
         idx = sys.argv.index("--ingest-ticks")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8

@@ -647,4 +647,105 @@ print(f"tracing-on spray OK (exact results, {len(files)} trace(s) "
       f"recovery trail: {[r['action'] for r in s.recovery_log]})")
 PY
 
+echo "== shared-cache spray (8 clients, file mutation + corrupt/raise/delay on resultcache.load + shared-store restore: exact answers, zero stale reads) =="
+# ISSUE 13 gate: with the fair interleaver + result cache + shared
+# stage cache ON, 8 client threads hammer a shared store while (a)
+# corrupt/raise/delay rules rot the resultcache.load and
+# checkpoint.restore (shared-store restore) paths and (b) an input
+# file is REWRITTEN between waves.  Every answer must exactly match
+# the oracle for the file set it ran against — a degraded load is a
+# recompute MISS, a moved fingerprint is an invalidation, NEVER stale
+# bytes — and invalidations must actually fire (>= 1 per pass).
+python - <<'PY'
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+
+ddir = tempfile.mkdtemp(prefix="tpu-shared-cache-data-")
+path = os.path.join(ddir, "fact.parquet")
+
+def write_fact(scale):
+    rng = np.random.default_rng(23)
+    pd.DataFrame({
+        "k": rng.integers(0, 32, 4000).astype(np.int64),
+        "v": rng.normal(size=4000) * scale,
+    }).to_parquet(path)
+
+def oracle():
+    pdf = pd.read_parquet(path)
+    pdf = pdf[pdf.v > -1.0]
+    out = pdf.groupby("k", as_index=False).v.sum()
+    out = out.rename(columns={"v": "sv"})
+    return out.sort_values("k", ignore_index=True)
+
+write_fact(1.0)
+s = TpuSession({
+    "spark.rapids.tpu.serving.interleave.enabled": True,
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.serving.sharedStage.enabled": True,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+}, mesh=make_mesh(8))
+
+def query():
+    return (s.read.parquet(path).filter(F.col("v") > -1.0)
+            .group_by("k").agg(F.sum(F.col("v")).alias("sv")))
+
+def wave(n=8, per_client=3):
+    want = oracle()
+    errors = []
+
+    def client():
+        try:
+            for _ in range(per_client):
+                got = query().to_pandas().sort_values(
+                    "k", ignore_index=True)
+                pd.testing.assert_frame_equal(got, want)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:1]
+
+with I.scoped_rules():
+    # rot both reuse load paths while the clients hammer the store
+    I.inject("resultcache.load", kind="corrupt", count=2,
+             probability=0.5, seed=29, all_threads=True)
+    I.inject("resultcache.load", count=2, probability=0.3, seed=31,
+             all_threads=True)
+    I.inject("resultcache.load", kind="delay", delay_s=0.05, count=2,
+             probability=0.3, seed=37, all_threads=True)
+    I.inject("checkpoint.restore", kind="corrupt", count=2,
+             probability=0.5, seed=41, all_threads=True)
+    wave()
+    # file MUTATION between waves: every post-mutation answer must
+    # match the fresh oracle (fingerprint drift -> invalidation ->
+    # recompute; a stale hit would fail the frame compare)
+    write_fact(3.0)
+    wave()
+    write_fact(5.0)
+    wave()
+
+rc = s.result_cache.snapshot()
+ss = s.shared_stages.snapshot()
+assert rc["hits"] >= 1, rc
+assert rc["invalidations"] >= 1, rc  # mutation + corrupt rules fired
+assert ss["writes"] >= 1, ss
+print("shared-cache spray OK (8 clients x 3 waves exact, "
+      f"resultCache={rc}, sharedStages(writes={ss['writes']}, "
+      f"splices={ss['resumes']}, invalid={ss['invalid']}))")
+s.stop()
+PY
+
 echo "CHAOS OK"

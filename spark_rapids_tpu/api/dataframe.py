@@ -612,11 +612,49 @@ class DataFrame:
         from spark_rapids_tpu.robustness.driver import QueryRetryDriver
         from spark_rapids_tpu.serving.context import QueryContext
         with QueryContext(self.session) as ctx:
+            # plan-keyed result cache (serving/reuse.py): consulted
+            # BEFORE planning or admission — a verified hit (exact
+            # plan text + matching input fingerprint + CRC) answers
+            # with zero executions and zero queueing; the token
+            # carries the PRE-execution fingerprint for the store
+            cache = getattr(self.session, "result_cache", None)
+            pend = cache.offer(self.plan) if cache is not None else None
+            if pend is not None and pend.hit:
+                return self._answer_from_cache(pend)
             ctx.admit()
+            if pend is not None and ctx.admission_wait_ms > 0.5:
+                # the query actually QUEUED: an identical twin ahead
+                # of it may have stored the answer while it waited
+                # (the dashboard-stampede shape — N near-simultaneous
+                # duplicates should cost ONE execution, not N), so
+                # re-consult before paying for a redundant run.  The
+                # first offer already counted this query's miss —
+                # count_miss=False keeps the hit rate honest.
+                pend = cache.offer(self.plan, count_miss=False)
+                if pend.hit:
+                    return self._answer_from_cache(pend)
             driver = QueryRetryDriver(self.session)
-            mgr = CheckpointManager.for_query(self.session)
+            # cross-query stage cache: when enabled, the SHARED
+            # always_resume store rides as this query's checkpoint
+            # manager — completed exchange stages register for every
+            # tenant and input-fingerprinted subtrees splice on first
+            # attempts.  The per-query manager is the fallback (its
+            # lineage dies with the query).
+            shared = getattr(self.session, "shared_stages", None)
+            use_shared = (shared is not None and shared.enabled
+                          and getattr(self.session, "mesh", None)
+                          is not None
+                          and self.session.checkpoints is None)
+            mgr = None
+            if use_shared:
+                self.session.checkpoints = shared
+            else:
+                mgr = CheckpointManager.for_query(self.session)
             try:
-                return driver.run(self._attempt_batches)
+                batches = driver.run(self._attempt_batches)
+                if pend is not None:
+                    cache.store(pend, batches)
+                return batches
             except Exception as exc:
                 # a fatal/exhausted ladder still flushes its full
                 # recovery/watchdog/checkpoint trail to the eventlog,
@@ -626,8 +664,35 @@ class DataFrame:
                 self._flush_fatal_trail(driver, exc)
                 raise
             finally:
-                if mgr is not None:
+                if use_shared:
+                    # detach only (never finish(): the shared store's
+                    # entries outlive this query by design); drain any
+                    # tally the QueryEnd didn't pop (events disabled)
+                    # so recycled thread idents never inherit it
+                    shared.take_query_stats()
+                    if self.session.checkpoints is shared:
+                        self.session.checkpoints = None
+                elif mgr is not None:
                     mgr.finish()
+
+    def _answer_from_cache(self, pend) -> List[ColumnarBatch]:
+        """Result-cache hit: emit a complete (trivial) query envelope
+        so the event stream, profiling and concurrency timeline see
+        the query, then answer from the store — zero executions."""
+        events = getattr(self.session, "events", None)
+        if events is not None and events.enabled:
+            qid = next(self.session._query_ids)
+            self.session._current_qid = qid
+            events.emit("QueryStart", queryId=qid,
+                        logicalPlan=self.plan.tree_string(),
+                        physicalPlan="ResultCache",
+                        explain="result-cache hit")
+            events.emit("QueryEnd", queryId=qid, status="success",
+                        durationMs=0.0, metrics={}, spill={},
+                        retry={}, sharing=self._sharing_info(),
+                        explain="result-cache hit")
+        self.session.last_dist_explain = "result-cache hit"
+        return pend.batches
 
     def _flush_fatal_trail(self, driver, exc: BaseException) -> None:
         ev = getattr(self.session, "events", None)
@@ -667,6 +732,28 @@ class DataFrame:
         from spark_rapids_tpu.serving import context as qc
         ctx = qc.current()
         return ctx.admission_info() if ctx is not None else {}
+
+    def _sharing_info(self) -> dict:
+        """Cross-query reuse facts for the QueryEnd ``sharing`` dict:
+        result-cache hit/miss flags (serving/reuse.py offer notes; a
+        STORE lands after the envelope closed and rides the
+        ResultCacheStore event instead), the shared stage store's
+        write/splice tallies for this query, and the interleaver's
+        wait/slice accounting.  EMPTY —
+        and therefore absent from the event — when every reuse knob is
+        off, so the knobs-off event stream is bit-identical to HEAD."""
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        out = {}
+        if ctx is not None:
+            out.update(ctx.sharing)
+            t = ctx.interleave_ticket
+            if t is not None:
+                out["interleave"] = t.info()
+        shared = getattr(self.session, "shared_stages", None)
+        if shared is not None and shared.enabled:
+            out.update(shared.take_query_stats())
+        return out
 
     def _attempt_batches_impl(self, mode) -> List[ColumnarBatch]:
         import time as _time
@@ -737,6 +824,7 @@ class DataFrame:
                                   or {})
                     fusion.update(_persistent_delta(pjit0,
                                                     persistent_info()))
+                    sh = self._sharing_info()
                     events.emit(
                         "QueryEnd", queryId=qid, status=status,
                         durationMs=round(wall_ms, 3),
@@ -744,6 +832,10 @@ class DataFrame:
                         distributed=True, shuffle=shuffle,
                         fusion=fusion, spans=spans,
                         admission=self._admission_info(),
+                        # absent entirely when every reuse knob is
+                        # off — the knobs-off event stream must stay
+                        # bit-identical to HEAD
+                        **({"sharing": sh} if sh else {}),
                         explain=self.session.last_dist_explain)
 
             try:
@@ -808,14 +900,28 @@ class DataFrame:
         from spark_rapids_tpu.config import rapids_conf as rc
         self.session.last_pipeline_stats = None
         conf = self.session.conf
+        # fair interleaver (serving/scheduler.py): every batch pull
+        # passes the weighted round-robin timeslice gate, so admitted
+        # queries share the device batch-for-batch instead of FIFO
+        # occupancy.  When pipelined, the wrapped iterator runs on the
+        # worker thread — exactly the thread doing the dispatching.
+        source = exec_plan.execute()
+        sched = getattr(self.session, "interleaver", None)
+        if sched is not None:
+            from spark_rapids_tpu.serving import context as qc
+            ctx = qc.current()
+            ticket = getattr(ctx, "interleave_ticket", None) \
+                if ctx is not None else None
+            if ticket is not None:
+                source = sched.interleaved(source, ticket)
         if not conf.get(rc.PIPELINE_ENABLED):
-            return list(exec_plan.execute())
+            return list(source)
         from spark_rapids_tpu.exec.pipeline import (
             PipelineStats, pipelined)
         stats = PipelineStats(conf.get(rc.PIPELINE_DEPTH))
         try:
             return list(pipelined(
-                exec_plan.execute(), stats.depth,
+                source, stats.depth,
                 catalog=getattr(self.session, "memory_catalog", None),
                 stats=stats,
                 semaphore=getattr(self.session, "semaphore", None)))
@@ -914,13 +1020,16 @@ class DataFrame:
             wall_ms = (_time.perf_counter() - t0) * 1e3
             spans = tracing.finish_query(self.session, qid, wall_ms,
                                          status)
+            sh = self._sharing_info()
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
                 durationMs=round(wall_ms, 3),
                 metrics=exec_plan.collect_metrics(), spill=spill,
                 retry={k: retry1[k] - retry0[k] for k in retry1},
                 pipeline=pipeline, fusion=fusion, spans=spans,
-                admission=self._admission_info())
+                admission=self._admission_info(),
+                # absent when every reuse knob is off (HEAD parity)
+                **({"sharing": sh} if sh else {}))
 
     def to_arrow(self):
         import pyarrow as pa

@@ -185,6 +185,13 @@ class TpuSession:
         obs = tracing.observation_store()
         if obs is not None:
             obs.flush()
+        for store_attr in ("result_cache", "shared_stages"):
+            store = getattr(self, store_attr, None)
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:
+                    pass  # teardown must reach the catalog sweep
         cat = getattr(self, "memory_catalog", None)
         if cat is not None:
             cat.close()
@@ -254,6 +261,23 @@ class TpuSession:
                 max_queue=self.conf.get(rc.SERVING_MAX_QUEUED_QUERIES))
         else:
             self.admission = None
+        # fair interleaving + cross-query reuse (serving/scheduler.py,
+        # serving/reuse.py) — all default-off; None attributes keep the
+        # knobs-off hot path to a single getattr
+        self.interleaver = None
+        if self.conf.get(rc.SERVING_INTERLEAVE_ENABLED):
+            from spark_rapids_tpu.serving.scheduler import (
+                FairInterleaver)
+            self.interleaver = FairInterleaver(
+                self.conf.get(rc.SERVING_INTERLEAVE_QUANTUM))
+        self.result_cache = None
+        if self.conf.get(rc.SERVING_RESULT_CACHE_ENABLED):
+            from spark_rapids_tpu.serving.reuse import ResultCache
+            self.result_cache = ResultCache(self)
+        self.shared_stages = None
+        if self.conf.get(rc.SERVING_SHARED_STAGE_ENABLED):
+            from spark_rapids_tpu.serving.reuse import SharedStageCache
+            self.shared_stages = SharedStageCache(self)
 
     # --------------------------------------------------------------- builders --
     @classmethod

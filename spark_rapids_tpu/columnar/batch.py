@@ -22,7 +22,12 @@ Schema = Sequence[Tuple[str, DataType]]
 
 
 class ColumnarBatch:
-    __slots__ = ("columns", "_row_count", "transient_wire_bytes")
+    # __weakref__: the serving result cache (serving/reuse.py) tracks
+    # in-memory input batches weakly — id()-based fingerprints are only
+    # sound while the referent lives, and the cache must never pin a
+    # client's batches
+    __slots__ = ("columns", "_row_count", "transient_wire_bytes",
+                 "__weakref__")
 
     def __init__(self, columns: Dict[str, Column], nrows=None):
         self.columns: Dict[str, Column] = dict(columns)

@@ -847,6 +847,76 @@ SERVING_CHECKPOINT_FLOOR_BYTES = conf(
     "disables the floor (pure priority order).", _to_int,
     lambda v: None if v >= 0 else "must be >= 0")
 
+SERVING_INTERLEAVE_ENABLED = conf(
+    "spark.rapids.tpu.serving.interleave.enabled", False,
+    "Fair batch-for-batch interleaving of admitted queries "
+    "(serving/scheduler.py): instead of each admitted query's batch "
+    "loop occupying the device FIFO until it finishes, queries take "
+    "weighted round-robin timeslices at every batch (and distributed "
+    "stage) boundary — a 10ms dashboard query no longer queues behind "
+    "a long scan, and every runnable query advances within one round "
+    "(starvation-proof by construction). Weights derive from the "
+    "serving budgets: lighter byte weights and deadline-budgeted "
+    "queries get more batch slices per round. Cooperative only — it "
+    "reorders when batches dispatch, never what they compute, so "
+    "results are bit-identical with it off.", _to_bool)
+
+SERVING_INTERLEAVE_QUANTUM = conf(
+    "spark.rapids.tpu.serving.interleave.quantumBatches", 1,
+    "Base number of batch slices one query may advance per "
+    "round-robin turn of the fair interleaver. The effective quantum "
+    "scales up for queries declaring a byte weight lighter than the "
+    "pool default (bounded 8x) and doubles for deadline-budgeted "
+    "queries; every registered query always advances at least one "
+    "batch per round.", _to_int, _positive)
+
+SERVING_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.serving.resultCache.enabled", False,
+    "Plan-keyed query RESULT cache (serving/reuse.py): before "
+    "planning, a query's exact logical-plan text plus the input "
+    "fingerprint of everything it reads (file path/size/mtime_ns "
+    "triples, in-memory batch identities) is looked up in a "
+    "session-scoped host/disk-tier store; a hit answers with ZERO "
+    "executions. Any fingerprint drift invalidates the entry (a "
+    "mutated input can never serve stale bytes), results are "
+    "CRC-verified on every hit (a failed check degrades to "
+    "recompute), and plans containing UDFs or pandas stages are "
+    "never cached. Most production dashboard traffic is "
+    "near-duplicate — this is the 'Accelerating Presto with GPUs' "
+    "result-reuse leg.", _to_bool)
+
+SERVING_RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.serving.resultCache.maxBytes", 256 << 20,
+    "Ceiling on the bytes the result cache may pin across the "
+    "host/disk spill tiers (stored size — the storage codec "
+    "stretches it). Least-recently-used entries evict first; a "
+    "result larger than the whole budget is simply not stored.",
+    _to_int, _positive)
+
+SERVING_SHARED_STAGE_ENABLED = conf(
+    "spark.rapids.tpu.serving.sharedStage.enabled", False,
+    "CROSS-QUERY stage cache (serving/reuse.py): mesh queries "
+    "register every completed exchange stage in a shared, "
+    "session-scoped store keyed by the structural stage id WITH the "
+    "input fingerprint folded in (the always_resume lineage "
+    "machinery, robustness/incremental.py precedent), so two "
+    "different queries sharing a subtree — same scan + filter + "
+    "partial aggregate — splice each other's checkpoints through "
+    "try_distributed(resume=True) on FIRST attempts. Entries carry "
+    "owner attribution for per-query budget billing; CRC failure, "
+    "eviction and fingerprint drift all degrade to recompute — "
+    "never wrong bytes. Payloads demote to host at write so the "
+    "shared store never competes with live batches for HBM.",
+    _to_bool)
+
+SERVING_SHARED_STAGE_MAX_BYTES = conf(
+    "spark.rapids.tpu.serving.sharedStage.maxBytes", 1 << 30,
+    "Ceiling on the bytes the shared cross-query stage cache may pin "
+    "across the host/disk spill tiers (stored size). Oldest entries "
+    "evict first (SharedStageEvict events); an evicted entry just "
+    "re-runs its subtree on the next query that wanted it.",
+    _to_int, _positive)
+
 INCREMENTAL_ENABLED = conf(
     "spark.rapids.tpu.incremental.enabled", True,
     "Enable incremental state for continuous micro-batch ingest "

@@ -858,6 +858,15 @@ class DistPlanner:
         exchange stage registers its post-shuffle frame for the next
         attempt.  A checkpoint that fails verification or was evicted
         is dropped by the manager and the subtree re-runs here."""
+        if not dry and self._checkpointable(plan):
+            # fair-interleaver stage boundary: a distributed query's
+            # "batches" are its exchange stages — gate here so a
+            # heavy multi-stage query yields the mesh to co-tenants
+            # between stages (serving/scheduler.py; no-op when the
+            # interleave knob is off or no ticket is registered)
+            from spark_rapids_tpu.serving.scheduler import \
+                yield_current
+            yield_current(self.session)
         if dry or self._ckpt is None or not self._ckpt.enabled or \
                 not self._checkpointable(plan):
             return self._dispatch(plan, dry)

@@ -113,6 +113,15 @@ class QueryContext:
         self.admission_weight = 0
         self.checkpoints = None       # per-query CheckpointManager
         self.budget_events: list = []  # BudgetExhausted facts emitted
+        # cross-query reuse facts accumulated for the QueryEnd
+        # ``sharing`` dict (serving/reuse.py result-cache offer/store,
+        # shared-stage tallies) — empty when every reuse knob is off,
+        # so the knobs-off event stream is bit-identical to HEAD
+        self.sharing: dict = {}
+        # fair-interleaver slot (serving/scheduler.py), registered at
+        # admit() and held across every attempt of this query action —
+        # recovery-ladder re-drives keep their slot
+        self.interleave_ticket = None
         self._budget_spilled = False   # memory ladder: spill fired once
         # unresolved async-exchange payload bytes charged to this query
         # (parallel/exchange_async.ExchangeWindow): in-flight exchange
@@ -141,6 +150,11 @@ class QueryContext:
     def __exit__(self, *exc) -> bool:
         ident = self.owner_ident
         try:
+            if self.interleave_ticket is not None:
+                sched = getattr(self.session, "interleaver", None)
+                if sched is not None:
+                    sched.unregister(self.interleave_ticket)
+                self.interleave_ticket = None
             self.release_admission()
         finally:
             cat = getattr(self.session, "memory_catalog", None)
@@ -175,16 +189,27 @@ class QueryContext:
     def admit(self) -> None:
         """Acquire the session's admission semaphore (no-op when the
         controller is disabled).  Blocks in FIFO order; a timeout or a
-        full queue raises the typed AdmissionFault."""
+        full queue raises the typed AdmissionFault.  Admitted queries
+        also join the fair interleaver's round (when enabled) — the
+        ticket spans every attempt, so ladder re-drives keep their
+        slot."""
         ctrl = getattr(self.session, "admission", None)
-        if ctrl is None or self.ticket is not None:
-            return
-        from spark_rapids_tpu.utils import tracing
-        t0 = time.perf_counter()
-        with tracing.span("admission.wait"):
-            self.ticket = ctrl.acquire(session=self.session)
-        self.admission_wait_ms = (time.perf_counter() - t0) * 1e3
-        self.admission_weight = self.ticket.weight_bytes
+        if ctrl is not None and self.ticket is None:
+            from spark_rapids_tpu.utils import tracing
+            t0 = time.perf_counter()
+            with tracing.span("admission.wait"):
+                self.ticket = ctrl.acquire(session=self.session)
+            self.admission_wait_ms = (time.perf_counter() - t0) * 1e3
+            self.admission_weight = self.ticket.weight_bytes
+        # the interleave ticket joins the round ONLY once admitted: a
+        # QUEUED query's ticket would hold the round-robin turn while
+        # never reaching a gate — admitted co-tenants would block at
+        # their gates waiting on it, and with all slots held by
+        # blocked tenants the queued query is never admitted either
+        # (session-wide deadlock)
+        sched = getattr(self.session, "interleaver", None)
+        if sched is not None and self.interleave_ticket is None:
+            self.interleave_ticket = sched.register(self)
 
     def release_admission(self) -> None:
         ctrl = getattr(self.session, "admission", None)

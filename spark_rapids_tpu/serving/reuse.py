@@ -1,0 +1,618 @@
+"""Cross-query computation reuse: result cache + shared stage cache.
+
+Most production dashboard traffic is near-duplicate ("Accelerating
+Presto with GPUs", PAPERS.md), and Theseus (PAPERS.md) frames
+recomputation as the most expensive data movement of all.  All the
+safety machinery this module needs already exists — PR5's structural
+stage ids, PR7's input fingerprints + ``always_resume`` splice, PR3's
+CRC-stamped spill tiers — it was just scoped per-session/per-query.
+This module promotes it to *shared*:
+
+**ResultCache** — a plan-keyed, budgeted, host/disk-tier store of whole
+query results, consulted by ``DataFrame._execute_batches`` before
+planning.  The key is the EXACT logical-plan text (literals included —
+the digit-normalized key the compare tools use would alias
+``limit(5)`` with ``limit(10)``) and a **hit additionally requires the
+plan's input fingerprint to match** (``checkpoint.input_fingerprint``:
+file path/size/mtime_ns triples + in-memory batch identities, statted
+fresh at lookup) — so a hit answers with zero executions and a mutated
+input can never serve stale bytes.  Every hit re-verifies the store's
+own canonical CRC (the checkpoint-restore discipline; the
+``resultcache.load`` injection point feeds the gate real rot in
+chaos); any failure invalidates the entry and the query recomputes.
+Plans containing UDFs or pandas stages are never cached (arbitrary
+Python is not provably deterministic).
+
+**SharedStageCache** — the ``always_resume`` checkpoint store promoted
+to a shared, multi-tenant, session-scoped store: every mesh query
+registers its completed exchange stages and consults the store on
+FIRST attempts, so two different queries sharing a subtree (same scan
++ filter + partial aggregate, proven by structural stage id + input
+fingerprint) splice each other's checkpoints through the existing
+``try_distributed(resume=True)`` path.  Entries carry owner
+attribution (the registering query's id and QueryContext ident, so
+per-owner spill billing sees them); the recovery driver's
+layout-rung ``clear()`` is a no-op here — a rung demotes ONE query off
+the mesh, while committed entries stay keyed to (subtree, mesh layout,
+inputs), all of which survive and serve the next tenant.  CRC failure,
+eviction and fingerprint drift all degrade to recompute — never wrong
+bytes, never a failed query.
+
+Both stores live in the session's spill catalog (host-demoted at
+write, so standing reuse state never competes with live batches for
+HBM) under ``spark.rapids.tpu.serving.{resultCache,sharedStage}.*``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.robustness.checkpoint import (CheckpointManager,
+                                                    input_fingerprint)
+from spark_rapids_tpu.robustness.inject import (fire, fire_mutate,
+                                                register_point)
+
+# chaos surface: raise/delay rules wedge/abort a cache load (the query
+# degrades to a recompute MISS — never a failure), corrupt rules flip
+# result-payload bits so the CRC gate has real rot to catch
+register_point("resultcache.load")
+
+# spill priorities: reuse state is insurance, colder than per-query
+# checkpoints (-1500) but warmer than standing incremental state
+# (-2000) — a live query's lineage always wins HBM over shared caches
+SHARED_STAGE_PRIORITY = -1750
+RESULT_CACHE_PRIORITY = -1800
+
+
+def _coop_acquire(lock) -> None:
+    """Watchdog-cooperative lock acquire: a tenant blocked behind a
+    wedged peer (chaos delay on a store point) still receives its
+    deadline cancellation instead of waiting forever."""
+    from spark_rapids_tpu.robustness import watchdog
+    while not lock.acquire(timeout=0.05):
+        watchdog.checkpoint()
+
+
+class _Locked:
+    """``with _Locked(lock):`` using the cooperative acquire."""
+
+    __slots__ = ("lock",)
+
+    def __init__(self, lock):
+        self.lock = lock
+
+    def __enter__(self):
+        _coop_acquire(self.lock)
+        return self
+
+    def __exit__(self, *exc):
+        self.lock.release()
+        return False
+
+
+def _rebuild_batch(schema, payload: dict, nrows: int):
+    """Host-side ColumnarBatch from a canonical payload dict (the
+    spill module's key layout) — the cached copy never aliases the
+    live result's (possibly device-resident) buffers."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import Column
+    cols = {}
+    for name, dt in schema:
+        data = payload.get(f"{name}.data")
+        if data is None:
+            data = np.zeros(
+                0, dtype=np.uint8 if dt.is_string else dt.storage)
+        cols[name] = Column(dt, np.ascontiguousarray(data), nrows,
+                            validity=payload.get(f"{name}.validity"),
+                            offsets=payload.get(f"{name}.offsets"))
+    return ColumnarBatch(cols, nrows)
+
+
+def _inmemory_batches(plan) -> list:
+    """Every live batch object an InMemoryRelation leaf references —
+    the objects whose ``id()``s the input fingerprint encodes."""
+    from spark_rapids_tpu.plan import logical as L
+    out = []
+
+    def walk(node):
+        if isinstance(node, L.InMemoryRelation):
+            out.extend(node.batches)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+class _CachedResult:
+    """One plan's stored result: per-batch spill handles + the
+    metadata to verify and rebuild them.
+
+    ``pins`` holds WEAK references to the in-memory input batches the
+    stored fingerprint's ``id()``s describe: ``id()`` identity is only
+    sound while the object lives, so a dead referent (CPython may
+    recycle the address onto different data) invalidates the entry at
+    the next lookup instead of risking a stale-aliased hit.  Weak, not
+    strong — the cache must never pin a client's (possibly
+    device-resident) input batches alive."""
+
+    __slots__ = ("key", "fingerprint", "schema", "parts", "seq",
+                 "owner_qid", "hits", "pins")
+
+    def __init__(self, key, fingerprint, schema, parts, seq, owner_qid,
+                 pins=()):
+        self.key = key
+        self.fingerprint = fingerprint
+        self.schema = list(schema)
+        # [(handle, crc, nrows)] in batch order
+        self.parts = parts
+        self.seq = seq
+        self.owner_qid = owner_qid
+        self.hits = 0
+        import weakref
+        self.pins = [weakref.ref(b) for b in pins]
+
+    def pins_alive(self) -> bool:
+        return all(r() is not None for r in self.pins)
+
+    @property
+    def stored_bytes(self) -> int:
+        return sum(h.stored_bytes for h, _, _ in self.parts
+                   if not h.closed)
+
+    def close(self) -> None:
+        for h, _, _ in self.parts:
+            try:
+                h.close()
+            except Exception:
+                pass
+
+
+class PendingResult:
+    """The token ``offer()`` hands back: carries the key and the
+    PRE-execution input fingerprint (stat-before-read — a file mutated
+    mid-execution leaves the entry stamped with pre-mutation identity,
+    so the next lookup's fresh stat walk misses instead of serving
+    stale bytes)."""
+
+    __slots__ = ("key", "fingerprint", "hit", "batches", "cacheable",
+                 "pins")
+
+    def __init__(self):
+        self.key: Optional[str] = None
+        self.fingerprint: Optional[str] = None
+        self.hit = False
+        self.batches = None
+        self.cacheable = False
+        self.pins: list = []  # live in-memory input batch objects
+
+
+class ResultCache:
+    """Session-scoped, budgeted result store (see module docstring)."""
+
+    def __init__(self, session):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.session = session
+        conf = session.conf
+        self.enabled = bool(conf.get(rc.SERVING_RESULT_CACHE_ENABLED))
+        self.max_bytes = int(
+            conf.get(rc.SERVING_RESULT_CACHE_MAX_BYTES))
+        self.catalog = getattr(session, "memory_catalog", None)
+        self._entries: Dict[str, _CachedResult] = {}
+        self._seq = itertools.count(1)
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.invalidations = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- helpers --
+    @staticmethod
+    def plan_key(plan) -> str:
+        """EXACT plan identity (literals included); the data the plan
+        reads is keyed separately by the input fingerprint."""
+        return hashlib.sha256(plan.tree_string().encode()).hexdigest()
+
+    @staticmethod
+    def cacheable(plan) -> bool:
+        """Only provably-deterministic plans cache: anything routing
+        through arbitrary Python (UDF expressions, *InPandas stages)
+        is refused — a stale answer is worse than no cache."""
+        text = plan.tree_string()
+        return not ("UDF" in text or "InPandas" in text or
+                    "ArrowEval" in text)
+
+    def _emit(self, event: str, **fields) -> None:
+        from spark_rapids_tpu.utils.events import emit_on_session
+        try:
+            emit_on_session(event, session=self.session, **fields)
+        except Exception:
+            pass  # cache bookkeeping must never fail a query
+
+    def _note_sharing(self, **fields) -> None:
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        if ctx is not None:
+            ctx.sharing.update(fields)
+
+    # -------------------------------------------------------------- lookup --
+    def offer(self, plan, count_miss: bool = True) -> PendingResult:
+        """Consult the cache BEFORE planning.  ``pend.hit`` with the
+        stored batches on a verified hit; otherwise the caller
+        executes normally and hands the result to :meth:`store` with
+        the same token.  ``count_miss=False`` for the post-admission
+        RE-consult of a query that already missed once — the second
+        lookup must not double-count the same miss."""
+        pend = PendingResult()
+        if not self.enabled or self.catalog is None:
+            return pend
+        try:
+            pend.cacheable = self.cacheable(plan)
+            if not pend.cacheable:
+                return pend
+            pend.key = self.plan_key(plan)
+            # stat BEFORE the read (the PR7 discipline)
+            pend.fingerprint = input_fingerprint(plan)
+            pend.pins = _inmemory_batches(plan)
+        except Exception:
+            pend.cacheable = False
+            return pend
+        try:
+            batches = self._load(pend, count_miss)
+        except Exception:
+            batches = None  # any load failure is a miss, never a
+            #                 failed query (the recompute is exact)
+        if batches is not None:
+            pend.hit = True
+            pend.batches = batches
+        return pend
+
+    def _miss(self, note: str, count: bool = True):
+        if count:
+            with _Locked(self._lock):
+                self.misses += 1
+            self._note_sharing(resultCache=note)
+        return None
+
+    def _invalidate(self, entry: "_CachedResult", reason: str,
+                    count_miss: bool = True):
+        """Invalidate-if-still-live (a concurrent lookup or eviction
+        may have removed the entry already) and count the miss."""
+        with _Locked(self._lock):
+            if self._entries.get(entry.key) is entry:
+                self._invalidate_locked(entry, reason)
+            if count_miss:
+                self.misses += 1
+        self._note_sharing(resultCache="invalidated")
+        return None
+
+    def _load(self, pend: PendingResult, count_miss: bool = True):
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
+        from spark_rapids_tpu.robustness.incremental import \
+            _batch_payload
+        with _Locked(self._lock):
+            entry = self._entries.get(pend.key)
+            if entry is None:
+                if count_miss:
+                    self.misses += 1
+                    self._note_sharing(resultCache="miss")
+                return None
+            if entry.fingerprint != pend.fingerprint:
+                # an input file moved (appended, rewritten — even
+                # same-size, the mtime catches it): the stored result
+                # no longer describes the data
+                self._invalidate_locked(entry,
+                                        "input-fingerprint-moved")
+                if count_miss:
+                    self.misses += 1
+                self._note_sharing(resultCache="invalidated")
+                return None
+            if not entry.pins_alive():
+                # an in-memory input batch the fingerprint's id()s
+                # describe was collected: the id may now alias a
+                # DIFFERENT object's data, so the match is unprovable
+                self._invalidate_locked(entry, "input-batch-collected")
+                if count_miss:
+                    self.misses += 1
+                self._note_sharing(resultCache="invalidated")
+                return None
+            parts = list(entry.parts)
+            schema = list(entry.schema)
+        # heavy verification OUTSIDE the lock: materializing and
+        # checksumming multi-MB host/disk payloads must not serialize
+        # co-tenants' lookups into a queue (this is the concurrency
+        # path).  A concurrent eviction closing a handle mid-read
+        # surfaces as OSError/ValueError and lands in the invalid arm.
+        try:
+            # chaos: raise/delay rules degrade the load to a MISS
+            # (the query recomputes — exact, just slower); corrupt
+            # rules below rot the payload for the CRC gate
+            fire("resultcache.load")
+            batches = []
+            for h, crc, nrows in parts:
+                batch = h.materialize()
+                payload = _batch_payload(batch)
+                key = next((k for k in sorted(payload)
+                            if payload[k].size > 0), None)
+                if key is not None:
+                    mutated = fire_mutate("resultcache.load",
+                                          payload[key])
+                    if mutated is not payload[key]:
+                        payload = dict(payload)
+                        payload[key] = mutated
+                got = _payload_checksum(payload, nrows)
+                if got != crc:
+                    return self._invalidate(
+                        entry,
+                        f"crc {got:#010x} != stored {crc:#010x}",
+                        count_miss)
+                batches.append(_rebuild_batch(schema, payload, nrows))
+        except (CorruptionFault, OSError, ValueError) as e:
+            # undecodable / vanished / tier-CRC-dropped payload:
+            # the entry is gone, the query recomputes
+            return self._invalidate(entry, f"{type(e).__name__}: {e}",
+                                    count_miss)
+        except Exception:
+            # an injected raise (or any other load-path failure)
+            # is a graceful miss, never a failed query
+            return self._miss("miss", count_miss)
+        with _Locked(self._lock):
+            if self._entries.get(pend.key) is entry:
+                entry.hits += 1
+                entry.seq = next(self._seq)  # LRU touch
+            self.hits += 1
+        self._emit("ResultCacheHit", key=pend.key[:16],
+                   batches=len(batches),
+                   rows=sum(b.nrows for b in batches))
+        self._note_sharing(resultCacheHit=True)
+        return batches
+
+    # --------------------------------------------------------------- store --
+    def store(self, pend: PendingResult, batches) -> None:
+        """Best-effort store of a freshly computed result under the
+        token's pre-execution key/fingerprint.  Failures (unstattable
+        inputs, a result over the whole budget, catalog pressure)
+        just skip the store — the cache is an optimization."""
+        if not self.enabled or self.catalog is None or \
+                not pend.cacheable or pend.hit or pend.key is None:
+            return
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        from spark_rapids_tpu.robustness.incremental import \
+            _batch_payload
+        parts = []
+        try:
+            with _Locked(self._lock):
+                if pend.key in self._entries:
+                    return  # a concurrent twin already stored it
+            schema = None
+            total = 0
+            staged = []
+            for b in batches:
+                if schema is None:
+                    schema = list(b.schema)
+                payload = _batch_payload(b)
+                nrows = int(b.nrows)
+                crc = _payload_checksum(payload, nrows)
+                copy = _rebuild_batch(schema, payload, nrows)
+                staged.append((copy, crc, nrows))
+            from spark_rapids_tpu.serving import context as qc
+            ctx = qc.current()
+            owner_qid = ctx.qid if ctx is not None else None
+            for copy, crc, nrows in staged:
+                h = self.catalog.register(
+                    copy, priority=RESULT_CACHE_PRIORITY)
+                self.catalog.demote(h, "HOST")
+                parts.append((h, crc, nrows))
+                total += h.stored_bytes
+            if total > self.max_bytes:
+                for h, _, _ in parts:
+                    h.close()
+                return
+            with _Locked(self._lock):
+                if pend.key in self._entries:
+                    for h, _, _ in parts:
+                        h.close()
+                    return
+                entry = _CachedResult(
+                    pend.key, pend.fingerprint,
+                    schema if schema is not None else [],
+                    parts, next(self._seq), owner_qid,
+                    pins=pend.pins)
+                self._entries[pend.key] = entry
+                self.stores += 1
+                self._evict_over_budget_locked()
+            # the store happens AFTER the final attempt's QueryEnd
+            # closed, so the fact rides this event (queryId is still
+            # the storing query's) — not the sharing dict, which the
+            # envelope already snapshotted
+            self._emit("ResultCacheStore", key=pend.key[:16],
+                       bytes=total, batches=len(parts))
+        except Exception:
+            for h, _, _ in parts:
+                try:
+                    h.close()
+                except Exception:
+                    pass
+
+    # --------------------------------------------------------- invalidation --
+    def _invalidate_locked(self, entry: _CachedResult,
+                           reason: str) -> None:
+        self._entries.pop(entry.key, None)
+        entry.close()
+        self.invalidations += 1
+        self._emit("ResultCacheInvalid", key=entry.key[:16],
+                   reason=reason)
+
+    def _evict_over_budget_locked(self) -> None:
+        while self._entries and \
+                sum(e.stored_bytes
+                    for e in self._entries.values()) > self.max_bytes:
+            victim = min(self._entries.values(), key=lambda e: e.seq)
+            self._entries.pop(victim.key, None)
+            bytes_ = victim.stored_bytes
+            victim.close()
+            self.evictions += 1
+            self._emit("ResultCacheEvict", key=victim.key[:16],
+                       bytes=bytes_, reason="max-bytes")
+
+    def snapshot(self) -> Dict[str, int]:
+        with _Locked(self._lock):
+            return {
+                "entries": len(self._entries),
+                "bytes": sum(e.stored_bytes
+                             for e in self._entries.values()),
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "invalidations": self.invalidations,
+                "evictions": self.evictions,
+            }
+
+    def close(self) -> None:
+        with _Locked(self._lock):
+            for entry in list(self._entries.values()):
+                entry.close()
+            self._entries.clear()
+
+
+class SharedStageCache(CheckpointManager):
+    """The ``always_resume`` lineage store, shared across a session's
+    tenants (see module docstring).  Reuses the CheckpointManager
+    save/restore/CRC machinery verbatim; what changes is scope (the
+    session, not one query), thread safety (concurrent queries share
+    the entry map), event names, and the layout-rung ``clear()``
+    contract (a per-query demotion must not wipe co-tenants' entries).
+    """
+
+    always_resume = True
+
+    def __init__(self, session):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        super().__init__(session)
+        conf = session.conf
+        self.enabled = bool(conf.get(rc.SERVING_SHARED_STAGE_ENABLED))
+        self.max_bytes = int(
+            conf.get(rc.SERVING_SHARED_STAGE_MAX_BYTES))
+        # never HBM-resident: shared insurance must not compete with
+        # any live query's batches for device memory
+        self.tiers = ("HOST", "DISK")
+        self.priority = SHARED_STAGE_PRIORITY
+        self._mu = threading.RLock()
+        # counters/tallies get their own small lock: restore() runs
+        # UNLOCKED (see below), so its metric bumps must not race
+        self._tally_mu = threading.Lock()
+        # stage id -> owning query id (attribution for events/billing)
+        self._owners: Dict[str, Optional[int]] = {}
+        # per-thread (per-query) write/splice tallies, popped into the
+        # QueryEnd sharing dict — store-local counters would smear
+        # across concurrent tenants
+        self._by_ident: Dict[int, Dict[str, int]] = {}
+
+    # ----------------------------------------------------------- event taps --
+    _EVENT_MAP = {"CheckpointWrite": "SharedStageWrite",
+                  "CheckpointResume": "SharedStageSplice",
+                  "CheckpointEvict": "SharedStageEvict",
+                  "CheckpointInvalid": "SharedStageInvalid"}
+
+    def _emit(self, event: str, **fields) -> None:
+        mapped = self._EVENT_MAP.get(event, event)
+        sid = fields.get("stageId")
+        if sid is not None and sid in self._owners:
+            fields["owner"] = self._owners.get(sid)
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session(mapped, session=self.session, **fields)
+
+    def _bump(self, field: str, by: int = 1) -> None:
+        # restore() bumps these WITHOUT the store lock held
+        from spark_rapids_tpu.robustness.checkpoint import (
+            checkpoint_metrics)
+        checkpoint_metrics.bump(field, by)
+        with self._tally_mu:
+            self.local[field] += int(by)
+
+    def _tally(self, field: str, by: int = 1) -> None:
+        from spark_rapids_tpu.serving import context as qc
+        ident = qc.effective_ident()
+        with self._tally_mu:
+            rec = self._by_ident.setdefault(ident, {})
+            rec[field] = rec.get(field, 0) + by
+
+    def take_query_stats(self) -> Dict[str, int]:
+        """Pop the calling query's write/splice tallies (QueryEnd)."""
+        from spark_rapids_tpu.serving import context as qc
+        with self._tally_mu:
+            return self._by_ident.pop(qc.effective_ident(), {})
+
+    # ----------------------------------------------------------- operations --
+    def save(self, sid: str, frame, stages: int = 1) -> None:
+        # saves hold the store lock end to end: they happen once per
+        # NEW stage id (repeat saves early-exit in the base), and the
+        # lock is what keeps _entries inserts + eviction iteration
+        # consistent.  The HOT multi-tenant path — restore — runs
+        # unlocked below.
+        with _Locked(self._mu):
+            known = sid in self._entries
+            from spark_rapids_tpu.serving import context as qc
+            ctx = qc.current()
+            if not known:
+                self._owners[sid] = ctx.qid if ctx is not None else None
+            super().save(sid, frame, stages)
+            if not known and sid in self._entries:
+                self._tally("stageWrites")
+            elif not known:
+                self._owners.pop(sid, None)  # save refused/failed
+
+    def restore(self, sid: str, mesh):
+        # UNLOCKED: materializing + CRC-checking a multi-MB payload
+        # under the store-wide lock would serialize every tenant's
+        # splice (the defect class ResultCache._load was restructured
+        # for).  Safe because the base restore only READS the entry
+        # map (GIL-atomic get), every _entries MUTATION goes through
+        # the locked save/drop/close paths, and a concurrent
+        # eviction closing the handle mid-materialize surfaces as
+        # OSError/ValueError -> drop -> recompute, the standard
+        # degrade.
+        frame = super().restore(sid, mesh)
+        if frame is not None:
+            self._tally("spliceResumes")
+        return frame
+
+    def drop(self, sid: str, reason: str, evict: bool = False) -> None:
+        with _Locked(self._mu):
+            super().drop(sid, reason, evict=evict)
+            self._owners.pop(sid, None)
+
+    def clear(self, reason: str) -> None:
+        """A recovery-ladder layout rung demotes ONE query off the
+        mesh; the shared store's committed entries are keyed to
+        (subtree, mesh layout, input fingerprint), all of which
+        survive the rung and stay valid for every other tenant — so
+        clear() is deliberately a no-op (the per-query manager wipes
+        its log here; the incremental store drops provisional only)."""
+
+    def finish(self) -> None:
+        """Never called per-query (the store outlives queries); a
+        stray call must not wipe the shared state."""
+
+    def close(self) -> None:
+        """Session teardown: release every payload."""
+        with _Locked(self._mu):
+            for sid in list(self._entries):
+                entry = self._entries.pop(sid)
+                try:
+                    entry.handle.close()
+                except Exception:
+                    pass
+            self._owners.clear()
+            self._by_ident.clear()
+
+    def snapshot(self) -> Dict[str, int]:
+        with _Locked(self._mu):
+            return super().snapshot()

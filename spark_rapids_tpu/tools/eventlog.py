@@ -78,6 +78,16 @@ class QueryInfo:
     # wallMs, exclusiveMs, unattributedMs/Frac, overlapMs, phases,
     # points, operators, sites); empty when tracing was off
     spans: Dict[str, object] = field(default_factory=dict)
+    # cross-query reuse (QueryEnd sharing dict, serving/reuse.py +
+    # serving/scheduler.py: resultCacheHit, resultCache
+    # miss/invalidated note, spliceResumes/stageWrites tallies,
+    # interleave wait/timeslices; stores ride the ResultCacheStore
+    # EVENT — they land after the envelope closed); ABSENT when every
+    # reuse knob is off
+    sharing: Dict[str, object] = field(default_factory=dict)
+    # result-cache / shared-stage-store events attributed to this
+    # query (kind is hit|store|invalid|evict|write|splice)
+    sharing_events: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -122,6 +132,10 @@ class AppInfo:
     # un-attributed JitCacheInvalid events (a load outside any query
     # envelope)
     jitcache: List[Dict[str, str]] = field(default_factory=list)
+    # un-attributed cross-query reuse events (a result-cache store
+    # lands after its query's envelope closed, invalidations fire
+    # during another query's lookup)
+    sharing_events: List[Dict[str, str]] = field(default_factory=list)
 
     def max_concurrent(self) -> int:
         """Peak number of simultaneously-open query envelopes — the
@@ -236,6 +250,28 @@ def parse_event_log(path: str) -> AppInfo:
                                             "action") if k in rec}
                 q = all_queries.get(rec.get("queryId"))
                 (q.budget if q is not None else app.budget).append(info)
+            elif ev in ("ResultCacheHit", "ResultCacheStore",
+                        "ResultCacheInvalid", "ResultCacheEvict",
+                        "SharedStageWrite", "SharedStageSplice",
+                        "SharedStageEvict", "SharedStageInvalid"):
+                info = {k: rec[k] for k in
+                        ("key", "bytes", "batches", "rows", "reason",
+                         "stageId", "stages", "stagesSaved", "tier",
+                         "owner") if k in rec}
+                info["kind"] = {
+                    "ResultCacheHit": "hit",
+                    "ResultCacheStore": "store",
+                    "ResultCacheInvalid": "invalid",
+                    "ResultCacheEvict": "evict",
+                    "SharedStageWrite": "write",
+                    "SharedStageSplice": "splice",
+                    "SharedStageEvict": "evict",
+                    "SharedStageInvalid": "invalid"}[ev]
+                info["store"] = "result" if ev.startswith("Result") \
+                    else "stage"
+                q = all_queries.get(rec.get("queryId"))
+                (q.sharing_events if q is not None
+                 else app.sharing_events).append(info)
             elif ev == "JitCacheInvalid":
                 info = {k: rec[k] for k in ("reason", "entry")
                         if k in rec}
@@ -268,6 +304,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.shuffle = rec.get("shuffle", {})
                 q.fusion = rec.get("fusion", {})
                 q.spans = rec.get("spans", {}) or {}
+                q.sharing = rec.get("sharing", {}) or {}
                 q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed

@@ -231,6 +231,69 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def sharing_stats(apps: List[AppInfo]) -> Dict[str, float]:
+    """Cross-query reuse effectiveness across sessions
+    (serving/reuse.py + serving/scheduler.py): result-cache
+    hits/misses/stores/invalidations, shared stage-store
+    writes/splices, and the fair interleaver's wait/timeslice
+    accounting.  ``result_cache_hits`` and ``stage_splices`` are the
+    headline numbers the bench --concurrency overlap mode reports."""
+    hits = misses = stores = invalid = evicts = 0
+    writes = splices = 0
+    interleaved = 0
+    wait_ms = slices = 0.0
+    for a in apps:
+        events = list(a.sharing_events) + \
+            [e for q in a.queries for e in q.sharing_events]
+        for e in events:
+            kind, store = e.get("kind"), e.get("store")
+            if store == "result":
+                if kind == "hit":
+                    hits += 1
+                elif kind == "store":
+                    stores += 1
+                elif kind == "invalid":
+                    invalid += 1
+                elif kind == "evict":
+                    evicts += 1
+            else:
+                if kind == "write":
+                    writes += 1
+                elif kind == "splice":
+                    splices += 1
+                elif kind == "invalid":
+                    invalid += 1
+                elif kind == "evict":
+                    evicts += 1
+        for q in a.queries:
+            sh = q.sharing
+            if not sh:
+                continue
+            if sh.get("resultCache") == "miss" or \
+                    sh.get("resultCache") == "invalidated":
+                misses += 1
+            il = sh.get("interleave")
+            if il:
+                interleaved += 1
+                wait_ms += il.get("waitMs", 0.0)
+                slices += il.get("timeslices", 0)
+    if not (hits or misses or stores or writes or splices or
+            interleaved or invalid or evicts):
+        return {}
+    return {
+        "result_cache_hits": hits,
+        "result_cache_misses": misses,
+        "result_cache_stores": stores,
+        "stage_writes": writes,
+        "stage_splices": splices,
+        "invalidations": invalid,
+        "evictions": evicts,
+        "interleaved_queries": interleaved,
+        "interleave_wait_ms": wait_ms,
+        "timeslices": slices,
+    }
+
+
 def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
     """Whole-stage fusion + persistent jit-cache effectiveness across
     queries (exec/fusion.py, ops/jit_cache.py): stages/operators fused,
@@ -563,6 +626,52 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     "check jitCache.dir persistence and jax/jaxlib "
                     "version churn")
             seen_plans.setdefault(key, q.query_id)
+        # result-cache thrash: the cache is ON and the SAME normalized
+        # plan repeated, yet no repeat ever hit — every entry is being
+        # invalidated (inputs that move every query) or the results
+        # never fit maxBytes; the store is configured but buying
+        # nothing
+        rc_on = str(a.conf.get(
+            "spark.rapids.tpu.serving.resultCache.enabled",
+            "")).lower() in ("1", "true", "yes", "on")
+        if rc_on:
+            plan_counts: Dict[str, int] = {}
+            for q in a.queries:
+                key = _re.sub(r"\d+", "N", q.logical_plan.strip())
+                if key:
+                    plan_counts[key] = plan_counts.get(key, 0) + 1
+            repeats = sum(n - 1 for n in plan_counts.values() if n > 1)
+            hit_any = any(
+                q.sharing.get("resultCacheHit") for q in a.queries
+            ) or any(e.get("kind") == "hit" and
+                     e.get("store") == "result"
+                     for q in a.queries for e in q.sharing_events)
+            if repeats and not hit_any:
+                problems.append(
+                    f"{a.session_id}: result cache 0% hit over "
+                    f"{repeats} repeat(s) of the same plan shape — "
+                    "the store is on but buying nothing (inputs "
+                    "mutating every query, results over "
+                    "resultCache.maxBytes, or uncacheable "
+                    "UDF/pandas plans)")
+        # interleaver starvation: a query spent far longer blocked at
+        # the timeslice gate than doing its own work — co-tenant
+        # quanta are too coarse for this mix
+        for q in a.queries:
+            il = q.sharing.get("interleave") if q.sharing else None
+            # gate waits happen INSIDE the query wall (waitMs <=
+            # durationMs), so starvation compares the wait to the
+            # query's OWN work: duration minus the wait itself
+            if il and q.duration_ms and il.get("waitMs", 0.0) > max(
+                    5 * (q.duration_ms - il.get("waitMs", 0.0)),
+                    1000.0):
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: interleaver "
+                    f"starvation — {il['waitMs']:.0f}ms at the "
+                    f"timeslice gate of a {q.duration_ms:.0f}ms "
+                    "query; lower co-tenant quanta "
+                    "(serving.interleave.quantumBatches) or raise "
+                    "this query's weight")
         for j in a.jitcache:
             problems.append(
                 f"{a.session_id}: persistent jit-cache entry dropped "
@@ -983,6 +1092,23 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"stagesSkipped={cp['stages_skipped']} "
             f"evictions={cp['evictions']} "
             f"invalidations={cp['invalidations']}")
+    sh = sharing_stats(apps)
+    if sh:
+        out.append("\n-- Cross-query reuse --")
+        out.append(
+            f"  resultCache: hits={sh['result_cache_hits']} "
+            f"misses={sh['result_cache_misses']} "
+            f"stores={sh['result_cache_stores']} "
+            f"invalidations={sh['invalidations']} "
+            f"evictions={sh['evictions']}")
+        out.append(
+            f"  sharedStages: writes={sh['stage_writes']} "
+            f"splices={sh['stage_splices']}")
+        if sh["interleaved_queries"]:
+            out.append(
+                f"  interleaver: queries={sh['interleaved_queries']} "
+                f"timeslices={sh['timeslices']:.0f} "
+                f"wait={sh['interleave_wait_ms']:.1f}ms")
     ic = incremental_stats(apps)
     if ic:
         out.append("\n-- Continuous ingest --")

@@ -84,6 +84,7 @@ _PHASE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("checkpoint.", "spill"),
     ("incremental.commit", "spill"),
     ("admission.wait", "wait"),
+    ("scheduler.", "wait"),
     ("udf.worker", "wait"),
     ("pipeline.worker", "wait"),
     ("hostsync.", "wait"),
@@ -437,7 +438,35 @@ class ObservationStore:
     """Persisted per-site observations: one JSONL file beside the AOT
     jit-cache dir.  Load-merge-rewrite on flush (atomic replace), so a
     fresh process reads the prior process's evidence — the ROADMAP
-    item 3 producer contract."""
+    item 3 producer contract.
+
+    Flushes are serialized across PROCESSES by a lock file
+    (O_CREAT|O_EXCL beside the store) and each flush RE-READS the
+    on-disk file under the lock, merging records it did not itself
+    observe — two concurrent sessions sharing one AOT cache dir can no
+    longer drop each other's observations in the read-rewrite window
+    (each used to overwrite the file with only its own snapshot).
+    Only sites this store OBSERVED since its last flush are written —
+    a site merely loaded at construction is a stale copy and must not
+    clobber another session's fresher on-disk record.  For a site both
+    observed, the flushing store's smoothed values win (freshest
+    evidence) except ``compile_ms`` (max — worst-case cost) and
+    ``n``/``ts`` (max — monotone counters).  A lock that cannot be
+    acquired within the timeout re-marks the snapshot dirty and
+    retries at the next flush; a lock file older than ``LOCK_STALE_S``
+    is broken by an atomic rename (exactly one breaker wins — two
+    sessions both unlinking could otherwise delete each other's FRESH
+    locks and run the merge concurrently)."""
+
+    LOCK_TIMEOUT_S = 2.0
+    # generous: the stale break exists for CRASHED holders only.  A
+    # live-but-slow holder whose merge outruns this window could have
+    # its lock stolen (two concurrent merges, lost updates) — the
+    # holder stamps the lock's mtime at acquire so the window measures
+    # from the start of ITS flush, and a flush that takes longer than
+    # this on an optimization-only store is an acceptable residual
+    # risk (the store degrades, it never corrupts queries)
+    LOCK_STALE_S = 30.0
 
     def __init__(self, dirpath: str):
         self.dir = dirpath
@@ -445,6 +474,11 @@ class ObservationStore:
         self._lock = threading.Lock()
         self.records: Dict[str, Dict[str, float]] = {}
         self._dirty = False
+        # sites THIS store observed since its last successful flush —
+        # only these may overwrite the on-disk record: a site merely
+        # LOADED at construction is a stale copy, and flushing it
+        # ours-win would revert a concurrent session's fresher values
+        self._dirty_sids: set = set()
         try:
             os.makedirs(dirpath, exist_ok=True)
             self.records = self.read(dirpath)
@@ -467,25 +501,107 @@ class ObservationStore:
                                    (1 - _OBS_ALPHA) * float(prev), 3)
             rec["ts"] = round(time.time(), 3)
             self._dirty = True
+            self._dirty_sids.add(sid)
+
+    def _acquire_file_lock(self) -> bool:
+        """Best-effort cross-process lock (O_EXCL create beside the
+        store).  False when another holder kept it past the timeout —
+        the caller retries at the next flush."""
+        lock = self.path + ".lock"
+        deadline = time.monotonic() + self.LOCK_TIMEOUT_S
+        while True:
+            try:
+                fd = os.open(lock,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                try:
+                    # anchor the staleness window to THIS flush's
+                    # start (creation time could predate a queued
+                    # wait on some filesystems)
+                    os.utime(lock)
+                except OSError:
+                    pass
+                return True
+            except FileExistsError:
+                try:
+                    if time.time() - os.path.getmtime(lock) > \
+                            self.LOCK_STALE_S:
+                        # crashed holder: break the lock by ATOMIC
+                        # rename — exactly one breaker wins the
+                        # rename, so two sessions can never each
+                        # unlink the other's freshly re-created lock
+                        # and both enter the merge window
+                        stale = f"{lock}.stale.{os.getpid()}"
+                        os.rename(lock, stale)
+                        os.unlink(stale)
+                        continue
+                except OSError:
+                    continue  # lock vanished / another breaker won
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.01)
+            except OSError:
+                return False  # unwritable dir: no lock, no flush
+
+    def _release_file_lock(self) -> None:
+        try:
+            os.unlink(self.path + ".lock")
+        except OSError:
+            pass
+
+    @classmethod
+    def _merge_record(cls, disk: Dict[str, float],
+                      ours: Dict[str, float]) -> Dict[str, float]:
+        """Field-wise merge for a site both stores observed: our
+        smoothed values win (freshest evidence), except max-semantics
+        fields (compile_ms worst case; n/ts monotone)."""
+        out = dict(disk)
+        out.update(ours)
+        for k in list(_OBS_MAX_FIELDS) + ["n", "ts"]:
+            if k in disk and k in ours:
+                out[k] = max(disk[k], ours[k])
+        return out
 
     def flush(self) -> None:
         with self._lock:
             if not self._dirty:
                 return
-            snapshot = {k: dict(v) for k, v in self.records.items()}
+            # only sites observed since load/last flush: a record this
+            # store merely loaded must never clobber a concurrent
+            # session's fresher on-disk copy of the same site
+            snapshot = {k: dict(self.records[k])
+                        for k in self._dirty_sids
+                        if k in self.records}
+            taken = set(self._dirty_sids)
+            self._dirty_sids.clear()
             self._dirty = False
+        if not self._acquire_file_lock():
+            with self._lock:
+                self._dirty = True  # nothing lost: retry next flush
+                self._dirty_sids |= taken
+            return
         try:
+            # merge under the lock: a concurrent session's flush since
+            # our load must survive ours (sites only it observed keep
+            # its record; shared sites merge field-wise)
+            merged = self.read(self.dir)
+            for sid, rec in snapshot.items():
+                prev = merged.get(sid)
+                merged[sid] = self._merge_record(prev, rec) \
+                    if prev else rec
             tmp = self.path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
-                for sid in sorted(snapshot):
+                for sid in sorted(merged):
                     rec = {"site": sid}
-                    rec.update(snapshot[sid])
+                    rec.update(merged[sid])
                     f.write(json.dumps(rec) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
         except OSError:
             pass  # persistence is an optimization, never a failure
+        finally:
+            self._release_file_lock()
 
     @staticmethod
     def read(dirpath: str) -> Dict[str, Dict[str, float]]:

@@ -1,0 +1,498 @@
+"""Concurrent scheduler + cross-query computation reuse (ISSUE 13).
+
+Covers the three serving legs end to end: the fair interleaver
+(round-robin progress guarantee, light-query latency under a heavy
+co-tenant, turn handoff on unregister), the plan-keyed result cache
+(hit answers with ZERO source pulls — counter-pinned; stale-read gate
+under file mutation; corrupt-load degrade; UDF refusal; budget
+eviction), the shared cross-query stage cache (a different query
+sharing a subtree splices the checkpoint bit-identically with zero
+source pulls; corrupt restore degrades to recompute), the knobs-off
+parity contract (no sharing field, no reuse events, no serving
+attributes), and the observability pipeline (QueryEnd sharing dict →
+eventlog → profiling stats + health checks).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+from spark_rapids_tpu.serving.scheduler import FairInterleaver
+from spark_rapids_tpu.serving.reuse import ResultCache
+
+NSHARDS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    with I.scoped_rules():
+        yield
+    I.clear()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    if jax.device_count() < NSHARDS:
+        pytest.skip("needs the virtual 8-device mesh")
+    return make_mesh(NSHARDS)
+
+
+@pytest.fixture()
+def fact_parquet(tmp_path):
+    path = str(tmp_path / "fact.parquet")
+    _write_fact(path, scale=1.0)
+    return path
+
+
+def _write_fact(path, scale, n=3000):
+    rng = np.random.default_rng(11)
+    pd.DataFrame({
+        "k": rng.integers(0, 24, n).astype(np.int64),
+        "v": rng.normal(size=n) * scale,
+    }).to_parquet(path)
+
+
+def _oracle(path):
+    pdf = pd.read_parquet(path)
+    pdf = pdf[pdf.v > -1.0]
+    out = pdf.groupby("k", as_index=False).v.sum().rename(
+        columns={"v": "sv"})
+    return out.sort_values("k", ignore_index=True)
+
+
+def _query(session, path):
+    return (session.read.parquet(path).filter(F.col("v") > -1.0)
+            .group_by("k").agg(F.sum(F.col("v")).alias("sv")))
+
+
+def _norm(df):
+    return df.sort_values("k", ignore_index=True)
+
+
+def _count_rule(point):
+    """Skip-consumption counter (the test_checkpoint idiom): every
+    fire() at ``point`` decrements ``skip`` without raising, so
+    (start - rule.skip) is an exact hit count."""
+    return I.inject(point, count=1, skip=1_000_000, all_threads=True)
+
+
+def _hits(rule):
+    return 1_000_000 - rule.skip
+
+
+REUSE_CONF = {
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.serving.sharedStage.enabled": True,
+    "spark.rapids.tpu.serving.interleave.enabled": True,
+    "spark.rapids.sql.recovery.backoffMs": 1,
+}
+
+
+# ------------------------------------------------------------ interleaver --
+def test_interleaver_light_progresses_under_heavy_tenant():
+    """Fairness: a light query's 20 batch slices complete while a
+    heavy co-tenant's 300 are still in flight — round-robin turns
+    bound how long the light tenant waits (starvation-proof)."""
+    sched = FairInterleaver(quantum_batches=1)
+
+    class _Ctx:  # quantum derives from budgets; none here -> base
+        session = None
+        memory_budget = 0
+        deadline_budget_ms = 0
+
+    heavy = sched.register(_Ctx())
+    light = sched.register(_Ctx())
+    heavy_total = 300
+
+    def heavy_client():
+        for _ in range(heavy_total):
+            sched.yield_slice(heavy)
+            time.sleep(0.002)  # a "big batch"
+
+    t = threading.Thread(target=heavy_client)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        for _ in range(20):
+            sched.yield_slice(light)
+        light_done = time.monotonic() - t0
+        heavy_progress = heavy.granted
+    finally:
+        sched.unregister(light)
+        t.join()
+        sched.unregister(heavy)
+    # the light client finished its 20 slices while the heavy one was
+    # still mid-flight (FIFO occupancy would have made it wait out all
+    # 300 x 2ms first), and did so quickly
+    assert heavy_progress < heavy_total, \
+        "light query waited out the whole heavy query (FIFO occupancy)"
+    assert light_done < 5.0
+    assert light.granted == 20
+
+
+def test_interleaver_unregister_passes_turn():
+    """A finishing query hands its turn on — a waiter never blocks
+    behind a ticket that already left the round."""
+    sched = FairInterleaver()
+
+    class _Ctx:
+        session = None
+        memory_budget = 0
+        deadline_budget_ms = 0
+
+    a = sched.register(_Ctx())
+    b = sched.register(_Ctx())
+    # isolate the unregister handoff from the off-gate turn lease
+    # (which would ALSO unblock the waiter, just later)
+    sched.TURN_LEASE_S = 30.0
+    sched.yield_slice(a)  # a holds the turn (quantum consumed)
+    done = threading.Event()
+
+    def waiter():
+        sched.yield_slice(b)  # blocks: a holds the turn
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set()
+    sched.unregister(a)  # turn passes to b
+    t.join(timeout=5.0)
+    assert done.is_set()
+    sched.unregister(b)
+
+
+def test_interleaver_off_gate_holder_lease_expires():
+    """A turn holder that never reaches a gate (cold compile, a long
+    stage body, its post-final-gate tail) must not stall the round:
+    waiters pass the turn over it after the lease and it rejoins at
+    its next gate."""
+    sched = FairInterleaver()
+
+    class _Ctx:
+        session = None
+        memory_budget = 0
+        deadline_budget_ms = 0
+
+    a = sched.register(_Ctx())
+    b = sched.register(_Ctx())
+    sched.yield_slice(a)  # a consumed its quantum, then went off-gate
+    t0 = time.monotonic()
+    sched.yield_slice(b)  # must proceed after the ~50ms lease
+    assert time.monotonic() - t0 < 5.0
+    assert sched.turn_leases_expired >= 1
+    sched.unregister(a)
+    sched.unregister(b)
+
+
+def test_interleaver_queued_query_never_holds_turn(fact_parquet):
+    """Deadlock regression: with ONE admission slot, a QUEUED query
+    must not join the round — its ticket would hold the turn while it
+    never reaches a gate, wedging the admitted query at its own gate
+    (which in turn keeps the slot forever).  Tickets register only
+    AFTER admission succeeds."""
+    conf = dict(REUSE_CONF)
+    conf["spark.rapids.tpu.serving.concurrentQueries"] = 1
+    # small reader batches -> the admitted query gates several times
+    conf["spark.rapids.sql.reader.batchSizeRows"] = 256
+    s = TpuSession(conf)
+    try:
+        results = []
+
+        def client():
+            results.append(
+                _norm(_query(s, fact_parquet).to_pandas()))
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads), \
+            "interleaver deadlock: queued query's ticket held the turn"
+        assert len(results) == 2
+        pd.testing.assert_frame_equal(results[1], results[0])
+    finally:
+        s.stop()
+
+
+def test_interleaver_quantum_weighting():
+    """Budget weighting: a byte weight lighter than the pool default
+    scales the quantum up (bounded), a deadline budget doubles it."""
+    sched = FairInterleaver(quantum_batches=1)
+
+    class _Ctrl:
+        default_weight = 1 << 20
+
+    class _Sess:
+        admission = _Ctrl()
+
+    class _Ctx:
+        session = _Sess()
+        memory_budget = 1 << 18  # 4x lighter than the default
+        deadline_budget_ms = 0
+
+    assert sched.quantum_for(_Ctx()) == 4
+    _Ctx.deadline_budget_ms = 50
+    assert sched.quantum_for(_Ctx()) == 8
+    _Ctx.memory_budget = 1  # absurdly light: bounded at 8x
+    assert sched.quantum_for(_Ctx()) == 16  # 8 (bound) * 2 (deadline)
+
+
+# ----------------------------------------------------------- result cache --
+def test_result_cache_hit_zero_source_pulls(fact_parquet):
+    """The zero-execution pin: a verified hit answers without pulling
+    a single source batch."""
+    s = TpuSession(dict(REUSE_CONF))
+    try:
+        q = _query(s, fact_parquet)
+        r1 = _norm(q.to_pandas())
+        pd.testing.assert_frame_equal(r1, _oracle(fact_parquet))
+        reads = _count_rule("io.read")
+        r2 = _norm(_query(s, fact_parquet).to_pandas())
+        assert _hits(reads) == 0, "cache hit still pulled the source"
+        pd.testing.assert_frame_equal(r2, r1)
+        snap = s.result_cache.snapshot()
+        assert snap["hits"] == 1 and snap["stores"] >= 1, snap
+    finally:
+        s.stop()
+
+
+def test_result_cache_stale_gate_file_mutation(fact_parquet):
+    """Fingerprint drift → invalidation + recompute; NEVER stale
+    bytes.  The rewrite changes content (and mtime), so a hit serving
+    the old frame would fail the oracle compare."""
+    s = TpuSession(dict(REUSE_CONF))
+    try:
+        q = _query(s, fact_parquet)
+        r1 = _norm(q.to_pandas())
+        _write_fact(fact_parquet, scale=4.0)
+        r2 = _norm(_query(s, fact_parquet).to_pandas())
+        pd.testing.assert_frame_equal(r2, _oracle(fact_parquet))
+        assert not r2.equals(r1), "stale read: pre-mutation bytes"
+        snap = s.result_cache.snapshot()
+        assert snap["invalidations"] >= 1, snap
+        assert snap["hits"] == 0, snap
+    finally:
+        s.stop()
+
+
+def test_result_cache_corrupt_load_degrades_to_recompute(fact_parquet):
+    """A flipped bit in the stored result fails the CRC gate: the
+    entry drops, the query recomputes — exact answer, hits stay 0."""
+    s = TpuSession(dict(REUSE_CONF))
+    try:
+        q = _query(s, fact_parquet)
+        r1 = _norm(q.to_pandas())
+        with I.injected("resultcache.load", kind="corrupt", count=1,
+                        all_threads=True):
+            r2 = _norm(_query(s, fact_parquet).to_pandas())
+        pd.testing.assert_frame_equal(r2, r1)
+        snap = s.result_cache.snapshot()
+        assert snap["invalidations"] >= 1 and snap["hits"] == 0, snap
+        # the recompute re-stored; a clean third run hits
+        r3 = _norm(_query(s, fact_parquet).to_pandas())
+        pd.testing.assert_frame_equal(r3, r1)
+        assert s.result_cache.snapshot()["hits"] == 1
+    finally:
+        s.stop()
+
+
+def test_result_cache_refuses_udf_and_pandas_plans(fact_parquet):
+    """Arbitrary Python is not provably deterministic: *InPandas
+    stages and UDF expressions never cache."""
+    s = TpuSession(dict(REUSE_CONF))
+    try:
+        df = s.read.parquet(fact_parquet)
+        ok_plan = df.filter(F.col("v") > 0).plan
+        assert ResultCache.cacheable(ok_plan)
+        pandas_plan = df.mapInPandas(
+            lambda it: it, "k long, v double").plan
+        assert not ResultCache.cacheable(pandas_plan)
+    finally:
+        s.stop()
+
+
+def test_result_cache_budget_eviction(fact_parquet):
+    """maxBytes=1: every store immediately evicts; queries stay exact
+    and the cache never answers (graceful, not wrong)."""
+    conf = dict(REUSE_CONF)
+    conf["spark.rapids.tpu.serving.resultCache.maxBytes"] = 1
+    s = TpuSession(conf)
+    try:
+        r1 = _norm(_query(s, fact_parquet).to_pandas())
+        r2 = _norm(_query(s, fact_parquet).to_pandas())
+        pd.testing.assert_frame_equal(r2, r1)
+        snap = s.result_cache.snapshot()
+        assert snap["hits"] == 0, snap
+        assert snap["entries"] == 0, snap
+        assert snap["evictions"] >= 1 or snap["stores"] == 0, snap
+    finally:
+        s.stop()
+
+
+def test_result_cache_inmemory_pins_gate_id_recycling():
+    """In-memory plans key on batch id()s, which are only sound while
+    the objects live: hits work while the DataFrame is held, and a
+    collected input invalidates the entry (a recycled id could alias
+    different data) — recompute, never a stale-aliased hit."""
+    import gc
+    s = TpuSession(dict(REUSE_CONF))
+    try:
+        pdf = pd.DataFrame({"k": np.arange(60) % 6,
+                            "v": np.arange(60.0)})
+        df = s.create_dataframe(pdf)
+        q = df.group_by("k").agg(F.sum(F.col("v")).alias("sv"))
+        r1 = _norm(q.to_pandas())
+        r2 = _norm(q.to_pandas())
+        pd.testing.assert_frame_equal(r2, r1)
+        assert s.result_cache.snapshot()["hits"] == 1
+        del df, q
+        gc.collect()
+        df2 = s.create_dataframe(pdf)
+        r3 = _norm(df2.group_by("k")
+                   .agg(F.sum(F.col("v")).alias("sv")).to_pandas())
+        pd.testing.assert_frame_equal(r3, r1)
+        snap = s.result_cache.snapshot()
+        assert snap["hits"] == 1, snap  # the post-gc run re-executed
+    finally:
+        s.stop()
+
+
+# ----------------------------------------------------- shared stage cache --
+def test_cross_query_splice_bit_identical_zero_pulls(mesh,
+                                                     fact_parquet):
+    """Two DIFFERENT queries sharing a subtree: the second splices the
+    first's aggregate checkpoint (zero source pulls — counter-pinned)
+    and answers bit-identically to a cold knobs-off session."""
+    cold = TpuSession({"spark.rapids.sql.recovery.backoffMs": 1},
+                      mesh=mesh)
+    try:
+        want = (_query(cold, fact_parquet).orderBy("k").to_pandas())
+    finally:
+        cold.stop()
+    s = TpuSession(dict(REUSE_CONF), mesh=mesh)
+    try:
+        _query(s, fact_parquet).to_pandas()  # warms the shared store
+        assert s.last_dist_explain == "distributed"
+        reads = _count_rule("io.read")
+        # a different plan (Sort on top) sharing the aggregate subtree
+        got = _query(s, fact_parquet).orderBy("k").to_pandas()
+        assert _hits(reads) == 0, \
+            "splice still pulled the shared subtree's source"
+        pd.testing.assert_frame_equal(got, want)
+        snap = s.shared_stages.snapshot()
+        assert snap["resumes"] >= 1, snap
+    finally:
+        s.stop()
+
+
+def test_shared_store_corrupt_restore_recomputes(mesh, fact_parquet):
+    """A corrupt shared-store restore drops the entry and the subtree
+    re-runs — exact answer, SharedStageInvalid on the trail."""
+    s = TpuSession(dict(REUSE_CONF), mesh=mesh)
+    try:
+        _query(s, fact_parquet).to_pandas()
+        with I.injected("checkpoint.restore", kind="corrupt", count=1,
+                        all_threads=True):
+            got = _norm(
+                _query(s, fact_parquet).orderBy("k").to_pandas())
+        pd.testing.assert_frame_equal(got, _oracle(fact_parquet))
+        snap = s.shared_stages.snapshot()
+        assert snap["invalid"] >= 1, snap
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------------ parity --
+def test_knobs_off_parity_with_head(fact_parquet, tmp_path):
+    """All three knobs off ⇒ no serving attributes, every run
+    executes (no silent caching), and the QueryEnd event stream
+    carries NO sharing field — bit-identical shape to HEAD."""
+    log_dir = str(tmp_path / "events")
+    s = TpuSession({"spark.rapids.tpu.eventLog.dir": log_dir})
+    try:
+        assert s.result_cache is None
+        assert s.shared_stages is None
+        assert s.interleaver is None
+        r1 = _norm(_query(s, fact_parquet).to_pandas())
+        reads = _count_rule("io.read")
+        r2 = _norm(_query(s, fact_parquet).to_pandas())
+        assert _hits(reads) > 0, "knobs off must re-execute"
+        pd.testing.assert_frame_equal(r2, r1)
+    finally:
+        s.stop()
+    events = []
+    for name in os.listdir(log_dir):
+        with open(os.path.join(log_dir, name)) as fh:
+            events += [json.loads(line) for line in fh if line.strip()]
+    ends = [e for e in events if e.get("event") == "QueryEnd"]
+    assert ends and all("sharing" not in e for e in ends)
+    assert not any(e.get("event", "").startswith(
+        ("ResultCache", "SharedStage")) for e in events)
+
+
+# ------------------------------------------------------- observability --
+def test_sharing_events_eventlog_profiling(mesh, fact_parquet,
+                                           tmp_path):
+    """QueryEnd sharing dict + reuse events → eventlog → profiling
+    stats; the repeat-plan-zero-hit health check stays quiet when the
+    cache is actually hitting."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (health_check,
+                                                  sharing_stats)
+    log_dir = str(tmp_path / "events")
+    conf = dict(REUSE_CONF)
+    conf["spark.rapids.tpu.eventLog.dir"] = log_dir
+    s = TpuSession(conf, mesh=mesh)
+    try:
+        _query(s, fact_parquet).to_pandas()
+        _query(s, fact_parquet).to_pandas()            # cache hit
+        _query(s, fact_parquet).orderBy("k").to_pandas()  # splice
+    finally:
+        s.stop()
+    apps = load_logs(log_dir)
+    assert apps
+    stats = sharing_stats(apps)
+    assert stats["result_cache_hits"] >= 1, stats
+    assert stats["stage_splices"] >= 1, stats
+    assert stats["stage_writes"] >= 1, stats
+    hits = [q for a in apps for q in a.queries
+            if q.sharing.get("resultCacheHit")]
+    assert hits, "no QueryEnd carried resultCacheHit"
+    problems = health_check(apps)
+    assert not any("result cache 0% hit" in p for p in problems), \
+        problems
+
+
+def test_health_check_flags_repeat_plan_zero_hit(fact_parquet,
+                                                 tmp_path):
+    """The cache is ON, the same plan repeats, nothing ever hits
+    (inputs rewritten every query): the health check must say so."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import health_check
+    log_dir = str(tmp_path / "events")
+    conf = dict(REUSE_CONF)
+    conf["spark.rapids.tpu.eventLog.dir"] = log_dir
+    s = TpuSession(conf)
+    try:
+        for i in range(3):
+            _write_fact(fact_parquet, scale=float(i + 1))
+            _query(s, fact_parquet).to_pandas()
+    finally:
+        s.stop()
+    problems = health_check(load_logs(log_dir))
+    assert any("result cache 0% hit" in p for p in problems), problems
