@@ -110,14 +110,21 @@ for enabled in (True, False):
           f"trail: {[r['action'] for r in s.recovery_log]})")
 PY
 
-echo "== continuous-ingest soak (N ticks under chaos spray, exact-result + bounded-memory gate) =="
-# a standing aggregation query ingests one appended parquet file per
-# tick while delay/raise/corrupt/oom rules spray every tick's
-# executions.  Gates: every tick's answer is EXACTLY the one-shot
-# recompute over everything ingested so far (epoch rollback may
-# degrade a tick to full recompute — never to wrong bytes), and memory
-# is bounded — spill-catalog device bytes and process RSS plateau
-# instead of growing monotonically across ticks.
+echo "== continuous-ingest soak: join + window + top-N shapes (N ticks under chaos spray, exact-result + bounded-memory/state gates) =="
+# THREE standing queries — join-enrich-then-aggregate with a top-N
+# post chain, windowed aggregation with watermark eviction, and the
+# original plain aggregate — each ingest one appended parquet file
+# per tick while delay/raise/corrupt/oom rules spray every tick's
+# executions (the incremental points plus the exchange and spill
+# surfaces).  Gates: every tick's answer on every shape is EXACTLY
+# the one-shot recompute over everything ingested so far (the
+# windowed oracle filtered by the tick's own committed watermark;
+# epoch rollback may degrade a tick to full recompute — never to
+# wrong bytes), memory is bounded (spill-catalog device bytes and
+# process RSS plateau instead of growing with tick count), and the
+# windowed shape's STATE is bounded — watermark eviction holds state
+# bytes at a plateau under infinite-style ingest with zero stale or
+# resurrected windows.
 python - <<'PY'
 import os
 import shutil
@@ -134,7 +141,7 @@ from spark_rapids_tpu.robustness import inject as I
 from spark_rapids_tpu.robustness import incremental as _inc  # registers points
 from spark_rapids_tpu.robustness.incremental import incremental_metrics
 
-TICKS = 6
+TICKS = 8
 SPRAY = (("io.read", dict(kind="raise", count=2, probability=0.4)),
          ("shuffle.exchange", dict(kind="raise", count=2,
                                    probability=0.4)),
@@ -143,6 +150,10 @@ SPRAY = (("io.read", dict(kind="raise", count=2, probability=0.4)),
          ("memory.oom", dict(kind="raise", count=1, probability=0.3)),
          ("incremental.state.restore", dict(kind="corrupt", count=1,
                                             probability=0.3)),
+         ("incremental.state.write", dict(kind="raise", count=1,
+                                          probability=0.2)),
+         ("checkpoint.restore", dict(kind="corrupt", count=1,
+                                     probability=0.2)),
          ("spill.corrupt.host", dict(kind="corrupt", count=1,
                                      probability=0.3)))
 
@@ -162,6 +173,16 @@ def write(i):
     pdf.to_parquet(p, index=False)
     return p
 
+def write_win(i, tick):
+    pdf = pd.DataFrame({
+        "k": rng.integers(0, 10, 3000),
+        "v": rng.integers(0, 1000, 3000).astype(np.float64),
+        "ts": pd.to_datetime("2024-01-01") + pd.to_timedelta(
+            tick * 600 + rng.integers(0, 600, 3000), unit="s")})
+    p = os.path.join(d, f"w{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
 s = TpuSession({"spark.rapids.sql.recovery.backoffMs": 5,
                 "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000,
                 # ISSUE 11: the state/spill frames this soak's corrupt
@@ -169,44 +190,117 @@ s = TpuSession({"spark.rapids.sql.recovery.backoffMs": 5,
                 # the incremental.state.restore spray therefore covers
                 # the compressed-state leg of the codec-corruption gate
                 "spark.rapids.tpu.encoding.storage.hostCodec": "lz4",
-                "spark.rapids.tpu.incremental.tiers": "host,disk"},
+                "spark.rapids.tpu.incremental.tiers": "host,disk",
+                # ISSUE 14: watermark eviction two buckets behind the
+                # newest event time — the bounded-state gate's knob
+                "spark.rapids.tpu.incremental.watermarkDelayMs": 1200000},
                mesh=make_mesh(8))
 incremental_metrics.reset()
-first = [write(0), write(1)]
-df = (s.read.parquet(*first).groupBy("k")
-      .agg(F.sum("v").alias("sv"), F.count("v").alias("c"),
-           F.avg("v").alias("av")).orderBy("k"))
-runner = s.incremental(df)
-runner.tick()  # cold epoch, no chaos
-dev, rss = [], []
+
+# shape 1: join-enrich-then-aggregate with a provable top-N chain
+dim = pd.DataFrame({"k": np.arange(50),
+                    "w": (np.arange(50) % 7 + 1).astype(np.float64)})
+dim_agg = s.create_dataframe(dim).groupBy("k").agg(F.max("w").alias("w"))
+fj = [write(0), write(1)]
+df_j = (s.read.parquet(*fj).join(dim_agg, "k").groupBy("k")
+        .agg(F.sum((F.col("v") * F.col("w")).alias("vw")).alias("s"),
+             F.count("v").alias("c"))
+        .orderBy(F.col("k").desc()).limit(20))
+run_j = s.incremental(df_j)
+assert run_j._spec is not None and run_j._spec.join_type == "inner"
+assert run_j._spec.trim_n == 20
+
+# shape 2: windowed aggregation with watermark eviction
+fw = [write_win(0, 0), write_win(1, 1)]
+df_w = (s.read.parquet(*fw)
+        .groupBy(F.window("ts", "10 minutes"), "k")
+        .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))
+        .orderBy("window.start", "k"))
+run_w = s.incremental(df_w)
+assert run_w._spec is not None and run_w._spec.window_end == "window.end"
+
+# shape 3: the original plain mergeable aggregate
+fa = [write(100), write(101)]
+df_a = (s.read.parquet(*fa).groupBy("k")
+        .agg(F.sum("v").alias("sv"), F.count("v").alias("c"),
+             F.avg("v").alias("av")).orderBy("k"))
+run_a = s.incremental(df_a)
+
+for r in (run_j, run_w, run_a):
+    r.tick()  # cold epochs, no chaos
+
+raised = 0
+dev, rss, wstate = [], [], []
 try:
     for t in range(TICKS):
-        p = write(2 + t)
+        pj, pw, pa = write(2 + t), write_win(2 + t, 2 + t), write(102 + t)
+        # a tick may RAISE when chaos kills both the delta attempt AND
+        # the degraded recompute (e.g. a state.write fault landing on
+        # the recompute path) — the PR7 contract is that the committed
+        # epoch is untouched and the files re-ingest on retry; the
+        # post-spray retry below exercises exactly that
+        results = {}
         with I.scoped_rules():
             for point, kw in SPRAY:
                 I.inject(point, seed=100 + t, all_threads=True, **kw)
-            got = runner.tick([p]).to_pandas()
-        # one-shot recompute oracle over everything ingested (runner
-        # keeps the standing df's scan in step), chaos disarmed
-        want = df.to_pandas()
-        pd.testing.assert_frame_equal(got, want)
+            for name, runner, paths in (("j", run_j, [pj]),
+                                        ("w", run_w, [pw]),
+                                        ("a", run_a, [pa])):
+                try:
+                    results[name] = runner.tick(paths)
+                except Exception:
+                    results[name] = None
+        for name, runner, paths in (("j", run_j, [pj]),
+                                    ("w", run_w, [pw]),
+                                    ("a", run_a, [pa])):
+            if results[name] is None:  # spray disarmed: clean retry
+                raised += 1
+                results[name] = runner.tick(paths)
+        got_j = results["j"].to_pandas()
+        got_w = results["w"].to_pandas()
+        got_a = results["a"].to_pandas()
+        # one-shot recompute oracles over everything ingested (each
+        # runner keeps its standing df's scan in step), chaos disarmed;
+        # the windowed oracle applies the tick's OWN committed
+        # watermark — stale or resurrected windows would diverge
+        pd.testing.assert_frame_equal(got_j, df_j.to_pandas())
+        wm = run_w.last_tick_info["watermark"]
+        # canonical eviction semantics (the test helper's oracle):
+        # null-window buckets never expire, so the filter keeps them
+        pd.testing.assert_frame_equal(
+            got_w, df_w.filter(
+                F.col("window.end").isNull() |
+                (F.col("window.end") > pd.Timestamp(wm, unit="us")))
+            .to_pandas())
+        pd.testing.assert_frame_equal(got_a, df_a.to_pandas())
         dev.append(s.memory_catalog.stats()["device_bytes"])
         rss.append(rss_mb())
+        wstate.append(run_w.store.state_bytes)
 finally:
-    runner.close()
+    for r in (run_j, run_w, run_a):
+        r.close()
     s.stop()
     shutil.rmtree(d, ignore_errors=True)
 
 m = incremental_metrics.snapshot()
-# bounded memory: state size is per-group, not per-ingested-row — the
-# device watermark and RSS must plateau, not grow with tick count
+# bounded memory: state size is per-group (and per-LIVE-window), not
+# per-ingested-row — device watermark and RSS plateau, not grow
 assert dev[-1] <= max(dev[:2]) + (16 << 20), dev
 assert rss[-1] - rss[1] < 400.0, rss
-assert m["commits"] >= TICKS, m
-print(f"ingest soak OK ({TICKS} chaos ticks exact, "
+# bounded state: watermark eviction holds the windowed shape's state
+# bytes at a plateau across 8 infinite-style ingest ticks
+assert wstate[-1] <= max(wstate[:3]) + 4096, wstate
+assert m["watermarkEvictedBuckets"] >= 4, m
+assert m["commits"] >= 3 * TICKS, m
+assert m["joinTicks"] + m["windowTicks"] + m["topnTicks"] >= 1, m
+print(f"ingest soak OK ({TICKS} chaos ticks x 3 shapes exact, "
+      f"raised+retried={raised}, "
       f"incremental={m['incrementalTicks']} full={m['fullRecomputes']} "
-      f"rollbacks={m['rollbacks']} stateBytes={m['stateBytes']}, "
-      f"device_bytes={dev[-1]} rssΔ={rss[-1]-rss[1]:.0f}MB)")
+      f"rollbacks={m['rollbacks']} stateBytes={m['stateBytes']} "
+      f"wmEvicted={m['watermarkEvictedBuckets']}bkt/"
+      f"{m['watermarkEvictedBytes']}B, "
+      f"device_bytes={dev[-1]} rssΔ={rss[-1]-rss[1]:.0f}MB "
+      f"windowState={wstate})")
 PY
 
 echo "== jit-cache corruption/version spray (persistent tier degraded, exact results) =="

@@ -616,9 +616,24 @@ class DataFrame:
             # BEFORE planning or admission — a verified hit (exact
             # plan text + matching input fingerprint + CRC) answers
             # with zero executions and zero queueing; the token
-            # carries the PRE-execution fingerprint for the store
+            # carries the PRE-execution fingerprint for the store.
+            # Continuous-ingest ticks bypass BOTH reuse stores
+            # wholesale (no lookup, no store, no shared-stage
+            # registration): a tick's plans over transient state
+            # relations carry id()-keyed in-memory fingerprints whose
+            # no-alias invariant ("the owning plan keeps its batches
+            # alive") does not hold for state batches freed at the
+            # next commit, and shared writes would outlive the epoch
+            # store's rollback — the tick's crash-consistency
+            # contract must rest on the epoch store alone
+            # (robustness/incremental.in_tick)
+            from spark_rapids_tpu.robustness.incremental import (
+                in_tick)
+            tick = in_tick()
             cache = getattr(self.session, "result_cache", None)
-            pend = cache.offer(self.plan) if cache is not None else None
+            pend = None
+            if cache is not None and not tick:
+                pend = cache.offer(self.plan)
             if pend is not None and pend.hit:
                 return self._answer_from_cache(pend)
             ctx.admit()
@@ -642,6 +657,7 @@ class DataFrame:
             # lineage dies with the query).
             shared = getattr(self.session, "shared_stages", None)
             use_shared = (shared is not None and shared.enabled
+                          and not tick
                           and getattr(self.session, "mesh", None)
                           is not None
                           and self.session.checkpoints is None)

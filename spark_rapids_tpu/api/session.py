@@ -427,7 +427,7 @@ class TpuSession:
         return resolve(self, parse(query))
 
     # --------------------------------------------------- continuous ingest --
-    def incremental(self, df: DataFrame):
+    def incremental(self, df: DataFrame, fact: Optional[str] = None):
         """Stand ``df`` up as a continuous-ingest micro-batch query
         (robustness/incremental.py): the returned
         :class:`MicroBatchRunner`'s ``tick(new_paths)`` ingests
@@ -435,10 +435,17 @@ class TpuSession:
         re-executing only the delta and merging with crash-consistent
         committed state — any mid-tick fault rolls back to the last
         committed epoch and the tick degrades to a full recompute.
+        Aggregates, delta-joins (new fact batches × unchanged
+        dimension state), windowed aggregation with watermark
+        eviction, and provably-mergeable top-N all tick
+        incrementally; anything else ticks as a full re-execution
+        with lineage splice.  ``fact`` designates the append-target
+        scan for multi-scan plans (a fact⋈dim join over two file
+        tables): pass any path already in the fact table's file list.
         Governed by ``spark.rapids.tpu.incremental.*``."""
         from spark_rapids_tpu.robustness.incremental import (
             MicroBatchRunner)
-        return MicroBatchRunner(self, df)
+        return MicroBatchRunner(self, df, fact=fact)
 
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan, overrides=None):

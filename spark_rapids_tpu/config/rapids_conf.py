@@ -953,6 +953,35 @@ INCREMENTAL_TIERS = conf(
     lambda v: None if v in ("device,host,disk", "host,disk", "disk")
     else "must be 'device,host,disk', 'host,disk' or 'disk'")
 
+INCREMENTAL_WATERMARK_DELAY_MS = conf(
+    "spark.rapids.tpu.incremental.watermarkDelayMs", -1,
+    "Event-time watermark delay for windowed continuous-ingest "
+    "queries (group keys built from functions.window): each committed "
+    "epoch advances the watermark to max(window end seen) minus this "
+    "delay, the tick's answer excludes windows whose end is at or "
+    "before the watermark, and their partial-state buckets evict "
+    "atomically with the commit — state stays bounded under infinite "
+    "ingest and late rows for expired windows are dropped (they can "
+    "never change the answer). A rolled-back tick advances nothing: "
+    "watermark and state restore to the committed epoch together. "
+    "-1 (default) disables eviction — windowed aggregations then keep "
+    "every bucket, like any other group key.", _to_int,
+    lambda v: None if v >= -1 else "must be >= -1 (-1 = off)")
+
+INCREMENTAL_TOPN_MAX_STATE_ROWS = conf(
+    "spark.rapids.tpu.incremental.topn.maxStateRows", 65536,
+    "State cap for mergeable top-N continuous-ingest queries "
+    "(orderBy(group keys).limit(n) over a decomposable aggregate): "
+    "when the sort key set covers the group keys with bare column "
+    "references — the condition under which merging per-epoch top-K "
+    "partials provably reproduces the one-shot answer bit-for-bit — "
+    "the standing state and every delta partial are trimmed to the "
+    "limit's n rows, so state is bounded by n instead of by the "
+    "number of groups ever seen. Limits larger than this cap keep "
+    "the untrimmed full-group state (still correct, just bigger); "
+    "sort keys touching aggregated values always refuse the trim.",
+    _to_int, _positive)
+
 ENCODING_EXECUTION_ENABLED = conf(
     "spark.rapids.tpu.encoding.execution.enabled", False,
     "Encoded execution: string GROUP BY keys that are bare column "

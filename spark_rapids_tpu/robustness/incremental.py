@@ -30,13 +30,30 @@ micro-batch with **epoch semantics**:
 
 Two reuse mechanisms compose:
 
-1. **Delta re-aggregation** (the streaming-aggregation workload class):
-   plans of shape ``[Sort|Limit|Filter]* <- Aggregate <-
-   [Filter|Project]* <- FileRelation`` decompose into mergeable
-   partials (sum→sum, count→sum, min→min, max→max, avg→(sum,count)).
-   The tick aggregates ONLY the appended files and merges
-   (old-state ⊕ delta) through the engine's own aggregate merge
-   discipline — zero re-pulls of already-ingested source files.
+1. **Delta decomposition** (the streaming workload classes): plans of
+   shape ``[Sort|Limit|Filter]* <- Aggregate <- [Filter|Project]* <-
+   source`` decompose into mergeable partials (sum→sum, count→sum,
+   min→min, max→max, avg→(sum,count)).  The tick aggregates ONLY the
+   appended files and merges (old-state ⊕ delta) through the engine's
+   own aggregate merge discipline — zero re-pulls of already-ingested
+   source files.  ``source`` may be the appended fact scan itself, or
+   a **delta-join**: ``Join(fact chain, dim subtree)`` where the join
+   type preserves per-fact-row locality (inner always; left/semi/anti
+   with the fact on the left; right with the fact on the right) — the
+   tick joins only the NEW fact batches against the unchanged
+   dimension state, whose completed subtrees splice from the lineage
+   store, and a dim-side input-fingerprint drift drops the state and
+   degrades the tick to full recompute.  Two refinements bound state:
+   **windowed aggregation** (group keys from ``functions.window``)
+   under ``incremental.watermarkDelayMs`` advances an event-time
+   watermark at every commit and evicts expired window buckets
+   atomically with it (rollback restores data AND watermark — no
+   resurrection of evicted windows, no premature eviction from a
+   rolled-back tick); **mergeable top-N**
+   (``orderBy(group keys).limit(n)``) trims state and delta partials
+   to the top-n rows whenever the sort key set provably makes the
+   merge reproduce the one-shot answer bit-for-bit (bare group-key
+   sort columns covering every key; value sorts refuse).
 2. **Lineage splice** for everything else: the store subclasses the
    PR5 CheckpointManager with ``always_resume`` — stage ids now fold in
    an **input fingerprint** (file list + sizes + mtimes,
@@ -62,6 +79,7 @@ from __future__ import annotations
 
 import copy
 import hashlib
+import itertools
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -78,6 +96,21 @@ from spark_rapids_tpu.robustness.inject import (fire, fire_mutate,
 register_point("incremental.state.write")
 register_point("incremental.state.restore")
 
+# Tick-in-flight marker (thread-local: ticks serialize per runner and
+# every execution inside a tick starts on the tick thread).  The
+# result cache (serving/reuse.py) must never answer a tick's
+# execution: tick plans over transient state relations can collide
+# with pre-tick entries, and the tick's crash-consistency contract
+# rests on the epoch store alone — DataFrame._execute_batches checks
+# in_tick() and bypasses lookup AND store for everything a tick runs.
+_TICK_TLS = threading.local()
+
+
+def in_tick() -> bool:
+    """True while the calling thread is inside MicroBatchRunner.tick()
+    (any runner, incremental.enabled on or off)."""
+    return getattr(_TICK_TLS, "depth", 0) > 0
+
 
 class IncrementalMetrics(CheckpointMetrics):
     """Process-wide continuous-ingest counters (bench.py --ingest-ticks
@@ -89,7 +122,8 @@ class IncrementalMetrics(CheckpointMetrics):
     FIELDS = ("ticks", "incrementalTicks", "fullRecomputes", "commits",
               "rollbacks", "writes", "bytesWritten", "resumes",
               "stagesSkipped", "evictions", "invalid", "stateBytes",
-              "stateBytesRaw")
+              "stateBytesRaw", "joinTicks", "windowTicks", "topnTicks",
+              "watermarkEvictedBuckets", "watermarkEvictedBytes")
 
     def set(self, field: str, value: int) -> None:
         with self._lock:
@@ -131,19 +165,25 @@ def _batch_payload(batch) -> dict:
 class AggState:
     """One epoch's partial-aggregate state: the spill-catalog handle
     holding the merged partial batch plus the input fingerprint it was
-    computed from."""
+    computed from.  ``watermark`` is the epoch's event-time watermark
+    (microseconds; None for non-windowed shapes) — it lives WITH the
+    state so commit promotes and rollback discards them together:
+    a rolled-back tick can neither advance the watermark nor
+    resurrect a bucket the committed epoch already evicted."""
 
     __slots__ = ("handle", "nrows", "crc", "size_bytes", "fingerprint",
-                 "epoch")
+                 "epoch", "watermark")
 
     def __init__(self, handle, nrows: int, crc: int, size_bytes: int,
-                 fingerprint: str, epoch: int):
+                 fingerprint: str, epoch: int,
+                 watermark: Optional[int] = None):
         self.handle = handle
         self.nrows = nrows
         self.crc = crc
         self.size_bytes = size_bytes
         self.fingerprint = fingerprint
         self.epoch = epoch
+        self.watermark = watermark
 
 
 class IncrementalStateStore(CheckpointManager):
@@ -159,6 +199,12 @@ class IncrementalStateStore(CheckpointManager):
 
     always_resume = True
 
+    # per-process store sequence: stamps StateWatermark with a stable
+    # per-standing-query discriminator so app-level consumers (the
+    # watermark-stall health check) can group one runner's trail —
+    # without it, one advancing windowed query masks a stalled one
+    _STORE_SEQ = itertools.count(1)
+
     def __init__(self, session):
         from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.memory.spill import (
@@ -166,6 +212,7 @@ class IncrementalStateStore(CheckpointManager):
         # base wiring (session/catalog/entry log/counters) is the
         # manager's; only the governing confs and the priority differ
         super().__init__(session)
+        self.store_id = next(IncrementalStateStore._STORE_SEQ)
         conf = session.conf
         self.enabled = bool(conf.get(rc.INCREMENTAL_ENABLED))
         self.max_bytes = int(conf.get(rc.INCREMENTAL_MAX_STATE_BYTES))
@@ -259,11 +306,15 @@ class IncrementalStateStore(CheckpointManager):
             self._agg_prov = None
 
     # ------------------------------------------------------------ agg state I/O --
-    def put_state(self, batch, fingerprint: str) -> None:
+    def put_state(self, batch, fingerprint: str,
+                  watermark: Optional[int] = None) -> None:
         """Register the tick's merged partial-aggregate batch as the
         PROVISIONAL epoch's state (replacing any earlier provisional
         from the same tick — a degraded tick overwrites its own
-        half-built state, never the committed epoch)."""
+        half-built state, never the committed epoch).  For windowed
+        shapes the batch arrives already watermark-evicted and
+        ``watermark`` is the epoch it was evicted against — the two
+        are one provisional unit, promoted or discarded together."""
         from spark_rapids_tpu.memory.spill import _payload_checksum
         fire("incremental.state.write")
         if self._agg_prov is not None:
@@ -287,7 +338,7 @@ class IncrementalStateStore(CheckpointManager):
                 handle, self.tiers[0] if self.tiers else "HOST")
         self._agg_prov = AggState(handle, batch.nrows, crc,
                                   handle.size_bytes, fingerprint,
-                                  self.epoch + 1)
+                                  self.epoch + 1, watermark=watermark)
         self._bump("writes")
         self._bump("bytesWritten", handle.size_bytes)
         self._evict_over_budget()
@@ -348,6 +399,14 @@ class IncrementalStateStore(CheckpointManager):
         return self._agg.fingerprint if self._agg is not None else None
 
     @property
+    def state_watermark(self) -> Optional[int]:
+        """The COMMITTED epoch's event-time watermark (us) — the floor
+        every later advance builds on; None for non-windowed state or
+        after a state drop (a recompute then re-derives an equal-or-
+        later watermark from the data, monotone by construction)."""
+        return self._agg.watermark if self._agg is not None else None
+
+    @property
     def state_bytes(self) -> int:
         """STORED bytes of all standing state — compressed host/disk
         frames meter their encoded size, so maxStateBytes holds
@@ -367,14 +426,22 @@ class IncrementalStateStore(CheckpointManager):
         return n
 
     # -------------------------------------------------------------------- epochs --
-    def commit(self, mode: str, delta_files: int, reused: bool) -> int:
+    def commit(self, mode: str, delta_files: int, reused: bool,
+               evicted_buckets: int = 0, evicted_rows: int = 0,
+               evicted_bytes: int = 0) -> int:
         """Atomically promote the provisional epoch: the new aggregate
         state replaces the old (whose payload is released), provisional
         stage entries become committed, and — when this tick spliced —
         committed entries the tick never touched are pruned (their
         input fingerprints have moved on; they can never match again).
         The commit is the LAST step of a tick: everything before it is
-        invisible to the next tick until this returns."""
+        invisible to the next tick until this returns.  For windowed
+        shapes the commit doubles as the watermark advance — the
+        provisional state was built already-evicted against its
+        watermark, so promoting it IS the atomic
+        eviction+advance (``evicted_buckets``/``evicted_bytes`` are
+        the counts that eviction removed, stamped on the
+        ``StateWatermark`` event this emits)."""
         self.epoch += 1
         if self._agg_prov is not None:
             old, self._agg = self._agg, self._agg_prov
@@ -414,6 +481,23 @@ class IncrementalStateStore(CheckpointManager):
                    stateBytes=self.state_bytes,
                    entries=len(self._entries), mode=mode,
                    deltaFiles=delta_files, reusedState=bool(reused))
+        if self._agg is not None and self._agg.watermark is not None:
+            # the windowed shape's commit fact: where the watermark
+            # landed and what its eviction removed — the profiling
+            # "Continuous ingest" watermark line and the
+            # watermark-stalled-growth health check read these
+            incremental_metrics.bump("watermarkEvictedBuckets",
+                                     evicted_buckets)
+            incremental_metrics.bump("watermarkEvictedBytes",
+                                     evicted_bytes)
+            self._emit("StateWatermark", epoch=self.epoch,
+                       store=self.store_id,
+                       watermark=int(self._agg.watermark),
+                       evictedBuckets=int(evicted_buckets),
+                       evictedRows=int(evicted_rows),
+                       evictedBytes=int(evicted_bytes),
+                       stateRows=int(self._agg.nrows),
+                       stateBytes=self.state_bytes)
         return self.epoch
 
     def rollback(self, reason: str) -> None:
@@ -463,9 +547,8 @@ class IncrementalStateStore(CheckpointManager):
 
 # ------------------------------------------------------------- plan analysis --
 
-def _single_file_scan(plan):
-    """The unique FileRelation leaf of a plan, or None (no scan, or
-    more than one — appending paths would be ambiguous)."""
+def _file_scans(plan) -> list:
+    """Every FileRelation leaf of a plan, in pre-order."""
     from spark_rapids_tpu.plan import logical as L
     scans = []
 
@@ -476,15 +559,32 @@ def _single_file_scan(plan):
             walk(c)
 
     walk(plan)
+    return scans
+
+
+def _find_fact_scan(plan, fact=None):
+    """The FileRelation leaf tick() appends to: the plan's unique scan,
+    or — for multi-scan plans like a fact⋈dim join over two parquet
+    tables — the one designated by ``fact`` (a path already in its
+    file list).  None when no unambiguous choice exists (the runner
+    then has no append target; plans without one still tick as full
+    re-executions with lineage splice)."""
+    scans = _file_scans(plan)
+    if fact is not None:
+        hits = [s for s in scans if fact in s.paths]
+        return hits[0] if len(hits) == 1 else None
     return scans[0] if len(scans) == 1 else None
 
 
 def _replace_scan(plan, scan, paths):
     """Clone ``plan`` with ``scan``'s path list swapped for ``paths``.
     Expressions stay shared (they are bound by ordinal and the delta
-    scan exposes the identical schema); only the node spine is
-    copied."""
-    from spark_rapids_tpu.plan import logical as L
+    scan exposes the identical schema), and subtrees that do not
+    contain ``scan`` are shared UNTOUCHED — the dimension side of a
+    delta-join keeps node identity across ticks, so its
+    InMemoryRelation batch ids (and therefore its input fingerprints
+    and spliceable stage ids) stay stable; only the spine from the
+    root down to the scan is copied."""
     if plan is scan:
         new = copy.copy(plan)
         new.paths = list(paths)
@@ -493,27 +593,60 @@ def _replace_scan(plan, scan, paths):
         return new
     if not plan.children:
         return plan
-    new = copy.copy(plan)
-    new.children = tuple(_replace_scan(c, scan, paths)
+    new_children = tuple(_replace_scan(c, scan, paths)
                          for c in plan.children)
+    if all(nc is c for nc, c in zip(new_children, plan.children)):
+        return plan
+    new = copy.copy(plan)
+    new.children = new_children
     return new
 
 
 class _AggSpec:
-    """Decomposition of an aggregation plan into mergeable partials.
+    """Decomposition prover: certify a standing plan's delta form, or
+    refuse it (``None`` — ticks then full-recompute with lineage
+    splice, which is always correct).
 
-    ``[Sort|Limit|Filter]* <- Aggregate <- [Filter|Project]* <- scan``
-    splits into: a partial-aggregate plan template (run over the delta
-    files only), a merge aggregate (re-reduce (old-state ⊕ delta)
-    partial rows — the same update/merge split the engine's chunked and
-    distributed aggregates use, ops/aggregates.merge_kind), a finalize
-    projection (avg = sum/count), and the post-aggregate operator chain
-    re-applied on top.  ``None`` from :meth:`analyze` means the plan
-    has no delta form — ticks then full-recompute (with lineage
-    splice), which is always correct."""
+    ``[Sort|Limit|Filter]* <- Aggregate <- [Filter|Project]* <-
+    source`` splits into: a partial-aggregate plan template (run over
+    the delta files only), a merge aggregate (re-reduce (old-state ⊕
+    delta) partial rows — the same update/merge split the engine's
+    chunked and distributed aggregates use, ops/aggregates.merge_kind),
+    a finalize projection (avg = sum/count), and the post-aggregate
+    operator chain re-applied on top.  Three admitted source/refinement
+    shapes beyond the plain scan:
+
+    - **delta-join** — ``source`` is ``Join(fact chain, dim subtree)``
+      where the fact scan sits under its own [Filter|Project]* chain
+      and the join type makes output rows a per-fact-row function
+      (inner always; left/semi/anti only with the fact on the left;
+      right only with the fact on the right — every other type scopes
+      output to DIM rows, where a new fact batch can flip matched-ness
+      and no per-delta decomposition is sound).  Dim subtrees routing
+      through arbitrary Python (UDF/pandas) refuse: the delta merge
+      re-executes the dim side and non-determinism would diverge from
+      the one-shot oracle.
+    - **windowed aggregation** — a group key pair built by
+      ``functions.window`` (tumbling only; sliding lowers through
+      Expand and never reaches this prover).  With
+      ``incremental.watermarkDelayMs`` set, ``window_end`` names the
+      bucket-end key the watermark advances on and eviction filters.
+    - **mergeable top-N** — post chain exactly ``Limit <- Sort`` whose
+      sort keys are bare group-key references covering EVERY group key
+      (the ordering over output rows is then total, and for append-only
+      ingest a group trimmed from the top-n can never re-enter: the n
+      better-keyed groups that displaced it persist — so merging
+      trimmed partials provably reproduces the one-shot answer
+      bit-for-bit).  Sort keys touching aggregated values refuse the
+      trim (a value can move a group back into the top-n after its
+      partial was discarded); limits above
+      ``incremental.topn.maxStateRows`` keep full-group state.
+    """
 
     def __init__(self, agg, pre_chain_root, post_ops, partial_aggs,
-                 merge_keys, merge_aggs, final_exprs, partial_schema):
+                 merge_keys, merge_aggs, final_exprs, partial_schema,
+                 join_type=None, dim_plan=None, window_end=None,
+                 delay_us=None, trim_n=None, trim_sort=None):
         self.agg = agg
         self.pre_root = pre_chain_root  # plan node directly above scan
         self.post_ops = post_ops        # outermost-first [Sort|Limit|Filter]
@@ -522,9 +655,53 @@ class _AggSpec:
         self.merge_aggs = merge_aggs
         self.final_exprs = final_exprs
         self.partial_schema = partial_schema
+        self.join_type = join_type      # admitted delta-join type
+        self.dim_plan = dim_plan        # static dimension subtree
+        self.window_end = window_end    # bucket-end key (eviction on)
+        self.delay_us = delay_us        # watermark delay (us)
+        self.trim_n = trim_n            # proven top-N state bound
+        self.trim_sort = trim_sort      # the Sort node the trim applies
+
+    @property
+    def shape(self) -> str:
+        """Primary shape label (spans, last_tick_info, bench)."""
+        if self.join_type is not None:
+            return "join"
+        if self.window_end is not None:
+            return "window"
+        if self.trim_n is not None:
+            return "topn"
+        return "agg"
+
+    @staticmethod
+    def _fact_side(join, scan):
+        """Which join child reaches ``scan`` through a pure
+        [Filter|Project]* chain (0=left, 1=right), or None.  Chain
+        purity is what lets ``_replace_scan`` build the delta fact
+        side; the other child is the dimension subtree and must not
+        contain the fact scan anywhere (a self-join over the appended
+        table has no per-delta form — delta×delta pairs would be
+        lost)."""
+        from spark_rapids_tpu.plan import logical as L
+        side = None
+        for i, child in enumerate(join.children):
+            c = child
+            while isinstance(c, (L.Filter, L.Project)):
+                c = c.children[0]
+            if c is scan:
+                side = i if side is None else None
+        if side is None:
+            return None
+
+        def contains(node):
+            if node is scan:
+                return True
+            return any(contains(ch) for ch in node.children)
+
+        return None if contains(join.children[1 - side]) else side
 
     @classmethod
-    def analyze(cls, plan, scan):
+    def analyze(cls, plan, scan, watermark_delay_us=None, topn_cap=0):
         from spark_rapids_tpu.columnar import dtypes as dts
         from spark_rapids_tpu.ops import aggregates as ag
         from spark_rapids_tpu.ops.arithmetic import Divide
@@ -546,14 +723,33 @@ class _AggSpec:
         c = pre
         while isinstance(c, (L.Filter, L.Project)):
             c = c.children[0]
-        if c is not scan:
+        join_type = dim_plan = None
+        if isinstance(c, L.Join):
+            side = cls._fact_side(c, scan)
+            if side is None:
+                return None
+            jt = c.join_type
+            if not (jt == "inner"
+                    or (side == 0 and jt in ("left", "semi", "anti"))
+                    or (side == 1 and jt == "right")):
+                return None  # output scoped to dim rows: a new fact
+                #               batch can flip a dim row's matched-ness,
+                #               so no per-delta decomposition is sound
+            dim_plan = c.children[1 - side]
+            dtext = dim_plan.tree_string()
+            if "UDF" in dtext or "InPandas" in dtext or \
+                    "ArrowEval" in dtext:
+                return None  # dim re-executes per delta; arbitrary
+                #               Python is not provably deterministic
+            join_type = jt
+        elif c is not scan:
             return None
 
         keys = [(ge.name, ge.dtype) for ge in agg.group_exprs]
         if len({n for n, _ in keys}) != len(keys):
             return None  # duplicate key names would mis-merge
-        if any(n.startswith("__p") for n, _ in keys):
-            return None  # reserved partial-column prefix
+        if any(n.startswith("__p") or n == "__wm" for n, _ in keys):
+            return None  # reserved partial/watermark column names
         partial_aggs: List = []   # Alias(AggregateExpression, pname)
         merge_aggs: List = []
         final_tail: List = []
@@ -605,8 +801,56 @@ class _AggSpec:
         partial_schema = keys + partial_cols
         merge_keys = [Alias(UnresolvedColumn(n), n) for n, _ in keys]
         final_exprs = [UnresolvedColumn(n) for n, _ in keys] + final_tail
+
+        # windowed shape: a tumbling functions.window bucket pair among
+        # the group keys — eviction arms only when the watermark delay
+        # conf is set and exactly ONE end edge exists (two different
+        # windows in one key set have no single watermark)
+        window_end = delay_us = None
+        if watermark_delay_us is not None and watermark_delay_us >= 0:
+            from spark_rapids_tpu.ops.datetime_ops import TimeWindow
+            ends = []
+            for ge in agg.group_exprs:
+                inner = ge.children[0] if isinstance(ge, Alias) else ge
+                if isinstance(inner, TimeWindow) and \
+                        inner.field == "end" and \
+                        inner.slide_us >= inner.window_us:
+                    ends.append(ge.name)
+            if len(ends) == 1:
+                window_end = ends[0]
+                delay_us = int(watermark_delay_us)
+
+        # mergeable top-N: post chain exactly Limit <- Sort, sort keys
+        # bare group-key references covering every key (total order
+        # over output rows -> trimmed merges provably reproduce the
+        # one-shot answer; see class docstring).  Never combined with
+        # watermark eviction: trimming to n keys BEFORE eviction could
+        # under-fill the limit the one-shot answer fills after its
+        # filter — eviction already bounds windowed state anyway.
+        trim_n = trim_sort = None
+        if window_end is None and len(post) == 2 and keys and \
+                isinstance(post[0], L.Limit) and \
+                isinstance(post[1], L.Sort) and \
+                0 < post[0].n <= int(topn_cap):
+            from spark_rapids_tpu.ops.expressions import BoundReference
+            n_keys = len(keys)
+            ords = []
+            for oe, _, _ in post[1].orders:
+                if isinstance(oe, BoundReference) and \
+                        oe.ordinal < n_keys:
+                    ords.append(oe.ordinal)
+                else:
+                    ords = None
+                    break
+            if ords is not None and set(ords) == set(range(n_keys)):
+                trim_n = post[0].n
+                trim_sort = post[1]
+
         spec = cls(agg, pre, post, partial_aggs, merge_keys, merge_aggs,
-                   final_exprs, partial_schema)
+                   final_exprs, partial_schema, join_type=join_type,
+                   dim_plan=dim_plan, window_end=window_end,
+                   delay_us=delay_us, trim_n=trim_n,
+                   trim_sort=trim_sort)
         # the decomposition must reproduce the original output schema
         # exactly — name or dtype drift means the merge form is not the
         # same query, so refuse it rather than answer differently
@@ -620,12 +864,27 @@ class _AggSpec:
         return spec
 
     # -- plan builders ----------------------------------------------------
+    def _trimmed(self, node):
+        """The proven top-N state bound applied to a partial plan: the
+        group keys lead the partial schema at the same ordinals as the
+        aggregate output, so the post chain's bound sort keys transfer
+        verbatim.  Identity when the trim was refused."""
+        from spark_rapids_tpu.plan import logical as L
+        if self.trim_n is None:
+            return node
+        return L.Limit(self.trim_n,
+                       L.Sort(list(self.trim_sort.orders), node))
+
     def partial_plan(self, scan, paths):
-        """Partial aggregate over ONLY ``paths`` (the delta)."""
+        """Partial aggregate over ONLY ``paths`` (the delta).  For a
+        delta-join the cloned spine keeps the dimension subtree SHARED
+        (node identity — see ``_replace_scan``), so its stage ids stay
+        spliceable and its in-memory batch ids stay fingerprintable."""
         from spark_rapids_tpu.plan import logical as L
         child = _replace_scan(self.pre_root, scan, paths)
-        return L.Aggregate(list(self.agg.group_exprs),
-                           list(self.partial_aggs), child)
+        return self._trimmed(L.Aggregate(list(self.agg.group_exprs),
+                                         list(self.partial_aggs),
+                                         child))
 
     def merge_plan(self, batches):
         """Re-aggregate (old-state ⊕ delta) partial rows into the next
@@ -633,8 +892,49 @@ class _AggSpec:
         in-memory union of partial batches."""
         from spark_rapids_tpu.plan import logical as L
         rel = L.InMemoryRelation(batches, self.partial_schema)
-        return L.Aggregate(list(self.merge_keys), list(self.merge_aggs),
-                           rel)
+        return self._trimmed(
+            L.Aggregate(list(self.merge_keys), list(self.merge_aggs),
+                        rel))
+
+    def evict_plan(self, state_batches, watermark: int):
+        """Watermark eviction as an engine plan: keep only buckets
+        whose window end is strictly AFTER the watermark.  Runs
+        through the full exec path (string keys, validity, the mesh
+        when one is up) instead of a hand-rolled host row filter.
+        The watermark rides as a DATA column (``__wm``), not a
+        literal: a literal would bake each tick's watermark into the
+        jit signature and recompile the evict stage every tick —
+        column-vs-column keeps one stable compiled program for the
+        life of the standing query."""
+        from spark_rapids_tpu.columnar import dtypes as dts
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.column import Column
+        from spark_rapids_tpu.ops.expressions import UnresolvedColumn
+        from spark_rapids_tpu.ops.predicates import (GreaterThan,
+                                                     IsNull, Or)
+        from spark_rapids_tpu.plan import logical as L
+        aug = []
+        for b in state_batches:
+            cols = dict(b.columns)
+            cap = next(iter(cols.values())).capacity if cols else 1
+            cols["__wm"] = Column.from_numpy(
+                np.full(b.nrows, int(watermark), dtype=np.int64),
+                dtype=dts.TIMESTAMP_US, capacity=cap)
+            aug.append(ColumnarBatch(cols, b.nrows))
+        rel = L.InMemoryRelation(
+            aug, self.partial_schema + [("__wm", dts.TIMESTAMP_US)])
+        # Kleene OR keeps NULL-end buckets: a null event time interns
+        # as its own group (the engine's null-key semantics) and has
+        # no position on the event-time axis — it can never expire.
+        # A bare `end > wm` would evaluate null for those rows and
+        # the filter's keep-mask discipline would silently evict a
+        # real data bucket (answers would then diverge from one-shot).
+        cond = Or(IsNull(UnresolvedColumn(self.window_end)),
+                  GreaterThan(UnresolvedColumn(self.window_end),
+                              UnresolvedColumn("__wm")))
+        return L.Project(
+            [UnresolvedColumn(n) for n, _ in self.partial_schema],
+            L.Filter(cond, rel))
 
     def result_plan(self, state_batches):
         """Finalize projection over the merged state (avg = sum/count)
@@ -670,7 +970,7 @@ class MicroBatchRunner:
     per runner; each execution inside a tick is an ordinary query to
     the rest of the engine (admission, budgets, ladder, watchdog)."""
 
-    def __init__(self, session, df):
+    def __init__(self, session, df, fact=None):
         from spark_rapids_tpu.config import rapids_conf as rc
         self.session = session
         self.df = df
@@ -679,9 +979,27 @@ class MicroBatchRunner:
             getattr(session, "memory_catalog", None) is not None
         self.store: Optional[IncrementalStateStore] = \
             IncrementalStateStore(session) if self.enabled else None
-        self._scan = _single_file_scan(df.plan)
-        self._spec = _AggSpec.analyze(df.plan, self._scan) \
-            if self.enabled else None
+        # the append target: the plan's unique file scan, or the one a
+        # multi-scan plan (fact⋈dim over two tables) designates via
+        # ``fact`` (any path already in the fact table's file list)
+        self._scan = _find_fact_scan(df.plan, fact)
+        if fact is not None and self._scan is None:
+            # fail fast with the candidates: swallowing this would
+            # surface ticks later with an error telling the user to
+            # pass the fact= they already passed
+            cands = [s.paths for s in _file_scans(df.plan)]
+            raise ValueError(
+                f"fact={fact!r} resolves to no unique file scan of "
+                "this plan (typo, relative-vs-absolute path, or the "
+                "path appears in several tables); scans present: "
+                + (str(cands) if cands else "none"))
+        delay_ms = int(conf.get(rc.INCREMENTAL_WATERMARK_DELAY_MS))
+        self._spec = _AggSpec.analyze(
+            df.plan, self._scan,
+            watermark_delay_us=(delay_ms * 1000 if delay_ms >= 0
+                                else None),
+            topn_cap=int(conf.get(rc.INCREMENTAL_TOPN_MAX_STATE_ROWS))
+        ) if self.enabled else None
         self._initial = list(self._scan.paths) if self._scan is not None \
             else []
         self._paths: List[str] = []   # committed (ingested) input set
@@ -693,16 +1011,37 @@ class MicroBatchRunner:
     # ------------------------------------------------------------- helpers --
     def _fingerprint(self, paths) -> str:
         from spark_rapids_tpu.io.readers import scan_input_meta
-        return self._meta_fingerprint(scan_input_meta(paths))
+        return self._state_fingerprint(scan_input_meta(paths))
 
-    @staticmethod
-    def _meta_fingerprint(meta) -> str:
-        """Fingerprint of an already-statted ``scan_input_meta``
-        result — lets one stat walk serve both the staleness check and
-        the new epoch's fingerprint within a tick."""
+    def _dim_fingerprint(self) -> str:
+        """The delta-join dimension subtree's input fingerprint
+        (file triples statted now + in-memory batch identities); ""
+        for non-join shapes."""
+        if self._spec is None or self._spec.dim_plan is None:
+            return ""
+        from spark_rapids_tpu.robustness.checkpoint import (
+            input_fingerprint)
+        return input_fingerprint(self._spec.dim_plan)
+
+    def _state_fingerprint(self, meta, dim_fp: Optional[str] = None
+                           ) -> str:
+        """Identity of everything the standing state was computed
+        from: the fact scan's already-statted ``scan_input_meta``
+        triples (one walk serves both the staleness check and the new
+        epoch's fingerprint within a tick) plus — for delta-joins —
+        the dimension subtree's input fingerprint.  ``dim_fp`` must be
+        the PRE-READ stat (the tick captures it once before its first
+        execution and reuses it for both the staleness check and the
+        new epoch's stamp): statting the dim side after the read would
+        stamp post-mutation identity onto state computed from
+        pre-mutation bytes and hide the mutation forever — the same
+        stat-before-read rule the fact side follows."""
         from spark_rapids_tpu.io.readers import input_signature
-        return hashlib.sha256(
-            input_signature(sorted(meta)).encode()).hexdigest()
+        sig = input_signature(sorted(meta))
+        if self._spec is not None and self._spec.dim_plan is not None:
+            sig += "\x1f" + (dim_fp if dim_fp is not None
+                             else self._dim_fingerprint())
+        return hashlib.sha256(sig.encode()).hexdigest()
 
     def _run(self, plan, splice: bool = False) -> list:
         """Execute one logical plan through the full robustness stack.
@@ -744,10 +1083,19 @@ class MicroBatchRunner:
     # ---------------------------------------------------------------- ticks --
     def tick(self, new_paths=()):
         """Ingest ``new_paths`` (appended files) and return the result
-        over everything ingested so far."""
+        over everything ingested so far.  Every execution inside the
+        tick runs with the in-tick marker set: the session ResultCache
+        is bypassed wholesale (no lookup, no store) — a tick must
+        never answer from a pre-tick entry, and its crash-consistency
+        contract rests on the epoch store alone."""
         with self._lock:
-            return self._tick([new_paths] if isinstance(new_paths, str)
-                              else list(new_paths))
+            _TICK_TLS.depth = getattr(_TICK_TLS, "depth", 0) + 1
+            try:
+                return self._tick(
+                    [new_paths] if isinstance(new_paths, str)
+                    else list(new_paths))
+            finally:
+                _TICK_TLS.depth -= 1
 
     def _phased(self, name: str, fn, *args, **kwargs):
         """Run one tick phase, timing it for the span runtime.  Phase
@@ -788,8 +1136,10 @@ class MicroBatchRunner:
         from spark_rapids_tpu.plan import logical as L
         if new_paths and self._scan is None:
             raise ValueError(
-                "tick(new_paths) needs a plan with exactly one file "
-                "scan to append to; this plan has none (or several)")
+                "tick(new_paths) needs an append-target file scan; "
+                "this plan has none, or several — designate one with "
+                "session.incremental(df, fact=<path in the fact "
+                "table's file list>)")
         base = list(self._paths) if self._ticked else list(self._initial)
         seen = set(base)
         delta = []
@@ -825,7 +1175,10 @@ class MicroBatchRunner:
             info["rollbackFrom"] = f"{type(exc).__name__}: {exc}"
             out = self._full_or_rollback(target, info)
         self._phased("commit", self.store.commit, info["mode"],
-                     info["deltaFiles"], info["reused"])
+                     info["deltaFiles"], info["reused"],
+                     info.get("evictedBuckets", 0),
+                     info.get("evictedRows", 0),
+                     info.get("evictedBytes", 0))
         self._finish(target, info)
         return self._result_df(out, self.df.plan.schema)
 
@@ -849,6 +1202,7 @@ class MicroBatchRunner:
         committed epoch cannot carry this tick."""
         if self._spec is None or not self._ticked:
             raise _TickDegraded
+        spec = self._spec
         state = self.store.get_state()
         if state is None:
             raise _TickDegraded
@@ -857,13 +1211,21 @@ class MicroBatchRunner:
         # serves the staleness check, and the target fingerprint
         # derives from it plus the (small) delta walk
         meta_committed = scan_input_meta(self._paths)
+        # dim side statted ONCE, before any execution: the same
+        # pre-read snapshot serves the staleness check AND the new
+        # epoch's stamp below — a post-execution re-stat could stamp a
+        # mid-tick dim mutation's identity onto state computed from
+        # the old bytes, hiding the mutation from every later check
+        dim_fp = self._dim_fingerprint()
         if self.store.state_fingerprint != \
-                self._meta_fingerprint(meta_committed):
-            # an already-ingested file changed out-of-band (rewritten,
-            # truncated, even same-size — mtime catches it): the state
-            # no longer describes the input
+                self._state_fingerprint(meta_committed, dim_fp):
+            # an already-ingested file (or the dimension side of a
+            # delta-join) changed out-of-band (rewritten, truncated,
+            # even same-size — mtime catches it): the state no longer
+            # describes the input
             self.store.drop_state("input-fingerprint-moved")
             raise _TickDegraded
+        watermark = self.store.state_watermark
         if delta:
             # stat BEFORE read: if a delta file mutates between the
             # stat and the scan, the committed fingerprint describes
@@ -872,27 +1234,98 @@ class MicroBatchRunner:
             # after the read would stamp post-mutation identity onto
             # pre-mutation state and hide the mutation forever.
             meta_delta = scan_input_meta(delta)
+            # delta-join: only the NEW fact batches join the unchanged
+            # dimension state — the delta runs with the store riding
+            # as checkpoint manager, so completed dim subtrees splice
+            # from committed lineage instead of re-running
             partial = self._phased(
-                "delta", self._run,
-                self._spec.partial_plan(self._scan, delta))
+                "join.delta" if spec.join_type is not None else "delta",
+                self._run, spec.partial_plan(self._scan, delta),
+                splice=spec.join_type is not None)
             merged = self._phased(
-                "merge", self._run, self._spec.merge_plan(
+                "topn.merge" if spec.trim_n is not None else "merge",
+                self._run, spec.merge_plan(
                     [state] + [b for b in partial if b.nrows]))
             state = self._concat(merged)
             if state is None:
                 from spark_rapids_tpu.columnar.batch import empty_batch
-                state = empty_batch(self._spec.partial_schema)
-            self.store.put_state(state, self._meta_fingerprint(
-                meta_committed + meta_delta))
+                state = empty_batch(spec.partial_schema)
+            state, watermark = self._advance_watermark(state, watermark,
+                                                       info)
+            self.store.put_state(
+                state,
+                self._state_fingerprint(meta_committed + meta_delta,
+                                        dim_fp),
+                watermark=watermark)
         out = self._phased("finalize", self._run,
-                           self._spec.result_plan([state]))
+                           spec.result_plan([state]))
         # counted only once the WHOLE incremental path answered: a
         # finalize-run fault degrades this tick to full recompute and
         # must not leave it double-counted in the reuse ratio
         info["mode"] = "incremental"
         info["reused"] = True
+        info["shape"] = spec.shape
+        if watermark is not None:
+            info["watermark"] = int(watermark)
         incremental_metrics.bump("incrementalTicks")
+        self._bump_shape_ticks(spec)
         return out
+
+    @staticmethod
+    def _bump_shape_ticks(spec) -> None:
+        for field, on in (("joinTicks", spec.join_type is not None),
+                          ("windowTicks", spec.window_end is not None),
+                          ("topnTicks", spec.trim_n is not None)):
+            if on:
+                incremental_metrics.bump(field)
+
+    def _advance_watermark(self, state, committed, info):
+        """Windowed shapes with eviction armed: advance the watermark
+        to max(window end seen) − delay (never regressing below the
+        committed floor — monotone by construction) and evict expired
+        buckets from the merged state via an engine Filter execution.
+        The evicted batch is what put_state registers, so eviction and
+        advance are one provisional unit that commits — or rolls
+        back — atomically with the epoch.  Identity for non-windowed
+        shapes."""
+        spec = self._spec
+        if spec.window_end is None or state.nrows == 0:
+            return state, committed
+        col = state.columns[spec.window_end]
+        ends = np.asarray(col.host_values())[:state.nrows]
+        valid = col.host_validity()
+        if valid is not None:
+            ends = ends[np.asarray(valid)[:state.nrows]]
+        if ends.size == 0:
+            return state, committed  # all-null buckets never expire
+        cand = int(ends.max()) - int(spec.delay_us)
+        wm = cand if committed is None else max(int(committed), cand)
+        expired = ends[ends <= wm]
+        info["watermark"] = wm
+        if expired.size == 0:
+            return state, wm
+        rows_before = int(state.nrows)
+        # payload buffers are capacity-padded, so attribute bytes
+        # row-proportionally instead of diffing padded buffer sizes
+        bytes_before = sum(a.nbytes for a in
+                           _batch_payload(state).values())
+        kept = self._phased("window.evict", self._run,
+                            spec.evict_plan([state], wm))
+        state = self._concat(kept)
+        if state is None:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            state = empty_batch(spec.partial_schema)
+        rows_evicted = max(0, rows_before - int(state.nrows))
+        # units: a BUCKET is one expired time window (distinct end
+        # edge); each bucket spans one state ROW per group-key tuple,
+        # and bytes are attributed per row — evictedRows is the
+        # denominator that makes evictedBytes ratios meaningful
+        info["evictedBuckets"] = info.get("evictedBuckets", 0) + \
+            int(np.unique(expired).size)
+        info["evictedRows"] = info.get("evictedRows", 0) + rows_evicted
+        info["evictedBytes"] = info.get("evictedBytes", 0) + \
+            bytes_before * rows_evicted // max(rows_before, 1)
+        return state, wm
 
     def _full_or_rollback(self, target, info) -> list:
         """Degraded recompute with the leak guard: a full recompute
@@ -913,26 +1346,45 @@ class MicroBatchRunner:
         delta-capable plan the state rebuilds from one partial pass
         over ALL inputs (result derives from it); otherwise the
         original plan re-runs with the lineage splice restoring
-        unchanged subtrees."""
+        unchanged subtrees.  Windowed shapes advance+evict against the
+        SAME committed watermark floor the incremental path would
+        have used, so a degraded tick's answer is identical to the
+        incremental tick it replaced (expired buckets rebuilt from
+        history evict right back out — no resurrection)."""
         incremental_metrics.bump("fullRecomputes")
         info["mode"] = "full"
+        # a rolled-back incremental attempt may have advanced/evicted
+        # into this SAME info dict before it died; those provisional
+        # facts were discarded with the rollback, and the recompute
+        # recounts its own from scratch — without the reset the one
+        # commit would stamp roughly double onto StateWatermark and
+        # the watermarkEvicted* counters
+        for k in ("watermark", "evictedBuckets", "evictedRows",
+                  "evictedBytes"):
+            info.pop(k, None)
         if self._spec is not None:
+            spec = self._spec
+            info["shape"] = spec.shape
             # stat before read (see _tick_body): a mid-scan mutation
             # must leave the state stamped with PRE-mutation identity
             fp = self._fingerprint(target)
             partial = self._phased(
                 "recompute", self._run,
-                self._spec.partial_plan(self._scan, target))
+                spec.partial_plan(self._scan, target),
+                splice=spec.join_type is not None)
             state = self._concat(partial)
             if state is None:
                 from spark_rapids_tpu.columnar.batch import empty_batch
-                state = empty_batch(self._spec.partial_schema)
-            self.store.put_state(state, fp)
+                state = empty_batch(spec.partial_schema)
+            state, watermark = self._advance_watermark(
+                state, self.store.state_watermark, info)
+            self.store.put_state(state, fp, watermark=watermark)
             return self._phased("finalize", self._run,
-                                self._spec.result_plan([state]))
+                                spec.result_plan([state]))
         # reuse detection reads the STORE-LOCAL resume counter, not the
         # process-global one: concurrent runners must not contaminate
         # each other's reusedState flag
+        info["shape"] = "splice"
         r0 = self.store.local["resumes"]
         out = self._phased("recompute", self._run,
                            self._full_plan(target), splice=True)

@@ -52,10 +52,11 @@ class QueryInfo:
     # write|resume|evict|invalid)
     checkpoint: List[Dict[str, str]] = field(default_factory=list)
     # continuous-ingest events (robustness/incremental.py
-    # StateCommit/StateRollback/StateEvict/IncrementalResume; "kind"
-    # is commit|rollback|evict|resume) — resumes land here (they fire
-    # inside a tick's query envelope); commit/rollback usually land on
-    # the app (they fire between the tick's executions)
+    # StateCommit/StateRollback/StateEvict/IncrementalResume/
+    # StateWatermark; "kind" is commit|rollback|evict|resume|
+    # watermark) — resumes land here (they fire inside a tick's query
+    # envelope); commit/rollback/watermark usually land on the app
+    # (they fire between the tick's executions)
     incremental: List[Dict[str, str]] = field(default_factory=list)
     # full post-mortem trail of a fatally-failed query (QueryFatal:
     # error, recovery actions, watchdog + checkpoint snapshots) —
@@ -223,16 +224,19 @@ def parse_event_log(path: str) -> AppInfo:
                 (q.checkpoint if q is not None
                  else app.checkpoint).append(info)
             elif ev in ("StateCommit", "StateRollback", "StateEvict",
-                        "IncrementalResume"):
+                        "IncrementalResume", "StateWatermark"):
                 info = {k: rec[k] for k in
                         ("epoch", "stateBytes", "entries", "mode",
                          "deltaFiles", "reusedState", "reason",
-                         "bytes", "stageId", "stagesSaved")
+                         "bytes", "stageId", "stagesSaved",
+                         "watermark", "evictedBuckets", "evictedRows",
+                         "evictedBytes", "stateRows", "store")
                         if k in rec}
                 info["kind"] = {"StateCommit": "commit",
                                 "StateRollback": "rollback",
                                 "StateEvict": "evict",
-                                "IncrementalResume": "resume"}[ev]
+                                "IncrementalResume": "resume",
+                                "StateWatermark": "watermark"}[ev]
                 q = all_queries.get(rec.get("queryId"))
                 (q.incremental if q is not None
                  else app.incremental).append(info)

@@ -190,7 +190,7 @@ def checkpoint_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
-def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
+def incremental_stats(apps: List[AppInfo]) -> Dict[str, object]:
     """Continuous-ingest effectiveness across sessions
     (robustness/incremental.py): committed epochs split by mode
     (incremental vs full-recompute), rollbacks, state evictions,
@@ -199,6 +199,8 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
     actually rode the committed epoch instead of recomputing."""
     commits = inc = full = rollbacks = evicts = resumes = 0
     state_bytes = 0
+    watermarks: Dict[object, int] = {}  # per standing query (store id)
+    wm_buckets = wm_bytes = 0
     for a in apps:
         events = list(a.incremental) + [e for q in a.queries
                                         for e in q.incremental]
@@ -217,6 +219,11 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
                 evicts += 1
             elif kind == "resume":
                 resumes += 1
+            elif kind == "watermark":
+                if e.get("watermark") is not None:
+                    watermarks[e.get("store")] = e["watermark"]
+                wm_buckets += e.get("evictedBuckets", 0)
+                wm_bytes += e.get("evictedBytes", 0)
     if not commits and not rollbacks:
         return {}
     return {
@@ -228,6 +235,13 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, float]:
         "splice_resumes": resumes,
         "state_bytes": state_bytes,
         "reuse_ratio": inc / commits if commits else 0.0,
+        # windowed shapes: where each standing query's event-time
+        # watermark last landed ({store id: watermark} — one pooled
+        # number would show whichever query committed last) and what
+        # eviction reclaimed across all committed epochs
+        "watermark": watermarks or None,
+        "watermark_evicted_buckets": wm_buckets,
+        "watermark_evicted_bytes": wm_bytes,
     }
 
 
@@ -785,6 +799,39 @@ def _incremental_problems(who: str, events: List[dict]) -> List[str]:
             f"evictions over {len(commits)} commit(s); "
             "incremental.maxStateBytes cannot hold one epoch, so "
             "every tick degrades to full recompute")
+    # watermark-stalled state growth: a windowed standing query whose
+    # event-time watermark stopped advancing while its state keeps
+    # growing — eviction can no longer bound the state (stale event
+    # times in the ingest, a delay larger than the data horizon, or a
+    # stuck source clock), so "bounded under infinite ingest" is off.
+    # Grouped per standing query (the event's `store` id): pooling
+    # would let one ADVANCING query's watermarks mask a stalled
+    # co-tenant's forever
+    by_store: Dict[object, list] = {}
+    for e in events:
+        if e.get("kind") == "watermark" and \
+                e.get("watermark") is not None:
+            by_store.setdefault(e.get("store"), []).append(e)
+    for store, wms in sorted(by_store.items(),
+                             key=lambda kv: str(kv[0])):
+        # judge the TAIL of the trail, not its whole history: a query
+        # that advanced normally and then stalled (the realistic
+        # pattern — source clock sticks mid-life) must still flag;
+        # full-trail constancy would be masked by any early advance
+        wms = wms[-5:]
+        if len(wms) < 3 or len({e["watermark"] for e in wms}) != 1:
+            continue
+        sizes = [e.get("stateBytes", 0) for e in wms]
+        if sizes[-1] > sizes[0] and \
+                all(b >= a for a, b in zip(sizes, sizes[1:])):
+            out.append(
+                f"{who}: watermark-stalled state growth (standing "
+                f"query {store}) — the event-time watermark sat at "
+                f"{wms[0]['watermark']} across {len(wms)} commits "
+                f"while state grew {sizes[0]} -> {sizes[-1]} bytes; "
+                "eviction is not bounding this standing query (check "
+                "ingest event times vs "
+                "incremental.watermarkDelayMs)")
     return out
 
 
@@ -1121,6 +1168,11 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"stateEvictions={ic['state_evictions']} "
             f"spliceResumes={ic['splice_resumes']} "
             f"stateBytes={ic['state_bytes']}")
+        if ic.get("watermark") is not None:
+            out.append(
+                f"  watermark={ic['watermark']} "
+                f"evictedBuckets={ic['watermark_evicted_buckets']} "
+                f"evictedBytes={ic['watermark_evicted_bytes']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

@@ -83,6 +83,10 @@ _PHASE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("spill.", "spill"),
     ("checkpoint.", "spill"),
     ("incremental.commit", "spill"),
+    # state maintenance, not answer compute: watermark eviction is the
+    # windowed tick's state-bounding pass (incremental.join.delta /
+    # .topn.merge stay "compute" — they ARE the steady-tick work)
+    ("incremental.window.evict", "spill"),
     ("admission.wait", "wait"),
     ("scheduler.", "wait"),
     ("udf.worker", "wait"),

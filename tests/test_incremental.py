@@ -287,10 +287,13 @@ def test_enabled_false_parity(mesh, tmp_path):
 
 # ---------------------------------------------------------- lineage splice --
 def test_splice_restores_static_subtree(mesh, tmp_path):
-    """Plans with no delta form (a join) still reuse: the static
-    dimension side's aggregate subtree keeps its input-fingerprinted
-    stage id across ticks, so the full-recompute tick splices it from
-    the persistent lineage store instead of re-running it."""
+    """Plans with no delta form still reuse: the static dimension
+    side's aggregate subtree keeps its input-fingerprinted stage id
+    across ticks, so the full-recompute tick splices it from the
+    persistent lineage store instead of re-running it.  (A plain
+    agg ← join(fact, dim) now has a delta-join form — ISSUE 14 — so
+    the fact side goes through distinct() to break the prover's pure
+    [Filter|Project]* chain requirement and force the splice path.)"""
     p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
     s = _session(mesh)
     dim = pd.DataFrame({"k": np.arange(20),
@@ -298,7 +301,7 @@ def test_splice_restores_static_subtree(mesh, tmp_path):
                         .astype(np.float64)})
     dim_agg = (s.create_dataframe(dim).groupBy("k")
                .agg(F.max("w").alias("w")))
-    fact = s.read.parquet(p1, p2)
+    fact = s.read.parquet(p1, p2).distinct()
     df = (fact.join(dim_agg, "k").groupBy("k")
           .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
                .alias("s")).orderBy("k"))
@@ -380,7 +383,571 @@ def test_splice_prune_requires_distributed_completion(mesh, tmp_path):
     s.stop()
 
 
+# ------------------------------------------------------------- delta-join --
+def _dim_agg(s, n=20):
+    dim = pd.DataFrame({"k": np.arange(n),
+                        "w": np.arange(n).astype(np.float64) + 1.0})
+    return s.create_dataframe(dim).groupBy("k").agg(
+        F.max("w").alias("w"))
+
+
+def _join_df(s, dim_agg, paths):
+    return (s.read.parquet(*paths).join(dim_agg, "k").groupBy("k")
+            .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
+                 .alias("s"),
+                 F.count("v").alias("c")).orderBy("k"))
+
+
+def test_delta_join_tick_counter_pinned(mesh, tmp_path):
+    """The delta-join acceptance pin: tick k+1 of an
+    agg ← join(fact, dim) plan joins ONLY the new fact file against
+    the unchanged dimension state (one source pull; the dim subtree
+    SPLICES from committed lineage instead of re-running) and the
+    answer is bit-identical to the one-shot recompute oracle."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+    dim_agg = _dim_agg(s)
+    df = _join_df(s, dim_agg, [p1, p2])
+    runner = s.incremental(df)
+    assert runner._spec is not None and runner._spec.shape == "join"
+    runner.tick()
+    assert runner.last_tick_info["mode"] == "full"
+
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    reads = _count_rule("io.read")
+    got = runner.tick([p3]).to_pandas()
+    tick_reads = _hits(reads)
+    I.remove(reads)
+    m1 = incremental_metrics.snapshot()
+    assert runner.last_tick_info["mode"] == "incremental"
+    assert runner.last_tick_info["shape"] == "join"
+    # the delta fact file is the ONLY source pull; the static dim
+    # side resumed from the committed epoch's lineage
+    assert tick_reads == 1, tick_reads
+    assert m1["resumes"] - m0["resumes"] >= 1
+    assert m1["joinTicks"] - m0["joinTicks"] == 1
+    oracle = _join_df(s, dim_agg, [p1, p2, p3]).to_pandas()
+    pd.testing.assert_frame_equal(got, oracle)
+
+    # zero-delta: answers from state, zero pulls
+    reads = _count_rule("io.read")
+    again = runner.tick().to_pandas()
+    assert _hits(reads) == 0
+    I.remove(reads)
+    pd.testing.assert_frame_equal(again, oracle)
+    runner.close()
+    s.stop()
+
+
+def test_delta_join_fault_rollback_and_dim_rewrite(mesh, tmp_path):
+    """Join-shape epoch discipline: (a) a mid-tick fault rolls back
+    and the SAME tick answers via full recompute; (b) an out-of-band
+    DIM-file rewrite drifts the composite state fingerprint — state
+    drops, the tick full-recomputes over the NEW dim bytes, and the
+    next tick is incremental again.  The fact scan is designated via
+    ``fact=`` (two file scans in one plan)."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    dimf = str(tmp_path / "dim.parquet")
+    pd.DataFrame({"k": np.arange(20),
+                  "w": np.ones(20)}).to_parquet(dimf, index=False)
+    s = _session(mesh, **{"spark.rapids.sql.recovery.enabled": False})
+
+    def make_df(paths):
+        dim = (s.read.parquet(dimf).groupBy("k")
+               .agg(F.max("w").alias("w")))
+        return (s.read.parquet(*paths).join(dim, "k").groupBy("k")
+                .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
+                     .alias("s")).orderBy("k"))
+
+    df = make_df([p1, p2])
+    runner = s.incremental(df, fact=p1)
+    assert runner._spec is not None and \
+        runner._spec.join_type == "inner"
+    assert runner._scan is not None and dimf not in runner._scan.paths
+    runner.tick()
+
+    # (a) mid-tick fault -> rollback -> degraded full, same tick
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    with I.injected("io.read", count=1):
+        got = runner.tick([p3]).to_pandas()
+    m1 = incremental_metrics.snapshot()
+    assert m1["rollbacks"] - m0["rollbacks"] == 1
+    assert runner.last_tick_info["mode"] == "full"
+    pd.testing.assert_frame_equal(got, make_df([p1, p2, p3])
+                                  .to_pandas())
+    p4 = _write(tmp_path, 4)
+    got = runner.tick([p4]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, make_df([p1, p2, p3, p4]).to_pandas())
+
+    # (b) dim-file rewrite: fingerprint drift -> state drop -> full
+    # recompute over the NEW dim bytes (never a stale join)
+    pd.DataFrame({"k": np.arange(20),
+                  "w": np.full(20, 3.0)}).to_parquet(dimf, index=False)
+    p5 = _write(tmp_path, 5)
+    got = runner.tick([p5]).to_pandas()
+    assert runner.last_tick_info["mode"] == "full"
+    pd.testing.assert_frame_equal(
+        got, make_df([p1, p2, p3, p4, p5]).to_pandas())
+    p6 = _write(tmp_path, 6)
+    got = runner.tick([p6]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, make_df([p1, p2, p3, p4, p5, p6]).to_pandas())
+    runner.close()
+    s.stop()
+
+
+def test_join_type_admission_rules(mesh, tmp_path):
+    """The prover's join-type table: per-fact-row-local types admit,
+    dim-row-scoped types refuse (a new fact batch can flip a dim
+    row's matched-ness), self-joins over the fact scan refuse
+    (delta×delta pairs would be lost)."""
+    from spark_rapids_tpu.robustness.incremental import (_AggSpec,
+                                                         _find_fact_scan)
+    p1 = _write(tmp_path, 1)
+    s = _session(mesh)
+    dim_agg = _dim_agg(s)
+
+    def spec_of(df):
+        return _AggSpec.analyze(df.plan, _find_fact_scan(df.plan))
+
+    def shaped(how):
+        fact = s.read.parquet(p1)
+        return (fact.join(dim_agg, "k", how=how).groupBy("k")
+                .agg(F.count("v").alias("c")).orderBy("k"))
+
+    for how in ("inner", "left", "semi", "anti"):
+        assert spec_of(shaped(how)) is not None, how
+    for how in ("right", "full"):
+        assert spec_of(shaped(how)) is None, how
+    # fact on the RIGHT: only types scoped to right rows admit
+    fact = s.read.parquet(p1)
+    right_fact = (dim_agg.join(fact, "k", how="right").groupBy("k")
+                  .agg(F.count("v").alias("c")).orderBy("k"))
+    assert spec_of(right_fact) is not None
+    left_dim = (dim_agg.join(fact, "k", how="left").groupBy("k")
+                .agg(F.count("v").alias("c")).orderBy("k"))
+    assert spec_of(left_dim) is None
+    # self-join over the appended table: no per-delta form
+    fact2 = s.read.parquet(p1)
+    selfj = (fact2.join(fact2.groupBy("k").agg(F.max("v").alias("m")),
+                        "k").groupBy("k")
+             .agg(F.count("v").alias("c")).orderBy("k"))
+    assert spec_of(selfj) is None
+    # an unresolvable fact= fails FAST at construction with the
+    # candidates, not at the first tick with a circular remedy
+    with pytest.raises(ValueError, match="resolves to no unique"):
+        s.incremental(shaped("inner"), fact=str(tmp_path / "no.pq"))
+    s.stop()
+
+
+# ------------------------------------------------- windowed + watermark --
+def _write_win(d, i, tick, n=1500, base="2024-01-01"):
+    """One ingest file whose event times live in tick's 10-minute
+    bucket (integer-valued doubles keep partial merges bit-exact).
+    A handful of NULL event times ride along: a null timestamp
+    interns as its own window bucket, which must never expire — the
+    eviction-filter regression (a bare `end > wm` predicate would
+    silently drop the bucket through the keep-mask discipline)."""
+    ts = pd.Series(pd.to_datetime(base) + pd.to_timedelta(
+        tick * 600 + _RNG.integers(0, 600, n), unit="s"))
+    ts.iloc[:: n // 20] = pd.NaT
+    pdf = pd.DataFrame({
+        "k": _RNG.integers(0, 8, n),
+        "v": _RNG.integers(0, 1000, n).astype(np.float64),
+        "ts": ts})
+    p = str(d / f"win-{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+
+def _win_df(s, paths):
+    return (s.read.parquet(*paths)
+            .groupBy(F.window("ts", "10 minutes"), "k")
+            .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))
+            .orderBy("window.start", "k"))
+
+
+def _win_oracle(df, wm):
+    """One-shot recompute under the same watermark: the windowed
+    tick's answer excludes expired buckets, so the oracle filters
+    the full recompute by the tick's own committed watermark —
+    KEEPING null-window buckets (no position on the event-time axis;
+    they never expire and always answer)."""
+    return df.filter(
+        F.col("window.end").isNull() |
+        (F.col("window.end") > pd.Timestamp(wm, unit="us"))
+    ).to_pandas()
+
+
+def test_window_watermark_eviction_bounded(mesh, tmp_path):
+    """The windowed acceptance pin: 10+ ticks of infinite-style ingest
+    (event time advances one bucket per tick, watermark delay two
+    buckets) hold state ROWS AND BYTES at a plateau — expired buckets
+    evict atomically with each commit — while every tick's answer is
+    bit-identical to the watermark-filtered one-shot recompute; a
+    late file for an already-evicted window is dropped (no
+    resurrection) and the answer still matches."""
+    s = _session(mesh, **{
+        "spark.rapids.tpu.incremental.watermarkDelayMs": 1_200_000})
+    w0, w1 = _write_win(tmp_path, 0, 0), _write_win(tmp_path, 1, 1)
+    df = _win_df(s, [w0, w1])
+    runner = s.incremental(df)
+    assert runner._spec is not None and runner._spec.shape == "window"
+    assert runner._spec.window_end == "window.end"
+    runner.tick()
+
+    state_rows, state_bytes = [], []
+    m0 = incremental_metrics.snapshot()
+    for t in range(2, 13):
+        p = _write_win(tmp_path, t, t)
+        got = runner.tick([p]).to_pandas()
+        info = runner.last_tick_info
+        assert info["mode"] == "incremental", info
+        assert info["shape"] == "window"
+        pd.testing.assert_frame_equal(
+            got, _win_oracle(df, info["watermark"]))
+        state_rows.append(runner.store._agg.nrows)
+        state_bytes.append(runner.store.state_bytes)
+    # bounded state: the plateau gate — live windows = delay horizon
+    # (2 buckets) + the in-flight one + the never-expiring null
+    # bucket, NOT one per ingested tick
+    assert max(state_rows) <= 4 * 8, state_rows
+    assert state_rows[-1] <= max(state_rows[:3]), state_rows
+    assert state_bytes[-1] <= max(state_bytes[:3]), state_bytes
+    m1 = incremental_metrics.snapshot()
+    assert m1["windowTicks"] - m0["windowTicks"] >= 10
+    assert m1["watermarkEvictedBuckets"] - \
+        m0["watermarkEvictedBuckets"] >= 8
+    assert m1["watermarkEvictedBytes"] - \
+        m0["watermarkEvictedBytes"] > 0
+
+    # late data for a long-evicted window: dropped, never resurrected,
+    # answer still equals the filtered one-shot (which also excludes
+    # that window), and state stays at the plateau
+    late = _write_win(tmp_path, 99, 0)  # tick-0 event times
+    got = runner.tick([late]).to_pandas()
+    info = runner.last_tick_info
+    assert info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, _win_oracle(df, info["watermark"]))
+    assert runner.store._agg.nrows <= 4 * 8
+    runner.close()
+    s.stop()
+
+
+def test_window_rollback_preserves_watermark(mesh, tmp_path):
+    """Epoch × watermark coupling: a chaos-killed tick (delta AND
+    degraded recompute both die) leaves state AND watermark exactly
+    at the committed epoch — no premature eviction, no phantom
+    advance; a state-restore bit flip degrades to full recompute
+    whose watermark advance matches the incremental tick's."""
+    s = _session(mesh, **{
+        "spark.rapids.tpu.incremental.watermarkDelayMs": 1_200_000,
+        "spark.rapids.sql.recovery.enabled": False})
+    w0, w1 = _write_win(tmp_path, 0, 0), _write_win(tmp_path, 1, 1)
+    df = _win_df(s, [w0, w1])
+    runner = s.incremental(df)
+    runner.tick()
+    w2 = _write_win(tmp_path, 2, 2)
+    runner.tick([w2])
+    wm0 = runner.store.state_watermark
+    ep0 = runner.store.epoch
+    rows0 = runner.store._agg.nrows
+    assert wm0 is not None
+
+    # chaos-killed tick: rollback leaves the committed epoch intact
+    w3 = _write_win(tmp_path, 3, 3)
+    with pytest.raises(Exception):
+        with I.injected("io.read", count=10):
+            runner.tick([w3])
+    assert runner.store.state_watermark == wm0
+    assert runner.store.epoch == ep0
+    assert runner.store._agg.nrows == rows0
+
+    # the retry re-ingests w3; the advance happens exactly once
+    got = runner.tick([w3]).to_pandas()
+    assert runner.last_tick_info["watermark"] > wm0
+    pd.testing.assert_frame_equal(
+        got, _win_oracle(df, runner.last_tick_info["watermark"]))
+
+    # state bit flip -> CRC drop -> full recompute, SAME watermark
+    # semantics (committed floor + max event seen), next tick
+    # incremental again
+    w4 = _write_win(tmp_path, 4, 4)
+    with I.injected("incremental.state.restore", kind="corrupt",
+                    count=1, all_threads=True):
+        got = runner.tick([w4]).to_pandas()
+    info = runner.last_tick_info
+    assert info["mode"] == "full"
+    pd.testing.assert_frame_equal(got, _win_oracle(df,
+                                                   info["watermark"]))
+    w5 = _write_win(tmp_path, 5, 5)
+    got = runner.tick([w5]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    pd.testing.assert_frame_equal(
+        got, _win_oracle(df, runner.last_tick_info["watermark"]))
+
+    # double-count regression: the incremental attempt advances and
+    # EVICTS, then dies at put_state -> rollback -> degraded
+    # recompute.  The commit must stamp ONLY the recompute's own
+    # eviction (the rolled-back attempt's counts were discarded with
+    # its provisional state) — pinned against the independently
+    # derived expired-window count: distinct non-null ends in the
+    # unfiltered one-shot minus the watermark-filtered one
+    w6 = _write_win(tmp_path, 6, 6)
+    with I.injected("incremental.state.write", count=1,
+                    all_threads=True):
+        got = runner.tick([w6]).to_pandas()
+    info = runner.last_tick_info
+    assert info["mode"] == "full"
+    full = df.to_pandas()
+    live = _win_oracle(df, info["watermark"])
+    pd.testing.assert_frame_equal(got, live)
+
+    def _ends(pdf):
+        return {w["end"] for w in pdf["window"] if w is not None
+                and not pd.isna(w["end"])}
+
+    expired = len(_ends(full) - _ends(live))
+    assert expired >= 1
+    assert info["evictedBuckets"] == expired, (info, expired)
+    runner.close()
+    s.stop()
+
+
+# ---------------------------------------------------------------- top-N --
+def test_topn_trim_counter_pinned(mesh, tmp_path):
+    """Provably-mergeable top-N: orderBy(desc key).limit(n) over a
+    decomposable aggregate keeps a trimmed n-row state that merges
+    with the delta's trimmed top-K — one source pull per tick, state
+    bounded by n, bit-identical to the one-shot answer.  Value sorts
+    and limits above topn.maxStateRows refuse the trim (full-group
+    state, still incremental)."""
+    from spark_rapids_tpu.robustness.incremental import (_AggSpec,
+                                                         _find_fact_scan)
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh)
+
+    def top_df(paths):
+        return (s.read.parquet(*paths).groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.avg("v").alias("av"))
+                .orderBy(F.col("k").desc()).limit(4))
+
+    df = top_df([p1, p2])
+    runner = s.incremental(df)
+    assert runner._spec is not None and runner._spec.trim_n == 4
+    assert runner._spec.shape == "topn"
+    runner.tick()
+    assert runner.store._agg.nrows <= 4  # trimmed from the first epoch
+
+    p3 = _write(tmp_path, 3)
+    m0 = incremental_metrics.snapshot()
+    reads = _count_rule("io.read")
+    got = runner.tick([p3]).to_pandas()
+    tick_reads = _hits(reads)
+    I.remove(reads)
+    m1 = incremental_metrics.snapshot()
+    assert tick_reads == 1, tick_reads
+    assert runner.last_tick_info["mode"] == "incremental"
+    assert m1["topnTicks"] - m0["topnTicks"] == 1
+    assert runner.store._agg.nrows <= 4
+    pd.testing.assert_frame_equal(got, top_df([p1, p2, p3])
+                                  .to_pandas())
+
+    # refusals keep the untrimmed (still incremental) path
+    val_sort = (s.read.parquet(p1).groupBy("k")
+                .agg(F.sum("v").alias("sv")).orderBy("sv").limit(3))
+    spec = _AggSpec.analyze(val_sort.plan,
+                            _find_fact_scan(val_sort.plan),
+                            topn_cap=65536)
+    assert spec is not None and spec.trim_n is None
+    over_cap = _AggSpec.analyze(df.plan, _find_fact_scan(df.plan),
+                                topn_cap=2)
+    assert over_cap is not None and over_cap.trim_n is None
+    runner.close()
+    s.stop()
+
+
+# ----------------------------------------------------------- knob parity --
+def test_enabled_false_parity_new_shapes(mesh, tmp_path):
+    """incremental.enabled=false: join, windowed, and top-N standing
+    queries all tick as plain full re-executions — identical results,
+    no standing state, no epochs."""
+    p1 = _write(tmp_path, 1)
+    w0 = _write_win(tmp_path, 0, 0)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.incremental.enabled": False,
+        "spark.rapids.tpu.incremental.watermarkDelayMs": 1_200_000})
+    dim_agg = _dim_agg(s)
+    shapes = [
+        _join_df(s, dim_agg, [p1]),
+        _win_df(s, [w0]),
+        (s.read.parquet(p1).groupBy("k")
+         .agg(F.sum("v").alias("sv"))
+         .orderBy(F.col("k").desc()).limit(4)),
+    ]
+    for df in shapes:
+        runner = s.incremental(df)
+        assert runner.store is None and runner._spec is None
+        got = runner.tick().to_pandas()
+        pd.testing.assert_frame_equal(got, df.to_pandas())
+        runner.close()
+    m = incremental_metrics.snapshot()
+    assert m["commits"] == 0 and m["writes"] == 0
+    s.stop()
+
+
+# ------------------------------------------------- result-cache bypass --
+def _poison_result_cache(cache):
+    """Rewrite every cached entry's stored payload with WRONG (but
+    CRC-consistent) float values: any later lookup that answers from
+    one of these entries provably returned stale bytes."""
+    from spark_rapids_tpu.memory.spill import _payload_checksum
+    from spark_rapids_tpu.robustness.incremental import _batch_payload
+    from spark_rapids_tpu.serving.reuse import (RESULT_CACHE_PRIORITY,
+                                                _rebuild_batch)
+    for entry in list(cache._entries.values()):
+        new_parts = []
+        for h, crc, nrows in entry.parts:
+            payload = dict(_batch_payload(h.materialize()))
+            for key, arr in payload.items():
+                if arr.dtype.kind == "f" and arr.size:
+                    payload[key] = arr * 2.0 + 1.0
+            poisoned = _rebuild_batch(entry.schema, payload, nrows)
+            nh = cache.catalog.register(poisoned,
+                                        priority=RESULT_CACHE_PRIORITY)
+            cache.catalog.demote(nh, "HOST")
+            h.close()
+            new_parts.append((nh, _payload_checksum(payload, nrows),
+                              nrows))
+        entry.parts = new_parts
+
+
+def test_tick_never_answers_from_result_cache(mesh, tmp_path):
+    """PR 7 × PR 12 regression: a tick must NEVER answer from (or
+    store into) the session ResultCache — its correctness contract
+    rests on the epoch store alone.  Pinned two ways: every cached
+    entry is poisoned with wrong bytes before a zero-delta tick (at
+    HEAD the tick's finalize HIT its own pre-tick entry and returned
+    whatever the cache held), and the cache counters are frozen
+    across the tick (zero lookups, zero stores).  Ordinary queries
+    keep using the cache."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.serving.resultCache.enabled": True})
+    df = _agg_df(s, [p1, p2])
+    oracle = _agg_df(s, [p1, p2]).to_pandas()  # also stores an entry
+    runner = s.incremental(df)
+    runner.tick()
+
+    # pre-tick entries now all carry provably-wrong bytes
+    _poison_result_cache(s.result_cache)
+    snap0 = s.result_cache.snapshot()
+    res = runner.tick()  # zero-delta: answers from the epoch store
+    snap1 = s.result_cache.snapshot()
+    for k in ("hits", "misses", "stores", "invalidations"):
+        assert snap1[k] == snap0[k], (k, snap0, snap1)
+    pd.testing.assert_frame_equal(res.to_pandas(), oracle)
+
+    # user queries still ride the cache: same plan + same inputs hits
+    s.result_cache.close()  # drop the poisoned entries
+    m0 = s.result_cache.snapshot()
+    _agg_df(s, [p1, p2]).to_pandas()
+    hit = _agg_df(s, [p1, p2]).to_pandas()
+    m1 = s.result_cache.snapshot()
+    assert m1["hits"] - m0["hits"] >= 1
+    pd.testing.assert_frame_equal(hit, oracle)
+    runner.close()
+    s.stop()
+
+
+def test_tick_never_registers_shared_stages(mesh, tmp_path):
+    """The SharedStageCache leg of the PR 7 × PR 12 fix: tick
+    executions must not register in (or splice from) the cross-query
+    shared stage store — their InMemoryRelation state batches are
+    freed at the next commit, voiding the id()-fingerprint no-alias
+    invariant, and shared writes would outlive the epoch store's
+    rollback.  Ordinary queries keep feeding the store."""
+    p1, p2 = _write(tmp_path, 1), _write(tmp_path, 2)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.serving.sharedStage.enabled": True})
+    runner = s.incremental(_agg_df(s, [p1, p2]))
+    runner.tick()
+    p3 = _write(tmp_path, 3)
+    got = runner.tick([p3]).to_pandas()
+    assert runner.last_tick_info["mode"] == "incremental"
+    assert len(s.shared_stages._entries) == 0  # ticks registered none
+    pd.testing.assert_frame_equal(got, _agg_df(s, [p1, p2, p3])
+                                  .to_pandas())
+    # the oracle query above ran OUTSIDE the tick: it registers
+    assert len(s.shared_stages._entries) > 0
+    runner.close()
+    s.stop()
+
+
 # ------------------------------------------------------------ observability --
+def test_window_events_and_health(mesh, tmp_path):
+    """StateWatermark flows into the eventlog tools (watermark +
+    evicted buckets/bytes in incremental_stats and the report) and
+    the watermark-stalled-state-growth health check fires on a
+    stalled-but-growing synthetic trail while staying quiet on a
+    healthy advancing one."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (_incremental_problems,
+                                                  format_report,
+                                                  incremental_stats)
+    logdir = tmp_path / "events"
+    s = _session(mesh, **{
+        "spark.rapids.tpu.eventLog.dir": str(logdir),
+        "spark.rapids.tpu.incremental.watermarkDelayMs": 1_200_000})
+    w0, w1 = _write_win(tmp_path, 0, 0), _write_win(tmp_path, 1, 1)
+    runner = s.incremental(_win_df(s, [w0, w1]))
+    runner.tick()
+    for t in (2, 3, 4):
+        runner.tick([_write_win(tmp_path, t, t)])
+    runner.close()
+    s.stop()
+
+    apps = load_logs(str(logdir))
+    stats = incremental_stats(apps)
+    assert stats["watermark"] is not None
+    assert stats["watermark_evicted_buckets"] >= 1
+    assert stats["watermark_evicted_bytes"] > 0
+    report = format_report(apps, top=5)
+    assert "watermark=" in report
+
+    # health check: stalled watermark + growing state flags; an
+    # advancing watermark with the same growth stays quiet
+    stalled = [{"kind": "watermark", "watermark": 100, "store": 1,
+                "stateBytes": 1000 * (i + 1)} for i in range(4)]
+    assert any("watermark-stalled" in p
+               for p in _incremental_problems("app", stalled))
+    advancing = [{"kind": "watermark", "watermark": 100 * (i + 1),
+                  "store": 2, "stateBytes": 1000 * (i + 1)}
+                 for i in range(4)]
+    assert not any("watermark-stalled" in p
+                   for p in _incremental_problems("app", advancing))
+    # per-standing-query grouping: a co-tenant's ADVANCING watermark
+    # must not mask the stalled query (the pooled-events regression)
+    assert any("watermark-stalled" in p
+               for p in _incremental_problems("app",
+                                              stalled + advancing))
+    # the realistic pattern — normal advance, THEN the source clock
+    # sticks: the check judges the trail's tail, so early advances
+    # must not mask a later stall
+    late_stall = [{"kind": "watermark", "watermark": 100 * (i + 1),
+                   "store": 3, "stateBytes": 1000} for i in range(3)]
+    late_stall += [{"kind": "watermark", "watermark": 400, "store": 3,
+                    "stateBytes": 2000 * (i + 1)} for i in range(5)]
+    assert any("watermark-stalled" in p
+               for p in _incremental_problems("app", late_stall))
+
+
 def test_events_profiling_and_health(mesh, tmp_path):
     """StateCommit/StateRollback/StateEvict/IncrementalResume flow into
     the eventlog tools ("Continuous ingest" profiling section) and the
