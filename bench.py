@@ -1090,9 +1090,98 @@ def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def zero_conf_main() -> None:
+    """Zero-conf A/B (the ISSUE 15 acceptance gate): the distributed
+    TPC-H sweep with EVERY tuned conf unset + the self-tuning cost
+    model on, against the current hand-tuned settings.  Phase 1 runs
+    the hand-tuned confs (async exchange, ragged slots, encoded
+    execution/wire — the MULTICHIP dryrun set); phase 2 unsets them
+    all and arms ``spark.rapids.tpu.costModel.enabled`` so the model
+    decides per-site from evidence.  Both phases warm each query once
+    (the model's evidence-fed second execution IS the converged plan)
+    then measure; every zero-conf answer must match the hand-tuned
+    one.  Emits ONE JSON line: per-query wall delta, aggregate walls,
+    the zero-conf/hand-tuned ratio, and the decision/replan counts
+    read from the decision ledger.  Env knobs:
+    ``BENCH_ZERO_CONF_QUERIES`` (comma list, default the full sweep),
+    ``BENCH_ZERO_CONF_SF`` (default 0.002)."""
+    import pandas as pd
+
+    import jax
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.models import tpch, tpch_sql
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+
+    sf = float(os.environ.get("BENCH_ZERO_CONF_SF", "0.002"))
+    sel_env = os.environ.get("BENCH_ZERO_CONF_QUERIES", "")
+    sel = [q.strip() for q in sel_env.split(",") if q.strip()] or \
+        sorted(tpch_sql.QUERIES, key=lambda s: int(s.lstrip("q")))
+    mesh = make_mesh(jax.device_count()) \
+        if jax.device_count() >= 2 else None
+    data = tpch.gen_tables(sf=sf)
+
+    def run_phase(conf):
+        session = TpuSession(trace_conf(conf), mesh=mesh)
+        tpch_sql.register(session, tpch.load(session, data))
+        walls, results = {}, {}
+        decisions = replans = mispredicts = 0
+        for q in sel:
+            df = session.sql(tpch_sql.QUERIES[q])
+            df.to_pandas()  # warm: compile + (phase 2) evidence
+            t0 = time.perf_counter()
+            results[q] = df.to_pandas()
+            walls[q] = (time.perf_counter() - t0) * 1e3
+            if mesh is not None:
+                assert session.last_dist_explain == "distributed", \
+                    (q, session.last_dist_explain)
+            p = getattr(session, "last_planner_stats", None)
+            if p:
+                decisions += len(p.get("decisions", []))
+                replans += p.get("replans", 0)
+                mispredicts += p.get("mispredicts", 0)
+        session.stop()
+        return walls, results, decisions, replans, mispredicts
+
+    tuned_conf = {
+        "spark.rapids.tpu.exchange.async.enabled": True,
+        "spark.rapids.tpu.shuffle.slot.ragged.enabled": True,
+        "spark.rapids.tpu.encoding.execution.enabled": True,
+        "spark.rapids.tpu.encoding.wire.enabled": True,
+    }
+    t_walls, t_res, _, _, _ = run_phase(tuned_conf)
+    z_walls, z_res, dec, rep, mis = run_phase(
+        {"spark.rapids.tpu.costModel.enabled": True})
+    matched = 0
+    for q in sel:
+        pd.testing.assert_frame_equal(
+            z_res[q].reset_index(drop=True),
+            t_res[q].reset_index(drop=True), rtol=1e-9)
+        matched += 1
+    t_total = sum(t_walls.values())
+    z_total = sum(z_walls.values())
+    print(json.dumps({
+        "metric": "zero_conf_vs_hand_tuned_wall_ratio",
+        "value": round(z_total / max(t_total, 1e-9), 4),
+        "unit": "ratio",
+        "queries_matched": matched,
+        "queries_total": len(sel),
+        "hand_tuned_wall_ms": round(t_total, 1),
+        "zero_conf_wall_ms": round(z_total, 1),
+        "per_query_delta_ms": {
+            q: round(z_walls[q] - t_walls[q], 2) for q in sel},
+        "planner_decisions": dec,
+        "planner_replans": rep,
+        "planner_mispredicts": mis,
+        "distributed": mesh is not None,
+    }))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main()
+    elif "--zero-conf" in sys.argv:
+        zero_conf_main()
     elif "--concurrency" in sys.argv:
         idx = sys.argv.index("--concurrency")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 4

@@ -842,4 +842,96 @@ print("shared-cache spray OK (8 clients x 3 waves exact, "
 s.stop()
 PY
 
+echo "== cost-model spray (decisions on, corrupt store + raise/delay/corrupt over costmodel.load + exchange/read faults: answers bit-identical to knobs-off) =="
+# ISSUE 15 gate: with spark.rapids.tpu.costModel.enabled the model
+# decides every knob while (a) its evidence store starts CORRUPT, (b)
+# raise/delay/corrupt rules rot every costmodel.load (evidence load +
+# the QueryEnd ledger/persistence writes), and (c) exchange/read
+# faults drive the recovery ladder mid-query — including through the
+# model's own ReplanRequested path.  Every answer must be bit-
+# identical to a knobs-off session's; a degraded load is built-in
+# defaults with CostModelInvalid, never a failed or wrong query.
+python - <<'PY'
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+import spark_rapids_tpu.plan.costmodel  # registers costmodel.load
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+
+ddir = tempfile.mkdtemp(prefix="tpu-costmodel-data-")
+store = tempfile.mkdtemp(prefix="tpu-costmodel-store-")
+n = 512
+rng = np.random.default_rng(17)
+fact = pd.DataFrame({"a": np.arange(n, dtype=np.int64),
+                     "j": np.zeros(n, dtype=np.int64),
+                     "x": rng.uniform(size=n)})
+paths = []
+for i in range(8):
+    p = os.path.join(ddir, f"fact-{i}.parquet")
+    fact.iloc[i * n // 8:(i + 1) * n // 8].to_parquet(p, index=False)
+    paths.append(p)
+dim = pd.DataFrame({"j": np.arange(16, dtype=np.int64),
+                    "w": np.arange(16) * 1.5})
+
+def queries(s):
+    f = s.read.parquet(*paths)
+    d = s.create_dataframe(dim)
+    agg = f.groupBy("a").agg(F.max("j").alias("j"),
+                             F.sum("x").alias("sx"))
+    skew_join = agg.join(d, "j")          # skewed: replan territory
+    grand = f.filter(F.col("x") > 0.1).agg(F.sum("x").alias("t"))
+    return [("join", skew_join, ["a"]), ("agg", grand, ["t"])]
+
+conf = {"spark.rapids.sql.join.broadcastThresholdRows": 4,
+        "spark.rapids.sql.recovery.backoffMs": 5}
+off = TpuSession(dict(conf), mesh=make_mesh(8))
+want = {name: q.to_pandas().sort_values(keys, ignore_index=True)
+        for name, q, keys in queries(off)}
+off.stop()
+
+# CORRUPT store from the start: a torn record plus valid lines
+with open(os.path.join(store, "observations.jsonl"), "w") as fh:
+    fh.write('{"site": "cm:aa", "rows": 64, "skew": 0.5}\n'
+             '{"site": "cm:bb", "ro')
+
+with I.scoped_rules():
+    # corrupt applies at the construction-time fire_mutate (the ONLY
+    # mutate site): the evidence bytes rot on top of the torn line;
+    # the raise rule skips that load so it lands on the first
+    # QueryEnd ledger/persistence write instead, and delays cover
+    # later writes — every costmodel.load flavor really executes
+    I.inject("costmodel.load", kind="corrupt", count=1,
+             all_threads=True)
+    I.inject("costmodel.load", count=1, skip=1, all_threads=True)
+    I.inject("costmodel.load", kind="delay", delay_s=0.05, count=2,
+             skip=2, all_threads=True)
+    I.inject("shuffle.exchange", count=1, skip=2, all_threads=True)
+    I.inject("io.read", count=1, skip=12, all_threads=True)
+    s = TpuSession(dict(conf, **{
+        "spark.rapids.tpu.costModel.enabled": True,
+        "spark.rapids.tpu.costModel.dir": store,
+    }), mesh=make_mesh(8))
+    assert s.cost_model.invalid_loads >= 1, "corrupt load undetected"
+    for round_ in range(2):  # round 2 runs on converged evidence
+        for name, q, keys in queries(s):
+            got = q.to_pandas().sort_values(keys, ignore_index=True)
+            pd.testing.assert_frame_equal(
+                got[want[name].columns], want[name],
+                check_dtype=False)
+    # both degrade legs fired: the corrupt/torn evidence LOAD and the
+    # raise on a QueryEnd ledger write
+    assert s.cost_model.invalid_loads >= 2, s.cost_model.invalid_loads
+    print("cost-model spray OK (2 rounds exact, "
+          f"invalid={s.cost_model.invalid_loads}, "
+          f"replans={s.cost_model.replan_count}, "
+          f"recovery={[r['fault'] for r in s.recovery_log]})")
+    s.stop()
+PY
+
 echo "CHAOS OK"

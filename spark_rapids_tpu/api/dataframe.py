@@ -834,6 +834,13 @@ class DataFrame:
                 wall_ms = (_time.perf_counter() - t0) * 1e3
                 spans = tracing.finish_query(self.session, qid,
                                              wall_ms, status)
+                # cost-model ledger drain (every exit too: a faulted
+                # attempt's envelope carries its replan decision, and
+                # the ledger never leaks into the next query); absent
+                # from the event when the model is off — HEAD parity
+                cm = getattr(self.session, "cost_model", None)
+                planner = cm.finish_query() if cm is not None else None
+                self.session.last_planner_stats = planner
                 if qid is not None:
                     fusion = dict(getattr(self.session,
                                           "last_fusion_stats", None)
@@ -852,6 +859,7 @@ class DataFrame:
                         # off — the knobs-off event stream must stay
                         # bit-identical to HEAD
                         **({"sharing": sh} if sh else {}),
+                        **({"planner": planner} if planner else {}),
                         explain=self.session.last_dist_explain)
 
             try:
@@ -983,6 +991,9 @@ class DataFrame:
                 tracing.finish_query(
                     self.session, None,
                     (_time.perf_counter() - t0) * 1e3, status)
+                cm = getattr(self.session, "cost_model", None)
+                self.session.last_planner_stats = \
+                    cm.finish_query() if cm is not None else None
         qid = next(self.session._query_ids)
         # the recovery driver stamps RecoveryAction events with the qid
         # of the attempt that failed
@@ -1037,15 +1048,26 @@ class DataFrame:
             spans = tracing.finish_query(self.session, qid, wall_ms,
                                          status)
             sh = self._sharing_info()
+            node_metrics = exec_plan.collect_metrics()
+            cm = getattr(self.session, "cost_model", None)
+            planner = None
+            if cm is not None:
+                # per-op observed device us/row — the evidence the
+                # unified CBO reads over its calibration file (the
+                # metrics were already materialized for the event)
+                cm.fold_op_metrics(node_metrics)
+                planner = cm.finish_query()
+            self.session.last_planner_stats = planner
             events.emit(
                 "QueryEnd", queryId=qid, status=status,
                 durationMs=round(wall_ms, 3),
-                metrics=exec_plan.collect_metrics(), spill=spill,
+                metrics=node_metrics, spill=spill,
                 retry={k: retry1[k] - retry0[k] for k in retry1},
                 pipeline=pipeline, fusion=fusion, spans=spans,
                 admission=self._admission_info(),
                 # absent when every reuse knob is off (HEAD parity)
-                **({"sharing": sh} if sh else {}))
+                **({"sharing": sh} if sh else {}),
+                **({"planner": planner} if planner else {}))
 
     def to_arrow(self):
         import pyarrow as pa

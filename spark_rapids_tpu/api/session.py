@@ -133,6 +133,15 @@ class TpuSession:
             max_events=self.conf.get(rc.TRACE_MAX_EVENTS),
             obs_dir=(self.conf.get(rc.JIT_CACHE_DIR) or trace_dir
                      or None))
+        # self-tuning cost-based planner (plan/costmodel.py): one
+        # evidence-fed decision authority over every tuning knob,
+        # default-off — None keeps every consumption site a single
+        # getattr and plans bit-identical to HEAD
+        self.cost_model = None
+        self.last_planner_stats = None  # QueryEnd planner dict mirror
+        if self.conf.get(rc.COSTMODEL_ENABLED):
+            from spark_rapids_tpu.plan.costmodel import CostModel
+            self.cost_model = CostModel(self, self.conf)
 
     # per-query state views: call sites keep reading/writing
     # ``session._current_qid`` / ``session.checkpoints`` and get the
@@ -185,6 +194,12 @@ class TpuSession:
         obs = tracing.observation_store()
         if obs is not None:
             obs.flush()
+        cm = getattr(self, "cost_model", None)
+        if cm is not None:
+            try:
+                cm.store.flush()
+            except Exception:
+                pass  # evidence persistence must not block teardown
         for store_attr in ("result_cache", "shared_stages"):
             store = getattr(self, store_attr, None)
             if store is not None:

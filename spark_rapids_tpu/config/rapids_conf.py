@@ -29,10 +29,18 @@ class ConfEntry:
         self.validator = validator
         self.internal = internal
 
+    def env_key(self) -> str:
+        """The entry's environment-variable form — the ONE derivation
+        shared by value resolution (``get``) and the explicitly-set
+        test (``RapidsConf.is_set``), so the cost model's
+        override-vs-decide discipline can never diverge from what
+        ``get`` actually reads."""
+        return self.key.upper().replace(".", "_")
+
     def get(self, settings: Dict[str, str]) -> Any:
         raw = settings.get(self.key)
         if raw is None:
-            raw = os.environ.get(self.key.upper().replace(".", "_"))
+            raw = os.environ.get(self.env_key())
         if raw is None:
             return self.default
         value = self.conv(raw) if isinstance(raw, str) else raw
@@ -1039,6 +1047,61 @@ ENCODING_STORAGE_HOST_CODEC = conf(
     lambda v: None if v in ("none", "zrle", "lz4", "zstd")
     else "unknown codec")
 
+COSTMODEL_ENABLED = conf(
+    "spark.rapids.tpu.costModel.enabled", False,
+    "Self-tuning cost-based planner (plan/costmodel.py): ONE "
+    "evidence-fed cost model decides every tuning knob the engine "
+    "otherwise takes from hand-set confs — exchange strategy (uniform "
+    "vs ragged vs gather vs host-staged), the host-staging threshold, "
+    "fusion chain boundaries, coded-vs-decoded execution, shuffle slot "
+    "priors, and the coalesce goal — reading per-site evidence from "
+    "the PR11 ObservationStore (rows/bytes/skew/compile_ms per "
+    "structural site id, persisted beside the AOT cache dir so WARM "
+    "STARTS GET WARM PLANS) and falling back to built-in tables when "
+    "a site has no history.  Explicitly-set conf keys stay as "
+    "OVERRIDES — the model only decides knobs the user left unset.  "
+    "Every decision is recorded in a per-query ledger (QueryEnd "
+    "'planner' dict -> eventlog -> profiling \"Planner decisions\") "
+    "and observed costs fold back into the store so the model "
+    "converges.  False (default) changes nothing: plans, events and "
+    "results are bit-identical to the model never existing.", _to_bool)
+
+COSTMODEL_DIR = conf(
+    "spark.rapids.tpu.costModel.dir", "",
+    "Directory holding the cost model's persisted per-site evidence "
+    "(the observations.jsonl the span-tracing ObservationStore "
+    "writes).  Empty (default) falls back to "
+    "spark.rapids.tpu.jitCache.dir, then spark.rapids.tpu.trace.dir; "
+    "with no directory at all the model runs on in-memory evidence "
+    "only (decisions still work, they just start cold every "
+    "process).  A corrupt or truncated store degrades the model to "
+    "its built-in defaults with a CostModelInvalid event — never a "
+    "failed or wrong query (the costmodel.load injection point).", str)
+
+COSTMODEL_REPLAN_ENABLED = conf(
+    "spark.rapids.tpu.costModel.replan.enabled", True,
+    "Mid-query adaptive re-planning (requires costModel.enabled and "
+    "the recovery ladder): when an exchange launch's measured "
+    "statistics contradict the model's plan-time decision past the "
+    "hysteresis band (measured skew says ragged, the plan chose "
+    "uniform), the launch raises a RETRYABLE ReplanRequested after "
+    "folding the fresh evidence into the store — the ladder's retry "
+    "rung keeps the mesh layout, completed stages splice from the "
+    "checkpoint lineage, and only the contradicted subtree re-plans "
+    "with the measured-optimal strategy.  At most ONE replan per "
+    "query; False records the contradiction in the decision ledger "
+    "without re-driving.", _to_bool)
+
+COSTMODEL_REPLAN_HYSTERESIS = conf(
+    "spark.rapids.tpu.costModel.replan.hysteresis", 2.0,
+    "How decisively the measured statistics must beat the plan-time "
+    "decision before a mid-query replan fires: the contradicting "
+    "alternative's predicted win (e.g. uniform wire rows / ragged "
+    "wire rows) must be at least this factor.  Higher values replan "
+    "less (the band a borderline workload oscillates in without "
+    "re-driving).", _to_float,
+    lambda v: None if v >= 1.0 else "must be >= 1.0")
+
 CBO_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: device regions whose estimated "
@@ -1140,6 +1203,14 @@ class RapidsConf:
 
     def get(self, entry: ConfEntry) -> Any:
         return entry.get(self.settings)
+
+    def is_set(self, entry: ConfEntry) -> bool:
+        """True when the user EXPLICITLY configured this entry (the
+        settings dict or its env-var form).  The cost model treats
+        explicit confs as overrides and only decides unset knobs."""
+        if entry.key in self.settings:
+            return True
+        return os.environ.get(entry.env_key()) is not None
 
     def __getitem__(self, key: str) -> Any:
         return _REGISTRY[key].get(self.settings)

@@ -799,6 +799,16 @@ class DistPlanner:
         from spark_rapids_tpu.config import rapids_conf as _rc
         self._fusion = bool(self.conf.get(_rc.FUSION_ENABLED))
         self._fusion_max = int(self.conf.get(_rc.FUSION_MAX_OPS))
+        from spark_rapids_tpu.plan.costmodel import active_model
+        # THIS session's model (or None), passed explicitly to every
+        # consumer this planner constructs: a concurrent session
+        # flipping TpuSession._active mid-query must never leak its
+        # model into (or out of) this query's plan
+        self._cost_model = active_model(session)
+        if self._cost_model is not None:
+            # self-tuning planner: one fusion-boundary decision shared
+            # with the single-process planner (conf stays an override)
+            self._fusion_max = self._cost_model.fusion_chain_limit()
         # async exchange/compute overlap (parallel/exchange_async.py):
         # exchange-bearing launches admit a handle into this bounded
         # window instead of blocking on their post-launch verification;
@@ -1365,6 +1375,7 @@ class DistPlanner:
                 group_exprs=group_exprs,
                 funcs=[a.func for a in agg_list],
                 filter_cond=lcond,
+                cost_model=self._cost_model,
                 # compressed wire: the exchanged partial frame's code
                 # columns (encoded group keys + encoded min/max/first/
                 # last partials) with their dictionaries
@@ -1605,7 +1616,8 @@ class DistPlanner:
                 build_dtypes=build_m.phys_dtypes,
                 probe_key_idx=pk_idx, build_key_idx=bk_idx,
                 join_type=join_type, out_factor=out_factor,
-                probe_encoded=probe_enc, build_encoded=build_enc)
+                probe_encoded=probe_enc, build_encoded=build_enc,
+                cost_model=self._cost_model)
             flat, n_out, total = join(
                 probe_m.cols, probe_m.nrows, build_m.cols,
                 build_m.nrows, window=self._xwindow)
@@ -1685,7 +1697,8 @@ class DistPlanner:
         keys, desc, nf = self._lower_orders(plan.orders, f)
         if dry:
             return f
-        dist = DistributedSort(self.mesh, f.phys_dtypes, keys, desc, nf)
+        dist = DistributedSort(self.mesh, f.phys_dtypes, keys, desc, nf,
+                               cost_model=self._cost_model)
         out_cols, nrows = dist(f.cols, f.nrows)
         self._emit_stats("sort", dist.last_stats)
         return f.replace(cols=list(out_cols), nrows=nrows.reshape(-1))

@@ -95,7 +95,8 @@ class DistributedAggregate:
                  group_exprs: Sequence[Expression],
                  funcs: Sequence[agg.AggregateFunction],
                  filter_cond: Optional[Expression] = None,
-                 encoded_keys=None, encoded_funcs=None):
+                 encoded_keys=None, encoded_funcs=None,
+                 cost_model="auto"):
         """``encoded_keys`` / ``encoded_funcs``: dictionaries behind
         group-key positions / function positions whose exchanged
         values are int64 dictionary codes — with
@@ -165,6 +166,16 @@ class DistributedAggregate:
                      ("packed", self.packed),
                      ("exch", self.exchange_strategy),
                      ("wenc", self.wire_encoding))
+        # self-tuning planner (plan/costmodel.py): ONE evidence-fed
+        # decision for this site's exchange strategy — uniform vs
+        # ragged vs gather vs host-staged — replacing the independent
+        # ragged/staging confs (which stay as overrides when
+        # explicitly set).  A "ragged" plan makes the stats histogram
+        # mandatory (the site never launches speculatively); the
+        # staging threshold comes budget-derived instead of hand-set.
+        from spark_rapids_tpu.plan.costmodel import \
+            resolve_consumer_exchange
+        resolve_consumer_exchange(self, "aggregate", model=cost_model)
         # keyless grand totals never exchange rows: single fused program
         self._jitted_keyless = cached_jit(
             self._sig + ("keyless",), lambda: _shard_map(
@@ -395,7 +406,7 @@ class DistributedAggregate:
         flight."""
         import numpy as np
         from spark_rapids_tpu.parallel.exchange_async import (
-            overlap_metrics_for_session, staging_threshold)
+            overlap_metrics_for_session)
         from spark_rapids_tpu.parallel.shuffle import (
             broadcast_wire_dicts, launch_checkpoint,
             metrics_for_session, plan_ragged, planner_for_session,
@@ -430,14 +441,22 @@ class DistributedAggregate:
             if broadcast_wire_dicts(site + ("dict",), dicts, metrics):
                 wenc = self._wire_encode
 
-        thr = staging_threshold() \
-            if self.exchange_strategy != "gather" else 0
+        from spark_rapids_tpu.plan.costmodel import (
+            consumer_staging_threshold)
+        # model-derived when the conf is unset (payloads past a
+        # fraction of the device budget stage through host RAM), else
+        # the conf helper's semantics
+        thr = 0 if self.exchange_strategy == "gather" \
+            else consumer_staging_threshold(self)
         # sizing uses the INTENDED wire; a corrupt-delta wide fallback
         # only makes the estimate conservative-side wrong for one launch
         row_bytes = max(
             wire_row_bytes(self._wire_dtypes())
             - 4 * len(self._wire_encode), 1)
-        spec = planner.speculative(site, capacity)
+        # a model-planned RAGGED site never launches speculatively:
+        # plan_ragged needs the materialized histogram every launch
+        spec = None if self._planned_mode == "ragged" \
+            else planner.speculative(site, capacity)
         if spec is not None and thr and \
                 self.nshards * self.nshards * spec["slot"] * row_bytes \
                 > thr:
@@ -459,9 +478,19 @@ class DistributedAggregate:
             rows = int(dst_counts.sum())
             slot = planner.plan(site, max_slice, capacity)
             est_bytes = self.nshards * self.nshards * slot * row_bytes
+            if self._cost_model is not None:
+                # launch-time evidence feed: what the next plan-time
+                # decision (and a warm start's) reads
+                self._cost_model.note_exchange(
+                    site, rows=rows, max_slice=max_slice,
+                    useful_bytes=rows * row_bytes)
             if thr and est_bytes > thr:
-                return self._launch_staged(partial_flat, lut,
+                outs = self._launch_staged(partial_flat, lut,
                                            dst_counts, metrics)
+                if self._cost_model is not None:
+                    self._cost_model.observe_staged(
+                        site, self.last_stats.get("stagedBytes", 0))
+                return outs
             resolve_wire()
             ragged = None
             if self.ragged and self.exchange_strategy != "gather":
@@ -493,6 +522,22 @@ class DistributedAggregate:
                 rows_useful=rows, packed=self.packed,
                 site=self._sig + ("final", wenc), ragged=ragged,
                 counts=dst_counts, wire_encode_cols=len(wenc))
+            if self._cost_model is not None:
+                # fold the observed wire cost onto the ledger decision,
+                # then check the launch against the plan: a uniform
+                # launch whose measured histogram says ragged would
+                # have won past the hysteresis band re-drives through
+                # the ladder (ReplanRequested) with the evidence above
+                # already folded — completed stages splice, only this
+                # subtree re-plans
+                self._cost_model.observe_outcome(
+                    "exchange", site,
+                    float(metrics.last_exchange_bytes))
+                if ragged is None and self.exchange_strategy != "gather":
+                    self._cost_model.check_contradiction(
+                        site, "aggregate", counts=dst_counts,
+                        capacity=capacity, nshards=self.nshards,
+                        slot=slot)
             if window is not None:
                 # stats-sized slots are proven (slot >= true max / the
                 # ragged limits cover every pair): no verification to
@@ -721,7 +766,8 @@ class DistributedHashJoin:
                  skew_factor: Optional[float] = None,
                  skew_min_rows: Optional[int] = None,
                  skew_enabled: Optional[bool] = None,
-                 probe_encoded=None, build_encoded=None):
+                 probe_encoded=None, build_encoded=None,
+                 cost_model="auto"):
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.config import rapids_conf as rc
 
@@ -798,6 +844,11 @@ class DistributedHashJoin:
                      join_type, out_factor, ("packed", self.packed),
                      ("exch", self.exchange_strategy),
                      ("wenc", self.wire_encoding))
+        # self-tuning planner: the same one-decision exchange policy
+        # the aggregate resolves (see DistributedAggregate.__init__)
+        from spark_rapids_tpu.plan.costmodel import \
+            resolve_consumer_exchange
+        resolve_consumer_exchange(self, "join", model=cost_model)
         self.last_stats: Optional[dict] = None
 
     def _jitted(self, strategy: str, slots, skewed=(), wencs=((), ())):
@@ -1125,10 +1176,23 @@ class DistributedHashJoin:
             # rides the device collective — both sides repartition
             # through host memory + the frame codec and the join runs
             # the no-exchange "local" program (the split-rung dodge)
-            from spark_rapids_tpu.parallel.exchange_async import (
-                staging_threshold)
             from spark_rapids_tpu.parallel.shuffle import wire_row_bytes
-            thr = staging_threshold()
+            from spark_rapids_tpu.plan.costmodel import (
+                consumer_staging_threshold)
+            thr = consumer_staging_threshold(self)
+            if self._cost_model is not None:
+                # launch-time evidence: probe-side skew (the side the
+                # skew machinery keys on) + both sides' useful bytes
+                p_useful = int(pcounts.sum()) * max(
+                    wire_row_bytes(self.probe_dtypes)
+                    - 4 * len(self._p_wenc), 1)
+                b_useful = int(bcounts.sum()) * max(
+                    wire_row_bytes(self.build_dtypes)
+                    - 4 * len(self._b_wenc), 1)
+                self._cost_model.note_exchange(
+                    self._sig, rows=int(pcounts.sum()),
+                    max_slice=int(pcounts.max()),
+                    useful_bytes=p_useful + b_useful)
             if thr and self.exchange_strategy != "gather":
                 # staging sized from POST-encoding byte counts: the
                 # narrowed wire halves each code column's contribution
@@ -1143,9 +1207,14 @@ class DistributedHashJoin:
                        * max(wire_row_bytes(self.build_dtypes)
                              - 4 * len(self._b_wenc), 1))
                 if est > thr:
-                    return self._staged_call(
+                    out = self._staged_call(
                         probe_flat, pcounts, build_flat, bcounts,
                         metrics)
+                    if self._cost_model is not None:
+                        self._cost_model.observe_staged(
+                            self._sig,
+                            self.last_stats.get("stagedBytes", 0))
+                    return out
             resolve_wire(True, True)
             # skew detection on the probe destination totals
             # (OptimizeSkewedJoin: partition > factor * median)
@@ -1184,8 +1253,14 @@ class DistributedHashJoin:
                 slots = (planner.plan(p_site, int(padj.max()), cap_p),
                          planner.plan(b_site, int(badj.max()), cap_b),
                          gather_cap)
-                planner.observe(p_site, int(padj.max()), slots[0], cap_p)
-                planner.observe(b_site, int(badj.max()), slots[1], cap_b)
+                # rows= feeds the per-site observation store (skew =
+                # max_slice/rows): join exchange sites carry evidence
+                # like aggregate sites do, so the ragged-vs-uniform
+                # decision has history on every exchange-bearing op
+                planner.observe(p_site, int(padj.max()), slots[0],
+                                cap_p, rows=int(pcounts.sum()))
+                planner.observe(b_site, int(badj.max()), slots[1],
+                                cap_b, rows=int(bcounts.sum()))
                 # the skewed-build bounded all-gather is a third data
                 # movement on ICI (gather_cap rows replicated to every
                 # shard) — it can dominate a heavily skewed build side,
@@ -1201,8 +1276,12 @@ class DistributedHashJoin:
             else:
                 u_p = planner.plan(p_site, int(pcounts.max()), cap_p)
                 u_b = planner.plan(b_site, int(bcounts.max()), cap_b)
-                planner.observe(p_site, int(pcounts.max()), u_p, cap_p)
-                planner.observe(b_site, int(bcounts.max()), u_b, cap_b)
+                # rows= so join sites feed skew/row evidence into the
+                # observation store (see the skewed branch above)
+                planner.observe(p_site, int(pcounts.max()), u_p, cap_p,
+                                rows=int(pcounts.sum()))
+                planner.observe(b_site, int(bcounts.max()), u_b, cap_b,
+                                rows=int(bcounts.sum()))
                 slots = (u_p, u_b)
                 if self.ragged and self.exchange_strategy != "gather":
                     # skew-adaptive ragged wire: the [src, dst]
@@ -1260,6 +1339,21 @@ class DistributedHashJoin:
                 probe_flat, probe_nrows_per_shard,
                 build_flat, build_nrows_per_shard)
         if strategy == "shuffle":
+            if self._cost_model is not None:
+                # ledger outcome + the plan-vs-measured contradiction
+                # check (see DistributedAggregate.__call__): a uniform
+                # launch over a histogram a ragged plan would have
+                # beaten past the hysteresis band re-drives ONCE
+                # through the ladder with the evidence already folded
+                self._cost_model.observe_outcome(
+                    "exchange", self._sig, float(launch_bytes))
+                if rag_p is None and rag_b is None and not skewed and \
+                        self.exchange_strategy != "gather":
+                    self._cost_model.check_contradiction(
+                        self._sig, "join", counts=pcounts,
+                        capacity=cap_p, nshards=self.nshards,
+                        slot=slots[0] if isinstance(slots[0], int)
+                        else 0)
             if window is not None:
                 # join slots are stats-sized (histograms are mandatory
                 # for skew detection), so there is no deferred

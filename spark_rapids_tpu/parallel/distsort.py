@@ -131,11 +131,15 @@ class DistributedSort:
                  key_exprs: Sequence[Expression],
                  descending: Sequence[bool],
                  nulls_first: Sequence[bool],
-                 partition_prefix: Optional[int] = None):
+                 partition_prefix: Optional[int] = None,
+                 cost_model="auto"):
         """``partition_prefix``: range-partition on only the first N
         keys (local sort still uses all of them), so rows equal on the
         prefix are guaranteed to land on ONE shard — the window
-        lowering's requirement that a partition never splits."""
+        lowering's requirement that a partition never splits.
+        ``cost_model``: the owning session's cost model (the
+        distributed planner passes it explicitly; "auto" resolves the
+        active session's — direct kernel use)."""
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.parallel.shuffle import packed_enabled
         self.mesh = mesh
@@ -156,6 +160,11 @@ class DistributedSort:
                      tuple(e.cache_key() for e in self.key_exprs),
                      tuple(self.descending), tuple(self.nulls_first),
                      self.prefix, ("packed", self.packed))
+        from spark_rapids_tpu.plan.costmodel import (AUTO_MODEL,
+                                                     active_model)
+        self._cost_model = active_model() \
+            if isinstance(cost_model, str) and cost_model == AUTO_MODEL \
+            else cost_model
         self.last_stats: Optional[dict] = None
 
     def _emit_keys(self, cols: List[ColVal], nrows) -> List[ColVal]:
@@ -276,6 +285,15 @@ class DistributedSort:
         slot = planner.plan(self._sig, max_slice, capacity)
         planner.observe(self._sig, max_slice, slot, capacity,
                         rows=int(counts.sum()))
+        if self._cost_model is not None:
+            # sort exchange sites feed the cost model's evidence too —
+            # all three exchange-bearing operators carry skew history
+            from spark_rapids_tpu.parallel.shuffle import wire_row_bytes
+            self._cost_model.note_exchange(
+                self._sig, rows=int(counts.sum()),
+                max_slice=max_slice,
+                useful_bytes=int(counts.sum())
+                * wire_row_bytes(self.in_dtypes))
         record_exchange_metrics(
             metrics_for_session(), dtypes=self.in_dtypes, slot=slot,
             num_parts=self.nshards, nshards=self.nshards,

@@ -234,6 +234,14 @@ def wire_encoding_enabled(conf=None) -> bool:
         if s is None:
             return rc.ENCODING_WIRE_ENABLED.default
         conf = s.conf
+    from spark_rapids_tpu.plan.costmodel import model_for_conf
+    cm = model_for_conf(conf)
+    if cm is not None:
+        # self-tuning planner: the model decides when the conf key is
+        # unset (an explicitly-set key stays an override inside it);
+        # conf-gated so a knobs-off session planning while a model-on
+        # session is _active keeps bit-identical HEAD parity
+        return cm.wire_encoding()
     return conf.get(rc.ENCODING_WIRE_ENABLED)
 
 
@@ -832,6 +840,16 @@ class SlotPlanner:
         with self._lock:
             e = self.sites.get(site)
             ema = e["ema"] if e and e.get("capacity") == capacity else 0.0
+        if not ema:
+            # cold site + cost model: seed the EMA from the persisted
+            # rows x skew evidence so a warm START lands in the same
+            # power-of-two bucket (= same jit key) as the last process
+            from spark_rapids_tpu.plan.costmodel import active_model
+            cm = active_model()
+            if cm is not None:
+                prior = cm.slot_prior(site)
+                if 0 < prior <= capacity:
+                    ema = float(prior)
         return pick_slot(max(int(max_slice), int(ema)), capacity)
 
     def observe(self, site: Hashable, max_slice: int, slot: int,

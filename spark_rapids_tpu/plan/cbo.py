@@ -131,6 +131,17 @@ class CostBasedOptimizer:
         tpu_w, cpu_w = load_weights()
         self.tpu_w = dict(tpu_w)
         self.cpu_w = dict(cpu_w)
+        # self-tuning cost model: MEASURED per-op device weights from
+        # the observation store (the ``op:<Name>`` evidence records
+        # the QueryEnd metric fold writes) beat the static calibration
+        # file — the calibration stays the cold-start fallback, conf
+        # keys below stay the final override
+        from spark_rapids_tpu.plan.costmodel import model_for_conf
+        cm = model_for_conf(conf)
+        if cm is not None:
+            for name, us in cm.op_weights().items():
+                if name in self.tpu_w:
+                    self.tpu_w[name] = us
         # conf keys override calibrated values per op
         for name in set(self.tpu_w) | set(self.cpu_w):
             ov = conf.op_cost("tpu", name)

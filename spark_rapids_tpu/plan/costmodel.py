@@ -1,0 +1,718 @@
+"""Self-tuning cost-based planner: ONE evidence-fed cost model over
+every tuning knob, with mid-query adaptive re-planning.
+
+The engine accumulated a dozen independently tuned heuristics — slot
+EMA + speculation, ragged ``minSavings``, ``hostStaging.thresholdBytes``,
+topology strategy, fusion ``maxChainOps``, encoding knobs, coalesce
+goals — each locally tuned, none sharing evidence.  This module unifies
+them behind one decision authority:
+
+* **Evidence** comes from the PR11 :class:`ObservationStore` — per-site
+  ``{rows, bytes, skew, compile_ms, span_ms}`` keyed by the SAME
+  structural site ids the jit cache and checkpoint lineage use,
+  persisted beside the AOT cache dir, so a warm start has warm *plans*,
+  not just warm executables.  The model's own records use a ``cm:``
+  sid prefix (and readable ``op:<Name>`` records for per-operator
+  weights) so they coexist with the tracing runtime's records in one
+  JSONL file; a site with no history falls back to the built-in
+  tables below ("GPU-Augmented OLAP Execution Engine" is the exemplar
+  for cost-modeled offload decisions, Theseus for movement costs).
+* **Decisions** — exchange strategy (uniform vs ragged vs gather vs
+  host-staged), the staging threshold, fusion chain boundaries,
+  coded-vs-decoded execution, shuffle slot priors, and the coalesce
+  goal — are each served by one API here, consumed by the SlotPlanner,
+  ``plan/overrides``, ``DistributedAggregate``/``DistributedHashJoin``
+  and the planner-inserted coalesce.  The hand-tuned conf keys stay as
+  *overrides*: an explicitly-set key wins and the model only decides
+  knobs the user left unset (``RapidsConf.is_set``).
+* **Ledger** — every decision records (knob, site, chosen,
+  alternatives with predicted costs, override/evidence provenance)
+  into a per-query ledger that rides the QueryEnd ``planner`` dict →
+  eventlog ``QueryInfo.planner`` → the profiling "Planner decisions"
+  section with a mispredict health check; observed costs fold back
+  into the ledger AND the observation store, so the model converges.
+* **Mid-query adaptive re-planning** — when a launch's measured
+  statistics contradict the plan-time decision past the hysteresis
+  band (measured skew says ragged, the plan chose uniform), the model
+  folds the fresh evidence and raises a RETRYABLE
+  :class:`ReplanRequested`: a non-failure entry point into the
+  recovery ladder's re-drive.  The retry rung keeps the mesh layout,
+  so completed stages splice from the stage-checkpoint lineage and
+  only the contradicted subtree re-plans — at most ONCE per query.
+
+Default-off (``spark.rapids.tpu.costModel.enabled``): with the knob
+off no model exists, every consumption site is a single None check,
+and plans/events/results are bit-identical to HEAD.  A corrupt or
+truncated evidence file — or a ledger/persistence write fault —
+degrades the model to its built-in defaults with a ``CostModelInvalid``
+event (the ``costmodel.load`` injection point), never a failed or
+wrong query.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu.robustness.inject import register_point
+from spark_rapids_tpu.utils.tracing import ObservationStore, site_id
+
+# chaos surface: raise/delay rules degrade evidence load (and the
+# QueryEnd ledger/evidence persistence) to built-in defaults; corrupt
+# rules bit-flip the raw observation bytes before parsing — either way
+# a CostModelInvalid event, never a failed or wrong query
+register_point("costmodel.load")
+
+# ---------------------------------------------------------------------------
+# Built-in cost tables (relative units — only ratios matter).  These are
+# the cold-start fallback when a site has no observation history; the
+# docs/performance.md "Self-tuning planner" decision table documents
+# which formula each knob uses.
+W_ICI_BYTE = 1.0        # device collective, per padded wire byte
+W_DCN_BYTE = 8.0        # cross-host (DCN) collective byte
+W_STAGED_BYTE = 6.0     # host staging per useful byte (D2H + codec + H2D)
+W_STAGED_FIXED = float(1 << 20)  # host round-trip setup, in
+#                                  bytes-equivalents: staging never wins
+#                                  on tiny payloads
+W_PERMUTE_ROUND = 4096.0  # per extra collective-permute round (launch
+#                           latency, amortized in bytes-equivalents)
+RAGGED_WIRE_OVERHEAD = 1.25  # ragged payload vs perfectly dense
+RAGGED_ROUNDS_EST = 2.0      # typical surplus rounds for a skewed site
+
+# plan-time priors
+RAGGED_MODEL_MIN_SAVINGS = 1.2  # launch-time minSavings when the model
+#                                 (not the conf) governs plan_ragged
+STAGING_BUDGET_FRACTION = 0.5   # of the spill catalog's device budget:
+#                                 padded payloads past this predict staged
+COALESCE_BUDGET_DIVISOR = 4     # coalesce goal <= device budget / this
+COALESCE_GOAL_FLOOR = 1 << 16
+COMPILE_HEAVY_MS = 10_000.0     # observed worst compile past this
+#                                 halves the fusion chain bound
+MISPREDICT_FACTOR = 4.0         # observed >= this x predicted = mispredict
+
+# built-in prior for coded-vs-decoded execution: the PR10 string-q1 A/B
+# measured the encoded fused stage ~1.8x the decoded path
+ENCODED_SPEEDUP_PRIOR = 1.8
+
+# exec node -> CBO operator-kind mapping for per-op observed weights
+_OP_NAMES = {
+    "TpuProjectExec": "Project", "TpuFilterExec": "Filter",
+    "TpuHashAggregateExec": "Aggregate", "TpuHashJoinExec": "Join",
+    "TpuSortExec": "Sort", "TpuTopNExec": "Sort",
+    "TpuWindowExec": "Window", "TpuGenerateExec": "Generate",
+    "TpuLocalLimitExec": "Limit", "TpuUnionExec": "Union",
+}
+
+
+@dataclass
+class ExchangePlan:
+    """One plan-time exchange decision for a consumer site.
+
+    ``mode`` is the predicted-cheapest strategy; ``ragged`` arms the
+    consumer's ragged capability (histograms become mandatory — the
+    site never launches speculatively); ``staging_thr`` is the
+    effective host-staging threshold in bytes (None = defer to the
+    conf helper ``exchange_async.staging_threshold``, i.e. the user
+    explicitly set the knob)."""
+
+    mode: str                      # uniform | ragged | gather | staged
+    ragged: bool
+    min_savings: float
+    staging_thr: Optional[int]
+
+
+class _MemoryStore(ObservationStore):
+    """Evidence store when no directory resolves: same EMA semantics,
+    in-memory only — decisions still converge within the process,
+    nothing persists across it."""
+
+    def __init__(self):  # noqa: D401 - deliberate no-super
+        self.dir = None
+        self.path = None
+        self._lock = threading.Lock()
+        self.records: Dict[str, Dict[str, float]] = {}
+        self._dirty = False
+        self._dirty_sids: set = set()
+
+    def flush(self) -> None:
+        pass
+
+
+class CostModel:
+    """One per session (``session.cost_model``; None when the knob is
+    off — every consumption site pays a single getattr)."""
+
+    def __init__(self, session, conf):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.session = session
+        self.conf = conf
+        self.hysteresis = conf.get(rc.COSTMODEL_REPLAN_HYSTERESIS)
+        self.replan_conf = conf.get(rc.COSTMODEL_REPLAN_ENABLED) and \
+            conf.get(rc.QUERY_RECOVERY_ENABLED)
+        self.dir = (conf.get(rc.COSTMODEL_DIR)
+                    or conf.get(rc.JIT_CACHE_DIR)
+                    or conf.get(rc.TRACE_DIR) or None)
+        self.invalid_loads = 0
+        self._invalid_reported = 0  # drained into per-query deltas
+        self.replan_count = 0
+        # cached worst-compile scan (value, computed_at): the full
+        # store walk must not run per plan on a store that can hold
+        # thousands of multi-session site records
+        self._compile_worst = (0.0, float("-inf"))
+        self._lock = threading.Lock()
+        # per-query decision ledger, keyed by effective thread ident
+        # (the PR6 attribution discipline: concurrent queries must not
+        # smear each other's decisions); popped at every QueryEnd
+        self._ledger: Dict[int, List[dict]] = {}
+        self._ledger_keys: Dict[int, set] = {}
+        self.evidence: Dict[str, Dict[str, float]] = {}
+        self.store: ObservationStore = _MemoryStore()
+        self._open_store()
+
+    # ------------------------------------------------------- evidence --
+    def _invalid(self, reason: str) -> None:
+        self.invalid_loads += 1
+        try:
+            from spark_rapids_tpu.utils.events import emit_on_session
+            emit_on_session("CostModelInvalid", session=self.session,
+                            reason=reason)
+        except Exception:
+            pass  # the degrade record must never fail a query
+
+    def _open_store(self) -> None:
+        """Load persisted evidence (guarded by ``costmodel.load``) and
+        attach the write-side store: the process-global tracing store
+        when it already persists to the same directory (one store, one
+        flush discipline), else the model's own."""
+        self.evidence = self._load_evidence()
+        if not self.dir:
+            return
+        try:
+            from spark_rapids_tpu.utils import tracing
+            shared = tracing.observation_store()
+            if shared is not None and \
+                    getattr(shared, "dir", None) == self.dir:
+                self.store = shared
+                return
+            store = ObservationStore(self.dir)
+            # the validated load is authoritative: the store's own
+            # silent-skip read must not resurrect corrupt-file state
+            store.records = {k: dict(v)
+                             for k, v in self.evidence.items()}
+            self.store = store
+        except Exception as e:
+            self._invalid(f"store-open: {type(e).__name__}: {e}")
+            self.store = _MemoryStore()
+
+    def _load_evidence(self) -> Dict[str, Dict[str, float]]:
+        """The one guarded evidence read: raise/delay chaos rules and
+        ANY parse/IO failure degrade to the built-in defaults (empty
+        evidence) with a CostModelInvalid event; corrupt rules mutate
+        the raw bytes before parsing, exercising the same path real
+        bit rot would."""
+        from spark_rapids_tpu.robustness.inject import fire, fire_mutate
+        from spark_rapids_tpu.utils.tracing import OBS_FILE
+        records: Dict[str, Dict[str, float]] = {}
+        try:
+            fire("costmodel.load")
+            path = os.path.join(self.dir, OBS_FILE) if self.dir else None
+            if not path or not os.path.exists(path):
+                return records
+            with open(path, "rb") as f:
+                raw = f.read()
+            raw = fire_mutate("costmodel.load", raw)
+            bad = 0
+            for line in raw.decode("utf-8",
+                                   errors="replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    sid = rec.pop("site")
+                    records[str(sid)] = {
+                        k: v for k, v in rec.items()
+                        if isinstance(v, (int, float))}
+                except Exception:
+                    bad += 1
+            if bad:
+                raise ValueError(f"{bad} corrupt observation line(s)")
+            return records
+        except Exception as e:
+            self._invalid(f"load: {type(e).__name__}: {e}")
+            return {}
+
+    def evidence_for(self, site) -> Dict[str, float]:
+        """Merged evidence for a structural site: the live store's
+        fresh observations win over the validated persisted load;
+        model (``cm:``) records win over the tracing runtime's."""
+        sid = site if isinstance(site, str) else site_id(site)
+        for key in (f"cm:{sid}", sid):
+            rec = self.store.records.get(key)
+            if rec is None:
+                rec = self.evidence.get(key)
+            if rec:
+                return dict(rec)
+        return {}
+
+    def observe_site(self, site, **fields) -> None:
+        """Fold observed per-site facts (rows/bytes/skew...) into the
+        evidence store under the model's ``cm:`` namespace."""
+        self._observe_sid(f"cm:{site_id(site)}", **fields)
+
+    def _store_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Shallow copy of the live store's records under its lock —
+        iteration-safe while concurrent queries observe() new sites
+        (a lock-free scan can die mid-iteration)."""
+        lock = getattr(self.store, "_lock", None)
+        if lock is None:
+            return dict(self.store.records)
+        with lock:
+            return dict(self.store.records)
+
+    def _observe_sid(self, sid: str, **fields) -> None:
+        try:
+            self.store.observe(sid, **fields)
+        except Exception:
+            pass  # evidence is an optimization, never a failure
+
+    # --------------------------------------------------------- ledger --
+    @staticmethod
+    def _ident() -> int:
+        from spark_rapids_tpu.serving import context as qc
+        return qc.effective_ident()
+
+    def _decide(self, knob: str, site, chosen: str,
+                alternatives: Optional[Dict[str, float]] = None,
+                override: bool = False, evidence: bool = False,
+                predicted: Optional[float] = None) -> dict:
+        """Record one decision in the current query's ledger (deduped
+        per (knob, site) so repeated planner consultations record
+        once).  Returns the (live) record so the caller can attach the
+        observed cost later."""
+        sid = "-" if site is None else (
+            site if isinstance(site, str) else site_id(site))
+        rec = {"knob": knob, "site": sid, "chosen": chosen,
+               "override": bool(override), "evidence": bool(evidence)}
+        if predicted is not None:
+            rec["predicted"] = round(float(predicted), 3)
+        if alternatives:
+            rec["alternatives"] = {k: round(float(v), 3)
+                                   for k, v in alternatives.items()}
+        ident = self._ident()
+        with self._lock:
+            keys = self._ledger_keys.setdefault(ident, set())
+            if (knob, sid) in keys and knob != "replan":
+                for old in reversed(self._ledger.get(ident, [])):
+                    if old["knob"] == knob and old["site"] == sid:
+                        return old
+            keys.add((knob, sid))
+            self._ledger.setdefault(ident, []).append(rec)
+            if len(self._ledger) > 256:
+                # recycled-ident flood: drop stale entries, keep ours
+                for k in list(self._ledger)[:128]:
+                    if k != ident:
+                        self._ledger.pop(k, None)
+                        self._ledger_keys.pop(k, None)
+        return rec
+
+    def observe_outcome(self, knob: str, site,
+                        observed_cost: float) -> None:
+        """Attach the observed cost to the latest matching ledger
+        decision — the mispredict health check's raw material."""
+        sid = site if isinstance(site, str) else site_id(site)
+        ident = self._ident()
+        with self._lock:
+            for rec in reversed(self._ledger.get(ident, [])):
+                if rec["knob"] == knob and rec["site"] == sid:
+                    rec["observed"] = round(float(observed_cost), 3)
+                    return
+
+    def finish_query(self) -> Dict[str, Any]:
+        """The QueryEnd drain: pop this query's ledger, derive the
+        mispredict/replan tallies, and persist the evidence (guarded —
+        a write fault degrades with CostModelInvalid, never fails the
+        query).  Returns the QueryEnd ``planner`` dict."""
+        ident = self._ident()
+        with self._lock:
+            recs = self._ledger.pop(ident, [])
+            self._ledger_keys.pop(ident, None)
+            # per-query DELTA of the degraded-load counter, drained by
+            # whichever envelope closes first (the process-global-delta
+            # attribution discipline) — a construction-time degrade
+            # must not re-stamp every later healthy query
+            invalid = self.invalid_loads - self._invalid_reported
+            self._invalid_reported = self.invalid_loads
+        mispredicts = sum(
+            1 for r in recs
+            if r.get("observed") is not None and r.get("predicted")
+            and r["observed"] >= MISPREDICT_FACTOR * r["predicted"])
+        out = {
+            "decisions": recs,
+            "replans": sum(1 for r in recs if r["knob"] == "replan"
+                           and r.get("applied")),
+            "mispredicts": mispredicts,
+            "invalidLoads": invalid,
+        }
+        try:
+            from spark_rapids_tpu.robustness.inject import fire
+            fire("costmodel.load")
+            self.store.flush()
+        except Exception as e:
+            self._invalid(f"ledger-write: {type(e).__name__}: {e}")
+        return out
+
+    # ------------------------------------------------ exchange policy --
+    def _device_budget(self) -> int:
+        cat = getattr(self.session, "memory_catalog", None)
+        return int(getattr(cat, "device_budget", 0) or (16 << 30))
+
+    def _derived_staging_thr(self) -> int:
+        """Budget-derived staging threshold: a padded exchange payload
+        the device budget could never comfortably hold should stage
+        through host RAM, not march into the spill/split rungs.  The
+        query's serving memory budget tightens it further (the
+        ``staging_threshold`` helper's discipline)."""
+        thr = max(int(self._device_budget() *
+                      STAGING_BUDGET_FRACTION), 1)
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        if ctx is not None and getattr(ctx, "memory_budget", 0):
+            thr = min(thr, int(ctx.memory_budget))
+        return thr
+
+    def resolve_exchange(self, site, nshards: int, op: str = "exchange",
+                         strategy: str = "all_to_all") -> ExchangePlan:
+        """Plan-time exchange decision for one consumer site, from
+        per-site evidence (cold sites predict uniform — the built-in
+        prior; ragged variants and staging estimates cost compile time
+        and host work that unskewed, fitting payloads should not pay).
+
+        Cost formulas (relative units, docs/performance.md):
+
+        * uniform = useful_bytes * padding_factor * W_wire, with
+          padding_factor = nshards^2 * observed hottest-slice skew;
+        * ragged  = useful_bytes * 1.25 * W_wire + rounds * W_permute;
+        * staged  = useful_bytes * W_staged (device->host + codec +
+          host->device), chosen when the padded payload exceeds the
+          budget-derived staging threshold;
+        * gather is the topology-resolved strategy (DCN-spanning axes)
+          and is recorded, not second-guessed — physics wins.
+
+        Explicitly-set conf keys override the corresponding leg."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        conf = self.conf
+        ragged_set = conf.is_set(rc.SHUFFLE_SLOT_RAGGED_ENABLED)
+        staging_set = conf.is_set(rc.EXCHANGE_HOST_STAGING_THRESHOLD)
+        ev = self.evidence_for(site)
+        rows = float(ev.get("rows") or 0.0)
+        skew = float(ev.get("skew") or 0.0)
+        useful = float(ev.get("bytes") or 0.0)
+        w = W_DCN_BYTE if strategy == "gather" else W_ICI_BYTE
+        pad_factor = max(nshards * nshards * skew, 1.0) if skew else 1.0
+        costs: Dict[str, float] = {}
+        if useful:
+            costs["uniform"] = useful * pad_factor * w
+            costs["ragged"] = useful * RAGGED_WIRE_OVERHEAD * w + \
+                RAGGED_ROUNDS_EST * W_PERMUTE_ROUND
+            costs["staged"] = useful * W_STAGED_BYTE + W_STAGED_FIXED
+        staging_thr = None if staging_set else self._derived_staging_thr()
+        # staged is a FITTING decision (budget threshold), ragged a
+        # SPEED decision (cost argmin): a payload the device budget
+        # could never comfortably hold stages regardless of speed
+        if strategy == "gather":
+            mode = "gather"
+        elif not staging_set and useful and \
+                useful * pad_factor > staging_thr:
+            mode = "staged"
+        elif costs and costs["ragged"] < costs["uniform"] and not (
+                ragged_set and
+                not conf.get(rc.SHUFFLE_SLOT_RAGGED_ENABLED)):
+            mode = "ragged"
+        else:
+            mode = "uniform"
+        if ragged_set:
+            ragged = conf.get(rc.SHUFFLE_SLOT_RAGGED_ENABLED)
+            min_savings = conf.get(rc.SHUFFLE_SLOT_RAGGED_FACTOR)
+        else:
+            ragged = mode == "ragged"
+            min_savings = RAGGED_MODEL_MIN_SAVINGS
+        self._decide(
+            "exchange", site, mode, alternatives=costs,
+            override=ragged_set or staging_set, evidence=bool(ev),
+            predicted=costs.get(mode))
+        return ExchangePlan(mode, ragged, min_savings, staging_thr)
+
+    def note_exchange(self, site, *, rows: float, max_slice: float,
+                      useful_bytes: float) -> None:
+        """Launch-time evidence feed for an exchange-bearing site: the
+        measured useful rows, hottest-slice fraction, and useful
+        payload bytes — what the NEXT plan-time decision (and a warm
+        start's) reads."""
+        rows = float(rows)
+        self.observe_site(site, rows=rows,
+                          skew=round(float(max_slice) / max(rows, 1.0),
+                                     6),
+                          bytes=float(useful_bytes))
+
+    def observe_staged(self, site, staged_bytes: float) -> None:
+        """Staged-launch ledger outcome: the compressed bytes that
+        actually crossed host RAM, in the staged leg's cost units."""
+        self.observe_outcome("exchange", site,
+                             float(staged_bytes) * W_STAGED_BYTE)
+
+    def check_contradiction(self, site, op: str, *, counts, capacity,
+                            nshards: int, slot: int) -> None:
+        """Post-launch contradiction check: the launch ran the UNIFORM
+        slot; if the measured histogram shows a ragged plan would have
+        cut wire rows past the hysteresis band, record the
+        contradiction and (once per query, replanning armed) raise the
+        retryable :class:`ReplanRequested` — completed stages splice
+        from checkpoints, only this subtree re-plans, and the evidence
+        already folded makes the re-plan choose ragged."""
+        import numpy as np
+        from spark_rapids_tpu.config import rapids_conf as rc
+        if self.conf.is_set(rc.SHUFFLE_SLOT_RAGGED_ENABLED) and \
+                not self.conf.get(rc.SHUFFLE_SLOT_RAGGED_ENABLED):
+            return  # the user forced uniform: override wins
+        from spark_rapids_tpu.parallel.shuffle import plan_ragged
+        counts = np.asarray(counts)
+        if counts.ndim != 2 or not counts.size:
+            return
+        rp = plan_ragged(counts, capacity, RAGGED_MODEL_MIN_SAVINGS)
+        if rp is None:
+            return
+        uniform_rows = nshards * counts.shape[1] * max(int(slot), 1)
+        ratio = uniform_rows / max(rp.wire_rows(nshards), 1)
+        if ratio < self.hysteresis:
+            return
+        sid = site_id(site)
+        rec = self._decide(
+            "replan", site, "ragged",
+            alternatives={"uniform": float(uniform_rows),
+                          "ragged": float(rp.wire_rows(nshards))},
+            evidence=True, predicted=float(rp.wire_rows(nshards)))
+        rec["observed"] = float(uniform_rows)
+        rec["op"] = op
+        # "applied" separates a RECORDED contradiction (replanning off,
+        # or the one-per-query budget spent) from an actual re-drive
+        rec["applied"] = False
+        if not self.replan_conf:
+            return
+        from spark_rapids_tpu.serving import context as qc
+        ctx = qc.current()
+        if ctx is None or getattr(ctx, "_cm_replanned", False):
+            return  # at most ONE replan per query — never oscillate
+        ctx._cm_replanned = True
+        rec["applied"] = True
+        self.replan_count += 1
+        from spark_rapids_tpu.robustness.faults import ReplanRequested
+        raise ReplanRequested(f"{op}:{sid[:12]}", "uniform", "ragged",
+                              ratio)
+
+    # ------------------------------------------------- other knobs --
+    def slot_prior(self, site) -> int:
+        """Cold-site slot prior for the SlotPlanner: the persisted
+        rows x skew estimate of the site's max slice, so a fresh
+        process's first launch lands in the same power-of-two bucket
+        as the last one's (stable slot = stable jit key = zero-compile
+        warm start — warm plans, not just warm executables)."""
+        ev = self.evidence_for(site)
+        rows = float(ev.get("rows") or 0.0)
+        skew = float(ev.get("skew") or 0.0)
+        est = int(rows * skew)
+        if est > 0:
+            self._decide("slot", site, f"prior:{est}", evidence=True,
+                         predicted=float(est))
+        return est
+
+    def fusion_chain_limit(self) -> int:
+        """Fusion chain boundary: the conf default, halved when the
+        observed worst compile cost says long chains are compile-bound
+        (compile_ms evidence comes from the jit.trace spans the
+        tracing runtime persists per site)."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        default = self.conf.get(rc.FUSION_MAX_OPS)
+        if self.conf.is_set(rc.FUSION_MAX_OPS):
+            self._decide("fusion", None, str(default), override=True)
+            return default
+        import time as _time
+        worst, at = self._compile_worst
+        now = _time.monotonic()
+        if now - at > 5.0:
+            # refresh at most every 5s: compile_ms is max-merged and
+            # moves rarely, but the TRACING runtime writes it into the
+            # shared store behind our back, so a pure running max
+            # maintained here would miss its updates
+            worst = 0.0
+            for recs in (self._store_snapshot(), self.evidence):
+                for rec in recs.values():
+                    worst = max(worst,
+                                float(rec.get("compile_ms") or 0.0))
+            self._compile_worst = (worst, now)
+        limit = max(4, default // 2) if worst > COMPILE_HEAVY_MS \
+            else default
+        self._decide("fusion", None, str(limit),
+                     alternatives={"default": float(default),
+                                   "worstCompileMs": worst},
+                     evidence=worst > 0)
+        return limit
+
+    def encoded_execution(self) -> bool:
+        """Coded-vs-decoded execution: with the conf unset the model
+        enables encoded execution (built-in prior: the coded fused
+        stage beat the decoded path ~1.8x; the dictionary-overflow
+        latch and the planner's equality-faithfulness gates still
+        bound it per shape — wrong shapes run decoded regardless)."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        if self.conf.is_set(rc.ENCODING_EXECUTION_ENABLED):
+            v = self.conf.get(rc.ENCODING_EXECUTION_ENABLED)
+            self._decide("encoding", None,
+                         "encoded" if v else "decoded", override=True)
+            return v
+        self._decide("encoding", None, "encoded",
+                     alternatives={"encoded": 1.0,
+                                   "decoded": ENCODED_SPEEDUP_PRIOR},
+                     predicted=1.0)
+        return True
+
+    def wire_encoding(self) -> bool:
+        """Compressed device wire for dictionary-code columns: free
+        bytes to crush (the corrupt-delta fallback keeps it safe), so
+        the model enables it whenever the conf leaves it unset."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        if self.conf.is_set(rc.ENCODING_WIRE_ENABLED):
+            v = self.conf.get(rc.ENCODING_WIRE_ENABLED)
+            self._decide("wire", None, "encoded" if v else "wide",
+                         override=True)
+            return v
+        self._decide("wire", None, "encoded",
+                     alternatives={"encoded": 1.0, "wide": 2.0},
+                     predicted=1.0)
+        return True
+
+    def coalesce_goal_bytes(self, default: int) -> int:
+        """Coalesce goal: the conf default, capped to a fraction of
+        the device budget so planner-inserted coalesces never build a
+        batch the spill watermark immediately has to break up."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        if self.conf.is_set(rc.BATCH_SIZE_BYTES):
+            self._decide("coalesce", None, str(default), override=True)
+            return default
+        goal = min(int(default),
+                   max(self._device_budget() // COALESCE_BUDGET_DIVISOR,
+                       COALESCE_GOAL_FLOOR))
+        self._decide("coalesce", None, str(goal),
+                     alternatives={"confDefault": float(default),
+                                   "budgetCap": float(goal)})
+        return goal
+
+    # ----------------------------------------------- per-op weights --
+    def fold_op_metrics(self, metrics: Dict[str, Dict[str, int]]
+                        ) -> None:
+        """Fold a query's per-node metrics into readable ``op:<Name>``
+        evidence records (observed device us/row per operator kind) —
+        the evidence half of the CBO unification: the CPU-vs-TPU
+        region decision reads these over the calibration file."""
+        try:
+            for path, m in metrics.items():
+                name = _OP_NAMES.get(path.rsplit(".", 1)[-1])
+                if name is None:
+                    continue
+                rows = int(m.get("numOutputRows") or 0)
+                self_ns = int(m.get("opTimeSelf") or 0)
+                if rows <= 0 or self_ns <= 0:
+                    continue
+                # stored in NS/row: the store rounds every field to 3
+                # decimals, and a sub-microsecond-per-row operator
+                # stored in us/row would round to 0.0 — a "free" op
+                # that would poison every CBO region decision
+                self._observe_sid(
+                    f"op:{name}",
+                    tpu_ns_per_row=round(self_ns / rows, 3),
+                    rows=float(rows))
+        except Exception:
+            pass  # metric folding is an optimization, never a failure
+
+    def op_weights(self) -> Dict[str, float]:
+        """Observed per-op device weights (us/row) for the CBO — from
+        the live store plus the persisted load; empty entries fall
+        back to the calibration file / built-in table."""
+        out: Dict[str, float] = {}
+        for recs in (self.evidence, self._store_snapshot()):
+            for sid, rec in recs.items():
+                if sid.startswith("op:") and \
+                        float(rec.get("tpu_ns_per_row") or 0.0) > 0:
+                    out[sid[3:]] = float(rec["tpu_ns_per_row"]) / 1e3
+        return out
+
+
+def active_model(session=None) -> Optional[CostModel]:
+    """The active session's cost model, or None (the knobs-off fast
+    path — a consumption site pays one getattr + None check)."""
+    if session is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    if session is None:
+        return None
+    return getattr(session, "cost_model", None)
+
+
+def model_for_conf(conf) -> Optional[CostModel]:
+    """The active model, but ONLY when the calling conf itself arms
+    the cost model: knobs-off parity is per-CONF, not per-process —
+    planning one session's conf while a different (model-on) session
+    is ``TpuSession._active`` must neither consult the other
+    session's model nor leak decisions into its ledger."""
+    if conf is None:
+        return None
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if not conf.get(rc.COSTMODEL_ENABLED):
+        return None
+    return active_model()
+
+
+def consumer_staging_threshold(consumer) -> int:
+    """Effective host-staging threshold for a consumer wired through
+    :func:`resolve_consumer_exchange`: the model's budget-derived
+    value when it owns the knob (conf unset), else the conf helper's
+    semantics."""
+    if getattr(consumer, "_cost_model", None) is not None and \
+            consumer._staging_thr is not None:
+        return consumer._staging_thr
+    from spark_rapids_tpu.parallel.exchange_async import (
+        staging_threshold)
+    return staging_threshold()
+
+
+# sentinel: "resolve the active session's model" — the default for
+# consumers constructed directly (kernel tests, the dryrun); the
+# distributed planner passes ITS session's model (or None) explicitly
+# so a concurrent session flipping TpuSession._active mid-construction
+# can never leak its model into another session's plan
+AUTO_MODEL = "auto"
+
+
+def resolve_consumer_exchange(consumer, op: str,
+                              model=AUTO_MODEL) -> None:
+    """Shared consumer-side hookup for the exchange-bearing operators
+    (DistributedAggregate / DistributedHashJoin): stamp the consumer
+    with the model's plan-time exchange decision — or the inert None
+    attributes when no model is active — so the two classes cannot
+    diverge."""
+    cm = active_model() if isinstance(model, str) and \
+        model == AUTO_MODEL else model
+    consumer._cost_model = cm
+    consumer._planned_mode = None
+    consumer._staging_thr = None
+    if cm is not None:
+        xp = cm.resolve_exchange(consumer._sig, consumer.nshards,
+                                 op=op,
+                                 strategy=consumer.exchange_strategy)
+        consumer.ragged = xp.ragged
+        consumer.ragged_min_savings = xp.min_savings
+        consumer._planned_mode = xp.mode
+        consumer._staging_thr = xp.staging_thr

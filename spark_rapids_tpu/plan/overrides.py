@@ -534,8 +534,15 @@ def _conv_file(node: L.FileRelation, children, conf):
         from spark_rapids_tpu.config import rapids_conf as _rc
         from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
         from spark_rapids_tpu.memory.coalesce import TargetSize
-        return TpuCoalesceBatchesExec(
-            scan, TargetSize(conf.get(_rc.BATCH_SIZE_BYTES)))
+        from spark_rapids_tpu.plan.costmodel import model_for_conf
+        goal = conf.get(_rc.BATCH_SIZE_BYTES)
+        cm = model_for_conf(conf)
+        if cm is not None:
+            # self-tuning planner: the coalesce goal caps at a
+            # fraction of the device budget unless batchSizeBytes was
+            # explicitly tuned (the override discipline)
+            goal = cm.coalesce_goal_bytes(goal)
+        return TpuCoalesceBatchesExec(scan, TargetSize(goal))
     return scan
 
 
@@ -559,9 +566,16 @@ def _encoding_exec_enabled(conf) -> bool:
     """Encoded execution conf, minus the session's overflow latch (a
     dictionary that outgrew maxDictSize latched the session back onto
     the decoded path; every attempt re-plans, so the latch takes
-    effect on the ladder's next rung)."""
+    effect on the ladder's next rung).  With the self-tuning cost
+    model active the model decides the coded-vs-decoded knob when the
+    conf leaves it unset (an explicit conf stays an override)."""
     from spark_rapids_tpu.config import rapids_conf as rc
-    if not conf.get(rc.ENCODING_EXECUTION_ENABLED):
+    from spark_rapids_tpu.plan.costmodel import model_for_conf
+    cm = model_for_conf(conf)  # conf-gated: knobs-off conf = HEAD
+    if cm is not None:
+        if not cm.encoded_execution():
+            return False
+    elif not conf.get(rc.ENCODING_EXECUTION_ENABLED):
         return False
     from spark_rapids_tpu.api.session import TpuSession
     return not getattr(TpuSession._active, "encoding_exec_latched",
@@ -953,6 +967,15 @@ class TpuOverrides:
                         m.pop(k, None)
         self._fusion_by_ident[ident] = self._fresh_fusion()
         self._chain_nodes_by_ident[ident] = set()
+        from spark_rapids_tpu.plan.costmodel import model_for_conf
+        cm = model_for_conf(self.conf)  # conf-gated: see costmodel.py
+        if cm is not None:
+            # self-tuning planner: fusion chain boundaries come from
+            # the one cost model (compile-cost evidence halves the
+            # bound; an explicit maxChainOps conf stays an override) —
+            # re-resolved per apply so the decision lands in the
+            # CURRENT query's ledger
+            self.fusion_max_ops = cm.fusion_chain_limit()
         from spark_rapids_tpu.config import rapids_conf as rc
         self.last_cbo = []
         if self.conf.get(rc.CBO_ENABLED):

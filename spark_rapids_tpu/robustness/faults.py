@@ -201,6 +201,36 @@ class EncodingOverflowFault(Exception):
         self.limit = limit
 
 
+class ReplanRequested(Exception):
+    """The cost model (plan/costmodel.py) observed launch statistics
+    that contradict its plan-time decision past the hysteresis band —
+    e.g. the measured per-destination histogram says a ragged exchange
+    would beat the uniform slot the plan chose by >= hysteresis x.
+    The fresh evidence was folded into the observation store BEFORE
+    raising, so the re-planned attempt decides the measured-optimal
+    strategy.  RETRYABLE, not degradable: the ladder's retry rung
+    keeps the mesh layout, completed stages splice from the
+    stage-checkpoint lineage, and only the contradicted subtree
+    re-plans — a non-failure entry point into the recovery re-drive.
+    The model arms at most ONE replan per query, so a borderline
+    workload cannot oscillate."""
+
+    kind = "replan"
+    severity = RETRYABLE
+
+    def __init__(self, site: str, planned: str, better: str,
+                 ratio: float):
+        super().__init__(
+            f"cost-model replan requested at {site}: measured stats "
+            f"say {better!r} beats the planned {planned!r} by "
+            f"{ratio:.1f}x (>= hysteresis); re-driving with fresh "
+            "evidence")
+        self.site = site
+        self.planned = planned
+        self.better = better
+        self.ratio = ratio
+
+
 class AdmissionFault(Exception):
     """The serving layer rejected this query at (or after) admission:
     the fair admission queue timed out / overflowed, or the query blew
@@ -273,6 +303,8 @@ def classify(exc: BaseException) -> Fault:
     if isinstance(exc, ShuffleSlotOverflow):
         return Fault(exc.kind, exc.severity)
     if isinstance(exc, EncodingOverflowFault):
+        return Fault(exc.kind, exc.severity)
+    if isinstance(exc, ReplanRequested):
         return Fault(exc.kind, exc.severity)
     from spark_rapids_tpu.memory.retry import SplitAndRetryOOM, is_oom
     if isinstance(exc, SplitAndRetryOOM):

@@ -10,6 +10,15 @@ its pandas equivalent (the CPU-fallback platform the optimizer would
 revert to), recording microseconds per row for both sides.
 
 Usage: ``spark-rapids-tpu-cbo-calibrate [out.json] [--rows N]``
+
+``--from-observations DIR`` refreshes the weights from a site-history
+directory instead of running the micro-benchmarks: the cost model's
+``op:<Name>`` evidence records (observed device us/row, folded from
+real queries' per-node metrics at QueryEnd) become the ``tpu`` weights,
+while ``cpu`` weights carry over from the existing calibration file
+(or the built-in ratio table).  Real-workload evidence beats a
+micro-benchmark: the observed rates include the batch sizes, fusion
+and encoding the production plans actually run with.
 """
 
 from __future__ import annotations
@@ -117,15 +126,60 @@ def calibrate(n: int = 1 << 20) -> Dict[str, Dict[str, float]]:
     }
 
 
+def from_observations(obs_dir: str) -> Dict:
+    """Weights blob from a site-history directory's ``op:<Name>``
+    evidence records (see module docstring)."""
+    from spark_rapids_tpu.utils.tracing import ObservationStore
+    records = ObservationStore.read(obs_dir)
+    # ns/row in the store (us/row would round to 0.0 for fast ops);
+    # zero/absent weights never become calibration entries
+    observed = {sid[3:]: rec for sid, rec in records.items()
+                if sid.startswith("op:")
+                and float(rec.get("tpu_ns_per_row") or 0.0) > 0}
+    if not observed:
+        raise SystemExit(
+            f"no op:<Name> observation records under {obs_dir!r}; run "
+            "queries with spark.rapids.tpu.costModel.enabled (and an "
+            "event log) first")
+    # cpu weights carry over from the existing calibration (or the
+    # built-in ratio table scaled into the same us/row domain)
+    from spark_rapids_tpu.plan import cbo
+    _, cpu_w = cbo.load_weights()
+    out = {}
+    for name, rec in observed.items():
+        out[name] = {
+            "tpu": round(float(rec["tpu_ns_per_row"]) / 1e3, 6),
+            "cpu": round(float(cpu_w.get(name, cpu_w["default"])), 6),
+        }
+        print(f"{name:10s} device {out[name]['tpu']:9.4f} us/row "
+              f"(observed, n={int(rec.get('n', 0))})   "
+              f"cpu {out[name]['cpu']:9.4f} us/row (carried)",
+              file=sys.stderr)
+    import jax
+    return {
+        "provenance": {
+            "platform": jax.devices()[0].platform,
+            "source": "observations",
+            "obs_dir": os.path.abspath(obs_dir),
+        },
+        "weights": out,
+    }
+
+
 def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     rows = 1 << 20
+    obs_dir = None
+    if "--from-observations" in args:
+        i = args.index("--from-observations")
+        obs_dir = args[i + 1]
+        del args[i:i + 2]
     if "--rows" in args:
         i = args.index("--rows")
         rows = int(args[i + 1])
         del args[i:i + 2]
     out_path = args[0] if args else DEFAULT_OUT
-    result = calibrate(rows)
+    result = from_observations(obs_dir) if obs_dir else calibrate(rows)
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(result, f, indent=1, sort_keys=True)
         f.write("\n")

@@ -89,6 +89,14 @@ class QueryInfo:
     # result-cache / shared-stage-store events attributed to this
     # query (kind is hit|store|invalid|evict|write|splice)
     sharing_events: List[Dict[str, str]] = field(default_factory=list)
+    # self-tuning cost model (QueryEnd planner dict,
+    # plan/costmodel.py: decisions ledger [knob, site, chosen,
+    # alternatives, predicted, observed], replans, mispredicts,
+    # invalidLoads); ABSENT when costModel.enabled is off
+    planner: Dict[str, object] = field(default_factory=dict)
+    # CostModelInvalid events (corrupt evidence load / ledger write
+    # fault — the model degraded to built-in defaults)
+    costmodel: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def succeeded(self) -> bool:
@@ -137,6 +145,9 @@ class AppInfo:
     # lands after its query's envelope closed, invalidations fire
     # during another query's lookup)
     sharing_events: List[Dict[str, str]] = field(default_factory=list)
+    # un-attributed CostModelInvalid events (a load at session
+    # construction runs before any query envelope)
+    costmodel: List[Dict[str, str]] = field(default_factory=list)
 
     def max_concurrent(self) -> int:
         """Peak number of simultaneously-open query envelopes — the
@@ -276,6 +287,11 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.sharing_events if q is not None
                  else app.sharing_events).append(info)
+            elif ev == "CostModelInvalid":
+                info = {k: rec[k] for k in ("reason",) if k in rec}
+                q = all_queries.get(rec.get("queryId"))
+                (q.costmodel if q is not None
+                 else app.costmodel).append(info)
             elif ev == "JitCacheInvalid":
                 info = {k: rec[k] for k in ("reason", "entry")
                         if k in rec}
@@ -309,6 +325,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.fusion = rec.get("fusion", {})
                 q.spans = rec.get("spans", {}) or {}
                 q.sharing = rec.get("sharing", {}) or {}
+                q.planner = rec.get("planner", {}) or {}
                 q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed

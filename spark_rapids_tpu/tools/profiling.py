@@ -308,6 +308,54 @@ def sharing_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def planner_stats(apps: List[AppInfo]) -> Dict[str, object]:
+    """Self-tuning cost-model effectiveness across queries
+    (plan/costmodel.py QueryEnd ``planner`` dicts): decisions per
+    knob, how many were evidence-fed vs built-in vs conf-overridden,
+    plus the replan/mispredict/degraded-load tallies the health
+    checks key on.  Empty when no query carried a planner dict
+    (costModel.enabled off)."""
+    queries = decisions = evidence = overrides = 0
+    replans = mispredicts = 0
+    invalid = 0
+    by_knob: Dict[str, int] = {}
+    chosen: Dict[str, int] = {}
+    for a in apps:
+        invalid += len(a.costmodel)
+        for q in a.queries:
+            invalid += len(q.costmodel)
+            p = q.planner
+            if not p:
+                continue
+            queries += 1
+            replans += int(p.get("replans", 0))
+            mispredicts += int(p.get("mispredicts", 0))
+            for d in p.get("decisions", []):
+                decisions += 1
+                by_knob[d.get("knob", "?")] = \
+                    by_knob.get(d.get("knob", "?"), 0) + 1
+                if d.get("knob") == "exchange":
+                    chosen[d.get("chosen", "?")] = \
+                        chosen.get(d.get("chosen", "?"), 0) + 1
+                if d.get("evidence"):
+                    evidence += 1
+                if d.get("override"):
+                    overrides += 1
+    if not queries and not invalid:
+        return {}
+    return {
+        "queries": queries,
+        "decisions": decisions,
+        "evidence_decisions": evidence,
+        "override_decisions": overrides,
+        "by_knob": dict(sorted(by_knob.items())),
+        "exchange_modes": dict(sorted(chosen.items())),
+        "replans": replans,
+        "mispredicts": mispredicts,
+        "invalid_loads": invalid,
+    }
+
+
 def fusion_stats(apps: List[AppInfo]) -> Dict[str, float]:
     """Whole-stage fusion + persistent jit-cache effectiveness across
     queries (exec/fusion.py, ops/jit_cache.py): stages/operators fused,
@@ -562,6 +610,34 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     "dispatch + device materialization per operator per "
                     "batch; check spark.rapids.tpu.fusion.enabled (or "
                     "an unfusible chain member forced the fallback)")
+            pl = q.planner
+            if pl and pl.get("mispredicts", 0):
+                # the SAME factor finish_query counted with — a tuned
+                # threshold must not desynchronize the report
+                from spark_rapids_tpu.plan.costmodel import \
+                    MISPREDICT_FACTOR
+                bad = [d for d in pl.get("decisions", [])
+                       if d.get("observed") is not None
+                       and d.get("predicted")
+                       and d["observed"] >=
+                       MISPREDICT_FACTOR * d["predicted"]]
+                knobs = sorted({d.get("knob", "?") for d in bad}) or \
+                    ["?"]
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: cost model "
+                    f"MISPREDICTED {pl['mispredicts']} decision(s) "
+                    f"({', '.join(knobs)}) — observed cost >= 4x the "
+                    "prediction; the evidence folds back, but repeated "
+                    "mispredicts on the same site mean the workload "
+                    "shifts faster than the EMA converges "
+                    "(docs/performance.md \"Self-tuning planner\")")
+            for cmev in q.costmodel:
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: cost-model "
+                    "evidence degraded to built-in defaults "
+                    f"({cmev.get('reason', '?')}) — decisions still "
+                    "made, never a failed query; check the "
+                    "costModel.dir store's health")
             if q.jitcache:
                 reasons = sorted({j.get("reason", "?").split(":")[0]
                                   for j in q.jitcache})
@@ -690,6 +766,11 @@ def health_check(apps: List[AppInfo]) -> List[str]:
             problems.append(
                 f"{a.session_id}: persistent jit-cache entry dropped "
                 f"without query attribution ({j.get('reason', '?')})")
+        for cmev in a.costmodel:
+            problems.append(
+                f"{a.session_id}: cost-model evidence degraded to "
+                f"built-in defaults ({cmev.get('reason', '?')}) — "
+                "decisions still made, never a failed query")
         for r in a.rejections:
             problems.append(
                 f"{a.session_id}: query rejected at admission "
@@ -1156,6 +1237,23 @@ def format_report(apps: List[AppInfo], top: int) -> str:
                 f"  interleaver: queries={sh['interleaved_queries']} "
                 f"timeslices={sh['timeslices']:.0f} "
                 f"wait={sh['interleave_wait_ms']:.1f}ms")
+    pdec = planner_stats(apps)
+    if pdec:
+        out.append("\n-- Planner decisions (cost model) --")
+        out.append(
+            f"  queries={pdec['queries']} "
+            f"decisions={pdec['decisions']} "
+            f"evidence={pdec['evidence_decisions']} "
+            f"overrides={pdec['override_decisions']} "
+            f"replans={pdec['replans']} "
+            f"mispredicts={pdec['mispredicts']} "
+            f"degradedLoads={pdec['invalid_loads']}")
+        if pdec["by_knob"]:
+            out.append("  knobs: " + "  ".join(
+                f"{k}={v}" for k, v in pdec["by_knob"].items()))
+        if pdec["exchange_modes"]:
+            out.append("  exchange modes: " + "  ".join(
+                f"{k}={v}" for k, v in pdec["exchange_modes"].items()))
     ic = incremental_stats(apps)
     if ic:
         out.append("\n-- Continuous ingest --")

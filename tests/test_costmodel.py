@@ -1,0 +1,529 @@
+"""Self-tuning cost-based planner suite (plan/costmodel.py).
+
+Covers the ISSUE 15 acceptance gates: knobs-off HEAD parity (no model,
+no decision events, byte-identical plans), evidence-driven convergence
+— a deliberately skewed workload converges to RAGGED plans and an
+oversized shuffle to HOST-STAGED plans within 2 executions, pinned by
+reading the decision ledger — conf overrides beating the model,
+mid-query replan splicing checkpoints (counter-pinned: exactly one
+extra exchange launch, zero source re-pulls), the mispredict health
+check, corrupt-evidence degradation (costmodel.load), warm-start warm
+plans from the persisted store, and the CBO/observation unification.
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.plan import costmodel as CM
+from spark_rapids_tpu.robustness import inject as I
+
+NSHARDS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    if jax.device_count() < NSHARDS:
+        pytest.skip("needs the virtual 8-device mesh")
+    return make_mesh(NSHARDS)
+
+
+@pytest.fixture(scope="module")
+def skew_parquet(tmp_path_factory):
+    """8 balanced fact files (the scan shards evenly — stage 1 stays
+    uniform) whose join key ``j`` is constant: every probe row hashes
+    to ONE destination, the deliberately skewed exchange shape."""
+    d = tmp_path_factory.mktemp("cm_fact")
+    n = 512
+    rng = np.random.default_rng(3)
+    fact = pd.DataFrame({"a": np.arange(n, dtype=np.int64),
+                         "j": np.zeros(n, dtype=np.int64),
+                         "x": rng.uniform(size=n)})
+    paths = []
+    for i in range(NSHARDS):
+        p = str(d / f"fact-{i}.parquet")
+        fact.iloc[i * n // NSHARDS:(i + 1) * n // NSHARDS].to_parquet(
+            p, index=False)
+        paths.append(p)
+    return paths
+
+
+_DIM = pd.DataFrame({"j": np.arange(16, dtype=np.int64),
+                     "w": np.arange(16) * 1.5})
+
+
+def _join_query(s, paths):
+    """agg(uniform keys) <- scan, joined on the SKEWED key: stage 1
+    (the aggregate) exchanges balanced, stage 2 (the join) exchanges
+    everything to one destination."""
+    f = s.read.parquet(*paths)
+    dim = s.create_dataframe(_DIM)
+    agg = f.groupBy("a").agg(F.max("j").alias("j"),
+                             F.sum("x").alias("sx"))
+    return agg.join(dim, "j")
+
+
+def _oracle(paths):
+    frames = pd.concat([pd.read_parquet(p) for p in paths])
+    agg = frames.groupby("a", as_index=False).agg(
+        j=("j", "max"), sx=("x", "sum"))
+    return agg.merge(_DIM, on="j")
+
+
+def _norm(df, cols):
+    return df.sort_values(cols).reset_index(drop=True)
+
+
+def _exchange_decisions(session):
+    return [d for d in (session.last_planner_stats or
+                        {}).get("decisions", [])
+            if d["knob"] == "exchange"]
+
+
+def _count_rule(point):
+    return I.inject(point, count=1, skip=1_000_000, all_threads=True)
+
+
+def _hits(rule):
+    return 1_000_000 - rule.skip
+
+
+# ------------------------------------------------------- knobs-off parity --
+def test_knobs_off_parity(tmp_path):
+    """costModel.enabled=false is bit-identical HEAD: no model object,
+    no planner stats, no planner field or CostModelInvalid in the raw
+    event stream, and the physical plan equals a plain session's."""
+    evd = tmp_path / "ev"
+    pdf = pd.DataFrame({"k": np.arange(64) % 5, "x": np.arange(64.0)})
+
+    def q(s):
+        df = s.create_dataframe(pdf)
+        return df.filter(F.col("x") > 3).groupBy("k").agg(
+            F.sum("x").alias("s"))
+
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": False,
+                    "spark.rapids.tpu.eventLog.dir": str(evd)})
+    assert s.cost_model is None
+    plan_off = s.plan(q(s).plan).tree_string()
+    q(s).to_pandas()
+    assert s.last_planner_stats is None
+    s.stop()
+    plain = TpuSession()
+    assert plain.plan(q(plain).plan).tree_string() == plan_off
+    plain.stop()
+    raw = ""
+    for p in evd.glob("tpu-events-*.jsonl"):
+        raw += p.read_text()
+    assert '"planner"' not in raw
+    assert "CostModelInvalid" not in raw
+
+
+def test_no_cross_session_model_leak():
+    """Knobs-off parity is per-CONF: a knobs-off session planning
+    while a model-on session is TpuSession._active must neither
+    consult the other session's model (its plans would diverge from
+    HEAD) nor leak decisions into its ledger."""
+    from spark_rapids_tpu.parallel.shuffle import wire_encoding_enabled
+    from spark_rapids_tpu.plan.overrides import _encoding_exec_enabled
+    off = TpuSession()
+    on = TpuSession({"spark.rapids.tpu.costModel.enabled": True})
+    assert TpuSession._active is on
+    # planning with the OFF session's conf keeps the HEAD defaults
+    assert not _encoding_exec_enabled(off.conf)
+    assert not wire_encoding_enabled(off.conf)
+    assert CM.model_for_conf(off.conf) is None
+    # nothing leaked into the model-on session's ledger
+    assert not any(on.cost_model._ledger.values())
+    # the model-on conf still resolves its own model
+    assert CM.model_for_conf(on.conf) is on.cost_model
+    on.stop()
+    off.stop()
+
+
+def test_decision_ledger_covers_plan_knobs(tmp_path):
+    """A model-on single-process query records the plan-time knob
+    decisions (fusion chain bound, coded-vs-decoded execution) in its
+    ledger, with conf-set knobs marked as overrides."""
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": str(tmp_path)})
+    pdf = pd.DataFrame({"k": np.arange(64) % 5, "x": np.arange(64.0)})
+    s.create_dataframe(pdf).filter(F.col("x") > 3).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    decs = (s.last_planner_stats or {}).get("decisions", [])
+    knobs = {d["knob"] for d in decs}
+    assert {"fusion", "encoding"} <= knobs, decs
+    assert not any(d["override"] for d in decs
+                   if d["knob"] in ("fusion", "encoding"))
+    s.stop()
+    s2 = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                     "spark.rapids.tpu.fusion.maxChainOps": 8,
+                     "spark.rapids.tpu.encoding.execution.enabled":
+                         False})
+    s2.create_dataframe(pdf).filter(F.col("x") > 3).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    decs = (s2.last_planner_stats or {}).get("decisions", [])
+    by_knob = {d["knob"]: d for d in decs}
+    assert by_knob["fusion"]["override"] and \
+        by_knob["fusion"]["chosen"] == "8"
+    assert by_knob["encoding"]["override"] and \
+        by_knob["encoding"]["chosen"] == "decoded"
+    s2.stop()
+
+
+# ------------------------------------------------------------ convergence --
+def test_skew_converges_to_ragged_within_2(mesh, skew_parquet):
+    """Execution 1 (cold, no evidence) plans uniform; the launch folds
+    the measured skew into the store; execution 2's plan-time decision
+    is RAGGED — pinned via the decision ledger — and the launch really
+    runs the ragged wire (raggedExchanges >= 1), bit-equal results."""
+    s = TpuSession({
+        "spark.rapids.tpu.costModel.enabled": True,
+        "spark.rapids.tpu.costModel.replan.enabled": False,
+        "spark.rapids.sql.join.broadcastThresholdRows": 4,
+    }, mesh=mesh)
+    q = _join_query(s, skew_parquet)
+    want = _oracle(skew_parquet)
+    r1 = q.to_pandas()
+    assert s.last_dist_explain == "distributed"
+    ex1 = _exchange_decisions(s)
+    assert ex1 and all(d["chosen"] == "uniform" for d in ex1), ex1
+    # the contradiction was RECORDED (replanning off => not applied)
+    p1 = s.last_planner_stats
+    assert p1["replans"] == 0
+    r2 = q.to_pandas()
+    ex2 = _exchange_decisions(s)
+    ragged = [d for d in ex2 if d["chosen"] == "ragged"]
+    assert ragged and all(d["evidence"] for d in ragged), ex2
+    sh = s.last_shuffle_stats or {}
+    assert sh.get("raggedExchanges", 0) >= 1, sh
+    cols = list(want.columns)
+    pd.testing.assert_frame_equal(_norm(r1[cols], ["a"]),
+                                  _norm(want, ["a"]))
+    pd.testing.assert_frame_equal(_norm(r2[cols], ["a"]),
+                                  _norm(want, ["a"]))
+    s.stop()
+
+
+def test_oversized_converges_to_staged_within_2(mesh):
+    """A shuffle payload far past the (tiny) device budget: the model's
+    budget-derived threshold stages it on first contact, and by
+    execution 2 the PLAN-time decision reads 'staged' from the bytes
+    evidence — pinned via the ledger."""
+    s = TpuSession({
+        "spark.rapids.tpu.costModel.enabled": True,
+        "spark.rapids.memory.tpu.deviceLimitBytes": 200_000,
+    }, mesh=mesh)
+    n = 1 << 15
+    pdf = pd.DataFrame({
+        "a": np.arange(n, dtype=np.int64),
+        "x": np.random.default_rng(0).uniform(size=n)})
+    q = s.create_dataframe(pdf).groupBy("a").agg(F.sum("x").alias("s"))
+    r1 = q.to_pandas()
+    assert s.last_dist_explain == "distributed"
+    ex1 = _exchange_decisions(s)
+    assert ex1 and ex1[0]["chosen"] == "uniform"  # cold prior
+    r2 = q.to_pandas()
+    ex2 = _exchange_decisions(s)
+    assert ex2 and ex2[0]["chosen"] == "staged" and \
+        ex2[0]["evidence"], ex2
+    assert len(r1) == n and len(r2) == n
+    assert abs(float(r1["s"].sum()) - float(pdf["x"].sum())) < 1e-6
+    s.stop()
+
+
+def test_conf_override_beats_model(mesh, skew_parquet):
+    """Explicitly-set confs stay overrides: ragged forced OFF and a
+    huge explicit staging threshold keep every launch uniform despite
+    skew evidence — decisions marked override, zero replans, exact
+    results."""
+    s = TpuSession({
+        "spark.rapids.tpu.costModel.enabled": True,
+        "spark.rapids.tpu.shuffle.slot.ragged.enabled": False,
+        "spark.rapids.tpu.exchange.hostStaging.thresholdBytes":
+            1 << 40,
+        "spark.rapids.sql.join.broadcastThresholdRows": 4,
+    }, mesh=mesh)
+    q = _join_query(s, skew_parquet)
+    want = _oracle(skew_parquet)
+    q.to_pandas()
+    r2 = q.to_pandas()  # evidence exists now — override must still win
+    ex = _exchange_decisions(s)
+    assert ex and all(d["chosen"] == "uniform" and d["override"]
+                      for d in ex), ex
+    assert s.cost_model.replan_count == 0
+    sh = s.last_shuffle_stats or {}
+    assert sh.get("raggedExchanges", 0) == 0
+    cols = list(want.columns)
+    pd.testing.assert_frame_equal(_norm(r2[cols], ["a"]),
+                                  _norm(want, ["a"]))
+    s.stop()
+
+
+# --------------------------------------------------------- mid-query replan --
+@pytest.mark.chaos
+def test_replan_splices_checkpoints(mesh, skew_parquet):
+    """The mid-query adaptive re-plan: the join launch's measured
+    histogram contradicts the cold uniform plan -> ReplanRequested ->
+    the ladder's retry rung re-drives with resume — the completed
+    aggregate stage SPLICES from its checkpoint (zero source re-pulls)
+    and only the join re-plans (exactly ONE extra exchange launch),
+    with the re-plan choosing ragged from the just-folded evidence."""
+    conf = {"spark.rapids.sql.join.broadcastThresholdRows": 4}
+    clean = TpuSession(dict(conf), mesh=mesh)
+    launches = _count_rule("shuffle.exchange")
+    reads = _count_rule("io.read")
+    want = _join_query(clean, skew_parquet).to_pandas()
+    clean_launches, clean_reads = _hits(launches), _hits(reads)
+    I.remove(launches)
+    I.remove(reads)
+    clean.stop()
+    assert clean_launches >= 2 and clean_reads > 0
+
+    s = TpuSession(dict(conf, **{
+        "spark.rapids.tpu.costModel.enabled": True}), mesh=mesh)
+    launches = _count_rule("shuffle.exchange")
+    reads = _count_rule("io.read")
+    got = _join_query(s, skew_parquet).to_pandas()
+    model_launches, model_reads = _hits(launches), _hits(reads)
+    I.remove(launches)
+    I.remove(reads)
+    assert s.cost_model.replan_count == 1
+    assert [r["fault"] for r in s.recovery_log] == ["replan"]
+    assert s.last_dist_explain == "distributed"
+    # counter pins: ONE extra exchange launch (the contradicted join
+    # re-ran), ZERO source re-pulls (the aggregate stage spliced)
+    assert model_launches == clean_launches + 1
+    assert model_reads == clean_reads
+    cols = list(want.columns)
+    pd.testing.assert_frame_equal(_norm(got[cols], ["a"]),
+                                  _norm(_norm(want, ["a"])[cols],
+                                        ["a"]))
+    # the re-driven attempt planned RAGGED from the folded evidence
+    ragged = [d for d in _exchange_decisions(s)
+              if d["chosen"] == "ragged"]
+    assert ragged and all(d["evidence"] for d in ragged)
+    s.stop()
+
+
+def test_replan_once_per_query(mesh, skew_parquet):
+    """The one-replan budget: a second contradiction in the same query
+    records without re-driving (the ledger's applied flag), so a
+    borderline workload can never oscillate."""
+    s = TpuSession({
+        "spark.rapids.tpu.costModel.enabled": True,
+        "spark.rapids.sql.join.broadcastThresholdRows": 4,
+    }, mesh=mesh)
+    from spark_rapids_tpu.robustness.faults import ReplanRequested
+    from spark_rapids_tpu.serving.context import QueryContext
+    cm = s.cost_model
+    counts = np.zeros((NSHARDS, NSHARDS), dtype=np.int64)
+    counts[:, 0] = 512  # everything to one destination
+    with QueryContext(s):
+        with pytest.raises(ReplanRequested):
+            cm.check_contradiction(("site",), "join", counts=counts,
+                                   capacity=4096, nshards=NSHARDS,
+                                   slot=512)
+        # same query scope: budget spent, records but never raises
+        cm.check_contradiction(("site",), "join", counts=counts,
+                               capacity=4096, nshards=NSHARDS,
+                               slot=512)
+    assert cm.replan_count == 1
+    s.stop()
+
+
+# ------------------------------------------------------- degraded evidence --
+@pytest.mark.chaos
+def test_corrupt_evidence_degrades_to_defaults(tmp_path):
+    """A corrupt/truncated observation file degrades the model to
+    built-in defaults with a CostModelInvalid event — the query still
+    answers, bit-equal to a knobs-off session.  (A deterministic torn
+    line; the chaos spray additionally bit-flips the raw bytes through
+    the costmodel.load fire_mutate point.)"""
+    d = tmp_path / "store"
+    d.mkdir()
+    (d / "observations.jsonl").write_text(
+        '{"site": "cm:abc", "rows": 100, "skew": 0.5}\n'
+        '{"site": "cm:def", "ro')  # truncated mid-record
+    evd = tmp_path / "ev"
+    pdf = pd.DataFrame({"k": np.arange(64) % 5, "x": np.arange(64.0)})
+    off = TpuSession()
+    want = off.create_dataframe(pdf).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    off.stop()
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": str(d),
+                    "spark.rapids.tpu.eventLog.dir": str(evd)})
+    assert s.cost_model.invalid_loads >= 1
+    assert s.cost_model.evidence == {}  # built-in defaults
+    got = s.create_dataframe(pdf).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    pd.testing.assert_frame_equal(_norm(got, ["k"]), _norm(want, ["k"]))
+    s.stop()
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    apps = load_logs(str(evd))
+    inv = sum(len(a.costmodel) +
+              sum(len(q.costmodel) for q in a.queries) for a in apps)
+    assert inv >= 1
+    from spark_rapids_tpu.tools.profiling import health_check
+    assert any("cost-model evidence degraded" in p
+               for p in health_check(apps))
+
+
+@pytest.mark.chaos
+def test_ledger_write_fault_degrades(tmp_path):
+    """A raise rule on the QueryEnd persistence path (the
+    decision-ledger write) degrades with CostModelInvalid — never a
+    failed query."""
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": str(tmp_path)})
+    before = s.cost_model.invalid_loads
+    pdf = pd.DataFrame({"k": np.arange(32) % 3, "x": np.arange(32.0)})
+    I.inject("costmodel.load", count=1, all_threads=True)
+    got = s.create_dataframe(pdf).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    assert len(got) == 3
+    assert s.cost_model.invalid_loads == before + 1
+    s.stop()
+
+
+# --------------------------------------------------- warm starts, warm plans --
+def test_evidence_persists_warm_plans(tmp_path):
+    """A fresh process (session) reads the prior one's evidence: the
+    plan-time decision is RAGGED before any launch, and the slot prior
+    reproduces the observed max slice (same power-of-two bucket = same
+    jit key, zero recompile)."""
+    d = str(tmp_path / "store")
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": d})
+    site = ("exchange", "site", 1)
+    s.cost_model.note_exchange(site, rows=4096, max_slice=512,
+                               useful_bytes=1 << 20)
+    s.cost_model.finish_query()  # flushes the store
+    s.stop()
+    s2 = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                     "spark.rapids.tpu.costModel.dir": d})
+    cm2 = s2.cost_model
+    ev = cm2.evidence_for(site)
+    assert ev.get("rows") == 4096 and ev.get("skew") == 0.125
+    xp = cm2.resolve_exchange(site, NSHARDS)
+    assert xp.mode == "ragged" and xp.ragged
+    assert cm2.slot_prior(site) == 512
+    s2.stop()
+
+
+# ------------------------------------------------------ mispredict health --
+def test_mispredict_health_check(tmp_path):
+    """The planner-decision health check fires on a synthetic bad
+    prediction (observed >= 4x predicted) and stays quiet on a good
+    one."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import health_check
+
+    def log(name, planner):
+        lines = [
+            {"event": "SessionStart", "sessionId": name, "ts": 1.0},
+            {"event": "QueryStart", "queryId": 1, "ts": 2.0,
+             "logicalPlan": "Aggregate", "physicalPlan": "x"},
+            {"event": "QueryEnd", "queryId": 1, "ts": 3.0,
+             "status": "success", "durationMs": 5.0,
+             "planner": planner},
+        ]
+        p = tmp_path / f"tpu-events-{name}.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+        return str(p)
+
+    bad = log("bad", {
+        "decisions": [{"knob": "exchange", "site": "s", "chosen":
+                       "uniform", "predicted": 100.0,
+                       "observed": 1000.0}],
+        "replans": 0, "mispredicts": 1, "invalidLoads": 0})
+    good = log("good", {
+        "decisions": [{"knob": "exchange", "site": "s", "chosen":
+                       "ragged", "predicted": 100.0,
+                       "observed": 120.0}],
+        "replans": 0, "mispredicts": 0, "invalidLoads": 0})
+    bad_problems = health_check(load_logs(bad))
+    assert any("MISPREDICTED" in p for p in bad_problems), bad_problems
+    good_problems = health_check(load_logs(good))
+    assert not any("MISPREDICTED" in p for p in good_problems)
+    from spark_rapids_tpu.tools.profiling import planner_stats
+    stats = planner_stats(load_logs(bad) + load_logs(good))
+    assert stats["queries"] == 2 and stats["mispredicts"] == 1
+
+
+# --------------------------------------------------------- CBO unification --
+def test_cbo_consults_observations(tmp_path):
+    """The CPU-vs-TPU region decision reads observed per-op weights
+    over the calibration file (conf keys still win), and
+    cbo_calibrate --from-observations refreshes the weights blob from
+    a site-history dir."""
+    d = str(tmp_path / "store")
+    evd = tmp_path / "ev"
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": d,
+                    "spark.rapids.tpu.eventLog.dir": str(evd)})
+    # e2e: a logged query folds op:<Name> evidence from its metrics
+    # (an aggregate — Filter/Project chains fuse into FusedStageExec,
+    # which maps to no single CBO operator kind and is skipped)
+    pdf = pd.DataFrame({"k": np.arange(256) % 7,
+                        "x": np.arange(256.0)})
+    s.create_dataframe(pdf).groupBy("k").agg(
+        F.sum("x").alias("s")).to_pandas()
+    assert "Aggregate" in s.cost_model.op_weights(), \
+        s.cost_model.store.records.keys()
+    # pin the consultation with a known value (stored as ns/row —
+    # us/row would round sub-microsecond ops to a "free" 0.0)
+    s.cost_model._observe_sid("op:Project", tpu_ns_per_row=123456.0,
+                              rows=1000)
+    from spark_rapids_tpu.plan.cbo import CostBasedOptimizer
+    opt = CostBasedOptimizer(s.conf)
+    assert opt.tpu_w["Project"] == pytest.approx(123.456, rel=0.5)
+    conf2 = s.conf.set("spark.rapids.sql.optimizer.tpuOpCost.Project",
+                       "9.0")
+    assert CostBasedOptimizer(conf2).tpu_w["Project"] == 9.0
+    s.cost_model.store.flush()
+    s.stop()
+    from spark_rapids_tpu.tools.cbo_calibrate import from_observations
+    blob = from_observations(d)
+    assert blob["provenance"]["source"] == "observations"
+    assert "Project" in blob["weights"]
+    assert blob["weights"]["Project"]["cpu"] > 0
+
+
+def test_join_and_sort_sites_feed_evidence(mesh, tmp_path):
+    """Satellite: join and sort exchange sites record skew/row
+    observations too — the ragged-vs-uniform decision has evidence on
+    all three exchange-bearing operators."""
+    s = TpuSession({"spark.rapids.tpu.costModel.enabled": True,
+                    "spark.rapids.tpu.costModel.dir": str(tmp_path),
+                    "spark.rapids.sql.join.broadcastThresholdRows": 4},
+                   mesh=mesh)
+    n = 256
+    rng = np.random.default_rng(5)
+    left = s.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 32, n).astype(np.int64),
+        "x": rng.uniform(size=n)}))
+    right = s.create_dataframe(pd.DataFrame({
+        "k": np.arange(32, dtype=np.int64), "w": np.arange(32.0)}))
+    left.join(right, "k").to_pandas()
+    left.orderBy("x").to_pandas()
+    recs = s.cost_model.store.records
+    cm_recs = [r for sid, r in recs.items() if sid.startswith("cm:")
+               and "skew" in r and "rows" in r]
+    # aggregate-free plan: the evidence came from join + sort sites
+    assert len(cm_recs) >= 2, recs.keys()
+    s.stop()
