@@ -934,4 +934,205 @@ with I.scoped_rules():
     s.stop()
 PY
 
+echo "== fleet soak: 8 standing subscribers, shared-ingest rounds under kill/delay/corrupt spray (exactly-once sinks, bit-identical answers, fault isolation) =="
+# ISSUE 16: an 8-subscriber fleet (4 join-enrich, 2 windowed with
+# DIFFERENT watermark delays, 2 plain aggregates) ticks shared-ingest
+# rounds while raise/delay/corrupt rules spray every surface a round
+# crosses — the source read, exchanges, state write/restore,
+# checkpoint restore, and the NEW incremental.sink.commit window
+# between compute and epoch commit.  Gates: every committed tick's
+# answer is bit-identical to its one-shot oracle (the windowed ones
+# under their OWN committed watermark); every committed epoch emitted
+# its SinkCommit exactly once (replays re-emit the same epoch,
+# flagged; the eventlog health check proves zero duplicates); a
+# faulted subscriber's co-subscribers commit clean answers in the
+# same round and the faulted one catches up from its backlog on the
+# next round.
+python - <<'PY'
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.memory import retry as _retry  # registers memory.oom
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness import incremental as _inc  # registers points
+from spark_rapids_tpu.robustness.incremental import incremental_metrics
+
+ROUNDS = 6
+SPRAY = (("io.read", dict(kind="raise", count=2, probability=0.3)),
+         ("shuffle.exchange", dict(kind="raise", count=2,
+                                   probability=0.3)),
+         ("shuffle.exchange", dict(kind="delay", delay_s=0.2, count=1,
+                                   probability=0.2)),
+         ("incremental.state.write", dict(kind="raise", count=1,
+                                          probability=0.25)),
+         ("incremental.state.restore", dict(kind="corrupt", count=1,
+                                            probability=0.25)),
+         ("checkpoint.restore", dict(kind="corrupt", count=1,
+                                     probability=0.2)),
+         # the exactly-once window: kill between compute and commit,
+         # and rot the staged payload so the CRC gate must catch it
+         ("incremental.sink.commit", dict(kind="raise", count=1,
+                                          probability=0.35)),
+         ("incremental.sink.commit", dict(kind="corrupt", count=1,
+                                          probability=0.35)))
+
+d = tempfile.mkdtemp(prefix="tpu-fleet-soak-")
+logdir = os.path.join(d, "events")
+rng = np.random.default_rng(23)
+
+# ONE append-only stream all 8 subscribers share: k/v for the join
+# and plain-agg shapes, event-time ts for the windowed ones (each
+# round's file lives in that round's 10-minute bucket)
+def write(i, tick):
+    n = 3000
+    pdf = pd.DataFrame({
+        "k": rng.integers(0, 40, n),
+        "v": rng.integers(0, 1000, n).astype(np.float64),
+        "ts": pd.to_datetime("2024-01-01") + pd.to_timedelta(
+            tick * 600 + rng.integers(0, 600, n), unit="s")})
+    p = os.path.join(d, f"b{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+s = TpuSession({"spark.rapids.sql.recovery.backoffMs": 5,
+                "spark.rapids.tpu.watchdog.defaultDeadlineMs": 15000,
+                "spark.rapids.tpu.eventLog.dir": logdir,
+                # cross-subscriber splices ride the epoch tier
+                "spark.rapids.tpu.serving.sharedStage.enabled": True},
+               mesh=make_mesh(8))
+incremental_metrics.reset()
+
+dim = pd.DataFrame({"k": np.arange(40),
+                    "w": (np.arange(40) % 7 + 1).astype(np.float64)})
+pdim = os.path.join(d, "dim.parquet")
+dim.to_parquet(pdim, index=False)
+
+fact0 = write(0, 0)
+fleet = s.fleet()
+dfs, wdfs = {}, {}
+for i in range(4):  # join-enrich subscribers share the dim subtree
+    dim_agg = (s.read.parquet(pdim).groupBy("k")
+               .agg(F.max("w").alias("w")))
+    dfs[f"j{i}"] = (s.read.parquet(fact0).join(dim_agg, "k")
+                    .groupBy("k")
+                    .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
+                         .alias("sx"),
+                         F.count("v").alias("c")).orderBy("k"))
+    fleet.subscribe(dfs[f"j{i}"], name=f"j{i}", fact=fact0)
+for i, delay in ((0, 1_200_000), (1, 3_600_000)):  # independent horizons
+    wdfs[f"w{i}"] = (s.read.parquet(fact0)
+                     .groupBy(F.window("ts", "10 minutes"), "k")
+                     .agg(F.sum("v").alias("sv"),
+                          F.count("v").alias("c"))
+                     .orderBy("window.start", "k"))
+    fleet.subscribe(wdfs[f"w{i}"], name=f"w{i}",
+                    watermark_delay_ms=delay)
+for i in range(2):
+    dfs[f"a{i}"] = (s.read.parquet(fact0).groupBy("k")
+                    .agg(F.sum("v").alias("sv"),
+                         F.count("v").alias("c"),
+                         F.avg("v").alias("av")).orderBy("k"))
+    fleet.subscribe(dfs[f"a{i}"], name=f"a{i}")
+
+fleet.tick()  # cold epochs, no chaos
+
+# per-subscriber exactly-once ledger: committed epoch -> payload crc
+ledger = {n: {} for n in fleet.subscribers}
+raised = retried = 0
+try:
+    for t in range(ROUNDS):
+        p = write(1 + t, 1 + t)  # the round's ONE appended file
+        with I.scoped_rules():
+            for point, kw in SPRAY:
+                I.inject(point, seed=300 + t, all_threads=True, **kw)
+            commits = fleet.tick([p])
+        info = dict(fleet.last_round_info)
+
+        def record(batch):
+            for n, sc in batch.items():
+                if sc is None:
+                    continue
+                led = ledger[n]
+                if sc.replayed:  # sanctioned: SAME epoch, SAME crc
+                    assert led.get(sc.epoch) == sc.crc, (n, sc)
+                else:  # a NEW emission rides a NEVER-emitted epoch
+                    assert sc.epoch not in led, (n, sc, sorted(led))
+                    led[sc.epoch] = sc.crc
+
+        record(commits)
+        if info["failures"]:
+            # isolation gate: a faulted subscriber is ALONE — every
+            # co-subscriber still committed this round
+            raised += info["failures"]
+            for n, sc in commits.items():
+                assert (sc is None) == \
+                    (n in fleet.last_round_errors), (n, info)
+            # catch-up round, chaos disarmed: backlogged files
+            # re-offer and the faulted subscribers re-ingest
+            commits = fleet.tick()
+            retried += 1
+            assert not fleet.last_round_errors, fleet.last_round_errors
+            record(commits)
+        for n, sc in commits.items():
+            assert sc is not None, (n, info)
+        # bit-identical gate: every subscriber's committed answer is
+        # its one-shot recompute oracle, chaos disarmed (the runners
+        # keep each standing df's scan in step)
+        for n, df in dfs.items():
+            pd.testing.assert_frame_equal(
+                commits[n].df.to_pandas(), df.to_pandas())
+        for n, df in wdfs.items():
+            h = fleet._handles[n]
+            wm = h.runner.last_tick_info["watermark"]
+            pd.testing.assert_frame_equal(
+                commits[n].df.to_pandas(),
+                df.filter(
+                    F.col("window.end").isNull() |
+                    (F.col("window.end") > pd.Timestamp(wm, unit="us"))
+                ).to_pandas())
+    # the two windowed subscribers evicted on their OWN schedules
+    tight = fleet._handles["w0"].runner.store
+    loose = fleet._handles["w1"].runner.store
+    assert tight.state_watermark > loose.state_watermark
+    # every subscriber holds at most one sink record per committed
+    # data round (replay rounds added none)
+    for n in fleet.subscribers:
+        st = fleet._handles[n].runner.store
+        assert len(st._sink) <= 1 + ROUNDS, (n, sorted(st._sink))
+finally:
+    fleet.close()
+    s.stop()
+    m = incremental_metrics.snapshot()
+
+# eventlog health: the duplicate-emission detector stayed quiet over
+# the WHOLE soak trail (and the sink/fleet rollups flowed through)
+from spark_rapids_tpu.tools.eventlog import load_logs
+from spark_rapids_tpu.tools.profiling import (_incremental_problems,
+                                              incremental_stats)
+apps = load_logs(logdir)
+stats = incremental_stats(apps)
+assert stats["sink_commits"] >= 8 * (1 + ROUNDS) - ROUNDS, stats
+assert stats["fleet_rounds"] >= 1 + ROUNDS, stats
+for a in apps:
+    evs = list(a.incremental) + [e for q in a.queries
+                                 for e in q.incremental]
+    dups = [p for p in _incremental_problems(a.session_id, evs)
+            if "duplicate sink emission" in p]
+    assert not dups, dups
+shutil.rmtree(d, ignore_errors=True)
+print(f"fleet soak OK ({ROUNDS} chaos rounds x 8 subscribers exact, "
+      f"faulted+retried={raised}/{retried}, "
+      f"sinkCommits={m['sinkCommits']} sinkReplays={m['sinkReplays']} "
+      f"rollbacks={m['rollbacks']} "
+      f"sourcePulls={stats['fleet_source_pulls']} "
+      f"splices={stats['fleet_splices']})")
+PY
+
 echo "CHAOS OK"

@@ -617,19 +617,24 @@ class DataFrame:
             # plan text + matching input fingerprint + CRC) answers
             # with zero executions and zero queueing; the token
             # carries the PRE-execution fingerprint for the store.
-            # Continuous-ingest ticks bypass BOTH reuse stores
-            # wholesale (no lookup, no store, no shared-stage
+            # A continuous-ingest tick's OWN executions bypass BOTH
+            # reuse stores (no lookup, no store, no shared-stage
             # registration): a tick's plans over transient state
             # relations carry id()-keyed in-memory fingerprints whose
             # no-alias invariant ("the owning plan keeps its batches
             # alive") does not hold for state batches freed at the
             # next commit, and shared writes would outlive the epoch
             # store's rollback — the tick's crash-consistency
-            # contract must rest on the epoch store alone
-            # (robustness/incremental.in_tick)
+            # contract rests on the epoch store alone, and committed
+            # tick work shares through the commit-published epoch
+            # tier instead.  The gate is the tick-EXECUTION marker,
+            # not the coarse tick-scope one: an ordinary query issued
+            # from within a tick callback (an on_commit sink-side
+            # lookup) caches normally
+            # (robustness/incremental.in_tick_execution)
             from spark_rapids_tpu.robustness.incremental import (
-                in_tick)
-            tick = in_tick()
+                in_tick_execution)
+            tick = in_tick_execution()
             cache = getattr(self.session, "result_cache", None)
             pend = None
             if cache is not None and not tick:

@@ -442,7 +442,8 @@ class TpuSession:
         return resolve(self, parse(query))
 
     # --------------------------------------------------- continuous ingest --
-    def incremental(self, df: DataFrame, fact: Optional[str] = None):
+    def incremental(self, df: DataFrame, fact: Optional[str] = None,
+                    watermark_delay_ms: Optional[int] = None):
         """Stand ``df`` up as a continuous-ingest micro-batch query
         (robustness/incremental.py): the returned
         :class:`MicroBatchRunner`'s ``tick(new_paths)`` ingests
@@ -454,13 +455,31 @@ class TpuSession:
         dimension state), windowed aggregation with watermark
         eviction, and provably-mergeable top-N all tick
         incrementally; anything else ticks as a full re-execution
-        with lineage splice.  ``fact`` designates the append-target
-        scan for multi-scan plans (a fact⋈dim join over two file
-        tables): pass any path already in the fact table's file list.
-        Governed by ``spark.rapids.tpu.incremental.*``."""
+        with lineage splice.  Every commit also yields an
+        exactly-once :class:`SinkCommit` (``runner.last_sink_commit``,
+        or the ``runner.on_commit`` callback).  ``fact`` designates
+        the append-target scan for multi-scan plans (a fact⋈dim join
+        over two file tables): pass any path already in the fact
+        table's file list.  ``watermark_delay_ms`` overrides the
+        session watermark conf for THIS runner.  Governed by
+        ``spark.rapids.tpu.incremental.*``."""
         from spark_rapids_tpu.robustness.incremental import (
             MicroBatchRunner)
-        return MicroBatchRunner(self, df, fact=fact)
+        return MicroBatchRunner(self, df, fact=fact,
+                                watermark_delay_ms=watermark_delay_ms)
+
+    def fleet(self):
+        """A standing-query fleet over one append-only stream
+        (serving/fleet.py): ``fleet().subscribe(df, ...)`` registers
+        standing queries; each ``tick(new_paths)`` round pulls the
+        delta ONCE and fans the batches out to every subscriber,
+        whose epochs commit/roll back independently and whose
+        committed stage work cross-splices through the epoch-aware
+        shared stage cache.  Every subscriber tick returns an
+        exactly-once :class:`SinkCommit`.  Governed by
+        ``spark.rapids.tpu.fleet.*``."""
+        from spark_rapids_tpu.serving.fleet import FleetRunner
+        return FleetRunner(self)
 
     # --------------------------------------------------------------- planning --
     def plan(self, logical: L.LogicalPlan, overrides=None):

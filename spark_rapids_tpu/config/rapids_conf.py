@@ -990,6 +990,46 @@ INCREMENTAL_TOPN_MAX_STATE_ROWS = conf(
     "sort keys touching aggregated values always refuse the trim.",
     _to_int, _positive)
 
+FLEET_SHARED_INGEST_ENABLED = conf(
+    "spark.rapids.tpu.fleet.sharedIngest.enabled", True,
+    "Shared-ingest fan-out for standing-query fleets "
+    "(serving/fleet.py, session.fleet()): each fleet tick-round stats "
+    "and READS the appended fact files exactly once and fans the "
+    "ingested batches out to every delta-capable subscriber — N "
+    "dashboards over one stream cost one source pull per new file "
+    "instead of N. Per-subscriber epochs still commit and roll back "
+    "independently (a faulted subscriber re-reads its own history on "
+    "the degraded path; co-subscribers are untouched). False makes "
+    "every subscriber pull its own delta, the lone-runner behavior.",
+    _to_bool)
+
+FLEET_EPOCH_SHARED_STAGE_ENABLED = conf(
+    "spark.rapids.tpu.fleet.sharedStage.epoch.enabled", True,
+    "Epoch-aware tier of the cross-query shared stage cache "
+    "(serving/reuse.py): at every standing-query COMMIT the epoch "
+    "store publishes a snapshot of its committed, file-fingerprinted "
+    "stage entries (stage id + input fingerprint + committed epoch) "
+    "into the session SharedStageCache, so two standing queries "
+    "sharing a delta-join subtree splice each other's committed tick "
+    "work. Entries register only at commit — never from provisional "
+    "state — so a rolled-back tick can never leak a pre-commit entry "
+    "to a co-tenant; an entry evicted from its owner after publication "
+    "simply misses and the subtree re-runs. Requires "
+    "spark.rapids.tpu.serving.sharedStage.enabled and a mesh.",
+    _to_bool)
+
+FLEET_SINK_MAX_RECORDS = conf(
+    "spark.rapids.tpu.fleet.sink.maxRecords", 16,
+    "Committed sink records one standing query retains for idempotent "
+    "re-emission (robustness/incremental.py SinkCommit): each record "
+    "is one committed epoch's emission (payload CRC + epoch + query "
+    "id, plus the result batches) riding the atomic epoch commit — a "
+    "replayed tick whose payload matches the latest committed record "
+    "re-emits THAT epoch instead of minting a duplicate. Oldest "
+    "records age out past this cap (they can no longer be replayed "
+    "against, which only matters for consumers lagging more than this "
+    "many data-bearing ticks).", _to_int, _positive)
+
 ENCODING_EXECUTION_ENABLED = conf(
     "spark.rapids.tpu.encoding.execution.enabled", False,
     "Encoded execution: string GROUP BY keys that are bare column "

@@ -905,8 +905,23 @@ class DistPlanner:
         # the one wrong-results hole the deferred overflow check opens)
         if self._xwindow is not None:
             self._xwindow.resolve_all()
-        self._ckpt.save(sid, frame, stages=self._count_stages(plan))
+        # shareable hint: a sid whose fingerprint folds ONLY file
+        # triples (no id()-keyed in-memory batches) is derivable by
+        # any query holding the identical subtree — the epoch-aware
+        # shared tier publishes exactly those at commit.  Only
+        # meaningful under input-fingerprinted ids (always_resume
+        # stores); the walk is cheap (node count) and saves are rare.
+        self._ckpt.save(sid, frame, stages=self._count_stages(plan),
+                        shareable=self._fp_inputs and
+                        not self._has_mem_relation(plan))
         return frame
+
+    @staticmethod
+    def _has_mem_relation(plan: L.LogicalPlan) -> bool:
+        if isinstance(plan, L.InMemoryRelation):
+            return True
+        return any(DistPlanner._has_mem_relation(c)
+                   for c in plan.children)
 
     def _dispatch(self, plan: L.LogicalPlan, dry: bool) -> ShardedFrame:
         if isinstance(plan, (L.InMemoryRelation, L.FileRelation, L.Range)):

@@ -327,11 +327,17 @@ class CheckpointManager:
         return sum(e.size_bytes for e in self._entries.values())
 
     # ------------------------------------------------------------------ write --
-    def save(self, sid: str, frame, stages: int = 1) -> None:
+    def save(self, sid: str, frame, stages: int = 1,
+             shareable: bool = False) -> None:
         """Register a completed stage's ShardedFrame under ``sid``.
         Best-effort: an I/O failure while persisting drops the
         checkpoint (the query continues without it); a watchdog
-        deadline on a wedged write still classifies as TimeoutFault."""
+        deadline on a wedged write still classifies as TimeoutFault.
+        ``shareable`` is the planner's hint that the sid's input
+        fingerprint is purely file-backed (no in-memory batch
+        identities), i.e. derivable by OTHER queries holding the same
+        subtree — ignored here; the session-persistent store uses it
+        to scope cross-query epoch publication."""
         if not self.enabled or sid in self._entries:
             return
         with watchdog.section("checkpoint.write"):

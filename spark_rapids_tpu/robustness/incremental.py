@@ -92,24 +92,63 @@ from spark_rapids_tpu.robustness.inject import (fire, fire_mutate,
 
 # chaos surface: a raise/delay rule on the write covers a wedged state
 # commit; a corrupt rule on the restore flips state bytes so the CRC
-# gate has real rot to catch (fire_mutate site)
+# gate has real rot to catch (fire_mutate site); the sink point sits
+# in the emission hand-off between compute and commit — a kill there
+# is the crash window exactly-once emission must survive, a corrupt
+# rule rots the staged sink payload so the promote-time CRC gate has
+# real rot to catch
 register_point("incremental.state.write")
 register_point("incremental.state.restore")
+register_point("incremental.sink.commit")
 
-# Tick-in-flight marker (thread-local: ticks serialize per runner and
-# every execution inside a tick starts on the tick thread).  The
-# result cache (serving/reuse.py) must never answer a tick's
-# execution: tick plans over transient state relations can collide
-# with pre-tick entries, and the tick's crash-consistency contract
-# rests on the epoch store alone — DataFrame._execute_batches checks
-# in_tick() and bypasses lookup AND store for everything a tick runs.
+# Tick markers (thread-local: ticks serialize per runner and every
+# execution inside a tick starts on the tick thread).  TWO distinct
+# facts live here, split deliberately:
+#
+# - ``depth``  — "inside MicroBatchRunner.tick()" (in_tick): scope
+#   bookkeeping, spans, and user code a tick invokes (an on_commit
+#   sink callback) all run under it;
+# - ``exec_depth`` — "running one of the RUNNER'S OWN executions"
+#   (in_tick_execution): delta partial, merge, watermark evict,
+#   finalize, degraded recompute, and the fleet shared-ingest read.
+#
+# Only the second gates the serving reuse stores
+# (DataFrame._execute_batches): the runner's plans over transient
+# state relations must bypass the result cache and shared-stage
+# registration — their crash-consistency contract rests on the epoch
+# store alone, and their id()-keyed in-memory fingerprints die with
+# the epoch.  An ORDINARY query issued from within a tick callback
+# (e.g. a sink-side lookup) carries depth but not exec_depth and
+# caches normally — one coarse marker for both facts silently
+# uncached every such query.
 _TICK_TLS = threading.local()
 
 
 def in_tick() -> bool:
     """True while the calling thread is inside MicroBatchRunner.tick()
-    (any runner, incremental.enabled on or off)."""
+    (any runner, incremental.enabled on or off) — including user code
+    the tick invokes, e.g. an on_commit sink callback."""
     return getattr(_TICK_TLS, "depth", 0) > 0
+
+
+def in_tick_execution() -> bool:
+    """True only while the calling thread is running one of a tick's
+    OWN plan executions (or a fleet shared-ingest read) — the marker
+    the serving reuse stores gate on; see the module comment above."""
+    return getattr(_TICK_TLS, "exec_depth", 0) > 0
+
+
+class tick_execution_scope:
+    """Mark the calling thread as running a tick-owned execution for
+    the duration of the ``with`` block (see in_tick_execution)."""
+
+    def __enter__(self) -> "tick_execution_scope":
+        _TICK_TLS.exec_depth = getattr(_TICK_TLS, "exec_depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TICK_TLS.exec_depth -= 1
+        return False
 
 
 class IncrementalMetrics(CheckpointMetrics):
@@ -123,7 +162,8 @@ class IncrementalMetrics(CheckpointMetrics):
               "rollbacks", "writes", "bytesWritten", "resumes",
               "stagesSkipped", "evictions", "invalid", "stateBytes",
               "stateBytesRaw", "joinTicks", "windowTicks", "topnTicks",
-              "watermarkEvictedBuckets", "watermarkEvictedBytes")
+              "watermarkEvictedBuckets", "watermarkEvictedBytes",
+              "sinkCommits", "sinkReplays")
 
     def set(self, field: str, value: int) -> None:
         with self._lock:
@@ -186,6 +226,71 @@ class AggState:
         self.watermark = watermark
 
 
+class _SinkRecord:
+    """One COMMITTED (or staged-provisional) emission's identity:
+    epoch + payload CRC + row/byte counts.  Metadata only — the
+    payload itself is the tick's result (bit-identical on recompute by
+    the epoch contract), so idempotent re-emission needs the identity,
+    not a copy of the bytes."""
+
+    __slots__ = ("epoch", "crc", "rows", "size_bytes")
+
+    def __init__(self, epoch: int, crc: int, rows: int,
+                 size_bytes: int):
+        self.epoch = epoch
+        self.crc = crc
+        self.rows = rows
+        self.size_bytes = size_bytes
+
+
+class SinkCommit:
+    """What ``runner.tick()`` hands a downstream sink: exactly-once
+    emission metadata that rode the atomic epoch commit.  ``epoch`` is
+    the emission's COMMITTED epoch — a replayed tick (no new data, or
+    a retried delivery) re-surfaces the SAME epoch with
+    ``replayed=True`` and an identical ``crc``, so a sink that
+    dedupes on (store, epoch) gets every answer exactly once across
+    crash/rollback/replay.  ``df`` is the tick's result DataFrame
+    (attached by the runner after commit)."""
+
+    __slots__ = ("store", "epoch", "crc", "rows", "size_bytes",
+                 "replayed", "df")
+
+    def __init__(self, store: int, epoch: int, crc: int, rows: int,
+                 size_bytes: int, replayed: bool):
+        self.store = store
+        self.epoch = epoch
+        self.crc = crc
+        self.rows = rows
+        self.size_bytes = size_bytes
+        self.replayed = replayed
+        self.df = None
+
+    def __repr__(self) -> str:
+        return (f"SinkCommit(store={self.store}, epoch={self.epoch}, "
+                f"crc={self.crc:#010x}, rows={self.rows}, "
+                f"replayed={self.replayed})")
+
+
+class SharedIngest:
+    """One fleet round's single source pull, fanned out to every
+    subscriber: the delta file list, its PRE-READ ``scan_input_meta``
+    stat triples (the stat-before-read rule — a file mutating after
+    the stat leaves the committed fingerprint describing pre-mutation
+    bytes, so the next staleness check catches it), the materialized
+    batches, and the full scan schema the batches carry (subscribers
+    whose fact scan reads a different shape fall back to their own
+    pull — correct, just unshared)."""
+
+    __slots__ = ("paths", "meta", "batches", "schema_names")
+
+    def __init__(self, paths, meta, batches, schema_names):
+        self.paths = list(paths)
+        self.meta = list(meta)
+        self.batches = list(batches)
+        self.schema_names = list(schema_names)  # [(name, dtype.name)]
+
+
 class IncrementalStateStore(CheckpointManager):
     """Session-persistent lineage + aggregate state with epochs.
 
@@ -226,6 +331,21 @@ class IncrementalStateStore(CheckpointManager):
         self._agg_prov: Optional[AggState] = None
         self._provisional: set = set()
         self._touched: set = set()
+        # exactly-once sink log: committed emission records (epoch →
+        # identity, insertion-ordered, trimmed to sink_max) plus the
+        # one staged-provisional record that rides the next commit
+        self.sink_max = int(conf.get(rc.FLEET_SINK_MAX_RECORDS))
+        self._sink: Dict[int, _SinkRecord] = {}
+        self._sink_prov: Optional[_SinkRecord] = None
+        self.last_sink: Optional[SinkCommit] = None
+        # epoch-aware sharing: sids whose input fingerprint is purely
+        # file-backed (no in-memory batch identities — the planner's
+        # shareable hint) are safe to splice ACROSS standing queries;
+        # commit publishes the committed subset to the session
+        # SharedStageCache's epoch tier
+        self.share_epoch = bool(
+            conf.get(rc.FLEET_EPOCH_SHARED_STAGE_ENABLED))
+        self._shareable: set = set()
         self._splice_active = False
         # True only when a splice execution ran DISTRIBUTED end to end
         # — the precondition for stale-entry pruning at commit: an
@@ -254,21 +374,41 @@ class IncrementalStateStore(CheckpointManager):
         emit_on_session(mapped, session=self.session, **fields)
 
     # ------------------------------------------------------------ stage lineage --
-    def save(self, sid: str, frame, stages: int = 1) -> None:
+    def save(self, sid: str, frame, stages: int = 1,
+             shareable: bool = False) -> None:
         known = sid in self._entries
         super().save(sid, frame, stages)
         if not known and sid in self._entries:
             self._provisional.add(sid)
+            if shareable:
+                # the planner vouched: this sid's fingerprint is
+                # purely file-backed, so another standing query whose
+                # plan contains the identical subtree derives the
+                # identical sid — publishable at commit
+                self._shareable.add(sid)
         self._touched.add(sid)
 
     def restore(self, sid: str, mesh):
         frame = super().restore(sid, mesh)
         if frame is not None:
             self._touched.add(sid)
-        return frame
+            return frame
+        # local miss: try a co-subscriber's COMMITTED epoch via the
+        # session shared-stage cache's epoch tier.  The hit is not
+        # adopted (not _touched, not ours): the owner store's epoch
+        # discipline governs its lifetime, and this store's pruning
+        # must not treat a borrowed entry as its own lineage.
+        if self.share_epoch:
+            shared = getattr(self.session, "shared_stages", None)
+            if shared is not None and getattr(shared, "enabled", False):
+                er = getattr(shared, "epoch_restore", None)
+                if er is not None:
+                    return er(sid, mesh, exclude=self)
+        return None
 
     def drop(self, sid: str, reason: str, evict: bool = False) -> None:
         self._provisional.discard(sid)
+        self._shareable.discard(sid)
         super().drop(sid, reason, evict=evict)
 
     def note_distributed_complete(self) -> None:
@@ -290,9 +430,11 @@ class IncrementalStateStore(CheckpointManager):
         every standing epoch on one transient demotion.)"""
         self._splice_complete = False  # a layout rung ran: this tick
         # can no longer vouch for which committed entries are stale
+        self._sink_prov = None  # metadata only; nothing to release
         for sid in list(self._provisional):
             entry = self._entries.pop(sid, None)
             self._provisional.discard(sid)
+            self._shareable.discard(sid)
             if entry is not None:
                 try:
                     entry.handle.close()
@@ -394,6 +536,45 @@ class IncrementalStateStore(CheckpointManager):
         self._emit("StateEvict", kind="aggState", reason=reason,
                    bytes=st.size_bytes, epoch=st.epoch)
 
+    # ---------------------------------------------------------------- sink log --
+    def sink_prepare(self, batches) -> None:
+        """Stage this tick's emission as the PROVISIONAL sink record
+        (CRC + rows + bytes over the result batches).  This is the
+        hand-off between compute and commit — the chaos point here IS
+        the crash window exactly-once emission must survive: a kill
+        raises before anything is staged (rollback discards, the
+        degraded recompute stages afresh, one commit → one emission),
+        and a corrupt rule rots the staged payload so the CRC gate
+        below catches real bit rot before it can ride a commit."""
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
+        fire("incremental.sink.commit")
+        crc, rows, size = 0, 0, 0
+        probed = False
+        for b in batches:
+            payload = _batch_payload(b)
+            c = _payload_checksum(payload, b.nrows)
+            if not probed:
+                key = next((k for k in sorted(payload)
+                            if payload[k].size > 0), None)
+                if key is not None:
+                    probed = True
+                    mut = fire_mutate("incremental.sink.commit",
+                                      payload[key])
+                    if mut is not payload[key]:
+                        staged = dict(payload)
+                        staged[key] = mut
+                        got = _payload_checksum(staged, b.nrows)
+                        if got != c:
+                            raise CorruptionFault(
+                                "sink payload rot between compute and"
+                                f" commit: crc {got:#010x} != "
+                                f"computed {c:#010x}")
+            crc = (crc * 1000003 + c) & 0xFFFFFFFF
+            rows += int(b.nrows)
+            size += sum(a.nbytes for a in payload.values())
+        self._sink_prov = _SinkRecord(self.epoch + 1, crc, rows, size)
+
     @property
     def state_fingerprint(self) -> Optional[str]:
         return self._agg.fingerprint if self._agg is not None else None
@@ -465,6 +646,7 @@ class IncrementalStateStore(CheckpointManager):
                         if s not in self._touched]:
                 entry = self._entries.pop(sid)
                 self._provisional.discard(sid)
+                self._shareable.discard(sid)
                 try:
                     entry.handle.close()
                 except Exception:
@@ -474,6 +656,40 @@ class IncrementalStateStore(CheckpointManager):
         self._splice_active = False
         self._splice_complete = False
         self._evict_over_budget()
+        # promote the staged sink record — the emission rides THIS
+        # commit.  An identical payload to the latest committed record
+        # is a REPLAY: the same committed epoch re-emits idempotently
+        # (retried tick, zero-delta round) and no new record lands —
+        # a (store, epoch)-deduping sink sees every answer exactly once
+        sink = None
+        if self._sink_prov is not None:
+            prov, self._sink_prov = self._sink_prov, None
+            last = (self._sink[next(reversed(self._sink))]
+                    if self._sink else None)
+            if last is not None and (last.crc, last.rows) == \
+                    (prov.crc, prov.rows):
+                self._bump("sinkReplays")
+                sink = SinkCommit(self.store_id, last.epoch, last.crc,
+                                  last.rows, last.size_bytes, True)
+            else:
+                self._sink[self.epoch] = _SinkRecord(
+                    self.epoch, prov.crc, prov.rows, prov.size_bytes)
+                while len(self._sink) > self.sink_max:
+                    self._sink.pop(next(iter(self._sink)))
+                self._bump("sinkCommits")
+                sink = SinkCommit(self.store_id, self.epoch, prov.crc,
+                                  prov.rows, prov.size_bytes, False)
+            self._emit("SinkCommit", epoch=sink.epoch, crc=sink.crc,
+                       rows=sink.rows, bytes=sink.size_bytes,
+                       replayed=bool(sink.replayed),
+                       store=self.store_id)
+        self.last_sink = sink
+        # publish the committed epoch's shareable sids to the session
+        # shared-stage cache — ONLY here, never from provisional state
+        # (rollback publishes nothing, so the snapshot other standing
+        # queries splice from is always a committed epoch's); an empty
+        # set still publishes, replacing a stale snapshot
+        self._publish_epoch()
         incremental_metrics.bump("commits")
         incremental_metrics.set("stateBytes", self.state_bytes)
         incremental_metrics.set("stateBytesRaw", self.state_bytes_raw)
@@ -500,10 +716,26 @@ class IncrementalStateStore(CheckpointManager):
                        stateBytes=self.state_bytes)
         return self.epoch
 
+    def _publish_epoch(self) -> None:
+        """Hand the session SharedStageCache a by-reference snapshot of
+        this store's committed, cross-query-safe stage entries (called
+        from commit ONLY)."""
+        if not self.share_epoch:
+            return
+        shared = getattr(self.session, "shared_stages", None)
+        if shared is None or not getattr(shared, "enabled", False):
+            return
+        pub = getattr(shared, "publish_epoch", None)
+        if pub is not None:
+            pub(self, frozenset(s for s in self._entries
+                                if s in self._shareable))
+
     def rollback(self, reason: str) -> None:
         """Discard every provisional write; the committed epoch is
         untouched — a chaos-killed tick leaves the standing state
-        exactly as the last commit left it."""
+        exactly as the last commit left it (including the sink log and
+        the published shared-epoch snapshot: neither is touched here,
+        both only ever move at commit)."""
         self.clear(reason)
         self._touched.clear()
         self._splice_active = False
@@ -530,6 +762,16 @@ class IncrementalStateStore(CheckpointManager):
 
     def close(self) -> None:
         """Release every payload (runner teardown / session stop)."""
+        shared = getattr(self.session, "shared_stages", None)
+        if shared is not None and \
+                hasattr(shared, "retract_epoch"):
+            try:
+                shared.retract_epoch(self)
+            except Exception:
+                pass
+        self._sink_prov = None
+        self._sink.clear()
+        self.last_sink = None
         self.clear("store-closed")
         for sid in list(self._entries):
             entry = self._entries.pop(sid)
@@ -576,8 +818,11 @@ def _find_fact_scan(plan, fact=None):
     return scans[0] if len(scans) == 1 else None
 
 
-def _replace_scan(plan, scan, paths):
-    """Clone ``plan`` with ``scan``'s path list swapped for ``paths``.
+def _replace_scan(plan, scan, paths, replacement=None):
+    """Clone ``plan`` with ``scan``'s path list swapped for ``paths``
+    — or, with ``replacement``, with the scan node swapped for that
+    relation outright (the fleet shared-ingest form: an
+    InMemoryRelation holding the already-pulled delta batches).
     Expressions stay shared (they are bound by ordinal and the delta
     scan exposes the identical schema), and subtrees that do not
     contain ``scan`` are shared UNTOUCHED — the dimension side of a
@@ -586,6 +831,8 @@ def _replace_scan(plan, scan, paths):
     and spliceable stage ids) stay stable; only the spine from the
     root down to the scan is copied."""
     if plan is scan:
+        if replacement is not None:
+            return replacement
         new = copy.copy(plan)
         new.paths = list(paths)
         new.pushed_filters = list(plan.pushed_filters)
@@ -593,7 +840,7 @@ def _replace_scan(plan, scan, paths):
         return new
     if not plan.children:
         return plan
-    new_children = tuple(_replace_scan(c, scan, paths)
+    new_children = tuple(_replace_scan(c, scan, paths, replacement)
                          for c in plan.children)
     if all(nc is c for nc, c in zip(new_children, plan.children)):
         return plan
@@ -875,13 +1122,20 @@ class _AggSpec:
         return L.Limit(self.trim_n,
                        L.Sort(list(self.trim_sort.orders), node))
 
-    def partial_plan(self, scan, paths):
+    def partial_plan(self, scan, paths, batches=None):
         """Partial aggregate over ONLY ``paths`` (the delta).  For a
         delta-join the cloned spine keeps the dimension subtree SHARED
         (node identity — see ``_replace_scan``), so its stage ids stay
-        spliceable and its in-memory batch ids stay fingerprintable."""
+        spliceable and its in-memory batch ids stay fingerprintable.
+        With ``batches`` (a fleet round's shared-ingest pull of those
+        same paths) the scan is replaced by an InMemoryRelation over
+        them — same schema, zero additional source pulls."""
         from spark_rapids_tpu.plan import logical as L
-        child = _replace_scan(self.pre_root, scan, paths)
+        rel = None
+        if batches is not None:
+            rel = L.InMemoryRelation(list(batches), list(scan.schema))
+        child = _replace_scan(self.pre_root, scan, paths,
+                              replacement=rel)
         return self._trimmed(L.Aggregate(list(self.agg.group_exprs),
                                          list(self.partial_aggs),
                                          child))
@@ -970,7 +1224,8 @@ class MicroBatchRunner:
     per runner; each execution inside a tick is an ordinary query to
     the rest of the engine (admission, budgets, ladder, watchdog)."""
 
-    def __init__(self, session, df, fact=None):
+    def __init__(self, session, df, fact=None,
+                 watermark_delay_ms=None):
         from spark_rapids_tpu.config import rapids_conf as rc
         self.session = session
         self.df = df
@@ -993,7 +1248,11 @@ class MicroBatchRunner:
                 "this plan (typo, relative-vs-absolute path, or the "
                 "path appears in several tables); scans present: "
                 + (str(cands) if cands else "none"))
-        delay_ms = int(conf.get(rc.INCREMENTAL_WATERMARK_DELAY_MS))
+        # the per-runner override lets fleet subscribers over ONE
+        # shared ingest evict on their own schedules (watermark
+        # independence); the session conf stays the default
+        delay_ms = int(conf.get(rc.INCREMENTAL_WATERMARK_DELAY_MS)) \
+            if watermark_delay_ms is None else int(watermark_delay_ms)
         self._spec = _AggSpec.analyze(
             df.plan, self._scan,
             watermark_delay_us=(delay_ms * 1000 if delay_ms >= 0
@@ -1007,6 +1266,14 @@ class MicroBatchRunner:
         self._lock = threading.Lock()
         self._phase_log: list = []  # (name, t0_ns, dur_ns) per tick
         self.last_tick_info: Dict[str, object] = {}
+        # exactly-once emission surface: the committed SinkCommit of
+        # the latest tick (result df attached), and an optional
+        # user callback invoked after every commit — the callback runs
+        # in tick SCOPE but not tick EXECUTION, so ordinary queries it
+        # issues (a sink-side lookup) hit the serving caches normally
+        self.last_sink_commit: Optional[SinkCommit] = None
+        self.on_commit = None
+        self._ingest: Optional[SharedIngest] = None  # per-tick loan
 
     # ------------------------------------------------------------- helpers --
     def _fingerprint(self, paths) -> str:
@@ -1050,21 +1317,23 @@ class MicroBatchRunner:
         restore instead of re-running."""
         from spark_rapids_tpu.api.dataframe import DataFrame
         df = DataFrame(self.session, plan)
-        if splice and self.store is not None and \
-                getattr(self.session, "mesh", None) is not None:
-            self.store._splice_active = True
-            self.session.checkpoints = self.store
-            try:
-                # stale-entry pruning at commit is only sound when the
-                # FINAL attempt really ran on the mesh; the planner
-                # signals that via note_distributed_complete on THIS
-                # thread (a shared session attribute would race with
-                # concurrent queries), and clear() (layout rung)
-                # vetoes it for the rest of the tick
-                return df._execute_batches()
-            finally:
-                self.session.checkpoints = None
-        return df._execute_batches()
+        with tick_execution_scope():
+            if splice and self.store is not None and \
+                    getattr(self.session, "mesh", None) is not None:
+                self.store._splice_active = True
+                self.session.checkpoints = self.store
+                try:
+                    # stale-entry pruning at commit is only sound when
+                    # the FINAL attempt really ran on the mesh; the
+                    # planner signals that via
+                    # note_distributed_complete on THIS thread (a
+                    # shared session attribute would race with
+                    # concurrent queries), and clear() (layout rung)
+                    # vetoes it for the rest of the tick
+                    return df._execute_batches()
+                finally:
+                    self.session.checkpoints = None
+            return df._execute_batches()
 
     @staticmethod
     def _concat(batches):
@@ -1080,21 +1349,46 @@ class MicroBatchRunner:
         return DataFrame(self.session,
                          L.InMemoryRelation(batches, list(schema)))
 
+    def _ingest_for(self, paths) -> Optional[SharedIngest]:
+        """This tick's shared-ingest loan, iff it is usable for
+        ``paths``: same file set, and a fact scan whose read shape the
+        pulled batches reproduce exactly (full schema, no metadata
+        columns, no pushdown pruning).  None falls back to the
+        runner's own pull — correct, just unshared."""
+        ing = self._ingest
+        scan = self._scan
+        if ing is None or scan is None or \
+                set(ing.paths) != set(paths):
+            return None
+        if scan.file_meta or scan.pushed_filters or \
+                getattr(scan, "required_columns", None):
+            return None
+        if [(n, d.name) for n, d in scan.schema] != ing.schema_names:
+            return None
+        return ing
+
     # ---------------------------------------------------------------- ticks --
-    def tick(self, new_paths=()):
+    def tick(self, new_paths=(), _ingest=None):
         """Ingest ``new_paths`` (appended files) and return the result
-        over everything ingested so far.  Every execution inside the
-        tick runs with the in-tick marker set: the session ResultCache
-        is bypassed wholesale (no lookup, no store) — a tick must
+        over everything ingested so far.  Every execution the RUNNER
+        issues inside the tick runs under the tick-execution marker:
+        the session ResultCache and direct SharedStageCache
+        registration are bypassed (no lookup, no store) — a tick must
         never answer from a pre-tick entry, and its crash-consistency
-        contract rests on the epoch store alone."""
+        contract rests on the epoch store alone.  (Cross-query sharing
+        of tick work happens instead through the epoch tier: committed
+        entries published at commit, borrowed via epoch_restore.)
+        ``_ingest`` is the fleet's shared-ingest loan for this round
+        (internal)."""
         with self._lock:
             _TICK_TLS.depth = getattr(_TICK_TLS, "depth", 0) + 1
+            self._ingest = _ingest
             try:
                 return self._tick(
                     [new_paths] if isinstance(new_paths, str)
                     else list(new_paths))
             finally:
+                self._ingest = None
                 _TICK_TLS.depth -= 1
 
     def _phased(self, name: str, fn, *args, **kwargs):
@@ -1156,9 +1450,11 @@ class MicroBatchRunner:
 
         if self.store is None:
             # incremental.enabled=false parity: every tick is a plain
-            # full execution, no standing state
+            # full execution, no standing state (and no sink log — the
+            # exactly-once contract needs the epoch store)
             out = self._run(self._full_plan(target))
             self._finish(target, info)
+            self.last_sink_commit = None
             return self._result_df(out, self.df.plan.schema)
 
         try:
@@ -1179,8 +1475,21 @@ class MicroBatchRunner:
                      info.get("evictedBuckets", 0),
                      info.get("evictedRows", 0),
                      info.get("evictedBytes", 0))
+        res = self._result_df(out, self.df.plan.schema)
+        sc = self.store.last_sink
+        if sc is not None:
+            sc.df = res
+            info["sinkEpoch"] = sc.epoch
+            info["sinkReplayed"] = bool(sc.replayed)
+        self.last_sink_commit = sc
         self._finish(target, info)
-        return self._result_df(out, self.df.plan.schema)
+        if sc is not None and self.on_commit is not None:
+            # user code: runs in tick SCOPE (depth) but not tick
+            # EXECUTION, so ordinary queries it issues cache normally;
+            # a callback fault must not un-commit the epoch — it
+            # already committed — so it propagates to the caller as-is
+            self.on_commit(sc)
+        return res
 
     def _finish(self, target, info) -> None:
         self._paths = list(target)
@@ -1232,15 +1541,22 @@ class MicroBatchRunner:
             # the PRE-mutation bytes and the next tick's staleness
             # check drops the state — the safe failure mode.  Statting
             # after the read would stamp post-mutation identity onto
-            # pre-mutation state and hide the mutation forever.
-            meta_delta = scan_input_meta(delta)
+            # pre-mutation state and hide the mutation forever.  A
+            # fleet shared-ingest loan carries its own PRE-READ stat
+            # (the fleet statted before its one pull) — zero source
+            # pulls and zero stats on this runner's account.
+            ing = self._ingest_for(delta)
+            meta_delta = list(ing.meta) if ing is not None \
+                else scan_input_meta(delta)
             # delta-join: only the NEW fact batches join the unchanged
             # dimension state — the delta runs with the store riding
             # as checkpoint manager, so completed dim subtrees splice
             # from committed lineage instead of re-running
             partial = self._phased(
                 "join.delta" if spec.join_type is not None else "delta",
-                self._run, spec.partial_plan(self._scan, delta),
+                self._run, spec.partial_plan(
+                    self._scan, delta,
+                    batches=ing.batches if ing is not None else None),
                 splice=spec.join_type is not None)
             merged = self._phased(
                 "topn.merge" if spec.trim_n is not None else "merge",
@@ -1259,6 +1575,10 @@ class MicroBatchRunner:
                 watermark=watermark)
         out = self._phased("finalize", self._run,
                            spec.result_plan([state]))
+        # stage the emission — a fault here (kill/rot in the
+        # compute→commit window) degrades the tick exactly like any
+        # other mid-tick fault: rollback, recompute, ONE commit
+        self._phased("sink", self.store.sink_prepare, out)
         # counted only once the WHOLE incremental path answered: a
         # finalize-run fault degrades this tick to full recompute and
         # must not leave it double-counted in the reuse ratio
@@ -1365,12 +1685,19 @@ class MicroBatchRunner:
         if self._spec is not None:
             spec = self._spec
             info["shape"] = spec.shape
+            # a fleet loan covers this recompute only when it spans
+            # the WHOLE target (the first tick: delta == everything);
+            # a degraded later tick must re-read history it owns
+            ing = self._ingest_for(target)
             # stat before read (see _tick_body): a mid-scan mutation
             # must leave the state stamped with PRE-mutation identity
-            fp = self._fingerprint(target)
+            fp = self._state_fingerprint(list(ing.meta)) \
+                if ing is not None else self._fingerprint(target)
             partial = self._phased(
                 "recompute", self._run,
-                spec.partial_plan(self._scan, target),
+                spec.partial_plan(
+                    self._scan, target,
+                    batches=ing.batches if ing is not None else None),
                 splice=spec.join_type is not None)
             state = self._concat(partial)
             if state is None:
@@ -1379,8 +1706,10 @@ class MicroBatchRunner:
             state, watermark = self._advance_watermark(
                 state, self.store.state_watermark, info)
             self.store.put_state(state, fp, watermark=watermark)
-            return self._phased("finalize", self._run,
-                                spec.result_plan([state]))
+            out = self._phased("finalize", self._run,
+                               spec.result_plan([state]))
+            self._phased("sink", self.store.sink_prepare, out)
+            return out
         # reuse detection reads the STORE-LOCAL resume counter, not the
         # process-global one: concurrent runners must not contaminate
         # each other's reusedState flag
@@ -1389,6 +1718,7 @@ class MicroBatchRunner:
         out = self._phased("recompute", self._run,
                            self._full_plan(target), splice=True)
         info["reused"] = self.store.local["resumes"] > r0
+        self._phased("sink", self.store.sink_prepare, out)
         return out
 
     def close(self) -> None:

@@ -87,6 +87,29 @@ def qid_for_ident(ident: int, session=None) -> Optional[int]:
     return None
 
 
+_deadline_tls = threading.local()
+
+
+class deadline_override:
+    """Scope a per-query deadline budget (ms) onto every QueryContext
+    the calling thread opens inside the ``with`` block — how a fleet
+    round gives each subscriber its own deadline-weighted quantum
+    under one shared session conf.  0/None means no override."""
+
+    def __init__(self, ms):
+        self.ms = None if not ms else int(ms)
+
+    def __enter__(self) -> "deadline_override":
+        self._prev = getattr(_deadline_tls, "ms", None)
+        if self.ms is not None:
+            _deadline_tls.ms = self.ms
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _deadline_tls.ms = self._prev
+        return False
+
+
 class QueryContext:
     """One query action's scope: identity, budgets, admission ticket.
 
@@ -107,6 +130,12 @@ class QueryContext:
         self.memory_budget = conf.get(rc.SERVING_QUERY_MEMORY_BUDGET)
         self.sync_budget = conf.get(rc.SERVING_SYNC_BUDGET)
         self.deadline_budget_ms = conf.get(rc.SERVING_DEADLINE_BUDGET_MS)
+        # thread-local per-query override (fleet subscribers carry
+        # their OWN deadlines while sharing one session conf): the
+        # fair interleaver widens deadline-carrying queries' quanta
+        ov = getattr(_deadline_tls, "ms", None)
+        if ov is not None:
+            self.deadline_budget_ms = int(ov)
         self.syncs_used = 0
         self.ticket = None            # AdmissionTicket once admitted
         self.admission_wait_ms = 0.0

@@ -1,0 +1,266 @@
+"""Standing-query fleets: shared-ingest fan-out over one fact stream.
+
+PR13 gave one standing query epoch semantics (``session.incremental``);
+this module composes N of them over the SAME append-only stream so a
+dashboard fleet costs far less than N lone runners (ROADMAP item —
+the "Accelerating Presto with GPUs" multi-tenant near-duplicate
+workload, with Theseus's keep-shared-data-movement-minimal
+discipline):
+
+- **one source pull per round** — ``FleetRunner.tick(new_paths)``
+  stats and reads the delta files ONCE (stat-before-read, the epoch
+  store's mutation-safety rule) and lends the materialized batches to
+  every subscriber as a :class:`~spark_rapids_tpu.robustness.
+  incremental.SharedIngest`; each subscriber's partial plan swaps its
+  fact scan for an InMemoryRelation over the shared batches — N
+  queries, 1 pull per new file.  A subscriber whose read shape the
+  loan cannot reproduce (metadata columns, pushdown pruning, its own
+  catch-up backlog after a faulted round) falls back to its own pull:
+  correct, just unshared.
+- **independent epochs** — every subscriber keeps its OWN
+  IncrementalStateStore; each tick inside a round commits or rolls
+  back alone, so one subscriber's chaos fault degrades that
+  subscriber to a (correct) recompute and never poisons a
+  co-subscriber's tick.  A subscriber whose degraded recompute ALSO
+  fails stays on its committed epoch and catches up on a later round.
+- **epoch-aware cross-subscriber splice** — at commit each store
+  publishes its file-fingerprinted stage entries to the session
+  SharedStageCache's epoch tier (serving/reuse.py); subscribers
+  sharing a delta-join subtree (the same dimension aggregate, say)
+  splice each other's COMMITTED tick work instead of re-running it.
+- **exactly-once emission** — every subscriber tick yields a
+  :class:`~spark_rapids_tpu.robustness.incremental.SinkCommit`
+  (payload CRC + committed epoch + store id) that rode its atomic
+  epoch commit; replays re-emit the same epoch idempotently.
+
+Subscriber ticks run sequentially inside a round, each under its own
+``deadline_override`` — every execution admits through the fair
+interleaver with deadline-weighted quanta (serving/scheduler.py), so
+a latency-pinned subscriber keeps its service level while sharing
+the mesh with the rest of the fleet and with ad-hoc queries.
+
+Observable: one ``FleetRound`` event per round (subscriber count,
+delta files, source pulls, cross-subscriber splices, failures) →
+eventlog → profiling "Continuous ingest" rollup.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.robustness.incremental import (MicroBatchRunner,
+                                                     SharedIngest,
+                                                     SinkCommit,
+                                                     tick_execution_scope)
+from spark_rapids_tpu.serving.context import deadline_override
+
+
+class FleetHandle:
+    """One subscriber's view of the fleet: ``handle.tick(new_paths)``
+    drives a WHOLE fleet round (every co-subscriber ticks too — the
+    stream moved for all of them) and returns this subscriber's
+    :class:`SinkCommit` — or re-raises this subscriber's own fault
+    after the co-subscribers finished their ticks."""
+
+    def __init__(self, fleet: "FleetRunner", name: str,
+                 runner: MicroBatchRunner, deadline_ms: int):
+        self.fleet = fleet
+        self.name = name
+        self.runner = runner
+        self.deadline_ms = int(deadline_ms or 0)
+        # paths offered to a round whose tick FAILED: re-offered next
+        # round (the runner dedupes anything it did commit), so a
+        # faulted subscriber's missed files are queued, never lost
+        self._backlog: List[str] = []
+
+    def tick(self, new_paths=()) -> Optional[SinkCommit]:
+        self.fleet.tick(new_paths)
+        err = self.fleet.last_round_errors.get(self.name)
+        if err is not None:
+            raise err
+        return self.runner.last_sink_commit
+
+    @property
+    def last_tick_info(self) -> Dict[str, object]:
+        return self.runner.last_tick_info
+
+    def close(self) -> None:
+        self.fleet.unsubscribe(self.name)
+
+
+class FleetRunner:
+    """N standing queries over one append-only fact stream, ticked in
+    shared-ingest rounds (module docstring).  ``session.fleet()``."""
+
+    def __init__(self, session):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        self.session = session
+        self.shared_ingest = bool(
+            session.conf.get(rc.FLEET_SHARED_INGEST_ENABLED))
+        self._handles: Dict[str, FleetHandle] = {}
+        self._seq = 0
+        self._offered: set = set()   # every path any round has pulled
+        self._round = 0
+        self._lock = threading.Lock()
+        self.last_round_info: Dict[str, object] = {}
+        self.last_round_errors: Dict[str, BaseException] = {}
+
+    # ---------------------------------------------------------- membership --
+    def subscribe(self, df, name: Optional[str] = None, fact=None,
+                  watermark_delay_ms=None, deadline_ms: int = 0,
+                  on_commit=None) -> FleetHandle:
+        """Register one standing query.  ``watermark_delay_ms``
+        overrides the session conf for THIS subscriber (independent
+        eviction schedules over one shared ingest); ``deadline_ms``
+        rides every execution of its ticks as the fair interleaver's
+        deadline budget; ``on_commit(SinkCommit)`` fires after each of
+        its commits (tick scope, NOT tick execution — queries it
+        issues cache normally)."""
+        with self._lock:
+            self._seq += 1
+            if name is None:
+                name = f"q{self._seq}"
+            if name in self._handles:
+                raise ValueError(f"subscriber {name!r} already exists")
+            runner = MicroBatchRunner(
+                self.session, df, fact=fact,
+                watermark_delay_ms=watermark_delay_ms)
+            runner.on_commit = on_commit
+            handle = FleetHandle(self, name, runner, deadline_ms)
+            self._handles[name] = handle
+            return handle
+
+    def unsubscribe(self, name: str) -> None:
+        with self._lock:
+            h = self._handles.pop(name, None)
+        if h is not None:
+            h.runner.close()
+
+    @property
+    def subscribers(self) -> List[str]:
+        return list(self._handles)
+
+    # --------------------------------------------------------------- rounds --
+    def _pull_once(self, paths) -> Optional[SharedIngest]:
+        """The round's ONE source pull: stat the delta (the meta every
+        subscriber's fingerprint will be stamped from — BEFORE the
+        read, so a file mutating mid-round is caught by the next
+        staleness check, never hidden), then materialize it through
+        the full engine path under the tick-execution marker (no
+        result-cache pollution: the loan's identity lives in the
+        subscribers' epoch fingerprints, not a plan-keyed cache)."""
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.io.readers import scan_input_meta
+        from spark_rapids_tpu.plan import logical as L
+        tmpl = next((h.runner._scan
+                     for h in self._handles.values()
+                     if h.runner._scan is not None), None)
+        if tmpl is None:
+            return None
+        try:
+            rel = L.FileRelation(list(paths), tmpl.file_format,
+                                 tmpl._schema, dict(tmpl.options))
+            schema_names = [(n, d.name) for n, d in rel.schema]
+            meta = scan_input_meta(list(paths))
+            with tick_execution_scope():
+                batches = DataFrame(self.session,
+                                    rel)._execute_batches()
+        except Exception:
+            # the shared pull is an optimization: any failure here
+            # (schema not yet resolvable, reader fault) downgrades the
+            # round to per-subscriber pulls, which carry their own
+            # fault handling
+            return None
+        return SharedIngest(paths, meta, batches, schema_names)
+
+    def tick(self, new_paths=()) -> Dict[str, Optional[SinkCommit]]:
+        """One fleet round: pull the delta once, then tick every
+        subscriber with the loan.  Subscriber faults are ISOLATED —
+        recorded in ``last_round_errors`` (and re-raised by that
+        subscriber's own ``handle.tick``) while every co-subscriber's
+        tick proceeds; the faulted subscriber's store stays on its
+        committed epoch and its missed files stay queued for the next
+        round (its catch-up delta simply exceeds the loan and reads
+        its own history)."""
+        offered = [new_paths] if isinstance(new_paths, str) \
+            else list(new_paths)
+        with self._lock:
+            handles = list(self._handles.values())
+            self._round += 1
+            rnd = self._round
+            # the fleet's view of "new": never pulled by any round.
+            # Round 1 folds in the subscribers' common initial file
+            # set (their first tick ingests scan history + delta, and
+            # the loan must span exactly that to be usable).
+            delta: List[str] = []
+            seen = set(self._offered)
+            if rnd == 1:
+                inits = {tuple(sorted(h.runner._initial))
+                         for h in handles if h.runner._scan is not None}
+                if len(inits) == 1:
+                    for p in sorted(inits.pop()):
+                        if p not in seen:
+                            seen.add(p)
+                            delta.append(p)
+            for p in offered:
+                if p not in seen:
+                    seen.add(p)
+                    delta.append(p)
+            self._offered = seen
+
+            ingest = None
+            if self.shared_ingest and delta and handles:
+                ingest = self._pull_once(delta)
+
+            shared = getattr(self.session, "shared_stages", None)
+            r0 = shared.local["resumes"] \
+                if shared is not None and shared.enabled else 0
+            results: Dict[str, Optional[SinkCommit]] = {}
+            errors: Dict[str, BaseException] = {}
+            for h in handles:
+                # catch-up: files a FAILED earlier tick never
+                # committed ride ahead of this round's delta (the
+                # loan no longer spans the offer, so the runner
+                # falls back to its own pull — correct, unshared)
+                offer = [p for p in h._backlog if p not in offered] \
+                    + offered
+                try:
+                    with deadline_override(h.deadline_ms):
+                        h.runner.tick(offer, _ingest=ingest)
+                    results[h.name] = h.runner.last_sink_commit
+                    h._backlog = []
+                except Exception as exc:  # noqa: BLE001 - isolation:
+                    # the runner already rolled back to its committed
+                    # epoch; the fault is THIS subscriber's alone
+                    errors[h.name] = exc
+                    results[h.name] = None
+                    h._backlog = [p for p in offer
+                                  if p not in offered] + list(delta)
+            splices = (shared.local["resumes"] - r0) \
+                if shared is not None and shared.enabled else 0
+            self.last_round_errors = errors
+            self.last_round_info = {
+                "round": rnd,
+                "subscribers": len(handles),
+                "deltaFiles": len(delta),
+                "sourcePulls": len(delta) if ingest is not None
+                else len(delta) * len(handles),
+                "sharedIngest": ingest is not None,
+                "splices": int(splices),
+                "failures": len(errors),
+            }
+            from spark_rapids_tpu.utils.events import emit_on_session
+            emit_on_session(
+                "FleetRound", session=self.session,
+                round=rnd, subscribers=len(handles),
+                deltaFiles=len(delta),
+                sourcePulls=int(self.last_round_info["sourcePulls"]),
+                splices=int(splices), failures=len(errors))
+            return results
+
+    def close(self) -> None:
+        with self._lock:
+            handles, self._handles = list(self._handles.values()), {}
+        for h in handles:
+            h.runner.close()

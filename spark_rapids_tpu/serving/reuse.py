@@ -514,6 +514,15 @@ class SharedStageCache(CheckpointManager):
         # QueryEnd sharing dict — store-local counters would smear
         # across concurrent tenants
         self._by_ident: Dict[int, Dict[str, int]] = {}
+        # epoch tier: per standing-query store, a BY-REFERENCE
+        # snapshot of its committed shareable stage ids (store id →
+        # (store, epoch, frozenset(sids))).  Published ONLY from
+        # IncrementalStateStore.commit, replaced wholesale each
+        # commit, never advanced by a rollback — so everything
+        # reachable through it is a committed epoch's work.  Payloads
+        # stay in the owner store (no copy); a sid whose entry the
+        # owner has since evicted simply misses (degrade = recompute).
+        self._epoch_tiers: Dict[int, tuple] = {}
 
     # ----------------------------------------------------------- event taps --
     _EVENT_MAP = {"CheckpointWrite": "SharedStageWrite",
@@ -551,7 +560,8 @@ class SharedStageCache(CheckpointManager):
             return self._by_ident.pop(qc.effective_ident(), {})
 
     # ----------------------------------------------------------- operations --
-    def save(self, sid: str, frame, stages: int = 1) -> None:
+    def save(self, sid: str, frame, stages: int = 1,
+             shareable: bool = False) -> None:
         # saves hold the store lock end to end: they happen once per
         # NEW stage id (repeat saves early-exit in the base), and the
         # lock is what keeps _entries inserts + eviction iteration
@@ -582,7 +592,59 @@ class SharedStageCache(CheckpointManager):
         frame = super().restore(sid, mesh)
         if frame is not None:
             self._tally("spliceResumes")
-        return frame
+            return frame
+        # miss in the cache's own entries: a standing query may have
+        # published the sid with a committed epoch — ordinary queries
+        # splice committed tick work through the same fallback the
+        # co-subscribing ticks use
+        return self.epoch_restore(sid, mesh)
+
+    # ------------------------------------------------------------ epoch tier --
+    def publish_epoch(self, store, sids: frozenset) -> None:
+        """Replace ``store``'s snapshot with its newly COMMITTED
+        shareable sids (called from IncrementalStateStore.commit
+        only — the commit-time-only registration IS the tick-safety
+        invariant: provisional work is unreachable here, and a
+        rollback, publishing nothing, leaves the last committed
+        snapshot standing)."""
+        with _Locked(self._mu):
+            self._epoch_tiers[store.store_id] = (
+                store, store.epoch, frozenset(sids))
+
+    def retract_epoch(self, store) -> None:
+        """Drop ``store``'s snapshot (runner teardown)."""
+        with _Locked(self._mu):
+            self._epoch_tiers.pop(store.store_id, None)
+
+    def epoch_restore(self, sid: str, mesh, exclude=None):
+        """Materialize ``sid`` from some standing query's committed
+        epoch, or None.  Runs UNLOCKED like restore() (one short
+        locked snapshot of the tier map, then payload work outside the
+        lock); the hit bills as a SPLICE of this cache — event
+        (SharedStageSplice) and per-query tally both — because that is
+        what it is: cross-query reuse of committed work.  ``exclude``
+        skips the asking store's own snapshot (its local restore
+        already missed; its own entries are not a co-subscriber's)."""
+        if not self.enabled:
+            return None
+        with _Locked(self._mu):
+            tiers = list(self._epoch_tiers.values())
+        from spark_rapids_tpu.robustness.faults import CorruptionFault
+        for store, _epoch, sids in tiers:
+            if store is exclude or sid not in sids:
+                continue
+            entry = store._entries.get(sid)
+            if entry is None:
+                continue  # owner evicted it since publication
+            try:
+                batch = entry.handle.materialize()
+            except (CorruptionFault, OSError, ValueError):
+                continue  # owner's problem; it degrades on next use
+            frame = self._restore_body(sid, entry, batch, mesh)
+            if frame is not None:
+                self._tally("spliceResumes")
+                return frame
+        return None
 
     def drop(self, sid: str, reason: str, evict: bool = False) -> None:
         with _Locked(self._mu):
@@ -612,6 +674,8 @@ class SharedStageCache(CheckpointManager):
                     pass
             self._owners.clear()
             self._by_ident.clear()
+            self._epoch_tiers.clear()  # by-reference: owners hold
+            # the payloads and release them in their own close()
 
     def snapshot(self) -> Dict[str, int]:
         with _Locked(self._mu):

@@ -235,19 +235,25 @@ def parse_event_log(path: str) -> AppInfo:
                 (q.checkpoint if q is not None
                  else app.checkpoint).append(info)
             elif ev in ("StateCommit", "StateRollback", "StateEvict",
-                        "IncrementalResume", "StateWatermark"):
+                        "IncrementalResume", "StateWatermark",
+                        "SinkCommit", "FleetRound"):
                 info = {k: rec[k] for k in
                         ("epoch", "stateBytes", "entries", "mode",
                          "deltaFiles", "reusedState", "reason",
                          "bytes", "stageId", "stagesSaved",
                          "watermark", "evictedBuckets", "evictedRows",
-                         "evictedBytes", "stateRows", "store")
+                         "evictedBytes", "stateRows", "store",
+                         "crc", "rows", "replayed", "round",
+                         "subscribers", "sourcePulls", "splices",
+                         "failures")
                         if k in rec}
                 info["kind"] = {"StateCommit": "commit",
                                 "StateRollback": "rollback",
                                 "StateEvict": "evict",
                                 "IncrementalResume": "resume",
-                                "StateWatermark": "watermark"}[ev]
+                                "StateWatermark": "watermark",
+                                "SinkCommit": "sink",
+                                "FleetRound": "round"}[ev]
                 q = all_queries.get(rec.get("queryId"))
                 (q.incremental if q is not None
                  else app.incremental).append(info)

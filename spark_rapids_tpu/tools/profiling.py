@@ -201,6 +201,8 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, object]:
     state_bytes = 0
     watermarks: Dict[object, int] = {}  # per standing query (store id)
     wm_buckets = wm_bytes = 0
+    sink_commits = sink_replays = 0
+    rounds = round_pulls = round_splices = round_failures = 0
     for a in apps:
         events = list(a.incremental) + [e for q in a.queries
                                         for e in q.incremental]
@@ -224,7 +226,17 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, object]:
                     watermarks[e.get("store")] = e["watermark"]
                 wm_buckets += e.get("evictedBuckets", 0)
                 wm_bytes += e.get("evictedBytes", 0)
-    if not commits and not rollbacks:
+            elif kind == "sink":
+                if e.get("replayed"):
+                    sink_replays += 1
+                else:
+                    sink_commits += 1
+            elif kind == "round":
+                rounds += 1
+                round_pulls += e.get("sourcePulls", 0)
+                round_splices += e.get("splices", 0)
+                round_failures += e.get("failures", 0)
+    if not commits and not rollbacks and not rounds:
         return {}
     return {
         "commits": commits,
@@ -242,6 +254,15 @@ def incremental_stats(apps: List[AppInfo]) -> Dict[str, object]:
         "watermark": watermarks or None,
         "watermark_evicted_buckets": wm_buckets,
         "watermark_evicted_bytes": wm_bytes,
+        # exactly-once sinks: NEW committed emissions vs idempotent
+        # re-emissions of an already-committed epoch
+        "sink_commits": sink_commits,
+        "sink_replays": sink_replays,
+        # fleet rounds: shared-ingest fan-out effectiveness
+        "fleet_rounds": rounds,
+        "fleet_source_pulls": round_pulls,
+        "fleet_splices": round_splices,
+        "fleet_failures": round_failures,
     }
 
 
@@ -913,6 +934,41 @@ def _incremental_problems(who: str, events: List[dict]) -> List[str]:
                 "eviction is not bounding this standing query (check "
                 "ingest event times vs "
                 "incremental.watermarkDelayMs)")
+    # exactly-once violation: the same standing query committing a
+    # NEW (non-replayed) sink record under one epoch twice means a
+    # downstream sink saw an answer twice — the invariant the sink
+    # log exists to hold.  Replays are the sanctioned path and are
+    # excluded.
+    sink_seen: Dict[object, set] = {}
+    for e in events:
+        if e.get("kind") == "sink" and not e.get("replayed"):
+            seen = sink_seen.setdefault(e.get("store"), set())
+            ep = e.get("epoch")
+            if ep in seen:
+                out.append(
+                    f"{who}: duplicate sink emission (standing query "
+                    f"{e.get('store')}, epoch {ep}) — a downstream "
+                    "sink saw one committed answer twice; the "
+                    "exactly-once contract is broken")
+            seen.add(ep)
+    # fleet fan-out that stopped sharing: every round pulling the
+    # source once PER SUBSCRIBER means the shared-ingest loan is
+    # never usable (schema drift, metadata columns, subscriber
+    # backlogs) and the fleet pays lone-runner cost
+    rounds = [e for e in events if e.get("kind") == "round"
+              and e.get("subscribers", 0) > 1
+              and e.get("deltaFiles", 0) > 0]
+    if rounds:
+        unshared = [e for e in rounds
+                    if e.get("sourcePulls", 0) >
+                    e.get("deltaFiles", 0)]
+        if len(unshared) == len(rounds):
+            out.append(
+                f"{who}: every fleet round ({len(rounds)}) pulled the "
+                "source once per subscriber — the shared-ingest loan "
+                "was never usable (mismatched fact scans, metadata "
+                "columns, or subscriber catch-up backlogs); the fleet "
+                "is paying N-lone-runner ingest cost")
     return out
 
 
@@ -1271,6 +1327,16 @@ def format_report(apps: List[AppInfo], top: int) -> str:
                 f"  watermark={ic['watermark']} "
                 f"evictedBuckets={ic['watermark_evicted_buckets']} "
                 f"evictedBytes={ic['watermark_evicted_bytes']}")
+        if ic.get("sink_commits") or ic.get("sink_replays"):
+            out.append(
+                f"  sinks: commits={ic['sink_commits']} "
+                f"replays={ic['sink_replays']}")
+        if ic.get("fleet_rounds"):
+            out.append(
+                f"  fleet: rounds={ic['fleet_rounds']} "
+                f"sourcePulls={ic['fleet_source_pulls']} "
+                f"splices={ic['fleet_splices']} "
+                f"failures={ic['fleet_failures']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

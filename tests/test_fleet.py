@@ -1,0 +1,539 @@
+"""Standing-query fleet suite: shared-ingest fan-out, epoch-aware
+cross-subscriber reuse, and exactly-once sink emission
+(serving/fleet.py + the sink/epoch-tier legs of
+robustness/incremental.py and serving/reuse.py).
+
+Counter-pinned like test_incremental.py: source pulls are counted
+through skip-consumption injection rules, so a round that silently
+re-pulled the stream once per subscriber fails the test, not just a
+slower one.  Integer-valued doubles keep every answer bit-identical
+to its one-shot recompute oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+from spark_rapids_tpu.robustness.incremental import incremental_metrics
+
+pytestmark = pytest.mark.chaos
+
+NSHARDS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    incremental_metrics.reset()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    if jax.device_count() < NSHARDS:
+        pytest.skip("needs the virtual 8-device mesh")
+    return make_mesh(NSHARDS)
+
+
+_RNG = np.random.default_rng(29)
+
+
+def _write(d, i, n=2000):
+    pdf = pd.DataFrame({
+        "k": _RNG.integers(0, 20, n),
+        "v": _RNG.integers(0, 1000, n).astype(np.float64)})
+    p = str(d / f"batch-{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+
+def _session(mesh, **conf):
+    base = {"spark.rapids.sql.recovery.backoffMs": 1}
+    base.update(conf)
+    return TpuSession(base, mesh=mesh)
+
+
+def _agg_df(session, paths):
+    return (session.read.parquet(*paths)
+            .groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count("v").alias("c"),
+                 F.min("v").alias("mn"), F.avg("v").alias("av"))
+            .orderBy("k"))
+
+
+def _count_rule(point):
+    return I.inject(point, count=1, skip=1_000_000, all_threads=True)
+
+
+def _hits(rule):
+    return 1_000_000 - rule.skip
+
+
+# --------------------------------------------------- shared-ingest fan-out --
+def test_fleet_shared_ingest_read_once(mesh, tmp_path):
+    """The fleet acceptance pin: 8 standing aggregates over ONE
+    append-only stream tick in rounds that pull each new file exactly
+    once — 8 queries, 1 source pull — while every subscriber's answer
+    stays bit-identical to its lone one-shot oracle and its SinkCommit
+    epochs advance in lockstep with its own store."""
+    p0 = _write(tmp_path, 0)
+    s = _session(mesh)
+    fleet = s.fleet()
+    handles = [fleet.subscribe(_agg_df(s, [p0]), name=f"q{i}",
+                               deadline_ms=50 if i == 0 else 0)
+               for i in range(8)]
+    assert fleet.subscribers == [f"q{i}" for i in range(8)]
+
+    reads = _count_rule("io.read")
+    commits = fleet.tick()
+    r1 = _hits(reads)
+    # round 1: the loan spans the subscribers' common initial set, so
+    # even the cold full ticks ride the one shared pull
+    assert r1 == 1, r1
+    info = dict(fleet.last_round_info)
+    assert info["subscribers"] == 8 and info["sharedIngest"]
+    assert info["sourcePulls"] == info["deltaFiles"] == 1
+    assert info["failures"] == 0
+
+    p1 = _write(tmp_path, 1)
+    commits = fleet.tick([p1])
+    r2 = _hits(reads) - r1
+    I.remove(reads)
+    # the tentpole pin: one new file, 8 subscribers, ONE pull
+    assert r2 == 1, r2
+    info = dict(fleet.last_round_info)
+    assert info["sourcePulls"] == 1 and info["sharedIngest"]
+
+    oracle = _agg_df(s, [p0, p1]).to_pandas()
+    epochs = set()
+    for h in handles:
+        sc = commits[h.name]
+        assert sc is not None and not sc.replayed
+        assert sc.epoch == h.runner.store.epoch
+        pd.testing.assert_frame_equal(sc.df.to_pandas(), oracle)
+        assert h.last_tick_info["mode"] == "incremental"
+        epochs.add(sc.epoch)
+    assert epochs == {2}  # every subscriber on its own epoch 2
+    # every emission carried the same payload fingerprint
+    assert len({commits[h.name].crc for h in handles}) == 1
+    fleet.close()
+    s.stop()
+
+
+def test_fleet_duplicate_and_stale_offers(mesh, tmp_path):
+    """Round hygiene: a path offered twice in one round, or re-offered
+    after a prior round pulled it, is never re-pulled and never
+    double-ingested (a file watcher emitting [p, p] twice)."""
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    s = _session(mesh)
+    fleet = s.fleet()
+    h = fleet.subscribe(_agg_df(s, [p0]), name="a")
+    fleet.tick()
+    reads = _count_rule("io.read")
+    fleet.tick([p1, p1])
+    assert _hits(reads) == 1
+    fleet.tick([p1])          # stale re-offer: a no-op round
+    assert _hits(reads) == 1
+    I.remove(reads)
+    assert fleet.last_round_info["deltaFiles"] == 0
+    pd.testing.assert_frame_equal(
+        h.runner.last_sink_commit.df.to_pandas(),
+        _agg_df(s, [p0, p1]).to_pandas())
+    fleet.close()
+    s.stop()
+
+
+# ----------------------------------------- epoch-aware cross-query splice --
+def _file_dim(tmp_path, n=20):
+    dim = pd.DataFrame({"k": np.arange(n),
+                        "w": np.arange(n).astype(np.float64) + 1.0})
+    p = str(tmp_path / "dim.parquet")
+    dim.to_parquet(p, index=False)
+    return p
+
+
+def _join_df(s, pdim, paths):
+    dim_agg = (s.read.parquet(pdim).groupBy("k")
+               .agg(F.max("w").alias("w")))
+    return (s.read.parquet(*paths).join(dim_agg, "k").groupBy("k")
+            .agg(F.sum((F.col("v") * F.col("w")).alias("vw"))
+                 .alias("sx"),
+                 F.count("v").alias("c")).orderBy("k"))
+
+
+def test_fleet_cross_subscriber_epoch_splice(mesh, tmp_path):
+    """Two delta-join subscribers sharing a file-backed dimension
+    subtree: the second subscriber SPLICES the first's committed dim
+    aggregate from the shared cache's epoch tier instead of re-reading
+    the dim file — and steady-state rounds cost ONE fact pull total.
+    The direct shared-cache entries stay empty across ticks (tick work
+    is published by reference at commit, never registered)."""
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    pdim = _file_dim(tmp_path)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.serving.sharedStage.enabled": True})
+    fleet = s.fleet()
+    ha = fleet.subscribe(_join_df(s, pdim, [p0]), name="a", fact=p0)
+    hb = fleet.subscribe(_join_df(s, pdim, [p0]), name="b", fact=p0)
+
+    reads = _count_rule("io.read")
+    fleet.tick()
+    r1 = dict(fleet.last_round_info)
+    n1 = _hits(reads)
+    fleet.tick([p1])
+    r2 = dict(fleet.last_round_info)
+    n2 = _hits(reads) - n1
+    I.remove(reads)
+
+    # round 1: one shared fact pull + a's dim read; b splices a's
+    # committed dim aggregate from the epoch tier (2 reads, not 3)
+    assert r1["sourcePulls"] == 1
+    assert r1["splices"] + r2["splices"] >= 1, (r1, r2)
+    assert n1 == 2, n1
+    # steady state: the delta round is ONE read for two join queries
+    assert n2 == 1, n2
+    assert r2["sourcePulls"] == 1
+
+    # ticks registered nothing in the direct shared store — epoch-tier
+    # publication is by reference, and only at commit
+    assert len(s.shared_stages._entries) == 0
+    oracle = _join_df(s, pdim, [p0, p1]).to_pandas()
+    for h in (ha, hb):
+        pd.testing.assert_frame_equal(
+            h.runner.last_sink_commit.df.to_pandas(), oracle)
+    # the oracle ran OUTSIDE any tick: it registers directly
+    assert len(s.shared_stages._entries) > 0
+    tiers = s.shared_stages._epoch_tiers
+    assert ha.runner.store.store_id in tiers
+    store, epoch, sids = tiers[ha.runner.store.store_id]
+    assert store is ha.runner.store and epoch == ha.runner.store.epoch
+    # closing a subscriber retracts its tier — no dangling store refs
+    ha.close()
+    assert ha.runner.store is None or True  # handle is closed
+    assert len([k for k in tiers]) <= 1
+    fleet.close()
+    s.stop()
+
+
+# --------------------------------------------------- exactly-once emission --
+def test_fleet_sink_exactly_once_kill_and_replay(mesh, tmp_path):
+    """The exactly-once pin: a crash injected BETWEEN compute and
+    commit (the new incremental.sink.commit point) rolls the epoch
+    back and the degraded retry emits exactly ONE new committed
+    record; a zero-delta replay re-emits the SAME committed epoch
+    idempotently (no new record); a payload bit-flip in the window is
+    caught by the CRC riding the commit and degrades to a clean
+    recompute whose emission matches the co-subscriber bit-for-bit."""
+    p0, p1, p2 = (_write(tmp_path, i) for i in range(3))
+    s = _session(mesh)
+    fleet = s.fleet()
+    ha = fleet.subscribe(_agg_df(s, [p0]), name="a")
+    hb = fleet.subscribe(_agg_df(s, [p0]), name="b")
+    fleet.tick()
+
+    # crash between compute and commit: subscriber a's first sink
+    # hand-off dies; the tick rolls back and the degraded recompute
+    # commits — ONE new record for the data tick, zero duplicates
+    with I.injected("incremental.sink.commit", count=1):
+        commits = fleet.tick([p1])
+    assert fleet.last_round_info["failures"] == 0
+    sa, sb = commits["a"], commits["b"]
+    oracle = _agg_df(s, [p0, p1]).to_pandas()
+    pd.testing.assert_frame_equal(sa.df.to_pandas(), oracle)
+    assert sa.crc == sb.crc and sa.rows == sb.rows
+    assert not sa.replayed and not sb.replayed
+    assert "rollbackFrom" in ha.runner.last_tick_info
+    assert sorted(ha.runner.store._sink) == [1, 2]  # one per tick
+    assert "rollbackFrom" not in hb.runner.last_tick_info  # isolation
+
+    # zero-delta replay: the SAME committed epoch re-emits, flagged,
+    # with no new sink record
+    m0 = incremental_metrics.snapshot()
+    commits = fleet.tick()
+    ra = commits["a"]
+    assert ra.replayed and ra.epoch == sa.epoch and ra.crc == sa.crc
+    assert sorted(ha.runner.store._sink) == [1, 2]
+    assert ha.last_tick_info["sinkReplayed"]
+    m1 = incremental_metrics.snapshot()
+    assert m1["sinkReplays"] - m0["sinkReplays"] == 2  # a and b
+    pd.testing.assert_frame_equal(ra.df.to_pandas(), oracle)
+
+    # payload rot between compute and commit: the CRC gate turns it
+    # into a rollback + recompute, never a corrupt emission
+    with I.injected("incremental.sink.commit", count=1,
+                    kind="corrupt"):
+        commits = fleet.tick([p2])
+    oracle = _agg_df(s, [p0, p1, p2]).to_pandas()
+    assert "rollbackFrom" in ha.runner.last_tick_info
+    assert commits["a"].crc == commits["b"].crc
+    pd.testing.assert_frame_equal(commits["a"].df.to_pandas(), oracle)
+    # exactly one NEW record per data tick (the replay round added
+    # none), and the newest one is this commit's epoch
+    assert len(ha.runner.store._sink) == 3
+    assert max(ha.runner.store._sink) == commits["a"].epoch
+    fleet.close()
+    s.stop()
+
+
+def test_fleet_rollback_leaves_committed_state(mesh, tmp_path):
+    """Commit-only registration, pinned from the rollback side: a tick
+    that dies mid-flight (recovery disabled, so the fault surfaces)
+    leaves the shared cache's epoch tier, the sink log, and the epoch
+    store's entries EXACTLY at their committed snapshots — a
+    pre-commit entry can never leak into cross-query reuse."""
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    pdim = _file_dim(tmp_path)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.serving.sharedStage.enabled": True,
+        "spark.rapids.sql.recovery.enabled": False})
+    fleet = s.fleet()
+    ha = fleet.subscribe(_join_df(s, pdim, [p0]), name="a", fact=p0)
+    fleet.tick()
+    store = ha.runner.store
+    tier0 = dict(s.shared_stages._epoch_tiers)
+    sink0 = dict(store._sink)
+    entries0 = set(store._entries)
+    epoch0 = store.epoch
+
+    with I.injected("incremental.sink.commit", count=2):
+        fleet.tick([p1])
+    assert fleet.last_round_info["failures"] == 1
+    with pytest.raises(Exception):
+        raise fleet.last_round_errors["a"]
+    # everything sink-visible and share-visible is still the committed
+    # snapshot: same tier tuples, same sink records, same entries
+    assert dict(s.shared_stages._epoch_tiers) == tier0
+    assert dict(store._sink) == sink0
+    assert set(store._entries) == entries0 and store.epoch == epoch0
+
+    # the next round catches the subscriber up (its backlog exceeds
+    # the loan, so it pulls its own history) and commits cleanly
+    commits = fleet.tick()
+    sc = commits["a"]
+    assert sc is not None and sc.epoch == epoch0 + 1
+    pd.testing.assert_frame_equal(
+        sc.df.to_pandas(), _join_df(s, pdim, [p0, p1]).to_pandas())
+    fleet.close()
+    s.stop()
+
+
+def test_fleet_fault_isolation(mesh, tmp_path):
+    """One subscriber's chaos fault is THAT subscriber's alone: the
+    co-subscribers' ticks commit clean answers with zero rollbacks,
+    the faulted handle re-raises its own error, and the faulted
+    subscriber catches up on the next round."""
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    s = _session(mesh, **{
+        "spark.rapids.sql.recovery.enabled": False})
+    fleet = s.fleet()
+    ha = fleet.subscribe(_agg_df(s, [p0]), name="a")
+    hb = fleet.subscribe(_agg_df(s, [p0]), name="b")
+    hc = fleet.subscribe(_agg_df(s, [p0]), name="c")
+    fleet.tick()
+
+    # subscriber a ticks first: its state write dies (and with
+    # recovery off, so does its degraded retry path's write)
+    with I.injected("incremental.state.write", count=2):
+        with pytest.raises(Exception):
+            ha.tick([p1])
+    info = dict(fleet.last_round_info)
+    assert info["failures"] == 1
+    assert set(fleet.last_round_errors) == {"a"}
+    oracle = _agg_df(s, [p0, p1]).to_pandas()
+    for h in (hb, hc):
+        assert "rollbackFrom" not in h.runner.last_tick_info
+        pd.testing.assert_frame_equal(
+            h.runner.last_sink_commit.df.to_pandas(), oracle)
+    assert ha.runner.store.epoch == 1  # still the committed epoch
+
+    # catch-up round: a's backlog (p1) re-ingests; b and c replay
+    commits = fleet.tick()
+    assert fleet.last_round_info["failures"] == 0
+    pd.testing.assert_frame_equal(
+        commits["a"].df.to_pandas(), oracle)
+    assert commits["b"].replayed and commits["c"].replayed
+    fleet.close()
+    s.stop()
+
+
+# ------------------------------------------------ watermark independence --
+def _write_win(d, i, tick, n=1500, base="2024-01-01"):
+    ts = pd.Series(pd.to_datetime(base) + pd.to_timedelta(
+        tick * 600 + _RNG.integers(0, 600, n), unit="s"))
+    pdf = pd.DataFrame({
+        "k": _RNG.integers(0, 8, n),
+        "v": _RNG.integers(0, 1000, n).astype(np.float64),
+        "ts": ts})
+    p = str(d / f"win-{i:03d}.parquet")
+    pdf.to_parquet(p, index=False)
+    return p
+
+
+def _win_df(s, paths):
+    return (s.read.parquet(*paths)
+            .groupBy(F.window("ts", "10 minutes"), "k")
+            .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))
+            .orderBy("window.start", "k"))
+
+
+def _win_oracle(df, wm):
+    return df.filter(
+        F.col("window.end").isNull() |
+        (F.col("window.end") > pd.Timestamp(wm, unit="us"))
+    ).to_pandas()
+
+
+def test_fleet_watermark_independence(mesh, tmp_path):
+    """Two windowed subscribers over ONE shared ingest, each with its
+    own watermarkDelayMs override: eviction schedules stay
+    independent (the tight subscriber's state plateaus well below the
+    loose one's) while every tick of each matches its OWN
+    watermark-filtered oracle — and the rounds still pull once."""
+    w0 = _write_win(tmp_path, 0, 0)
+    s = _session(mesh)
+    fleet = s.fleet()
+    # tight: 2-bucket horizon; loose: effectively never evicts.
+    # Each subscriber keeps ITS df — the runner grows its scan's
+    # path list at commit, so the df doubles as recompute oracle.
+    dfs = {"tight": _win_df(s, [w0]), "loose": _win_df(s, [w0])}
+    tight = fleet.subscribe(dfs["tight"], name="tight",
+                            watermark_delay_ms=1_200_000)
+    loose = fleet.subscribe(dfs["loose"], name="loose",
+                            watermark_delay_ms=3_600_000_000)
+    fleet.tick()
+    assert tight.runner._spec.delay_us == 1_200_000 * 1000
+    assert loose.runner._spec.delay_us == 3_600_000_000 * 1000
+
+    reads = _count_rule("io.read")
+    for t in range(1, 9):
+        p = _write_win(tmp_path, t, t)
+        r0 = _hits(reads)
+        commits = fleet.tick([p])
+        # one pull per round for the two windowed subscribers (the
+        # oracle queries below read outside the counter window)
+        assert _hits(reads) - r0 == 1
+        assert fleet.last_round_info["sourcePulls"] == 1
+        for h in (tight, loose):
+            info = h.last_tick_info
+            assert info["shape"] == "window"
+            pd.testing.assert_frame_equal(
+                commits[h.name].df.to_pandas(),
+                _win_oracle(dfs[h.name], info["watermark"]))
+    I.remove(reads)
+
+    # independent eviction: same ingest, different horizons — the
+    # tight subscriber's watermark leads (smaller delay off the same
+    # event-time frontier) and its state plateaus far lower
+    assert tight.runner.store.state_watermark > \
+        loose.runner.store.state_watermark
+    assert tight.runner.store._agg.nrows < \
+        loose.runner.store._agg.nrows, (
+            tight.runner.store._agg.nrows,
+            loose.runner.store._agg.nrows)
+    assert tight.runner.store._agg.nrows <= 4 * 8
+    fleet.close()
+    s.stop()
+
+
+# ------------------------------------------------------ tick-marker split --
+def test_fleet_on_commit_queries_use_caches(mesh, tmp_path):
+    """Both directions of the tick-marker split: queries issued from
+    an on_commit callback (tick SCOPE, not tick EXECUTION) ride the
+    ResultCache and register shared stages like any ordinary query,
+    while the runner's own executions — and the fleet's shared pull —
+    still never touch either."""
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.serving.resultCache.enabled": True,
+        "spark.rapids.tpu.serving.sharedStage.enabled": True})
+    seen = []
+
+    def on_commit(sc):
+        from spark_rapids_tpu.robustness.incremental import (
+            in_tick, in_tick_execution)
+        assert in_tick() and not in_tick_execution()
+        # an ordinary query from the callback: second run must HIT
+        probe = (s.read.parquet(p0).groupBy("k")
+                 .agg(F.count("v").alias("c")).orderBy("k"))
+        h0 = s.result_cache.snapshot()["hits"]
+        probe.to_pandas()
+        probe.to_pandas()
+        seen.append(s.result_cache.snapshot()["hits"] - h0)
+
+    fleet = s.fleet()
+    fleet.subscribe(_agg_df(s, [p0]), name="a", on_commit=on_commit)
+    fleet.tick()
+    snap0 = s.result_cache.snapshot()
+    fleet.tick([p1])
+    snap1 = s.result_cache.snapshot()
+    assert seen and all(n >= 1 for n in seen)
+    # the runner's executions and the shared pull stored NOTHING new
+    # beyond the callback's probe entry (one plan, one store)
+    assert snap1["stores"] - snap0["stores"] <= 1
+    fleet.close()
+    s.stop()
+
+
+# ------------------------------------------------------------ observability --
+def test_fleet_events_and_health(mesh, tmp_path):
+    """SinkCommit and FleetRound flow into the eventlog tools (sink
+    commit/replay and fleet round/pull/splice tallies in
+    incremental_stats and the report) and the two new health checks
+    fire on synthetic violation trails while staying quiet on clean
+    ones."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (_incremental_problems,
+                                                  format_report,
+                                                  incremental_stats)
+    logdir = tmp_path / "events"
+    p0, p1 = _write(tmp_path, 0), _write(tmp_path, 1)
+    s = _session(mesh, **{
+        "spark.rapids.tpu.eventLog.dir": str(logdir)})
+    fleet = s.fleet()
+    fleet.subscribe(_agg_df(s, [p0]), name="a")
+    fleet.subscribe(_agg_df(s, [p0]), name="b")
+    fleet.tick()
+    fleet.tick([p1])
+    fleet.tick()  # replay round
+    fleet.close()
+    s.stop()
+
+    apps = load_logs(str(logdir))
+    stats = incremental_stats(apps)
+    assert stats["sink_commits"] >= 4     # 2 subscribers x 2 ticks
+    assert stats["sink_replays"] >= 2     # the zero-delta round
+    assert stats["fleet_rounds"] == 3
+    assert stats["fleet_source_pulls"] == 2
+    assert stats["fleet_failures"] == 0
+    report = format_report(apps, top=5)
+    assert "sinks: commits=" in report and "fleet: rounds=" in report
+
+    # duplicate-emission health check: two NEW records on one epoch
+    dup = [{"kind": "sink", "store": 7, "epoch": 3, "replayed": False},
+           {"kind": "sink", "store": 7, "epoch": 3, "replayed": False}]
+    assert any("duplicate sink emission" in p
+               for p in _incremental_problems("app", dup))
+    replays = [{"kind": "sink", "store": 7, "epoch": 3,
+                "replayed": False},
+               {"kind": "sink", "store": 7, "epoch": 3,
+                "replayed": True}]
+    assert not any("duplicate sink emission" in p
+                   for p in _incremental_problems("app", replays))
+
+    # never-shared health check: every round paying N-lone-pull cost
+    unshared = [{"kind": "round", "subscribers": 4, "deltaFiles": 1,
+                 "sourcePulls": 4} for _ in range(3)]
+    assert any("shared-ingest loan" in p
+               for p in _incremental_problems("app", unshared))
+    mixed = unshared + [{"kind": "round", "subscribers": 4,
+                         "deltaFiles": 1, "sourcePulls": 1}]
+    assert not any("shared-ingest loan" in p
+                   for p in _incremental_problems("app", mixed))
