@@ -1072,6 +1072,132 @@ def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
     sys.stdout.flush()
 
 
+# ----------------------------------------------------- template serving --
+def template_qps_main(target_qps: int, seconds: float = 4.0) -> None:
+    """Prepared-statement serving bench (plan templates): a q6-family
+    stream whose filter literals are randomized per query, driven
+    through prepared handles on N client threads.  Phase 1 holds the
+    literals FIXED (the no-churn baseline); phase 2 randomizes them
+    from a small pool every run.  Emits ONE JSON line with aggregate
+    queries/s, p50/p95 per-phase latency (p95 flat across phases is
+    the headline), and the pinned counters — retraces (in-memory jit
+    misses), persistent-tier misses, and planning passes on repeats
+    must all be ZERO after warmup, or the template tier bought
+    nothing.  Template-tier hit ratio reflects pool reuse.  Runs
+    in-process on whatever platform jax resolves (set JAX_PLATFORMS=cpu
+    for the tunnel-proof number)."""
+    import random
+    import threading
+
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.ops import jit_cache
+    from spark_rapids_tpu.plan import overrides as _ov
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+
+    n_threads = int(os.environ.get("BENCH_TEMPLATE_THREADS", "4"))
+    n_rows = 1 << 16
+    session = TpuSession(trace_conf({
+        "spark.rapids.tpu.template.enabled": "true",
+        "spark.rapids.tpu.serving.resultCache.enabled": "true",
+        "spark.rapids.tpu.template.resultCache.enabled": "true",
+    }))
+    df = session.create_dataframe(gen_host(n_rows))
+    base = (df.filter(
+        (F.col("l_shipdate") >= F.lit(9131)) &
+        (F.col("l_shipdate") < F.lit(9496)) &
+        (F.col("l_discount") >= F.lit(0.05)) &
+        (F.col("l_discount") <= F.lit(0.07)) &
+        (F.col("l_quantity") < F.lit(24.0)))
+        .select((F.col("l_extendedprice") * F.col("l_discount"))
+                .alias("rev"))
+        .agg(F.sum(F.col("rev")).alias("revenue")))
+    # one handle per thread: ParamSlot bindings are per-handle mutable
+    # state, and handles with identical plans share every jit entry
+    handles = [session.prepare(base) for _ in range(n_threads)]
+    # literal pool: ~32 distinct vectors => churn with some repeats,
+    # so the template-tier hit ratio is meaningful
+    rng = random.Random(42)
+    pool = [(9131 + rng.randrange(0, 300), 9496 + rng.randrange(0, 300),
+             round(0.02 + 0.01 * rng.randrange(0, 6), 2),
+             float(rng.randrange(20, 40)))
+            for _ in range(32)]
+    for h in handles:  # warmup: trace + plan, outside every counter
+        h.run_batches()
+    jit0 = jit_cache.cache_info()
+    pjit0 = jit_cache.persistent_info()
+    plan0 = _ov.planning_passes()
+    rc_cache = session.result_cache
+    th0, tm0 = rc_cache.template_hits, rc_cache.template_misses
+
+    def phase(churn: bool):
+        lat, lock = [], threading.Lock()
+        stop_at = time.monotonic() + seconds / 2.0
+
+        def client(h):
+            local = []
+            while time.monotonic() < stop_at:
+                if churn:
+                    lo, hi, d, q = pool[rng.randrange(len(pool))]
+                else:
+                    lo, hi, d, q = pool[0]
+                t0 = time.perf_counter()
+                h.run_batches(lo, hi, d - 0.01, d + 0.01, q)
+                local.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(local)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(h,))
+                   for h in handles]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return lat, wall
+
+    fixed_lat, fixed_wall = phase(churn=False)
+    churn_lat, churn_wall = phase(churn=True)
+    jit1 = jit_cache.cache_info()
+    pjit1 = jit_cache.persistent_info()
+    plan1 = _ov.planning_passes()
+    th1, tm1 = rc_cache.template_hits, rc_cache.template_misses
+    queries = len(fixed_lat) + len(churn_lat)
+    qps = queries / max(fixed_wall + churn_wall, 1e-9)
+    hits, misses = th1 - th0, tm1 - tm0
+    print(json.dumps({
+        "metric": "template_qps",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "target_qps": target_qps,
+        "threads": n_threads,
+        "rows": n_rows,
+        "queries": queries,
+        "fixed_p50_ms": round(
+            nearest_rank(fixed_lat, 0.50) * 1e3, 3),
+        "fixed_p95_ms": round(
+            nearest_rank(fixed_lat, 0.95) * 1e3, 3),
+        "churn_p50_ms": round(
+            nearest_rank(churn_lat, 0.50) * 1e3, 3),
+        "churn_p95_ms": round(
+            nearest_rank(churn_lat, 0.95) * 1e3, 3),
+        "retraces": jit1["misses"] - jit0["misses"],
+        "persistent_misses": pjit1["misses"] - pjit0["misses"],
+        "planning_passes": plan1 - plan0,
+        "template_hits": hits,
+        "template_misses": misses,
+        "template_hit_ratio": round(
+            hits / max(hits + misses, 1), 4),
+        "param_count": handles[0].param_count,
+        "refusals": [r for r, _ in handles[0].refusals],
+        **span_frac_fields(session),
+    }))
+    sys.stdout.flush()
+    session.stop()
+
+
 # ------------------------------------------------------- overlap workload --
 def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
     """Overlapping-workload serving bench (the ISSUE 13 acceptance
@@ -1330,6 +1456,11 @@ if __name__ == "__main__":
         idx = sys.argv.index("--repeat")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 5
         repeat_main(n)
+    elif "--template-qps" in sys.argv:
+        idx = sys.argv.index("--template-qps")
+        n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 1000
+        template_qps_main(n, float(os.environ.get(
+            "BENCH_TEMPLATE_SECONDS", "4")))
     else:
         _install_safety_net()
         main()

@@ -1135,4 +1135,69 @@ print(f"fleet soak OK ({ROUNDS} chaos rounds x 8 subscribers exact, "
       f"splices={stats['fleet_splices']})")
 PY
 
+echo "== template spray (prepared statements + template cache under corrupt/raise/delay on templatecache.load: exact answers, zero planning passes, rot invalidates then re-stores) =="
+# ISSUE 17 gate: a prepared handle serves randomized literal bindings
+# while corrupt/raise/delay rules rot every templatecache.load.  A
+# degraded load is a recompute MISS on the handle's cached physical
+# plan — never a wrong answer, never a failed query, and never a
+# planning pass (prepare paid for planning once; cache rot must not
+# smuggle one back in).  Corruption must actually land (CRC-gated
+# invalidations >= 1) and the clean wave after the spray must hit
+# again (rot evicts entries, it does not poison the tier).
+python - <<'PY'
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.plan import overrides as OV
+from spark_rapids_tpu.robustness import inject as I
+
+rng = np.random.default_rng(7)
+pdf = pd.DataFrame({"k": rng.integers(0, 16, 4000),
+                    "v": rng.normal(size=4000),
+                    "q": rng.uniform(1.0, 50.0, 4000)})
+s = TpuSession({
+    "spark.rapids.tpu.template.enabled": True,
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.template.resultCache.enabled": True,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+})
+df = (s.create_dataframe(pdf)
+      .filter((F.col("q") >= F.lit(5.0)) & (F.col("q") < F.lit(20.0)))
+      .select((F.col("v") * F.col("q")).alias("rev"))
+      .agg(F.sum(F.col("rev")).alias("revenue")))
+h = s.prepare(df)
+assert h.param_count == 2 and not h.refusals, h.describe()
+VECTORS = [(5.0, 20.0), (7.5, 30.0), (2.0, 44.0), (11.0, 13.0)]
+# warm wave: each binding computes once and stores a template entry
+want = {vec: h.run(*vec) for vec in VECTORS}
+p0 = OV.planning_passes()
+with I.scoped_rules():
+    I.inject("templatecache.load", kind="corrupt", count=3,
+             probability=0.6, seed=43, all_threads=True)
+    I.inject("templatecache.load", count=2, probability=0.4, seed=47,
+             all_threads=True)
+    I.inject("templatecache.load", kind="delay", delay_s=0.2, count=2,
+             probability=0.4, seed=53, all_threads=True)
+    for _ in range(2):
+        for vec in VECTORS:
+            assert h.run(*vec) == want[vec], vec
+snap = s.result_cache.snapshot()
+assert snap["templateHits"] >= 1, snap
+assert snap["invalidations"] >= 1, "corrupt rule never rotted a load"
+assert OV.planning_passes() == p0, \
+    "cache rot smuggled a planning pass into a prepared repeat"
+# clean wave: rot-invalidated entries were re-stored and hit again
+for vec in VECTORS:
+    assert h.run(*vec) == want[vec], vec
+snap2 = s.result_cache.snapshot()
+assert snap2["templateHits"] > snap["templateHits"], (snap, snap2)
+s.stop()
+print("template spray OK (4 bindings x 3 waves exact, "
+      f"templateHits={snap2['templateHits']} "
+      f"templateStores={snap2['templateStores']} "
+      f"invalidations={snap2['invalidations']}, planning passes 0)")
+PY
+
 echo "CHAOS OK"

@@ -181,6 +181,13 @@ class DataFrame:
     def __init__(self, session, plan: L.LogicalPlan):
         self.session = session
         self.plan = plan
+        # plan-template state (plan/template.py): _template is the
+        # TemplateInfo active for the CURRENT execution (set fresh per
+        # _execute_batches); _prepared is the owning PreparedStatement
+        # handle, whose pre-hoisted template and cached physical plan
+        # repeats reuse
+        self._template = None
+        self._prepared = None
 
     # ------------------------------------------------------------- transforms --
     @property
@@ -635,9 +642,55 @@ class DataFrame:
             from spark_rapids_tpu.robustness.incremental import (
                 in_tick_execution)
             tick = in_tick_execution()
+            # parameterized plan templates (plan/template.py): hoist
+            # constant literals into typed parameter slots so the
+            # jit / AOT / fused-stage tiers key on the TEMPLATE and
+            # the values ride as device-scalar dispatch arguments —
+            # zero retrace across literal churn.  Default-off; tick
+            # executions keep the exact path (their plans are over
+            # transient state relations).  A prepared handle
+            # (api/prepared.py) injects its pre-hoisted template
+            # instead of re-hoisting per run.
+            from spark_rapids_tpu.config import rapids_conf as rc
+            prep = getattr(self, "_prepared", None)
+            info = None
+            if prep is not None:
+                info = prep.info
+                self._template = info
+            elif not tick and \
+                    self.session.conf.get(rc.TEMPLATE_ENABLED):
+                from spark_rapids_tpu.plan.template import (
+                    hoist_literals)
+                info = hoist_literals(self.plan)
+                self._template = info if info.hoisted else None
+            else:
+                self._template = None
+            if info is not None:
+                # template facts ride the QueryEnd sharing dict: the
+                # profiling health check groups repeats by fingerprint
+                # and explains a template that bought nothing via the
+                # refusal list (knobs-off streams stay HEAD-identical
+                # — this only fires when template.enabled is on)
+                ctx.sharing["template"] = {
+                    "fingerprint": info.fingerprint[:16],
+                    "params": info.param_count,
+                    "refusals": sorted({r for r, _ in info.refusals}),
+                }
             cache = getattr(self.session, "result_cache", None)
             pend = None
-            if cache is not None and not tick:
+            use_template_cache = (
+                cache is not None and not tick
+                and self._template is not None
+                and self.session.conf.get(
+                    rc.TEMPLATE_RESULT_CACHE_ENABLED))
+            if use_template_cache:
+                # template tier: keyed on (template fingerprint,
+                # parameter vector).  The template PLAN's exact key is
+                # value-free (ParamSlot cache keys carry no binding),
+                # so templated runs must never key the exact tier on
+                # it — two bindings would alias.
+                pend = cache.offer_template(self._template)
+            elif cache is not None and not tick:
                 pend = cache.offer(self.plan)
             if pend is not None and pend.hit:
                 return self._answer_from_cache(pend)
@@ -650,7 +703,10 @@ class DataFrame:
                 # re-consult before paying for a redundant run.  The
                 # first offer already counted this query's miss —
                 # count_miss=False keeps the hit rate honest.
-                pend = cache.offer(self.plan, count_miss=False)
+                pend = cache.offer_template(
+                    self._template, count_miss=False) \
+                    if use_template_cache else \
+                    cache.offer(self.plan, count_miss=False)
                 if pend.hit:
                     return self._answer_from_cache(pend)
             driver = QueryRetryDriver(self.session)
@@ -701,18 +757,21 @@ class DataFrame:
         so the event stream, profiling and concurrency timeline see
         the query, then answer from the store — zero executions."""
         events = getattr(self.session, "events", None)
+        note = "template-cache hit" \
+            if getattr(pend, "tier", "exact") == "template" \
+            else "result-cache hit"
         if events is not None and events.enabled:
             qid = next(self.session._query_ids)
             self.session._current_qid = qid
             events.emit("QueryStart", queryId=qid,
                         logicalPlan=self.plan.tree_string(),
                         physicalPlan="ResultCache",
-                        explain="result-cache hit")
+                        explain=note)
             events.emit("QueryEnd", queryId=qid, status="success",
                         durationMs=0.0, metrics={}, spill={},
                         retry={}, sharing=self._sharing_info(),
-                        explain="result-cache hit")
-        self.session.last_dist_explain = "result-cache hit"
+                        explain=note)
+        self.session.last_dist_explain = note
         return pend.batches
 
     def _flush_fatal_trail(self, driver, exc: BaseException) -> None:
@@ -793,8 +852,18 @@ class DataFrame:
                 if mode.batch_scale == 1.0 else
                 "demoted: single-device split-batch replan "
                 "(query recovery)")
+        template = getattr(self, "_template", None)
+        if mesh is not None and template is not None:
+            # distributed/parallel kernels build EmitContexts without
+            # a parameter vector: a templated plan executes on the
+            # single-process engine (whose stage + fused-aggregate
+            # kernels thread params) rather than silently failing
+            # every slot emit on the mesh
+            self.session.last_dist_explain = (
+                "template: single-process execution "
+                "(parameterized kernels)")
         if mode.use_mesh and mode.batch_scale == 1.0 and \
-                mesh is not None:
+                mesh is not None and template is None:
             # mesh session: offer the plan to the distributed planner
             # first (planner-inserted exchange analog); unsupported plans
             # fall through to the single-process engine.  The split
@@ -960,10 +1029,24 @@ class DataFrame:
     def _run_single_process(self, mode,
                             overrides=None) -> List[ColumnarBatch]:
         import time as _time
+        template = getattr(self, "_template", None)
+        logical = template.plan if template is not None else self.plan
+        prep = getattr(self, "_prepared", None)
         if mode.cpu_only:
-            exec_plan = self.session.plan_cpu_only(self.plan)
+            exec_plan = self.session.plan_cpu_only(logical)
+        elif prep is not None and template is not None \
+                and overrides is None:
+            # prepared repeat on the baseline rung: reuse the handle's
+            # cached physical plan — zero planning / override-translation
+            # passes.  Ladder re-drives (cpu_only above, split-batch
+            # overrides here) re-plan: their rung parameters are
+            # captured into exec nodes at plan time.
+            exec_plan = prep.exec_plan
+            if exec_plan is None:
+                exec_plan = self.session.plan(logical)
+                prep.exec_plan = exec_plan
         else:
-            exec_plan = self.session.plan(self.plan,
+            exec_plan = self.session.plan(logical,
                                           overrides=overrides)
         self._last_exec = exec_plan
         from spark_rapids_tpu.utils import tracing

@@ -441,6 +441,17 @@ class TpuSession:
         from spark_rapids_tpu.sql import parse, resolve
         return resolve(self, parse(query))
 
+    def prepare(self, df: DataFrame):
+        """Prepare ``df`` as a parameterized plan template
+        (api/prepared.py): the literal-hoisting pass runs ONCE, and
+        each ``handle.run(p0=..., ...)`` binds a fresh parameter
+        vector and executes — zero re-planning, zero retracing and
+        zero recompilation across literal churn, while admission,
+        budgets, the recovery ladder and span tracing all still
+        apply.  Requires ``spark.rapids.tpu.template.enabled``."""
+        from spark_rapids_tpu.api.prepared import PreparedStatement
+        return PreparedStatement(self, df)
+
     # --------------------------------------------------- continuous ingest --
     def incremental(self, df: DataFrame, fact: Optional[str] = None,
                     watermark_delay_ms: Optional[int] = None):

@@ -925,6 +925,35 @@ SERVING_SHARED_STAGE_MAX_BYTES = conf(
     "re-runs its subtree on the next query that wanted it.",
     _to_int, _positive)
 
+TEMPLATE_ENABLED = conf(
+    "spark.rapids.tpu.template.enabled", False,
+    "Parameterized plan templates (plan/template.py): before "
+    "planning, constant literals are hoisted out of the logical plan "
+    "into typed parameter slots with VALUE-FREE cache keys, so the "
+    "stage-compiler signatures, fused-aggregate programs and "
+    "persistent AOT entries all key on the normalized template and "
+    "the literal values travel as device-scalar arguments at "
+    "dispatch — a dashboard plan re-issued with shifting literals "
+    "retraces and recompiles ZERO times after warmup. Hoisting "
+    "refuses literals that change plan shape (nulls, strings, "
+    "decimals, ANSI-check constants, LIMIT/slot constants, unaliased "
+    "projection names) — refused shapes fall back to exact keying "
+    "and produce byte-identical results. Default off; with it off "
+    "every plan takes the exact-key path bit-identically.", _to_bool)
+
+TEMPLATE_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.template.resultCache.enabled", False,
+    "TEMPLATE tier of the serving result cache (serving/reuse.py): "
+    "answered queries also store under (normalized template "
+    "fingerprint, parameter vector), so the SAME dashboard re-issued "
+    "with the SAME literals hits even when the exact plan text was "
+    "never seen in this form (prepared statements, re-hoisted "
+    "ad-hoc plans). Same verification discipline as the exact tier "
+    "— input fingerprints statted fresh at lookup, CRC re-verified "
+    "on every hit, failures degrade to recompute. Requires BOTH "
+    "template.enabled and serving.resultCache.enabled; shares the "
+    "exact tier's byte budget.", _to_bool)
+
 INCREMENTAL_ENABLED = conf(
     "spark.rapids.tpu.incremental.enabled", True,
     "Enable incremental state for continuous micro-batch ingest "

@@ -30,10 +30,12 @@ from spark_rapids_tpu.exec.base import (
     AGG_TIME, CONCAT_TIME, NUM_INPUT_BATCHES, NUM_INPUT_ROWS, Schema, TpuExec)
 from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops.compiler import (
-    StageFn, batch_to_flat, capacity_of, colvals_to_columns, flat_to_colvals)
+    StageFn, batch_to_flat, capacity_of, colvals_to_columns, flat_to_colvals,
+    param_args, params_dict)
 from spark_rapids_tpu.ops.concat import concat_batches
 from spark_rapids_tpu.ops.expressions import (
-    Alias, BoundReference, ColVal, EmitContext, Expression)
+    Alias, BoundReference, ColVal, EmitContext, Expression,
+    collect_param_slots)
 from spark_rapids_tpu.plan.logical import AggregateExpression
 
 
@@ -218,6 +220,15 @@ class TpuHashAggregateExec(TpuExec):
         self._ord_encoders: Dict[int, _StringKeyEncoder] = {}
         self._kgroup: List[Expression] = list(self.group_exprs)
         self.max_dict_size = int(max_dict_size)
+        # hoisted-literal slots across every kernel-evaluated expression
+        # (keys, agg children, fused pre-filter conjuncts): the jitted
+        # bodies take them as one trailing argument vector, so template
+        # signatures (value-free ParamSlot cache keys) share executables
+        # across literal bindings
+        self._slots = collect_param_slots(
+            list(self.group_exprs)
+            + [f.child for f in self.funcs if f.child is not None]
+            + self.pre_filters)
 
         if self._single_pass:
             # collect aggregates: one grouped pass over the concatenated
@@ -453,14 +464,20 @@ class TpuHashAggregateExec(TpuExec):
             return None
         return fold_conjuncts(ctx, self.pre_filters)
 
-    def _update_fused(self, flat_cols, nrows):
+    def _pargs(self):
+        """Dispatch-time ParamSlot argument vector (empty when the
+        operator's expressions carry no hoisted literals)."""
+        return param_args(self._slots)
+
+    def _update_fused(self, flat_cols, nrows, params=()):
         """No string keys: key eval + buffer eval + group-by, one computation.
 
         A fused pre_filter predicate contributes a row mask — the whole
         filter+project+partial-agg stage is a single XLA program."""
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
-        ctx = EmitContext(inputs, nrows, capacity)
+        ctx = EmitContext(inputs, nrows, capacity,
+                          params=params_dict(self._slots, params))
         row_mask = self._pre_filter_mask(ctx)
         keys = [e.emit(ctx) for e in self._kgroup]
         buf_inputs = self._eval_update_inputs(ctx)
@@ -474,12 +491,13 @@ class TpuHashAggregateExec(TpuExec):
         return ([(k.values, k.validity, k.offsets) for k in out_keys],
                 [(b.values, b.validity, b.offsets) for b in out_bufs], n)
 
-    def _stage_a(self, flat_cols, nrows):
+    def _stage_a(self, flat_cols, nrows, params=()):
         """Filter mask + key-range probe: the cheap pass whose scalars
         the host needs before picking stage B (coded path)."""
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
-        ctx = EmitContext(inputs, nrows, capacity)
+        ctx = EmitContext(inputs, nrows, capacity,
+                          params=params_dict(self._slots, params))
         mask = self._pre_filter_mask(ctx)
         if mask is None:
             mask = ctx.row_mask()
@@ -493,10 +511,11 @@ class TpuHashAggregateExec(TpuExec):
         and buffer expressions re-evaluate HERE, fused straight into the
         segment reductions — no materialized intermediate columns."""
 
-        def run(flat_cols, nrows, mask, mins, slot_ranges):
+        def run(flat_cols, nrows, mask, mins, slot_ranges, params=()):
             capacity = capacity_of(flat_cols)
             inputs = flat_to_colvals(flat_cols, self._in_dtypes)
-            ctx = EmitContext(inputs, nrows, capacity)
+            ctx = EmitContext(inputs, nrows, capacity,
+                              params=params_dict(self._slots, params))
             if self.pre_filters:
                 ctx.extra_check_mask = mask
             keys = [agg.widen_colval(e.emit(ctx), capacity)
@@ -516,10 +535,11 @@ class TpuHashAggregateExec(TpuExec):
         ONE XLA computation — the probe pass and its host round trip
         only ever happen on a speculation miss."""
 
-        def run(flat_cols, nrows):
+        def run(flat_cols, nrows, params=()):
             capacity = capacity_of(flat_cols)
             inputs = flat_to_colvals(flat_cols, self._in_dtypes)
-            ctx = EmitContext(inputs, nrows, capacity)
+            ctx = EmitContext(inputs, nrows, capacity,
+                              params=params_dict(self._slots, params))
             mask = self._pre_filter_mask(ctx)
             if mask is None:
                 mask = ctx.row_mask()
@@ -581,7 +601,8 @@ class TpuHashAggregateExec(TpuExec):
                 ("agg_coded_auto", spec_k) + self._base_sig + (
                     self._pre_sig,),
                 lambda: self._coded_update_auto(spec_k))
-            key_out, buf_out, n, fits, mins, maxs, mask = fn(flat, nrows)
+            key_out, buf_out, n, fits, mins, maxs, mask = fn(
+                flat, nrows, self._pargs())
             fits_h, mins_h, maxs_h = hostsync.fetch(fits, mins, maxs)
             if bool(fits_h):
                 outs = [ColVal(dt, v, val) for dt, (v, val) in
@@ -594,11 +615,12 @@ class TpuHashAggregateExec(TpuExec):
             self._spec_misses += 1
             pick = self._coded_pick_host(mins_h, maxs_h)
         else:
-            mask, mins, maxs = self._stage_a_fn(flat, nrows)
+            mask, mins, maxs = self._stage_a_fn(flat, nrows, self._pargs())
             pick = self._coded_pick(mins, maxs)
         if pick is None:
             # key space too large: the fully fused sort kernel
-            key_flat, buf_flat, n = self._update_fn(flat, nrows)
+            key_flat, buf_flat, n = self._update_fn(flat, nrows,
+                                                    self._pargs())
             n_rc = self._wrap_count(n)
             outs = [ColVal(dt, v, val, offs)
                     for dt, (v, val, offs) in
@@ -609,7 +631,8 @@ class TpuHashAggregateExec(TpuExec):
         fn = cached_jit(
             ("agg_coded_update", k_bucket) + self._base_sig,
             lambda: self._coded_update(k_bucket))
-        key_out, buf_out, n = fn(flat, nrows, mask, mins_d, slots_d)
+        key_out, buf_out, n = fn(flat, nrows, mask, mins_d, slots_d,
+                                 self._pargs())
         n_rc = self._wrap_count(n)
         outs = [ColVal(dt, v, val) for dt, (v, val) in
                 zip(dtypes, list(key_out) + list(buf_out))]
@@ -643,7 +666,8 @@ class TpuHashAggregateExec(TpuExec):
                 if self._coded_eligible:
                     return self._partial_coded(batch, names, dtypes)
                 key_flat, buf_flat, n = self._update_fn(
-                    batch_to_flat(batch), batch.row_count.device_i32())
+                    batch_to_flat(batch), batch.row_count.device_i32(),
+                    self._pargs())
                 # keyless reductions have statically one output row;
                 # grouped counts stay device-resident (deferred) — the
                 # per-batch int(n) costs a full tunnel round trip
@@ -914,11 +938,12 @@ class TpuHashAggregateExec(TpuExec):
                 for v, o in zip(codes, ok)]
         return Column.from_strings(strs, capacity=out_cap)
 
-    def _single_kernel(self, flat_cols, nrows):
+    def _single_kernel(self, flat_cols, nrows, params=()):
         """Grouped pass mixing collect arrays with regular reductions."""
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self._in_dtypes)
-        ctx = EmitContext(inputs, nrows, capacity)
+        ctx = EmitContext(inputs, nrows, capacity,
+                          params=params_dict(self._slots, params))
         row_mask = self._pre_filter_mask(ctx)
         keys = [e.emit(ctx) for e in self.group_exprs]
         keyless = not keys
@@ -981,7 +1006,8 @@ class TpuHashAggregateExec(TpuExec):
             h.close()
         with self.timer(AGG_TIME):
             out_flat, n = self._single_fn(batch_to_flat(merged),
-                                          merged.row_count.device_i32())
+                                          merged.row_count.device_i32(),
+                                          self._pargs())
             # collect arrays re-decode on the host right below: the
             # count is needed concretely either way (counted sync)
             n = int(RowCount(device=n))

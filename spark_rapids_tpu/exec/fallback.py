@@ -96,7 +96,7 @@ def _eval_pandas(expr, df: pd.DataFrame):
     from spark_rapids_tpu.ops import arithmetic as A
     from spark_rapids_tpu.ops import predicates as P
     from spark_rapids_tpu.ops.expressions import (
-        Alias, BoundReference, Literal, UnresolvedColumn)
+        Alias, BoundReference, Literal, ParamSlot, UnresolvedColumn)
     from spark_rapids_tpu.udf.python_exec import PythonUDF
 
     e = expr
@@ -107,6 +107,11 @@ def _eval_pandas(expr, df: pd.DataFrame):
     if isinstance(e, UnresolvedColumn):
         return df[e.col_name]
     if isinstance(e, Literal):
+        return pd.Series([e.value] * len(df))
+    if isinstance(e, ParamSlot):
+        # hoisted literal (plan/template.py): the CPU rung evaluates the
+        # current binding — a recovery re-drive of a prepared run must
+        # see the same value the kernels would have
         return pd.Series([e.value] * len(df))
     if isinstance(e, PythonUDF):
         args = [_eval_pandas(c, df) for c in e.children]

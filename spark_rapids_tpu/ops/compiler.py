@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import Column
 from spark_rapids_tpu.columnar.dtypes import DataType
-from spark_rapids_tpu.ops.expressions import ColVal, EmitContext, Expression
+from spark_rapids_tpu.ops.expressions import (
+    ColVal, EmitContext, Expression, collect_param_slots)
 
 # A column crosses the jit boundary as (values, validity|None, offsets|None).
 FlatCol = Tuple
@@ -98,6 +99,23 @@ def raise_failed_checks(messages, flags) -> None:
         raise ArithmeticError("; ".join(failed) or "ANSI check failed")
 
 
+def param_args(slots) -> Tuple:
+    """Dispatch-time argument vector for a stage's ParamSlots: the
+    current binding of each slot as a 0-d storage scalar.  Empty tuple
+    (an empty pytree — free at the jit boundary) when the stage has no
+    slots, so unparameterized stages pay nothing."""
+    return tuple(s.device_value() for s in slots)
+
+
+def params_dict(slots, params):
+    """Traced param arguments -> the slot-index map EmitContext reads.
+    Slot INDEX ordering matches :func:`collect_param_slots`, so any
+    instance sharing the cached executable builds the same mapping."""
+    if not slots:
+        return None
+    return {s.index: p for s, p in zip(slots, params)}
+
+
 class StageFn:
     """A compiled per-batch function for a fixed expression forest.
 
@@ -112,16 +130,18 @@ class StageFn:
         self.exprs = list(exprs)
         self.input_dtypes = list(input_dtypes)
         self.donate = effective_donate(donate)
+        self._slots = collect_param_slots(self.exprs)
         self._sig = ("stage", tuple(e.cache_key() for e in self.exprs),
                      tuple(dt.name for dt in self.input_dtypes),
                      ("donate", self.donate))
         self._jitted = cached_jit(self._sig, lambda: self._run,
                                   **_donate_kwargs(self.donate))
 
-    def _run(self, flat_cols, nrows):
+    def _run(self, flat_cols, nrows, params=()):
         capacity = capacity_of(flat_cols) if flat_cols else 0
         inputs = flat_to_colvals(flat_cols, self.input_dtypes)
-        ctx = EmitContext(inputs, nrows, capacity)
+        ctx = EmitContext(inputs, nrows, capacity,
+                          params=params_dict(self._slots, params))
         outs = [e.emit(ctx) for e in self.exprs]
         # messages are static per expression tree: record them at trace
         # time so a failure needs no re-execution
@@ -134,7 +154,8 @@ class StageFn:
         # device_i32: a deferred upstream count flows straight into the
         # stage without a host sync
         nrows = batch.row_count.device_i32()
-        out_flat, check_flags = self._jitted(flat, nrows)
+        out_flat, check_flags = self._jitted(flat, nrows,
+                                             param_args(self._slots))
         raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
         outs = [ColVal(e.dtype, v, validity, offsets)
                 for e, (v, validity, offsets) in zip(self.exprs, out_flat)]
@@ -168,6 +189,7 @@ class FilterStageFn:
         self.project = list(project)
         self.input_dtypes = list(input_dtypes)
         self.donate = effective_donate(donate)
+        self._slots = collect_param_slots(self.conjuncts + self.project)
         self._sig = ("filter_stage",
                      tuple(p.cache_key() for p in conjuncts),
                      tuple(e.cache_key() for e in self.project),
@@ -176,12 +198,13 @@ class FilterStageFn:
         self._jitted = cached_jit(self._sig, lambda: self._run,
                                   **_donate_kwargs(self.donate))
 
-    def _run(self, flat_cols, nrows):
+    def _run(self, flat_cols, nrows, params=()):
         from spark_rapids_tpu.ops import selection
         from spark_rapids_tpu.ops.expressions import fold_conjuncts
         capacity = capacity_of(flat_cols)
         inputs = flat_to_colvals(flat_cols, self.input_dtypes)
-        ctx = EmitContext(inputs, nrows, capacity)
+        ctx = EmitContext(inputs, nrows, capacity,
+                          params=params_dict(self._slots, params))
         # projections then evaluate over PRE-filter rows (compaction is
         # one pass at the end): fold_conjuncts leaves the check mask at
         # the survivor set, so ANSI checks only fire for survivors
@@ -209,7 +232,7 @@ class FilterStageFn:
         from spark_rapids_tpu.columnar.column import RowCount
         flat = batch_to_flat(batch)
         out_flat, new_nrows, check_flags = self._jitted(
-            flat, batch.row_count.device_i32())
+            flat, batch.row_count.device_i32(), param_args(self._slots))
         raise_failed_checks(_CHECK_MSGS.get(self._sig, []), check_flags)
         # the selected-row count is a genuine host decision (empty-batch
         # skip); RowCount makes the sync visible to the accounting

@@ -70,10 +70,16 @@ class EmitContext:
     ``capacity``: static int — the shape bucket.
     """
 
-    def __init__(self, inputs: Sequence[ColVal], nrows, capacity: int):
+    def __init__(self, inputs: Sequence[ColVal], nrows, capacity: int,
+                 params: Optional[Dict[int, Any]] = None):
         self.inputs = list(inputs)
         self.nrows = nrows
         self.capacity = capacity
+        # hoisted-literal bindings: slot index -> traced 0-d scalar.
+        # Stages compiled from a parameterized template pass their
+        # ParamSlot values here as runtime arguments, so the SAME
+        # executable serves every literal binding (zero retrace).
+        self.params = params
         # (message, traced bool scalar) pairs appended by ANSI-mode
         # expressions; stage runners surface them and raise host-side
         # (Spark ANSI throws, GpuCast ansi mode)
@@ -299,11 +305,7 @@ class Literal(Expression):
             offs = jnp.asarray(
                 np.array([0, len(data)], dtype=np.int32))
             return ColVal(self._dtype, jnp.asarray(data), offsets=offs)
-        v = self.value
-        if self._dtype.is_timestamp and not isinstance(v, (int, np.integer)):
-            v = np.datetime64(v, "us").astype(np.int64)
-        if self._dtype.is_date and not isinstance(v, (int, np.integer)):
-            v = np.datetime64(v, "D").astype(np.int32)
+        v = literal_storage_value(self.value, self._dtype)
         return ColVal(self._dtype, jnp.asarray(v, dtype=self._dtype.storage))
 
     @property
@@ -315,6 +317,91 @@ class Literal(Expression):
 
     def __str__(self):
         return f"lit({self.value!r})"
+
+
+def literal_storage_value(value, dtype: DataType):
+    """Host value -> its storage representation, exactly the conversion
+    ``Literal.emit`` bakes into a trace (timestamp/date strings
+    normalize to their integer storage).  Shared with ``ParamSlot`` so
+    a hoisted literal binds to the bit-identical scalar the inline
+    literal would have traced as a constant."""
+    if dtype.is_timestamp and not isinstance(value, (int, np.integer)):
+        return np.datetime64(value, "us").astype(np.int64)
+    if dtype.is_date and not isinstance(value, (int, np.integer)):
+        return np.datetime64(value, "D").astype(np.int32)
+    return value
+
+
+class ParamSlot(Expression):
+    """A hoisted literal: a typed parameter position in a plan template.
+
+    ``cache_key`` is VALUE-FREE — stages compiled from a parameterized
+    template share one signature across all literal bindings, and the
+    slot evaluates to a runtime scalar argument (``ctx.params[index]``)
+    inside the trace instead of a baked-in constant.  The current
+    binding lives on the slot (``bind_value``) so dispatch can collect
+    the argument vector; ``device_value()`` converts it exactly the way
+    ``Literal.emit`` would have traced it.  Evaluating a slot in a
+    context with no params is a hard error, never a stale answer.
+    """
+
+    def __init__(self, index: int, dtype: DataType, value=None):
+        self.index = index
+        self._dtype = dtype
+        self.value = value
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def bind_value(self, value) -> None:
+        self.value = value
+
+    def device_value(self):
+        """Current binding as the 0-d storage-dtype scalar the kernels
+        consume (the dispatch-time argument for this slot)."""
+        v = literal_storage_value(self.value, self._dtype)
+        return jnp.asarray(v, dtype=self._dtype.storage)
+
+    def emit(self, ctx: EmitContext) -> ColVal:
+        if ctx.params is None or self.index not in ctx.params:
+            raise RuntimeError(
+                f"ParamSlot ${self.index} evaluated in a stage that "
+                "does not thread template parameters (ctx.params "
+                "missing) — refusing rather than baking a stale value")
+        return ColVal(self._dtype, ctx.params[self.index])
+
+    @property
+    def name(self) -> str:
+        return f"$p{self.index}"
+
+    def cache_key(self):
+        return ("Param", self.index, self._dtype.name)
+
+    def __str__(self):
+        return f"$p{self.index}:{self._dtype.name}"
+
+
+def collect_param_slots(exprs) -> List["ParamSlot"]:
+    """Unique ParamSlots in an expression forest, ordered by slot index
+    (the dispatch argument order — deterministic for a given template
+    regardless of which instance compiled the shared stage)."""
+    slots: Dict[int, ParamSlot] = {}
+
+    def walk(e: Expression) -> None:
+        if isinstance(e, ParamSlot):
+            slots.setdefault(e.index, e)
+        for c in e.children:
+            walk(c)
+
+    for e in exprs:
+        if e is not None:
+            walk(e)
+    return [slots[i] for i in sorted(slots)]
 
 
 def _infer_literal_type(value) -> DataType:

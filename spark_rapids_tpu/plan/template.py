@@ -1,0 +1,288 @@
+"""Parameterized plan templates: literal hoisting and template fingerprints.
+
+Real high-QPS serving traffic is one plan template re-issued with shifting
+literals (the same dashboard filter per user with a different date range or
+customer id).  Every literal change today produces a brand-new plan text, so
+the result cache misses, the stage signatures change, and the jit/AOT tiers
+re-trace.  :func:`hoist_literals` rewrites a bound logical plan so constant
+literals become typed :class:`~spark_rapids_tpu.ops.expressions.ParamSlot`
+leaves whose cache keys are VALUE-FREE — the stage compiler, fused-aggregate
+kernels, and persistent AOT store then key on the *template*, and the literal
+values travel as device-scalar arguments at dispatch (zero retrace, zero
+recompile across literal churn).
+
+Hoisting is deliberately conservative — a literal is only hoisted when the
+swap provably changes neither the plan SHAPE nor any output name:
+
+==========================  =================================================
+refused literal             why (falls back to exact keying)
+==========================  =================================================
+null literals               validity structure differs from a value scalar
+string literals             char-array shape depends on the value
+decimal literals            precision/scale derive from the digits
+inside an ANSI-checked op   check constants are baked into the traced program
+unaliased projections       the output column NAME embeds the literal text
+LIMIT / slot constants      row-count shaping is structural, not a parameter
+join/sort/window positions  kernels there do not thread parameters (yet)
+==========================  =================================================
+
+Refused literals simply stay inline: their values remain part of the
+template fingerprint, so correctness never depends on the refusal list —
+a refusal only means less sharing.  Every refusal is recorded with a reason
+so the profiling health check can explain a template tier that bought
+nothing.
+
+:func:`plan_signature` is the shared canonical identity walk: node
+structure plus every expression's ``cache_key()`` (which DOES include
+inline literal values).  The exact result-cache tier keys on it too,
+closing the historical hazard where ``Project.describe`` showed only
+output names and two plans differing in an aliased literal could alias.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import numbers
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.ops.expressions import (
+    Alias, Expression, Literal, ParamSlot, literal_storage_value)
+from spark_rapids_tpu.plan import logical as L
+
+# refusal reasons (stable strings: they flow through eventlog -> profiling)
+REFUSE_NULL = "null-literal"
+REFUSE_STRING = "string-shape"
+REFUSE_DECIMAL = "decimal-precision"
+REFUSE_ANSI = "ansi-check-constant"
+REFUSE_NAME = "unaliased-output-name"
+REFUSE_LIMIT = "limit-shape-constant"
+REFUSE_POSITION = "position-not-parameterized"
+
+
+def _literal_refusal(lit: Literal) -> Optional[str]:
+    """Value-class refusals: literal kinds whose swap changes trace
+    shape (never hoistable, regardless of position)."""
+    if lit.value is None:
+        return REFUSE_NULL
+    if lit.dtype.is_string:
+        return REFUSE_STRING
+    if lit.dtype.is_decimal:
+        return REFUSE_DECIMAL
+    return None
+
+
+def _contains_literal(e: Expression) -> bool:
+    if isinstance(e, Literal):
+        return True
+    return any(_contains_literal(c) for c in e.children)
+
+
+def check_bindable(value, dtype: DataType) -> None:
+    """Reject a parameter binding that could not have been the hoisted
+    literal: silent jnp coercion (a float truncating into an int slot)
+    must never stand in for a type error."""
+    if value is None:
+        raise TypeError(
+            f"cannot bind None to a {dtype.name} parameter slot (null "
+            "literals are never hoisted — issue the query with the null "
+            "inline)")
+    if dtype.name == "boolean":
+        if not isinstance(value, bool):
+            raise TypeError(f"parameter expects boolean, got {value!r}")
+        return
+    if dtype.is_integral:
+        if isinstance(value, bool) or \
+                not isinstance(value, numbers.Integral):
+            raise TypeError(
+                f"parameter expects {dtype.name}, got {value!r}")
+        return
+    if dtype.is_floating:
+        if isinstance(value, bool) or not isinstance(value, numbers.Real):
+            raise TypeError(
+                f"parameter expects {dtype.name}, got {value!r}")
+        return
+    # date/timestamp: accept what Literal accepts (ints or parseable
+    # date-likes); literal_storage_value raises on garbage
+    if dtype.is_datetime:
+        literal_storage_value(value, dtype)
+        return
+    raise TypeError(f"{dtype.name} parameters are not hoistable")
+
+
+class _Hoister:
+    def __init__(self):
+        self.slots: List[ParamSlot] = []
+        self.refusals: List[Tuple[str, str]] = []
+
+    # ------------------------------------------------------- expressions --
+    def _hoist_expr(self, e: Expression, ansi: bool = False) -> Expression:
+        if isinstance(e, Literal):
+            reason = _literal_refusal(e)
+            if reason is None and ansi:
+                reason = REFUSE_ANSI
+            if reason is not None:
+                self.refusals.append((reason, str(e)))
+                return e
+            slot = ParamSlot(len(self.slots), e.dtype, e.value)
+            self.slots.append(slot)
+            return slot
+        if not e.children:
+            return e
+        # any ANSI-checked operator (Cast ansi=True today) bakes its
+        # check constants into the traced program: refuse underneath
+        child_ansi = ansi or bool(getattr(e, "ansi", False))
+        new = [self._hoist_expr(c, child_ansi) for c in e.children]
+        if all(n is o for n, o in zip(new, e.children)):
+            return e
+        return e.with_children(new)
+
+    def _hoist_named(self, e: Expression) -> Expression:
+        """Output-name-exposed position (projection / aggregate lists):
+        only an Alias pins the column name against the rewrite."""
+        if isinstance(e, Alias):
+            inner = self._hoist_expr(e.child)
+            return e if inner is e.child else Alias(inner, e.alias)
+        if _contains_literal(e):
+            self.refusals.append((REFUSE_NAME, e.name))
+        return e
+
+    # ------------------------------------------------------------- nodes --
+    def visit(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        new_children = [self.visit(c) for c in plan.children]
+        changed = any(n is not o
+                      for n, o in zip(new_children, plan.children))
+        fields = {}
+        if isinstance(plan, L.Filter):
+            cond = self._hoist_expr(plan.condition)
+            if cond is not plan.condition:
+                fields["condition"] = cond
+        elif isinstance(plan, L.Project):
+            exprs = [self._hoist_named(e) for e in plan.exprs]
+            if any(n is not o for n, o in zip(exprs, plan.exprs)):
+                fields["exprs"] = exprs
+        elif isinstance(plan, L.Aggregate):
+            group = [self._hoist_named(e) for e in plan.group_exprs]
+            aggs = [self._hoist_named(e) for e in plan.agg_exprs]
+            if any(n is not o for n, o in zip(group, plan.group_exprs)):
+                fields["group_exprs"] = group
+            if any(n is not o for n, o in zip(aggs, plan.agg_exprs)):
+                fields["agg_exprs"] = aggs
+        elif isinstance(plan, L.Limit):
+            self.refusals.append((REFUSE_LIMIT, f"LIMIT {plan.n}"))
+        else:
+            # out-of-scope expression positions (join keys/conditions,
+            # sort orders, windows, ...): literals stay inline — record
+            # one refusal per node so churn there is explainable
+            if any(_contains_literal(e) for e in _all_expressions(plan)):
+                self.refusals.append((REFUSE_POSITION, plan.node_name()))
+        if not changed and not fields:
+            return plan
+        node = copy.copy(plan)  # NEVER deepcopy: relations hold live batches
+        node.children = tuple(new_children)
+        for k, v in fields.items():
+            setattr(node, k, v)
+        return node
+
+
+def _all_expressions(node: L.LogicalPlan) -> List[Expression]:
+    from spark_rapids_tpu.plan.overrides import _node_expressions
+    exprs = list(_node_expressions(node))
+    cond = getattr(node, "condition", None)
+    if isinstance(node, L.Join) and cond is not None:
+        exprs.append(cond)
+    return exprs
+
+
+def plan_signature(plan: L.LogicalPlan) -> Tuple:
+    """Canonical structural identity: node names/describe lines plus
+    every expression's cache_key (inline literal VALUES included,
+    ParamSlot keys value-free).  This — not the rendered tree text —
+    is what cache tiers key on."""
+    recs: List[Tuple] = []
+
+    def rec(node: L.LogicalPlan, depth: int) -> None:
+        entry: List = [depth, node.node_name(), node.describe()]
+        exprs = _all_expressions(node)
+        if exprs:
+            entry.append(tuple(e.cache_key() for e in exprs))
+        if isinstance(node, L.Limit):
+            entry.append(("n", node.n))
+        if isinstance(node, L.FileRelation):
+            entry.append(("paths", tuple(node.paths), node.file_format))
+        recs.append(tuple(entry))
+        for c in node.children:
+            rec(c, depth + 1)
+
+    rec(plan, 0)
+    return tuple(recs)
+
+
+def plan_fingerprint(plan: L.LogicalPlan) -> str:
+    return hashlib.sha256(
+        repr(plan_signature(plan)).encode("utf-8")).hexdigest()
+
+
+@dataclasses.dataclass
+class TemplateInfo:
+    """A hoisted plan template plus its current parameter binding.
+
+    ``plan`` shares every un-rewritten subtree with the original logical
+    plan (relations, joins, ... are the same objects); only nodes along
+    a rewritten expression path are shallow-copied.  The ParamSlots are
+    OWNED by this template — binding new values mutates them, so one
+    TemplateInfo must not execute concurrently with itself (the
+    prepared-statement handle serializes runs; the ad-hoc path hoists a
+    fresh template per query).
+    """
+
+    plan: L.LogicalPlan
+    slots: List[ParamSlot]
+    refusals: List[Tuple[str, str]]
+    fingerprint: str
+
+    @property
+    def hoisted(self) -> bool:
+        return bool(self.slots)
+
+    @property
+    def param_count(self) -> int:
+        return len(self.slots)
+
+    def bind(self, values) -> None:
+        """Bind a positional parameter vector (type-checked)."""
+        if len(values) != len(self.slots):
+            raise ValueError(
+                f"template expects {len(self.slots)} parameters, "
+                f"got {len(values)}")
+        for s, v in zip(self.slots, values):
+            check_bindable(v, s.dtype)
+        for s, v in zip(self.slots, values):
+            s.bind_value(v)
+
+    def values(self) -> Tuple:
+        return tuple(s.value for s in self.slots)
+
+    def param_vector(self) -> Tuple:
+        """Canonical (dtype, storage-value) vector of the CURRENT
+        binding — the template result-cache key component."""
+        return tuple(
+            (s.dtype.name,
+             repr(literal_storage_value(s.value, s.dtype)))
+            for s in self.slots)
+
+
+def hoist_literals(plan: L.LogicalPlan) -> TemplateInfo:
+    """Rewrite ``plan`` into its parameterized template.
+
+    Returns a TemplateInfo whose slots carry the original literal values
+    as their initial binding, so ``info.plan`` executes identically to
+    ``plan`` without further binding.  ``info.hoisted`` is False when
+    nothing was hoistable — callers then stay on the exact-key path.
+    """
+    h = _Hoister()
+    tplan = h.visit(plan)
+    return TemplateInfo(plan=tplan, slots=h.slots, refusals=h.refusals,
+                        fingerprint=plan_fingerprint(tplan))

@@ -10,10 +10,15 @@ This module promotes it to *shared*:
 
 **ResultCache** — a plan-keyed, budgeted, host/disk-tier store of whole
 query results, consulted by ``DataFrame._execute_batches`` before
-planning.  The key is the EXACT logical-plan text (literals included —
-the digit-normalized key the compare tools use would alias
-``limit(5)`` with ``limit(10)``) and a **hit additionally requires the
-plan's input fingerprint to match** (``checkpoint.input_fingerprint``:
+planning.  The EXACT tier keys on the plan's structural signature
+(``plan/template.py:plan_signature`` — node structure plus every
+expression cache_key, literal VALUES included; the rendered tree text
+alone hid aliased-literal digits behind output names, and the
+digit-normalized key the compare tools use would alias ``limit(5)``
+with ``limit(10)``).  The TEMPLATE tier keys on (normalized template
+fingerprint, parameter vector) — one entry per literal binding of a
+hoisted plan template, same verification discipline.  Either way a
+**hit additionally requires the plan's input fingerprint to match** (``checkpoint.input_fingerprint``:
 file path/size/mtime_ns triples + in-memory batch identities, statted
 fresh at lookup) — so a hit answers with zero executions and a mutated
 input can never serve stale bytes.  Every hit re-verifies the store's
@@ -59,8 +64,11 @@ from spark_rapids_tpu.robustness.inject import (fire, fire_mutate,
 
 # chaos surface: raise/delay rules wedge/abort a cache load (the query
 # degrades to a recompute MISS — never a failure), corrupt rules flip
-# result-payload bits so the CRC gate has real rot to catch
+# result-payload bits so the CRC gate has real rot to catch.  The
+# template tier has its own point so the spray can rot template hits
+# specifically without touching exact-tier traffic.
 register_point("resultcache.load")
+register_point("templatecache.load")
 
 # spill priorities: reuse state is insurance, colder than per-query
 # checkpoints (-1500) but warmer than standing incremental state
@@ -181,7 +189,7 @@ class PendingResult:
     stale bytes)."""
 
     __slots__ = ("key", "fingerprint", "hit", "batches", "cacheable",
-                 "pins")
+                 "pins", "tier")
 
     def __init__(self):
         self.key: Optional[str] = None
@@ -190,6 +198,7 @@ class PendingResult:
         self.batches = None
         self.cacheable = False
         self.pins: list = []  # live in-memory input batch objects
+        self.tier = "exact"   # "exact" | "template"
 
 
 class ResultCache:
@@ -211,13 +220,31 @@ class ResultCache:
         self.stores = 0
         self.invalidations = 0
         self.evictions = 0
+        # template tier (ISSUE 17): entries share the map/budget/locks
+        # with the exact tier ("T:"-prefixed keys), counted separately
+        self.template_hits = 0
+        self.template_misses = 0
+        self.template_stores = 0
 
     # ------------------------------------------------------------- helpers --
     @staticmethod
     def plan_key(plan) -> str:
-        """EXACT plan identity (literals included); the data the plan
-        reads is keyed separately by the input fingerprint."""
-        return hashlib.sha256(plan.tree_string().encode()).hexdigest()
+        """EXACT plan identity: the structural signature — node
+        structure plus every expression cache_key, literal VALUES
+        included (two plans differing only in literal digits can never
+        alias, even where describe() shows output names only).  The
+        data the plan reads is keyed separately by the input
+        fingerprint."""
+        from spark_rapids_tpu.plan.template import plan_signature
+        return hashlib.sha256(
+            repr(plan_signature(plan)).encode()).hexdigest()
+
+    @staticmethod
+    def template_key(fingerprint: str, param_vector) -> str:
+        """TEMPLATE tier identity: (normalized template fingerprint,
+        canonical parameter vector)."""
+        return "T:" + hashlib.sha256(
+            (fingerprint + "|" + repr(param_vector)).encode()).hexdigest()
 
     @staticmethod
     def cacheable(plan) -> bool:
@@ -273,23 +300,66 @@ class ResultCache:
             pend.batches = batches
         return pend
 
-    def _miss(self, note: str, count: bool = True):
+    def offer_template(self, info, count_miss: bool = True
+                       ) -> PendingResult:
+        """Template-tier lookup: key on (template fingerprint,
+        CURRENT parameter vector) of a hoisted
+        :class:`~spark_rapids_tpu.plan.template.TemplateInfo`.  The
+        fingerprint-verification discipline is the exact tier's —
+        input fingerprints statted fresh, weak pins on in-memory
+        inputs, CRC re-verified on every hit."""
+        pend = PendingResult()
+        pend.tier = "template"
+        if not self.enabled or self.catalog is None:
+            return pend
+        try:
+            pend.cacheable = self.cacheable(info.plan)
+            if not pend.cacheable:
+                return pend
+            pend.key = self.template_key(info.fingerprint,
+                                         info.param_vector())
+            pend.fingerprint = input_fingerprint(info.plan)
+            pend.pins = _inmemory_batches(info.plan)
+        except Exception:
+            pend.cacheable = False
+            return pend
+        try:
+            batches = self._load(pend, count_miss)
+        except Exception:
+            batches = None
+        if batches is not None:
+            pend.hit = True
+            pend.batches = batches
+        return pend
+
+    def _count_miss_locked(self, tier: str) -> None:
+        self.misses += 1
+        if tier == "template":
+            self.template_misses += 1
+
+    def _miss(self, note: str, count: bool = True, tier: str = "exact"):
         if count:
             with _Locked(self._lock):
-                self.misses += 1
-            self._note_sharing(resultCache=note)
+                self._count_miss_locked(tier)
+            if tier == "template":
+                self._note_sharing(templateCache=note)
+            else:
+                self._note_sharing(resultCache=note)
         return None
 
     def _invalidate(self, entry: "_CachedResult", reason: str,
-                    count_miss: bool = True):
+                    count_miss: bool = True, tier: str = "exact"):
         """Invalidate-if-still-live (a concurrent lookup or eviction
         may have removed the entry already) and count the miss."""
         with _Locked(self._lock):
             if self._entries.get(entry.key) is entry:
                 self._invalidate_locked(entry, reason)
             if count_miss:
-                self.misses += 1
-        self._note_sharing(resultCache="invalidated")
+                self._count_miss_locked(tier)
+        if tier == "template":
+            self._note_sharing(templateCache="invalidated")
+        else:
+            self._note_sharing(resultCache="invalidated")
         return None
 
     def _load(self, pend: PendingResult, count_miss: bool = True):
@@ -297,12 +367,17 @@ class ResultCache:
         from spark_rapids_tpu.robustness.faults import CorruptionFault
         from spark_rapids_tpu.robustness.incremental import \
             _batch_payload
+        tier = pend.tier
+        point = "templatecache.load" if tier == "template" \
+            else "resultcache.load"
         with _Locked(self._lock):
             entry = self._entries.get(pend.key)
             if entry is None:
                 if count_miss:
-                    self.misses += 1
-                    self._note_sharing(resultCache="miss")
+                    self._count_miss_locked(tier)
+                    self._note_sharing(**{
+                        "templateCache" if tier == "template"
+                        else "resultCache": "miss"})
                 return None
             if entry.fingerprint != pend.fingerprint:
                 # an input file moved (appended, rewritten — even
@@ -311,8 +386,10 @@ class ResultCache:
                 self._invalidate_locked(entry,
                                         "input-fingerprint-moved")
                 if count_miss:
-                    self.misses += 1
-                self._note_sharing(resultCache="invalidated")
+                    self._count_miss_locked(tier)
+                self._note_sharing(**{
+                    "templateCache" if tier == "template"
+                    else "resultCache": "invalidated"})
                 return None
             if not entry.pins_alive():
                 # an in-memory input batch the fingerprint's id()s
@@ -320,8 +397,10 @@ class ResultCache:
                 # DIFFERENT object's data, so the match is unprovable
                 self._invalidate_locked(entry, "input-batch-collected")
                 if count_miss:
-                    self.misses += 1
-                self._note_sharing(resultCache="invalidated")
+                    self._count_miss_locked(tier)
+                self._note_sharing(**{
+                    "templateCache" if tier == "template"
+                    else "resultCache": "invalidated"})
                 return None
             parts = list(entry.parts)
             schema = list(entry.schema)
@@ -334,7 +413,7 @@ class ResultCache:
             # chaos: raise/delay rules degrade the load to a MISS
             # (the query recomputes — exact, just slower); corrupt
             # rules below rot the payload for the CRC gate
-            fire("resultcache.load")
+            fire(point)
             batches = []
             for h, crc, nrows in parts:
                 batch = h.materialize()
@@ -342,8 +421,7 @@ class ResultCache:
                 key = next((k for k in sorted(payload)
                             if payload[k].size > 0), None)
                 if key is not None:
-                    mutated = fire_mutate("resultcache.load",
-                                          payload[key])
+                    mutated = fire_mutate(point, payload[key])
                     if mutated is not payload[key]:
                         payload = dict(payload)
                         payload[key] = mutated
@@ -352,26 +430,32 @@ class ResultCache:
                     return self._invalidate(
                         entry,
                         f"crc {got:#010x} != stored {crc:#010x}",
-                        count_miss)
+                        count_miss, tier)
                 batches.append(_rebuild_batch(schema, payload, nrows))
         except (CorruptionFault, OSError, ValueError) as e:
             # undecodable / vanished / tier-CRC-dropped payload:
             # the entry is gone, the query recomputes
             return self._invalidate(entry, f"{type(e).__name__}: {e}",
-                                    count_miss)
+                                    count_miss, tier)
         except Exception:
             # an injected raise (or any other load-path failure)
             # is a graceful miss, never a failed query
-            return self._miss("miss", count_miss)
+            return self._miss("miss", count_miss, tier)
         with _Locked(self._lock):
             if self._entries.get(pend.key) is entry:
                 entry.hits += 1
                 entry.seq = next(self._seq)  # LRU touch
             self.hits += 1
-        self._emit("ResultCacheHit", key=pend.key[:16],
+            if tier == "template":
+                self.template_hits += 1
+        self._emit("TemplateCacheHit" if tier == "template"
+                   else "ResultCacheHit", key=pend.key[:16],
                    batches=len(batches),
                    rows=sum(b.nrows for b in batches))
-        self._note_sharing(resultCacheHit=True)
+        if tier == "template":
+            self._note_sharing(templateCacheHit=True)
+        else:
+            self._note_sharing(resultCacheHit=True)
         return batches
 
     # --------------------------------------------------------------- store --
@@ -427,12 +511,15 @@ class ResultCache:
                     pins=pend.pins)
                 self._entries[pend.key] = entry
                 self.stores += 1
+                if pend.tier == "template":
+                    self.template_stores += 1
                 self._evict_over_budget_locked()
             # the store happens AFTER the final attempt's QueryEnd
             # closed, so the fact rides this event (queryId is still
             # the storing query's) — not the sharing dict, which the
             # envelope already snapshotted
-            self._emit("ResultCacheStore", key=pend.key[:16],
+            self._emit("TemplateCacheStore" if pend.tier == "template"
+                       else "ResultCacheStore", key=pend.key[:16],
                        bytes=total, batches=len(parts))
         except Exception:
             for h, _, _ in parts:
@@ -473,6 +560,9 @@ class ResultCache:
                 "stores": self.stores,
                 "invalidations": self.invalidations,
                 "evictions": self.evictions,
+                "templateHits": self.template_hits,
+                "templateMisses": self.template_misses,
+                "templateStores": self.template_stores,
             }
 
     def close(self) -> None:

@@ -273,6 +273,7 @@ def parse_event_log(path: str) -> AppInfo:
                 (q.budget if q is not None else app.budget).append(info)
             elif ev in ("ResultCacheHit", "ResultCacheStore",
                         "ResultCacheInvalid", "ResultCacheEvict",
+                        "TemplateCacheHit", "TemplateCacheStore",
                         "SharedStageWrite", "SharedStageSplice",
                         "SharedStageEvict", "SharedStageInvalid"):
                 info = {k: rec[k] for k in
@@ -284,12 +285,15 @@ def parse_event_log(path: str) -> AppInfo:
                     "ResultCacheStore": "store",
                     "ResultCacheInvalid": "invalid",
                     "ResultCacheEvict": "evict",
+                    "TemplateCacheHit": "hit",
+                    "TemplateCacheStore": "store",
                     "SharedStageWrite": "write",
                     "SharedStageSplice": "splice",
                     "SharedStageEvict": "evict",
                     "SharedStageInvalid": "invalid"}[ev]
-                info["store"] = "result" if ev.startswith("Result") \
-                    else "stage"
+                info["store"] = (
+                    "template" if ev.startswith("Template") else
+                    "result" if ev.startswith("Result") else "stage")
                 q = all_queries.get(rec.get("queryId"))
                 (q.sharing_events if q is not None
                  else app.sharing_events).append(info)

@@ -274,6 +274,7 @@ def sharing_stats(apps: List[AppInfo]) -> Dict[str, float]:
     accounting.  ``result_cache_hits`` and ``stage_splices`` are the
     headline numbers the bench --concurrency overlap mode reports."""
     hits = misses = stores = invalid = evicts = 0
+    t_hits = t_misses = t_stores = 0
     writes = splices = 0
     interleaved = 0
     wait_ms = slices = 0.0
@@ -291,6 +292,11 @@ def sharing_stats(apps: List[AppInfo]) -> Dict[str, float]:
                     invalid += 1
                 elif kind == "evict":
                     evicts += 1
+            elif store == "template":
+                if kind == "hit":
+                    t_hits += 1
+                elif kind == "store":
+                    t_stores += 1
             else:
                 if kind == "write":
                     writes += 1
@@ -307,18 +313,25 @@ def sharing_stats(apps: List[AppInfo]) -> Dict[str, float]:
             if sh.get("resultCache") == "miss" or \
                     sh.get("resultCache") == "invalidated":
                 misses += 1
+            if sh.get("templateCache") == "miss" or \
+                    sh.get("templateCache") == "invalidated":
+                t_misses += 1
             il = sh.get("interleave")
             if il:
                 interleaved += 1
                 wait_ms += il.get("waitMs", 0.0)
                 slices += il.get("timeslices", 0)
     if not (hits or misses or stores or writes or splices or
-            interleaved or invalid or evicts):
+            interleaved or invalid or evicts or
+            t_hits or t_misses or t_stores):
         return {}
     return {
         "result_cache_hits": hits,
         "result_cache_misses": misses,
         "result_cache_stores": stores,
+        "template_cache_hits": t_hits,
+        "template_cache_misses": t_misses,
+        "template_cache_stores": t_stores,
         "stage_writes": writes,
         "stage_splices": splices,
         "invalidations": invalid,
@@ -765,6 +778,38 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     "mutating every query, results over "
                     "resultCache.maxBytes, or uncacheable "
                     "UDF/pandas plans)")
+        # template tier that bought nothing: the SAME template
+        # fingerprint repeated after warmup, yet repeats still
+        # re-traced (jit misses) or nothing was hoistable at all —
+        # the refusal list (plan/template.py hoisting rules) says
+        # which literals stayed inline and why
+        by_tpl: Dict[str, list] = {}
+        for q in a.queries:
+            t = (q.sharing or {}).get("template")
+            if t and t.get("fingerprint"):
+                by_tpl.setdefault(t["fingerprint"], []).append(q)
+        for fp, qs in by_tpl.items():
+            if len(qs) < 2:
+                continue
+            refusals = sorted({r for q in qs for r in
+                               (q.sharing["template"]
+                                .get("refusals") or [])})
+            why = (f"refused literals: {', '.join(refusals)}"
+                   if refusals else "no literals in the plan")
+            retraced = [q for q in qs[1:]
+                        if q.pipeline.get("jitCacheMisses", 0) > 0]
+            if all(q.sharing["template"].get("params", 0) == 0
+                   for q in qs):
+                problems.append(
+                    f"{a.session_id}: template {fp} repeated "
+                    f"{len(qs)}x but nothing was hoisted — template "
+                    f"tier bought nothing ({why})")
+            elif retraced:
+                problems.append(
+                    f"{a.session_id}: template {fp} re-traced on "
+                    f"{len(retraced)} repeat(s) after warmup "
+                    f"(query {retraced[0].query_id}) — template tier "
+                    f"bought nothing ({why})")
         # interleaver starvation: a query spent far longer blocked at
         # the timeslice gate than doing its own work — co-tenant
         # quanta are too coarse for this mix
@@ -1285,6 +1330,12 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"stores={sh['result_cache_stores']} "
             f"invalidations={sh['invalidations']} "
             f"evictions={sh['evictions']}")
+        if sh["template_cache_hits"] or sh["template_cache_misses"] \
+                or sh["template_cache_stores"]:
+            out.append(
+                f"  templateCache: hits={sh['template_cache_hits']} "
+                f"misses={sh['template_cache_misses']} "
+                f"stores={sh['template_cache_stores']}")
         out.append(
             f"  sharedStages: writes={sh['stage_writes']} "
             f"splices={sh['stage_splices']}")

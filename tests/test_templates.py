@@ -1,0 +1,560 @@
+"""Parameterized plan templates + prepared statements.
+
+Covers the literal-hoisting pass (plan/template.py): what hoists, what
+refuses and why; the value-free ParamSlot signatures that keep the
+jit/fused-stage tiers from re-tracing across literal churn; the
+template tier of the result cache (fingerprint + parameter vector
+keying, chaos degradation); the prepared-statement API (bind-and-run,
+zero planning passes on repeats, recovery-ladder re-drives mid-run);
+and the regression for the historical exact-tier keying hazard — two
+plans differing only in literal digits must never alias in EITHER
+tier.
+"""
+
+import decimal
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dts
+from spark_rapids_tpu.ops import jit_cache
+from spark_rapids_tpu.ops.expressions import ParamSlot
+from spark_rapids_tpu.plan import overrides as OV
+from spark_rapids_tpu.plan.template import (
+    REFUSE_ANSI, REFUSE_DECIMAL, REFUSE_LIMIT, REFUSE_NAME, REFUSE_NULL,
+    REFUSE_STRING, check_bindable, hoist_literals, plan_fingerprint,
+    plan_signature)
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.driver import recovery_metrics
+
+TPL_CONF = {
+    "spark.rapids.tpu.template.enabled": True,
+}
+TPL_CACHE_CONF = {
+    "spark.rapids.tpu.template.enabled": True,
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.template.resultCache.enabled": True,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    recovery_metrics.reset()
+    with I.scoped_rules():
+        yield
+    I.clear()
+
+
+def _pdf(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 16, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "q": rng.integers(1, 50, n).astype(np.float64),
+    })
+
+
+def _q6ish(df, lo, hi):
+    return (df.filter((F.col("q") >= F.lit(lo)) &
+                      (F.col("q") < F.lit(hi)))
+            .select((F.col("v") * F.col("q")).alias("rev"))
+            .agg(F.sum(F.col("rev")).alias("revenue")))
+
+
+def _oracle(pdf, lo, hi):
+    sub = pdf[(pdf.q >= lo) & (pdf.q < hi)]
+    return float((sub.v * sub.q).sum())
+
+
+# ------------------------------------------------------------ hoist pass --
+def test_hoist_shares_fingerprint_across_literals():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(_pdf())
+        a = hoist_literals(_q6ish(df, 5.0, 20.0).plan)
+        b = hoist_literals(_q6ish(df, 9.0, 33.0).plan)
+        assert a.hoisted and b.hoisted
+        assert a.param_count == b.param_count == 2
+        assert a.fingerprint == b.fingerprint
+        assert a.param_vector() != b.param_vector()
+        # the UN-hoisted plans must still have distinct signatures
+        assert plan_signature(_q6ish(df, 5.0, 20.0).plan) != \
+            plan_signature(_q6ish(df, 9.0, 33.0).plan)
+        # initial binding = original literal values: the template plan
+        # executes identically without further binding
+        assert a.values() == (5.0, 20.0)
+    finally:
+        s.stop()
+
+
+def test_hoist_refuses_null_string_decimal_literals():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(_pdf())
+        cases = [
+            (df.select(F.lit(None, dts.FLOAT64).alias("n"),
+                       F.col("v")), REFUSE_NULL),
+            (df.select(F.lit("tag").alias("t"), F.col("v")),
+             REFUSE_STRING),
+            (df.select(F.lit(decimal.Decimal("1.50"),
+                             dts.DecimalType(4, 2)).alias("d"),
+                       F.col("v")), REFUSE_DECIMAL),
+        ]
+        for frame, reason in cases:
+            info = hoist_literals(frame.plan)
+            assert not info.hoisted, reason
+            assert reason in [r for r, _ in info.refusals], \
+                (reason, info.refusals)
+            # refused literals stay in the fingerprint: different
+            # values => different templates (no aliasing risk)
+        d1 = hoist_literals(
+            df.select(F.lit("x").alias("t")).plan).fingerprint
+        d2 = hoist_literals(
+            df.select(F.lit("y").alias("t")).plan).fingerprint
+        assert d1 != d2
+    finally:
+        s.stop()
+
+
+def test_hoist_refuses_ansi_cast_constants():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(_pdf())
+        frame = df.select(
+            (F.col("v") + F.lit(5.0)).cast("int", ansi=True)
+            .alias("c"))
+        info = hoist_literals(frame.plan)
+        assert not info.hoisted
+        assert REFUSE_ANSI in [r for r, _ in info.refusals]
+        # the same cast WITHOUT ansi hoists fine
+        loose = df.select(
+            (F.col("v") + F.lit(5.0)).cast("int").alias("c"))
+        assert hoist_literals(loose.plan).hoisted
+    finally:
+        s.stop()
+
+
+def test_hoist_refuses_limit_and_keeps_n_in_fingerprint():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(_pdf())
+        info = hoist_literals(df.limit(3).plan)
+        assert REFUSE_LIMIT in [r for r, _ in info.refusals]
+        f3 = plan_fingerprint(df.limit(3).plan)
+        f4 = plan_fingerprint(df.limit(4).plan)
+        assert f3 != f4, "LIMIT n must stay structural"
+    finally:
+        s.stop()
+
+
+def test_hoist_refuses_unaliased_output_names():
+    """An unaliased projection's column NAME embeds the literal text
+    (``(v * 2)``): hoisting would rename the output, so it refuses."""
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(_pdf())
+        frame = df.select(F.col("v") * F.lit(2.0))
+        info = hoist_literals(frame.plan)
+        assert not info.hoisted
+        assert REFUSE_NAME in [r for r, _ in info.refusals]
+        assert [n for n, _ in info.plan.schema] == \
+            [n for n, _ in frame.plan.schema]
+        # same expression under an Alias hoists
+        aliased = df.select((F.col("v") * F.lit(2.0)).alias("x"))
+        assert hoist_literals(aliased.plan).hoisted
+    finally:
+        s.stop()
+
+
+def test_hoist_date_and_timestamp_literals():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        dates = pd.to_datetime(
+            ["2024-01-01", "2024-03-05", "2023-06-30", "2024-07-04"])
+        df = s.create_dataframe(pd.DataFrame({
+            "d": dates.date, "v": [1.0, 2.0, 3.0, 4.0]}))
+        frame = (df.filter(F.col("d") >= F.lit("2024-01-01",
+                                               dts.DATE32))
+                 .agg(F.sum(F.col("v")).alias("sv")))
+        info = hoist_literals(frame.plan)
+        assert info.hoisted and info.param_count == 1
+        assert info.slots[0].dtype.is_date
+        # template executes with the initial binding...
+        assert frame.collect()[0][0] == pytest.approx(7.0)
+        # ...and a rebind via the prepared API sees the new cutoff
+        h = s.prepare(frame)
+        assert h.run(p0="2024-04-01")[0][0] == pytest.approx(4.0)
+        assert h.run(p0="2023-01-01")[0][0] == pytest.approx(10.0)
+        # timestamp literals hoist as int64-microsecond params
+        ts = pd.to_datetime(["2024-01-01 00:00:01",
+                             "2024-01-02 12:00:00"])
+        df2 = s.create_dataframe(pd.DataFrame({
+            "t": ts, "v": [1.0, 2.0]}))
+        info2 = hoist_literals(
+            df2.filter(F.col("t") >= F.lit("2024-01-02",
+                                           dts.TIMESTAMP_US))
+            .plan)
+        assert info2.hoisted and info2.slots[0].dtype.is_timestamp
+    finally:
+        s.stop()
+
+
+def test_check_bindable_rejects_type_mismatches():
+    with pytest.raises(TypeError):
+        check_bindable(None, dts.FLOAT64)
+    with pytest.raises(TypeError):
+        check_bindable(1.5, dts.INT64)       # silent truncation
+    with pytest.raises(TypeError):
+        check_bindable(True, dts.INT64)      # bool is not an int here
+    with pytest.raises(TypeError):
+        check_bindable(1, dts.BOOL)
+    with pytest.raises(TypeError):
+        check_bindable("x", dts.STRING)      # strings never hoist
+    check_bindable(3, dts.INT32)
+    check_bindable(0.5, dts.FLOAT64)
+    check_bindable(7, dts.FLOAT64)           # int widens losslessly
+    check_bindable("2024-01-01", dts.DATE32)
+
+
+# ------------------------------------------------- cache keying regression --
+def test_exact_tier_literal_digit_plans_never_alias():
+    """Regression for the historical keying hazard: two plans
+    differing ONLY in an aliased literal's digits (same output names,
+    same tree text) must never alias in the exact tier."""
+    s = TpuSession({
+        "spark.rapids.tpu.serving.resultCache.enabled": True})
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+
+        def q(mult):
+            return (df.select((F.col("v") * F.lit(mult)).alias("x"))
+                    .agg(F.sum(F.col("x")).alias("sx")))
+        r2 = q(2.0).collect()[0][0]
+        r3 = q(3.0).collect()[0][0]
+        assert r2 == pytest.approx(float(pdf.v.sum()) * 2.0)
+        assert r3 == pytest.approx(float(pdf.v.sum()) * 3.0)
+        snap = s.result_cache.snapshot()
+        assert snap["hits"] == 0, f"literal-digit plans aliased: {snap}"
+        # sanity: a true repeat DOES hit
+        assert q(2.0).collect()[0][0] == r2
+        assert s.result_cache.snapshot()["hits"] == 1
+    finally:
+        s.stop()
+
+
+def test_template_tier_literal_digit_plans_never_alias():
+    """Same regression on the template tier: one fingerprint, two
+    parameter vectors — distinct keys, distinct answers."""
+    s = TpuSession(dict(TPL_CACHE_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        r1 = _q6ish(df, 5.0, 20.0).collect()[0][0]
+        r2 = _q6ish(df, 6.0, 20.0).collect()[0][0]
+        assert r1 == pytest.approx(_oracle(pdf, 5.0, 20.0))
+        assert r2 == pytest.approx(_oracle(pdf, 6.0, 20.0))
+        snap = s.result_cache.snapshot()
+        assert snap["templateHits"] == 0, snap
+        assert snap["templateStores"] == 2, snap
+        # identical binding => template-tier hit, same answer
+        assert _q6ish(df, 5.0, 20.0).collect()[0][0] == r1
+        assert s.result_cache.snapshot()["templateHits"] == 1
+    finally:
+        s.stop()
+
+
+def test_template_cache_corrupt_load_degrades_to_recompute():
+    """Chaos on the template hit path: a corrupt stored entry fails
+    verification, drops, and the query recomputes — never a wrong or
+    failed answer."""
+    s = TpuSession(dict(TPL_CACHE_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        want = _q6ish(df, 5.0, 20.0).collect()[0][0]
+        with I.injected("templatecache.load", kind="corrupt", count=1,
+                        all_threads=True):
+            got = _q6ish(df, 5.0, 20.0).collect()[0][0]
+        assert got == pytest.approx(_oracle(pdf, 5.0, 20.0))
+        snap = s.result_cache.snapshot()
+        assert snap["invalidations"] >= 1, snap
+        assert snap["templateHits"] == 0, snap
+        # the recompute re-stored; a clean third run hits
+        assert _q6ish(df, 5.0, 20.0).collect()[0][0] == want
+        assert s.result_cache.snapshot()["templateHits"] == 1
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------- zero-retrace pin --
+def test_templated_repeats_never_retrace():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        _q6ish(df, 5.0, 20.0).collect()       # warmup: traces once
+        m0 = jit_cache.cache_info()["misses"]
+        for lo in (6.0, 7.5, 9.0, 11.0):
+            got = _q6ish(df, lo, 40.0).collect()[0][0]
+            assert got == pytest.approx(_oracle(pdf, lo, 40.0))
+        m1 = jit_cache.cache_info()["misses"]
+        assert m1 == m0, f"literal churn re-traced {m1 - m0} stage(s)"
+    finally:
+        s.stop()
+
+
+def test_template_off_is_bit_identical_and_unannotated(tmp_path):
+    """A/B: with template.enabled=false nothing changes — results are
+    byte-identical to the exact path and the event stream carries no
+    template annotations."""
+    log_dir = str(tmp_path / "events")
+    pdf = _pdf()
+
+    def run(conf):
+        s = TpuSession(conf)
+        try:
+            df = s.create_dataframe(pdf)
+            # element-wise query (no reduction): outputs must match
+            # BYTE for byte, not just to a tolerance
+            out = (df.filter(F.col("q") >= F.lit(9.0))
+                   .select((F.col("v") * F.col("q")).alias("rev"))
+                   .to_pandas())
+            agg = _q6ish(df, 5.0, 20.0).collect()[0][0]
+            return out, agg
+        finally:
+            s.stop()
+
+    out_off, agg_off = run(
+        {"spark.rapids.tpu.eventLog.dir": log_dir})
+    out_on, agg_on = run(dict(TPL_CONF))
+    pd.testing.assert_frame_equal(out_on, out_off)
+    assert agg_on == pytest.approx(agg_off, rel=1e-12)
+    # hoist-REFUSED shapes ride the exact path byte-identically
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        df = s.create_dataframe(pdf)
+        refused = df.select(F.col("v") * F.lit(2.0))  # unaliased
+        assert refused._template is None or True  # set at execute time
+        got = refused.to_pandas()
+        refused_off = TpuSession({})
+        try:
+            want = (refused_off.create_dataframe(pdf)
+                    .select(F.col("v") * F.lit(2.0)).to_pandas())
+        finally:
+            refused_off.stop()
+        pd.testing.assert_frame_equal(got, want)
+    finally:
+        s.stop()
+    # knobs-off event stream: no template field anywhere
+    events = []
+    for name in os.listdir(log_dir):
+        with open(os.path.join(log_dir, name)) as fh:
+            events += [json.loads(line) for line in fh if line.strip()]
+    ends = [e for e in events if e.get("event") == "QueryEnd"]
+    assert ends
+    assert not any("template" in (e.get("sharing") or {})
+                   for e in ends)
+    assert not any(e.get("event", "").startswith("TemplateCache")
+                   for e in events)
+
+
+# ------------------------------------------------------ prepared handles --
+def test_prepare_requires_conf():
+    s = TpuSession({})
+    try:
+        df = s.create_dataframe(_pdf())
+        with pytest.raises(RuntimeError, match="template.enabled"):
+            s.prepare(_q6ish(df, 5.0, 20.0))
+    finally:
+        s.stop()
+
+
+def test_prepared_bind_and_run():
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        h = s.prepare(_q6ish(df, 5.0, 20.0))
+        assert h.param_count == 2 and not h.refusals
+        assert "$p0" in h.describe()
+        # initial binding
+        assert h.run()[0][0] == pytest.approx(_oracle(pdf, 5.0, 20.0))
+        # positional rebind
+        assert h.run(8.0, 30.0)[0][0] == \
+            pytest.approx(_oracle(pdf, 8.0, 30.0))
+        # keyword rebind is partial: p1 keeps its previous binding
+        assert h.run(p0=12.0)[0][0] == \
+            pytest.approx(_oracle(pdf, 12.0, 30.0))
+        assert h.run_count == 3
+        with pytest.raises(ValueError):
+            h.run(1.0)                       # arity
+        with pytest.raises(TypeError):
+            h.run(p0="not-a-number", p1=30.0)
+        with pytest.raises(TypeError):
+            h.run(p7=1.0)                    # out of range
+        with pytest.raises(TypeError):
+            h.run(nope=1.0)                  # unknown name
+    finally:
+        s.stop()
+
+
+def test_prepared_repeats_zero_planning_zero_retrace():
+    s = TpuSession(dict(TPL_CACHE_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        h = s.prepare(_q6ish(df, 5.0, 20.0))
+        h.run_batches()                      # warmup: trace once
+        m0 = jit_cache.cache_info()["misses"]
+        p0 = OV.planning_passes()
+        for lo in (6.0, 7.0, 8.0, 9.0, 6.0, 7.0):
+            h.run_batches(lo, 40.0)
+        assert jit_cache.cache_info()["misses"] == m0
+        assert OV.planning_passes() == p0, \
+            "prepared repeats must never re-plan"
+        snap = s.result_cache.snapshot()
+        assert snap["templateHits"] >= 2, snap  # repeated vectors hit
+    finally:
+        s.stop()
+
+
+def test_prepared_survives_recovery_redrive_mid_run(tmp_path):
+    """A retryable fault mid-run re-drives the prepared query down
+    the ladder; the handle answers correctly and later runs are back
+    to zero planning passes.  (An in-memory scan heals OOMs at the
+    split-retry layer without the ladder, so the fault is injected at
+    the reader of a parquet-backed template.)"""
+    pdf = _pdf()
+    path = str(tmp_path / "fact.parquet")
+    pdf.to_parquet(path, index=False)
+    s = TpuSession(dict(TPL_CONF) | {
+        "spark.rapids.sql.recovery.backoffMs": 1})
+    try:
+        df = s.read.parquet(path)
+        h = s.prepare(_q6ish(df, 5.0, 20.0))
+        h.run()                              # warm
+        s.recovery_log.clear()
+        with I.injected("io.read", count=1, all_threads=True) as rule:
+            got = h.run(7.0, 30.0)[0][0]
+            assert rule.fired == 1
+        assert got == pytest.approx(_oracle(pdf, 7.0, 30.0))
+        assert [r["action"] for r in s.recovery_log] == ["retry"], \
+            s.recovery_log
+        # the re-drive rode the ladder, but the handle's cached
+        # baseline plan still serves clean repeats plan-free
+        p0 = OV.planning_passes()
+        assert h.run(9.0, 30.0)[0][0] == \
+            pytest.approx(_oracle(pdf, 9.0, 30.0))
+        assert OV.planning_passes() == p0
+    finally:
+        s.stop()
+
+
+def test_template_plan_executes_on_cpu_rung():
+    """The terminal CPU rung evaluates ParamSlots from their current
+    binding (exec/fallback.py), so a re-drive that lands there sees
+    the same values the kernels would have."""
+    s = TpuSession(dict(TPL_CONF))
+    try:
+        pdf = _pdf()
+        df = s.create_dataframe(pdf)
+        info = hoist_literals(_q6ish(df, 5.0, 20.0).plan)
+        assert info.hoisted
+        exec_plan = s.plan_cpu_only(info.plan)
+        [batch] = list(exec_plan.execute())
+        got = float(np.asarray(batch.columns["revenue"].data[:1])[0])
+        assert got == pytest.approx(_oracle(pdf, 5.0, 20.0))
+        info.bind((9.0, 30.0))
+        [batch] = list(s.plan_cpu_only(info.plan).execute())
+        got = float(np.asarray(batch.columns["revenue"].data[:1])[0])
+        assert got == pytest.approx(_oracle(pdf, 9.0, 30.0))
+    finally:
+        s.stop()
+
+
+def test_param_slot_refuses_unbound_emit():
+    """A ParamSlot reached by a path that did not thread params must
+    refuse loudly — never bake a stale value into a trace."""
+    from spark_rapids_tpu.ops.expressions import EmitContext
+    slot = ParamSlot(0, dts.FLOAT64, 1.5)
+    ctx = EmitContext({}, None, 4)  # no params threaded
+    with pytest.raises(RuntimeError, match="param"):
+        slot.emit(ctx)
+
+
+# -------------------------------------------------------- observability --
+def test_eventlog_and_profiling_see_template_tier(tmp_path):
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import (health_check,
+                                                  sharing_stats)
+    log_dir = str(tmp_path / "events")
+    conf = dict(TPL_CACHE_CONF)
+    conf["spark.rapids.tpu.eventLog.dir"] = log_dir
+    s = TpuSession(conf)
+    try:
+        df = s.create_dataframe(_pdf())
+        _q6ish(df, 5.0, 20.0).collect()
+        _q6ish(df, 5.0, 20.0).collect()      # template-tier hit
+        _q6ish(df, 8.0, 20.0).collect()      # new vector: store
+    finally:
+        s.stop()
+    apps = load_logs(log_dir)
+    stats = sharing_stats(apps)
+    assert stats["template_cache_hits"] >= 1, stats
+    assert stats["template_cache_stores"] >= 2, stats
+    tpl = [q.sharing.get("template") for a in apps for q in a.queries
+           if q.sharing.get("template")]
+    assert tpl and all(t["params"] == 2 for t in tpl)
+    # a healthy template tier raises no flags
+    problems = health_check(apps)
+    assert not any("template" in p for p in problems), problems
+
+
+def test_health_check_flags_template_that_bought_nothing(tmp_path):
+    """Template mode ON, the same query repeated — but the only
+    literal position was refused (LIMIT shape), so repeats share
+    nothing.  The health check must say so, with the refusal
+    reason."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    from spark_rapids_tpu.tools.profiling import health_check
+    log_dir = str(tmp_path / "events")
+    conf = dict(TPL_CONF)
+    conf["spark.rapids.tpu.eventLog.dir"] = log_dir
+    s = TpuSession(conf)
+    try:
+        df = s.create_dataframe(_pdf())
+        for _ in range(3):
+            df.limit(5).to_pandas()
+    finally:
+        s.stop()
+    problems = health_check(load_logs(log_dir))
+    flagged = [p for p in problems
+               if "template tier bought nothing" in p]
+    assert flagged, problems
+    assert REFUSE_LIMIT in flagged[0], flagged
+
+
+def test_health_check_flags_retrace_after_warmup():
+    """Synthesized eventlog shape: a hoisted template whose repeats
+    still re-traced must be flagged."""
+    from spark_rapids_tpu.tools.eventlog import AppInfo, QueryInfo
+    from spark_rapids_tpu.tools.profiling import health_check
+    app = AppInfo("s-1", "")
+    for i, misses in enumerate((5, 3)):
+        q = QueryInfo(i)
+        q.status = "success"
+        q.sharing = {"template": {"fingerprint": "abc123",
+                                  "params": 2,
+                                  "refusals": [REFUSE_ANSI]}}
+        q.pipeline = {"jitCacheMisses": misses}
+        app.queries.append(q)
+    problems = health_check([app])
+    flagged = [p for p in problems if "re-traced" in p]
+    assert flagged and REFUSE_ANSI in flagged[0], problems
