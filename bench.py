@@ -928,6 +928,157 @@ def fleet_main(n_subs: int) -> None:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# ------------------------------------------------------------- fleet-hosts --
+_FLEET_CHILD_SRC = """
+import json, sys
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
+
+path, cache_dir = sys.argv[1], sys.argv[2]
+s = TpuSession(conf={
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.fleet.cache.dir": cache_dir,
+})
+df = (s.read.parquet(path).filter(F.col("v") >= 0.0)
+      .group_by("k").agg(F.sum(F.col("v")).alias("sv"),
+                         F.count(F.col("v")).alias("c")))
+df.to_pandas()
+print("CHILD " + json.dumps({
+    "fleet_hits": s.result_cache.fleet_hits,
+    "cross_hits": s.fleet_cache.stats()["cross_hits"]}), flush=True)
+s.stop()
+"""
+
+
+def fleet_hosts_main(n_hosts: int) -> None:
+    """--fleet-hosts N: multi-host fleet bench (ISSUE 18) on a
+    logical-host partition of the local device mesh — the data axis
+    classifies DCN, so host-staged exchange, the DCN deadline scale,
+    and the membership layer all run exactly as they would across
+    processes.  Emits ONE JSON line: per-host rows/s, the cross-host
+    exchange wall (shuffle.exchange spans) and bytes moved vs the same
+    query on the undivided ICI mesh, plus the fleet-scoped cache's
+    cross-PROCESS hit counters (a real child process answering from
+    this process's published result)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+    from spark_rapids_tpu.utils import tracing
+
+    import jax
+    ndev = jax.device_count()
+    reps = int(os.environ.get("BENCH_FLEET_HOSTS_REPS", "5"))
+    rows = 1 << 17
+    d = tempfile.mkdtemp(prefix="tpu-fleet-hosts-bench-")
+    rng = np.random.default_rng(29)
+    path = os.path.join(d, "fact.parquet")
+    pd.DataFrame({"k": rng.integers(0, 64, rows),
+                  "v": rng.integers(0, 10_000, rows)
+                  .astype(np.float64)}).to_parquet(path, index=False)
+
+    def query(s):
+        return (s.read.parquet(path).filter(F.col("v") >= 0.0)
+                .group_by("k").agg(F.sum(F.col("v")).alias("sv"),
+                                   F.count(F.col("v")).alias("c")))
+
+    def drive(s):
+        """Warm once, then reps timed runs: wall p50, the exchange
+        span wall, and the exchange bytes actually moved."""
+        q = query(s)
+        q.to_pandas()
+        m0 = metrics_for_session(s).snapshot()
+        walls, ex_ms = [], 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            q.to_pandas()
+            walls.append((time.perf_counter() - t0) * 1e3)
+            sp = getattr(s, "last_span_stats", None) or {}
+            ex_ms += (sp.get("phases") or {}).get("exchange", 0.0)
+        m1 = metrics_for_session(s).snapshot()
+        walls.sort()
+        return {
+            "wall_ms_p50": round(nearest_rank(walls, 0.50), 3),
+            "exchange_wall_ms": round(ex_ms, 3),
+            "bytes_moved": int(m1["bytesMoved"] - m0["bytesMoved"]),
+            "exchanges": int(m1["exchanges"] - m0["exchanges"]),
+        }
+
+    try:
+        base_conf = dict(trace_conf() or {})
+        base_conf["spark.rapids.tpu.trace.enabled"] = True
+        base_conf["spark.rapids.sql.distributed.numShards"] = str(ndev)
+
+        # undivided mesh: every link ICI, the A/B baseline
+        s_ici = TpuSession(dict(base_conf))
+        ici = drive(s_ici)
+        s_ici.stop()
+
+        # logical-host fleet: data axis spans hosts -> DCN semantics
+        cache_dir = os.path.join(d, "fcache")
+        s_dcn = TpuSession(dict(base_conf, **{
+            "spark.rapids.tpu.fleet.logicalHosts": str(n_hosts),
+            "spark.rapids.tpu.fleet.membershipDir":
+                os.path.join(d, "members"),
+        }))
+        fleet_live = s_dcn.fleet_membership is not None
+        dcn = drive(s_dcn)
+        s_dcn.stop()
+
+        # fleet-scoped cache, cross-PROCESS: publish here, then a real
+        # child process answers from the shared directory
+        s_pub = TpuSession({
+            "spark.rapids.tpu.serving.resultCache.enabled": True,
+            "spark.rapids.tpu.fleet.cache.dir": cache_dir,
+        })
+        query(s_pub).to_pandas()
+        stores = s_pub.result_cache.fleet_stores
+        s_pub.stop()
+        child = subprocess.run(
+            [sys.executable, "-c", _FLEET_CHILD_SRC, path, cache_dir],
+            capture_output=True, text=True, timeout=300)
+        child_stats = {"fleet_hits": 0, "cross_hits": 0}
+        for line in child.stdout.splitlines():
+            if line.startswith("CHILD "):
+                child_stats = json.loads(line[len("CHILD "):])
+        tracing.configure(enabled=False)
+
+        wall_s = sum([dcn["wall_ms_p50"]]) / 1e3
+        rows_per_s = rows / max(wall_s, 1e-9)
+        print(json.dumps({
+            "metric": "fleet_hosts_rows_per_s_per_host",
+            "value": round(rows_per_s / max(n_hosts, 1), 1),
+            "unit": "rows/s/host",
+            "hosts": n_hosts,
+            "devices": ndev,
+            "rows": rows,
+            "reps": reps,
+            "fleet_membership_live": fleet_live,
+            "rows_per_s": round(rows_per_s, 1),
+            "dcn": dcn,
+            "ici": ici,
+            "dcn_vs_ici_bytes": round(
+                dcn["bytes_moved"] / max(ici["bytes_moved"], 1), 3),
+            "dcn_vs_ici_exchange_wall": round(
+                dcn["exchange_wall_ms"] /
+                max(ici["exchange_wall_ms"], 1e-9), 3),
+            "fleet_cache": {
+                "stores": stores,
+                "child_fleet_hits": child_stats["fleet_hits"],
+                "cross_process_hits": child_stats["cross_hits"],
+            },
+        }))
+        sys.stdout.flush()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # ------------------------------------------------------------------ repeat --
 def repeat_main(n_repeats: int) -> None:
     """Warm-start bench (whole-stage fusion + persistent jit cache):
@@ -1448,6 +1599,10 @@ if __name__ == "__main__":
         idx = sys.argv.index("--ingest-ticks")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8
         ingest_main(n)
+    elif "--fleet-hosts" in sys.argv:
+        idx = sys.argv.index("--fleet-hosts")
+        n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 2
+        fleet_hosts_main(n)
     elif "--fleet" in sys.argv:
         idx = sys.argv.index("--fleet")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8

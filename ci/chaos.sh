@@ -1200,4 +1200,82 @@ print("template spray OK (4 bindings x 3 waves exact, "
       f"invalidations={snap2['invalidations']}, planning passes 0)")
 PY
 
+echo "== multi-host fleet spray (logical-host fleet, injected host loss + host_sync delays: shrink-rung recovery bit-identical, co-hosted queries clean, stale writer fenced) =="
+# ISSUE 18 gate: a 2-host logical fleet (8-device mesh partitioned by
+# fleet.logicalHosts, real HostMembership registry) loses a host
+# mid-query — an injected HostLossFault on the fleet.heartbeat point,
+# with bounded delays sprayed on dist.host_sync — and must recover
+# through the ladder's SHRINK rung: mesh rebuilt over the survivors,
+# answer bit-identical to the clean full-fleet run.  Co-hosted clean
+# queries are counter-pinned at ZERO attributed recovery events, zero
+# robustness events float unattributed, and a zombie writer still
+# holding the pre-shrink fence token is REJECTED by the fleet cache
+# (entry never written, FleetCacheFence health trail recorded).
+python - <<'PY'
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.robustness import inject as I
+
+logdir = tempfile.mkdtemp(prefix="tpu-fleet-chaos-events-")
+s = TpuSession({
+    "spark.rapids.sql.distributed.numShards": "8",
+    "spark.rapids.tpu.fleet.logicalHosts": "2",
+    "spark.rapids.tpu.fleet.membershipDir":
+        tempfile.mkdtemp(prefix="tpu-fleet-chaos-members-"),
+    "spark.rapids.tpu.fleet.cache.dir":
+        tempfile.mkdtemp(prefix="tpu-fleet-chaos-cache-"),
+    # un-rate-limit the heartbeat so the injected loss lands on the
+    # query path's first membership check
+    "spark.rapids.tpu.fleet.heartbeatMs": 1,
+    "spark.rapids.tpu.eventLog.dir": logdir,
+    "spark.rapids.sql.recovery.backoffMs": 5,
+})
+rng = np.random.default_rng(19)
+pdf = pd.DataFrame({"k": rng.integers(0, 50, 4000),
+                    "v": rng.normal(size=4000)})
+df = (s.create_dataframe(pdf).group_by("k")
+      .agg(F.sum(F.col("v")).alias("sv"),
+           F.count(F.col("v")).alias("c")))
+want = df.to_pandas().sort_values("k", ignore_index=True)
+assert s.mesh.devices.size == 8
+stale_tok = s.fleet_epoch  # the token a zombie would still hold
+s.recovery_log.clear()
+with I.scoped_rules():
+    I.inject("fleet.heartbeat", count=1, all_threads=True)
+    I.inject("dist.host_sync", kind="delay", delay_s=0.2, count=2,
+             probability=0.5, seed=61, all_threads=True)
+    got = df.to_pandas().sort_values("k", ignore_index=True)
+pd.testing.assert_frame_equal(got, want)  # survivor bit-identical
+actions = [r["action"] for r in s.recovery_log]
+assert "shrink" in actions, actions
+assert s.mesh.devices.size == 4, "mesh did not shrink to survivors"
+# co-hosted clean queries: ZERO new attributed recovery events
+n_events = len(s.recovery_log)
+again = df.to_pandas().sort_values("k", ignore_index=True)
+pd.testing.assert_frame_equal(again, want)
+assert len(s.recovery_log) == n_events, s.recovery_log[n_events:]
+# the zombie's publish: pre-shrink fence token, REJECTED + never read
+assert not s.fleet_cache.publish("zombie-entry", {"x": 1}, stale_tok)
+assert s.fleet_cache.counters["fenced"] == 1
+assert s.fleet_cache.lookup("zombie-entry") is None
+s.stop()
+from spark_rapids_tpu.tools.eventlog import load_logs
+app = load_logs(logdir)[0]
+assert app.recovery == [], f"unattributed recovery: {app.recovery}"
+for q in app.queries:
+    kinds = {r.get("fault") for r in q.recovery}
+    assert kinds <= {"host_loss"}, (q.query_id, q.recovery)
+fleet_kinds = [e["kind"] for e in app.fleet]
+for k in ("join", "shrink", "fence"):
+    assert k in fleet_kinds, fleet_kinds
+print("multi-host fleet spray OK (shrink recovery exact, "
+      f"trail={actions}, fleet events={fleet_kinds}, "
+      f"fenced={s.fleet_cache.counters['fenced']})")
+PY
+
 echo "CHAOS OK"

@@ -85,6 +85,10 @@ class TpuSession:
         _jc.configure_persistent(
             self.conf.get(_rc.JIT_CACHE_DIR) or None,
             self.conf.get(_rc.JIT_CACHE_MAX_BYTES))
+        # multi-controller bring-up MUST precede the first jax.devices()
+        # call (mesh construction below): jax.distributed.initialize is
+        # what makes the fleet's global devices visible
+        self._init_fleet_runtime()
         self.mesh = mesh
         if self.mesh is None:
             from spark_rapids_tpu.config import rapids_conf as rc
@@ -92,9 +96,106 @@ class TpuSession:
             if n:
                 from spark_rapids_tpu.parallel.mesh import make_mesh
                 self.mesh = make_mesh(n)
+        self._init_fleet_membership()
         self._init_memory()
         self._init_observability()
+        if self.fleet_membership is not None:
+            # the JOIN beat waits for the event logger so HostJoin
+            # lands in the log (membership itself must exist earlier:
+            # the serving caches read fleet_cache at construction)
+            self.fleet_membership.beat(force=True)
         TpuSession._active = self
+
+    def _init_fleet_runtime(self) -> None:
+        """Join the multi-controller fleet when
+        spark.rapids.tpu.fleet.coordinator/.processId/.numProcesses are
+        configured (parallel/mesh.py init_fleet); single-controller
+        configs no-op."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.parallel import mesh as mesh_lib
+        self._fleet_multi = mesh_lib.init_fleet(
+            self.conf.get(rc.FLEET_COORDINATOR),
+            self.conf.get(rc.FLEET_PROCESS_ID),
+            self.conf.get(rc.FLEET_NUM_PROCESSES))
+
+    def _init_fleet_membership(self) -> None:
+        """Stand up host membership + the fleet-scoped cache store.
+        Three shapes: a real multi-controller fleet (hosts = jax
+        processes), a logical-host fleet (fleet.logicalHosts partitions
+        of a single-process mesh — the tier-1-testable simulation), or
+        no fleet at all (every attribute None, zero overhead)."""
+        import jax
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.parallel import mesh as mesh_lib
+        self.fleet_membership = None
+        self.fleet_cache = None
+        self.fleet_epoch = 0
+        self._logical_hosts_assigned = False
+        n_hosts, host = 1, 0
+        if self._fleet_multi:
+            n_hosts, host = jax.process_count(), jax.process_index()
+        elif self.mesh is not None:
+            logical = self.conf.get(rc.FLEET_LOGICAL_HOSTS)
+            if logical >= 2:
+                mesh_lib.assign_logical_hosts(self.mesh, logical)
+                self._logical_hosts_assigned = True
+                n_hosts = len(mesh_lib.mesh_hosts(self.mesh))
+        if n_hosts > 1:
+            self.fleet_membership = mesh_lib.HostMembership(
+                mesh_lib.membership_dir(
+                    self.conf.get(rc.FLEET_MEMBERSHIP_DIR),
+                    self.conf.get(rc.FLEET_COORDINATOR)),
+                host_id=host, n_hosts=n_hosts,
+                heartbeat_ms=self.conf.get(rc.FLEET_HEARTBEAT_MS),
+                missed_fatal=self.conf.get(rc.FLEET_MISSED_BEATS_FATAL),
+                session=self)
+        cache_dir = self.conf.get(rc.FLEET_CACHE_DIR)
+        if cache_dir:
+            from spark_rapids_tpu.serving.fleetcache import FleetStore
+            self.fleet_cache = FleetStore(cache_dir, session=self)
+            self.fleet_epoch = self.fleet_cache.fence_epoch()
+
+    def shrink_fleet_mesh(self, lost_host: int = -1) -> bool:
+        """The shrink rung's side effect (robustness/driver.py): swap
+        ``session.mesh`` for one rebuilt over the surviving hosts, so
+        the re-driven attempt plans distributed on what's left.  The
+        fleet cache's fence epoch bumps atomically with the swap — a
+        publish in flight from the lost host carries the OLD epoch and
+        is rejected (it could hold bytes computed on the dead layout).
+        ``lost_host`` names the casualty when known (-1: take the
+        membership registry's lost set, else drop the highest-indexed
+        remote host — the injected-loss-with-no-named-host case).
+        Returns False when there is nothing to shrink."""
+        from spark_rapids_tpu.parallel import mesh as mesh_lib
+        membership = self.fleet_membership
+        if membership is None or self.mesh is None:
+            return False
+        hosts_before = mesh_lib.mesh_hosts(self.mesh)
+        lost = set(membership.lost)
+        if lost_host >= 0:
+            lost.add(lost_host)
+        lost.discard(membership.host)
+        if not (lost & set(hosts_before)):
+            remote = [h for h in hosts_before if h != membership.host]
+            if not remote:
+                return False
+            lost = {max(remote)}
+        new_mesh = mesh_lib.surviving_mesh(self.mesh, lost)
+        membership.lost |= lost
+        from_devices = int(self.mesh.devices.size)
+        self.mesh = new_mesh
+        if self.fleet_cache is not None:
+            self.fleet_epoch = self.fleet_cache.bump_fence(
+                reason="shrink")
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session(
+            "MeshShrink", self,
+            fromHosts=len(hosts_before),
+            toHosts=len(mesh_lib.mesh_hosts(new_mesh)),
+            fromDevices=from_devices,
+            toDevices=int(new_mesh.devices.size),
+            lostHosts=sorted(lost), reason="host_loss")
+        return True
 
     def _init_observability(self) -> None:
         import itertools
@@ -207,6 +308,15 @@ class TpuSession:
                     store.close()
                 except Exception:
                     pass  # teardown must reach the catalog sweep
+        membership = getattr(self, "fleet_membership", None)
+        if membership is not None:
+            membership.leave()
+        if getattr(self, "_logical_hosts_assigned", False):
+            # module-level simulation state must not leak into the
+            # next session's link classification
+            from spark_rapids_tpu.parallel.mesh import \
+                clear_logical_hosts
+            clear_logical_hosts()
         cat = getattr(self, "memory_catalog", None)
         if cat is not None:
             cat.close()

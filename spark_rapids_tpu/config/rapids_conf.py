@@ -1059,6 +1059,91 @@ FLEET_SINK_MAX_RECORDS = conf(
     "against, which only matters for consumers lagging more than this "
     "many data-bearing ticks).", _to_int, _positive)
 
+FLEET_COORDINATOR = conf(
+    "spark.rapids.tpu.fleet.coordinator", "",
+    "Coordinator address (host:port) for multi-controller fleet "
+    "bring-up. When set together with fleet.processId and "
+    "fleet.numProcesses, session construction calls "
+    "jax.distributed.initialize so every host's process contributes "
+    "its local devices to one global mesh spanning DCN. Empty "
+    "(default) keeps the single-controller mode — one process, one "
+    "host, the behavior of every prior release.", str)
+
+FLEET_PROCESS_ID = conf(
+    "spark.rapids.tpu.fleet.processId", -1,
+    "This host's process index in the multi-controller fleet "
+    "(0..numProcesses-1; process 0 also serves as the coordinator). "
+    "-1 (default) with an empty fleet.coordinator means "
+    "single-controller mode.", _to_int,
+    lambda v: None if v >= -1 else "must be >= -1")
+
+FLEET_NUM_PROCESSES = conf(
+    "spark.rapids.tpu.fleet.numProcesses", 0,
+    "Total process count in the multi-controller fleet. 0 (default) "
+    "means single-controller mode; values >= 2 require "
+    "fleet.coordinator and fleet.processId.", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+FLEET_HEARTBEAT_MS = conf(
+    "spark.rapids.tpu.fleet.heartbeatMs", 500,
+    "Heartbeat period for the per-host membership registry "
+    "(parallel/mesh.py HostMembership): each host writes a beat "
+    "record at most this often, and peers are judged against it. A "
+    "peer silent for heartbeatMs * missedBeatsFatal is declared lost "
+    "— a HostLoss event is emitted and the next membership check "
+    "raises a RETRYABLE HostLossFault, entering the recovery "
+    "ladder's shrink rung.", _to_int, _positive)
+
+FLEET_MISSED_BEATS_FATAL = conf(
+    "spark.rapids.tpu.fleet.missedBeatsFatal", 3,
+    "How many consecutive missed heartbeats declare a peer host lost "
+    "(see fleet.heartbeatMs). Higher values tolerate longer GC/compile "
+    "pauses at the cost of slower failure detection.", _to_int,
+    _positive)
+
+FLEET_MEMBERSHIP_DIR = conf(
+    "spark.rapids.tpu.fleet.membershipDir", "",
+    "Directory backing the HostMembership registry (one beat file per "
+    "host, written atomically). On CPU test meshes and "
+    "logical-host fleets this is a local tmp dir; on a real fleet it "
+    "is shared storage every host can reach. Empty (default) places "
+    "it under the system temp dir keyed by coordinator address, or "
+    "disables membership entirely when the session has no fleet.",
+    str)
+
+FLEET_CACHE_DIR = conf(
+    "spark.rapids.tpu.fleet.cache.dir", "",
+    "Shared-storage directory for FLEET-scoped stage/result/template "
+    "cache entries (serving/fleetcache.py): session caches publish "
+    "CRC-stamped, fingerprint-verified payloads here so a repeated "
+    "plan on ANY host answers from a peer's work. Writers are "
+    "epoch-fenced — a publish carrying a fence token older than the "
+    "registry's current epoch (a partitioned or restarted 'zombie' "
+    "host) is rejected and health-checked, never read. Empty "
+    "(default) keeps every cache session-scoped.", str)
+
+FLEET_DCN_DEADLINE_SCALE = conf(
+    "spark.rapids.tpu.fleet.dcnDeadlineScale", 4.0,
+    "Watchdog deadline multiplier for exchange launches whose "
+    "collective crosses DCN (the data axis spans processes or "
+    "logical hosts): cross-host hops are orders of magnitude slower "
+    "than ICI, so the shuffle.exchange deadline scales by this factor "
+    "before a TimeoutFault is parked. 1.0 disables the scaling.",
+    _to_float, _positive)
+
+FLEET_LOGICAL_HOSTS = conf(
+    "spark.rapids.tpu.fleet.logicalHosts", 0,
+    "Partition a SINGLE-process mesh's devices into this many "
+    "simulated hosts for testing the fleet machinery without real "
+    "multi-controller bring-up: axis link classification reads 'dcn' "
+    "across simulated host boundaries (DCN collective selection, "
+    "deadline scaling, and byte accounting all engage), membership "
+    "tracks one logical host per partition, and the shrink rung can "
+    "rebuild the mesh over survivors. 0 (default) disables; ignored "
+    "in real multi-controller mode (process boundaries define "
+    "hosts).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
 ENCODING_EXECUTION_ENABLED = conf(
     "spark.rapids.tpu.encoding.execution.enabled", False,
     "Encoded execution: string GROUP BY keys that are bare column "

@@ -48,6 +48,7 @@ from spark_rapids_tpu.columnar.dtypes import DataType
 from spark_rapids_tpu.ops import predicates as preds
 from spark_rapids_tpu.ops.expressions import (
     Alias, BoundReference, ColVal, EmitContext, Expression, Literal)
+from spark_rapids_tpu.parallel import mesh as mesh_lib
 from spark_rapids_tpu.parallel.mesh import shard_map as _shard_map
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.logical import AggregateExpression
@@ -654,8 +655,8 @@ def _run_slice(f: ShardedFrame, los, his):
     return cached_jit(sig, lambda: _shard_map(
         step, mesh=f.mesh, in_specs=(P(axis), P(axis), P(axis)),
         out_specs=P(axis), check_vma=False))(
-        f.cols, jnp.asarray(np.asarray(los, dtype=np.int32)),
-        jnp.asarray(np.asarray(his, dtype=np.int32)))
+        f.cols, mesh_lib.host_put(f.mesh, np.asarray(los, np.int32)),
+        mesh_lib.host_put(f.mesh, np.asarray(his, np.int32)))
 
 
 def _run_fused(f: ShardedFrame, exprs: Sequence[Expression],
@@ -1019,10 +1020,14 @@ class DistPlanner:
                 sl = slice(offsets[s], offsets[s] + counts[s])
                 vbuf[s, :counts[s]] = host[sl]
                 mbuf[s, :counts[s]] = valid[sl]
-            cols.append((jnp.asarray(vbuf.reshape(-1)),
-                         jnp.asarray(mbuf.reshape(-1))))
+            # host_put, not jnp.asarray: under a multi-controller mesh
+            # every process executed the identical scan above, so each
+            # contributes its addressable shards of the SAME global
+            # buffer (single-controller this IS jnp.asarray)
+            cols.append((mesh_lib.host_put(self.mesh, vbuf.reshape(-1)),
+                         mesh_lib.host_put(self.mesh, mbuf.reshape(-1))))
         return ShardedFrame(self.mesh, names, log_dtypes, cols,
-                            jnp.asarray(counts), enc)
+                            mesh_lib.host_put(self.mesh, counts), enc)
 
     def _scan_sharded_files(self, plan, schema) -> ShardedFrame:
         """Genuinely distributed scan: the FILE LIST is sharded across
@@ -1407,7 +1412,7 @@ class DistPlanner:
                 # count the single output row on shard 0 only
                 nrows = np.zeros(f.nshards, dtype=np.int32)
                 nrows[0] = 1
-                nrows = jnp.asarray(nrows)
+                nrows = mesh_lib.host_put(self.mesh, nrows)
             else:
                 nrows = outs[0][2].reshape(-1)
             agg_frame = ShardedFrame(
@@ -1895,13 +1900,14 @@ class DistPlanner:
         f = self.run(plan.child, dry)
         if dry:
             return f
-        counts = np.asarray(f.nrows).copy()
+        counts = mesh_lib.to_host(f.nrows).copy()
         left = plan.n
         for i in range(len(counts)):
             take = min(int(counts[i]), left)
             counts[i] = take
             left -= take
-        return f.replace(nrows=jnp.asarray(counts.astype(np.int32)))
+        return f.replace(nrows=mesh_lib.host_put(
+            self.mesh, counts.astype(np.int32)))
 
     def _topn(self, plan: L.Limit, dry: bool) -> ShardedFrame:
         from spark_rapids_tpu.parallel.distsort import (
@@ -1915,12 +1921,12 @@ class DistPlanner:
                                plan.n)
         flat, key_flat, nrows = dist(f.cols, f.nrows)
         nshards = f.nshards
-        counts = np.asarray(nrows).reshape(-1)
+        counts = mesh_lib.to_host(nrows).reshape(-1)
         cap = int(flat[0][0].shape[0]) // nshards
 
         def host_rows(pair):
-            v = np.asarray(pair[0]).reshape(nshards, cap)
-            m = np.asarray(pair[1]).reshape(nshards, cap)
+            v = mesh_lib.to_host(pair[0]).reshape(nshards, cap)
+            m = mesh_lib.to_host(pair[1]).reshape(nshards, cap)
             vs = np.concatenate([v[i, :counts[i]] for i in range(nshards)])
             ms = np.concatenate([m[i, :counts[i]] for i in range(nshards)])
             return vs, ms
@@ -1937,10 +1943,12 @@ class DistPlanner:
             mbuf = np.zeros(nshards * out_cap, dtype=bool)
             vbuf[:n] = vs[order]
             mbuf[:n] = ms[order]
-            cols.append((jnp.asarray(vbuf), jnp.asarray(mbuf)))
+            cols.append((mesh_lib.host_put(self.mesh, vbuf),
+                         mesh_lib.host_put(self.mesh, mbuf)))
         out_counts = np.zeros(nshards, dtype=np.int32)
         out_counts[0] = n
-        return f.replace(cols=cols, nrows=jnp.asarray(out_counts))
+        return f.replace(cols=cols,
+                         nrows=mesh_lib.host_put(self.mesh, out_counts))
 
     # -- collect ----------------------------------------------------------
     def collect(self, f: ShardedFrame) -> ColumnarBatch:
@@ -1952,12 +1960,12 @@ class DistPlanner:
             self._xwindow.resolve_all()
         nshards = f.nshards
         cap = f.capacity
-        counts = np.asarray(f.nrows).reshape(-1)
+        counts = mesh_lib.to_host(f.nrows).reshape(-1)
         total = int(counts.sum())
         out = {}
         for i, ((name, dt), (v, m)) in enumerate(zip(f.schema, f.cols)):
-            vals = np.asarray(v).reshape(nshards, cap)
-            mask = np.asarray(m).reshape(nshards, cap)
+            vals = mesh_lib.to_host(v).reshape(nshards, cap)
+            mask = mesh_lib.to_host(m).reshape(nshards, cap)
             if total:
                 vs = np.concatenate(
                     [vals[s, :counts[s]] for s in range(nshards)])

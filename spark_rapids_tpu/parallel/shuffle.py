@@ -75,9 +75,37 @@ def launch_checkpoint():
     host-touching checkpoints can deliver it (robustness/watchdog.py)."""
     from spark_rapids_tpu.robustness import watchdog
     from spark_rapids_tpu.robustness.inject import fire
-    with watchdog.section("shuffle.exchange"):
+    with watchdog.section("shuffle.exchange",
+                          deadline_ms=_launch_deadline_ms()):
         fire("shuffle.exchange")
         yield
+
+
+def _launch_deadline_ms() -> Optional[float]:
+    """Exchange-launch deadline, DCN-aware: a cross-host collective is
+    orders of magnitude slower than the same bytes over ICI, so when
+    the active session's data axis spans hosts the per-point deadline
+    scales by ``spark.rapids.tpu.fleet.dcnDeadlineScale`` — otherwise
+    the deadline tuned for ICI misfires on every healthy DCN exchange.
+    None defers to the watchdog's own per-point resolution (the
+    single-host behavior, unchanged)."""
+    try:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    except ImportError:  # torn-down interpreter only
+        return None
+    mesh = getattr(session, "mesh", None)
+    if session is None or mesh is None:
+        return None
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.parallel.mesh import axis_link_kind
+    if axis_link_kind(mesh) != "dcn":
+        return None
+    base = session.conf.watchdog_deadline_ms("shuffle.exchange")
+    if base is None or base <= 0:
+        return None
+    return float(base) * float(
+        session.conf.get(rc.FLEET_DCN_DEADLINE_SCALE))
 
 
 def pick_slot(max_slice: int, capacity: int, floor: int = 8) -> int:

@@ -421,7 +421,6 @@ class CheckpointManager:
             return self._restore_body(sid, entry, batch, mesh)
 
     def _restore_body(self, sid, entry, batch, mesh):
-        import jax.numpy as jnp
         from spark_rapids_tpu.memory.spill import _payload_checksum
         from spark_rapids_tpu.parallel.dist_planner import ShardedFrame
         payload = {"__counts.data":
@@ -445,10 +444,14 @@ class CheckpointManager:
             self.drop(sid, reason=f"crc {got:#010x} != stored "
                                   f"{entry.crc:#010x}")
             return None
-        cols = [(jnp.asarray(payload[f"c{i}.data"]),
-                 jnp.asarray(payload[f"c{i}.validity"]))
+        # host_put, not jnp.asarray: every fleet controller restores the
+        # identical host payload, so each contributes its shards of the
+        # global frame (single-controller this IS jnp.asarray)
+        from spark_rapids_tpu.parallel.mesh import host_put
+        cols = [(host_put(mesh, payload[f"c{i}.data"]),
+                 host_put(mesh, payload[f"c{i}.validity"]))
                 for i in range(len(entry.names))]
-        nrows = jnp.asarray(payload["__counts.data"])
+        nrows = host_put(mesh, payload["__counts.data"])
         self._bump("resumes")
         self._bump("stagesSkipped", entry.stages)
         self._emit("CheckpointResume", stageId=sid,

@@ -15,6 +15,10 @@ here, where the recovery options get progressively more drastic:
   demote     — re-plan the distributed query onto a single device
                (mesh sessions only; shuffle/host-sync faults that
                survive retries)
+  shrink     — rebuild the mesh over the SURVIVING hosts and re-plan
+               on it (fleet sessions only; a HostLossFault enters
+               here — the lost host's shards are gone, but the
+               remaining fleet can still answer distributed)
   cpu        — re-plan the whole query onto the CPU fallback chain
                (exec/fallback.py) — slow, but it answers
 
@@ -45,15 +49,17 @@ RETRY = "retry"
 SPILL_RETRY = "spill"
 SPLIT_RETRY = "split"
 DEMOTE_SINGLE_DEVICE = "demote"
+SHRINK_FLEET = "shrink"
 CPU_FALLBACK = "cpu"
 
 # canonical escalation order (every ladder is a subsequence of this)
 RUNG_ORDER = [RETRY, SPILL_RETRY, SPLIT_RETRY, DEMOTE_SINGLE_DEVICE,
-              CPU_FALLBACK]
+              SHRINK_FLEET, CPU_FALLBACK]
 
 # rungs that change the plan's shard layout: stage-checkpoint lineage
 # keyed to the mesh layout is stale once any of these runs
-_LAYOUT_CHANGING = (SPLIT_RETRY, DEMOTE_SINGLE_DEVICE, CPU_FALLBACK)
+_LAYOUT_CHANGING = (SPLIT_RETRY, DEMOTE_SINGLE_DEVICE, SHRINK_FLEET,
+                    CPU_FALLBACK)
 
 
 @dataclass
@@ -153,11 +159,21 @@ class QueryRetryDriver:
         rungs = [RETRY] * self.max_retries + [SPILL_RETRY, SPLIT_RETRY]
         if getattr(self.session, "mesh", None) is not None:
             rungs.append(DEMOTE_SINGLE_DEVICE)
+            if getattr(self.session, "fleet_membership", None) \
+                    is not None:
+                rungs.append(SHRINK_FLEET)
         rungs.append(CPU_FALLBACK)
         return rungs
 
     @staticmethod
     def _entry_rung(fault: F.Fault) -> str:
+        if fault.kind == "host_loss":
+            # identical re-execution waits on a dead peer forever; the
+            # shrink rung rebuilds the mesh over survivors first.  A
+            # non-fleet session has no shrink rung in its ladder, so
+            # _advance_to escalates this entry to cpu — the only rung
+            # that doesn't need the lost host
+            return SHRINK_FLEET
         if fault.severity == F.DEGRADABLE:
             # identical re-execution is pointless; jump to plan
             # changes.  Spill corruption enters at SPLIT: the dropped
@@ -179,6 +195,10 @@ class QueryRetryDriver:
             mode.batch_scale = prev.batch_scale / 2
         elif rung == DEMOTE_SINGLE_DEVICE:
             mode.use_mesh = False
+        elif rung == SHRINK_FLEET:
+            # stays distributed: the attempt re-reads session.mesh,
+            # which _shrink_fleet just rebuilt over the survivors
+            mode.use_mesh = True
         elif rung == CPU_FALLBACK:
             mode.use_mesh = False
             mode.cpu_only = True
@@ -274,6 +294,8 @@ class QueryRetryDriver:
                 self._update_lineage(rung, mode)
                 if rung == SPILL_RETRY:
                     self._spill_device_store()
+                if rung == SHRINK_FLEET:
+                    self._shrink_fleet(exc)
                 if rung == RETRY and self.backoff_s > 0:
                     # exponential backoff, capped (backoffCapMs) and
                     # jittered into [0.5, 1.0]x — chaos tests and real
@@ -283,6 +305,18 @@ class QueryRetryDriver:
                                self.backoff_cap_s)
                     time.sleep(base * (0.5 + 0.5 * self._rng.random()))
                     backoffs += 1
+
+    def _shrink_fleet(self, exc: BaseException) -> None:
+        """Rebuild the session mesh over surviving hosts (the shrink
+        rung's side effect; the re-attempt reads session.mesh fresh
+        and re-plans on the new layout).  Best-effort: a shrink that
+        cannot help — nothing survives, no fleet — leaves the mesh
+        alone and the re-attempt's failure escalates to cpu."""
+        try:
+            self.session.shrink_fleet_mesh(
+                lost_host=getattr(exc, "host", -1))
+        except Exception:
+            pass
 
     @staticmethod
     def _spill_device_store() -> None:

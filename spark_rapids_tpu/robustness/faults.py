@@ -95,6 +95,28 @@ class InjectedWorkerFault(InjectedFault):
     severity = DEGRADABLE
 
 
+class HostLossFault(InjectedFault):
+    """A fleet peer host went silent: its heartbeat record aged past
+    ``heartbeatMs * missedBeatsFatal`` in the HostMembership registry
+    (parallel/mesh.py), or the membership layer was told of the loss
+    directly (a coordinator-level eviction, an injected kill).
+    RETRYABLE — but unlike a transient retry, identical re-execution
+    on the SAME mesh cannot succeed while the host stays dark, so the
+    recovery ladder enters at its ``shrink`` rung: rebuild the mesh
+    over the surviving hosts, clear layout-keyed lineage, re-drive.
+    Subclasses InjectedFault so the chaos registry can raise it at
+    the ``fleet.heartbeat`` point with production classification."""
+
+    kind = "host_loss"
+    severity = RETRYABLE
+
+    def __init__(self, point: str = "fleet.heartbeat", note: str = "",
+                 host: int = -1):
+        super().__init__(point, note or (f"host {host} silent"
+                                         if host >= 0 else ""))
+        self.host = host
+
+
 class TimeoutFault(Exception):
     """A watchdog deadline fired: a monitored section (reader decode,
     shuffle launch, the pipeline worker heartbeat, whole-query wall

@@ -1,0 +1,198 @@
+"""FleetStore: fleet-scoped cache entries on shared storage, with
+epoch-fenced writers.
+
+The session caches (serving/reuse.py: result/template tiers, the
+shared stage cache) are already host-tier, CRC-verified and
+owner-attributed — this module is the storage layer that promotes them
+to FLEET scope: a directory every host can reach
+(``spark.rapids.tpu.fleet.cache.dir``) holding one atomic blob per
+entry, so a repeated plan on ANY host answers from a peer's work.
+
+**Fencing** is the correctness core.  A host that was partitioned away
+(or judged lost and shrunk out of the mesh) may still be running — a
+*zombie* — and may try to publish an entry it computed before it was
+cut off.  Every writer therefore carries a fence token: the registry
+epoch it read at session start (or at its last fence refresh).  The
+shrink rung bumps the epoch atomically with the mesh swap, so a zombie
+publish arrives with ``token < epoch`` and is REJECTED under the
+publish lock — counted, health-checked (``FleetCacheFence`` events),
+and never written where a reader could see it.  This generalizes the
+ObservationStore's lock-file-merge discipline (utils/locking.py is the
+shared lock) from "merge, last writer wins field-wise" to "publish
+only while your lease on the layout is current".
+
+Readers never need the lock: entries land by atomic rename and every
+blob re-verifies its CRC at lookup, so a torn or rotted file is a miss
+(never wrong bytes) — the same verification discipline every other
+tier in the engine follows.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import zlib
+from typing import Any, Optional, Tuple
+
+from spark_rapids_tpu.utils.locking import InterProcessLock
+
+_FENCE_FILE = "fence.json"
+_BLOB_MAGIC = b"SRTFC1\n"
+
+
+def _entry_path(dirpath: str, key: str) -> str:
+    return os.path.join(
+        dirpath, f"e-{hashlib.sha256(key.encode()).hexdigest()}.bin")
+
+
+class FleetStore:
+    """One fleet's shared cache directory.  All methods are
+    best-effort: storage trouble degrades to a miss / skipped publish,
+    never to an error on the query path."""
+
+    def __init__(self, dirpath: str, session=None):
+        self.dir = dirpath
+        self._session = session
+        self._lock = threading.Lock()
+        self._fence_lock = InterProcessLock(
+            os.path.join(dirpath, _FENCE_FILE + ".lock"))
+        # counters surfaced via stats() -> bench/tests; cross_hits are
+        # hits on entries another PROCESS published (the fleet payoff)
+        self.counters = {"hits": 0, "cross_hits": 0, "misses": 0,
+                         "stores": 0, "fenced": 0}
+        os.makedirs(dirpath, exist_ok=True)
+
+    def _emit(self, **fields) -> None:
+        try:
+            from spark_rapids_tpu.utils.events import emit_on_session
+            emit_on_session("FleetCacheFence", self._session, **fields)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- fence --
+    def fence_epoch(self) -> int:
+        """Current fence epoch (0 for a fresh directory)."""
+        try:
+            with open(os.path.join(self.dir, _FENCE_FILE),
+                      encoding="utf-8") as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def bump_fence(self, reason: str = "") -> int:
+        """Advance the fence epoch (shrink rung, membership change).
+        Every writer still holding the old token is fenced from here
+        on.  Returns the new epoch — the caller's fresh token."""
+        path = os.path.join(self.dir, _FENCE_FILE)
+        got = self._fence_lock.acquire(timeout_s=5.0)
+        try:
+            epoch = self.fence_epoch() + 1
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"epoch": epoch}, f)
+                os.replace(tmp, path)
+            except OSError:
+                return self.fence_epoch()
+        finally:
+            if got:
+                self._fence_lock.release()
+        self._emit(action="bump", fenceEpoch=epoch, reason=reason)
+        return epoch
+
+    # ----------------------------------------------------------- publish --
+    def publish(self, key: str, obj: Any, token: int) -> bool:
+        """Write ``obj`` under ``key`` — IF the writer's fence
+        ``token`` is still current.  The token check runs under the
+        fence lock, so a concurrent bump either lands before (publish
+        rejected) or after (entry was valid when the bump fenced it) —
+        a zombie can never slip an entry past an epoch it didn't
+        live through."""
+        got = self._fence_lock.acquire(timeout_s=2.0)
+        if not got:
+            return False  # contended storage: skip, it's only a cache
+        try:
+            fence = self.fence_epoch()
+            if token < fence:
+                with self._lock:
+                    self.counters["fenced"] += 1
+                self._emit(action="reject", key=key[:64],
+                           writerEpoch=int(token), fenceEpoch=fence,
+                           reason="stale fence token")
+                return False
+            try:
+                blob = pickle.dumps(
+                    {"key": key, "epoch": int(token),
+                     "owner": os.getpid(), "payload": obj},
+                    protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception:
+                return False  # unpicklable payload: not publishable
+            path = _entry_path(self.dir, key)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(_BLOB_MAGIC)
+                    f.write(zlib.crc32(blob).to_bytes(4, "big"))
+                    f.write(blob)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return False
+        finally:
+            if got:
+                self._fence_lock.release()
+        with self._lock:
+            self.counters["stores"] += 1
+        return True
+
+    # ------------------------------------------------------------ lookup --
+    def lookup(self, key: str) -> Optional[Tuple[Any, int]]:
+        """Fetch ``key``'s payload -> (payload, owner_pid), or None.
+        Lock-free: entries land by atomic rename, and the CRC gate
+        turns any torn/rotted blob into a miss — never wrong bytes."""
+        path = _entry_path(self.dir, key)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            with self._lock:
+                self.counters["misses"] += 1
+            return None
+        try:
+            if not raw.startswith(_BLOB_MAGIC):
+                raise ValueError("bad magic")
+            off = len(_BLOB_MAGIC)
+            crc = int.from_bytes(raw[off:off + 4], "big")
+            blob = raw[off + 4:]
+            if zlib.crc32(blob) != crc:
+                raise ValueError("crc mismatch")
+            rec = pickle.loads(blob)
+            if rec.get("key") != key:
+                raise ValueError("key collision")
+        except Exception:
+            # verification failure: drop the blob so it cannot keep
+            # missing, and report a miss
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            with self._lock:
+                self.counters["misses"] += 1
+            return None
+        owner = int(rec.get("owner", 0))
+        with self._lock:
+            self.counters["hits"] += 1
+            if owner != os.getpid():
+                self.counters["cross_hits"] += 1
+        return rec.get("payload"), owner
+
+    # ------------------------------------------------------------- stats --
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self.counters)

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -225,6 +226,15 @@ class ResultCache:
         self.template_hits = 0
         self.template_misses = 0
         self.template_stores = 0
+        # fleet tier (ISSUE 18): a FleetStore on shared storage,
+        # consulted when the LOCAL map misses and published to after a
+        # local store.  Only pin-free plans participate: the weak-pin
+        # discipline keys in-memory inputs by ``id()``, which is
+        # process-local — a cross-process id match proves nothing, so
+        # plans with in-memory leaves never cross the process boundary.
+        self.fleet = getattr(session, "fleet_cache", None)
+        self.fleet_hits = 0
+        self.fleet_stores = 0
 
     # ------------------------------------------------------------- helpers --
     @staticmethod
@@ -373,13 +383,15 @@ class ResultCache:
         with _Locked(self._lock):
             entry = self._entries.get(pend.key)
             if entry is None:
-                if count_miss:
-                    self._count_miss_locked(tier)
-                    self._note_sharing(**{
-                        "templateCache" if tier == "template"
-                        else "resultCache": "miss"})
-                return None
-            if entry.fingerprint != pend.fingerprint:
+                fleet_try = (self.fleet is not None and not pend.pins)
+                if not fleet_try:
+                    if count_miss:
+                        self._count_miss_locked(tier)
+                        self._note_sharing(**{
+                            "templateCache" if tier == "template"
+                            else "resultCache": "miss"})
+                    return None
+            elif entry.fingerprint != pend.fingerprint:
                 # an input file moved (appended, rewritten — even
                 # same-size, the mtime catches it): the stored result
                 # no longer describes the data
@@ -391,7 +403,7 @@ class ResultCache:
                     "templateCache" if tier == "template"
                     else "resultCache": "invalidated"})
                 return None
-            if not entry.pins_alive():
+            elif not entry.pins_alive():
                 # an in-memory input batch the fingerprint's id()s
                 # describe was collected: the id may now alias a
                 # DIFFERENT object's data, so the match is unprovable
@@ -402,8 +414,13 @@ class ResultCache:
                     "templateCache" if tier == "template"
                     else "resultCache": "invalidated"})
                 return None
-            parts = list(entry.parts)
-            schema = list(entry.schema)
+            else:
+                parts = list(entry.parts)
+                schema = list(entry.schema)
+        if entry is None:
+            # local miss, no process-local pins: a peer process may
+            # have published this plan's result to the fleet store
+            return self._fleet_load(pend, point, count_miss)
         # heavy verification OUTSIDE the lock: materializing and
         # checksumming multi-MB host/disk payloads must not serialize
         # co-tenants' lookups into a queue (this is the concurrency
@@ -458,6 +475,82 @@ class ResultCache:
             self._note_sharing(resultCacheHit=True)
         return batches
 
+    # --------------------------------------------------------------- fleet --
+    def _fleet_load(self, pend: PendingResult, point: str,
+                    count_miss: bool = True):
+        """Consult the fleet tier after a local miss.  The verification
+        discipline is the local tier's, re-run on the peer's bytes:
+        fingerprint must match (statted fresh THIS process — a peer's
+        view of the files proves nothing here), every part's CRC
+        re-verified against the payload as unpickled.  Any doubt is a
+        miss; the entry is a peer's to invalidate, not ours."""
+        from spark_rapids_tpu.memory.spill import _payload_checksum
+        tier = pend.tier
+        try:
+            got = self.fleet.lookup(pend.key)
+        except Exception:
+            got = None
+        if got is None:
+            return self._miss("miss", count_miss, tier)
+        rec, owner = got
+        try:
+            if not isinstance(rec, dict) or \
+                    rec.get("fingerprint") != pend.fingerprint:
+                return self._miss("fleet-fingerprint-moved",
+                                  count_miss, tier)
+            # the chaos surface covers fleet loads too: the same
+            # raise/delay/corrupt rules the local tier faces
+            fire(point)
+            schema = list(rec["schema"])
+            batches = []
+            for payload, crc, nrows in rec["parts"]:
+                key = next((k for k in sorted(payload)
+                            if payload[k].size > 0), None)
+                if key is not None:
+                    mutated = fire_mutate(point, payload[key])
+                    if mutated is not payload[key]:
+                        payload = dict(payload)
+                        payload[key] = mutated
+                if _payload_checksum(payload, nrows) != crc:
+                    return self._miss("fleet-crc-mismatch",
+                                      count_miss, tier)
+                batches.append(_rebuild_batch(schema, payload, nrows))
+        except Exception:
+            return self._miss("miss", count_miss, tier)
+        with _Locked(self._lock):
+            self.hits += 1
+            self.fleet_hits += 1
+            if tier == "template":
+                self.template_hits += 1
+        self._emit("TemplateCacheHit" if tier == "template"
+                   else "ResultCacheHit", key=pend.key[:16],
+                   batches=len(batches),
+                   rows=sum(b.nrows for b in batches),
+                   tier="fleet", crossProcess=owner != os.getpid())
+        if tier == "template":
+            self._note_sharing(templateCacheHit=True)
+        else:
+            self._note_sharing(resultCacheHit=True)
+        return batches
+
+    def _fleet_publish(self, pend: PendingResult, schema,
+                       staged) -> None:
+        """Publish a freshly stored, pin-free result to the fleet
+        store, carrying the session's CURRENT fence token — a zombie
+        host's stale token is rejected at the store (see
+        serving/fleetcache.py)."""
+        try:
+            rec = {"fingerprint": pend.fingerprint,
+                   "schema": list(schema or []),
+                   "parts": [(payload, crc, nrows)
+                             for _, crc, nrows, payload in staged]}
+            token = int(getattr(self.session, "fleet_epoch", 0))
+            if self.fleet.publish(pend.key, rec, token):
+                with _Locked(self._lock):
+                    self.fleet_stores += 1
+        except Exception:
+            pass  # the fleet tier is an optimization, never a failure
+
     # --------------------------------------------------------------- store --
     def store(self, pend: PendingResult, batches) -> None:
         """Best-effort store of a freshly computed result under the
@@ -485,11 +578,11 @@ class ResultCache:
                 nrows = int(b.nrows)
                 crc = _payload_checksum(payload, nrows)
                 copy = _rebuild_batch(schema, payload, nrows)
-                staged.append((copy, crc, nrows))
+                staged.append((copy, crc, nrows, payload))
             from spark_rapids_tpu.serving import context as qc
             ctx = qc.current()
             owner_qid = ctx.qid if ctx is not None else None
-            for copy, crc, nrows in staged:
+            for copy, crc, nrows, _ in staged:
                 h = self.catalog.register(
                     copy, priority=RESULT_CACHE_PRIORITY)
                 self.catalog.demote(h, "HOST")
@@ -521,6 +614,10 @@ class ResultCache:
             self._emit("TemplateCacheStore" if pend.tier == "template"
                        else "ResultCacheStore", key=pend.key[:16],
                        bytes=total, batches=len(parts))
+            if self.fleet is not None and not pend.pins:
+                # pin-free plans only: id()-keyed in-memory pins are
+                # process-local, so a cross-process match is unsound
+                self._fleet_publish(pend, schema, staged)
         except Exception:
             for h, _, _ in parts:
                 try:
@@ -563,6 +660,8 @@ class ResultCache:
                 "templateHits": self.template_hits,
                 "templateMisses": self.template_misses,
                 "templateStores": self.template_stores,
+                "fleetHits": self.fleet_hits,
+                "fleetStores": self.fleet_stores,
             }
 
     def close(self) -> None:
@@ -613,6 +712,13 @@ class SharedStageCache(CheckpointManager):
         # stay in the owner store (no copy); a sid whose entry the
         # owner has since evicted simply misses (degrade = recompute).
         self._epoch_tiers: Dict[int, tuple] = {}
+        # fleet tier (ISSUE 18): shareable saves (purely file-backed
+        # input fingerprints — the planner's hint) publish to the
+        # fleet store so peer HOSTS splice them; consulted after both
+        # the local map and the epoch tier miss
+        self.fleet = getattr(session, "fleet_cache", None)
+        self.fleet_splices = 0
+        self.fleet_publishes = 0
 
     # ----------------------------------------------------------- event taps --
     _EVENT_MAP = {"CheckpointWrite": "SharedStageWrite",
@@ -666,6 +772,8 @@ class SharedStageCache(CheckpointManager):
             super().save(sid, frame, stages)
             if not known and sid in self._entries:
                 self._tally("stageWrites")
+                if shareable and self.fleet is not None:
+                    self._fleet_publish_stage(sid)
             elif not known:
                 self._owners.pop(sid, None)  # save refused/failed
 
@@ -687,7 +795,93 @@ class SharedStageCache(CheckpointManager):
         # published the sid with a committed epoch — ordinary queries
         # splice committed tick work through the same fallback the
         # co-subscribing ticks use
-        return self.epoch_restore(sid, mesh)
+        frame = self.epoch_restore(sid, mesh)
+        if frame is not None:
+            return frame
+        # last resort: a peer HOST may have published the sid to the
+        # fleet store (shareable = file-backed fingerprint, so the
+        # structural stage id proves the same bytes on any host)
+        return self._fleet_restore(sid, mesh)
+
+    # ------------------------------------------------------------ fleet tier --
+    def _fleet_publish_stage(self, sid: str) -> None:
+        """Publish a freshly saved SHAREABLE stage to the fleet store
+        under ``"S:" + sid``, fence-token attached.  The payload is
+        rebuilt from the just-registered (host-demoted) handle — no
+        extra device sync — and carries the entry's canonical CRC so a
+        peer re-verifies the exact bytes this host stamped."""
+        entry = self._entries.get(sid)
+        if entry is None:
+            return
+        try:
+            batch = entry.handle.materialize()
+            payload = {"__counts.data":
+                       batch.columns["__counts"].host_values()
+                       [:entry.nshards].astype(np.int32)}
+            for i in range(len(entry.names)):
+                col = batch.columns[f"c{i}"]
+                payload[f"c{i}.data"] = col.host_values()
+                v = col.host_validity()
+                payload[f"c{i}.validity"] = v if v is not None else \
+                    np.ones(col.capacity, dtype=bool)
+            rec = {"names": list(entry.names),
+                   "log_dtypes": list(entry.log_dtypes),
+                   "enc": {k: list(v) for k, v in entry.enc.items()},
+                   "nshards": int(entry.nshards),
+                   "capacity": int(entry.capacity),
+                   "crc": int(entry.crc),
+                   "stages": int(entry.stages),
+                   "payload": payload}
+            token = int(getattr(self.session, "fleet_epoch", 0))
+            if self.fleet.publish("S:" + sid, rec, token):
+                with self._tally_mu:
+                    self.fleet_publishes += 1
+        except Exception:
+            pass  # the fleet tier is an optimization, never a failure
+
+    def _fleet_restore(self, sid: str, mesh):
+        """Materialize ``sid`` from a peer's fleet-published payload,
+        or None.  Runs UNLOCKED like restore(); the CRC gate re-runs
+        on the bytes as unpickled, so a torn/rotted/foreign blob is a
+        recompute, never wrong data."""
+        if not self.enabled or self.fleet is None:
+            return None
+        try:
+            got = self.fleet.lookup("S:" + sid)
+        except Exception:
+            return None
+        if got is None:
+            return None
+        rec, owner = got
+        try:
+            from spark_rapids_tpu.memory.spill import _payload_checksum
+            payload = rec["payload"]
+            names = list(rec["names"])
+            total = int(payload["c0.data"].shape[0]) if names else 0
+            if _payload_checksum(payload, total) != int(rec["crc"]):
+                return None
+            from spark_rapids_tpu.parallel.dist_planner import \
+                ShardedFrame
+            from spark_rapids_tpu.parallel.mesh import host_put
+            cols = [(host_put(mesh, payload[f"c{i}.data"]),
+                     host_put(mesh, payload[f"c{i}.validity"]))
+                    for i in range(len(names))]
+            nrows = host_put(
+                mesh, np.asarray(payload["__counts.data"], np.int32))
+            frame = ShardedFrame(
+                mesh, names, list(rec["log_dtypes"]), cols, nrows,
+                {k: list(v) for k, v in rec["enc"].items()})
+        except Exception:
+            return None
+        self._bump("resumes")
+        self._bump("stagesSkipped", int(rec.get("stages", 1)))
+        with self._tally_mu:
+            self.fleet_splices += 1
+        self._emit("CheckpointResume", stageId=sid,
+                   stagesSaved=int(rec.get("stages", 1)), tier="fleet",
+                   crossProcess=owner != os.getpid())
+        self._tally("spliceResumes")
+        return frame
 
     # ------------------------------------------------------------ epoch tier --
     def publish_epoch(self, store, sids: frozenset) -> None:
@@ -769,4 +963,7 @@ class SharedStageCache(CheckpointManager):
 
     def snapshot(self) -> Dict[str, int]:
         with _Locked(self._mu):
-            return super().snapshot()
+            out = super().snapshot()
+            out["fleetSplices"] = self.fleet_splices
+            out["fleetPublishes"] = self.fleet_publishes
+            return out

@@ -148,6 +148,10 @@ class AppInfo:
     # un-attributed CostModelInvalid events (a load at session
     # construction runs before any query envelope)
     costmodel: List[Dict[str, str]] = field(default_factory=list)
+    # fleet membership / fencing stream (HostJoin, HostLoss,
+    # MeshShrink, FleetCacheFence) — host lifecycle is session-level
+    # by nature, so these always live on the app
+    fleet: List[Dict[str, object]] = field(default_factory=list)
 
     def max_concurrent(self) -> int:
         """Peak number of simultaneously-open query envelopes — the
@@ -279,7 +283,7 @@ def parse_event_log(path: str) -> AppInfo:
                 info = {k: rec[k] for k in
                         ("key", "bytes", "batches", "rows", "reason",
                          "stageId", "stages", "stagesSaved", "tier",
-                         "owner") if k in rec}
+                         "owner", "crossProcess") if k in rec}
                 info["kind"] = {
                     "ResultCacheHit": "hit",
                     "ResultCacheStore": "store",
@@ -297,6 +301,19 @@ def parse_event_log(path: str) -> AppInfo:
                 q = all_queries.get(rec.get("queryId"))
                 (q.sharing_events if q is not None
                  else app.sharing_events).append(info)
+            elif ev in ("HostJoin", "HostLoss", "MeshShrink",
+                        "FleetCacheFence"):
+                info = {k: rec[k] for k in
+                        ("host", "pid", "hosts", "silentMs", "missed",
+                         "fromHosts", "toHosts", "fromDevices",
+                         "toDevices", "lostHosts", "reason", "action",
+                         "key", "writerEpoch", "fenceEpoch", "ts")
+                        if k in rec}
+                info["kind"] = {"HostJoin": "join",
+                                "HostLoss": "loss",
+                                "MeshShrink": "shrink",
+                                "FleetCacheFence": "fence"}[ev]
+                app.fleet.append(info)
             elif ev == "CostModelInvalid":
                 info = {k: rec[k] for k in ("reason",) if k in rec}
                 q = all_queries.get(rec.get("queryId"))

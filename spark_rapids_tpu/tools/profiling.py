@@ -557,6 +557,111 @@ def concurrency_stats(apps: List[AppInfo]) -> Dict[str, float]:
     }
 
 
+def fleet_stats(apps: List[AppInfo]) -> Dict[str, object]:
+    """Fleet membership report: host joins/losses, mesh shrink
+    actions, and cache-fence activity (bumps and the rejected stale
+    publishes the fence exists to stop) — the observability face of
+    the multi-host machinery (parallel/mesh.py, serving/fleetcache.py)."""
+    joins = losses = shrinks = bumps = rejections = 0
+    cross_hits = 0
+    hosts: set = set()
+    lost_hosts: set = set()
+    for a in apps:
+        for ev in a.fleet:
+            kind = ev.get("kind")
+            if kind == "join":
+                joins += 1
+                hosts.add((a.session_id, ev.get("host")))
+            elif kind == "loss":
+                losses += 1
+                lost_hosts.add((a.session_id, ev.get("host")))
+            elif kind == "shrink":
+                shrinks += 1
+            elif kind == "fence":
+                if ev.get("action") == "bump":
+                    bumps += 1
+                elif ev.get("action") == "reject":
+                    rejections += 1
+        for q in a.queries:
+            for e in q.sharing_events:
+                if e.get("kind") in ("hit", "splice") and \
+                        e.get("tier") == "fleet" and \
+                        e.get("crossProcess"):
+                    cross_hits += 1
+        for e in a.sharing_events:
+            if e.get("kind") in ("hit", "splice") and \
+                    e.get("tier") == "fleet" and e.get("crossProcess"):
+                cross_hits += 1
+    if not (joins or losses or shrinks or bumps or rejections):
+        return {}
+    return {
+        "hosts_seen": len(hosts),
+        "joins": joins,
+        "losses": losses,
+        "hosts_lost": len(lost_hosts),
+        "mesh_shrinks": shrinks,
+        "fence_bumps": bumps,
+        "fenced_publishes": rejections,
+        "fleet_cross_hits": cross_hits,
+    }
+
+
+def _fleet_problems(a: AppInfo) -> List[str]:
+    """Fleet health: flapping hosts (lost then re-joined — a network
+    or heartbeat-tuning problem, each flap pays a shrink/recovery),
+    shrink rungs that saved nothing (the query fell through to cpu
+    anyway, so the fleet paid the mesh rebuild for nothing), and
+    fenced writers (the zombie-protection WORKING — worth surfacing
+    because a zombie process is still running somewhere)."""
+    problems: List[str] = []
+    who = a.session_id
+    loss_hosts: Dict[object, int] = {}
+    join_after_loss: Dict[object, int] = {}
+    for ev in a.fleet:
+        h = ev.get("host")
+        if ev.get("kind") == "loss":
+            loss_hosts[h] = loss_hosts.get(h, 0) + 1
+        elif ev.get("kind") == "join" and h in loss_hosts:
+            join_after_loss[h] = join_after_loss.get(h, 0) + 1
+    for h, flaps in sorted(join_after_loss.items()):
+        problems.append(
+            f"{who}: host {h} FLAPPING — declared lost then re-joined "
+            f"{flaps}x; each flap pays a mesh shrink + recovery "
+            "re-drive. Raise fleet.heartbeatMs/missedBeatsFatal or "
+            "fix the host's network before it erodes the fleet")
+    shrinks = [ev for ev in a.fleet if ev.get("kind") == "shrink"]
+    if shrinks:
+        # a shrink that saved nothing: some query still fell through
+        # to the cpu rung (or died) after the mesh rebuild
+        wasted = 0
+        for q in a.queries:
+            rungs = [r.get("rung") or r.get("action")
+                     for r in q.recovery]
+            if any(r == "shrink" for r in rungs) and (
+                    any(r == "cpu" for r in rungs) or not q.succeeded):
+                wasted += 1
+        if wasted:
+            problems.append(
+                f"{who}: shrink rung saved nothing for {wasted} "
+                "quer(y/ies) — the survivor mesh was rebuilt but the "
+                "re-drive still fell to cpu (or failed); if this "
+                "repeats, the failing stage doesn't fit the shrunken "
+                "fleet and the ladder should skip straight to cpu")
+    fenced = [ev for ev in a.fleet if ev.get("kind") == "fence"
+              and ev.get("action") == "reject"]
+    if fenced:
+        eps = sorted({(ev.get("writerEpoch"), ev.get("fenceEpoch"))
+                      for ev in fenced})
+        problems.append(
+            f"{who}: {len(fenced)} stale fleet-cache publish(es) "
+            f"REJECTED by the fence (writer/fence epochs: "
+            f"{', '.join(f'{w}<{f}' for w, f in eps)}) — the "
+            "zombie-writer protection worked and no reader saw the "
+            "entry, but a fenced-out process is still running "
+            "somewhere; make sure the lost host actually died")
+    return problems
+
+
 def health_check(apps: List[AppInfo]) -> List[str]:
     problems = []
     for a in apps:
@@ -872,6 +977,7 @@ def health_check(apps: List[AppInfo]) -> List[str]:
             a.session_id,
             list(a.incremental) + [e for q in a.queries
                                    for e in q.incremental]))
+        problems.extend(_fleet_problems(a))
         for f in a.fatal:
             problems.append(
                 f"{a.session_id}: fatal query (no attributed id) — "
@@ -1388,6 +1494,16 @@ def format_report(apps: List[AppInfo], top: int) -> str:
                 f"sourcePulls={ic['fleet_source_pulls']} "
                 f"splices={ic['fleet_splices']} "
                 f"failures={ic['fleet_failures']}")
+    fl = fleet_stats(apps)
+    if fl:
+        out.append("\n-- Fleet membership --")
+        out.append(
+            f"  hosts={fl['hosts_seen']} joins={fl['joins']} "
+            f"losses={fl['losses']} "
+            f"meshShrinks={fl['mesh_shrinks']} "
+            f"fenceBumps={fl['fence_bumps']} "
+            f"fencedPublishes={fl['fenced_publishes']} "
+            f"fleetCrossHits={fl['fleet_cross_hits']}")
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

@@ -139,6 +139,23 @@ def note_upload(ns: int) -> None:
         sink.upload_overlap_ns += ns
 
 
+def _globalize(buffers):
+    """Replicate non-fully-addressable buffers before the device_get:
+    in a multi-controller fleet each process holds only its shards of
+    a global array, and ``jax.device_get`` on one raises instead of
+    fetching — route those through ``mesh.to_host`` (a cross-fleet
+    replicate, every controller gets the identical full copy the SPMD
+    contract needs).  Single-controller arrays pass through untouched,
+    so this is one attribute probe per buffer on the common path."""
+    import jax
+    out = list(buffers)
+    for i, b in enumerate(out):
+        if isinstance(b, jax.Array) and not b.is_fully_addressable:
+            from spark_rapids_tpu.parallel.mesh import to_host
+            out[i] = to_host(b)
+    return out
+
+
 def fetch(*buffers):
     """Fetch device buffers to host in ONE transfer (one counted sync).
 
@@ -154,7 +171,7 @@ def fetch(*buffers):
     host_sync_metrics.bump(1)
     _charge_budget(1)
     with tracing.span("hostsync.fetch"):
-        got = jax.device_get(list(buffers))
+        got = jax.device_get(_globalize(buffers))
     return got[0] if len(buffers) == 1 else got
 
 
@@ -169,4 +186,4 @@ def fetch_all(buffers: Sequence):
     host_sync_metrics.bump(1)
     _charge_budget(1)
     with tracing.span("hostsync.fetch"):
-        return jax.device_get(list(buffers))
+        return jax.device_get(_globalize(buffers))

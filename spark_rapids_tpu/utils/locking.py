@@ -1,0 +1,163 @@
+"""Cross-process file locks with crashed-holder reaping.
+
+The ObservationStore introduced the repo's lock-file discipline
+(O_CREAT|O_EXCL beside the protected file, stale break by atomic
+rename); the fleet-scoped caches (serving/fleetcache.py) generalize it
+to shared storage that many hosts mutate.  This module is the one
+implementation both use, hardened against the failure mode the original
+left open: a kill-9'd merger's lock file wedged the next writer until
+the 30s mtime-staleness window expired.  Locks here are **pid-stamped**
+— the holder writes its pid into the lock file at acquire, and a waiter
+that finds the holder's pid dead reaps the lock immediately (atomic
+rename, exactly one reaper wins) instead of waiting out the window.
+The mtime window remains as the fallback for unreadable/empty stamps
+and for holders on OTHER machines (a shared-filesystem fleet cannot
+probe a remote pid; the stamp records host+pid so same-host death is
+still provable).
+
+Acquire polls with **jittered exponential backoff** (not the fixed
+10ms spin the ObservationStore used): N processes all hammering one
+lock after a fleet-wide event de-synchronize instead of retrying in
+lockstep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import time
+from typing import Optional
+
+_BACKOFF_START_S = 0.002
+_BACKOFF_CAP_S = 0.05
+
+
+def _pid_alive(pid: int) -> bool:
+    """Is ``pid`` a live process on THIS host?  Signal 0 probes without
+    delivering; EPERM means alive-but-not-ours."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return True  # unknowable: never reap on doubt
+
+
+class InterProcessLock:
+    """Advisory cross-process lock file.
+
+    ``acquire(timeout_s)`` returns True when held; ``release()`` must
+    follow (use as a context manager for scoped regions).  Best-effort
+    by design — callers treat a failed acquire as "skip/retry later",
+    never as corruption: every protected artifact is independently
+    verified (CRC) by its readers.
+    """
+
+    def __init__(self, path: str, stale_s: float = 30.0,
+                 seed: Optional[int] = None):
+        self.path = path
+        self.stale_s = stale_s
+        self._rng = random.Random(
+            seed if seed is not None else (os.getpid() << 16) ^ id(self))
+        self._held = False
+
+    # ------------------------------------------------------------- stamping --
+    def _stamp(self, fd: int) -> None:
+        """Write the holder's identity into the lock file so waiters
+        can prove a same-host holder dead and reap immediately."""
+        try:
+            os.write(fd, json.dumps(
+                {"pid": os.getpid(),
+                 "host": socket.gethostname()}).encode())
+        except OSError:
+            pass  # an unstamped lock still works via the mtime window
+
+    def _holder_dead(self) -> bool:
+        """True when the lock's stamp names a provably-dead same-host
+        holder.  Unreadable/foreign stamps return False — the mtime
+        staleness window handles those."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                stamp = json.loads(f.read() or "{}")
+        except (OSError, ValueError):
+            return False
+        if stamp.get("host") != socket.gethostname():
+            return False
+        try:
+            return not _pid_alive(int(stamp.get("pid", 0)))
+        except (TypeError, ValueError):
+            return False
+
+    def _reap(self) -> None:
+        """Break the lock by atomic rename: exactly one reaper wins the
+        rename, so two waiters can never each unlink the other's
+        freshly re-created lock and both enter the critical section."""
+        tomb = f"{self.path}.stale.{os.getpid()}"
+        os.rename(self.path, tomb)
+        os.unlink(tomb)
+
+    # -------------------------------------------------------------- acquire --
+    def acquire(self, timeout_s: float = 2.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        backoff = _BACKOFF_START_S
+        while True:
+            try:
+                fd = os.open(self.path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                try:
+                    self._stamp(fd)
+                finally:
+                    os.close(fd)
+                try:
+                    # anchor the staleness window to THIS acquire (the
+                    # create time could predate a queued wait on some
+                    # filesystems)
+                    os.utime(self.path)
+                except OSError:
+                    pass
+                self._held = True
+                return True
+            except FileExistsError:
+                try:
+                    if self._holder_dead():
+                        # crashed same-host holder: reap NOW — this is
+                        # the kill-9'd-merger case the mtime window
+                        # made every waiter sit out
+                        self._reap()
+                        continue
+                    if time.time() - os.path.getmtime(self.path) > \
+                            self.stale_s:
+                        self._reap()
+                        continue
+                except OSError:
+                    continue  # lock vanished / another reaper won
+                if time.monotonic() >= deadline:
+                    return False
+                # jittered exponential backoff: a herd of waiters
+                # de-synchronizes instead of polling in lockstep
+                time.sleep(backoff * (0.5 + 0.5 * self._rng.random()))
+                backoff = min(backoff * 2, _BACKOFF_CAP_S)
+            except OSError:
+                return False  # unwritable dir: no lock to be had
+
+    def release(self) -> None:
+        self._held = False
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def __enter__(self) -> "InterProcessLock":
+        self.acquire(timeout_s=float("inf"))
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False

@@ -476,6 +476,7 @@ class ObservationStore:
         self.dir = dirpath
         self.path = os.path.join(dirpath, OBS_FILE)
         self._lock = threading.Lock()
+        self._file_lock = None  # lazy InterProcessLock (pid-stamped)
         self.records: Dict[str, Dict[str, float]] = {}
         self._dirty = False
         # sites THIS store observed since its last successful flush —
@@ -508,50 +509,21 @@ class ObservationStore:
             self._dirty_sids.add(sid)
 
     def _acquire_file_lock(self) -> bool:
-        """Best-effort cross-process lock (O_EXCL create beside the
-        store).  False when another holder kept it past the timeout —
-        the caller retries at the next flush."""
-        lock = self.path + ".lock"
-        deadline = time.monotonic() + self.LOCK_TIMEOUT_S
-        while True:
-            try:
-                fd = os.open(lock,
-                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.close(fd)
-                try:
-                    # anchor the staleness window to THIS flush's
-                    # start (creation time could predate a queued
-                    # wait on some filesystems)
-                    os.utime(lock)
-                except OSError:
-                    pass
-                return True
-            except FileExistsError:
-                try:
-                    if time.time() - os.path.getmtime(lock) > \
-                            self.LOCK_STALE_S:
-                        # crashed holder: break the lock by ATOMIC
-                        # rename — exactly one breaker wins the
-                        # rename, so two sessions can never each
-                        # unlink the other's freshly re-created lock
-                        # and both enter the merge window
-                        stale = f"{lock}.stale.{os.getpid()}"
-                        os.rename(lock, stale)
-                        os.unlink(stale)
-                        continue
-                except OSError:
-                    continue  # lock vanished / another breaker won
-                if time.monotonic() >= deadline:
-                    return False
-                time.sleep(0.01)
-            except OSError:
-                return False  # unwritable dir: no lock, no flush
+        """Best-effort cross-process lock beside the store.  False when
+        another holder kept it past the timeout — the caller retries at
+        the next flush.  Delegates to the shared pid-stamped
+        InterProcessLock: a kill-9'd merger's lock is reaped as soon as
+        any waiter observes the dead pid, instead of wedging every
+        writer for the full LOCK_STALE_S window."""
+        from spark_rapids_tpu.utils.locking import InterProcessLock
+        if self._file_lock is None:
+            self._file_lock = InterProcessLock(self.path + ".lock",
+                                               stale_s=self.LOCK_STALE_S)
+        return self._file_lock.acquire(timeout_s=self.LOCK_TIMEOUT_S)
 
     def _release_file_lock(self) -> None:
-        try:
-            os.unlink(self.path + ".lock")
-        except OSError:
-            pass
+        if self._file_lock is not None:
+            self._file_lock.release()
 
     @classmethod
     def _merge_record(cls, disk: Dict[str, float],

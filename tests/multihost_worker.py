@@ -1,13 +1,23 @@
-"""Worker process for the multi-host distributed-aggregate test.
+"""Worker process for the multi-host distributed tests.
 
-Run as:  python multihost_worker.py <process_id> <num_processes> <port>
+Run as:  python multihost_worker.py <process_id> <num_processes> <port> [mode]
 
 Each process contributes its local CPU devices to a GLOBAL mesh (the
-jax.distributed multi-controller layout real TPU pods use), builds its
-local shard data, and runs the engine's DistributedAggregate SPMD —
-the all-to-all exchange crosses the process boundary (Gloo collectives
-here; ICI/DCN on a pod).  Emits per-group results from the process's
-addressable shards for the parent to merge and oracle-check.
+jax.distributed multi-controller layout real TPU pods use).  Modes:
+
+``agg`` (default) — builds local shard data and runs the engine's
+DistributedAggregate SPMD directly: the all-to-all exchange crosses the
+process boundary (Gloo collectives here; ICI/DCN on a pod).  Emits
+per-group results from the process's addressable shards for the parent
+to merge and oracle-check.
+
+``tpch`` — the full-engine path: a real TpuSession joins the fleet via
+the spark.rapids.tpu.fleet.* confs (session._init_fleet_runtime does
+the jax.distributed bring-up, membership heartbeats run on the shared
+registry dir), loads synthetic TPC-H tables, and runs q6 + q3
+distributed over the global mesh, checking each against a pandas
+oracle in-process.  Every controller executes the same SPMD program
+and must land the identical answer.
 """
 
 import json
@@ -15,15 +25,22 @@ import os
 import sys
 
 
-def main():
-    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+def _init_distributed(pid: int, nproc: int, port: str):
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    os.environ.setdefault("JAX_CPU_COLLECTIVES", "gloo")
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    # CPU collectives need the Gloo backend or every cross-process
+    # collective dies with "Multiprocess computations aren't
+    # implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
                                process_id=pid)
+    return jax
+
+
+def run_agg(pid: int, nproc: int, port: str) -> None:
+    jax = _init_distributed(pid, nproc, port)
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -79,6 +96,99 @@ def main():
     print("RESULT " + json.dumps(rows), flush=True)
     print(f"p{pid}: OK ({len(rows)} groups on "
           f"{local_shards} local shards)", flush=True)
+
+
+def run_tpch(pid: int, nproc: int, port: str) -> None:
+    # the SESSION does the distributed bring-up here (fleet confs) —
+    # only the platform/device flags are set up front
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # init_fleet's gloo gate
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.models import tpch
+
+    fleet_dir = os.environ["SR_TPU_FLEET_DIR"]
+    session = TpuSession(conf={
+        "spark.rapids.tpu.fleet.coordinator": f"localhost:{port}",
+        "spark.rapids.tpu.fleet.processId": str(pid),
+        "spark.rapids.tpu.fleet.numProcesses": str(nproc),
+        "spark.rapids.tpu.fleet.membershipDir":
+            os.path.join(fleet_dir, "members"),
+        "spark.rapids.tpu.fleet.cache.dir":
+            os.path.join(fleet_dir, "cache"),
+        # generous failure-detection budget: jit compilation stalls a
+        # controller for seconds, and a 2-process test declaring its
+        # peer dead mid-compile would shrink into divergent meshes
+        "spark.rapids.tpu.fleet.heartbeatMs": "2000",
+        "spark.rapids.tpu.fleet.missedBeatsFatal": "150",
+        "spark.rapids.sql.distributed.numShards": str(4 * nproc),
+    })
+    assert jax.process_count() == nproc, "fleet bring-up failed"
+    assert session.fleet_membership is not None
+    data = tpch.gen_tables(sf=0.002)
+    t = tpch.load(session, data)
+
+    # q6: scalar filter+aggregate
+    got6 = tpch.q6(t).to_pandas()
+    l = data["lineitem"]
+    m = l[(l.l_shipdate >= pd.Timestamp("1994-01-01")) &
+          (l.l_shipdate < pd.Timestamp("1995-01-01")) &
+          (l.l_discount >= 0.05) & (l.l_discount <= 0.07) &
+          (l.l_quantity < 24)]
+    want6 = float((m.l_extendedprice * m.l_discount).sum())
+    np.testing.assert_allclose(float(got6["revenue"][0]), want6,
+                               rtol=1e-9)
+    print(f"p{pid}: q6 OK revenue={float(got6['revenue'][0]):.6f}",
+          flush=True)
+
+    # q3: join + group-by + top-10
+    got3 = tpch.q3(t).to_pandas()
+    c, o = data["customer"], data["orders"]
+    cutoff = pd.Timestamp("1995-03-15")
+    cc = c[c.c_mktsegment == "BUILDING"]
+    oo = o[o.o_orderdate < cutoff]
+    ll = l[l.l_shipdate > cutoff]
+    j = cc.merge(oo, left_on="c_custkey", right_on="o_custkey") \
+        .merge(ll, left_on="o_orderkey", right_on="l_orderkey")
+    j = j.assign(revenue=j.l_extendedprice * (1 - j.l_discount))
+    want3 = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                      as_index=False)["revenue"].sum() \
+        .sort_values(["revenue", "o_orderdate"],
+                     ascending=[False, True]).head(10)
+    np.testing.assert_allclose(got3["revenue"], want3["revenue"],
+                               rtol=1e-9)
+    assert got3["l_orderkey"].tolist() == want3["l_orderkey"].tolist()
+    print("RESULT " + json.dumps(
+        [got3["l_orderkey"].tolist(), float(got6["revenue"][0])]),
+        flush=True)
+    print(f"p{pid}: q3 OK top={got3['l_orderkey'].tolist()[:3]}",
+          flush=True)
+    session.stop()
+    print(f"p{pid}: OK", flush=True)
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "agg"
+    try:
+        if mode == "tpch":
+            run_tpch(pid, nproc, port)
+        else:
+            run_agg(pid, nproc, port)
+    finally:
+        # without an explicit shutdown the non-coordinator processes
+        # hang at interpreter exit waiting on the coordinator service
+        try:
+            import jax
+            jax.distributed.shutdown()
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

@@ -24,6 +24,19 @@ import pytest
 N_PROC = 2
 SHARDS_PER_PROC = 4
 
+# environment markers that mean "this box cannot run a 2-process
+# jax.distributed CPU mesh at all" (no Gloo collectives in the wheel,
+# sandboxed loopback) — those skip with the reason recorded, while a
+# real engine bug still FAILS
+_ENV_SKIP_MARKERS = (
+    "Multiprocess computations aren't implemented",
+    "unknown collectives implementation",
+    "Unknown attribute cpu_collectives",
+    "Address already in use",
+    "DEADLINE_EXCEEDED",
+    "failed to connect to all addresses",
+)
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -33,7 +46,10 @@ def _free_port() -> int:
     return port
 
 
-def test_cross_process_aggregate_exchange():
+def _run_workers(mode: str, extra_env=None, timeout: int = 420):
+    """Spawn the 2-process worker fleet; returns (procs, outs).
+    Environment-level bring-up failures skip the calling test with the
+    marker recorded; engine failures assert."""
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__),
                           "multihost_worker.py")
@@ -43,22 +59,35 @@ def test_cross_process_aggregate_exchange():
     env.pop("JAX_PLATFORMS", None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(i), str(N_PROC), str(port)],
+        [sys.executable, worker, str(i), str(N_PROC), str(port), mode],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env) for i in range(N_PROC)]
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail("multihost worker timed out")
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        if p.returncode != 0:
+            for marker in _ENV_SKIP_MARKERS:
+                if marker in out:
+                    pytest.skip(
+                        f"2-process jax.distributed bring-up "
+                        f"unavailable here: {marker}")
+            assert p.returncode == 0, \
+                f"worker {i} failed:\n{out[-2000:]}"
         assert f"p{i}: OK" in out, out[-2000:]
+    return procs, outs
+
+
+def test_cross_process_aggregate_exchange():
+    procs, outs = _run_workers("agg")
 
     # merge per-group rows from both processes; every group must appear
     # on exactly ONE shard (the exchange moved all its partials there)
@@ -89,6 +118,27 @@ def test_cross_process_aggregate_exchange():
         np.testing.assert_allclose(m, sel.min(), rtol=1e-12)
 
 
+def test_cross_process_tpch_fleet(tmp_path):
+    """Full-engine multi-controller run (ISSUE 18 tentpole): each
+    process builds a real TpuSession that joins the fleet through the
+    spark.rapids.tpu.fleet.* confs (session-driven jax.distributed
+    bring-up + HostMembership heartbeats on a shared registry dir) and
+    runs TPC-H q6 + q3 distributed over the global 8-device mesh.
+    Each worker oracle-checks against pandas in-process; the parent
+    additionally pins that both controllers answered IDENTICALLY (the
+    SPMD contract a divergent host_put/to_host would break)."""
+    procs, outs = _run_workers(
+        "tpch", extra_env={"SR_TPU_FLEET_DIR": str(tmp_path)})
+    results = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results.append(json.loads(line[len("RESULT "):]))
+    assert len(results) == N_PROC
+    # bit-identical across controllers: same q3 top-10, same q6 revenue
+    assert results[0] == results[1], results
+
+
 def test_missing_peer_detected_within_timeout():
     """Failure detection at the coordination layer (the §5 elasticity
     story's first line of defense): a controller whose peer never
@@ -103,7 +153,7 @@ def test_missing_peer_detected_within_timeout():
         "jax.config.update('jax_platforms', 'cpu')\n"
         "jax.distributed.initialize("
         f"'localhost:{port}', num_processes=2, process_id=0, "
-        "initialization_timeout=15)\n"
+        "initialization_timeout=6)\n"
     )
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)

@@ -1,0 +1,420 @@
+"""Multi-host fleet robustness (single-process, tier-1): logical-host
+fleets make the whole failure story testable without jax.distributed —
+``fleet.logicalHosts`` partitions an 8-device CPU mesh into 2 "hosts"
+with a real HostMembership registry, so heartbeat loss, the shrink
+recovery rung, fleet-scoped cache fencing and the lock hygiene
+underneath all run under the normal suite.  The genuinely
+multi-process bring-up lives in test_multihost.py; this file pins the
+semantics those processes rely on:
+
+- a silent host is declared lost exactly once and raises the retryable
+  HostLossFault on the query path (host_sync's membership check);
+- the recovery ladder's shrink rung rebuilds the mesh over survivors
+  and re-drives to the oracle answer, while co-hosted clean queries
+  record ZERO attributed recovery events;
+- fleet-scoped cache entries cross a real process boundary (subprocess
+  re-run answers from the parent's published result) and a stale
+  fence token can never publish;
+- InterProcessLock reaps crashed holders immediately (the kill-9'd
+  ObservationStore merger regression).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import HostMembership
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.faults import HostLossFault
+from spark_rapids_tpu.serving.fleetcache import FleetStore
+from spark_rapids_tpu.utils.locking import InterProcessLock
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture
+def fleet_session(tmp_path):
+    """Factory for logical-host fleet sessions; stops every session it
+    made so logical-host simulation state never leaks across tests."""
+    made = []
+
+    def make(**extra):
+        conf = {
+            "spark.rapids.sql.distributed.numShards": "8",
+            "spark.rapids.tpu.fleet.logicalHosts": "2",
+            "spark.rapids.tpu.fleet.membershipDir":
+                str(tmp_path / "members"),
+            "spark.rapids.sql.recovery.backoffMs": 1,
+        }
+        conf.update(extra)
+        s = TpuSession(conf)
+        made.append(s)
+        return s
+
+    yield make
+    for s in made:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _groupby_query(session, pdf):
+    return (session.create_dataframe(pdf)
+            .group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv"),
+                 F.count(F.col("v")).alias("n")))
+
+
+def _pdf(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"k": rng.integers(0, 13, n),
+                         "v": rng.normal(10.0, 3.0, n)})
+
+
+def _norm(df):
+    return df.sort_values("k", ignore_index=True)
+
+
+# ----------------------------------------------------------- membership --
+def test_heartbeat_loss_detected_once(tmp_path):
+    d = str(tmp_path / "members")
+    m0 = HostMembership(d, host_id=0, n_hosts=2, heartbeat_ms=50,
+                        missed_fatal=2)
+    m1 = HostMembership(d, host_id=1, n_hosts=2, heartbeat_ms=50,
+                        missed_fatal=2)
+    m1.beat(force=True)
+    assert m0.check() == set()  # fresh beat: alive
+    m0.simulate_loss(1)
+    with pytest.raises(HostLossFault) as ei:
+        m0.check()
+    assert ei.value.host == 1
+    from spark_rapids_tpu.robustness import faults as FT
+    f = FT.classify(ei.value)
+    assert f.kind == "host_loss" and f.retryable  # enters the ladder
+    # declared lost exactly once: later checks skip it, never re-raise
+    assert m0.check() == {1}
+    assert m0.alive_hosts() == [0]
+
+
+def test_never_beat_peer_is_not_lost(tmp_path):
+    """Bring-up must not read as death: a peer that never wrote a beat
+    record is not-yet-joined, even long past the fatal window."""
+    m0 = HostMembership(str(tmp_path / "m"), host_id=0, n_hosts=2,
+                        heartbeat_ms=1, missed_fatal=1)
+    time.sleep(0.05)  # well past the 1ms x 1 window
+    assert m0.check() == set()
+
+
+def test_vanished_after_join_is_lost(tmp_path):
+    """The inverse: a peer that joined and then had its record removed
+    (host rebooted, registry wiped) IS a loss."""
+    d = str(tmp_path / "m")
+    m0 = HostMembership(d, host_id=0, n_hosts=2, heartbeat_ms=50,
+                        missed_fatal=2)
+    m1 = HostMembership(d, host_id=1, n_hosts=2, heartbeat_ms=50,
+                        missed_fatal=2)
+    m1.beat(force=True)
+    assert m0.check() == set()  # records peer 1 as seen
+    m1.leave()
+    with pytest.raises(HostLossFault):
+        m0.check()
+
+
+# ----------------------------------------------------------- shrink rung --
+def test_shrink_rung_recovers_oracle_matched(fleet_session):
+    """A host judged lost mid-query enters the ladder at the shrink
+    rung: the mesh is rebuilt over the survivors and the re-driven
+    attempt lands the clean answer (ISSUE 18 acceptance)."""
+    s = fleet_session()
+    assert s.fleet_membership is not None
+    assert s.mesh.devices.size == 8
+    pdf = _pdf()
+    q = lambda: _groupby_query(s, pdf).to_pandas()
+    want = q()  # clean oracle on the full fleet
+    s.recovery_log.clear()
+
+    s.fleet_membership.simulate_loss(1)
+    got = q()
+
+    actions = [r["action"] for r in s.recovery_log]
+    assert "shrink" in actions, actions
+    assert {r["fault"] for r in s.recovery_log} == {"host_loss"}
+    assert s.mesh.devices.size == 4  # survivors only
+    pd.testing.assert_frame_equal(_norm(got), _norm(want), rtol=1e-9)
+
+    # co-hosted clean queries: counter-pinned at ZERO attributed
+    # recovery events after the shrink settled
+    n_before = len(s.recovery_log)
+    again = q()
+    assert len(s.recovery_log) == n_before
+    pd.testing.assert_frame_equal(_norm(again), _norm(want), rtol=1e-9)
+
+
+def test_injected_heartbeat_loss_recovers(fleet_session):
+    """Chaos-point variant: an injected HostLossFault on the
+    ``fleet.heartbeat`` point (no named casualty) still recovers
+    through the shrink rung — the mesh drops the highest remote host
+    and the answer matches the clean run."""
+    s = fleet_session(**{"spark.rapids.tpu.fleet.heartbeatMs": 1})
+    pdf = _pdf(seed=3)
+    q = lambda: _groupby_query(s, pdf).to_pandas()
+    want = q()
+    s.recovery_log.clear()
+    with I.injected("fleet.heartbeat", count=1):
+        got = q()
+    assert "shrink" in [r["action"] for r in s.recovery_log]
+    assert s.mesh.devices.size == 4
+    pd.testing.assert_frame_equal(_norm(got), _norm(want), rtol=1e-9)
+
+
+# -------------------------------------------------------------- fencing --
+def test_fence_rejects_stale_writer(tmp_path):
+    fs = FleetStore(str(tmp_path / "fc"))
+    tok = fs.fence_epoch()
+    assert tok == 0
+    assert fs.publish("k1", {"a": 1}, tok)
+    payload, owner = fs.lookup("k1")
+    assert payload == {"a": 1} and owner == os.getpid()
+
+    new = fs.bump_fence(reason="shrink")
+    assert new == tok + 1
+    # the zombie's publish: old token, REJECTED and never written
+    assert not fs.publish("k2", {"zombie": True}, tok)
+    assert fs.counters["fenced"] == 1
+    assert fs.lookup("k2") is None
+    # a current writer is unaffected
+    assert fs.publish("k2", {"fresh": True}, new)
+    assert fs.lookup("k2")[0] == {"fresh": True}
+
+
+def test_torn_blob_is_a_miss_and_reaped(tmp_path):
+    from spark_rapids_tpu.serving.fleetcache import _entry_path
+    fs = FleetStore(str(tmp_path / "fc"))
+    assert fs.publish("k", [1, 2, 3], 0)
+    path = _entry_path(fs.dir, "k")
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-3])  # torn write
+    assert fs.lookup("k") is None  # CRC gate: miss, never wrong bytes
+    assert not os.path.exists(path)  # dropped so it cannot keep missing
+
+
+def test_shrink_bumps_fence_epoch(fleet_session, tmp_path):
+    """Session-level fencing: the shrink rung bumps the fence epoch
+    atomically with the mesh swap, so a publish still carrying the
+    pre-shrink token is rejected."""
+    s = fleet_session(**{"spark.rapids.tpu.fleet.cache.dir":
+                         str(tmp_path / "fcache")})
+    stale_tok = s.fleet_epoch
+    assert s.shrink_fleet_mesh(lost_host=1)
+    assert s.fleet_epoch == stale_tok + 1
+    assert not s.fleet_cache.publish("z", {"stale": True}, stale_tok)
+    assert s.fleet_cache.lookup("z") is None
+    assert s.fleet_cache.counters["fenced"] == 1
+
+
+# ------------------------------------------------- fleet cache, 2 procs --
+_CHILD_SRC = """
+import json, sys
+import numpy as np
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
+
+path, cache_dir = sys.argv[1], sys.argv[2]
+s = TpuSession(conf={
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.fleet.cache.dir": cache_dir,
+})
+df = (s.read.parquet(path).filter(F.col("v") > -1.0)
+      .group_by("k").agg(F.sum(F.col("v")).alias("sv")))
+out = df.to_pandas().sort_values("k", ignore_index=True)
+print("CHILD " + json.dumps({
+    "fleet_hits": s.result_cache.fleet_hits,
+    "cross_hits": s.fleet_cache.stats()["cross_hits"],
+    "rows": [[int(k), float(v)] for k, v in zip(out["k"], out["sv"])],
+}), flush=True)
+s.stop()
+"""
+
+
+def test_fleet_cache_cross_process_hit(tmp_path):
+    """The fleet payoff: a repeated plan in a DIFFERENT process answers
+    from this process's published result — cross-process hit counters
+    pinned > 0 and the answer byte-identical (ISSUE 18 acceptance)."""
+    rng = np.random.default_rng(11)
+    path = str(tmp_path / "fact.parquet")
+    pd.DataFrame({"k": rng.integers(0, 25, 3000),
+                  "v": rng.normal(0, 1.0, 3000)}).to_parquet(
+                      path, index=False)
+    cache_dir = str(tmp_path / "fcache")
+    s = TpuSession(conf={
+        "spark.rapids.tpu.serving.resultCache.enabled": True,
+        "spark.rapids.tpu.fleet.cache.dir": cache_dir,
+    })
+    try:
+        df = (s.read.parquet(path).filter(F.col("v") > -1.0)
+              .group_by("k").agg(F.sum(F.col("v")).alias("sv")))
+        want = df.to_pandas().sort_values("k", ignore_index=True)
+        assert s.result_cache.fleet_stores >= 1
+        assert s.fleet_cache.stats()["stores"] >= 1
+    finally:
+        s.stop()
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, "-c", _CHILD_SRC, path, cache_dir],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rec = next(json.loads(l[len("CHILD "):])
+               for l in p.stdout.splitlines() if l.startswith("CHILD "))
+    assert rec["fleet_hits"] > 0, p.stdout  # answered from our publish
+    assert rec["cross_hits"] > 0  # ...and attributed cross-process
+    got = np.array([r[1] for r in rec["rows"]])
+    assert [r[0] for r in rec["rows"]] == want["k"].tolist()
+    np.testing.assert_allclose(got, want["sv"].to_numpy(), rtol=1e-12)
+
+
+def test_fleet_tier_skipped_for_pinned_plans(tmp_path):
+    """In-memory relations pin process-local objects — their results
+    must never publish to the fleet (an id()-keyed pin is meaningless
+    in another process)."""
+    s = TpuSession(conf={
+        "spark.rapids.tpu.serving.resultCache.enabled": True,
+        "spark.rapids.tpu.fleet.cache.dir": str(tmp_path / "fc"),
+    })
+    try:
+        pdf = _pdf(n=500, seed=5)
+        _groupby_query(s, pdf).to_pandas()
+        assert s.result_cache.fleet_stores == 0
+        assert s.fleet_cache.stats()["stores"] == 0
+    finally:
+        s.stop()
+
+
+# --------------------------------------------------------- observability --
+def test_fleet_events_profile_and_health(fleet_session, tmp_path):
+    """The whole trail lands in the event log: HostJoin at bring-up,
+    HostLoss on detection, MeshShrink from the rung, FleetCacheFence
+    bump+reject — parsed into AppInfo.fleet, rolled up by
+    profiling.fleet_stats, and the fenced publish is health-checked."""
+    from spark_rapids_tpu.tools import profiling
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    evd = str(tmp_path / "events")
+    s = fleet_session(**{
+        "spark.rapids.tpu.eventLog.dir": evd,
+        "spark.rapids.tpu.fleet.cache.dir": str(tmp_path / "fcache"),
+    })
+    pdf = _pdf(seed=7)
+    q = lambda: _groupby_query(s, pdf).to_pandas()
+    q()
+    stale_tok = s.fleet_epoch
+    s.fleet_membership.simulate_loss(1)
+    q()  # loss -> shrink (bumps fence) -> recovered
+    s.fleet_cache.publish("zombie-key", {"x": 1}, stale_tok)  # rejected
+    s.stop()
+
+    apps = load_logs(evd)
+    assert apps
+    kinds = [e["kind"] for a in apps for e in a.fleet]
+    for k in ("join", "loss", "shrink", "fence"):
+        assert k in kinds, kinds
+
+    stats = profiling.fleet_stats(apps)
+    assert stats["losses"] == 1
+    assert stats["mesh_shrinks"] == 1
+    assert stats["fenced_publishes"] == 1
+    assert stats["fence_bumps"] >= 1
+
+    report = profiling.format_report(apps, top=5)
+    assert "Fleet membership" in report
+    problems = profiling.health_check(apps)
+    assert any("fenced" in p.lower() or "fence" in p.lower()
+               for p in problems), problems
+
+
+# ------------------------------------------------------- lock hygiene --
+def _dead_pid():
+    p = subprocess.run([sys.executable, "-c",
+                        "import os; print(os.getpid())"],
+                       capture_output=True, text=True)
+    return int(p.stdout)
+
+
+def test_lock_reaps_crashed_same_host_holder(tmp_path):
+    lock_path = str(tmp_path / "x.lock")
+    with open(lock_path, "w", encoding="utf-8") as f:
+        json.dump({"pid": _dead_pid(),
+                   "host": socket.gethostname()}, f)
+    lk = InterProcessLock(lock_path)  # default stale window: 30s
+    t0 = time.monotonic()
+    assert lk.acquire(timeout_s=5.0)
+    # reaped via the dead-pid stamp, NOT by waiting out the 30s
+    # mtime-staleness window
+    assert time.monotonic() - t0 < 5.0
+    lk.release()
+    assert not os.path.exists(lock_path)
+
+
+def test_lock_does_not_reap_foreign_host_stamp(tmp_path):
+    """A shared-filesystem fleet cannot probe a remote pid: a fresh
+    lock stamped by ANOTHER host must be respected (only the mtime
+    window may break it)."""
+    lock_path = str(tmp_path / "x.lock")
+    with open(lock_path, "w", encoding="utf-8") as f:
+        json.dump({"pid": _dead_pid(), "host": "some-other-host"}, f)
+    lk = InterProcessLock(lock_path)
+    assert not lk.acquire(timeout_s=0.3)
+
+
+def test_observation_store_survives_killed_merger(tmp_path):
+    """Regression (ISSUE 18 satellite): a merger kill-9'd while holding
+    the ObservationStore's flush lock used to wedge every later writer
+    for the full 30s staleness window.  The pid-stamped lock is reaped
+    immediately and the next flush merges and persists."""
+    from spark_rapids_tpu.utils.tracing import ObservationStore
+    d = str(tmp_path / "obs")
+    os.makedirs(d, exist_ok=True)
+    store = ObservationStore(d)
+    lock_path = store.path + ".lock"
+    code = (
+        "import os, signal, sys\n"
+        "from spark_rapids_tpu.utils.locking import InterProcessLock\n"
+        f"l = InterProcessLock({lock_path!r})\n"
+        "assert l.acquire(timeout_s=5.0)\n"
+        "print('HELD', flush=True)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert "HELD" in p.stdout
+    assert p.returncode == -signal.SIGKILL
+    assert os.path.exists(lock_path)  # the wedge the reaper must clear
+
+    store.observe("fleet.test.site", wall_ms=4.2)
+    t0 = time.monotonic()
+    store.flush()
+    assert time.monotonic() - t0 < 10.0  # no 30s stale-window sit-out
+    assert not store._dirty  # flush SUCCEEDED (a failed lock re-dirties)
+    assert "fleet.test.site" in ObservationStore.read(d)
+    assert not os.path.exists(lock_path)
