@@ -234,6 +234,21 @@ def span_frac_fields(session) -> dict:
     }
 
 
+def fused_wire_fields(session=None) -> dict:
+    """Wire-fusion launch accounting (parallel/shuffle.py, ISSUE 19)
+    for a bench emission: warm distributed stages that shipped the
+    packed wire payload out of ONE program vs stages that still ran
+    the two-dispatch sequence.  Structural zeros on single-device runs
+    and with `spark.rapids.tpu.fusion.wire.enabled` off — same
+    convention as shuffle_bytes_moved."""
+    from spark_rapids_tpu.parallel.shuffle import metrics_for_session
+    w = metrics_for_session(session).snapshot()
+    return {
+        "fused_wire_dispatches": w.get("fusedWireDispatches", 0),
+        "unfused_wire_dispatches": w.get("unfusedWireDispatches", 0),
+    }
+
+
 def gen_host(n: int, seed: int = 42):
     import numpy as np
     rng = np.random.default_rng(seed)
@@ -391,7 +406,10 @@ def child_main() -> None:
             "jit_cache_persistent_misses": 0,
             "jit_cache_persistent_stores": 0,
             # async exchange/compute overlap (parallel/exchange_async.py)
-            "exchange_overlap_ms": 0.0, "exchange_overlap_fraction": 0.0}
+            "exchange_overlap_ms": 0.0, "exchange_overlap_fraction": 0.0,
+            # wire-fused distributed stages (ISSUE 19): one program
+            # per shard emitting the packed wire payload
+            "fused_wire_dispatches": 0, "unfused_wire_dispatches": 0}
 
     def wire_fields(session):
         from spark_rapids_tpu.ops.jit_cache import persistent_info
@@ -402,6 +420,9 @@ def child_main() -> None:
             checkpoint_metrics
         w = metrics_for_session(session).snapshot()
         best["shuffle_bytes_moved"] = w["bytesMoved"]
+        best["fused_wire_dispatches"] = w.get("fusedWireDispatches", 0)
+        best["unfused_wire_dispatches"] = \
+            w.get("unfusedWireDispatches", 0)
         best["shuffle_padding_ratio"] = round(
             w["rowsMoved"] / max(w["rowsUseful"], 1), 3)
         c = checkpoint_metrics.snapshot()
@@ -794,6 +815,7 @@ def ingest_main(n_ticks: int) -> None:
                 m["watermarkEvictedBuckets"],
             "watermark_evicted_bytes": m["watermarkEvictedBytes"],
             **span_frac_fields(session),
+            **fused_wire_fields(session),
         }))
         sys.stdout.flush()
         session.stop()
@@ -921,6 +943,7 @@ def fleet_main(n_subs: int) -> None:
             "splices": splices,
             "distributed": mesh is not None,
             **span_frac_fields(session),
+            **fused_wire_fields(session),
         }))
         sys.stdout.flush()
         session.stop()
@@ -1155,6 +1178,7 @@ def repeat_main(n_repeats: int) -> None:
             "fused_operator_count":
                 fm1["fusedOperators"] - fm0["fusedOperators"],
             **span_frac_fields(session),
+            **fused_wire_fields(session),
         }))
         sys.stdout.flush()
         session.stop()
@@ -1219,6 +1243,7 @@ def concurrency_main(n_clients: int, seconds: float = 10.0) -> None:
         "admission_peak_concurrent": adm.get("peakConcurrent", 0),
         "admission_rejected": adm.get("totalRejected", 0),
         **span_frac_fields(session),
+        **fused_wire_fields(session),
     }))
     sys.stdout.flush()
 
@@ -1344,6 +1369,7 @@ def template_qps_main(target_qps: int, seconds: float = 4.0) -> None:
         "param_count": handles[0].param_count,
         "refusals": [r for r, _ in handles[0].refusals],
         **span_frac_fields(session),
+        **fused_wire_fields(session),
     }))
     sys.stdout.flush()
     session.stop()
@@ -1427,6 +1453,8 @@ def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
             return [lambda: q6(9000, 9500), lambda: q6(9500, 10000),
                     q3_agg, q3_top, lambda: q6(9000, 10000)]
 
+        wire_acc: dict = {}
+
         def run_phase(conf_extra):
             mesh = None
             if jax.device_count() >= 2:
@@ -1463,6 +1491,8 @@ def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
                 if session.shared_stages else {}
             il = session.interleaver.snapshot() \
                 if session.interleaver else {}
+            for k, v in fused_wire_fields(session).items():
+                wire_acc[k] = wire_acc.get(k, 0) + v
             session.stop()
             return sum(counts) / max(wall, 1e-9), rc, ss, il
 
@@ -1487,6 +1517,7 @@ def overlap_main(n_clients: int, seconds: float = 8.0) -> None:
             "stage_cache_writes": ss.get("writes", 0),
             "interleave_timeslices": il.get("totalSlices", 0),
             "interleave_wait_ms": il.get("totalWaitMs", 0.0),
+            **wire_acc,
             "distributed": bool(jax.device_count() >= 2),
         }))
         sys.stdout.flush()
@@ -1523,6 +1554,7 @@ def zero_conf_main() -> None:
     mesh = make_mesh(jax.device_count()) \
         if jax.device_count() >= 2 else None
     data = tpch.gen_tables(sf=sf)
+    wire_acc: dict = {}
 
     def run_phase(conf):
         session = TpuSession(trace_conf(conf), mesh=mesh)
@@ -1543,6 +1575,8 @@ def zero_conf_main() -> None:
                 decisions += len(p.get("decisions", []))
                 replans += p.get("replans", 0)
                 mispredicts += p.get("mispredicts", 0)
+        for k, v in fused_wire_fields(session).items():
+            wire_acc[k] = wire_acc.get(k, 0) + v
         session.stop()
         return walls, results, decisions, replans, mispredicts
 
@@ -1576,7 +1610,98 @@ def zero_conf_main() -> None:
         "planner_decisions": dec,
         "planner_replans": rep,
         "planner_mispredicts": mis,
+        **wire_acc,
         "distributed": mesh is not None,
+    }))
+    sys.stdout.flush()
+
+
+def hash_agg_main(cards) -> None:
+    """--hash-agg-cardinality N1,N2,...: hash-table group-by vs the
+    current dispatch per key cardinality (ISSUE 19 acceptance axis).
+
+    Keys are sampled SPARSELY from a 2^40 space so the coded
+    directory refuses every cardinality (keyspace over the 2^21 cap)
+    and the baseline is the sort/segment-sum kernel — exactly the
+    path the hash table is meant to beat.  Per cardinality the table
+    is sized to the next power of two >= 4*C (recorded in the
+    emission) so the sweep measures the hash kernel, not its
+    overflow fallback; the forced-overflow story lives in ci/chaos.sh.
+    Every cardinality asserts bit-identical answers before timing
+    counts.  Emits ONE JSON line with rows/s for both paths, the
+    speedup per cardinality, and the measured crossover (largest
+    swept cardinality where the hash path still wins; past it the
+    sort/segment-sum baseline is faster on this backend).  Env knobs:
+    ``BENCH_HASH_AGG_ROWS`` (default 262144), ``BENCH_HASH_AGG_REPS``
+    (default 3)."""
+    import numpy as np
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec.fusion import fusion_metrics
+
+    n_rows = int(os.environ.get("BENCH_HASH_AGG_ROWS", str(1 << 18)))
+    reps = int(os.environ.get("BENCH_HASH_AGG_REPS", "3"))
+    rng = np.random.default_rng(42)
+    rows = []
+    for c in cards:
+        uni = np.unique(rng.integers(0, 1 << 40, 4 * c,
+                                     dtype=np.int64))[:c]
+        keys = uni[rng.integers(0, len(uni), n_rows)]
+        # integer-valued floats: group sums are exact in float64, so
+        # bit-identity never hinges on accumulation order
+        vals = rng.integers(0, 1000, n_rows).astype(np.float64)
+        slots = 1 << max(6, int(np.ceil(np.log2(2 * len(uni)))))
+
+        def run(enabled):
+            s = TpuSession({
+                "spark.rapids.tpu.pallas.hash.enabled": enabled,
+                "spark.rapids.tpu.pallas.hash.tableSlots": str(slots),
+            })
+            try:
+                q = (s.create_dataframe({"k": keys, "v": vals})
+                     .groupBy("k")
+                     .agg(F.sum("v").alias("s"),
+                          F.count("v").alias("n")))
+                res = q.to_pandas()  # warm: compile + dispatch pick
+                fm0 = fusion_metrics.snapshot()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    q.to_pandas()
+                wall = time.perf_counter() - t0
+                fm1 = fusion_metrics.snapshot()
+            finally:
+                s.stop()
+            launches = fm1["hashKernelLaunches"] \
+                - fm0["hashKernelLaunches"]
+            res = res.sort_values("k").reset_index(drop=True)
+            return res, reps * n_rows / max(wall, 1e-9), launches
+
+        base_res, base_rps, base_hl = run("false")
+        hash_res, hash_rps, hash_hl = run("true")
+        assert base_hl == 0, ("hash launches with conf off", base_hl)
+        assert hash_hl >= reps, \
+            ("hash path never engaged", c, hash_hl)
+        assert base_res.equals(hash_res), \
+            ("hash vs baseline answers diverged", c)
+        rows.append({"cardinality": c, "table_slots": slots,
+                     "baseline_rows_per_sec": round(base_rps),
+                     "hash_rows_per_sec": round(hash_rps),
+                     "speedup": round(hash_rps / max(base_rps, 1e-9),
+                                      3)})
+        log(f"hash-agg: C={c} base={base_rps:,.0f} r/s "
+            f"hash={hash_rps:,.0f} r/s "
+            f"({rows[-1]['speedup']}x)")
+    wins = [r["cardinality"] for r in rows if r["speedup"] > 1.0]
+    print(json.dumps({
+        "metric": "hash_agg_rows_per_sec",
+        "value": max(r["hash_rows_per_sec"] for r in rows),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "reps": reps,
+        "sweep": rows,
+        "crossover_cardinality": max(wins) if wins else None,
+        "bit_identical": True,
     }))
     sys.stdout.flush()
 
@@ -1616,6 +1741,11 @@ if __name__ == "__main__":
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 1000
         template_qps_main(n, float(os.environ.get(
             "BENCH_TEMPLATE_SECONDS", "4")))
+    elif "--hash-agg-cardinality" in sys.argv:
+        idx = sys.argv.index("--hash-agg-cardinality")
+        spec = sys.argv[idx + 1] if len(sys.argv) > idx + 1 \
+            else "512,8192,65536"
+        hash_agg_main([int(x) for x in spec.split(",") if x])
     else:
         _install_safety_net()
         main()

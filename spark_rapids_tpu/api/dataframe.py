@@ -870,6 +870,8 @@ class DataFrame:
             # rung (batch_scale < 1) skips this branch: the distributed
             # plan has no batch knob, so re-offering it would re-run
             # the identical plan that just failed
+            from spark_rapids_tpu.exec.fusion import (fusion_metrics,
+                                                      hash_wire_delta)
             from spark_rapids_tpu.ops.jit_cache import persistent_info
             from spark_rapids_tpu.parallel.dist_planner import (
                 try_distributed)
@@ -882,6 +884,7 @@ class DataFrame:
             t0 = _time.perf_counter()
             wire = metrics_for_session(self.session)
             wire0 = wire.snapshot()
+            fm0 = fusion_metrics.snapshot()
             overlap = overlap_metrics_for_session(self.session)
             overlap0 = overlap.snapshot()
             pjit0 = persistent_info()
@@ -921,6 +924,7 @@ class DataFrame:
                                   or {})
                     fusion.update(_persistent_delta(pjit0,
                                                     persistent_info()))
+                    fusion.update(hash_wire_delta(fm0))
                     sh = self._sharing_info()
                     events.emit(
                         "QueryEnd", queryId=qid, status=status,
@@ -1052,11 +1056,12 @@ class DataFrame:
         from spark_rapids_tpu.utils import tracing
         events = getattr(self.session, "events", None)
         if events is None or not events.enabled:
-            from spark_rapids_tpu.exec.fusion import \
-                collect_runtime_savings
+            from spark_rapids_tpu.exec.fusion import (
+                collect_runtime_savings, fusion_metrics, hash_wire_delta)
             from spark_rapids_tpu.ops.jit_cache import persistent_info
             self.session._current_qid = None
             p0 = persistent_info()
+            fm0 = fusion_metrics.snapshot()
             t0 = _time.perf_counter()
             status = "success"
             try:
@@ -1072,6 +1077,7 @@ class DataFrame:
                 fusion = dict(getattr(ov, "last_fusion", None) or {})
                 fusion.update(collect_runtime_savings(exec_plan))
                 fusion.update(_persistent_delta(p0, persistent_info()))
+                fusion.update(hash_wire_delta(fm0))
                 self.session.last_fusion_stats = fusion
                 # span drain runs with or without an event log: bench
                 # reads session.last_span_stats, and trace files must
@@ -1098,10 +1104,12 @@ class DataFrame:
         # thread-local view: concurrent queries on other threads must not
         # contaminate this query's attribution
         retry0 = retry_metrics.snapshot_local()
+        from spark_rapids_tpu.exec.fusion import fusion_metrics
         from spark_rapids_tpu.ops.jit_cache import (cache_info,
                                                     persistent_info)
         jit0 = cache_info()
         pjit0 = persistent_info()
+        fm0 = fusion_metrics.snapshot()
         t0 = _time.perf_counter()
         status = "success"
         try:
@@ -1125,12 +1133,13 @@ class DataFrame:
             # per-query whole-stage fusion attribution: planned chains
             # from the planner, runtime dispatch savings from the
             # executed tree, persistent-tier deltas from the jit cache
-            from spark_rapids_tpu.exec.fusion import \
-                collect_runtime_savings
+            from spark_rapids_tpu.exec.fusion import (
+                collect_runtime_savings, hash_wire_delta)
             ov = overrides or self.session.overrides
             fusion = dict(getattr(ov, "last_fusion", None) or {})
             fusion.update(collect_runtime_savings(exec_plan))
             fusion.update(_persistent_delta(pjit0, persistent_info()))
+            fusion.update(hash_wire_delta(fm0))
             self.session.last_fusion_stats = fusion
             wall_ms = (_time.perf_counter() - t0) * 1e3
             spans = tracing.finish_query(self.session, qid, wall_ms,

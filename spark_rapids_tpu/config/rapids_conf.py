@@ -743,6 +743,46 @@ FUSION_ENABLED = conf(
     "one-dispatch-per-operator execution (the A/B baseline; results are "
     "bit-identical either way).", _to_bool)
 
+PALLAS_HASH_ENABLED = conf(
+    "spark.rapids.tpu.pallas.hash.enabled", False,
+    "Hash-table group-by and join phase-A (ops/pallas_kernels.py): a "
+    "single-pass open-addressing table over the 64-bit coded key "
+    "replaces the sort/segment-sum formulation where the dense coded "
+    "table cannot fit (high-cardinality keys) and on the single-key "
+    "inner/left/semi/anti join probe.  A pallas kernel owns the "
+    "VMEM-resident table on real TPUs; elsewhere a round-based XLA "
+    "formulation runs the same contract.  Probe-chain overflow raises a "
+    "flag and the launch DISCARDS the hash output and re-runs the "
+    "current sort path (rows are never dropped), recorded in the "
+    "fusion-metrics breadcrumb family.  False (default) is a full A/B: "
+    "results are bit-identical either way.", _to_bool)
+
+PALLAS_HASH_TABLE_SLOTS = conf(
+    "spark.rapids.tpu.pallas.hash.tableSlots", 1 << 16,
+    "Slot count of the hash group-by table (power of two).  Bounds "
+    "distinct groups per launch — more groups than slots (or a probe "
+    "chain past the 256-step bound) overflows to the sort path.  Also "
+    "the VMEM bound: the table is 3 i32 lanes, 12 bytes/slot, so 2^20 "
+    "slots (~12 MB) is the practical ceiling on-chip.", _to_int,
+    lambda v: None if v >= 64 and (v & (v - 1)) == 0
+    else "must be a power of two >= 64")
+
+FUSION_WIRE_ENABLED = conf(
+    "spark.rapids.tpu.fusion.wire.enabled", False,
+    "Fuse the wire across the exchange boundary (parallel/"
+    "distributed.py): a warm distributed aggregate launches ONE program "
+    "per shard that runs scan-mask -> filter -> partial-agg -> lane "
+    "packing/validity bit-packing -> all_to_all -> merge/finalize, "
+    "instead of the separate local-partials and exchange+merge "
+    "dispatches.  Applies only on the speculative (warm-slot) path; "
+    "stats-planned, ragged, staged, and keyless launches keep the "
+    "two-dispatch shape and record a fused-wire fallback breadcrumb.  "
+    "Slot overflow inside a fused launch degrades to the current "
+    "two-phase path exactly like speculative overflow does today.  "
+    "stage_ids are unchanged fused or not (checkpoint/resume splice "
+    "unaffected).  False (default) is a full A/B: results are "
+    "bit-identical either way.", _to_bool)
+
 FUSION_MAX_OPS = conf(
     "spark.rapids.tpu.fusion.maxChainOps", 16,
     "Ceiling on the operators one fused stage may collapse. Bounds the "

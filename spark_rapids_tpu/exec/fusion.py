@@ -48,7 +48,17 @@ class FusionMetrics:
     surfaced by bench.py alongside the jit-cache counters."""
 
     FIELDS = ("fusedStages", "fusedOperators", "fusibleChains",
-              "fallbacks")
+              "fallbacks",
+              # Pallas hash-kernel dispatch breadcrumbs: launches that
+              # went through the hash table, and launches that came back
+              # with the overflow flag set and re-ran the sort kernel
+              # (rows are never dropped — the fallback is the exact path).
+              "hashKernelLaunches", "hashOverflowFallbacks",
+              # Wire-fused distributed stages: stages that emitted the
+              # packed wire payload inside the compute program, and warm
+              # stages that COULD have fused but ran the two-dispatch
+              # path (the "fusible chain ran unfused" health-check family).
+              "fusedWireStages", "wireUnfusedLaunches")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -69,6 +79,22 @@ class FusionMetrics:
 
 
 fusion_metrics = FusionMetrics()
+
+# Hash-kernel / wire-fusion counters folded into each QueryEnd fusion
+# dict as per-query deltas of the process-wide counters above.  Only
+# non-zero deltas are merged: a query with no hash-kernel or wire-fusion
+# activity emits a fusion dict bit-identical to HEAD's.
+QUERY_DELTA_FIELDS = ("hashKernelLaunches", "hashOverflowFallbacks",
+                      "fusedWireStages", "wireUnfusedLaunches")
+
+
+def hash_wire_delta(before: Dict[str, int]) -> Dict[str, int]:
+    """Non-zero per-query deltas of the hash/wire fusion counters since
+    ``before`` (a ``fusion_metrics.snapshot()`` taken at query start)."""
+    now = fusion_metrics.snapshot()
+    return {k: now.get(k, 0) - before.get(k, 0)
+            for k in QUERY_DELTA_FIELDS
+            if now.get(k, 0) - before.get(k, 0)}
 
 
 def compose_chain(exprs: Optional[List[Expression]],

@@ -149,11 +149,17 @@ class DistributedAggregate:
         from spark_rapids_tpu.ops.jit_cache import cached_jit
         from spark_rapids_tpu.parallel.shuffle import (
             packed_enabled, ragged_enabled, topology_strategy,
-            wire_encoding_enabled)
+            wire_encoding_enabled, wire_fusion_enabled)
         self._cached_jit = cached_jit
         # resolved at construction and baked into the jit signature: a
         # packed.enabled flip must retrace, never hit a stale cache
         self.packed = packed_enabled()
+        # wire-fused stages (fusion.wire.enabled): warm speculative
+        # launches run partial agg + lane packing + exchange + final
+        # merge as ONE program per shard.  NOT part of self._sig —
+        # stage ids / report sites stay byte-identical fused or not;
+        # the fused program's own jit key carries the component.
+        self.wire_fused = wire_fusion_enabled()
         # topology-aware collective selection (parallel/mesh.py): ICI
         # axes keep the padded all_to_all, DCN-spanning axes lower the
         # exchange to gather-then-redistribute
@@ -299,6 +305,24 @@ class DistributedAggregate:
         return self._merge_finalize(recv[:nkeys], recv[nkeys:],
                                     recv_n, overflow)
 
+    def _step_fused(self, slot, wenc, lut, flat_cols, nrows_arr):
+        """The wire-fused stage: scan-mask/filter, partial aggregate,
+        lane packing + counts, the all_to_all and the final merge as
+        ONE program per shard — the packed wire payload is built by
+        shuffle.pack_for_wire inside the exchange's send side with no
+        dispatch boundary anywhere in the chain.  Math is the exact
+        composition of ``_step_local`` and ``_step_final`` (minus the
+        histogram the warm path never reads), so outputs are
+        bit-identical to the two-dispatch sequence."""
+        keys, buf_inputs, _, nrows, capacity = self._local_partials(
+            flat_cols, nrows_arr)
+        pkeys, pbufs, n_groups = agg.groupby_aggregate(
+            keys, buf_inputs, nrows, capacity)
+        outs = list(pkeys) + list(pbufs)
+        partial_flat = tuple((o.values, o.validity) for o in outs)
+        return self._step_final(slot, None, wenc, lut, partial_flat,
+                                jnp.reshape(n_groups, (1,)))
+
     def _step_final_local(self, partial_flat, n_rows_arr):
         """Final merge over ALREADY co-located partials (the host-RAM
         staging path repartitioned them off-device): no exchange, one
@@ -383,6 +407,13 @@ class DistributedAggregate:
                 in_specs=(P(), P(self.axis), P(self.axis)),
                 out_specs=P(self.axis), check_vma=False))
 
+    def _fused_jitted(self, slot: int, wenc=()):
+        return self._cached_jit(
+            self._sig + ("wire_fused", slot, wenc), lambda: _shard_map(
+                partial(self._step_fused, slot, wenc), mesh=self.mesh,
+                in_specs=(P(), P(self.axis), P(self.axis)),
+                out_specs=P(self.axis), check_vma=False))
+
     def _final_local_jitted(self):
         return self._cached_jit(
             self._sig + ("final_local",), lambda: _shard_map(
@@ -430,9 +461,10 @@ class DistributedAggregate:
         if not self.group_exprs:
             self.last_stats = {"keyless": True}
             return self._jitted_keyless(flat_cols, nrows_per_shard)
-        partial_flat, n_groups, hist = self._jitted_local(
-            flat_cols, nrows_per_shard)
-        capacity = int(partial_flat[0][0].shape[0]) // self.nshards
+        # partial capacity equals input capacity (groupby preserves it),
+        # so the warm-path decision needs no dispatch: a wire-fused
+        # launch replaces local+final with ONE program per shard
+        capacity = int(flat_cols[0][0].shape[0]) // self.nshards
         planner = planner_for_session()
         metrics = metrics_for_session()
         site = self._sig
@@ -480,8 +512,30 @@ class DistributedAggregate:
             # device collective — a warm site's cached slot proves the
             # estimate, so fall through to the stats path, which stages
             spec = None
-        if spec is not None and "lut" in spec and \
-                len(spec["lut"]) == self.buckets:
+        warm = spec is not None and "lut" in spec and \
+            len(spec["lut"]) == self.buckets
+        if warm and self.wire_fused:
+            resolve_wire()
+            outs = self._launch_fused(site, spec, flat_cols,
+                                      nrows_per_shard, capacity,
+                                      planner, metrics, window=window,
+                                      wenc=wenc)
+            if outs is not None:
+                self.last_stats["wire"] = metrics.snapshot()
+                return outs
+            # fused slot overflow: degrade to the current two-dispatch
+            # stats-sized path below (rows are never dropped)
+            warm = False
+        partial_flat, n_groups, hist = self._jitted_local(
+            flat_cols, nrows_per_shard)
+        metrics.record_fused_dispatch(False)
+        if self.wire_fused:
+            # conf ON but this launch ran unfused (cold site, staged,
+            # ragged-planned, or a fused overflow degrade): the
+            # "fusible chain ran unfused" health-check breadcrumb
+            from spark_rapids_tpu.exec.fusion import fusion_metrics
+            fusion_metrics.bump("wireUnfusedLaunches")
+        if warm:
             resolve_wire()
             outs = self._launch_speculative(site, spec, partial_flat,
                                             n_groups, capacity, planner,
@@ -598,6 +652,78 @@ class DistributedAggregate:
                            "packed": self.packed,
                            "wire": metrics.snapshot()}
         return raw[:-1]
+
+    def _launch_fused(self, site, spec, flat_cols, nrows_per_shard,
+                      capacity, planner, metrics, window=None, wenc=()):
+        """Warm-path launch with the wire payload emitted inside the
+        compute program: ONE dispatch per shard covers scan/filter,
+        partial aggregate, lane packing, the all_to_all and the final
+        merge.  Slot overflow returns None — the caller degrades to
+        the current two-dispatch stats-sized path (rows are never
+        dropped) after the same planner latch + recovery-trail entry
+        the unfused speculative launch records."""
+        import numpy as np
+        from spark_rapids_tpu.exec.fusion import fusion_metrics
+        from spark_rapids_tpu.parallel.exchange_async import (
+            overlap_metrics_for_session)
+        from spark_rapids_tpu.parallel.shuffle import (
+            launch_checkpoint, record_exchange_metrics)
+        slot, lut = spec["slot"], spec["lut"]
+        self.last_stats = {"slot": slot, "capacity": capacity,
+                           "speculative": True, "packed": self.packed,
+                           "wire_fused": True}
+        with launch_checkpoint():
+            raw = self._fused_jitted(slot, wenc=wenc)(
+                jnp.asarray(lut), flat_cols, nrows_per_shard)
+        outs, ovf = raw[:-1], raw[-1]
+        fusion_metrics.bump("fusedWireStages")
+        metrics.record_fused_dispatch(True)
+        record_exchange_metrics(
+            metrics, dtypes=self._wire_dtypes(),
+            slot=capacity if self.exchange_strategy == "gather"
+            else slot,
+            num_parts=self.nshards, nshards=self.nshards,
+            rows_useful=spec.get("rows", 0), packed=self.packed,
+            site=self._sig + ("final", wenc),
+            wire_encode_cols=len(wenc))
+        if window is not None:
+            overlap = overlap_metrics_for_session()
+
+            def verify():
+                if not bool(np.asarray(host_sync(ovf)).any()):
+                    return
+                planner.observe_overflow(site)
+                metrics.record_overflow()
+                overlap.record_deferred_overflow()
+                from spark_rapids_tpu.api.session import TpuSession
+                from spark_rapids_tpu.robustness.driver import (
+                    record_degradation)
+                from spark_rapids_tpu.robustness.faults import (
+                    AsyncExchangeOverflow)
+                err = AsyncExchangeOverflow("aggregate", slot, capacity)
+                record_degradation(TpuSession._active, err.kind,
+                                   "shuffle-slot-async-replan", str(err))
+                raise err
+
+            window.admit(site + ("final",),
+                         metrics.last_exchange_bytes, verify)
+            return outs
+        overlap_metrics_for_session().record_sync()
+        if not bool(np.asarray(host_sync(ovf)).any()):
+            return outs
+        # slot overflow inside the fused program: latch the site off
+        # speculation, record the handled fault, and let the caller
+        # re-run the unfused stats-sized sequence
+        planner.observe_overflow(site)
+        metrics.record_overflow()
+        from spark_rapids_tpu.api.session import TpuSession
+        from spark_rapids_tpu.robustness.driver import record_degradation
+        from spark_rapids_tpu.robustness.faults import ShuffleSlotOverflow
+        err = ShuffleSlotOverflow("aggregate", slot, capacity)
+        record_degradation(TpuSession._active, err.kind,
+                           "shuffle-slot-capacity-rerun", str(err))
+        self.last_stats["overflow"] = True
+        return None
 
     def _launch_speculative(self, site, spec, partial_flat, n_groups,
                             capacity, planner, metrics, window=None,
@@ -1349,6 +1475,17 @@ class DistributedHashJoin:
         # a bare all-gather with no "shuffle.exchange" checkpoint
         cp = launch_checkpoint() if strategy == "shuffle" \
             else contextlib.nullcontext()
+        if strategy == "shuffle":
+            # the join's stats pass is mandatory (skew detection), so
+            # its exchange always launches as the two-dispatch
+            # sequence; with fusion.wire.enabled on, the stage leaves
+            # the "fusible chain ran unfused" breadcrumb
+            metrics.record_fused_dispatch(False)
+            from spark_rapids_tpu.parallel.shuffle import (
+                wire_fusion_enabled)
+            if wire_fusion_enabled():
+                from spark_rapids_tpu.exec.fusion import fusion_metrics
+                fusion_metrics.bump("wireUnfusedLaunches")
         with cp:
             out = self._jitted(strategy, slots, skewed,
                                (wenc_p, wenc_b))(

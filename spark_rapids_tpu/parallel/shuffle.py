@@ -273,6 +273,22 @@ def wire_encoding_enabled(conf=None) -> bool:
     return conf.get(rc.ENCODING_WIRE_ENABLED)
 
 
+def wire_fusion_enabled(conf=None) -> bool:
+    """Resolve spark.rapids.tpu.fusion.wire.enabled: explicit conf >
+    active session > entry default.  Consumers resolve at construction;
+    the fused program's jit key carries its own component (never the
+    shared stage signature, so stage ids stay byte-identical fused or
+    not)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSession
+        s = TpuSession._active
+        if s is None:
+            return rc.FUSION_WIRE_ENABLED.default
+        conf = s.conf
+    return conf.get(rc.FUSION_WIRE_ENABLED)
+
+
 def packed_enabled(conf=None) -> bool:
     """Resolve spark.rapids.tpu.shuffle.packed.enabled: explicit conf >
     active session > entry default.  Exchange consumers resolve this at
@@ -513,6 +529,57 @@ def _unpack_payloads(cols: Sequence[ColVal], plan: _PackPlan,
 
 # ---------------------------------------------------------------- exchange --
 
+class WirePayload:
+    """The wire-ready send side of one exchange, produced by
+    :func:`pack_for_wire` inside the SAME traced program as the compute
+    that fed it: partition-sorted columns, narrowed code columns, the
+    per-destination counts, and (when the lane packer accepts the
+    columns) the (u32, u8) lane payloads in the padded-slot send
+    layout.  ``exchange`` composes this with the all_to_all and the
+    receive-side unpack; a fused distributed stage emits it without any
+    intermediate dispatch boundary."""
+
+    __slots__ = ("cols", "narrowed", "counts", "starts", "src",
+                 "plan", "p32", "p8")
+
+    def __init__(self, cols, narrowed, counts, starts, src, plan,
+                 p32, p8):
+        self.cols = cols
+        self.narrowed = narrowed
+        self.counts = counts
+        self.starts = starts
+        self.src = src
+        self.plan = plan
+        self.p32 = p32
+        self.p8 = p8
+
+
+def pack_for_wire(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
+                  num_parts: int, slot: int,
+                  packed: bool = True,
+                  wire_encode: Sequence[int] = ()) -> WirePayload:
+    """Composable traced lane packer: everything the send side of an
+    exchange does before the collective — layout_by_partition, wire
+    narrowing, padded-slot gather indices, bitcast lane payloads and
+    packed validity tails — as one traceable function.  Callers fuse
+    it into the producing program so the stage's compute and its
+    wire-ready payload come out of ONE dispatch per shard; ``plan`` is
+    None when the columns are unpackable (or ``packed`` is False) and
+    the caller ships per-column."""
+    capacity = pids.shape[0]
+    sorted_cols, counts, starts = layout_by_partition(
+        cols, pids, nrows, num_parts)
+    sorted_cols, narrowed = _narrow_wire_cols(sorted_cols, wire_encode)
+    j = jnp.arange(slot, dtype=jnp.int32)[None, :]
+    src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
+    plan = _plan_pack(sorted_cols) if packed else None
+    p32 = p8 = None
+    if plan is not None:
+        p32, p8 = _pack_payloads(sorted_cols, plan, sel=src)
+    return WirePayload(sorted_cols, narrowed, counts, starts, src,
+                       plan, p32, p8)
+
+
 def _compaction_indices(recv_counts, total, num_parts: int, slot: int):
     """Slice→dense map shared by every lane/column of one exchange:
     for each dense output position, the (source slice, offset) it reads
@@ -561,22 +628,22 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
     slot = slot or capacity
     if packed is None:
         packed = packed_enabled()
-    sorted_cols, counts, starts = layout_by_partition(
-        cols, pids, nrows, num_parts)
-    # compressed wire (encoding.wire.enabled): caller-marked dictionary
-    # code columns narrow to i32 lanes here — AFTER partitioning (pids
-    # were computed on the original values) and BEFORE lane packing, so
-    # every wire variant below (packed/ragged/per-column) ships the
-    # narrow form and the trace-time report meters post-encoding bytes
-    sorted_cols, narrowed = _narrow_wire_cols(sorted_cols, wire_encode)
+    # the send side — partition layout, wire narrowing (compressed
+    # wire narrows caller-marked code columns AFTER partitioning and
+    # BEFORE lane packing, so every wire variant ships the narrow form
+    # and the trace-time report meters post-encoding bytes), padded-
+    # slot gather and lane payloads — is the composable packer; fused
+    # stages emit it from the producing program directly
+    pay = pack_for_wire(cols, pids, nrows, num_parts, slot,
+                        packed=packed, wire_encode=wire_encode)
+    sorted_cols, narrowed = pay.cols, pay.narrowed
+    counts, starts, src, plan = pay.counts, pay.starts, pay.src, pay.plan
     saved_pr = 4 * len(narrowed)
 
     # counts for my slices on every peer: all_to_all of the counts vector
     recv_counts = jax.lax.all_to_all(
         counts.reshape(num_parts, 1), axis_name, split_axis=0,
         concat_axis=0).reshape(num_parts)
-
-    plan = _plan_pack(sorted_cols) if packed else None
     if ragged is not None and plan is not None:
         # skew-adaptive ragged wire (needs the lane-packed format; an
         # unpackable column set falls through to the uniform slot the
@@ -604,10 +671,6 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
                             sorted_cols, None, fallback=True,
                             saved_per_row=saved_pr)
 
-    # gather each destination's rows into its padded slot: send[d, j]
-    j = jnp.arange(slot, dtype=jnp.int32)[None, :]
-    src = jnp.clip(starts[:, None] + j, 0, capacity - 1)
-
     total = recv_counts.sum()
     # the slice→dense compaction map, computed ONCE and shared by every
     # lane (packed) or column (fallback)
@@ -626,7 +689,7 @@ def exchange(cols: Sequence[ColVal], pids: jnp.ndarray, nrows,
         # per launch; a nonzero count is the signal, not a launch tally.
         metrics_for_session().record_fallback()
     if plan is not None:
-        p32, p8 = _pack_payloads(sorted_cols, plan, sel=src)
+        p32, p8 = pay.p32, pay.p8
         flat32 = flat8 = None
         if p32 is not None:
             r32 = jax.lax.all_to_all(p32, axis_name, split_axis=0,
@@ -974,7 +1037,8 @@ class ShuffleWireMetrics:
     FIELDS = ("exchanges", "collectives", "rowsMoved", "rowsUseful",
               "bytesMoved", "slotOverflowRetries", "perColumnFallbacks",
               "raggedExchanges", "encodedBytesSaved", "wireDictBytes",
-              "encodableDecodedExchanges", "wireDictFallbacks")
+              "encodableDecodedExchanges", "wireDictFallbacks",
+              "fusedWireDispatches", "unfusedWireDispatches")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -1039,6 +1103,17 @@ class ShuffleWireMetrics:
             self.counters["wireDictBytes"] += int(delta_bytes)
             if not ok:
                 self.counters["wireDictFallbacks"] += 1
+
+    def record_fused_dispatch(self, fused: bool) -> None:
+        """One distributed-stage launch: ``fused`` means the stage's
+        compute and its wire-ready payload came out of ONE program per
+        shard (fusion.wire.enabled warm path); unfused launches ran the
+        two-dispatch local+exchange sequence.  Bench emits the pair as
+        ``fused_wire_dispatches`` per distributed emission."""
+        with self._lock:
+            key = "fusedWireDispatches" if fused \
+                else "unfusedWireDispatches"
+            self.counters[key] += 1
 
     def record_fallback(self) -> None:
         """An exchange that requested the packed wire but traced the

@@ -749,6 +749,24 @@ def health_check(apps: List[AppInfo]) -> List[str]:
                     "dispatch + device materialization per operator per "
                     "batch; check spark.rapids.tpu.fusion.enabled (or "
                     "an unfusible chain member forced the fallback)")
+            if fu and fu.get("wireUnfusedLaunches", 0):
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{fu['wireUnfusedLaunches']} warm distributed "
+                    "stage(s) ran the two-dispatch wire path (compute "
+                    "launch + separate pack launch per shard) — "
+                    "spark.rapids.tpu.fusion.wire.enabled would fold "
+                    "the wire packer into the compute program, one "
+                    "launch per shard")
+            if fu and fu.get("hashOverflowFallbacks", 0):
+                problems.append(
+                    f"{a.session_id} query {q.query_id}: "
+                    f"{fu['hashOverflowFallbacks']} hash-kernel "
+                    "launch(es) overflowed the slot table and re-ran "
+                    "the sort kernel — results stay exact, but the "
+                    "hash dispatch was wasted work; raise "
+                    "spark.rapids.tpu.pallas.hash.tableSlots above "
+                    "2x the live key cardinality")
             pl = q.planner
             if pl and pl.get("mispredicts", 0):
                 # the SAME factor finish_query counted with — a tuned

@@ -32,6 +32,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_pallas_gate():
+    """A test that flips SPARK_RAPIDS_TPU_DISABLE_PALLAS must not poison
+    later tests through the lru_cache'd use_pallas() decision."""
+    from spark_rapids_tpu.ops.pallas_kernels import reset_use_pallas
+    reset_use_pallas()
+    yield
+    reset_use_pallas()
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
