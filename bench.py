@@ -1102,6 +1102,133 @@ def fleet_hosts_main(n_hosts: int) -> None:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# --------------------------------------------------------------- fail-slow --
+def fail_slow_main() -> None:
+    """--fail-slow: gray-failure A/B (ISSUE 20) — one logical host
+    turns fail-slow (sub-deadline delay rules wedge its host-staging
+    shards; its gossiped walls stretch 10x) and the SAME workload runs
+    with ``fleet.grayFailure.enabled`` off then on.  Off, every wedge
+    rides the query wall; on, the SUSPECT host's shards hedge onto the
+    healthy path.  Emits ONE JSON line: slowed-vs-healthy wall ratios
+    for both arms, the hedge/duplicate counters, and the bit-identical
+    gate (both arms must answer exactly the healthy run's result)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.robustness import inject as I
+    from spark_rapids_tpu.tools.profiling import nearest_rank
+
+    import jax
+    ndev = jax.device_count()
+    reps = int(os.environ.get("BENCH_FAIL_SLOW_REPS", "5"))
+    delay_s = float(os.environ.get("BENCH_FAIL_SLOW_DELAY_S", "0.15"))
+    rows = 1 << 15
+    d = tempfile.mkdtemp(prefix="tpu-fail-slow-bench-")
+    rng = np.random.default_rng(31)
+    fact = pd.DataFrame({"k": rng.integers(0, 300, rows),
+                         "v": rng.normal(size=rows)})
+    dim = pd.DataFrame({"k": np.arange(300),
+                        "w": rng.normal(size=300)})
+
+    def session(gray: bool) -> TpuSession:
+        return TpuSession({
+            "spark.rapids.sql.distributed.numShards": str(ndev),
+            "spark.rapids.tpu.fleet.logicalHosts": "2",
+            "spark.rapids.tpu.fleet.membershipDir":
+                os.path.join(d, "members-on" if gray else "members-off"),
+            "spark.rapids.tpu.fleet.grayFailure.enabled": gray,
+            "spark.rapids.tpu.fleet.suspectWindow": 8,
+            "spark.rapids.tpu.fleet.hedgeFloorMs": 25,
+            "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+            "spark.rapids.sql.join.broadcastThresholdRows": 1,
+            # the logical-host sim auto-picks the DCN gather strategy,
+            # which never host-stages; pin the ICI collective so the
+            # staging tier (the hedgeable path) carries the exchange
+            "spark.rapids.tpu.shuffle.topology.strategy": "all_to_all",
+            "spark.rapids.sql.recovery.backoffMs": 1,
+        })
+
+    def query(s):
+        return (s.create_dataframe(fact)
+                .join(s.create_dataframe(dim), on="k")
+                .group_by("k")
+                .agg(F.sum(F.col("v")).alias("sv"),
+                     F.sum(F.col("w")).alias("sw")))
+
+    def drive(s, slow: bool):
+        """Warm once, then reps timed runs; ``slow`` arms ONE
+        sub-deadline staging wedge per rep (the sick host's shard)."""
+        q = query(s)
+        q.to_pandas()
+        walls = []
+        for _ in range(reps):
+            rule = I.inject("exchange.host_staging", kind="delay",
+                            delay_s=delay_s, count=1) if slow else None
+            t0 = time.perf_counter()
+            out = q.to_pandas().sort_values("k", ignore_index=True)
+            walls.append((time.perf_counter() - t0) * 1e3)
+            if rule is not None:
+                I.remove(rule)
+        walls.sort()
+        return round(nearest_rank(walls, 0.50), 3), out
+
+    try:
+        results = {}
+        frames = {}
+        for gray in (False, True):
+            s = session(gray)
+            t = s.gray_health
+            if t is not None:
+                # host 1's gossiped beat walls stretch 10x -> SUSPECT
+                for _ in range(8):
+                    t.observe_wall(0, "exchange.host_staging", 10.0)
+                    t.observe_peer_walls(
+                        1, {"exchange.host_staging": 100.0})
+                t.poll()
+            healthy_ms, frames["healthy"] = drive(s, slow=False)
+            slowed_ms, frames["gray_on" if gray else "gray_off"] = \
+                drive(s, slow=True)
+            arm = {
+                "healthy_wall_ms_p50": healthy_ms,
+                "slowed_wall_ms_p50": slowed_ms,
+                "slowdown": round(slowed_ms / max(healthy_ms, 1e-9), 3),
+            }
+            if t is not None:
+                arm["counters"] = {
+                    k: v for k, v in t.query_counters().items()
+                    if k in ("hedgesFired", "hedgesWon",
+                             "duplicatesSuppressed", "suspects")}
+            results["gray_on" if gray else "gray_off"] = arm
+            s.stop()
+        bit_identical = all(
+            frames[k].equals(frames["healthy"])
+            for k in ("gray_off", "gray_on"))
+        on, off = results["gray_on"], results["gray_off"]
+        print(json.dumps({
+            "metric": "fail_slow_hedge_wall_ratio",
+            # hedged slowed-wall over unhedged slowed-wall: < 1.0 means
+            # hedging bought the wedge back
+            "value": round(on["slowed_wall_ms_p50"]
+                           / max(off["slowed_wall_ms_p50"], 1e-9), 3),
+            "unit": "x",
+            "devices": ndev,
+            "rows": rows,
+            "reps": reps,
+            "injected_delay_ms": round(delay_s * 1e3, 1),
+            "bit_identical": bit_identical,
+            "gray_off": off,
+            "gray_on": on,
+        }))
+        sys.stdout.flush()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 # ------------------------------------------------------------------ repeat --
 def repeat_main(n_repeats: int) -> None:
     """Warm-start bench (whole-stage fusion + persistent jit cache):
@@ -1728,6 +1855,8 @@ if __name__ == "__main__":
         idx = sys.argv.index("--fleet-hosts")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 2
         fleet_hosts_main(n)
+    elif "--fail-slow" in sys.argv:
+        fail_slow_main()
     elif "--fleet" in sys.argv:
         idx = sys.argv.index("--fleet")
         n = int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1 else 8

@@ -1385,4 +1385,119 @@ print("multi-host fleet spray OK (shrink recovery exact, "
       f"fenced={s.fleet_cache.counters['fenced']})")
 PY
 
+echo "== fail-slow spray (gray failure: one slow host, sub-deadline delays -> hedge + quarantine/rejoin, bit-identical) =="
+# fail-SLOW, not fail-stop: host 1's staging/host_sync walls stretch via
+# sub-hard-deadline delay rules and gossiped slow walls — no heartbeat
+# loss ever trips.  Gates: every query bit-identical to the clean run,
+# the mitigation rungs actually fire (hedge AND quarantine->rejoin),
+# and co-hosted clean queries attribute ZERO recovery entries (a hedge
+# is not a fault; the ladder stays silent throughout).
+python - <<'PY'
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.robustness import grayfailure as gf
+from spark_rapids_tpu.robustness import inject as I
+
+s = TpuSession({
+    "spark.rapids.sql.distributed.numShards": "8",
+    "spark.rapids.tpu.fleet.logicalHosts": "2",
+    "spark.rapids.tpu.fleet.grayFailure.enabled": True,
+    "spark.rapids.tpu.fleet.suspectWindow": 8,
+    "spark.rapids.tpu.fleet.quarantineAfterMs": 30,
+    "spark.rapids.tpu.fleet.rejoinAfterMs": 30,
+    "spark.rapids.tpu.fleet.hedgeFloorMs": 25,
+    "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+    "spark.rapids.sql.join.broadcastThresholdRows": 1,
+    # logical hosts auto-pick the DCN gather strategy, which never
+    # host-stages; pin the ICI collective so the hedgeable tier runs
+    "spark.rapids.tpu.shuffle.topology.strategy": "all_to_all",
+    "spark.rapids.sql.recovery.backoffMs": 1,
+})
+rng = np.random.default_rng(23)
+fact = pd.DataFrame({"k": rng.integers(0, 300, 4000),
+                     "v": rng.normal(size=4000)})
+dim = pd.DataFrame({"k": np.arange(300), "w": rng.normal(size=300)})
+
+def q():
+    return (s.create_dataframe(fact)
+            .join(s.create_dataframe(dim), on="k")
+            .group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv"),
+                 F.sum(F.col("w")).alias("sw"))
+            .to_pandas().sort_values("k", ignore_index=True))
+
+want = q()  # clean oracle (already on the staging path)
+assert s.exchange_overlap_metrics.snapshot()["hostStagedExchanges"] >= 2
+
+t = s.gray_health
+# host 1 turns fail-slow: its gossiped beat walls stretch 10x on every
+# evidence point while host 0 stays at fleet speed — the exact payload
+# a degraded peer's beat records would carry
+rules = []
+try:
+    for _ in range(8):
+        t.observe_wall(0, "exchange.host_staging", 10.0)
+        t.observe_wall(0, "dist.host_sync", 5.0)
+        t.observe_peer_walls(1, {"exchange.host_staging": 100.0,
+                                 "dist.host_sync": 50.0})
+    t.observe_beat(1, 1000.0)
+    t.observe_beat(1, 1000.9)  # stretched beat interval, NOT silence
+    t.poll()
+    assert t.is_suspect(1), t.state
+    # sub-hard-deadline wedges on the sick host's staging/sync writes
+    # (far below any watchdog deadline: these are delays, not hangs)
+    rules.append(I.inject("exchange.host_staging", kind="delay",
+                          delay_s=0.4, count=1))
+    rules.append(I.inject("dist.host_sync", kind="delay",
+                          delay_s=0.05, count=2, probability=0.5,
+                          seed=3, all_threads=True))
+    got = q()  # hedged: healthy re-dispatch answers
+    pd.testing.assert_frame_equal(got, want)
+    c = t.query_counters()
+    assert c["hedgesFired"] >= 1 and c["hedgesWon"] >= 1, c
+    time.sleep(0.05)  # outlast quarantineAfterMs
+    got = q()  # boundary drains the sick host (soft shrink)
+    pd.testing.assert_frame_equal(got, want)
+    assert int(s.mesh.devices.size) == 4, s.mesh.devices.size
+    assert t.state[1] == gf.QUARANTINED
+    assert 1 not in s.fleet_membership.lost  # slow, never judged lost
+    # the host recovers: its gossiped walls come back to the fleet's
+    # OWN observed medians on every evidence point (one still-slow
+    # point would keep the score pinned) -> rejoin at the next boundary
+    for _ in range(8):
+        t.observe_peer_walls(1, t.local_walls())
+    t.poll()
+    time.sleep(0.05)
+    got = q()
+    pd.testing.assert_frame_equal(got, want)
+    assert int(s.mesh.devices.size) == 8, s.mesh.devices.size
+finally:
+    for r in rules:
+        I.remove(r)
+# co-hosted clean queries: ZERO attributed recovery entries — the
+# whole fail-slow story ran without ever engaging the fault ladder.
+# Both TPC-H shapes: the join+group-by (q3-like) and a
+# filter+aggregate (q6-like) on the restored full mesh.
+assert s.recovery_log == [], s.recovery_log
+again = q()
+pd.testing.assert_frame_equal(again, want)
+q6 = (s.create_dataframe(fact).filter(F.col("v") >= 0.0)
+      .group_by("k").agg(F.sum(F.col("v")).alias("rev"))
+      .to_pandas().sort_values("k", ignore_index=True))
+q6_want = (fact[fact["v"] >= 0.0].groupby("k", as_index=False)
+           .agg(rev=("v", "sum")).sort_values("k", ignore_index=True))
+pd.testing.assert_frame_equal(q6, q6_want, check_dtype=False)
+assert s.recovery_log == [], s.recovery_log
+cc = t.query_counters()
+print("fail-slow spray OK (hedges "
+      f"{cc['hedgesFired']}/{cc['hedgesWon']}, quarantines "
+      f"{cc['quarantines']}, rejoins {cc['rejoins']}, ladder silent)")
+s.stop()
+PY
+
 echo "CHAOS OK"

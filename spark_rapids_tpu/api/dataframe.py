@@ -888,6 +888,11 @@ class DataFrame:
             overlap = overlap_metrics_for_session(self.session)
             overlap0 = overlap.snapshot()
             pjit0 = persistent_info()
+            # gray-failure counter snapshot: QueryEnd pins THIS query's
+            # hedge/quarantine deltas (None tracker = knob off, and the
+            # event field is absent — bit-identical A/B)
+            gray = getattr(self.session, "gray_health", None)
+            gray0 = gray.query_counters() if gray is not None else None
             # the envelope opens BEFORE execution so everything the
             # attempt emits mid-flight — CheckpointWrite/Resume,
             # RecoveryAction, WatchdogTrip — carries this attempt's
@@ -926,6 +931,13 @@ class DataFrame:
                                                     persistent_info()))
                     fusion.update(hash_wire_delta(fm0))
                     sh = self._sharing_info()
+                    fleet = None
+                    if gray is not None:
+                        delta = type(gray).counters_delta(
+                            gray.query_counters(), gray0)
+                        if any(delta.values()) or gray.suspect_hosts():
+                            fleet = dict(delta)
+                            fleet["suspectHosts"] = gray.suspect_hosts()
                     events.emit(
                         "QueryEnd", queryId=qid, status=status,
                         durationMs=round(wall_ms, 3),
@@ -938,6 +950,7 @@ class DataFrame:
                         # bit-identical to HEAD
                         **({"sharing": sh} if sh else {}),
                         **({"planner": planner} if planner else {}),
+                        **({"fleet": fleet} if fleet else {}),
                         explain=self.session.last_dist_explain)
 
             try:

@@ -124,6 +124,8 @@ class TpuSession:
         processes), a logical-host fleet (fleet.logicalHosts partitions
         of a single-process mesh — the tier-1-testable simulation), or
         no fleet at all (every attribute None, zero overhead)."""
+        import threading
+
         import jax
         from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.parallel import mesh as mesh_lib
@@ -154,6 +156,36 @@ class TpuSession:
             from spark_rapids_tpu.serving.fleetcache import FleetStore
             self.fleet_cache = FleetStore(cache_dir, session=self)
             self.fleet_epoch = self.fleet_cache.fence_epoch()
+        # gray-failure (fail-slow) runtime: default-off — None keeps
+        # every consumption site a single getattr and the hot path
+        # bit-identical to the knob-off run
+        self.gray_health = None
+        self.gray_deadlines = None
+        self._full_mesh = None  # pre-quarantine mesh, for rejoin
+        self._quarantined = set()  # hosts soft-shrunk but NOT lost
+        self._gray_inflight = 0  # queries in flight (safe-boundary gate)
+        self._gray_lock = threading.Lock()
+        if self.conf.get(rc.GRAY_FAILURE_ENABLED):
+            from spark_rapids_tpu.robustness.grayfailure import (
+                DeadlineCalibrator, HostHealthTracker)
+            if self.fleet_membership is not None:
+                self.gray_health = HostHealthTracker(
+                    session=self, host_id=host, n_hosts=n_hosts,
+                    suspect_factor=self.conf.get(rc.FLEET_SUSPECT_FACTOR),
+                    window=self.conf.get(rc.FLEET_SUSPECT_WINDOW),
+                    min_samples=self.conf.get(rc.FLEET_SUSPECT_MIN_SAMPLES),
+                    quarantine_after_ms=self.conf.get(
+                        rc.FLEET_QUARANTINE_AFTER_MS),
+                    rejoin_after_ms=self.conf.get(rc.FLEET_REJOIN_AFTER_MS),
+                    hedge_percentile=self.conf.get(rc.FLEET_HEDGE_PERCENTILE),
+                    hedge_margin=self.conf.get(rc.FLEET_HEDGE_MARGIN),
+                    hedge_floor_ms=self.conf.get(rc.FLEET_HEDGE_FLOOR_MS))
+            self.gray_deadlines = DeadlineCalibrator(
+                floor_ms=self.conf.get(rc.WATCHDOG_CALIBRATION_FLOOR_MS),
+                ceiling_ms=self.conf.get(rc.WATCHDOG_CALIBRATION_CEILING_MS),
+                margin=self.conf.get(rc.WATCHDOG_CALIBRATION_MARGIN),
+                min_samples=self.conf.get(
+                    rc.WATCHDOG_CALIBRATION_MIN_SAMPLES))
 
     def shrink_fleet_mesh(self, lost_host: int = -1) -> bool:
         """The shrink rung's side effect (robustness/driver.py): swap
@@ -183,6 +215,8 @@ class TpuSession:
         new_mesh = mesh_lib.surviving_mesh(self.mesh, lost)
         membership.lost |= lost
         from_devices = int(self.mesh.devices.size)
+        if self._full_mesh is None:
+            self._full_mesh = self.mesh  # rejoin's restore point
         self.mesh = new_mesh
         if self.fleet_cache is not None:
             self.fleet_epoch = self.fleet_cache.bump_fence(
@@ -196,6 +230,108 @@ class TpuSession:
             toDevices=int(new_mesh.devices.size),
             lostHosts=sorted(lost), reason="host_loss")
         return True
+
+    def quarantine_host(self, host: int) -> bool:
+        """Gray-failure soft-shrink: drain a SUSPECT host out of the
+        mesh through the SAME machinery the hard shrink rung uses
+        (mesh swap + fence-epoch bump) — but the host is NOT judged
+        lost: its beats keep flowing through the membership registry so
+        the health tracker can watch it recover and rejoin it later."""
+        from spark_rapids_tpu.parallel import mesh as mesh_lib
+        if self.mesh is None or host < 0:
+            return False
+        hosts_before = mesh_lib.mesh_hosts(self.mesh)
+        if host not in hosts_before or len(hosts_before) < 2:
+            return False
+        membership = self.fleet_membership
+        if membership is not None and host == membership.host:
+            return False  # never quarantine ourselves
+        if self._full_mesh is None:
+            self._full_mesh = self.mesh
+        self._quarantined.add(host)
+        drop = set(self._quarantined)
+        if membership is not None:
+            drop |= set(membership.lost)
+        new_mesh = mesh_lib.surviving_mesh(self._full_mesh, drop)
+        from_devices = int(self.mesh.devices.size)
+        self.mesh = new_mesh
+        if self.fleet_cache is not None:
+            self.fleet_epoch = self.fleet_cache.bump_fence(
+                reason="quarantine")
+        tracker = self.gray_health
+        if tracker is not None:
+            tracker.mark_quarantined(host)
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session(
+            "HostQuarantine", self, host=host,
+            fromHosts=len(hosts_before),
+            toHosts=len(mesh_lib.mesh_hosts(new_mesh)),
+            fromDevices=from_devices,
+            toDevices=int(new_mesh.devices.size))
+        emit_on_session(
+            "MeshShrink", self,
+            fromHosts=len(hosts_before),
+            toHosts=len(mesh_lib.mesh_hosts(new_mesh)),
+            fromDevices=from_devices,
+            toDevices=int(new_mesh.devices.size),
+            lostHosts=sorted({host}), reason="quarantine")
+        return True
+
+    def rejoin_fleet_mesh(self, host: int) -> bool:
+        """The shrink rung's inverse (new with gray failure): fold a
+        recovered quarantined host back into the mesh at a safe
+        boundary — caller guarantees no query in flight.  The fence
+        epoch bumps AGAIN (advanced twice across quarantine→rejoin), so
+        entries published against the shrunken layout are fenced from
+        the restored one."""
+        from spark_rapids_tpu.parallel import mesh as mesh_lib
+        if self._full_mesh is None or host not in self._quarantined:
+            return False
+        self._quarantined.discard(host)
+        membership = self.fleet_membership
+        if membership is not None:
+            membership.rejoin(host)
+        drop = set(self._quarantined)
+        if membership is not None:
+            drop |= set(membership.lost)
+        hosts_before = mesh_lib.mesh_hosts(self.mesh)
+        from_devices = int(self.mesh.devices.size)
+        new_mesh = (self._full_mesh if not drop
+                    else mesh_lib.surviving_mesh(self._full_mesh, drop))
+        self.mesh = new_mesh
+        if not drop:
+            self._full_mesh = None  # fully restored
+        if self.fleet_cache is not None:
+            self.fleet_epoch = self.fleet_cache.bump_fence(
+                reason="rejoin")
+        tracker = self.gray_health
+        if tracker is not None:
+            tracker.mark_rejoined(host)
+        from spark_rapids_tpu.utils.events import emit_on_session
+        emit_on_session(
+            "HostRejoin", self, host=host,
+            fromHosts=len(hosts_before),
+            toHosts=len(mesh_lib.mesh_hosts(new_mesh)),
+            fromDevices=from_devices,
+            toDevices=int(new_mesh.devices.size))
+        return True
+
+    def maybe_apply_gray_actions(self) -> None:
+        """Apply due quarantine/rejoin transitions — called from the
+        recovery driver at a safe boundary (before a query's first
+        attempt, when this is the only query in flight): mesh swaps
+        never touch a plan mid-execution."""
+        tracker = self.gray_health
+        if tracker is None:
+            return
+        tracker.poll()
+        with self._gray_lock:
+            if self._gray_inflight > 1:
+                return  # another query mid-flight: not a safe boundary
+            for h in tracker.quarantine_due():
+                self.quarantine_host(h)
+            for h in tracker.rejoin_due():
+                self.rejoin_fleet_mesh(h)
 
     def _init_observability(self) -> None:
         import itertools

@@ -1184,6 +1184,118 @@ FLEET_LOGICAL_HOSTS = conf(
     "hosts).", _to_int,
     lambda v: None if v >= 0 else "must be >= 0")
 
+GRAY_FAILURE_ENABLED = conf(
+    "spark.rapids.tpu.fleet.grayFailure.enabled", False,
+    "Master switch for the gray-failure subsystem "
+    "(robustness/grayfailure.py): per-host health scoring from "
+    "heartbeat jitter and exchange/host-staging wall observations, "
+    "hedged re-dispatch of a SUSPECT host's host-side shard work, "
+    "proactive quarantine of a persistently-degraded host through the "
+    "soft-shrink path (and its rejoin once recovered), and "
+    "self-calibrated watchdog deadlines derived from observed p99 "
+    "walls. A fail-slow host — thermal throttle, degraded DCN link — "
+    "never trips the heartbeat-loss judgment, so without this the "
+    "whole fleet stalls at its pace. False (default) keeps every "
+    "decision path bit-identical to the pre-gray-failure engine.",
+    _to_bool)
+
+FLEET_SUSPECT_FACTOR = conf(
+    "spark.rapids.tpu.fleet.suspectFactor", 3.0,
+    "A host whose median observed wall (per evidence point: heartbeat "
+    "interval, dist.host_sync, exchange.host_staging) is persistently "
+    "this many times the fleet median over the rolling suspect window "
+    "becomes SUSPECT — a typed HostSuspect event, never a hard fault "
+    "on its own. SUSPECT gates hedged execution and starts the "
+    "quarantine clock.", _to_float,
+    lambda v: None if v > 1.0 else "must be > 1.0")
+
+FLEET_SUSPECT_WINDOW = conf(
+    "spark.rapids.tpu.fleet.suspectWindow", 32,
+    "Rolling window (observations per host per evidence point) the "
+    "gray-failure health score is computed over. Smaller windows "
+    "detect faster but flap on one slow GC pause; larger windows "
+    "smooth transients at the cost of detection latency.", _to_int,
+    _positive)
+
+FLEET_SUSPECT_MIN_SAMPLES = conf(
+    "spark.rapids.tpu.fleet.suspectMinSamples", 3,
+    "Minimum observations a host must have at an evidence point "
+    "before that point contributes to its health score — bring-up "
+    "and cold caches must not read as sickness.", _to_int, _positive)
+
+FLEET_QUARANTINE_AFTER_MS = conf(
+    "spark.rapids.tpu.fleet.quarantineAfterMs", 60_000,
+    "A host continuously SUSPECT for this long is proactively "
+    "quarantined: drained out of the mesh through the soft-shrink "
+    "path (fence-epoch bump, survivors-only mesh) at the next safe "
+    "query boundary, before anything wedges. Unlike a heartbeat "
+    "loss, the host keeps beating and its recovery is tracked for "
+    "rejoin. 0 disables proactive quarantine (detection and hedging "
+    "still run).", _to_int,
+    lambda v: None if v >= 0 else "must be >= 0")
+
+FLEET_REJOIN_AFTER_MS = conf(
+    "spark.rapids.tpu.fleet.rejoinAfterMs", 30_000,
+    "A quarantined host whose health score stays below the suspect "
+    "threshold for this long rejoins the mesh at the next safe query "
+    "boundary: devices restored, fleet caches re-fenced (the fence "
+    "epoch advances again), no in-flight query touched.", _to_int,
+    _positive)
+
+FLEET_HEDGE_PERCENTILE = conf(
+    "spark.rapids.tpu.fleet.hedgePercentile", 0.95,
+    "Adaptive hedge deadline: a SUSPECT host's host-side shard work "
+    "(host staging, member replay) that runs past this percentile of "
+    "the fleet's recent healthy walls (times fleet.hedgeMarginFactor) "
+    "is re-dispatched on a healthy survivor; first result wins, the "
+    "loser is discarded with hedgesFired/hedgesWon/"
+    "duplicatesSuppressed pinned.", _to_float,
+    lambda v: None if 0.5 <= v <= 1.0 else "must be in [0.5, 1.0]")
+
+FLEET_HEDGE_MARGIN = conf(
+    "spark.rapids.tpu.fleet.hedgeMarginFactor", 2.0,
+    "Multiplier applied to the hedge percentile wall before a hedge "
+    "fires — hedging costs duplicate work, so the deadline leaves "
+    "honest headroom above the observed healthy tail.", _to_float,
+    lambda v: None if v >= 1.0 else "must be >= 1.0")
+
+FLEET_HEDGE_FLOOR_MS = conf(
+    "spark.rapids.tpu.fleet.hedgeFloorMs", 25,
+    "Floor on the adaptive hedge deadline: never hedge work that has "
+    "run for less than this, whatever the observed walls say — "
+    "sub-floor work is cheaper to wait out than to duplicate.",
+    _to_int, _positive)
+
+WATCHDOG_CALIBRATION_FLOOR_MS = conf(
+    "spark.rapids.tpu.watchdog.calibration.floorMs", 1000,
+    "Floor for self-calibrated watchdog deadlines (gray-failure mode "
+    "only): a calibrated per-point deadline never drops below this, "
+    "whatever the observed p99 says — operator-controlled headroom "
+    "against a burst of fast observations tightening a deadline onto "
+    "normal jitter.", _to_int, _positive)
+
+WATCHDOG_CALIBRATION_CEILING_MS = conf(
+    "spark.rapids.tpu.watchdog.calibration.ceilingMs", 600_000,
+    "Ceiling for self-calibrated watchdog deadlines (gray-failure "
+    "mode only): the calibrated value never exceeds this, so a run "
+    "of pathologically slow observations cannot disable hang "
+    "detection by inflating the deadline without bound.", _to_int,
+    _positive)
+
+WATCHDOG_CALIBRATION_MARGIN = conf(
+    "spark.rapids.tpu.watchdog.calibration.marginFactor", 4.0,
+    "Multiplier applied to the observed per-point p99 wall to form "
+    "the self-calibrated deadline — the deadline is a hang detector, "
+    "not a latency SLO, so it sits well above the healthy tail.",
+    _to_float, lambda v: None if v >= 1.0 else "must be >= 1.0")
+
+WATCHDOG_CALIBRATION_MIN_SAMPLES = conf(
+    "spark.rapids.tpu.watchdog.calibration.minSamples", 8,
+    "Observations a point needs before its watchdog deadline "
+    "self-calibrates; below this the static conf deadline "
+    "(deadline.<point> / defaultDeadlineMs, DCN-scaled) applies "
+    "unchanged.", _to_int, _positive)
+
 ENCODING_EXECUTION_ENABLED = conf(
     "spark.rapids.tpu.encoding.execution.enabled", False,
     "Encoded execution: string GROUP BY keys that are bare column "

@@ -1195,13 +1195,33 @@ class DistPlanner:
     def _replay_members(self, f: ShardedFrame, members,
                         dry: bool) -> ShardedFrame:
         """Unfused fallback: apply the chain member-by-member over the
-        already-computed tail frame (the tail never re-runs)."""
-        for node in reversed(members):
-            if isinstance(node, L.Filter):
-                f = self._filter_frame(f, node, dry)
-            else:
-                f = self._project_frame(f, node, dry)
-        return f
+        already-computed tail frame (the tail never re-runs).  The
+        replay is per-shard re-execution with no collective inside, so
+        it is hedge-eligible: when the mesh spans a SUSPECT host and
+        gray failure is armed, an overrunning replay re-dispatches on
+        the healthy ``dist.member_replay.hedge`` path and the first
+        result wins (robustness/grayfailure.py)."""
+        def _replay():
+            from spark_rapids_tpu.robustness import grayfailure, watchdog
+            from spark_rapids_tpu.robustness.inject import fire
+            out = f
+            point = grayfailure.hedge_point("dist.member_replay")
+            with watchdog.section(point, session=self.session):
+                if not dry:
+                    fire(point)
+                for node in reversed(members):
+                    if isinstance(node, L.Filter):
+                        out = self._filter_frame(out, node, dry)
+                    else:
+                        out = self._project_frame(out, node, dry)
+            return out
+
+        if dry:
+            return _replay()
+        from spark_rapids_tpu.robustness import grayfailure
+        suspect = grayfailure.suspect_host_in(self.session, self.mesh)
+        return grayfailure.hedged_call(
+            self.session, "dist.member_replay", suspect, _replay)
 
     def _fused_chain(self, plan: L.LogicalPlan,
                      dry: bool) -> Optional[ShardedFrame]:

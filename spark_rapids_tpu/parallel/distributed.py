@@ -48,9 +48,24 @@ def host_sync(x):
     _membership_check()
     # deadline on the phase boundary: a dead peer that never answers
     # the stats all-gather becomes a TimeoutFault instead of an
-    # eternal wait (the transport-heartbeat analog)
-    with watchdog.section("dist.host_sync"):
-        return _host_sync_body(x)
+    # eternal wait (the transport-heartbeat analog).  The observed wall
+    # also feeds the gray-failure health score's dist.host_sync axis —
+    # host_sync is a COLLECTIVE, so it is evidence only, never hedged
+    # (re-entering a fleet rendezvous concurrently would wedge SPMD).
+    import time as _time
+    t0 = _time.monotonic()
+    try:
+        with watchdog.section("dist.host_sync"):
+            return _host_sync_body(x)
+    finally:
+        try:
+            from spark_rapids_tpu.api.session import TpuSession
+            from spark_rapids_tpu.robustness import grayfailure
+            grayfailure.note_wall(
+                TpuSession._active, "dist.host_sync",
+                (_time.monotonic() - t0) * 1e3)
+        except ImportError:
+            pass
 
 
 def _membership_check() -> None:

@@ -381,11 +381,18 @@ def host_staged_partition(cols_host: Sequence[Tuple[np.ndarray,
     counts) ready for jnp.asarray placement.  Fires the
     ``exchange.host_staging`` injection point under a watchdog section
     (retryable through the ladder like any exchange fault)."""
+    import time as _time
+
     from spark_rapids_tpu.columnar.column import bucket_capacity
-    from spark_rapids_tpu.robustness import watchdog
+    from spark_rapids_tpu.robustness import grayfailure, watchdog
     from spark_rapids_tpu.robustness.inject import fire
-    with watchdog.section("exchange.host_staging"):
-        fire("exchange.host_staging")
+    # the hedge leg of a hedged_call routes through
+    # exchange.host_staging.hedge — the healthy-survivor path a sick
+    # host's armed delay rules do not target
+    point = grayfailure.hedge_point("exchange.host_staging")
+    t0 = _time.monotonic()
+    with watchdog.section(point):
+        fire(point)
         cap = pids_host.shape[0] // nshards
         live = np.zeros(nshards * cap, dtype=bool)
         for s in range(nshards):
@@ -419,6 +426,12 @@ def host_staged_partition(cols_host: Sequence[Tuple[np.ndarray,
                 vbuf[d * out_cap: d * out_cap + n] = v[sl]
                 mbuf[d * out_cap: d * out_cap + n] = m[sl]
             out_cols.append((vbuf, mbuf))
+        if session is None:
+            from spark_rapids_tpu.api.session import TpuSession
+            session = TpuSession._active
+        grayfailure.note_wall(
+            session, "exchange.host_staging",
+            (_time.monotonic() - t0) * 1e3)
         return out_cols, dest_counts.astype(np.int32), staged_bytes
 
 
@@ -448,4 +461,19 @@ def stage_host_side(flat, hist, key_idx, num_buckets: int, nshards: int,
             for i in key_idx]
     bids = host_hash_partition_ids(keys, num_buckets)
     pids = bids if lut is None else np.asarray(lut, dtype=np.int32)[bids]
-    return host_staged_partition(host, counts, pids, nshards)
+    # hedge eligibility: staging is PURE host-side work (no collective,
+    # no device state), so when the exchange spans a SUSPECT host the
+    # repartition may be re-dispatched on the healthy path and the
+    # first result wins (robustness/grayfailure.py hedged_call; a plain
+    # call when gray failure is off or every host is healthy)
+    from spark_rapids_tpu.robustness import grayfailure
+    try:
+        from spark_rapids_tpu.api.session import TpuSession
+        session = TpuSession._active
+    except ImportError:
+        session = None
+    suspect = grayfailure.suspect_host_in(
+        session, getattr(session, "mesh", None))
+    return grayfailure.hedged_call(
+        session, "exchange.host_staging", suspect,
+        lambda: host_staged_partition(host, counts, pids, nshards))

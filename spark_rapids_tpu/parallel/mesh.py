@@ -327,7 +327,21 @@ class HostMembership:
         self._seen: Set[int] = set()
         self._last_beat = 0.0
         self._joined = False
+        # last successfully-parsed record per peer: a beat file whose
+        # CONTENT is torn/corrupt (external corruption — the atomic
+        # tmp+fsync+replace write itself never publishes a torn
+        # record) must not read as "vanished after join"; the peer is
+        # judged by its last good timestamp until a fresh record lands
+        self._last_rec: Dict[int, dict] = {}
         os.makedirs(dirpath, exist_ok=True)
+
+    @property
+    def _tracker(self):
+        """The session's gray-failure health tracker (None unless
+        fleet.grayFailure.enabled) — beat records gossip local walls
+        to it and check() feeds it peers' evidence."""
+        return getattr(self._session, "gray_health", None) \
+            if self._session is not None else None
 
     # ----------------------------------------------------------- paths --
     def _path(self, host: int) -> str:
@@ -343,8 +357,11 @@ class HostMembership:
     # ---------------------------------------------------------- beating --
     def beat(self, force: bool = False) -> None:
         """Write this host's beat record (rate-limited to the
-        heartbeat period unless ``force``).  The write is atomic
-        (tmp+rename) so a reader never sees a torn record."""
+        heartbeat period unless ``force``).  The write is atomic with
+        the temp+fsync+``os.replace`` discipline used by every other
+        durable blob in the engine, so a reader never sees a torn
+        record — even across a power cut between the rename and the
+        data reaching the platters."""
         now = time.time()
         if not force and (now - self._last_beat) * 1000.0 < \
                 self.heartbeat_ms:
@@ -353,11 +370,22 @@ class HostMembership:
         inject.fire("fleet.heartbeat")
         rec = {"host": self.host, "pid": os.getpid(),
                "ts": round(now, 3)}
+        tracker = self._tracker
+        if tracker is not None:
+            # gossip this host's per-point walls on the beat record:
+            # peers fold them into their health view of us, which is
+            # how per-host wall evidence crosses process boundaries
+            # without a coordinator
+            walls = tracker.local_walls()
+            if walls:
+                rec["walls"] = walls
         path = self._path(self.host)
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(rec, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except OSError:
             return  # a missed write is just a missed beat
@@ -369,11 +397,20 @@ class HostMembership:
 
     # --------------------------------------------------------- checking --
     def _read(self, host: int) -> Optional[dict]:
+        """Parse ``host``'s beat record.  A MISSING file is None (the
+        vanished-after-join judgment needs it); a file whose content
+        is torn or corrupt answers the last successfully-parsed record
+        instead — external corruption of the registry must age the
+        peer out by silence, never false-kill it on the spot."""
         try:
             with open(self._path(host), encoding="utf-8") as f:
-                return json.load(f)
-        except (OSError, ValueError):
+                rec = json.load(f)
+            self._last_rec[host] = rec
+            return rec
+        except FileNotFoundError:
             return None
+        except (OSError, ValueError):
+            return self._last_rec.get(host)
 
     def silent_ms(self, host: int) -> Optional[float]:
         """How long since ``host``'s last beat (None = never beat)."""
@@ -392,16 +429,25 @@ class HostMembership:
         over.  Returns the full lost set."""
         self.beat()
         fatal_ms = float(self.heartbeat_ms * self.missed_fatal)
+        tracker = self._tracker
         newly = []
         for h in range(self.n_hosts):
             if h == self.host or h in self.lost:
                 continue
-            silent = self.silent_ms(h)
-            if silent is None:
+            rec = self._read(h)
+            if rec is None:
                 if h in self._seen:
                     newly.append((h, fatal_ms))  # joined, then vanished
                 continue
             self._seen.add(h)
+            if tracker is not None:
+                # gray-failure evidence: the peer's achieved beat
+                # interval (jitter shows a fail-slow writer long
+                # before fatal silence) plus its gossiped walls
+                tracker.observe_beat(h, float(rec.get("ts", 0)))
+                tracker.observe_peer_walls(h, rec.get("walls"))
+            silent = max(0.0, (time.time() -
+                               float(rec.get("ts", 0))) * 1000.0)
             if silent > fatal_ms:
                 newly.append((h, silent))
         for h, silent in newly:
@@ -419,6 +465,16 @@ class HostMembership:
 
     def alive_hosts(self) -> List[int]:
         return [h for h in range(self.n_hosts) if h not in self.lost]
+
+    def rejoin(self, host: int) -> None:
+        """Readmit a previously-lost (or quarantined) host: drop it
+        from the lost set and from the seen set, so a host whose
+        record has not re-appeared yet reads as not-yet-joined (never
+        instantly re-lost as vanished-after-join) and fresh evidence
+        starts clean."""
+        self.lost.discard(host)
+        self._seen.discard(host)
+        self._last_rec.pop(host, None)
 
     # ------------------------------------------------------ test levers --
     def simulate_loss(self, host: int) -> None:

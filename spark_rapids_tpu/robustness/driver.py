@@ -273,38 +273,66 @@ class QueryRetryDriver:
         self._rungs = self._ladder()
         self._pos = 0  # next rung to use on failure; only moves forward
         backoffs = 0
-        while True:
-            try:
-                result = attempt(mode)
-                self._emit_summary("recovered")
-                return result
-            except Exception as exc:  # noqa: BLE001 - classified below
-                fault = F.classify(exc)
-                if fault.fatal:
-                    self._emit_summary("fatal")
-                    raise
-                self._advance_to(self._entry_rung(fault))
-                if self._pos >= len(self._rungs):
-                    self._emit_summary("exhausted")
-                    raise
-                rung = self._rungs[self._pos]
-                self._pos += 1
-                self._record(rung, fault, exc)
-                mode = self._mode_for(rung, mode)
-                self._update_lineage(rung, mode)
-                if rung == SPILL_RETRY:
-                    self._spill_device_store()
-                if rung == SHRINK_FLEET:
-                    self._shrink_fleet(exc)
-                if rung == RETRY and self.backoff_s > 0:
-                    # exponential backoff, capped (backoffCapMs) and
-                    # jittered into [0.5, 1.0]x — chaos tests and real
-                    # preemptions both stay responsive, and concurrent
-                    # drivers never retry in lockstep
-                    base = min(self.backoff_s * (2 ** backoffs),
-                               self.backoff_cap_s)
-                    time.sleep(base * (0.5 + 0.5 * self._rng.random()))
-                    backoffs += 1
+        self._gray_enter()
+        try:
+            while True:
+                try:
+                    result = attempt(mode)
+                    self._emit_summary("recovered")
+                    return result
+                except Exception as exc:  # noqa: BLE001 - classified below
+                    fault = F.classify(exc)
+                    if fault.fatal:
+                        self._emit_summary("fatal")
+                        raise
+                    self._advance_to(self._entry_rung(fault))
+                    if self._pos >= len(self._rungs):
+                        self._emit_summary("exhausted")
+                        raise
+                    rung = self._rungs[self._pos]
+                    self._pos += 1
+                    self._record(rung, fault, exc)
+                    mode = self._mode_for(rung, mode)
+                    self._update_lineage(rung, mode)
+                    if rung == SPILL_RETRY:
+                        self._spill_device_store()
+                    if rung == SHRINK_FLEET:
+                        self._shrink_fleet(exc)
+                    if rung == RETRY and self.backoff_s > 0:
+                        # exponential backoff, capped (backoffCapMs) and
+                        # jittered into [0.5, 1.0]x — chaos tests and
+                        # real preemptions both stay responsive, and
+                        # concurrent drivers never retry in lockstep
+                        base = min(self.backoff_s * (2 ** backoffs),
+                                   self.backoff_cap_s)
+                        time.sleep(
+                            base * (0.5 + 0.5 * self._rng.random()))
+                        backoffs += 1
+        finally:
+            self._gray_exit()
+
+    def _gray_enter(self) -> None:
+        """Safe boundary for gray-failure mitigation: before a query's
+        FIRST attempt (no plan in flight on this driver yet), let the
+        session apply due quarantine drains / rejoins, so the attempt
+        plans on the post-mitigation mesh.  The inflight count gates
+        mesh swaps — a concurrent query mid-flight defers mitigation to
+        the next boundary.  No-op without a tracker."""
+        if getattr(self.session, "gray_health", None) is None:
+            return
+        with self.session._gray_lock:
+            self.session._gray_inflight += 1
+        try:
+            self.session.maybe_apply_gray_actions()
+        except Exception:
+            pass  # mitigation is best-effort; never blocks the query
+
+    def _gray_exit(self) -> None:
+        if getattr(self.session, "gray_health", None) is None:
+            return
+        with self.session._gray_lock:
+            self.session._gray_inflight = max(
+                0, self.session._gray_inflight - 1)
 
     def _shrink_fleet(self, exc: BaseException) -> None:
         """Rebuild the session mesh over surviving hosts (the shrink

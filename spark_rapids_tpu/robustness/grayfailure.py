@@ -1,0 +1,564 @@
+"""Gray-failure resilience: fail-slow detection, hedged execution,
+quarantine/rejoin tracking, and self-calibrating deadlines.
+
+PR 18's membership layer answers fail-STOP — a silent host is declared
+lost and the shrink rung rebuilds the mesh.  The dominant real-world
+failure mode is fail-SLOW: a thermally-throttled chip, a degraded DCN
+link, a noisy co-tenant.  A fail-slow host never trips the heartbeat
+loss judgment; it just stalls every DCN-spanning collective at its own
+pace.  This module treats asymmetric slowness as a first-class fault
+with its own detection, mitigation, and recovery rungs:
+
+- :class:`HostHealthTracker` folds a per-host health score from
+  heartbeat-interval jitter (gossiped through the membership beat
+  records) and per-host wall observations at the evidence points
+  (``dist.host_sync``, ``exchange.host_staging``).  A host
+  persistently slower than the fleet median by
+  ``fleet.suspectFactor`` over a rolling window becomes SUSPECT — a
+  typed ``HostSuspect`` event, never a hard fault on its own.
+- :func:`hedged_call` re-dispatches a SUSPECT host's host-side shard
+  work (host staging, per-member replay) on a healthy path when it
+  overruns an adaptive percentile deadline.  First result wins; the
+  loser is discarded with ``hedgesFired``/``hedgesWon``/
+  ``duplicatesSuppressed`` pinned.  Only *pure host-side* work may
+  hedge — a collective is a fleet-wide rendezvous and re-entering one
+  concurrently would wedge or corrupt the SPMD program, so collectives
+  never hedge (docs/robustness.md "hedge eligibility").
+- quarantine/rejoin bookkeeping: SUSPECT past
+  ``fleet.quarantineAfterMs`` requests a soft-shrink drain (the
+  session applies it at a safe query boundary); a quarantined host
+  whose score recovers for ``fleet.rejoinAfterMs`` requests a rejoin.
+- :class:`DeadlineCalibrator` derives watchdog per-point deadlines
+  from observed p99 walls (floor/ceiling confs retain operator
+  control) instead of hand-tuned static confs.
+
+Everything hangs off ``session.gray_health`` / ``session.gray_deadlines``
+— both None unless ``spark.rapids.tpu.fleet.grayFailure.enabled``, so
+the default engine stays bit-identical (every hook is a None check).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+# evidence pseudo-point for heartbeat-interval jitter (the walls of the
+# other evidence points are real observed durations; this one is the
+# gap between a peer's successive beat records)
+HEARTBEAT_POINT = "fleet.heartbeat"
+# points whose walls feed the per-host health score
+EVIDENCE_POINTS = (HEARTBEAT_POINT, "dist.host_sync",
+                   "exchange.host_staging")
+
+# host health states
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+
+# thread-local hedge context: the re-dispatched (hedge) leg of a
+# hedged_call runs with this set so the work body routes through the
+# ``<point>.hedge`` injection/watchdog point — the simulated analog of
+# dispatching on a DIFFERENT (healthy) host, where the sick host's
+# armed delay rules do not apply
+_tls = threading.local()
+
+
+def in_hedge() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+def hedge_point(point: str) -> str:
+    """Effective injection/watchdog point name for the current leg:
+    the hedge leg fires ``<point>.hedge`` (registered alongside the
+    primary point) so chaos rules wedging the sick host's path do not
+    wedge the healthy re-dispatch."""
+    return point + ".hedge" if in_hedge() else point
+
+
+def _percentile(vals: List[float], p: float) -> float:
+    """Nearest-rank percentile of an unsorted sample (no numpy on the
+    hot path)."""
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    k = max(0, min(len(s) - 1, int(round(p * len(s) + 0.5)) - 1))
+    return s[k]
+
+
+def _median(vals) -> float:
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class HostHealthTracker:
+    """Per-host health scoring plus hedge/quarantine bookkeeping.
+
+    Evidence arrives from three producers: the membership layer feeds
+    peers' heartbeat intervals and gossiped per-point walls
+    (``observe_beat``/``observe_peer_walls``, read from the beat
+    records every ``check()``), and the engine's own host-side work
+    feeds local walls (``observe_wall``).  ``poll()`` folds the
+    evidence into per-host states and emits the typed transition
+    events; the session applies quarantine/rejoin requests at safe
+    query boundaries (``quarantine_due``/``rejoin_due``).
+
+    The health score of a host is the worst (max) ratio, over evidence
+    points with at least ``min_samples`` observations, of the host's
+    median wall to the fleet's median-of-host-medians at that point —
+    robust to one outlier observation AND to one outlier host
+    dragging the fleet baseline."""
+
+    def __init__(self, session=None, host_id: int = 0, n_hosts: int = 1,
+                 suspect_factor: float = 3.0, window: int = 32,
+                 min_samples: int = 3, quarantine_after_ms: int = 60_000,
+                 rejoin_after_ms: int = 30_000,
+                 hedge_percentile: float = 0.95,
+                 hedge_margin: float = 2.0, hedge_floor_ms: int = 25):
+        self._session = session
+        self.host = int(host_id)
+        self.n_hosts = int(n_hosts)
+        self.suspect_factor = float(suspect_factor)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.quarantine_after_ms = int(quarantine_after_ms)
+        self.rejoin_after_ms = int(rejoin_after_ms)
+        self.hedge_percentile = float(hedge_percentile)
+        self.hedge_margin = float(hedge_margin)
+        self.hedge_floor_ms = int(hedge_floor_ms)
+        self._lock = threading.Lock()
+        # (host, point) -> rolling wall observations [ms]
+        self._walls: Dict[Tuple[int, str], deque] = {}
+        # host -> last beat ts seen (for interval derivation)
+        self._last_beat_ts: Dict[int, float] = {}
+        self.state: Dict[int, str] = {}
+        self.scores: Dict[int, float] = {}
+        # host -> monotonic time it entered SUSPECT / recovered while
+        # quarantined (the quarantine / rejoin clocks)
+        self._suspect_since: Dict[int, float] = {}
+        self._recovered_since: Dict[int, float] = {}
+        # score timeline for the profiling "Fleet health" section:
+        # emitted on the event log, mirrored here for tests
+        self.transitions: List[Dict[str, object]] = []
+        self.counters: Dict[str, int] = {
+            "hedgesFired": 0, "hedgesWon": 0, "duplicatesSuppressed": 0,
+            "suspects": 0, "recoveries": 0, "quarantines": 0,
+            "rejoins": 0}
+
+    # ------------------------------------------------------- evidence --
+    def observe_wall(self, host: int, point: str, wall_ms: float
+                     ) -> None:
+        """One wall observation for ``host`` at an evidence point.
+        Local work attributes to the local host; membership gossip
+        attributes to peers.  Also persisted on the ObservationStore's
+        per-host axis (``host<h>@<point>`` sites) so evidence survives
+        process restarts alongside the per-site records."""
+        host = int(host)
+        if host < 0:
+            return
+        with self._lock:
+            dq = self._walls.setdefault((host, point),
+                                        deque(maxlen=self.window))
+            dq.append(float(wall_ms))
+        from spark_rapids_tpu.utils import tracing
+        tracing.observe_host(host, point, wall_ms=float(wall_ms))
+
+    def observe_beat(self, host: int, beat_ts: float) -> None:
+        """Derive the heartbeat-interval evidence from a peer's beat
+        record: the gap between successive ``ts`` stamps IS the
+        interval the peer achieved (a wedged writer shows up as a
+        stretched interval long before the fatal silence window)."""
+        prev = self._last_beat_ts.get(host)
+        self._last_beat_ts[host] = beat_ts
+        if prev is not None and beat_ts > prev:
+            self.observe_wall(host, HEARTBEAT_POINT,
+                              (beat_ts - prev) * 1000.0)
+
+    def observe_peer_walls(self, host: int,
+                           walls: Dict[str, float]) -> None:
+        """Fold a peer's gossiped per-point EMA walls (carried on its
+        beat record) into its evidence."""
+        for point, ms in (walls or {}).items():
+            if point in EVIDENCE_POINTS:
+                self.observe_wall(host, point, float(ms))
+
+    def local_walls(self) -> Dict[str, float]:
+        """This host's latest per-point walls — the gossip payload its
+        next beat record carries."""
+        with self._lock:
+            out = {}
+            for (h, point), dq in self._walls.items():
+                if h == self.host and dq and point != HEARTBEAT_POINT:
+                    out[point] = round(_median(dq), 3)
+            return out
+
+    # -------------------------------------------------------- scoring --
+    def score(self, host: int) -> float:
+        """Worst per-point slowness ratio vs the fleet baseline (1.0 =
+        at the fleet median; below min_samples everywhere = 1.0).  The
+        baseline is the median of the OTHER hosts' medians — in a
+        small fleet the scored host's own evidence would drag the
+        baseline toward itself and mask the asymmetry."""
+        with self._lock:
+            worst = 1.0
+            for point in EVIDENCE_POINTS:
+                mine = self._walls.get((int(host), point))
+                if not mine or len(mine) < self.min_samples:
+                    continue
+                peers = [
+                    _median(dq) for (h, p), dq in self._walls.items()
+                    if p == point and h != int(host)
+                    and len(dq) >= self.min_samples]
+                if not peers:
+                    continue  # no fleet baseline to compare against
+                fleet = _median(peers)
+                if fleet <= 0:
+                    continue
+                worst = max(worst, _median(mine) / fleet)
+            return worst
+
+    def _emit(self, event: str, **fields) -> None:
+        try:
+            from spark_rapids_tpu.utils.events import emit_on_session
+            emit_on_session(event, self._session, **fields)
+        except Exception:
+            pass  # health tracking must work without an event log
+
+    def poll(self) -> Dict[int, str]:
+        """Recompute every known host's state and emit transition
+        events.  Never touches the mesh — mitigation is the session's
+        (safe-boundary) job; detection alone is side-effect free."""
+        now = time.monotonic()
+        with self._lock:
+            hosts = sorted({h for h, _ in self._walls})
+        for h in hosts:
+            if h == self.host:
+                continue
+            sc = self.score(h)
+            self.scores[h] = round(sc, 3)
+            state = self.state.get(h, HEALTHY)
+            if state == QUARANTINED:
+                # recovery clock: score back under the threshold and
+                # staying there arms the rejoin request
+                if sc < self.suspect_factor:
+                    self._recovered_since.setdefault(h, now)
+                else:
+                    self._recovered_since.pop(h, None)
+                continue
+            if sc >= self.suspect_factor and state != SUSPECT:
+                self.state[h] = SUSPECT
+                self._suspect_since[h] = now
+                self.counters["suspects"] += 1
+                rec = {"kind": "suspect", "host": h, "score": sc}
+                self.transitions.append(rec)
+                self._emit("HostSuspect", host=h, score=round(sc, 3),
+                           factor=self.suspect_factor)
+            elif sc < self.suspect_factor and state == SUSPECT:
+                self.state[h] = HEALTHY
+                self._suspect_since.pop(h, None)
+                self.counters["recoveries"] += 1
+                self.transitions.append(
+                    {"kind": "recovered", "host": h, "score": sc})
+                self._emit("HostRecovered", host=h,
+                           score=round(sc, 3))
+        return dict(self.state)
+
+    # ------------------------------------------------------- requests --
+    def is_suspect(self, host: int) -> bool:
+        return self.state.get(int(host)) in (SUSPECT, QUARANTINED)
+
+    def suspect_hosts(self) -> List[int]:
+        return sorted(h for h, s in self.state.items() if s == SUSPECT)
+
+    def quarantine_due(self) -> List[int]:
+        """SUSPECT hosts whose degradation outlasted the quarantine
+        window — the session drains these through the soft-shrink
+        path at the next safe boundary."""
+        if self.quarantine_after_ms <= 0:
+            return []
+        now = time.monotonic()
+        return sorted(
+            h for h, s in self.state.items()
+            if s == SUSPECT and
+            (now - self._suspect_since.get(h, now)) * 1000.0
+            >= self.quarantine_after_ms)
+
+    def rejoin_due(self) -> List[int]:
+        """Quarantined hosts whose recovery outlasted the rejoin
+        window — the session restores these at the next safe
+        boundary."""
+        now = time.monotonic()
+        return sorted(
+            h for h, s in self.state.items()
+            if s == QUARANTINED and h in self._recovered_since and
+            (now - self._recovered_since[h]) * 1000.0
+            >= self.rejoin_after_ms)
+
+    def mark_quarantined(self, host: int) -> None:
+        self.state[int(host)] = QUARANTINED
+        self._suspect_since.pop(int(host), None)
+        self._recovered_since.pop(int(host), None)
+        self.counters["quarantines"] += 1
+        self.transitions.append({"kind": "quarantine", "host": host,
+                                 "score": self.scores.get(host, 0.0)})
+
+    def mark_rejoined(self, host: int) -> None:
+        self.state[int(host)] = HEALTHY
+        self._recovered_since.pop(int(host), None)
+        self.counters["rejoins"] += 1
+        self.transitions.append({"kind": "rejoin", "host": host,
+                                 "score": self.scores.get(host, 0.0)})
+        # a rejoined host starts with a clean slate: its quarantine-era
+        # evidence (stale, observed while it did no fleet work) must
+        # not re-trip SUSPECT on the first post-rejoin poll
+        with self._lock:
+            for key in [k for k in self._walls if k[0] == int(host)]:
+                del self._walls[key]
+        self.scores.pop(int(host), None)
+
+    # -------------------------------------------------------- hedging --
+    def hedge_deadline_ms(self, point: str) -> float:
+        """Adaptive hedge deadline for ``point``: the configured
+        percentile of the recent healthy-host walls, scaled by the
+        hedge margin and floored — a freshly-started fleet with no
+        evidence hedges at the floor."""
+        with self._lock:
+            healthy: List[float] = []
+            for (h, p), dq in self._walls.items():
+                if p == point and \
+                        self.state.get(h, HEALTHY) == HEALTHY:
+                    healthy.extend(dq)
+        if not healthy:
+            return float(self.hedge_floor_ms)
+        return max(float(self.hedge_floor_ms),
+                   _percentile(healthy, self.hedge_percentile)
+                   * self.hedge_margin)
+
+    def query_counters(self) -> Dict[str, int]:
+        """Cumulative counter snapshot (QueryEnd computes per-query
+        deltas against this)."""
+        with self._lock:
+            return dict(self.counters)
+
+    @staticmethod
+    def counters_delta(after: Dict[str, int], before: Dict[str, int]
+                       ) -> Dict[str, int]:
+        return {k: after.get(k, 0) - before.get(k, 0) for k in after}
+
+
+class DeadlineCalibrator:
+    """Self-calibrating watchdog deadlines (tentpole layer 4).
+
+    The watchdog's section exits feed per-point wall observations;
+    once a point has ``minSamples`` the resolved deadline becomes
+    ``clamp(p99 * marginFactor, floorMs, ceilingMs)`` instead of the
+    static conf value — detection tightens as evidence accumulates
+    instead of being hand-tuned per topology (the dcnDeadlineScale
+    knob keeps applying to the static path for points still below
+    minSamples).  Explicit ``deadline_ms`` arguments and per-point
+    conf overrides always win: calibration replaces only the implicit
+    default."""
+
+    def __init__(self, floor_ms: int = 50, ceiling_ms: int = 600_000,
+                 margin: float = 4.0, min_samples: int = 8,
+                 window: int = 128):
+        self.floor_ms = float(floor_ms)
+        self.ceiling_ms = float(ceiling_ms)
+        self.margin = float(margin)
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._walls: Dict[str, deque] = {}
+
+    def observe(self, point: str, wall_ms: float) -> None:
+        with self._lock:
+            self._walls.setdefault(
+                point, deque(maxlen=128)).append(float(wall_ms))
+
+    def deadline_ms(self, point: str) -> Optional[float]:
+        """Calibrated deadline for ``point``; None below minSamples
+        (the caller falls back to the static conf chain)."""
+        with self._lock:
+            dq = self._walls.get(point)
+            if not dq or len(dq) < self.min_samples:
+                return None
+            vals = list(dq)
+        p99 = _percentile(vals, 0.99)
+        return min(self.ceiling_ms, max(self.floor_ms,
+                                        p99 * self.margin))
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {}
+        with self._lock:
+            points = list(self._walls)
+        for p in points:
+            d = self.deadline_ms(p)
+            if d is not None:
+                out[p] = round(d, 1)
+        return out
+
+
+# ------------------------------------------------------- session hooks --
+
+def tracker_for(session) -> Optional[HostHealthTracker]:
+    return getattr(session, "gray_health", None) \
+        if session is not None else None
+
+
+def note_wall(session, point: str, wall_ms: float,
+              host: Optional[int] = None) -> None:
+    """Attribute one local wall observation; no-op without a tracker.
+    ``host`` defaults to the session's own fleet host."""
+    tracker = tracker_for(session)
+    if tracker is None:
+        return
+    tracker.observe_wall(tracker.host if host is None else host,
+                         point, wall_ms)
+
+
+def suspect_host_in(session, mesh) -> int:
+    """A SUSPECT host participating in ``mesh``, or -1.  The hedge
+    eligibility gate: host-side shard work only hedges when the
+    exchange actually spans a host the tracker distrusts."""
+    tracker = tracker_for(session)
+    if tracker is None or mesh is None:
+        return -1
+    suspects = {h for h, s in tracker.state.items()
+                if s == SUSPECT}
+    if not suspects:
+        return -1
+    try:
+        from spark_rapids_tpu.parallel.mesh import mesh_hosts
+        hosts = set(mesh_hosts(mesh))
+    except Exception:
+        return -1
+    hit = sorted(suspects & hosts)
+    return hit[0] if hit else -1
+
+
+def hedged_call(session, point: str, host: int,
+                fn: Callable[[], object]):
+    """Run ``fn`` with hedged re-dispatch when ``host`` is SUSPECT.
+
+    The primary leg runs on a worker thread adopted into the driving
+    thread's identity (chaos rules modeling the sick host fire there,
+    exactly as on the real dispatch).  If it has not produced within
+    the adaptive hedge deadline, the hedge leg re-runs ``fn`` inline
+    under the hedge context (``<point>.hedge`` — the healthy-survivor
+    path) and the first completed leg wins.  The loser's result is
+    discarded (``duplicatesSuppressed``) and the abandoned worker is
+    disowned from every attribution registry so its eventual
+    completion cannot consume the query's next cancellation token or
+    rule budget.  Exactly-once holds structurally: exactly ONE result
+    object is returned to the caller, so sinks emit once and the
+    lineage log records once.
+
+    ``fn`` MUST be pure host-side work (staging repartitions, member
+    replays) — never a collective: both legs may run concurrently.
+
+    Without a tracker, with a healthy host, or in a nested hedge, this
+    is exactly ``fn()`` — the default path stays bit-identical."""
+    tracker = tracker_for(session)
+    if tracker is None or host < 0 or not tracker.is_suspect(host) \
+            or in_hedge():
+        return fn()
+    deadline_ms = tracker.hedge_deadline_ms(point)
+
+    lock = threading.Lock()
+    done = threading.Event()
+    box: Dict[str, object] = {}  # "value" | "error", set by the winner
+
+    def _claim(key, val) -> bool:
+        with lock:
+            if "value" in box or "error" in box:
+                tracker.counters["duplicatesSuppressed"] += 1
+                return False
+            box[key] = val
+            done.set()
+            return True
+
+    from spark_rapids_tpu.exec import pipeline
+    from spark_rapids_tpu.serving import context as qc
+    # adopt the EFFECTIVE ident: when the caller is itself an adopted
+    # pipeline worker, the primary leg must chain to the driving
+    # query's identity or thread-scoped chaos rules / cancellation
+    # tokens would miss it
+    owner = qc.effective_ident()
+
+    def _primary():
+        with pipeline.worker_attribution(owner):
+            # watchdog identity stays LOCAL: a wedged primary's
+            # section trip must park on THIS thread, not on the
+            # driving query — the hedge leg (which runs on the
+            # driver) would inherit the fault at its first checkpoint
+            # and the hedge could never win
+            from spark_rapids_tpu.robustness import watchdog
+            watchdog.release_thread()
+            try:
+                val = fn()
+            except BaseException as exc:  # noqa: BLE001 — relayed
+                _claim("error", exc)
+                return
+            _claim("value", val)
+
+    t = threading.Thread(target=_primary, daemon=True,
+                         name=f"tpu-hedge-primary-{point}")
+    t.start()
+    if done.wait(deadline_ms / 1000.0):
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    # primary overran the hedge deadline: re-dispatch on the healthy
+    # path, first result wins
+    tracker.counters["hedgesFired"] += 1
+    tracker._emit("HedgeFired", point=point, host=host,
+                  deadlineMs=round(deadline_ms, 1))
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        try:
+            hedge_val = fn()
+        except BaseException as exc:  # noqa: BLE001
+            # hedge leg failed; the primary may still land — give it
+            # one more deadline before surfacing the hedge's fault
+            if done.wait(deadline_ms / 1000.0):
+                if "value" in box:
+                    return box["value"]
+                raise box["error"]
+            raise exc
+    finally:
+        _tls.depth -= 1
+    if _claim("value", hedge_val):
+        tracker.counters["hedgesWon"] += 1
+        tracker._emit("HedgeWon", point=point, host=host)
+        # abandon the wedged primary: sever its adopted identity so
+        # its dying fire()/checkpoint() calls cannot consume the
+        # query's rule budgets or cancellation token
+        if t.ident is not None:
+            pipeline.disown_worker(t.ident)
+        return hedge_val
+    # the primary finished in the hedge's shadow (photo finish): its
+    # result was claimed first, ours is the suppressed duplicate
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def register_hedge_points() -> None:
+    """Declare the ``<point>.hedge`` injection points beside their
+    primaries so chaos rules can target (or deliberately spare) the
+    healthy-survivor leg."""
+    from spark_rapids_tpu.robustness import inject
+    from spark_rapids_tpu.robustness.faults import InjectedShuffleFault
+    inject.register_point("exchange.host_staging.hedge",
+                          InjectedShuffleFault)
+    inject.register_point("dist.member_replay",
+                          InjectedShuffleFault)
+    inject.register_point("dist.member_replay.hedge",
+                          InjectedShuffleFault)
+
+
+register_hedge_points()

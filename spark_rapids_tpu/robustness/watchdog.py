@@ -237,8 +237,12 @@ def _active_session():
 
 
 def _resolve_deadline_ms(point: str, deadline_ms, session) -> float:
-    """Explicit arg > per-point conf > default conf; 0/None disables.
-    Returns 0.0 when the section should not be monitored."""
+    """Explicit arg > per-point conf > calibrated p99 > default conf;
+    0/None disables.  Returns 0.0 when the section should not be
+    monitored.  The calibrated tier (robustness/grayfailure.py
+    DeadlineCalibrator, armed by fleet.grayFailure.enabled) replaces
+    only the implicit DEFAULT: an explicit argument or a per-point conf
+    keeps operator control."""
     global _poll_target_s
     conf = getattr(session, "conf", None) if session is not None else None
     if conf is not None:
@@ -246,7 +250,15 @@ def _resolve_deadline_ms(point: str, deadline_ms, session) -> float:
         if not conf.get(rc.WATCHDOG_ENABLED):
             return 0.0
         if deadline_ms is None:
-            deadline_ms = conf.watchdog_deadline_ms(point)
+            raw = conf.settings.get(rc._WATCHDOG_DEADLINE_PREFIX + point)
+            if raw is not None:
+                deadline_ms = int(raw)  # explicit per-point conf wins
+            else:
+                cal = getattr(session, "gray_deadlines", None)
+                if cal is not None:
+                    deadline_ms = cal.deadline_ms(point)
+                if deadline_ms is None:
+                    deadline_ms = conf.watchdog_deadline_ms(point)
         _poll_target_s = conf.get(rc.WATCHDOG_POLL_MS) / 1e3
     return float(deadline_ms or 0)
 
@@ -356,12 +368,22 @@ def section(point: str, deadline_ms: Optional[float] = None,
     # "query" is excluded — it stays open across the QueryEnd drain,
     # whose wall clock already covers it.
     sp = tracing.span(point) if point != "query" else None
+    # self-calibration: clean section exits feed the per-point wall
+    # evidence the DeadlineCalibrator derives future deadlines from
+    # (None unless fleet.grayFailure.enabled — a single getattr here)
+    cal = getattr(session, "gray_deadlines", None) \
+        if point != "query" else None
     if ms <= 0:
-        if sp is None:
-            yield None
-        else:
-            with sp:
+        t0 = time.monotonic() if cal is not None else 0.0
+        try:
+            if sp is None:
                 yield None
+            else:
+                with sp:
+                    yield None
+        finally:
+            if cal is not None:
+                cal.observe(point, (time.monotonic() - t0) * 1e3)
         return
     s = Section(point, ms / 1e3, _effective_ident(), session)
     with _lock:
@@ -377,6 +399,10 @@ def section(point: str, deadline_ms: Optional[float] = None,
     finally:
         with _lock:
             _sections.pop(s.id, None)
+        if cal is not None and not s.tripped:
+            # tripped sections are excluded: a wedge's wall is not
+            # evidence of the point's healthy latency
+            cal.observe(point, (time.monotonic() - s.started) * 1e3)
     checkpoint()  # after finally: never masks an in-flight exception
 
 

@@ -239,6 +239,20 @@ class FleetRunner:
                                   if p not in offered] + list(delta)
             splices = (shared.local["resumes"] - r0) \
                 if shared is not None and shared.enabled else 0
+            # standing queries tick for hours: every round is also a
+            # gray-failure detection boundary — fold the accumulated
+            # wall/heartbeat evidence into per-host states so a host
+            # going fail-slow mid-stream surfaces as HostSuspect here,
+            # not only at the next ad-hoc query
+            tracker = getattr(self.session, "gray_health", None)
+            suspects = 0
+            if tracker is not None:
+                try:
+                    states = tracker.poll()
+                    suspects = sum(1 for s in states.values()
+                                   if s != "healthy")
+                except Exception:
+                    pass  # detection must never fault a round
             self.last_round_errors = errors
             self.last_round_info = {
                 "round": rnd,
@@ -256,7 +270,8 @@ class FleetRunner:
                 round=rnd, subscribers=len(handles),
                 deltaFiles=len(delta),
                 sourcePulls=int(self.last_round_info["sourcePulls"]),
-                splices=int(splices), failures=len(errors))
+                splices=int(splices), failures=len(errors),
+                **({"suspectHosts": suspects} if suspects else {}))
             return results
 
     def close(self) -> None:

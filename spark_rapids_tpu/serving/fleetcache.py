@@ -94,6 +94,8 @@ class FleetStore:
             try:
                 with open(tmp, "w", encoding="utf-8") as f:
                     json.dump({"epoch": epoch}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
             except OSError:
                 return self.fence_epoch()
@@ -137,6 +139,8 @@ class FleetStore:
                     f.write(_BLOB_MAGIC)
                     f.write(zlib.crc32(blob).to_bytes(4, "big"))
                     f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
             except OSError:
                 try:

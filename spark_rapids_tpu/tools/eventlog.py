@@ -97,6 +97,11 @@ class QueryInfo:
     # CostModelInvalid events (corrupt evidence load / ledger write
     # fault — the model degraded to built-in defaults)
     costmodel: List[Dict[str, str]] = field(default_factory=list)
+    # gray-failure counters (QueryEnd fleet dict,
+    # robustness/grayfailure.py: hedgesFired/hedgesWon/
+    # duplicatesSuppressed/suspects/quarantines/rejoins deltas +
+    # suspectHosts list); ABSENT when grayFailure.enabled is off
+    fleet_health: Dict[str, object] = field(default_factory=dict)
 
     @property
     def succeeded(self) -> bool:
@@ -302,17 +307,26 @@ def parse_event_log(path: str) -> AppInfo:
                 (q.sharing_events if q is not None
                  else app.sharing_events).append(info)
             elif ev in ("HostJoin", "HostLoss", "MeshShrink",
-                        "FleetCacheFence"):
+                        "FleetCacheFence", "HostSuspect",
+                        "HostRecovered", "HostQuarantine", "HostRejoin",
+                        "HedgeFired", "HedgeWon"):
                 info = {k: rec[k] for k in
                         ("host", "pid", "hosts", "silentMs", "missed",
                          "fromHosts", "toHosts", "fromDevices",
                          "toDevices", "lostHosts", "reason", "action",
-                         "key", "writerEpoch", "fenceEpoch", "ts")
+                         "key", "writerEpoch", "fenceEpoch", "ts",
+                         "score", "factor", "point", "deadlineMs")
                         if k in rec}
                 info["kind"] = {"HostJoin": "join",
                                 "HostLoss": "loss",
                                 "MeshShrink": "shrink",
-                                "FleetCacheFence": "fence"}[ev]
+                                "FleetCacheFence": "fence",
+                                "HostSuspect": "suspect",
+                                "HostRecovered": "recovered",
+                                "HostQuarantine": "quarantine",
+                                "HostRejoin": "rejoin",
+                                "HedgeFired": "hedge_fired",
+                                "HedgeWon": "hedge_won"}[ev]
                 app.fleet.append(info)
             elif ev == "CostModelInvalid":
                 info = {k: rec[k] for k in ("reason",) if k in rec}
@@ -353,6 +367,7 @@ def parse_event_log(path: str) -> AppInfo:
                 q.spans = rec.get("spans", {}) or {}
                 q.sharing = rec.get("sharing", {}) or {}
                 q.planner = rec.get("planner", {}) or {}
+                q.fleet_health = rec.get("fleet", {}) or {}
                 q.admission = rec.get("admission", {}) or q.admission
                 app.queries.append(q)
     # queries that started but never ended (crash) count as failed

@@ -564,6 +564,8 @@ def fleet_stats(apps: List[AppInfo]) -> Dict[str, object]:
     the multi-host machinery (parallel/mesh.py, serving/fleetcache.py)."""
     joins = losses = shrinks = bumps = rejections = 0
     cross_hits = 0
+    suspects = recoveries = quarantines = rejoins = 0
+    hedges_fired = hedges_won = dup_suppressed = 0
     hosts: set = set()
     lost_hosts: set = set()
     for a in apps:
@@ -582,6 +584,21 @@ def fleet_stats(apps: List[AppInfo]) -> Dict[str, object]:
                     bumps += 1
                 elif ev.get("action") == "reject":
                     rejections += 1
+            elif kind == "suspect":
+                suspects += 1
+            elif kind == "recovered":
+                recoveries += 1
+            elif kind == "quarantine":
+                quarantines += 1
+            elif kind == "rejoin":
+                rejoins += 1
+            elif kind == "hedge_fired":
+                hedges_fired += 1
+            elif kind == "hedge_won":
+                hedges_won += 1
+        for q in a.queries:
+            fh = getattr(q, "fleet_health", {}) or {}
+            dup_suppressed += int(fh.get("duplicatesSuppressed", 0))
         for q in a.queries:
             for e in q.sharing_events:
                 if e.get("kind") in ("hit", "splice") and \
@@ -592,7 +609,8 @@ def fleet_stats(apps: List[AppInfo]) -> Dict[str, object]:
             if e.get("kind") in ("hit", "splice") and \
                     e.get("tier") == "fleet" and e.get("crossProcess"):
                 cross_hits += 1
-    if not (joins or losses or shrinks or bumps or rejections):
+    if not (joins or losses or shrinks or bumps or rejections
+            or suspects or hedges_fired or quarantines or rejoins):
         return {}
     return {
         "hosts_seen": len(hosts),
@@ -603,6 +621,13 @@ def fleet_stats(apps: List[AppInfo]) -> Dict[str, object]:
         "fence_bumps": bumps,
         "fenced_publishes": rejections,
         "fleet_cross_hits": cross_hits,
+        "suspects": suspects,
+        "suspect_recoveries": recoveries,
+        "quarantines": quarantines,
+        "rejoins": rejoins,
+        "hedges_fired": hedges_fired,
+        "hedges_won": hedges_won,
+        "duplicates_suppressed": dup_suppressed,
     }
 
 
@@ -659,6 +684,33 @@ def _fleet_problems(a: AppInfo) -> List[str]:
             "zombie-writer protection worked and no reader saw the "
             "entry, but a fenced-out process is still running "
             "somewhere; make sure the lost host actually died")
+    # gray-failure checks: a SUSPECT verdict that never led anywhere
+    # (no hedge, no quarantine, no recovery — detection without
+    # mitigation is just latency), and hedges that never won (the
+    # duplicate work bought nothing — the deadline fires too early or
+    # the "healthy" path is just as slow)
+    suspect_hosts = {ev.get("host") for ev in a.fleet
+                     if ev.get("kind") == "suspect"}
+    mitigated = {ev.get("host") for ev in a.fleet
+                 if ev.get("kind") in ("quarantine", "recovered",
+                                       "rejoin", "hedge_fired",
+                                       "hedge_won")}
+    for h in sorted(h for h in suspect_hosts
+                    if h not in mitigated and h is not None):
+        problems.append(
+            f"{who}: host {h} went SUSPECT but was never mitigated — "
+            "no hedge fired, no quarantine, no recovery; the fleet "
+            "kept waiting on the slow host. Lower "
+            "fleet.quarantineAfterMs or check the hedge-eligible "
+            "paths actually ran")
+    fired = sum(1 for ev in a.fleet if ev.get("kind") == "hedge_fired")
+    won = sum(1 for ev in a.fleet if ev.get("kind") == "hedge_won")
+    if fired and not won:
+        problems.append(
+            f"{who}: {fired} hedge(s) fired but ZERO won — the "
+            "primary always beat the re-dispatch, so the hedging cost "
+            "bought nothing; raise fleet.hedgeMarginFactor/"
+            "hedgePercentile so hedges fire only on real stalls")
     return problems
 
 
@@ -1522,6 +1574,35 @@ def format_report(apps: List[AppInfo], top: int) -> str:
             f"fenceBumps={fl['fence_bumps']} "
             f"fencedPublishes={fl['fenced_publishes']} "
             f"fleetCrossHits={fl['fleet_cross_hits']}")
+        if fl.get("suspects") or fl.get("hedges_fired") \
+                or fl.get("quarantines") or fl.get("rejoins"):
+            out.append("\n-- Fleet health --")
+            out.append(
+                f"  suspects={fl['suspects']} "
+                f"recoveries={fl['suspect_recoveries']} "
+                f"quarantines={fl['quarantines']} "
+                f"rejoins={fl['rejoins']} "
+                f"hedgesFired={fl['hedges_fired']} "
+                f"hedgesWon={fl['hedges_won']} "
+                f"duplicatesSuppressed={fl['duplicates_suppressed']}")
+            # per-host score timeline: each state transition with the
+            # score that drove it, in log order — the gray-failure
+            # post-mortem trail (when did it go bad, how bad, when did
+            # it come back)
+            for a in apps:
+                line = []
+                for ev in a.fleet:
+                    k = ev.get("kind")
+                    if k in ("suspect", "recovered", "quarantine",
+                             "rejoin"):
+                        sc = ev.get("score")
+                        tag = f"{k}@host{ev.get('host')}"
+                        if sc is not None:
+                            tag += f"(x{sc})"
+                        line.append(tag)
+                if line:
+                    out.append(
+                        f"  {a.session_id}: " + " -> ".join(line))
     problems = health_check(apps)
     out.append("\n-- Health check --")
     if problems:

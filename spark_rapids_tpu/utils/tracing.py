@@ -235,6 +235,19 @@ def observe_site(site, **fields) -> None:
     _obs.observe(site_id(site), **fields)
 
 
+def observe_host(host: int, point: str, **fields) -> None:
+    """Record per-HOST evidence into the observation store — the
+    gray-failure per-host axis beside the structural per-site axis.
+    Sites are sha-hashed structural ids; host records use the stable
+    human-readable ``host<h>@<point>`` form so the profiling per-host
+    history and a fresh process's HostHealthTracker can read them
+    back without a reverse mapping.  No-op when tracing is off or no
+    store is configured."""
+    if not _armed or _obs is None:
+        return
+    _obs.observe(f"host{int(host)}@{point}", **fields)
+
+
 # ------------------------------------------------------------ configure --
 
 def configure(enabled: bool, trace_dir: Optional[str] = None,

@@ -1,0 +1,403 @@
+"""Gray-failure resilience (robustness/grayfailure.py): fail-slow
+detection, hedged shard execution, proactive quarantine/rejoin, and
+self-calibrating watchdog deadlines — all under the logical-host fleet
+simulation (fleet.logicalHosts partitions the 8-device CPU mesh into 2
+"hosts"), so the whole fail-SLOW story runs tier-1 just like PR 18's
+fail-stop story:
+
+- a host persistently slower than the fleet baseline becomes SUSPECT
+  (typed HostSuspect event, never a hard fault) and recovers when its
+  walls do;
+- a SUSPECT host's wedged host-staging shard is hedged: the healthy
+  re-dispatch wins, the loser is suppressed, the answer is
+  bit-identical and the ladder records NOTHING (a hedge is not a
+  fault);
+- SUSPECT past quarantineAfterMs soft-shrinks the mesh (fence bump),
+  recovery past rejoinAfterMs restores it (fence bump AGAIN — the
+  epoch advances twice across the round trip) and the full-mesh query
+  oracle-matches;
+- heartbeat records survive torn writes (last-good-record cache), and
+  the beat file carries the gossiped per-point walls;
+- calibrated deadlines derive from observed p99 with floor/ceiling
+  clamps, and explicit per-point confs still win.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.parallel.mesh import HostMembership
+from spark_rapids_tpu.robustness import grayfailure as gf
+from spark_rapids_tpu.robustness import inject as I
+from spark_rapids_tpu.robustness.faults import HostLossFault
+
+STAGING = "exchange.host_staging"
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    I.clear()
+    with I.scoped_rules():
+        yield
+
+
+@pytest.fixture
+def gray_session(tmp_path):
+    """Factory for logical-host fleet sessions with gray failure armed
+    (small windows so the suspect/quarantine/rejoin clocks run at test
+    speed); stops every session it made."""
+    made = []
+
+    def make(**extra):
+        conf = {
+            "spark.rapids.sql.distributed.numShards": "8",
+            "spark.rapids.tpu.fleet.logicalHosts": "2",
+            "spark.rapids.tpu.fleet.membershipDir":
+                str(tmp_path / "members"),
+            "spark.rapids.tpu.fleet.grayFailure.enabled": True,
+            "spark.rapids.tpu.fleet.suspectWindow": 8,
+            "spark.rapids.sql.recovery.backoffMs": 1,
+        }
+        conf.update(extra)
+        s = TpuSession(conf)
+        made.append(s)
+        return s
+
+    yield make
+    for s in made:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _pdf(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({"k": rng.integers(0, 13, n),
+                         "v": rng.normal(10.0, 3.0, n)})
+
+
+def _groupby_query(session, pdf):
+    return (session.create_dataframe(pdf)
+            .group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv"),
+                 F.count(F.col("v")).alias("n")))
+
+
+def _norm(df):
+    return df.sort_values("k", ignore_index=True)
+
+
+def _prime_suspect(tracker, host=1, slow_ms=100.0, fast_ms=10.0, n=8):
+    """Feed asymmetric staging walls so ``host`` scores SUSPECT."""
+    for _ in range(n):
+        tracker.observe_wall(0, STAGING, fast_ms)
+        tracker.observe_wall(1, STAGING, slow_ms if host == 1
+                             else fast_ms)
+    tracker.poll()
+
+
+# ------------------------------------------------------------ detection --
+def test_suspect_detection_and_recovery(gray_session):
+    s = gray_session()
+    t = s.gray_health
+    assert t is not None and s.gray_deadlines is not None
+    _prime_suspect(t)
+    assert t.score(1) == pytest.approx(10.0)
+    assert t.state[1] == gf.SUSPECT
+    assert t.is_suspect(1)
+    assert t.counters["suspects"] == 1
+    assert [tr["kind"] for tr in t.transitions] == ["suspect"]
+    # walls back to fleet speed -> recovery, not quarantine
+    for _ in range(8):
+        t.observe_wall(1, STAGING, 10.0)
+    t.poll()
+    assert t.state[1] == gf.HEALTHY
+    assert t.counters["recoveries"] == 1
+    # detection alone never touched the ladder or the mesh
+    assert s.recovery_log == []
+    assert int(s.mesh.devices.size) == 8
+
+
+def test_gray_off_is_bit_identical(gray_session):
+    pdf = _pdf(seed=3)
+    s_on = gray_session()
+    on = _norm(_groupby_query(s_on, pdf).to_pandas())
+    s_on.stop()
+    s_off = gray_session(**{
+        "spark.rapids.tpu.fleet.grayFailure.enabled": False})
+    assert s_off.gray_health is None and s_off.gray_deadlines is None
+    off = _norm(_groupby_query(s_off, pdf).to_pandas())
+    pd.testing.assert_frame_equal(on, off)
+
+
+# -------------------------------------------------------------- hedging --
+def _staged_join_query(session, fact, dim):
+    """The known staging shape (test_shuffle_packed's acceptance): a
+    shuffle join + aggregate whose exchanges route through host RAM
+    once ``hostStaging.thresholdBytes`` is floored."""
+    return (session.create_dataframe(fact)
+            .join(session.create_dataframe(dim), on="k")
+            .group_by("k")
+            .agg(F.sum(F.col("v")).alias("sv"),
+                 F.sum(F.col("w")).alias("sw")))
+
+
+@pytest.mark.chaos
+def test_hedge_exactly_once(gray_session, tmp_path):
+    """A SUSPECT host's wedged staging shard is re-dispatched on the
+    healthy path: first result wins, the answer is bit-identical, the
+    suppressed duplicate is counted, the ladder records NOTHING, and
+    the hedge counters are pinned on the query's QueryEnd."""
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    evd = str(tmp_path / "events")
+    s = gray_session(**{
+        "spark.rapids.tpu.exchange.hostStaging.thresholdBytes": 1,
+        "spark.rapids.sql.join.broadcastThresholdRows": 1,
+        # the logical-host sim auto-picks the DCN gather strategy,
+        # which never host-stages; pin the ICI collective so the
+        # staging tier (the hedgeable path) engages
+        "spark.rapids.tpu.shuffle.topology.strategy": "all_to_all",
+        "spark.rapids.tpu.fleet.hedgeFloorMs": 25,
+        "spark.rapids.tpu.eventLog.dir": evd,
+    })
+    rng = np.random.default_rng(11)
+    fact = pd.DataFrame({"k": rng.integers(0, 300, 4000),
+                         "v": rng.normal(size=4000)})
+    dim = pd.DataFrame({"k": np.arange(300),
+                        "w": rng.normal(size=300)})
+    want = _norm(_staged_join_query(s, fact, dim).to_pandas())
+    assert s.exchange_overlap_metrics.snapshot()[
+        "hostStagedExchanges"] >= 2  # the shape really stages
+    t = s.gray_health
+    _prime_suspect(t)
+    assert t.is_suspect(1)
+    rule = I.inject(STAGING, kind="delay", delay_s=0.4, count=1)
+    got = _norm(_staged_join_query(s, fact, dim).to_pandas())
+    pd.testing.assert_frame_equal(got, want)
+    assert rule.fired == 1  # the wedge hit the PRIMARY leg only
+    c = t.query_counters()
+    assert c["hedgesFired"] == 1, c
+    assert c["hedgesWon"] == 1, c
+    # a hedge is not a fault: the recovery ladder never engaged
+    assert s.recovery_log == [], s.recovery_log
+    # the abandoned primary eventually unwedges; its late result is
+    # the suppressed duplicate — exactly one result ever surfaced
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            t.query_counters()["duplicatesSuppressed"] < 1:
+        time.sleep(0.01)
+    assert t.query_counters()["duplicatesSuppressed"] == 1
+    s.stop()
+    apps = load_logs(evd)
+    assert apps
+    fleets = [q.fleet_health for a in apps for q in a.queries
+              if q.fleet_health]
+    assert any(fh.get("hedgesFired", 0) >= 1 and
+               fh.get("hedgesWon", 0) >= 1 for fh in fleets), fleets
+    kinds = [e["kind"] for a in apps for e in a.fleet]
+    assert "suspect" in kinds and "hedge_fired" in kinds \
+        and "hedge_won" in kinds, kinds
+
+
+def test_hedged_call_relays_primary_error(gray_session):
+    """A fast-failing primary's exception surfaces unchanged (no hedge
+    fired): hedging must never swallow or duplicate a fault."""
+    s = gray_session()
+    t = s.gray_health
+    _prime_suspect(t)
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad():
+        raise Boom("primary fault")
+
+    with pytest.raises(Boom):
+        gf.hedged_call(s, STAGING, 1, bad)
+    assert t.query_counters()["hedgesFired"] == 0
+
+
+def test_hedged_call_passthrough_when_healthy(gray_session):
+    """No suspect host -> exactly fn(), zero hedge machinery."""
+    s = gray_session()
+    assert gf.hedged_call(s, STAGING, -1, lambda: 7) == 7
+    assert gf.hedged_call(s, STAGING, 1, lambda: 8) == 8  # healthy
+    assert s.gray_health.query_counters()["hedgesFired"] == 0
+
+
+# -------------------------------------------------- quarantine / rejoin --
+@pytest.mark.chaos
+def test_quarantine_then_rejoin_fence_epoch_twice(gray_session,
+                                                  tmp_path):
+    """The full soft-shrink round trip: SUSPECT past quarantineAfterMs
+    drains the host at the next query boundary (mesh shrinks, fence
+    bumps), recovery past rejoinAfterMs restores it (mesh back to full,
+    fence bumps AGAIN), and queries oracle-match on every layout."""
+    from spark_rapids_tpu.tools import profiling
+    from spark_rapids_tpu.tools.eventlog import load_logs
+    evd = str(tmp_path / "events")
+    s = gray_session(**{
+        "spark.rapids.tpu.fleet.quarantineAfterMs": 30,
+        "spark.rapids.tpu.fleet.rejoinAfterMs": 30,
+        "spark.rapids.tpu.fleet.cache.dir": str(tmp_path / "fcache"),
+        "spark.rapids.tpu.eventLog.dir": evd,
+    })
+    pdf = _pdf(seed=5)
+    oracle = (pdf.groupby("k", as_index=False)
+              .agg(sv=("v", "sum"), n=("v", "count")))
+    oracle["n"] = oracle["n"].astype(np.int64)
+
+    want = _norm(_groupby_query(s, pdf).to_pandas())
+    pd.testing.assert_frame_equal(
+        want, _norm(oracle), check_dtype=False)
+    assert int(s.mesh.devices.size) == 8
+    e0 = s.fleet_epoch
+
+    t = s.gray_health
+    _prime_suspect(t)
+    time.sleep(0.05)  # outlast quarantineAfterMs
+    got = _norm(_groupby_query(s, pdf).to_pandas())  # boundary drains
+    pd.testing.assert_frame_equal(got, want)
+    assert int(s.mesh.devices.size) == 4  # host 1 drained
+    assert s.fleet_epoch == e0 + 1
+    assert t.state[1] == gf.QUARANTINED
+    assert 1 in s._quarantined
+    # quarantine is NOT loss: the membership registry never judged it
+    assert 1 not in s.fleet_membership.lost
+
+    # the host recovers: fleet-speed walls, sustained past the rejoin
+    # window -> next boundary folds it back in
+    for _ in range(8):
+        t.observe_wall(1, STAGING, 10.0)
+    t.poll()
+    time.sleep(0.05)
+    got = _norm(_groupby_query(s, pdf).to_pandas())  # boundary rejoins
+    pd.testing.assert_frame_equal(got, want)
+    assert int(s.mesh.devices.size) == 8  # full mesh restored
+    assert s.fleet_epoch == e0 + 2  # fence advanced TWICE
+    assert t.state[1] == gf.HEALTHY
+    assert s._quarantined == set()
+    c = t.query_counters()
+    assert c["quarantines"] == 1 and c["rejoins"] == 1, c
+    s.stop()
+
+    apps = load_logs(evd)
+    kinds = [e["kind"] for a in apps for e in a.fleet]
+    for k in ("suspect", "quarantine", "rejoin", "fence"):
+        assert k in kinds, kinds
+    stats = profiling.fleet_stats(apps)
+    assert stats["quarantines"] == 1 and stats["rejoins"] == 1
+    report = profiling.format_report(apps, top=5)
+    assert "Fleet health" in report
+    assert "quarantine@host1" in report, report
+
+
+def test_quarantine_never_targets_self_or_last_host(gray_session):
+    s = gray_session()
+    assert not s.quarantine_host(0)  # our own host
+    assert not s.quarantine_host(7)  # not in the mesh
+    assert s.quarantine_host(1)
+    # with host 1 out there is no second host left to drain
+    assert not s.quarantine_host(1)
+    assert s.rejoin_fleet_mesh(1)
+    assert not s.rejoin_fleet_mesh(1)  # already home
+
+
+# ------------------------------------------------- heartbeat integrity --
+def test_torn_heartbeat_write_regression(tmp_path):
+    """A torn/corrupt beat file must NOT fail the reader or falsely
+    kill the peer: the last good record answers (age-out by silence is
+    the only path to a loss judgment)."""
+    d = str(tmp_path / "members")
+    m0 = HostMembership(d, host_id=0, n_hosts=2, heartbeat_ms=30,
+                        missed_fatal=3)
+    m1 = HostMembership(d, host_id=1, n_hosts=2, heartbeat_ms=30,
+                        missed_fatal=3)
+    m1.beat(force=True)
+    m0.beat(force=True)
+    m0.check()  # healthy
+    path = os.path.join(d, "host-1.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"ts": 17')  # torn mid-record
+    # immediately after the tear: cached record answers, no fault
+    m0.check()
+    assert 1 not in m0.lost
+    # the tear never heals and the silence window passes: the cached
+    # record ages out and the ordinary loss judgment fires
+    time.sleep(0.12)
+    with pytest.raises(HostLossFault):
+        m0.check()
+    assert 1 in m0.lost
+
+
+def test_beat_write_is_atomic_and_carries_walls(gray_session,
+                                                tmp_path):
+    """The beat write follows temp+fsync+replace (no *.tmp droppings)
+    and gossips the host's local per-point median walls so peers can
+    score it without sharing memory."""
+    s = gray_session()
+    t = s.gray_health
+    for _ in range(4):
+        t.observe_wall(t.host, STAGING, 12.0)
+    m = s.fleet_membership
+    m.beat(force=True)
+    rec = json.load(open(os.path.join(m.dir, f"host-{m.host}.json")))
+    assert rec["walls"][STAGING] == pytest.approx(12.0)
+    assert not [f for f in os.listdir(m.dir) if ".tmp" in f]
+
+
+# ------------------------------------------------ deadline calibration --
+def test_deadline_calibrator_clamps():
+    cal = gf.DeadlineCalibrator(floor_ms=50, ceiling_ms=1000,
+                                margin=4.0, min_samples=8)
+    for i in range(7):
+        cal.observe("p", 100.0)
+    assert cal.deadline_ms("p") is None  # below minSamples
+    cal.observe("p", 100.0)
+    assert cal.deadline_ms("p") == pytest.approx(400.0)  # p99 * margin
+    for _ in range(8):
+        cal.observe("q", 1.0)
+    assert cal.deadline_ms("q") == 50.0  # floor
+    for _ in range(8):
+        cal.observe("r", 1e6)
+    assert cal.deadline_ms("r") == 1000.0  # ceiling
+    assert set(cal.snapshot()) == {"p", "q", "r"}
+
+
+def test_calibrated_deadline_resolution(gray_session):
+    """The watchdog's implicit default comes from the calibrator once
+    evidence accumulates; an explicit per-point conf still wins."""
+    from spark_rapids_tpu.robustness import watchdog
+    s = gray_session(**{
+        "spark.rapids.tpu.watchdog.calibration.floorMs": 50,
+    })
+    point = "dist.host_sync"
+    assert watchdog._resolve_deadline_ms(point, None, s) == 300_000.0
+    for _ in range(8):
+        s.gray_deadlines.observe(point, 100.0)
+    assert watchdog._resolve_deadline_ms(point, None, s) \
+        == pytest.approx(400.0)
+    # explicit argument and explicit per-point conf both beat it
+    assert watchdog._resolve_deadline_ms(point, 77, s) == 77.0
+    s2 = gray_session(**{
+        "spark.rapids.tpu.watchdog.deadline.dist.host_sync": 123,
+    })
+    for _ in range(8):
+        s2.gray_deadlines.observe(point, 100.0)
+    assert watchdog._resolve_deadline_ms(point, None, s2) == 123.0
+
+
+def test_sections_feed_calibrator(gray_session):
+    """Clean watchdog section exits are the calibrator's evidence
+    source — a query's host syncs populate the per-point walls."""
+    s = gray_session()
+    _groupby_query(s, _pdf(seed=2)).to_pandas()
+    walls = s.gray_deadlines._walls
+    assert any(len(dq) > 0 for dq in walls.values()), dict(walls)
